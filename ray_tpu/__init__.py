@@ -1,0 +1,57 @@
+"""ray_tpu: a TPU-native distributed AI framework.
+
+Core primitives (tasks, actors, objects, placement groups) over an
+ownership-based kernel, plus AI libraries (train / tune / data / serve / rl)
+and a native JAX parallelism layer (DP/FSDP/TP/PP/SP/EP over device meshes).
+
+Import stays light: no JAX at import time — the compute-path modules
+(ray_tpu.parallel, ray_tpu.models, ray_tpu.train, ...) import JAX lazily so
+the cluster kernel starts fast in worker processes.
+"""
+
+from ray_tpu import exceptions  # noqa: F401
+from ray_tpu._raylet import ObjectRef, ObjectRefGenerator  # noqa: F401
+from ray_tpu.actor import ActorClass, ActorHandle  # noqa: F401
+from ray_tpu.api import (  # noqa: F401
+    available_resources,
+    cancel,
+    cluster_resources,
+    get,
+    get_actor,
+    init,
+    is_initialized,
+    kill,
+    nodes,
+    put,
+    remote,
+    shutdown,
+    wait,
+)
+from ray_tpu.remote_function import RemoteFunction  # noqa: F401
+from ray_tpu.runtime_context import get_runtime_context  # noqa: F401
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "ObjectRef",
+    "ObjectRefGenerator",
+    "ActorClass",
+    "ActorHandle",
+    "RemoteFunction",
+    "available_resources",
+    "cancel",
+    "cluster_resources",
+    "exceptions",
+    "get",
+    "get_actor",
+    "get_runtime_context",
+    "init",
+    "is_initialized",
+    "kill",
+    "nodes",
+    "put",
+    "remote",
+    "shutdown",
+    "wait",
+    "__version__",
+]

@@ -1,0 +1,120 @@
+"""Env-overridable config registry.
+
+Equivalent of the reference's `RAY_CONFIG(type, name, default)` table
+(ray: src/ray/common/ray_config_def.h) — every knob can be overridden with an
+`RT_<NAME>` environment variable or via `ray_tpu.init(_system_config={...})`,
+and the chosen values are propagated to every spawned process through the
+`RT_SYSTEM_CONFIG` env var (JSON).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Dict
+
+_ENV_PREFIX = "RT_"
+_SYSTEM_CONFIG_ENV = "RT_SYSTEM_CONFIG"
+
+
+class _Config:
+    def __init__(self):
+        self._defaults: Dict[str, Any] = {}
+        self._values: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def define(self, name: str, default: Any) -> None:
+        self._defaults[name] = default
+
+    def get(self, name: str) -> Any:
+        with self._lock:
+            if name in self._values:
+                return self._values[name]
+        if name not in self._defaults:
+            raise KeyError(f"unknown config {name}")
+        default = self._defaults[name]
+        env = os.environ.get(_ENV_PREFIX + name.upper())
+        if env is not None:
+            return _coerce(env, default)
+        return default
+
+    def set(self, name: str, value: Any) -> None:
+        if name not in self._defaults:
+            raise KeyError(f"unknown config {name}")
+        with self._lock:
+            self._values[name] = value
+
+    def apply_system_config(self, overrides: Dict[str, Any]) -> None:
+        for k, v in overrides.items():
+            self.set(k, v)
+
+    def load_from_env(self) -> None:
+        raw = os.environ.get(_SYSTEM_CONFIG_ENV)
+        if raw:
+            self.apply_system_config(json.loads(raw))
+
+    def serialized_overrides(self) -> str:
+        with self._lock:
+            return json.dumps(self._values)
+
+    def __getattr__(self, name: str) -> Any:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return self.get(name)
+
+
+def _coerce(raw: str, default: Any) -> Any:
+    if isinstance(default, bool):
+        return raw.lower() in ("1", "true", "yes")
+    if isinstance(default, int):
+        return int(raw)
+    if isinstance(default, float):
+        return float(raw)
+    return raw
+
+
+CONFIG = _Config()
+_d = CONFIG.define
+
+# --- kernel timing -----------------------------------------------------------
+_d("heartbeat_period_ms", 250)          # raylet -> GCS resource report period
+_d("health_check_period_ms", 1000)      # GCS -> raylet liveness probe period
+_d("health_check_failure_threshold", 5)
+_d("worker_register_timeout_s", 30.0)
+_d("worker_lease_idle_timeout_ms", 1000)  # submitter returns cached leases after this
+_d("worker_pool_idle_timeout_s", 60.0)    # raylet kills idle spare workers
+_d("worker_pool_prestart", 0)
+_d("rpc_connect_timeout_s", 10.0)
+_d("rpc_call_timeout_s", 60.0)
+
+# --- objects -----------------------------------------------------------------
+_d("max_direct_call_object_size", 100 * 1024)  # inline threshold (bytes)
+_d("object_store_memory_bytes", 2 * 1024**3)   # per-node plasma capacity
+_d("object_store_fallback_dir", "/tmp/ray_tpu_spill")
+_d("fetch_retry_interval_ms", 100)
+_d("max_lineage_bytes", 64 * 1024**2)
+_d("enable_lineage_reconstruction", True)
+
+# --- tasks / actors ----------------------------------------------------------
+_d("default_task_num_cpus", 1.0)
+_d("default_actor_num_cpus", 1.0)
+_d("task_retry_delay_ms", 0)
+_d("actor_restart_delay_ms", 100)
+_d("max_pending_lease_requests_per_scheduling_key", 10)
+_d("streaming_generator_backpressure_objects", -1)  # -1 = unbounded
+
+# --- scheduling --------------------------------------------------------------
+_d("scheduler_spread_threshold", 0.5)  # hybrid policy: pack below this utilization
+_d("scheduler_top_k_fraction", 0.2)
+_d("max_tasks_in_flight_per_worker", 1)
+
+# --- gcs ---------------------------------------------------------------------
+_d("gcs_storage_path", "")  # "" = pure in-memory; path = snapshot for restart
+_d("maximum_gcs_dead_node_cache_count", 1000)
+
+# --- logging -----------------------------------------------------------------
+_d("log_dir", "/tmp/ray_tpu/logs")
+_d("log_to_driver", True)
+
+CONFIG.load_from_env()

@@ -1,0 +1,69 @@
+"""Validation/merging of `@remote`/`.options()` arguments.
+
+Reference: ray python/ray/_private/ray_option_utils.py — the single table that
+validates every option a task or actor can carry.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ray_tpu._private.config import CONFIG
+
+_COMMON_OPTIONS = {
+    "num_cpus", "num_gpus", "num_tpus", "resources", "max_retries",
+    "retry_exceptions", "num_returns", "scheduling_strategy", "name",
+    "namespace", "lifetime", "max_restarts", "max_task_retries",
+    "max_concurrency", "get_if_exists", "runtime_env", "memory",
+    "placement_group", "placement_group_bundle_index",
+    "max_pending_calls", "concurrency_groups", "label_selector",
+    "_metadata",
+}
+
+TASK_ONLY = {"max_retries", "retry_exceptions"}
+ACTOR_ONLY = {
+    "max_restarts", "max_task_retries", "max_concurrency", "lifetime",
+    "get_if_exists", "max_pending_calls", "concurrency_groups",
+}
+
+
+def validate_options(options: Dict[str, Any], *, is_actor: bool) -> Dict[str, Any]:
+    for k in options:
+        if k not in _COMMON_OPTIONS:
+            raise ValueError(f"Unknown option '{k}'")
+        if is_actor and k in TASK_ONLY:
+            raise ValueError(f"Option '{k}' is only valid for tasks")
+        if not is_actor and k in ACTOR_ONLY:
+            raise ValueError(f"Option '{k}' is only valid for actors")
+    nr = options.get("num_returns")
+    if nr is not None and nr != "streaming" and (not isinstance(nr, int) or nr < 0):
+        raise ValueError("num_returns must be a non-negative int or 'streaming'")
+    for key in ("num_cpus", "num_gpus", "num_tpus", "memory"):
+        v = options.get(key)
+        if v is not None and (not isinstance(v, (int, float)) or v < 0):
+            raise ValueError(f"{key} must be a non-negative number")
+    return options
+
+
+def resources_from_options(options: Dict[str, Any], *, is_actor: bool):
+    resources = dict(options.get("resources") or {})
+    if "num_cpus" in options and options["num_cpus"] is not None:
+        resources["CPU"] = float(options["num_cpus"])
+    else:
+        resources.setdefault(
+            "CPU",
+            CONFIG.default_actor_num_cpus if is_actor else CONFIG.default_task_num_cpus,
+        )
+    if options.get("num_gpus"):
+        resources["GPU"] = float(options["num_gpus"])
+    if options.get("num_tpus"):
+        resources["TPU"] = float(options["num_tpus"])
+    if options.get("memory"):
+        resources["memory"] = float(options["memory"])
+    return resources
+
+
+def merge_options(base: Optional[Dict[str, Any]], overrides: Dict[str, Any]):
+    out = dict(base or {})
+    out.update(overrides)
+    return out

@@ -1,0 +1,394 @@
+"""L1: RPC + pubsub transport.
+
+Plays the role of the reference's gRPC wrappers (ray: src/ray/rpc/grpc_server.cc,
+client_call.h) and long-poll pubsub (src/ray/pubsub/): every control-plane
+boundary (GCS services, raylet lease protocol, worker task push, object
+service) is a method on an `RpcServer`, and clients hold persistent
+connections with request-id correlation. Transport is asyncio TCP with
+4-byte-length-prefixed pickle frames; good for localhost and DCN. Data-plane
+payloads ride the same connections as out-of-band bytes (no double pickling).
+
+Also provides `EventLoopThread` — the per-component io_context equivalent of
+the reference's instrumented asio loops (src/ray/common/asio/).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+import pickle
+import socket
+import threading
+import time
+from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+_REQUEST = 0
+_REPLY_OK = 1
+_REPLY_ERR = 2
+_ONEWAY = 3
+
+
+class RpcError(Exception):
+    pass
+
+
+class ConnectionLost(RpcError):
+    pass
+
+
+def _addr_str(addr: Tuple[str, int]) -> str:
+    return f"{addr[0]}:{addr[1]}"
+
+
+def parse_addr(addr: str) -> Tuple[str, int]:
+    host, port = addr.rsplit(":", 1)
+    return host, int(port)
+
+
+class EventLoopThread:
+    """A dedicated asyncio loop on a daemon thread (asio io_context analogue)."""
+
+    def __init__(self, name: str = "rt-io"):
+        self.loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(target=self._run, name=name, daemon=True)
+        self._started = threading.Event()
+        self._thread.start()
+        self._started.wait()
+
+    def _run(self):
+        asyncio.set_event_loop(self.loop)
+        self.loop.call_soon(self._started.set)
+        self.loop.run_forever()
+
+    def run_coro(self, coro: Awaitable, timeout: Optional[float] = None):
+        """Run a coroutine on the loop from another thread; block for result."""
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return fut.result(timeout)
+
+    def submit(self, coro: Awaitable):
+        """Fire-and-forget a coroutine onto the loop."""
+        return asyncio.run_coroutine_threadsafe(coro, self.loop)
+
+    def stop(self):
+        def _shutdown():
+            tasks = [t for t in asyncio.all_tasks(self.loop)
+                     if t is not asyncio.current_task(self.loop)]
+            for task in tasks:
+                task.cancel()
+
+            async def _drain():
+                await asyncio.gather(*tasks, return_exceptions=True)
+                self.loop.stop()
+
+            asyncio.ensure_future(_drain())
+
+        if self.loop.is_running():
+            self.loop.call_soon_threadsafe(_shutdown)
+        self._thread.join(timeout=2.0)
+
+
+async def _read_frame(reader: asyncio.StreamReader) -> Any:
+    header = await reader.readexactly(4)
+    length = int.from_bytes(header, "little")
+    payload = await reader.readexactly(length)
+    return pickle.loads(payload)
+
+
+def _frame(msg: Any) -> bytes:
+    payload = pickle.dumps(msg, protocol=5)
+    return len(payload).to_bytes(4, "little") + payload
+
+
+class RpcServer:
+    """Asyncio TCP server dispatching named methods.
+
+    Handlers are async callables `(payload) -> reply` registered by name.
+    Runs on a caller-provided event loop (so one component = one loop thread
+    serving many roles, like the reference's asio services).
+    """
+
+    def __init__(self, loop_thread: EventLoopThread, host: str = "127.0.0.1"):
+        self._lt = loop_thread
+        self._host = host
+        self._handlers: Dict[str, Callable[[Any], Awaitable[Any]]] = {}
+        self._server: Optional[asyncio.AbstractServer] = None
+        self.address: Optional[str] = None
+        self._conn_lost_cb: Optional[Callable] = None
+
+    def register(self, method: str, handler: Callable[[Any], Awaitable[Any]]):
+        self._handlers[method] = handler
+
+    def register_all(self, obj, prefix: str = ""):
+        """Register every `handle_*` coroutine method of obj."""
+        for name in dir(obj):
+            if name.startswith("handle_"):
+                self.register(prefix + name[len("handle_"):], getattr(obj, name))
+
+    def on_connection_lost(self, cb: Callable[[Any], None]):
+        """cb(peer_meta) invoked when a registered peer's connection drops."""
+        self._conn_lost_cb = cb
+
+    def start(self, port: int = 0) -> str:
+        async def _start():
+            self._server = await asyncio.start_server(
+                self._handle_conn, self._host, port
+            )
+            sock = self._server.sockets[0]
+            return sock.getsockname()[:2]
+
+        host, bound_port = self._lt.run_coro(_start())
+        self.address = f"{self._host}:{bound_port}"
+        return self.address
+
+    def stop(self):
+        async def _stop():
+            if self._server is not None:
+                self._server.close()
+                await self._server.wait_closed()
+
+        try:
+            self._lt.run_coro(_stop(), timeout=2.0)
+        except Exception:
+            pass
+
+    async def _handle_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        peer_meta: Dict[str, Any] = {}
+        write_lock = asyncio.Lock()
+        try:
+            while True:
+                msg = await _read_frame(reader)
+                kind, msg_id, method, payload = msg
+                if method == "_register_peer":
+                    peer_meta.update(payload)
+                    async with write_lock:
+                        writer.write(_frame((_REPLY_OK, msg_id, None, None)))
+                        await writer.drain()
+                    continue
+                handler = self._handlers.get(method)
+                if handler is None:
+                    if kind == _REQUEST:
+                        async with write_lock:
+                            writer.write(_frame((_REPLY_ERR, msg_id, None,
+                                                 RpcError(f"no handler {method}"))))
+                            await writer.drain()
+                    continue
+                asyncio.ensure_future(
+                    self._dispatch(handler, kind, msg_id, method, payload, writer, write_lock)
+                )
+        except (asyncio.IncompleteReadError, ConnectionResetError, BrokenPipeError):
+            pass
+        except Exception:
+            logger.exception("rpc server connection error")
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+            if peer_meta and self._conn_lost_cb is not None:
+                try:
+                    self._conn_lost_cb(peer_meta)
+                except Exception:
+                    logger.exception("connection-lost callback failed")
+
+    async def _dispatch(self, handler, kind, msg_id, method, payload, writer, write_lock):
+        try:
+            reply = await handler(payload)
+            if kind == _REQUEST:
+                frame = _frame((_REPLY_OK, msg_id, None, reply))
+        except Exception as e:
+            if kind == _REQUEST:
+                try:
+                    frame = _frame((_REPLY_ERR, msg_id, None, e))
+                except Exception:
+                    frame = _frame((_REPLY_ERR, msg_id, None, RpcError(str(e))))
+            else:
+                logger.exception("error in oneway handler %s", method)
+                return
+        if kind == _REQUEST:
+            try:
+                async with write_lock:
+                    writer.write(frame)
+                    await writer.drain()
+            except (ConnectionResetError, BrokenPipeError):
+                pass
+
+
+class RpcClient:
+    """Persistent connection to an RpcServer with request-id correlation.
+
+    Thread-safe sync facade (`call`, `send`) over the owning EventLoopThread;
+    async variants for use on the loop itself. Lazily connects; `call` raises
+    ConnectionLost when the peer is gone (callers implement retry policy, like
+    the reference's retryable gRPC clients).
+    """
+
+    def __init__(self, address: str, loop_thread: EventLoopThread,
+                 peer_meta: Optional[dict] = None):
+        self.address = address
+        self._lt = loop_thread
+        self._peer_meta = peer_meta
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._msg_ids = itertools.count()
+        self._connect_lock: Optional[asyncio.Lock] = None
+        self._closed = False
+
+    async def _ensure_connected(self):
+        if self._connect_lock is None:
+            self._connect_lock = asyncio.Lock()
+        async with self._connect_lock:
+            if self._writer is not None and not self._writer.is_closing():
+                return
+            host, port = parse_addr(self.address)
+            self._reader, self._writer = await asyncio.open_connection(host, port)
+            asyncio.ensure_future(self._read_loop(self._reader))
+            if self._peer_meta:
+                await self._call_async_locked("_register_peer", self._peer_meta)
+
+    async def _read_loop(self, reader: asyncio.StreamReader):
+        try:
+            while True:
+                kind, msg_id, _method, payload = await _read_frame(reader)
+                fut = self._pending.pop(msg_id, None)
+                if fut is None or fut.done():
+                    continue
+                if kind == _REPLY_OK:
+                    fut.set_result(payload)
+                else:
+                    fut.set_exception(payload)
+        except (asyncio.IncompleteReadError, ConnectionResetError, BrokenPipeError, OSError):
+            pass
+        finally:
+            self._fail_pending(ConnectionLost(f"connection to {self.address} lost"))
+            if self._writer is not None:
+                try:
+                    self._writer.close()
+                except Exception:
+                    pass
+            self._writer = None
+
+    def _fail_pending(self, exc: Exception):
+        for fut in list(self._pending.values()):
+            if not fut.done():
+                fut.set_exception(exc)
+        self._pending.clear()
+
+    async def _call_async_locked(self, method: str, payload: Any):
+        msg_id = next(self._msg_ids)
+        fut = asyncio.get_event_loop().create_future()
+        self._pending[msg_id] = fut
+        self._writer.write(_frame((_REQUEST, msg_id, method, payload)))
+        await self._writer.drain()
+        return await fut
+
+    async def call_async(self, method: str, payload: Any = None,
+                         timeout: Optional[float] = None):
+        if self._closed:
+            raise ConnectionLost("client closed")
+        try:
+            await self._ensure_connected()
+        except OSError as e:
+            raise ConnectionLost(f"cannot connect to {self.address}: {e}")
+        msg_id = next(self._msg_ids)
+        fut = asyncio.get_event_loop().create_future()
+        self._pending[msg_id] = fut
+        try:
+            self._writer.write(_frame((_REQUEST, msg_id, method, payload)))
+            await self._writer.drain()
+        except (ConnectionResetError, BrokenPipeError, AttributeError):
+            self._pending.pop(msg_id, None)
+            raise ConnectionLost(f"connection to {self.address} lost")
+        if timeout is None:
+            return await fut
+        return await asyncio.wait_for(fut, timeout)
+
+    async def send_async(self, method: str, payload: Any = None):
+        """One-way message (no reply)."""
+        if self._closed:
+            raise ConnectionLost("client closed")
+        try:
+            await self._ensure_connected()
+        except OSError as e:
+            raise ConnectionLost(f"cannot connect to {self.address}: {e}")
+        try:
+            self._writer.write(_frame((_ONEWAY, next(self._msg_ids), method, payload)))
+            await self._writer.drain()
+        except (ConnectionResetError, BrokenPipeError, AttributeError):
+            raise ConnectionLost(f"connection to {self.address} lost")
+
+    # ---- sync facades (callable from any non-loop thread) ----
+    def call(self, method: str, payload: Any = None, timeout: Optional[float] = None):
+        from ray_tpu._private.config import CONFIG
+        t = timeout if timeout is not None else CONFIG.rpc_call_timeout_s
+        return self._lt.run_coro(self.call_async(method, payload, timeout=t), timeout=t + 5)
+
+    def send(self, method: str, payload: Any = None):
+        self._lt.run_coro(self.send_async(method, payload), timeout=10)
+
+    def close(self):
+        self._closed = True
+
+        async def _close():
+            if self._writer is not None:
+                try:
+                    self._writer.close()
+                except Exception:
+                    pass
+            self._fail_pending(ConnectionLost("client closed"))
+
+        try:
+            self._lt.run_coro(_close(), timeout=2.0)
+        except Exception:
+            pass
+
+
+class ClientPool:
+    """Cache of RpcClients by address (one persistent connection per peer)."""
+
+    def __init__(self, loop_thread: EventLoopThread, peer_meta: Optional[dict] = None):
+        self._lt = loop_thread
+        self._peer_meta = peer_meta
+        self._clients: Dict[str, RpcClient] = {}
+        self._lock = threading.Lock()
+
+    def get(self, address: str) -> RpcClient:
+        with self._lock:
+            client = self._clients.get(address)
+            if client is None or client._closed:
+                client = RpcClient(address, self._lt, peer_meta=self._peer_meta)
+                self._clients[address] = client
+            return client
+
+    def invalidate(self, address: str):
+        with self._lock:
+            client = self._clients.pop(address, None)
+        if client is not None:
+            client.close()
+
+    def close_all(self):
+        with self._lock:
+            clients = list(self._clients.values())
+            self._clients.clear()
+        for c in clients:
+            c.close()
+
+
+def find_free_port() -> int:
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def wait_until(predicate: Callable[[], bool], timeout: float, interval: float = 0.02) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()

@@ -1,0 +1,128 @@
+"""Serialization context: cloudpickle + out-of-band zero-copy buffers.
+
+Equivalent of the reference's SerializationContext
+(ray: python/ray/_private/serialization.py:111) — pickle protocol 5 with
+out-of-band buffer collection so large numpy arrays round-trip without copies,
+plus ObjectRef tracking so refs nested inside arguments/results are discovered
+(for borrowing/ref-counting) during (de)serialization.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+import threading
+from typing import Any, List, Optional, Tuple
+
+import cloudpickle
+
+_thread_local = threading.local()
+
+
+def _get_ctx_stack():
+    if not hasattr(_thread_local, "ref_stack"):
+        _thread_local.ref_stack = []
+    return _thread_local.ref_stack
+
+
+class SerializedObject:
+    """A serialized payload: a pickle5 stream plus out-of-band buffers."""
+
+    __slots__ = ("inband", "buffers", "contained_refs")
+
+    def __init__(self, inband: bytes, buffers: List[pickle.PickleBuffer], contained_refs):
+        self.inband = inband
+        self.buffers = buffers
+        self.contained_refs = contained_refs
+
+    def __reduce__(self):
+        # Wire format: drop contained_refs (metadata, carried separately in
+        # TaskArg.nested_ids) so that transporting a serialized payload never
+        # re-instantiates live ObjectRefs mid-frame-decode — doing so would
+        # trigger borrow registration on the RPC loop thread (deadlock).
+        return (
+            _rebuild_serialized,
+            (self.inband, [bytes(b.raw()) for b in self.buffers]),
+        )
+
+    def total_bytes(self) -> int:
+        return len(self.inband) + sum(b.raw().nbytes for b in self.buffers)
+
+    def to_bytes(self) -> bytes:
+        """Flatten to a single contiguous wire format (copies buffers)."""
+        out = io.BytesIO()
+        raw_buffers = [b.raw() for b in self.buffers]
+        header = pickle.dumps(
+            (len(self.inband), [m.nbytes for m in raw_buffers]), protocol=5
+        )
+        out.write(len(header).to_bytes(4, "little"))
+        out.write(header)
+        out.write(self.inband)
+        for m in raw_buffers:
+            out.write(m)
+        return out.getvalue()
+
+    @classmethod
+    def from_bytes(cls, data) -> "SerializedObject":
+        view = memoryview(data)
+        hlen = int.from_bytes(view[:4], "little")
+        inband_len, buf_lens = pickle.loads(view[4 : 4 + hlen])
+        off = 4 + hlen
+        inband = bytes(view[off : off + inband_len])
+        off += inband_len
+        buffers = []
+        for n in buf_lens:
+            buffers.append(pickle.PickleBuffer(view[off : off + n]))
+            off += n
+        return cls(inband, buffers, [])
+
+
+def _rebuild_serialized(inband: bytes, raw_buffers) -> "SerializedObject":
+    return SerializedObject(inband, [pickle.PickleBuffer(b) for b in raw_buffers], [])
+
+
+def serialize(value: Any) -> SerializedObject:
+    """Serialize with out-of-band buffers and contained-ObjectRef discovery."""
+    from ray_tpu._raylet import ObjectRef  # local import to avoid cycle
+
+    buffers: List[pickle.PickleBuffer] = []
+    contained: List[ObjectRef] = []
+
+    def buffer_callback(buf: pickle.PickleBuffer) -> bool:
+        buffers.append(buf)
+        return False  # do not serialize in-band
+
+    stack = _get_ctx_stack()
+    stack.append(contained)
+    try:
+        inband = cloudpickle.dumps(value, protocol=5, buffer_callback=buffer_callback)
+    finally:
+        stack.pop()
+    return SerializedObject(inband, buffers, contained)
+
+
+def deserialize(obj: SerializedObject) -> Tuple[Any, list]:
+    """Deserialize; returns (value, contained_object_refs)."""
+    contained: list = []
+    stack = _get_ctx_stack()
+    stack.append(contained)
+    try:
+        value = pickle.loads(obj.inband, buffers=obj.buffers)
+    finally:
+        stack.pop()
+    return value, contained
+
+
+def note_object_ref(ref) -> None:
+    """Called from ObjectRef.__reduce__ / deserialization to record nesting."""
+    stack = _get_ctx_stack()
+    if stack:
+        stack[-1].append(ref)
+
+
+def dumps_function(fn) -> bytes:
+    return cloudpickle.dumps(fn)
+
+
+def loads_function(data: bytes):
+    return pickle.loads(data)

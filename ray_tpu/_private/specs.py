@@ -1,0 +1,235 @@
+"""L0 common value types: addresses, resource sets, task/actor specs.
+
+Equivalents of the reference's TaskSpecification / ResourceSet / Address
+(ray: src/ray/common/task/task_spec.h, scheduling/resource_set.h,
+protobuf/common.proto). Specs are plain picklable dataclasses — they ARE the
+wire format for the RPC layer.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private.ids import (
+    ActorID,
+    JobID,
+    NodeID,
+    ObjectID,
+    PlacementGroupID,
+    TaskID,
+    WorkerID,
+)
+
+Resources = Dict[str, float]
+
+
+def resources_fit(avail: Resources, demand: Resources) -> bool:
+    return all(avail.get(k, 0.0) + 1e-9 >= v for k, v in demand.items() if v > 0)
+
+
+def subtract_resources(avail: Resources, demand: Resources) -> None:
+    for k, v in demand.items():
+        if v > 0:
+            avail[k] = avail.get(k, 0.0) - v
+
+
+def add_resources(avail: Resources, demand: Resources) -> None:
+    for k, v in demand.items():
+        if v > 0:
+            avail[k] = avail.get(k, 0.0) + v
+
+
+@dataclass(frozen=True)
+class Address:
+    """Location of a worker process: (node, worker id, rpc address)."""
+
+    node_id: Optional[NodeID] = None
+    worker_id: Optional[WorkerID] = None
+    rpc_address: str = ""  # host:port of the worker's RpcServer
+
+    def __repr__(self):
+        return f"Address({self.rpc_address})"
+
+
+class TaskType(Enum):
+    NORMAL_TASK = 0
+    ACTOR_CREATION_TASK = 1
+    ACTOR_TASK = 2
+
+
+@dataclass
+class TaskArg:
+    """Either an inlined serialized value or an ObjectID reference.
+
+    Mirrors the reference's TaskArg (by-value vs by-reference,
+    ray: src/ray/common/task/task_util.h).
+    """
+
+    is_inline: bool
+    data: Any = None                  # SerializedObject when inline
+    object_id: Optional[ObjectID] = None
+    owner_address: Optional[Address] = None
+    # ObjectIDs nested inside an inlined value (borrowed refs).
+    nested_ids: List[ObjectID] = field(default_factory=list)
+
+
+@dataclass
+class ActorCreationSpec:
+    actor_id: ActorID
+    max_restarts: int = 0
+    max_task_retries: int = 0
+    max_concurrency: int = 1
+    max_pending_calls: int = -1
+    name: Optional[str] = None
+    namespace: Optional[str] = None
+    is_detached: bool = False
+    is_asyncio: bool = False
+    concurrency_groups: Dict[str, int] = field(default_factory=dict)
+
+
+@dataclass
+class SchedulingStrategySpec:
+    """DEFAULT / SPREAD / node-affinity / placement-group strategies."""
+
+    kind: str = "DEFAULT"  # DEFAULT | SPREAD | NODE_AFFINITY | PLACEMENT_GROUP
+    node_id: Optional[NodeID] = None
+    soft: bool = False
+    placement_group_id: Optional[PlacementGroupID] = None
+    bundle_index: int = -1
+    capture_child_tasks: bool = False
+
+
+@dataclass
+class TaskSpec:
+    task_id: TaskID
+    job_id: JobID
+    task_type: TaskType
+    function_id: str                    # key into GCS function table
+    function_name: str                  # for error messages
+    args: List[TaskArg] = field(default_factory=list)
+    num_returns: int = 1                # -1 => streaming generator
+    resources: Resources = field(default_factory=dict)
+    owner_address: Optional[Address] = None
+    max_retries: int = 0
+    retry_exceptions: bool = False
+    scheduling_strategy: SchedulingStrategySpec = field(
+        default_factory=SchedulingStrategySpec
+    )
+    runtime_env: Optional[dict] = None
+    # Actor tasks:
+    actor_id: Optional[ActorID] = None
+    sequence_number: int = 0
+    method_name: str = ""
+    concurrency_group: str = ""
+    # Actor creation:
+    actor_creation: Optional[ActorCreationSpec] = None
+    # Attempt bookkeeping (owner-side retry FSM):
+    attempt_number: int = 0
+    # Dynamic/streaming generator backpressure:
+    generator_backpressure_num_objects: int = -1
+
+    def return_ids(self) -> List[ObjectID]:
+        n = max(self.num_returns, 1) if self.num_returns != 0 else 0
+        if self.num_returns == -1:
+            n = 1  # streaming: the generator ref itself
+        return [ObjectID.for_task_return(self.task_id, i + 1) for i in range(n)]
+
+    def is_streaming_generator(self) -> bool:
+        return self.num_returns == -1
+
+    def scheduling_key(self) -> tuple:
+        """Tasks with equal keys can reuse each other's worker leases."""
+        return (
+            self.function_id,
+            tuple(sorted(self.resources.items())),
+            self.scheduling_strategy.kind,
+            self.scheduling_strategy.node_id,
+            self.scheduling_strategy.placement_group_id,
+            self.scheduling_strategy.bundle_index,
+        )
+
+
+class ActorState(Enum):
+    """GCS actor lifecycle FSM (reference: gcs_actor_manager.h:251-281)."""
+
+    DEPENDENCIES_UNREADY = 0
+    PENDING_CREATION = 1
+    ALIVE = 2
+    RESTARTING = 3
+    DEAD = 4
+
+
+@dataclass
+class ActorInfo:
+    actor_id: ActorID
+    state: ActorState
+    address: Optional[Address] = None
+    name: Optional[str] = None
+    namespace: str = ""
+    is_detached: bool = False
+    num_restarts: int = 0
+    max_restarts: int = 0
+    death_cause: Optional[str] = None
+    class_name: str = ""
+    job_id: Optional[JobID] = None
+    pid: int = 0
+
+
+class PlacementGroupState(Enum):
+    PENDING = 0
+    PREPARED = 1
+    CREATED = 2
+    REMOVED = 3
+    RESCHEDULING = 4
+
+
+@dataclass
+class PlacementGroupSpec:
+    placement_group_id: PlacementGroupID
+    bundles: List[Resources]
+    strategy: str = "PACK"  # PACK | SPREAD | STRICT_PACK | STRICT_SPREAD
+    name: str = ""
+    lifetime: Optional[str] = None  # None | "detached"
+    job_id: Optional[JobID] = None
+
+
+@dataclass
+class PlacementGroupInfo:
+    spec: PlacementGroupSpec
+    state: PlacementGroupState
+    # bundle index -> node id (filled when committed)
+    bundle_locations: Dict[int, NodeID] = field(default_factory=dict)
+
+
+@dataclass
+class NodeInfo:
+    node_id: NodeID
+    raylet_address: str
+    object_manager_address: str = ""
+    resources_total: Resources = field(default_factory=dict)
+    resources_available: Resources = field(default_factory=dict)
+    labels: Dict[str, str] = field(default_factory=dict)
+    alive: bool = True
+    start_time: float = field(default_factory=time.time)
+    is_head: bool = False
+
+
+class WorkerExitType(Enum):
+    IDLE = 0
+    INTENDED_USER_EXIT = 1
+    SYSTEM_ERROR = 2
+    NODE_DEATH = 3
+
+
+@dataclass
+class JobInfo:
+    job_id: JobID
+    driver_address: str = ""
+    start_time: float = field(default_factory=time.time)
+    end_time: Optional[float] = None
+    namespace: str = ""
+    metadata: Dict[str, str] = field(default_factory=dict)
+    is_dead: bool = False

@@ -1,0 +1,58 @@
+"""Worker process entry point.
+
+Role of the reference's default_worker.py (ray: python/ray/_private/workers/
+default_worker.py): spawned by the raylet's WorkerPool, connects a CoreWorker
+back to its raylet + GCS, then serves push_task until told to exit. Imports
+stay light (no JAX) so spawn latency is low; user tasks that need JAX import
+it lazily on first use.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+import time
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--raylet-address", required=True)
+    parser.add_argument("--gcs-address", required=True)
+    parser.add_argument("--node-id", required=True)
+    parser.add_argument("--log-level", default="INFO")
+    args = parser.parse_args()
+
+    logging.basicConfig(
+        level=getattr(logging, args.log_level.upper(), logging.INFO),
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+    from ray_tpu._private.config import CONFIG
+    CONFIG.load_from_env()
+
+    from ray_tpu._private.ids import NodeID
+    from ray_tpu.worker.core_worker import CoreWorker
+
+    core_worker = CoreWorker(
+        mode="worker",
+        gcs_address=args.gcs_address,
+        raylet_address=args.raylet_address,
+        node_id=NodeID.from_hex(args.node_id),
+    )
+
+    def _term(_sig, _frm):
+        sys.exit(0)
+
+    signal.signal(signal.SIGTERM, _term)
+
+    # The RPC loop threads do the work; park the main thread.
+    try:
+        while True:
+            time.sleep(3600)
+    except (KeyboardInterrupt, SystemExit):
+        pass
+
+
+if __name__ == "__main__":
+    main()

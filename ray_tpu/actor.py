@@ -1,0 +1,138 @@
+"""ActorClass / ActorHandle / ActorMethod.
+
+Reference: ray python/ray/actor.py — ActorClass (:566), ActorHandle (:1226),
+ActorMethod (:116), with options num_cpus/max_restarts/max_task_retries/
+max_concurrency/name/namespace/lifetime="detached"/get_if_exists (:204,:720).
+Async actors: classes with `async def` methods run their methods on an event
+loop with max_concurrency (default 1000), matching actor.py:953-956.
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Dict, Optional
+
+from ray_tpu._private import ray_option_utils as opts
+from ray_tpu._private.ids import ActorID
+from ray_tpu._raylet import get_core_worker
+from ray_tpu.util.scheduling_strategies import to_spec
+
+
+def _is_asyncio_class(cls) -> bool:
+    for _name, method in inspect.getmembers(cls, inspect.isfunction):
+        if inspect.iscoroutinefunction(method):
+            return True
+    return False
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", method_name: str,
+                 num_returns=1):
+        self._handle = handle
+        self._method_name = method_name
+        self._num_returns = num_returns
+
+    def options(self, **overrides) -> "ActorMethod":
+        return ActorMethod(
+            self._handle,
+            self._method_name,
+            num_returns=overrides.get("num_returns", self._num_returns),
+        )
+
+    def remote(self, *args, **kwargs):
+        cw = get_core_worker()
+        result = cw.submit_actor_task(
+            self._handle._actor_id,
+            self._method_name,
+            args,
+            kwargs,
+            num_returns=self._num_returns,
+        )
+        if isinstance(result, list):
+            if self._num_returns == 1:
+                return result[0]
+            if self._num_returns == 0:
+                return None
+        return result
+
+    def bind(self, *args, **kwargs):
+        from ray_tpu.dag import ClassMethodNode
+
+        return ClassMethodNode(self._handle, self._method_name, args, kwargs)
+
+
+def _reconstruct_handle(actor_id_bytes: bytes):
+    return ActorHandle(ActorID(actor_id_bytes))
+
+
+class ActorHandle:
+    def __init__(self, actor_id: ActorID):
+        object.__setattr__(self, "_actor_id", actor_id)
+
+    @property
+    def _id(self) -> ActorID:
+        return self._actor_id
+
+    def __getattr__(self, name: str) -> ActorMethod:
+        if name.startswith("__") and name.endswith("__") and name != "__ray_terminate__":
+            raise AttributeError(name)
+        return ActorMethod(self, name)
+
+    def __repr__(self):
+        return f"ActorHandle({self._actor_id.hex()})"
+
+    def __reduce__(self):
+        return (_reconstruct_handle, (self._actor_id.binary(),))
+
+    def __eq__(self, other):
+        return isinstance(other, ActorHandle) and other._actor_id == self._actor_id
+
+    def __hash__(self):
+        return hash(self._actor_id)
+
+
+class ActorClass:
+    def __init__(self, cls, options: Optional[Dict[str, Any]] = None):
+        self._cls = cls
+        self._options = opts.validate_options(options or {}, is_actor=True)
+        self.__name__ = getattr(cls, "__name__", "ActorClass")
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor class '{self.__name__}' cannot be instantiated directly; "
+            "use .remote()."
+        )
+
+    def options(self, **overrides) -> "ActorClass":
+        return ActorClass(self._cls, opts.merge_options(self._options, overrides))
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        cw = get_core_worker()
+        o = self._options
+        strategy = to_spec(o.get("scheduling_strategy"), o)
+        actor_id = cw.create_actor(
+            self._cls,
+            args,
+            kwargs,
+            resources=opts.resources_from_options(o, is_actor=True),
+            max_restarts=o.get("max_restarts", 0),
+            max_task_retries=o.get("max_task_retries", 0),
+            max_concurrency=o.get("max_concurrency"),
+            name=o.get("name"),
+            namespace=o.get("namespace"),
+            lifetime=o.get("lifetime"),
+            get_if_exists=o.get("get_if_exists", False),
+            scheduling_strategy=strategy,
+            is_asyncio=_is_asyncio_class(self._cls),
+            runtime_env=o.get("runtime_env"),
+        )
+        return ActorHandle(actor_id)
+
+    def bind(self, *args, **kwargs):
+        from ray_tpu.dag import ClassNode
+
+        return ClassNode(self, args, kwargs)
+
+    @property
+    def _underlying(self):
+        return self._cls

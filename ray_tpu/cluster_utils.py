@@ -1,0 +1,99 @@
+"""Cluster: in-process multi-node test harness.
+
+Reference: ray python/ray/cluster_utils.py:135 (Cluster, add_node :201) — the
+standard way every multi-node scheduling/failover test runs on one machine:
+one GCS plus N raylets with fake resources. Here each raylet runs on its own
+event-loop thread in the current process (its workers are still real
+subprocesses), so `kill_node` exercises real node-death paths: heartbeats
+stop, the GCS health checker marks the node dead, actors restart elsewhere.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from ray_tpu._private.config import CONFIG
+from ray_tpu._private.rpc import wait_until
+from ray_tpu.gcs.server import GcsServer
+from ray_tpu.raylet.raylet import Raylet
+
+
+class Cluster:
+    def __init__(
+        self,
+        initialize_head: bool = True,
+        head_node_args: Optional[dict] = None,
+        connect: bool = False,
+        namespace: str = "",
+    ):
+        self.gcs = GcsServer()
+        self.gcs_address = self.gcs.start(0)
+        self.raylets: List[Raylet] = []
+        self.head_node: Optional[Raylet] = None
+        self._connected = False
+        if initialize_head:
+            self.head_node = self.add_node(**(head_node_args or {}), _is_head=True)
+            if connect:
+                self.connect(namespace=namespace)
+
+    @property
+    def address(self) -> str:
+        return self.gcs_address
+
+    def add_node(
+        self,
+        num_cpus: Optional[float] = None,
+        resources: Optional[Dict[str, float]] = None,
+        labels: Optional[Dict[str, str]] = None,
+        _is_head: bool = False,
+        **kwargs,
+    ) -> Raylet:
+        node_resources = dict(resources or {})
+        if num_cpus is not None:
+            node_resources["CPU"] = float(num_cpus)
+        raylet = Raylet(
+            gcs_address=self.gcs_address,
+            resources=node_resources or None,
+            is_head=_is_head,
+            labels=labels,
+        )
+        raylet.start(0)
+        self.raylets.append(raylet)
+        return raylet
+
+    def connect(self, namespace: str = ""):
+        import ray_tpu
+
+        ray_tpu.init(address=self.gcs_address, namespace=namespace)
+        self._connected = True
+
+    def remove_node(self, raylet: Raylet, allow_graceful: bool = True):
+        """Kill a node. allow_graceful=False skips GCS unregistration so death
+        is discovered via missed heartbeats (chaos-testing path)."""
+        raylet.stop(unregister=allow_graceful)
+        if raylet in self.raylets:
+            self.raylets.remove(raylet)
+
+    kill_node = remove_node
+
+    def wait_for_nodes(self, timeout: float = 10.0) -> bool:
+        """Wait until every added node is alive in the GCS view."""
+        expected = len(self.raylets)
+
+        def check():
+            infos = self.gcs.node_manager._nodes
+            return sum(1 for i in infos.values() if i.alive) >= expected
+
+        return wait_until(check, timeout)
+
+    def shutdown(self):
+        import ray_tpu
+
+        if self._connected:
+            ray_tpu.shutdown()
+            self._connected = False
+        for raylet in self.raylets:
+            raylet.stop(unregister=False)
+        self.raylets.clear()
+        self.gcs.stop()

@@ -1,0 +1,58 @@
+"""Lazy task DAGs (placeholder; full compiled-graph support lands with the
+pipeline layer). Reference: ray python/ray/dag/dag_node.py (.bind() API)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+
+class DAGNode:
+    def __init__(self, args: Tuple, kwargs: Dict[str, Any]):
+        self._bound_args = args
+        self._bound_kwargs = kwargs
+
+    def execute(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def _resolve(self, value):
+        if isinstance(value, DAGNode):
+            return value.execute()
+        return value
+
+    def _resolved_args(self):
+        args = [self._resolve(a) for a in self._bound_args]
+        kwargs = {k: self._resolve(v) for k, v in self._bound_kwargs.items()}
+        return args, kwargs
+
+
+class FunctionNode(DAGNode):
+    def __init__(self, remote_fn, args, kwargs):
+        super().__init__(args, kwargs)
+        self._remote_fn = remote_fn
+
+    def execute(self, *_a, **_kw):
+        args, kwargs = self._resolved_args()
+        return self._remote_fn.remote(*args, **kwargs)
+
+
+class ClassNode(DAGNode):
+    def __init__(self, actor_cls, args, kwargs):
+        super().__init__(args, kwargs)
+        self._actor_cls = actor_cls
+
+    def execute(self, *_a, **_kw):
+        args, kwargs = self._resolved_args()
+        return self._actor_cls.remote(*args, **kwargs)
+
+
+class ClassMethodNode(DAGNode):
+    def __init__(self, handle, method_name, args, kwargs):
+        super().__init__(args, kwargs)
+        self._handle = handle
+        self._method_name = method_name
+
+    def execute(self, *_a, **_kw):
+        from ray_tpu.actor import ActorMethod
+
+        args, kwargs = self._resolved_args()
+        return ActorMethod(self._handle, self._method_name).remote(*args, **kwargs)

@@ -1,0 +1,276 @@
+"""GCS actor lifecycle management.
+
+Role of the reference's GcsActorManager + GcsActorScheduler
+(ray: src/ray/gcs/gcs_server/gcs_actor_manager.h:251-281 — the lifecycle FSM
+DEPENDENCIES_UNREADY -> PENDING_CREATION -> ALIVE -> (RESTARTING ->
+PENDING_CREATION)* -> DEAD — and gcs_actor_scheduler.cc, which leases a
+worker from a raylet and pushes the creation task).
+
+Creation is asynchronous: `register_actor` returns immediately; callers learn
+the address via the ACTOR pubsub channel or `get_actor_info`.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu._private.config import CONFIG
+from ray_tpu._private.ids import ActorID, NodeID
+from ray_tpu._private.rpc import ClientPool, ConnectionLost
+from ray_tpu._private.specs import (
+    ActorInfo,
+    ActorState,
+    Address,
+    TaskSpec,
+)
+from ray_tpu.gcs import pubsub as ps
+
+logger = logging.getLogger(__name__)
+
+
+class GcsActorManager:
+    def __init__(self, node_view, publisher: ps.Publisher, client_pool: ClientPool):
+        # node_view: GcsNodeManager (cluster resource view + raylet addresses)
+        self._nodes = node_view
+        self._pub = publisher
+        self._pool = client_pool
+        self._actors: Dict[ActorID, ActorInfo] = {}
+        self._creation_specs: Dict[ActorID, TaskSpec] = {}
+        # (namespace, name) -> actor_id
+        self._named: Dict[Tuple[str, str], ActorID] = {}
+        # node_id -> set of actor ids placed there
+        self._by_node: Dict[NodeID, set] = {}
+        self._lock = asyncio.Lock()
+
+    # ---- RPC handlers -------------------------------------------------------
+
+    async def handle_register_actor(self, payload):
+        spec: TaskSpec = payload["spec"]
+        get_if_exists: bool = payload.get("get_if_exists", False)
+        creation = spec.actor_creation
+        name = creation.name
+        namespace = creation.namespace or ""
+        async with self._lock:
+            if name:
+                existing_id = self._named.get((namespace, name))
+                if existing_id is not None:
+                    existing = self._actors.get(existing_id)
+                    if existing is not None and existing.state != ActorState.DEAD:
+                        if get_if_exists:
+                            return {"status": "exists", "info": existing}
+                        return {
+                            "status": "error",
+                            "message": f"Actor name '{name}' already taken in "
+                                       f"namespace '{namespace}'",
+                        }
+                self._named[(namespace, name)] = creation.actor_id
+            info = ActorInfo(
+                actor_id=creation.actor_id,
+                state=ActorState.PENDING_CREATION,
+                name=name,
+                namespace=namespace,
+                is_detached=creation.is_detached,
+                max_restarts=creation.max_restarts,
+                class_name=spec.function_name,
+                job_id=spec.job_id,
+            )
+            self._actors[creation.actor_id] = info
+            self._creation_specs[creation.actor_id] = spec
+        asyncio.ensure_future(self._schedule_actor(creation.actor_id))
+        return {"status": "registered", "info": info}
+
+    async def handle_get_actor_info(self, payload):
+        return self._actors.get(payload["actor_id"])
+
+    async def handle_list_actors(self, payload):
+        return list(self._actors.values())
+
+    async def handle_get_named_actor(self, payload):
+        key = (payload.get("namespace") or "", payload["name"])
+        actor_id = self._named.get(key)
+        if actor_id is None:
+            return None
+        return self._actors.get(actor_id)
+
+    async def handle_list_named_actors(self, payload):
+        all_namespaces = payload.get("all_namespaces", False)
+        namespace = payload.get("namespace") or ""
+        out = []
+        for (ns, name), actor_id in self._named.items():
+            info = self._actors.get(actor_id)
+            if info is None or info.state == ActorState.DEAD:
+                continue
+            if all_namespaces or ns == namespace:
+                out.append({"namespace": ns, "name": name})
+        return out
+
+    async def handle_kill_actor(self, payload):
+        actor_id: ActorID = payload["actor_id"]
+        no_restart: bool = payload.get("no_restart", True)
+        info = self._actors.get(actor_id)
+        if info is None:
+            return False
+        if info.state == ActorState.ALIVE and info.address is not None:
+            client = self._pool.get(info.address.rpc_address)
+            try:
+                await client.send_async(
+                    "kill_actor", {"actor_id": actor_id, "no_restart": no_restart}
+                )
+            except (ConnectionLost, OSError):
+                pass
+        if no_restart:
+            await self._mark_dead(actor_id, "ray_tpu.kill() was called")
+        return True
+
+    async def handle_report_actor_alive(self, payload):
+        """Called by the worker once the creation task (__init__) succeeds."""
+        actor_id: ActorID = payload["actor_id"]
+        address: Address = payload["address"]
+        info = self._actors.get(actor_id)
+        if info is None:
+            return False
+        info.state = ActorState.ALIVE
+        info.address = address
+        info.pid = payload.get("pid", 0)
+        self._by_node.setdefault(address.node_id, set()).add(actor_id)
+        self._pub.publish(ps.ACTOR_CHANNEL, actor_id, info)
+        return True
+
+    async def handle_report_actor_death(self, payload):
+        """Called by a raylet when an actor's worker process exits."""
+        actor_id: ActorID = payload["actor_id"]
+        reason: str = payload.get("reason", "worker process died")
+        intended: bool = payload.get("intended", False)
+        await self._on_actor_failure(actor_id, reason, intended)
+        return True
+
+    # ---- internals ----------------------------------------------------------
+
+    async def on_node_death(self, node_id: NodeID):
+        for actor_id in list(self._by_node.get(node_id, ())):
+            await self._on_actor_failure(
+                actor_id, f"node {node_id.hex()[:8]} died", intended=False
+            )
+
+    async def on_job_finished(self, job_id):
+        """Non-detached actors die with their job (owner lifetime)."""
+        for actor_id, info in list(self._actors.items()):
+            if info.job_id == job_id and not info.is_detached and (
+                info.state != ActorState.DEAD
+            ):
+                await self.handle_kill_actor(
+                    {"actor_id": actor_id, "no_restart": True}
+                )
+
+    async def _on_actor_failure(self, actor_id: ActorID, reason: str, intended: bool):
+        info = self._actors.get(actor_id)
+        if info is None or info.state == ActorState.DEAD:
+            return
+        if info.address is not None:
+            self._by_node.get(info.address.node_id, set()).discard(actor_id)
+        restarts_left = (
+            info.max_restarts == -1 or info.num_restarts < info.max_restarts
+        )
+        if not intended and restarts_left:
+            info.state = ActorState.RESTARTING
+            info.num_restarts += 1
+            info.address = None
+            self._pub.publish(ps.ACTOR_CHANNEL, actor_id, info)
+            await asyncio.sleep(CONFIG.actor_restart_delay_ms / 1000.0)
+            asyncio.ensure_future(self._schedule_actor(actor_id))
+        else:
+            await self._mark_dead(actor_id, reason)
+
+    async def _mark_dead(self, actor_id: ActorID, reason: str):
+        info = self._actors.get(actor_id)
+        if info is None:
+            return
+        info.state = ActorState.DEAD
+        info.death_cause = reason
+        if info.address is not None:
+            self._by_node.get(info.address.node_id, set()).discard(actor_id)
+            info.address = None
+        if info.name:
+            self._named.pop((info.namespace, info.name), None)
+        self._creation_specs.pop(actor_id, None)
+        self._pub.publish(ps.ACTOR_CHANNEL, actor_id, info)
+
+    async def _schedule_actor(self, actor_id: ActorID):
+        """Lease a worker somewhere and push the creation task to it."""
+        spec = self._creation_specs.get(actor_id)
+        info = self._actors.get(actor_id)
+        if spec is None or info is None or info.state == ActorState.DEAD:
+            return
+        attempt = 0
+        target_node: Optional[NodeID] = None
+        while attempt < 60:
+            attempt += 1
+            candidates = self._nodes.pick_nodes_for(spec)
+            if target_node is not None:
+                candidates = [target_node] + [c for c in candidates if c != target_node]
+                target_node = None
+            if not candidates:
+                await asyncio.sleep(0.25)
+                continue
+            node_id = candidates[0]
+            raylet_addr = self._nodes.raylet_address(node_id)
+            if raylet_addr is None:
+                await asyncio.sleep(0.1)
+                continue
+            client = self._pool.get(raylet_addr)
+            try:
+                reply = await client.call_async(
+                    "request_worker_lease",
+                    {"spec": spec, "grant_or_reject": False},
+                    timeout=CONFIG.worker_register_timeout_s,
+                )
+            except (ConnectionLost, OSError, asyncio.TimeoutError):
+                await asyncio.sleep(0.2)
+                continue
+            if reply.get("rejected"):
+                await asyncio.sleep(0.2)
+                continue
+            if reply.get("retry_at"):
+                target_node = reply["retry_at_node_id"]
+                continue
+            worker_addr: Address = reply["worker_address"]
+            ok = await self._push_creation_task(actor_id, spec, worker_addr, raylet_addr)
+            if ok:
+                return
+            await asyncio.sleep(0.2)
+        await self._mark_dead(actor_id, "actor creation could not be scheduled")
+
+    async def _push_creation_task(
+        self, actor_id: ActorID, spec: TaskSpec, worker_addr: Address, raylet_addr: str
+    ) -> bool:
+        client = self._pool.get(worker_addr.rpc_address)
+        try:
+            reply = await client.call_async(
+                "push_task", {"spec": spec}, timeout=CONFIG.rpc_call_timeout_s * 10
+            )
+        except (ConnectionLost, OSError, asyncio.TimeoutError):
+            return False
+        if reply.get("status") == "ok":
+            # Worker reports itself alive (handle_report_actor_alive) with its
+            # serving address; nothing more to do here.
+            return True
+        # __init__ raised: the actor is dead on arrival; propagate the error.
+        await self._mark_dead(
+            actor_id,
+            reply.get("error_str", "actor constructor failed"),
+        )
+        info = self._actors.get(actor_id)
+        if info is not None:
+            info.death_cause = reply.get("error_str", "actor constructor failed")
+            self._pub.publish(ps.ACTOR_CHANNEL, actor_id, info)
+        # Return the leased worker to the pool.
+        try:
+            await self._pool.get(raylet_addr).send_async(
+                "return_worker",
+                {"worker_address": worker_addr, "disconnect": True},
+            )
+        except (ConnectionLost, OSError):
+            pass
+        return True

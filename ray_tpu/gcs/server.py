@@ -1,0 +1,361 @@
+"""GCS server: headnode control plane.
+
+Role of the reference's GcsServer (ray: src/ray/gcs/gcs_server/gcs_server.h,
+gcs_server_main.cc), hosting:
+  - node membership + health checks (gcs_node_manager.cc,
+    gcs_health_check_manager.h:39 — here: heartbeat staleness detection),
+  - resource view sync (the ray_syncer equivalent: heartbeat replies carry the
+    full cluster resource view back to each raylet),
+  - actor manager (actor_manager.py), placement groups (pg_manager.py),
+  - jobs (gcs_job_manager.cc), internal KV (gcs_kv_manager.cc) which also
+    stores exported functions (gcs_function_manager.h),
+  - task events for observability (gcs_task_manager.cc),
+  - pubsub (pubsub_handler.cc).
+
+Runs embedded in the head node process on its own EventLoopThread, or
+standalone via `python -m ray_tpu.gcs.server`.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from ray_tpu._private.config import CONFIG
+from ray_tpu._private.ids import JobID, NodeID
+from ray_tpu._private.rpc import ClientPool, EventLoopThread, RpcServer
+from ray_tpu._private.specs import (
+    JobInfo,
+    NodeInfo,
+    Resources,
+    TaskSpec,
+    resources_fit,
+)
+from ray_tpu.gcs import pubsub as ps
+from ray_tpu.gcs.actor_manager import GcsActorManager
+from ray_tpu.gcs.pg_manager import GcsPlacementGroupManager
+from ray_tpu.gcs.storage import make_store
+
+logger = logging.getLogger(__name__)
+
+
+class GcsNodeManager:
+    """Node registry + cluster resource view + failure detection."""
+
+    def __init__(self, publisher: ps.Publisher):
+        self._pub = publisher
+        self._nodes: Dict[NodeID, NodeInfo] = {}
+        self._last_heartbeat: Dict[NodeID, float] = {}
+        self._death_listeners = []
+        self.pg_locator = None  # wired to GcsPlacementGroupManager by GcsServer
+
+    def add_death_listener(self, cb):
+        self._death_listeners.append(cb)
+
+    # -- RPC --
+    async def handle_register_node(self, payload):
+        info: NodeInfo = payload["info"]
+        self._nodes[info.node_id] = info
+        self._last_heartbeat[info.node_id] = time.monotonic()
+        self._pub.publish(ps.NODE_CHANNEL, info.node_id, info)
+        logger.info("node %s registered (%s)", info.node_id.hex()[:8], info.raylet_address)
+        return True
+
+    async def handle_unregister_node(self, payload):
+        await self._mark_dead(payload["node_id"], expected=True)
+        return True
+
+    async def handle_report_resources(self, payload):
+        """Raylet heartbeat; reply carries the cluster view (syncer role)."""
+        node_id: NodeID = payload["node_id"]
+        info = self._nodes.get(node_id)
+        if info is None or not info.alive:
+            return {"status": "unknown_node"}
+        info.resources_available = payload["available"]
+        info.resources_total = payload.get("total", info.resources_total)
+        self._last_heartbeat[node_id] = time.monotonic()
+        return {
+            "status": "ok",
+            "cluster_view": {
+                nid: (n.raylet_address, n.resources_total, n.resources_available)
+                for nid, n in self._nodes.items()
+                if n.alive
+            },
+        }
+
+    async def handle_get_all_node_info(self, payload):
+        return list(self._nodes.values())
+
+    async def handle_check_alive(self, payload):
+        node_ids = payload.get("node_ids") or list(self._nodes)
+        return {nid: (nid in self._nodes and self._nodes[nid].alive) for nid in node_ids}
+
+    # -- used by actor/pg schedulers --
+    def resource_view(self) -> Dict[NodeID, Resources]:
+        return {
+            nid: dict(n.resources_available)
+            for nid, n in self._nodes.items()
+            if n.alive
+        }
+
+    def raylet_address(self, node_id: NodeID) -> Optional[str]:
+        info = self._nodes.get(node_id)
+        return info.raylet_address if info is not None and info.alive else None
+
+    def pick_nodes_for(self, spec: TaskSpec) -> List[NodeID]:
+        """Feasible nodes for a task spec, best-first (GCS-side scheduling)."""
+        strat = spec.scheduling_strategy
+        alive = [n for n in self._nodes.values() if n.alive]
+        if strat.kind == "PLACEMENT_GROUP" and self.pg_locator is not None:
+            info = self.pg_locator._groups.get(strat.placement_group_id)
+            if info is None:
+                return []
+            if strat.bundle_index >= 0:
+                node = info.bundle_locations.get(strat.bundle_index)
+                return [node] if node is not None else []
+            return list(dict.fromkeys(info.bundle_locations.values()))
+        if strat.kind == "NODE_AFFINITY":
+            out = [n.node_id for n in alive if n.node_id == strat.node_id]
+            if out or not strat.soft:
+                return out
+        candidates = [
+            n.node_id
+            for n in alive
+            if resources_fit(n.resources_available, spec.resources)
+            or resources_fit(n.resources_total, spec.resources)
+        ]
+        # Most-available first (actors spread by default here; per-task
+        # fine-grained policy lives in the raylet's cluster task manager).
+        candidates.sort(
+            key=lambda nid: sum(self._nodes[nid].resources_available.values()),
+            reverse=True,
+        )
+        return candidates
+
+    # -- health loop --
+    async def health_check_loop(self):
+        period = CONFIG.health_check_period_ms / 1000.0
+        threshold = CONFIG.health_check_failure_threshold
+        while True:
+            await asyncio.sleep(period)
+            now = time.monotonic()
+            for node_id, info in list(self._nodes.items()):
+                if not info.alive:
+                    continue
+                last = self._last_heartbeat.get(node_id, now)
+                if now - last > period * threshold + CONFIG.heartbeat_period_ms / 1000.0 * threshold:
+                    logger.warning("node %s missed heartbeats; marking dead",
+                                   node_id.hex()[:8])
+                    await self._mark_dead(node_id, expected=False)
+
+    async def _mark_dead(self, node_id: NodeID, expected: bool):
+        info = self._nodes.get(node_id)
+        if info is None or not info.alive:
+            return
+        info.alive = False
+        info.resources_available = {}
+        self._pub.publish(ps.NODE_CHANNEL, node_id, info)
+        for cb in self._death_listeners:
+            try:
+                await cb(node_id)
+            except Exception:
+                logger.exception("node-death listener failed")
+
+
+class GcsKvManager:
+    """Namespaced binary KV (internal KV + function/code storage)."""
+
+    def __init__(self, store):
+        self._store = store
+
+    @staticmethod
+    def _table(ns: Optional[str]) -> str:
+        return "kv:" + (ns or "")
+
+    async def handle_kv_put(self, payload):
+        overwrite = payload.get("overwrite", True)
+        table = self._table(payload.get("namespace"))
+        if not overwrite and self._store.get(table, payload["key"]) is not None:
+            return False
+        self._store.put(table, payload["key"], payload["value"])
+        return True
+
+    async def handle_kv_get(self, payload):
+        return self._store.get(self._table(payload.get("namespace")), payload["key"])
+
+    async def handle_kv_multi_get(self, payload):
+        table = self._table(payload.get("namespace"))
+        return {k: self._store.get(table, k) for k in payload["keys"]}
+
+    async def handle_kv_del(self, payload):
+        table = self._table(payload.get("namespace"))
+        if payload.get("del_by_prefix"):
+            n = 0
+            for k in self._store.keys(table, payload["key"]):
+                n += int(self._store.delete(table, k))
+            return n
+        return int(self._store.delete(table, payload["key"]))
+
+    async def handle_kv_keys(self, payload):
+        return self._store.keys(
+            self._table(payload.get("namespace")), payload.get("prefix", b"")
+        )
+
+    async def handle_kv_exists(self, payload):
+        return (
+            self._store.get(self._table(payload.get("namespace")), payload["key"])
+            is not None
+        )
+
+
+class GcsJobManager:
+    def __init__(self, publisher: ps.Publisher):
+        self._pub = publisher
+        self._jobs: Dict[JobID, JobInfo] = {}
+        self._counter = 0
+        self._finish_listeners = []
+
+    def add_finish_listener(self, cb):
+        self._finish_listeners.append(cb)
+
+    async def handle_get_next_job_id(self, payload):
+        self._counter += 1
+        return JobID.from_int(self._counter)
+
+    async def handle_add_job(self, payload):
+        info: JobInfo = payload["info"]
+        self._jobs[info.job_id] = info
+        self._pub.publish(ps.JOB_CHANNEL, info.job_id, info)
+        return True
+
+    async def handle_mark_job_finished(self, payload):
+        job_id: JobID = payload["job_id"]
+        info = self._jobs.get(job_id)
+        if info is not None:
+            info.is_dead = True
+            info.end_time = time.time()
+            self._pub.publish(ps.JOB_CHANNEL, job_id, info)
+        for cb in self._finish_listeners:
+            try:
+                await cb(job_id)
+            except Exception:
+                logger.exception("job-finish listener failed")
+        return True
+
+    async def handle_get_all_job_info(self, payload):
+        return list(self._jobs.values())
+
+
+class GcsTaskEventManager:
+    """Bounded task-event buffer for the state API / timeline.
+
+    Reference: src/ray/gcs/gcs_server/gcs_task_manager.cc fed by per-worker
+    TaskEventBuffers.
+    """
+
+    def __init__(self, max_events: int = 100_000):
+        self._events = deque(maxlen=max_events)
+
+    async def handle_add_task_events(self, payload):
+        self._events.extend(payload["events"])
+        return True
+
+    async def handle_get_task_events(self, payload):
+        limit = payload.get("limit", 10_000)
+        job_id = payload.get("job_id")
+        out = []
+        for ev in reversed(self._events):
+            if job_id is not None and ev.get("job_id") != job_id:
+                continue
+            out.append(ev)
+            if len(out) >= limit:
+                break
+        return out
+
+
+class GcsServer:
+    """Assembles all managers onto one RpcServer + loop."""
+
+    def __init__(self, host: str = "127.0.0.1", storage_path: str = ""):
+        self._lt = EventLoopThread("gcs-io")
+        self._server = RpcServer(self._lt, host)
+        self._pool = ClientPool(self._lt)
+        self.publisher = ps.Publisher(self._lt)
+        store = make_store(storage_path or CONFIG.gcs_storage_path)
+        self.node_manager = GcsNodeManager(self.publisher)
+        self.kv_manager = GcsKvManager(store)
+        self.job_manager = GcsJobManager(self.publisher)
+        self.actor_manager = GcsActorManager(self.node_manager, self.publisher, self._pool)
+        self.pg_manager = GcsPlacementGroupManager(self.node_manager, self.publisher, self._pool)
+        self.task_event_manager = GcsTaskEventManager()
+        self.node_manager.pg_locator = self.pg_manager
+        self.node_manager.add_death_listener(self.actor_manager.on_node_death)
+        self.node_manager.add_death_listener(self.pg_manager.on_node_death)
+        self.job_manager.add_finish_listener(self.actor_manager.on_job_finished)
+        self.address: Optional[str] = None
+        self._health_task = None
+
+    def start(self, port: int = 0) -> str:
+        for mgr in (
+            self.node_manager,
+            self.kv_manager,
+            self.job_manager,
+            self.actor_manager,
+            self.pg_manager,
+            self.task_event_manager,
+        ):
+            self._server.register_all(mgr)
+        self._server.register("subscribe", self._handle_subscribe)
+        self._server.register("unsubscribe", self._handle_unsubscribe)
+        self._server.register("gcs_ping", self._handle_ping)
+        self.address = self._server.start(port)
+        self._health_task = self._lt.submit(self.node_manager.health_check_loop())
+        return self.address
+
+    async def _handle_subscribe(self, payload):
+        self.publisher.subscribe(payload["channel"], payload["subscriber_address"])
+        return True
+
+    async def _handle_unsubscribe(self, payload):
+        if payload.get("all"):
+            self.publisher.unsubscribe_all(payload["subscriber_address"])
+        else:
+            self.publisher.unsubscribe(payload["channel"], payload["subscriber_address"])
+        return True
+
+    async def _handle_ping(self, payload):
+        return {"status": "ok", "time": time.time()}
+
+    def stop(self):
+        if self._health_task is not None:
+            self._health_task.cancel()
+        self.publisher.close()
+        self._pool.close_all()
+        self._server.stop()
+        self._lt.stop()
+
+
+def main():
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--port", type=int, default=6380)
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--storage-path", default="")
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    server = GcsServer(host=args.host, storage_path=args.storage_path)
+    addr = server.start(args.port)
+    logger.info("GCS serving at %s", addr)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

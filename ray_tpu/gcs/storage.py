@@ -1,0 +1,75 @@
+"""GCS table storage: pluggable persistence.
+
+Reference: ray src/ray/gcs/store_client/{in_memory,redis}_store_client.cc and
+the table layer gcs_table_storage.cc. In-memory is the default; a file-backed
+store (append-less JSON-pickle snapshot on mutation batches) provides
+restart-survivable state the way the reference uses Redis.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+from typing import Dict, List, Optional
+
+
+class InMemoryStore:
+    """table -> key(bytes) -> value(bytes). Thread-safe."""
+
+    def __init__(self):
+        self._tables: Dict[str, Dict[bytes, bytes]] = {}
+        self._lock = threading.RLock()
+
+    def put(self, table: str, key: bytes, value: bytes) -> None:
+        with self._lock:
+            self._tables.setdefault(table, {})[key] = value
+        self._persist()
+
+    def get(self, table: str, key: bytes) -> Optional[bytes]:
+        with self._lock:
+            return self._tables.get(table, {}).get(key)
+
+    def delete(self, table: str, key: bytes) -> bool:
+        with self._lock:
+            existed = self._tables.get(table, {}).pop(key, None) is not None
+        self._persist()
+        return existed
+
+    def keys(self, table: str, prefix: bytes = b"") -> List[bytes]:
+        with self._lock:
+            return [k for k in self._tables.get(table, {}) if k.startswith(prefix)]
+
+    def get_all(self, table: str) -> Dict[bytes, bytes]:
+        with self._lock:
+            return dict(self._tables.get(table, {}))
+
+    def _persist(self):
+        pass
+
+
+class FileBackedStore(InMemoryStore):
+    """Snapshot-on-write persistence for GCS fault tolerance."""
+
+    def __init__(self, path: str):
+        super().__init__()
+        self._path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        if os.path.exists(path):
+            with open(path, "rb") as f:
+                try:
+                    self._tables = pickle.load(f)
+                except Exception:
+                    self._tables = {}
+
+    def _persist(self):
+        tmp = self._path + ".tmp"
+        with self._lock:
+            data = pickle.dumps(self._tables)
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, self._path)
+
+
+def make_store(path: str = "") -> InMemoryStore:
+    return FileBackedStore(path) if path else InMemoryStore()

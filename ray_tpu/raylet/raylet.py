@@ -1,0 +1,460 @@
+"""Raylet: per-node manager — lease protocol, local dispatch, PG bundles.
+
+Role of the reference's NodeManager + ClusterTaskManager + LocalTaskManager
+(ray: src/ray/raylet/node_manager.cc:1780 HandleRequestWorkerLease,
+scheduling/cluster_task_manager.h:42, local_task_manager.h:58,
+placement_group_resource_manager.h:46 for the 2PC bundle states). A lease
+request is first given a cluster-level decision (hybrid/spread policies over
+the synced cluster view — spillback replies carry `retry_at` like
+node_manager.proto:74-78); locally-granted requests wait in a dispatch queue
+for resources + an idle worker from the WorkerPool.
+
+Differences from the reference, by design: argument staging (dependency
+manager pulls) happens in the executing worker rather than the raylet, and
+the node-local object store is the worker-embedded store until the plasma shm
+store is wired in.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu._private.config import CONFIG
+from ray_tpu._private.ids import NodeID, PlacementGroupID, WorkerID
+from ray_tpu._private.rpc import (
+    ClientPool,
+    ConnectionLost,
+    EventLoopThread,
+    RpcServer,
+)
+from ray_tpu._private.specs import (
+    Address,
+    NodeInfo,
+    Resources,
+    TaskSpec,
+    TaskType,
+    add_resources,
+    resources_fit,
+    subtract_resources,
+)
+from ray_tpu.raylet import scheduling_policy as policy
+from ray_tpu.raylet.worker_pool import WorkerHandle, WorkerPool
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class _Bundle:
+    resources: Resources
+    available: Resources
+    committed: bool = False
+
+
+@dataclass
+class _Lease:
+    worker_id: WorkerID
+    resources: Resources
+    pg_id: Optional[PlacementGroupID] = None
+    bundle_index: int = -1
+    is_actor: bool = False
+
+
+@dataclass
+class _QueuedLease:
+    spec: TaskSpec
+    future: asyncio.Future
+    enqueue_time: float = field(default_factory=time.monotonic)
+
+
+class Raylet:
+    def __init__(
+        self,
+        gcs_address: str,
+        resources: Optional[Resources] = None,
+        host: str = "127.0.0.1",
+        is_head: bool = False,
+        labels: Optional[Dict[str, str]] = None,
+        log_dir: Optional[str] = None,
+        worker_env: Optional[dict] = None,
+    ):
+        self.node_id = NodeID.from_random()
+        self.gcs_address = gcs_address
+        self.is_head = is_head
+        self._lt = EventLoopThread(f"raylet-{self.node_id.hex()[:6]}")
+        self._server = RpcServer(self._lt, host)
+        self._pool = ClientPool(self._lt)
+        self._gcs = None  # RpcClient, set on start
+        if resources is None:
+            resources = {}
+        resources = dict(resources)
+        resources.setdefault("CPU", float(os.cpu_count() or 1))
+        resources.setdefault("memory", 4.0 * 1024**3)
+        self.labels = labels or {}
+        # node:<ip> affinity resource like the reference.
+        self.total: Resources = resources
+        self.available: Resources = dict(resources)
+        self._bundles: Dict[PlacementGroupID, Dict[int, _Bundle]] = {}
+        self._leases: Dict[WorkerID, _Lease] = {}
+        self._queue: List[_QueuedLease] = []
+        self._dispatch_event: Optional[asyncio.Event] = None
+        self._cluster_view: policy.View = {}
+        self._spread_rr = 0
+        self._log_dir = log_dir or os.path.join(CONFIG.log_dir, "workers")
+        self._worker_env = worker_env
+        self.worker_pool: Optional[WorkerPool] = None
+        self.address: Optional[str] = None
+        self._tasks: List[asyncio.Task] = []
+        self._stopped = False
+
+    # ------------------------------------------------------------------ start
+    def start(self, port: int = 0, max_workers: Optional[int] = None) -> str:
+        self._server.register_all(self)
+        self.address = self._server.start(port)
+        self.total.setdefault(f"node:{self.address}", 1.0)
+        self.available.setdefault(f"node:{self.address}", 1.0)
+        if max_workers is None:
+            max_workers = int(self.total.get("CPU", 1)) * 4 + 4
+        self.worker_pool = WorkerPool(
+            node_id_hex=self.node_id.hex(),
+            raylet_address=self.address,
+            gcs_address=self.gcs_address,
+            loop=self._lt.loop,
+            max_workers=max_workers,
+            log_dir=self._log_dir,
+            on_worker_death=self._on_worker_death,
+            env=self._worker_env,
+        )
+        from ray_tpu._private.rpc import RpcClient
+
+        self._gcs = RpcClient(self.gcs_address, self._lt)
+        info = NodeInfo(
+            node_id=self.node_id,
+            raylet_address=self.address,
+            resources_total=dict(self.total),
+            resources_available=dict(self.available),
+            labels=self.labels,
+            is_head=self.is_head,
+        )
+        self._gcs.call("register_node", {"info": info})
+        self._cluster_view[self.node_id] = (dict(self.total), dict(self.available))
+        self._cluster_addrs: Dict[NodeID, str] = {self.node_id: self.address}
+        # Event-driven view updates: heartbeats sync resources every period,
+        # but node joins/deaths must reflect immediately (a lease burst right
+        # after cluster bring-up would otherwise see a stale one-node view).
+        self._gcs.call(
+            "subscribe", {"channel": "NODE", "subscriber_address": self.address}
+        )
+
+        def _start_tasks():
+            self._dispatch_event = asyncio.Event()
+            self.worker_pool.start()
+            self._tasks.append(self._lt.loop.create_task(self._heartbeat_loop()))
+            self._tasks.append(self._lt.loop.create_task(self._dispatch_loop()))
+
+        self._lt.loop.call_soon_threadsafe(_start_tasks)
+        return self.address
+
+    def stop(self, unregister: bool = True):
+        if self._stopped:
+            return
+        self._stopped = True
+        for t in self._tasks:
+            t.cancel()
+        if self.worker_pool is not None:
+            self.worker_pool.shutdown()
+        if unregister and self._gcs is not None:
+            try:
+                self._gcs.call("unregister_node", {"node_id": self.node_id}, timeout=2)
+            except Exception:
+                pass
+        self._pool.close_all()
+        if self._gcs is not None:
+            self._gcs.close()
+        self._server.stop()
+        self._lt.stop()
+
+    # ------------------------------------------------------------- RPC: pool
+    async def handle_register_worker(self, payload):
+        self.worker_pool.register_worker(
+            payload["worker_id"], payload["pid"], payload["address"]
+        )
+        self._kick()
+        return {"status": "ok", "node_id": self.node_id}
+
+    async def handle_register_driver(self, payload):
+        self.worker_pool.register_driver(
+            payload["worker_id"], payload["pid"], payload["address"]
+        )
+        return {"status": "ok", "node_id": self.node_id, "gcs_address": self.gcs_address}
+
+    async def handle_return_worker(self, payload):
+        """Lease released by the submitter (direct_task_transport returns)."""
+        addr: Address = payload["worker_address"]
+        worker_id = addr.worker_id
+        lease = self._leases.pop(worker_id, None)
+        if lease is not None:
+            self._release_lease_resources(lease)
+        self.worker_pool.return_worker(worker_id, payload.get("disconnect", False))
+        self._kick()
+        return True
+
+    # ------------------------------------------------------------ RPC: lease
+    async def handle_request_worker_lease(self, payload):
+        spec: TaskSpec = payload["spec"]
+        spillback_count = payload.get("spillback_count", 0)
+        strat = spec.scheduling_strategy
+
+        if strat.kind == "PLACEMENT_GROUP":
+            # The submitter routes PG leases to the node holding the bundle.
+            if strat.placement_group_id not in self._bundles:
+                return {"rejected": True, "reason": "bundle not on this node"}
+            return await self._queue_local(spec)
+
+        if spillback_count == 0:
+            target = self._cluster_decision(spec)
+            if target is not None and target != self.node_id:
+                addr = self._raylet_addr_for(target)
+                if addr is not None:
+                    return {
+                        "retry_at": addr,
+                        "retry_at_node_id": target,
+                    }
+        if not resources_fit(self.total, spec.resources):
+            return {"rejected": True, "reason": "infeasible on this node"}
+        return await self._queue_local(spec)
+
+    def _cluster_decision(self, spec: TaskSpec) -> Optional[NodeID]:
+        strat = spec.scheduling_strategy
+        view = self._cluster_view
+        if strat.kind == "NODE_AFFINITY":
+            return policy.node_affinity_policy(
+                view, spec.resources, strat.node_id, strat.soft, self.node_id
+            )
+        if strat.kind == "SPREAD":
+            self._spread_rr += 1
+            return policy.spread_policy(view, spec.resources, self._spread_rr)
+        return policy.hybrid_policy(view, spec.resources, self.node_id)
+
+    def _raylet_addr_for(self, node_id: NodeID) -> Optional[str]:
+        entry = self._cluster_addrs.get(node_id) if hasattr(self, "_cluster_addrs") else None
+        return entry
+
+    async def _queue_local(self, spec: TaskSpec):
+        fut = self._lt.loop.create_future()
+        self._queue.append(_QueuedLease(spec, fut))
+        self._kick()
+        return await fut
+
+    def _kick(self):
+        if self._dispatch_event is not None:
+            self._lt.loop.call_soon_threadsafe(self._dispatch_event.set)
+
+    # -------------------------------------------------------- dispatch loop
+    async def _dispatch_loop(self):
+        while True:
+            await self._dispatch_event.wait()
+            self._dispatch_event.clear()
+            again = True
+            while again:
+                again = False
+                for q in list(self._queue):
+                    if q.future.done():
+                        self._queue.remove(q)
+                        continue
+                    alloc = self._try_allocate(q.spec)
+                    if alloc is None:
+                        continue
+                    self._queue.remove(q)
+                    again = True
+                    asyncio.ensure_future(self._grant(q, alloc))
+
+    def _try_allocate(self, spec: TaskSpec) -> Optional[Tuple[Resources, Optional[PlacementGroupID], int]]:
+        strat = spec.scheduling_strategy
+        if strat.kind == "PLACEMENT_GROUP":
+            bundles = self._bundles.get(strat.placement_group_id)
+            if bundles is None:
+                return None
+            indices = (
+                [strat.bundle_index]
+                if strat.bundle_index >= 0
+                else sorted(bundles.keys())
+            )
+            for i in indices:
+                b = bundles.get(i)
+                if b is not None and b.committed and resources_fit(b.available, spec.resources):
+                    subtract_resources(b.available, spec.resources)
+                    return (dict(spec.resources), strat.placement_group_id, i)
+            return None
+        if resources_fit(self.available, spec.resources):
+            subtract_resources(self.available, spec.resources)
+            return (dict(spec.resources), None, -1)
+        return None
+
+    async def _grant(self, q: _QueuedLease, alloc):
+        resources, pg_id, bundle_index = alloc
+        needs_accel = q.spec.resources.get("TPU", 0) > 0
+        worker = await self.worker_pool.pop_worker(
+            CONFIG.worker_register_timeout_s, needs_accelerator=needs_accel
+        )
+        if worker is None or q.future.done():
+            self._release_alloc(resources, pg_id, bundle_index)
+            if worker is not None:
+                self.worker_pool.return_worker(worker.worker_id)
+            if not q.future.done():
+                q.future.set_result({"rejected": True, "reason": "no worker available"})
+            return
+        is_actor = q.spec.task_type == TaskType.ACTOR_CREATION_TASK
+        self._leases[worker.worker_id] = _Lease(
+            worker_id=worker.worker_id,
+            resources=resources,
+            pg_id=pg_id,
+            bundle_index=bundle_index,
+            is_actor=is_actor,
+        )
+        if is_actor:
+            self.worker_pool.mark_actor_worker(
+                worker.worker_id, q.spec.actor_creation.actor_id
+            )
+        addr = Address(
+            node_id=self.node_id,
+            worker_id=worker.worker_id,
+            rpc_address=worker.address.rpc_address,
+        )
+        q.future.set_result({"worker_address": addr})
+
+    def _release_alloc(self, resources: Resources, pg_id, bundle_index):
+        if pg_id is not None:
+            bundles = self._bundles.get(pg_id)
+            if bundles is not None and bundle_index in bundles:
+                add_resources(bundles[bundle_index].available, resources)
+        else:
+            add_resources(self.available, resources)
+        self._kick()
+
+    def _release_lease_resources(self, lease: _Lease):
+        self._release_alloc(lease.resources, lease.pg_id, lease.bundle_index)
+
+    # ----------------------------------------------------------- RPC: PG 2PC
+    async def handle_prepare_bundles(self, payload):
+        pg_id: PlacementGroupID = payload["placement_group_id"]
+        bundles: Dict[int, Resources] = payload["bundles"]
+        total_demand: Resources = {}
+        for b in bundles.values():
+            for k, v in b.items():
+                total_demand[k] = total_demand.get(k, 0.0) + v
+        if not resources_fit(self.available, total_demand):
+            return False
+        subtract_resources(self.available, total_demand)
+        entry = self._bundles.setdefault(pg_id, {})
+        for i, b in bundles.items():
+            entry[i] = _Bundle(resources=dict(b), available=dict(b), committed=False)
+        return True
+
+    async def handle_commit_bundles(self, payload):
+        pg_id: PlacementGroupID = payload["placement_group_id"]
+        entry = self._bundles.get(pg_id, {})
+        for i in payload["indices"]:
+            if i in entry:
+                entry[i].committed = True
+        self._kick()
+        return True
+
+    async def handle_cancel_bundles(self, payload):
+        pg_id: PlacementGroupID = payload["placement_group_id"]
+        entry = self._bundles.pop(pg_id, None)
+        if entry:
+            for b in entry.values():
+                # Return the bundle reservation to the node pool. Resources
+                # currently consumed by still-running leases are returned when
+                # those leases end (guarded in _release_alloc by pg removal).
+                add_resources(self.available, b.available)
+        self._kick()
+        return True
+
+    # ------------------------------------------------------------ RPC: stats
+    async def handle_get_node_stats(self, payload):
+        return {
+            "node_id": self.node_id,
+            "total": dict(self.total),
+            "available": dict(self.available),
+            "queued_leases": len(self._queue),
+            "active_leases": len(self._leases),
+            "num_workers": self.worker_pool.num_alive if self.worker_pool else 0,
+            "bundles": {
+                pg.hex(): {i: b.resources for i, b in e.items()}
+                for pg, e in self._bundles.items()
+            },
+        }
+
+    async def handle_raylet_ping(self, payload):
+        return {"status": "ok", "node_id": self.node_id}
+
+    async def handle_pubsub_message(self, payload):
+        channel, key, message = payload
+        if channel == "NODE":
+            info: NodeInfo = message
+            if info.node_id == self.node_id:
+                return True
+            if info.alive:
+                self._cluster_view[info.node_id] = (
+                    dict(info.resources_total),
+                    dict(info.resources_available),
+                )
+                self._cluster_addrs[info.node_id] = info.raylet_address
+            else:
+                self._cluster_view.pop(info.node_id, None)
+                self._cluster_addrs.pop(info.node_id, None)
+        return True
+
+    # ------------------------------------------------------- background loops
+    async def _heartbeat_loop(self):
+        period = CONFIG.heartbeat_period_ms / 1000.0
+        while True:
+            try:
+                reply = await self._gcs.call_async(
+                    "report_resources",
+                    {
+                        "node_id": self.node_id,
+                        "available": dict(self.available),
+                        "total": dict(self.total),
+                        "load": len(self._queue),
+                    },
+                    timeout=5.0,
+                )
+                if reply.get("status") == "ok":
+                    view = reply["cluster_view"]
+                    self._cluster_addrs = {nid: v[0] for nid, v in view.items()}
+                    new_view = {}
+                    for nid, (addr, total, avail) in view.items():
+                        if nid == self.node_id:
+                            new_view[nid] = (dict(self.total), dict(self.available))
+                        else:
+                            new_view[nid] = (total, avail)
+                    self._cluster_view = new_view
+            except (ConnectionLost, OSError, asyncio.TimeoutError):
+                pass
+            await asyncio.sleep(period)
+
+    # ------------------------------------------------------------ worker death
+    def _on_worker_death(self, handle: WorkerHandle, prev_state: str):
+        lease = self._leases.pop(handle.worker_id, None) if handle.worker_id else None
+        if lease is not None:
+            self._release_lease_resources(lease)
+        if prev_state == "actor" and handle.actor_id is not None:
+            code = handle.proc.returncode if handle.proc else None
+            self._lt.submit(
+                self._gcs.send_async(
+                    "report_actor_death",
+                    {
+                        "actor_id": handle.actor_id,
+                        "reason": f"actor worker process died (exit code {code})",
+                        "intended": code == 0,
+                    },
+                )
+            )
+        self._kick()

@@ -1,0 +1,253 @@
+"""Raylet worker pool: spawn, register, lease, reap worker processes.
+
+Role of the reference's WorkerPool (ray: src/ray/raylet/worker_pool.h:155):
+starts `default_worker` subprocesses, matches lease requests to idle workers,
+prestarts spares, kills workers idle beyond the timeout, and watches child
+exits so the raylet can report worker/actor deaths.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from ray_tpu._private.config import CONFIG
+from ray_tpu._private.ids import WorkerID
+from ray_tpu._private.specs import Address
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class WorkerHandle:
+    worker_id: Optional[WorkerID] = None
+    pid: int = 0
+    address: Optional[Address] = None
+    proc: Optional[subprocess.Popen] = None
+    state: str = "starting"  # starting | idle | leased | actor | dead
+    idle_since: float = field(default_factory=time.monotonic)
+    actor_id = None
+    lease_task_id = None
+    is_driver: bool = False
+    needs_accelerator: bool = False
+
+
+class WorkerPool:
+    def __init__(
+        self,
+        node_id_hex: str,
+        raylet_address: str,
+        gcs_address: str,
+        loop: asyncio.AbstractEventLoop,
+        max_workers: int,
+        log_dir: str,
+        on_worker_death: Callable,
+        env: Optional[dict] = None,
+    ):
+        self._node_id_hex = node_id_hex
+        self._raylet_address = raylet_address
+        self._gcs_address = gcs_address
+        self._loop = loop
+        self._max_workers = max_workers
+        self._log_dir = log_dir
+        self._on_worker_death = on_worker_death
+        self._extra_env = env or {}
+        self._workers: Dict[int, WorkerHandle] = {}  # pid -> handle
+        self._registered: Dict[WorkerID, WorkerHandle] = {}
+        self._pop_waiters = 0
+        self._waiters: List[asyncio.Future] = []
+        self._monitor_task: Optional[asyncio.Task] = None
+        self._closed = False
+        os.makedirs(log_dir, exist_ok=True)
+
+    def start(self):
+        self._monitor_task = self._loop.create_task(self._monitor_loop())
+        for _ in range(CONFIG.worker_pool_prestart):
+            self._spawn()
+
+    @property
+    def num_alive(self) -> int:
+        return sum(1 for w in self._workers.values() if w.state != "dead")
+
+    def _spawn(self, needs_accelerator: bool = False):
+        if self._closed:
+            return
+        env = dict(os.environ)
+        if not needs_accelerator:
+            # This host's sitecustomize registers the TPU PJRT plugin (and
+            # imports JAX, ~2s) in every python process when
+            # PALLAS_AXON_POOL_IPS is set. Plain workers don't need the
+            # accelerator; dropping the trigger keeps spawn latency ~100ms.
+            # Leases whose task demands a `TPU` resource get a dedicated
+            # worker spawned with the accelerator env preserved.
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            env.setdefault("JAX_PLATFORMS", "cpu")
+        env.update(self._extra_env)
+        env["RT_SYSTEM_CONFIG"] = CONFIG.serialized_overrides()
+        # Keep worker start light: no JAX/accelerator init at import time.
+        cmd = [
+            sys.executable,
+            "-m",
+            "ray_tpu._private.workers.default_worker",
+            "--raylet-address", self._raylet_address,
+            "--gcs-address", self._gcs_address,
+            "--node-id", self._node_id_hex,
+        ]
+        logfile = open(
+            os.path.join(self._log_dir, f"worker-{time.monotonic_ns()}.log"), "ab"
+        )
+        proc = subprocess.Popen(
+            cmd, stdout=logfile, stderr=subprocess.STDOUT, env=env,
+            start_new_session=True,
+        )
+        handle = WorkerHandle(
+            pid=proc.pid, proc=proc, state="starting",
+            needs_accelerator=needs_accelerator,
+        )
+        self._workers[proc.pid] = handle
+
+    # -- registration (RPC from the worker once its server is up) --
+    def register_worker(self, worker_id: WorkerID, pid: int, address: Address) -> bool:
+        handle = self._workers.get(pid)
+        if handle is None:
+            # Worker not spawned by us (e.g. driver); track it anyway.
+            handle = WorkerHandle(pid=pid)
+            self._workers[pid] = handle
+        handle.worker_id = worker_id
+        handle.address = address
+        handle.state = "idle"
+        handle.idle_since = time.monotonic()
+        self._registered[worker_id] = handle
+        self._wake_waiters()
+        return True
+
+    def register_driver(self, worker_id: WorkerID, pid: int, address: Address):
+        handle = WorkerHandle(
+            worker_id=worker_id, pid=pid, address=address, state="leased",
+            is_driver=True,
+        )
+        self._workers[pid] = handle
+        self._registered[worker_id] = handle
+
+    def _wake_waiters(self):
+        waiters, self._waiters = self._waiters, []
+        for fut in waiters:
+            if not fut.done():
+                fut.set_result(None)
+
+    def _num_starting(self, needs_accelerator: bool) -> int:
+        return sum(
+            1
+            for w in self._workers.values()
+            if w.state == "starting" and w.needs_accelerator == needs_accelerator
+        )
+
+    async def pop_worker(
+        self, timeout: float, needs_accelerator: bool = False
+    ) -> Optional[WorkerHandle]:
+        """Get an idle worker, spawning if below the cap. None on timeout."""
+        deadline = time.monotonic() + timeout
+        self._pop_waiters = getattr(self, "_pop_waiters", 0) + 1
+        try:
+            while not self._closed:
+                for w in self._workers.values():
+                    if w.state == "idle" and w.needs_accelerator == needs_accelerator:
+                        w.state = "leased"
+                        return w
+                if (
+                    self.num_alive < self._max_workers
+                    and self._num_starting(needs_accelerator) < self._pop_waiters
+                ):
+                    self._spawn(needs_accelerator)
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                fut = self._loop.create_future()
+                self._waiters.append(fut)
+                try:
+                    await asyncio.wait_for(fut, min(remaining, 0.5))
+                except asyncio.TimeoutError:
+                    pass
+            return None
+        finally:
+            self._pop_waiters -= 1
+
+    def return_worker(self, worker_id: WorkerID, disconnect: bool = False):
+        handle = self._registered.get(worker_id)
+        if handle is None:
+            return
+        if disconnect:
+            self._kill(handle)
+            return
+        handle.state = "idle"
+        handle.idle_since = time.monotonic()
+        self._wake_waiters()
+
+    def mark_actor_worker(self, worker_id: WorkerID, actor_id):
+        handle = self._registered.get(worker_id)
+        if handle is not None:
+            handle.state = "actor"
+            handle.actor_id = actor_id
+
+    def get_by_worker_id(self, worker_id: WorkerID) -> Optional[WorkerHandle]:
+        return self._registered.get(worker_id)
+
+    def _kill(self, handle: WorkerHandle):
+        handle.state = "dead"
+        if handle.proc is not None and handle.proc.poll() is None:
+            try:
+                handle.proc.terminate()
+            except Exception:
+                pass
+
+    async def _monitor_loop(self):
+        """Reap dead children + idle-timeout spares (worker_pool.cc analog)."""
+        idle_timeout = CONFIG.worker_pool_idle_timeout_s
+        while not self._closed:
+            await asyncio.sleep(0.05)
+            now = time.monotonic()
+            for pid, handle in list(self._workers.items()):
+                if handle.proc is not None and handle.proc.poll() is not None:
+                    if handle.state != "dead":
+                        prev_state = handle.state
+                        handle.state = "dead"
+                        try:
+                            self._on_worker_death(handle, prev_state)
+                        except Exception:
+                            logger.exception("worker-death callback failed")
+                    if handle.worker_id is not None:
+                        self._registered.pop(handle.worker_id, None)
+                    del self._workers[pid]
+                elif (
+                    handle.state == "idle"
+                    and now - handle.idle_since > idle_timeout
+                    and not handle.is_driver
+                ):
+                    self._kill(handle)
+
+    def shutdown(self):
+        self._closed = True
+        if self._monitor_task is not None:
+            self._monitor_task.cancel()
+        for handle in self._workers.values():
+            if handle.proc is not None and handle.proc.poll() is None:
+                try:
+                    handle.proc.terminate()
+                except Exception:
+                    pass
+        deadline = time.monotonic() + 2.0
+        for handle in self._workers.values():
+            if handle.proc is not None:
+                try:
+                    handle.proc.wait(timeout=max(0.05, deadline - time.monotonic()))
+                except Exception:
+                    try:
+                        handle.proc.kill()
+                    except Exception:
+                        pass

@@ -1,0 +1,73 @@
+"""RemoteFunction: the `@ray_tpu.remote` task wrapper.
+
+Reference: ray python/ray/remote_function.py (RemoteFunction._remote :266 →
+core_worker.submit_task :435) with `.options(...)` overrides
+(remote_function.py:160) validated by ray_option_utils.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional
+
+from ray_tpu._private import ray_option_utils as opts
+from ray_tpu._raylet import get_core_worker
+from ray_tpu._private.specs import SchedulingStrategySpec
+from ray_tpu.util.scheduling_strategies import to_spec
+
+
+class RemoteFunction:
+    def __init__(self, fn, options: Optional[Dict[str, Any]] = None):
+        self._function = fn
+        self._options = opts.validate_options(options or {}, is_actor=False)
+        self._function_id: Optional[str] = None
+        functools.update_wrapper(self, fn)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Remote function '{self._function.__name__}' cannot be called "
+            "directly; use .remote()."
+        )
+
+    def options(self, **overrides) -> "RemoteFunction":
+        merged = opts.merge_options(self._options, overrides)
+        rf = RemoteFunction(self._function, merged)
+        rf._function_id = self._function_id
+        return rf
+
+    def remote(self, *args, **kwargs):
+        cw = get_core_worker()
+        if self._function_id is None:
+            self._function_id = cw.register_function(self._function)
+        o = self._options
+        num_returns = o.get("num_returns", 1)
+        strategy = to_spec(o.get("scheduling_strategy"), o)
+        result = cw.submit_task(
+            self._function,
+            args,
+            kwargs,
+            num_returns=num_returns,
+            resources=opts.resources_from_options(o, is_actor=False),
+            max_retries=o.get("max_retries", 3),
+            retry_exceptions=bool(o.get("retry_exceptions", False)),
+            scheduling_strategy=strategy,
+            name=o.get("name") or self._function.__name__,
+            function_id=self._function_id,
+            runtime_env=o.get("runtime_env"),
+        )
+        if isinstance(result, list):
+            if num_returns == 1:
+                return result[0]
+            if num_returns == 0:
+                return None
+        return result
+
+    def bind(self, *args, **kwargs):
+        """Lazy DAG node construction (reference: dag/dag_node.py .bind())."""
+        from ray_tpu.dag import FunctionNode
+
+        return FunctionNode(self, args, kwargs)
+
+    @property
+    def _underlying(self):
+        return self._function

@@ -1,0 +1,90 @@
+"""ActorPool: load-balance tasks over a fixed set of actors.
+
+Reference: ray python/ray/util/actor_pool.py:13 — same API
+(submit/get_next/get_next_unordered/map/map_unordered/has_next/
+has_free/pop_idle/push).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, List
+
+
+class ActorPool:
+    def __init__(self, actors: List[Any]):
+        self._idle = list(actors)
+        self._future_to_actor = {}
+        self._index_to_future = {}
+        self._next_task_index = 0
+        self._next_return_index = 0
+        self._pending_submits = []
+
+    def submit(self, fn: Callable, value: Any) -> None:
+        if self._idle:
+            actor = self._idle.pop()
+            future = fn(actor, value)
+            self._future_to_actor[future] = (self._next_task_index, actor)
+            self._index_to_future[self._next_task_index] = future
+            self._next_task_index += 1
+        else:
+            self._pending_submits.append((fn, value))
+
+    def has_next(self) -> bool:
+        return bool(self._future_to_actor)
+
+    def get_next(self, timeout=None) -> Any:
+        from ray_tpu import api
+
+        if not self.has_next():
+            raise StopIteration("no more results to get")
+        future = self._index_to_future.pop(self._next_return_index)
+        self._next_return_index += 1
+        i, actor = self._future_to_actor.pop(future)
+        self._return_actor(actor)
+        return api.get(future, timeout=timeout)
+
+    def get_next_unordered(self, timeout=None) -> Any:
+        from ray_tpu import api
+
+        if not self.has_next():
+            raise StopIteration("no more results to get")
+        ready, _ = api.wait(
+            list(self._future_to_actor), num_returns=1, timeout=timeout
+        )
+        if not ready:
+            raise TimeoutError("timed out waiting for result")
+        future = ready[0]
+        i, actor = self._future_to_actor.pop(future)
+        self._index_to_future.pop(i, None)
+        self._return_actor(actor)
+        return api.get(future)
+
+    def _return_actor(self, actor):
+        self._idle.append(actor)
+        while self._pending_submits and self._idle:
+            fn, value = self._pending_submits.pop(0)
+            self.submit(fn, value)
+
+    def map(self, fn: Callable, values: Iterable[Any]):
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()
+
+    def map_unordered(self, fn: Callable, values: Iterable[Any]):
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next_unordered()
+
+    def has_free(self) -> bool:
+        return bool(self._idle) and not self._pending_submits
+
+    def pop_idle(self):
+        return self._idle.pop() if self.has_free() else None
+
+    def push(self, actor) -> None:
+        busy = {a for _, a in self._future_to_actor.values()}
+        if actor in self._idle or actor in busy:
+            raise ValueError("actor already belongs to the pool")
+        self._return_actor(actor)

@@ -1,0 +1,1476 @@
+"""CoreWorker: the in-process runtime of every driver and worker.
+
+Role of the reference's CoreWorker (ray: src/ray/core_worker/core_worker.h:292)
+— task submission (core_worker.cc:2147), actor creation (:2224), actor task
+submission (:2469), Get (:1542), Put (:1242), Wait (:1735), placement groups
+(:2395/:2455) — plus its transports: the lease-based normal-task submitter
+with per-scheduling-key worker-lease caching
+(transport/direct_task_transport.h:75) and the direct actor submitter with
+sequence-number ordering (transport/direct_actor_task_submitter.h:74), the
+owner-side task retry FSM (task_manager.h:208) and lineage-based object
+recovery (object_recovery_manager.h:41).
+
+One CoreWorker per process; drivers and workers differ only in how they were
+started and whether an Executor serves push_task.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import logging
+import os
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu import exceptions as exc
+from ray_tpu._private import serialization as ser
+from ray_tpu._private.config import CONFIG
+from ray_tpu._private.ids import (
+    ActorID,
+    JobID,
+    NodeID,
+    ObjectID,
+    PlacementGroupID,
+    TaskID,
+    WorkerID,
+)
+from ray_tpu._private.rpc import (
+    ClientPool,
+    ConnectionLost,
+    EventLoopThread,
+    RpcClient,
+    RpcServer,
+)
+from ray_tpu._private.specs import (
+    ActorCreationSpec,
+    ActorState,
+    Address,
+    PlacementGroupSpec,
+    SchedulingStrategySpec,
+    TaskArg,
+    TaskSpec,
+    TaskType,
+)
+from ray_tpu._raylet import ObjectRef, ObjectRefGenerator, global_state
+from ray_tpu.gcs import pubsub as ps
+from ray_tpu.worker.executor import Executor
+from ray_tpu.worker.memory_store import MemoryStore, StoreEntry, _SENTINEL
+from ray_tpu.worker.reference_counter import ReferenceCounter
+
+logger = logging.getLogger(__name__)
+
+_task_ctx = threading.local()
+
+
+@dataclass
+class _PendingTask:
+    spec: TaskSpec
+    retries_left: int
+    is_actor_task: bool = False
+    pushed_to: Optional[str] = None  # worker rpc address while running
+    arg_ids: List[ObjectID] = field(default_factory=list)
+
+
+@dataclass
+class _GeneratorState:
+    total: Optional[int] = None      # known once the task completes
+    reported: int = 0
+    error: Optional[ser.SerializedObject] = None
+    cv: threading.Condition = field(default_factory=threading.Condition)
+
+
+@dataclass
+class _Lease:
+    address: Address
+    busy: bool = False
+    idle_since: float = 0.0
+
+
+@dataclass
+class _KeyState:
+    pending: deque = field(default_factory=deque)
+    leases: Dict[str, _Lease] = field(default_factory=dict)
+    inflight_lease_requests: int = 0
+
+
+@dataclass
+class _ActorRecord:
+    actor_id: ActorID
+    state: str = "PENDING"  # PENDING | ALIVE | RESTARTING | DEAD
+    address: Optional[Address] = None
+    seq: int = 0
+    queue: deque = field(default_factory=deque)  # TaskSpec waiting for address
+    inflight: int = 0
+    death_cause: Optional[str] = None
+    max_task_retries: int = 0
+    incarnation: int = 0  # observed num_restarts; seq resets per incarnation
+
+
+class CoreWorker:
+    def __init__(
+        self,
+        *,
+        mode: str,  # "driver" | "worker"
+        gcs_address: str,
+        raylet_address: Optional[str],
+        job_id: Optional[JobID] = None,
+        namespace: str = "",
+        node_id: Optional[NodeID] = None,
+        host: str = "127.0.0.1",
+    ):
+        self.mode = mode
+        self.namespace = namespace
+        self.worker_id = WorkerID.from_random()
+        self.node_id = node_id
+        self._lt = EventLoopThread(f"cw-{self.worker_id.hex()[:6]}")
+        self._server = RpcServer(self._lt, host)
+        self._peers = ClientPool(self._lt, peer_meta={"worker_id": self.worker_id.hex()})
+        self._gcs = RpcClient(gcs_address, self._lt)
+        self.gcs_address = gcs_address
+        self._raylet = RpcClient(raylet_address, self._lt) if raylet_address else None
+        self.raylet_address = raylet_address
+        self.memory_store = MemoryStore()
+        self.reference_counter = ReferenceCounter(
+            free_callback=self._free_owned_object,
+            notify_owner_release=self._notify_owner_release,
+        )
+        self.executor = Executor(self)
+        self._pending_tasks: Dict[TaskID, _PendingTask] = {}
+        self._generators: Dict[TaskID, _GeneratorState] = {}
+        self._key_states: Dict[tuple, _KeyState] = {}
+        self._actors: Dict[ActorID, _ActorRecord] = {}
+        self._actor_sub_started = False
+        self._secondary_copies: set = set()
+        self._registered_fns: set = set()
+        self._fn_kv_cache: Dict[bytes, bytes] = {}
+        self._put_index = 0
+        self._put_lock = threading.Lock()
+        self._subscriptions: Dict[str, list] = {}
+        self._node_addr_cache: Dict[NodeID, str] = {}
+        self._pg_cache: Dict[PlacementGroupID, Any] = {}
+        self._task_events: deque = deque(maxlen=10_000)
+        self._shutdown = False
+        self.current_actor_id: Optional[ActorID] = None
+        self.is_actor_worker = False
+
+        # -- connect --
+        self._register_handlers()
+        self.address_str = self._server.start(0)
+        if job_id is None:
+            job_id = self._gcs.call("get_next_job_id", {})
+        self.job_id = job_id
+        self._root_task_id = TaskID.for_normal_task(job_id)
+        self.address = Address(
+            node_id=self.node_id, worker_id=self.worker_id, rpc_address=self.address_str
+        )
+        # Publish the global worker BEFORE raylet registration: the raylet may
+        # lease this worker and push a task the instant registration lands.
+        global_state.core_worker = self
+        if self._raylet is not None:
+            method = "register_driver" if mode == "driver" else "register_worker"
+            reply = self._raylet.call(
+                method,
+                {
+                    "worker_id": self.worker_id,
+                    "pid": os.getpid(),
+                    "address": Address(
+                        node_id=None, worker_id=self.worker_id, rpc_address=self.address_str
+                    ),
+                },
+            )
+            self.node_id = reply.get("node_id", node_id)
+            self.address = Address(
+                node_id=self.node_id, worker_id=self.worker_id,
+                rpc_address=self.address_str,
+            )
+        self._lease_reaper = self._lt.submit(self._lease_reaper_loop())
+        self._event_flusher = self._lt.submit(self._task_event_loop())
+        # Node-death awareness: a dead raylet's TCP connections can linger
+        # (especially for in-process test raylets), so lease requests to it
+        # would hang. Invalidate its clients the moment the GCS declares it
+        # dead, and fail the local raylet over if it was ours.
+        self.subscribe(ps.NODE_CHANNEL, self._on_node_event)
+        self._gcs.call(
+            "subscribe",
+            {"channel": ps.NODE_CHANNEL, "subscriber_address": self.address_str},
+        )
+
+    # ------------------------------------------------------------- lifecycle
+    def _register_handlers(self):
+        s = self._server
+        s.register("push_task", self._handle_push_task)
+        s.register("fetch_object", self._handle_fetch_object)
+        s.register("get_object", self._handle_get_object)
+        s.register("free_objects", self._handle_free_objects)
+        s.register("add_borrower", self._handle_add_borrower)
+        s.register("remove_borrower", self._handle_remove_borrower)
+        s.register("report_generator_item", self._handle_report_generator_item)
+        s.register("kill_actor", self._handle_kill_actor)
+        s.register("cancel_task", self._handle_cancel_task)
+        s.register("exit", self._handle_exit)
+        s.register("ping", self._handle_ping)
+        s.register("pubsub_message", self._handle_pubsub_message)
+        s.register("reconstruct_object", self._handle_reconstruct_object)
+
+    def shutdown(self, mark_job_finished: bool = True):
+        if self._shutdown:
+            return
+        self._shutdown = True
+        if self.mode == "driver" and mark_job_finished:
+            try:
+                self._gcs.call("mark_job_finished", {"job_id": self.job_id}, timeout=5)
+            except Exception:
+                pass
+        self._lease_reaper.cancel()
+        self._event_flusher.cancel()
+        self.executor.shutdown()
+        self._peers.close_all()
+        self._gcs.close()
+        if self._raylet is not None:
+            self._raylet.close()
+        self._server.stop()
+        self._lt.stop()
+        if global_state.core_worker is self:
+            global_state.core_worker = None
+
+    def _fire(self, coro):
+        """Fire-and-forget a coroutine, swallowing connection errors."""
+
+        async def _safe():
+            try:
+                await coro
+            except Exception:
+                pass
+
+        self._lt.submit(_safe())
+
+    # ---------------------------------------------------------- task context
+    def enter_task_context(self, spec: TaskSpec):
+        prev = getattr(_task_ctx, "spec", None)
+        _task_ctx.spec = spec
+        return prev
+
+    def exit_task_context(self, token):
+        _task_ctx.spec = token
+
+    def current_task_id(self) -> TaskID:
+        spec = getattr(_task_ctx, "spec", None)
+        return spec.task_id if spec is not None else self._root_task_id
+
+    def current_spec(self) -> Optional[TaskSpec]:
+        return getattr(_task_ctx, "spec", None)
+
+    # ------------------------------------------------------------------- KV
+    def kv_get(self, key: bytes, namespace: Optional[str] = None) -> Optional[bytes]:
+        cached = self._fn_kv_cache.get(key)
+        if cached is not None:
+            return cached
+        value = self._gcs.call("kv_get", {"key": key, "namespace": namespace})
+        if value is not None and key.startswith(b"fun:"):
+            self._fn_kv_cache[key] = value
+        return value
+
+    def kv_put(self, key: bytes, value: bytes, overwrite: bool = True,
+               namespace: Optional[str] = None) -> bool:
+        return self._gcs.call(
+            "kv_put",
+            {"key": key, "value": value, "overwrite": overwrite, "namespace": namespace},
+        )
+
+    def register_function(self, fn) -> str:
+        data = ser.dumps_function(fn)
+        fid = hashlib.sha1(data).hexdigest()
+        if fid not in self._registered_fns:
+            self.kv_put(b"fun:" + fid.encode(), data, overwrite=False)
+            self._registered_fns.add(fid)
+        return fid
+
+    # ------------------------------------------------------------------- put
+    def put(self, value: Any) -> ObjectRef:
+        with self._put_lock:
+            self._put_index += 1
+            idx = self._put_index
+        oid = ObjectID.for_put(self.current_task_id(), idx)
+        s = ser.serialize(value)
+        self.memory_store.put_serialized(oid, s, value=value)
+        self.reference_counter.add_owned(oid, self.address)
+        for ref in s.contained_refs:
+            pass  # nested refs stay alive via the stored value holding them
+        return ObjectRef(oid, owner_address=self.address)
+
+    # ------------------------------------------------------------------- get
+    def get(self, refs: List[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
+        ids = [r.object_id() for r in refs]
+        owners = [r.owner_address for r in refs]
+        return self.get_objects_by_id(ids, owners, timeout)
+
+    def get_objects_by_id(
+        self, ids: List[ObjectID], owners: List[Optional[Address]],
+        timeout: Optional[float] = None,
+    ) -> List[Any]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        out: List[Any] = []
+        for oid, owner in zip(ids, owners):
+            out.append(self._get_one(oid, owner, deadline))
+        return out
+
+    def _remaining(self, deadline) -> Optional[float]:
+        if deadline is None:
+            return None
+        rem = deadline - time.monotonic()
+        if rem <= 0:
+            raise exc.GetTimeoutError("get() timed out")
+        return rem
+
+    def _get_one(self, oid: ObjectID, owner: Optional[Address], deadline) -> Any:
+        while True:
+            entry = self.memory_store.get_entry(oid)
+            if entry is not None:
+                return self._materialize(oid, entry, deadline)
+            if self.reference_counter.owns(oid) or (
+                owner is not None and owner.rpc_address == self.address_str
+            ):
+                rem = self._remaining(deadline)
+                entry = self.memory_store.wait_entry(oid, rem if rem is not None else None)
+                if entry is None:
+                    if deadline is not None:
+                        raise exc.GetTimeoutError("get() timed out")
+                    continue
+                return self._materialize(oid, entry, deadline)
+            if owner is None:
+                raise exc.ObjectLostError(oid.hex())
+            # Borrower path: long-poll the owner.
+            rem = self._remaining(deadline)
+            slice_t = 2.0 if rem is None else min(2.0, rem)
+            client = self._peers.get(owner.rpc_address)
+            try:
+                reply = client.call(
+                    "get_object",
+                    {"object_id": oid, "want_value": True, "timeout": slice_t},
+                    timeout=slice_t + 10,
+                )
+            except ConnectionLost:
+                raise exc.OwnerDiedError(oid.hex())
+            status = reply["status"]
+            if status == "ready":
+                if "data" in reply:
+                    value, _ = ser.deserialize(reply["data"])
+                    if reply.get("is_exception"):
+                        self._raise_stored_error(value)
+                    return value
+                location = reply["location"]
+                try:
+                    data = self._fetch_from_location(oid, location, owner, deadline)
+                except _RetryGet:
+                    continue  # owner is reconstructing; re-resolve
+                value, _ = ser.deserialize(data)
+                return value
+            if status == "freed":
+                raise exc.ObjectFreedError(oid.hex())
+            if status == "not_owner":
+                raise exc.OwnerDiedError(oid.hex())
+            # pending: loop (deadline enforced via _remaining)
+
+    def _materialize(self, oid: ObjectID, entry: StoreEntry, deadline) -> Any:
+        if entry.freed:
+            raise exc.ObjectFreedError(oid.hex())
+        if entry.location is not None and entry.serialized is None:
+            data = self._fetch_from_location(oid, entry.location, self.address, deadline)
+            value, _ = ser.deserialize(data)
+            if entry.is_exception:
+                self._raise_stored_error(value)
+            return value
+        if entry.value is not _SENTINEL:
+            if entry.is_exception:
+                self._raise_stored_error(entry.value)
+            return entry.value
+        value, _ = ser.deserialize(entry.serialized)
+        self.memory_store.cache_value(oid, value)
+        if entry.is_exception:
+            self._raise_stored_error(value)
+        return value
+
+    def _raise_stored_error(self, err: Any):
+        if isinstance(err, exc.RayTaskError):
+            raise err.as_instanceof_cause()
+        if isinstance(err, BaseException):
+            raise err
+        raise exc.RaySystemError(f"corrupt error object: {err!r}")
+
+    def _fetch_from_location(
+        self, oid: ObjectID, location: str, owner: Optional[Address], deadline
+    ) -> ser.SerializedObject:
+        attempts = 0
+        while True:
+            attempts += 1
+            client = self._peers.get(location)
+            try:
+                reply = client.call("fetch_object", {"object_id": oid}, timeout=60)
+                if reply.get("status") == "ok":
+                    return reply["data"]
+            except ConnectionLost:
+                self._peers.invalidate(location)
+            # Primary copy lost. Try lineage reconstruction via the owner.
+            if owner is not None and owner.rpc_address == self.address_str:
+                if not self._try_reconstruct(oid):
+                    raise exc.ObjectLostError(oid.hex())
+                entry = self.memory_store.wait_entry(oid, 60)
+                if entry is None:
+                    raise exc.ObjectLostError(oid.hex())
+                if entry.location is not None and entry.serialized is None:
+                    location = entry.location
+                    continue
+                return entry.serialized
+            elif owner is not None:
+                try:
+                    ok = self._peers.get(owner.rpc_address).call(
+                        "reconstruct_object", {"object_id": oid}, timeout=60
+                    )
+                except ConnectionLost:
+                    raise exc.OwnerDiedError(oid.hex())
+                if not ok:
+                    raise exc.ObjectLostError(oid.hex())
+                time.sleep(CONFIG.fetch_retry_interval_ms / 1000.0)
+                raise _RetryGet()  # caller loop re-resolves via owner
+            if attempts > 3:
+                raise exc.ObjectLostError(oid.hex())
+
+    def _try_reconstruct(self, oid: ObjectID) -> bool:
+        """Owner-side lineage reconstruction (object_recovery_manager.h:41)."""
+        if not CONFIG.enable_lineage_reconstruction:
+            return False
+        spec = self.reference_counter.get_lineage(oid)
+        if spec is None:
+            return False
+        tid = spec.task_id
+        if tid in self._pending_tasks:
+            return True  # already re-executing
+        logger.info("reconstructing %s by re-executing %s", oid.hex()[:12], spec.function_name)
+        self.memory_store.delete([o for o in spec.return_ids()])
+        spec.attempt_number += 1
+        self._pending_tasks[tid] = _PendingTask(
+            spec=spec, retries_left=0, arg_ids=[a.object_id for a in spec.args if not a.is_inline]
+        )
+        self._normal_submit(spec)
+        return True
+
+    # ------------------------------------------------------------------ wait
+    def wait(
+        self,
+        refs: List[ObjectRef],
+        num_returns: int = 1,
+        timeout: Optional[float] = None,
+        fetch_local: bool = True,
+    ) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        pending = list(refs)
+        ready: List[ObjectRef] = []
+        while True:
+            still = []
+            for ref in pending:
+                if self._is_ready(ref):
+                    ready.append(ref)
+                else:
+                    still.append(ref)
+            pending = still
+            if len(ready) >= num_returns or not pending:
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            time.sleep(0.005)
+        return ready[:num_returns], ready[num_returns:] + pending
+
+    def _is_ready(self, ref: ObjectRef) -> bool:
+        oid = ref.object_id()
+        if self.memory_store.contains(oid):
+            return True
+        if self.reference_counter.owns(oid):
+            return False
+        owner = ref.owner_address
+        if owner is None or owner.rpc_address == self.address_str:
+            return False
+        try:
+            reply = self._peers.get(owner.rpc_address).call(
+                "get_object",
+                {"object_id": oid, "want_value": False, "timeout": 0},
+                timeout=10,
+            )
+        except ConnectionLost:
+            raise exc.OwnerDiedError(oid.hex())
+        return reply["status"] in ("ready", "freed")
+
+    # ----------------------------------------------------------- submit task
+    def _build_args(self, args, kwargs) -> Tuple[List[TaskArg], Dict[str, TaskArg], List[ObjectID]]:
+        arg_ids: List[ObjectID] = []
+
+        def build(value) -> TaskArg:
+            if isinstance(value, ObjectRef):
+                arg_ids.append(value.object_id())
+                self.reference_counter.add_submitted_task_ref(value.object_id())
+                return TaskArg(
+                    is_inline=False,
+                    object_id=value.object_id(),
+                    owner_address=value.owner_address or self.address,
+                )
+            s = ser.serialize(value)
+            if s.total_bytes() > CONFIG.max_direct_call_object_size:
+                ref = self.put(value)
+                arg_ids.append(ref.object_id())
+                self.reference_counter.add_submitted_task_ref(ref.object_id())
+                return TaskArg(
+                    is_inline=False, object_id=ref.object_id(), owner_address=self.address
+                )
+            nested = [r.object_id() for r in s.contained_refs]
+            for r in s.contained_refs:
+                arg_ids.append(r.object_id())
+                self.reference_counter.add_submitted_task_ref(r.object_id())
+            return TaskArg(is_inline=True, data=s, nested_ids=nested)
+
+        return (
+            [build(a) for a in args],
+            {k: build(v) for k, v in (kwargs or {}).items()},
+            arg_ids,
+        )
+
+    def submit_task(
+        self,
+        fn,
+        args: tuple,
+        kwargs: dict,
+        *,
+        num_returns=1,
+        resources=None,
+        max_retries: int = 3,
+        retry_exceptions: bool = False,
+        scheduling_strategy: Optional[SchedulingStrategySpec] = None,
+        name: str = "",
+        function_id: Optional[str] = None,
+        runtime_env: Optional[dict] = None,
+    ):
+        fid = function_id or self.register_function(fn)
+        task_id = TaskID.for_normal_task(self.job_id)
+        streaming = num_returns == "streaming" or num_returns == -1
+        arg_specs, kwarg_specs, arg_ids = self._build_args(args, kwargs)
+        spec = TaskSpec(
+            task_id=task_id,
+            job_id=self.job_id,
+            task_type=TaskType.NORMAL_TASK,
+            function_id=fid,
+            function_name=name or getattr(fn, "__name__", "task"),
+            args=arg_specs,
+            num_returns=-1 if streaming else num_returns,
+            resources=resources or {"CPU": CONFIG.default_task_num_cpus},
+            owner_address=self.address,
+            max_retries=max_retries,
+            retry_exceptions=retry_exceptions,
+            scheduling_strategy=scheduling_strategy or SchedulingStrategySpec(),
+            runtime_env=runtime_env,
+        )
+        spec.kwarg_specs = kwarg_specs
+        self._pending_tasks[task_id] = _PendingTask(
+            spec=spec, retries_left=max_retries, arg_ids=arg_ids
+        )
+        lineage = spec if CONFIG.enable_lineage_reconstruction else None
+        return_refs = []
+        for oid in spec.return_ids():
+            self.reference_counter.add_owned(oid, self.address, lineage_task=lineage)
+            return_refs.append(ObjectRef(oid, owner_address=self.address))
+        self._record_task_event(spec, "PENDING")
+        if streaming:
+            self._generators[task_id] = _GeneratorState()
+        self._normal_submit(spec)
+        if streaming:
+            return ObjectRefGenerator(task_id)
+        return return_refs
+
+    def _normal_submit(self, spec: TaskSpec):
+        self._lt.submit(self._submit_async(spec))
+
+    async def _submit_async(self, spec: TaskSpec):
+        key = spec.scheduling_key()
+        st = self._key_states.setdefault(key, _KeyState())
+        st.pending.append(spec)
+        await self._pump(key)
+
+    async def _pump(self, key):
+        st = self._key_states.get(key)
+        if st is None:
+            return
+        # Assign pending specs to idle leases.
+        for lease in list(st.leases.values()):
+            if not st.pending:
+                break
+            if not lease.busy:
+                spec = st.pending.popleft()
+                lease.busy = True
+                asyncio.ensure_future(self._push(key, lease, spec))
+        # Request more leases if there is unassigned work.
+        want = len(st.pending)
+        cap = CONFIG.max_pending_lease_requests_per_scheduling_key
+        while st.inflight_lease_requests < min(want, cap):
+            st.inflight_lease_requests += 1
+            spec = st.pending[0]
+            asyncio.ensure_future(self._request_lease(key, spec))
+            want -= 1
+
+    async def _resolve_route(self, spec: TaskSpec) -> Optional[str]:
+        strat = spec.scheduling_strategy
+        if strat.kind == "PLACEMENT_GROUP":
+            info = await self._get_pg_info(strat.placement_group_id)
+            if info is None:
+                return None
+            locations = info.bundle_locations
+            if strat.bundle_index >= 0:
+                node = locations.get(strat.bundle_index)
+            else:
+                nodes = list(locations.values())
+                node = nodes[spec.task_id.binary()[0] % len(nodes)] if nodes else None
+            if node is None:
+                return None
+            return await self._node_raylet_addr(node)
+        if strat.kind == "NODE_AFFINITY" and strat.node_id is not None:
+            addr = await self._node_raylet_addr(strat.node_id)
+            if addr is not None:
+                return addr
+            if not strat.soft:
+                return None
+        return self.raylet_address
+
+    async def _get_pg_info(self, pg_id: PlacementGroupID):
+        info = self._pg_cache.get(pg_id)
+        if info is not None and len(info.bundle_locations) == len(info.spec.bundles):
+            return info
+        reply = await self._gcs.call_async(
+            "wait_placement_group_ready",
+            {"placement_group_id": pg_id, "timeout": 60},
+        )
+        if reply.get("status") != "ready":
+            return None
+        info = reply["info"]
+        self._pg_cache[pg_id] = info
+        return info
+
+    async def _node_raylet_addr(self, node_id: NodeID) -> Optional[str]:
+        addr = self._node_addr_cache.get(node_id)
+        if addr is not None:
+            return addr
+        nodes = await self._gcs.call_async("get_all_node_info", {})
+        for n in nodes:
+            if n.alive:
+                self._node_addr_cache[n.node_id] = n.raylet_address
+        return self._node_addr_cache.get(node_id)
+
+    async def _request_lease(self, key, sample_spec: TaskSpec):
+        st = self._key_states[key]
+        try:
+            await self._request_lease_inner(key, sample_spec, st)
+        except ConnectionLost:
+            if not self._shutdown:
+                self._fail_queued(key, exc.RaySystemError(
+                    "lost connection to the local raylet"))
+        finally:
+            st.inflight_lease_requests -= 1
+
+    async def _request_lease_inner(self, key, sample_spec: TaskSpec, st):
+        target = await self._resolve_route(sample_spec)
+        spillback = 0
+        warned = 0.0
+        while not self._shutdown:
+            if not st.pending:
+                return
+            if target is None:
+                self._fail_queued(key, exc.RaySystemError(
+                    f"no feasible node for task {sample_spec.function_name} "
+                    f"(strategy={sample_spec.scheduling_strategy.kind})"))
+                return
+            client = self._peers.get(target)
+            try:
+                reply = await client.call_async(
+                    "request_worker_lease",
+                    {"spec": st.pending[0] if st.pending else sample_spec,
+                     "spillback_count": spillback},
+                    timeout=None,
+                )
+            except ConnectionLost:
+                if target == self.raylet_address:
+                    new_local = await self._refresh_local_raylet()
+                    if new_local is None or new_local == target:
+                        raise
+                    target = new_local
+                else:
+                    target = self.raylet_address
+                spillback = 0
+                continue
+            if "retry_at" in reply:
+                target = reply["retry_at"]
+                spillback = 1
+                continue
+            if reply.get("rejected"):
+                now = time.monotonic()
+                if now - warned > 10:
+                    warned = now
+                    logger.warning(
+                        "lease request for %s rejected: %s (retrying)",
+                        sample_spec.function_name, reply.get("reason"),
+                    )
+                await asyncio.sleep(0.2)
+                target = await self._resolve_route(sample_spec)
+                spillback = 0
+                continue
+            addr: Address = reply["worker_address"]
+            st.leases[addr.rpc_address] = _Lease(address=addr, busy=False,
+                                                idle_since=time.monotonic())
+            await self._pump(key)
+            return
+
+    def _fail_queued(self, key, error: Exception):
+        st = self._key_states.get(key)
+        if st is None:
+            return
+        while st.pending:
+            spec = st.pending.popleft()
+            self._store_error_for_task(spec, error)
+
+    async def _push(self, key, lease: _Lease, spec: TaskSpec):
+        st = self._key_states[key]
+        pending = self._pending_tasks.get(spec.task_id)
+        if pending is not None:
+            pending.pushed_to = lease.address.rpc_address
+        client = self._peers.get(lease.address.rpc_address)
+        self._record_task_event(spec, "RUNNING")
+        try:
+            reply = await client.call_async("push_task", {"spec": spec}, timeout=None)
+        except ConnectionLost:
+            st.leases.pop(lease.address.rpc_address, None)
+            self._peers.invalidate(lease.address.rpc_address)
+            self._on_worker_failure(spec)
+            await self._pump(key)
+            return
+        self._on_task_reply(spec, reply)
+        lease.busy = False
+        lease.idle_since = time.monotonic()
+        if st.pending:
+            await self._pump(key)
+
+    async def _lease_reaper_loop(self):
+        timeout = CONFIG.worker_lease_idle_timeout_ms / 1000.0
+        while True:
+            await asyncio.sleep(timeout / 2)
+            now = time.monotonic()
+            for key, st in list(self._key_states.items()):
+                for addr, lease in list(st.leases.items()):
+                    if not lease.busy and now - lease.idle_since > timeout:
+                        st.leases.pop(addr, None)
+                        asyncio.ensure_future(self._return_lease(lease))
+
+    async def _return_lease(self, lease: _Lease):
+        node = lease.address.node_id
+        raylet_addr = self.raylet_address
+        if node is not None and node != self.node_id:
+            raylet_addr = await self._node_raylet_addr(node) or raylet_addr
+        if raylet_addr is None:
+            return
+        try:
+            await self._peers.get(raylet_addr).send_async(
+                "return_worker", {"worker_address": lease.address}
+            )
+        except ConnectionLost:
+            pass
+
+    # ------------------------------------------------- task completion paths
+    def _on_task_reply(self, spec: TaskSpec, reply: dict):
+        pending = self._pending_tasks.get(spec.task_id)
+        if pending is None or pending.spec.attempt_number != spec.attempt_number:
+            return
+        status = reply.get("status")
+        if status == "ok":
+            for oid, payload in reply.get("returns", []):
+                self._store_return(oid, payload)
+            if spec.is_streaming_generator():
+                self._finish_generator(spec.task_id, reply.get("streaming_num_items", 0))
+            self._finalize_task(spec, "FINISHED")
+        elif status == "cancelled":
+            err = exc.TaskCancelledError(spec.task_id)
+            self._store_error_for_task(spec, err)
+            self._finalize_task(spec, "CANCELLED")
+        else:  # application error
+            if spec.retry_exceptions and pending.retries_left > 0:
+                pending.retries_left -= 1
+                self._resubmit(spec)
+                return
+            error_obj, _ = ser.deserialize(reply["error"])
+            self._store_error_for_task(spec, error_obj)
+            if spec.is_streaming_generator():
+                self._finish_generator(spec.task_id, 0, error=reply["error"])
+            self._finalize_task(spec, "FAILED")
+
+    def _on_worker_failure(self, spec: TaskSpec):
+        pending = self._pending_tasks.get(spec.task_id)
+        if pending is None:
+            return
+        if pending.retries_left > 0:
+            pending.retries_left -= 1
+            logger.info("retrying task %s after worker failure (%d retries left)",
+                        spec.function_name, pending.retries_left)
+            self._resubmit(spec)
+            return
+        err = exc.WorkerCrashedError(
+            f"The worker executing task {spec.function_name} died unexpectedly."
+        )
+        self._store_error_for_task(spec, err)
+        self._finalize_task(spec, "FAILED")
+
+    def _resubmit(self, spec: TaskSpec):
+        spec.attempt_number += 1
+        pending = self._pending_tasks.get(spec.task_id)
+        if pending is not None:
+            pending.spec = spec
+        if spec.task_type == TaskType.NORMAL_TASK:
+            self._normal_submit(spec)
+        else:
+            self._actor_submit(spec)
+
+    def _store_return(self, oid: ObjectID, payload: dict):
+        if "inline" in payload:
+            self.memory_store.put_serialized(oid, payload["inline"])
+        else:
+            self.memory_store.put_serialized(oid, None, location=payload["location"])
+            self.reference_counter.set_location(oid, payload["location"])
+
+    def _store_error_for_task(self, spec: TaskSpec, error: BaseException):
+        s = ser.serialize(error)
+        for oid in spec.return_ids():
+            self.memory_store.put_serialized(oid, s, value=error, is_exception=True)
+
+    def _finalize_task(self, spec: TaskSpec, state: str):
+        pending = self._pending_tasks.pop(spec.task_id, None)
+        if pending is not None:
+            for oid in pending.arg_ids:
+                self.reference_counter.remove_submitted_task_ref(oid)
+        self._record_task_event(spec, state)
+
+    # ------------------------------------------------------- actor submission
+    def create_actor(
+        self,
+        cls,
+        args: tuple,
+        kwargs: dict,
+        *,
+        resources=None,
+        max_restarts: int = 0,
+        max_task_retries: int = 0,
+        max_concurrency: Optional[int] = None,
+        name: Optional[str] = None,
+        namespace: Optional[str] = None,
+        lifetime: Optional[str] = None,
+        get_if_exists: bool = False,
+        scheduling_strategy: Optional[SchedulingStrategySpec] = None,
+        is_asyncio: bool = False,
+        runtime_env: Optional[dict] = None,
+    ) -> ActorID:
+        actor_id = ActorID.of(self.job_id)
+        fid = self.register_function(cls)
+        if max_concurrency is None:
+            max_concurrency = 1000 if is_asyncio else 1
+        creation = ActorCreationSpec(
+            actor_id=actor_id,
+            max_restarts=max_restarts,
+            max_task_retries=max_task_retries,
+            max_concurrency=max_concurrency,
+            name=name,
+            namespace=namespace if namespace is not None else self.namespace,
+            is_detached=lifetime == "detached",
+            is_asyncio=is_asyncio,
+        )
+        arg_specs, kwarg_specs, arg_ids = self._build_args(args, kwargs)
+        spec = TaskSpec(
+            task_id=TaskID.for_actor_creation_task(actor_id),
+            job_id=self.job_id,
+            task_type=TaskType.ACTOR_CREATION_TASK,
+            function_id=fid,
+            function_name=getattr(cls, "__name__", "Actor") + ".__init__",
+            args=arg_specs,
+            num_returns=0,
+            resources=resources or {"CPU": CONFIG.default_actor_num_cpus},
+            owner_address=self.address,
+            scheduling_strategy=scheduling_strategy or SchedulingStrategySpec(),
+            actor_creation=creation,
+            runtime_env=runtime_env,
+        )
+        spec.kwarg_specs = kwarg_specs
+        reply = self._gcs.call("register_actor", {"spec": spec, "get_if_exists": get_if_exists})
+        if reply["status"] == "error":
+            raise ValueError(reply["message"])
+        info = reply["info"]
+        rec = self._actors.setdefault(
+            info.actor_id, _ActorRecord(actor_id=info.actor_id)
+        )
+        rec.max_task_retries = max_task_retries
+        self._ensure_actor_subscription()
+        return info.actor_id
+
+    def _on_node_event(self, key, info):
+        if info.alive:
+            self._node_addr_cache[info.node_id] = info.raylet_address
+            return
+        self._node_addr_cache.pop(info.node_id, None)
+        self._peers.invalidate(info.raylet_address)
+        if info.raylet_address == self.raylet_address and self.mode == "driver":
+            self._lt.submit(self._refresh_local_raylet())
+
+    async def _refresh_local_raylet(self):
+        try:
+            nodes = await self._gcs.call_async("get_all_node_info", {})
+        except (ConnectionLost, OSError):
+            return None
+        alive = [n for n in nodes if n.alive]
+        if not alive:
+            return None
+        head = next((n for n in alive if n.is_head), alive[0])
+        if head.raylet_address != self.raylet_address:
+            logger.warning(
+                "local raylet died; failing over to %s", head.raylet_address
+            )
+            self.raylet_address = head.raylet_address
+        return self.raylet_address
+
+    def _ensure_actor_subscription(self):
+        if self._actor_sub_started:
+            return
+        self._actor_sub_started = True
+        self.subscribe(ps.ACTOR_CHANNEL, self._on_actor_event)
+        self._gcs.call(
+            "subscribe",
+            {"channel": ps.ACTOR_CHANNEL, "subscriber_address": self.address_str},
+        )
+
+    def _on_actor_event(self, key, info):
+        self._lt.submit(self._on_actor_event_async(info))
+
+    async def _on_actor_event_async(self, info):
+        rec = self._actors.get(info.actor_id)
+        if rec is None:
+            return
+        if info.state == ActorState.ALIVE:
+            rec.state = "ALIVE"
+            rec.address = info.address
+            if info.num_restarts > rec.incarnation:
+                # New incarnation: its sequencing gate starts at 0.
+                rec.incarnation = info.num_restarts
+                rec.seq = 0
+            await self._flush_actor_queue(rec)
+        elif info.state == ActorState.RESTARTING:
+            rec.state = "RESTARTING"
+            rec.address = None
+        elif info.state == ActorState.DEAD:
+            rec.state = "DEAD"
+            rec.death_cause = info.death_cause
+            rec.address = None
+            while rec.queue:
+                spec = rec.queue.popleft()
+                self._store_error_for_task(
+                    spec,
+                    exc.ActorDiedError(rec.actor_id, error_message=(
+                        f"Actor {rec.actor_id.hex()[:12]} is dead: {rec.death_cause}")),
+                )
+                self._finalize_task(spec, "FAILED")
+
+    def submit_actor_task(
+        self, actor_id: ActorID, method_name: str, args: tuple, kwargs: dict,
+        *, num_returns=1,
+    ):
+        rec = self._actors.get(actor_id)
+        if rec is None:
+            rec = _ActorRecord(actor_id=actor_id)
+            self._actors[actor_id] = rec
+            self._ensure_actor_subscription()
+            info = self._gcs.call("get_actor_info", {"actor_id": actor_id})
+            if info is not None:
+                if info.state == ActorState.ALIVE:
+                    rec.state = "ALIVE"
+                    rec.address = info.address
+                elif info.state == ActorState.DEAD:
+                    rec.state = "DEAD"
+                    rec.death_cause = info.death_cause
+        if rec.state == "DEAD":
+            raise exc.ActorDiedError(
+                actor_id, error_message=f"Actor is dead: {rec.death_cause}"
+            )
+        streaming = num_returns == "streaming" or num_returns == -1
+        task_id = TaskID.for_actor_task(actor_id)
+        arg_specs, kwarg_specs, arg_ids = self._build_args(args, kwargs)
+        spec = TaskSpec(
+            task_id=task_id,
+            job_id=self.job_id,
+            task_type=TaskType.ACTOR_TASK,
+            function_id="",
+            function_name=method_name,
+            method_name=method_name,
+            args=arg_specs,
+            num_returns=-1 if streaming else num_returns,
+            owner_address=self.address,
+            actor_id=actor_id,
+        )
+        spec.kwarg_specs = kwarg_specs
+        self._pending_tasks[task_id] = _PendingTask(
+            spec=spec, retries_left=rec.max_task_retries, is_actor_task=True,
+            arg_ids=arg_ids,
+        )
+        return_refs = []
+        for oid in spec.return_ids():
+            self.reference_counter.add_owned(oid, self.address)
+            return_refs.append(ObjectRef(oid, owner_address=self.address))
+        if streaming:
+            self._generators[task_id] = _GeneratorState()
+        self._actor_submit(spec)
+        if streaming:
+            return ObjectRefGenerator(task_id)
+        return return_refs
+
+    def _actor_submit(self, spec: TaskSpec):
+        self._lt.submit(self._actor_submit_async(spec))
+
+    async def _actor_submit_async(self, spec: TaskSpec):
+        rec = self._actors[spec.actor_id]
+        if rec.state == "ALIVE" and rec.address is not None:
+            await self._push_actor_task(rec, spec)
+        elif rec.state == "DEAD":
+            self._store_error_for_task(
+                spec, exc.ActorDiedError(rec.actor_id,
+                                         error_message=f"Actor is dead: {rec.death_cause}"))
+            self._finalize_task(spec, "FAILED")
+        else:
+            rec.queue.append(spec)
+            # Poll GCS once in case we missed the ALIVE event.
+            info = await self._gcs.call_async("get_actor_info", {"actor_id": spec.actor_id})
+            if info is not None and info.state == ActorState.ALIVE and rec.state != "ALIVE":
+                rec.state = "ALIVE"
+                rec.address = info.address
+                if info.num_restarts > rec.incarnation:
+                    rec.incarnation = info.num_restarts
+                    rec.seq = 0
+                await self._flush_actor_queue(rec)
+
+    async def _flush_actor_queue(self, rec: _ActorRecord):
+        while rec.queue and rec.state == "ALIVE" and rec.address is not None:
+            spec = rec.queue.popleft()
+            asyncio.ensure_future(self._push_actor_task(rec, spec))
+
+    async def _push_actor_task(self, rec: _ActorRecord, spec: TaskSpec):
+        # Sequence numbers are assigned at push time (on the loop, in FIFO
+        # order) so that a restarted actor incarnation starts again from 0.
+        spec.sequence_number = rec.seq
+        rec.seq += 1
+        client = self._peers.get(rec.address.rpc_address)
+        self._record_task_event(spec, "RUNNING")
+        try:
+            reply = await client.call_async("push_task", {"spec": spec}, timeout=None)
+        except ConnectionLost:
+            pending = self._pending_tasks.get(spec.task_id)
+            if pending is not None and pending.retries_left > 0:
+                pending.retries_left -= 1
+                rec.queue.append(spec)
+                if rec.state == "ALIVE":
+                    rec.state = "RESTARTING"  # wait for pubsub to re-resolve
+                # The address may simply be stale (actor already restarted):
+                # re-resolve once from the GCS.
+                info = await self._gcs.call_async(
+                    "get_actor_info", {"actor_id": rec.actor_id}
+                )
+                if (
+                    info is not None
+                    and info.state == ActorState.ALIVE
+                    and info.address is not None
+                    and (rec.address is None
+                         or info.address.rpc_address != rec.address.rpc_address
+                         or info.num_restarts > rec.incarnation)
+                ):
+                    rec.state = "ALIVE"
+                    rec.address = info.address
+                    if info.num_restarts > rec.incarnation:
+                        rec.incarnation = info.num_restarts
+                        rec.seq = 0
+                    await self._flush_actor_queue(rec)
+            else:
+                self._store_error_for_task(
+                    spec,
+                    exc.ActorUnavailableError(
+                        rec.actor_id,
+                        error_message="Lost connection to actor "
+                        f"{rec.actor_id.hex()[:12]} while task {spec.method_name} "
+                        "was in flight.",
+                    ),
+                )
+                self._finalize_task(spec, "FAILED")
+            return
+        self._on_task_reply(spec, reply)
+
+    # -------------------------------------------------------- actor controls
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
+        self._gcs.call("kill_actor", {"actor_id": actor_id, "no_restart": no_restart})
+
+    def get_actor_info(self, actor_id: ActorID):
+        return self._gcs.call("get_actor_info", {"actor_id": actor_id})
+
+    def get_named_actor(self, name: str, namespace: Optional[str] = None):
+        return self._gcs.call(
+            "get_named_actor",
+            {"name": name, "namespace": namespace if namespace is not None else self.namespace},
+        )
+
+    def cancel_task(self, ref: ObjectRef, force: bool = False):
+        task_id = ref.object_id().task_id()
+        pending = self._pending_tasks.get(task_id)
+        if pending is None:
+            return
+        if pending.pushed_to is not None:
+            try:
+                self._peers.get(pending.pushed_to).call(
+                    "cancel_task", {"task_id": task_id, "force": force}, timeout=10
+                )
+            except ConnectionLost:
+                pass
+        else:
+            # Still queued locally: drop it.
+            key = pending.spec.scheduling_key()
+            st = self._key_states.get(key)
+            if st is not None:
+                try:
+                    st.pending.remove(pending.spec)
+                except ValueError:
+                    pass
+                else:
+                    self._store_error_for_task(
+                        pending.spec, exc.TaskCancelledError(task_id))
+                    self._finalize_task(pending.spec, "CANCELLED")
+
+    # ------------------------------------------------------ placement groups
+    def create_placement_group(
+        self, bundles, strategy="PACK", name="", lifetime=None
+    ) -> PlacementGroupID:
+        pg_id = PlacementGroupID.of(self.job_id)
+        spec = PlacementGroupSpec(
+            placement_group_id=pg_id,
+            bundles=[dict(b) for b in bundles],
+            strategy=strategy,
+            name=name,
+            lifetime=lifetime,
+            job_id=self.job_id,
+        )
+        reply = self._gcs.call("create_placement_group", {"spec": spec})
+        if reply["status"] != "ok":
+            raise ValueError(reply.get("message", "placement group creation failed"))
+        return pg_id
+
+    def remove_placement_group(self, pg_id: PlacementGroupID):
+        self._pg_cache.pop(pg_id, None)
+        self._gcs.call("remove_placement_group", {"placement_group_id": pg_id})
+
+    def wait_placement_group_ready(self, pg_id: PlacementGroupID, timeout=None) -> bool:
+        reply = self._gcs.call(
+            "wait_placement_group_ready",
+            {"placement_group_id": pg_id, "timeout": timeout},
+            timeout=(timeout + 5) if timeout and timeout > 0 else None,
+        )
+        return reply.get("status") == "ready"
+
+    # -------------------------------------------------------------- pubsub
+    def subscribe(self, channel: str, callback):
+        self._subscriptions.setdefault(channel, []).append(callback)
+
+    async def _handle_pubsub_message(self, payload):
+        channel, key, message = payload
+        for cb in self._subscriptions.get(channel, []):
+            try:
+                cb(key, message)
+            except Exception:
+                logger.exception("pubsub callback failed")
+        return True
+
+    # ------------------------------------------------------ owner services
+    async def _handle_get_object(self, payload):
+        oid: ObjectID = payload["object_id"]
+        want_value = payload.get("want_value", True)
+        timeout = payload.get("timeout", 0)
+        entry = self.memory_store.get_entry(oid)
+        if entry is None and timeout and timeout > 0:
+            loop = asyncio.get_event_loop()
+            fut = loop.create_future()
+
+            def _cb(e):
+                loop.call_soon_threadsafe(
+                    lambda: fut.set_result(e) if not fut.done() else None
+                )
+
+            self.memory_store.add_callback(oid, _cb)
+            try:
+                entry = await asyncio.wait_for(fut, timeout)
+            except asyncio.TimeoutError:
+                entry = self.memory_store.get_entry(oid)
+        if entry is None:
+            if self.reference_counter.owns(oid) or oid.task_id() in self._pending_tasks:
+                return {"status": "pending"}
+            return {"status": "not_owner"}
+        if entry.freed:
+            return {"status": "freed"}
+        if entry.location is not None and entry.serialized is None:
+            return {"status": "ready", "location": entry.location}
+        if want_value:
+            return {
+                "status": "ready",
+                "data": entry.serialized,
+                "is_exception": entry.is_exception,
+            }
+        return {"status": "ready"}
+
+    async def _handle_fetch_object(self, payload):
+        oid: ObjectID = payload["object_id"]
+        entry = self.memory_store.get_entry(oid)
+        if entry is None or entry.serialized is None:
+            return {"status": "not_found"}
+        return {"status": "ok", "data": entry.serialized}
+
+    async def _handle_free_objects(self, payload):
+        self.memory_store.delete(payload["object_ids"])
+        for oid in payload["object_ids"]:
+            self._secondary_copies.discard(oid)
+        return True
+
+    async def _handle_add_borrower(self, payload):
+        self.reference_counter.add_borrower(payload["object_id"], payload["borrower"])
+        return True
+
+    async def _handle_remove_borrower(self, payload):
+        self.reference_counter.remove_borrower(payload["object_id"], payload["borrower"])
+        return True
+
+    async def _handle_reconstruct_object(self, payload):
+        return self._try_reconstruct(payload["object_id"])
+
+    async def _handle_push_task(self, payload):
+        spec: TaskSpec = payload["spec"]
+        self._record_task_event(spec, "EXECUTING")
+        reply = await self.executor.execute(spec)
+        return reply
+
+    async def _handle_kill_actor(self, payload):
+        threading.Thread(target=lambda: (time.sleep(0.05), os._exit(0)), daemon=True).start()
+        return True
+
+    async def _handle_cancel_task(self, payload):
+        return self.executor.cancel(payload["task_id"], payload.get("force", False))
+
+    async def _handle_exit(self, payload):
+        threading.Thread(target=lambda: (time.sleep(0.05), os._exit(0)), daemon=True).start()
+        return True
+
+    async def _handle_ping(self, payload):
+        return {"status": "ok", "worker_id": self.worker_id.hex(), "pid": os.getpid()}
+
+    # ---------------------------------------------- generator streaming (owner)
+    async def _handle_report_generator_item(self, payload):
+        task_id: TaskID = payload["task_id"]
+        state = self._generators.get(task_id)
+        if state is None:
+            return False
+        if payload.get("error"):
+            with state.cv:
+                state.error = payload["item"]["inline"] if payload.get("item") else None
+                state.total = state.reported
+                state.cv.notify_all()
+            return True
+        if payload.get("done"):
+            self._finish_generator(task_id, payload["index"])
+            return True
+        index = payload["index"]
+        oid = ObjectID.for_task_return(task_id, index + 1)
+        self.reference_counter.add_owned(oid, self.address)
+        self._store_return(oid, payload["item"])
+        with state.cv:
+            state.reported = max(state.reported, index + 1)
+            state.cv.notify_all()
+        return True
+
+    def _finish_generator(self, task_id: TaskID, total: int, error=None):
+        state = self._generators.get(task_id)
+        if state is None:
+            return
+        with state.cv:
+            state.total = total
+            if error is not None:
+                state.error = error
+            state.cv.notify_all()
+
+    def next_generator_item(self, task_id: TaskID, consumed: int, timeout=None):
+        """Blocking: returns the ObjectRef for item `consumed`, or None at end."""
+        state = self._generators.get(task_id)
+        if state is None:
+            return None
+        with state.cv:
+            state.cv.wait_for(
+                lambda: state.reported > consumed or state.total is not None,
+                timeout,
+            )
+            if state.reported > consumed:
+                oid = ObjectID.for_task_return(task_id, consumed + 1)
+                return ObjectRef(oid, owner_address=self.address)
+            if state.error is not None:
+                err, _ = ser.deserialize(state.error)
+                self._generators.pop(task_id, None)
+                self._raise_stored_error(err)
+            self._generators.pop(task_id, None)
+            return None
+
+    def report_generator_item(self, spec: TaskSpec, index: int, item, done: bool,
+                              error: bool = False):
+        """Executor-side: stream one yielded item to the owner."""
+        owner = spec.owner_address
+        client = self._peers.get(owner.rpc_address)
+        try:
+            client.send(
+                "report_generator_item",
+                {"task_id": spec.task_id, "index": index, "item": item,
+                 "done": done, "error": error},
+            )
+        except ConnectionLost:
+            raise exc.OwnerDiedError(spec.task_id.hex())
+
+    # --------------------------------------------------------- ref counting
+    def register_deserialized_ref(self, ref: ObjectRef):
+        oid = ref.object_id()
+        owner = ref.owner_address
+        first = self.reference_counter.add_borrowed(oid, owner)
+        self.reference_counter.add_local_ref(oid)
+        if first and owner is not None and owner.rpc_address != self.address_str:
+            # Fire-and-forget: may run on the RPC loop thread mid-decode, so
+            # it must never block on the loop.
+            client = self._peers.get(owner.rpc_address)
+            self._fire(
+                client.send_async(
+                    "add_borrower", {"object_id": oid, "borrower": self.address_str}
+                )
+            )
+
+    def _notify_owner_release(self, oid: ObjectID, owner_address):
+        self.memory_store.delete([oid])
+        if owner_address is None or owner_address.rpc_address == self.address_str:
+            return
+        client = self._peers.get(owner_address.rpc_address)
+        self._fire(
+            client.send_async(
+                "remove_borrower", {"object_id": oid, "borrower": self.address_str}
+            )
+        )
+
+    def _free_owned_object(self, oid: ObjectID, location: Optional[str]):
+        self.memory_store.delete([oid])
+        if location is not None and location != self.address_str:
+            try:
+                self._peers.get(location).send("free_objects", {"object_ids": [oid]})
+            except ConnectionLost:
+                pass
+
+    def free_objects(self, refs: List[ObjectRef]):
+        """Manual eviction (reference: internal_api.free)."""
+        for ref in refs:
+            oid = ref.object_id()
+            loc = self.reference_counter.get_location(oid)
+            self.memory_store.mark_freed(oid)
+            if loc is not None:
+                try:
+                    self._peers.get(loc).send("free_objects", {"object_ids": [oid]})
+                except ConnectionLost:
+                    pass
+
+    def hold_secondary_copy(self, oid: ObjectID):
+        self._secondary_copies.add(oid)
+
+    # ------------------------------------------------------------- executor glue
+    def become_actor(self, creation: ActorCreationSpec):
+        self.is_actor_worker = True
+        self.current_actor_id = creation.actor_id
+        self._gcs.call(
+            "report_actor_alive",
+            {"actor_id": creation.actor_id, "address": self.address, "pid": os.getpid()},
+        )
+
+    def exit_actor_process(self, intended: bool = True):
+        threading.Thread(
+            target=lambda: (time.sleep(0.1), os._exit(0 if intended else 1)),
+            daemon=True,
+        ).start()
+
+    # ------------------------------------------------------------ futures API
+    def as_future(self, ref: ObjectRef):
+        import concurrent.futures
+
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+
+        def _resolve():
+            try:
+                fut.set_result(self.get([ref])[0])
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+        threading.Thread(target=_resolve, daemon=True).start()
+        return fut
+
+    def as_asyncio_future(self, ref: ObjectRef):
+        loop = asyncio.get_event_loop()
+        afut = loop.create_future()
+
+        def _resolve():
+            try:
+                value = self.get([ref])[0]
+                loop.call_soon_threadsafe(
+                    lambda: afut.set_result(value) if not afut.done() else None
+                )
+            except BaseException as e:  # noqa: BLE001
+                loop.call_soon_threadsafe(
+                    lambda: afut.set_exception(e) if not afut.done() else None
+                )
+
+        threading.Thread(target=_resolve, daemon=True).start()
+        return afut
+
+    def on_completed(self, ref: ObjectRef, callback):
+        def _cb(entry):
+            callback(ref)
+
+        self.memory_store.add_callback(ref.object_id(), _cb)
+
+    # ------------------------------------------------------------ task events
+    def _record_task_event(self, spec: TaskSpec, state: str):
+        self._task_events.append(
+            {
+                "task_id": spec.task_id.hex(),
+                "name": spec.function_name,
+                "type": spec.task_type.name,
+                "state": state,
+                "job_id": spec.job_id.hex() if spec.job_id else None,
+                "node": self.node_id.hex() if self.node_id else None,
+                "worker_id": self.worker_id.hex(),
+                "time": time.time(),
+            }
+        )
+
+    async def _task_event_loop(self):
+        while True:
+            await asyncio.sleep(2.0)
+            if not self._task_events:
+                continue
+            events = []
+            while self._task_events and len(events) < 5000:
+                events.append(self._task_events.popleft())
+            try:
+                await self._gcs.send_async("add_task_events", {"events": events})
+            except (ConnectionLost, OSError):
+                pass
+
+
+class _RetryGet(Exception):
+    pass

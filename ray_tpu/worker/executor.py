@@ -1,0 +1,303 @@
+"""Task/actor execution inside a worker process.
+
+Role of the reference's execution half of CoreWorker
+(ray: src/ray/core_worker/core_worker.cc:2883 ExecuteTask, :3455
+HandlePushTask; Python callback _raylet.pyx:2253 task_execution_handler) plus
+the server-side actor scheduling queues
+(transport/actor_scheduling_queue.cc — per-caller sequence-number ordering —
+and concurrency_group_manager.cc for async/threaded actors).
+
+Returns policy (matches the reference): small results are inlined in the
+PushTaskReply back to the owner; large results stay in this worker's store and
+the reply carries a location marker. Streaming-generator items are reported to
+the owner one by one (report_generator_item) as they are yielded.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import ctypes
+import logging
+import threading
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private.config import CONFIG
+from ray_tpu._private.ids import ActorID, ObjectID, TaskID
+from ray_tpu._private import serialization as ser
+from ray_tpu._private.specs import Address, TaskArg, TaskSpec, TaskType
+from ray_tpu.exceptions import (
+    AsyncioActorExit,
+    RayTaskError,
+    TaskCancelledError,
+)
+
+logger = logging.getLogger(__name__)
+
+
+def _async_raise(thread_id: int, exc_type) -> bool:
+    """Inject an exception into a running thread (cancellation support,
+    mirrors the reference's cancellation-by-KeyboardInterrupt in
+    _raylet.pyx execute_task_with_cancellation_handler)."""
+    res = ctypes.pythonapi.PyThreadState_SetAsyncExc(
+        ctypes.c_ulong(thread_id), ctypes.py_object(exc_type)
+    )
+    return res == 1
+
+
+class _SequencingGate:
+    """Starts actor tasks in per-caller sequence order."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._next_seq: Dict[bytes, int] = {}
+
+    def wait_turn(self, caller: bytes, seq: int):
+        with self._cv:
+            expected = self._next_seq.setdefault(caller, 0)
+            if seq < expected:
+                return  # replay after restart; let it run
+            self._cv.wait_for(lambda: self._next_seq.get(caller, 0) >= seq, timeout=60)
+
+    def advance(self, caller: bytes, seq: int):
+        with self._cv:
+            cur = self._next_seq.setdefault(caller, 0)
+            if seq + 1 > cur:
+                self._next_seq[caller] = seq + 1
+            self._cv.notify_all()
+
+
+class Executor:
+    def __init__(self, core_worker):
+        self.cw = core_worker
+        self._fn_cache: Dict[str, Any] = {}
+        self._pool = ThreadPoolExecutor(max_workers=256, thread_name_prefix="rt-exec")
+        self.actor_instance: Any = None
+        self.actor_id: Optional[ActorID] = None
+        self._actor_spec = None
+        self._seq_gate = _SequencingGate()
+        self._actor_semaphore: Optional[threading.Semaphore] = None
+        self._async_loop: Optional[asyncio.AbstractEventLoop] = None
+        self._running_threads: Dict[TaskID, int] = {}  # task -> thread ident
+        self._cancelled: set = set()
+
+    # ------------------------------------------------------------------ entry
+    async def execute(self, spec: TaskSpec) -> dict:
+        """Run on the worker's RPC loop; dispatches to a thread and returns
+        the PushTaskReply payload."""
+        loop = asyncio.get_event_loop()
+        if spec.task_type == TaskType.ACTOR_TASK:
+            return await loop.run_in_executor(self._pool, self._run_actor_task, spec)
+        if spec.task_type == TaskType.ACTOR_CREATION_TASK:
+            return await loop.run_in_executor(self._pool, self._run_actor_creation, spec)
+        return await loop.run_in_executor(self._pool, self._run_normal_task, spec)
+
+    def cancel(self, task_id: TaskID, force: bool) -> bool:
+        self._cancelled.add(task_id)
+        ident = self._running_threads.get(task_id)
+        if ident is not None:
+            return _async_raise(ident, TaskCancelledError)
+        return True
+
+    # ---------------------------------------------------------------- helpers
+    def _load_function(self, function_id: str):
+        fn = self._fn_cache.get(function_id)
+        if fn is None:
+            data = self.cw.kv_get(b"fun:" + function_id.encode())
+            if data is None:
+                raise RuntimeError(f"function {function_id} not found in GCS")
+            fn = ser.loads_function(data)
+            self._fn_cache[function_id] = fn
+        return fn
+
+    def _resolve_args(
+        self, args: List[TaskArg], kwargs: Dict[str, TaskArg]
+    ) -> Tuple[list, dict]:
+        # Gather by-reference args and fetch them in one batch.
+        ref_ids, ref_owners = [], []
+        for a in list(args) + list(kwargs.values()):
+            if not a.is_inline:
+                ref_ids.append(a.object_id)
+                ref_owners.append(a.owner_address)
+        fetched = {}
+        if ref_ids:
+            values = self.cw.get_objects_by_id(ref_ids, ref_owners, timeout=None)
+            fetched = dict(zip(ref_ids, values))
+
+        def materialize(a: TaskArg):
+            if a.is_inline:
+                value, _refs = ser.deserialize(a.data)
+                return value
+            return fetched[a.object_id]
+
+        return [materialize(a) for a in args], {
+            k: materialize(a) for k, a in kwargs.items()
+        }
+
+    def _package_returns(
+        self, spec: TaskSpec, result: Any
+    ) -> List[Tuple[ObjectID, dict]]:
+        return_ids = spec.return_ids()
+        if spec.num_returns <= 1:
+            values = [result]
+        else:
+            values = list(result)
+            if len(values) != spec.num_returns:
+                raise ValueError(
+                    f"task {spec.function_name} declared num_returns="
+                    f"{spec.num_returns} but returned {len(values)} values"
+                )
+        out = []
+        for oid, value in zip(return_ids, values):
+            out.append((oid, self._package_value(oid, value)))
+        return out
+
+    def _package_value(self, oid: ObjectID, value: Any) -> dict:
+        s = ser.serialize(value)
+        if s.total_bytes() <= CONFIG.max_direct_call_object_size:
+            return {"inline": s}
+        # Keep the primary copy here; the owner records the location.
+        self.cw.memory_store.put_serialized(oid, s, value=value)
+        self.cw.hold_secondary_copy(oid)
+        return {"location": self.cw.address.rpc_address}
+
+    def _error_reply(self, spec: TaskSpec, exc: BaseException) -> dict:
+        if isinstance(exc, RayTaskError):
+            err = exc
+        else:
+            err = RayTaskError.from_exception(spec.function_name, exc)
+        s = ser.serialize(err)
+        return {
+            "status": "error",
+            "error_str": str(exc),
+            "is_application_error": True,
+            "error": s,
+            "return_ids": spec.return_ids(),
+        }
+
+    # ---------------------------------------------------------- normal tasks
+    def _run_normal_task(self, spec: TaskSpec) -> dict:
+        if spec.task_id in self._cancelled:
+            return {
+                "status": "cancelled",
+                "return_ids": spec.return_ids(),
+            }
+        token = self.cw.enter_task_context(spec)
+        self._running_threads[spec.task_id] = threading.get_ident()
+        try:
+            fn = self._load_function(spec.function_id)
+            args, kwargs = self._resolve_args(spec.args, getattr(spec, "kwarg_specs", {}) or {})
+            if spec.is_streaming_generator():
+                return self._run_generator(spec, fn, args, kwargs)
+            result = fn(*args, **kwargs)
+            return {"status": "ok", "returns": self._package_returns(spec, result)}
+        except TaskCancelledError:
+            return {"status": "cancelled", "return_ids": spec.return_ids()}
+        except BaseException as e:  # noqa: BLE001 — errors are data here
+            return self._error_reply(spec, e)
+        finally:
+            self._running_threads.pop(spec.task_id, None)
+            self.cw.exit_task_context(token)
+
+    def _run_generator(self, spec: TaskSpec, fn, args, kwargs) -> dict:
+        """Streaming generator: report each item to the owner as produced."""
+        try:
+            gen = fn(*args, **kwargs)
+            index = 0
+            for item in gen:
+                oid = ObjectID.for_task_return(spec.task_id, index + 1)
+                payload = self._package_value(oid, item)
+                self.cw.report_generator_item(spec, index, payload, done=False)
+                index += 1
+            self.cw.report_generator_item(spec, index, None, done=True)
+            return {"status": "ok", "returns": [], "streaming_num_items": index}
+        except BaseException as e:  # noqa: BLE001
+            err = RayTaskError.from_exception(spec.function_name, e)
+            oid = ObjectID.for_task_return(spec.task_id, 1)
+            self.cw.report_generator_item(
+                spec, -1, {"inline": ser.serialize(err)}, done=True, error=True
+            )
+            return self._error_reply(spec, e)
+
+    # ---------------------------------------------------------------- actors
+    def _run_actor_creation(self, spec: TaskSpec) -> dict:
+        token = self.cw.enter_task_context(spec)
+        try:
+            creation = spec.actor_creation
+            cls = self._load_function(spec.function_id)
+            args, kwargs = self._resolve_args(spec.args, getattr(spec, "kwarg_specs", {}) or {})
+            self.actor_instance = cls(*args, **kwargs)
+            self.actor_id = creation.actor_id
+            self._actor_spec = creation
+            if creation.max_concurrency > 1 or creation.is_asyncio:
+                self._actor_semaphore = threading.Semaphore(creation.max_concurrency)
+            if creation.is_asyncio:
+                self._start_async_loop()
+            self.cw.become_actor(creation)
+            return {"status": "ok", "returns": []}
+        except BaseException as e:  # noqa: BLE001
+            return self._error_reply(spec, e)
+        finally:
+            self.cw.exit_task_context(token)
+
+    def _start_async_loop(self):
+        loop = asyncio.new_event_loop()
+        self._async_loop = loop
+        t = threading.Thread(target=loop.run_forever, name="rt-actor-asyncio", daemon=True)
+        t.start()
+
+    def _run_actor_task(self, spec: TaskSpec) -> dict:
+        if spec.method_name == "__ray_terminate__":
+            self.cw.exit_actor_process(intended=True)
+            return {"status": "ok", "returns": []}
+        caller = spec.owner_address.worker_id.binary() if spec.owner_address else b""
+        creation = self._actor_spec
+        ordered = creation is None or (
+            creation.max_concurrency <= 1 and not creation.is_asyncio
+        )
+        if ordered:
+            self._seq_gate.wait_turn(caller, spec.sequence_number)
+        try:
+            if self.actor_instance is None:
+                raise RuntimeError("actor instance not initialized")
+            method = getattr(self.actor_instance, spec.method_name)
+            token = self.cw.enter_task_context(spec)
+            self._running_threads[spec.task_id] = threading.get_ident()
+            if self._actor_semaphore is not None:
+                self._actor_semaphore.acquire()
+            try:
+                args, kwargs = self._resolve_args(
+                    spec.args, getattr(spec, "kwarg_specs", {}) or {}
+                )
+                if spec.is_streaming_generator():
+                    return self._run_generator(spec, method, args, kwargs)
+                if self._async_loop is not None and asyncio.iscoroutinefunction(method):
+                    fut = asyncio.run_coroutine_threadsafe(
+                        method(*args, **kwargs), self._async_loop
+                    )
+                    result = fut.result()
+                else:
+                    result = method(*args, **kwargs)
+                return {"status": "ok", "returns": self._package_returns(spec, result)}
+            finally:
+                if self._actor_semaphore is not None:
+                    self._actor_semaphore.release()
+                self._running_threads.pop(spec.task_id, None)
+                self.cw.exit_task_context(token)
+        except (AsyncioActorExit, SystemExit):
+            self.cw.exit_actor_process(intended=True)
+            return {"status": "ok", "returns": []}
+        except TaskCancelledError:
+            return {"status": "cancelled", "return_ids": spec.return_ids()}
+        except BaseException as e:  # noqa: BLE001
+            return self._error_reply(spec, e)
+        finally:
+            if ordered:
+                self._seq_gate.advance(caller, spec.sequence_number)
+
+    def shutdown(self):
+        self._pool.shutdown(wait=False, cancel_futures=True)
+        if self._async_loop is not None:
+            self._async_loop.call_soon_threadsafe(self._async_loop.stop)

@@ -1,0 +1,48 @@
+"""Shared fixtures (reference pattern: ray python/ray/tests/conftest.py —
+ray_start_regular :419, ray_start_cluster :500).
+
+JAX-facing tests run on a faked 8-device CPU mesh
+(xla_force_host_platform_device_count), per SURVEY §4.4: no TPU hardware is
+needed to exercise sharding/collective code paths.
+"""
+
+import os
+
+# Must be set before anything imports jax (including this host's
+# sitecustomize in spawned workers — handled by worker env).
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def ray_start_regular():
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_2_cpus():
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=2)
+    yield
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_cluster():
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=False)
+    yield cluster
+    cluster.shutdown()

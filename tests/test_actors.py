@@ -1,0 +1,207 @@
+"""Actor tests: lifecycle, naming, async actors, restarts, kill.
+
+Reference patterns: ray python/ray/tests/test_actor.py, test_actor_failures.py.
+"""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions as exc
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self, start=0):
+        self.n = start
+
+    def incr(self, k=1):
+        self.n += k
+        return self.n
+
+    def value(self):
+        return self.n
+
+    def pid(self):
+        return os.getpid()
+
+    def crash(self):
+        os._exit(1)
+
+
+def test_actor_basic(ray_start_regular):
+    c = Counter.remote(10)
+    assert ray_tpu.get(c.incr.remote(), timeout=30) == 11
+    assert ray_tpu.get(c.incr.remote(5), timeout=30) == 16
+    assert ray_tpu.get(c.value.remote(), timeout=30) == 16
+
+
+def test_actor_task_ordering(ray_start_regular):
+    c = Counter.remote()
+    refs = [c.incr.remote() for _ in range(20)]
+    assert ray_tpu.get(refs, timeout=30) == list(range(1, 21))
+
+
+def test_actor_constructor_error(ray_start_regular):
+    @ray_tpu.remote
+    class Bad:
+        def __init__(self):
+            raise RuntimeError("bad init")
+
+        def ping(self):
+            return "pong"
+
+    b = Bad.remote()
+    with pytest.raises((exc.RayActorError, exc.RayTaskError)):
+        ray_tpu.get(b.ping.remote(), timeout=60)
+
+
+def test_named_actor(ray_start_regular):
+    c = Counter.options(name="global_counter").remote()
+    ray_tpu.get(c.incr.remote(), timeout=30)
+    c2 = ray_tpu.get_actor("global_counter")
+    assert ray_tpu.get(c2.value.remote(), timeout=30) == 1
+
+
+def test_named_actor_duplicate(ray_start_regular):
+    Counter.options(name="dup").remote()
+    time.sleep(0.2)
+    with pytest.raises(ValueError):
+        Counter.options(name="dup").remote()
+
+
+def test_get_if_exists(ray_start_regular):
+    a = Counter.options(name="maybe", get_if_exists=True).remote()
+    ray_tpu.get(a.incr.remote(), timeout=30)
+    b = Counter.options(name="maybe", get_if_exists=True).remote()
+    assert ray_tpu.get(b.value.remote(), timeout=30) == 1
+
+
+def test_get_actor_missing(ray_start_regular):
+    with pytest.raises(ValueError):
+        ray_tpu.get_actor("does_not_exist")
+
+
+def test_kill_actor(ray_start_regular):
+    c = Counter.remote()
+    ray_tpu.get(c.incr.remote(), timeout=30)
+    ray_tpu.kill(c)
+    time.sleep(0.5)
+    with pytest.raises(exc.RayActorError):
+        ray_tpu.get(c.incr.remote(), timeout=30)
+
+
+def test_actor_restart(ray_start_regular):
+    c = Counter.options(max_restarts=1, max_task_retries=0).remote()
+    pid1 = ray_tpu.get(c.pid.remote(), timeout=30)
+    try:
+        ray_tpu.get(c.crash.remote(), timeout=30)
+    except Exception:
+        pass
+    # Wait for the restart.
+    deadline = time.time() + 30
+    pid2 = None
+    while time.time() < deadline:
+        try:
+            pid2 = ray_tpu.get(c.pid.remote(), timeout=5)
+            break
+        except Exception:
+            time.sleep(0.2)
+    assert pid2 is not None and pid2 != pid1
+
+
+def test_actor_no_restart_dies(ray_start_regular):
+    c = Counter.options(max_restarts=0).remote()
+    ray_tpu.get(c.incr.remote(), timeout=30)
+    try:
+        ray_tpu.get(c.crash.remote(), timeout=30)
+    except Exception:
+        pass
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        try:
+            ray_tpu.get(c.incr.remote(), timeout=5)
+            time.sleep(0.2)
+        except exc.RayActorError:
+            return
+    pytest.fail("actor should be dead")
+
+
+def test_actor_handle_passing(ray_start_regular):
+    @ray_tpu.remote
+    def use_actor(handle):
+        return ray_tpu.get(handle.incr.remote(100))
+
+    c = Counter.remote()
+    assert ray_tpu.get(use_actor.remote(c), timeout=60) == 100
+    assert ray_tpu.get(c.value.remote(), timeout=30) == 100
+
+
+def test_async_actor(ray_start_regular):
+    import asyncio
+
+    @ray_tpu.remote
+    class AsyncActor:
+        async def work(self, t, v):
+            await asyncio.sleep(t)
+            return v
+
+    a = AsyncActor.remote()
+    # Submit concurrent calls: total wall time should be ~max not ~sum.
+    t0 = time.time()
+    refs = [a.work.remote(0.4, i) for i in range(5)]
+    assert ray_tpu.get(refs, timeout=30) == list(range(5))
+    assert time.time() - t0 < 3.0
+
+
+def test_max_concurrency_threaded(ray_start_regular):
+    @ray_tpu.remote(max_concurrency=4)
+    class Slow:
+        def work(self):
+            time.sleep(0.4)
+            return 1
+
+    s = Slow.remote()
+    t0 = time.time()
+    assert sum(ray_tpu.get([s.work.remote() for _ in range(4)], timeout=30)) == 4
+    assert time.time() - t0 < 3.0
+
+
+def test_actor_exit_via_terminate(ray_start_regular):
+    c = Counter.remote()
+    ray_tpu.get(c.incr.remote(), timeout=30)
+    c.__ray_terminate__.remote()
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        try:
+            ray_tpu.get(c.value.remote(), timeout=5)
+            time.sleep(0.2)
+        except exc.RayActorError:
+            return
+    pytest.fail("actor should have exited")
+
+
+def test_actor_streaming_method(ray_start_regular):
+    @ray_tpu.remote
+    class Gen:
+        def stream(self, n):
+            for i in range(n):
+                yield i
+
+    g = Gen.remote()
+    refs = list(g.stream.options(num_returns="streaming").remote(4))
+    assert [ray_tpu.get(r, timeout=30) for r in refs] == [0, 1, 2, 3]
+
+
+def test_namespaces(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)
+    cluster.connect(namespace="ns1")
+    c = Counter.options(name="c", namespace="ns2").remote()
+    ray_tpu.get(c.incr.remote(), timeout=30)
+    with pytest.raises(ValueError):
+        ray_tpu.get_actor("c")  # wrong namespace (ns1)
+    c2 = ray_tpu.get_actor("c", namespace="ns2")
+    assert ray_tpu.get(c2.value.remote(), timeout=30) == 1

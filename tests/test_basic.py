@@ -1,0 +1,255 @@
+"""Core API tests: tasks, objects, errors, options.
+
+Reference patterns: ray python/ray/tests/test_basic.py / test_basic_2.py.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions as exc
+
+
+def test_put_get(ray_start_regular):
+    ref = ray_tpu.put({"a": [1, 2, 3]})
+    assert ray_tpu.get(ref) == {"a": [1, 2, 3]}
+
+
+def test_put_get_numpy_zero_copyish(ray_start_regular):
+    arr = np.arange(1_000_000, dtype=np.float32)
+    ref = ray_tpu.put(arr)
+    out = ray_tpu.get(ref)
+    np.testing.assert_array_equal(arr, out)
+
+
+def test_simple_task(ray_start_regular):
+    @ray_tpu.remote
+    def f(x):
+        return x * 2
+
+    assert ray_tpu.get(f.remote(21), timeout=30) == 42
+
+
+def test_task_with_kwargs_and_ref_args(ray_start_regular):
+    @ray_tpu.remote
+    def f(a, b=0, c=0):
+        return a + b + c
+
+    ref_a = ray_tpu.put(1)
+    assert ray_tpu.get(f.remote(ref_a, b=2, c=3), timeout=30) == 6
+
+
+def test_nested_refs_in_args(ray_start_regular):
+    @ray_tpu.remote
+    def deref(d):
+        return ray_tpu.get(d["ref"])
+
+    inner = ray_tpu.put("hello")
+    assert ray_tpu.get(deref.remote({"ref": inner}), timeout=30) == "hello"
+
+
+def test_chained_tasks(ray_start_regular):
+    @ray_tpu.remote
+    def inc(x):
+        return x + 1
+
+    ref = inc.remote(0)
+    for _ in range(9):
+        ref = inc.remote(ref)
+    assert ray_tpu.get(ref, timeout=60) == 10
+
+
+def test_multiple_returns(ray_start_regular):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert ray_tpu.get([a, b, c], timeout=30) == [1, 2, 3]
+
+
+def test_large_args_and_returns(ray_start_regular):
+    @ray_tpu.remote
+    def echo(x):
+        return x
+
+    big = np.ones((1000, 1000), dtype=np.float32)  # 4 MB > inline threshold
+    out = ray_tpu.get(echo.remote(big), timeout=60)
+    np.testing.assert_array_equal(big, out)
+
+
+def test_error_propagation_with_type(ray_start_regular):
+    @ray_tpu.remote
+    def boom():
+        raise KeyError("missing")
+
+    with pytest.raises(KeyError):
+        ray_tpu.get(boom.remote(), timeout=30)
+    # The error is also an instance of RayTaskError.
+    try:
+        ray_tpu.get(boom.remote(), timeout=30)
+    except Exception as e:
+        assert isinstance(e, exc.RayTaskError)
+
+
+def test_get_timeout(ray_start_regular):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(60)
+
+    ref = slow.remote()
+    with pytest.raises(exc.GetTimeoutError):
+        ray_tpu.get(ref, timeout=1.0)
+
+
+def test_wait(ray_start_regular):
+    @ray_tpu.remote
+    def delay(t):
+        time.sleep(t)
+        return t
+
+    fast = delay.remote(0.05)
+    slow = delay.remote(5.0)
+    ready, not_ready = ray_tpu.wait([fast, slow], num_returns=1, timeout=10)
+    assert ready == [fast]
+    assert not_ready == [slow]
+
+
+def test_wait_timeout(ray_start_regular):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(30)
+
+    ref = slow.remote()
+    ready, not_ready = ray_tpu.wait([ref], num_returns=1, timeout=0.5)
+    assert ready == []
+    assert not_ready == [ref]
+
+
+def test_options_validation(ray_start_regular):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    with pytest.raises(ValueError):
+        f.options(bogus_option=1)
+    with pytest.raises(ValueError):
+        f.options(num_cpus=-1)
+    assert ray_tpu.get(f.options(num_cpus=0.5, name="half").remote(), timeout=30) == 1
+
+
+def test_calling_remote_directly_raises(ray_start_regular):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    with pytest.raises(TypeError):
+        f()
+
+
+def test_task_retries_on_worker_death(ray_start_regular):
+    import os
+
+    @ray_tpu.remote(max_retries=2)
+    def die_once(marker_path):
+        if not os.path.exists(marker_path):
+            open(marker_path, "w").close()
+            os._exit(1)
+        return "survived"
+
+    marker = f"/tmp/rt_test_die_{time.time_ns()}"
+    try:
+        assert ray_tpu.get(die_once.remote(marker), timeout=60) == "survived"
+    finally:
+        if os.path.exists(marker):
+            os.remove(marker)
+
+
+def test_no_retries_raises_worker_crashed(ray_start_regular):
+    import os
+
+    @ray_tpu.remote(max_retries=0)
+    def die():
+        os._exit(1)
+
+    with pytest.raises(exc.WorkerCrashedError):
+        ray_tpu.get(die.remote(), timeout=60)
+
+
+def test_retry_exceptions(ray_start_regular):
+    import os
+
+    @ray_tpu.remote(max_retries=3, retry_exceptions=True)
+    def flaky(marker_path):
+        if not os.path.exists(marker_path):
+            open(marker_path, "w").close()
+            raise RuntimeError("transient")
+        return "ok"
+
+    marker = f"/tmp/rt_test_flaky_{time.time_ns()}"
+    try:
+        assert ray_tpu.get(flaky.remote(marker), timeout=60) == "ok"
+    finally:
+        if os.path.exists(marker):
+            os.remove(marker)
+
+
+def test_nested_tasks(ray_start_regular):
+    @ray_tpu.remote
+    def inner(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def outer(x):
+        return ray_tpu.get(inner.remote(x)) + 10
+
+    assert ray_tpu.get(outer.remote(0), timeout=60) == 11
+
+
+def test_cancel_queued_task(ray_start_regular):
+    @ray_tpu.remote
+    def hog():
+        time.sleep(30)
+
+    @ray_tpu.remote
+    def queued():
+        return 1
+
+    hogs = [hog.remote() for _ in range(4)]  # consume all 4 CPUs
+    time.sleep(0.5)
+    ref = queued.remote()
+    ray_tpu.cancel(ref)
+    with pytest.raises((exc.TaskCancelledError, exc.GetTimeoutError)):
+        ray_tpu.get(ref, timeout=2)
+
+
+def test_cluster_resources(ray_start_regular):
+    total = ray_tpu.cluster_resources()
+    assert total.get("CPU") == 4.0
+    assert len(ray_tpu.nodes()) == 1
+
+
+def test_streaming_generator(ray_start_regular):
+    @ray_tpu.remote(num_returns="streaming")
+    def gen(n):
+        for i in range(n):
+            yield i * i
+
+    out = [ray_tpu.get(ref, timeout=30) for ref in gen.remote(5)]
+    assert out == [0, 1, 4, 9, 16]
+
+
+def test_streaming_generator_error(ray_start_regular):
+    @ray_tpu.remote(num_returns="streaming")
+    def gen():
+        yield 1
+        raise ValueError("stream broke")
+
+    it = gen.remote()
+    first = next(it)
+    assert ray_tpu.get(first, timeout=30) == 1
+    with pytest.raises(Exception):
+        for ref in it:
+            ray_tpu.get(ref, timeout=30)

@@ -1,0 +1,64 @@
+"""Unit tests for the binary ID scheme (reference: id layout in
+ray src/ray/design_docs/id_specification.md)."""
+
+import pickle
+
+import pytest
+
+from ray_tpu._private.ids import (
+    ActorID,
+    JobID,
+    NodeID,
+    ObjectID,
+    PlacementGroupID,
+    TaskID,
+)
+
+
+def test_sizes():
+    assert len(JobID.from_int(1).binary()) == 4
+    assert len(ActorID.of(JobID.from_int(1)).binary()) == 16
+    assert len(TaskID.for_normal_task(JobID.from_int(1)).binary()) == 24
+    t = TaskID.for_normal_task(JobID.from_int(1))
+    assert len(ObjectID.for_task_return(t, 1).binary()) == 28
+
+
+def test_derivations():
+    job = JobID.from_int(7)
+    actor = ActorID.of(job)
+    assert actor.job_id() == job
+    task = TaskID.for_actor_task(actor)
+    assert task.actor_id() == actor
+    assert task.job_id() == job
+    oid = ObjectID.for_task_return(task, 3)
+    assert oid.task_id() == task
+    assert oid.return_index() == 3
+    assert not oid.is_put()
+    put = ObjectID.for_put(task, 3)
+    assert put.is_put()
+    assert put != oid
+
+
+def test_creation_task_deterministic():
+    actor = ActorID.of(JobID.from_int(1))
+    assert TaskID.for_actor_creation_task(actor) == TaskID.for_actor_creation_task(actor)
+
+
+def test_hex_roundtrip_and_pickle():
+    n = NodeID.from_random()
+    assert NodeID.from_hex(n.hex()) == n
+    assert pickle.loads(pickle.dumps(n)) == n
+    assert hash(pickle.loads(pickle.dumps(n))) == hash(n)
+
+
+def test_nil_and_validation():
+    assert JobID.nil().is_nil()
+    assert not JobID.from_int(1).is_nil()
+    with pytest.raises(ValueError):
+        JobID(b"too long for a job id")
+
+
+def test_immutability():
+    j = JobID.from_int(1)
+    with pytest.raises(AttributeError):
+        j.x = 1
