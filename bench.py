@@ -1,0 +1,103 @@
+"""Headline benchmark: Llama training-step throughput on the local TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Metric: training tokens/sec/chip on a ~350M-param Llama-family model
+(bf16, flash-attention Pallas kernels, remat, donated buffers) at seq 2048.
+The reference publishes no absolute model-training numbers
+(BASELINE.md: `published: {}`), so vs_baseline is MFU relative to the
+A100-class 40% MFU bar named in BASELINE.json's north-star
+("≥A100-equivalent MFU"): vs_baseline = MFU / 0.40.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from functools import partial
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.models import llama
+    from ray_tpu.parallel.mesh import MeshConfig, build_mesh
+    from ray_tpu.parallel.sharding import LogicalAxisRules, logical_sharding
+    from ray_tpu.train.step import init_train_state, make_train_step
+
+    n_devices = len(jax.devices())
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+
+    if on_tpu:
+        cfg = llama.LlamaConfig(
+            vocab_size=32_000, d_model=2048, n_layers=8, n_heads=16,
+            n_kv_heads=8, d_head=128, d_ff=5632, max_seq_len=2048,
+        )
+        batch, seq, steps = 8, 2048, 20
+        peak_flops = 197e12  # v5e bf16 peak per chip
+    else:  # CPU smoke fallback so the script always emits a line
+        cfg = llama.LlamaConfig.tiny()
+        batch, seq, steps = 4, 128, 3
+        peak_flops = 1e12
+
+    mesh = build_mesh(MeshConfig(dp=n_devices))
+    rules = LogicalAxisRules()
+    opt = optax.adamw(3e-4, weight_decay=0.0)
+    state, shardings = init_train_state(
+        partial(llama.init, cfg), opt, llama.param_logical_axes(cfg),
+        mesh, jax.random.PRNGKey(0), rules,
+    )
+    bs = logical_sharding(mesh, ("batch", "seq"), rules)
+    step = make_train_step(
+        partial(llama.loss_fn, config=cfg, mesh=mesh, rules=rules),
+        opt, shardings, batch_sharding={"inputs": bs, "targets": bs},
+    )
+    toks = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, seq + 1), 0, cfg.vocab_size
+    )
+    b = {
+        "inputs": jax.device_put(toks[:, :-1], bs),
+        "targets": jax.device_put(toks[:, 1:], bs),
+    }
+
+    # Warmup/compile. NOTE: synchronize with a host transfer (float()), not
+    # block_until_ready — on tunneled/remote PJRT backends the latter can
+    # return before the computation runs.
+    state, m = step(state, b)
+    float(m["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, m = step(state, b)
+    float(m["loss"])
+    dt = (time.perf_counter() - t0) / steps
+
+    tokens_per_step = batch * seq
+    tokens_per_sec_per_chip = tokens_per_step / dt / n_devices
+    flops_tok = llama.flops_per_token(cfg, seq)
+    mfu = flops_tok * tokens_per_sec_per_chip / peak_flops
+
+    print(json.dumps({
+        "metric": "llama_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec_per_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.40, 4),
+        "detail": {
+            "model_params_m": round(cfg.num_params() / 1e6, 1),
+            "seq_len": seq,
+            "global_batch": batch,
+            "step_time_ms": round(dt * 1e3, 2),
+            "mfu": round(mfu, 4),
+            "platform": platform,
+            "n_devices": n_devices,
+            "loss": round(float(m["loss"]), 4),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,10 @@
+"""Model zoo: JAX pytree models with logical sharding annotations.
+
+Each model module exposes: a Config dataclass, `init(config, key)`,
+`forward(params, tokens, config)`, `loss_fn`, and `param_logical_axes(config)`
+for the parallel layer. Models are plain pytrees — no framework object wrap —
+so donation, sharding, and checkpointing stay trivial.
+"""
+
+from ray_tpu.models import llama  # noqa: F401
+from ray_tpu.models import mlp  # noqa: F401

@@ -1,0 +1,246 @@
+"""Llama-family decoder-only transformer, TPU-first.
+
+Flagship model for the framework's training/serving stacks: GQA attention
+(Pallas flash kernels on TPU, ring attention when the mesh has an `sp` axis),
+RMSNorm, SwiGLU, RoPE, scan-over-layers with per-layer remat
+(`jax.checkpoint`) so compile time and HBM stay flat as depth grows, and
+logical sharding annotations (batch/embed/heads/mlp/vocab) that lower to
+DP/FSDP/TP on any mesh via ray_tpu.parallel.sharding.
+
+Capability note: the reference has no model zoo of its own — its Train/Serve
+stacks wrap external Torch models. Here the model layer is in-framework so
+parallelism is native (SURVEY.md §5, §7).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.flash_attention import flash_attention
+from ray_tpu.parallel.sharding import LogicalAxisRules, with_logical_constraint
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32_000
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    d_head: int = 128
+    d_ff: int = 14_336
+    rope_theta: float = 500_000.0
+    norm_eps: float = 1e-5
+    max_seq_len: int = 8192
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+    use_ring_attention: bool = False  # set when mesh sp-axis > 1
+
+    @staticmethod
+    def llama3_8b() -> "LlamaConfig":
+        return LlamaConfig(
+            vocab_size=128_256, d_model=4096, n_layers=32, n_heads=32,
+            n_kv_heads=8, d_head=128, d_ff=14_336,
+        )
+
+    @staticmethod
+    def tiny(vocab_size: int = 512) -> "LlamaConfig":
+        return LlamaConfig(
+            vocab_size=vocab_size, d_model=128, n_layers=2, n_heads=4,
+            n_kv_heads=2, d_head=32, d_ff=256, max_seq_len=512,
+        )
+
+    @staticmethod
+    def small_1b() -> "LlamaConfig":
+        return LlamaConfig(
+            vocab_size=32_000, d_model=2048, n_layers=16, n_heads=16,
+            n_kv_heads=8, d_head=128, d_ff=5632,
+        )
+
+    def num_params(self) -> int:
+        per_layer = (
+            self.d_model * self.n_heads * self.d_head      # wq
+            + 2 * self.d_model * self.n_kv_heads * self.d_head  # wk, wv
+            + self.n_heads * self.d_head * self.d_model    # wo
+            + 3 * self.d_model * self.d_ff                 # gate, up, down
+            + 2 * self.d_model                             # norms
+        )
+        return (
+            self.vocab_size * self.d_model                 # embed
+            + self.n_layers * per_layer
+            + self.d_model                                 # final norm
+            + self.d_model * self.vocab_size               # lm head
+        )
+
+
+def param_logical_axes(config: LlamaConfig) -> Dict[str, Any]:
+    """Logical axis names per parameter (layers stacked on 'layers')."""
+    L = ("layers",)
+    return {
+        "embed": ("vocab", "embed"),
+        "layers": {
+            "attn_norm": L + (None,),
+            "wq": L + ("embed", "heads", "kv"),
+            "wk": L + ("embed", "heads", "kv"),
+            "wv": L + ("embed", "heads", "kv"),
+            "wo": L + ("heads", "kv", "embed"),
+            "mlp_norm": L + (None,),
+            "w_gate": L + ("embed", "mlp"),
+            "w_up": L + ("embed", "mlp"),
+            "w_down": L + ("mlp", "embed"),
+        },
+        "final_norm": (None,),
+        "lm_head": ("embed", "vocab"),
+    }
+
+
+def init(config: LlamaConfig, key) -> Dict[str, Any]:
+    c = config
+    k_embed, k_layers, k_head = jax.random.split(key, 3)
+
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape, dtype=jnp.float32)
+                * (fan_in ** -0.5)).astype(c.dtype)
+
+    def layer_params(key):
+        ks = jax.random.split(key, 7)
+        return {
+            "attn_norm": jnp.ones((c.d_model,), dtype=c.dtype),
+            "wq": dense(ks[0], (c.d_model, c.n_heads, c.d_head), c.d_model),
+            "wk": dense(ks[1], (c.d_model, c.n_kv_heads, c.d_head), c.d_model),
+            "wv": dense(ks[2], (c.d_model, c.n_kv_heads, c.d_head), c.d_model),
+            "wo": dense(ks[3], (c.n_heads, c.d_head, c.d_model),
+                        c.n_heads * c.d_head),
+            "mlp_norm": jnp.ones((c.d_model,), dtype=c.dtype),
+            "w_gate": dense(ks[4], (c.d_model, c.d_ff), c.d_model),
+            "w_up": dense(ks[5], (c.d_model, c.d_ff), c.d_model),
+            "w_down": dense(ks[6], (c.d_ff, c.d_model), c.d_ff),
+        }
+
+    layer_keys = jax.random.split(k_layers, c.n_layers)
+    layers = jax.vmap(layer_params)(layer_keys)
+    return {
+        "embed": dense(k_embed, (c.vocab_size, c.d_model), c.d_model),
+        "layers": layers,
+        "final_norm": jnp.ones((c.d_model,), dtype=c.dtype),
+        "lm_head": dense(k_head, (c.d_model, c.vocab_size), c.d_model),
+    }
+
+
+def _rms_norm(x, weight, eps):
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    var = jnp.mean(x * x, axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + eps)).astype(dtype) * weight
+
+
+def _rope(x, positions, theta):
+    # x: [B, S, H, D]; rotate pairs (d, d + D/2).
+    d = x.shape[-1]
+    half = d // 2
+    freqs = 1.0 / (theta ** (jnp.arange(half, dtype=jnp.float32) / half))
+    angles = positions[:, :, None].astype(jnp.float32) * freqs[None, None, :]
+    cos = jnp.cos(angles)[:, :, None, :]  # [B, S, 1, half]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(jnp.float32)
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    ).astype(x.dtype)
+
+
+def _attention(q, k, v, config: LlamaConfig, mesh=None):
+    if config.use_ring_attention and mesh is not None and mesh.shape.get("sp", 1) > 1:
+        from ray_tpu.parallel.ring_attention import ring_attention_sharded
+
+        rep = config.n_heads // config.n_kv_heads
+        if rep > 1:
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        return ring_attention_sharded(q, k, v, mesh, causal=True)
+    return flash_attention(q, k, v, causal=True)
+
+
+def _layer(x, params, positions, config: LlamaConfig, mesh=None,
+           rules: Optional[LogicalAxisRules] = None):
+    c = config
+    lc = partial(with_logical_constraint, mesh=mesh, rules=rules)
+
+    h = _rms_norm(x, params["attn_norm"], c.norm_eps)
+    q = jnp.einsum("bsd,dhk->bshk", h, params["wq"])
+    k = jnp.einsum("bsd,dhk->bshk", h, params["wk"])
+    v = jnp.einsum("bsd,dhk->bshk", h, params["wv"])
+    q = lc(q, ("batch", "seq", "act_heads", "act_kv"))
+    k = lc(k, ("batch", "seq", "act_heads", "act_kv"))
+    q = _rope(q, positions, c.rope_theta)
+    k = _rope(k, positions, c.rope_theta)
+    attn = _attention(q, k, v, c, mesh)
+    x = x + jnp.einsum("bshk,hkd->bsd", attn, params["wo"])
+    x = lc(x, ("batch", "seq", "act_embed"))
+
+    h = _rms_norm(x, params["mlp_norm"], c.norm_eps)
+    gate = jnp.einsum("bsd,df->bsf", h, params["w_gate"])
+    up = jnp.einsum("bsd,df->bsf", h, params["w_up"])
+    gate = lc(gate, ("batch", "seq", "act_mlp"))
+    ff = jax.nn.silu(gate) * up
+    x = x + jnp.einsum("bsf,fd->bsd", ff, params["w_down"])
+    return lc(x, ("batch", "seq", "act_embed"))
+
+
+def forward(params, tokens, config: LlamaConfig, mesh=None,
+            rules: Optional[LogicalAxisRules] = None):
+    """tokens: [B, S] int32 -> logits [B, S, vocab] (cast to fp32)."""
+    c = config
+    lc = partial(with_logical_constraint, mesh=mesh, rules=rules)
+    b, s = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+    x = params["embed"][tokens].astype(c.dtype)
+    x = lc(x, ("batch", "seq", "act_embed"))
+
+    layer_fn = partial(_layer, positions=positions, config=c, mesh=mesh,
+                       rules=rules)
+    if c.remat:
+        layer_fn = jax.checkpoint(layer_fn)
+
+    def scan_body(x, layer_p):
+        return layer_fn(x, layer_p), None
+
+    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+    x = _rms_norm(x, params["final_norm"], c.norm_eps)
+    logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"])
+    logits = lc(logits, ("batch", "seq", "act_vocab"))
+    return logits.astype(jnp.float32)
+
+
+def loss_fn(params, batch, config: LlamaConfig, mesh=None,
+            rules: Optional[LogicalAxisRules] = None):
+    """Next-token cross-entropy. batch: {"tokens": [B, S]} (targets are the
+    shifted tokens) or explicit {"inputs", "targets", "mask"}."""
+    if "inputs" in batch:
+        inputs, targets = batch["inputs"], batch["targets"]
+        mask = batch.get("mask")
+    else:
+        tokens = batch["tokens"]
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        mask = None
+    logits = forward(params, inputs, config, mesh, rules)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if mask is not None:
+        nll = nll * mask
+        denom = jnp.maximum(jnp.sum(mask), 1.0)
+    else:
+        denom = nll.size
+    return jnp.sum(nll) / denom
+
+
+def flops_per_token(config: LlamaConfig, seq_len: int) -> float:
+    """Approx training FLOPs/token (fwd+bwd ≈ 6N + attention term)."""
+    c = config
+    param_flops = 6.0 * c.num_params()
+    attn_flops = 12.0 * c.n_layers * c.n_heads * c.d_head * seq_len  # causal avg
+    return param_flops + attn_flops

@@ -1,0 +1,58 @@
+"""Minimal MLP (MNIST-class) — the SURVEY §7 end-to-end-slice model."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class MLPConfig:
+    in_dim: int = 784
+    hidden: Sequence[int] = (256, 256)
+    out_dim: int = 10
+    dtype: Any = jnp.float32
+
+
+def param_logical_axes(config: MLPConfig):
+    axes = []
+    for _ in range(len(config.hidden) + 1):
+        axes.append({"w": ("embed", "mlp"), "b": (None,)})
+    return {"layers": axes}
+
+
+def init(config: MLPConfig, key) -> Dict[str, Any]:
+    dims = [config.in_dim, *config.hidden, config.out_dim]
+    layers = []
+    for i, (d_in, d_out) in enumerate(zip(dims[:-1], dims[1:])):
+        key, sub = jax.random.split(key)
+        layers.append({
+            "w": (jax.random.normal(sub, (d_in, d_out)) * (d_in ** -0.5)
+                  ).astype(config.dtype),
+            "b": jnp.zeros((d_out,), dtype=config.dtype),
+        })
+    return {"layers": layers}
+
+
+def forward(params, x, config: MLPConfig):
+    for i, layer in enumerate(params["layers"]):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(params["layers"]) - 1:
+            x = jax.nn.relu(x)
+    return x
+
+
+def loss_fn(params, batch, config: MLPConfig):
+    logits = forward(params, batch["x"], config)
+    labels = batch["y"]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+    return jnp.mean(nll)
+
+
+def accuracy(params, batch, config: MLPConfig):
+    logits = forward(params, batch["x"], config)
+    return jnp.mean((jnp.argmax(logits, -1) == batch["y"]).astype(jnp.float32))

@@ -1,0 +1,7 @@
+"""Hot-path ops: Pallas TPU kernels with pure-JAX fallbacks.
+
+Kernels target the MXU/VMEM model from the Pallas TPU guide; every op has a
+reference JAX implementation used on CPU (tests) and as the numerical oracle.
+"""
+
+from ray_tpu.ops.flash_attention import flash_attention  # noqa: F401

@@ -1,0 +1,24 @@
+"""TPU-native parallelism layer: mesh construction, sharding rules, ring
+attention (SP), pipeline stages (PP), and MoE dispatch (EP).
+
+This is capability the reference delegates to external Torch ecosystems
+(SURVEY.md §5 "Long-context / sequence parallelism": DeepSpeed/Accelerate/FSDP
+integrations under ray python/ray/train/) — here it is first-class: DP/FSDP
+via NamedSharding, TP via Megatron-style PartitionSpecs, SP via ring attention
+over `ppermute`, PP via staged shard_map, EP via sharded expert dispatch.
+
+Imports JAX lazily at module level only inside submodules — `import ray_tpu`
+never pulls JAX in.
+"""
+
+from ray_tpu.parallel.mesh import (  # noqa: F401
+    MeshConfig,
+    build_mesh,
+    local_device_mesh,
+)
+from ray_tpu.parallel.sharding import (  # noqa: F401
+    LogicalAxisRules,
+    logical_sharding,
+    shard_params,
+    with_logical_constraint,
+)

@@ -1,0 +1,106 @@
+"""Device mesh construction + multi-host bootstrap.
+
+The TPU-native replacement for the reference's NCCL process-group bootstrap
+(ray: python/ray/train/torch/config.py:112 _setup_torch_process_group, and
+ray/util/collective's NCCL groups): instead of exchanging NCCL unique ids,
+worker gangs call `initialize_distributed` (a thin `jax.distributed` wrapper
+whose coordinator is the rank-0 worker), then every process builds the same
+`jax.sharding.Mesh` over the global device set and runs the same jit program
+— collectives are emitted by XLA over ICI/DCN (SURVEY.md §5 "Distributed
+communication backend").
+
+Mesh axes (outer → inner, DCN-ish → ICI-ish): pp, dp, fsdp, ep, sp, tp.
+TP innermost so its collectives ride the fastest ICI links.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import math
+from typing import Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+AXIS_ORDER = ("pp", "dp", "fsdp", "ep", "sp", "tp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Degrees for each parallelism axis; -1 on dp means 'fill remaining'."""
+
+    dp: int = -1      # data parallel (pure replication of params)
+    fsdp: int = 1     # fully-sharded data parallel (params sharded on batch axis)
+    tp: int = 1       # tensor (Megatron) parallel
+    sp: int = 1       # sequence/context parallel (ring attention)
+    pp: int = 1       # pipeline parallel
+    ep: int = 1       # expert parallel (MoE)
+
+    def resolved(self, n_devices: int) -> "MeshConfig":
+        known = self.fsdp * self.tp * self.sp * self.pp * self.ep
+        dp = self.dp
+        if dp == -1:
+            if n_devices % known != 0:
+                raise ValueError(
+                    f"device count {n_devices} not divisible by "
+                    f"fsdp*tp*sp*pp*ep={known}"
+                )
+            dp = n_devices // known
+        if dp * known != n_devices:
+            raise ValueError(
+                f"mesh {self} needs {dp * known} devices, have {n_devices}"
+            )
+        return dataclasses.replace(self, dp=dp)
+
+    def axis_sizes(self) -> Dict[str, int]:
+        return {
+            "pp": self.pp, "dp": self.dp, "fsdp": self.fsdp,
+            "ep": self.ep, "sp": self.sp, "tp": self.tp,
+        }
+
+
+def build_mesh(config: MeshConfig = MeshConfig(), devices=None):
+    """Build a jax.sharding.Mesh over the (global) device set."""
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    config = config.resolved(len(devices))
+    sizes = config.axis_sizes()
+    shape = tuple(sizes[a] for a in AXIS_ORDER)
+    import numpy as np
+
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, AXIS_ORDER)
+
+
+def local_device_mesh(config: Optional[MeshConfig] = None):
+    """Mesh over this process's local devices only (single-host)."""
+    import jax
+
+    return build_mesh(config or MeshConfig(), devices=jax.local_devices())
+
+
+def initialize_distributed(
+    coordinator_address: str, num_processes: int, process_id: int
+) -> None:
+    """Multi-host rendezvous: the mesh-collective equivalent of NCCL init.
+
+    Called by every worker in a gang (see ray_tpu.train's backend setup);
+    rank 0's address is distributed through the actor gang the same way the
+    reference broadcasts the master address (torch/config.py:112).
+    """
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def best_mesh_for(n_devices: int, model_axis_max: int = 8) -> MeshConfig:
+    """Heuristic default: TP within a chip-group bound, rest data parallel."""
+    tp = math.gcd(n_devices, model_axis_max)
+    return MeshConfig(dp=n_devices // tp, tp=tp)

@@ -1,0 +1,12 @@
+"""Distributed training library (JaxTrainer and friends).
+
+Reference counterpart: Ray Train (ray: python/ray/train — BaseTrainer.fit
+base_trainer.py:567, DataParallelTrainer, BackendExecutor, WorkerGroup), with
+the NCCL backend replaced by mesh construction + XLA collectives.
+"""
+
+from ray_tpu.train.step import (  # noqa: F401
+    TrainState,
+    make_train_step,
+    init_train_state,
+)

@@ -10,13 +10,19 @@ import os
 
 # Must be set before anything imports jax (including this host's
 # sitecustomize in spawned workers — handled by worker env).
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: host env may say "axon" (TPU)
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The host sitecustomize may have imported jax already (locking the platform
+# choice read from env at import time) — override through the config API.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
