@@ -37,7 +37,10 @@ def main():
             vocab_size=32_000, d_model=2048, n_layers=8, n_heads=16,
             n_kv_heads=8, d_head=128, d_ff=5632, max_seq_len=2048,
         )
-        batch, seq, steps = 8, 2048, 20
+        # Per-chip batch of 8: global batch scales with the dp width so the
+        # batch dim always divides the mesh (fixed global batch would fail
+        # device_put on slices wider than 8 chips).
+        batch, seq, steps = 8 * n_devices, 2048, 20
         peak_flops = 197e12  # v5e bf16 peak per chip
     else:  # CPU smoke fallback so the script always emits a line
         cfg = llama.LlamaConfig.tiny()

@@ -114,7 +114,7 @@ _d("gcs_storage_path", "")  # "" = pure in-memory; path = snapshot for restart
 _d("maximum_gcs_dead_node_cache_count", 1000)
 
 # --- logging -----------------------------------------------------------------
-_d("log_dir", "/tmp/ray_tpu/logs")
+_d("log_dir", "/tmp/rt_session/logs")
 _d("log_to_driver", True)
 
 CONFIG.load_from_env()

@@ -162,6 +162,12 @@ def _attention(q, k, v, config: LlamaConfig, mesh=None):
             k = jnp.repeat(k, rep, axis=2)
             v = jnp.repeat(v, rep, axis=2)
         return ring_attention_sharded(q, k, v, mesh, causal=True)
+    if mesh is not None and any(
+        mesh.shape.get(a, 1) > 1 for a in ("dp", "fsdp", "tp")
+    ):
+        from ray_tpu.ops.flash_attention import flash_attention_sharded
+
+        return flash_attention_sharded(q, k, v, mesh, causal=True)
     return flash_attention(q, k, v, causal=True)
 
 
@@ -242,5 +248,7 @@ def flops_per_token(config: LlamaConfig, seq_len: int) -> float:
     """Approx training FLOPs/token (fwd+bwd ≈ 6N + attention term)."""
     c = config
     param_flops = 6.0 * c.num_params()
-    attn_flops = 12.0 * c.n_layers * c.n_heads * c.d_head * seq_len  # causal avg
+    # Causal attention: QK^T + PV = 2 matmuls × 2 flops × H·D × S/2 (causal
+    # average) × 3 (fwd+bwd) = 6·H·D·S per layer per token.
+    attn_flops = 6.0 * c.n_layers * c.n_heads * c.d_head * seq_len
     return param_flops + attn_flops

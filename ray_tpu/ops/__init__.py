@@ -4,4 +4,7 @@ Kernels target the MXU/VMEM model from the Pallas TPU guide; every op has a
 reference JAX implementation used on CPU (tests) and as the numerical oracle.
 """
 
-from ray_tpu.ops.flash_attention import flash_attention  # noqa: F401
+from ray_tpu.ops.flash_attention import (  # noqa: F401
+    flash_attention,
+    flash_attention_sharded,
+)

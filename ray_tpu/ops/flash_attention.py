@@ -387,3 +387,41 @@ def flash_attention(
     else:
         o = _reference_attention(qt, kt, vt, causal, scale)
     return o.transpose(0, 2, 1, 3)
+
+
+def flash_attention_sharded(q, k, v, mesh, causal: bool = True,
+                            scale: Optional[float] = None, **kw):
+    """shard_map-wrapped flash attention for use inside a pjit-sharded model.
+
+    GSPMD has no partitioning rule for a Pallas custom call, so without this
+    wrapper XLA all-gathers q/k/v to every device and replicates the kernel.
+    Here batch rides ('dp','fsdp') and heads ride 'tp' explicitly; each shard
+    runs the kernel on its local [B/dp·fsdp, S, H/tp, D] block. KV heads are
+    repeated to match q heads first so the tp shard is uniform under GQA.
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    h_kv = k.shape[2]
+    h = q.shape[2]
+    if h_kv != h:
+        rep = h // h_kv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+
+    batch_axes = tuple(a for a in ("dp", "fsdp")
+                       if mesh.shape.get(a, 1) > 1)
+    batch_div = 1
+    for a in batch_axes:
+        batch_div *= mesh.shape[a]
+    if q.shape[0] % max(batch_div, 1) != 0:
+        batch_axes = ()
+    head_axis = "tp" if (mesh.shape.get("tp", 1) > 1
+                         and h % mesh.shape["tp"] == 0) else None
+    spec = P(batch_axes or None, None, head_axis, None)
+
+    fn = functools.partial(flash_attention, causal=causal, scale=scale, **kw)
+    return shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )(q, k, v)

@@ -30,14 +30,19 @@ def top_k_gating(logits, k: int, capacity: int):
     ce = jnp.mean(top1, axis=0)
     aux_loss = e * jnp.sum(me * ce)
 
-    # Position of each token within its expert's buffer, per chosen expert.
+    # Position of each token within its expert's buffer. Slots are assigned
+    # in priority order (all slot-0 choices first, then slot-1, ...) with a
+    # running per-expert offset so a token picking expert E as 1st choice and
+    # another picking E as 2nd choice never collide in the same capacity slot.
     dispatch = jnp.zeros((t, e, capacity), dtype=jnp.float32)
     combine = jnp.zeros((t, e, capacity), dtype=jnp.float32)
+    expert_counts = jnp.zeros((e,), dtype=jnp.float32)
     for slot in range(k):
         idx = gate_idx[:, slot]                              # [T]
         onehot = jax.nn.one_hot(idx, e)                      # [T, E]
-        pos = (jnp.cumsum(onehot, axis=0) - onehot) * onehot  # [T, E]
+        pos = (jnp.cumsum(onehot, axis=0) - onehot + expert_counts) * onehot
         pos_in_expert = jnp.sum(pos, axis=-1).astype(jnp.int32)  # [T]
+        expert_counts = expert_counts + jnp.sum(onehot, axis=0)
         keep = pos_in_expert < capacity
         cap_onehot = jax.nn.one_hot(pos_in_expert, capacity)  # [T, C]
         d = onehot[:, :, None] * cap_onehot[:, None, :] * keep[:, None, None]

@@ -114,7 +114,18 @@ def ring_attention_sharded(q, k, v, mesh, axis_name: str = "sp",
     from jax.sharding import PartitionSpec as P
     from jax import shard_map
 
-    spec = P(None, axis_name, None, None)
+    # Shard batch over dp/fsdp and heads over tp too — replicating those dims
+    # would all-gather the activations and redo attention on every dp/tp
+    # shard, defeating the O(S_local) memory point of the ring.
+    batch_axes = tuple(a for a in ("dp", "fsdp") if mesh.shape.get(a, 1) > 1)
+    bdiv = 1
+    for a in batch_axes:
+        bdiv *= mesh.shape[a]
+    if q.shape[0] % max(bdiv, 1) != 0:
+        batch_axes = ()
+    head_axis = ("tp" if mesh.shape.get("tp", 1) > 1
+                 and q.shape[2] % mesh.shape["tp"] == 0 else None)
+    spec = P(batch_axes or None, axis_name, head_axis, None)
     fn = functools.partial(ring_attention, axis_name=axis_name, causal=causal)
     return shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,

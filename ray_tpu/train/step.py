@@ -16,7 +16,11 @@ from typing import Any, Callable, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from ray_tpu.parallel.sharding import LogicalAxisRules, logical_sharding
+from ray_tpu.parallel.sharding import (
+    LogicalAxisRules,
+    logical_sharding,
+    param_shardings,
+)
 
 
 @dataclasses.dataclass
@@ -33,20 +37,6 @@ def _as_dict(state: "TrainState") -> Dict[str, Any]:
             "step": state.step}
 
 
-def _tree_shardings(param_logical_axes, mesh, rules):
-    def make(axes):
-        if axes is None:
-            axes = ()
-        return logical_sharding(mesh, axes, rules)
-
-    return jax.tree.map(
-        make, param_logical_axes,
-        is_leaf=lambda x: x is None or (
-            isinstance(x, tuple) and all(a is None or isinstance(a, str) for a in x)
-        ),
-    )
-
-
 def init_train_state(
     init_fn: Callable[[Any], Any],     # key -> params pytree
     optimizer,                          # optax GradientTransformation
@@ -60,7 +50,7 @@ def init_train_state(
     Returns (state, state_shardings) — the latter for use as jit shardings.
     """
     rules = rules or LogicalAxisRules()
-    p_shardings = _tree_shardings(param_logical_axes, mesh, rules)
+    p_shardings = param_shardings(param_logical_axes, mesh, rules)
 
     params_shape = jax.eval_shape(init_fn, key)
     # Optimizer state shardings: optax states embed params-shaped subtrees

@@ -1,0 +1,386 @@
+"""Actor-set collectives — the TPU-native ``ray.util.collective``.
+
+Reference surface: ray python/ray/util/collective/collective.py —
+init_collective_group (:120), allreduce (:258), broadcast (:373),
+allgather (:423), reducescatter (:472), send/recv (:531/:594), declared
+group bookkeeping (:52).
+
+TPU-native design (SURVEY §2.3, §5): on TPU the hot-path collectives are
+*compiler-emitted* — ``jax.lax.psum/all_gather/reduce_scatter/ppermute``
+inside ``jit`` over a ``jax.sharding.Mesh`` ride the ICI interconnect, so
+this module's job is the part NCCL/gloo did *outside* jit:
+
+- **rendezvous**: ranks of an actor gang find each other through a named
+  detached rendezvous actor (replacing NCCL unique-id exchange);
+- **host (DCN) collectives**: numpy-tree collectives between processes for
+  metadata, gradients-of-small-things, and out-of-jit coordination;
+- **mesh bootstrap**: `ray_tpu.parallel.mesh` consumes the same rendezvous
+  to run ``jax.distributed.initialize`` for multi-host meshes.
+
+Backend "host" works anywhere (it moves bytes through the object-store /
+actor RPC plane). Backend "mesh" is documented sugar: it asserts the caller
+is inside a mesh context and tells them to use in-jit collectives.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+class ReduceOp:
+    SUM = "sum"
+    PRODUCT = "product"
+    MIN = "min"
+    MAX = "max"
+
+
+_REDUCERS = {
+    ReduceOp.SUM: lambda xs: _tree_reduce(xs, np.add),
+    ReduceOp.PRODUCT: lambda xs: _tree_reduce(xs, np.multiply),
+    ReduceOp.MIN: lambda xs: _tree_reduce(xs, np.minimum),
+    ReduceOp.MAX: lambda xs: _tree_reduce(xs, np.maximum),
+}
+
+
+def _tree_reduce(xs: List[Any], op):
+    out = xs[0]
+    for x in xs[1:]:
+        out = _tree_map2(op, out, x)
+    return out
+
+
+def _tree_map2(op, a, b):
+    if isinstance(a, dict):
+        return {k: _tree_map2(op, a[k], b[k]) for k in a}
+    if isinstance(a, (list, tuple)):
+        return type(a)(_tree_map2(op, x, y) for x, y in zip(a, b))
+    return op(np.asarray(a), np.asarray(b))
+
+
+class _GroupCoordinator:
+    """Async rendezvous + collective completion actor (one per group).
+
+    Each rank posts its contribution for (op_kind, seq); when world_size
+    contributions arrive the op completes and every rank's awaiting call
+    returns. P2P send/recv is a mailbox keyed by (src, dst, tag).
+    """
+
+    def __init__(self, world_size: int):
+        import asyncio
+
+        self.world_size = world_size
+        self._ops: Dict[tuple, dict] = {}
+        self._mail: Dict[tuple, Any] = {}
+        self._mail_events: Dict[tuple, asyncio.Event] = {}
+        self._ranks_seen = set()
+
+    def ready(self):
+        return True
+
+    async def register(self, rank: int):
+        self._ranks_seen.add(rank)
+        return self.world_size
+
+    async def _op_state(self, key):
+        import asyncio
+
+        st = self._ops.get(key)
+        if st is None:
+            st = {"contribs": {}, "event": asyncio.Event(), "result": None}
+            self._ops[key] = st
+        return st
+
+    async def contribute(self, kind: str, seq: int, rank: int, payload,
+                         meta: Optional[dict] = None):
+        """Generic all-to-one-to-all collective step."""
+        key = (kind, seq)
+        st = await self._op_state(key)
+        st["contribs"][rank] = payload
+        if meta:
+            st.setdefault("meta", {}).update(meta)
+        if len(st["contribs"]) == self.world_size:
+            st["result"] = self._complete(kind, st)
+            st["event"].set()
+        await st["event"].wait()
+        result = st["result"]
+        st.setdefault("fetched", set()).add(rank)
+        if len(st["fetched"]) == self.world_size:
+            self._ops.pop(key, None)
+        if kind in ("allgather", "reducescatter"):
+            return result[rank] if kind == "reducescatter" else result
+        return result
+
+    def _complete(self, kind: str, st: dict):
+        contribs = st["contribs"]
+        ordered = [contribs[r] for r in sorted(contribs)]
+        if kind == "allreduce":
+            op = st.get("meta", {}).get("op", ReduceOp.SUM)
+            return _REDUCERS[op](ordered)
+        if kind == "allgather":
+            return ordered
+        if kind == "broadcast":
+            root = st.get("meta", {}).get("root", 0)
+            return contribs[root]
+        if kind == "barrier":
+            return None
+        if kind == "reducescatter":
+            # Each rank contributed a list of world_size chunks; rank r
+            # receives reduce(chunk[r] over all ranks).
+            op = st.get("meta", {}).get("op", ReduceOp.SUM)
+            return [
+                _REDUCERS[op]([c[r] for c in ordered])
+                for r in range(self.world_size)
+            ]
+        raise ValueError(f"unknown collective kind: {kind}")
+
+    async def post(self, src: int, dst: int, tag: int, payload):
+        import asyncio
+
+        # Per-key FIFO: two sends on the same (src, dst, tag) before the
+        # first recv must both be delivered, in order.
+        key = (src, dst, tag)
+        self._mail.setdefault(key, []).append(payload)
+        ev = self._mail_events.setdefault(key, asyncio.Event())
+        ev.set()
+
+    async def fetch(self, src: int, dst: int, tag: int):
+        import asyncio
+
+        key = (src, dst, tag)
+        while not self._mail.get(key):
+            ev = self._mail_events.setdefault(key, asyncio.Event())
+            await ev.wait()
+        queue = self._mail[key]
+        payload = queue.pop(0)
+        if not queue:
+            del self._mail[key]
+            ev = self._mail_events.get(key)
+            if ev is not None:
+                ev.clear()
+        return payload
+
+
+class _GroupHandle:
+    def __init__(self, name: str, world_size: int, rank: int, coordinator):
+        self.name = name
+        self.world_size = world_size
+        self.rank = rank
+        self.coordinator = coordinator
+        self._seq = 0
+        self._p2p_tag = 0
+        self._lock = threading.Lock()
+
+    def next_seq(self) -> int:
+        with self._lock:
+            self._seq += 1
+            return self._seq
+
+
+_groups: Dict[str, _GroupHandle] = {}
+_declared: set = set()  # groups this process declared via create_collective_group
+_groups_lock = threading.Lock()
+
+_COORD_PREFIX = "rt_collective_coordinator:"
+
+
+def _coordinator_actor(group_name: str, world_size: int):
+    import ray_tpu as rt
+
+    # num_cpus=0: pure coordination actor — must never compete with gang
+    # members for CPU slots or a full-width gang deadlocks on scheduling.
+    cls = rt.remote(_GroupCoordinator)
+    return cls.options(
+        name=_COORD_PREFIX + group_name,
+        lifetime="detached",
+        get_if_exists=True,
+        max_concurrency=1000,
+        num_cpus=0,
+    ).remote(world_size)
+
+
+def init_collective_group(
+    world_size: int,
+    rank: int,
+    backend: str = "host",
+    group_name: str = "default",
+) -> None:
+    """Join this process into a named collective group (collective.py:120)."""
+    import ray_tpu as rt
+
+    if backend not in ("host", "gloo", "mesh", "xla"):
+        raise ValueError(f"unsupported backend {backend!r}")
+    if not (0 <= rank < world_size):
+        raise ValueError(f"rank {rank} out of range for world size {world_size}")
+    with _groups_lock:
+        if group_name in _groups:
+            raise RuntimeError(f"group {group_name!r} already initialized")
+    coord = _coordinator_actor(group_name, world_size)
+    rt.get(coord.register.remote(rank))
+    with _groups_lock:
+        _groups[group_name] = _GroupHandle(group_name, world_size, rank, coord)
+
+
+def create_collective_group(actors, world_size: int, ranks: List[int],
+                            backend: str = "host",
+                            group_name: str = "default") -> None:
+    """Driver-side declaration (collective.py:52): have each actor join."""
+    import ray_tpu as rt
+
+    _coordinator_actor(group_name, world_size)
+    with _groups_lock:
+        _declared.add(group_name)
+
+    def _join(actor, rank):
+        return actor._rt_collective_join.remote(world_size, rank, backend,
+                                                group_name)
+
+    rt.get([_join(a, r) for a, r in zip(actors, ranks)])
+
+
+class CollectiveActorMixin:
+    """Mix into an actor class to make it joinable via
+    ``create_collective_group`` (driver-declared groups, collective.py:52)."""
+
+    def _rt_collective_join(self, world_size: int, rank: int, backend: str,
+                            group_name: str) -> bool:
+        init_collective_group(world_size, rank, backend, group_name)
+        return True
+
+
+def is_group_initialized(group_name: str = "default") -> bool:
+    with _groups_lock:
+        return group_name in _groups
+
+
+def get_group_info(group_name: str = "default") -> dict:
+    g = _require(group_name)
+    return {"world_size": g.world_size, "rank": g.rank, "name": g.name}
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    import ray_tpu as rt
+
+    with _groups_lock:
+        g = _groups.pop(group_name, None)
+        declared = group_name in _declared
+        _declared.discard(group_name)
+    # The detached coordinator must die with the group or a later group
+    # reusing the name silently inherits the old world_size via
+    # get_if_exists. Rank 0 kills it; so does the declaring driver (which
+    # never joined and has no rank).
+    if (g is not None and g.rank == 0) or (g is None and declared):
+        try:
+            actor = rt.get_actor(_COORD_PREFIX + group_name)
+            rt.kill(actor)
+        except ValueError:
+            pass
+
+
+def _require(group_name: str) -> _GroupHandle:
+    with _groups_lock:
+        g = _groups.get(group_name)
+    if g is None:
+        raise RuntimeError(
+            f"collective group {group_name!r} is not initialized in this "
+            "process; call init_collective_group() first"
+        )
+    return g
+
+
+def _to_host(tensor):
+    """Move a jax.Array / torch tensor / array-like to host numpy."""
+    t = type(tensor)
+    if t.__module__.startswith("torch"):
+        return tensor.detach().cpu().numpy()
+    return np.asarray(tensor)
+
+
+def _tree_to_host(x):
+    if isinstance(x, dict):
+        return {k: _tree_to_host(v) for k, v in x.items()}
+    if isinstance(x, (list, tuple)):
+        return type(x)(_tree_to_host(v) for v in x)
+    return _to_host(x)
+
+
+def allreduce(tensor, group_name: str = "default", op=ReduceOp.SUM):
+    """Host allreduce (collective.py:258). Pytrees of arrays supported.
+
+    For on-device tensors inside a training step, use ``jax.lax.psum`` over
+    the mesh axis instead — this call is for out-of-jit host data.
+    """
+    import ray_tpu as rt
+
+    g = _require(group_name)
+    seq = g.next_seq()
+    return rt.get(g.coordinator.contribute.remote(
+        "allreduce", seq, g.rank, _tree_to_host(tensor), {"op": op}))
+
+
+def allgather(tensor, group_name: str = "default") -> List[Any]:
+    """Gather every rank's tensor, ordered by rank (collective.py:423)."""
+    import ray_tpu as rt
+
+    g = _require(group_name)
+    seq = g.next_seq()
+    return rt.get(g.coordinator.contribute.remote(
+        "allgather", seq, g.rank, _tree_to_host(tensor)))
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    """Broadcast from src_rank to all (collective.py:373)."""
+    import ray_tpu as rt
+
+    g = _require(group_name)
+    seq = g.next_seq()
+    payload = _tree_to_host(tensor) if g.rank == src_rank else None
+    return rt.get(g.coordinator.contribute.remote(
+        "broadcast", seq, g.rank, payload, {"root": src_rank}))
+
+
+def reducescatter(tensor_list: List[Any], group_name: str = "default",
+                  op=ReduceOp.SUM):
+    """Reduce chunk r over all ranks → rank r (collective.py:472)."""
+    import ray_tpu as rt
+
+    g = _require(group_name)
+    if len(tensor_list) != g.world_size:
+        raise ValueError(
+            f"reducescatter needs world_size={g.world_size} chunks, got "
+            f"{len(tensor_list)}")
+    seq = g.next_seq()
+    return rt.get(g.coordinator.contribute.remote(
+        "reducescatter", seq, g.rank, [_tree_to_host(t) for t in tensor_list],
+        {"op": op}))
+
+
+def barrier(group_name: str = "default") -> None:
+    import ray_tpu as rt
+
+    g = _require(group_name)
+    seq = g.next_seq()
+    rt.get(g.coordinator.contribute.remote("barrier", seq, g.rank, None))
+
+
+def send(tensor, dst_rank: int, group_name: str = "default",
+         tag: int = 0) -> None:
+    """P2P send (collective.py:531)."""
+    import ray_tpu as rt
+
+    g = _require(group_name)
+    rt.get(g.coordinator.post.remote(g.rank, dst_rank, tag,
+                                     _tree_to_host(tensor)))
+
+
+def recv(src_rank: int, group_name: str = "default", tag: int = 0):
+    """P2P recv (collective.py:594)."""
+    import ray_tpu as rt
+
+    g = _require(group_name)
+    return rt.get(g.coordinator.fetch.remote(src_rank, g.rank, tag))

@@ -1,0 +1,106 @@
+"""Collective API tests — mirrors ray python/ray/util/collective tests:
+group init bookkeeping, allreduce/allgather/broadcast/reducescatter/
+send-recv semantics across an actor gang."""
+
+import numpy as np
+import pytest
+
+import ray_tpu as rt
+from ray_tpu.util.collective import CollectiveActorMixin
+
+
+@rt.remote
+class Rank(CollectiveActorMixin):
+    def __init__(self, rank):
+        self.rank = rank
+
+    def do_allreduce(self, value):
+        from ray_tpu.util import collective as col
+
+        return col.allreduce(np.array([float(value)]))
+
+    def do_allgather(self):
+        from ray_tpu.util import collective as col
+
+        return col.allgather({"r": np.array([self.rank])})
+
+    def do_broadcast(self, value=None):
+        from ray_tpu.util import collective as col
+
+        return col.broadcast(value, src_rank=0)
+
+    def do_reducescatter(self, chunks):
+        from ray_tpu.util import collective as col
+
+        return col.reducescatter([np.array([float(c)]) for c in chunks])
+
+    def do_sendrecv(self, world_size):
+        from ray_tpu.util import collective as col
+
+        nxt = (self.rank + 1) % world_size
+        prev = (self.rank - 1) % world_size
+        col.send(np.array([self.rank]), nxt, tag=7)
+        got = col.recv(prev, tag=7)
+        return int(got[0])
+
+    def info(self):
+        from ray_tpu.util import collective as col
+
+        return col.get_group_info()
+
+
+@pytest.fixture
+def gang(ray_start_regular):
+    from ray_tpu.util import collective as col
+
+    world = 3
+    actors = [Rank.remote(i) for i in range(world)]
+    col.create_collective_group(actors, world, list(range(world)))
+    yield actors, world
+    col.destroy_collective_group()
+
+
+def test_group_info(gang):
+    actors, world = gang
+    infos = rt.get([a.info.remote() for a in actors])
+    assert sorted(i["rank"] for i in infos) == list(range(world))
+    assert all(i["world_size"] == world for i in infos)
+
+
+def test_allreduce_sum(gang):
+    actors, world = gang
+    outs = rt.get([a.do_allreduce.remote(i + 1) for i, a in enumerate(actors)])
+    for o in outs:
+        assert float(o[0]) == sum(range(1, world + 1))
+
+
+def test_allgather_pytree(gang):
+    actors, world = gang
+    outs = rt.get([a.do_allgather.remote() for a in actors])
+    for o in outs:
+        assert [int(x["r"][0]) for x in o] == list(range(world))
+
+
+def test_broadcast(gang):
+    actors, _ = gang
+    calls = [actors[0].do_broadcast.remote(np.array([42.0]))]
+    calls += [a.do_broadcast.remote() for a in actors[1:]]
+    outs = rt.get(calls)
+    assert all(float(o[0]) == 42.0 for o in outs)
+
+
+def test_reducescatter(gang):
+    actors, world = gang
+    # Every rank contributes chunks [10, 20, 30]; rank r gets sum of chunk r.
+    outs = rt.get([a.do_reducescatter.remote([10, 20, 30]) for a in actors])
+    infos = rt.get([a.info.remote() for a in actors])
+    for o, i in zip(outs, infos):
+        assert float(o[0]) == [10, 20, 30][i["rank"]] * world
+
+
+def test_send_recv_ring(gang):
+    actors, world = gang
+    outs = rt.get([a.do_sendrecv.remote(world) for a in actors])
+    infos = rt.get([a.info.remote() for a in actors])
+    for o, i in zip(outs, infos):
+        assert o == (i["rank"] - 1) % world
