@@ -1,0 +1,21 @@
+"""AIR common config/result types shared by train and tune.
+
+Reference counterpart: ray python/ray/air/config.py (ScalingConfig,
+RunConfig, FailureConfig, CheckpointConfig) and air/result.py (Result).
+"""
+
+from ray_tpu.air.config import (  # noqa: F401
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.air.result import Result  # noqa: F401
+
+__all__ = [
+    "CheckpointConfig",
+    "FailureConfig",
+    "RunConfig",
+    "ScalingConfig",
+    "Result",
+]

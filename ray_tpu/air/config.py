@@ -1,0 +1,120 @@
+"""Run/scaling/failure/checkpoint configuration dataclasses.
+
+Reference: ray python/ray/air/config.py — ScalingConfig (resource math for
+the worker gang), RunConfig (name/storage/failure/checkpoint), FailureConfig
+(max_failures), CheckpointConfig (num_to_keep / checkpoint_score_attribute).
+
+TPU twist: ScalingConfig understands a `topology` gang (e.g. "v5p-16") in
+addition to per-worker resources — a topology claim becomes a single
+placement-group bundle carrying the slice's gang resource, mirroring the
+reference's TPU pod resources (ray python/ray/_private/accelerators/tpu.py:75).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, Optional
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    """How many workers, with what resources each.
+
+    num_workers: size of the SPMD gang (one process per host in multi-host).
+    use_tpu: give each worker the node's TPU resource.
+    resources_per_worker: extra custom resources per worker.
+    placement_strategy: PACK | SPREAD | STRICT_PACK | STRICT_SPREAD.
+    topology: optional TPU slice topology string (gang resource name).
+    """
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    trainer_resources: Optional[Dict[str, float]] = None
+    resources_per_worker: Optional[Dict[str, float]] = None
+    placement_strategy: str = "PACK"
+    topology: Optional[str] = None
+
+    def __post_init__(self):
+        if self.num_workers <= 0:
+            raise ValueError("num_workers must be positive")
+
+    @property
+    def _resources_per_worker_not_none(self) -> Dict[str, float]:
+        res = dict(self.resources_per_worker or {})
+        if "CPU" not in res:
+            res["CPU"] = 1.0
+        if self.use_tpu and "TPU" not in res:
+            res["TPU"] = 1.0
+        if self.topology:
+            res[f"TPU-{self.topology}-head"] = res.get(
+                f"TPU-{self.topology}-head", 0.0
+            )
+        return res
+
+    def as_placement_group_factory(self):
+        """Bundle list for the worker gang (+ optional trainer bundle)."""
+        bundles = [dict(self._resources_per_worker_not_none)
+                   for _ in range(self.num_workers)]
+        if self.trainer_resources:
+            bundles = [dict(self.trainer_resources)] + bundles
+        return bundles
+
+    @property
+    def total_resources(self) -> Dict[str, float]:
+        out: Dict[str, float] = dict(self.trainer_resources or {})
+        for _ in range(self.num_workers):
+            for k, v in self._resources_per_worker_not_none.items():
+                out[k] = out.get(k, 0.0) + v
+        return out
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    """max_failures: retries of the whole run from the latest checkpoint.
+    0 = no retries; -1 = infinite. (air/config.py FailureConfig)."""
+
+    max_failures: int = 0
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    """num_to_keep: keep only the best/most recent N checkpoints;
+    checkpoint_score_attribute/order select "best"."""
+
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+    checkpoint_frequency: int = 0
+    checkpoint_at_end: Optional[bool] = None
+
+    def __post_init__(self):
+        if self.num_to_keep is not None and self.num_to_keep <= 0:
+            raise ValueError("num_to_keep must be positive or None")
+        if self.checkpoint_score_order not in ("max", "min"):
+            raise ValueError("checkpoint_score_order must be 'max' or 'min'")
+
+
+@dataclasses.dataclass
+class RunConfig:
+    """Experiment-level config: name, storage root, FT, checkpointing."""
+
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: Optional[FailureConfig] = None
+    checkpoint_config: Optional[CheckpointConfig] = None
+    stop: Optional[Any] = None
+    verbose: int = 1
+    log_to_file: bool = False
+    callbacks: Optional[list] = None
+
+    def __post_init__(self):
+        if self.storage_path is None:
+            self.storage_path = os.environ.get(
+                "RAY_TPU_STORAGE_PATH",
+                os.path.expanduser("~/ray_tpu_results"),
+            )
+        if self.failure_config is None:
+            self.failure_config = FailureConfig()
+        if self.checkpoint_config is None:
+            self.checkpoint_config = CheckpointConfig()

@@ -1,0 +1,55 @@
+"""Result of a training/tuning run (reference: ray python/ray/air/result.py)."""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+
+@dataclasses.dataclass
+class Result:
+    metrics: Optional[Dict[str, Any]]
+    checkpoint: Optional[Any]  # train.Checkpoint
+    path: Optional[str] = None
+    error: Optional[Exception] = None
+    metrics_dataframe: Optional[Any] = None
+    best_checkpoints: Optional[List[Tuple[Any, Dict[str, Any]]]] = None
+
+    @property
+    def config(self) -> Optional[Dict[str, Any]]:
+        if self.metrics is None:
+            return None
+        return self.metrics.get("config")
+
+    def get_best_checkpoint(self, metric: str, mode: str = "max"):
+        if not self.best_checkpoints:
+            return None
+        sign = 1 if mode == "max" else -1
+        best = max(
+            (bc for bc in self.best_checkpoints if metric in bc[1]),
+            key=lambda bc: sign * bc[1][metric],
+            default=None,
+        )
+        return best[0] if best else None
+
+    @classmethod
+    def from_path(cls, path: str) -> "Result":
+        """Reload a Result from a run directory written by _StorageContext."""
+        from ray_tpu.train.checkpoint import Checkpoint
+
+        result_json = os.path.join(path, "result.json")
+        metrics = None
+        if os.path.exists(result_json):
+            with open(result_json) as f:
+                lines = [ln for ln in f.read().splitlines() if ln.strip()]
+            if lines:
+                metrics = json.loads(lines[-1])
+        ckpts = sorted(
+            d for d in os.listdir(path) if d.startswith("checkpoint_")
+        ) if os.path.isdir(path) else []
+        checkpoint = (
+            Checkpoint(os.path.join(path, ckpts[-1])) if ckpts else None
+        )
+        return cls(metrics=metrics, checkpoint=checkpoint, path=path)

@@ -1,0 +1,63 @@
+"""Streaming dataset library.
+
+Reference counterpart: Ray Data (ray: python/ray/data — Dataset dataset.py,
+read_api.py, streaming executor _internal/execution/streaming_executor.py:48)
+rebuilt on ray_tpu tasks + streaming generators, with iter_jax_batches
+landing sharded global batches directly on the TPU mesh.
+"""
+
+from ray_tpu.data.block import Block, BlockAccessor  # noqa: F401
+from ray_tpu.data.dataset import Dataset, MaterializedDataset  # noqa: F401
+from ray_tpu.data.grouped_data import (  # noqa: F401
+    AggregateFn,
+    Count,
+    Max,
+    Mean,
+    Min,
+    Sum,
+)
+from ray_tpu.data.read_api import (  # noqa: F401
+    from_arrow,
+    from_huggingface,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,
+    read_binary_files,
+    read_csv,
+    read_datasource,
+    read_images,
+    read_json,
+    read_numpy,
+    read_parquet,
+    read_text,
+    read_tfrecords,
+)
+
+__all__ = [
+    "AggregateFn",
+    "Block",
+    "BlockAccessor",
+    "Count",
+    "Dataset",
+    "MaterializedDataset",
+    "Max",
+    "Mean",
+    "Min",
+    "Sum",
+    "from_arrow",
+    "from_huggingface",
+    "from_items",
+    "from_numpy",
+    "from_pandas",
+    "range",
+    "read_binary_files",
+    "read_csv",
+    "read_datasource",
+    "read_images",
+    "read_json",
+    "read_numpy",
+    "read_parquet",
+    "read_text",
+    "read_tfrecords",
+]

@@ -1,0 +1,232 @@
+"""Streaming execution of a data plan over ray_tpu tasks.
+
+Reference: ray python/ray/data/_internal/execution/streaming_executor.py:48 —
+a pull-based pipeline where map stages run as tasks with bounded in-flight
+concurrency (backpressure via the concurrency cap,
+backpressure_policy/concurrency_cap_backpressure_policy.py), and all-to-all
+stages (shuffle/sort/repartition) materialize as barriers
+(_internal/planner/exchange/).
+
+Map-like stage fusion happens at plan level (Plan.fused_stages), so a
+read→map_batches→filter chain is one task per block, not three. Read tasks
+run as streaming-generator tasks (num_returns="streaming"), so a read that
+produces many blocks yields them to downstream stages as they materialize.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Iterator, List
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data._internal.plan import Operator, Plan
+from ray_tpu.data.block import Block, BlockAccessor
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_MAX_IN_FLIGHT = 16
+
+
+# -- per-block stage application (runs inside a task) ------------------------
+
+def _apply_map_ops(block: Block, ops: List[Operator]) -> Block:
+    for op in ops:
+        acc = BlockAccessor.for_block(block)
+        if op.kind == "map_batches":
+            fmt = op.options.get("batch_format", "numpy")
+            bsz = op.options.get("batch_size")
+            if bsz is None or acc.num_rows() <= bsz:
+                out = op.fn(acc.to_batch(fmt))
+                block = BlockAccessor.batch_to_block(out)
+            else:
+                pieces = []
+                for s in range(0, acc.num_rows(), bsz):
+                    piece = BlockAccessor.for_block(
+                        acc.slice(s, min(s + bsz, acc.num_rows())))
+                    pieces.append(BlockAccessor.batch_to_block(
+                        op.fn(piece.to_batch(fmt))))
+                block = BlockAccessor.concat(pieces)
+        elif op.kind == "map_rows":
+            block = BlockAccessor.rows_to_block(
+                [op.fn(r) for r in acc.iter_rows()])
+        elif op.kind == "flat_map":
+            out_rows: List[dict] = []
+            for r in acc.iter_rows():
+                out_rows.extend(op.fn(r))
+            block = BlockAccessor.rows_to_block(out_rows)
+        elif op.kind == "filter":
+            block = BlockAccessor.rows_to_block(
+                [r for r in acc.iter_rows() if op.fn(r)])
+        elif op.kind == "write":
+            op.fn(block, **op.options)
+            block = BlockAccessor.rows_to_block(
+                [{"num_rows": acc.num_rows()}])
+        else:
+            raise ValueError(f"not a map-like op: {op.kind}")
+    return block
+
+
+def _run_read_task(read_task: Callable, ops: List[Operator]):
+    """Streaming-generator task: yields one block at a time."""
+    blocks = read_task()
+    if not isinstance(blocks, (list, tuple)):
+        blocks = [blocks]
+    for b in blocks:
+        yield _apply_map_ops(b, ops) if ops else b
+
+
+def execute_refs(plan: Plan, *, max_in_flight: int = DEFAULT_MAX_IN_FLIGHT
+                 ) -> Iterator[Any]:
+    """Yield ObjectRefs to output blocks (order-preserving, streaming)."""
+    stages = plan.fused_stages()
+    run_read = ray_tpu.remote(_run_read_task).options(
+        num_returns="streaming")
+    run_ops = ray_tpu.remote(_apply_map_ops)
+
+    # Stage 0: read with fused leading map ops.
+    rest_stages = list(stages)
+    first_maps: List[Operator] = []
+    if rest_stages and rest_stages[0][0].is_map_like:
+        first_maps = rest_stages.pop(0)
+
+    def read_stream() -> Iterator[Any]:
+        gens: List[Any] = []
+        for rt in plan.read_tasks:
+            while len(gens) >= max_in_flight:
+                yield from _drain_generator(gens.pop(0))
+            gens.append(run_read.remote(rt, first_maps))
+        for g in gens:
+            yield from _drain_generator(g)
+
+    def _drain_generator(gen) -> Iterator[Any]:
+        for item_ref in gen:
+            yield item_ref
+
+    stream: Iterator[Any] = read_stream()
+
+    for stage in rest_stages:
+        op = stage[0]
+        if op.is_map_like:
+            stream = _map_stage(stream, stage, run_ops, max_in_flight)
+        elif op.kind == "limit":
+            stream = _limit_stage(stream, op.options["n"])
+        elif op.kind == "repartition":
+            stream = _repartition_stage(stream, op.options["num_blocks"])
+        elif op.kind == "random_shuffle":
+            stream = _shuffle_stage(stream, op.options.get("seed"))
+        elif op.kind == "sort":
+            stream = _sort_stage(stream, op.options["key"],
+                                 op.options.get("descending", False))
+        elif op.kind == "union":
+            others = op.options["other_plans"]
+            stream = _chain(stream, *(
+                execute_refs(p, max_in_flight=max_in_flight) for p in others))
+        elif op.kind == "zip":
+            other = op.options["other_plan"]
+            stream = _zip_stage(
+                stream, execute_refs(other, max_in_flight=max_in_flight))
+        else:
+            raise ValueError(f"unknown operator {op.kind}")
+    yield from stream
+
+
+def execute_streaming(plan: Plan, *,
+                      max_in_flight: int = DEFAULT_MAX_IN_FLIGHT
+                      ) -> Iterator[Block]:
+    """Yield materialized output blocks in order, streaming through stages."""
+    for ref in execute_refs(plan, max_in_flight=max_in_flight):
+        yield ray_tpu.get(ref)
+
+
+def _chain(*its):
+    for it in its:
+        yield from it
+
+
+def _map_stage(stream, ops: List[Operator], run_ops, max_in_flight):
+    in_flight: List[Any] = []
+    for ref in stream:
+        if len(in_flight) >= max_in_flight:
+            yield in_flight.pop(0)  # preserve order: emit the oldest
+        in_flight.append(run_ops.remote(ref, ops))
+    yield from in_flight
+
+
+def _limit_stage(stream, n: int):
+    remaining = n
+    for ref in stream:
+        if remaining <= 0:
+            return
+        block = ray_tpu.get(ref)
+        acc = BlockAccessor.for_block(block)
+        if acc.num_rows() <= remaining:
+            remaining -= acc.num_rows()
+            yield ref
+        else:
+            yield ray_tpu.put(acc.slice(0, remaining))
+            return
+
+
+def _materialize(stream) -> List[Block]:
+    return [ray_tpu.get(r) for r in stream]
+
+
+def _repartition_stage(stream, num_blocks: int):
+    big = BlockAccessor.concat(_materialize(stream))
+    n = big.num_rows
+    if n == 0:
+        yield ray_tpu.put(big)
+        return
+    acc = BlockAccessor.for_block(big)
+    per = max(1, n // num_blocks)
+    bounds = [min(i * per, n) for i in range(num_blocks)] + [n]
+    for i in range(num_blocks):
+        yield ray_tpu.put(acc.slice(bounds[i], bounds[i + 1]))
+
+
+def _shuffle_stage(stream, seed):
+    blocks = _materialize(stream)
+    big = BlockAccessor.concat(blocks)
+    if big.num_rows == 0:
+        yield ray_tpu.put(big)
+        return
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(big.num_rows)
+    shuffled = BlockAccessor.for_block(big).take_indices(perm)
+    n_out = max(1, len(blocks))
+    acc = BlockAccessor.for_block(shuffled)
+    per = max(1, shuffled.num_rows // n_out)
+    for i in range(n_out):
+        start = i * per
+        end = shuffled.num_rows if i == n_out - 1 else (i + 1) * per
+        if start < shuffled.num_rows:
+            yield ray_tpu.put(acc.slice(start, end))
+
+
+def _sort_stage(stream, key, descending: bool):
+    big = BlockAccessor.concat(_materialize(stream))
+    if big.num_rows == 0:
+        yield ray_tpu.put(big)
+        return
+    order = "descending" if descending else "ascending"
+    keys = [(key, order)] if isinstance(key, str) else [
+        (k, order) for k in key]
+    yield ray_tpu.put(big.sort_by(keys))
+
+
+def _zip_stage(stream, other_stream):
+    import pyarrow as pa
+
+    left = BlockAccessor.concat(_materialize(stream))
+    right = BlockAccessor.concat(_materialize(other_stream))
+    if left.num_rows != right.num_rows:
+        raise ValueError(
+            f"zip requires equal row counts: {left.num_rows} vs "
+            f"{right.num_rows}")
+    cols = {name: left.column(name) for name in left.column_names}
+    for name in right.column_names:
+        out_name = name if name not in cols else f"{name}_1"
+        cols[out_name] = right.column(name)
+    yield ray_tpu.put(pa.table(cols))

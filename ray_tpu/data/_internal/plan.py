@@ -1,0 +1,45 @@
+"""Logical plan: a chain of operators over read tasks (reference: ray
+python/ray/data/_internal/logical/ — LogicalPlan of operators, optimized and
+lowered to physical operators; here one representation serves both roles,
+with fusion of adjacent map-like stages as the one optimizer rule that
+matters for task-launch overhead)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+@dataclasses.dataclass
+class Operator:
+    kind: str  # read | map_batches | map_rows | flat_map | filter | limit |
+    #            repartition | random_shuffle | sort | union | zip | write
+    fn: Optional[Callable] = None
+    options: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    MAP_KINDS = ("map_batches", "map_rows", "flat_map", "filter", "write")
+
+    @property
+    def is_map_like(self) -> bool:
+        return self.kind in self.MAP_KINDS
+
+
+@dataclasses.dataclass
+class Plan:
+    read_tasks: List[Callable]  # each -> list[Block]
+    operators: List[Operator]
+    # Datasets produced by union/zip hold the other plans here:
+    other_plans: List["Plan"] = dataclasses.field(default_factory=list)
+
+    def with_operator(self, op: Operator) -> "Plan":
+        return Plan(self.read_tasks, self.operators + [op], self.other_plans)
+
+    def fused_stages(self) -> List[List[Operator]]:
+        """Group consecutive map-like operators into single task stages."""
+        stages: List[List[Operator]] = []
+        for op in self.operators:
+            if op.is_map_like and stages and stages[-1][-1].is_map_like:
+                stages[-1].append(op)
+            else:
+                stages.append([op])
+        return stages

@@ -1,0 +1,119 @@
+"""Block: the unit of data movement (reference: ray python/ray/data/block.py
+— a block is a pyarrow.Table in the object store; BlockAccessor provides
+row/batch views and builders).
+
+Batch formats: "numpy" (dict[str, np.ndarray], the default handed to
+map_batches), "pandas", "pyarrow". TPU-native addition: "jax" device-puts
+the numpy batch (used by iter_jax_batches with an optional NamedSharding).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional, Union
+
+import numpy as np
+import pyarrow as pa
+
+Block = pa.Table
+BatchType = Union[Dict[str, np.ndarray], "pa.Table", Any]
+
+
+def _column_to_numpy(col: pa.ChunkedArray) -> np.ndarray:
+    try:
+        return col.combine_chunks().to_numpy(zero_copy_only=False)
+    except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
+        return np.array(col.to_pylist(), dtype=object)
+
+
+class BlockAccessor:
+    def __init__(self, block: Block):
+        self._table = block
+
+    @staticmethod
+    def for_block(block: Block) -> "BlockAccessor":
+        if not isinstance(block, pa.Table):
+            raise TypeError(f"blocks are pyarrow Tables, got {type(block)}")
+        return BlockAccessor(block)
+
+    @staticmethod
+    def batch_to_block(batch: BatchType) -> Block:
+        if isinstance(batch, pa.Table):
+            return batch
+        if isinstance(batch, dict):
+            cols = {}
+            for k, v in batch.items():
+                v = np.asarray(v)
+                if v.ndim > 1:  # tensor column: one list entry per row
+                    cols[k] = pa.array(list(v))
+                else:
+                    cols[k] = pa.array(v)
+            return pa.table(cols)
+        try:
+            import pandas as pd
+
+            if isinstance(batch, pd.DataFrame):
+                return pa.Table.from_pandas(batch, preserve_index=False)
+        except ImportError:
+            pass
+        raise TypeError(
+            f"map_batches must return dict[str, ndarray] / pyarrow.Table / "
+            f"pandas.DataFrame, got {type(batch)}")
+
+    @staticmethod
+    def rows_to_block(rows: List[Dict[str, Any]]) -> Block:
+        if not rows:
+            return pa.table({})
+        return pa.Table.from_pylist(rows)
+
+    # -- views ---------------------------------------------------------------
+
+    def num_rows(self) -> int:
+        return self._table.num_rows
+
+    def size_bytes(self) -> int:
+        return self._table.nbytes
+
+    def schema(self):
+        return self._table.schema
+
+    def to_arrow(self) -> pa.Table:
+        return self._table
+
+    def to_pandas(self):
+        return self._table.to_pandas()
+
+    def to_numpy_batch(self) -> Dict[str, np.ndarray]:
+        out = {}
+        for name in self._table.column_names:
+            col = _column_to_numpy(self._table.column(name))
+            if col.dtype == object and len(col) and isinstance(
+                    col[0], np.ndarray):
+                col = np.stack(col)
+            out[name] = col
+        return out
+
+    def to_batch(self, batch_format: str) -> BatchType:
+        if batch_format == "numpy":
+            return self.to_numpy_batch()
+        if batch_format == "pandas":
+            return self.to_pandas()
+        if batch_format in ("pyarrow", "arrow"):
+            return self._table
+        raise ValueError(f"unknown batch_format {batch_format!r}")
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        for row in self._table.to_pylist():
+            yield row
+
+    def slice(self, start: int, end: int) -> Block:
+        return self._table.slice(start, end - start)
+
+    def take_indices(self, indices: np.ndarray) -> Block:
+        return self._table.take(pa.array(indices))
+
+    @staticmethod
+    def concat(blocks: List[Block]) -> Block:
+        blocks = [b for b in blocks if b.num_rows > 0]
+        if not blocks:
+            return pa.table({})
+        return pa.concat_tables(blocks, promote_options="default")

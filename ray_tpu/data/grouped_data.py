@@ -1,0 +1,117 @@
+"""GroupBy + aggregations (reference: ray python/ray/data/grouped_data.py —
+Dataset.groupby(key).count()/sum()/mean()/min()/max()/aggregate()/
+map_groups())."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List
+
+import numpy as np
+
+from ray_tpu.data.block import BlockAccessor
+
+
+class AggregateFn:
+    def __init__(self, init: Callable[[], Any],
+                 accumulate: Callable[[Any, np.ndarray], Any],
+                 merge: Callable[[Any, Any], Any],
+                 finalize: Callable[[Any], Any] = lambda a: a,
+                 name: str = "agg"):
+        self.init = init
+        self.accumulate = accumulate
+        self.merge = merge
+        self.finalize = finalize
+        self.name = name
+
+
+def Count() -> AggregateFn:  # noqa: N802 — reference naming
+    return AggregateFn(lambda: 0, lambda a, col: a + len(col),
+                       lambda a, b: a + b, name="count()")
+
+
+def Sum(on: str) -> AggregateFn:  # noqa: N802
+    return AggregateFn(lambda: 0.0, lambda a, col: a + float(np.sum(col)),
+                       lambda a, b: a + b, name=f"sum({on})")
+
+
+def Min(on: str) -> AggregateFn:  # noqa: N802
+    return AggregateFn(lambda: float("inf"),
+                       lambda a, col: min(a, float(np.min(col))),
+                       min, name=f"min({on})")
+
+
+def Max(on: str) -> AggregateFn:  # noqa: N802
+    return AggregateFn(lambda: float("-inf"),
+                       lambda a, col: max(a, float(np.max(col))),
+                       max, name=f"max({on})")
+
+
+def Mean(on: str) -> AggregateFn:  # noqa: N802
+    return AggregateFn(
+        lambda: (0.0, 0),
+        lambda a, col: (a[0] + float(np.sum(col)), a[1] + len(col)),
+        lambda a, b: (a[0] + b[0], a[1] + b[1]),
+        lambda a: a[0] / a[1] if a[1] else None,
+        name=f"mean({on})")
+
+
+class GroupedData:
+    def __init__(self, dataset, key: str):
+        self._ds = dataset
+        self._key = key
+
+    def _groups(self) -> Dict[Any, List]:
+        """key -> list of row dicts (hash-based grouping on the driver after
+        a distributed map; fine for aggregate-sized outputs)."""
+        groups: Dict[Any, List] = {}
+        for row in self._ds.iter_rows():
+            groups.setdefault(row[self._key], []).append(row)
+        return groups
+
+    def _aggregate_on(self, aggs: List[tuple]) -> "Any":
+        from ray_tpu.data.dataset import MaterializedDataset
+
+        out_rows = []
+        for key_val, rows in sorted(self._groups().items(),
+                                    key=lambda kv: str(kv[0])):
+            row_out = {self._key: key_val}
+            for on, agg in aggs:
+                acc = agg.init()
+                col = np.array([r[on] for r in rows]) if on else \
+                    np.empty(len(rows))
+                acc = agg.accumulate(acc, col)
+                row_out[agg.name] = agg.finalize(acc)
+            out_rows.append(row_out)
+        return MaterializedDataset(
+            [BlockAccessor.rows_to_block(out_rows)])
+
+    def count(self):
+        return self._aggregate_on([(None, Count())])
+
+    def sum(self, on: str):  # noqa: A003
+        return self._aggregate_on([(on, Sum(on))])
+
+    def min(self, on: str):  # noqa: A003
+        return self._aggregate_on([(on, Min(on))])
+
+    def max(self, on: str):  # noqa: A003
+        return self._aggregate_on([(on, Max(on))])
+
+    def mean(self, on: str):
+        return self._aggregate_on([(on, Mean(on))])
+
+    def aggregate(self, *aggs: AggregateFn):
+        return self._aggregate_on([(getattr(a, "_on", None), a)
+                                   for a in aggs])
+
+    def map_groups(self, fn: Callable):
+        from ray_tpu.data.dataset import MaterializedDataset
+
+        out_blocks = []
+        for _key_val, rows in sorted(self._groups().items(),
+                                     key=lambda kv: str(kv[0])):
+            batch = BlockAccessor.for_block(
+                BlockAccessor.rows_to_block(rows)).to_numpy_batch()
+            result = fn(batch)
+            out_blocks.append(BlockAccessor.batch_to_block(result))
+        return MaterializedDataset(out_blocks)

@@ -1,0 +1,244 @@
+"""Dataset creation (reference: ray python/ray/data/read_api.py — range:
+from_items, read_parquet:634, read_csv:1227, read_json:1086, read_text:1393,
+read_numpy:1611, read_binary_files:1963, from_pandas, from_numpy,
+from_huggingface:2712, read_datasource:335).
+
+Each reader builds read tasks (one per file / partition) that run as
+streaming-generator tasks in workers.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from ray_tpu.data._internal.plan import Plan
+from ray_tpu.data.block import BlockAccessor
+from ray_tpu.data.dataset import Dataset
+
+DEFAULT_ROWS_PER_BLOCK = 1000
+
+
+def _expand_paths(paths, suffix: Optional[str] = None) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            pattern = os.path.join(p, "**", f"*{suffix or ''}")
+            out.extend(sorted(
+                f for f in _glob.glob(pattern, recursive=True)
+                if os.path.isfile(f)))
+        elif any(c in p for c in "*?["):
+            out.extend(sorted(_glob.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no files matched {paths}")
+    return out
+
+
+def _plan_from_tasks(tasks: List[Callable]) -> Dataset:
+    return Dataset(Plan(tasks, []))
+
+
+def range(n: int, *, override_num_blocks: Optional[int] = None) -> Dataset:  # noqa: A001
+    import builtins
+
+    blocks = override_num_blocks or max(1, min(32, n // DEFAULT_ROWS_PER_BLOCK or 1))
+    per = (n + blocks - 1) // blocks
+
+    def make_task(start: int, end: int):
+        def read():
+            return [pa.table({"id": np.arange(start, end, dtype=np.int64)})]
+
+        return read
+
+    tasks = [make_task(i * per, min((i + 1) * per, n))
+             for i in builtins.range(blocks) if i * per < n]
+    return _plan_from_tasks(tasks or [lambda: [pa.table({"id": []})]])
+
+
+def from_items(items: List[Any], *,
+               override_num_blocks: Optional[int] = None) -> Dataset:
+    import builtins
+
+    rows = [it if isinstance(it, dict) else {"item": it} for it in items]
+    blocks = override_num_blocks or max(1, min(8, len(rows)))
+    per = (len(rows) + blocks - 1) // blocks
+
+    def make_task(chunk):
+        def read():
+            return [BlockAccessor.rows_to_block(chunk)]
+
+        return read
+
+    tasks = [make_task(rows[i * per:(i + 1) * per])
+             for i in builtins.range(blocks) if rows[i * per:(i + 1) * per]]
+    return _plan_from_tasks(tasks or [lambda: [pa.table({})]])
+
+
+def from_pandas(dfs) -> Dataset:
+    if not isinstance(dfs, list):
+        dfs = [dfs]
+
+    def make_task(df):
+        return lambda: [pa.Table.from_pandas(df, preserve_index=False)]
+
+    return _plan_from_tasks([make_task(df) for df in dfs])
+
+
+def from_numpy(arrays) -> Dataset:
+    if not isinstance(arrays, list):
+        arrays = [arrays]
+
+    def make_task(arr):
+        return lambda: [BlockAccessor.batch_to_block({"data": arr})]
+
+    return _plan_from_tasks([make_task(a) for a in arrays])
+
+
+def from_arrow(tables) -> Dataset:
+    if not isinstance(tables, list):
+        tables = [tables]
+    return _plan_from_tasks([(lambda t=t: [t]) for t in tables])
+
+
+def from_huggingface(hf_dataset) -> Dataset:
+    """An in-memory HF datasets.Dataset → one-shot arrow read."""
+    table = hf_dataset.data.table if hasattr(hf_dataset, "data") else None
+    if table is None:
+        import pandas as pd
+
+        return from_pandas(pd.DataFrame(hf_dataset))
+    return from_arrow(table.combine_chunks())
+
+
+def read_parquet(paths, *, columns: Optional[List[str]] = None,
+                 **_kw) -> Dataset:
+    files = _expand_paths(paths, ".parquet")
+
+    def make_task(path):
+        def read():
+            import pyarrow.parquet as pq
+
+            return [pq.read_table(path, columns=columns)]
+
+        return read
+
+    return _plan_from_tasks([make_task(f) for f in files])
+
+
+def read_csv(paths, **_kw) -> Dataset:
+    files = _expand_paths(paths, ".csv")
+
+    def make_task(path):
+        def read():
+            from pyarrow import csv as pacsv
+
+            return [pacsv.read_csv(path)]
+
+        return read
+
+    return _plan_from_tasks([make_task(f) for f in files])
+
+
+def read_json(paths, **_kw) -> Dataset:
+    files = _expand_paths(paths)
+
+    def make_task(path):
+        def read():
+            from pyarrow import json as pajson
+
+            return [pajson.read_json(path)]
+
+        return read
+
+    return _plan_from_tasks([make_task(f) for f in files])
+
+
+def read_text(paths, **_kw) -> Dataset:
+    files = _expand_paths(paths)
+
+    def make_task(path):
+        def read():
+            with open(path) as f:
+                lines = [ln.rstrip("\n") for ln in f]
+            return [pa.table({"text": lines})]
+
+        return read
+
+    return _plan_from_tasks([make_task(f) for f in files])
+
+
+def read_numpy(paths, **_kw) -> Dataset:
+    files = _expand_paths(paths, ".npy")
+
+    def make_task(path):
+        def read():
+            arr = np.load(path)
+            return [BlockAccessor.batch_to_block({"data": arr})]
+
+        return read
+
+    return _plan_from_tasks([make_task(f) for f in files])
+
+
+def read_binary_files(paths, *, include_paths: bool = False, **_kw) -> Dataset:
+    files = _expand_paths(paths)
+
+    def make_task(path):
+        def read():
+            with open(path, "rb") as f:
+                data = f.read()
+            row: Dict[str, Any] = {"bytes": data}
+            if include_paths:
+                row["path"] = path
+            return [BlockAccessor.rows_to_block([row])]
+
+        return read
+
+    return _plan_from_tasks([make_task(f) for f in files])
+
+
+def read_images(paths, *, size=None, mode: Optional[str] = None,
+                include_paths: bool = False, **_kw) -> Dataset:
+    files = _expand_paths(paths)
+
+    def make_task(path):
+        def read():
+            from PIL import Image
+
+            img = Image.open(path)
+            if mode:
+                img = img.convert(mode)
+            if size:
+                img = img.resize(size)
+            row: Dict[str, Any] = {"image": np.asarray(img)}
+            if include_paths:
+                row["path"] = path
+            return [BlockAccessor.batch_to_block(
+                {k: np.asarray([v]) if k == "image" else np.array([v])
+                 for k, v in row.items()})]
+
+        return read
+
+    return _plan_from_tasks([make_task(f) for f in files])
+
+
+def read_tfrecords(paths, **_kw) -> Dataset:
+    raise NotImplementedError(
+        "read_tfrecords requires tensorflow, which is not bundled; "
+        "read the records with read_binary_files and parse in map_batches")
+
+
+def read_datasource(datasource, *, parallelism: int = -1, **kwargs) -> Dataset:
+    """Custom datasource: an object with get_read_tasks(parallelism) -> list
+    of callables, each returning block(s)."""
+    tasks = datasource.get_read_tasks(
+        parallelism if parallelism > 0 else 8, **kwargs)
+    return _plan_from_tasks(list(tasks))

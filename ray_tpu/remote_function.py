@@ -20,7 +20,11 @@ class RemoteFunction:
     def __init__(self, fn, options: Optional[Dict[str, Any]] = None):
         self._function = fn
         self._options = opts.validate_options(options or {}, is_actor=False)
+        # Export cache is keyed by worker session: module-level remote
+        # functions outlive ray_tpu.init/shutdown cycles, and each new
+        # cluster's GCS needs its own export.
         self._function_id: Optional[str] = None
+        self._exported_session: Optional[bytes] = None
         functools.update_wrapper(self, fn)
 
     def __call__(self, *args, **kwargs):
@@ -33,12 +37,15 @@ class RemoteFunction:
         merged = opts.merge_options(self._options, overrides)
         rf = RemoteFunction(self._function, merged)
         rf._function_id = self._function_id
+        rf._exported_session = self._exported_session
         return rf
 
     def remote(self, *args, **kwargs):
         cw = get_core_worker()
-        if self._function_id is None:
+        session = cw.worker_id.binary()
+        if self._function_id is None or self._exported_session != session:
             self._function_id = cw.register_function(self._function)
+            self._exported_session = session
         o = self._options
         num_returns = o.get("num_returns", 1)
         strategy = to_spec(o.get("scheduling_strategy"), o)

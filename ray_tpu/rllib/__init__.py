@@ -1,0 +1,26 @@
+"""Reinforcement-learning library.
+
+Reference counterpart: RLlib new API stack (ray: rllib/ — Algorithm
+algorithms/algorithm.py:213, AlgorithmConfig algorithm_config.py, EnvRunner
+actors env/single_agent_env_runner.py:124, RLModule core/rl_module/,
+Learner/LearnerGroup core/learner/) rebuilt as JAX: the RLModule is a pure
+params-pytree + apply functions, the Learner's update is one jit with
+donated buffers, and multi-learner data parallelism is a mesh sharding
+(pmap-style) instead of DDP.
+"""
+
+from ray_tpu.rllib.algorithm import Algorithm  # noqa: F401
+from ray_tpu.rllib.algorithm_config import AlgorithmConfig  # noqa: F401
+from ray_tpu.rllib.episode import SingleAgentEpisode  # noqa: F401
+from ray_tpu.rllib.replay_buffer import (  # noqa: F401
+    PrioritizedReplayBuffer,
+    ReplayBuffer,
+)
+
+__all__ = [
+    "Algorithm",
+    "AlgorithmConfig",
+    "PrioritizedReplayBuffer",
+    "ReplayBuffer",
+    "SingleAgentEpisode",
+]

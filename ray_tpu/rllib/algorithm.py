@@ -1,0 +1,81 @@
+"""Algorithm base (reference: ray rllib/algorithms/algorithm.py:213 —
+a Tune Trainable whose step() (:818) runs one training_step and returns a
+result dict; save/restore via checkpoint dirs)."""
+
+from __future__ import annotations
+
+import os
+import pickle
+from collections import deque
+from typing import Any, Dict, Optional
+
+from ray_tpu.rllib.algorithm_config import AlgorithmConfig
+
+
+class Algorithm:
+    def __init__(self, config: AlgorithmConfig):
+        self.config = config
+        self.iteration = 0
+        self._num_env_steps_sampled_lifetime = 0
+        self._episode_returns = deque(maxlen=100)
+        self.setup(config)
+
+    # -- subclass API --------------------------------------------------------
+
+    def setup(self, config: AlgorithmConfig) -> None:
+        raise NotImplementedError
+
+    def training_step(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    # -- Trainable-style API -------------------------------------------------
+
+    def train(self) -> Dict[str, Any]:
+        self.iteration += 1
+        result = self.training_step()
+        result.setdefault("training_iteration", self.iteration)
+        result.setdefault("num_env_steps_sampled_lifetime",
+                          self._num_env_steps_sampled_lifetime)
+        if self._episode_returns:
+            result.setdefault(
+                "episode_return_mean",
+                sum(self._episode_returns) / len(self._episode_returns))
+        return result
+
+    def _record_episodes(self, episodes) -> None:
+        for ep in episodes:
+            self._num_env_steps_sampled_lifetime += len(ep)
+            if ep.is_done:
+                self._episode_returns.append(ep.total_reward)
+
+    def get_state(self) -> Dict[str, Any]:
+        return {"iteration": self.iteration}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.iteration = state.get("iteration", 0)
+
+    def save(self, checkpoint_dir: str) -> str:
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        with open(os.path.join(checkpoint_dir, "algo_state.pkl"), "wb") as f:
+            pickle.dump(self.get_state(), f)
+        return checkpoint_dir
+
+    def restore(self, checkpoint_dir: str) -> None:
+        with open(os.path.join(checkpoint_dir, "algo_state.pkl"), "rb") as f:
+            self.set_state(pickle.load(f))
+
+    def stop(self) -> None:
+        pass
+
+    @staticmethod
+    def _env_spaces(env_id: str, env_config: Optional[dict] = None):
+        """(obs_dim, num_actions) for a discrete-action env."""
+        import gymnasium as gym
+
+        env = gym.make(env_id, **(env_config or {}))
+        try:
+            obs_dim = int(env.observation_space.shape[0])
+            num_actions = int(env.action_space.n)
+        finally:
+            env.close()
+        return obs_dim, num_actions

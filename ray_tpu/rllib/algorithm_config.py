@@ -1,0 +1,99 @@
+"""Config-as-object builder (reference: ray
+rllib/algorithms/algorithm_config.py — AlgorithmConfig with .environment()/
+.env_runners()/.training()/.evaluation() builder methods and .build())."""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, Optional, Type
+
+
+class AlgorithmConfig:
+    def __init__(self, algo_class: Optional[Type] = None):
+        self.algo_class = algo_class
+        # environment
+        self.env: Optional[str] = None
+        self.env_config: Dict[str, Any] = {}
+        # env runners
+        self.num_env_runners: int = 0  # 0 = sample in the driver
+        self.num_envs_per_env_runner: int = 1
+        self.rollout_fragment_length: int = 200
+        # training
+        self.lr: float = 3e-4
+        self.gamma: float = 0.99
+        self.train_batch_size: int = 4000
+        self.minibatch_size: int = 128
+        self.num_epochs: int = 8
+        self.grad_clip: Optional[float] = None
+        self.model: Dict[str, Any] = {"fcnet_hiddens": [64, 64]}
+        # PPO
+        self.lambda_: float = 0.95
+        self.clip_param: float = 0.2
+        self.vf_loss_coeff: float = 0.5
+        self.entropy_coeff: float = 0.0
+        # DQN
+        self.epsilon: list = [(0, 1.0), (10_000, 0.05)]
+        self.target_network_update_freq: int = 500
+        self.replay_buffer_config: Dict[str, Any] = {
+            "type": "ReplayBuffer", "capacity": 50_000}
+        self.num_steps_sampled_before_learning_starts: int = 1000
+        # learners
+        self.num_learners: int = 0
+        # misc
+        self.seed: Optional[int] = None
+        self.explore: bool = True
+
+    # -- builder methods -----------------------------------------------------
+
+    def environment(self, env: Optional[str] = None, *,
+                    env_config: Optional[dict] = None) -> "AlgorithmConfig":
+        if env is not None:
+            self.env = env
+        if env_config is not None:
+            self.env_config = env_config
+        return self
+
+    def env_runners(self, *, num_env_runners: Optional[int] = None,
+                    num_envs_per_env_runner: Optional[int] = None,
+                    rollout_fragment_length: Optional[int] = None,
+                    **_kw) -> "AlgorithmConfig":
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if num_envs_per_env_runner is not None:
+            self.num_envs_per_env_runner = num_envs_per_env_runner
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, **kwargs) -> "AlgorithmConfig":
+        for k, v in kwargs.items():
+            key = "lambda_" if k == "lambda" else k
+            if not hasattr(self, key):
+                raise ValueError(f"unknown training option {k!r}")
+            setattr(self, key, v)
+        return self
+
+    def learners(self, *, num_learners: Optional[int] = None,
+                 **_kw) -> "AlgorithmConfig":
+        if num_learners is not None:
+            self.num_learners = num_learners
+        return self
+
+    def debugging(self, *, seed: Optional[int] = None,
+                  **_kw) -> "AlgorithmConfig":
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    def copy(self) -> "AlgorithmConfig":
+        return copy.deepcopy(self)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {k: v for k, v in self.__dict__.items()
+                if k != "algo_class"}
+
+    def build(self):
+        if self.algo_class is None:
+            raise ValueError(
+                "use PPOConfig()/DQNConfig() or pass algo_class")
+        return self.algo_class(config=self.copy())

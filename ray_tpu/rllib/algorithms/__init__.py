@@ -1,0 +1,4 @@
+from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig  # noqa: F401
+
+__all__ = ["DQN", "DQNConfig", "PPO", "PPOConfig"]

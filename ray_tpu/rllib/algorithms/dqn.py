@@ -1,0 +1,168 @@
+"""DQN (reference: ray rllib/algorithms/dqn/ — epsilon-greedy sampling into
+a (prioritized) replay buffer, double-Q target update, periodic target-net
+sync)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm
+from ray_tpu.rllib.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.learner import JaxLearner
+from ray_tpu.rllib.replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
+
+
+class DQNConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(algo_class=DQN)
+        self.lr = 5e-4
+        self.train_batch_size = 32
+        self.num_steps_per_iteration = 1000
+
+
+class DQNLearner(JaxLearner):
+    def __init__(self, module_spec: Dict[str, Any], config: Dict[str, Any]):
+        from ray_tpu.rllib.rl_module import QModule
+
+        module = QModule(
+            module_spec["obs_dim"], module_spec["num_actions"],
+            module_spec.get("hiddens", (64, 64)))
+        super().__init__(module, config)
+        self.target_params = self.params
+
+    def _build_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        gamma = self.config.get("gamma", 0.99)
+
+        def loss_fn(params, target_params, batch):
+            q = self.module.forward(params, batch["obs"])
+            q_sel = q[jnp.arange(q.shape[0]), batch["actions"]]
+            q_next_online = self.module.forward(params, batch["next_obs"])
+            best = jnp.argmax(q_next_online, axis=-1)
+            q_next = self.module.forward(target_params, batch["next_obs"])
+            q_best = q_next[jnp.arange(q_next.shape[0]), best]
+            target = batch["rewards"] + gamma * q_best * (
+                1.0 - batch["terminateds"])
+            td = q_sel - jax.lax.stop_gradient(target)
+            weights = batch.get("weights", jnp.ones_like(td))
+            loss = jnp.mean(weights * td ** 2)
+            return loss, {"td_error": jnp.abs(td), "qf_mean": jnp.mean(q_sel)}
+
+        def update(params, opt_state, target_params, batch):
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, target_params, batch)
+            updates, opt_state = self.optimizer.update(
+                grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            aux["total_loss"] = loss
+            return params, opt_state, aux
+
+        # Donate opt_state only: params may alias target_params right after
+        # a target sync (donating both args of `f(donate(a), a)` is invalid).
+        return jax.jit(update, donate_argnums=(1,))
+
+    def update_from_batch(self, batch: Dict[str, np.ndarray]
+                          ) -> Dict[str, Any]:
+        self.params, self.opt_state, aux = self._update(
+            self.params, self.opt_state, self.target_params, batch)
+        td = np.asarray(aux.pop("td_error"))
+        out = {k: float(v) for k, v in aux.items()}
+        out["td_error"] = td
+        return out
+
+    def sync_target(self) -> None:
+        self.target_params = self.params
+
+
+class DQN(Algorithm):
+    def setup(self, config: AlgorithmConfig) -> None:
+        from ray_tpu.rllib.env_runner import EnvRunnerGroup
+        from ray_tpu.rllib.rl_module import QModule
+
+        obs_dim, num_actions = self._env_spaces(config.env, config.env_config)
+        self.module_spec = {
+            "obs_dim": obs_dim, "num_actions": num_actions,
+            "hiddens": tuple(config.model.get("fcnet_hiddens", (64, 64))),
+        }
+        cfg = config.to_dict()
+        self.learner = DQNLearner(self.module_spec, cfg)
+        buf_cfg = config.replay_buffer_config
+        buf_cls = PrioritizedReplayBuffer \
+            if buf_cfg.get("type") == "PrioritizedReplayBuffer" \
+            else ReplayBuffer
+        self.buffer = buf_cls(capacity=buf_cfg.get("capacity", 50_000))
+        self._rng = np.random.default_rng(config.seed)
+        import gymnasium as gym
+
+        self.env = gym.make(config.env, **(config.env_config or {}))
+        self._obs, _ = self.env.reset(seed=config.seed)
+        self._ep_return = 0.0
+        self._num_actions = num_actions
+        import jax
+
+        self._q_fwd = jax.jit(self.learner.module.forward)
+        self._steps_since_target_sync = 0
+
+    def _epsilon(self) -> float:
+        sched = self.config.epsilon
+        t = self._num_env_steps_sampled_lifetime
+        (t0, e0), (t1, e1) = sched[0], sched[-1]
+        if t >= t1:
+            return e1
+        frac = (t - t0) / max(1, t1 - t0)
+        return e0 + frac * (e1 - e0)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        metrics: Dict[str, Any] = {}
+        for _ in range(cfg.num_steps_per_iteration):
+            if self._rng.random() < self._epsilon():
+                action = int(self._rng.integers(self._num_actions))
+            else:
+                q = self._q_fwd(
+                    self.learner.params,
+                    self._obs.astype(np.float32)[None, :])
+                action = int(np.argmax(np.asarray(q)[0]))
+            next_obs, reward, term, trunc, _ = self.env.step(action)
+            self.buffer.add({
+                "obs": self._obs.astype(np.float32),
+                "next_obs": np.asarray(next_obs, dtype=np.float32),
+                "actions": np.int32(action),
+                "rewards": np.float32(reward),
+                "terminateds": np.float32(term),
+            })
+            self._num_env_steps_sampled_lifetime += 1
+            self._ep_return += float(reward)
+            if term or trunc:
+                self._episode_returns.append(self._ep_return)
+                self._ep_return = 0.0
+                self._obs, _ = self.env.reset()
+            else:
+                self._obs = next_obs
+
+            if (self._num_env_steps_sampled_lifetime
+                    >= cfg.num_steps_sampled_before_learning_starts
+                    and len(self.buffer) >= cfg.train_batch_size):
+                batch = self.buffer.sample(cfg.train_batch_size)
+                out = self.learner.update_from_batch(batch)
+                td = out.pop("td_error")
+                if isinstance(self.buffer, PrioritizedReplayBuffer):
+                    self.buffer.update_priorities(
+                        batch["batch_indexes"], td)
+                metrics = out
+                self._steps_since_target_sync += 1
+                if (self._steps_since_target_sync
+                        >= cfg.target_network_update_freq):
+                    self.learner.sync_target()
+                    self._steps_since_target_sync = 0
+        metrics["buffer_size"] = len(self.buffer)
+        metrics["epsilon"] = self._epsilon()
+        return metrics
+
+    def stop(self) -> None:
+        self.env.close()

@@ -1,0 +1,168 @@
+"""EnvRunner: samples episodes from gymnasium vector envs.
+
+Reference: ray rllib/env/single_agent_env_runner.py:124 (sample loop over
+gymnasium vector envs with RLModule.forward_exploration) and
+env/env_runner_group.py (the actor group with weight sync). The action
+step is one jit (module forward + categorical sample) so the hot loop is
+env.step + a single device call.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.episode import SingleAgentEpisode
+
+
+def make_env(env_id: str, env_config: Optional[dict] = None):
+    import gymnasium as gym
+
+    return gym.make(env_id, **(env_config or {}))
+
+
+class EnvRunner:
+    """One sampling worker (used inline with num_env_runners=0, or as an
+    actor in an EnvRunnerGroup)."""
+
+    def __init__(self, config: Dict[str, Any], module_spec: Dict[str, Any],
+                 worker_index: int = 0):
+        import gymnasium as gym
+        import jax
+
+        self.config = config
+        self.worker_index = worker_index
+        n_envs = config.get("num_envs_per_env_runner", 1)
+        self.envs = gym.vector.SyncVectorEnv(
+            [partial(make_env, config["env"], config.get("env_config"))
+             for _ in range(n_envs)])
+        self.n_envs = n_envs
+        from ray_tpu.rllib.rl_module import DiscreteActorCriticModule
+
+        self.module = DiscreteActorCriticModule(
+            module_spec["obs_dim"], module_spec["num_actions"],
+            module_spec.get("hiddens", (64, 64)))
+        seed = (config.get("seed") or 0) * 1000 + worker_index
+        self._key = jax.random.PRNGKey(seed)
+        self.params = None
+
+        @jax.jit
+        def _act(params, obs, key):
+            return self.module.forward_exploration(
+                params, {"obs": obs}, key)
+
+        self._act = _act
+
+        @jax.jit
+        def _act_greedy(params, obs):
+            return self.module.forward_inference(params, {"obs": obs})
+
+        self._act_greedy = _act_greedy
+        self._obs, _ = self.envs.reset(seed=seed)
+        self._episodes = [SingleAgentEpisode() for _ in range(n_envs)]
+        for i, ep in enumerate(self._episodes):
+            ep.add_env_reset(self._obs[i])
+
+    def set_weights(self, params) -> None:
+        self.params = params
+
+    def get_weights(self):
+        return self.params
+
+    def sample(self, *, num_steps: Optional[int] = None,
+               explore: bool = True,
+               random_actions: bool = False
+               ) -> List[SingleAgentEpisode]:
+        """Collect num_steps env steps (per vector env slot), returning
+        completed + truncated-in-progress episodes."""
+        import jax
+
+        assert self.params is not None or random_actions, \
+            "set_weights first"
+        num_steps = num_steps or self.config.get(
+            "rollout_fragment_length", 200)
+        done_episodes: List[SingleAgentEpisode] = []
+        for _ in range(num_steps):
+            if random_actions:
+                actions = np.stack([
+                    self.envs.single_action_space.sample()
+                    for _ in range(self.n_envs)])
+                extra: Dict[str, np.ndarray] = {}
+            else:
+                self._key, sub = jax.random.split(self._key)
+                if explore:
+                    out = self._act(self.params,
+                                    self._obs.astype(np.float32), sub)
+                    extra = {"logp": np.asarray(out["logp"]),
+                             "vf_preds": np.asarray(out["vf_preds"])}
+                else:
+                    out = self._act_greedy(
+                        self.params, self._obs.astype(np.float32))
+                    extra = {}
+                actions = np.asarray(out["actions"])
+            next_obs, rewards, terms, truncs, infos = self.envs.step(actions)
+            for i in range(self.n_envs):
+                per_step_extra = {k: v[i] for k, v in extra.items()}
+                self._episodes[i].add_env_step(
+                    next_obs[i], actions[i], rewards[i],
+                    terminated=bool(terms[i]), truncated=bool(truncs[i]),
+                    **per_step_extra)
+                if terms[i] or truncs[i]:
+                    done_episodes.append(self._episodes[i])
+                    self._episodes[i] = SingleAgentEpisode()
+                    self._episodes[i].add_env_reset(next_obs[i])
+            self._obs = next_obs
+        # Hand out in-progress fragments too (truncated at the boundary),
+        # so the learner sees exactly n_envs*num_steps transitions.
+        for i in range(self.n_envs):
+            if len(self._episodes[i]) > 0:
+                frag = self._episodes[i]
+                frag.is_truncated = True
+                done_episodes.append(frag)
+                self._episodes[i] = SingleAgentEpisode()
+                self._episodes[i].add_env_reset(self._obs[i])
+        return done_episodes
+
+    def stop(self) -> None:
+        self.envs.close()
+
+
+class EnvRunnerGroup:
+    """Driver-side handle to N EnvRunner actors (or one inline runner)."""
+
+    def __init__(self, config: Dict[str, Any], module_spec: Dict[str, Any]):
+        self.num_remote = config.get("num_env_runners", 0)
+        if self.num_remote == 0:
+            self.local = EnvRunner(config, module_spec, worker_index=0)
+            self.remotes = []
+        else:
+            self.local = None
+            cls = ray_tpu.remote(EnvRunner)
+            self.remotes = [
+                cls.options(num_cpus=1).remote(config, module_spec, i + 1)
+                for i in range(self.num_remote)]
+
+    def sync_weights(self, params) -> None:
+        if self.local is not None:
+            self.local.set_weights(params)
+        else:
+            ref = ray_tpu.put(params)
+            ray_tpu.get([w.set_weights.remote(ref) for w in self.remotes])
+
+    def sample(self, **kw) -> List[SingleAgentEpisode]:
+        if self.local is not None:
+            return self.local.sample(**kw)
+        out = ray_tpu.get([w.sample.remote(**kw) for w in self.remotes])
+        return [ep for eps in out for ep in eps]
+
+    def stop(self) -> None:
+        if self.local is not None:
+            self.local.stop()
+        for w in self.remotes:
+            try:
+                ray_tpu.kill(w)
+            except Exception:  # noqa: BLE001
+                pass

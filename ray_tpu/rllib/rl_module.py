@@ -1,0 +1,105 @@
+"""RLModule: the policy/value network as a pure JAX params pytree + apply
+functions (reference: ray rllib/core/rl_module/rl_module.py — the
+forward_exploration / forward_inference / forward_train triple; torch
+nn.Module there, functional JAX here so the same apply runs inside the
+EnvRunner's jit action step and the Learner's jit update).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _dense_init(key, in_dim: int, out_dim: int, scale: float = None):
+    kw, _ = jax.random.split(key)
+    scale = scale if scale is not None else float(np.sqrt(2.0 / in_dim))
+    return {
+        "w": jax.random.normal(kw, (in_dim, out_dim)) * scale,
+        "b": jnp.zeros((out_dim,)),
+    }
+
+
+def _dense(p, x):
+    return x @ p["w"] + p["b"]
+
+
+class DiscreteActorCriticModule:
+    """MLP torso + policy logits head + value head (discrete actions)."""
+
+    def __init__(self, obs_dim: int, num_actions: int,
+                 hiddens: Sequence[int] = (64, 64)):
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        self.hiddens = tuple(hiddens)
+
+    def init(self, key) -> Dict[str, Any]:
+        params: Dict[str, Any] = {"torso": []}
+        dims = [self.obs_dim] + list(self.hiddens)
+        keys = jax.random.split(key, len(dims) + 1)
+        for i in range(len(dims) - 1):
+            params["torso"].append(_dense_init(keys[i], dims[i], dims[i + 1]))
+        params["pi"] = _dense_init(keys[-2], dims[-1], self.num_actions,
+                                   scale=0.01)
+        params["vf"] = _dense_init(keys[-1], dims[-1], 1, scale=1.0)
+        return params
+
+    def _torso(self, params, obs):
+        x = obs
+        for layer in params["torso"]:
+            x = jnp.tanh(_dense(layer, x))
+        return x
+
+    def forward(self, params, obs) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """-> (logits [B, A], value [B])"""
+        x = self._torso(params, obs)
+        return _dense(params["pi"], x), _dense(params["vf"], x)[..., 0]
+
+    # -- RLModule API --------------------------------------------------------
+
+    def forward_inference(self, params, batch: Dict[str, jnp.ndarray]):
+        logits, _ = self.forward(params, batch["obs"])
+        return {"actions": jnp.argmax(logits, axis=-1)}
+
+    def forward_exploration(self, params, batch, key):
+        logits, value = self.forward(params, batch["obs"])
+        actions = jax.random.categorical(key, logits)
+        logp = jax.nn.log_softmax(logits)[
+            jnp.arange(logits.shape[0]), actions]
+        return {"actions": actions, "logp": logp, "vf_preds": value}
+
+    def forward_train(self, params, batch):
+        logits, value = self.forward(params, batch["obs"])
+        logp_all = jax.nn.log_softmax(logits)
+        logp = logp_all[jnp.arange(logits.shape[0]), batch["actions"]]
+        entropy = -jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1)
+        return {"logp": logp, "vf_preds": value, "entropy": entropy,
+                "logits": logits}
+
+
+class QModule:
+    """MLP Q-network for DQN (discrete actions)."""
+
+    def __init__(self, obs_dim: int, num_actions: int,
+                 hiddens: Sequence[int] = (64, 64)):
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        self.hiddens = tuple(hiddens)
+
+    def init(self, key) -> Dict[str, Any]:
+        params: Dict[str, Any] = {"layers": []}
+        dims = [self.obs_dim] + list(self.hiddens) + [self.num_actions]
+        keys = jax.random.split(key, len(dims))
+        for i in range(len(dims) - 1):
+            params["layers"].append(_dense_init(keys[i], dims[i], dims[i + 1]))
+        return params
+
+    def forward(self, params, obs) -> jnp.ndarray:
+        x = obs
+        layers = params["layers"]
+        for layer in layers[:-1]:
+            x = jnp.tanh(_dense(layer, x))
+        return _dense(layers[-1], x)

@@ -1,0 +1,43 @@
+"""Model serving library.
+
+Reference counterpart: Ray Serve (ray: python/ray/serve — serve.run
+api.py:544, ServeController _private/controller.py:86, pow-2 router
+_private/replica_scheduler/pow_2_scheduler.py:49, ReplicaActor
+replica.py:231, DeploymentHandle handle.py:714, @serve.batch batching.py:468,
+@serve.multiplexed multiplex.py:22).
+"""
+
+from ray_tpu.serve.api import (  # noqa: F401
+    Application,
+    Deployment,
+    delete,
+    deployment,
+    get_app_handle,
+    get_deployment_handle,
+    run,
+    shutdown,
+    start,
+    status,
+)
+from ray_tpu.serve.batching import batch  # noqa: F401
+from ray_tpu.serve.context import get_multiplexed_model_id  # noqa: F401
+from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse  # noqa: F401
+from ray_tpu.serve.multiplex import multiplexed  # noqa: F401
+
+__all__ = [
+    "Application",
+    "Deployment",
+    "DeploymentHandle",
+    "DeploymentResponse",
+    "batch",
+    "delete",
+    "deployment",
+    "get_app_handle",
+    "get_deployment_handle",
+    "get_multiplexed_model_id",
+    "multiplexed",
+    "run",
+    "shutdown",
+    "start",
+    "status",
+]

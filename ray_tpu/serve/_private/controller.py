@@ -1,0 +1,267 @@
+"""ServeController actor.
+
+Reference: ray python/ray/serve/_private/controller.py:86 — owns target
+state; run_control_loop (:369) reconciles: deployment state machines
+(deployment_state.py:1226,2309) start/stop ReplicaActors toward the target
+replica count, health-check them, and apply autoscaling decisions
+(autoscaling_state.py:262 get_decision_num_replicas over replica queue
+metrics).
+
+The controller is a plain threaded actor: a daemon reconcile thread runs
+~5Hz. Replica gangs per deployment; handles are served to routers from the
+live-replica table.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.serve._private.replica import ReplicaActor
+
+logger = logging.getLogger(__name__)
+
+RECONCILE_INTERVAL_S = 0.2
+HEALTH_CHECK_INTERVAL_S = 2.0
+
+
+class _ReplicaState:
+    def __init__(self, handle, replica_id: str):
+        self.handle = handle
+        self.replica_id = replica_id
+        self.healthy = True
+        self.last_health_check = time.monotonic()
+
+
+class _DeploymentState:
+    def __init__(self, app: str, name: str, config: Dict[str, Any]):
+        self.app = app
+        self.name = name
+        self.config = config
+        self.target_num_replicas = config.get("num_replicas", 1)
+        self.replicas: List[_ReplicaState] = []
+        self.next_replica_idx = 0
+        self.autoscaling = config.get("autoscaling_config")
+        if self.autoscaling:
+            self.target_num_replicas = self.autoscaling.get(
+                "initial_replicas", self.autoscaling.get("min_replicas", 1))
+
+    @property
+    def full_name(self) -> str:
+        return f"{self.app}#{self.name}" if self.app else self.name
+
+
+class ServeController:
+    def __init__(self):
+        self._deployments: Dict[str, _DeploymentState] = {}
+        self._apps: Dict[str, Dict[str, Any]] = {}
+        self._lock = threading.RLock()
+        self._shutdown = threading.Event()
+        self._reconcile_thread = threading.Thread(
+            target=self._run_control_loop, name="serve-controller",
+            daemon=True)
+        self._reconcile_thread.start()
+
+    # -- API called by serve.run / handles ----------------------------------
+
+    def deploy_application(self, app_name: str,
+                           deployments: List[Dict[str, Any]],
+                           ingress: str, route_prefix: str) -> None:
+        with self._lock:
+            self._apps[app_name] = {
+                "ingress": ingress,
+                "route_prefix": route_prefix,
+                "deployments": [d["name"] for d in deployments],
+            }
+            for cfg in deployments:
+                key = f"{app_name}#{cfg['name']}"
+                existing = self._deployments.get(key)
+                if existing is not None:
+                    existing.config = cfg
+                    if not existing.autoscaling:
+                        existing.target_num_replicas = cfg.get(
+                            "num_replicas", 1)
+                    existing.autoscaling = cfg.get("autoscaling_config")
+                else:
+                    self._deployments[key] = _DeploymentState(
+                        app_name, cfg["name"], cfg)
+        self._wait_for_ready(app_name)
+
+    def _wait_for_ready(self, app_name: str, timeout: float = 60.0) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                states = [d for d in self._deployments.values()
+                          if d.app == app_name]
+                if states and all(
+                        len([r for r in d.replicas if r.healthy])
+                        >= min(1, d.target_num_replicas)
+                        for d in states):
+                    return
+            time.sleep(0.1)
+        raise TimeoutError(f"application {app_name!r} failed to become ready")
+
+    def delete_application(self, app_name: str) -> None:
+        with self._lock:
+            app = self._apps.pop(app_name, None)
+            if not app:
+                return
+            for dep in app["deployments"]:
+                state = self._deployments.pop(f"{app_name}#{dep}", None)
+                if state:
+                    for r in state.replicas:
+                        self._stop_replica(r)
+
+    def get_replica_handles(self, app_name: str,
+                            deployment_name: str) -> List[Any]:
+        with self._lock:
+            state = self._deployments.get(f"{app_name}#{deployment_name}")
+            if state is None:
+                return []
+            return [r.handle for r in state.replicas if r.healthy]
+
+    def get_app_info(self, app_name: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            return self._apps.get(app_name)
+
+    def list_applications(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            return dict(self._apps)
+
+    def get_deployment_status(self, app_name: str,
+                              deployment_name: str) -> Dict[str, Any]:
+        with self._lock:
+            state = self._deployments.get(f"{app_name}#{deployment_name}")
+            if state is None:
+                return {"status": "NOT_FOUND"}
+            healthy = sum(1 for r in state.replicas if r.healthy)
+            return {
+                "status": "HEALTHY" if healthy >= state.target_num_replicas
+                else "UPDATING",
+                "replicas": healthy,
+                "target_replicas": state.target_num_replicas,
+            }
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+        with self._lock:
+            for state in self._deployments.values():
+                for r in state.replicas:
+                    self._stop_replica(r)
+            self._deployments.clear()
+            self._apps.clear()
+
+    def ping(self) -> str:
+        return "pong"
+
+    # -- reconcile loop ------------------------------------------------------
+
+    def _run_control_loop(self) -> None:
+        last_health = 0.0
+        while not self._shutdown.is_set():
+            try:
+                self._reconcile()
+                now = time.monotonic()
+                if now - last_health > HEALTH_CHECK_INTERVAL_S:
+                    self._health_check()
+                    self._autoscale()
+                    last_health = now
+            except Exception:  # noqa: BLE001 — loop must survive
+                logger.exception("reconcile error")
+            self._shutdown.wait(RECONCILE_INTERVAL_S)
+
+    def _reconcile(self) -> None:
+        with self._lock:
+            states = list(self._deployments.values())
+        for state in states:
+            with self._lock:
+                healthy = [r for r in state.replicas if r.healthy]
+                want = state.target_num_replicas
+                to_start = want - len(healthy)
+                dead = [r for r in state.replicas if not r.healthy]
+            for r in dead:
+                self._stop_replica(r)
+                with self._lock:
+                    state.replicas.remove(r)
+            for _ in range(max(0, to_start)):
+                self._start_replica(state)
+            if to_start < 0:
+                with self._lock:
+                    excess = [r for r in state.replicas if r.healthy][to_start:]
+                    for r in excess:
+                        state.replicas.remove(r)
+                for r in excess:
+                    self._stop_replica(r)
+
+    def _start_replica(self, state: _DeploymentState) -> None:
+        cfg = state.config
+        replica_id = f"{state.full_name}#{state.next_replica_idx}"
+        state.next_replica_idx += 1
+        actor_opts = dict(cfg.get("ray_actor_options") or {})
+        actor_opts.setdefault("num_cpus", 0.1)
+        actor_opts["max_concurrency"] = cfg.get("max_ongoing_requests", 8)
+        try:
+            handle = ray_tpu.remote(ReplicaActor).options(
+                **actor_opts).remote({
+                    "callable": cfg["callable"],
+                    "init_args": cfg.get("init_args", ()),
+                    "init_kwargs": cfg.get("init_kwargs", {}),
+                    "deployment": state.name,
+                    "replica_id": replica_id,
+                })
+            if cfg.get("user_config") is not None:
+                handle.reconfigure.remote(cfg["user_config"])
+            with self._lock:
+                state.replicas.append(_ReplicaState(handle, replica_id))
+        except Exception:  # noqa: BLE001
+            logger.exception("failed to start replica for %s",
+                             state.full_name)
+
+    def _stop_replica(self, replica: _ReplicaState) -> None:
+        try:
+            replica.handle.prepare_shutdown.remote()
+            ray_tpu.kill(replica.handle)
+        except Exception:  # noqa: BLE001 — best-effort
+            pass
+
+    def _health_check(self) -> None:
+        with self._lock:
+            all_replicas = [(s, r) for s in self._deployments.values()
+                            for r in s.replicas]
+        for state, replica in all_replicas:
+            try:
+                ray_tpu.get(replica.handle.check_health.remote(), timeout=5.0)
+                replica.healthy = True
+            except Exception:  # noqa: BLE001 — mark dead, reconcile restarts
+                logger.warning("replica %s failed health check",
+                               replica.replica_id)
+                replica.healthy = False
+
+    def _autoscale(self) -> None:
+        """Default policy (reference: serve/autoscaling_policy.py:12):
+        target = ceil(total_ongoing / target_ongoing_requests), clamped."""
+        with self._lock:
+            states = [s for s in self._deployments.values() if s.autoscaling]
+        for state in states:
+            cfg = state.autoscaling
+            total = 0
+            for r in list(state.replicas):
+                if not r.healthy:
+                    continue
+                try:
+                    m = ray_tpu.get(r.handle.get_metrics.remote(),
+                                    timeout=2.0)
+                    total += m["num_ongoing_requests"]
+                except Exception:  # noqa: BLE001
+                    pass
+            target_per = cfg.get("target_ongoing_requests", 2)
+            desired = math.ceil(total / max(target_per, 1)) if total else \
+                cfg.get("min_replicas", 1)
+            desired = max(cfg.get("min_replicas", 1),
+                          min(cfg.get("max_replicas", 10), desired))
+            with self._lock:
+                state.target_num_replicas = desired

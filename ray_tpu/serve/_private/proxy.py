@@ -1,0 +1,102 @@
+"""HTTP ingress proxy (reference: ray python/ray/serve/_private/proxy.py:1130
+ProxyActor; HTTPProxy :761 — uvicorn/starlette there, aiohttp here).
+
+Routes: longest-matching route_prefix → the app's ingress deployment handle.
+GET/POST bodies are decoded as JSON when possible, else passed as raw bytes;
+responses likewise JSON-encoded unless already bytes/str.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import threading
+from typing import Any, Dict, Optional
+
+import ray_tpu
+
+logger = logging.getLogger(__name__)
+
+
+class ProxyActor:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000):
+        self._host = host
+        self._port = port
+        self._routes: Dict[str, Any] = {}  # route_prefix -> handle
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._started = threading.Event()
+        self._thread = threading.Thread(
+            target=self._serve_forever, name="serve-proxy", daemon=True)
+        self._thread.start()
+        self.update_routes()
+
+    def ready(self) -> str:
+        self._started.wait(10)
+        return f"http://{self._host}:{self._port}"
+
+    def update_routes(self) -> None:
+        from ray_tpu.serve.context import get_controller
+        from ray_tpu.serve.handle import DeploymentHandle
+
+        try:
+            controller = get_controller()
+        except RuntimeError:
+            return
+        apps = ray_tpu.get(controller.list_applications.remote())
+        routes = {}
+        for app_name, info in apps.items():
+            routes[info["route_prefix"]] = DeploymentHandle(
+                info["ingress"], app_name)
+        self._routes = routes
+
+    def _match_route(self, path: str):
+        best = None
+        for prefix, handle in self._routes.items():
+            if path == prefix or path.startswith(
+                    prefix.rstrip("/") + "/") or prefix == "/":
+                if best is None or len(prefix) > len(best[0]):
+                    best = (prefix, handle)
+        return best
+
+    def _serve_forever(self) -> None:
+        from aiohttp import web
+
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        self._loop = loop
+
+        async def handler(request: "web.Request") -> "web.Response":
+            match = self._match_route(request.path)
+            if match is None:
+                return web.Response(status=404, text="no matching route")
+            _, handle = match
+            body = await request.read()
+            arg: Any
+            if body:
+                try:
+                    arg = json.loads(body)
+                except (ValueError, UnicodeDecodeError):
+                    arg = body
+            else:
+                arg = dict(request.query) if request.query else None
+            try:
+                response = await loop.run_in_executor(
+                    None, lambda: handle.remote(arg).result(timeout_s=60))
+            except Exception as e:  # noqa: BLE001 — surface as 500
+                logger.exception("request failed")
+                return web.Response(status=500, text=str(e))
+            if isinstance(response, bytes):
+                return web.Response(body=response)
+            if isinstance(response, str):
+                return web.Response(text=response)
+            return web.json_response(response)
+
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", handler)
+        runner = web.AppRunner(app)
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, self._host, self._port)
+        loop.run_until_complete(site.start())
+        self._started.set()
+        loop.run_forever()

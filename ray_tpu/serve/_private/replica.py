@@ -1,0 +1,98 @@
+"""Replica actor: wraps the user's callable (reference: ray
+python/ray/serve/_private/replica.py:231 ReplicaActor, :738
+UserCallableWrapper — exposes queue length for the pow-2 router, runs
+user __call__ / methods, supports async callables and streaming).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import threading
+import time
+from typing import Any, Dict, Optional
+
+
+class ReplicaActor:
+    """Hosts one copy of a deployment's user callable."""
+
+    def __init__(self, serialized_init: Dict[str, Any]):
+        from ray_tpu._private import serialization as ser
+
+        cls_or_fn = ser.loads_function(serialized_init["callable"])
+        args = serialized_init.get("init_args", ())
+        kwargs = serialized_init.get("init_kwargs", {})
+        self._deployment = serialized_init.get("deployment", "")
+        self._replica_id = serialized_init.get("replica_id", "")
+        if inspect.isclass(cls_or_fn):
+            self._callable = cls_or_fn(*args, **kwargs)
+            self._is_function = False
+        else:
+            self._callable = cls_or_fn
+            self._is_function = True
+        self._num_ongoing = 0
+        self._num_processed = 0
+        self._lock = threading.Lock()
+        self._healthy = True
+
+    # -- metrics / control ---------------------------------------------------
+
+    def get_queue_len(self) -> int:
+        return self._num_ongoing
+
+    def get_metrics(self) -> Dict[str, Any]:
+        return {
+            "replica_id": self._replica_id,
+            "num_ongoing_requests": self._num_ongoing,
+            "num_processed": self._num_processed,
+        }
+
+    def check_health(self) -> bool:
+        user_check = getattr(self._callable, "check_health", None)
+        if user_check is not None:
+            user_check()
+        return True
+
+    def reconfigure(self, user_config: Any) -> None:
+        hook = getattr(self._callable, "reconfigure", None)
+        if hook is not None:
+            hook(user_config)
+
+    def prepare_shutdown(self) -> None:
+        hook = getattr(self._callable, "shutdown", None)
+        if callable(hook):
+            hook()
+
+    # -- request path --------------------------------------------------------
+
+    def _user_loop(self) -> asyncio.AbstractEventLoop:
+        """Private event loop for async user callables (lazily started)."""
+        loop = getattr(self, "_loop", None)
+        if loop is None:
+            loop = asyncio.new_event_loop()
+            t = threading.Thread(
+                target=loop.run_forever, name="rt-replica-loop", daemon=True)
+            t.start()
+            self._loop = loop
+        return loop
+
+    def handle_request(self, method_name: str, args: tuple,
+                       kwargs: dict) -> Any:
+        with self._lock:
+            self._num_ongoing += 1
+        try:
+            if self._is_function or method_name in ("__call__", ""):
+                target = self._callable
+            else:
+                target = getattr(self._callable, method_name)
+            out = target(*args, **kwargs)
+            if inspect.iscoroutine(out):
+                fut = asyncio.run_coroutine_threadsafe(out, self._user_loop())
+                out = fut.result()
+            if inspect.isgenerator(out):
+                return list(out)
+            return out
+        finally:
+            with self._lock:
+                self._num_ongoing -= 1
+                self._num_processed += 1

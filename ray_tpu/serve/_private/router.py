@@ -1,0 +1,97 @@
+"""Request router with power-of-two-choices replica scheduling.
+
+Reference: ray python/ray/serve/_private/router.py:312 Router +
+replica_scheduler/pow_2_scheduler.py:49-64 — sample two replicas, probe
+their queue lengths, send to the shorter queue; queue-len probes are cached
+briefly (the reference's queue-len cache) so the router stays off the actor
+hot path.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+QUEUE_LEN_CACHE_S = 0.2
+
+
+class PowerOfTwoChoicesReplicaScheduler:
+    def __init__(self):
+        self._replicas: List[Any] = []  # actor handles
+        self._cache: Dict[Any, tuple] = {}  # handle -> (ts, qlen)
+        self._lock = threading.Lock()
+        self._rng = random.Random()
+
+    def update_replicas(self, replicas: List[Any]) -> None:
+        with self._lock:
+            self._replicas = list(replicas)
+            self._cache = {h: c for h, c in self._cache.items()
+                           if h in self._replicas}
+
+    def _queue_len(self, handle) -> int:
+        now = time.monotonic()
+        with self._lock:
+            cached = self._cache.get(handle)
+        if cached and now - cached[0] < QUEUE_LEN_CACHE_S:
+            return cached[1]
+        try:
+            qlen = ray_tpu.get(handle.get_queue_len.remote(), timeout=2.0)
+        except Exception:  # noqa: BLE001 — dead replica ranks last
+            qlen = 1 << 30
+        with self._lock:
+            self._cache[handle] = (now, qlen)
+        return qlen
+
+    def choose_replica(self):
+        with self._lock:
+            replicas = list(self._replicas)
+        if not replicas:
+            return None
+        if len(replicas) == 1:
+            return replicas[0]
+        a, b = self._rng.sample(replicas, 2)
+        return a if self._queue_len(a) <= self._queue_len(b) else b
+
+
+class Router:
+    """Per-handle router; refreshes its replica set from the controller."""
+
+    def __init__(self, controller, deployment_name: str, app_name: str = ""):
+        self._controller = controller
+        self._deployment = deployment_name
+        self._app = app_name
+        self._scheduler = PowerOfTwoChoicesReplicaScheduler()
+        self._last_refresh = 0.0
+        self._refresh_interval = 1.0
+        self._lock = threading.Lock()
+
+    def _refresh(self, force: bool = False) -> None:
+        now = time.monotonic()
+        with self._lock:
+            if not force and now - self._last_refresh < self._refresh_interval:
+                return
+            self._last_refresh = now
+        replicas = ray_tpu.get(
+            self._controller.get_replica_handles.remote(
+                self._app, self._deployment))
+        self._scheduler.update_replicas(replicas)
+
+    def assign_request(self, method_name: str, args: tuple, kwargs: dict):
+        """Returns an ObjectRef for the response."""
+        self._refresh()
+        deadline = time.monotonic() + 30.0
+        while True:
+            replica = self._scheduler.choose_replica()
+            if replica is not None:
+                return replica.handle_request.remote(
+                    method_name, args, kwargs)
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"no replicas available for deployment "
+                    f"{self._deployment!r} after 30s")
+            time.sleep(0.1)
+            self._refresh(force=True)

@@ -1,0 +1,233 @@
+"""Public Serve API: @deployment, run, start, shutdown, handles.
+
+Reference: ray python/ray/serve/api.py — serve.run (:544) →
+controller.deploy_application (controller.py:719); @serve.deployment
+decorator builds Deployment objects; .bind() builds an application graph
+whose non-ingress nodes become DeploymentHandles injected into the ingress
+constructor (handle.py composition).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Union
+
+from ray_tpu._private import serialization as ser
+from ray_tpu.serve import context as serve_context
+from ray_tpu.serve.handle import DeploymentHandle
+
+
+@dataclasses.dataclass
+class Application:
+    """A bound deployment graph rooted at the ingress deployment."""
+
+    root: "BoundDeployment"
+
+    def _collect(self) -> List["BoundDeployment"]:
+        seen: Dict[str, BoundDeployment] = {}
+
+        def walk(node: BoundDeployment):
+            if node.deployment.name in seen:
+                return
+            seen[node.deployment.name] = node
+            for a in list(node.init_args) + list(node.init_kwargs.values()):
+                child = _as_bound(a)
+                if child is not None:
+                    walk(child)
+
+        walk(self.root)
+        return list(seen.values())
+
+
+@dataclasses.dataclass
+class BoundDeployment:
+    deployment: "Deployment"
+    init_args: tuple
+    init_kwargs: dict
+
+
+def _as_bound(value: Any) -> Optional[BoundDeployment]:
+    """bind() returns Application; nested graph args may be either form."""
+    if isinstance(value, BoundDeployment):
+        return value
+    if isinstance(value, Application):
+        return value.root
+    return None
+
+
+class Deployment:
+    def __init__(self, func_or_class: Union[Callable, type],
+                 name: Optional[str] = None,
+                 num_replicas: Union[int, str, None] = None,
+                 ray_actor_options: Optional[dict] = None,
+                 user_config: Any = None,
+                 max_ongoing_requests: int = 8,
+                 autoscaling_config: Optional[dict] = None,
+                 health_check_period_s: float = 2.0,
+                 **_kw):
+        self.func_or_class = func_or_class
+        self.name = name or getattr(func_or_class, "__name__", "deployment")
+        if num_replicas == "auto":
+            autoscaling_config = autoscaling_config or {
+                "min_replicas": 1, "max_replicas": 10,
+                "target_ongoing_requests": 2}
+            num_replicas = None
+        self.num_replicas = num_replicas or 1
+        self.ray_actor_options = ray_actor_options
+        self.user_config = user_config
+        self.max_ongoing_requests = max_ongoing_requests
+        self.autoscaling_config = autoscaling_config
+
+    def options(self, **overrides) -> "Deployment":
+        merged = dict(
+            name=self.name, num_replicas=self.num_replicas,
+            ray_actor_options=self.ray_actor_options,
+            user_config=self.user_config,
+            max_ongoing_requests=self.max_ongoing_requests,
+            autoscaling_config=self.autoscaling_config,
+        )
+        merged.update(overrides)
+        return Deployment(self.func_or_class, **merged)
+
+    def bind(self, *args, **kwargs) -> Application:
+        return Application(BoundDeployment(self, args, kwargs))
+
+    def __call__(self, *a, **kw):
+        raise RuntimeError(
+            "Deployments cannot be called directly; use handle.remote() or "
+            "serve.run()")
+
+
+def deployment(func_or_class=None, **options):
+    """@serve.deployment decorator."""
+    if func_or_class is not None and callable(func_or_class) and not options:
+        return Deployment(func_or_class)
+
+    def wrap(fc):
+        return Deployment(fc, **options)
+
+    return wrap
+
+
+def start(detached: bool = True, http_options: Optional[dict] = None,
+          **_kw) -> None:
+    serve_context.get_controller(create=True)
+    if http_options and http_options.get("port"):
+        _ensure_proxy(http_options)
+
+
+_proxy = None
+
+
+def _ensure_proxy(http_options: Optional[dict] = None):
+    global _proxy
+    import ray_tpu
+    from ray_tpu.serve._private.proxy import ProxyActor
+
+    if _proxy is None:
+        opts = http_options or {}
+        _proxy = ray_tpu.remote(ProxyActor).options(
+            name="SERVE_PROXY", lifetime="detached", num_cpus=0.1,
+            get_if_exists=True, max_concurrency=64,
+        ).remote(host=opts.get("host", "127.0.0.1"),
+                 port=opts.get("port", 8000))
+        ray_tpu.get(_proxy.ready.remote())
+    return _proxy
+
+
+def run(app: Application, *, name: str = "default", route_prefix: str = "/",
+        _blocking: bool = False, http_port: Optional[int] = None
+        ) -> DeploymentHandle:
+    controller = serve_context.get_controller(create=True)
+    import ray_tpu
+
+    nodes = app._collect()
+    deployments = []
+    for node in nodes:
+        d = node.deployment
+        # Replace bound children with handles so replicas route directly.
+        init_args = tuple(
+            DeploymentHandle(_as_bound(a).deployment.name, name)
+            if _as_bound(a) is not None else a
+            for a in node.init_args)
+        init_kwargs = {
+            k: DeploymentHandle(_as_bound(v).deployment.name, name)
+            if _as_bound(v) is not None else v
+            for k, v in node.init_kwargs.items()}
+        deployments.append({
+            "name": d.name,
+            "callable": ser.dumps_function(d.func_or_class),
+            "init_args": init_args,
+            "init_kwargs": init_kwargs,
+            "num_replicas": d.num_replicas,
+            "ray_actor_options": d.ray_actor_options,
+            "user_config": d.user_config,
+            "max_ongoing_requests": d.max_ongoing_requests,
+            "autoscaling_config": d.autoscaling_config,
+        })
+    ray_tpu.get(controller.deploy_application.remote(
+        name, deployments, app.root.deployment.name, route_prefix))
+    if http_port is not None:
+        proxy = _ensure_proxy({"port": http_port})
+        ray_tpu.get(proxy.update_routes.remote())
+    return DeploymentHandle(app.root.deployment.name, name)
+
+
+def get_app_handle(name: str = "default") -> DeploymentHandle:
+    controller = serve_context.get_controller()
+    import ray_tpu
+
+    info = ray_tpu.get(controller.get_app_info.remote(name))
+    if info is None:
+        raise ValueError(f"no application named {name!r}")
+    return DeploymentHandle(info["ingress"], name)
+
+
+def get_deployment_handle(deployment_name: str,
+                          app_name: str = "default") -> DeploymentHandle:
+    return DeploymentHandle(deployment_name, app_name)
+
+
+def delete(name: str) -> None:
+    controller = serve_context.get_controller()
+    import ray_tpu
+
+    ray_tpu.get(controller.delete_application.remote(name))
+
+
+def status() -> Dict[str, Any]:
+    controller = serve_context.get_controller()
+    import ray_tpu
+
+    apps = ray_tpu.get(controller.list_applications.remote())
+    out = {}
+    for app_name, info in apps.items():
+        deps = {}
+        for dep in info["deployments"]:
+            deps[dep] = ray_tpu.get(
+                controller.get_deployment_status.remote(app_name, dep))
+        out[app_name] = {"deployments": deps,
+                         "route_prefix": info["route_prefix"]}
+    return out
+
+
+def shutdown() -> None:
+    global _proxy
+    import ray_tpu
+
+    try:
+        controller = serve_context.get_controller()
+    except RuntimeError:
+        return
+    try:
+        ray_tpu.get(controller.shutdown.remote(), timeout=30)
+        ray_tpu.kill(controller)
+    except Exception:  # noqa: BLE001 — best-effort teardown
+        pass
+    if _proxy is not None:
+        try:
+            ray_tpu.kill(_proxy)
+        except Exception:  # noqa: BLE001
+            pass
+        _proxy = None
+    serve_context.clear_controller_cache()

@@ -1,0 +1,55 @@
+"""Global serve client state (reference: ray python/ray/serve/context.py —
+the per-driver handle to the controller, replica-internal context)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+_lock = threading.Lock()
+_controller = None
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+
+
+def get_controller(create: bool = False):
+    """The ServeController detached actor (created on first use)."""
+    global _controller
+    import ray_tpu
+
+    with _lock:
+        if _controller is not None:
+            return _controller
+        try:
+            _controller = ray_tpu.get_actor(CONTROLLER_NAME)
+            return _controller
+        except ValueError:
+            if not create:
+                raise RuntimeError(
+                    "Serve is not running; call serve.start() or serve.run()"
+                ) from None
+        from ray_tpu.serve._private.controller import ServeController
+
+        _controller = ray_tpu.remote(ServeController).options(
+            name=CONTROLLER_NAME, lifetime="detached", num_cpus=0.1,
+            max_concurrency=32,
+        ).remote()
+        ray_tpu.get(_controller.ping.remote())
+        return _controller
+
+
+def clear_controller_cache() -> None:
+    global _controller
+    with _lock:
+        _controller = None
+
+
+_replica_context = threading.local()
+
+
+def get_multiplexed_model_id() -> str:
+    return getattr(_replica_context, "multiplexed_model_id", "")
+
+
+def set_multiplexed_model_id(model_id: str) -> None:
+    _replica_context.multiplexed_model_id = model_id

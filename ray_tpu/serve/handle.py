@@ -1,0 +1,74 @@
+"""DeploymentHandle / DeploymentResponse (reference: ray
+python/ray/serve/handle.py:714 DeploymentHandle, .remote() :786 —
+composition: handles passed into other deployments' constructors route
+requests replica-to-replica without the proxy).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import ray_tpu
+
+
+class DeploymentResponse:
+    """Future for a deployment request (awaitable via .result())."""
+
+    def __init__(self, ref):
+        self._ref = ref
+
+    def result(self, timeout_s: Optional[float] = None) -> Any:
+        return ray_tpu.get(self._ref, timeout=timeout_s)
+
+    def _to_object_ref(self):
+        return self._ref
+
+    def __await__(self):
+        return self._ref.__await__()
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_name: str, app_name: str = "",
+                 method_name: str = "__call__", controller=None):
+        self.deployment_name = deployment_name
+        self.app_name = app_name
+        self._method_name = method_name
+        self._controller = controller
+        self._router = None
+
+    def _get_router(self):
+        if self._router is None:
+            from ray_tpu.serve._private.router import Router
+            from ray_tpu.serve.context import get_controller
+
+            controller = self._controller or get_controller()
+            self._router = Router(
+                controller, self.deployment_name, self.app_name)
+        return self._router
+
+    def options(self, *, method_name: Optional[str] = None,
+                **_kw) -> "DeploymentHandle":
+        h = DeploymentHandle(
+            self.deployment_name, self.app_name,
+            method_name or self._method_name, self._controller)
+        h._router = self._router
+        return h
+
+    def __getattr__(self, name: str) -> "DeploymentHandle":
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return self.options(method_name=name)
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        # Unwrap nested DeploymentResponses so composed models pass values.
+        args = tuple(a._to_object_ref() if isinstance(a, DeploymentResponse)
+                     else a for a in args)
+        kwargs = {k: v._to_object_ref() if isinstance(v, DeploymentResponse)
+                  else v for k, v in kwargs.items()}
+        ref = self._get_router().assign_request(
+            self._method_name, args, kwargs)
+        return DeploymentResponse(ref)
+
+    def __reduce__(self):
+        return (DeploymentHandle,
+                (self.deployment_name, self.app_name, self._method_name))

@@ -1,12 +1,71 @@
 """Distributed training library (JaxTrainer and friends).
 
 Reference counterpart: Ray Train (ray: python/ray/train — BaseTrainer.fit
-base_trainer.py:567, DataParallelTrainer, BackendExecutor, WorkerGroup), with
-the NCCL backend replaced by mesh construction + XLA collectives.
+base_trainer.py:567, DataParallelTrainer, BackendExecutor, WorkerGroup,
+session report/get_checkpoint/get_context session.py:666/:753/context.py:80),
+with the NCCL backend replaced by mesh construction + XLA collectives.
 """
 
+from ray_tpu.air import (  # noqa: F401 — re-exported like ray.train does
+    CheckpointConfig,
+    FailureConfig,
+    Result,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train._internal.dataset_integration import (  # noqa: F401
+    get_dataset_shard,
+)
+from ray_tpu.train._internal.session import (  # noqa: F401
+    get_checkpoint,
+    get_context,
+    report,
+)
+from ray_tpu.train.backend import (  # noqa: F401
+    Backend,
+    BackendConfig,
+    JaxBackend,
+    JaxConfig,
+    TorchBackend,
+    TorchConfig,
+)
+from ray_tpu.train.checkpoint import Checkpoint  # noqa: F401
+from ray_tpu.train.context import TrainContext  # noqa: F401
 from ray_tpu.train.step import (  # noqa: F401
     TrainState,
-    make_train_step,
     init_train_state,
+    make_train_step,
 )
+from ray_tpu.train.trainer import (  # noqa: F401
+    BaseTrainer,
+    DataParallelTrainer,
+    JaxTrainer,
+    TorchTrainer,
+)
+
+__all__ = [
+    "Backend",
+    "BackendConfig",
+    "BaseTrainer",
+    "Checkpoint",
+    "CheckpointConfig",
+    "DataParallelTrainer",
+    "FailureConfig",
+    "JaxBackend",
+    "JaxConfig",
+    "JaxTrainer",
+    "Result",
+    "RunConfig",
+    "ScalingConfig",
+    "TorchBackend",
+    "TorchConfig",
+    "TorchTrainer",
+    "TrainContext",
+    "TrainState",
+    "get_checkpoint",
+    "get_context",
+    "get_dataset_shard",
+    "init_train_state",
+    "make_train_step",
+    "report",
+]

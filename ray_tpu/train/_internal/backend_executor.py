@@ -1,0 +1,143 @@
+"""Driver-side executor for a training run.
+
+Reference: ray python/ray/train/_internal/backend_executor.py:66 —
+start (:124) builds the WorkerGroup + runs backend.on_start;
+start_training (:436) initializes sessions and launches train_fn on every
+worker; the fit loop then pulls one result per worker per round
+(`get_next_results` barrier semantics) until all workers finish.
+Worker failure surfaces as TrainingWorkerError (backend_executor.py:43) and
+the trainer restarts the gang from the latest checkpoint (gang-atomic
+recovery — SURVEY §7: a failed host means the whole mesh restarts).
+"""
+
+from __future__ import annotations
+
+import logging
+from collections import defaultdict
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.train._internal.storage import StorageContext
+from ray_tpu.train._internal.worker_group import WorkerGroup
+from ray_tpu.train.backend import BackendConfig
+from ray_tpu.train.checkpoint import Checkpoint
+
+logger = logging.getLogger(__name__)
+
+
+class TrainingWorkerError(RuntimeError):
+    """A training worker died or its train_fn raised."""
+
+
+class BackendExecutor:
+    def __init__(
+        self,
+        backend_config: BackendConfig,
+        num_workers: int,
+        resources_per_worker: Dict[str, float],
+        placement_strategy: str = "PACK",
+    ):
+        self._backend_config = backend_config
+        self._backend = backend_config.backend_cls()
+        self._num_workers = num_workers
+        self._resources = resources_per_worker
+        self._strategy = placement_strategy
+        self.worker_group: Optional[WorkerGroup] = None
+
+    def start(self) -> None:
+        self.worker_group = WorkerGroup(
+            self._num_workers, self._resources, self._strategy)
+        self.worker_group.start()
+        try:
+            self._backend.on_start(self.worker_group, self._backend_config)
+        except Exception:
+            self.shutdown()
+            raise
+
+    def start_training(
+        self,
+        train_fn: Callable,
+        config: Dict[str, Any],
+        storage: StorageContext,
+        latest_checkpoint: Optional[Checkpoint] = None,
+        experiment_name: str = "",
+        trial_id: str = "",
+    ) -> None:
+        wg = self.worker_group
+        assert wg is not None, "start() must run first"
+        # node_rank / local_rank derived from gang metadata, like the
+        # reference's _create_rank_world_size_mappings.
+        meta = wg.group_metadata()
+        node_ids = []
+        for m in meta:
+            if m["node_id"] not in node_ids:
+                node_ids.append(m["node_id"])
+        local_counter: Dict[str, int] = defaultdict(int)
+        init_refs = []
+        for rank, (worker, m) in enumerate(zip(wg.workers, meta)):
+            local_rank = local_counter[m["node_id"]]
+            local_counter[m["node_id"]] += 1
+            ctx_kwargs = dict(
+                world_size=self._num_workers,
+                world_rank=rank,
+                local_rank=local_rank,
+                local_world_size=sum(
+                    1 for mm in meta if mm["node_id"] == m["node_id"]),
+                node_rank=node_ids.index(m["node_id"]),
+                experiment_name=experiment_name,
+                trial_id=trial_id,
+                trial_name=trial_id,
+                storage_path=storage.storage_path,
+                trial_dir=storage.trial_dir,
+            )
+            init_refs.append(
+                worker.init_session.remote(ctx_kwargs, latest_checkpoint))
+        ray_tpu.get(init_refs)
+        self._backend.on_training_start(wg, self._backend_config)
+        ray_tpu.get([
+            w.start_training.remote(train_fn, config) for w in wg.workers
+        ])
+
+    def get_next_results(self, timeout: float = 3600.0) -> Optional[List[dict]]:
+        """One result per worker, or None when training completed everywhere.
+
+        Raises TrainingWorkerError if any worker failed or died.
+        """
+        wg = self.worker_group
+        refs = [w.next_result.remote(timeout) for w in wg.workers]
+        try:
+            results = ray_tpu.get(refs, timeout=timeout)
+        except Exception as e:  # noqa: BLE001 — train_fn / actor-death errors
+            raise TrainingWorkerError(str(e)) from e
+        done = [r is None for r in results]
+        if all(done):
+            return None
+        if any(done):
+            raise TrainingWorkerError(
+                "some training workers finished while others are still "
+                "reporting — train_fn must report the same number of times "
+                "on every rank")
+        return results
+
+    def pause_reporting(self) -> None:
+        for w in self.worker_group.workers:
+            w.request_stop.remote()
+
+    def finish(self) -> None:
+        if self.worker_group is not None:
+            try:
+                ray_tpu.get([
+                    w.finish.remote() for w in self.worker_group.workers
+                ], timeout=30)
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+
+    def shutdown(self) -> None:
+        if self.worker_group is not None:
+            try:
+                self._backend.on_shutdown(
+                    self.worker_group, self._backend_config)
+            except Exception:  # noqa: BLE001
+                pass
+            self.worker_group.shutdown()
+            self.worker_group = None

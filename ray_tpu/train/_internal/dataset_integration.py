@@ -1,0 +1,39 @@
+"""Per-worker dataset shard plumbing (reference: ray
+python/ray/train/_internal/data_config.py — streaming_split feeds each train
+worker its shard; accessed via train.get_dataset_shard(name)).
+
+Until a Dataset object is passed, shards are stored per-process; when
+ray_tpu.data Datasets are provided to the trainer, `set_dataset_shards`
+splits them by world rank lazily at first access.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+_lock = threading.Lock()
+_datasets: Dict[str, Any] = {}
+
+
+def set_dataset_shards(datasets: Dict[str, Any]) -> None:
+    with _lock:
+        _datasets.clear()
+        _datasets.update(datasets)
+
+
+def get_dataset_shard(name: str = "train") -> Optional[Any]:
+    from ray_tpu.train._internal.session import get_session
+
+    ds = _datasets.get(name)
+    if ds is None:
+        return None
+    s = get_session()
+    if s is None:
+        return ds
+    ctx = s.context
+    # ray_tpu.data Datasets know how to shard themselves; plain iterables are
+    # strided by world rank.
+    if hasattr(ds, "split_shard"):
+        return ds.split_shard(ctx.world_rank, ctx.world_size)
+    return ds

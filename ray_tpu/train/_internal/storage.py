@@ -1,0 +1,78 @@
+"""Run storage layout (reference: ray python/ray/train/_internal/storage.py:349
+StorageContext — experiment dir / trial dir / checkpoint dirs on a
+(shared) filesystem).
+
+Layout: <storage_path>/<experiment_name>/<trial_id>/
+    result.json            — one JSON line per reported round (rank-0 metrics)
+    checkpoint_NNNNNN/     — uploaded checkpoints
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class StorageContext:
+    def __init__(self, storage_path: str, experiment_name: str,
+                 trial_id: str = ""):
+        self.storage_path = os.path.abspath(os.path.expanduser(storage_path))
+        self.experiment_name = experiment_name
+        self.trial_id = trial_id
+        os.makedirs(self.trial_dir, exist_ok=True)
+
+    @property
+    def experiment_dir(self) -> str:
+        return os.path.join(self.storage_path, self.experiment_name)
+
+    @property
+    def trial_dir(self) -> str:
+        if not self.trial_id:
+            return self.experiment_dir
+        return os.path.join(self.experiment_dir, self.trial_id)
+
+    def append_result(self, metrics: Dict[str, Any]) -> None:
+        row = dict(metrics)
+        row.setdefault("_timestamp", time.time())
+        with open(os.path.join(self.trial_dir, "result.json"), "a") as f:
+            f.write(json.dumps(row, default=str) + "\n")
+
+    def checkpoint_path(self, name: str) -> str:
+        return os.path.join(self.trial_dir, name)
+
+    def list_checkpoints(self) -> List[str]:
+        if not os.path.isdir(self.trial_dir):
+            return []
+        return sorted(
+            d for d in os.listdir(self.trial_dir)
+            if d.startswith("checkpoint_")
+            and os.path.isdir(os.path.join(self.trial_dir, d))
+        )
+
+    def latest_checkpoint(self) -> Optional[str]:
+        cs = self.list_checkpoints()
+        return self.checkpoint_path(cs[-1]) if cs else None
+
+    def prune_checkpoints(self, num_to_keep: Optional[int],
+                          scores: Optional[Dict[str, float]] = None,
+                          order: str = "max") -> None:
+        """Keep the newest (or best-scoring) num_to_keep checkpoints."""
+        if num_to_keep is None:
+            return
+        cs = self.list_checkpoints()
+        if len(cs) <= num_to_keep:
+            return
+        if scores:
+            sign = 1 if order == "max" else -1
+            ranked = sorted(
+                cs, key=lambda c: sign * scores.get(c, float("-inf")),
+                reverse=True)
+            keep = set(ranked[:num_to_keep])
+        else:
+            keep = set(cs[-num_to_keep:])
+        for c in cs:
+            if c not in keep:
+                shutil.rmtree(self.checkpoint_path(c), ignore_errors=True)

@@ -1,0 +1,166 @@
+"""WorkerGroup: a gang of training-worker actors on a placement group.
+
+Reference: ray python/ray/train/_internal/worker_group.py:102 (start :193,
+execute_async :233). Workers are plain actors scheduled into one placement
+group so the gang is atomic: either the whole slice is reserved or nothing
+runs (SURVEY §7 "SPMD-vs-actor impedance" — a TPU mesh gang must be
+scheduled and failed as one unit).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.util.placement_group import placement_group, remove_placement_group
+from ray_tpu.util.scheduling_strategies import PlacementGroupSchedulingStrategy
+
+logger = logging.getLogger(__name__)
+
+
+class TrainWorker:
+    """Actor body hosting the training session (one per gang slot)."""
+
+    def __init__(self):
+        self._train_thread: Optional[threading.Thread] = None
+        self._session = None
+
+    def get_metadata(self) -> Dict[str, Any]:
+        ctx = ray_tpu.get_runtime_context()
+        return {
+            "node_id": ctx.get_node_id(),
+            "hostname": socket.gethostname(),
+            "pid": os.getpid(),
+        }
+
+    def init_session(self, context_kwargs: Dict[str, Any],
+                     latest_checkpoint=None) -> None:
+        from ray_tpu.train._internal import session as session_mod
+        from ray_tpu.train.context import TrainContext
+
+        self._session = session_mod.init_session(
+            TrainContext(**context_kwargs), latest_checkpoint)
+
+    def run_backend_hook(self, hook: Callable, *args, **kwargs) -> Any:
+        return hook(*args, **kwargs)
+
+    def start_training(self, train_fn: Callable, config: Dict[str, Any]) -> None:
+        assert self._session is not None, "init_session must run first"
+        s = self._session
+
+        def _run():
+            try:
+                import inspect
+
+                if len(inspect.signature(train_fn).parameters) == 0:
+                    train_fn()
+                else:
+                    train_fn(config)
+            except BaseException as e:  # noqa: BLE001 — report any failure
+                s.error = e
+            finally:
+                s.finished.set()
+
+        self._train_thread = threading.Thread(
+            target=_run, name="rt-train-fn", daemon=True)
+        self._train_thread.start()
+
+    def next_result(self, timeout: float = 3600.0):
+        """One report from the train thread, or None when training finished.
+
+        Raises the train thread's error, if any, after it finishes.
+        """
+        import queue as _q
+
+        s = self._session
+        deadline = timeout
+        while True:
+            try:
+                r = s.result_queue.get(timeout=min(0.1, deadline))
+                return {"metrics": r.metrics,
+                        "checkpoint_dir_name": r.checkpoint_dir_name}
+            except _q.Empty:
+                deadline -= 0.1
+                if s.finished.is_set() and s.result_queue.empty():
+                    if s.error is not None:
+                        raise s.error
+                    return None
+                if deadline <= 0:
+                    raise TimeoutError("no training result within timeout")
+
+    def request_stop(self) -> None:
+        if self._session is not None:
+            self._session.stop_requested.set()
+
+    def finish(self, timeout: float = 30.0) -> None:
+        if self._train_thread is not None:
+            self._train_thread.join(timeout)
+        from ray_tpu.train._internal import session as session_mod
+
+        session_mod.shutdown_session()
+
+    def execute(self, fn: Callable, *args, **kwargs) -> Any:
+        return fn(*args, **kwargs)
+
+
+class WorkerGroup:
+    """Owns the placement group + actor gang."""
+
+    def __init__(self, num_workers: int,
+                 resources_per_worker: Dict[str, float],
+                 placement_strategy: str = "PACK",
+                 actor_cls=None):
+        self.num_workers = num_workers
+        self._resources = resources_per_worker
+        self._strategy = placement_strategy
+        self._actor_cls = actor_cls or TrainWorker
+        self.workers: List[Any] = []
+        self._pg = None
+
+    def start(self) -> None:
+        bundles = [dict(self._resources) for _ in range(self.num_workers)]
+        self._pg = placement_group(bundles, strategy=self._strategy)
+        ray_tpu.get(self._pg.ready())
+        remote_cls = ray_tpu.remote(self._actor_cls)
+        self.workers = [
+            remote_cls.options(
+                num_cpus=self._resources.get("CPU", 1.0),
+                resources={k: v for k, v in self._resources.items()
+                           if k != "CPU" and v > 0},
+                max_concurrency=4,  # next_result must overlap start_training
+                scheduling_strategy=PlacementGroupSchedulingStrategy(
+                    placement_group=self._pg,
+                    placement_group_bundle_index=i,
+                ),
+            ).remote()
+            for i in range(self.num_workers)
+        ]
+        # Surface actor-start failures eagerly.
+        ray_tpu.get([w.get_metadata.remote() for w in self.workers])
+
+    def execute(self, fn: Callable, *args, **kwargs) -> List[Any]:
+        return ray_tpu.get(self.execute_async(fn, *args, **kwargs))
+
+    def execute_async(self, fn: Callable, *args, **kwargs) -> List[Any]:
+        return [w.execute.remote(fn, *args, **kwargs) for w in self.workers]
+
+    def execute_single(self, rank: int, fn: Callable, *args, **kwargs) -> Any:
+        return ray_tpu.get(self.workers[rank].execute.remote(fn, *args, **kwargs))
+
+    def group_metadata(self) -> List[Dict[str, Any]]:
+        return ray_tpu.get([w.get_metadata.remote() for w in self.workers])
+
+    def shutdown(self) -> None:
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+        self.workers = []
+        if self._pg is not None:
+            remove_placement_group(self._pg)
+            self._pg = None

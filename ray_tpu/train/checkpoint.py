@@ -1,0 +1,103 @@
+"""Checkpoint = a directory of files (reference: ray
+python/ray/train/_checkpoint.py:56 — Checkpoint as a pyarrow-fs directory).
+
+TPU-native extras: `from_arrays` / `to_arrays` store a JAX pytree via a
+flat .npz + treedef, so a sharded train state round-trips through
+`jax.device_get` / `device_put` without orbax being required (orbax is used
+when available for large multi-host states — see ray_tpu.train.orbax_io).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import shutil
+import tempfile
+import uuid
+from contextlib import contextmanager
+from typing import Any, Dict, Iterator, Optional
+
+
+class Checkpoint:
+    """A reference to a directory tree containing checkpoint data."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    def __repr__(self):
+        return f"Checkpoint(path={self.path!r})"
+
+    def __eq__(self, other):
+        return isinstance(other, Checkpoint) and other.path == self.path
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    @contextmanager
+    def as_directory(self) -> Iterator[str]:
+        yield self.path
+
+    def to_directory(self, path: Optional[str] = None) -> str:
+        dest = path or os.path.join(
+            tempfile.gettempdir(), f"ckpt_{uuid.uuid4().hex[:8]}"
+        )
+        if os.path.abspath(dest) != self.path:
+            shutil.copytree(self.path, dest, dirs_exist_ok=True)
+        return dest
+
+    # -- convenience payloads ------------------------------------------------
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "Checkpoint":
+        d = tempfile.mkdtemp(prefix="ckpt_dict_")
+        with open(os.path.join(d, "data.pkl"), "wb") as f:
+            pickle.dump(data, f)
+        return cls(d)
+
+    def to_dict(self) -> Dict[str, Any]:
+        with open(os.path.join(self.path, "data.pkl"), "rb") as f:
+            return pickle.load(f)
+
+    def update_metadata(self, metadata: Dict[str, Any]) -> None:
+        meta = self.get_metadata()
+        meta.update(metadata)
+        with open(os.path.join(self.path, ".metadata.json"), "w") as f:
+            json.dump(meta, f)
+
+    def get_metadata(self) -> Dict[str, Any]:
+        p = os.path.join(self.path, ".metadata.json")
+        if not os.path.exists(p):
+            return {}
+        with open(p) as f:
+            return json.load(f)
+
+    # -- JAX pytree payloads -------------------------------------------------
+
+    @classmethod
+    def from_arrays(cls, tree: Any, path: Optional[str] = None) -> "Checkpoint":
+        """Save a pytree of arrays (device arrays are fetched to host)."""
+        import jax
+        import numpy as np
+
+        d = path or tempfile.mkdtemp(prefix="ckpt_arrays_")
+        os.makedirs(d, exist_ok=True)
+        host_tree = jax.device_get(tree)
+        leaves, treedef = jax.tree_util.tree_flatten(host_tree)
+        np.savez(os.path.join(d, "arrays.npz"),
+                 **{str(i): np.asarray(x) for i, x in enumerate(leaves)})
+        with open(os.path.join(d, "treedef.pkl"), "wb") as f:
+            pickle.dump(jax.tree_util.tree_structure(host_tree), f)
+        del treedef
+        return cls(d)
+
+    def to_arrays(self) -> Any:
+        import jax
+        import numpy as np
+
+        with open(os.path.join(self.path, "treedef.pkl"), "rb") as f:
+            treedef = pickle.load(f)
+        z = np.load(os.path.join(self.path, "arrays.npz"))
+        leaves = [z[str(i)] for i in range(len(z.files))]
+        return jax.tree_util.tree_unflatten(treedef, leaves)

@@ -1,0 +1,84 @@
+"""Hyperparameter tuning library.
+
+Reference counterpart: Ray Tune (ray: python/ray/tune — Tuner.fit tuner.py:44,
+TuneController execution/tune_controller.py:68, searchers in search/,
+schedulers in schedulers/, tune.report == train.report session plumbing).
+"""
+
+from ray_tpu.train._internal.session import (  # noqa: F401 — tune.report
+    get_checkpoint,
+    get_context,
+    report,
+)
+from ray_tpu.train.checkpoint import Checkpoint  # noqa: F401
+from ray_tpu.tune.search.sample import (  # noqa: F401
+    choice,
+    grid_search,
+    lograndint,
+    loguniform,
+    qrandint,
+    quniform,
+    randint,
+    randn,
+    uniform,
+)
+from ray_tpu.tune.tuner import ResultGrid, TuneConfig, Tuner  # noqa: F401
+
+
+def with_parameters(fn, **kwargs):
+    """Bind large constant objects to a trainable (reference:
+    tune/trainable/util.py with_parameters — objects go through the object
+    store once, not per-trial pickling)."""
+    import functools
+
+    import ray_tpu
+
+    refs = {k: ray_tpu.put(v) for k, v in kwargs.items()}
+
+    @functools.wraps(fn)
+    def wrapped(config):
+        resolved = {k: ray_tpu.get(r) for k, r in refs.items()}
+        return fn(config, **resolved)
+
+    return wrapped
+
+
+def run(trainable, *, config=None, num_samples=1, metric=None, mode="max",
+        scheduler=None, search_alg=None, stop=None, storage_path=None,
+        name=None, max_concurrent_trials=None, **_ignored):
+    """Legacy tune.run API (reference: tune/tune.py run)."""
+    from ray_tpu.air import RunConfig
+
+    tuner = Tuner(
+        trainable,
+        param_space=config,
+        tune_config=TuneConfig(
+            metric=metric, mode=mode, num_samples=num_samples,
+            scheduler=scheduler, search_alg=search_alg,
+            max_concurrent_trials=max_concurrent_trials,
+        ),
+        run_config=RunConfig(name=name, storage_path=storage_path, stop=stop),
+    )
+    return tuner.fit()
+
+
+__all__ = [
+    "Checkpoint",
+    "ResultGrid",
+    "TuneConfig",
+    "Tuner",
+    "choice",
+    "get_checkpoint",
+    "get_context",
+    "grid_search",
+    "lograndint",
+    "loguniform",
+    "qrandint",
+    "quniform",
+    "randint",
+    "randn",
+    "report",
+    "run",
+    "uniform",
+    "with_parameters",
+]

@@ -1,0 +1,247 @@
+"""The Tune event loop.
+
+Reference: ray python/ray/tune/execution/tune_controller.py —
+TuneController (:68) steps (:666) an event loop that asks the searcher for
+new trials, schedules trial actors (:964) under resource limits, consumes
+their results, routes them through the TrialScheduler (continue/stop/pause),
+and checkpoints experiment state (:351) so `Tuner.restore` (tuner.py:171)
+can resume.
+
+Each trial runs its function-trainable inside a TrainWorker actor (the same
+actor body Train uses): train-thread + report queue; the controller polls
+`next_result` futures with ray_tpu.wait, which keeps the loop event-driven
+over any number of concurrent trials.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.train._internal.storage import StorageContext
+from ray_tpu.train._internal.worker_group import TrainWorker
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.tune.experiment.trial import (
+    ERROR,
+    PENDING,
+    RUNNING,
+    TERMINATED,
+    Trial,
+)
+from ray_tpu.tune.schedulers import FIFOScheduler, TrialScheduler
+from ray_tpu.tune.search import BasicVariantGenerator, Searcher
+
+logger = logging.getLogger(__name__)
+
+
+class TuneController:
+    def __init__(
+        self,
+        trainable: Callable,
+        *,
+        param_space: Optional[Dict[str, Any]] = None,
+        searcher: Optional[Searcher] = None,
+        scheduler: Optional[TrialScheduler] = None,
+        num_samples: int = 1,
+        metric: Optional[str] = None,
+        mode: str = "max",
+        max_concurrent_trials: Optional[int] = None,
+        resources_per_trial: Optional[Dict[str, float]] = None,
+        storage_path: str = "~/ray_tpu_results",
+        experiment_name: Optional[str] = None,
+        stop: Optional[Dict[str, Any]] = None,
+        trial_executor_cls=None,
+    ):
+        self._trainable = trainable
+        self._searcher = searcher or BasicVariantGenerator(
+            param_space or {}, num_samples=num_samples)
+        self._searcher.set_search_properties(metric, mode, param_space or {})
+        self._scheduler = scheduler or FIFOScheduler()
+        self._scheduler.set_search_properties(metric, mode)
+        self._metric = metric
+        self._mode = mode
+        self._max_concurrent = max_concurrent_trials or 8
+        self._resources = resources_per_trial or {"CPU": 1.0}
+        self._experiment_name = experiment_name or (
+            getattr(trainable, "__name__", "exp") + time.strftime("_%H%M%S"))
+        self._storage_root = os.path.abspath(os.path.expanduser(storage_path))
+        self._stop_criteria = stop or {}
+        self._actor_cls = ray_tpu.remote(trial_executor_cls or TrainWorker)
+        self.trials: List[Trial] = []
+        self._pending_result: Dict[Any, Trial] = {}  # ref -> trial
+        self._search_done = False
+
+    # -- experiment state checkpoint ----------------------------------------
+
+    @property
+    def experiment_dir(self) -> str:
+        return os.path.join(self._storage_root, self._experiment_name)
+
+    def save_experiment_state(self) -> None:
+        os.makedirs(self.experiment_dir, exist_ok=True)
+        state = {
+            "experiment_name": self._experiment_name,
+            "trials": [t.to_json() for t in self.trials],
+        }
+        tmp = os.path.join(self.experiment_dir, ".tuner_state.tmp")
+        with open(tmp, "w") as f:
+            json.dump(state, f, default=str)
+        os.replace(tmp, os.path.join(self.experiment_dir, "tuner_state.json"))
+
+    @classmethod
+    def load_experiment_state(cls, experiment_dir: str) -> List[Trial]:
+        p = os.path.join(experiment_dir, "tuner_state.json")
+        if not os.path.exists(p):
+            return []
+        with open(p) as f:
+            state = json.load(f)
+        name = state.get("experiment_name", "restored")
+        return [Trial.from_json(tj, name) for tj in state["trials"]]
+
+    def restore_trials(self, trials: List[Trial]) -> None:
+        for t in trials:
+            if t.status in (RUNNING, PENDING, ERROR):
+                t.status = PENDING
+                t.actor = None
+            self.trials.append(t)
+
+    # -- trial lifecycle -----------------------------------------------------
+
+    def _launch_trial(self, trial: Trial) -> None:
+        trial.storage = StorageContext(
+            self._storage_root, self._experiment_name, trial.trial_id)
+        actor = self._actor_cls.options(
+            num_cpus=self._resources.get("CPU", 1.0),
+            resources={k: v for k, v in self._resources.items()
+                       if k != "CPU" and v > 0},
+            max_concurrency=4,
+        ).remote()
+        trial.actor = actor
+        ctx_kwargs = dict(
+            world_size=1, world_rank=0, local_rank=0, local_world_size=1,
+            node_rank=0, experiment_name=self._experiment_name,
+            trial_id=trial.trial_id, trial_name=trial.trial_id,
+            storage_path=self._storage_root,
+            trial_dir=trial.storage.trial_dir,
+        )
+        ray_tpu.get(actor.init_session.remote(
+            ctx_kwargs, trial.latest_checkpoint))
+        actor.start_training.remote(self._trainable, trial.config)
+        trial.status = RUNNING
+        ref = actor.next_result.remote()
+        self._pending_result[ref] = trial
+
+    def _stop_trial(self, trial: Trial, status: str = TERMINATED,
+                    error: Optional[str] = None) -> None:
+        trial.status = status
+        trial.error = error
+        if trial.actor is not None:
+            try:
+                ray_tpu.kill(trial.actor)
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+            trial.actor = None
+        self._scheduler.on_trial_complete(trial, trial.last_result)
+        self._searcher.on_trial_complete(
+            trial.trial_id, trial.last_result, error=status == ERROR)
+
+    def _maybe_create_trials(self) -> None:
+        while (not self._search_done
+               and sum(1 for t in self.trials if t.status == RUNNING)
+               + sum(1 for t in self.trials if t.status == PENDING)
+               < self._max_concurrent):
+            config = self._searcher.suggest(f"trial_{len(self.trials)}")
+            if config == Searcher.FINISHED:
+                self._search_done = True
+                return
+            if config is None:
+                return
+            trial = Trial(config, self._experiment_name)
+            self._scheduler.on_trial_add(trial)
+            self.trials.append(trial)
+
+    def _check_stop_criteria(self, result: Dict[str, Any]) -> bool:
+        for k, v in self._stop_criteria.items():
+            if k in result and result[k] >= v:
+                return True
+        return False
+
+    def _process_result(self, trial: Trial, payload: Optional[dict]) -> None:
+        if payload is None:  # train fn finished
+            self._stop_trial(trial, TERMINATED)
+            return
+        trial.num_results += 1
+        result = dict(payload["metrics"])
+        result.setdefault("training_iteration", trial.num_results)
+        result.setdefault("trial_id", trial.trial_id)
+        result["config"] = trial.config
+        trial.last_result = result
+        if payload["checkpoint_dir_name"] and trial.storage:
+            trial.latest_checkpoint = Checkpoint(
+                trial.storage.checkpoint_path(payload["checkpoint_dir_name"]))
+        trial.storage.append_result(result)
+        self._searcher.on_trial_result(trial.trial_id, result)
+        decision = self._scheduler.on_trial_result(trial, result)
+        if self._check_stop_criteria(result):
+            decision = TrialScheduler.STOP
+        if decision == TrialScheduler.STOP:
+            self._stop_trial(trial, TERMINATED)
+        elif decision == TrialScheduler.PAUSE and trial.pbt_exploit:
+            # PBT exploit/explore: restart with donor config + checkpoint.
+            exploit = trial.pbt_exploit
+            trial.pbt_exploit = None
+            self._stop_trial(trial, TERMINATED)
+            clone = Trial(exploit["config"], self._experiment_name)
+            clone.latest_checkpoint = exploit["checkpoint"]
+            self._scheduler.on_trial_add(clone)
+            self.trials.append(clone)
+        else:
+            ref = trial.actor.next_result.remote()
+            self._pending_result[ref] = trial
+
+    # -- the loop ------------------------------------------------------------
+
+    def step(self) -> bool:
+        """One event-loop turn. Returns False when everything is done."""
+        self._maybe_create_trials()
+        for trial in self.trials:
+            if trial.status == PENDING and (
+                    sum(1 for t in self.trials if t.status == RUNNING)
+                    < self._max_concurrent):
+                try:
+                    self._launch_trial(trial)
+                except Exception as e:  # noqa: BLE001 — actor start failure
+                    logger.exception("failed to launch trial %s", trial)
+                    self._stop_trial(trial, ERROR, str(e))
+        if not self._pending_result:
+            return any(t.status in (PENDING, RUNNING) for t in self.trials) \
+                or not self._search_done
+        ready, _ = ray_tpu.wait(
+            list(self._pending_result), num_returns=1, timeout=1.0)
+        for ref in ready:
+            trial = self._pending_result.pop(ref)
+            try:
+                payload = ray_tpu.get(ref)
+            except Exception as e:  # noqa: BLE001 — trainable raised / died
+                self._stop_trial(trial, ERROR, str(e))
+                continue
+            self._process_result(trial, payload)
+        return True
+
+    def run(self) -> List[Trial]:
+        try:
+            last_ckpt = 0.0
+            while self.step():
+                if time.monotonic() - last_ckpt > 5.0:
+                    self.save_experiment_state()
+                    last_ckpt = time.monotonic()
+        finally:
+            for t in self.trials:
+                if t.status == RUNNING:
+                    self._stop_trial(t, ERROR, "controller exited")
+            self.save_experiment_state()
+        return self.trials

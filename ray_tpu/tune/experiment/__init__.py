@@ -1,0 +1,1 @@
+from ray_tpu.tune.experiment.trial import Trial  # noqa: F401

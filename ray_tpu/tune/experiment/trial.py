@@ -1,0 +1,59 @@
+"""Trial bookkeeping (reference: ray python/ray/tune/experiment/trial.py —
+status lifecycle PENDING→RUNNING→TERMINATED/ERROR, per-trial storage dir,
+latest result/checkpoint tracking)."""
+
+from __future__ import annotations
+
+import uuid
+from typing import Any, Dict, Optional
+
+PENDING = "PENDING"
+RUNNING = "RUNNING"
+PAUSED = "PAUSED"
+TERMINATED = "TERMINATED"
+ERROR = "ERROR"
+
+
+class Trial:
+    def __init__(self, config: Dict[str, Any], experiment_name: str,
+                 trial_id: Optional[str] = None):
+        self.trial_id = trial_id or uuid.uuid4().hex[:8]
+        self.config = config
+        self.experiment_name = experiment_name
+        self.status = PENDING
+        self.last_result: Optional[Dict[str, Any]] = None
+        self.num_results = 0
+        self.error: Optional[str] = None
+        self.latest_checkpoint = None  # train.Checkpoint
+        self.actor = None
+        self.storage = None
+        self.restarts = 0
+        self.pbt_exploit: Optional[Dict[str, Any]] = None
+
+    def __repr__(self):
+        return f"Trial({self.trial_id}, {self.status})"
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "trial_id": self.trial_id,
+            "config": self.config,
+            "status": self.status,
+            "last_result": self.last_result,
+            "num_results": self.num_results,
+            "error": self.error,
+            "checkpoint_path": getattr(self.latest_checkpoint, "path", None),
+        }
+
+    @classmethod
+    def from_json(cls, data: Dict[str, Any], experiment_name: str) -> "Trial":
+        t = cls(data["config"], experiment_name, data["trial_id"])
+        t.status = data["status"]
+        t.last_result = data.get("last_result")
+        t.num_results = data.get("num_results", 0)
+        t.error = data.get("error")
+        p = data.get("checkpoint_path")
+        if p:
+            from ray_tpu.train.checkpoint import Checkpoint
+
+            t.latest_checkpoint = Checkpoint(p)
+        return t

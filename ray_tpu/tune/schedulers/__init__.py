@@ -1,0 +1,23 @@
+"""Trial schedulers (reference: ray python/ray/tune/schedulers/ —
+FIFOScheduler, ASHA async_hyperband.py, HyperBandScheduler, median stopping,
+PBT pbt.py)."""
+
+from ray_tpu.tune.schedulers.schedulers import (  # noqa: F401
+    ASHAScheduler,
+    AsyncHyperBandScheduler,
+    FIFOScheduler,
+    HyperBandScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+    TrialScheduler,
+)
+
+__all__ = [
+    "ASHAScheduler",
+    "AsyncHyperBandScheduler",
+    "FIFOScheduler",
+    "HyperBandScheduler",
+    "MedianStoppingRule",
+    "PopulationBasedTraining",
+    "TrialScheduler",
+]

@@ -1,0 +1,209 @@
+"""Trial schedulers.
+
+Reference semantics:
+- ASHA (ray python/ray/tune/schedulers/async_hyperband.py) — asynchronous
+  successive halving: rungs at grace_period * reduction_factor^k; a trial
+  reaching a rung continues only if in the top 1/reduction_factor of
+  completed results at that rung.
+- MedianStoppingRule (median_stopping_rule.py) — stop when a trial's best
+  result is worse than the median of running averages.
+- PBT (pbt.py) — at each perturbation_interval, bottom-quantile trials
+  exploit a top-quantile trial's checkpoint and explore (mutate) its config.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from collections import defaultdict
+from typing import Any, Callable, Dict, List, Optional
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+PAUSE = "PAUSE"
+
+
+class TrialScheduler:
+    CONTINUE = CONTINUE
+    STOP = STOP
+    PAUSE = PAUSE
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 metric: Optional[str] = None, mode: str = "max"):
+        self.time_attr = time_attr
+        self.metric = metric
+        self.mode = mode
+
+    def set_search_properties(self, metric, mode) -> bool:
+        if metric:
+            self.metric = metric
+        if mode:
+            self.mode = mode
+        return True
+
+    def _score(self, result: Dict[str, Any]) -> float:
+        v = result[self.metric]
+        return v if self.mode == "max" else -v
+
+    def on_trial_add(self, trial) -> None:
+        pass
+
+    def on_trial_result(self, trial, result: Dict[str, Any]) -> str:
+        return CONTINUE
+
+    def on_trial_complete(self, trial, result: Optional[Dict[str, Any]]) -> None:
+        pass
+
+
+class FIFOScheduler(TrialScheduler):
+    pass
+
+
+class AsyncHyperBandScheduler(TrialScheduler):
+    def __init__(self, time_attr="training_iteration", metric=None,
+                 mode="max", max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: float = 4, brackets: int = 1):
+        super().__init__(time_attr, metric, mode)
+        self.max_t = max_t
+        self.grace_period = grace_period
+        self.rf = reduction_factor
+        # rung milestones: grace * rf^k below max_t
+        self.milestones: List[float] = []
+        t = grace_period
+        while t < max_t:
+            self.milestones.append(t)
+            t *= reduction_factor
+        self._rung_results: Dict[float, List[float]] = defaultdict(list)
+
+    def on_trial_result(self, trial, result):
+        if self.metric not in result or self.time_attr not in result:
+            return CONTINUE
+        t = result[self.time_attr]
+        score = self._score(result)
+        action = CONTINUE
+        for milestone in self.milestones:
+            if t >= milestone and milestone not in getattr(
+                    trial, "_asha_rungs", set()):
+                rungs = getattr(trial, "_asha_rungs", set())
+                rungs.add(milestone)
+                trial._asha_rungs = rungs
+                recorded = self._rung_results[milestone]
+                recorded.append(score)
+                if len(recorded) >= self.rf:
+                    cutoff_idx = int(len(recorded) / self.rf)
+                    cutoff = sorted(recorded, reverse=True)[
+                        max(0, cutoff_idx - 1)]
+                    if score < cutoff:
+                        action = STOP
+        if t >= self.max_t:
+            action = STOP
+        return action
+
+
+ASHAScheduler = AsyncHyperBandScheduler
+
+
+class HyperBandScheduler(AsyncHyperBandScheduler):
+    """Synchronous Hyperband approximated by ASHA brackets (the reference
+    keeps both; ASHA dominates in practice — hyperband.py vs
+    async_hyperband.py)."""
+
+
+class MedianStoppingRule(TrialScheduler):
+    def __init__(self, time_attr="training_iteration", metric=None,
+                 mode="max", grace_period: int = 3, min_samples_required: int = 3):
+        super().__init__(time_attr, metric, mode)
+        self.grace_period = grace_period
+        self.min_samples = min_samples_required
+        self._avg: Dict[str, List[float]] = defaultdict(list)
+
+    def on_trial_result(self, trial, result):
+        if self.metric not in result:
+            return CONTINUE
+        tid = trial.trial_id
+        self._avg[tid].append(self._score(result))
+        t = result.get(self.time_attr, 0)
+        if t < self.grace_period or len(self._avg) < self.min_samples:
+            return CONTINUE
+        medians = sorted(
+            sum(v) / len(v) for k, v in self._avg.items() if k != tid)
+        if not medians:
+            return CONTINUE
+        median = medians[len(medians) // 2]
+        best = max(self._avg[tid])
+        return STOP if best < median else CONTINUE
+
+
+class PopulationBasedTraining(TrialScheduler):
+    def __init__(self, time_attr="training_iteration", metric=None,
+                 mode="max", perturbation_interval: int = 4,
+                 hyperparam_mutations: Optional[Dict[str, Any]] = None,
+                 quantile_fraction: float = 0.25,
+                 resample_probability: float = 0.25,
+                 seed: Optional[int] = None):
+        super().__init__(time_attr, metric, mode)
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.resample_prob = resample_probability
+        self._rng = random.Random(seed)
+        self._last_perturb: Dict[str, float] = {}
+        self._latest: Dict[str, float] = {}
+        self._trials: Dict[str, Any] = {}
+
+    def on_trial_add(self, trial):
+        self._trials[trial.trial_id] = trial
+
+    def _quantiles(self):
+        scored = [(tid, s) for tid, s in self._latest.items()]
+        if len(scored) < 2:
+            return [], []
+        scored.sort(key=lambda x: x[1])
+        n = max(1, int(math.ceil(len(scored) * self.quantile)))
+        bottom = [t for t, _ in scored[:n]]
+        top = [t for t, _ in scored[-n:]]
+        return bottom, top
+
+    def _explore(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        from ray_tpu.tune.search.sample import Domain
+
+        new = dict(config)
+        for k, spec in self.mutations.items():
+            if self._rng.random() < self.resample_prob or k not in new:
+                if isinstance(spec, Domain):
+                    new[k] = spec.sample(self._rng)
+                elif isinstance(spec, list):
+                    new[k] = self._rng.choice(spec)
+                elif callable(spec):
+                    new[k] = spec()
+            else:
+                factor = self._rng.choice([0.8, 1.2])
+                if isinstance(new[k], (int, float)) and not isinstance(
+                        new[k], bool):
+                    new[k] = type(new[k])(new[k] * factor)
+        return new
+
+    def on_trial_result(self, trial, result):
+        if self.metric not in result:
+            return CONTINUE
+        tid = trial.trial_id
+        self._latest[tid] = self._score(result)
+        t = result.get(self.time_attr, 0)
+        if t - self._last_perturb.get(tid, 0) < self.interval:
+            return CONTINUE
+        self._last_perturb[tid] = t
+        bottom, top = self._quantiles()
+        if tid in bottom and top:
+            donor_id = self._rng.choice(top)
+            donor = self._trials.get(donor_id)
+            if donor is not None and donor is not trial:
+                trial.pbt_exploit = {
+                    "donor": donor_id,
+                    "config": self._explore(dict(donor.config)),
+                    "checkpoint": getattr(donor, "latest_checkpoint", None),
+                }
+                return PAUSE  # controller restarts the trial with new config
+        return CONTINUE
+
+    def on_trial_complete(self, trial, result):
+        self._latest.pop(trial.trial_id, None)

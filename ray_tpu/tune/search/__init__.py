@@ -1,0 +1,40 @@
+"""Search algorithms (reference: ray python/ray/tune/search/ —
+BasicVariantGenerator grid/random in basic_variant.py, Searcher base in
+searcher.py, ConcurrencyLimiter in search_generator.py)."""
+
+from ray_tpu.tune.search.basic_variant import BasicVariantGenerator  # noqa: F401
+from ray_tpu.tune.search.sample import (  # noqa: F401
+    Categorical,
+    Domain,
+    Float,
+    Integer,
+    choice,
+    grid_search,
+    lograndint,
+    loguniform,
+    qrandint,
+    quniform,
+    randint,
+    randn,
+    uniform,
+)
+from ray_tpu.tune.search.searcher import ConcurrencyLimiter, Searcher  # noqa: F401
+
+__all__ = [
+    "BasicVariantGenerator",
+    "Categorical",
+    "ConcurrencyLimiter",
+    "Domain",
+    "Float",
+    "Integer",
+    "Searcher",
+    "choice",
+    "grid_search",
+    "lograndint",
+    "loguniform",
+    "qrandint",
+    "quniform",
+    "randint",
+    "randn",
+    "uniform",
+]

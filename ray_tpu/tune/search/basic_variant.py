@@ -1,0 +1,51 @@
+"""Grid/random search (reference: ray python/ray/tune/search/basic_variant.py
+— grid_search markers expanded to a cartesian product, each variant's Domain
+leaves sampled num_samples times)."""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Dict, Optional
+
+from ray_tpu.tune.search.sample import expand_grid, resolve_config
+from ray_tpu.tune.search.searcher import Searcher
+
+
+class BasicVariantGenerator(Searcher):
+    def __init__(self, space: Optional[Dict[str, Any]] = None,
+                 num_samples: int = 1, seed: Optional[int] = None,
+                 metric=None, mode: str = "max"):
+        super().__init__(metric, mode)
+        self._space = space or {}
+        self._num_samples = num_samples
+        self._rng = random.Random(seed)
+        self._queue = None
+
+    def set_search_properties(self, metric, mode, config) -> bool:
+        super().set_search_properties(metric, mode, config)
+        if config:
+            self._space = config
+        return True
+
+    def _build_queue(self):
+        variants = expand_grid(self._space)
+        self._queue = [
+            v for _ in range(self._num_samples) for v in variants
+        ]
+
+    @property
+    def total_trials(self) -> int:
+        if self._queue is None:
+            self._build_queue()
+        return self._generated + len(self._queue)
+
+    _generated = 0
+
+    def suggest(self, trial_id: str):
+        if self._queue is None:
+            self._build_queue()
+        if not self._queue:
+            return Searcher.FINISHED
+        variant = self._queue.pop(0)
+        self._generated += 1
+        return resolve_config(variant, self._rng)

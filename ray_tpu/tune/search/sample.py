@@ -1,0 +1,141 @@
+"""Search-space primitives (reference: ray python/ray/tune/search/sample.py —
+Domain/Float/Integer/Categorical samplers and the grid_search marker dict)."""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Dict, List, Sequence
+
+
+def grid_search(values: Sequence[Any]) -> Dict[str, Any]:
+    return {"grid_search": list(values)}
+
+
+class Domain:
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+class Float(Domain):
+    def __init__(self, lower: float, upper: float, log: bool = False,
+                 q: float = None, normal: bool = False):
+        self.lower, self.upper, self.log, self.q = lower, upper, log, q
+        self.normal = normal
+
+    def sample(self, rng: random.Random) -> float:
+        import math
+
+        if self.normal:
+            v = rng.gauss(self.lower, self.upper)  # (mean, sd)
+        elif self.log:
+            v = math.exp(rng.uniform(math.log(self.lower),
+                                     math.log(self.upper)))
+        else:
+            v = rng.uniform(self.lower, self.upper)
+        if self.q:
+            v = round(v / self.q) * self.q
+        return v
+
+
+class Integer(Domain):
+    def __init__(self, lower: int, upper: int, log: bool = False, q: int = None):
+        self.lower, self.upper, self.log, self.q = lower, upper, log, q
+
+    def sample(self, rng: random.Random) -> int:
+        import math
+
+        if self.log:
+            v = int(math.exp(rng.uniform(math.log(self.lower),
+                                         math.log(self.upper))))
+        else:
+            v = rng.randint(self.lower, self.upper - 1 if self.q is None
+                            else self.upper)
+        if self.q:
+            v = int(round(v / self.q) * self.q)
+        return max(self.lower, min(v, self.upper))
+
+
+class Categorical(Domain):
+    def __init__(self, categories: Sequence[Any]):
+        self.categories = list(categories)
+
+    def sample(self, rng: random.Random) -> Any:
+        return rng.choice(self.categories)
+
+
+def uniform(lower: float, upper: float) -> Float:
+    return Float(lower, upper)
+
+
+def quniform(lower: float, upper: float, q: float) -> Float:
+    return Float(lower, upper, q=q)
+
+
+def loguniform(lower: float, upper: float) -> Float:
+    return Float(lower, upper, log=True)
+
+
+def randn(mean: float = 0.0, sd: float = 1.0) -> Float:
+    return Float(mean, sd, normal=True)
+
+
+def randint(lower: int, upper: int) -> Integer:
+    return Integer(lower, upper)
+
+
+def qrandint(lower: int, upper: int, q: int) -> Integer:
+    return Integer(lower, upper, q=q)
+
+
+def lograndint(lower: int, upper: int) -> Integer:
+    return Integer(lower, upper, log=True)
+
+
+def choice(categories: Sequence[Any]) -> Categorical:
+    return Categorical(categories)
+
+
+def resolve_config(space: Dict[str, Any], rng: random.Random) -> Dict[str, Any]:
+    """Sample every Domain leaf; grid_search markers must be expanded first
+    (BasicVariantGenerator does that)."""
+    out = {}
+    for k, v in space.items():
+        if isinstance(v, Domain):
+            out[k] = v.sample(rng)
+        elif isinstance(v, dict) and "grid_search" not in v:
+            out[k] = resolve_config(v, rng)
+        else:
+            out[k] = v
+    return out
+
+
+def expand_grid(space: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Cartesian product over every {"grid_search": [...]} marker."""
+    import itertools
+
+    grid_keys = []
+    grid_vals = []
+
+    def find(prefix, d):
+        for k, v in d.items():
+            if isinstance(v, dict) and "grid_search" in v:
+                grid_keys.append(prefix + (k,))
+                grid_vals.append(v["grid_search"])
+            elif isinstance(v, dict):
+                find(prefix + (k,), v)
+
+    find((), space)
+    if not grid_keys:
+        return [space]
+    variants = []
+    for combo in itertools.product(*grid_vals):
+        import copy
+
+        var = copy.deepcopy(space)
+        for path, value in zip(grid_keys, combo):
+            d = var
+            for p in path[:-1]:
+                d = d[p]
+            d[path[-1]] = value
+        variants.append(var)
+    return variants

@@ -1,0 +1,62 @@
+"""Searcher base + ConcurrencyLimiter (reference: ray
+python/ray/tune/search/searcher.py, concurrency_limiter.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+
+class Searcher:
+    FINISHED = "FINISHED"
+
+    def __init__(self, metric: Optional[str] = None, mode: str = "max"):
+        self.metric = metric
+        self.mode = mode
+
+    def set_search_properties(self, metric: Optional[str], mode: Optional[str],
+                              config: Dict[str, Any]) -> bool:
+        if metric:
+            self.metric = metric
+        if mode:
+            self.mode = mode
+        return True
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        """Next config, None to wait, or Searcher.FINISHED."""
+        raise NotImplementedError
+
+    def on_trial_result(self, trial_id: str, result: Dict[str, Any]) -> None:
+        pass
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict[str, Any]] = None,
+                          error: bool = False) -> None:
+        pass
+
+
+class ConcurrencyLimiter(Searcher):
+    def __init__(self, searcher: Searcher, max_concurrent: int):
+        super().__init__(searcher.metric, searcher.mode)
+        self.searcher = searcher
+        self.max_concurrent = max_concurrent
+        self._live: set = set()
+
+    def set_search_properties(self, metric, mode, config) -> bool:
+        ok = self.searcher.set_search_properties(metric, mode, config)
+        self.metric, self.mode = self.searcher.metric, self.searcher.mode
+        return ok
+
+    def suggest(self, trial_id: str):
+        if len(self._live) >= self.max_concurrent:
+            return None
+        out = self.searcher.suggest(trial_id)
+        if out is not None and out != Searcher.FINISHED:
+            self._live.add(trial_id)
+        return out
+
+    def on_trial_result(self, trial_id, result):
+        self.searcher.on_trial_result(trial_id, result)
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        self._live.discard(trial_id)
+        self.searcher.on_trial_complete(trial_id, result, error)

@@ -1,0 +1,133 @@
+"""Tuner / TuneConfig / ResultGrid (reference: ray python/ray/tune/tuner.py:44
+Tuner.fit, :171 Tuner.restore; tune_config.py; result_grid.py)."""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Callable, Dict, List, Optional, Union
+
+from ray_tpu.air import Result, RunConfig
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.tune.execution.tune_controller import TuneController
+from ray_tpu.tune.experiment.trial import ERROR, Trial
+from ray_tpu.tune.schedulers import TrialScheduler
+from ray_tpu.tune.search import Searcher
+
+
+@dataclasses.dataclass
+class TuneConfig:
+    metric: Optional[str] = None
+    mode: str = "max"
+    num_samples: int = 1
+    search_alg: Optional[Searcher] = None
+    scheduler: Optional[TrialScheduler] = None
+    max_concurrent_trials: Optional[int] = None
+    time_budget_s: Optional[float] = None
+    reuse_actors: bool = False
+
+
+class ResultGrid:
+    def __init__(self, results: List[Result], metric=None, mode="max"):
+        self._results = results
+        self._metric = metric
+        self._mode = mode
+
+    def __len__(self):
+        return len(self._results)
+
+    def __getitem__(self, i) -> Result:
+        return self._results[i]
+
+    def __iter__(self):
+        return iter(self._results)
+
+    @property
+    def errors(self) -> List[Exception]:
+        return [r.error for r in self._results if r.error is not None]
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> Result:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        if metric is None:
+            raise ValueError("metric required (set TuneConfig.metric)")
+        sign = 1 if mode == "max" else -1
+        candidates = [r for r in self._results
+                      if r.metrics and metric in r.metrics]
+        if not candidates:
+            raise RuntimeError("no trial reported the metric "
+                               f"{metric!r}")
+        return max(candidates, key=lambda r: sign * r.metrics[metric])
+
+    def get_dataframe(self):
+        import pandas as pd
+
+        return pd.DataFrame([r.metrics or {} for r in self._results])
+
+
+class Tuner:
+    def __init__(
+        self,
+        trainable: Union[Callable, Any],
+        *,
+        param_space: Optional[Dict[str, Any]] = None,
+        tune_config: Optional[TuneConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        _restored_trials: Optional[List[Trial]] = None,
+    ):
+        from ray_tpu.train.trainer import BaseTrainer
+
+        if isinstance(trainable, BaseTrainer):
+            trainable = trainable.as_trainable()
+        self._trainable = trainable
+        self._param_space = param_space or {}
+        self._tune_config = tune_config or TuneConfig()
+        self._run_config = run_config or RunConfig()
+        self._restored_trials = _restored_trials
+
+    def fit(self) -> ResultGrid:
+        tc = self._tune_config
+        controller = TuneController(
+            self._trainable,
+            param_space=self._param_space,
+            searcher=tc.search_alg,
+            scheduler=tc.scheduler,
+            num_samples=tc.num_samples,
+            metric=tc.metric,
+            mode=tc.mode,
+            max_concurrent_trials=tc.max_concurrent_trials,
+            storage_path=self._run_config.storage_path,
+            experiment_name=self._run_config.name,
+            stop=self._run_config.stop,
+        )
+        if self._restored_trials:
+            controller.restore_trials(self._restored_trials)
+            controller._search_done = True
+        trials = controller.run()
+        results = [
+            Result(
+                metrics=t.last_result,
+                checkpoint=t.latest_checkpoint,
+                path=t.storage.trial_dir if t.storage else None,
+                error=RuntimeError(t.error) if t.status == ERROR else None,
+            )
+            for t in trials
+        ]
+        return ResultGrid(results, tc.metric, tc.mode)
+
+    @classmethod
+    def restore(cls, path: str, trainable: Callable,
+                resume_errored: bool = True) -> "Tuner":
+        trials = TuneController.load_experiment_state(path)
+        if not resume_errored:
+            trials = [t for t in trials if t.status != ERROR]
+        run_config = RunConfig(
+            name=os.path.basename(os.path.normpath(path)),
+            storage_path=os.path.dirname(os.path.normpath(path)),
+        )
+        return cls(trainable, run_config=run_config, _restored_trials=trials)
+
+    @classmethod
+    def can_restore(cls, path: str) -> bool:
+        return os.path.exists(os.path.join(path, "tuner_state.json"))

@@ -6,3 +6,14 @@ from ray_tpu.util.placement_group import (  # noqa: F401
     placement_group_table,
     remove_placement_group,
 )
+from ray_tpu.util.queue import Queue  # noqa: F401
+
+__all__ = [
+    "ActorPool",
+    "PlacementGroup",
+    "Queue",
+    "get_placement_group",
+    "placement_group",
+    "placement_group_table",
+    "remove_placement_group",
+]

@@ -1,0 +1,153 @@
+"""User-defined metrics (reference: ray python/ray/util/metrics.py —
+Counter/Gauge/Histogram with tag_keys; exported in Prometheus exposition
+format by the node metrics agent, ray _private/metrics_agent.py +
+prometheus_exporter.py — here a per-process registry that the dashboard's
+/metrics endpoint scrapes)."""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_registry_lock = threading.Lock()
+_registry: List["Metric"] = []
+
+
+def _tags_key(tags: Optional[Dict[str, str]]) -> Tuple:
+    return tuple(sorted((tags or {}).items()))
+
+
+class Metric:
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Optional[Sequence[str]] = None):
+        if not name:
+            raise ValueError("metric name is required")
+        self._name = name
+        self._description = description
+        self._tag_keys = tuple(tag_keys or ())
+        self._default_tags: Dict[str, str] = {}
+        self._lock = threading.Lock()
+        with _registry_lock:
+            _registry.append(self)
+
+    def set_default_tags(self, tags: Dict[str, str]) -> "Metric":
+        self._default_tags = dict(tags)
+        return self
+
+    def _merged(self, tags: Optional[Dict[str, str]]) -> Dict[str, str]:
+        out = dict(self._default_tags)
+        out.update(tags or {})
+        unknown = set(out) - set(self._tag_keys)
+        if unknown:
+            raise ValueError(f"unknown tag keys {unknown}; declared "
+                             f"{self._tag_keys}")
+        return out
+
+    @property
+    def info(self) -> Dict:
+        return {"name": self._name, "description": self._description,
+                "tag_keys": self._tag_keys}
+
+    def _samples(self) -> List[Tuple[str, Dict[str, str], float]]:
+        raise NotImplementedError
+
+
+class Counter(Metric):
+    def __init__(self, name, description="", tag_keys=None):
+        super().__init__(name, description, tag_keys)
+        self._values: Dict[Tuple, float] = defaultdict(float)
+
+    def inc(self, value: float = 1.0,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        if value <= 0:
+            raise ValueError("Counter.inc value must be positive")
+        merged = self._merged(tags)
+        with self._lock:
+            self._values[_tags_key(merged)] += value
+
+    def _samples(self):
+        with self._lock:
+            return [(self._name, dict(k), v)
+                    for k, v in self._values.items()]
+
+
+class Gauge(Metric):
+    def __init__(self, name, description="", tag_keys=None):
+        super().__init__(name, description, tag_keys)
+        self._values: Dict[Tuple, float] = {}
+
+    def set(self, value: float,  # noqa: A003
+            tags: Optional[Dict[str, str]] = None) -> None:
+        merged = self._merged(tags)
+        with self._lock:
+            self._values[_tags_key(merged)] = float(value)
+
+    def _samples(self):
+        with self._lock:
+            return [(self._name, dict(k), v)
+                    for k, v in self._values.items()]
+
+
+class Histogram(Metric):
+    def __init__(self, name, description="", boundaries=None, tag_keys=None):
+        super().__init__(name, description, tag_keys)
+        if not boundaries:
+            boundaries = [0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10, 50, 100]
+        self._boundaries = sorted(boundaries)
+        self._counts: Dict[Tuple, List[int]] = {}
+        self._sums: Dict[Tuple, float] = defaultdict(float)
+        self._totals: Dict[Tuple, int] = defaultdict(int)
+
+    def observe(self, value: float,
+                tags: Optional[Dict[str, str]] = None) -> None:
+        merged = self._merged(tags)
+        key = _tags_key(merged)
+        with self._lock:
+            buckets = self._counts.setdefault(
+                key, [0] * (len(self._boundaries) + 1))
+            idx = len(self._boundaries)
+            for i, b in enumerate(self._boundaries):
+                if value <= b:
+                    idx = i
+                    break
+            buckets[idx] += 1
+            self._sums[key] += value
+            self._totals[key] += 1
+
+    def _samples(self):
+        out = []
+        with self._lock:
+            for key, buckets in self._counts.items():
+                tags = dict(key)
+                cum = 0
+                for b, c in zip(self._boundaries, buckets):
+                    cum += c
+                    out.append((f"{self._name}_bucket",
+                                {**tags, "le": str(b)}, cum))
+                out.append((f"{self._name}_bucket",
+                            {**tags, "le": "+Inf"}, self._totals[key]))
+                out.append((f"{self._name}_sum", tags, self._sums[key]))
+                out.append((f"{self._name}_count", tags, self._totals[key]))
+        return out
+
+
+def prometheus_text() -> str:
+    """All registered metrics in Prometheus exposition format."""
+    lines: List[str] = []
+    with _registry_lock:
+        metrics = list(_registry)
+    for m in metrics:
+        if m._description:
+            lines.append(f"# HELP {m._name} {m._description}")
+        kind = {"Counter": "counter", "Gauge": "gauge",
+                "Histogram": "histogram"}.get(type(m).__name__, "untyped")
+        lines.append(f"# TYPE {m._name} {kind}")
+        for name, tags, value in m._samples():
+            if tags:
+                tag_str = ",".join(
+                    f'{k}="{v}"' for k, v in sorted(tags.items()))
+                lines.append(f"{name}{{{tag_str}}} {value}")
+            else:
+                lines.append(f"{name} {value}")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,3 @@
+from ray_tpu.util.multiprocessing.pool import Pool  # noqa: F401
+
+__all__ = ["Pool"]

@@ -1,0 +1,139 @@
+"""multiprocessing.Pool API over actors (reference: ray
+python/ray/util/multiprocessing/pool.py — Pool of actor workers exposing
+map/starmap/apply/imap with the stdlib signature)."""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Iterable, List, Optional
+
+import ray_tpu
+from ray_tpu.util.actor_pool import ActorPool
+
+
+class _PoolWorker:
+    def run(self, fn, args, kwargs):
+        return fn(*args, **kwargs)
+
+    def run_batch(self, fn, chunk):
+        return [fn(*a) for a in chunk]
+
+
+class AsyncResult:
+    def __init__(self, refs: List[Any], single: bool = False):
+        self._refs = refs
+        self._single = single
+
+    def get(self, timeout: Optional[float] = None):
+        out = ray_tpu.get(self._refs, timeout=timeout)
+        return out[0] if self._single else out
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        ray_tpu.wait(self._refs, num_returns=len(self._refs), timeout=timeout)
+
+    def ready(self) -> bool:
+        ready, _ = ray_tpu.wait(
+            self._refs, num_returns=len(self._refs), timeout=0)
+        return len(ready) == len(self._refs)
+
+    def successful(self) -> bool:
+        try:
+            self.get(timeout=0)
+            return True
+        except Exception:  # noqa: BLE001
+            return False
+
+
+class Pool:
+    def __init__(self, processes: Optional[int] = None,
+                 initializer: Optional[Callable] = None,
+                 initargs: tuple = (), ray_remote_args: Optional[dict] = None):
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        if processes is None:
+            processes = max(1, int(
+                ray_tpu.cluster_resources().get("CPU", 1)))
+        self._n = processes
+        opts = dict(ray_remote_args or {})
+        opts.setdefault("num_cpus", 1)
+        cls = ray_tpu.remote(_PoolWorker)
+        self._workers = [cls.options(**opts).remote()
+                         for _ in range(processes)]
+        if initializer:
+            ray_tpu.get([
+                w.run.remote(initializer, initargs, {})
+                for w in self._workers])
+        self._rr = itertools.cycle(range(processes))
+        self._closed = False
+
+    def _next_worker(self):
+        return self._workers[next(self._rr)]
+
+    def apply(self, fn, args: tuple = (), kwds: Optional[dict] = None):
+        return ray_tpu.get(
+            self._next_worker().run.remote(fn, args, kwds or {}))
+
+    def apply_async(self, fn, args: tuple = (), kwds: Optional[dict] = None,
+                    callback=None, error_callback=None) -> AsyncResult:
+        ref = self._next_worker().run.remote(fn, args, kwds or {})
+        return AsyncResult([ref], single=True)
+
+    def _chunks(self, iterable: Iterable, chunksize: Optional[int]):
+        items = [(x,) if not isinstance(x, tuple) else x for x in iterable]
+        if chunksize is None:
+            chunksize = max(1, len(items) // (self._n * 4) or 1)
+        return [items[i:i + chunksize]
+                for i in range(0, len(items), chunksize)], chunksize
+
+    def map(self, fn, iterable: Iterable,
+            chunksize: Optional[int] = None) -> List[Any]:
+        return self.starmap(fn, [(x,) for x in iterable], chunksize)
+
+    def map_async(self, fn, iterable: Iterable,
+                  chunksize: Optional[int] = None) -> AsyncResult:
+        chunks, _ = self._chunks([(x,) for x in iterable], chunksize)
+        refs = [self._next_worker().run_batch.remote(fn, c) for c in chunks]
+        return _FlattenResult(refs)
+
+    def starmap(self, fn, iterable: Iterable,
+                chunksize: Optional[int] = None) -> List[Any]:
+        chunks, _ = self._chunks(iterable, chunksize)
+        out = ray_tpu.get([
+            self._next_worker().run_batch.remote(fn, c) for c in chunks])
+        return [x for chunk in out for x in chunk]
+
+    def imap(self, fn, iterable: Iterable,
+             chunksize: Optional[int] = None):
+        chunks, _ = self._chunks([(x,) for x in iterable], chunksize)
+        refs = [self._next_worker().run_batch.remote(fn, c) for c in chunks]
+        for ref in refs:
+            yield from ray_tpu.get(ref)
+
+    imap_unordered = imap
+
+    def close(self) -> None:
+        self._closed = True
+
+    def terminate(self) -> None:
+        for w in self._workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:  # noqa: BLE001
+                pass
+        self._closed = True
+
+    def join(self) -> None:
+        if not self._closed:
+            raise ValueError("Pool is still open")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.terminate()
+
+
+class _FlattenResult(AsyncResult):
+    def get(self, timeout: Optional[float] = None):
+        out = ray_tpu.get(self._refs, timeout=timeout)
+        return [x for chunk in out for x in chunk]

@@ -1,0 +1,110 @@
+"""Actor-backed distributed queue (reference: ray python/ray/util/queue.py —
+Queue over a _QueueActor with put/get/qsize/empty/full and batch variants)."""
+
+from __future__ import annotations
+
+import time
+from typing import Any, List, Optional
+
+import ray_tpu
+
+
+class Empty(Exception):
+    pass
+
+
+class Full(Exception):
+    pass
+
+
+class _QueueActor:
+    def __init__(self, maxsize: int):
+        self._maxsize = maxsize
+        self._items: List[Any] = []
+
+    def qsize(self) -> int:
+        return len(self._items)
+
+    def put(self, item) -> bool:
+        if self._maxsize > 0 and len(self._items) >= self._maxsize:
+            return False
+        self._items.append(item)
+        return True
+
+    def put_batch(self, items: List[Any]) -> int:
+        n = 0
+        for it in items:
+            if not self.put(it):
+                break
+            n += 1
+        return n
+
+    def get(self) -> tuple:
+        if not self._items:
+            return (False, None)
+        return (True, self._items.pop(0))
+
+    def get_batch(self, n: int) -> List[Any]:
+        out, self._items = self._items[:n], self._items[n:]
+        return out
+
+
+class Queue:
+    def __init__(self, maxsize: int = 0, actor_options: Optional[dict] = None):
+        opts = dict(actor_options or {})
+        opts.setdefault("num_cpus", 0.1)
+        self.actor = ray_tpu.remote(_QueueActor).options(**opts).remote(maxsize)
+        self.maxsize = maxsize
+
+    def qsize(self) -> int:
+        return ray_tpu.get(self.actor.qsize.remote())
+
+    def empty(self) -> bool:
+        return self.qsize() == 0
+
+    def full(self) -> bool:
+        return self.maxsize > 0 and self.qsize() >= self.maxsize
+
+    def put(self, item, block: bool = True,
+            timeout: Optional[float] = None) -> None:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            if ray_tpu.get(self.actor.put.remote(item)):
+                return
+            if not block:
+                raise Full()
+            if deadline is not None and time.monotonic() > deadline:
+                raise Full()
+            time.sleep(0.05)
+
+    def put_nowait(self, item) -> None:
+        self.put(item, block=False)
+
+    def get(self, block: bool = True, timeout: Optional[float] = None) -> Any:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            ok, item = ray_tpu.get(self.actor.get.remote())
+            if ok:
+                return item
+            if not block:
+                raise Empty()
+            if deadline is not None and time.monotonic() > deadline:
+                raise Empty()
+            time.sleep(0.05)
+
+    def get_nowait(self) -> Any:
+        return self.get(block=False)
+
+    def put_nowait_batch(self, items: List[Any]) -> None:
+        n = ray_tpu.get(self.actor.put_batch.remote(items))
+        if n < len(items):
+            raise Full(f"queue accepted only {n}/{len(items)} items")
+
+    def get_nowait_batch(self, n: int) -> List[Any]:
+        items = ray_tpu.get(self.actor.get_batch.remote(n))
+        if len(items) < n:
+            raise Empty(f"queue had only {len(items)}/{n} items")
+        return items
+
+    def shutdown(self) -> None:
+        ray_tpu.kill(self.actor)

@@ -1,0 +1,29 @@
+from ray_tpu.util.state.api import (  # noqa: F401
+    get_actor,
+    get_node,
+    get_task,
+    list_actors,
+    list_jobs,
+    list_nodes,
+    list_objects,
+    list_placement_groups,
+    list_tasks,
+    list_workers,
+    summarize_actors,
+    summarize_tasks,
+)
+
+__all__ = [
+    "get_actor",
+    "get_node",
+    "get_task",
+    "list_actors",
+    "list_jobs",
+    "list_nodes",
+    "list_objects",
+    "list_placement_groups",
+    "list_tasks",
+    "list_workers",
+    "summarize_actors",
+    "summarize_tasks",
+]

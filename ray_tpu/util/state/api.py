@@ -1,0 +1,182 @@
+"""State API (reference: ray python/ray/util/state/api.py — list_actors
+:781, list_tasks :1008, list_nodes/objects/jobs/placement_groups/workers;
+data sourced from GCS task events + managers, like the reference's
+state_aggregator behind the dashboard's state_head).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._raylet import get_core_worker
+
+
+def _gcs():
+    return get_core_worker()._gcs
+
+
+def list_nodes(filters=None, limit: int = 100, **_kw) -> List[Dict[str, Any]]:
+    nodes = _gcs().call("get_all_node_info", {})
+    out = [
+        {
+            "node_id": n.node_id.hex(),
+            "state": "ALIVE" if n.alive else "DEAD",
+            "node_ip": n.raylet_address.split(":")[0]
+            if n.raylet_address else None,
+            "raylet_address": n.raylet_address,
+            "resources_total": dict(n.resources_total),
+            "resources_available": dict(n.resources_available),
+            "labels": dict(n.labels),
+            "is_head_node": n.is_head,
+        }
+        for n in nodes
+    ]
+    return _apply_filters(out, filters)[:limit]
+
+
+def list_actors(filters=None, limit: int = 100, **_kw) -> List[Dict[str, Any]]:
+    actors = _gcs().call("list_actors", {})
+    out = [
+        {
+            "actor_id": a.actor_id.hex(),
+            "state": a.state.name if hasattr(a.state, "name") else str(a.state),
+            "name": a.name or "",
+            "class_name": a.class_name,
+            "address": a.address.rpc_address
+            if a.address is not None else None,
+            "pid": a.pid,
+            "restarts": a.num_restarts,
+            "is_detached": a.is_detached,
+        }
+        for a in actors
+    ]
+    return _apply_filters(out, filters)[:limit]
+
+
+def list_tasks(filters=None, limit: int = 100,
+               job_id: Optional[str] = None, **_kw) -> List[Dict[str, Any]]:
+    events = _gcs().call("get_task_events", {"job_id": job_id, "limit": 10_000})
+    # Collapse events to latest-state per task (the reference's state
+    # aggregation over gcs task events).
+    latest: Dict[str, Dict[str, Any]] = {}
+    for ev in reversed(events):
+        latest[ev["task_id"]] = ev
+    out = [
+        {
+            "task_id": ev["task_id"],
+            "name": ev["name"],
+            "state": ev["state"],
+            "type": ev["type"],
+            "job_id": ev.get("job_id"),
+            "node_id": ev.get("node"),
+            "worker_id": ev.get("worker_id"),
+        }
+        for ev in latest.values()
+    ]
+    return _apply_filters(out, filters)[:limit]
+
+
+def list_jobs(filters=None, limit: int = 100, **_kw) -> List[Dict[str, Any]]:
+    jobs = _gcs().call("get_all_job_info", {})
+    out = [
+        {
+            "job_id": j.job_id.hex() if hasattr(j.job_id, "hex") else str(j.job_id),
+            "is_dead": j.is_dead,
+            "driver_address": j.driver_address,
+            "namespace": j.namespace,
+        }
+        for j in jobs
+    ]
+    return _apply_filters(out, filters)[:limit]
+
+
+def list_placement_groups(filters=None, limit: int = 100,
+                          **_kw) -> List[Dict[str, Any]]:
+    from ray_tpu.util.placement_group import placement_group_table
+
+    table = placement_group_table()
+    out = []
+    for pg_id, info in table.items():
+        row = dict(info)
+        row["placement_group_id"] = pg_id
+        out.append(row)
+    return _apply_filters(out, filters)[:limit]
+
+
+def list_objects(filters=None, limit: int = 100, **_kw) -> List[Dict[str, Any]]:
+    """Objects known to THIS worker's reference counter (the reference
+    aggregates per-worker core-worker stats; ray memory does the same)."""
+    cw = get_core_worker()
+    out = []
+    for oid, ref in cw.reference_counter.snapshot().items():
+        out.append({
+            "object_id": oid.hex(),
+            "local_refs": ref.local_refs,
+            "submitted_task_refs": ref.submitted_task_refs,
+            "pinned": ref.pinned,
+            "owned": ref.owned,
+            "borrowers": len(ref.borrowers),
+            "location": ref.location,
+        })
+    return _apply_filters(out, filters)[:limit]
+
+
+def list_workers(filters=None, limit: int = 100, **_kw) -> List[Dict[str, Any]]:
+    actors = list_actors(limit=limit)
+    # Worker-level view: one row per live actor process + the driver.
+    cw = get_core_worker()
+    rows = [{"worker_id": cw.worker_id.hex(), "worker_type": "DRIVER",
+             "pid": __import__("os").getpid()}]
+    for a in actors:
+        if a["pid"]:
+            rows.append({"worker_id": None, "worker_type": "WORKER",
+                         "pid": a["pid"], "actor_id": a["actor_id"]})
+    return _apply_filters(rows, filters)[:limit]
+
+
+def get_actor(actor_id: str) -> Optional[Dict[str, Any]]:
+    for a in list_actors(limit=100_000):
+        if a["actor_id"] == actor_id:
+            return a
+    return None
+
+
+def get_node(node_id: str) -> Optional[Dict[str, Any]]:
+    for n in list_nodes(limit=100_000):
+        if n["node_id"] == node_id:
+            return n
+    return None
+
+
+def get_task(task_id: str) -> Optional[Dict[str, Any]]:
+    for t in list_tasks(limit=100_000):
+        if t["task_id"] == task_id:
+            return t
+    return None
+
+
+def summarize_tasks() -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for t in list_tasks(limit=100_000):
+        out[t["state"]] = out.get(t["state"], 0) + 1
+    return out
+
+
+def summarize_actors() -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for a in list_actors(limit=100_000):
+        out[a["state"]] = out.get(a["state"], 0) + 1
+    return out
+
+
+def _apply_filters(rows: List[Dict[str, Any]], filters) -> List[Dict[str, Any]]:
+    if not filters:
+        return rows
+    for key, op, value in filters:
+        if op == "=":
+            rows = [r for r in rows if str(r.get(key)) == str(value)]
+        elif op == "!=":
+            rows = [r for r in rows if str(r.get(key)) != str(value)]
+        else:
+            raise ValueError(f"unsupported filter op {op!r}")
+    return rows

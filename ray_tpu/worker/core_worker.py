@@ -575,16 +575,20 @@ class CoreWorker:
             spec=spec, retries_left=max_retries, arg_ids=arg_ids
         )
         lineage = spec if CONFIG.enable_lineage_reconstruction else None
+        self._record_task_event(spec, "PENDING")
+        if streaming:
+            # Item oids are registered as owned when each item is reported
+            # (_handle_report_generator_item). Creating return refs here
+            # would alias item 0's oid and free it from a discarded ref on a
+            # GC-dependent schedule.
+            self._generators[task_id] = _GeneratorState()
+            self._normal_submit(spec)
+            return ObjectRefGenerator(task_id)
         return_refs = []
         for oid in spec.return_ids():
             self.reference_counter.add_owned(oid, self.address, lineage_task=lineage)
             return_refs.append(ObjectRef(oid, owner_address=self.address))
-        self._record_task_event(spec, "PENDING")
-        if streaming:
-            self._generators[task_id] = _GeneratorState()
         self._normal_submit(spec)
-        if streaming:
-            return ObjectRefGenerator(task_id)
         return return_refs
 
     def _normal_submit(self, spec: TaskSpec):
@@ -1020,15 +1024,16 @@ class CoreWorker:
             spec=spec, retries_left=rec.max_task_retries, is_actor_task=True,
             arg_ids=arg_ids,
         )
+        if streaming:
+            # See submit_task: item oids are owned at report time, not here.
+            self._generators[task_id] = _GeneratorState()
+            self._actor_submit(spec)
+            return ObjectRefGenerator(task_id)
         return_refs = []
         for oid in spec.return_ids():
             self.reference_counter.add_owned(oid, self.address)
             return_refs.append(ObjectRef(oid, owner_address=self.address))
-        if streaming:
-            self._generators[task_id] = _GeneratorState()
         self._actor_submit(spec)
-        if streaming:
-            return ObjectRefGenerator(task_id)
         return return_refs
 
     def _actor_submit(self, spec: TaskSpec):
@@ -1311,8 +1316,13 @@ class CoreWorker:
         if state is None:
             return None
         with state.cv:
+            # End-of-stream requires total set AND all items reported: the
+            # task-completion reply (which carries total) travels on a
+            # different channel than item reports and may arrive first.
             state.cv.wait_for(
-                lambda: state.reported > consumed or state.total is not None,
+                lambda: state.reported > consumed
+                or state.error is not None
+                or (state.total is not None and state.reported >= state.total),
                 timeout,
             )
             if state.reported > consumed:

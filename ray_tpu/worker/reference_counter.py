@@ -216,3 +216,10 @@ class ReferenceCounter:
                 "num_owned": sum(1 for r in self._refs.values() if r.owned),
                 "num_borrowed": sum(1 for r in self._refs.values() if not r.owned),
             }
+
+    def snapshot(self) -> dict:
+        """object_id -> Reference copy (for `ray memory` / state API)."""
+        import copy
+
+        with self._lock:
+            return {oid: copy.copy(ref) for oid, ref in self._refs.items()}

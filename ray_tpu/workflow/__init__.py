@@ -1,0 +1,34 @@
+"""Durable workflow execution.
+
+Reference counterpart: Ray Workflow (ray: python/ray/workflow — run/run_async
+api.py:123/:177, resume :243, resume_all :502, executor
+workflow_executor.py:32, storage workflow_storage.py): a task DAG whose
+every step result is checkpointed to storage, so a crashed run resumes from
+the last completed step.
+"""
+
+from ray_tpu.workflow.api import (  # noqa: F401
+    cancel,
+    delete,
+    get_metadata,
+    get_output,
+    get_status,
+    list_all,
+    resume,
+    resume_all,
+    run,
+    run_async,
+)
+
+__all__ = [
+    "cancel",
+    "delete",
+    "get_metadata",
+    "get_output",
+    "get_status",
+    "list_all",
+    "resume",
+    "resume_all",
+    "run",
+    "run_async",
+]

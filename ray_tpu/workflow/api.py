@@ -1,0 +1,169 @@
+"""Workflow public API + executor.
+
+Reference: ray python/ray/workflow/api.py — run (:123), run_async (:177),
+resume (:243), resume_all (:502), get_output, get_status, cancel, delete;
+executor workflow_executor.py:32 walks the DAG, checkpointing every step's
+result so resume skips completed steps.
+
+A workflow here is a ray_tpu.dag node graph (fn.bind(...)): execution walks
+the DAG depth-first; each step runs as a task; its result is persisted under
+a deterministic step id (content path in the DAG) before dependents run.
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu._private import serialization as ser
+from ray_tpu.dag import DAGNode, FunctionNode
+from ray_tpu.workflow.storage import WorkflowStorage, list_workflow_ids
+
+_running: Dict[str, threading.Thread] = {}
+_results: Dict[str, Any] = {}
+_cancelled: set = set()
+
+
+class WorkflowCancelledError(RuntimeError):
+    pass
+
+
+def _execute_node(node: Any, storage: WorkflowStorage, path: str,
+                  workflow_id: str) -> Any:
+    """Post-order DAG walk with per-step checkpointing."""
+    if workflow_id in _cancelled:
+        raise WorkflowCancelledError(workflow_id)
+    if not isinstance(node, DAGNode):
+        return node
+    step_id = path
+    if storage.has_step_result(step_id):
+        return storage.load_step_result(step_id)
+    if not isinstance(node, FunctionNode):
+        raise TypeError(
+            "workflows support function-node DAGs (fn.bind(...)); got "
+            f"{type(node).__name__}")
+    args = [
+        _execute_node(a, storage, f"{path}.a{i}", workflow_id)
+        for i, a in enumerate(node._bound_args)]
+    kwargs = {
+        k: _execute_node(v, storage, f"{path}.k{k}", workflow_id)
+        for k, v in node._bound_kwargs.items()}
+    ref = node._remote_fn.remote(*args, **kwargs)
+    result = ray_tpu.get(ref)
+    storage.save_step_result(step_id, result)
+    return result
+
+
+def _run_sync(dag: DAGNode, workflow_id: str,
+              storage: WorkflowStorage) -> Any:
+    storage.save_status("RUNNING")
+    try:
+        result = _execute_node(dag, storage, "root", workflow_id)
+    except WorkflowCancelledError:
+        storage.save_status("CANCELED")
+        raise
+    except BaseException as e:  # noqa: BLE001
+        storage.save_status("FAILED", {"error": str(e)})
+        raise
+    storage.save_step_result("__output__", result)
+    storage.save_status("SUCCESSFUL")
+    return result
+
+
+def run(dag: DAGNode, *, workflow_id: Optional[str] = None) -> Any:
+    workflow_id = workflow_id or f"workflow-{uuid.uuid4().hex[:8]}"
+    storage = WorkflowStorage(workflow_id)
+    storage.save_dag(ser.dumps_function(dag))
+    return _run_sync(dag, workflow_id, storage)
+
+
+def run_async(dag: DAGNode, *, workflow_id: Optional[str] = None):
+    """Returns the workflow id; poll with get_status/get_output."""
+    workflow_id = workflow_id or f"workflow-{uuid.uuid4().hex[:8]}"
+    storage = WorkflowStorage(workflow_id)
+    storage.save_dag(ser.dumps_function(dag))
+
+    def _bg():
+        try:
+            _results[workflow_id] = _run_sync(dag, workflow_id, storage)
+        except BaseException as e:  # noqa: BLE001
+            _results[workflow_id] = e
+
+    t = threading.Thread(target=_bg, daemon=True,
+                         name=f"workflow-{workflow_id}")
+    _running[workflow_id] = t
+    t.start()
+    return workflow_id
+
+
+def resume(workflow_id: str) -> Any:
+    """Re-run from storage; completed steps are skipped via their
+    checkpointed results."""
+    storage = WorkflowStorage(workflow_id)
+    if storage.has_step_result("__output__"):
+        return storage.load_step_result("__output__")
+    dag_bytes = storage.load_dag()
+    if dag_bytes is None:
+        raise ValueError(f"no workflow {workflow_id!r} in storage")
+    dag = ser.loads_function(dag_bytes)
+    _cancelled.discard(workflow_id)
+    return _run_sync(dag, workflow_id, storage)
+
+
+def resume_all() -> List[tuple]:
+    out = []
+    for wid in list_workflow_ids():
+        status = WorkflowStorage(wid).load_status().get("status")
+        if status in ("RUNNING", "FAILED", "CANCELED"):
+            try:
+                out.append((wid, resume(wid)))
+            except BaseException:  # noqa: BLE001 — keep resuming others
+                pass
+    return out
+
+
+def get_output(workflow_id: str, *, timeout: Optional[float] = None) -> Any:
+    t = _running.get(workflow_id)
+    if t is not None:
+        t.join(timeout)
+        if t.is_alive():
+            raise TimeoutError(f"workflow {workflow_id} still running")
+        result = _results.get(workflow_id)
+        if isinstance(result, BaseException):
+            raise result
+        return result
+    storage = WorkflowStorage(workflow_id)
+    if storage.has_step_result("__output__"):
+        return storage.load_step_result("__output__")
+    raise ValueError(f"workflow {workflow_id!r} has no output")
+
+
+def get_status(workflow_id: str) -> str:
+    return WorkflowStorage(workflow_id).load_status().get("status",
+                                                          "NOT_FOUND")
+
+
+def get_metadata(workflow_id: str) -> Dict[str, Any]:
+    return WorkflowStorage(workflow_id).load_status()
+
+
+def cancel(workflow_id: str) -> None:
+    _cancelled.add(workflow_id)
+    WorkflowStorage(workflow_id).save_status("CANCELED")
+
+
+def delete(workflow_id: str) -> None:
+    WorkflowStorage(workflow_id).delete()
+    _results.pop(workflow_id, None)
+    _running.pop(workflow_id, None)
+
+
+def list_all(status_filter: Optional[str] = None) -> List[tuple]:
+    out = []
+    for wid in list_workflow_ids():
+        st = get_status(wid)
+        if status_filter is None or st == status_filter:
+            out.append((wid, st))
+    return out

@@ -1,0 +1,83 @@
+"""Workflow storage (reference: ray python/ray/workflow/workflow_storage.py —
+step results + DAG structure + status persisted per workflow id under a
+filesystem root; pluggable via storage URL)."""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import shutil
+import time
+from typing import Any, Dict, List, Optional
+
+_DEFAULT_ROOT = os.path.expanduser("~/ray_tpu_workflows")
+
+
+def storage_root() -> str:
+    return os.environ.get("RAY_TPU_WORKFLOW_STORAGE", _DEFAULT_ROOT)
+
+
+class WorkflowStorage:
+    def __init__(self, workflow_id: str, root: Optional[str] = None):
+        self.workflow_id = workflow_id
+        self.dir = os.path.join(root or storage_root(), workflow_id)
+        os.makedirs(self.dir, exist_ok=True)
+
+    # -- status --------------------------------------------------------------
+
+    def save_status(self, status: str, metadata: Optional[dict] = None) -> None:
+        payload = {"status": status, "updated_at": time.time()}
+        if metadata:
+            payload.update(metadata)
+        tmp = os.path.join(self.dir, ".status.tmp")
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, os.path.join(self.dir, "status.json"))
+
+    def load_status(self) -> Dict[str, Any]:
+        p = os.path.join(self.dir, "status.json")
+        if not os.path.exists(p):
+            return {"status": "NOT_FOUND"}
+        with open(p) as f:
+            return json.load(f)
+
+    # -- dag -----------------------------------------------------------------
+
+    def save_dag(self, dag_bytes: bytes) -> None:
+        with open(os.path.join(self.dir, "dag.pkl"), "wb") as f:
+            f.write(dag_bytes)
+
+    def load_dag(self) -> Optional[bytes]:
+        p = os.path.join(self.dir, "dag.pkl")
+        if not os.path.exists(p):
+            return None
+        with open(p, "rb") as f:
+            return f.read()
+
+    # -- step results --------------------------------------------------------
+
+    def has_step_result(self, step_id: str) -> bool:
+        return os.path.exists(os.path.join(self.dir, f"step_{step_id}.pkl"))
+
+    def save_step_result(self, step_id: str, result: Any) -> None:
+        tmp = os.path.join(self.dir, f".step_{step_id}.tmp")
+        with open(tmp, "wb") as f:
+            pickle.dump(result, f)
+        os.replace(tmp, os.path.join(self.dir, f"step_{step_id}.pkl"))
+
+    def load_step_result(self, step_id: str) -> Any:
+        with open(os.path.join(self.dir, f"step_{step_id}.pkl"), "rb") as f:
+            return pickle.load(f)
+
+    def delete(self) -> None:
+        shutil.rmtree(self.dir, ignore_errors=True)
+
+
+def list_workflow_ids(root: Optional[str] = None) -> List[str]:
+    r = root or storage_root()
+    if not os.path.isdir(r):
+        return []
+    return sorted(
+        d for d in os.listdir(r)
+        if os.path.isdir(os.path.join(r, d)))

@@ -1,0 +1,205 @@
+"""Data library tests (reference patterns: ray python/ray/data/tests/)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from ray_tpu import data
+
+
+def test_range_and_count(ray_start_regular):
+    ds = data.range(100, override_num_blocks=4)
+    assert ds.count() == 100
+    assert ds.num_blocks() == 4
+
+
+def test_from_items_take(ray_start_regular):
+    ds = data.from_items([{"x": i} for i in range(10)])
+    rows = ds.take(5)
+    assert [r["x"] for r in rows] == [0, 1, 2, 3, 4]
+
+
+def test_map_batches_and_filter(ray_start_regular):
+    ds = (data.range(20, override_num_blocks=2)
+          .map_batches(lambda b: {"id": b["id"], "sq": b["id"] ** 2})
+          .filter(lambda r: r["sq"] % 2 == 0))
+    rows = ds.take_all()
+    assert all(r["sq"] == r["id"] ** 2 for r in rows)
+    assert all(r["sq"] % 2 == 0 for r in rows)
+    assert len(rows) == 10
+
+
+def test_map_and_flat_map(ray_start_regular):
+    ds = data.from_items([{"x": 1}, {"x": 2}])
+    assert [r["y"] for r in ds.map(lambda r: {"y": r["x"] * 10}).take_all()] \
+        == [10, 20]
+    flat = ds.flat_map(lambda r: [{"v": r["x"]}, {"v": -r["x"]}]).take_all()
+    assert [r["v"] for r in flat] == [1, -1, 2, -2]
+
+
+def test_limit_streams_early(ray_start_regular):
+    ds = data.range(1000, override_num_blocks=10).limit(7)
+    assert ds.count() == 7
+
+
+def test_iter_batches_sizes(ray_start_regular):
+    ds = data.range(25, override_num_blocks=3)
+    sizes = [len(b["id"]) for b in ds.iter_batches(batch_size=10)]
+    assert sum(sizes) == 25
+    assert sizes[:-1] == [10, 10]
+
+
+def test_repartition_and_shuffle(ray_start_regular):
+    ds = data.range(30, override_num_blocks=2).repartition(5)
+    blocks = list(ds.iter_blocks())
+    assert len(blocks) == 5
+    shuffled = data.range(30).random_shuffle(seed=0)
+    vals = [r["id"] for r in shuffled.take_all()]
+    assert sorted(vals) == list(range(30))
+    assert vals != list(range(30))
+
+
+def test_sort(ray_start_regular):
+    ds = data.from_items([{"k": v} for v in [3, 1, 2]]).sort("k")
+    assert [r["k"] for r in ds.take_all()] == [1, 2, 3]
+    dsd = data.from_items([{"k": v} for v in [3, 1, 2]]).sort(
+        "k", descending=True)
+    assert [r["k"] for r in dsd.take_all()] == [3, 2, 1]
+
+
+def test_union_zip(ray_start_regular):
+    a = data.from_items([{"x": 1}, {"x": 2}])
+    b = data.from_items([{"x": 3}])
+    assert a.union(b).count() == 3
+    c = data.from_items([{"y": 10}, {"y": 20}])
+    zipped = a.zip(c).take_all()
+    assert zipped == [{"x": 1, "y": 10}, {"x": 2, "y": 20}]
+
+
+def test_groupby(ray_start_regular):
+    ds = data.from_items(
+        [{"g": i % 2, "v": float(i)} for i in range(6)])
+    out = ds.groupby("g").sum("v").take_all()
+    assert {r["g"]: r["sum(v)"] for r in out} == {0: 6.0, 1: 9.0}
+    means = ds.groupby("g").mean("v").take_all()
+    assert {r["g"]: r["mean(v)"] for r in means} == {0: 2.0, 1: 3.0}
+
+
+def test_aggregates(ray_start_regular):
+    ds = data.range(10)
+    assert ds.sum("id") == 45
+    assert ds.min("id") == 0
+    assert ds.max("id") == 9
+    assert ds.mean("id") == 4.5
+
+
+def test_schema_columns(ray_start_regular):
+    ds = data.from_items([{"a": 1, "b": "x"}])
+    assert ds.columns() == ["a", "b"]
+
+
+def test_parquet_roundtrip(ray_start_regular, tmp_path):
+    ds = data.range(50, override_num_blocks=2)
+    out = str(tmp_path / "pq")
+    ds.write_parquet(out)
+    back = data.read_parquet(out)
+    assert back.count() == 50
+    assert sorted(r["id"] for r in back.take_all()) == list(range(50))
+
+
+def test_csv_json_roundtrip(ray_start_regular, tmp_path):
+    ds = data.from_items([{"a": i, "b": f"s{i}"} for i in range(5)])
+    csv_dir = str(tmp_path / "csv")
+    ds.write_csv(csv_dir)
+    assert data.read_csv(csv_dir).count() == 5
+    json_dir = str(tmp_path / "json")
+    ds.write_json(json_dir)
+    back = data.read_json(json_dir)
+    assert sorted(r["a"] for r in back.take_all()) == list(range(5))
+
+
+def test_read_text_binary(ray_start_regular, tmp_path):
+    p = tmp_path / "f.txt"
+    p.write_text("hello\nworld\n")
+    ds = data.read_text(str(p))
+    assert [r["text"] for r in ds.take_all()] == ["hello", "world"]
+    bds = data.read_binary_files(str(p), include_paths=True)
+    row = bds.take_all()[0]
+    assert row["bytes"] == b"hello\nworld\n"
+
+
+def test_from_pandas_numpy(ray_start_regular):
+    import pandas as pd
+
+    df = pd.DataFrame({"x": [1, 2, 3]})
+    assert data.from_pandas(df).count() == 3
+    nds = data.from_numpy(np.arange(12).reshape(4, 3))
+    batch = next(nds.iter_batches(batch_size=4))
+    assert batch["data"].shape == (4, 3)
+
+
+def test_split_and_shard(ray_start_regular):
+    ds = data.range(100, override_num_blocks=4)
+    shards = [ds.split_shard(i, 2) for i in range(2)]
+    total = sum(s.count() for s in shards)
+    assert total == 100
+    # stride fallback when fewer blocks than workers
+    ds1 = data.range(10, override_num_blocks=1)
+    shards = [ds1.split_shard(i, 4) for i in range(4)]
+    assert sum(s.count() for s in shards) == 10
+    splits = ds.split(3)
+    assert sum(s.count() for s in splits) == 100
+
+
+def test_train_test_split(ray_start_regular):
+    tr, te = data.range(10).train_test_split(0.3)
+    assert tr.count() == 7 and te.count() == 3
+
+
+def test_iter_jax_batches(ray_start_regular):
+    import jax.numpy as jnp
+
+    ds = data.range(32, override_num_blocks=2)
+    batches = list(ds.iter_jax_batches(batch_size=16))
+    assert len(batches) == 2
+    assert isinstance(batches[0]["id"], jnp.ndarray)
+
+
+def test_iter_torch_batches(ray_start_regular):
+    import torch
+
+    ds = data.range(8)
+    b = next(ds.iter_torch_batches(batch_size=8))
+    assert isinstance(b["id"], torch.Tensor)
+
+
+def test_add_drop_select_columns(ray_start_regular):
+    ds = data.range(5).add_column("double", lambda b: b["id"] * 2)
+    assert [r["double"] for r in ds.take_all()] == [0, 2, 4, 6, 8]
+    assert ds.drop_columns(["double"]).columns() == ["id"]
+    assert ds.select_columns(["double"]).columns() == ["double"]
+
+
+def test_dataset_in_trainer(ray_start_regular, tmp_path):
+    """Datasets flow into train workers via get_dataset_shard."""
+    from ray_tpu import train
+    from ray_tpu.air import RunConfig, ScalingConfig
+    from ray_tpu.train import DataParallelTrainer
+
+    ds = data.range(40, override_num_blocks=4)
+
+    def train_fn(config):
+        shard = train.get_dataset_shard("train")
+        n = shard.count()
+        train.report({"rows": n})
+
+    trainer = DataParallelTrainer(
+        train_fn,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="ds", storage_path=str(tmp_path)),
+        datasets={"train": ds},
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["rows"] == 20

@@ -1,0 +1,214 @@
+"""Tune library tests (reference patterns: ray python/ray/tune/tests/ —
+controller tests with mock trainables, searcher/scheduler unit tests)."""
+
+import os
+
+import pytest
+
+from ray_tpu import tune
+from ray_tpu.air import RunConfig
+from ray_tpu.tune import TuneConfig, Tuner
+from ray_tpu.tune.schedulers import (
+    ASHAScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+    TrialScheduler,
+)
+from ray_tpu.tune.search import BasicVariantGenerator, ConcurrencyLimiter
+from ray_tpu.tune.search.sample import expand_grid, resolve_config
+
+
+def test_grid_expansion():
+    space = {"a": tune.grid_search([1, 2]), "b": tune.grid_search(["x", "y"]),
+             "c": 7}
+    variants = expand_grid(space)
+    assert len(variants) == 4
+    assert all(v["c"] == 7 for v in variants)
+    assert {(v["a"], v["b"]) for v in variants} == {
+        (1, "x"), (1, "y"), (2, "x"), (2, "y")}
+
+
+def test_sample_domains():
+    import random
+
+    rng = random.Random(0)
+    for _ in range(20):
+        assert 0.0 <= tune.uniform(0, 1).sample(rng) <= 1.0
+        assert 1e-4 <= tune.loguniform(1e-4, 1e-1).sample(rng) <= 1e-1
+        assert tune.randint(0, 10).sample(rng) in range(10)
+        assert tune.choice(["a", "b"]).sample(rng) in ("a", "b")
+        q = tune.quniform(0, 1, 0.25).sample(rng)
+        assert abs(q / 0.25 - round(q / 0.25)) < 1e-9
+
+
+def test_basic_variant_generator():
+    gen = BasicVariantGenerator(
+        {"lr": tune.grid_search([0.1, 0.2]), "wd": tune.uniform(0, 1)},
+        num_samples=3, seed=0)
+    configs = []
+    while True:
+        c = gen.suggest(f"t{len(configs)}")
+        if c == gen.FINISHED:
+            break
+        configs.append(c)
+    assert len(configs) == 6
+    assert sorted({c["lr"] for c in configs}) == [0.1, 0.2]
+
+
+def test_concurrency_limiter():
+    gen = ConcurrencyLimiter(
+        BasicVariantGenerator({"x": 1}, num_samples=5), max_concurrent=2)
+    a = gen.suggest("t0")
+    b = gen.suggest("t1")
+    assert a and b
+    assert gen.suggest("t2") is None
+    gen.on_trial_complete("t0")
+    assert gen.suggest("t2") is not None
+
+
+def test_asha_scheduler_stops_bad_trials():
+    from ray_tpu.tune.experiment.trial import Trial
+
+    sched = ASHAScheduler(metric="score", mode="max", grace_period=1,
+                          reduction_factor=2, max_t=10)
+    trials = [Trial({"i": i}, "exp") for i in range(4)]
+    # High scorers arrive at each rung first (asynchronous SHA promotes by
+    # comparing against results recorded so far), low scorers after.
+    decisions = {}
+    for it in range(1, 5):
+        for i, t in reversed(list(enumerate(trials))):
+            if decisions.get(t.trial_id) == TrialScheduler.STOP:
+                continue
+            d = sched.on_trial_result(
+                t, {"training_iteration": it, "score": float(i)})
+            decisions[t.trial_id] = d
+    assert decisions[trials[0].trial_id] == TrialScheduler.STOP
+    assert decisions[trials[3].trial_id] == TrialScheduler.CONTINUE
+
+
+def test_median_stopping_rule():
+    from ray_tpu.tune.experiment.trial import Trial
+
+    sched = MedianStoppingRule(metric="score", mode="max", grace_period=2,
+                               min_samples_required=2)
+    good, bad = Trial({}, "e"), Trial({}, "e")
+    for it in range(1, 6):
+        d_good = sched.on_trial_result(
+            good, {"training_iteration": it, "score": 10.0})
+        d_bad = sched.on_trial_result(
+            bad, {"training_iteration": it, "score": 0.1})
+    assert d_good == TrialScheduler.CONTINUE
+    assert d_bad == TrialScheduler.STOP
+
+
+def test_tuner_grid_search_e2e(ray_start_regular, tmp_path):
+    def trainable(config):
+        tune.report({"score": config["x"] * 2})
+
+    tuner = Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([1, 2, 3])},
+        tune_config=TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(name="grid", storage_path=str(tmp_path)),
+    )
+    results = tuner.fit()
+    assert len(results) == 3
+    best = results.get_best_result()
+    assert best.metrics["score"] == 6
+    assert best.config["x"] == 3
+
+
+def test_tuner_with_scheduler_e2e(ray_start_regular, tmp_path):
+    def trainable(config):
+        for i in range(8):
+            tune.report({"loss": (10 - config["lr"] * i)})
+
+    tuner = Tuner(
+        trainable,
+        param_space={"lr": tune.grid_search([0.1, 1.0])},
+        tune_config=TuneConfig(
+            metric="loss", mode="min",
+            scheduler=ASHAScheduler(metric="loss", mode="min",
+                                    grace_period=2, max_t=8),
+        ),
+        run_config=RunConfig(name="asha", storage_path=str(tmp_path)),
+    )
+    results = tuner.fit()
+    best = results.get_best_result()
+    assert best.config["lr"] == 1.0
+
+
+def test_tuner_trainable_error_captured(ray_start_regular, tmp_path):
+    def trainable(config):
+        if config["x"] == 1:
+            raise ValueError("boom")
+        tune.report({"score": 1})
+
+    tuner = Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([0, 1])},
+        tune_config=TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(name="err", storage_path=str(tmp_path)),
+    )
+    results = tuner.fit()
+    assert len(results.errors) == 1
+    assert results.get_best_result().metrics["score"] == 1
+
+
+def test_tuner_checkpoint_and_restore(ray_start_regular, tmp_path):
+    def trainable(config):
+        for i in range(3):
+            tune.report(
+                {"score": i}, checkpoint=tune.Checkpoint.from_dict({"i": i}))
+
+    tuner = Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([1, 2])},
+        tune_config=TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(name="ckpt", storage_path=str(tmp_path)),
+    )
+    results = tuner.fit()
+    assert results.get_best_result().checkpoint.to_dict()["i"] == 2
+    exp_dir = os.path.join(str(tmp_path), "ckpt")
+    assert Tuner.can_restore(exp_dir)
+    trials = __import__(
+        "ray_tpu.tune.execution.tune_controller",
+        fromlist=["TuneController"],
+    ).TuneController.load_experiment_state(exp_dir)
+    assert len(trials) == 2
+
+
+def test_tune_stop_criteria(ray_start_regular, tmp_path):
+    def trainable(config):
+        for i in range(100):
+            tune.report({"score": i})
+
+    results = tune.run(
+        trainable, config={"x": 1}, metric="score", mode="max",
+        stop={"score": 5}, storage_path=str(tmp_path), name="stopc")
+    assert results.get_best_result().metrics["score"] == 5
+
+
+def test_pbt_exploit(ray_start_regular, tmp_path):
+    """PBT: a bad trial exploits the good trial's config."""
+
+    def trainable(config):
+        lr = config["lr"]
+        ckpt = tune.get_checkpoint()
+        start = ckpt.to_dict()["i"] + 1 if ckpt else 0
+        for i in range(start, 12):
+            tune.report({"score": lr * (i + 1), "training_iteration": i + 1},
+                        checkpoint=tune.Checkpoint.from_dict({"i": i}))
+
+    pbt = PopulationBasedTraining(
+        metric="score", mode="max", perturbation_interval=3,
+        hyperparam_mutations={"lr": tune.uniform(0.5, 2.0)}, seed=0)
+    tuner = Tuner(
+        trainable,
+        param_space={"lr": tune.grid_search([0.01, 1.0])},
+        tune_config=TuneConfig(metric="score", mode="max", scheduler=pbt),
+        run_config=RunConfig(name="pbt", storage_path=str(tmp_path)),
+    )
+    results = tuner.fit()
+    best = results.get_best_result()
+    assert best.metrics["score"] >= 12.0 * 0.5

@@ -1,0 +1,114 @@
+"""Workflow + DAG tests (reference patterns: ray python/ray/workflow/tests/,
+dag/tests/)."""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import workflow
+from ray_tpu.dag import InputNode, MultiOutputNode
+
+
+@pytest.fixture
+def wf_storage(tmp_path, monkeypatch):
+    monkeypatch.setenv("RAY_TPU_WORKFLOW_STORAGE", str(tmp_path / "wf"))
+    yield str(tmp_path / "wf")
+
+
+@ray_tpu.remote
+def _add(a, b):
+    return a + b
+
+
+@ray_tpu.remote
+def _mul(a, b):
+    return a * b
+
+
+def test_dag_bind_execute(ray_start_regular):
+    dag = _add.bind(_mul.bind(2, 3), 4)
+    assert ray_tpu.get(dag.execute()) == 10
+
+
+def test_dag_input_node(ray_start_regular):
+    with InputNode() as inp:
+        dag = _add.bind(inp, 10)
+    assert ray_tpu.get(dag.execute(5)) == 15
+    assert ray_tpu.get(dag.execute(7)) == 17
+
+
+def test_dag_multi_output(ray_start_regular):
+    with InputNode() as inp:
+        a = _add.bind(inp, 1)
+        b = _mul.bind(inp, 2)
+        dag = MultiOutputNode([a, b])
+    refs = dag.execute(10)
+    assert ray_tpu.get(refs) == [11, 20]
+
+
+def test_compiled_dag_actor_chain(ray_start_regular):
+    @ray_tpu.remote
+    class Stage:
+        def __init__(self, offset):
+            self.offset = offset
+
+        def fwd(self, x):
+            return x + self.offset
+
+    with InputNode() as inp:
+        s1 = Stage.bind(1)
+        s2 = Stage.bind(10)
+        dag = s2.fwd.bind(s1.fwd.bind(inp))
+    compiled = dag.experimental_compile()
+    assert ray_tpu.get(compiled.execute(0)) == 11
+    assert ray_tpu.get(compiled.execute(5)) == 16  # actors reused
+    compiled.teardown()
+
+
+def test_workflow_run(ray_start_regular, wf_storage):
+    dag = _add.bind(_mul.bind(3, 4), 5)
+    assert workflow.run(dag, workflow_id="w1") == 17
+    assert workflow.get_status("w1") == "SUCCESSFUL"
+    assert workflow.get_output("w1") == 17
+
+
+def test_workflow_resume_skips_done_steps(ray_start_regular, wf_storage,
+                                          tmp_path):
+    marker = str(tmp_path / "ran")
+
+    @ray_tpu.remote
+    def flaky(x):
+        if not os.path.exists(marker):
+            open(marker, "w").close()
+            raise RuntimeError("first attempt fails")
+        return x * 100
+
+    @ray_tpu.remote
+    def expensive(x):
+        # Count executions via a side file to prove resume skips this step.
+        cnt = str(tmp_path / "count")
+        n = int(open(cnt).read()) if os.path.exists(cnt) else 0
+        open(cnt, "w").write(str(n + 1))
+        return x + 1
+
+    dag = flaky.bind(expensive.bind(1))
+    with pytest.raises(Exception):
+        workflow.run(dag, workflow_id="w2")
+    assert workflow.get_status("w2") == "FAILED"
+    assert workflow.resume("w2") == 200
+    assert open(str(tmp_path / "count")).read() == "1"  # ran only once
+
+
+def test_workflow_run_async(ray_start_regular, wf_storage):
+    dag = _add.bind(1, 2)
+    wid = workflow.run_async(dag)
+    assert workflow.get_output(wid, timeout=30) == 3
+    assert workflow.get_status(wid) == "SUCCESSFUL"
+
+
+def test_workflow_list_delete(ray_start_regular, wf_storage):
+    workflow.run(_add.bind(1, 1), workflow_id="wlist")
+    assert ("wlist", "SUCCESSFUL") in workflow.list_all()
+    workflow.delete("wlist")
+    assert all(w != "wlist" for w, _ in workflow.list_all())
