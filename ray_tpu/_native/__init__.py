@@ -1,0 +1,63 @@
+"""Native (C++) components of ray_tpu.
+
+The reference implements its runtime hot paths in C++ (plasma store,
+raylet, core worker — SURVEY §2.1); ray_tpu keeps the same split: JAX/XLA
+is the TPU compute path, and node-local runtime services live in C++ here,
+bound into Python with ctypes (no pybind11 in the image).
+
+Libraries are compiled on demand with g++ and cached next to the sources
+(keyed by a source hash), so the repo carries sources, not binaries.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_SRC_DIR = os.path.join(os.path.dirname(__file__), "src")
+_BUILD_DIR = os.path.join(os.path.dirname(__file__), "build")
+_lock = threading.Lock()
+_built: dict = {}
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def build_library(name: str, sources: Optional[list] = None) -> str:
+    """Compile ray_tpu/_native/src/<name>.cc into a cached .so; return path."""
+    sources = sources or [os.path.join(_SRC_DIR, f"{name}.cc")]
+    with _lock:
+        if name in _built:
+            return _built[name]
+        h = hashlib.sha256()
+        for s in sources:
+            with open(s, "rb") as f:
+                h.update(f.read())
+        tag = h.hexdigest()[:16]
+        out = os.path.join(_BUILD_DIR, f"lib{name}-{tag}.so")
+        if not os.path.exists(out):
+            os.makedirs(_BUILD_DIR, exist_ok=True)
+            tmp = out + f".tmp.{os.getpid()}"
+            cmd = [
+                "g++", "-O2", "-g", "-std=c++17", "-shared", "-fPIC",
+                "-pthread", "-o", tmp, *sources,
+            ]
+            proc = subprocess.run(cmd, capture_output=True, text=True)
+            if proc.returncode != 0:
+                raise NativeBuildError(
+                    f"g++ failed for {name}:\n{proc.stderr[-4000:]}")
+            os.replace(tmp, out)
+        _built[name] = out
+        return out
+
+
+def try_build_library(name: str) -> Optional[str]:
+    """build_library, or None when no toolchain is available."""
+    try:
+        return build_library(name)
+    except (NativeBuildError, FileNotFoundError, OSError):
+        return None

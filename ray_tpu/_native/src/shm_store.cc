@@ -1,0 +1,728 @@
+// TPU-native shared-memory object store ("plasma" equivalent).
+//
+// Role of the reference's Plasma store (ray: src/ray/object_manager/plasma/
+// store.h:55, client.cc, protocol over Unix socket + fd passing fling.cc):
+// a per-node immutable object store in shared memory so every worker process
+// on the node reads object payloads zero-copy.  TPU twist: payloads are the
+// flat SerializedObject wire format, so a worker can wrap a stored numpy/jax
+// host buffer as a jax.Array input without copies (mmap -> device_put).
+//
+// Design (not a translation of plasma):
+//   * one shm arena per node created with memfd_create, passed to clients
+//     over SCM_RIGHTS during the socket handshake (like plasma's fling.cc,
+//     but a single arena instead of per-object mmaps)
+//   * server-side first-fit free-list allocator with coalescing (plasma
+//     vendors dlmalloc; an in-server allocator keeps all metadata private)
+//   * thread-per-connection control plane guarded by one mutex + condvar;
+//     the data plane never touches the server (clients read/write the
+//     mapped arena directly)
+//   * objects are PRIMARY (owner payload: never auto-evicted, listed for
+//     disk spilling like raylet/local_object_manager.h:41) or CACHE
+//     (remote-fetch copies: LRU auto-evicted under memory pressure like
+//     plasma/eviction_policy.cc)
+//   * per-connection reference counts; a dying client auto-releases
+//     (plasma client disconnect semantics)
+//
+// Exposed as a C API (rtps_*) for ctypes binding from Python
+// (ray_tpu/_private/shm_store.py).
+
+#define _GNU_SOURCE 1
+
+#include <errno.h>
+#include <fcntl.h>
+#include <pthread.h>
+#include <stdint.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <iterator>
+#include <stdexcept>
+#include <string>
+#include <condition_variable>
+#include <cstdio>
+#include <cstdlib>
+#include <list>
+#include <map>
+#include <mutex>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------- protocol
+
+constexpr uint64_t kMagic = 0x52545053484d3031ULL;  // "RTPSHM01"
+constexpr uint64_t kAlign = 64;
+
+enum Op : uint8_t {
+  OP_CREATE = 1,
+  OP_SEAL = 2,
+  OP_GET = 3,
+  OP_RELEASE = 4,
+  OP_DELETE = 5,
+  OP_CONTAINS = 6,
+  OP_STATS = 7,
+  OP_LIST = 8,   // a: max ids, b: 1 = spillable primaries, 0 = evictable caches
+  OP_ABORT = 9,  // abort an unsealed create
+};
+
+enum Status : int64_t {
+  ST_OK = 0,
+  ST_FULL = -1,
+  ST_EXISTS = -2,
+  ST_NOT_FOUND = -3,
+  ST_TIMEOUT = -4,
+  ST_NOT_SEALED = -5,
+  ST_ERR = -6,
+};
+
+struct Request {
+  uint8_t op;
+  uint8_t pad[7];
+  uint8_t id[16];
+  uint64_t a;  // CREATE: size, GET: timeout_ms (UINT64_MAX = infinite), LIST: max
+  uint64_t b;  // CREATE: flags (1 = primary), LIST: 1 = primaries
+};
+
+struct Response {
+  int64_t status;
+  uint64_t offset;
+  uint64_t size;
+};
+
+struct ObjectId {
+  uint8_t b[16];
+  bool operator==(const ObjectId& o) const { return memcmp(b, o.b, 16) == 0; }
+};
+
+struct IdHash {
+  size_t operator()(const ObjectId& id) const {
+    uint64_t h;
+    memcpy(&h, id.b, 8);
+    return static_cast<size_t>(h * 0x9E3779B97F4A7C15ULL);
+  }
+};
+
+bool ReadFull(int fd, void* buf, size_t n) {
+  auto* p = static_cast<uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = read(fd, p, n);
+    if (r <= 0) {
+      if (r < 0 && errno == EINTR) continue;
+      return false;
+    }
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool WriteFull(int fd, const void* buf, size_t n) {
+  const auto* p = static_cast<const uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = write(fd, p, n);
+    if (r <= 0) {
+      if (r < 0 && errno == EINTR) continue;
+      return false;
+    }
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+// Send the arena fd + capacity in one message (SCM_RIGHTS, cf. plasma fling.cc).
+bool SendHandshake(int sock, int arena_fd, uint64_t capacity) {
+  uint64_t payload[2] = {kMagic, capacity};
+  struct iovec iov = {payload, sizeof(payload)};
+  char cmsgbuf[CMSG_SPACE(sizeof(int))];
+  memset(cmsgbuf, 0, sizeof(cmsgbuf));
+  struct msghdr msg;
+  memset(&msg, 0, sizeof(msg));
+  msg.msg_iov = &iov;
+  msg.msg_iovlen = 1;
+  msg.msg_control = cmsgbuf;
+  msg.msg_controllen = sizeof(cmsgbuf);
+  struct cmsghdr* cmsg = CMSG_FIRSTHDR(&msg);
+  cmsg->cmsg_level = SOL_SOCKET;
+  cmsg->cmsg_type = SCM_RIGHTS;
+  cmsg->cmsg_len = CMSG_LEN(sizeof(int));
+  memcpy(CMSG_DATA(cmsg), &arena_fd, sizeof(int));
+  return sendmsg(sock, &msg, 0) == sizeof(payload);
+}
+
+bool RecvHandshake(int sock, int* arena_fd, uint64_t* capacity) {
+  uint64_t payload[2] = {0, 0};
+  struct iovec iov = {payload, sizeof(payload)};
+  char cmsgbuf[CMSG_SPACE(sizeof(int))];
+  struct msghdr msg;
+  memset(&msg, 0, sizeof(msg));
+  msg.msg_iov = &iov;
+  msg.msg_iovlen = 1;
+  msg.msg_control = cmsgbuf;
+  msg.msg_controllen = sizeof(cmsgbuf);
+  if (recvmsg(sock, &msg, 0) != sizeof(payload)) return false;
+  if (payload[0] != kMagic) return false;
+  struct cmsghdr* cmsg = CMSG_FIRSTHDR(&msg);
+  if (cmsg == nullptr || cmsg->cmsg_type != SCM_RIGHTS) return false;
+  memcpy(arena_fd, CMSG_DATA(cmsg), sizeof(int));
+  *capacity = payload[1];
+  return true;
+}
+
+// ---------------------------------------------------------------- allocator
+
+// First-fit free list with coalescing over arena offsets.
+class Arena {
+ public:
+  explicit Arena(uint64_t capacity) : capacity_(capacity) {
+    free_[0] = capacity;
+  }
+
+  // Returns false if no contiguous block fits.
+  bool Alloc(uint64_t size, uint64_t* offset) {
+    uint64_t need = (size + kAlign - 1) & ~(kAlign - 1);
+    if (need == 0) need = kAlign;
+    for (auto it = free_.begin(); it != free_.end(); ++it) {
+      if (it->second >= need) {
+        *offset = it->first;
+        uint64_t rem = it->second - need;
+        uint64_t tail = it->first + need;
+        free_.erase(it);
+        if (rem > 0) free_[tail] = rem;
+        used_ += need;
+        sizes_[*offset] = need;
+        return true;
+      }
+    }
+    return false;
+  }
+
+  void Free(uint64_t offset) {
+    auto sit = sizes_.find(offset);
+    if (sit == sizes_.end()) return;
+    uint64_t size = sit->second;
+    sizes_.erase(sit);
+    used_ -= size;
+    auto it = free_.emplace(offset, size).first;
+    // Coalesce with next block.
+    auto next = std::next(it);
+    if (next != free_.end() && it->first + it->second == next->first) {
+      it->second += next->second;
+      free_.erase(next);
+    }
+    // Coalesce with previous block.
+    if (it != free_.begin()) {
+      auto prev = std::prev(it);
+      if (prev->first + prev->second == it->first) {
+        prev->second += it->second;
+        free_.erase(it);
+      }
+    }
+  }
+
+  uint64_t used() const { return used_; }
+  uint64_t capacity() const { return capacity_; }
+
+ private:
+  uint64_t capacity_;
+  uint64_t used_ = 0;
+  std::map<uint64_t, uint64_t> free_;             // offset -> size
+  std::unordered_map<uint64_t, uint64_t> sizes_;  // offset -> allocated size
+};
+
+// ------------------------------------------------------------------ server
+
+struct ObjectEntry {
+  uint64_t offset = 0;
+  uint64_t size = 0;
+  bool sealed = false;
+  bool primary = false;
+  bool pending_delete = false;
+  int creator_conn = -1;  // connection that created it (for abort-on-death)
+  uint64_t refcount = 0;  // across all connections
+  uint64_t lru_tick = 0;  // last-touched tick for CACHE eviction order
+};
+
+class StoreServer {
+ public:
+  StoreServer(const char* socket_path, uint64_t capacity)
+      : path_(socket_path), arena_(capacity) {
+    arena_fd_ = memfd_create("ray_tpu_store", MFD_CLOEXEC);
+    if (arena_fd_ < 0) throw std::runtime_error("memfd_create failed");
+    if (ftruncate(arena_fd_, static_cast<off_t>(capacity)) != 0) {
+      close(arena_fd_);
+      throw std::runtime_error("ftruncate failed");
+    }
+    base_ = static_cast<uint8_t*>(mmap(nullptr, capacity,
+                                       PROT_READ | PROT_WRITE, MAP_SHARED,
+                                       arena_fd_, 0));
+    if (base_ == MAP_FAILED) {
+      close(arena_fd_);
+      throw std::runtime_error("mmap failed");
+    }
+
+    listen_fd_ = socket(AF_UNIX, SOCK_STREAM | SOCK_CLOEXEC, 0);
+    if (listen_fd_ < 0) throw std::runtime_error("socket failed");
+    struct sockaddr_un addr;
+    memset(&addr, 0, sizeof(addr));
+    addr.sun_family = AF_UNIX;
+    snprintf(addr.sun_path, sizeof(addr.sun_path), "%s", socket_path);
+    unlink(socket_path);
+    if (bind(listen_fd_, reinterpret_cast<struct sockaddr*>(&addr),
+             sizeof(addr)) != 0 ||
+        listen(listen_fd_, 128) != 0) {
+      close(listen_fd_);
+      throw std::runtime_error("bind/listen failed");
+    }
+    accept_thread_ = std::thread([this] { AcceptLoop(); });
+  }
+
+  ~StoreServer() { Stop(); }
+
+  void Stop() {
+    bool expected = false;
+    if (!stopping_.compare_exchange_strong(expected, true)) return;
+    shutdown(listen_fd_, SHUT_RDWR);
+    close(listen_fd_);
+    unlink(path_.c_str());
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      for (int fd : conn_fds_) shutdown(fd, SHUT_RDWR);
+      cv_.notify_all();
+    }
+    if (accept_thread_.joinable()) accept_thread_.join();
+    for (auto& t : conn_threads_) {
+      if (t.joinable()) t.join();
+    }
+    munmap(base_, arena_.capacity());
+    close(arena_fd_);
+  }
+
+ private:
+  void AcceptLoop() {
+    int conn_id = 0;
+    while (!stopping_.load()) {
+      int fd = accept4(listen_fd_, nullptr, nullptr, SOCK_CLOEXEC);
+      if (fd < 0) {
+        if (errno == EINTR) continue;
+        break;  // listen socket closed => shutting down
+      }
+      if (!SendHandshake(fd, arena_fd_, arena_.capacity())) {
+        close(fd);
+        continue;
+      }
+      int id = conn_id++;
+      {
+        std::lock_guard<std::mutex> g(mu_);
+        conn_fds_.push_back(fd);
+        conn_threads_.emplace_back([this, fd, id] { Serve(fd, id); });
+      }
+    }
+  }
+
+  void Serve(int fd, int conn_id) {
+    std::unordered_map<ObjectId, uint64_t, IdHash> held;  // id -> refs
+    Request req;
+    while (ReadFull(fd, &req, sizeof(req))) {
+      Response rsp = {ST_ERR, 0, 0};
+      std::vector<uint8_t> extra;
+      ObjectId id;
+      memcpy(id.b, req.id, 16);
+      switch (req.op) {
+        case OP_CREATE:
+          rsp = Create(id, req.a, req.b, conn_id, &held);
+          break;
+        case OP_SEAL:
+          rsp = Seal(id);
+          break;
+        case OP_GET:
+          rsp = Get(id, req.a, &held);
+          break;
+        case OP_RELEASE:
+          rsp = Release(id, &held);
+          break;
+        case OP_DELETE:
+          rsp = Delete(id);
+          break;
+        case OP_ABORT:
+          rsp = Abort(id, &held);
+          break;
+        case OP_CONTAINS: {
+          std::lock_guard<std::mutex> g(mu_);
+          auto it = objects_.find(id);
+          rsp.status =
+              (it != objects_.end() && it->second.sealed) ? ST_OK : ST_NOT_FOUND;
+          if (rsp.status == ST_OK) rsp.size = it->second.size;
+          break;
+        }
+        case OP_STATS: {
+          std::lock_guard<std::mutex> g(mu_);
+          rsp.status = static_cast<int64_t>(objects_.size());
+          rsp.offset = arena_.used();
+          rsp.size = arena_.capacity();
+          break;
+        }
+        case OP_LIST:
+          rsp = List(req.a, req.b != 0, &extra);
+          break;
+        default:
+          rsp.status = ST_ERR;
+      }
+      if (!WriteFull(fd, &rsp, sizeof(rsp))) break;
+      if (!extra.empty() && !WriteFull(fd, extra.data(), extra.size())) break;
+    }
+    // Client died or disconnected: release everything it held, abort its
+    // unsealed creates (plasma disconnect semantics).
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      for (auto& kv : held) {
+        auto it = objects_.find(kv.first);
+        if (it == objects_.end()) continue;
+        ObjectEntry& e = it->second;
+        e.refcount -= std::min(e.refcount, kv.second);
+        if (!e.sealed && e.creator_conn == conn_id) {
+          arena_.Free(e.offset);
+          objects_.erase(it);
+        } else if (e.refcount == 0 && e.pending_delete) {
+          arena_.Free(e.offset);
+          objects_.erase(it);
+        }
+      }
+      cv_.notify_all();
+    }
+    close(fd);
+  }
+
+  // Evict the single least-recently-used sealed, unreferenced CACHE object.
+  // Returns false when none is evictable. Caller holds mu_.
+  bool EvictOneCache() {
+    ObjectId victim;
+    uint64_t best_tick = UINT64_MAX;
+    bool found = false;
+    for (auto& kv : objects_) {
+      ObjectEntry& e = kv.second;
+      if (e.sealed && !e.primary && e.refcount == 0 && e.lru_tick < best_tick) {
+        best_tick = e.lru_tick;
+        victim = kv.first;
+        found = true;
+      }
+    }
+    if (!found) return false;
+    auto it = objects_.find(victim);
+    arena_.Free(it->second.offset);
+    objects_.erase(it);
+    return true;
+  }
+
+  Response Create(const ObjectId& id, uint64_t size, uint64_t flags,
+                  int conn_id,
+                  std::unordered_map<ObjectId, uint64_t, IdHash>* held) {
+    std::lock_guard<std::mutex> g(mu_);
+    if (objects_.count(id)) return {ST_EXISTS, 0, 0};
+    uint64_t offset = 0;
+    // Allocation needs a CONTIGUOUS block, so evicting "enough bytes" is not
+    // enough under fragmentation: evict LRU caches one at a time (freed
+    // neighbours coalesce) and retry until the block fits or nothing is left.
+    while (!arena_.Alloc(size, &offset)) {
+      if (!EvictOneCache()) return {ST_FULL, arena_.used(), size};
+    }
+    ObjectEntry e;
+    e.offset = offset;
+    e.size = size;
+    e.primary = (flags & 1) != 0;
+    e.creator_conn = conn_id;
+    e.refcount = 1;  // creator holds a ref until release
+    e.lru_tick = tick_++;
+    objects_[id] = e;
+    (*held)[id] += 1;
+    return {ST_OK, offset, size};
+  }
+
+  Response Seal(const ObjectId& id) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = objects_.find(id);
+    if (it == objects_.end()) return {ST_NOT_FOUND, 0, 0};
+    it->second.sealed = true;
+    cv_.notify_all();
+    return {ST_OK, it->second.offset, it->second.size};
+  }
+
+  Response Get(const ObjectId& id, uint64_t timeout_ms,
+               std::unordered_map<ObjectId, uint64_t, IdHash>* held) {
+    std::unique_lock<std::mutex> lk(mu_);
+    auto sealed = [&]() -> ObjectEntry* {
+      auto it = objects_.find(id);
+      return (it != objects_.end() && it->second.sealed) ? &it->second : nullptr;
+    };
+    ObjectEntry* e = sealed();
+    if (e == nullptr && timeout_ms > 0) {
+      auto pred = [&] { return stopping_.load() || sealed() != nullptr; };
+      if (timeout_ms == UINT64_MAX) {
+        cv_.wait(lk, pred);
+      } else {
+        cv_.wait_for(lk, std::chrono::milliseconds(timeout_ms), pred);
+      }
+      e = sealed();
+    }
+    if (e == nullptr) return {ST_TIMEOUT, 0, 0};
+    e->refcount += 1;
+    e->lru_tick = tick_++;
+    (*held)[id] += 1;
+    return {ST_OK, e->offset, e->size};
+  }
+
+  Response Release(const ObjectId& id,
+                   std::unordered_map<ObjectId, uint64_t, IdHash>* held) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = objects_.find(id);
+    auto hit = held->find(id);
+    if (it == objects_.end() || hit == held->end()) return {ST_NOT_FOUND, 0, 0};
+    if (--hit->second == 0) held->erase(hit);
+    ObjectEntry& e = it->second;
+    if (e.refcount > 0) e.refcount -= 1;
+    if (e.refcount == 0 && e.pending_delete) {
+      arena_.Free(e.offset);
+      objects_.erase(it);
+    }
+    return {ST_OK, 0, 0};
+  }
+
+  Response Delete(const ObjectId& id) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = objects_.find(id);
+    if (it == objects_.end()) return {ST_NOT_FOUND, 0, 0};
+    ObjectEntry& e = it->second;
+    if (e.refcount > 0) {
+      e.pending_delete = true;
+      return {ST_OK, 0, 1};  // size=1: deferred
+    }
+    arena_.Free(e.offset);
+    objects_.erase(it);
+    return {ST_OK, 0, 0};
+  }
+
+  Response Abort(const ObjectId& id,
+                 std::unordered_map<ObjectId, uint64_t, IdHash>* held) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = objects_.find(id);
+    if (it == objects_.end()) return {ST_NOT_FOUND, 0, 0};
+    if (it->second.sealed) return {ST_ERR, 0, 0};
+    arena_.Free(it->second.offset);
+    objects_.erase(it);
+    held->erase(id);
+    return {ST_OK, 0, 0};
+  }
+
+  Response List(uint64_t max_ids, bool primaries, std::vector<uint8_t>* extra) {
+    std::lock_guard<std::mutex> g(mu_);
+    // Oldest-first so the spiller drains cold objects (LRU spill order).
+    std::vector<std::pair<uint64_t, const ObjectId*>> order;
+    for (auto& kv : objects_) {
+      const ObjectEntry& e = kv.second;
+      if (e.sealed && e.refcount == 0 && e.primary == primaries) {
+        order.emplace_back(e.lru_tick, &kv.first);
+      }
+    }
+    std::sort(order.begin(), order.end(),
+              [](const auto& a, const auto& b) { return a.first < b.first; });
+    uint64_t n = std::min<uint64_t>(order.size(), max_ids);
+    extra->resize(n * 16);
+    for (uint64_t i = 0; i < n; ++i) {
+      memcpy(extra->data() + i * 16, order[i].second->b, 16);
+    }
+    return {static_cast<int64_t>(n), 0, 0};
+  }
+
+  std::string path_;
+  Arena arena_;
+  int arena_fd_ = -1;
+  uint8_t* base_ = nullptr;
+  int listen_fd_ = -1;
+  std::thread accept_thread_;
+  std::vector<std::thread> conn_threads_;
+  std::vector<int> conn_fds_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::atomic<bool> stopping_{false};
+  std::unordered_map<ObjectId, ObjectEntry, IdHash> objects_;
+  uint64_t tick_ = 0;
+};
+
+// ------------------------------------------------------------------ client
+
+class StoreClient {
+ public:
+  explicit StoreClient(const char* socket_path) {
+    fd_ = socket(AF_UNIX, SOCK_STREAM | SOCK_CLOEXEC, 0);
+    if (fd_ < 0) throw std::runtime_error("socket failed");
+    struct sockaddr_un addr;
+    memset(&addr, 0, sizeof(addr));
+    addr.sun_family = AF_UNIX;
+    snprintf(addr.sun_path, sizeof(addr.sun_path), "%s", socket_path);
+    if (connect(fd_, reinterpret_cast<struct sockaddr*>(&addr),
+                sizeof(addr)) != 0) {
+      close(fd_);
+      throw std::runtime_error("connect failed");
+    }
+    int arena_fd = -1;
+    if (!RecvHandshake(fd_, &arena_fd, &capacity_)) {
+      close(fd_);
+      throw std::runtime_error("handshake failed");
+    }
+    base_ = static_cast<uint8_t*>(
+        mmap(nullptr, capacity_, PROT_READ | PROT_WRITE, MAP_SHARED,
+             arena_fd, 0));
+    close(arena_fd);
+    if (base_ == MAP_FAILED) {
+      close(fd_);
+      throw std::runtime_error("client mmap failed");
+    }
+  }
+
+  ~StoreClient() {
+    CloseSocket();
+    if (base_ != MAP_FAILED && base_ != nullptr) munmap(base_, capacity_);
+  }
+
+  void CloseSocket() {
+    std::lock_guard<std::mutex> g(mu_);
+    if (fd_ >= 0) {
+      close(fd_);
+      fd_ = -1;
+    }
+  }
+
+  int64_t Call(uint8_t op, const uint8_t id[16], uint64_t a, uint64_t b,
+               uint64_t* offset, uint64_t* size, uint8_t* extra,
+               uint64_t extra_cap) {
+    std::lock_guard<std::mutex> g(mu_);
+    Request req;
+    memset(&req, 0, sizeof(req));
+    req.op = op;
+    if (id != nullptr) memcpy(req.id, id, 16);
+    req.a = a;
+    req.b = b;
+    if (!WriteFull(fd_, &req, sizeof(req))) return ST_ERR;
+    Response rsp;
+    if (!ReadFull(fd_, &rsp, sizeof(rsp))) return ST_ERR;
+    if (offset != nullptr) *offset = rsp.offset;
+    if (size != nullptr) *size = rsp.size;
+    if (op == OP_LIST && rsp.status > 0) {
+      uint64_t want = static_cast<uint64_t>(rsp.status) * 16;
+      if (want > extra_cap || !ReadFull(fd_, extra, want)) return ST_ERR;
+    }
+    return rsp.status;
+  }
+
+  uint8_t* base() const { return base_; }
+  uint64_t capacity() const { return capacity_; }
+
+ private:
+  int fd_ = -1;
+  uint8_t* base_ = nullptr;
+  uint64_t capacity_ = 0;
+  std::mutex mu_;
+};
+
+}  // namespace
+
+// ------------------------------------------------------------------- C API
+
+extern "C" {
+
+void* rtps_server_start(const char* socket_path, uint64_t capacity) {
+  try {
+    return new StoreServer(socket_path, capacity);
+  } catch (...) {
+    return nullptr;
+  }
+}
+
+void rtps_server_stop(void* srv) {
+  auto* s = static_cast<StoreServer*>(srv);
+  s->Stop();
+  delete s;
+}
+
+void* rtps_client_connect(const char* socket_path) {
+  try {
+    return new StoreClient(socket_path);
+  } catch (...) {
+    return nullptr;
+  }
+}
+
+void rtps_client_disconnect(void* cli) {
+  delete static_cast<StoreClient*>(cli);
+}
+
+// Close only the control socket (server releases this client's refs) while
+// LEAVING the arena mapped: user code may still hold zero-copy views into it,
+// so unmapping would turn those into SIGSEGV. The mapping lives until process
+// exit (plasma clients behave the same way). The handle leaks ~100 bytes.
+void rtps_client_close_socket(void* cli) {
+  static_cast<StoreClient*>(cli)->CloseSocket();
+}
+
+uint8_t* rtps_client_base(void* cli) {
+  return static_cast<StoreClient*>(cli)->base();
+}
+
+int64_t rtps_create(void* cli, const uint8_t* id, uint64_t size,
+                    uint64_t flags, uint64_t* offset) {
+  return static_cast<StoreClient*>(cli)->Call(OP_CREATE, id, size, flags,
+                                              offset, nullptr, nullptr, 0);
+}
+
+int64_t rtps_seal(void* cli, const uint8_t* id) {
+  return static_cast<StoreClient*>(cli)->Call(OP_SEAL, id, 0, 0, nullptr,
+                                              nullptr, nullptr, 0);
+}
+
+int64_t rtps_get(void* cli, const uint8_t* id, uint64_t timeout_ms,
+                 uint64_t* offset, uint64_t* size) {
+  return static_cast<StoreClient*>(cli)->Call(OP_GET, id, timeout_ms, 0,
+                                              offset, size, nullptr, 0);
+}
+
+int64_t rtps_release(void* cli, const uint8_t* id) {
+  return static_cast<StoreClient*>(cli)->Call(OP_RELEASE, id, 0, 0, nullptr,
+                                              nullptr, nullptr, 0);
+}
+
+int64_t rtps_delete(void* cli, const uint8_t* id) {
+  return static_cast<StoreClient*>(cli)->Call(OP_DELETE, id, 0, 0, nullptr,
+                                              nullptr, nullptr, 0);
+}
+
+int64_t rtps_abort(void* cli, const uint8_t* id) {
+  return static_cast<StoreClient*>(cli)->Call(OP_ABORT, id, 0, 0, nullptr,
+                                              nullptr, nullptr, 0);
+}
+
+int64_t rtps_contains(void* cli, const uint8_t* id, uint64_t* size) {
+  return static_cast<StoreClient*>(cli)->Call(OP_CONTAINS, id, 0, 0, nullptr,
+                                              size, nullptr, 0);
+}
+
+int64_t rtps_stats(void* cli, uint64_t* used, uint64_t* capacity) {
+  return static_cast<StoreClient*>(cli)->Call(OP_STATS, nullptr, 0, 0, used,
+                                              capacity, nullptr, 0);
+}
+
+int64_t rtps_list(void* cli, uint64_t max_ids, uint64_t primaries,
+                  uint8_t* ids_out) {
+  return static_cast<StoreClient*>(cli)->Call(OP_LIST, nullptr, max_ids,
+                                              primaries, nullptr, nullptr,
+                                              ids_out, max_ids * 16);
+}
+
+}  // extern "C"

@@ -92,6 +92,9 @@ _d("rpc_call_timeout_s", 60.0)
 _d("max_direct_call_object_size", 100 * 1024)  # inline threshold (bytes)
 _d("object_store_memory_bytes", 2 * 1024**3)   # per-node plasma capacity
 _d("object_store_fallback_dir", "/tmp/ray_tpu_spill")
+_d("enable_plasma_store", True)                # node-local C++ shm store
+_d("object_spilling_high_watermark", 0.80)     # spill above this fill ratio
+_d("object_spilling_low_watermark", 0.60)      # ...down to this ratio
 _d("fetch_retry_interval_ms", 100)
 _d("max_lineage_bytes", 64 * 1024**2)
 _d("enable_lineage_reconstruction", True)

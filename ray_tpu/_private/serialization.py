@@ -48,13 +48,40 @@ class SerializedObject:
     def total_bytes(self) -> int:
         return len(self.inband) + sum(b.raw().nbytes for b in self.buffers)
 
-    def to_bytes(self) -> bytes:
-        """Flatten to a single contiguous wire format (copies buffers)."""
-        out = io.BytesIO()
+    def _wire_parts(self):
         raw_buffers = [b.raw() for b in self.buffers]
         header = pickle.dumps(
             (len(self.inband), [m.nbytes for m in raw_buffers]), protocol=5
         )
+        return header, raw_buffers
+
+    def wire_size(self) -> int:
+        """Size of the flat wire format produced by to_bytes/write_into."""
+        header, raw_buffers = self._wire_parts()
+        return 4 + len(header) + len(self.inband) + sum(
+            m.nbytes for m in raw_buffers)
+
+    def write_into(self, view: memoryview) -> int:
+        """Write the flat wire format directly into a writable buffer (e.g. a
+        shared-memory create() view) — single copy, no intermediate bytes."""
+        header, raw_buffers = self._wire_parts()
+        off = 0
+        view[off:off + 4] = len(header).to_bytes(4, "little")
+        off += 4
+        view[off:off + len(header)] = header
+        off += len(header)
+        view[off:off + len(self.inband)] = self.inband
+        off += len(self.inband)
+        for m in raw_buffers:
+            n = m.nbytes
+            view[off:off + n] = m  # raw() is always 1-D contiguous 'B'
+            off += n
+        return off
+
+    def to_bytes(self) -> bytes:
+        """Flatten to a single contiguous wire format (copies buffers)."""
+        out = io.BytesIO()
+        header, raw_buffers = self._wire_parts()
         out.write(len(header).to_bytes(4, "little"))
         out.write(header)
         out.write(self.inband)

@@ -1,0 +1,264 @@
+"""ctypes binding for the C++ shared-memory object store.
+
+Python face of ray_tpu/_native/src/shm_store.cc — the node-local plasma
+equivalent (reference: ray src/ray/object_manager/plasma/client.cc, store
+protocol plasma/protocol.cc).  `StoreClient.get` returns a zero-copy
+memoryview over the shared arena; `SerializedObject.from_bytes` keeps that
+zero-copy end to end, so a large numpy/jax host buffer read from the store
+feeds `jax.device_put` without a host copy.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+import weakref
+from typing import List, Optional, Tuple
+
+from ray_tpu._native import try_build_library
+
+# Status codes (shm_store.cc enum Status).
+ST_OK = 0
+ST_FULL = -1
+ST_EXISTS = -2
+ST_NOT_FOUND = -3
+ST_TIMEOUT = -4
+ST_NOT_SEALED = -5
+ST_ERR = -6
+
+FLAG_PRIMARY = 1
+
+_lib = None
+_lib_failed = False
+
+
+class ShmStoreError(RuntimeError):
+    pass
+
+
+class ShmStoreFull(ShmStoreError):
+    pass
+
+
+def _load():
+    global _lib, _lib_failed
+    if _lib is not None or _lib_failed:
+        return _lib
+    path = try_build_library("shm_store")
+    if path is None:
+        _lib_failed = True
+        return None
+    lib = ctypes.CDLL(path)
+    lib.rtps_server_start.restype = ctypes.c_void_p
+    lib.rtps_server_start.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+    lib.rtps_server_stop.argtypes = [ctypes.c_void_p]
+    lib.rtps_client_connect.restype = ctypes.c_void_p
+    lib.rtps_client_connect.argtypes = [ctypes.c_char_p]
+    lib.rtps_client_disconnect.argtypes = [ctypes.c_void_p]
+    lib.rtps_client_close_socket.argtypes = [ctypes.c_void_p]
+    lib.rtps_client_base.restype = ctypes.POINTER(ctypes.c_ubyte)
+    lib.rtps_client_base.argtypes = [ctypes.c_void_p]
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    lib.rtps_create.restype = ctypes.c_int64
+    lib.rtps_create.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                ctypes.c_uint64, ctypes.c_uint64, u64p]
+    lib.rtps_seal.restype = ctypes.c_int64
+    lib.rtps_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.rtps_get.restype = ctypes.c_int64
+    lib.rtps_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                             ctypes.c_uint64, u64p, u64p]
+    for fn in ("rtps_release", "rtps_delete", "rtps_abort"):
+        getattr(lib, fn).restype = ctypes.c_int64
+        getattr(lib, fn).argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.rtps_contains.restype = ctypes.c_int64
+    lib.rtps_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p, u64p]
+    lib.rtps_stats.restype = ctypes.c_int64
+    lib.rtps_stats.argtypes = [ctypes.c_void_p, u64p, u64p]
+    lib.rtps_list.restype = ctypes.c_int64
+    lib.rtps_list.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                              ctypes.c_uint64, ctypes.c_char_p]
+    _lib = lib
+    return lib
+
+
+def native_store_available() -> bool:
+    return _load() is not None
+
+
+def _pad_id(object_id: bytes) -> bytes:
+    """Store ids are exactly 16 bytes; ray_tpu ObjectIDs are 28 bytes
+    (task_id(24) + return index(4), SURVEY §2.1 id layout) so a prefix is NOT
+    unique — map through a 16-byte keyed digest, deterministic across
+    processes."""
+    if len(object_id) == 16:
+        return bytes(object_id)
+    import hashlib
+
+    return hashlib.blake2b(bytes(object_id), digest_size=16).digest()
+
+
+class StoreServer:
+    """In-process store server (hosted by the raylet, like plasma inside the
+    raylet process — reference: plasma/store_runner.cc)."""
+
+    def __init__(self, socket_path: str, capacity: int):
+        lib = _load()
+        if lib is None:
+            raise ShmStoreError("native store unavailable (no toolchain)")
+        self._lib = lib
+        self._handle = lib.rtps_server_start(
+            socket_path.encode(), ctypes.c_uint64(capacity))
+        if not self._handle:
+            raise ShmStoreError(f"failed to start store at {socket_path}")
+        self.socket_path = socket_path
+        self.capacity = capacity
+
+    def stop(self):
+        if self._handle:
+            self._lib.rtps_server_stop(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.stop()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
+
+
+class StoreClient:
+    def __init__(self, socket_path: str):
+        lib = _load()
+        if lib is None:
+            raise ShmStoreError("native store unavailable (no toolchain)")
+        self._lib = lib
+        self._handle = lib.rtps_client_connect(socket_path.encode())
+        if not self._handle:
+            raise ShmStoreError(f"failed to connect to store {socket_path}")
+        self._base = lib.rtps_client_base(self._handle)
+        self._closed = False
+
+    def disconnect(self):
+        """Close the control socket (the server auto-releases this client's
+        refs). The arena stays mapped and the native handle is intentionally
+        leaked: user code may still hold zero-copy views into the mapping,
+        and pin finalizers may still fire from the GC thread — both must
+        remain safe after disconnect."""
+        if self._handle and not self._closed:
+            self._closed = True
+            self._lib.rtps_client_close_socket(self._handle)
+
+    close_socket = disconnect
+
+    def __del__(self):
+        try:
+            self.disconnect()
+        except Exception:  # noqa: BLE001
+            pass
+
+    # -- object ops ---------------------------------------------------------
+
+    @staticmethod
+    def _release_pin(client: "StoreClient", key: bytes) -> None:
+        """GC finalizer: the last zero-copy view of an object died; drop the
+        server-side ref so the slot becomes evictable/deletable."""
+        try:
+            if not client._closed:
+                client._lib.rtps_release(client._handle, key)
+        except Exception:  # noqa: BLE001 — GC context, never raise
+            pass
+
+    def _view(self, offset: int, size: int, readonly: bool,
+              pin_key: Optional[bytes] = None) -> memoryview:
+        buf_t = ctypes.c_ubyte * size
+        buf = buf_t.from_address(
+            ctypes.addressof(self._base.contents) + offset)
+        if pin_key is not None:
+            # Tie the store ref to the buffer object's lifetime: numpy views
+            # deserialized zero-copy keep `buf` alive through their .base
+            # chain, so the ref is released exactly when the last user value
+            # dies — never before (use-after-free) nor later (arena leak).
+            weakref.finalize(buf, StoreClient._release_pin, self, pin_key)
+        view = memoryview(buf).cast("B")
+        return view.toreadonly() if readonly else view
+
+    def create(self, object_id: bytes, size: int,
+               primary: bool = True) -> memoryview:
+        """Allocate a writable buffer; must be followed by seal()."""
+        off = ctypes.c_uint64()
+        st = self._lib.rtps_create(
+            self._handle, _pad_id(object_id), ctypes.c_uint64(size),
+            ctypes.c_uint64(FLAG_PRIMARY if primary else 0),
+            ctypes.byref(off))
+        if st == ST_FULL:
+            raise ShmStoreFull(f"store full creating {size} bytes")
+        if st == ST_EXISTS:
+            raise ShmStoreError("object already exists")
+        if st != ST_OK:
+            raise ShmStoreError(f"create failed: {st}")
+        return self._view(off.value, size, readonly=False)
+
+    def seal(self, object_id: bytes) -> None:
+        st = self._lib.rtps_seal(self._handle, _pad_id(object_id))
+        if st != ST_OK:
+            raise ShmStoreError(f"seal failed: {st}")
+
+    def put(self, object_id: bytes, data, primary: bool = True) -> None:
+        view = self.create(object_id, len(data), primary=primary)
+        view[:] = data
+        self.seal(object_id)
+        self.release(object_id)
+
+    def get(self, object_id: bytes,
+            timeout_ms: Optional[int] = 0) -> Optional[memoryview]:
+        """Zero-copy read-only view, or None on timeout/absent.
+
+        The store ref is auto-released when the returned view (and anything
+        aliasing it, e.g. zero-copy numpy arrays) is garbage collected; an
+        earlier explicit release(id) is allowed and idempotent.
+        """
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        t = (2**64 - 1) if timeout_ms is None else int(timeout_ms)
+        key = _pad_id(object_id)
+        st = self._lib.rtps_get(
+            self._handle, key, ctypes.c_uint64(t),
+            ctypes.byref(off), ctypes.byref(size))
+        if st in (ST_TIMEOUT, ST_NOT_FOUND):
+            return None
+        if st != ST_OK:
+            raise ShmStoreError(f"get failed: {st}")
+        return self._view(off.value, size.value, readonly=True, pin_key=key)
+
+    def release(self, object_id: bytes) -> None:
+        self._lib.rtps_release(self._handle, _pad_id(object_id))
+
+    def delete(self, object_id: bytes) -> None:
+        self._lib.rtps_delete(self._handle, _pad_id(object_id))
+
+    def abort(self, object_id: bytes) -> None:
+        self._lib.rtps_abort(self._handle, _pad_id(object_id))
+
+    def contains(self, object_id: bytes) -> bool:
+        size = ctypes.c_uint64()
+        return self._lib.rtps_contains(
+            self._handle, _pad_id(object_id), ctypes.byref(size)) == ST_OK
+
+    def stats(self) -> Tuple[int, int, int]:
+        """-> (num_objects, bytes_used, bytes_capacity)."""
+        used = ctypes.c_uint64()
+        cap = ctypes.c_uint64()
+        n = self._lib.rtps_stats(self._handle, ctypes.byref(used),
+                                 ctypes.byref(cap))
+        return int(n), int(used.value), int(cap.value)
+
+    def list_ids(self, max_ids: int = 4096,
+                 primaries: bool = True) -> List[bytes]:
+        """Sealed, unreferenced object ids, LRU-first (spill candidates when
+        primaries=True; evictable caches when False)."""
+        buf = ctypes.create_string_buffer(max_ids * 16)
+        n = self._lib.rtps_list(
+            self._handle, ctypes.c_uint64(max_ids),
+            ctypes.c_uint64(1 if primaries else 0), buf)
+        if n < 0:
+            raise ShmStoreError(f"list failed: {n}")
+        return [buf.raw[i * 16:(i + 1) * 16] for i in range(n)]

@@ -20,6 +20,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import os
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
@@ -110,11 +111,23 @@ class Raylet:
         self.address: Optional[str] = None
         self._tasks: List[asyncio.Task] = []
         self._stopped = False
+        # Node-local C++ shm object store (plasma equivalent, hosted inside
+        # the raylet like the reference's store_runner.cc) + disk spilling
+        # state (reference: raylet/local_object_manager.h:41).
+        self._store_server = None
+        self._store_client = None
+        self.store_socket: Optional[str] = None
+        self._spilled: Dict[bytes, str] = {}  # store key -> spill file path
+        self._spill_dir: Optional[str] = None
+        # Serializes _spill_until across the watermark loop and per-worker
+        # spill_objects RPCs (both run via asyncio.to_thread).
+        self._spill_lock = threading.Lock()
 
     # ------------------------------------------------------------------ start
     def start(self, port: int = 0, max_workers: Optional[int] = None) -> str:
         self._server.register_all(self)
         self.address = self._server.start(port)
+        self._start_object_store()
         self.total.setdefault(f"node:{self.address}", 1.0)
         self.available.setdefault(f"node:{self.address}", 1.0)
         if max_workers is None:
@@ -155,9 +168,135 @@ class Raylet:
             self.worker_pool.start()
             self._tasks.append(self._lt.loop.create_task(self._heartbeat_loop()))
             self._tasks.append(self._lt.loop.create_task(self._dispatch_loop()))
+            if self._store_client is not None:
+                self._tasks.append(self._lt.loop.create_task(self._spill_loop()))
 
         self._lt.loop.call_soon_threadsafe(_start_tasks)
         return self.address
+
+    # ------------------------------------------------- object store hosting
+    def _start_object_store(self):
+        """Host the node's C++ shm store; workers learn the socket at
+        registration (like plasma's socket in the reference's node info)."""
+        if not CONFIG.enable_plasma_store:
+            return
+        try:
+            from ray_tpu._private.shm_store import StoreClient, StoreServer
+            from ray_tpu._private.shm_store import native_store_available
+
+            if not native_store_available():
+                return
+            sock_dir = os.path.join(CONFIG.log_dir, "sockets")
+            os.makedirs(sock_dir, exist_ok=True)
+            # Unix socket paths cap at ~107 chars; keep it short.
+            sock = os.path.join(sock_dir, f"st-{self.node_id.hex()[:12]}.sock")
+            self._store_server = StoreServer(
+                sock, CONFIG.object_store_memory_bytes)
+            self._store_client = StoreClient(sock)
+            self.store_socket = sock
+            self._spill_dir = os.path.join(
+                CONFIG.object_store_fallback_dir, self.node_id.hex()[:12])
+        except Exception as e:  # noqa: BLE001 — degrade to memory-only store
+            logger.warning("node object store unavailable: %s", e)
+            self._store_server = None
+            self._store_client = None
+
+    def _spill_until(self, target_bytes: int) -> int:
+        """Spill LRU unreferenced primaries until usage <= target. Returns
+        bytes spilled. Runs on the caller's thread (file IO off the loop)."""
+        c = self._store_client
+        if c is None:
+            return 0
+        with self._spill_lock:
+            spilled = 0
+            _, used, cap = c.stats()
+            if used <= target_bytes:
+                return 0
+            os.makedirs(self._spill_dir, exist_ok=True)
+            for key in c.list_ids(primaries=True):
+                view = c.get(key, timeout_ms=0)
+                if view is None:
+                    continue
+                path = os.path.join(self._spill_dir, key.hex())
+                tmp = f"{path}.tmp.{threading.get_ident()}"
+                try:
+                    with open(tmp, "wb") as f:
+                        f.write(view)
+                    os.replace(tmp, path)
+                finally:
+                    c.release(key)
+                self._spilled[key] = path
+                c.delete(key)
+                spilled += len(view)
+                _, used, cap = c.stats()
+                if used <= target_bytes:
+                    break
+            return spilled
+
+    async def _spill_loop(self):
+        """Watermark-driven background spilling (reference: plasma create
+        backpressure + local_object_manager spilling)."""
+        while True:
+            await asyncio.sleep(1.0)
+            c = self._store_client
+            if c is None:
+                return
+            try:
+                _, used, cap = c.stats()
+                if used > CONFIG.object_spilling_high_watermark * cap:
+                    target = int(CONFIG.object_spilling_low_watermark * cap)
+                    n = await asyncio.to_thread(self._spill_until, target)
+                    if n:
+                        logger.info("spilled %d bytes to %s", n, self._spill_dir)
+            except Exception:  # noqa: BLE001 — keep the loop alive
+                logger.exception("spill loop error")
+
+    async def handle_spill_objects(self, payload):
+        """A worker hit store-full: spill synchronously to make room."""
+        if self._store_client is None:
+            return 0
+        _, used, cap = self._store_client.stats()
+        need = payload.get("need", 0)
+        target = max(0, min(int(CONFIG.object_spilling_low_watermark * cap),
+                            cap - need))
+        return await asyncio.to_thread(self._spill_until, target)
+
+    async def handle_restore_object(self, payload):
+        """Restore a spilled object back into shm for a reader."""
+        from ray_tpu._private.shm_store import _pad_id
+
+        oid = payload["object_id"]
+        key = _pad_id(oid.binary())
+        path = self._spilled.get(key)
+        if path is None or self._store_client is None:
+            return False
+
+        def _restore() -> bool:
+            try:
+                with open(path, "rb") as f:
+                    data = f.read()
+            except OSError:
+                return False
+            try:
+                self._store_client.put(key, data, primary=True)
+            except Exception:  # noqa: BLE001 — EXISTS race is success
+                return self._store_client.contains(key)
+            return True
+
+        return await asyncio.to_thread(_restore)
+
+    async def handle_free_spilled(self, payload):
+        from ray_tpu._private.shm_store import _pad_id
+
+        for oid in payload["object_ids"]:
+            key = _pad_id(oid.binary())
+            path = self._spilled.pop(key, None)
+            if path is not None:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+        return True
 
     def stop(self, unregister: bool = True):
         if self._stopped:
@@ -165,6 +304,12 @@ class Raylet:
         self._stopped = True
         for t in self._tasks:
             t.cancel()
+        if self._store_client is not None:
+            self._store_client.disconnect()
+            self._store_client = None
+        if self._store_server is not None:
+            self._store_server.stop()
+            self._store_server = None
         if self.worker_pool is not None:
             self.worker_pool.shutdown()
         if unregister and self._gcs is not None:
@@ -184,13 +329,16 @@ class Raylet:
             payload["worker_id"], payload["pid"], payload["address"]
         )
         self._kick()
-        return {"status": "ok", "node_id": self.node_id}
+        return {"status": "ok", "node_id": self.node_id,
+                "store_socket": self.store_socket}
 
     async def handle_register_driver(self, payload):
         self.worker_pool.register_driver(
             payload["worker_id"], payload["pid"], payload["address"]
         )
-        return {"status": "ok", "node_id": self.node_id, "gcs_address": self.gcs_address}
+        return {"status": "ok", "node_id": self.node_id,
+                "gcs_address": self.gcs_address,
+                "store_socket": self.store_socket}
 
     async def handle_return_worker(self, payload):
         """Lease released by the submitter (direct_task_transport returns)."""

@@ -156,6 +156,9 @@ class CoreWorker:
         self._shutdown = False
         self.current_actor_id: Optional[ActorID] = None
         self.is_actor_worker = False
+        # Node-local shm store provider (plasma equivalent); connected after
+        # raylet registration hands us the store socket.
+        self.plasma = None
 
         # -- connect --
         self._register_handlers()
@@ -187,6 +190,7 @@ class CoreWorker:
                 node_id=self.node_id, worker_id=self.worker_id,
                 rpc_address=self.address_str,
             )
+            self._connect_plasma(reply.get("store_socket"))
         self._lease_reaper = self._lt.submit(self._lease_reaper_loop())
         self._event_flusher = self._lt.submit(self._task_event_loop())
         # Node-death awareness: a dead raylet's TCP connections can linger
@@ -198,6 +202,23 @@ class CoreWorker:
             "subscribe",
             {"channel": ps.NODE_CHANNEL, "subscriber_address": self.address_str},
         )
+
+    def _connect_plasma(self, store_socket: Optional[str]) -> None:
+        if not store_socket or not CONFIG.enable_plasma_store:
+            return
+        try:
+            from ray_tpu.worker.plasma_provider import PlasmaProvider
+
+            def _raylet_call(method, payload):
+                return self._raylet.call(method, payload, timeout=60)
+
+            self.plasma = PlasmaProvider(store_socket, _raylet_call)
+        except Exception as e:  # noqa: BLE001 — degrade to in-memory objects
+            logger.warning("plasma store unavailable: %s", e)
+            self.plasma = None
+
+    def _plasma_threshold(self) -> int:
+        return CONFIG.max_direct_call_object_size
 
     # ------------------------------------------------------------- lifecycle
     def _register_handlers(self):
@@ -228,6 +249,12 @@ class CoreWorker:
         self._lease_reaper.cancel()
         self._event_flusher.cancel()
         self.executor.shutdown()
+        if self.plasma is not None:
+            try:
+                self.plasma.close()
+            except Exception:  # noqa: BLE001 — store may already be gone
+                pass
+            self.plasma = None
         self._peers.close_all()
         self._gcs.close()
         if self._raylet is not None:
@@ -296,7 +323,17 @@ class CoreWorker:
             idx = self._put_index
         oid = ObjectID.for_put(self.current_task_id(), idx)
         s = ser.serialize(value)
-        self.memory_store.put_serialized(oid, s, value=value)
+        # Large payloads go to the node shm store so sibling processes read
+        # them zero-copy (reference: Put > inline threshold lands in plasma,
+        # core_worker.cc:1242).
+        if (self.plasma is not None
+                and s.total_bytes() > self._plasma_threshold()
+                and self.plasma.put_serialized(oid, s, primary=True)):
+            self.memory_store.put_serialized(
+                oid, None, value=value, in_plasma=True,
+                plasma_node=self.node_id.hex() if self.node_id else None)
+        else:
+            self.memory_store.put_serialized(oid, s, value=value)
         self.reference_counter.add_owned(oid, self.address)
         for ref in s.contained_refs:
             pass  # nested refs stay alive via the stored value holding them
@@ -343,6 +380,16 @@ class CoreWorker:
                 return self._materialize(oid, entry, deadline)
             if owner is None:
                 raise exc.ObjectLostError(oid.hex())
+            # Borrower fast path: the owner (or the executing worker) may be
+            # on this node, in which case the payload is already in the node
+            # shm store — read it zero-copy without owner RPC.
+            if self.plasma is not None:
+                s = self.plasma.get_serialized(oid, restore=False)
+                if s is not None:
+                    value, _ = ser.deserialize(s)
+                    self.memory_store.put_serialized(
+                        oid, None, value=value, in_plasma=True)
+                    return value
             # Borrower path: long-poll the owner.
             rem = self._remaining(deadline)
             slice_t = 2.0 if rem is None else min(2.0, rem)
@@ -378,16 +425,32 @@ class CoreWorker:
     def _materialize(self, oid: ObjectID, entry: StoreEntry, deadline) -> Any:
         if entry.freed:
             raise exc.ObjectFreedError(oid.hex())
+        if entry.value is not _SENTINEL:
+            if entry.is_exception:
+                self._raise_stored_error(entry.value)
+            return entry.value
+        if entry.serialized is None and entry.in_plasma:
+            # Same-node shm read (zero-copy; restores from disk if spilled).
+            local = (self.plasma is not None and
+                     (entry.plasma_node is None or self.node_id is None or
+                      entry.plasma_node == self.node_id.hex()))
+            if local:
+                s = self.plasma.get_serialized(oid)
+                if s is not None:
+                    value, _ = ser.deserialize(s)
+                    self.memory_store.cache_value(oid, value)
+                    if entry.is_exception:
+                        self._raise_stored_error(value)
+                    return value
+            # Remote (or lost locally): fall through to the location fetch.
+            if entry.location is None:
+                raise exc.ObjectLostError(oid.hex())
         if entry.location is not None and entry.serialized is None:
             data = self._fetch_from_location(oid, entry.location, self.address, deadline)
             value, _ = ser.deserialize(data)
             if entry.is_exception:
                 self._raise_stored_error(value)
             return value
-        if entry.value is not _SENTINEL:
-            if entry.is_exception:
-                self._raise_stored_error(entry.value)
-            return entry.value
         value, _ = ser.deserialize(entry.serialized)
         self.memory_store.cache_value(oid, value)
         if entry.is_exception:
@@ -842,7 +905,10 @@ class CoreWorker:
         if "inline" in payload:
             self.memory_store.put_serialized(oid, payload["inline"])
         else:
-            self.memory_store.put_serialized(oid, None, location=payload["location"])
+            self.memory_store.put_serialized(
+                oid, None, location=payload["location"],
+                in_plasma=payload.get("plasma_node") is not None,
+                plasma_node=payload.get("plasma_node"))
             self.reference_counter.set_location(oid, payload["location"])
 
     def _store_error_for_task(self, spec: TaskSpec, error: BaseException):
@@ -1224,6 +1290,17 @@ class CoreWorker:
             return {"status": "freed"}
         if entry.location is not None and entry.serialized is None:
             return {"status": "ready", "location": entry.location}
+        if entry.in_plasma and entry.serialized is None:
+            # Owner holds the payload in its node shm store: serve it from
+            # there (borrower is remote — same-node borrowers hit shm
+            # directly and never reach this RPC).
+            if want_value:
+                s = await asyncio.to_thread(self._read_local_plasma, oid)
+                if s is None:
+                    return {"status": "freed"}
+                return {"status": "ready", "data": s,
+                        "is_exception": entry.is_exception}
+            return {"status": "ready"}
         if want_value:
             return {
                 "status": "ready",
@@ -1232,17 +1309,39 @@ class CoreWorker:
             }
         return {"status": "ready"}
 
+    def _read_local_plasma(self, oid: ObjectID):
+        if self.plasma is None:
+            return None
+        return self.plasma.get_serialized(oid)
+
     async def _handle_fetch_object(self, payload):
         oid: ObjectID = payload["object_id"]
         entry = self.memory_store.get_entry(oid)
-        if entry is None or entry.serialized is None:
+        if entry is None:
+            return {"status": "not_found"}
+        if entry.serialized is None and entry.in_plasma:
+            s = await asyncio.to_thread(self._read_local_plasma, oid)
+            if s is None:
+                return {"status": "not_found"}
+            return {"status": "ok", "data": s}
+        if entry.serialized is None:
             return {"status": "not_found"}
         return {"status": "ok", "data": entry.serialized}
 
     async def _handle_free_objects(self, payload):
+        plasma_frees = []
+        for oid in payload["object_ids"]:
+            entry = self.memory_store.get_entry(oid)
+            if entry is not None and entry.in_plasma and self.plasma is not None:
+                plasma_frees.append(oid)
         self.memory_store.delete(payload["object_ids"])
         for oid in payload["object_ids"]:
             self._secondary_copies.discard(oid)
+        if plasma_frees:
+            def _free():
+                for oid in plasma_frees:
+                    self.plasma.free(oid)
+            await asyncio.to_thread(_free)
         return True
 
     async def _handle_add_borrower(self, payload):
@@ -1377,7 +1476,12 @@ class CoreWorker:
         )
 
     def _free_owned_object(self, oid: ObjectID, location: Optional[str]):
+        entry = self.memory_store.get_entry(oid)
         self.memory_store.delete([oid])
+        if (entry is not None and entry.in_plasma and self.plasma is not None
+                and (entry.plasma_node is None or self.node_id is None
+                     or entry.plasma_node == self.node_id.hex())):
+            self.plasma.free(oid)
         if location is not None and location != self.address_str:
             try:
                 self._peers.get(location).send("free_objects", {"object_ids": [oid]})
@@ -1389,7 +1493,13 @@ class CoreWorker:
         for ref in refs:
             oid = ref.object_id()
             loc = self.reference_counter.get_location(oid)
+            entry = self.memory_store.get_entry(oid)
             self.memory_store.mark_freed(oid)
+            if (entry is not None and entry.in_plasma
+                    and self.plasma is not None
+                    and (entry.plasma_node is None or self.node_id is None
+                         or entry.plasma_node == self.node_id.hex())):
+                self.plasma.free(oid)
             if loc is not None:
                 try:
                     self._peers.get(loc).send("free_objects", {"object_ids": [oid]})

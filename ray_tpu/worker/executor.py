@@ -158,10 +158,22 @@ class Executor:
         s = ser.serialize(value)
         if s.total_bytes() <= CONFIG.max_direct_call_object_size:
             return {"inline": s}
-        # Keep the primary copy here; the owner records the location.
-        self.cw.memory_store.put_serialized(oid, s, value=value)
+        # Keep the primary copy on this node; the owner records the location.
+        # Preferred home is the node shm store (same-node readers map it
+        # zero-copy; the raylet can spill it); fall back to this worker's
+        # memory store when the shm store is absent/full.
+        plasma_node = None
+        if (self.cw.plasma is not None
+                and self.cw.plasma.put_serialized(oid, s, primary=True)):
+            plasma_node = self.cw.node_id.hex() if self.cw.node_id else ""
+            self.cw.memory_store.put_serialized(
+                oid, None, value=value, in_plasma=True,
+                plasma_node=plasma_node)
+        else:
+            self.cw.memory_store.put_serialized(oid, s, value=value)
         self.cw.hold_secondary_copy(oid)
-        return {"location": self.cw.address.rpc_address}
+        return {"location": self.cw.address.rpc_address,
+                "plasma_node": plasma_node}
 
     def _error_reply(self, spec: TaskSpec, exc: BaseException) -> dict:
         if isinstance(exc, RayTaskError):

@@ -28,6 +28,10 @@ class StoreEntry:
     # object is not here; it lives at this worker address (secondary copy holder)
     location: Optional[str] = None
     freed: bool = False
+    # payload lives in a node-local shm store (plasma); when the holder is a
+    # remote node, plasma_node says which node's store has the primary copy.
+    in_plasma: bool = False
+    plasma_node: Optional[str] = None
 
 
 class MemoryStore:
@@ -46,12 +50,16 @@ class MemoryStore:
         value: Any = _SENTINEL,
         is_exception: bool = False,
         location: Optional[str] = None,
+        in_plasma: bool = False,
+        plasma_node: Optional[str] = None,
     ) -> None:
         entry = StoreEntry(
             serialized=serialized,
             value=value,
             is_exception=is_exception,
             location=location,
+            in_plasma=in_plasma,
+            plasma_node=plasma_node,
         )
         with self._lock:
             self._entries[object_id] = entry

@@ -1,0 +1,101 @@
+"""Worker-side provider for the node-local C++ shared-memory object store.
+
+Equivalent of the reference's CoreWorkerPlasmaStoreProvider
+(ray: src/ray/core_worker/store_provider/plasma_store_provider.h:88): puts
+objects above the inline threshold into the node's shm store and reads them
+back zero-copy.  Restore-on-miss goes through the raylet, which owns disk
+spilling (reference: raylet/local_object_manager.h:41).
+
+Zero-copy discipline: a deserialized value may alias the shm arena (pickle5
+out-of-band numpy buffers).  StoreClient.get ties the store ref to the GC
+lifetime of the mapped view, so the slot stays pinned exactly as long as any
+user value aliases it — a delete() while values are alive defers server-side
+until the last view dies (plasma's pinning semantics).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from ray_tpu._private import serialization as ser
+from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.shm_store import ShmStoreError, ShmStoreFull, StoreClient
+
+logger = logging.getLogger(__name__)
+
+
+class PlasmaProvider:
+    def __init__(self, socket_path: str, raylet_call=None):
+        """raylet_call(method, payload) -> reply; used for spill/restore."""
+        self._client = StoreClient(socket_path)
+        self._raylet_call = raylet_call
+
+    # -- write --------------------------------------------------------------
+
+    def put_serialized(self, oid: ObjectID, s: ser.SerializedObject,
+                       primary: bool = True) -> bool:
+        """Write the flat payload into shm. Returns False when it doesn't fit
+        (caller falls back to in-memory bytes)."""
+        key = oid.binary()
+        size = s.wire_size()
+        for attempt in (0, 1):
+            try:
+                view = self._client.create(key, size, primary=primary)
+            except ShmStoreFull:
+                if attempt == 0 and self._raylet_call is not None:
+                    try:  # ask the raylet to spill cold primaries, then retry
+                        self._raylet_call("spill_objects", {"need": size})
+                        continue
+                    except Exception:  # noqa: BLE001 — spill is best-effort
+                        return False
+                return False
+            except ShmStoreError:
+                return False
+            try:
+                s.write_into(view)
+            finally:
+                del view
+            self._client.seal(key)
+            self._client.release(key)
+            return True
+        return False
+
+    # -- read ---------------------------------------------------------------
+
+    def get_serialized(self, oid: ObjectID,
+                       restore: bool = True) -> Optional[ser.SerializedObject]:
+        """Zero-copy read; the underlying slot stays pinned while any
+        deserialized value aliases it (GC-tied ref, see StoreClient.get)."""
+        key = oid.binary()
+        view = self._client.get(key, timeout_ms=0)
+        if view is None and restore and self._raylet_call is not None:
+            try:
+                ok = self._raylet_call("restore_object", {"object_id": oid})
+            except Exception:  # noqa: BLE001 — raylet down ⇒ treat as miss
+                ok = False
+            if ok:
+                view = self._client.get(key, timeout_ms=1000)
+        if view is None:
+            return None
+        return ser.SerializedObject.from_bytes(view)
+
+    def contains(self, oid: ObjectID) -> bool:
+        return self._client.contains(oid.binary())
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def free(self, oid: ObjectID) -> None:
+        """Delete the object (server defers the slot free until the last
+        pinned reader view dies) and drop any spilled copy."""
+        self._client.delete(oid.binary())
+        if self._raylet_call is not None:
+            try:
+                self._raylet_call("free_spilled", {"object_ids": [oid]})
+            except Exception:  # noqa: BLE001
+                pass
+
+    def close(self) -> None:
+        """Close the control socket. The arena mapping stays alive so any
+        user-held zero-copy values remain valid."""
+        self._client.disconnect()
