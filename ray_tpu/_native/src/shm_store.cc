@@ -49,6 +49,7 @@
 #include <cstdlib>
 #include <list>
 #include <map>
+#include <memory>
 #include <mutex>
 #include <thread>
 #include <unordered_map>
@@ -299,8 +300,13 @@ class StoreServer {
       cv_.notify_all();
     }
     if (accept_thread_.joinable()) accept_thread_.join();
-    for (auto& t : conn_threads_) {
-      if (t.joinable()) t.join();
+    std::vector<std::unique_ptr<Conn>> conns;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      conns.swap(conn_threads_);
+    }
+    for (auto& c : conns) {
+      if (c->thread.joinable()) c->thread.join();
     }
     munmap(base_, arena_.capacity());
     close(arena_fd_);
@@ -322,8 +328,35 @@ class StoreServer {
       int id = conn_id++;
       {
         std::lock_guard<std::mutex> g(mu_);
+        // Stop() may have run between accept4 and here; registering now
+        // would miss its shutdown pass and leave a Serve thread blocked in
+        // read() forever (deadlocking Stop's join).
+        if (stopping_.load()) {
+          close(fd);
+          break;
+        }
+        ReapFinishedLocked();
         conn_fds_.push_back(fd);
-        conn_threads_.emplace_back([this, fd, id] { Serve(fd, id); });
+        conn_threads_.emplace_back(
+            new Conn{std::thread(), {false}});
+        Conn* c = conn_threads_.back().get();
+        c->thread = std::thread([this, fd, id, c] {
+          Serve(fd, id);
+          c->done.store(true);
+        });
+      }
+    }
+  }
+
+  // Join threads whose Serve() has exited (bounds conn_threads_ growth under
+  // connection churn). Caller holds mu_.
+  void ReapFinishedLocked() {
+    for (auto it = conn_threads_.begin(); it != conn_threads_.end();) {
+      if ((*it)->done.load()) {
+        if ((*it)->thread.joinable()) (*it)->thread.join();
+        it = conn_threads_.erase(it);
+      } else {
+        ++it;
       }
     }
   }
@@ -396,6 +429,10 @@ class StoreServer {
           objects_.erase(it);
         }
       }
+      // Forget this connection's fd so Stop() never calls shutdown() on an
+      // fd number the process may have reused for an unrelated socket.
+      conn_fds_.erase(std::remove(conn_fds_.begin(), conn_fds_.end(), fd),
+                      conn_fds_.end());
       cv_.notify_all();
     }
     close(fd);
@@ -541,13 +578,18 @@ class StoreServer {
     return {static_cast<int64_t>(n), 0, 0};
   }
 
+  struct Conn {
+    std::thread thread;
+    std::atomic<bool> done;
+  };
+
   std::string path_;
   Arena arena_;
   int arena_fd_ = -1;
   uint8_t* base_ = nullptr;
   int listen_fd_ = -1;
   std::thread accept_thread_;
-  std::vector<std::thread> conn_threads_;
+  std::vector<std::unique_ptr<Conn>> conn_threads_;
   std::vector<int> conn_fds_;
   std::mutex mu_;
   std::condition_variable cv_;

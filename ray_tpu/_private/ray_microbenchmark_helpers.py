@@ -1,0 +1,46 @@
+"""Microbenchmark harness.
+
+Reference: ray python/ray/_private/ray_microbenchmark_helpers.py:15 — the
+`timeit` helper runs each benchmark in fixed-duration batches and reports
+throughput (multiplier = ops per fn() call).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, List, Optional, Tuple
+
+Result = Tuple[str, float, float]  # (name, mean ops/s, stddev)
+
+
+def timeit(name: str, fn: Callable[[], None], multiplier: float = 1,
+           warmup_time_s: float = 1.0, duration_s: float = 2.0,
+           rounds: int = 3) -> Result:
+    """Run fn repeatedly for warmup, then `rounds` timed windows; report the
+    mean and stddev of ops/s across windows."""
+    deadline = time.monotonic() + warmup_time_s
+    while time.monotonic() < deadline:
+        fn()
+    rates: List[float] = []
+    for _ in range(rounds):
+        n = 0
+        start = time.monotonic()
+        stop = start + duration_s / rounds
+        while time.monotonic() < stop:
+            fn()
+            n += 1
+        elapsed = time.monotonic() - start
+        rates.append(n * multiplier / elapsed)
+    mean = sum(rates) / len(rates)
+    var = sum((r - mean) ** 2 for r in rates) / len(rates)
+    return (name, mean, var ** 0.5)
+
+
+def format_results(results: List[Optional[Result]]) -> str:
+    lines = []
+    for r in results:
+        if r is None:
+            continue
+        name, mean, std = r
+        lines.append(f"{name} per second {mean:.2f} +- {std:.2f}")
+    return "\n".join(lines)

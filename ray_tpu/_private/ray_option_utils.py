@@ -10,6 +10,8 @@ from typing import Any, Dict, Optional
 
 from ray_tpu._private.config import CONFIG
 
+_UNSET = object()  # sentinel: "use the per-kind default CPU"
+
 _COMMON_OPTIONS = {
     "num_cpus", "num_gpus", "num_tpus", "resources", "max_retries",
     "retry_exceptions", "num_returns", "scheduling_strategy", "name",
@@ -45,15 +47,19 @@ def validate_options(options: Dict[str, Any], *, is_actor: bool) -> Dict[str, An
     return options
 
 
-def resources_from_options(options: Dict[str, Any], *, is_actor: bool):
+def resources_from_options(options: Dict[str, Any], *, is_actor: bool,
+                           default_cpu: Optional[float] = _UNSET):
+    """Translate @remote options to a resource dict. default_cpu=None means
+    'no CPU unless explicitly requested' (used for actor HELD resources)."""
     resources = dict(options.get("resources") or {})
     if "num_cpus" in options and options["num_cpus"] is not None:
         resources["CPU"] = float(options["num_cpus"])
     else:
-        resources.setdefault(
-            "CPU",
-            CONFIG.default_actor_num_cpus if is_actor else CONFIG.default_task_num_cpus,
-        )
+        if default_cpu is _UNSET:
+            default_cpu = (CONFIG.default_actor_num_cpus if is_actor
+                           else CONFIG.default_task_num_cpus)
+        if default_cpu is not None:
+            resources.setdefault("CPU", default_cpu)
     if options.get("num_gpus"):
         resources["GPU"] = float(options["num_gpus"])
     if options.get("num_tpus"):
@@ -61,6 +67,19 @@ def resources_from_options(options: Dict[str, Any], *, is_actor: bool):
     if options.get("memory"):
         resources["memory"] = float(options["memory"])
     return resources
+
+
+def actor_resources_from_options(options: Dict[str, Any]):
+    """-> (held, placement): resources an actor HOLDS for its lifetime vs the
+    resources used for the placement decision. Matches the reference (ray
+    actor default: schedules with 1 CPU, holds 0 — required_resources vs
+    required_placement_resources in TaskSpec), so idle actors don't pin CPUs
+    and a 4-CPU node can host hundreds of actors."""
+    held = resources_from_options(options, is_actor=True, default_cpu=None)
+    placement = dict(held)
+    if "CPU" not in held:
+        placement["CPU"] = CONFIG.default_actor_num_cpus
+    return held, placement
 
 
 def merge_options(base: Optional[Dict[str, Any]], overrides: Dict[str, Any]):

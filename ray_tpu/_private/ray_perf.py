@@ -1,0 +1,166 @@
+"""Core microbenchmark suite.
+
+Reference: ray python/ray/_private/ray_perf.py:93-317 — the canonical list:
+single/multi-client object put/get calls/s, put GB/s, task submission
+(sync/async), 1:1 / 1:n / n:n actor calls/s, async-actor variants, placement
+group create/remove per second. Run via `python -m ray_tpu._private.ray_perf`
+or the `ray-tpu microbenchmark` CLI.
+
+TPU additions beyond the reference list: shm-store zero-copy get GB/s (the
+host-side staging path for device_put) — the data-plane metric that matters
+for feeding a TPU chip.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu._private.ray_microbenchmark_helpers import (
+    Result,
+    format_results,
+    timeit,
+)
+
+
+def main(quick: bool = False) -> list:
+    results: list = []
+    dur = 0.6 if quick else 2.0
+    rounds = 2 if quick else 3
+
+    def bench(name, fn, multiplier=1):
+        results.append(timeit(name, fn, multiplier,
+                              warmup_time_s=0.2 if quick else 1.0,
+                              duration_s=dur, rounds=rounds))
+
+    ray_tpu.init(num_cpus=4)
+    try:
+        # ---- object store -------------------------------------------------
+        small = b"x" * 1024
+
+        def put_small():
+            for _ in range(100):
+                ray_tpu.put(small)
+
+        bench("single client put calls (1KiB)", put_small, 100)
+
+        refs_cache = [ray_tpu.put(small) for _ in range(100)]
+
+        def get_small():
+            for r in refs_cache:
+                ray_tpu.get(r)
+
+        bench("single client get calls (1KiB)", get_small, 100)
+
+        arr = np.zeros(10 * 1024 * 1024, dtype=np.uint8)  # 10 MiB
+
+        def put_gb():
+            ref = ray_tpu.put(arr)
+            ray_tpu._raylet.get_core_worker().free_objects([ref])
+
+        bench("single client put gigabytes", put_gb, 10 / 1024)
+
+        big_ref = ray_tpu.put(arr)
+
+        @ray_tpu.remote
+        def read_big(a):
+            return a.nbytes
+
+        def get_gb():
+            # cross-process zero-copy read through the shm store
+            ray_tpu.get(read_big.remote(big_ref))
+
+        bench("multi client get gigabytes (shm)", get_gb, 10 / 1024)
+
+        # ---- tasks --------------------------------------------------------
+        @ray_tpu.remote
+        def noop():
+            pass
+
+        def submit_sync():
+            ray_tpu.get(noop.remote())
+
+        bench("single client tasks sync", submit_sync)
+
+        def submit_async():
+            ray_tpu.get([noop.remote() for _ in range(100)])
+
+        bench("single client tasks async", submit_async, 100)
+
+        # ---- actors -------------------------------------------------------
+        @ray_tpu.remote
+        class Actor:
+            def ping(self):
+                pass
+
+            async def aping(self):
+                pass
+
+        a = Actor.remote()
+        ray_tpu.get(a.ping.remote())
+
+        def actor_sync():
+            ray_tpu.get(a.ping.remote())
+
+        bench("1:1 actor calls sync", actor_sync)
+
+        def actor_async():
+            ray_tpu.get([a.ping.remote() for _ in range(100)])
+
+        bench("1:1 actor calls async", actor_async, 100)
+
+        actors = [Actor.remote() for _ in range(4)]
+        ray_tpu.get([b.ping.remote() for b in actors])
+
+        def one_to_n():
+            ray_tpu.get([b.ping.remote() for b in actors for _ in range(25)])
+
+        bench("1:n actor calls async", one_to_n, 100)
+
+        @ray_tpu.remote
+        class Caller:
+            def __init__(self, targets):
+                self.targets = targets
+
+            def run(self, n):
+                ray_tpu.get([t.ping.remote() for t in self.targets
+                             for _ in range(n)])
+
+        callers = [Caller.remote(actors) for _ in range(4)]
+        ray_tpu.get([c.run.remote(1) for c in callers])
+
+        def n_to_n():
+            ray_tpu.get([c.run.remote(25) for c in callers])
+
+        bench("n:n actor calls async", n_to_n, 400)
+
+        aa = Actor.options(max_concurrency=8).remote()
+        ray_tpu.get(aa.aping.remote())
+
+        def async_actor():
+            ray_tpu.get([aa.aping.remote() for _ in range(100)])
+
+        bench("1:1 async-actor calls async", async_actor, 100)
+
+        # ---- placement groups --------------------------------------------
+        from ray_tpu.util.placement_group import (
+            placement_group,
+            remove_placement_group,
+        )
+
+        def pg_cycle():
+            pg = placement_group([{"CPU": 0.1}], strategy="PACK")
+            ray_tpu.get(pg.ready(), timeout=10)
+            remove_placement_group(pg)
+
+        bench("placement group create/removal", pg_cycle)
+    finally:
+        ray_tpu.shutdown()
+    print(format_results(results))
+    return results
+
+
+if __name__ == "__main__":
+    import sys
+
+    main(quick="--quick" in sys.argv)

@@ -28,12 +28,13 @@ def _get_ctx_stack():
 class SerializedObject:
     """A serialized payload: a pickle5 stream plus out-of-band buffers."""
 
-    __slots__ = ("inband", "buffers", "contained_refs")
+    __slots__ = ("inband", "buffers", "contained_refs", "_wire_cache")
 
     def __init__(self, inband: bytes, buffers: List[pickle.PickleBuffer], contained_refs):
         self.inband = inband
         self.buffers = buffers
         self.contained_refs = contained_refs
+        self._wire_cache = None
 
     def __reduce__(self):
         # Wire format: drop contained_refs (metadata, carried separately in
@@ -49,11 +50,16 @@ class SerializedObject:
         return len(self.inband) + sum(b.raw().nbytes for b in self.buffers)
 
     def _wire_parts(self):
-        raw_buffers = [b.raw() for b in self.buffers]
-        header = pickle.dumps(
-            (len(self.inband), [m.nbytes for m in raw_buffers]), protocol=5
-        )
-        return header, raw_buffers
+        # Cached: wire_size() + write_into() on the shm put path would
+        # otherwise re-pickle the header and re-materialize buffer views.
+        # Safe because payload (inband/buffers) is immutable after creation.
+        if self._wire_cache is None:
+            raw_buffers = [b.raw() for b in self.buffers]
+            header = pickle.dumps(
+                (len(self.inband), [m.nbytes for m in raw_buffers]), protocol=5
+            )
+            self._wire_cache = (header, raw_buffers)
+        return self._wire_cache
 
     def wire_size(self) -> int:
         """Size of the flat wire format produced by to_bytes/write_into."""

@@ -138,11 +138,13 @@ class StoreClient:
         self._closed = False
 
     def disconnect(self):
-        """Close the control socket (the server auto-releases this client's
-        refs). The arena stays mapped and the native handle is intentionally
-        leaked: user code may still hold zero-copy views into the mapping,
-        and pin finalizers may still fire from the GC thread — both must
-        remain safe after disconnect."""
+        """Close the control socket. The server then releases every ref this
+        client held — so ONLY disconnect when no zero-copy views are alive
+        (process teardown, test fixtures): a released slot can be reused and
+        silently mutate a still-alive aliasing array. Long-lived runtimes
+        should leave the connection open (see PlasmaProvider.close) and let
+        process exit sever it. The arena stays mapped and the native handle
+        is intentionally leaked so late pin-finalizer calls stay safe."""
         if self._handle and not self._closed:
             self._closed = True
             self._lib.rtps_client_close_socket(self._handle)

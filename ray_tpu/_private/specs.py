@@ -112,6 +112,11 @@ class TaskSpec:
     args: List[TaskArg] = field(default_factory=list)
     num_returns: int = 1                # -1 => streaming generator
     resources: Resources = field(default_factory=dict)
+    # Resources used for the scheduling decision when they differ from the
+    # resources HELD while running (reference: TaskSpec required_resources vs
+    # required_placement_resources — a default-cpu actor schedules with 1 CPU
+    # but holds 0 for its lifetime).
+    placement_resources: Optional[Resources] = None
     owner_address: Optional[Address] = None
     max_retries: int = 0
     retry_exceptions: bool = False

@@ -110,11 +110,13 @@ class ActorClass:
         cw = get_core_worker()
         o = self._options
         strategy = to_spec(o.get("scheduling_strategy"), o)
+        held, placement = opts.actor_resources_from_options(o)
         actor_id = cw.create_actor(
             self._cls,
             args,
             kwargs,
-            resources=opts.resources_from_options(o, is_actor=True),
+            resources=held,
+            placement_resources=placement,
             max_restarts=o.get("max_restarts", 0),
             max_task_retries=o.get("max_task_retries", 0),
             max_concurrency=o.get("max_concurrency"),

@@ -27,7 +27,12 @@ class DAGNode:
 
     def _resolve(self, value, input_ctx):
         if isinstance(value, DAGNode):
-            return value._execute_with(input_ctx)
+            # Memoize per execution: a subgraph shared by several parents
+            # (diamond DAGs) is submitted exactly once.
+            memo = input_ctx.setdefault("_memo", {})
+            if id(value) not in memo:
+                memo[id(value)] = value._execute_with(input_ctx)
+            return memo[id(value)]
         return value
 
     def _execute_with(self, input_ctx):
@@ -73,6 +78,35 @@ class InputNode(DAGNode):
 
     def execute(self, *input_args, **input_kwargs):
         return input_args[0] if input_args else None
+
+    def __getattr__(self, name: str) -> "InputAttributeNode":
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return InputAttributeNode(self, name, "attr")
+
+    def __getitem__(self, key) -> "InputAttributeNode":
+        return InputAttributeNode(self, key, "item")
+
+
+class InputAttributeNode(DAGNode):
+    """`inp.x` / `inp[k]` — projects a field out of the execution input
+    (reference: dag/input_node.py InputAttributeNode), so one InputNode can
+    feed structured inputs to several branches."""
+
+    def __init__(self, parent: InputNode, key, kind: str):
+        super().__init__((parent,), {})
+        self._key = key
+        self._kind = kind
+
+    def _project(self, value):
+        return value[self._key] if self._kind == "item" else getattr(
+            value, self._key)
+
+    def _execute_with(self, input_ctx):
+        return self._project(input_ctx["input"])
+
+    def execute(self, *input_args, **input_kwargs):
+        return self._project(input_args[0])
 
 
 class MultiOutputNode(DAGNode):
@@ -193,3 +227,57 @@ class CompiledDAG:
                 node._cached_handle = None
 
         kill_actors(self._root)
+
+
+def lower_to_jit(dag: DAGNode, static_argnames=None):
+    """Fuse a PURE-FUNCTION DAG into one jitted XLA program.
+
+    The reference's compiled DAG moves tensors between GPU actors over
+    NCCL/shm channels (compiled_dag_node.py:374). On TPU, the channel between
+    stages that fit on one device is XLA fusion itself — so a DAG whose
+    nodes are jax-traceable, side-effect-free functions lowers to a SINGLE
+    compiled program: `lower_to_jit(dag)(x)` runs the entire graph on-device
+    with no per-stage dispatch, shared subgraphs computed once.
+
+    Actor-method nodes hold process state and cannot fuse; use
+    experimental_compile() (static actor pipeline) or
+    ray_tpu.parallel.pipeline (SPMD stages over the mesh) for those.
+    """
+    import jax
+
+    def check(node: DAGNode):
+        if isinstance(node, (ClassNode, ClassMethodNode)):
+            raise TypeError(
+                "lower_to_jit supports pure-function DAGs only; "
+                f"found {type(node).__name__}")
+        for c in node._children():
+            check(c)
+
+    check(dag)
+
+    def fused(x):
+        memo: Dict[int, Any] = {}
+
+        def run(node: DAGNode):
+            if id(node) in memo:
+                return memo[id(node)]
+            if isinstance(node, InputNode):
+                out = x
+            elif isinstance(node, InputAttributeNode):
+                out = node._project(x)
+            elif isinstance(node, MultiOutputNode):
+                out = [run(o) for o in node._bound_args]
+            elif isinstance(node, FunctionNode):
+                args = [run(a) if isinstance(a, DAGNode) else a
+                        for a in node._bound_args]
+                kwargs = {k: run(v) if isinstance(v, DAGNode) else v
+                          for k, v in node._bound_kwargs.items()}
+                out = node._remote_fn._function(*args, **kwargs)
+            else:
+                raise TypeError(f"cannot lower {type(node).__name__}")
+            memo[id(node)] = out
+            return out
+
+        return run(dag)
+
+    return jax.jit(fused, static_argnames=static_argnames)

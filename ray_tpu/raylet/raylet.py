@@ -72,6 +72,12 @@ class _QueuedLease:
     enqueue_time: float = field(default_factory=time.monotonic)
 
 
+def _placement_res(spec: TaskSpec) -> Resources:
+    return (spec.placement_resources
+            if getattr(spec, "placement_resources", None) is not None
+            else spec.resources)
+
+
 class Raylet:
     def __init__(
         self,
@@ -272,16 +278,29 @@ class Raylet:
             return False
 
         def _restore() -> bool:
+            from ray_tpu._private.shm_store import ShmStoreFull
+
             try:
                 with open(path, "rb") as f:
                     data = f.read()
             except OSError:
                 return False
-            try:
-                self._store_client.put(key, data, primary=True)
-            except Exception:  # noqa: BLE001 — EXISTS race is success
-                return self._store_client.contains(key)
-            return True
+            for attempt in (0, 1):
+                try:
+                    self._store_client.put(key, data, primary=True)
+                    return True
+                except ShmStoreFull:
+                    if attempt == 0:
+                        # Store under pressure: make room by spilling other
+                        # cold primaries, then retry — failing here would
+                        # surface as ObjectLost for data that's safe on disk.
+                        _, used, cap = self._store_client.stats()
+                        self._spill_until(max(0, cap - len(data)))
+                        continue
+                    return False
+                except Exception:  # noqa: BLE001 — EXISTS race is success
+                    return self._store_client.contains(key)
+            return False
 
         return await asyncio.to_thread(_restore)
 
@@ -372,21 +391,22 @@ class Raylet:
                         "retry_at": addr,
                         "retry_at_node_id": target,
                     }
-        if not resources_fit(self.total, spec.resources):
+        if not resources_fit(self.total, _placement_res(spec)):
             return {"rejected": True, "reason": "infeasible on this node"}
         return await self._queue_local(spec)
 
     def _cluster_decision(self, spec: TaskSpec) -> Optional[NodeID]:
         strat = spec.scheduling_strategy
         view = self._cluster_view
+        res = _placement_res(spec)
         if strat.kind == "NODE_AFFINITY":
             return policy.node_affinity_policy(
-                view, spec.resources, strat.node_id, strat.soft, self.node_id
+                view, res, strat.node_id, strat.soft, self.node_id
             )
         if strat.kind == "SPREAD":
             self._spread_rr += 1
-            return policy.spread_policy(view, spec.resources, self._spread_rr)
-        return policy.hybrid_policy(view, spec.resources, self.node_id)
+            return policy.spread_policy(view, res, self._spread_rr)
+        return policy.hybrid_policy(view, res, self.node_id)
 
     def _raylet_addr_for(self, node_id: NodeID) -> Optional[str]:
         entry = self._cluster_addrs.get(node_id) if hasattr(self, "_cluster_addrs") else None
@@ -422,7 +442,12 @@ class Raylet:
                     asyncio.ensure_future(self._grant(q, alloc))
 
     def _try_allocate(self, spec: TaskSpec) -> Optional[Tuple[Resources, Optional[PlacementGroupID], int]]:
+        # The placement decision checks placement_resources; the allocation
+        # holds only spec.resources (what the task/actor retains while
+        # running — for default-cpu actors that's no CPU, reference
+        # semantics: required_resources vs required_placement_resources).
         strat = spec.scheduling_strategy
+        place = _placement_res(spec)
         if strat.kind == "PLACEMENT_GROUP":
             bundles = self._bundles.get(strat.placement_group_id)
             if bundles is None:
@@ -434,11 +459,11 @@ class Raylet:
             )
             for i in indices:
                 b = bundles.get(i)
-                if b is not None and b.committed and resources_fit(b.available, spec.resources):
+                if b is not None and b.committed and resources_fit(b.available, place):
                     subtract_resources(b.available, spec.resources)
                     return (dict(spec.resources), strat.placement_group_id, i)
             return None
-        if resources_fit(self.available, spec.resources):
+        if resources_fit(self.available, place):
             subtract_resources(self.available, spec.resources)
             return (dict(spec.resources), None, -1)
         return None

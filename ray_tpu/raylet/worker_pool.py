@@ -75,6 +75,17 @@ class WorkerPool:
     def num_alive(self) -> int:
         return sum(1 for w in self._workers.values() if w.state != "dead")
 
+    @property
+    def num_poolable(self) -> int:
+        """Workers that can (eventually) serve future leases. Workers
+        dedicated to a live actor leave the pool accounting — like the
+        reference's soft limit, which bounds spare/idle workers, not
+        actor-dedicated processes (worker_pool.h:155 num_workers_soft_limit);
+        otherwise a node could host at most max_workers actors."""
+        return sum(1 for w in self._workers.values()
+                   if w.state in ("starting", "idle", "leased")
+                   and not w.is_driver)
+
     def _spawn(self, needs_accelerator: bool = False):
         if self._closed:
             return
@@ -161,7 +172,7 @@ class WorkerPool:
                         w.state = "leased"
                         return w
                 if (
-                    self.num_alive < self._max_workers
+                    self.num_poolable < self._max_workers
                     and self._num_starting(needs_accelerator) < self._pop_waiters
                 ):
                     self._spawn(needs_accelerator)

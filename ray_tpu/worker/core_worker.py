@@ -931,6 +931,7 @@ class CoreWorker:
         kwargs: dict,
         *,
         resources=None,
+        placement_resources=None,
         max_restarts: int = 0,
         max_task_retries: int = 0,
         max_concurrency: Optional[int] = None,
@@ -965,7 +966,9 @@ class CoreWorker:
             function_name=getattr(cls, "__name__", "Actor") + ".__init__",
             args=arg_specs,
             num_returns=0,
-            resources=resources or {"CPU": CONFIG.default_actor_num_cpus},
+            resources=resources if resources is not None
+            else {"CPU": CONFIG.default_actor_num_cpus},
+            placement_resources=placement_resources,
             owner_address=self.address,
             scheduling_strategy=scheduling_strategy or SchedulingStrategySpec(),
             actor_creation=creation,

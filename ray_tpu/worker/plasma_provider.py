@@ -96,6 +96,8 @@ class PlasmaProvider:
                 pass
 
     def close(self) -> None:
-        """Close the control socket. The arena mapping stays alive so any
-        user-held zero-copy values remain valid."""
-        self._client.disconnect()
+        """Deliberately leave the store connection OPEN: disconnecting would
+        drop this process's pinned refs while user code may still hold
+        zero-copy arrays aliasing those slots (the server would then reuse
+        them — silent corruption). Process exit severs the socket, at which
+        point no Python value can alias the arena anymore."""

@@ -112,3 +112,50 @@ def test_workflow_list_delete(ray_start_regular, wf_storage):
     assert ("wlist", "SUCCESSFUL") in workflow.list_all()
     workflow.delete("wlist")
     assert all(w != "wlist" for w, _ in workflow.list_all())
+
+
+def test_dag_input_attribute_node(ray_start_regular):
+    import ray_tpu
+    from ray_tpu.dag import InputNode
+
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    with InputNode() as inp:
+        dag = add.bind(inp["x"], inp["y"])
+    assert ray_tpu.get(dag.execute({"x": 2, "y": 40})) == 42
+
+
+def test_dag_lower_to_jit(ray_start_regular):
+    import jax.numpy as jnp
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.dag import InputNode, MultiOutputNode, lower_to_jit
+
+    @ray_tpu.remote
+    def scale(x):
+        return x * 2.0
+
+    @ray_tpu.remote
+    def shift(x):
+        return x + 1.0
+
+    @ray_tpu.remote
+    def combine(a, b):
+        return a @ b.T
+
+    with InputNode() as inp:
+        s = scale.bind(inp)
+        dag = MultiOutputNode([combine.bind(s, shift.bind(s)), s])
+
+    fn = lower_to_jit(dag)
+    x = jnp.ones((4, 4))
+    out, s_val = fn(x)
+    np.testing.assert_allclose(np.asarray(out), np.full((4, 4), 24.0))
+    np.testing.assert_allclose(np.asarray(s_val), np.full((4, 4), 2.0))
+    # And the same DAG still executes distributed (shared subgraph `s` is
+    # submitted once per execute).
+    refs = dag.execute(np.ones((4, 4)))
+    np.testing.assert_allclose(ray_tpu.get(refs[1]), np.full((4, 4), 2.0))
