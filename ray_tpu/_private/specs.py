@@ -146,7 +146,14 @@ class TaskSpec:
         return self.num_returns == -1
 
     def scheduling_key(self) -> tuple:
-        """Tasks with equal keys can reuse each other's worker leases."""
+        """Tasks with equal keys can reuse each other's worker leases.
+        Includes the runtime-env hash: workers are DEDICATED per environment
+        (reference: runtime-env workers are never shared across envs)."""
+        env_key = ""
+        if self.runtime_env:
+            from ray_tpu.runtime_env import env_hash
+
+            env_key = env_hash(self.runtime_env)
         return (
             self.function_id,
             tuple(sorted(self.resources.items())),
@@ -154,6 +161,7 @@ class TaskSpec:
             self.scheduling_strategy.node_id,
             self.scheduling_strategy.placement_group_id,
             self.scheduling_strategy.bundle_index,
+            env_key,
         )
 
 

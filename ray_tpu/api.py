@@ -136,6 +136,15 @@ def init(
             raylet_address=raylet_address,
             namespace=namespace or "",
         )
+        if runtime_env:
+            from ray_tpu import runtime_env as re_mod
+
+            cw.job_runtime_env = re_mod.validate(runtime_env)
+            # env_vars of the job-level env apply to the driver itself too
+            # (reference: job runtime env is the driver's env).
+            for k, v in (cw.job_runtime_env or {}).get(
+                    "env_vars", {}).items():
+                os.environ[k] = v
         cw._gcs.call(
             "add_job",
             {"info": JobInfo(job_id=cw.job_id, driver_address=cw.address_str,

@@ -1,0 +1,26 @@
+"""Autoscaler: demand-driven cluster scaling.
+
+Reference: ray python/ray/autoscaler — StandardAutoscaler.update loop
+(_private/autoscaler.py:172,374) reading GCS load (load_metrics.py),
+bin-packing demand (resource_demand_scheduler.py), launching/terminating via
+a pluggable NodeProvider (node_provider.py:13); v2 reconciler design
+(v2/instance_manager/reconciler.py:53) driven by GCS autoscaler state.
+
+This implementation follows the v2 shape: a single reconciler step
+(StandardAutoscaler.update) diffs observed cluster state (GCS
+get_cluster_load) against the config's node-type bounds, launches via the
+provider, and terminates idle nodes. TPU twist: a node type with a `TPU`
+resource is a SLICE (gang) — scale-up adds whole slices, and scale-down only
+removes a slice when it is fully idle (no per-chip elasticity inside a mesh,
+SURVEY §7 hard parts).
+"""
+
+from ray_tpu.autoscaler.autoscaler import StandardAutoscaler  # noqa: F401
+from ray_tpu.autoscaler.monitor import Monitor  # noqa: F401
+from ray_tpu.autoscaler.node_provider import (  # noqa: F401
+    LocalNodeProvider,
+    NodeProvider,
+)
+from ray_tpu.autoscaler.resource_demand_scheduler import (  # noqa: F401
+    get_nodes_to_launch,
+)

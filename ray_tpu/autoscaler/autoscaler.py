@@ -1,0 +1,125 @@
+"""StandardAutoscaler: one reconciler step per update().
+
+Reference: ray python/ray/autoscaler/_private/autoscaler.py
+(StandardAutoscaler.update :172/:374): read load -> enforce min/max ->
+launch for unfulfilled demand -> terminate idle nodes. The v2 redesign
+(v2/instance_manager/reconciler.py:53) folds this into a single
+state-diffing step, which is the shape used here.
+
+TPU gang semantics: a node type whose resources include "TPU" is a slice;
+idle-termination requires the WHOLE node idle (available == total), never
+partial — and pending PG bundles (gang demand) count as demand so a
+STRICT_SPREAD gang triggers multi-node scale-up at once.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, Optional
+
+from ray_tpu.autoscaler.node_provider import (
+    STATUS_UP,
+    TAG_NODE_STATUS,
+    TAG_NODE_TYPE,
+    NodeProvider,
+)
+from ray_tpu.autoscaler.resource_demand_scheduler import get_nodes_to_launch
+
+logger = logging.getLogger(__name__)
+
+
+class StandardAutoscaler:
+    def __init__(self, config: dict, provider: NodeProvider, gcs_client,
+                 idle_timeout_s: Optional[float] = None):
+        """config: {"max_workers": int, "idle_timeout_s": float,
+        "node_types": {name: {"resources": {...}, "min_workers": int,
+        "max_workers": int}}}"""
+        self.config = config
+        self.provider = provider
+        self.gcs = gcs_client
+        self.idle_timeout_s = (
+            idle_timeout_s if idle_timeout_s is not None
+            else config.get("idle_timeout_s", 60.0))
+        self._idle_since: Dict[str, float] = {}  # provider node id -> ts
+
+    # -- helpers -------------------------------------------------------------
+
+    def _counts_by_type(self) -> Dict[str, int]:
+        counts: Dict[str, int] = {}
+        for nid in self.provider.non_terminated_nodes():
+            t = self.provider.node_tags(nid).get(TAG_NODE_TYPE, "")
+            counts[t] = counts.get(t, 0) + 1
+        return counts
+
+    def _launch(self, node_type: str, count: int):
+        cfg = self.config["node_types"][node_type]
+        logger.info("autoscaler launching %d x %s", count, node_type)
+        self.provider.create_node(
+            {"resources": cfg.get("resources") or {}},
+            {TAG_NODE_TYPE: node_type, TAG_NODE_STATUS: STATUS_UP},
+            count,
+        )
+
+    # -- the reconciler step -------------------------------------------------
+
+    def update(self) -> None:
+        load = self.gcs.call("get_cluster_load", {})
+        nodes = load["nodes"]
+        demands = [(dict(s), c) for s, c in load.get("demands", [])]
+        for bundle in load.get("pending_pg_bundles", []):
+            demands.append((dict(bundle), 1))
+
+        counts = self._counts_by_type()
+
+        # 1. min_workers floor per type.
+        for name, cfg in self.config.get("node_types", {}).items():
+            deficit = cfg.get("min_workers", 0) - counts.get(name, 0)
+            if deficit > 0:
+                self._launch(name, deficit)
+                counts[name] = counts.get(name, 0) + deficit
+
+        # 2. demand-driven scale-up (bin-packing over free capacity).
+        if demands:
+            avail = [dict(n["available"]) for n in nodes.values() if n["alive"]]
+            to_launch = get_nodes_to_launch(
+                self.config.get("node_types", {}), avail, demands, counts)
+            total_cap = self.config.get("max_workers", 2**31)
+            total_now = sum(counts.values())
+            for name, count in to_launch.items():
+                count = min(count, max(0, total_cap - total_now))
+                if count > 0:
+                    self._launch(name, count)
+                    counts[name] = counts.get(name, 0) + count
+                    total_now += count
+
+        # 3. idle-node termination (whole-node idle only; respects
+        #    min_workers; never touches the head node — provider nodes only).
+        now = time.monotonic()
+        alive_ids = self.provider.non_terminated_nodes()
+        by_gcs_id = {}
+        raylet_id = getattr(self.provider, "raylet_node_id", None)
+        for pid in alive_ids:
+            gid = raylet_id(pid) if raylet_id else None
+            if gid is not None:
+                by_gcs_id[pid] = gid
+        for pid in alive_ids:
+            gid = by_gcs_id.get(pid)
+            info = nodes.get(gid) if gid else None
+            if info is None or not info["alive"]:
+                continue
+            idle = (info["available"] == info["total"]) and not demands
+            if not idle:
+                self._idle_since.pop(pid, None)
+                continue
+            start = self._idle_since.setdefault(pid, now)
+            if now - start < self.idle_timeout_s:
+                continue
+            t = self.provider.node_tags(pid).get(TAG_NODE_TYPE, "")
+            cfg = self.config.get("node_types", {}).get(t, {})
+            if counts.get(t, 0) <= cfg.get("min_workers", 0):
+                continue
+            logger.info("autoscaler terminating idle node %s (%s)", pid, t)
+            self.provider.terminate_node(pid)
+            counts[t] = counts.get(t, 0) - 1
+            self._idle_since.pop(pid, None)

@@ -1,0 +1,48 @@
+"""Monitor: the autoscaler's driver loop.
+
+Reference: ray python/ray/autoscaler/_private/monitor.py:126 — a process on
+the head node that periodically runs StandardAutoscaler.update. Here it is a
+daemon thread owned by AutoscalingCluster / `ray-tpu start --head`.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+from ray_tpu._private.rpc import EventLoopThread, RpcClient
+from ray_tpu.autoscaler.autoscaler import StandardAutoscaler
+from ray_tpu.autoscaler.node_provider import NodeProvider
+
+logger = logging.getLogger(__name__)
+
+
+class Monitor:
+    def __init__(self, gcs_address: str, provider: NodeProvider, config: dict,
+                 update_interval_s: float = 1.0):
+        self._lt = EventLoopThread("autoscaler-monitor")
+        self._gcs = RpcClient(gcs_address, self._lt)
+        self.autoscaler = StandardAutoscaler(config, provider, self._gcs)
+        self._interval = update_interval_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._run, name="autoscaler", daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.wait(self._interval):
+            try:
+                self.autoscaler.update()
+            except Exception:  # noqa: BLE001 — keep reconciling
+                logger.exception("autoscaler update failed")
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self._gcs.close()
+        self._lt.stop()

@@ -1,0 +1,79 @@
+"""Bin-packing of unfulfilled resource demand onto node types.
+
+Reference: ray python/ray/autoscaler/_private/resource_demand_scheduler.py —
+given pending demand shapes and the config's node types, compute how many of
+each type to launch. Strategy here mirrors the reference: first fit demands
+onto the simulated free capacity of existing+planned nodes, then pick the
+"best" (fewest-resources-that-fit) type for what remains, respecting
+max_workers caps.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+Resources = Dict[str, float]
+
+
+def _fits(avail: Resources, demand: Resources) -> bool:
+    return all(avail.get(k, 0.0) >= v for k, v in demand.items() if v > 0)
+
+
+def _subtract(avail: Resources, demand: Resources) -> None:
+    for k, v in demand.items():
+        avail[k] = avail.get(k, 0.0) - v
+
+
+def get_nodes_to_launch(
+    node_types: Dict[str, dict],
+    existing_available: List[Resources],
+    demands: List[Tuple[Resources, int]],
+    counts_by_type: Dict[str, int],
+) -> Dict[str, int]:
+    """-> {node_type: count to launch}.
+
+    node_types: {name: {"resources": {...}, "max_workers": int}}
+    existing_available: free resources of live nodes (simulated mutable)
+    demands: [(shape, count)] pending demand aggregated by shape
+    counts_by_type: current node count per type (for max_workers caps)
+    """
+    sim = [dict(a) for a in existing_available]
+    planned: Dict[str, int] = {}
+
+    flat: List[Resources] = []
+    for shape, count in demands:
+        flat.extend([shape] * min(count, 1000))
+    # Pack big demands first — reduces fragmentation, like the reference's
+    # sorted bin-packing.
+    flat.sort(key=lambda d: -sum(d.values()))
+
+    for demand in flat:
+        placed = False
+        for avail in sim:
+            if _fits(avail, demand):
+                _subtract(avail, demand)
+                placed = True
+                break
+        if placed:
+            continue
+        # Choose the feasible type with the least total resources (cheapest
+        # that fits), respecting max_workers.
+        best: Optional[str] = None
+        best_size = float("inf")
+        for name, cfg in node_types.items():
+            res = cfg.get("resources") or {}
+            cap = cfg.get("max_workers", 0)
+            current = counts_by_type.get(name, 0) + planned.get(name, 0)
+            if current >= cap:
+                continue
+            if _fits(dict(res), demand):
+                size = sum(res.values())
+                if size < best_size:
+                    best, best_size = name, size
+        if best is None:
+            continue  # infeasible demand: nothing in the config can host it
+        planned[best] = planned.get(best, 0) + 1
+        avail = dict(node_types[best].get("resources") or {})
+        _subtract(avail, demand)
+        sim.append(avail)
+    return planned

@@ -97,3 +97,45 @@ class Cluster:
             raylet.stop(unregister=False)
         self.raylets.clear()
         self.gcs.stop()
+
+
+class AutoscalingCluster(Cluster):
+    """Cluster with a live autoscaler over the in-process fake node provider
+    (reference: cluster_utils.py:26 AutoscalingCluster +
+    fake_multi_node/node_provider.py): worker nodes appear/disappear in
+    response to demand, exercising the full scale-up/down loop without a
+    cloud."""
+
+    def __init__(self, head_resources: Optional[dict] = None,
+                 worker_node_types: Optional[dict] = None,
+                 idle_timeout_s: float = 3.0,
+                 max_workers: int = 8,
+                 update_interval_s: float = 0.5,
+                 **kwargs):
+        super().__init__(
+            initialize_head=True,
+            head_node_args={"resources": head_resources or {"CPU": 2}},
+            **kwargs,
+        )
+        from ray_tpu.autoscaler.monitor import Monitor
+        from ray_tpu.autoscaler.node_provider import LocalNodeProvider
+
+        self.provider = LocalNodeProvider(self.gcs_address)
+        config = {
+            "max_workers": max_workers,
+            "idle_timeout_s": idle_timeout_s,
+            "node_types": worker_node_types or {
+                "worker": {"resources": {"CPU": 2},
+                           "min_workers": 0, "max_workers": max_workers},
+            },
+        }
+        self.monitor = Monitor(self.gcs_address, self.provider, config,
+                               update_interval_s=update_interval_s)
+
+    def start(self):
+        self.monitor.start()
+
+    def shutdown(self):
+        self.monitor.stop()
+        self.provider.shutdown()
+        super().shutdown()

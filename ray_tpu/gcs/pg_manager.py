@@ -40,6 +40,20 @@ class GcsPlacementGroupManager:
         self._ready_events: Dict[PlacementGroupID, asyncio.Event] = {}
         self._named: Dict[str, PlacementGroupID] = {}
 
+    def pending_bundle_shapes(self):
+        """Bundle resource shapes of PGs not yet fully placed — gang demand
+        for the autoscaler (reference: pending PGs in the autoscaler state
+        from gcs_autoscaler_state_manager.cc)."""
+        out = []
+        for info in self._groups.values():
+            if info.state in (PlacementGroupState.PENDING,
+                              PlacementGroupState.RESCHEDULING):
+                placed = set(info.bundle_locations)
+                for i, b in enumerate(info.spec.bundles):
+                    if i not in placed:
+                        out.append(dict(b))
+        return out
+
     # ---- RPC handlers -------------------------------------------------------
 
     async def handle_create_placement_group(self, payload):

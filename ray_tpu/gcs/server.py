@@ -49,6 +49,7 @@ class GcsNodeManager:
         self._pub = publisher
         self._nodes: Dict[NodeID, NodeInfo] = {}
         self._last_heartbeat: Dict[NodeID, float] = {}
+        self._pending_demands: Dict[NodeID, list] = {}
         self._death_listeners = []
         self.pg_locator = None  # wired to GcsPlacementGroupManager by GcsServer
 
@@ -77,6 +78,7 @@ class GcsNodeManager:
         info.resources_available = payload["available"]
         info.resources_total = payload.get("total", info.resources_total)
         self._last_heartbeat[node_id] = time.monotonic()
+        self._pending_demands[node_id] = payload.get("pending_demands", [])
         return {
             "status": "ok",
             "cluster_view": {
@@ -88,6 +90,36 @@ class GcsNodeManager:
 
     async def handle_get_all_node_info(self, payload):
         return list(self._nodes.values())
+
+    async def handle_get_cluster_load(self, payload):
+        """Autoscaler snapshot: per-node usage + aggregated unfulfilled
+        demand shapes (reference: GCS load feeding load_metrics.py and the
+        autoscaler state API gcs_autoscaler_state_manager.cc)."""
+        demands: Dict[tuple, int] = {}
+        for nid, shapes in self._pending_demands.items():
+            info = self._nodes.get(nid)
+            if info is None or not info.alive:
+                continue
+            for shape, count in shapes:
+                key = tuple(sorted(shape.items()))
+                demands[key] = demands.get(key, 0) + count
+        pending_pgs = []
+        if self.pg_locator is not None:
+            pending_pgs = self.pg_locator.pending_bundle_shapes()
+        return {
+            "nodes": {
+                nid.hex(): {
+                    "total": dict(n.resources_total),
+                    "available": dict(n.resources_available),
+                    "alive": n.alive,
+                    "is_head": n.is_head,
+                    "labels": dict(n.labels),
+                }
+                for nid, n in self._nodes.items()
+            },
+            "demands": [(dict(k), v) for k, v in demands.items()],
+            "pending_pg_bundles": pending_pgs,
+        }
 
     async def handle_check_alive(self, payload):
         node_ids = payload.get("node_ids") or list(self._nodes)
@@ -157,6 +189,8 @@ class GcsNodeManager:
             return
         info.alive = False
         info.resources_available = {}
+        self._pending_demands.pop(node_id, None)
+        self._last_heartbeat.pop(node_id, None)
         self._pub.publish(ps.NODE_CHANNEL, node_id, info)
         for cb in self._death_listeners:
             try:

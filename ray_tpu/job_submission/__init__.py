@@ -1,0 +1,242 @@
+"""Job submission: run driver scripts on the cluster and track them.
+
+Reference: ray dashboard/modules/job — `JobSubmissionClient`
+(dashboard/modules/job/sdk.py:39: submit_job/stop_job/get_job_status/
+get_job_info/list_jobs/get_job_logs/tail_job_logs), `JobManager`
+(job_manager.py:56) running each driver as a subprocess under a
+`JobSupervisor` actor (job_supervisor.py:49) with log capture.
+
+Design here: one detached named JobManager actor per cluster (created
+lazily, get_if_exists) hosts the supervisors; each submitted job is a
+subprocess of that actor's worker with RT_ADDRESS injected so the
+entrypoint's ray_tpu.init() joins the cluster. Logs stream to a per-job
+file served back through the actor.
+"""
+
+from __future__ import annotations
+
+import enum
+import os
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterator, List, Optional
+
+JOB_MANAGER_NAME = "_rt_job_manager"
+JOB_MANAGER_NAMESPACE = "_rt_internal"
+_JOB_ID_ENV = "RT_JOB_SUBMISSION_ID"
+
+
+class JobStatus(str, enum.Enum):
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    STOPPED = "STOPPED"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+
+    def is_terminal(self) -> bool:
+        return self in (JobStatus.STOPPED, JobStatus.SUCCEEDED,
+                        JobStatus.FAILED)
+
+
+@dataclass
+class JobDetails:
+    submission_id: str
+    entrypoint: str
+    status: JobStatus = JobStatus.PENDING
+    message: str = ""
+    metadata: Dict[str, str] = field(default_factory=dict)
+    runtime_env: Dict[str, Any] = field(default_factory=dict)
+    start_time: Optional[float] = None
+    end_time: Optional[float] = None
+    driver_exit_code: Optional[int] = None
+
+
+class _JobManager:
+    """Actor body. Runs driver subprocesses and tracks their lifecycle."""
+
+    def __init__(self, log_dir: str):
+        import subprocess  # noqa: F401  (bound at call time)
+
+        self._log_dir = log_dir
+        os.makedirs(log_dir, exist_ok=True)
+        self._jobs: Dict[str, JobDetails] = {}
+        self._procs: Dict[str, Any] = {}
+
+    def submit(self, entrypoint: str, submission_id: str,
+               runtime_env: Optional[dict], metadata: Optional[dict]) -> str:
+        import subprocess
+
+        if submission_id in self._jobs:
+            raise ValueError(f"job {submission_id} already exists")
+        details = JobDetails(
+            submission_id=submission_id,
+            entrypoint=entrypoint,
+            runtime_env=runtime_env or {},
+            metadata=metadata or {},
+        )
+        env = dict(os.environ)
+        import ray_tpu
+
+        cw = ray_tpu._raylet.get_core_worker()
+        env["RT_ADDRESS"] = cw.gcs_address
+        env[_JOB_ID_ENV] = submission_id
+        renv = runtime_env or {}
+        env.update({str(k): str(v)
+                    for k, v in (renv.get("env_vars") or {}).items()})
+        cwd = None
+        if renv.get("working_dir"):
+            cwd = renv["working_dir"]
+        logpath = self._log_path(submission_id)
+        logfile = open(logpath, "ab")
+        try:
+            proc = subprocess.Popen(
+                entrypoint, shell=True, stdout=logfile,
+                stderr=subprocess.STDOUT, env=env, cwd=cwd,
+                start_new_session=True,
+            )
+        except OSError as e:
+            details.status = JobStatus.FAILED
+            details.message = str(e)
+            self._jobs[submission_id] = details
+            return submission_id
+        details.status = JobStatus.RUNNING
+        details.start_time = time.time()
+        details.message = "Job is currently running."
+        self._jobs[submission_id] = details
+        self._procs[submission_id] = proc
+        return submission_id
+
+    def _log_path(self, submission_id: str) -> str:
+        return os.path.join(self._log_dir, f"job-{submission_id}.log")
+
+    def _refresh(self, submission_id: str) -> None:
+        details = self._jobs.get(submission_id)
+        proc = self._procs.get(submission_id)
+        if details is None or proc is None or details.status.is_terminal():
+            return
+        code = proc.poll()
+        if code is None:
+            return
+        details.end_time = time.time()
+        details.driver_exit_code = code
+        if code == 0:
+            details.status = JobStatus.SUCCEEDED
+            details.message = "Job finished successfully."
+        elif details.status != JobStatus.STOPPED:
+            details.status = JobStatus.FAILED
+            details.message = f"Driver exited with code {code}."
+        self._procs.pop(submission_id, None)
+
+    def status(self, submission_id: str) -> Optional[JobDetails]:
+        self._refresh(submission_id)
+        return self._jobs.get(submission_id)
+
+    def list(self) -> List[JobDetails]:
+        for sid in list(self._jobs):
+            self._refresh(sid)
+        return list(self._jobs.values())
+
+    def stop(self, submission_id: str) -> bool:
+        self._refresh(submission_id)
+        details = self._jobs.get(submission_id)
+        proc = self._procs.get(submission_id)
+        if details is None or details.status.is_terminal() or proc is None:
+            return False
+        details.status = JobStatus.STOPPED
+        details.message = "Job was intentionally stopped."
+        details.end_time = time.time()
+        try:
+            import signal
+
+            os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            pass
+        return True
+
+    def logs(self, submission_id: str) -> str:
+        try:
+            with open(self._log_path(submission_id), "rb") as f:
+                return f.read().decode(errors="replace")
+        except OSError:
+            return ""
+
+
+def _manager_handle():
+    import ray_tpu
+    from ray_tpu._private.config import CONFIG
+
+    cls = ray_tpu.remote(_JobManager)
+    return cls.options(
+        name=JOB_MANAGER_NAME,
+        namespace=JOB_MANAGER_NAMESPACE,
+        lifetime="detached",
+        get_if_exists=True,
+    ).remote(os.path.join(CONFIG.log_dir, "jobs"))
+
+
+class JobSubmissionClient:
+    """SDK + CLI face (reference: dashboard/modules/job/sdk.py:39). The
+    `address` is the cluster GCS address (or None to use the current/ambient
+    connection)."""
+
+    def __init__(self, address: Optional[str] = None):
+        import ray_tpu
+
+        if not ray_tpu.is_initialized():
+            ray_tpu.init(address=address or os.environ.get("RT_ADDRESS"))
+        self._mgr = _manager_handle()
+
+    def submit_job(self, *, entrypoint: str,
+                   submission_id: Optional[str] = None,
+                   runtime_env: Optional[dict] = None,
+                   metadata: Optional[dict] = None) -> str:
+        import ray_tpu
+
+        sid = submission_id or f"raysubmit_{uuid.uuid4().hex[:12]}"
+        return ray_tpu.get(self._mgr.submit.remote(
+            entrypoint, sid, runtime_env, metadata))
+
+    def get_job_status(self, submission_id: str) -> JobStatus:
+        details = self.get_job_info(submission_id)
+        return details.status
+
+    def get_job_info(self, submission_id: str) -> JobDetails:
+        import ray_tpu
+
+        details = ray_tpu.get(self._mgr.status.remote(submission_id))
+        if details is None:
+            raise RuntimeError(f"Job {submission_id} does not exist.")
+        return details
+
+    def list_jobs(self) -> List[JobDetails]:
+        import ray_tpu
+
+        return ray_tpu.get(self._mgr.list.remote())
+
+    def stop_job(self, submission_id: str) -> bool:
+        import ray_tpu
+
+        return ray_tpu.get(self._mgr.stop.remote(submission_id))
+
+    def get_job_logs(self, submission_id: str) -> str:
+        import ray_tpu
+
+        return ray_tpu.get(self._mgr.logs.remote(submission_id))
+
+    def tail_job_logs(self, submission_id: str,
+                      poll_interval_s: float = 0.5) -> Iterator[str]:
+        """Yield log increments until the job reaches a terminal state."""
+        offset = 0
+        while True:
+            text = self.get_job_logs(submission_id)
+            if len(text) > offset:
+                yield text[offset:]
+                offset = len(text)
+            status = self.get_job_status(submission_id)
+            if status.is_terminal():
+                text = self.get_job_logs(submission_id)
+                if len(text) > offset:
+                    yield text[offset:]
+                return
+            time.sleep(poll_interval_s)

@@ -128,6 +128,9 @@ class Raylet:
         # Serializes _spill_until across the watermark loop and per-worker
         # spill_objects RPCs (both run via asyncio.to_thread).
         self._spill_lock = threading.Lock()
+        # Recently-rejected infeasible demand shapes -> last-seen time;
+        # reported to the GCS while fresh so the autoscaler sees them.
+        self._infeasible: Dict[tuple, float] = {}
 
     # ------------------------------------------------------------------ start
     def start(self, port: int = 0, max_workers: Optional[int] = None) -> str:
@@ -392,6 +395,12 @@ class Raylet:
                         "retry_at_node_id": target,
                     }
         if not resources_fit(self.total, _placement_res(spec)):
+            # Remember the shape: rejected demand must still be visible to
+            # the autoscaler (reference: the infeasible-task queue in
+            # cluster_task_manager is reported as load), otherwise a task no
+            # node can host never triggers scale-up.
+            shape = tuple(sorted(_placement_res(spec).items()))
+            self._infeasible[shape] = time.monotonic()
             return {"rejected": True, "reason": "infeasible on this node"}
         return await self._queue_local(spec)
 
@@ -471,8 +480,14 @@ class Raylet:
     async def _grant(self, q: _QueuedLease, alloc):
         resources, pg_id, bundle_index = alloc
         needs_accel = q.spec.resources.get("TPU", 0) > 0
+        env_key = ""
+        if q.spec.runtime_env:
+            from ray_tpu.runtime_env import env_hash as _env_hash
+
+            env_key = _env_hash(q.spec.runtime_env)
         worker = await self.worker_pool.pop_worker(
-            CONFIG.worker_register_timeout_s, needs_accelerator=needs_accel
+            CONFIG.worker_register_timeout_s, needs_accelerator=needs_accel,
+            env_hash=env_key,
         )
         if worker is None or q.future.done():
             self._release_alloc(resources, pg_id, bundle_index)
@@ -589,6 +604,21 @@ class Raylet:
         period = CONFIG.heartbeat_period_ms / 1000.0
         while True:
             try:
+                # Aggregate queued lease shapes so the autoscaler can
+                # bin-pack unfulfilled demand (reference: load reported to
+                # GCS drives resource_demand_scheduler.py).
+                demand_counts: Dict[tuple, int] = {}
+                for q in self._queue[:200]:
+                    shape = tuple(sorted(_placement_res(q.spec).items()))
+                    demand_counts[shape] = demand_counts.get(shape, 0) + 1
+                # Infeasible shapes seen in the last 5s count as demand
+                # (the submitter is still retrying them against us).
+                now = time.monotonic()
+                for shape, ts in list(self._infeasible.items()):
+                    if now - ts > 5.0:
+                        del self._infeasible[shape]
+                    else:
+                        demand_counts[shape] = demand_counts.get(shape, 0) + 1
                 reply = await self._gcs.call_async(
                     "report_resources",
                     {
@@ -596,6 +626,9 @@ class Raylet:
                         "available": dict(self.available),
                         "total": dict(self.total),
                         "load": len(self._queue),
+                        "pending_demands": [
+                            (dict(shape), n) for shape, n in demand_counts.items()
+                        ],
                     },
                     timeout=5.0,
                 )

@@ -36,6 +36,11 @@ class WorkerHandle:
     lease_task_id = None
     is_driver: bool = False
     needs_accelerator: bool = False
+    # Runtime-env hash applied in this worker ("" = pristine). A worker that
+    # ran under an env can ONLY serve that env again — the reference
+    # dedicates workers per runtime env; returning one to the general pool
+    # would leak env vars/cwd/sys.path into unrelated tasks.
+    env_hash: str = ""
 
 
 class WorkerPool:
@@ -160,17 +165,34 @@ class WorkerPool:
         )
 
     async def pop_worker(
-        self, timeout: float, needs_accelerator: bool = False
+        self, timeout: float, needs_accelerator: bool = False,
+        env_hash: str = "",
     ) -> Optional[WorkerHandle]:
-        """Get an idle worker, spawning if below the cap. None on timeout."""
+        """Get an idle worker, spawning if below the cap. None on timeout.
+
+        env-matched idle workers are preferred; a pristine worker may be
+        claimed for any env (it becomes dedicated to it); an idle worker
+        carrying a DIFFERENT env is never handed out."""
         deadline = time.monotonic() + timeout
         self._pop_waiters = getattr(self, "_pop_waiters", 0) + 1
         try:
             while not self._closed:
+                pristine = None
+                claimed = None
                 for w in self._workers.values():
-                    if w.state == "idle" and w.needs_accelerator == needs_accelerator:
-                        w.state = "leased"
-                        return w
+                    if w.state != "idle" or w.needs_accelerator != needs_accelerator:
+                        continue
+                    if w.env_hash == env_hash:
+                        claimed = w
+                        break
+                    if w.env_hash == "" and pristine is None:
+                        pristine = w
+                if claimed is None and pristine is not None:
+                    claimed = pristine
+                    claimed.env_hash = env_hash
+                if claimed is not None:
+                    claimed.state = "leased"
+                    return claimed
                 if (
                     self.num_poolable < self._max_workers
                     and self._num_starting(needs_accelerator) < self._pop_waiters

@@ -25,6 +25,7 @@ class RemoteFunction:
         # cluster's GCS needs its own export.
         self._function_id: Optional[str] = None
         self._exported_session: Optional[bytes] = None
+        self._prepared_env: Optional[dict] = None
         functools.update_wrapper(self, fn)
 
     def __call__(self, *args, **kwargs):
@@ -35,10 +36,11 @@ class RemoteFunction:
 
     def options(self, **overrides) -> "RemoteFunction":
         merged = opts.merge_options(self._options, overrides)
-        rf = RemoteFunction(self._function, merged)
-        rf._function_id = self._function_id
-        rf._exported_session = self._exported_session
-        return rf
+        # No session-state copy: overrides may change runtime_env, so the
+        # derived function must re-run the prepare-once branch on first
+        # .remote() (function registration is content-hashed and cached, so
+        # re-export is cheap).
+        return RemoteFunction(self._function, merged)
 
     def remote(self, *args, **kwargs):
         cw = get_core_worker()
@@ -46,6 +48,11 @@ class RemoteFunction:
         if self._function_id is None or self._exported_session != session:
             self._function_id = cw.register_function(self._function)
             self._exported_session = session
+            # Prepare (validate + merge job default + package dirs) ONCE per
+            # session, not per submission — runtime-env prep involves
+            # hashing/validation that doesn't belong on the hot submit path.
+            self._prepared_env = cw.prepare_runtime_env(
+                self._options.get("runtime_env"))
         o = self._options
         num_returns = o.get("num_returns", 1)
         strategy = to_spec(o.get("scheduling_strategy"), o)
@@ -60,7 +67,8 @@ class RemoteFunction:
             scheduling_strategy=strategy,
             name=o.get("name") or self._function.__name__,
             function_id=self._function_id,
-            runtime_env=o.get("runtime_env"),
+            runtime_env=self._prepared_env,
+            runtime_env_prepared=True,
         )
         if isinstance(result, list):
             if num_returns == 1:

@@ -1,0 +1,185 @@
+"""Runtime environments: per-task/actor/job execution environments.
+
+Reference: ray python/ray/_private/runtime_env — `RuntimeEnv` validation
+(runtime_env.py), `working_dir`/`py_modules` zip packaging uploaded to the
+GCS KV (packaging.py), env-var injection, `worker_process_setup_hook`
+(setup_hook.py); environments are built per node by the runtime-env agent
+and workers are DEDICATED per runtime-env (a worker never mixes envs).
+
+Design here: packaging stores zips in the GCS KV under a content hash
+(`pkg:gcs://<sha>` keys) so any node can materialize them; the executing
+worker extracts to a per-hash cache dir, prepends it to sys.path, applies
+env_vars, and runs the setup hook. The TaskSpec scheduling key includes the
+runtime-env hash, so leases never mix environments (the reference's
+dedicated-worker rule). `pip`/`conda` are validated but rejected in this
+zero-egress image with a clear RuntimeEnvSetupError.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import sys
+import zipfile
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.exceptions import RuntimeEnvSetupError
+
+_SUPPORTED = {"env_vars", "working_dir", "py_modules", "pip", "conda",
+              "config", "worker_process_setup_hook"}
+_PKG_PREFIX = b"pkg:"
+_CACHE_ROOT = "/tmp/rt_session/runtime_envs"
+
+
+class RuntimeEnv(dict):
+    """Validated runtime-env dict (reference: runtime_env/runtime_env.py)."""
+
+    def __init__(self, **kwargs):
+        unknown = set(kwargs) - _SUPPORTED
+        if unknown:
+            raise ValueError(
+                f"unsupported runtime_env fields: {sorted(unknown)}; "
+                f"supported: {sorted(_SUPPORTED)}")
+        env_vars = kwargs.get("env_vars")
+        if env_vars is not None and not (
+                isinstance(env_vars, dict)
+                and all(isinstance(k, str) and isinstance(v, str)
+                        for k, v in env_vars.items())):
+            raise TypeError("env_vars must be a Dict[str, str]")
+        wd = kwargs.get("working_dir")
+        if wd is not None and not isinstance(wd, str):
+            raise TypeError("working_dir must be a path or gcs:// URI string")
+        super().__init__(**{k: v for k, v in kwargs.items() if v is not None})
+
+
+def validate(env: Optional[dict]) -> Optional[dict]:
+    if not env:
+        return None
+    return dict(RuntimeEnv(**env))
+
+
+def env_hash(env: Optional[dict]) -> str:
+    if not env:
+        return ""
+    return hashlib.sha1(
+        json.dumps(env, sort_keys=True, default=str).encode()).hexdigest()[:16]
+
+
+# ------------------------------------------------------------- packaging
+
+
+def _zip_dir(path: str) -> bytes:
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+        for root, dirs, files in os.walk(path):
+            dirs[:] = [d for d in dirs
+                       if d not in ("__pycache__", ".git", ".venv")]
+            for fname in files:
+                full = os.path.join(root, fname)
+                z.write(full, os.path.relpath(full, path))
+    return buf.getvalue()
+
+
+def package_local_dirs(env: Optional[dict], kv_put) -> Optional[dict]:
+    """Driver-side: replace local working_dir/py_modules paths with gcs://
+    URIs backed by the GCS KV (reference: packaging.py upload_package_to_gcs).
+    kv_put(key: bytes, value: bytes)."""
+    if not env:
+        return env
+    env = dict(env)
+
+    def upload(path: str) -> str:
+        if path.startswith("gcs://"):
+            return path
+        if not os.path.isdir(path):
+            raise RuntimeEnvSetupError(
+                f"working_dir/py_modules path not found: {path}")
+        data = _zip_dir(path)
+        sha = hashlib.sha1(data).hexdigest()[:20]
+        uri = f"gcs://{sha}"
+        kv_put(_PKG_PREFIX + uri.encode(), data)
+        return uri
+
+    if env.get("working_dir"):
+        env["working_dir"] = upload(env["working_dir"])
+    if env.get("py_modules"):
+        env["py_modules"] = [upload(p) for p in env["py_modules"]]
+    return env
+
+
+def _materialize(uri: str, kv_get) -> str:
+    """Worker-side: fetch a gcs:// package and extract to the local cache."""
+    sha = uri[len("gcs://"):]
+    dest = os.path.join(_CACHE_ROOT, sha)
+    if os.path.isdir(dest):
+        return dest
+    data = kv_get(_PKG_PREFIX + uri.encode())
+    if data is None:
+        raise RuntimeEnvSetupError(f"package {uri} not found in cluster KV")
+    tmp = f"{dest}.tmp.{os.getpid()}"
+    os.makedirs(tmp, exist_ok=True)
+    with zipfile.ZipFile(io.BytesIO(data)) as z:
+        z.extractall(tmp)
+    try:
+        os.rename(tmp, dest)
+    except OSError:  # another worker won the race
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+    return dest
+
+
+# ---------------------------------------------------------------- worker side
+
+
+class RuntimeEnvContext:
+    def __init__(self, env: dict):
+        self.env = env
+        self.paths: List[str] = []
+        self.workdir: Optional[str] = None
+
+
+def setup_runtime_env(env: Optional[dict], kv_get) -> Optional[RuntimeEnvContext]:
+    """Apply a runtime env in the current worker process. Sticky: workers are
+    dedicated per env hash (scheduling-key isolation), so applying directly
+    to the process is safe."""
+    if not env:
+        return None
+    ctx = RuntimeEnvContext(env)
+    for field in ("pip", "conda"):
+        if env.get(field):
+            raise RuntimeEnvSetupError(
+                f"runtime_env[{field!r}] needs package installation, which "
+                "is unavailable in this zero-egress image; bake dependencies "
+                "into the base environment instead")
+    for k, v in (env.get("env_vars") or {}).items():
+        os.environ[k] = v
+    if env.get("working_dir"):
+        wd = env["working_dir"]
+        path = _materialize(wd, kv_get) if wd.startswith("gcs://") else wd
+        if not os.path.isdir(path):
+            raise RuntimeEnvSetupError(f"working_dir not found: {path}")
+        os.chdir(path)
+        ctx.workdir = path
+        sys.path.insert(0, path)
+        ctx.paths.append(path)
+    for mod in env.get("py_modules") or []:
+        path = _materialize(mod, kv_get) if mod.startswith("gcs://") else mod
+        sys.path.insert(0, path)
+        ctx.paths.append(path)
+    hook = env.get("worker_process_setup_hook")
+    if hook:
+        if isinstance(hook, str):
+            module, _, attr = hook.partition(":")
+            import importlib
+
+            try:
+                fn = getattr(importlib.import_module(module), attr or "main")
+            except (ImportError, AttributeError) as e:
+                raise RuntimeEnvSetupError(f"setup hook {hook!r}: {e}") from e
+            fn()
+        elif callable(hook):
+            hook()
+    return ctx

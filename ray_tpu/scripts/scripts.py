@@ -1,0 +1,311 @@
+"""CLI: cluster lifecycle, jobs, state, debugging.
+
+Reference: ray python/ray/scripts/scripts.py — `ray start:571`, `stop:1047`,
+`status:1993`, `submit:1581`, `timeline:1879`, `memory:1944`,
+`microbenchmark:1865`, plus `ray job ...` and `ray list ...`
+(util/state/state_cli.py). Invoke as `python -m ray_tpu <cmd>`.
+
+`start --head` runs a real head process (GCS + raylet + autoscaler-ready);
+`start --address=H:P` joins a worker raylet — so multi-process /
+multi-machine clusters work exactly like the reference's `ray start` flow.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+PIDFILE_DIR = "/tmp/rt_session"
+
+
+def _pidfile(role: str) -> str:
+    return os.path.join(PIDFILE_DIR, f"{role}-{os.getpid()}.pid")
+
+
+def _write_pidfile(role: str, info: dict) -> None:
+    os.makedirs(PIDFILE_DIR, exist_ok=True)
+    with open(_pidfile(role), "w") as f:
+        json.dump({"pid": os.getpid(), **info}, f)
+
+
+def _all_pidfiles():
+    if not os.path.isdir(PIDFILE_DIR):
+        return []
+    out = []
+    for name in os.listdir(PIDFILE_DIR):
+        if name.endswith(".pid"):
+            try:
+                with open(os.path.join(PIDFILE_DIR, name)) as f:
+                    out.append((os.path.join(PIDFILE_DIR, name), json.load(f)))
+            except (OSError, json.JSONDecodeError):
+                continue
+    return out
+
+
+# ----------------------------------------------------------------- commands
+
+
+def cmd_start(args) -> int:
+    resources = json.loads(args.resources) if args.resources else {}
+    if args.num_cpus is not None:
+        resources["CPU"] = float(args.num_cpus)
+
+    if args.head:
+        from ray_tpu.gcs.server import GcsServer
+        from ray_tpu.raylet.raylet import Raylet
+
+        gcs = GcsServer()
+        gcs_address = gcs.start(args.port or 0)
+        raylet = Raylet(gcs_address=gcs_address,
+                        resources=resources or None, is_head=True)
+        raylet.start(0)
+        _write_pidfile("head", {"address": gcs_address})
+        print(f"Started head node.\n\n  GCS address: {gcs_address}\n\n"
+              f"To add a worker node:\n"
+              f"  python -m ray_tpu start --address={gcs_address}\n"
+              f"To connect a driver:\n"
+              f"  ray_tpu.init(address=\"{gcs_address}\")  # or "
+              f"RT_ADDRESS={gcs_address}")
+        if args.block:
+            _block_forever()
+            raylet.stop()
+            gcs.stop()
+        return 0
+
+    if not args.address:
+        print("either --head or --address=<gcs addr> is required",
+              file=sys.stderr)
+        return 1
+    from ray_tpu.raylet.raylet import Raylet
+
+    raylet = Raylet(gcs_address=args.address, resources=resources or None)
+    raylet.start(0)
+    _write_pidfile("worker", {"address": args.address})
+    print(f"Started worker node; joined {args.address}")
+    if args.block:
+        _block_forever()
+        raylet.stop()
+    return 0
+
+
+def _block_forever():
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    while not stop:
+        time.sleep(0.25)
+
+
+def cmd_stop(args) -> int:
+    n = 0
+    for path, info in _all_pidfiles():
+        pid = info.get("pid")
+        try:
+            os.kill(pid, signal.SIGTERM)
+            n += 1
+        except (ProcessLookupError, TypeError):
+            pass
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+    print(f"Sent SIGTERM to {n} node process(es).")
+    return 0
+
+
+def _connect(args):
+    import ray_tpu
+
+    addr = getattr(args, "address", None) or os.environ.get("RT_ADDRESS")
+    ray_tpu.init(address=addr, ignore_reinit_error=True)
+    return ray_tpu
+
+
+def cmd_status(args) -> int:
+    ray_tpu = _connect(args)
+    from ray_tpu.util.state import list_nodes
+
+    nodes = list_nodes()
+    total = ray_tpu.cluster_resources()
+    avail = ray_tpu.available_resources()
+    print(f"Nodes: {sum(1 for n in nodes if n['state'] == 'ALIVE')} alive / "
+          f"{len(nodes)} total")
+    for n in nodes:
+        head = " (head)" if n.get("is_head_node") else ""
+        print(f"  {n['node_id'][:12]} {n['state']}{head}  "
+              f"{n['resources_total']}")
+    print("\nResources:")
+    for k in sorted(total):
+        print(f"  {avail.get(k, 0):g}/{total[k]:g} {k}")
+    return 0
+
+
+def cmd_submit(args) -> int:
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient(args.address)
+    runtime_env = json.loads(args.runtime_env) if args.runtime_env else None
+    entry = args.entrypoint
+    if entry and entry[0] == "--":
+        entry = entry[1:]
+    import shlex
+
+    sid = client.submit_job(
+        entrypoint=" ".join(shlex.quote(a) for a in entry),
+        runtime_env=runtime_env)
+    print(f"Job submitted: {sid}")
+    if args.no_wait:
+        return 0
+    for chunk in client.tail_job_logs(sid):
+        sys.stdout.write(chunk)
+        sys.stdout.flush()
+    status = client.get_job_status(sid)
+    print(f"\nJob {sid} finished: {status.value}")
+    return 0 if status.value == "SUCCEEDED" else 1
+
+
+def cmd_job(args) -> int:
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient(args.address)
+    if args.job_cmd == "list":
+        for d in client.list_jobs():
+            print(f"{d.submission_id}  {d.status.value:10} {d.entrypoint}")
+    elif args.job_cmd == "status":
+        print(client.get_job_status(args.id).value)
+    elif args.job_cmd == "logs":
+        print(client.get_job_logs(args.id), end="")
+    elif args.job_cmd == "stop":
+        print("stopped" if client.stop_job(args.id) else "not running")
+    return 0
+
+
+def cmd_list(args) -> int:
+    _connect(args)
+    from ray_tpu.util import state as st
+
+    fn = {
+        "nodes": st.list_nodes, "actors": st.list_actors,
+        "tasks": st.list_tasks, "jobs": st.list_jobs,
+        "placement-groups": st.list_placement_groups,
+        "objects": st.list_objects, "workers": st.list_workers,
+    }[args.kind]
+    rows = fn(limit=args.limit)
+    print(json.dumps(rows, indent=2, default=str))
+    return 0
+
+
+def cmd_memory(args) -> int:
+    ray_tpu = _connect(args)
+    cw = ray_tpu._raylet.get_core_worker()
+    stats = {"memory_store_objects": cw.memory_store.size(),
+             "memory_store_bytes": cw.memory_store.total_bytes()}
+    if cw.plasma is not None:
+        n, used, cap = cw.plasma._client.stats()
+        stats["shm_store"] = {"objects": n, "used_bytes": used,
+                              "capacity_bytes": cap}
+    print(json.dumps(stats, indent=2))
+    return 0
+
+
+def cmd_timeline(args) -> int:
+    """Dump task events as a chrome://tracing file (reference: ray timeline
+    -> chrome_tracing_dump, _private/state.py:434)."""
+    _connect(args)
+    from ray_tpu.util.state import list_tasks
+
+    events = list_tasks(limit=100_000, raw_events=True)
+    trace = []
+    starts = {}
+    for ev in events:
+        key = (ev["task_id"], ev["worker_id"])
+        if ev["state"] == "RUNNING":
+            starts[key] = ev["time"]
+        elif ev["state"] in ("FINISHED", "FAILED") and key in starts:
+            t0 = starts.pop(key)
+            trace.append({
+                "cat": "task", "ph": "X", "name": ev["name"],
+                "pid": ev.get("node") or "driver",
+                "tid": ev["worker_id"][:12],
+                "ts": int(t0 * 1e6), "dur": int((ev["time"] - t0) * 1e6),
+                "args": {"task_id": ev["task_id"], "state": ev["state"]},
+            })
+    out = args.output or "timeline.json"
+    with open(out, "w") as f:
+        json.dump(trace, f)
+    print(f"Wrote {len(trace)} events to {out} "
+          f"(open in chrome://tracing or perfetto.dev)")
+    return 0
+
+
+def cmd_microbenchmark(args) -> int:
+    from ray_tpu._private.ray_perf import main as perf_main
+
+    perf_main(quick=args.quick)
+    return 0
+
+
+# --------------------------------------------------------------------- main
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("ray-tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("start", help="start a head or worker node process")
+    sp.add_argument("--head", action="store_true")
+    sp.add_argument("--address", help="GCS address to join as a worker")
+    sp.add_argument("--port", type=int, default=0)
+    sp.add_argument("--num-cpus", type=float, default=None)
+    sp.add_argument("--resources", help="JSON resource dict")
+    sp.add_argument("--block", action="store_true", default=True)
+    sp.add_argument("--no-block", dest="block", action="store_false")
+    sp.set_defaults(fn=cmd_start)
+
+    sp = sub.add_parser("stop", help="stop locally-started node processes")
+    sp.set_defaults(fn=cmd_stop)
+
+    sp = sub.add_parser("status", help="cluster nodes + resources")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_status)
+
+    sp = sub.add_parser("submit", help="submit a job (entrypoint command)")
+    sp.add_argument("--address")
+    sp.add_argument("--runtime-env", help="JSON runtime env")
+    sp.add_argument("--no-wait", action="store_true")
+    sp.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    sp.set_defaults(fn=cmd_submit)
+
+    sp = sub.add_parser("job", help="job operations")
+    sp.add_argument("--address")
+    sp.add_argument("job_cmd", choices=["list", "status", "logs", "stop"])
+    sp.add_argument("id", nargs="?")
+    sp.set_defaults(fn=cmd_job)
+
+    sp = sub.add_parser("list", help="list cluster state")
+    sp.add_argument("kind", choices=["nodes", "actors", "tasks", "jobs",
+                                     "placement-groups", "objects",
+                                     "workers"])
+    sp.add_argument("--address")
+    sp.add_argument("--limit", type=int, default=100)
+    sp.set_defaults(fn=cmd_list)
+
+    sp = sub.add_parser("memory", help="object store usage")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_memory)
+
+    sp = sub.add_parser("timeline", help="dump chrome trace of task events")
+    sp.add_argument("--address")
+    sp.add_argument("-o", "--output")
+    sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser("microbenchmark", help="run the core benchmark suite")
+    sp.add_argument("--quick", action="store_true")
+    sp.set_defaults(fn=cmd_microbenchmark)
+
+    args = p.parse_args(argv)
+    return args.fn(args)

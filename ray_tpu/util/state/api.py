@@ -54,8 +54,13 @@ def list_actors(filters=None, limit: int = 100, **_kw) -> List[Dict[str, Any]]:
 
 
 def list_tasks(filters=None, limit: int = 100,
-               job_id: Optional[str] = None, **_kw) -> List[Dict[str, Any]]:
-    events = _gcs().call("get_task_events", {"job_id": job_id, "limit": 10_000})
+               job_id: Optional[str] = None,
+               raw_events: bool = False, **_kw) -> List[Dict[str, Any]]:
+    events = _gcs().call(
+        "get_task_events", {"job_id": job_id, "limit": max(limit, 10_000)})
+    if raw_events:
+        # Full state-transition stream (for `ray-tpu timeline`).
+        return events[:limit]
     # Collapse events to latest-state per task (the reference's state
     # aggregation over gcs task events).
     latest: Dict[str, Dict[str, Any]] = {}

@@ -147,6 +147,7 @@ class CoreWorker:
         self._secondary_copies: set = set()
         self._registered_fns: set = set()
         self._fn_kv_cache: Dict[bytes, bytes] = {}
+        self._prepared_envs: Dict[str, dict] = {}
         self._put_index = 0
         self._put_lock = threading.Lock()
         self._subscriptions: Dict[str, list] = {}
@@ -219,6 +220,34 @@ class CoreWorker:
 
     def _plasma_threshold(self) -> int:
         return CONFIG.max_direct_call_object_size
+
+    # ---------------------------------------------------------- runtime envs
+    job_runtime_env: Optional[dict] = None  # job default (init(runtime_env=))
+
+    def prepare_runtime_env(self, env: Optional[dict]) -> Optional[dict]:
+        """Driver-side: merge over the job default, validate, and upload any
+        local working_dir/py_modules to the GCS KV (packaging.py role)."""
+        from ray_tpu import runtime_env as re_mod
+
+        base = self.job_runtime_env
+        if base and env:
+            merged = {**base, **env}
+            ev = {**(base.get("env_vars") or {}), **(env.get("env_vars") or {})}
+            if ev:
+                merged["env_vars"] = ev
+            env = merged
+        elif base:
+            env = dict(base)
+        env = re_mod.validate(env)
+        if env is None:
+            return None
+        cached = self._prepared_envs.get(re_mod.env_hash(env))
+        if cached is not None:
+            return cached
+        packaged = re_mod.package_local_dirs(
+            env, lambda k, v: self.kv_put(k, v, overwrite=False))
+        self._prepared_envs[re_mod.env_hash(env)] = packaged
+        return packaged
 
     # ------------------------------------------------------------- lifecycle
     def _register_handlers(self):
@@ -307,6 +336,21 @@ class CoreWorker:
             "kv_put",
             {"key": key, "value": value, "overwrite": overwrite, "namespace": namespace},
         )
+
+    def kv_del(self, key: bytes, del_by_prefix: bool = False,
+               namespace: Optional[str] = None) -> int:
+        return self._gcs.call(
+            "kv_del",
+            {"key": key, "del_by_prefix": del_by_prefix, "namespace": namespace},
+        )
+
+    def kv_keys(self, prefix: bytes, namespace: Optional[str] = None) -> list:
+        return self._gcs.call(
+            "kv_keys", {"prefix": prefix, "namespace": namespace})
+
+    def kv_exists(self, key: bytes, namespace: Optional[str] = None) -> bool:
+        return self._gcs.call(
+            "kv_exists", {"key": key, "namespace": namespace})
 
     def register_function(self, fn) -> str:
         data = ser.dumps_function(fn)
@@ -613,8 +657,11 @@ class CoreWorker:
         name: str = "",
         function_id: Optional[str] = None,
         runtime_env: Optional[dict] = None,
+        runtime_env_prepared: bool = False,
     ):
         fid = function_id or self.register_function(fn)
+        if not runtime_env_prepared:
+            runtime_env = self.prepare_runtime_env(runtime_env)
         task_id = TaskID.for_normal_task(self.job_id)
         streaming = num_returns == "streaming" or num_returns == -1
         arg_specs, kwarg_specs, arg_ids = self._build_args(args, kwargs)
@@ -945,6 +992,7 @@ class CoreWorker:
     ) -> ActorID:
         actor_id = ActorID.of(self.job_id)
         fid = self.register_function(cls)
+        runtime_env = self.prepare_runtime_env(runtime_env)
         if max_concurrency is None:
             max_concurrency = 1000 if is_asyncio else 1
         creation = ActorCreationSpec(

@@ -82,12 +82,31 @@ class Executor:
         self._async_loop: Optional[asyncio.AbstractEventLoop] = None
         self._running_threads: Dict[TaskID, int] = {}  # task -> thread ident
         self._cancelled: set = set()
+        self._env_context = None  # applied RuntimeEnvContext (sticky)
+
+    def _apply_runtime_env(self, env: dict) -> None:
+        from ray_tpu import runtime_env as re_mod
+
+        self._env_context = (
+            re_mod.setup_runtime_env(env, self.cw.kv_get) or True)
 
     # ------------------------------------------------------------------ entry
     async def execute(self, spec: TaskSpec) -> dict:
         """Run on the worker's RPC loop; dispatches to a thread and returns
         the PushTaskReply payload."""
         loop = asyncio.get_event_loop()
+        if spec.runtime_env and self._env_context is None:
+            # Apply once; workers are dedicated per env hash (the scheduling
+            # key includes it), so env state never mixes across tasks.
+            try:
+                await loop.run_in_executor(
+                    self._pool, self._apply_runtime_env, spec.runtime_env)
+            except Exception as e:  # noqa: BLE001 — surface as task error
+                from ray_tpu.exceptions import RuntimeEnvSetupError
+
+                err = (e if isinstance(e, RuntimeEnvSetupError)
+                       else RuntimeEnvSetupError(str(e)))
+                return self._error_reply(spec, err)
         if spec.task_type == TaskType.ACTOR_TASK:
             return await loop.run_in_executor(self._pool, self._run_actor_task, spec)
         if spec.task_type == TaskType.ACTOR_CREATION_TASK:

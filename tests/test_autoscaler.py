@@ -1,0 +1,149 @@
+"""Autoscaler tests.
+
+Reference patterns: ray python/ray/tests/test_autoscaler_fake_multinode.py
+(fake provider end-to-end) and resource_demand_scheduler unit tests —
+bin-packing decisions tested pure, scale-up/down tested against real
+in-process nodes.
+"""
+
+import time
+
+import pytest
+
+from ray_tpu.autoscaler.resource_demand_scheduler import get_nodes_to_launch
+
+
+# ---------------------------------------------------------------- unit tests
+
+
+def types(**kw):
+    return {
+        name: {"resources": res, "min_workers": 0, "max_workers": 10}
+        for name, res in kw.items()
+    }
+
+
+def test_demand_fits_existing_capacity():
+    out = get_nodes_to_launch(
+        types(small={"CPU": 4}),
+        existing_available=[{"CPU": 8}],
+        demands=[({"CPU": 2}, 3)],
+        counts_by_type={},
+    )
+    assert out == {}
+
+
+def test_demand_launches_nodes():
+    out = get_nodes_to_launch(
+        types(small={"CPU": 4}),
+        existing_available=[],
+        demands=[({"CPU": 2}, 5)],  # 10 CPUs -> 3 x 4-CPU nodes
+        counts_by_type={},
+    )
+    assert out == {"small": 3}
+
+
+def test_picks_cheapest_fitting_type():
+    out = get_nodes_to_launch(
+        types(big={"CPU": 16}, small={"CPU": 4}),
+        existing_available=[],
+        demands=[({"CPU": 2}, 1)],
+        counts_by_type={},
+    )
+    assert out == {"small": 1}
+
+
+def test_gpu_demand_needs_gpu_type():
+    out = get_nodes_to_launch(
+        types(cpu={"CPU": 8}, tpu={"CPU": 4, "TPU": 4}),
+        existing_available=[{"CPU": 64}],  # plenty of CPU, no TPU
+        demands=[({"TPU": 4}, 2)],
+        counts_by_type={},
+    )
+    assert out == {"tpu": 2}
+
+
+def test_max_workers_cap_respected():
+    nt = types(small={"CPU": 4})
+    nt["small"]["max_workers"] = 2
+    out = get_nodes_to_launch(
+        nt, existing_available=[], demands=[({"CPU": 4}, 10)],
+        counts_by_type={"small": 1},
+    )
+    assert out == {"small": 1}
+
+
+def test_infeasible_demand_ignored():
+    out = get_nodes_to_launch(
+        types(small={"CPU": 4}),
+        existing_available=[],
+        demands=[({"CPU": 128}, 1)],
+        counts_by_type={},
+    )
+    assert out == {}
+
+
+# --------------------------------------------------------------- end-to-end
+
+
+def test_autoscaling_cluster_scales_up_and_down():
+    import ray_tpu
+    from ray_tpu.cluster_utils import AutoscalingCluster
+
+    cluster = AutoscalingCluster(
+        head_resources={"CPU": 0.1},  # head can't run the demand
+        worker_node_types={
+            "worker": {"resources": {"CPU": 2, "tag": 1},
+                       "min_workers": 0, "max_workers": 4},
+        },
+        idle_timeout_s=2.0,
+        update_interval_s=0.25,
+    )
+    try:
+        cluster.start()
+        cluster.connect()
+
+        @ray_tpu.remote(num_cpus=1, resources={"tag": 0.1})
+        def work(i):
+            time.sleep(0.2)
+            return i
+
+        # Demand needs worker nodes (head has no `tag`): scale-up.
+        out = ray_tpu.get([work.remote(i) for i in range(8)], timeout=60)
+        assert sorted(out) == list(range(8))
+        assert len(cluster.provider.non_terminated_nodes()) >= 1
+
+        # Idle: scale back down to min_workers=0.
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if not cluster.provider.non_terminated_nodes():
+                break
+            time.sleep(0.5)
+        assert cluster.provider.non_terminated_nodes() == []
+    finally:
+        cluster.shutdown()
+
+
+def test_min_workers_floor():
+    from ray_tpu.cluster_utils import AutoscalingCluster
+
+    cluster = AutoscalingCluster(
+        worker_node_types={
+            "worker": {"resources": {"CPU": 1},
+                       "min_workers": 2, "max_workers": 4},
+        },
+        update_interval_s=0.25,
+    )
+    try:
+        cluster.start()
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            if len(cluster.provider.non_terminated_nodes()) >= 2:
+                break
+            time.sleep(0.25)
+        assert len(cluster.provider.non_terminated_nodes()) == 2
+        # min_workers nodes are never idle-terminated.
+        time.sleep(3)
+        assert len(cluster.provider.non_terminated_nodes()) == 2
+    finally:
+        cluster.shutdown()

@@ -1,0 +1,138 @@
+"""Job submission + CLI tests.
+
+Reference patterns: ray dashboard/modules/job/tests (submit/status/logs/stop
+lifecycle) and scripts tests. The CLI head/worker processes are exercised as
+real subprocesses — the same path a user runs.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def job_client(ray_start_regular):
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    return JobSubmissionClient()
+
+
+def test_job_lifecycle_success(job_client):
+    sid = job_client.submit_job(
+        entrypoint=f"{sys.executable} -c \"print('hello from job')\"")
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if job_client.get_job_status(sid).is_terminal():
+            break
+        time.sleep(0.2)
+    assert job_client.get_job_status(sid).value == "SUCCEEDED"
+    assert "hello from job" in job_client.get_job_logs(sid)
+
+
+def test_job_failure_reports_exit_code(job_client):
+    sid = job_client.submit_job(
+        entrypoint=f"{sys.executable} -c 'import sys; sys.exit(3)'")
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        info = job_client.get_job_info(sid)
+        if info.status.is_terminal():
+            break
+        time.sleep(0.2)
+    assert info.status.value == "FAILED"
+    assert info.driver_exit_code == 3
+
+
+def test_job_stop(job_client):
+    sid = job_client.submit_job(
+        entrypoint=f"{sys.executable} -c 'import time; time.sleep(60)'")
+    time.sleep(0.5)
+    assert job_client.stop_job(sid)
+    assert job_client.get_job_status(sid).value == "STOPPED"
+
+
+def test_job_runs_cluster_workload(job_client):
+    """The submitted driver connects back to this cluster via RT_ADDRESS."""
+    script = ("import ray_tpu; ray_tpu.init(); "
+              "f = ray_tpu.remote(lambda: 40 + 2); "
+              "print('answer=', ray_tpu.get(f.remote()))")
+    sid = job_client.submit_job(
+        entrypoint=f"{sys.executable} -c \"{script}\"")
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if job_client.get_job_status(sid).is_terminal():
+            break
+        time.sleep(0.3)
+    logs = job_client.get_job_logs(sid)
+    assert job_client.get_job_status(sid).value == "SUCCEEDED", logs
+    assert "answer= 42" in logs
+
+
+def test_job_list(job_client):
+    sid = job_client.submit_job(entrypoint="true")
+    jobs = job_client.list_jobs()
+    assert any(d.submission_id == sid for d in jobs)
+
+
+# ------------------------------------------------------------------ CLI
+
+
+def _cli(*args, timeout=60, env=None):
+    e = dict(os.environ)
+    e["PYTHONPATH"] = REPO + os.pathsep + e.get("PYTHONPATH", "")
+    e.update(env or {})
+    return subprocess.run(
+        [sys.executable, "-m", "ray_tpu", *args],
+        capture_output=True, text=True, timeout=timeout, env=e)
+
+
+def test_cli_head_worker_status_submit(tmp_path):
+    """Full user flow: start head process, join a worker process, check
+    status, submit a job, stop everything."""
+    e = dict(os.environ)
+    e["PYTHONPATH"] = REPO + os.pathsep + e.get("PYTHONPATH", "")
+    head = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu", "start", "--head",
+         "--num-cpus", "2"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=e)
+    try:
+        address = None
+        deadline = time.time() + 30
+        lines = []
+        while time.time() < deadline and address is None:
+            line = head.stdout.readline()
+            lines.append(line)
+            if "GCS address:" in line:
+                address = line.split("GCS address:")[1].strip()
+        assert address, "head did not print its address: " + "".join(lines)
+
+        worker = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu", "start",
+             "--address", address, "--num-cpus", "2"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=e)
+        try:
+            deadline = time.time() + 30
+            ok = False
+            while time.time() < deadline and not ok:
+                st = _cli("status", "--address", address)
+                ok = "2 alive" in st.stdout
+                if not ok:
+                    time.sleep(0.5)
+            assert ok, st.stdout + st.stderr
+
+            sub = _cli("submit", "--address", address, "--",
+                       sys.executable, "-c", "print(6*7)")
+            assert "42" in sub.stdout, sub.stdout + sub.stderr
+            assert "SUCCEEDED" in sub.stdout
+        finally:
+            worker.terminate()
+            worker.wait(10)
+    finally:
+        head.terminate()
+        head.wait(10)

@@ -1,6 +1,8 @@
 """Smoke tests for the microbenchmark suite (reference pattern: ray
 microbenchmark smoke in python/ray/tests; harness ray_perf.py:93)."""
 
+import time
+
 import numpy as np
 import pytest
 
@@ -27,7 +29,21 @@ def test_actor_default_cpu_is_placement_only(ray_start_regular):
     assert ray_tpu.get([a.ping.remote() for a in actors],
                        timeout=60) == [1] * 8
 
-    # Explicit num_cpus IS held: two 2-CPU actors saturate 4 CPUs and tasks
-    # still run (tasks get CPU back only because actors hold, tasks queue).
     avail = ray_tpu.available_resources()
     assert avail.get("CPU", 0) >= 3.9  # the 8 default actors hold none
+
+    # Explicit num_cpus IS held for the actor's lifetime.
+    @ray_tpu.remote(num_cpus=2)
+    class Held:
+        def ping(self):
+            return 1
+
+    h = Held.remote()
+    assert ray_tpu.get(h.ping.remote(), timeout=60) == 1
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if ray_tpu.available_resources().get("CPU", 4) <= 2.0:
+            break
+        time.sleep(0.2)
+    assert ray_tpu.available_resources().get("CPU", 4) <= 2.0
+    ray_tpu.kill(h)

@@ -1,0 +1,108 @@
+"""Runtime env tests (reference patterns: ray python/ray/tests/
+test_runtime_env_env_vars.py, test_runtime_env_working_dir.py)."""
+
+import os
+import sys
+
+import pytest
+
+from ray_tpu.runtime_env import RuntimeEnv, env_hash, validate
+
+
+def test_validate_rejects_unknown_fields():
+    with pytest.raises(ValueError):
+        RuntimeEnv(bogus_field=1)
+
+
+def test_validate_env_var_types():
+    with pytest.raises(TypeError):
+        RuntimeEnv(env_vars={"A": 1})
+
+
+def test_env_hash_stable_and_distinct():
+    a = {"env_vars": {"X": "1"}}
+    assert env_hash(a) == env_hash({"env_vars": {"X": "1"}})
+    assert env_hash(a) != env_hash({"env_vars": {"X": "2"}})
+    assert env_hash(None) == "" and env_hash({}) == ""
+
+
+def test_task_env_vars(ray_start_regular):
+    import ray_tpu
+
+    @ray_tpu.remote(runtime_env={"env_vars": {"RT_TEST_FLAG": "on"}})
+    def read():
+        return os.environ.get("RT_TEST_FLAG")
+
+    @ray_tpu.remote
+    def read_plain():
+        return os.environ.get("RT_TEST_FLAG")
+
+    assert ray_tpu.get(read.remote()) == "on"
+    # Plain tasks run in workers without the env (dedicated workers per env).
+    assert ray_tpu.get(read_plain.remote()) is None
+
+
+def test_actor_env_vars(ray_start_regular):
+    import ray_tpu
+
+    @ray_tpu.remote(runtime_env={"env_vars": {"RT_ACTOR_FLAG": "yes"}})
+    class A:
+        def read(self):
+            return os.environ.get("RT_ACTOR_FLAG")
+
+    assert ray_tpu.get(A.remote().read.remote()) == "yes"
+
+
+def test_working_dir_ships_local_files(ray_start_regular, tmp_path):
+    import ray_tpu
+
+    (tmp_path / "my_helper_mod.py").write_text("VALUE = 123\n")
+    (tmp_path / "data.txt").write_text("payload")
+
+    @ray_tpu.remote(runtime_env={"working_dir": str(tmp_path)})
+    def use():
+        import my_helper_mod  # importable: working_dir on sys.path
+
+        with open("data.txt") as f:  # cwd is the working_dir
+            return my_helper_mod.VALUE, f.read()
+
+    assert ray_tpu.get(use.remote()) == (123, "payload")
+
+
+def test_pip_rejected_with_clear_error(ray_start_regular):
+    import ray_tpu
+    from ray_tpu.exceptions import RuntimeEnvSetupError
+
+    @ray_tpu.remote(runtime_env={"pip": ["some-package"]})
+    def f():
+        return 1
+
+    with pytest.raises(RuntimeEnvSetupError):
+        ray_tpu.get(f.remote())
+
+
+def test_job_level_runtime_env(tmp_path):
+    import ray_tpu
+
+    ray_tpu.shutdown()
+    try:
+        ray_tpu.init(num_cpus=2,
+                     runtime_env={"env_vars": {"RT_JOB_WIDE": "42"}})
+
+        @ray_tpu.remote
+        def read():
+            return os.environ.get("RT_JOB_WIDE")
+
+        # Job-level env applies to all tasks AND the driver.
+        assert ray_tpu.get(read.remote()) == "42"
+        assert os.environ.get("RT_JOB_WIDE") == "42"
+
+        # Per-task env merges over the job default.
+        @ray_tpu.remote(runtime_env={"env_vars": {"RT_EXTRA": "x"}})
+        def both():
+            return os.environ.get("RT_JOB_WIDE"), os.environ.get("RT_EXTRA")
+
+        assert ray_tpu.get(both.remote()) == ("42", "x")
+    finally:
+        ray_tpu.shutdown()
+        os.environ.pop("RT_JOB_WIDE", None)

@@ -109,3 +109,19 @@ def test_actor_pool(ray_start_regular):
     pool = ActorPool([W.remote(), W.remote()])
     out = list(pool.map(lambda a, v: a.double.remote(v), [1, 2, 3, 4]))
     assert out == [2, 4, 6, 8]
+
+
+def test_internal_kv(ray_start_regular):
+    from ray_tpu.experimental import internal_kv as kv
+
+    assert kv.internal_kv_initialized()
+    assert kv.internal_kv_put(b"ik_key", b"v1")
+    assert kv.internal_kv_get(b"ik_key") == b"v1"
+    assert kv.internal_kv_exists(b"ik_key")
+    # namespacing isolates keys
+    kv.internal_kv_put(b"ik_key", b"other", namespace=b"ns")
+    assert kv.internal_kv_get(b"ik_key", namespace=b"ns") == b"other"
+    assert kv.internal_kv_get(b"ik_key") == b"v1"
+    assert b"ik_key" in kv.internal_kv_list(b"ik_")
+    kv.internal_kv_del(b"ik_key")
+    assert kv.internal_kv_get(b"ik_key") is None
