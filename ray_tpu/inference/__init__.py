@@ -1,0 +1,10 @@
+"""TPU-native LLM inference: bucketed prefill + continuous batching decode.
+
+The reference has no in-repo inference engine (Ray Serve delegates LLM
+serving to user code); SURVEY §7 lists "async serving on TPU: batching +
+compiled-shape management (bucketing) in Serve replicas" as a required
+hard part — this package supplies it.
+"""
+
+from ray_tpu.inference.engine import GenerationConfig, InferenceEngine  # noqa: F401
+from ray_tpu.inference.sampling import sample_token  # noqa: F401

@@ -1,0 +1,211 @@
+"""InferenceEngine: slot-based continuous batching over a jitted decode step.
+
+TPU design constraints this implements (SURVEY §7 "async serving on TPU"):
+
+  * STATIC SHAPES — XLA compiles one program per shape. Prefill pads each
+    prompt to a size bucket (powers of two up to max_len) so at most
+    len(buckets) prefill programs exist; decode always runs the full
+    [max_batch, 1] step regardless of how many slots are active.
+  * CONTINUOUS BATCHING — requests occupy slots of a fixed-size batch;
+    a finished request frees its slot for the next admission without
+    stopping decode for the others (the "persistent batch" pattern).
+  * DONATION — the KV cache is donated into each step so XLA updates it
+    in place in HBM instead of copying [L,B,T,kv,K] every token.
+
+Model-agnostic: any model exposing `forward_with_cache(params, tokens,
+cache, lengths, config)` + `init_kv_cache` works (llama.py provides both).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.inference.sampling import sample_token
+
+
+@dataclasses.dataclass(frozen=True)
+class GenerationConfig:
+    max_new_tokens: int = 64
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    eos_token_id: Optional[int] = None
+
+
+def _default_buckets(max_len: int) -> Tuple[int, ...]:
+    out, b = [], 64
+    while b < max_len:
+        out.append(b)
+        b *= 2
+    out.append(max_len)
+    return tuple(out)
+
+
+class InferenceEngine:
+    def __init__(
+        self,
+        params: Any,
+        config: Any,
+        *,
+        forward_with_cache: Optional[Callable] = None,
+        init_kv_cache: Optional[Callable] = None,
+        max_batch: int = 4,
+        max_len: int = 1024,
+        prefill_buckets: Optional[Tuple[int, ...]] = None,
+    ):
+        if forward_with_cache is None or init_kv_cache is None:
+            from ray_tpu.models import llama
+
+            forward_with_cache = forward_with_cache or llama.forward_with_cache
+            init_kv_cache = init_kv_cache or llama.init_kv_cache
+        self.params = params
+        self.config = config
+        self.max_batch = max_batch
+        self.max_len = max_len
+        self.buckets = prefill_buckets or _default_buckets(max_len)
+        self._fwd = forward_with_cache
+        self.cache = init_kv_cache(config, max_batch, max_len)
+        # slot state (host side)
+        self.lengths = np.zeros(max_batch, dtype=np.int32)
+        self.free_slots = list(range(max_batch))
+        self._key = jax.random.PRNGKey(0)
+
+        # One compiled prefill per bucket; one compiled decode. Marked donate
+        # for the cache operand.
+        @partial(jax.jit, donate_argnums=(1,))
+        def prefill(params, cache, tokens, slot, true_len):
+            """tokens: [1, bucket] padded; writes KV into `slot`, returns
+            logits of the last REAL token. The slot row is rebuilt from
+            zeros (a reused slot may hold a previous request's stale KV)."""
+            t = cache["k"].shape[2]
+            row_cache = {
+                k: jnp.zeros((v.shape[0], 1) + v.shape[2:], v.dtype)
+                for k, v in cache.items()
+            }
+            logits, row_cache = self._fwd(
+                params, tokens, row_cache, jnp.zeros((1,), jnp.int32),
+                self.config)
+            # Zero the padded tail so it never pollutes later decode steps.
+            valid = (jnp.arange(t) < true_len)[None, None, :, None, None]
+            new_cache = {}
+            for k in cache:
+                updated = jnp.where(valid, row_cache[k], 0).astype(
+                    cache[k].dtype)
+                new_cache[k] = jax.lax.dynamic_update_slice_in_dim(
+                    cache[k], updated, slot, axis=1)
+            last = logits[0, true_len - 1]
+            return new_cache, last
+
+        @partial(jax.jit, donate_argnums=(1,), static_argnames=("temperature", "top_k", "top_p"))
+        def decode(params, cache, tokens, lengths, key,
+                   temperature=0.0, top_k=0, top_p=1.0):
+            """tokens: [B,1] current token per slot -> next token per slot."""
+            logits, cache = self._fwd(params, tokens, cache, lengths,
+                                      self.config)
+            nxt = sample_token(logits[:, -1], key, temperature=temperature,
+                               top_k=top_k, top_p=top_p)
+            return cache, nxt
+
+        self._prefill = prefill
+        self._decode = decode
+
+    # -- internals ----------------------------------------------------------
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        raise ValueError(f"prompt of {n} tokens exceeds max_len={self.max_len}")
+
+    def _admit(self, prompt: List[int], gen: GenerationConfig) -> Tuple[int, int]:
+        """Prefill a prompt into a free slot; returns (slot, first_token)."""
+        slot = self.free_slots.pop()
+        n = len(prompt)
+        bucket = self._bucket_for(n)
+        toks = np.zeros((1, bucket), dtype=np.int32)
+        toks[0, :n] = prompt
+        self.cache, last_logits = self._prefill(
+            self.params, self.cache, jnp.asarray(toks), slot, n)
+        self._key, sub = jax.random.split(self._key)
+        first = int(sample_token(last_logits[None, :], sub,
+                                 temperature=gen.temperature,
+                                 top_k=gen.top_k, top_p=gen.top_p)[0])
+        self.lengths[slot] = n
+        return slot, first
+
+    def _release(self, slot: int) -> None:
+        self.lengths[slot] = 0
+        self.free_slots.append(slot)
+
+    # -- public API ---------------------------------------------------------
+
+    def generate_stream(
+        self,
+        prompts: List[List[int]],
+        gen: Optional[GenerationConfig] = None,
+    ) -> Iterator[Tuple[int, int]]:
+        """Continuous-batching generation. Yields (request_index, token_id)
+        as tokens are produced; requests are admitted as slots free up."""
+        gen = gen or GenerationConfig()
+        pending = list(enumerate(prompts))[::-1]  # stack of (req_idx, prompt)
+        active: Dict[int, dict] = {}  # slot -> {req, produced, current}
+
+        def admit_all():
+            while pending and self.free_slots:
+                req_idx, prompt = pending.pop()
+                slot, first = self._admit(prompt, gen)
+                yield req_idx, first
+                # The prefill-sampled token can already terminate the request.
+                if ((gen.eos_token_id is not None and first == gen.eos_token_id)
+                        or gen.max_new_tokens <= 1
+                        or self.lengths[slot] + 1 >= self.max_len):
+                    self._release(slot)
+                    continue
+                active[slot] = {"req": req_idx, "produced": 1,
+                                "current": first}
+
+        yield from admit_all()
+        while active:
+            tokens = np.zeros((self.max_batch, 1), dtype=np.int32)
+            for slot, st in active.items():
+                tokens[slot, 0] = st["current"]
+            # Record cache positions BEFORE bumping: each slot's current
+            # token goes at index lengths[slot].
+            lengths = jnp.asarray(self.lengths)
+            self._key, sub = jax.random.split(self._key)
+            self.cache, nxt = self._decode(
+                self.params, self.cache, jnp.asarray(tokens), lengths, sub,
+                temperature=gen.temperature, top_k=gen.top_k, top_p=gen.top_p)
+            nxt = np.asarray(nxt)
+            for slot in list(active):
+                st = active[slot]
+                self.lengths[slot] += 1
+                token = int(nxt[slot])
+                done = False
+                st["produced"] += 1
+                st["current"] = token
+                if gen.eos_token_id is not None and token == gen.eos_token_id:
+                    done = True
+                if st["produced"] >= gen.max_new_tokens:
+                    done = True
+                if self.lengths[slot] + 1 >= self.max_len:
+                    done = True
+                yield st["req"], token
+                if done:
+                    del active[slot]
+                    self._release(slot)
+                    yield from admit_all()
+
+    def generate(self, prompts: List[List[int]],
+                 gen: Optional[GenerationConfig] = None) -> List[List[int]]:
+        """-> new tokens per prompt (prompt not included)."""
+        out: List[List[int]] = [[] for _ in prompts]
+        for req_idx, token in self.generate_stream(prompts, gen):
+            out[req_idx].append(token)
+        return out

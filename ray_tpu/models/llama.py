@@ -171,11 +171,15 @@ def _attention(q, k, v, config: LlamaConfig, mesh=None):
     return flash_attention(q, k, v, causal=True)
 
 
-def _layer(x, params, positions, config: LlamaConfig, mesh=None,
-           rules: Optional[LogicalAxisRules] = None):
+def _attn_sublayer(x, params, positions, config: LlamaConfig, mesh=None,
+                   rules: Optional[LogicalAxisRules] = None,
+                   kv_cache=None, lengths=None):
+    """Pre-norm attention block shared by the training layer, the KV-cache
+    decode path and mixtral. With kv_cache=(k_cache, v_cache) it scatters
+    the new K/V at `positions` and attends over the cache, returning
+    (x, (new_k_cache, new_v_cache)); otherwise returns (x, None)."""
     c = config
     lc = partial(with_logical_constraint, mesh=mesh, rules=rules)
-
     h = _rms_norm(x, params["attn_norm"], c.norm_eps)
     q = jnp.einsum("bsd,dhk->bshk", h, params["wq"])
     k = jnp.einsum("bsd,dhk->bshk", h, params["wk"])
@@ -184,9 +188,28 @@ def _layer(x, params, positions, config: LlamaConfig, mesh=None,
     k = lc(k, ("batch", "seq", "act_heads", "act_kv"))
     q = _rope(q, positions, c.rope_theta)
     k = _rope(k, positions, c.rope_theta)
-    attn = _attention(q, k, v, c, mesh)
+    new_cache = None
+    if kv_cache is not None:
+        k_cache, v_cache = kv_cache
+        # Additive one-hot scatter at each row's offset (target slots are
+        # still zero in append-only generation) — a single MXU matmul.
+        t = k_cache.shape[1]
+        onehot = jax.nn.one_hot(positions, t, dtype=k.dtype)  # [B,S,T]
+        k_cache = k_cache + jnp.einsum("bst,bshk->bthk", onehot, k)
+        v_cache = v_cache + jnp.einsum("bst,bshk->bthk", onehot, v)
+        attn = _cached_attention(q, k_cache, v_cache, lengths, c)
+        new_cache = (k_cache, v_cache)
+    else:
+        attn = _attention(q, k, v, c, mesh)
     x = x + jnp.einsum("bshk,hkd->bsd", attn, params["wo"])
-    x = lc(x, ("batch", "seq", "act_embed"))
+    return lc(x, ("batch", "seq", "act_embed")), new_cache
+
+
+def _layer(x, params, positions, config: LlamaConfig, mesh=None,
+           rules: Optional[LogicalAxisRules] = None):
+    c = config
+    lc = partial(with_logical_constraint, mesh=mesh, rules=rules)
+    x, _ = _attn_sublayer(x, params, positions, c, mesh, rules)
 
     h = _rms_norm(x, params["mlp_norm"], c.norm_eps)
     gate = jnp.einsum("bsd,df->bsf", h, params["w_gate"])
@@ -220,6 +243,78 @@ def forward(params, tokens, config: LlamaConfig, mesh=None,
     logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"])
     logits = lc(logits, ("batch", "seq", "act_vocab"))
     return logits.astype(jnp.float32)
+
+
+def init_kv_cache(config: LlamaConfig, batch: int, max_len: int,
+                  dtype=None) -> Dict[str, Any]:
+    """Per-layer KV cache for incremental decoding: arrays shaped
+    [n_layers, batch, max_len, n_kv_heads, d_head] (layer-major so the same
+    lax.scan over params['layers'] carries the matching cache slice)."""
+    c = config
+    dtype = dtype or c.dtype
+    shape = (c.n_layers, batch, max_len, c.n_kv_heads, c.d_head)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def _cached_attention(q, k_cache, v_cache, lengths, config: LlamaConfig):
+    """q: [B,S,H,K] new queries at positions lengths..lengths+S;
+    k/v_cache: [B,T,kv,K] full cache (already containing the new keys).
+    Masks out cache positions >= lengths+S and enforces causality within
+    the new block. Plain einsum attention: decode shapes are small and XLA
+    maps them straight onto the MXU."""
+    c = config
+    b, s, h, d = q.shape
+    t = k_cache.shape[1]
+    rep = c.n_heads // c.n_kv_heads
+    if rep > 1:
+        k_cache = jnp.repeat(k_cache, rep, axis=2)
+        v_cache = jnp.repeat(v_cache, rep, axis=2)
+    scores = jnp.einsum("bshk,bthk->bhst", q.astype(jnp.float32),
+                        k_cache.astype(jnp.float32)) / (d ** 0.5)
+    # position j is visible to query i (absolute pos lengths+i) iff j <= pos.
+    q_pos = lengths[:, None, None, None] + jnp.arange(s)[None, None, :, None]
+    j_pos = jnp.arange(t)[None, None, None, :]
+    mask = j_pos <= q_pos
+    scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhst,bthk->bshk", probs.astype(v_cache.dtype), v_cache)
+    return out
+
+
+def forward_with_cache(params, tokens, cache, lengths, config: LlamaConfig):
+    """Incremental forward for generation (prefill when S>1, decode at S=1).
+
+    tokens: [B, S] the NEW tokens, logically at positions lengths..lengths+S.
+    cache:  dict from init_kv_cache (functionally updated and returned).
+    lengths: [B] int32 — number of tokens already in the cache per row.
+    -> (logits [B, S, vocab] fp32, new_cache)
+
+    Reference parity note: ray has no inference engine (serving delegates to
+    user code / vLLM); this is the TPU-native decode path that
+    ray_tpu.inference builds continuous batching on.
+    """
+    c = config
+    b, s = tokens.shape
+    positions = lengths[:, None] + jnp.arange(s)[None, :]
+    x = params["embed"][tokens].astype(c.dtype)
+
+    def scan_body(x, layer_in):
+        layer_p, k_cache, v_cache = layer_in
+        x, (k_cache, v_cache) = _attn_sublayer(
+            x, layer_p, positions, c, kv_cache=(k_cache, v_cache),
+            lengths=lengths)
+        hh = _rms_norm(x, layer_p["mlp_norm"], c.norm_eps)
+        gate = jnp.einsum("bsd,df->bsf", hh, layer_p["w_gate"])
+        up = jnp.einsum("bsd,df->bsf", hh, layer_p["w_up"])
+        ff = jax.nn.silu(gate) * up
+        x = x + jnp.einsum("bsf,fd->bsd", ff, layer_p["w_down"])
+        return x, (k_cache, v_cache)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        scan_body, x, (params["layers"], cache["k"], cache["v"]))
+    x = _rms_norm(x, params["final_norm"], c.norm_eps)
+    logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"])
+    return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
 
 
 def loss_fn(params, batch, config: LlamaConfig, mesh=None,

@@ -1,0 +1,174 @@
+"""Mixtral-family sparse-MoE decoder, TPU-first.
+
+Reference gap: KantiCodes/ray has no model zoo — its RLlib/Train run user
+models; SURVEY §5 ("Long-context / sequence parallelism... the TPU framework
+must supply its own model-parallel layer natively") and §7 name sharded MoE
+dispatch a required native capability. This model composes the Llama-family
+attention stack (models/llama.py) with top-k routed experts
+(parallel/moe.py): dense gating per token, k experts, capacity-bounded
+dispatch; with an `ep` mesh axis the experts shard across chips and tokens
+travel via all_to_all on ICI.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models import llama
+from ray_tpu.models.llama import LlamaConfig, _rms_norm, _rope
+from ray_tpu.parallel.moe import moe_layer, moe_shard_map
+from ray_tpu.parallel.sharding import LogicalAxisRules
+
+
+@dataclasses.dataclass(frozen=True)
+class MixtralConfig(LlamaConfig):
+    n_experts: int = 8
+    experts_per_token: int = 2
+    capacity_factor: float = 1.25
+    aux_loss_coef: float = 0.01
+
+    @staticmethod
+    def tiny(vocab_size: int = 512) -> "MixtralConfig":
+        return MixtralConfig(
+            vocab_size=vocab_size, d_model=128, n_layers=2, n_heads=4,
+            n_kv_heads=2, d_head=32, d_ff=256, max_seq_len=512,
+            n_experts=4, experts_per_token=2,
+        )
+
+    def num_params(self) -> int:
+        base = super().num_params()
+        # replace the dense FFN count with n_experts routed FFNs + gate
+        dense_ffn = self.n_layers * 3 * self.d_model * self.d_ff
+        moe_ffn = self.n_layers * (
+            self.n_experts * 3 * self.d_model * self.d_ff
+            + self.d_model * self.n_experts)
+        return base - dense_ffn + moe_ffn
+
+
+def param_logical_axes(config: MixtralConfig) -> Dict[str, Any]:
+    axes = llama.param_logical_axes(config)
+    layer_axes = axes["layers"]
+    for k in ("w_gate", "w_up", "w_down"):
+        layer_axes.pop(k, None)
+    L = ("layers",)
+    layer_axes["moe_gate"] = L + ("embed", "expert")
+    layer_axes["experts"] = {
+        "w_gate": L + ("expert", "embed", "mlp"),
+        "w_up": L + ("expert", "embed", "mlp"),
+        "w_down": L + ("expert", "mlp", "embed"),
+    }
+    return axes
+
+
+def init(config: MixtralConfig, key) -> Dict[str, Any]:
+    c = config
+    params = llama.init(c, key)
+    layers = params["layers"]
+    for k in ("w_gate", "w_up", "w_down"):
+        layers.pop(k, None)
+    k1, k2, k3, k4 = jax.random.split(jax.random.fold_in(key, 0xE), 4)
+    scale_in = (2.0 / (c.d_model + c.d_ff)) ** 0.5
+    # Leading axis n_layers (scanned), then n_experts (sharded on `ep`).
+    layers["moe_gate"] = (
+        jax.random.normal(k1, (c.n_layers, c.d_model, c.n_experts)) * 0.02
+    ).astype(c.dtype)
+    layers["experts"] = {
+        "w_gate": (jax.random.normal(
+            k2, (c.n_layers, c.n_experts, c.d_model, c.d_ff)) * scale_in
+        ).astype(c.dtype),
+        "w_up": (jax.random.normal(
+            k3, (c.n_layers, c.n_experts, c.d_model, c.d_ff)) * scale_in
+        ).astype(c.dtype),
+        "w_down": (jax.random.normal(
+            k4, (c.n_layers, c.n_experts, c.d_ff, c.d_model)) * scale_in
+        ).astype(c.dtype),
+    }
+    return params
+
+
+def _expert_ffn(p, x):
+    """One expert's SwiGLU FFN. p: dict of [d,f],[d,f],[f,d]; x: [t, d]."""
+    gate = x @ p["w_gate"]
+    up = x @ p["w_up"]
+    return (jax.nn.silu(gate) * up) @ p["w_down"]
+
+
+def _moe_block(h, layer_p, config: MixtralConfig, mesh):
+    """h: [B,S,D] -> (out [B,S,D], aux_loss scalar)."""
+    c = config
+    b, s, d = h.shape
+    flat = h.reshape(b * s, d)
+    expert_params = {
+        "w_gate": layer_p["experts"]["w_gate"],
+        "w_up": layer_p["experts"]["w_up"],
+        "w_down": layer_p["experts"]["w_down"],
+    }
+    if mesh is not None and mesh.shape.get("ep", 1) > 1:
+        out, aux = moe_shard_map(
+            flat, layer_p["moe_gate"], _expert_ffn, expert_params, mesh,
+            k=c.experts_per_token, capacity_factor=c.capacity_factor)
+    else:
+        out, aux = moe_layer(
+            flat, layer_p["moe_gate"], _expert_ffn, expert_params,
+            k=c.experts_per_token, capacity_factor=c.capacity_factor)
+    return out.reshape(b, s, d), aux
+
+
+def forward(params, tokens, config: MixtralConfig, mesh=None,
+            rules: Optional[LogicalAxisRules] = None):
+    """tokens [B,S] -> (logits [B,S,V] fp32, aux_loss scalar fp32)."""
+    c = config
+    b, s = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+    x = params["embed"][tokens].astype(c.dtype)
+
+    def layer_fn(x, layer_p):
+        x, _ = llama._attn_sublayer(x, layer_p, positions, c, mesh, rules)
+        h2 = _rms_norm(x, layer_p["mlp_norm"], c.norm_eps)
+        moe_out, aux = _moe_block(h2, layer_p, c, mesh)
+        return x + moe_out, aux
+
+    if c.remat:
+        layer_fn = jax.checkpoint(layer_fn)
+
+    def scan_body(x, layer_p):
+        x, aux = layer_fn(x, layer_p)
+        return x, aux
+
+    x, aux_per_layer = jax.lax.scan(scan_body, x, params["layers"])
+    x = _rms_norm(x, params["final_norm"], c.norm_eps)
+    logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"])
+    return logits.astype(jnp.float32), jnp.mean(aux_per_layer)
+
+
+def loss_fn(params, batch, config: MixtralConfig, mesh=None,
+            rules: Optional[LogicalAxisRules] = None):
+    """Next-token CE + load-balancing aux loss (Switch/Mixtral style).
+    Scalar return (make_train_step contract, train/step.py:100)."""
+    if "inputs" in batch:
+        inputs, targets = batch["inputs"], batch["targets"]
+    else:
+        tokens = batch["tokens"]
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    logits, aux = forward(params, inputs, config, mesh, rules)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ce = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(ce) + config.aux_loss_coef * aux
+
+
+def flops_per_token(config: MixtralConfig, seq_len: int) -> float:
+    """6·N_active + attention term: a token only multiplies through its
+    k routed experts, so the (n_experts - k) inactive expert FFNs per layer
+    are excluded from the 6N parameter-flops count."""
+    c = config
+    inactive_ffn_params = (
+        c.n_layers * (c.n_experts - c.experts_per_token)
+        * 3 * c.d_model * c.d_ff)
+    param_flops = 6.0 * (c.num_params() - inactive_ffn_params)
+    attn_flops = 6.0 * c.n_layers * c.n_heads * c.d_head * seq_len
+    return param_flops + attn_flops

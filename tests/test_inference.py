@@ -1,0 +1,149 @@
+"""Inference engine tests: KV-cache parity with the full forward pass,
+bucketed prefill, continuous batching, sampling."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.inference import GenerationConfig, InferenceEngine
+from ray_tpu.inference.sampling import sample_token
+from ray_tpu.models import llama
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = llama.LlamaConfig.tiny(vocab_size=128)
+    cfg = cfg.__class__(**{**cfg.__dict__, "dtype": jnp.float32,
+                           "remat": False})
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def test_cache_parity_with_full_forward(tiny):
+    """Prefill+decode logits must match the plain forward pass."""
+    cfg, params = tiny
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 12), 0,
+                              cfg.vocab_size)
+    full = llama.forward(params, toks, cfg)  # [2, 12, V]
+
+    cache = llama.init_kv_cache(cfg, 2, 32)
+    # Prefill the first 8 tokens, then decode the remaining 4 one by one.
+    logits_p, cache = llama.forward_with_cache(
+        params, toks[:, :8], cache, jnp.zeros(2, jnp.int32), cfg)
+    np.testing.assert_allclose(np.asarray(logits_p),
+                               np.asarray(full[:, :8]), rtol=2e-4, atol=2e-4)
+    for i in range(8, 12):
+        step, cache = llama.forward_with_cache(
+            params, toks[:, i:i + 1], cache,
+            jnp.full(2, i, jnp.int32), cfg)
+        np.testing.assert_allclose(np.asarray(step[:, 0]),
+                                   np.asarray(full[:, i]),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_greedy_engine_matches_naive_decode(tiny):
+    cfg, params = tiny
+    prompt = [3, 17, 42, 9]
+    n_new = 6
+
+    # Naive: repeatedly run the full forward and take argmax.
+    seq = list(prompt)
+    for _ in range(n_new):
+        logits = llama.forward(
+            params, jnp.asarray([seq], jnp.int32), cfg)
+        seq.append(int(jnp.argmax(logits[0, -1])))
+    expected = seq[len(prompt):]
+
+    eng = InferenceEngine(params, cfg, max_batch=2, max_len=64)
+    out = eng.generate([prompt], GenerationConfig(max_new_tokens=n_new))
+    assert out[0] == expected
+
+
+def test_continuous_batching_many_requests(tiny):
+    """More requests than slots: slots are recycled; every request gets
+    exactly max_new_tokens tokens; per-request results are independent of
+    batch composition."""
+    cfg, params = tiny
+    prompts = [[i + 1, i + 2, i + 3] for i in range(5)]
+    eng = InferenceEngine(params, cfg, max_batch=2, max_len=64)
+    out = eng.generate(prompts, GenerationConfig(max_new_tokens=4))
+    assert all(len(o) == 4 for o in out)
+
+    # Same prompts one-at-a-time give identical greedy outputs.
+    for i, p in enumerate(prompts):
+        eng1 = InferenceEngine(params, cfg, max_batch=1, max_len=64)
+        solo = eng1.generate([p], GenerationConfig(max_new_tokens=4))
+        assert solo[0] == out[i], f"request {i} differs under batching"
+
+
+def test_eos_frees_slot(tiny):
+    cfg, params = tiny
+    eng = InferenceEngine(params, cfg, max_batch=1, max_len=64)
+    # Find what greedy emits first, then use it as "eos".
+    probe = eng.generate([[5, 6, 7]], GenerationConfig(max_new_tokens=1))
+    eos = probe[0][0]
+    eng2 = InferenceEngine(params, cfg, max_batch=1, max_len=64)
+    out = eng2.generate(
+        [[5, 6, 7]], GenerationConfig(max_new_tokens=16, eos_token_id=eos))
+    assert out[0] == [eos]  # stopped immediately at eos
+    assert eng2.free_slots == [0]
+
+
+def test_prefill_bucketing(tiny):
+    cfg, params = tiny
+    eng = InferenceEngine(params, cfg, max_batch=1, max_len=256,
+                          prefill_buckets=(8, 32, 256))
+    assert eng._bucket_for(5) == 8
+    assert eng._bucket_for(8) == 8
+    assert eng._bucket_for(9) == 32
+    assert eng._bucket_for(250) == 256
+    with pytest.raises(ValueError):
+        eng._bucket_for(257)
+    # Long and short prompts produce consistent greedy output regardless of
+    # padding bucket.
+    p = [7] * 20  # bucket 32
+    out = eng.generate([p], GenerationConfig(max_new_tokens=3))
+    eng2 = InferenceEngine(params, cfg, max_batch=1, max_len=256,
+                           prefill_buckets=(64, 256))
+    out2 = eng2.generate([p], GenerationConfig(max_new_tokens=3))
+    assert out[0] == out2[0]
+
+
+def test_sampling_ops():
+    key = jax.random.PRNGKey(0)
+    logits = jnp.asarray([[1.0, 5.0, 2.0, 0.5]])
+    # Greedy
+    assert int(sample_token(logits, key)[0]) == 1
+    # top_k=1 equals greedy even at high temperature
+    assert int(sample_token(logits, key, temperature=5.0, top_k=1)[0]) == 1
+    # top_p tiny keeps only the best token
+    assert int(sample_token(logits, key, temperature=1.0, top_p=0.01)[0]) == 1
+    # temperature sampling stays within the vocab and varies with key
+    toks = {int(sample_token(logits, jax.random.PRNGKey(i),
+                             temperature=2.0)[0]) for i in range(20)}
+    assert toks.issubset({0, 1, 2, 3}) and len(toks) > 1
+
+
+def test_llm_serve_deployment(ray_start_regular, tiny):
+    """End-to-end: LLM deployment behind serve with concurrent requests."""
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.serve.llm import llm_deployment
+
+    cfg, params = tiny
+
+    def build():
+        return InferenceEngine(params, cfg, max_batch=2, max_len=64)
+
+    app = llm_deployment(build, default_config={"max_new_tokens": 4})
+    handle = serve.run(app, name="llm-app")
+    try:
+        refs = [handle.generate.remote([i + 1, i + 2]) for i in range(4)]
+        outs = [r.result(timeout_s=120) for r in refs]
+        assert all(len(o) == 4 for o in outs)
+        # Deterministic greedy: same prompt -> same output.
+        again = handle.generate.remote([1, 2]).result(timeout_s=120)
+        assert again == outs[0]
+    finally:
+        serve.shutdown()

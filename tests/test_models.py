@@ -1,0 +1,166 @@
+"""Model-family tests: Mixtral MoE (dense + expert-parallel) and ViT,
+plus train-step integration on the 8-device CPU mesh."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.models import llama, mixtral, vit
+from ray_tpu.parallel.mesh import MeshConfig, build_mesh
+from ray_tpu.parallel.sharding import LogicalAxisRules, logical_sharding
+from ray_tpu.train.step import init_train_state, make_train_step
+
+
+def _f32(cfg_cls, **kw):
+    base = cfg_cls.tiny()
+    return cfg_cls(**{**base.__dict__, "dtype": jnp.float32,
+                      "remat": False, **kw})
+
+
+# ------------------------------------------------------------------ Mixtral
+
+
+@pytest.fixture(scope="module")
+def mx():
+    cfg = _f32(mixtral.MixtralConfig)
+    return cfg, mixtral.init(cfg, jax.random.PRNGKey(0))
+
+
+def test_mixtral_forward_shapes(mx):
+    cfg, params = mx
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                              cfg.vocab_size)
+    logits, aux = mixtral.forward(params, toks, cfg)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+    assert np.isfinite(float(aux)) and float(aux) > 0
+
+
+def test_mixtral_loss_decreases(mx):
+    cfg, params = mx
+    import optax
+
+    toks = jax.random.randint(jax.random.PRNGKey(2), (4, 17), 0,
+                              cfg.vocab_size)
+    batch = {"inputs": toks[:, :-1], "targets": toks[:, 1:]}
+    loss = partial(mixtral.loss_fn, config=cfg)
+    opt = optax.adam(1e-2)
+    opt_state = opt.init(params)
+    l0 = float(loss(params, batch))
+
+    @jax.jit
+    def step(params, opt_state):
+        l, g = jax.value_and_grad(loss)(params, batch)
+        u, opt_state = opt.update(g, opt_state, params)
+        return optax.apply_updates(params, u), opt_state, l
+
+    for _ in range(8):
+        params, opt_state, l = step(params, opt_state)
+    assert float(l) < l0
+
+
+def test_mixtral_ep_sharded_matches_dense(mx):
+    """Expert-parallel execution must agree with single-device routing.
+
+    Capacity is computed over LOCAL tokens in the sharded path vs global in
+    the dense path, so token-dropping can legitimately differ at tight
+    capacity — parity is asserted at ample capacity where nothing drops."""
+    cfg, params = mx
+    cfg = mixtral.MixtralConfig(**{**cfg.__dict__, "capacity_factor": 8.0})
+    toks = jax.random.randint(jax.random.PRNGKey(3), (2, 16), 0,
+                              cfg.vocab_size)
+    dense_logits, dense_aux = mixtral.forward(params, toks, cfg)
+
+    mesh = build_mesh(MeshConfig(ep=4))
+    sharded = jax.jit(
+        partial(mixtral.forward, config=cfg, mesh=mesh))(params, toks)
+    np.testing.assert_allclose(np.asarray(sharded[0]),
+                               np.asarray(dense_logits),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_mixtral_train_step_on_mesh(mx):
+    cfg, _ = mx
+    import optax
+
+    mesh = build_mesh(MeshConfig(dp=2, ep=4))
+    rules = LogicalAxisRules()
+    opt = optax.adamw(1e-3)
+    state, shardings = init_train_state(
+        partial(mixtral.init, cfg), opt, mixtral.param_logical_axes(cfg),
+        mesh, jax.random.PRNGKey(0), rules)
+    bs = logical_sharding(mesh, ("batch", "seq"), rules)
+    step = make_train_step(
+        partial(mixtral.loss_fn, config=cfg, mesh=mesh, rules=rules),
+        opt, shardings, batch_sharding={"inputs": bs, "targets": bs})
+    t = jax.random.randint(jax.random.PRNGKey(2), (4, 33), 0, cfg.vocab_size)
+    batch = {"inputs": jax.device_put(t[:, :-1], bs),
+             "targets": jax.device_put(t[:, 1:], bs)}
+    state, m = step(state, batch)
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_mixtral_param_count():
+    cfg = _f32(mixtral.MixtralConfig)
+    params = mixtral.init(cfg, jax.random.PRNGKey(0))
+    actual = sum(x.size for x in jax.tree.leaves(params))
+    assert actual == cfg.num_params()
+
+
+# ---------------------------------------------------------------------- ViT
+
+
+def test_vit_forward_and_loss():
+    cfg = vit.ViTConfig.tiny()
+    params = vit.init(cfg, jax.random.PRNGKey(0))
+    images = jax.random.normal(jax.random.PRNGKey(1), (2, 32, 32, 3))
+    logits = vit.forward(params, images, cfg)
+    assert logits.shape == (2, 10)
+    labels = jnp.asarray([1, 7])
+    loss = vit.loss_fn(params, {"images": images, "labels": labels}, cfg)
+    assert np.isfinite(float(loss))
+
+
+def test_vit_param_count():
+    cfg = vit.ViTConfig.tiny()
+    params = vit.init(cfg, jax.random.PRNGKey(0))
+    actual = sum(x.size for x in jax.tree.leaves(params))
+    assert actual == cfg.num_params()
+
+
+def test_vit_patchify_roundtrip():
+    cfg = vit.ViTConfig.tiny()
+    images = jnp.arange(2 * 32 * 32 * 3, dtype=jnp.float32).reshape(
+        2, 32, 32, 3)
+    patches = vit.patchify(images, cfg)
+    assert patches.shape == (2, cfg.n_patches, cfg.patch_size ** 2 * 3)
+    # First patch equals the top-left 8x8 block, row-major.
+    expect = images[0, :8, :8, :].reshape(-1)
+    np.testing.assert_array_equal(np.asarray(patches[0, 0]),
+                                  np.asarray(expect))
+
+
+def test_vit_trains_on_mesh():
+    import optax
+
+    cfg = vit.ViTConfig.tiny()
+    mesh = build_mesh(MeshConfig(dp=8))
+    rules = LogicalAxisRules()
+    opt = optax.adamw(1e-3)
+    state, shardings = init_train_state(
+        partial(vit.init, cfg), opt, vit.param_logical_axes(cfg),
+        mesh, jax.random.PRNGKey(0), rules)
+    bs = logical_sharding(mesh, ("batch",), rules)
+    ls = logical_sharding(mesh, ("batch",), rules)
+    step = make_train_step(
+        partial(vit.loss_fn, config=cfg), opt, shardings,
+        batch_sharding={"images": bs, "labels": ls})
+    images = jax.random.normal(jax.random.PRNGKey(1), (8, 32, 32, 3))
+    labels = jax.random.randint(jax.random.PRNGKey(2), (8,), 0, 10)
+    batch = {"images": jax.device_put(images, bs),
+             "labels": jax.device_put(labels, ls)}
+    state, m = step(state, batch)
+    assert np.isfinite(float(m["loss"]))
