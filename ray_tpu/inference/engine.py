@@ -125,17 +125,23 @@ class InferenceEngine:
 
     def _admit(self, prompt: List[int], gen: GenerationConfig) -> Tuple[int, int]:
         """Prefill a prompt into a free slot; returns (slot, first_token)."""
-        slot = self.free_slots.pop()
         n = len(prompt)
-        bucket = self._bucket_for(n)
-        toks = np.zeros((1, bucket), dtype=np.int32)
-        toks[0, :n] = prompt
-        self.cache, last_logits = self._prefill(
-            self.params, self.cache, jnp.asarray(toks), slot, n)
-        self._key, sub = jax.random.split(self._key)
-        first = int(sample_token(last_logits[None, :], sub,
-                                 temperature=gen.temperature,
-                                 top_k=gen.top_k, top_p=gen.top_p)[0])
+        if n == 0:
+            raise ValueError("cannot generate from an empty prompt")
+        bucket = self._bucket_for(n)  # validate BEFORE claiming a slot
+        slot = self.free_slots.pop()
+        try:
+            toks = np.zeros((1, bucket), dtype=np.int32)
+            toks[0, :n] = prompt
+            self.cache, last_logits = self._prefill(
+                self.params, self.cache, jnp.asarray(toks), slot, n)
+            self._key, sub = jax.random.split(self._key)
+            first = int(sample_token(last_logits[None, :], sub,
+                                     temperature=gen.temperature,
+                                     top_k=gen.top_k, top_p=gen.top_p)[0])
+        except Exception:
+            self.free_slots.append(slot)
+            raise
         self.lengths[slot] = n
         return slot, first
 
@@ -153,6 +159,13 @@ class InferenceEngine:
         """Continuous-batching generation. Yields (request_index, token_id)
         as tokens are produced; requests are admitted as slots free up."""
         gen = gen or GenerationConfig()
+        if not self.free_slots:
+            # All slots are occupied — only possible when a previous
+            # generate_stream iterator was abandoned mid-stream; refuse
+            # rather than silently serving nothing.
+            raise RuntimeError(
+                "no free engine slots (an earlier generate_stream was "
+                "abandoned mid-stream?); create a fresh engine")
         pending = list(enumerate(prompts))[::-1]  # stack of (req_idx, prompt)
         active: Dict[int, dict] = {}  # slot -> {req, produced, current}
 

@@ -39,6 +39,13 @@ class LlamaConfig:
     max_seq_len: int = 8192
     dtype: Any = jnp.bfloat16
     remat: bool = True
+    # "full" recomputes everything; "dots" saves matmul outputs and
+    # recomputes only cheap elementwise ops (~6% faster at 500M/1-chip,
+    # still fits long-seq activations in HBM).
+    remat_policy: str = "dots"
+    # >0: compute the training CE over sequence chunks of this size so the
+    # full [B,S,V] fp32 logits tensor never materializes (chunked_ce).
+    loss_chunk_size: int = 0
     use_ring_attention: bool = False  # set when mesh sp-axis > 1
 
     @staticmethod
@@ -132,6 +139,14 @@ def init(config: LlamaConfig, key) -> Dict[str, Any]:
     }
 
 
+def _remat_policy(config):
+    """Map config.remat_policy to a jax.checkpoint policy (None = full)."""
+    name = getattr(config, "remat_policy", "full")
+    if name == "dots":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    return None
+
+
 def _rms_norm(x, weight, eps):
     dtype = x.dtype
     x = x.astype(jnp.float32)
@@ -205,12 +220,11 @@ def _attn_sublayer(x, params, positions, config: LlamaConfig, mesh=None,
     return lc(x, ("batch", "seq", "act_embed")), new_cache
 
 
-def _layer(x, params, positions, config: LlamaConfig, mesh=None,
-           rules: Optional[LogicalAxisRules] = None):
+def _mlp_sublayer(x, params, config: LlamaConfig, mesh=None,
+                  rules: Optional[LogicalAxisRules] = None):
+    """Pre-norm SwiGLU MLP block shared by training and decode paths."""
     c = config
     lc = partial(with_logical_constraint, mesh=mesh, rules=rules)
-    x, _ = _attn_sublayer(x, params, positions, c, mesh, rules)
-
     h = _rms_norm(x, params["mlp_norm"], c.norm_eps)
     gate = jnp.einsum("bsd,df->bsf", h, params["w_gate"])
     up = jnp.einsum("bsd,df->bsf", h, params["w_up"])
@@ -220,9 +234,15 @@ def _layer(x, params, positions, config: LlamaConfig, mesh=None,
     return lc(x, ("batch", "seq", "act_embed"))
 
 
-def forward(params, tokens, config: LlamaConfig, mesh=None,
-            rules: Optional[LogicalAxisRules] = None):
-    """tokens: [B, S] int32 -> logits [B, S, vocab] (cast to fp32)."""
+def _layer(x, params, positions, config: LlamaConfig, mesh=None,
+           rules: Optional[LogicalAxisRules] = None):
+    x, _ = _attn_sublayer(x, params, positions, config, mesh, rules)
+    return _mlp_sublayer(x, params, config, mesh, rules)
+
+
+def forward_hidden(params, tokens, config: LlamaConfig, mesh=None,
+                   rules: Optional[LogicalAxisRules] = None):
+    """tokens [B,S] -> final-norm hidden states [B,S,D] (pre-lm_head)."""
     c = config
     lc = partial(with_logical_constraint, mesh=mesh, rules=rules)
     b, s = tokens.shape
@@ -233,16 +253,52 @@ def forward(params, tokens, config: LlamaConfig, mesh=None,
     layer_fn = partial(_layer, positions=positions, config=c, mesh=mesh,
                        rules=rules)
     if c.remat:
-        layer_fn = jax.checkpoint(layer_fn)
+        layer_fn = jax.checkpoint(layer_fn, policy=_remat_policy(c))
 
     def scan_body(x, layer_p):
         return layer_fn(x, layer_p), None
 
     x, _ = jax.lax.scan(scan_body, x, params["layers"])
-    x = _rms_norm(x, params["final_norm"], c.norm_eps)
+    return _rms_norm(x, params["final_norm"], c.norm_eps)
+
+
+def forward(params, tokens, config: LlamaConfig, mesh=None,
+            rules: Optional[LogicalAxisRules] = None):
+    """tokens: [B, S] int32 -> logits [B, S, vocab] (cast to fp32)."""
+    lc = partial(with_logical_constraint, mesh=mesh, rules=rules)
+    x = forward_hidden(params, tokens, config, mesh, rules)
     logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"])
     logits = lc(logits, ("batch", "seq", "act_vocab"))
     return logits.astype(jnp.float32)
+
+
+def chunked_ce(hidden, lm_head, targets, mask=None, chunk: int = 256):
+    """Cross-entropy without materializing full [B,S,V] fp32 logits: the
+    sequence is scanned in chunks and each chunk's logits are rematerialized
+    in the backward pass. At V=32k, S=2048 this cuts peak HBM by ~4 GB per
+    8 rows — the difference between batch 8 and 16+ on one v5e chip."""
+    b, s, d = hidden.shape
+    n = s // chunk
+    rem = s - n * chunk
+
+    def body(carry, xs):
+        h_ck, t_ck, m_ck = xs
+        logits = (h_ck @ lm_head).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, t_ck[..., None], axis=-1)[..., 0]
+        return carry + jnp.sum(nll * m_ck), None
+
+    if mask is None:
+        mask = jnp.ones((b, s), jnp.float32)
+    h_main = hidden[:, :n * chunk].reshape(b, n, chunk, d).transpose(1, 0, 2, 3)
+    t_main = targets[:, :n * chunk].reshape(b, n, chunk).transpose(1, 0, 2)
+    m_main = mask[:, :n * chunk].reshape(b, n, chunk).transpose(1, 0, 2)
+    total, _ = jax.lax.scan(jax.checkpoint(body), jnp.float32(0.0),
+                            (h_main, t_main, m_main))
+    if rem:
+        total, _ = body(total, (hidden[:, n * chunk:], targets[:, n * chunk:],
+                                mask[:, n * chunk:]))
+    return total / jnp.maximum(jnp.sum(mask), 1.0)
 
 
 def init_kv_cache(config: LlamaConfig, batch: int, max_len: int,
@@ -303,11 +359,7 @@ def forward_with_cache(params, tokens, cache, lengths, config: LlamaConfig):
         x, (k_cache, v_cache) = _attn_sublayer(
             x, layer_p, positions, c, kv_cache=(k_cache, v_cache),
             lengths=lengths)
-        hh = _rms_norm(x, layer_p["mlp_norm"], c.norm_eps)
-        gate = jnp.einsum("bsd,df->bsf", hh, layer_p["w_gate"])
-        up = jnp.einsum("bsd,df->bsf", hh, layer_p["w_up"])
-        ff = jax.nn.silu(gate) * up
-        x = x + jnp.einsum("bsf,fd->bsd", ff, layer_p["w_down"])
+        x = _mlp_sublayer(x, layer_p, c)
         return x, (k_cache, v_cache)
 
     x, (new_k, new_v) = jax.lax.scan(
@@ -320,7 +372,9 @@ def forward_with_cache(params, tokens, cache, lengths, config: LlamaConfig):
 def loss_fn(params, batch, config: LlamaConfig, mesh=None,
             rules: Optional[LogicalAxisRules] = None):
     """Next-token cross-entropy. batch: {"tokens": [B, S]} (targets are the
-    shifted tokens) or explicit {"inputs", "targets", "mask"}."""
+    shifted tokens) or explicit {"inputs", "targets", "mask"}.
+    With config.loss_chunk_size > 0 the CE is computed chunk-by-chunk over
+    the sequence (see chunked_ce) so full-vocab logits never materialize."""
     if "inputs" in batch:
         inputs, targets = batch["inputs"], batch["targets"]
         mask = batch.get("mask")
@@ -328,6 +382,10 @@ def loss_fn(params, batch, config: LlamaConfig, mesh=None,
         tokens = batch["tokens"]
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
         mask = None
+    if config.loss_chunk_size:
+        hidden = forward_hidden(params, inputs, config, mesh, rules)
+        return chunked_ce(hidden, params["lm_head"], targets, mask,
+                          chunk=config.loss_chunk_size)
     logits = forward(params, inputs, config, mesh, rules)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]

@@ -20,7 +20,8 @@ import jax
 import jax.numpy as jnp
 
 from ray_tpu.models import llama
-from ray_tpu.models.llama import LlamaConfig, _rms_norm, _rope
+from ray_tpu.models.llama import (LlamaConfig, _remat_policy, _rms_norm,
+                                  _rope)
 from ray_tpu.parallel.moe import moe_layer, moe_shard_map
 from ray_tpu.parallel.sharding import LogicalAxisRules
 
@@ -134,7 +135,7 @@ def forward(params, tokens, config: MixtralConfig, mesh=None,
         return x + moe_out, aux
 
     if c.remat:
-        layer_fn = jax.checkpoint(layer_fn)
+        layer_fn = jax.checkpoint(layer_fn, policy=_remat_policy(c))
 
     def scan_body(x, layer_p):
         x, aux = layer_fn(x, layer_p)
@@ -152,13 +153,19 @@ def loss_fn(params, batch, config: MixtralConfig, mesh=None,
     Scalar return (make_train_step contract, train/step.py:100)."""
     if "inputs" in batch:
         inputs, targets = batch["inputs"], batch["targets"]
+        mask = batch.get("mask")
     else:
         tokens = batch["tokens"]
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        mask = None
     logits, aux = forward(params, inputs, config, mesh, rules)
     logp = jax.nn.log_softmax(logits, axis=-1)
     ce = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(ce) + config.aux_loss_coef * aux
+    if mask is not None:
+        ce_mean = jnp.sum(ce * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    else:
+        ce_mean = jnp.mean(ce)
+    return ce_mean + config.aux_loss_coef * aux
 
 
 def flops_per_token(config: MixtralConfig, seq_len: int) -> float:

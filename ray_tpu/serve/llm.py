@@ -15,7 +15,6 @@ import queue
 import threading
 from typing import Any, Dict, List, Optional
 
-from ray_tpu import serve
 from ray_tpu.inference import GenerationConfig, InferenceEngine
 
 

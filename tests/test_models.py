@@ -164,3 +164,25 @@ def test_vit_trains_on_mesh():
              "labels": jax.device_put(labels, ls)}
     state, m = step(state, batch)
     assert np.isfinite(float(m["loss"]))
+
+
+def test_llama_chunked_ce_matches_plain():
+    """chunked_ce must equal the full-logits CE exactly (incl. masks and a
+    sequence length not divisible by the chunk)."""
+    cfg = _f32(llama.LlamaConfig)
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(5), (2, 30), 0,
+                              cfg.vocab_size)
+    mask = (jax.random.uniform(jax.random.PRNGKey(6), (2, 29)) > 0.3)
+    batch = {"inputs": toks[:, :-1], "targets": toks[:, 1:],
+             "mask": mask.astype(jnp.float32)}
+    plain = float(llama.loss_fn(params, batch, cfg))
+    ccfg = llama.LlamaConfig(**{**cfg.__dict__, "loss_chunk_size": 8})
+    chunked = float(llama.loss_fn(params, batch, ccfg))
+    assert abs(plain - chunked) < 1e-5
+    # Gradients agree too.
+    g1 = jax.grad(lambda p: llama.loss_fn(p, batch, cfg))(params)
+    g2 = jax.grad(lambda p: llama.loss_fn(p, batch, ccfg))(params)
+    np.testing.assert_allclose(np.asarray(g1["lm_head"]),
+                               np.asarray(g2["lm_head"]),
+                               rtol=1e-4, atol=1e-6)
