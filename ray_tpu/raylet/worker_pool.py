@@ -103,7 +103,11 @@ class WorkerPool:
             # Leases whose task demands a `TPU` resource get a dedicated
             # worker spawned with the accelerator env preserved.
             env.pop("PALLAS_AXON_POOL_IPS", None)
-            env.setdefault("JAX_PLATFORMS", "cpu")
+            # Force, don't setdefault: the host env may export
+            # JAX_PLATFORMS=axon (TPU plugin), but we just stripped the
+            # plugin trigger — a worker inheriting 'axon' would die on its
+            # first jax import ("backend 'axon' not in the list").
+            env["JAX_PLATFORMS"] = "cpu"
         env.update(self._extra_env)
         env["RT_SYSTEM_CONFIG"] = CONFIG.serialized_overrides()
         # Keep worker start light: no JAX/accelerator init at import time.

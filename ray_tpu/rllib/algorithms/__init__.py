@@ -1,4 +1,7 @@
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.sac import SAC, SACConfig  # noqa: F401
 
-__all__ = ["DQN", "DQNConfig", "PPO", "PPOConfig"]
+__all__ = ["DQN", "DQNConfig", "IMPALA", "IMPALAConfig", "PPO", "PPOConfig",
+           "SAC", "SACConfig"]

@@ -1,0 +1,233 @@
+"""IMPALA — asynchronous actor-learner with V-trace off-policy correction
+(reference: ray rllib/algorithms/impala/impala.py:679 — EnvRunner actors
+sample continuously; the learner consumes whatever batches are ready and
+broadcasts weights periodically, so sampling never blocks on learning).
+
+V-trace (Espeholt et al. 2018) runs as a lax.scan over the reversed
+trajectory inside the jitted update — the whole correction + policy-gradient
++ value + entropy update is one XLA program.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.algorithm import Algorithm
+from ray_tpu.rllib.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.env_runner import EnvRunner, EnvRunnerGroup
+
+
+class IMPALAConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(algo_class=IMPALA)
+        self.lr = 5e-4
+        self.rollout_fragment_length = 50
+        self.num_env_runners = 2
+        self.vtrace_clip_rho_threshold = 1.0
+        self.vtrace_clip_c_threshold = 1.0
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.broadcast_interval = 1   # learner steps between weight pushes
+        self.max_requests_in_flight_per_env_runner = 2
+        self.normalize_advantages = True
+
+
+def make_vtrace_update(module, optimizer, config: Dict[str, Any]):
+    """-> jitted update(params, opt_state, batch) for [B, T] trajectories."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    gamma = config.get("gamma", 0.99)
+    rho_bar = config.get("vtrace_clip_rho_threshold", 1.0)
+    c_bar = config.get("vtrace_clip_c_threshold", 1.0)
+    vf_coeff = config.get("vf_loss_coeff", 0.5)
+    ent_coeff = config.get("entropy_coeff", 0.01)
+    normalize_adv = config.get("normalize_advantages", True)
+
+    def loss_fn(params, batch):
+        # batch arrays are [B, T] (+ trailing dims); flatten for the module.
+        b, t = batch["actions"].shape
+        obs = batch["obs"].reshape(b * t, -1)
+        out = module.forward_train(
+            params, {"obs": obs, "actions": batch["actions"].reshape(-1)})
+        logp = out["logp"].reshape(b, t)
+        values = out["vf_preds"].reshape(b, t)
+        entropy = out["entropy"].reshape(b, t)
+        mask = batch["mask"]  # 1 = real transition, 0 = shape padding
+        denom = jnp.maximum(jnp.sum(mask), 1.0)
+        behaviour_logp = batch["logp"]
+        rhos = jnp.exp(logp - behaviour_logp)
+        clipped_rho = jnp.minimum(rho_bar, rhos)
+        clipped_c = jnp.minimum(c_bar, rhos)
+        discounts = gamma * (1.0 - batch["terminateds"])
+        bootstrap = batch["bootstrap_value"]  # [B]
+
+        values_t_plus_1 = jnp.concatenate(
+            [values[:, 1:], bootstrap[:, None]], axis=1)
+        deltas = clipped_rho * (
+            batch["rewards"] + discounts * values_t_plus_1 - values)
+
+        # vs_t = V(x_t) + sum_{k>=t} gamma^{k-t} (prod c) delta_k — reverse scan.
+        def backward(acc, xs):
+            delta_t, disc_t, c_t = xs
+            acc = delta_t + disc_t * c_t * acc
+            return acc, acc
+
+        _, vs_minus_v = jax.lax.scan(
+            backward, jnp.zeros_like(bootstrap),
+            (deltas.T[::-1], discounts.T[::-1], clipped_c.T[::-1]))
+        vs = values + vs_minus_v[::-1].T
+
+        vs_t_plus_1 = jnp.concatenate([vs[:, 1:], bootstrap[:, None]], axis=1)
+        pg_adv = jax.lax.stop_gradient(
+            clipped_rho * (batch["rewards"] + discounts * vs_t_plus_1
+                           - values))
+        if normalize_adv:
+            adv_mean = jnp.sum(pg_adv * mask) / denom
+            adv_var = jnp.sum(mask * (pg_adv - adv_mean) ** 2) / denom
+            pg_adv = (pg_adv - adv_mean) * jax.lax.rsqrt(adv_var + 1e-8)
+        pg_loss = -jnp.sum(logp * pg_adv * mask) / denom
+        vf_loss = 0.5 * jnp.sum(
+            mask * (values - jax.lax.stop_gradient(vs)) ** 2) / denom
+        ent = jnp.sum(entropy * mask) / denom
+        total = pg_loss + vf_coeff * vf_loss - ent_coeff * ent
+        return total, {"pg_loss": pg_loss, "vf_loss": vf_loss,
+                       "entropy": ent,
+                       "mean_rho": jnp.sum(rhos * mask) / denom}
+
+    def update(params, opt_state, batch):
+        (loss, aux), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, batch)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        aux["total_loss"] = loss
+        return params, opt_state, aux
+
+    return jax.jit(update, donate_argnums=(1,))
+
+
+class IMPALA(Algorithm):
+    def setup(self, config: AlgorithmConfig) -> None:
+        import jax
+        import optax
+
+        from ray_tpu.rllib.rl_module import DiscreteActorCriticModule
+
+        obs_dim, num_actions = self._env_spaces(config.env, config.env_config)
+        self.module_spec = {
+            "obs_dim": obs_dim, "num_actions": num_actions,
+            "hiddens": tuple(config.model.get("fcnet_hiddens", (64, 64))),
+        }
+        self.module = DiscreteActorCriticModule(
+            obs_dim, num_actions, self.module_spec["hiddens"])
+        self.params = self.module.init(jax.random.PRNGKey(config.seed or 0))
+        self.optimizer = optax.adam(config.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self._update = make_vtrace_update(
+            self.module, self.optimizer, config.to_dict())
+        self._value_fn = jax.jit(
+            lambda p, o: self.module.forward(p, o)[1])
+
+        cfg = config.to_dict()
+        self.runner_group = EnvRunnerGroup(cfg, self.module_spec)
+        self.runner_group.sync_weights(self.params)
+        self._inflight: Dict[Any, Any] = {}  # ref -> runner handle
+        # Async pipeline: keep N sample requests in flight per runner.
+        if self.runner_group.remotes:
+            per = config.max_requests_in_flight_per_env_runner
+            for w in self.runner_group.remotes:
+                for _ in range(per):
+                    self._inflight[w.sample.remote(
+                        num_steps=config.rollout_fragment_length)] = w
+        self._steps_since_broadcast = 0
+
+    def _episodes_to_batch(self, episodes) -> Dict[str, np.ndarray]:
+        """Pack fragments densely: concatenate every fragment into one
+        stream, then chop into rows of exactly T=rollout_fragment_length
+        ([B_bucket, T], B padded to a bucket of 4 with masked dead rows).
+
+        Every fragment ends with terminateds=1: terminated episodes as-is,
+        truncated ones with the bootstrap folded into the last reward
+        (r += gamma*V(boundary_obs)). The discount therefore cuts at every
+        fragment boundary, so v-trace targets never cross rows and rows may
+        split the stream anywhere — no per-episode padding (the old
+        per-episode layout was ~75% padding on short-episode envs)."""
+        t_len = self.config.rollout_fragment_length
+        stream = {k: [] for k in
+                  ("obs", "actions", "rewards", "logp", "terminateds")}
+        for ep in episodes:
+            rews = np.asarray(ep.rewards, np.float32).copy()
+            terms = np.zeros(len(ep), np.float32)
+            terms[-1] = 1.0
+            if not ep.is_done:
+                last_obs = np.asarray(ep.obs[-1], np.float32)
+                rews[-1] += self.config.gamma * float(self._value_fn(
+                    self.params, last_obs[None, :])[0])
+            stream["obs"].append(np.asarray(ep.obs[:-1], np.float32))
+            stream["actions"].append(np.asarray(ep.actions, np.int64))
+            stream["rewards"].append(rews)
+            stream["logp"].append(
+                np.asarray(ep.extra.get("logp"), np.float32))
+            stream["terminateds"].append(terms)
+        flat = {k: np.concatenate(v) for k, v in stream.items()}
+        n = len(flat["actions"])
+        mask = np.ones(n, np.float32)
+        pad = (-n) % t_len
+        if pad:
+            flat = {k: np.concatenate(
+                [v, np.repeat(v[-1:], pad, axis=0)]) for k, v in flat.items()}
+            flat["rewards"][n:] = 0
+            flat["terminateds"][n:] = 1
+            mask = np.concatenate([mask, np.zeros(pad, np.float32)])
+        b = (n + pad) // t_len
+        b_bucket = ((b + 3) // 4) * 4
+        batch = {}
+        for k, v in flat.items():
+            v = v.reshape((b, t_len) + v.shape[1:])
+            dead = np.zeros(((b_bucket - b), t_len) + v.shape[2:], v.dtype)
+            if k == "terminateds":
+                dead = dead + 1
+            batch[k] = np.concatenate([v, dead])
+        m = mask.reshape(b, t_len)
+        batch["mask"] = np.concatenate(
+            [m, np.zeros((b_bucket - b, t_len), np.float32)])
+        batch["bootstrap_value"] = np.zeros(b_bucket, np.float32)
+        return batch
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        if not self.runner_group.remotes:
+            # Synchronous fallback (num_env_runners=0): sample inline.
+            episodes = self.runner_group.sample(
+                num_steps=cfg.rollout_fragment_length)
+        else:
+            ready, _ = ray_tpu.wait(
+                list(self._inflight), num_returns=1, timeout=60)
+            episodes = []
+            for ref in ready:
+                runner = self._inflight.pop(ref)
+                episodes.extend(ray_tpu.get(ref))
+                # immediately re-arm the runner (async pipeline)
+                self._inflight[runner.sample.remote(
+                    num_steps=cfg.rollout_fragment_length)] = runner
+        if not episodes:
+            # Runners stalled (worker spawn / first-compile); retry next step.
+            return {"num_episodes": 0}
+        self._record_episodes(episodes)
+        batch = self._episodes_to_batch(episodes)
+        self.params, self.opt_state, aux = self._update(
+            self.params, self.opt_state, batch)
+        self._steps_since_broadcast += 1
+        if self._steps_since_broadcast >= cfg.broadcast_interval:
+            self.runner_group.sync_weights(self.params)
+            self._steps_since_broadcast = 0
+        out = {k: float(v) for k, v in aux.items()}
+        out["num_episodes"] = len(episodes)
+        return out
+
+    def stop(self) -> None:
+        self.runner_group.stop()

@@ -1,0 +1,198 @@
+"""SAC — continuous control (reference: ray rllib/algorithms/sac/ —
+squashed-Gaussian actor, twin Q critics with target networks, entropy
+temperature alpha auto-tuned to a target entropy).
+
+The actor/critic/alpha updates run as ONE jitted step per gradient update
+(no host roundtrips between the three optimizers); target networks use
+polyak averaging inside the same program.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm
+from ray_tpu.rllib.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.replay_buffer import ReplayBuffer
+
+
+class SACConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(algo_class=SAC)
+        self.lr = 3e-4
+        self.train_batch_size = 256
+        self.num_steps_per_iteration = 1000
+        self.tau = 0.005                      # polyak target rate
+        self.initial_alpha = 1.0
+        self.target_entropy = "auto"          # -act_dim
+        self.num_steps_sampled_before_learning_starts = 1500
+        self.model = {"fcnet_hiddens": [256, 256]}
+
+
+class SAC(Algorithm):
+    def setup(self, config: AlgorithmConfig) -> None:
+        import gymnasium as gym
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from ray_tpu.rllib.rl_module import (
+            ContinuousQModule,
+            GaussianActorModule,
+        )
+
+        env = gym.make(config.env, **(config.env_config or {}))
+        obs_dim = int(env.observation_space.shape[0])
+        act_dim = int(env.action_space.shape[0])
+        self._act_low = np.asarray(env.action_space.low, np.float32)
+        self._act_high = np.asarray(env.action_space.high, np.float32)
+        self.env = env
+        hid = tuple(config.model.get("fcnet_hiddens", (256, 256)))
+        self.actor = GaussianActorModule(obs_dim, act_dim, hid)
+        self.q1 = ContinuousQModule(obs_dim, act_dim, hid)
+        self.q2 = ContinuousQModule(obs_dim, act_dim, hid)
+
+        key = jax.random.PRNGKey(config.seed or 0)
+        ka, k1, k2 = jax.random.split(key, 3)
+        self.params = {
+            "actor": self.actor.init(ka),
+            "q1": self.q1.init(k1),
+            "q2": self.q2.init(k2),
+            "log_alpha": jnp.asarray(np.log(config.initial_alpha),
+                                     jnp.float32),
+        }
+        self.target = {"q1": self.params["q1"], "q2": self.params["q2"]}
+        self.opt = optax.adam(config.lr)
+        self.opt_state = self.opt.init(self.params)
+        target_entropy = (-float(act_dim)
+                          if config.target_entropy == "auto"
+                          else float(config.target_entropy))
+        gamma, tau = config.gamma, config.tau
+        actor, q1m, q2m = self.actor, self.q1, self.q2
+
+        def losses(params, target, batch, key):
+            obs, act = batch["obs"], batch["actions"]
+            next_obs = batch["next_obs"]
+            alpha = jnp.exp(params["log_alpha"])
+
+            # critic targets from the CURRENT policy at next_obs
+            next_act, next_logp = actor.sample(params["actor"], next_obs, key)
+            tq = jnp.minimum(
+                q1m.forward(target["q1"], next_obs, next_act),
+                q2m.forward(target["q2"], next_obs, next_act))
+            backup = batch["rewards"] + gamma * (1 - batch["terminateds"]) * (
+                tq - jax.lax.stop_gradient(alpha) * next_logp)
+            backup = jax.lax.stop_gradient(backup)
+            q1_pred = q1m.forward(params["q1"], obs, act)
+            q2_pred = q2m.forward(params["q2"], obs, act)
+            critic_loss = jnp.mean((q1_pred - backup) ** 2) + jnp.mean(
+                (q2_pred - backup) ** 2)
+
+            # actor: maximize Q - alpha * logp (fresh sample, reparam'd)
+            new_act, logp = actor.sample(params["actor"], obs,
+                                         jax.random.fold_in(key, 1))
+            q_new = jnp.minimum(
+                q1m.forward(jax.lax.stop_gradient(params["q1"]), obs, new_act),
+                q2m.forward(jax.lax.stop_gradient(params["q2"]), obs, new_act))
+            actor_loss = jnp.mean(
+                jax.lax.stop_gradient(alpha) * logp - q_new)
+
+            # alpha: drive entropy toward the target
+            alpha_loss = -jnp.mean(
+                params["log_alpha"]
+                * jax.lax.stop_gradient(logp + target_entropy))
+            total = critic_loss + actor_loss + alpha_loss
+            return total, {
+                "critic_loss": critic_loss, "actor_loss": actor_loss,
+                "alpha": alpha, "entropy": -jnp.mean(logp),
+                "qf_mean": jnp.mean(q1_pred),
+            }
+
+        def update(params, opt_state, target, batch, key):
+            (_, aux), grads = jax.value_and_grad(
+                losses, has_aux=True)(params, target, batch, key)
+            updates, opt_state = self.opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            target = jax.tree.map(
+                lambda t, p: (1 - tau) * t + tau * p,
+                target, {"q1": params["q1"], "q2": params["q2"]})
+            return params, opt_state, target, aux
+
+        self._update = jax.jit(update, donate_argnums=(1,))
+        self._sample_act = jax.jit(actor.sample)
+        self._greedy = jax.jit(
+            lambda p, o: actor.forward_inference(p, {"obs": o})["actions"])
+        self._key = jax.random.PRNGKey((config.seed or 0) + 1)
+        self.buffer = ReplayBuffer(
+            capacity=config.replay_buffer_config.get("capacity", 100_000))
+        self._obs, _ = env.reset(seed=config.seed)
+        self._ep_return = 0.0
+        self._rng = np.random.default_rng(config.seed)
+
+    def compute_single_action(self, obs, explore: bool = False):
+        """Deterministic (tanh-mean) or sampled action in ENV units
+        (reference API: Algorithm.compute_single_action)."""
+        import jax
+
+        obs = np.asarray(obs, np.float32)[None, :]
+        if explore:
+            self._key, sub = jax.random.split(self._key)
+            act, _ = self._sample_act(self.params["actor"], obs, sub)
+        else:
+            act = self._greedy(self.params["actor"], obs)
+        return self._env_action(np.asarray(act)[0])
+
+    def _env_action(self, act):
+        return (act * (self._act_high - self._act_low) / 2.0
+                + (self._act_high + self._act_low) / 2.0)
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax
+
+        cfg = self.config
+        metrics: Dict[str, Any] = {}
+        warmup = cfg.num_steps_sampled_before_learning_starts
+        for _ in range(cfg.num_steps_per_iteration):
+            if self._num_env_steps_sampled_lifetime < warmup:
+                act = self._rng.uniform(-1, 1,
+                                        size=self._act_low.shape).astype(
+                                            np.float32)
+            else:
+                self._key, sub = jax.random.split(self._key)
+                a, _ = self._sample_act(
+                    self.params["actor"],
+                    self._obs.astype(np.float32)[None, :], sub)
+                act = np.asarray(a)[0]
+            next_obs, reward, term, trunc, _ = self.env.step(
+                self._env_action(act))
+            self.buffer.add({
+                "obs": self._obs.astype(np.float32),
+                "next_obs": np.asarray(next_obs, np.float32),
+                "actions": act.astype(np.float32),
+                "rewards": np.float32(reward),
+                "terminateds": np.float32(term),
+            })
+            self._num_env_steps_sampled_lifetime += 1
+            self._ep_return += float(reward)
+            if term or trunc:
+                self._episode_returns.append(self._ep_return)
+                self._ep_return = 0.0
+                self._obs, _ = self.env.reset()
+            else:
+                self._obs = next_obs
+
+            if (self._num_env_steps_sampled_lifetime >= warmup
+                    and len(self.buffer) >= cfg.train_batch_size):
+                batch = self.buffer.sample(cfg.train_batch_size)
+                self._key, sub = jax.random.split(self._key)
+                (self.params, self.opt_state, self.target,
+                 aux) = self._update(self.params, self.opt_state,
+                                     self.target, batch, sub)
+                metrics = {k: float(v) for k, v in aux.items()}
+        metrics["buffer_size"] = len(self.buffer)
+        return metrics
+
+    def stop(self) -> None:
+        self.env.close()

@@ -40,11 +40,17 @@ class EnvRunner:
             [partial(make_env, config["env"], config.get("env_config"))
              for _ in range(n_envs)])
         self.n_envs = n_envs
-        from ray_tpu.rllib.rl_module import DiscreteActorCriticModule
+        from ray_tpu.rllib.rl_module import resolve_module
 
-        self.module = DiscreteActorCriticModule(
-            module_spec["obs_dim"], module_spec["num_actions"],
-            module_spec.get("hiddens", (64, 64)))
+        self.module = resolve_module(module_spec)
+        # Continuous (Box) action spaces: module outputs live in [-1,1];
+        # rescale into the env bounds at the boundary.
+        space = self.envs.single_action_space
+        self._act_scale = None
+        if hasattr(space, "low") and hasattr(space, "high"):
+            low = np.asarray(space.low, np.float32)
+            high = np.asarray(space.high, np.float32)
+            self._act_scale = ((high - low) / 2.0, (high + low) / 2.0)
         seed = (config.get("seed") or 0) * 1000 + worker_index
         self._key = jax.random.PRNGKey(seed)
         self.params = None
@@ -61,6 +67,7 @@ class EnvRunner:
             return self.module.forward_inference(params, {"obs": obs})
 
         self._act_greedy = _act_greedy
+        self._pending_env_actions = None  # env-unit actions for this step
         self._obs, _ = self.envs.reset(seed=seed)
         self._episodes = [SingleAgentEpisode() for _ in range(n_envs)]
         for i, ep in enumerate(self._episodes):
@@ -87,9 +94,17 @@ class EnvRunner:
         done_episodes: List[SingleAgentEpisode] = []
         for _ in range(num_steps):
             if random_actions:
-                actions = np.stack([
+                sampled = np.stack([
                     self.envs.single_action_space.sample()
                     for _ in range(self.n_envs)])
+                if self._act_scale is not None:
+                    # Store module-space [-1,1] actions; send env units.
+                    scale, offset = self._act_scale
+                    actions = (sampled - offset) / np.where(scale == 0, 1, scale)
+                    self._pending_env_actions = sampled
+                else:
+                    actions = sampled
+                    self._pending_env_actions = None
                 extra: Dict[str, np.ndarray] = {}
             else:
                 self._key, sub = jax.random.split(self._key)
@@ -103,7 +118,14 @@ class EnvRunner:
                         self.params, self._obs.astype(np.float32))
                     extra = {}
                 actions = np.asarray(out["actions"])
-            next_obs, rewards, terms, truncs, infos = self.envs.step(actions)
+                self._pending_env_actions = None
+            env_actions = actions
+            if self._pending_env_actions is not None:
+                env_actions = self._pending_env_actions
+            elif self._act_scale is not None:
+                scale, offset = self._act_scale
+                env_actions = actions * scale + offset
+            next_obs, rewards, terms, truncs, infos = self.envs.step(env_actions)
             for i in range(self.n_envs):
                 per_step_extra = {k: v[i] for k, v in extra.items()}
                 self._episodes[i].add_env_step(

@@ -103,3 +103,105 @@ class QModule:
         for layer in layers[:-1]:
             x = jnp.tanh(_dense(layer, x))
         return _dense(layers[-1], x)
+
+
+class GaussianActorModule:
+    """Squashed-Gaussian policy for continuous actions (SAC actor;
+    reference: rllib sac policy's tanh-squashed DiagGaussian)."""
+
+    def __init__(self, obs_dim: int, act_dim: int,
+                 hiddens: Sequence[int] = (256, 256),
+                 log_std_bounds=(-10.0, 2.0)):
+        self.obs_dim = obs_dim
+        self.act_dim = act_dim
+        self.hiddens = tuple(hiddens)
+        self.log_std_bounds = log_std_bounds
+
+    def init(self, key) -> Dict[str, Any]:
+        params: Dict[str, Any] = {"torso": []}
+        dims = [self.obs_dim] + list(self.hiddens)
+        keys = jax.random.split(key, len(dims) + 1)
+        for i in range(len(dims) - 1):
+            params["torso"].append(_dense_init(keys[i], dims[i], dims[i + 1]))
+        params["mu"] = _dense_init(keys[-2], dims[-1], self.act_dim, scale=0.01)
+        params["log_std"] = _dense_init(keys[-1], dims[-1], self.act_dim,
+                                        scale=0.01)
+        return params
+
+    def _dist(self, params, obs):
+        x = obs
+        for layer in params["torso"]:
+            x = jax.nn.relu(_dense(layer, x))
+        mu = _dense(params["mu"], x)
+        lo, hi = self.log_std_bounds
+        log_std = jnp.clip(_dense(params["log_std"], x), lo, hi)
+        return mu, log_std
+
+    def sample(self, params, obs, key):
+        """-> (action in [-1,1], log_prob) with tanh squash correction."""
+        mu, log_std = self._dist(params, obs)
+        std = jnp.exp(log_std)
+        eps = jax.random.normal(key, mu.shape)
+        pre = mu + std * eps
+        act = jnp.tanh(pre)
+        logp = jnp.sum(
+            -0.5 * (eps ** 2 + 2 * log_std + jnp.log(2 * jnp.pi))
+            - jnp.log(jnp.clip(1 - act ** 2, 1e-6)), axis=-1)
+        return act, logp
+
+    # RLModule-style API (used by EnvRunner)
+    def forward_exploration(self, params, batch, key):
+        act, logp = self.sample(params, batch["obs"], key)
+        return {"actions": act, "logp": logp,
+                "vf_preds": jnp.zeros(act.shape[0])}
+
+    def forward_inference(self, params, batch):
+        mu, _ = self._dist(params, batch["obs"])
+        return {"actions": jnp.tanh(mu)}
+
+
+class ContinuousQModule:
+    """Q(s, a) head for continuous control (SAC critic)."""
+
+    def __init__(self, obs_dim: int, act_dim: int,
+                 hiddens: Sequence[int] = (256, 256)):
+        self.obs_dim = obs_dim
+        self.act_dim = act_dim
+        self.hiddens = tuple(hiddens)
+
+    def init(self, key) -> Dict[str, Any]:
+        params: Dict[str, Any] = {"layers": []}
+        dims = [self.obs_dim + self.act_dim] + list(self.hiddens) + [1]
+        keys = jax.random.split(key, len(dims))
+        for i in range(len(dims) - 1):
+            params["layers"].append(_dense_init(keys[i], dims[i], dims[i + 1]))
+        return params
+
+    def forward(self, params, obs, act) -> jnp.ndarray:
+        x = jnp.concatenate([obs, act], axis=-1)
+        layers = params["layers"]
+        for layer in layers[:-1]:
+            x = jax.nn.relu(_dense(layer, x))
+        return _dense(layers[-1], x)[..., 0]
+
+
+def resolve_module(module_spec: Dict[str, Any]):
+    """Build the RLModule named by module_spec['module_class'] (defaults to
+    DiscreteActorCriticModule). Accepts a class or "module:ClassName"."""
+    cls = module_spec.get("module_class", DiscreteActorCriticModule)
+    if isinstance(cls, str):
+        import importlib
+
+        mod, _, name = cls.rpartition(":")
+        cls = getattr(importlib.import_module(mod or __name__), name)
+    kwargs = dict(module_spec.get("module_kwargs") or {})
+    if cls is DiscreteActorCriticModule:
+        return cls(module_spec["obs_dim"], module_spec["num_actions"],
+                   module_spec.get("hiddens", (64, 64)))
+    if kwargs:
+        return cls(**kwargs)
+    args = [module_spec["obs_dim"],
+            module_spec.get("num_actions") or module_spec["act_dim"]]
+    if module_spec.get("hiddens"):
+        args.append(tuple(module_spec["hiddens"]))
+    return cls(*args)

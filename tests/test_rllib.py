@@ -175,3 +175,126 @@ def test_ppo_save_restore(tmp_path):
     for a, b in zip(w1, w2):
         np.testing.assert_allclose(a, b, rtol=1e-6)
     algo2.stop()
+
+
+def test_impala_learns_cartpole(ray_start_regular):
+    """IMPALA with async remote env runners improves CartPole return."""
+    from ray_tpu.rllib.algorithms.impala import IMPALAConfig
+
+    config = (IMPALAConfig()
+              .environment("CartPole-v1")
+              .env_runners(num_env_runners=2, num_envs_per_env_runner=4,
+                           rollout_fragment_length=128)
+              .training(lr=1e-3, entropy_coeff=0.0, gamma=0.95)
+              .debugging(seed=0))
+    algo = config.build()
+    try:
+        first, best = None, 0.0
+        for i in range(250):
+            result = algo.train()
+            ret = result.get("episode_return_mean")
+            if ret is not None and first is None:
+                first = ret
+            best = max(best, ret or 0.0)
+            if best > 60.0:
+                break
+        assert best > 60.0, f"best return {best} (first {first})"
+        assert first is None or best > first
+    finally:
+        algo.stop()
+
+
+def test_sac_learns_pendulum():
+    """SAC improves Pendulum return (starts ~-1400, target > -900)."""
+    from ray_tpu.rllib.algorithms.sac import SACConfig
+
+    config = (SACConfig()
+              .environment("Pendulum-v1")
+              .training(lr=1e-3, train_batch_size=256)
+              .debugging(seed=0))
+    config.num_steps_per_iteration = 2000
+    config.num_steps_sampled_before_learning_starts = 1000
+    algo = config.build()
+    try:
+        ret = None
+        for i in range(15):
+            result = algo.train()
+            ret = result.get("episode_return_mean")
+            if ret is not None and ret > -900.0:
+                break
+        assert ret is not None and ret > -900.0, f"final return {ret}"
+    finally:
+        algo.stop()
+
+
+def test_vtrace_reduces_to_gae_like_targets():
+    """With on-policy data (rho=1) V-trace vs equals the discounted return
+    bootstrap (lambda=1 TD), a basic correctness anchor."""
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.rllib.algorithms.impala import make_vtrace_update
+    from ray_tpu.rllib.rl_module import DiscreteActorCriticModule
+
+    module = DiscreteActorCriticModule(3, 2)
+    import jax
+
+    params = module.init(jax.random.PRNGKey(0))
+    opt = optax.sgd(0.0)  # zero LR: we only inspect the loss pipeline
+    update = make_vtrace_update(module, opt, {"gamma": 0.9})
+    B, T = 2, 5
+    obs = np.random.rand(B, T, 3).astype(np.float32)
+    out = module.forward_train(
+        params, {"obs": obs.reshape(B * T, 3),
+                 "actions": np.zeros(B * T, np.int64)})
+    behaviour_logp = np.asarray(out["logp"]).reshape(B, T)
+    batch = {
+        "obs": obs,
+        "actions": np.zeros((B, T), np.int64),
+        "rewards": np.ones((B, T), np.float32),
+        "logp": behaviour_logp,  # on-policy: rhos == 1
+        "terminateds": np.zeros((B, T), np.float32),
+        "mask": np.ones((B, T), np.float32),
+        "bootstrap_value": np.zeros(B, np.float32),
+    }
+    _, _, aux = update(params, opt.init(params), batch)
+    assert abs(float(aux["mean_rho"]) - 1.0) < 1e-4
+    # On-policy with rho=c=1, vs_t equals the lambda=1 discounted return:
+    # verify vf_loss against targets computed independently on the host.
+    values = np.asarray(out["vf_preds"]).reshape(B, T)
+    gamma = 0.9
+    G = np.zeros((B, T), np.float32)
+    acc = np.zeros(B, np.float32)  # bootstrap_value = 0
+    for t in reversed(range(T)):
+        acc = batch["rewards"][:, t] + gamma * acc
+        G[:, t] = acc
+    expect_vf = 0.5 * np.mean((values - G) ** 2)
+    assert abs(float(aux["vf_loss"]) - expect_vf) < 1e-3 * max(1, expect_vf)
+
+
+def test_connector_pipeline():
+    from ray_tpu.rllib.connectors import (
+        ClipRewards,
+        ConnectorPipelineV2,
+        FlattenObservations,
+        NormalizeObservations,
+    )
+
+    pipe = ConnectorPipelineV2([FlattenObservations(),
+                                NormalizeObservations(clip=5.0),
+                                ClipRewards(1.0)])
+    batch = {"obs": np.random.rand(4, 2, 3),
+             "rewards": np.asarray([0.5, -3.0, 2.0, 0.0])}
+    out = pipe(batch)
+    assert out["obs"].shape == (4, 6)
+    assert out["rewards"].max() <= 1.0 and out["rewards"].min() >= -1.0
+    # state roundtrip: a restored pipeline normalizes identically.
+    state = pipe.get_state()
+    pipe2 = ConnectorPipelineV2([FlattenObservations(),
+                                 NormalizeObservations(clip=5.0),
+                                 ClipRewards(1.0)])
+    pipe2.set_state(state)
+    probe = {"obs": np.random.rand(2, 2, 3)}
+    a = pipe(dict(probe), update_stats=False)["obs"]
+    b = pipe2(dict(probe), update_stats=False)["obs"]
+    np.testing.assert_allclose(a, b)
