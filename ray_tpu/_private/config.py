@@ -95,6 +95,9 @@ _d("object_store_fallback_dir", "/tmp/ray_tpu_spill")
 _d("enable_plasma_store", True)                # node-local C++ shm store
 _d("object_spilling_high_watermark", 0.80)     # spill above this fill ratio
 _d("object_spilling_low_watermark", 0.60)      # ...down to this ratio
+_d("memory_usage_threshold", 0.95)             # OOM killer trigger fraction
+_d("memory_monitor_refresh_ms", 500)           # 0 disables the monitor
+_d("worker_killing_policy", "retriable_lifo")  # or "group_by_owner"
 _d("fetch_retry_interval_ms", 100)
 _d("max_lineage_bytes", 64 * 1024**2)
 _d("enable_lineage_reconstruction", True)

@@ -33,7 +33,7 @@ class _HeadNode:
     """In-process head: GCS + head raylet (SURVEY §3.1 process layout)."""
 
     def __init__(self, num_cpus=None, resources=None, _system_config=None,
-                 object_store_memory=None):
+                 object_store_memory=None, include_dashboard=False):
         from ray_tpu.gcs.server import GcsServer
         from ray_tpu.raylet.raylet import Raylet
 
@@ -50,16 +50,25 @@ class _HeadNode:
             is_head=True,
         )
         self.raylet_address = self.raylet.start(0)
+        self.dashboard = None
+        if include_dashboard:
+            from ray_tpu.dashboard import DashboardHead
+
+            self.dashboard = DashboardHead(self.gcs_address, port=0)
 
     def stop(self):
+        if self.dashboard is not None:
+            self.dashboard.stop()
+            self.dashboard = None
         self.raylet.stop(unregister=False)
         self.gcs.stop()
 
 
 class RayContext:
-    def __init__(self, gcs_address: str, node_id, namespace: str):
+    def __init__(self, gcs_address: str, node_id, namespace: str,
+                 dashboard_url=None):
         self.address_info = {"gcs_address": gcs_address, "address": gcs_address}
-        self.dashboard_url = None
+        self.dashboard_url = dashboard_url
         self.node_id = node_id
         self.namespace = namespace
 
@@ -81,6 +90,7 @@ def init(
     namespace: Optional[str] = None,
     object_store_memory: Optional[int] = None,
     ignore_reinit_error: bool = False,
+    include_dashboard: bool = False,
     log_to_driver: bool = True,
     runtime_env: Optional[dict] = None,
     _system_config: Optional[dict] = None,
@@ -105,6 +115,7 @@ def init(
                 num_cpus=num_cpus, resources=resources,
                 _system_config=_system_config,
                 object_store_memory=object_store_memory,
+                include_dashboard=include_dashboard,
             )
             gcs_address = _global_node.gcs_address
             raylet_address = _global_node.raylet_address
@@ -151,7 +162,10 @@ def init(
                              namespace=namespace or "")},
         )
         atexit.register(shutdown)
-        return RayContext(gcs_address, cw.node_id, namespace or "")
+        dash = (_global_node.dashboard.url
+                if _global_node is not None and _global_node.dashboard
+                else None)
+        return RayContext(gcs_address, cw.node_id, namespace or "", dash)
 
 
 def shutdown():

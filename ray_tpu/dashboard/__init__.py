@@ -1,0 +1,1 @@
+from ray_tpu.dashboard.head import DashboardHead  # noqa: F401

@@ -1,0 +1,207 @@
+"""Dashboard head: HTTP state API + Prometheus metrics.
+
+Reference: ray dashboard/head.py (aiohttp app with pluggable modules —
+job/state/reporter/metrics) + the per-node metrics agent's Prometheus
+exposition (_private/metrics_agent.py). This implementation is a stdlib
+threaded HTTP server talking straight to the GCS, so it runs standalone on
+the head node with zero extra dependencies.
+
+Endpoints:
+  GET /                     tiny HTML overview
+  GET /api/cluster_status   nodes + resource totals/available + demands
+  GET /api/nodes|actors|jobs|placement_groups|tasks|workers
+  GET /api/version
+  GET /metrics              Prometheus exposition (user metrics + core gauges)
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+from ray_tpu._private.rpc import EventLoopThread, RpcClient
+
+logger = logging.getLogger(__name__)
+
+
+class DashboardHead:
+    def __init__(self, gcs_address: str, host: str = "127.0.0.1",
+                 port: int = 8265):
+        self.gcs_address = gcs_address
+        self._lt = EventLoopThread("dashboard")
+        self._gcs = RpcClient(gcs_address, self._lt)
+        dash = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # noqa: N802 — quiet
+                pass
+
+            def do_GET(self):  # noqa: N802 — http.server API
+                try:
+                    dash._route(self)
+                except BrokenPipeError:
+                    pass
+                except Exception as e:  # noqa: BLE001
+                    logger.exception("dashboard request failed")
+                    try:
+                        self.send_error(500, str(e))
+                    except Exception:  # noqa: BLE001
+                        pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.url = f"http://{host}:{self._httpd.server_address[1]}"
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="dashboard-http",
+            daemon=True)
+        self._thread.start()
+
+    # -- routing -------------------------------------------------------------
+
+    def _route(self, req: BaseHTTPRequestHandler) -> None:
+        path = req.path.split("?")[0].rstrip("/") or "/"
+        if path == "/":
+            self._respond(req, self._index_html(), "text/html")
+        elif path == "/metrics":
+            self._respond(req, self._metrics_text(),
+                          "text/plain; version=0.0.4")
+        elif path == "/api/version":
+            self._json(req, {"ray_version": "ray_tpu-0.1",
+                             "gcs_address": self.gcs_address})
+        elif path == "/api/cluster_status":
+            self._json(req, self._cluster_status())
+        elif path.startswith("/api/"):
+            kind = path[len("/api/"):]
+            data = self._list(kind)
+            if data is None:
+                req.send_error(404, f"unknown resource {kind!r}")
+            else:
+                self._json(req, data)
+        else:
+            req.send_error(404)
+
+    def _respond(self, req, body: str, ctype: str) -> None:
+        data = body.encode()
+        req.send_response(200)
+        req.send_header("Content-Type", ctype)
+        req.send_header("Content-Length", str(len(data)))
+        req.end_headers()
+        req.wfile.write(data)
+
+    def _json(self, req, obj: Any) -> None:
+        self._respond(req, json.dumps(obj, default=str), "application/json")
+
+    # -- data ----------------------------------------------------------------
+
+    def _cluster_status(self) -> Dict[str, Any]:
+        load = self._gcs.call("get_cluster_load", {}, timeout=10)
+        total: Dict[str, float] = {}
+        avail: Dict[str, float] = {}
+        for n in load["nodes"].values():
+            if not n["alive"]:
+                continue
+            for k, v in n["total"].items():
+                total[k] = total.get(k, 0.0) + v
+            for k, v in n["available"].items():
+                avail[k] = avail.get(k, 0.0) + v
+        return {"nodes": load["nodes"], "resources_total": total,
+                "resources_available": avail,
+                "pending_demands": load.get("demands", []),
+                "pending_pg_bundles": load.get("pending_pg_bundles", [])}
+
+    def _list(self, kind: str) -> Optional[list]:
+        if kind == "nodes":
+            infos = self._gcs.call("get_all_node_info", {}, timeout=10)
+            return [{
+                "node_id": n.node_id.hex(),
+                "state": "ALIVE" if n.alive else "DEAD",
+                "raylet_address": n.raylet_address,
+                "resources_total": dict(n.resources_total),
+                "resources_available": dict(n.resources_available),
+                "is_head_node": n.is_head,
+            } for n in infos]
+        if kind == "actors":
+            actors = self._gcs.call("list_actors", {}, timeout=10)
+            return [{
+                "actor_id": a.actor_id.hex(),
+                "state": getattr(a.state, "name", str(a.state)),
+                "name": a.name or "",
+                "class_name": a.class_name,
+                "pid": a.pid,
+                "restarts": a.num_restarts,
+            } for a in actors]
+        if kind == "jobs":
+            jobs = self._gcs.call("get_all_job_info", {}, timeout=10)
+            return [{
+                "job_id": j.job_id.hex() if hasattr(j.job_id, "hex")
+                else str(j.job_id),
+                "is_dead": j.is_dead,
+                "driver_address": j.driver_address,
+            } for j in jobs]
+        if kind == "placement_groups":
+            pgs = self._gcs.call("list_placement_groups", {}, timeout=10)
+            return pgs
+        if kind == "tasks":
+            events = self._gcs.call(
+                "get_task_events", {"job_id": None, "limit": 10_000},
+                timeout=10)
+            from ray_tpu.util.state.api import latest_task_events
+
+            return list(latest_task_events(events).values())
+        if kind == "workers":
+            from ray_tpu.util.state import list_workers
+
+            try:
+                return list_workers()
+            except Exception:  # noqa: BLE001 — needs a connected worker
+                return []
+        return None
+
+    def _metrics_text(self) -> str:
+        from ray_tpu.util.metrics import prometheus_text
+
+        lines = [prometheus_text()]
+        try:
+            status = self._cluster_status()
+            for k, v in status["resources_total"].items():
+                name = k.replace(":", "_").replace(".", "_")
+                lines.append(
+                    f'ray_tpu_cluster_resource_total{{resource="{name}"}} {v}')
+            for k, v in status["resources_available"].items():
+                name = k.replace(":", "_").replace(".", "_")
+                lines.append(
+                    f'ray_tpu_cluster_resource_available{{resource="{name}"}}'
+                    f' {v}')
+            alive = sum(1 for n in status["nodes"].values() if n["alive"])
+            lines.append(f"ray_tpu_cluster_nodes_alive {alive}")
+        except Exception:  # noqa: BLE001 — GCS may be mid-restart
+            pass
+        return "\n".join(lines) + "\n"
+
+    def _index_html(self) -> str:
+        status = self._cluster_status()
+        rows = "".join(
+            f"<tr><td>{nid[:12]}</td>"
+            f"<td>{'ALIVE' if n['alive'] else 'DEAD'}</td>"
+            f"<td>{n['total']}</td></tr>"
+            for nid, n in status["nodes"].items())
+        return (
+            "<html><head><title>ray_tpu dashboard</title></head><body>"
+            "<h2>ray_tpu cluster</h2>"
+            f"<p>GCS: {self.gcs_address}</p>"
+            f"<p>Resources: {status['resources_available']} free of "
+            f"{status['resources_total']}</p>"
+            "<table border=1><tr><th>node</th><th>state</th>"
+            f"<th>resources</th></tr>{rows}</table>"
+            "<p>APIs: /api/cluster_status /api/nodes /api/actors /api/jobs "
+            "/api/placement_groups /api/tasks /metrics</p>"
+            "</body></html>")
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._gcs.close()
+        self._lt.stop()

@@ -63,6 +63,9 @@ class _Lease:
     pg_id: Optional[PlacementGroupID] = None
     bundle_index: int = -1
     is_actor: bool = False
+    retriable: bool = False
+    owner_id: str = ""
+    start_time: float = field(default_factory=time.monotonic)
 
 
 @dataclass
@@ -179,9 +182,58 @@ class Raylet:
             self._tasks.append(self._lt.loop.create_task(self._dispatch_loop()))
             if self._store_client is not None:
                 self._tasks.append(self._lt.loop.create_task(self._spill_loop()))
+            if CONFIG.memory_monitor_refresh_ms > 0:
+                self._tasks.append(
+                    self._lt.loop.create_task(self._memory_monitor_loop()))
 
         self._lt.loop.call_soon_threadsafe(_start_tasks)
         return self.address
+
+    # --------------------------------------------------------- OOM killing
+    async def _memory_monitor_loop(self):
+        """Kill a victim worker when node memory crosses the threshold
+        (reference: memory_monitor.h:52 + worker_killing_policy.h)."""
+        from ray_tpu.raylet.memory_monitor import (
+            MemoryMonitor,
+            WorkerCandidate,
+            group_by_owner_policy,
+            retriable_lifo_policy,
+        )
+
+        monitor = MemoryMonitor(threshold=CONFIG.memory_usage_threshold)
+        policy = (group_by_owner_policy
+                  if CONFIG.worker_killing_policy == "group_by_owner"
+                  else retriable_lifo_policy)
+        period = CONFIG.memory_monitor_refresh_ms / 1000.0
+        while True:
+            await asyncio.sleep(period)
+            try:
+                if not monitor.should_kill():
+                    continue
+                candidates = [
+                    WorkerCandidate(
+                        worker_id=wid, is_actor=lease.is_actor,
+                        retriable=lease.retriable,
+                        start_time=lease.start_time,
+                        owner_id=lease.owner_id,
+                    )
+                    for wid, lease in self._leases.items()
+                ]
+                victim = policy(candidates)
+                if victim is None:
+                    continue
+                handle = self.worker_pool.get_by_worker_id(victim.worker_id)
+                if handle is None:
+                    continue
+                logger.warning(
+                    "node memory above %.0f%%: killing worker %s "
+                    "(actor=%s retriable=%s) to relieve pressure",
+                    CONFIG.memory_usage_threshold * 100,
+                    victim.worker_id.hex()[:8], victim.is_actor,
+                    victim.retriable)
+                self.worker_pool.kill_worker(handle)
+            except Exception:  # noqa: BLE001 — keep monitoring
+                logger.exception("memory monitor error")
 
     # ------------------------------------------------- object store hosting
     def _start_object_store(self):
@@ -497,12 +549,18 @@ class Raylet:
                 q.future.set_result({"rejected": True, "reason": "no worker available"})
             return
         is_actor = q.spec.task_type == TaskType.ACTOR_CREATION_TASK
+        owner = q.spec.owner_address
         self._leases[worker.worker_id] = _Lease(
             worker_id=worker.worker_id,
             resources=resources,
             pg_id=pg_id,
             bundle_index=bundle_index,
             is_actor=is_actor,
+            retriable=(q.spec.actor_creation.max_restarts != 0
+                       if is_actor and q.spec.actor_creation is not None
+                       else q.spec.max_retries != 0),
+            owner_id=(owner.worker_id.hex()
+                      if owner is not None and owner.worker_id else ""),
         )
         if is_actor:
             self.worker_pool.mark_actor_worker(

@@ -235,6 +235,18 @@ class WorkerPool:
     def get_by_worker_id(self, worker_id: WorkerID) -> Optional[WorkerHandle]:
         return self._registered.get(worker_id)
 
+    def kill_worker(self, handle: WorkerHandle):
+        """Terminate a worker while LEAVING its state intact, so the monitor
+        loop reaper observes the exit and fires on_worker_death — releasing
+        the lease/resources and reporting actor death. (_kill pre-marks the
+        handle dead, which suppresses the callback; that is only correct for
+        workers whose lease was already released.)"""
+        if handle.proc is not None and handle.proc.poll() is None:
+            try:
+                handle.proc.terminate()
+            except Exception:  # noqa: BLE001
+                pass
+
     def _kill(self, handle: WorkerHandle):
         handle.state = "dead"
         if handle.proc is not None and handle.proc.poll() is None:

@@ -195,7 +195,21 @@ class IMPALA(Algorithm):
         m = mask.reshape(b, t_len)
         batch["mask"] = np.concatenate(
             [m, np.zeros((b_bucket - b, t_len), np.float32)])
-        batch["bootstrap_value"] = np.zeros(b_bucket, np.float32)
+        # Row boundaries may split a fragment mid-stream; such rows need a
+        # bootstrap value V(first obs of the NEXT row) or their tail targets
+        # would assume zero future return. Rows ending at a fragment end
+        # (terminateds=1) ignore the bootstrap (discount is 0 there).
+        boots = np.zeros(b_bucket, np.float32)
+        flat_terms = batch["terminateds"].reshape(-1)
+        need = [i for i in range(b - 1)
+                if flat_terms[(i + 1) * t_len - 1] == 0]
+        if need:
+            next_obs = batch["obs"].reshape((-1,) + batch["obs"].shape[2:])[
+                [(i + 1) * t_len for i in need]]
+            vals = np.asarray(self._value_fn(self.params, next_obs))
+            for i, v in zip(need, vals):
+                boots[i] = v
+        batch["bootstrap_value"] = boots
         return batch
 
     def training_step(self) -> Dict[str, Any]:

@@ -67,7 +67,6 @@ class EnvRunner:
             return self.module.forward_inference(params, {"obs": obs})
 
         self._act_greedy = _act_greedy
-        self._pending_env_actions = None  # env-unit actions for this step
         self._obs, _ = self.envs.reset(seed=seed)
         self._episodes = [SingleAgentEpisode() for _ in range(n_envs)]
         for i, ep in enumerate(self._episodes):
@@ -93,6 +92,7 @@ class EnvRunner:
             "rollout_fragment_length", 200)
         done_episodes: List[SingleAgentEpisode] = []
         for _ in range(num_steps):
+            env_actions = None
             if random_actions:
                 sampled = np.stack([
                     self.envs.single_action_space.sample()
@@ -101,10 +101,9 @@ class EnvRunner:
                     # Store module-space [-1,1] actions; send env units.
                     scale, offset = self._act_scale
                     actions = (sampled - offset) / np.where(scale == 0, 1, scale)
-                    self._pending_env_actions = sampled
+                    env_actions = sampled
                 else:
                     actions = sampled
-                    self._pending_env_actions = None
                 extra: Dict[str, np.ndarray] = {}
             else:
                 self._key, sub = jax.random.split(self._key)
@@ -118,13 +117,11 @@ class EnvRunner:
                         self.params, self._obs.astype(np.float32))
                     extra = {}
                 actions = np.asarray(out["actions"])
-                self._pending_env_actions = None
-            env_actions = actions
-            if self._pending_env_actions is not None:
-                env_actions = self._pending_env_actions
-            elif self._act_scale is not None:
-                scale, offset = self._act_scale
-                env_actions = actions * scale + offset
+            if env_actions is None:
+                env_actions = actions
+                if self._act_scale is not None:
+                    scale, offset = self._act_scale
+                    env_actions = actions * scale + offset
             next_obs, rewards, terms, truncs, infos = self.envs.step(env_actions)
             for i in range(self.n_envs):
                 per_step_extra = {k: v[i] for k, v in extra.items()}

@@ -63,6 +63,16 @@ def cmd_start(args) -> int:
         raylet = Raylet(gcs_address=gcs_address,
                         resources=resources or None, is_head=True)
         raylet.start(0)
+        dashboard = None
+        if args.dashboard_port >= 0:
+            try:
+                from ray_tpu.dashboard import DashboardHead
+
+                dashboard = DashboardHead(gcs_address,
+                                          port=args.dashboard_port)
+                print(f"Dashboard: {dashboard.url}")
+            except OSError as e:
+                print(f"dashboard disabled: {e}", file=sys.stderr)
         _write_pidfile("head", {"address": gcs_address})
         print(f"Started head node.\n\n  GCS address: {gcs_address}\n\n"
               f"To add a worker node:\n"
@@ -72,6 +82,8 @@ def cmd_start(args) -> int:
               f"RT_ADDRESS={gcs_address}")
         if args.block:
             _block_forever()
+            if dashboard is not None:
+                dashboard.stop()
             raylet.stop()
             gcs.stop()
         return 0
@@ -262,6 +274,8 @@ def main(argv=None) -> int:
     sp.add_argument("--port", type=int, default=0)
     sp.add_argument("--num-cpus", type=float, default=None)
     sp.add_argument("--resources", help="JSON resource dict")
+    sp.add_argument("--dashboard-port", type=int, default=8265,
+                    help="-1 disables the dashboard; 0 picks a free port")
     sp.add_argument("--block", action="store_true", default=True)
     sp.add_argument("--no-block", dest="block", action="store_false")
     sp.set_defaults(fn=cmd_start)

@@ -15,6 +15,17 @@ def _gcs():
     return get_core_worker()._gcs
 
 
+def latest_task_events(events) -> Dict[str, Dict[str, Any]]:
+    """Collapse a task-event stream to the latest state per task by event
+    TIME (events from different processes can arrive out of order)."""
+    latest: Dict[str, Dict[str, Any]] = {}
+    for ev in events:
+        cur = latest.get(ev["task_id"])
+        if cur is None or ev.get("time", 0) >= cur.get("time", 0):
+            latest[ev["task_id"]] = ev
+    return latest
+
+
 def list_nodes(filters=None, limit: int = 100, **_kw) -> List[Dict[str, Any]]:
     nodes = _gcs().call("get_all_node_info", {})
     out = [
@@ -61,11 +72,7 @@ def list_tasks(filters=None, limit: int = 100,
     if raw_events:
         # Full state-transition stream (for `ray-tpu timeline`).
         return events[:limit]
-    # Collapse events to latest-state per task (the reference's state
-    # aggregation over gcs task events).
-    latest: Dict[str, Dict[str, Any]] = {}
-    for ev in reversed(events):
-        latest[ev["task_id"]] = ev
+    latest = latest_task_events(events)
     out = [
         {
             "task_id": ev["task_id"],

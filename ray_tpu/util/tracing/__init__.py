@@ -1,0 +1,5 @@
+from ray_tpu.util.tracing.tracing_helper import (  # noqa: F401
+    get_trace_events,
+    profile,
+    trace_span,
+)

@@ -1,0 +1,103 @@
+"""Tracing spans (reference: ray python/ray/util/tracing/tracing_helper.py —
+a lazy `_opentelemetry` proxy (:36-57) so the dependency is optional, spans
+injected around task submit/execute; plus the C++ ProfileEvent buffered into
+the task-event stream for `ray timeline`).
+
+`trace_span` uses OpenTelemetry when it is importable, and ALWAYS records a
+profile event into the process-local buffer that `ray-tpu timeline` dumps —
+so spans appear in the chrome trace regardless of otel availability.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+_events: deque = deque(maxlen=100_000)
+_lock = threading.Lock()
+
+
+class _LazyOpenTelemetry:
+    """Import opentelemetry on first use; stay inert if unavailable
+    (reference pattern: tracing_helper.py:36-57)."""
+
+    def __init__(self):
+        self._tracer = None
+        self._tried = False
+
+    @property
+    def tracer(self):
+        if not self._tried:
+            self._tried = True
+            try:
+                from opentelemetry import trace  # type: ignore
+
+                self._tracer = trace.get_tracer("ray_tpu")
+            except ImportError:
+                self._tracer = None
+        return self._tracer
+
+
+_otel = _LazyOpenTelemetry()
+
+
+@contextlib.contextmanager
+def trace_span(name: str, attributes: Optional[Dict[str, Any]] = None):
+    """Record a span: otel (if present) + the local profile-event buffer."""
+    start = time.time()
+    otel_cm = None
+    if _otel.tracer is not None:
+        otel_cm = _otel.tracer.start_as_current_span(name)
+        otel_cm.__enter__()
+    try:
+        yield
+    finally:
+        end = time.time()
+        if otel_cm is not None:
+            otel_cm.__exit__(None, None, None)
+        with _lock:
+            _events.append({
+                "name": name,
+                "start": start,
+                "end": end,
+                "thread": threading.current_thread().name,
+                "attributes": dict(attributes or {}),
+            })
+
+
+def profile(name: str):
+    """Decorator form: @profile("stage") wraps calls in trace_span."""
+
+    def wrap(fn):
+        @functools.wraps(fn)
+        def inner(*a, **kw):
+            with trace_span(name):
+                return fn(*a, **kw)
+
+        return inner
+
+    return wrap
+
+
+def get_trace_events(clear: bool = False) -> List[Dict[str, Any]]:
+    with _lock:
+        out = list(_events)
+        if clear:
+            _events.clear()
+    return out
+
+
+def chrome_trace(events: Optional[List[Dict[str, Any]]] = None) -> list:
+    """Convert profile events to chrome://tracing 'X' entries."""
+    events = events if events is not None else get_trace_events()
+    return [{
+        "cat": "profile", "ph": "X", "name": ev["name"],
+        "pid": "profile", "tid": ev["thread"],
+        "ts": int(ev["start"] * 1e6),
+        "dur": int((ev["end"] - ev["start"]) * 1e6),
+        "args": ev["attributes"],
+    } for ev in events]

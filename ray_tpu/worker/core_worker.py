@@ -277,6 +277,12 @@ class CoreWorker:
                 pass
         self._lease_reaper.cancel()
         self._event_flusher.cancel()
+        # Final event flush so short-lived drivers still show their tasks in
+        # the state API / timeline.
+        try:
+            self._lt.submit(self._flush_task_events()).result(timeout=2)
+        except Exception:  # noqa: BLE001 — best effort on teardown
+            pass
         self.executor.shutdown()
         if self.plasma is not None:
             try:
@@ -1631,16 +1637,19 @@ class CoreWorker:
 
     async def _task_event_loop(self):
         while True:
-            await asyncio.sleep(2.0)
-            if not self._task_events:
-                continue
-            events = []
-            while self._task_events and len(events) < 5000:
-                events.append(self._task_events.popleft())
-            try:
-                await self._gcs.send_async("add_task_events", {"events": events})
-            except (ConnectionLost, OSError):
-                pass
+            await asyncio.sleep(1.0)
+            await self._flush_task_events()
+
+    async def _flush_task_events(self):
+        if not self._task_events:
+            return
+        events = []
+        while self._task_events and len(events) < 5000:
+            events.append(self._task_events.popleft())
+        try:
+            await self._gcs.send_async("add_task_events", {"events": events})
+        except (ConnectionLost, OSError):
+            pass
 
 
 class _RetryGet(Exception):

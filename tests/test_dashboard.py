@@ -1,0 +1,73 @@
+"""Dashboard-lite tests (reference pattern: ray dashboard/tests — HTTP
+endpoints against a live cluster)."""
+
+import json
+import urllib.request
+
+import pytest
+
+
+@pytest.fixture()
+def dash_cluster():
+    import ray_tpu
+
+    ctx = ray_tpu.init(num_cpus=2, include_dashboard=True)
+    yield ctx
+    ray_tpu.shutdown()
+
+
+def _get(url: str) -> str:
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.read().decode()
+
+
+def test_dashboard_endpoints(dash_cluster):
+    import ray_tpu
+
+    base = dash_cluster.dashboard_url
+    assert base and base.startswith("http://")
+
+    status = json.loads(_get(base + "/api/cluster_status"))
+    assert status["resources_total"].get("CPU") == 2.0
+    assert len(status["nodes"]) == 1
+
+    nodes = json.loads(_get(base + "/api/nodes"))
+    assert nodes[0]["state"] == "ALIVE" and nodes[0]["is_head_node"]
+
+    # actors appear after creation
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return 1
+
+    a = A.options(name="dash-actor").remote()
+    ray_tpu.get(a.ping.remote())
+    actors = json.loads(_get(base + "/api/actors"))
+    assert any(x["name"] == "dash-actor" for x in actors)
+
+    jobs = json.loads(_get(base + "/api/jobs"))
+    assert len(jobs) >= 1
+
+    html = _get(base + "/")
+    assert "ray_tpu cluster" in html
+
+    version = json.loads(_get(base + "/api/version"))
+    assert "gcs_address" in version
+
+
+def test_dashboard_prometheus_metrics(dash_cluster):
+    from ray_tpu.util.metrics import Counter
+
+    c = Counter("dash_test_total", "test counter", tag_keys=("k",))
+    c.inc(3, tags={"k": "v"})
+    text = _get(dash_cluster.dashboard_url + "/metrics")
+    assert 'dash_test_total{k="v"} 3' in text
+    assert "ray_tpu_cluster_nodes_alive 1" in text
+    assert 'ray_tpu_cluster_resource_total{resource="CPU"} 2.0' in text
+
+
+def test_dashboard_404(dash_cluster):
+    import urllib.error
+
+    with pytest.raises(urllib.error.HTTPError):
+        _get(dash_cluster.dashboard_url + "/api/bogus")

@@ -1,0 +1,98 @@
+"""Memory monitor / OOM killing policy tests (reference pattern:
+worker_killing_policy_test.cc + memory monitor tests)."""
+
+import time
+
+import pytest
+
+from ray_tpu.raylet.memory_monitor import (
+    MemoryMonitor,
+    WorkerCandidate,
+    group_by_owner_policy,
+    retriable_lifo_policy,
+    system_memory_usage_fraction,
+)
+
+
+def _c(wid, actor=False, retriable=True, t=0.0, owner="o1"):
+    return WorkerCandidate(worker_id=wid, is_actor=actor,
+                           retriable=retriable, start_time=t, owner_id=owner)
+
+
+def test_policy_prefers_youngest_retriable_task():
+    victim = retriable_lifo_policy([
+        _c("old-task", t=1.0),
+        _c("young-task", t=5.0),
+        _c("actor", actor=True, t=9.0),
+        _c("nonretriable", retriable=False, t=8.0),
+    ])
+    assert victim.worker_id == "young-task"
+
+
+def test_policy_kills_actors_last():
+    victim = retriable_lifo_policy([
+        _c("actor-young", actor=True, t=9.0),
+        _c("nonretriable-task", retriable=False, t=1.0),
+    ])
+    assert victim.worker_id == "nonretriable-task"
+    only_actors = [_c("a1", actor=True, t=1.0), _c("a2", actor=True, t=2.0)]
+    assert retriable_lifo_policy(only_actors).worker_id == "a2"
+
+
+def test_group_by_owner_targets_biggest_owner():
+    victim = group_by_owner_policy([
+        _c("w1", owner="big", t=1.0),
+        _c("w2", owner="big", t=2.0),
+        _c("w3", owner="big", t=3.0),
+        _c("w4", owner="small", t=9.0),
+    ])
+    assert victim.worker_id == "w3"  # youngest of the biggest owner
+
+
+def test_empty_candidates():
+    assert retriable_lifo_policy([]) is None
+    assert group_by_owner_policy([]) is None
+
+
+def test_monitor_threshold_and_rate_limit():
+    readings = iter([0.5, 0.99, 0.99, 0.99])
+    mon = MemoryMonitor(get_usage=lambda: next(readings),
+                        threshold=0.9, min_kill_interval_s=10.0)
+    assert not mon.should_kill()       # below threshold
+    assert mon.should_kill()           # above -> kill
+    assert not mon.should_kill()       # rate limited
+    mon._last_kill = time.monotonic() - 11
+    assert mon.should_kill()           # interval elapsed
+
+
+def test_system_memory_reading():
+    frac = system_memory_usage_fraction()
+    assert 0.0 <= frac < 1.0
+
+
+def test_oom_kill_retries_task(ray_start_regular):
+    """End-to-end: a forced-kill victim's task is retried on a new worker."""
+    import ray_tpu
+    from ray_tpu._raylet import get_core_worker
+
+    @ray_tpu.remote(max_retries=2)
+    def slow():
+        time.sleep(1.0)
+        return "done"
+
+    ref = slow.remote()
+    time.sleep(0.4)  # task is running on some worker
+    # Simulate the monitor firing: kill the leased worker directly.
+    node = ray_tpu.api._global_node
+    raylet = node.raylet
+    leases = dict(raylet._leases)
+    assert leases, "expected a leased worker"
+    wid = next(iter(leases))
+    handle = raylet.worker_pool.get_by_worker_id(wid)
+    raylet.worker_pool.kill_worker(handle)
+    assert ray_tpu.get(ref, timeout=60) == "done"  # retried elsewhere
+    # the lease must be released (no leak) once the death is processed
+    deadline = time.time() + 10
+    while time.time() < deadline and wid in raylet._leases:
+        time.sleep(0.2)
+    assert wid not in raylet._leases

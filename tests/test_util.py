@@ -125,3 +125,27 @@ def test_internal_kv(ray_start_regular):
     assert b"ik_key" in kv.internal_kv_list(b"ik_")
     kv.internal_kv_del(b"ik_key")
     assert kv.internal_kv_get(b"ik_key") is None
+
+
+def test_tracing_spans():
+    import time as _t
+
+    from ray_tpu.util.tracing import get_trace_events, profile, trace_span
+    from ray_tpu.util.tracing.tracing_helper import chrome_trace
+
+    with trace_span("outer", {"k": "v"}):
+        _t.sleep(0.01)
+
+    @profile("inner")
+    def work():
+        return 42
+
+    assert work() == 42
+    events = get_trace_events()
+    names = [e["name"] for e in events]
+    assert "outer" in names and "inner" in names
+    outer = next(e for e in events if e["name"] == "outer")
+    assert outer["end"] - outer["start"] >= 0.01
+    assert outer["attributes"] == {"k": "v"}
+    trace = chrome_trace(events)
+    assert all(t["ph"] == "X" and t["dur"] >= 0 for t in trace)
