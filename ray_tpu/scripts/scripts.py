@@ -254,6 +254,29 @@ def cmd_timeline(args) -> int:
     return 0
 
 
+def cmd_serve(args) -> int:
+    """serve deploy/status/shutdown (reference: serve/scripts.py CLI)."""
+    _connect(args)
+    from ray_tpu import serve
+
+    if args.serve_cmd == "deploy":
+        if not args.config:
+            print("serve deploy requires a JSON config path", file=sys.stderr)
+            return 1
+        from ray_tpu.serve.schema import ServeDeploySchema, deploy_config
+
+        config = ServeDeploySchema.parse_file(args.config)
+        handles = deploy_config(config)
+        print(f"Deployed {len(handles)} application(s): "
+              f"{', '.join(handles)}")
+    elif args.serve_cmd == "status":
+        print(json.dumps(serve.status(), indent=2, default=str))
+    elif args.serve_cmd == "shutdown":
+        serve.shutdown()
+        print("Serve shut down.")
+    return 0
+
+
 def cmd_microbenchmark(args) -> int:
     from ray_tpu._private.ray_perf import main as perf_main
 
@@ -316,6 +339,12 @@ def main(argv=None) -> int:
     sp.add_argument("--address")
     sp.add_argument("-o", "--output")
     sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser("serve", help="serve deploy/status/shutdown")
+    sp.add_argument("serve_cmd", choices=["deploy", "status", "shutdown"])
+    sp.add_argument("config", nargs="?", help="JSON config (deploy)")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_serve)
 
     sp = sub.add_parser("microbenchmark", help="run the core benchmark suite")
     sp.add_argument("--quick", action="store_true")

@@ -91,7 +91,9 @@ class BackendExecutor:
                 trial_dir=storage.trial_dir,
             )
             init_refs.append(
-                worker.init_session.remote(ctx_kwargs, latest_checkpoint))
+                worker.init_session.remote(
+                    ctx_kwargs, latest_checkpoint,
+                    storage.next_checkpoint_index()))
         ray_tpu.get(init_refs)
         self._backend.on_training_start(wg, self._backend_config)
         ray_tpu.get([

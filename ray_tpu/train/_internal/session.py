@@ -32,14 +32,15 @@ class _TrainingResult:
 
 class _Session:
     def __init__(self, context: TrainContext,
-                 latest_checkpoint: Optional[Checkpoint] = None):
+                 latest_checkpoint: Optional[Checkpoint] = None,
+                 checkpoint_index_start: int = 0):
         self.context = context
         self.latest_checkpoint = latest_checkpoint
         self.result_queue: "queue.Queue[_TrainingResult]" = queue.Queue(maxsize=1)
         self.finished = threading.Event()
         self.error: Optional[BaseException] = None
         self.stop_requested = threading.Event()
-        self._report_count = 0
+        self._report_count = checkpoint_index_start
 
     # called from the train thread
     def report(self, metrics: Dict[str, Any],
@@ -86,10 +87,12 @@ _session: Optional[_Session] = None
 
 
 def init_session(context: TrainContext,
-                 latest_checkpoint: Optional[Checkpoint] = None) -> _Session:
+                 latest_checkpoint: Optional[Checkpoint] = None,
+                 checkpoint_index_start: int = 0) -> _Session:
     global _session
     with _session_lock:
-        _session = _Session(context, latest_checkpoint)
+        _session = _Session(context, latest_checkpoint,
+                            checkpoint_index_start)
         return _session
 
 

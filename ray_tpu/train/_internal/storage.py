@@ -52,6 +52,19 @@ class StorageContext:
             and os.path.isdir(os.path.join(self.trial_dir, d))
         )
 
+    def next_checkpoint_index(self) -> int:
+        """First unused checkpoint index. Restarted attempts must CONTINUE
+        the numbering — reusing indices would overwrite prior attempts'
+        checkpoints while late-initializing workers may still be reading
+        them (gang-restart race)."""
+        cs = self.list_checkpoints()
+        if not cs:
+            return 0
+        try:
+            return max(int(c.rsplit("_", 1)[-1]) for c in cs) + 1
+        except ValueError:
+            return len(cs)
+
     def latest_checkpoint(self) -> Optional[str]:
         cs = self.list_checkpoints()
         return self.checkpoint_path(cs[-1]) if cs else None

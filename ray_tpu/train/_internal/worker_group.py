@@ -38,12 +38,14 @@ class TrainWorker:
         }
 
     def init_session(self, context_kwargs: Dict[str, Any],
-                     latest_checkpoint=None) -> None:
+                     latest_checkpoint=None,
+                     checkpoint_index_start: int = 0) -> None:
         from ray_tpu.train._internal import session as session_mod
         from ray_tpu.train.context import TrainContext
 
         self._session = session_mod.init_session(
-            TrainContext(**context_kwargs), latest_checkpoint)
+            TrainContext(**context_kwargs), latest_checkpoint,
+            checkpoint_index_start)
 
     def run_backend_hook(self, hook: Callable, *args, **kwargs) -> Any:
         return hook(*args, **kwargs)

@@ -1,0 +1,124 @@
+"""Joblib backend: run joblib.Parallel workloads (e.g. scikit-learn n_jobs)
+as cluster tasks.
+
+Reference: ray python/ray/util/joblib — register_ray() installs a
+'ray' parallel backend so `with joblib.parallel_backend("ray"): ...`
+distributes batches over the cluster.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+
+def register_ray() -> None:
+    from joblib import register_parallel_backend
+
+    register_parallel_backend("ray", RayTpuBackend)
+
+
+class _AsyncResult:
+    def __init__(self, ref):
+        self._ref = ref
+
+    def get(self, timeout: Optional[float] = None):
+        import ray_tpu
+
+        return ray_tpu.get(self._ref, timeout=timeout)
+
+
+def _run_batch(batch):
+    return batch()
+
+
+from joblib._parallel_backends import ParallelBackendBase  # noqa: E402
+
+
+class RayTpuBackend(ParallelBackendBase):
+    """joblib ParallelBackendBase implementation over remote tasks."""
+
+    supports_timeout = True
+    supports_sharedmem = False
+    uses_threads = False
+    supports_retrieve_callback = False
+    default_n_jobs = -1
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.parallel = None
+        self._n_jobs = 1
+
+    # -- joblib backend API --------------------------------------------------
+
+    def configure(self, n_jobs: int = 1, parallel=None, **_kw) -> int:
+        import ray_tpu
+
+        if not ray_tpu.is_initialized():
+            ray_tpu.init(ignore_reinit_error=True)
+        self.parallel = parallel
+        self._n_jobs = self.effective_n_jobs(n_jobs)
+        return self._n_jobs
+
+    def effective_n_jobs(self, n_jobs: Optional[int]) -> int:
+        import ray_tpu
+
+        if n_jobs == 0:
+            raise ValueError("n_jobs == 0 has no meaning")
+        if n_jobs is None:
+            return 1
+        if n_jobs < 0:
+            if not ray_tpu.is_initialized():
+                return 4
+            return max(1, int(ray_tpu.cluster_resources().get("CPU", 1)))
+        return n_jobs
+
+    def apply_async(self, func, callback=None) -> _AsyncResult:
+        import ray_tpu
+
+        if not hasattr(self, "_remote_fn"):
+            self._remote_fn = ray_tpu.remote(_run_batch)
+        ref = self._remote_fn.remote(func)
+        result = _AsyncResult(ref)
+        if callback is not None:
+            # joblib expects the callback once the work completes; resolve
+            # on a helper thread so apply_async stays non-blocking.
+            import threading
+
+            def waiter():
+                try:
+                    result.get()
+                except Exception:  # noqa: BLE001 — surfaced via .get()
+                    pass
+                callback(result)
+
+            threading.Thread(target=waiter, daemon=True).start()
+        return result
+
+    def compute_batch_size(self) -> int:
+        return 1
+
+    def batch_completed(self, batch_size, duration) -> None:
+        pass
+
+    def abort_everything(self, ensure_ready: bool = True) -> None:
+        if ensure_ready and self.parallel is not None:
+            self.configure(self._n_jobs, parallel=self.parallel)
+
+    def terminate(self) -> None:
+        pass
+
+    def stop_call(self) -> None:
+        pass
+
+    def start_call(self) -> None:
+        pass
+
+    def get_nested_backend(self):
+        from joblib._parallel_backends import SequentialBackend
+
+        return SequentialBackend(), None
+
+    def retrieval_context(self):
+        import contextlib
+
+        return contextlib.nullcontext()

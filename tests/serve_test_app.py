@@ -1,0 +1,11 @@
+"""Importable app for the serve schema deploy test."""
+from ray_tpu import serve
+
+
+@serve.deployment
+class Doubler:
+    def double(self, x):
+        return x * 2
+
+
+app = Doubler.bind()

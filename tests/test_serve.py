@@ -194,3 +194,42 @@ def test_delete_application(serve_instance):
     assert "to_delete" in serve.status()
     serve.delete("to_delete")
     assert "to_delete" not in serve.status()
+
+
+def test_serve_schema_deploy(ray_start_regular, tmp_path):
+    """Declarative config deploy (reference: serve deploy + schema.py)."""
+    import json as _json
+
+    from ray_tpu import serve
+    from ray_tpu.serve.schema import ServeDeploySchema, deploy_config
+
+    cfg = {
+        "applications": [{
+            "name": "schema-app",
+            "import_path": "tests.serve_test_app:app",
+            "route_prefix": "/sch",
+            "deployments": [{"name": "Doubler", "num_replicas": 2}],
+        }]
+    }
+    path = tmp_path / "serve.json"
+    path.write_text(_json.dumps(cfg))
+    schema = ServeDeploySchema.parse_file(str(path))
+    assert schema.applications[0].deployments[0].num_replicas == 2
+    try:
+        handles = deploy_config(schema)
+        h = handles["schema-app"]
+        assert h.double.remote(21).result(timeout_s=60) == 42
+        # the override took effect: two replicas
+        st = serve.status()
+        dep = st["schema-app"]["deployments"]["Doubler"]
+        assert dep["target_replicas"] == 2
+    finally:
+        serve.shutdown()
+
+
+def test_serve_schema_rejects_unknown_fields():
+    from ray_tpu.serve.schema import ServeApplicationSchema
+
+    with pytest.raises(ValueError):
+        ServeApplicationSchema.from_dict(
+            {"import_path": "x:y", "bogus": 1})
