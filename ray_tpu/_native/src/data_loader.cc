@@ -1,0 +1,225 @@
+// Native data loader: multi-threaded ordered file reader.
+//
+// Role of the reference's native IO paths (ray's C++ data plane reads file
+// chunks off the Python thread; Ray Data's performance depends on it —
+// SURVEY §2.1 lists the runtime around the compute path as native).  Python
+// file loops serialize on the GIL; this loader keeps N reader threads ahead
+// of the consumer and hands buffers back IN SUBMISSION ORDER so dataset
+// iteration stays deterministic while IO overlaps compute — the host-side
+// ingest path that keeps a TPU input pipeline fed.
+//
+// C API (rtdl_*) bound via ctypes in ray_tpu/data/_internal/native_loader.py.
+
+#include <errno.h>
+#include <fcntl.h>
+#include <stdint.h>
+#include <string.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <condition_variable>
+#include <cstdlib>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Job {
+  uint64_t seq;
+  std::string path;
+};
+
+struct Result {
+  uint8_t* data = nullptr;  // malloc'd; freed by rtdl_release / destructor
+  uint64_t size = 0;
+  int error = 0;            // errno on failure
+  std::string path;
+};
+
+class Loader {
+ public:
+  Loader(int num_threads, int max_ahead)
+      : max_ahead_(max_ahead < 1 ? 1 : max_ahead) {
+    if (num_threads < 1) num_threads = 1;
+    for (int i = 0; i < num_threads; ++i) {
+      threads_.emplace_back([this] { Work(); });
+    }
+  }
+
+  ~Loader() {
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      stopping_ = true;
+      cv_.notify_all();
+    }
+    for (auto& t : threads_) t.join();
+    for (auto& kv : done_) std::free(kv.second.data);
+  }
+
+  uint64_t Submit(const char* path) {
+    std::lock_guard<std::mutex> g(mu_);
+    uint64_t seq = next_seq_++;
+    queue_.push_back(Job{seq, path});
+    cv_.notify_one();
+    return seq;
+  }
+
+  // Blocks until the NEXT sequential result is ready (ordered delivery).
+  // Returns 0 ok, -1 timeout, -2 nothing outstanding, >0 errno for the item.
+  int Next(uint8_t** data, uint64_t* size, char* path_out, uint64_t path_cap,
+           int64_t timeout_ms) {
+    std::unique_lock<std::mutex> lk(mu_);
+    if (consume_seq_ >= next_seq_) return -2;
+    auto ready = [&] { return done_.count(consume_seq_) > 0; };
+    if (timeout_ms < 0) {
+      cv_done_.wait(lk, ready);
+    } else if (!cv_done_.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                                  ready)) {
+      return -1;
+    }
+    auto it = done_.find(consume_seq_);
+    Result r = std::move(it->second);
+    done_.erase(it);
+    consume_seq_++;
+    cv_.notify_all();  // reader threads may resume (look-ahead window)
+    lk.unlock();
+    if (path_out != nullptr && path_cap > 0) {
+      snprintf(path_out, path_cap, "%s", r.path.c_str());
+    }
+    if (r.error != 0) {
+      std::free(r.data);
+      *data = nullptr;
+      *size = 0;
+      return r.error;
+    }
+    *data = r.data;  // ownership to caller (free via rtdl_release)
+    *size = r.size;
+    return 0;
+  }
+
+  uint64_t Pending() {
+    std::lock_guard<std::mutex> g(mu_);
+    return next_seq_ - consume_seq_;
+  }
+
+ private:
+  void Work() {
+    for (;;) {
+      Job job;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_.wait(lk, [&] {
+          // Look-ahead bound: don't read more than max_ahead_ items past
+          // the consumer (keeps memory bounded on huge file lists).
+          return stopping_ ||
+                 (!queue_.empty() &&
+                  queue_.front().seq < consume_seq_ + max_ahead_);
+        });
+        if (stopping_) return;
+        job = std::move(queue_.front());
+        queue_.pop_front();
+      }
+      Result r;
+      r.path = job.path;
+      ReadFile(job.path, &r);
+      {
+        std::lock_guard<std::mutex> g(mu_);
+        done_[job.seq] = std::move(r);
+        cv_done_.notify_all();
+      }
+    }
+  }
+
+  static void ReadFile(const std::string& path, Result* r) {
+    int fd = open(path.c_str(), O_RDONLY | O_CLOEXEC);
+    if (fd < 0) {
+      r->error = errno ? errno : EIO;
+      return;
+    }
+    struct stat st;
+    if (fstat(fd, &st) != 0) {
+      r->error = errno ? errno : EIO;
+      close(fd);
+      return;
+    }
+    // st_size is only a capacity HINT: virtual files (procfs/sysfs, some
+    // FUSE) report 0 yet stream real content, and files can grow between
+    // stat and read — always read to EOF, growing the buffer as needed.
+    uint64_t cap = static_cast<uint64_t>(st.st_size);
+    if (cap < 4096) cap = 4096;
+    uint8_t* buf = static_cast<uint8_t*>(std::malloc(cap));
+    if (buf == nullptr) {
+      r->error = ENOMEM;
+      close(fd);
+      return;
+    }
+    uint64_t off = 0;
+    for (;;) {
+      if (off == cap) {
+        cap *= 2;
+        uint8_t* grown = static_cast<uint8_t*>(std::realloc(buf, cap));
+        if (grown == nullptr) {
+          r->error = ENOMEM;
+          break;
+        }
+        buf = grown;
+      }
+      ssize_t n = read(fd, buf + off, cap - off);
+      if (n < 0) {
+        if (errno == EINTR) continue;
+        r->error = errno;
+        break;
+      }
+      if (n == 0) break;  // EOF
+      off += static_cast<uint64_t>(n);
+    }
+    close(fd);
+    if (r->error != 0) {
+      std::free(buf);
+      return;
+    }
+    r->data = buf;
+    r->size = off;
+  }
+
+  int max_ahead_;
+  std::vector<std::thread> threads_;
+  std::mutex mu_;
+  std::condition_variable cv_;       // reader threads wait here
+  std::condition_variable cv_done_;  // consumer waits here
+  std::deque<Job> queue_;
+  std::map<uint64_t, Result> done_;
+  uint64_t next_seq_ = 0;
+  uint64_t consume_seq_ = 0;
+  bool stopping_ = false;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* rtdl_create(int num_threads, int max_ahead) {
+  return new Loader(num_threads, max_ahead);
+}
+
+void rtdl_destroy(void* h) { delete static_cast<Loader*>(h); }
+
+uint64_t rtdl_submit(void* h, const char* path) {
+  return static_cast<Loader*>(h)->Submit(path);
+}
+
+int rtdl_next(void* h, uint8_t** data, uint64_t* size, char* path_out,
+              uint64_t path_cap, int64_t timeout_ms) {
+  return static_cast<Loader*>(h)->Next(data, size, path_out, path_cap,
+                                       timeout_ms);
+}
+
+void rtdl_release(uint8_t* data) { std::free(data); }
+
+uint64_t rtdl_pending(void* h) { return static_cast<Loader*>(h)->Pending(); }
+
+}  // extern "C"
