@@ -211,7 +211,7 @@ def read_binary_files(paths, *, include_paths: bool = False,
             if native_loader_available():
                 # Look-ahead capped well below the group size so a block of
                 # large files doesn't double-buffer the whole group in RAM.
-                with NativeFileLoader(num_threads=min(8, len(group)),
+                with NativeFileLoader(num_threads=min(4, len(group)),
                                       max_ahead=4) as ld:
                     for path, data in ld.read(group):
                         row: Dict[str, Any] = {"bytes": data}

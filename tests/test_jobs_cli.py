@@ -82,7 +82,7 @@ def test_job_list(job_client):
 # ------------------------------------------------------------------ CLI
 
 
-def _cli(*args, timeout=60, env=None):
+def _cli(*args, timeout=180, env=None):
     e = dict(os.environ)
     e["PYTHONPATH"] = REPO + os.pathsep + e.get("PYTHONPATH", "")
     e.update(env or {})
@@ -126,13 +126,21 @@ def test_cli_head_worker_status_submit(tmp_path):
                     time.sleep(0.5)
             assert ok, st.stdout + st.stderr
 
+            # Generous timeout: submit starts the JobManager actor (worker
+            # spawn) and runs a driver subprocess — slow on a loaded machine.
             sub = _cli("submit", "--address", address, "--",
-                       sys.executable, "-c", "print(6*7)")
+                       sys.executable, "-c", "print(6*7)", timeout=300)
             assert "42" in sub.stdout, sub.stdout + sub.stderr
             assert "SUCCEEDED" in sub.stdout
         finally:
             worker.terminate()
-            worker.wait(10)
+            try:
+                worker.wait(30)
+            except subprocess.TimeoutExpired:
+                worker.kill()
     finally:
         head.terminate()
-        head.wait(10)
+        try:
+            head.wait(30)
+        except subprocess.TimeoutExpired:
+            head.kill()
