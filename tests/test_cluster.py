@@ -188,3 +188,36 @@ def test_actor_restart_after_node_death(ray_start_cluster):
     # Re-verify cluster still schedules on the surviving node.
     r = Roamer.remote()
     assert ray_tpu.get(r.node.remote(), timeout=60) is not None
+
+
+def test_gcs_state_survives_restart(tmp_path):
+    """GCS fault tolerance (reference: Redis-backed gcs store_client —
+    SURVEY §5): KV state written before a GCS stop is visible after a new
+    GCS starts from the same storage path."""
+    from ray_tpu.gcs.server import GcsServer
+    from ray_tpu._private.rpc import EventLoopThread, RpcClient
+
+    path = str(tmp_path / "gcs_state.pkl")
+    lt = EventLoopThread("t")
+    try:
+        gcs = GcsServer(storage_path=path)
+        addr = gcs.start(0)
+        try:
+            c = RpcClient(addr, lt)
+            assert c.call("kv_put", {"key": b"durable", "value": b"v1",
+                                     "overwrite": True, "namespace": None})
+            c.close()
+        finally:
+            gcs.stop()
+
+        gcs2 = GcsServer(storage_path=path)
+        addr2 = gcs2.start(0)
+        try:
+            c2 = RpcClient(addr2, lt)
+            assert c2.call(
+                "kv_get", {"key": b"durable", "namespace": None}) == b"v1"
+            c2.close()
+        finally:
+            gcs2.stop()
+    finally:
+        lt.stop()

@@ -138,9 +138,11 @@ def test_cli_head_worker_status_submit(tmp_path):
                 worker.wait(30)
             except subprocess.TimeoutExpired:
                 worker.kill()
+                worker.wait(10)
     finally:
         head.terminate()
         try:
             head.wait(30)
         except subprocess.TimeoutExpired:
             head.kill()
+            head.wait(10)
