@@ -22,6 +22,7 @@ from ray_tpu.data.read_api import (  # noqa: F401
     from_items,
     from_numpy,
     from_pandas,
+    from_torch,
     range,
     read_binary_files,
     read_csv,
@@ -30,8 +31,10 @@ from ray_tpu.data.read_api import (  # noqa: F401
     read_json,
     read_numpy,
     read_parquet,
+    read_sql,
     read_text,
     read_tfrecords,
+    read_webdataset,
 )
 
 __all__ = [
@@ -50,6 +53,7 @@ __all__ = [
     "from_items",
     "from_numpy",
     "from_pandas",
+    "from_torch",
     "range",
     "read_binary_files",
     "read_csv",
@@ -58,6 +62,8 @@ __all__ = [
     "read_json",
     "read_numpy",
     "read_parquet",
+    "read_sql",
     "read_text",
     "read_tfrecords",
+    "read_webdataset",
 ]

@@ -19,8 +19,11 @@ BatchType = Union[Dict[str, np.ndarray], "pa.Table", Any]
 
 
 def _column_to_numpy(col: pa.ChunkedArray) -> np.ndarray:
+    combined = col.combine_chunks()
+    if isinstance(combined, pa.FixedShapeTensorArray):
+        return combined.to_numpy_ndarray()
     try:
-        return col.combine_chunks().to_numpy(zero_copy_only=False)
+        return combined.to_numpy(zero_copy_only=False)
     except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
         return np.array(col.to_pylist(), dtype=object)
 
@@ -43,8 +46,11 @@ class BlockAccessor:
             cols = {}
             for k, v in batch.items():
                 v = np.asarray(v)
-                if v.ndim > 1:  # tensor column: one list entry per row
-                    cols[k] = pa.array(list(v))
+                if v.ndim > 1:
+                    # tensor column (reference: ray's ArrowTensorArray
+                    # extension) — fixed-shape tensors per row
+                    cols[k] = pa.FixedShapeTensorArray.from_numpy_ndarray(
+                        np.ascontiguousarray(v))
                 else:
                     cols[k] = pa.array(v)
             return pa.table(cols)
@@ -63,6 +69,25 @@ class BlockAccessor:
     def rows_to_block(rows: List[Dict[str, Any]]) -> Block:
         if not rows:
             return pa.table({})
+        # Tensor-valued rows can't go through from_pylist; route uniform
+        # ndarray columns through the fixed-shape tensor path.
+        if any(isinstance(v, np.ndarray) and v.ndim >= 1
+               for v in rows[0].values()):
+            cols = {}
+            for k in rows[0]:
+                vals = [r.get(k) for r in rows]
+                v0 = vals[0]
+                if (isinstance(v0, np.ndarray) and v0.ndim >= 1
+                        and all(isinstance(v, np.ndarray)
+                                and v.shape == v0.shape for v in vals)):
+                    # stacked is ndim>=2 (v0.ndim>=1), always tensor-typed
+                    cols[k] = pa.FixedShapeTensorArray.from_numpy_ndarray(
+                        np.ascontiguousarray(np.stack(vals)))
+                else:  # ragged / mixed: nested lists
+                    cols[k] = pa.array([
+                        v.tolist() if isinstance(v, np.ndarray) else v
+                        for v in vals])
+            return pa.table(cols)
         return pa.Table.from_pylist(rows)
 
     # -- views ---------------------------------------------------------------
@@ -102,7 +127,15 @@ class BlockAccessor:
         raise ValueError(f"unknown batch_format {batch_format!r}")
 
     def iter_rows(self) -> Iterator[Dict[str, Any]]:
-        for row in self._table.to_pylist():
+        tensor_cols = {
+            name: _column_to_numpy(self._table.column(name))
+            for name in self._table.column_names
+            if isinstance(self._table.schema.field(name).type,
+                          pa.FixedShapeTensorType)
+        }
+        for i, row in enumerate(self._table.to_pylist()):
+            for name, arr in tensor_cols.items():
+                row[name] = arr[i]  # to_pylist flattens tensor extensions
             yield row
 
     def slice(self, start: int, end: int) -> Block:

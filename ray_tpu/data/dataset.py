@@ -381,6 +381,72 @@ class Dataset:
 
         self._write(path, w, ".npy")
 
+    def write_tfrecords(self, path: str, **_kw) -> None:
+        """tf.train.Example TFRecords via the built-in codec (no TF)."""
+        from ray_tpu.data._internal import tfrecords as tfr
+
+        def w(block, p):
+            with open(p, "wb") as f:
+                for row in BlockAccessor.for_block(block).iter_rows():
+                    tfr.write_record(f, tfr.encode_example(row))
+
+        self._write(path, w, ".tfrecords")
+
+    def write_webdataset(self, path: str, **_kw) -> None:
+        """WebDataset tar shards: row["__key__"] names the sample (generated
+        if absent); each other column becomes `<key>.<column>` with bytes /
+        utf-8 content."""
+        import io
+        import tarfile
+
+        def w(block, p):
+            with tarfile.open(p, "w") as tf:
+                for i, row in enumerate(
+                        BlockAccessor.for_block(block).iter_rows()):
+                    key = str(row.pop("__key__", f"sample{i:06d}"))
+                    for col, value in row.items():
+                        if isinstance(value, np.ndarray):
+                            # .npy bytes — full-fidelity (str() would
+                            # truncate); np.load(BytesIO(...)) recovers it
+                            buf = io.BytesIO()
+                            np.save(buf, value)
+                            value = buf.getvalue()
+                        elif not isinstance(value, bytes):
+                            value = str(value).encode()
+                        info = tarfile.TarInfo(f"{key}.{col}")
+                        info.size = len(value)
+                        tf.addfile(info, io.BytesIO(value))
+
+        self._write(path, w, ".tar")
+
+    def write_sql(self, sql: str, connection_factory: Callable, **_kw) -> None:
+        """Run a parameterized INSERT per row over a DBAPI connection
+        (reference: dataset.py write_sql — e.g. "INSERT INTO t VALUES (?, ?)")."""
+        def bindable(v):
+            if isinstance(v, np.generic):
+                return v.item()
+            if isinstance(v, np.ndarray):
+                # DBAPI drivers can't bind arrays; store round-trippable
+                # .npy bytes (np.load(BytesIO(blob)) recovers the tensor)
+                import io
+
+                buf = io.BytesIO()
+                np.save(buf, v)
+                return buf.getvalue()
+            return v
+
+        conn = connection_factory()
+        try:
+            cur = conn.cursor()
+            for block in self.iter_blocks():
+                acc = BlockAccessor.for_block(block)
+                cur.executemany(sql, [tuple(bindable(v)
+                                            for v in r.values())
+                                      for r in acc.iter_rows()])
+            conn.commit()
+        finally:
+            conn.close()
+
     # -- misc ----------------------------------------------------------------
 
     def num_blocks(self) -> int:

@@ -1,0 +1,47 @@
+"""Fit/transform preprocessors over Datasets.
+
+Reference counterpart: ray python/ray/data/preprocessors/ (Preprocessor base
+python/ray/data/preprocessor.py; scalers scaler.py, encoders encoder.py,
+imputer imputer.py, concatenator concatenator.py, chain chain.py,
+batch_mapper batch_mapper.py). Stats are fit with a single streaming pass
+over numpy batches; transform is a lazy map_batches so it fuses into the
+streaming executor (and stays off the driver for iter_jax_batches feeds).
+"""
+
+from ray_tpu.data.preprocessors.preprocessor import (  # noqa: F401
+    Preprocessor,
+    PreprocessorNotFittedError,
+)
+from ray_tpu.data.preprocessors.batch_mapper import BatchMapper  # noqa: F401
+from ray_tpu.data.preprocessors.chain import Chain  # noqa: F401
+from ray_tpu.data.preprocessors.concatenator import Concatenator  # noqa: F401
+from ray_tpu.data.preprocessors.encoder import (  # noqa: F401
+    LabelEncoder,
+    OneHotEncoder,
+    OrdinalEncoder,
+)
+from ray_tpu.data.preprocessors.imputer import SimpleImputer  # noqa: F401
+from ray_tpu.data.preprocessors.scaler import (  # noqa: F401
+    MaxAbsScaler,
+    MinMaxScaler,
+    Normalizer,
+    RobustScaler,
+    StandardScaler,
+)
+
+__all__ = [
+    "BatchMapper",
+    "Chain",
+    "Concatenator",
+    "LabelEncoder",
+    "MaxAbsScaler",
+    "MinMaxScaler",
+    "Normalizer",
+    "OneHotEncoder",
+    "OrdinalEncoder",
+    "Preprocessor",
+    "PreprocessorNotFittedError",
+    "RobustScaler",
+    "SimpleImputer",
+    "StandardScaler",
+]

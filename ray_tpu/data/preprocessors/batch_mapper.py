@@ -1,0 +1,22 @@
+"""BatchMapper (reference: ray python/ray/data/preprocessors/batch_mapper.py
+— wrap a user batch function as a stateless preprocessor so it can live in a
+Chain and be stored with checkpoints)."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import numpy as np
+
+from ray_tpu.data.preprocessors.preprocessor import Preprocessor
+
+
+class BatchMapper(Preprocessor):
+    _is_fittable = False
+
+    def __init__(self, fn: Callable[[Dict[str, np.ndarray]], Dict[str, np.ndarray]]):
+        super().__init__()
+        self.fn = fn
+
+    def _transform_numpy(self, batch):
+        return self.fn(batch)

@@ -1,0 +1,37 @@
+"""Chain (reference: ray python/ray/data/preprocessors/chain.py — sequential
+composition; fit runs each stage on the output of the previous ones)."""
+
+from __future__ import annotations
+
+from ray_tpu.data.preprocessors.preprocessor import Preprocessor
+
+
+class Chain(Preprocessor):
+    def __init__(self, *stages: Preprocessor):
+        super().__init__()
+        self.stages = list(stages)
+
+    def _fit(self, dataset):
+        # Fitting stage k requires the data as transformed by stages <k.
+        for stage in self.stages:
+            dataset = stage.fit(dataset).transform(dataset)
+
+    def fit_transform(self, dataset):
+        for stage in self.stages:
+            dataset = stage.fit(dataset).transform(dataset)
+        self._fitted = True
+        return dataset
+
+    def transform(self, dataset):
+        self._check_fitted()
+        for stage in self.stages:
+            dataset = stage.transform(dataset)
+        return dataset
+
+    def _transform_numpy(self, batch):
+        for stage in self.stages:
+            batch = stage._transform_numpy(batch)
+        return batch
+
+    def __repr__(self):
+        return f"Chain({', '.join(repr(s) for s in self.stages)})"

@@ -1,0 +1,35 @@
+"""Concatenator (reference: ray python/ray/data/preprocessors/
+concatenator.py — merge numeric columns into one vector column, the standard
+final step before feeding a model)."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ray_tpu.data.preprocessors.preprocessor import Preprocessor
+
+
+class Concatenator(Preprocessor):
+    _is_fittable = False
+
+    def __init__(self, columns: Optional[List[str]] = None,
+                 output_column_name: str = "concat_out",
+                 exclude: Optional[List[str]] = None,
+                 dtype=np.float32):
+        super().__init__()
+        self.columns = columns
+        self.output_column_name = output_column_name
+        self.exclude = set(exclude or [])
+        self.dtype = dtype
+
+    def _transform_numpy(self, batch):
+        cols = self.columns or [c for c in batch if c not in self.exclude]
+        parts = []
+        for c in cols:
+            v = np.asarray(batch[c], dtype=self.dtype)
+            parts.append(v[:, None] if v.ndim == 1 else v.reshape(len(v), -1))
+            del batch[c]
+        batch[self.output_column_name] = np.concatenate(parts, axis=1)
+        return batch

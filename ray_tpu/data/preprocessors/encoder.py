@@ -1,0 +1,92 @@
+"""Categorical encoders (reference: ray python/ray/data/preprocessors/
+encoder.py — OrdinalEncoder/OneHotEncoder/LabelEncoder; unseen categories
+encode as -1 / all-zeros like the reference's null handling)."""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from ray_tpu.data.preprocessors.preprocessor import Preprocessor
+
+
+def _unique_values(dataset, columns: List[str]) -> Dict[str, list]:
+    uniques: Dict[str, set] = {c: set() for c in columns}
+    for batch in dataset.iter_batches(batch_format="numpy"):
+        for c in columns:
+            uniques[c].update(np.asarray(batch[c]).ravel().tolist())
+    return {c: sorted(vals, key=str) for c, vals in uniques.items()}
+
+
+class OrdinalEncoder(Preprocessor):
+    """category -> dense int index (sorted order); unseen -> -1."""
+
+    def __init__(self, columns: List[str]):
+        super().__init__()
+        self.columns = columns
+
+    def _fit(self, dataset):
+        for c, vals in _unique_values(dataset, self.columns).items():
+            self.stats_[f"unique_values({c})"] = {v: i for i, v in
+                                                  enumerate(vals)}
+
+    def _transform_numpy(self, batch):
+        for c in self.columns:
+            mapping = self.stats_[f"unique_values({c})"]
+            col = np.asarray(batch[c])
+            batch[c] = np.array([mapping.get(v, -1) for v in col.tolist()],
+                                dtype=np.int64)
+        return batch
+
+
+class OneHotEncoder(Preprocessor):
+    """column -> one `{col}_{value}` 0/1 column per category; unseen rows
+    are all-zeros."""
+
+    def __init__(self, columns: List[str]):
+        super().__init__()
+        self.columns = columns
+
+    def _fit(self, dataset):
+        for c, vals in _unique_values(dataset, self.columns).items():
+            self.stats_[f"unique_values({c})"] = vals
+
+    def _transform_numpy(self, batch):
+        for c in self.columns:
+            vals = self.stats_[f"unique_values({c})"]
+            col = np.asarray(batch[c]).tolist()
+            for v in vals:
+                batch[f"{c}_{v}"] = np.array([1 if x == v else 0 for x in col],
+                                             dtype=np.int64)
+            del batch[c]
+        return batch
+
+
+class LabelEncoder(Preprocessor):
+    """Single label column -> dense int index; unseen -> -1."""
+
+    def __init__(self, label_column: str):
+        super().__init__()
+        self.label_column = label_column
+
+    def _fit(self, dataset):
+        vals = _unique_values(dataset, [self.label_column])[self.label_column]
+        self.stats_[f"unique_values({self.label_column})"] = {
+            v: i for i, v in enumerate(vals)}
+
+    def _transform_numpy(self, batch):
+        mapping = self.stats_[f"unique_values({self.label_column})"]
+        col = np.asarray(batch[self.label_column])
+        batch[self.label_column] = np.array(
+            [mapping.get(v, -1) for v in col.tolist()], dtype=np.int64)
+        return batch
+
+    def inverse_transform_batch(self, batch):
+        self._check_fitted()
+        mapping = self.stats_[f"unique_values({self.label_column})"]
+        inverse = {i: v for v, i in mapping.items()}
+        col = np.asarray(batch[self.label_column])
+        batch[self.label_column] = np.array(
+            [inverse.get(int(v)) for v in col.tolist()])
+        return batch

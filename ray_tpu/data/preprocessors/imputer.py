@@ -1,0 +1,74 @@
+"""Missing-value imputer (reference: ray python/ray/data/preprocessors/
+imputer.py — SimpleImputer with mean/most_frequent/constant strategies)."""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Any, List, Optional
+
+import numpy as np
+
+from ray_tpu.data.preprocessors.preprocessor import Preprocessor
+
+
+def _missing_mask(col: np.ndarray) -> np.ndarray:
+    if col.dtype.kind == "f":
+        return np.isnan(col)
+    return np.array([v is None for v in col.tolist()])
+
+
+class SimpleImputer(Preprocessor):
+    def __init__(self, columns: List[str], strategy: str = "mean",
+                 fill_value: Optional[Any] = None):
+        super().__init__()
+        if strategy not in ("mean", "most_frequent", "constant"):
+            raise ValueError(f"unknown strategy {strategy!r}")
+        if strategy == "constant" and fill_value is None:
+            raise ValueError("strategy='constant' requires fill_value")
+        self.columns = columns
+        self.strategy = strategy
+        self.fill_value = fill_value
+
+    def _fit(self, dataset):
+        if self.strategy == "constant":
+            return
+        if self.strategy == "mean":
+            total = {c: 0.0 for c in self.columns}
+            count = {c: 0 for c in self.columns}
+            for batch in dataset.iter_batches(batch_format="numpy"):
+                for c in self.columns:
+                    col = np.asarray(batch[c], dtype=np.float64)
+                    ok = ~np.isnan(col)
+                    total[c] += float(col[ok].sum())
+                    count[c] += int(ok.sum())
+            for c in self.columns:
+                self.stats_[f"mean({c})"] = (
+                    total[c] / count[c] if count[c] else 0.0)
+        else:  # most_frequent
+            counters = {c: Counter() for c in self.columns}
+            for batch in dataset.iter_batches(batch_format="numpy"):
+                for c in self.columns:
+                    col = np.asarray(batch[c])
+                    present = col[~_missing_mask(col)]
+                    counters[c].update(present.tolist())
+            for c in self.columns:
+                common = counters[c].most_common(1)
+                self.stats_[f"most_frequent({c})"] = (
+                    common[0][0] if common else None)
+
+    def _transform_numpy(self, batch):
+        for c in self.columns:
+            col = np.asarray(batch[c])
+            if self.strategy == "mean":
+                col = np.asarray(col, dtype=np.float64)
+                fill = self.stats_[f"mean({c})"]
+            elif self.strategy == "most_frequent":
+                fill = self.stats_[f"most_frequent({c})"]
+            else:
+                fill = self.fill_value
+            mask = _missing_mask(col)
+            if mask.any():
+                col = col.copy()
+                col[mask] = fill
+            batch[c] = col
+        return batch

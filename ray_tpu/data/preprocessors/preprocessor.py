@@ -1,0 +1,75 @@
+"""Preprocessor base class (reference: ray python/ray/data/preprocessor.py —
+fit/transform/fit_transform/transform_batch with a fitted-state check)."""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, Dict
+
+import numpy as np
+
+
+class PreprocessorNotFittedError(RuntimeError):
+    """transform() was called before fit() on a stateful preprocessor."""
+
+
+class Preprocessor:
+    """Fit statistics on a Dataset, then transform Datasets or batches.
+
+    Subclasses implement `_fit(dataset)` (populate `self.stats_`) and
+    `_transform_numpy(batch)` (pure function of batch + stats, run inside
+    map_batches workers).
+    """
+
+    # Stateless preprocessors (e.g. Concatenator) override with False.
+    _is_fittable: bool = True
+
+    def __init__(self):
+        self.stats_: Dict[str, Any] = {}
+        self._fitted = False
+
+    # -- public API ----------------------------------------------------------
+
+    def fit(self, dataset) -> "Preprocessor":
+        if self._is_fittable:
+            self._fit(dataset)
+        self._fitted = True
+        return self
+
+    def fit_transform(self, dataset):
+        return self.fit(dataset).transform(dataset)
+
+    def transform(self, dataset):
+        self._check_fitted()
+        return dataset.map_batches(self._transform_numpy)
+
+    def transform_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        self._check_fitted()
+        return self._transform_numpy(dict(batch))
+
+    def _check_fitted(self):
+        if self._is_fittable and not self._fitted:
+            raise PreprocessorNotFittedError(
+                f"{type(self).__name__} must be fit before transform; "
+                "call .fit(dataset) or .fit_transform(dataset)")
+
+    # -- persistence (checkpoints embed fitted preprocessors) ----------------
+
+    def serialize(self) -> bytes:
+        return pickle.dumps(self)
+
+    @staticmethod
+    def deserialize(data: bytes) -> "Preprocessor":
+        return pickle.loads(data)
+
+    # -- subclass hooks ------------------------------------------------------
+
+    def _fit(self, dataset) -> None:
+        raise NotImplementedError
+
+    def _transform_numpy(self, batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        raise NotImplementedError
+
+    def __repr__(self):
+        stats = ", ".join(sorted(self.stats_)) if self.stats_ else "unfitted"
+        return f"{type(self).__name__}({stats})"

@@ -1,0 +1,163 @@
+"""Column scalers (reference: ray python/ray/data/preprocessors/scaler.py —
+StandardScaler/MinMaxScaler/MaxAbsScaler/RobustScaler/Normalizer)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.data.preprocessors.preprocessor import Preprocessor
+
+
+def _column_moments(dataset, columns: List[str]):
+    """One streaming pass: per-column count/sum/sumsq (float64)."""
+    count = {c: 0 for c in columns}
+    total = {c: 0.0 for c in columns}
+    sumsq = {c: 0.0 for c in columns}
+    for batch in dataset.iter_batches(batch_format="numpy"):
+        for c in columns:
+            col = np.asarray(batch[c], dtype=np.float64)
+            count[c] += col.size
+            total[c] += float(col.sum())
+            sumsq[c] += float((col * col).sum())
+    return count, total, sumsq
+
+
+class StandardScaler(Preprocessor):
+    """x -> (x - mean) / std, std==0 treated as 1."""
+
+    def __init__(self, columns: List[str]):
+        super().__init__()
+        self.columns = columns
+
+    def _fit(self, dataset):
+        count, total, sumsq = _column_moments(dataset, self.columns)
+        for c in self.columns:
+            n = max(count[c], 1)
+            mean = total[c] / n
+            var = max(sumsq[c] / n - mean * mean, 0.0)
+            std = float(np.sqrt(var))
+            self.stats_[f"mean({c})"] = mean
+            self.stats_[f"std({c})"] = std if std > 0 else 1.0
+
+    def _transform_numpy(self, batch):
+        for c in self.columns:
+            batch[c] = ((np.asarray(batch[c], dtype=np.float64)
+                         - self.stats_[f"mean({c})"])
+                        / self.stats_[f"std({c})"])
+        return batch
+
+
+class MinMaxScaler(Preprocessor):
+    """x -> (x - min) / (max - min); constant columns map to 0."""
+
+    def __init__(self, columns: List[str]):
+        super().__init__()
+        self.columns = columns
+
+    def _fit(self, dataset):
+        lo = {c: np.inf for c in self.columns}
+        hi = {c: -np.inf for c in self.columns}
+        for batch in dataset.iter_batches(batch_format="numpy"):
+            for c in self.columns:
+                col = np.asarray(batch[c], dtype=np.float64)
+                if col.size:
+                    lo[c] = min(lo[c], float(col.min()))
+                    hi[c] = max(hi[c], float(col.max()))
+        for c in self.columns:
+            self.stats_[f"min({c})"] = lo[c]
+            self.stats_[f"max({c})"] = hi[c]
+
+    def _transform_numpy(self, batch):
+        for c in self.columns:
+            lo = self.stats_[f"min({c})"]
+            span = self.stats_[f"max({c})"] - lo
+            col = np.asarray(batch[c], dtype=np.float64)
+            batch[c] = (col - lo) / span if span > 0 else np.zeros_like(col)
+        return batch
+
+
+class MaxAbsScaler(Preprocessor):
+    """x -> x / max(|x|); all-zero columns stay 0."""
+
+    def __init__(self, columns: List[str]):
+        super().__init__()
+        self.columns = columns
+
+    def _fit(self, dataset):
+        peak = {c: 0.0 for c in self.columns}
+        for batch in dataset.iter_batches(batch_format="numpy"):
+            for c in self.columns:
+                col = np.asarray(batch[c], dtype=np.float64)
+                if col.size:
+                    peak[c] = max(peak[c], float(np.abs(col).max()))
+        for c in self.columns:
+            self.stats_[f"abs_max({c})"] = peak[c] if peak[c] > 0 else 1.0
+
+    def _transform_numpy(self, batch):
+        for c in self.columns:
+            batch[c] = (np.asarray(batch[c], dtype=np.float64)
+                        / self.stats_[f"abs_max({c})"])
+        return batch
+
+
+class RobustScaler(Preprocessor):
+    """x -> (x - median) / IQR, quantiles over the fit dataset.
+
+    Quantiles are exact: fitting materializes each column once (reference
+    semantics; scale-out approximate quantiles can come later).
+    """
+
+    def __init__(self, columns: List[str],
+                 quantile_range: tuple = (0.25, 0.75)):
+        super().__init__()
+        self.columns = columns
+        self.quantile_range = quantile_range
+
+    def _fit(self, dataset):
+        values: Dict[str, list] = {c: [] for c in self.columns}
+        for batch in dataset.iter_batches(batch_format="numpy"):
+            for c in self.columns:
+                values[c].append(np.asarray(batch[c], dtype=np.float64).ravel())
+        lo_q, hi_q = self.quantile_range
+        for c in self.columns:
+            col = np.concatenate(values[c]) if values[c] else np.zeros(1)
+            lo, med, hi = np.quantile(col, [lo_q, 0.5, hi_q])
+            iqr = float(hi - lo)
+            self.stats_[f"median({c})"] = float(med)
+            self.stats_[f"iqr({c})"] = iqr if iqr > 0 else 1.0
+
+    def _transform_numpy(self, batch):
+        for c in self.columns:
+            batch[c] = ((np.asarray(batch[c], dtype=np.float64)
+                         - self.stats_[f"median({c})"])
+                        / self.stats_[f"iqr({c})"])
+        return batch
+
+
+class Normalizer(Preprocessor):
+    """Row-wise norm scaling across a set of columns (stateless)."""
+
+    _is_fittable = False
+
+    def __init__(self, columns: List[str], norm: str = "l2"):
+        super().__init__()
+        if norm not in ("l1", "l2", "max"):
+            raise ValueError(f"norm must be l1/l2/max, got {norm!r}")
+        self.columns = columns
+        self.norm = norm
+
+    def _transform_numpy(self, batch):
+        cols = [np.asarray(batch[c], dtype=np.float64) for c in self.columns]
+        mat = np.stack(cols, axis=-1)
+        if self.norm == "l1":
+            denom = np.abs(mat).sum(axis=-1)
+        elif self.norm == "l2":
+            denom = np.sqrt((mat * mat).sum(axis=-1))
+        else:
+            denom = np.abs(mat).max(axis=-1)
+        denom = np.where(denom == 0, 1.0, denom)
+        for i, c in enumerate(self.columns):
+            batch[c] = cols[i] / denom
+        return batch

@@ -258,9 +258,162 @@ def read_images(paths, *, size=None, mode: Optional[str] = None,
 
 
 def read_tfrecords(paths, **_kw) -> Dataset:
-    raise NotImplementedError(
-        "read_tfrecords requires tensorflow, which is not bundled; "
-        "read the records with read_binary_files and parse in map_batches")
+    """TFRecord files of tf.train.Example — decoded by the built-in codec
+    (_internal/tfrecords.py), no tensorflow import."""
+    files = _expand_paths(paths)
+
+    def make_task(path):
+        def read():
+            from ray_tpu.data._internal import tfrecords as tfr
+
+            rows = []
+            with open(path, "rb") as f:
+                for record in tfr.read_records(f):
+                    rows.append(tfr.decode_example(record))
+            return [BlockAccessor.rows_to_block(rows)]
+
+        return read
+
+    return _plan_from_tasks([make_task(f) for f in files])
+
+
+def read_sql(sql: str, connection_factory: Callable[[], Any],
+             *, parallelism: int = 1, **_kw) -> Dataset:
+    """Read a DBAPI-2.0 query result (reference: ray data/read_api.py:2077
+    read_sql — works with sqlite3, psycopg2, any DBAPI connection factory).
+
+    With parallelism > 1 each task runs the query on its own connection and
+    keeps the rows whose stable content hash lands in its shard — row order
+    may differ per connection (no ORDER BY required), but each row
+    occurrence is kept exactly once across shards. Note each worker still
+    executes the full query; use parallelism=1 for expensive queries.
+    """
+    import builtins
+    import zlib
+
+    def make_task(shard: int, total: int):
+        def read():
+            conn = connection_factory()
+            try:
+                cur = conn.cursor()
+                cur.execute(sql)
+                rows = cur.fetchall()
+                if total > 1:
+                    # Stable striping: hash canonicalized row content (+
+                    # occurrence index among identical rows) so the shard
+                    # split is identical on every connection regardless of
+                    # row order. memoryview (e.g. bytea) must become bytes
+                    # first — its repr is an address, not content.
+                    def canon(r):
+                        return repr(tuple(
+                            bytes(v) if isinstance(v, memoryview) else v
+                            for v in r)).encode()
+
+                    seen: Dict[bytes, int] = {}
+                    kept = []
+                    for r in rows:
+                        key = canon(r)
+                        occ = seen.get(key, 0)
+                        seen[key] = occ + 1
+                        if zlib.crc32(key + str(occ).encode()) % total \
+                                == shard:
+                            kept.append(r)
+                    rows = kept
+                cols = [d[0] for d in cur.description]
+                dict_rows = [dict(zip(cols, r)) for r in rows]
+                return [BlockAccessor.rows_to_block(dict_rows)]
+            finally:
+                conn.close()
+
+        return read
+
+    n = max(1, parallelism)
+    return _plan_from_tasks([make_task(i, n) for i in builtins.range(n)])
+
+
+def _torch_sample_to_row(sample) -> Dict[str, Any]:
+    """One torch sample → arrow-compatible row: tensors become numpy,
+    (input, label)-style tuples become item_0..item_k columns, dicts keep
+    their keys, everything else lands in "item"."""
+    def conv(v):
+        if hasattr(v, "detach") and hasattr(v, "numpy"):  # torch.Tensor
+            return v.detach().cpu().numpy()
+        if hasattr(v, "__array__") and not isinstance(v, np.ndarray):
+            return np.asarray(v)  # e.g. PIL Image
+        return v
+
+    if isinstance(sample, dict):
+        return {k: conv(v) for k, v in sample.items()}
+    if isinstance(sample, (tuple, list)):
+        return {f"item_{i}": conv(v) for i, v in enumerate(sample)}
+    return {"item": conv(sample)}
+
+
+def from_torch(torch_dataset) -> Dataset:
+    """A torch.utils.data.Dataset → rows (reference: ray
+    data/read_api.py:2901 from_torch). Tensors are converted to numpy and
+    tuple samples to item_0..k columns (see _torch_sample_to_row).
+    Map-style datasets are split into index-range read tasks;
+    iterable-style are read in one task."""
+    import builtins
+
+    try:
+        n = len(torch_dataset)
+    except TypeError:
+        def read_all():
+            rows = [_torch_sample_to_row(s) for s in torch_dataset]
+            return [BlockAccessor.rows_to_block(rows)]
+
+        return _plan_from_tasks([read_all])
+
+    blocks = max(1, min(8, n))
+    per = (n + blocks - 1) // blocks
+
+    def make_task(start, end):
+        def read():
+            rows = [_torch_sample_to_row(torch_dataset[i])
+                    for i in builtins.range(start, end)]
+            return [BlockAccessor.rows_to_block(rows)]
+
+        return read
+
+    return _plan_from_tasks(
+        [make_task(i * per, min((i + 1) * per, n))
+         for i in builtins.range(blocks) if i * per < n])
+
+
+def read_webdataset(paths, **_kw) -> Dataset:
+    """WebDataset tar shards (reference: ray data/read_api.py:1870): each
+    sample is the group of tar members sharing a basename; extensions become
+    columns ("__key__" carries the basename). Pure tarfile, no wds dep."""
+    files = _expand_paths(paths)
+
+    def make_task(path):
+        def read():
+            import tarfile
+
+            samples: Dict[str, Dict[str, Any]] = {}
+            order: List[str] = []
+            with tarfile.open(path) as tf:
+                for member in tf.getmembers():
+                    if not member.isfile():
+                        continue
+                    # split at the first dot of the BASENAME — dots in
+                    # directory components must not affect grouping
+                    dirname, _, fname = member.name.rpartition("/")
+                    stem, dot, ext = fname.partition(".")
+                    base = f"{dirname}/{stem}" if dirname else stem
+                    if base not in samples:
+                        samples[base] = {"__key__": base}
+                        order.append(base)
+                    data = tf.extractfile(member).read()
+                    samples[base][ext if dot else "data"] = data
+            return [BlockAccessor.rows_to_block(
+                [samples[k] for k in order])]
+
+        return read
+
+    return _plan_from_tasks([make_task(f) for f in files])
 
 
 def read_datasource(datasource, *, parallelism: int = -1, **kwargs) -> Dataset:

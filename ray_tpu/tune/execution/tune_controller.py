@@ -59,9 +59,18 @@ class TuneController:
         self._trainable = trainable
         self._searcher = searcher or BasicVariantGenerator(
             param_space or {}, num_samples=num_samples)
+        # Budget for searchers that sample forever (TPE/BayesOpt/BOHB):
+        # BasicVariantGenerator enforces its own grid*num_samples queue and
+        # returns FINISHED; for every other searcher the controller caps
+        # total trials at num_samples (reference: SearchGenerator budget).
+        inner = getattr(self._searcher, "searcher", self._searcher)
+        self._num_samples = (
+            None if isinstance(inner, BasicVariantGenerator) else num_samples)
         self._searcher.set_search_properties(metric, mode, param_space or {})
         self._scheduler = scheduler or FIFOScheduler()
         self._scheduler.set_search_properties(metric, mode)
+        if hasattr(self._scheduler, "set_controller"):
+            self._scheduler.set_controller(self)
         self._metric = metric
         self._mode = mode
         self._max_concurrent = max_concurrent_trials or 8
@@ -74,6 +83,7 @@ class TuneController:
         self.trials: List[Trial] = []
         self._pending_result: Dict[Any, Trial] = {}  # ref -> trial
         self._search_done = False
+        self._num_suggested = 0
 
     # -- experiment state checkpoint ----------------------------------------
 
@@ -114,9 +124,13 @@ class TuneController:
     def _launch_trial(self, trial: Trial) -> None:
         trial.storage = StorageContext(
             self._storage_root, self._experiment_name, trial.trial_id)
+        # Per-trial override (ResourceChangingScheduler) wins over the
+        # experiment-wide default; applied whenever the actor (re)starts.
+        res = getattr(trial, "resources", None) or self._resources
+        trial._launched_resources = dict(res)
         actor = self._actor_cls.options(
-            num_cpus=self._resources.get("CPU", 1.0),
-            resources={k: v for k, v in self._resources.items()
+            num_cpus=res.get("CPU", 1.0),
+            resources={k: v for k, v in res.items()
                        if k != "CPU" and v > 0},
             max_concurrency=4,
         ).remote()
@@ -128,8 +142,11 @@ class TuneController:
             storage_path=self._storage_root,
             trial_dir=trial.storage.trial_dir,
         )
+        # Bounded wait: an actor that can never schedule (e.g. an
+        # infeasible resource override) must fail the trial, not wedge the
+        # whole event loop.
         ray_tpu.get(actor.init_session.remote(
-            ctx_kwargs, trial.latest_checkpoint))
+            ctx_kwargs, trial.latest_checkpoint), timeout=120.0)
         actor.start_training.remote(self._trainable, trial.config)
         trial.status = RUNNING
         ref = actor.next_result.remote()
@@ -154,13 +171,26 @@ class TuneController:
                and sum(1 for t in self.trials if t.status == RUNNING)
                + sum(1 for t in self.trials if t.status == PENDING)
                < self._max_concurrent):
-            config = self._searcher.suggest(f"trial_{len(self.trials)}")
+            # Cap counts searcher-suggested trials only — PBT/PB2 exploit
+            # clones are appended to self.trials without a suggest() call
+            # and must not eat the num_samples budget.
+            if (self._num_samples is not None
+                    and self._num_suggested >= self._num_samples):
+                self._search_done = True
+                return
+            # The id handed to suggest() MUST be the trial's real id: the
+            # searcher's on_trial_result/complete callbacks receive
+            # trial.trial_id, and stateful searchers (ConcurrencyLimiter,
+            # TPE) key their live-trial maps on it.
+            tid = f"trial_{len(self.trials)}_{os.urandom(3).hex()}"
+            config = self._searcher.suggest(tid)
             if config == Searcher.FINISHED:
                 self._search_done = True
                 return
             if config is None:
                 return
-            trial = Trial(config, self._experiment_name)
+            self._num_suggested += 1
+            trial = Trial(config, self._experiment_name, trial_id=tid)
             self._scheduler.on_trial_add(trial)
             self.trials.append(trial)
 
@@ -199,6 +229,19 @@ class TuneController:
             clone.latest_checkpoint = exploit["checkpoint"]
             self._scheduler.on_trial_add(clone)
             self.trials.append(clone)
+        elif (trial.resources is not None and trial.latest_checkpoint
+              and trial.resources != getattr(trial, "_launched_resources",
+                                             None)):
+            # ResourceChangingScheduler: apply new resources at a checkpoint
+            # boundary by restarting the actor; the PENDING pass in step()
+            # relaunches it with trial.resources and the latest checkpoint.
+            if trial.actor is not None:
+                try:
+                    ray_tpu.kill(trial.actor)
+                except Exception:  # noqa: BLE001 — teardown best-effort
+                    pass
+                trial.actor = None
+            trial.status = PENDING
         else:
             ref = trial.actor.next_result.remote()
             self._pending_result[ref] = trial

@@ -29,6 +29,8 @@ class Trial:
         self.storage = None
         self.restarts = 0
         self.pbt_exploit: Optional[Dict[str, Any]] = None
+        # per-trial resource override (ResourceChangingScheduler)
+        self.resources: Optional[Dict[str, float]] = None
 
     def __repr__(self):
         return f"Trial({self.trial_id}, {self.status})"
@@ -42,6 +44,7 @@ class Trial:
             "num_results": self.num_results,
             "error": self.error,
             "checkpoint_path": getattr(self.latest_checkpoint, "path", None),
+            "resources": self.resources,
         }
 
     @classmethod
@@ -51,6 +54,7 @@ class Trial:
         t.last_result = data.get("last_result")
         t.num_results = data.get("num_results", 0)
         t.error = data.get("error")
+        t.resources = data.get("resources")
         p = data.get("checkpoint_path")
         if p:
             from ray_tpu.train.checkpoint import Checkpoint

@@ -1,11 +1,18 @@
 """Trial schedulers (reference: ray python/ray/tune/schedulers/ —
 FIFOScheduler, ASHA async_hyperband.py, HyperBandScheduler, median stopping,
-PBT pbt.py)."""
+PBT pbt.py, PB2 pb2.py, BOHB hb_bohb.py, resource-changing
+resource_changing_scheduler.py)."""
 
+from ray_tpu.tune.schedulers.pb2 import PB2  # noqa: F401
+from ray_tpu.tune.schedulers.resource_changing import (  # noqa: F401
+    DistributeResources,
+    ResourceChangingScheduler,
+)
 from ray_tpu.tune.schedulers.schedulers import (  # noqa: F401
     ASHAScheduler,
     AsyncHyperBandScheduler,
     FIFOScheduler,
+    HyperBandForBOHB,
     HyperBandScheduler,
     MedianStoppingRule,
     PopulationBasedTraining,
@@ -15,9 +22,13 @@ from ray_tpu.tune.schedulers.schedulers import (  # noqa: F401
 __all__ = [
     "ASHAScheduler",
     "AsyncHyperBandScheduler",
+    "DistributeResources",
     "FIFOScheduler",
+    "HyperBandForBOHB",
     "HyperBandScheduler",
     "MedianStoppingRule",
+    "PB2",
     "PopulationBasedTraining",
+    "ResourceChangingScheduler",
     "TrialScheduler",
 ]

@@ -1,0 +1,86 @@
+"""PB2: Population Based Bandits (reference: ray
+python/ray/tune/schedulers/pb2.py — PBT where the explore step selects new
+hyperparameters with a GP-bandit over the population's recent
+(config -> score improvement) data instead of random perturbation; Parker-
+Holder et al. 2020). Uses the native GP from search/_gp.py (the reference
+imports GPy)."""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.tune.schedulers.schedulers import PopulationBasedTraining
+from ray_tpu.tune.search._gp import GP
+
+
+class PB2(PopulationBasedTraining):
+    def __init__(self, time_attr: str = "training_iteration",
+                 metric: Optional[str] = None, mode: str = "max",
+                 perturbation_interval: int = 4,
+                 hyperparam_bounds: Optional[Dict[str, List[float]]] = None,
+                 quantile_fraction: float = 0.25,
+                 seed: Optional[int] = None):
+        super().__init__(
+            time_attr=time_attr, metric=metric, mode=mode,
+            perturbation_interval=perturbation_interval,
+            hyperparam_mutations={}, quantile_fraction=quantile_fraction,
+            seed=seed)
+        self.bounds = hyperparam_bounds or {}
+        # (warped config vector, score improvement) observations
+        self._gp_data: List[Tuple[np.ndarray, float]] = []
+        self._prev_score: Dict[str, float] = {}
+        self._np_rng = np.random.default_rng(seed)
+
+    # -- GP data collection --------------------------------------------------
+
+    def _warp(self, config: Dict[str, Any]) -> np.ndarray:
+        out = []
+        for k, (lo, hi) in self.bounds.items():
+            v = float(config.get(k, lo))
+            out.append((v - lo) / (hi - lo) if hi > lo else 0.0)
+        return np.array(out)
+
+    def _unwarp(self, u: np.ndarray) -> Dict[str, Any]:
+        out = {}
+        for i, (k, (lo, hi)) in enumerate(self.bounds.items()):
+            out[k] = lo + float(np.clip(u[i], 0, 1)) * (hi - lo)
+        return out
+
+    def on_trial_result(self, trial, result):
+        if self.metric in result and self.bounds:
+            tid = trial.trial_id
+            score = self._score(result)
+            prev = self._prev_score.get(tid)
+            if prev is not None:
+                self._gp_data.append((self._warp(trial.config),
+                                      score - prev))
+                if len(self._gp_data) > 200:
+                    self._gp_data.pop(0)
+            self._prev_score[tid] = score
+        return super().on_trial_result(trial, result)
+
+    # -- explore = GP-UCB over bounds ---------------------------------------
+
+    def _explore(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        new = dict(config)
+        if not self.bounds:
+            return new
+        if len(self._gp_data) < 4:
+            # cold start: uniform resample within bounds
+            u = self._np_rng.random(len(self.bounds))
+            new.update(self._unwarp(u))
+            return new
+        x = np.stack([d[0] for d in self._gp_data])
+        y = np.array([d[1] for d in self._gp_data])
+        gp = GP().fit(x, y)
+        cands = self._np_rng.random((128, len(self.bounds)))
+        best = cands[int(np.argmax(gp.ucb(cands, kappa=2.0)))]
+        new.update(self._unwarp(best))
+        return new
+
+    def on_trial_complete(self, trial, result):
+        self._prev_score.pop(trial.trial_id, None)
+        super().on_trial_complete(trial, result)

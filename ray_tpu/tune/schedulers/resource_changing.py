@@ -1,0 +1,78 @@
+"""ResourceChangingScheduler (reference: ray
+python/ray/tune/schedulers/resource_changing_scheduler.py — wraps a base
+scheduler; a resources_allocation_function proposes new per-trial resources
+on each result; DistributeResources spreads the cluster's free CPUs evenly
+over running trials).
+
+Updated resources are stored on `trial.resources` and take effect the next
+time the trial's actor is (re)started — the same apply-on-restart semantics
+the reference uses (resources change at checkpoint boundaries)."""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.tune.schedulers.schedulers import TrialScheduler
+
+
+class DistributeResources:
+    """Evenly divide the cluster's CPUs among live trials (reference:
+    resource_changing_scheduler.py DistributeResources)."""
+
+    def __init__(self, add_bundles: bool = False):
+        self.add_bundles = add_bundles
+
+    def __call__(self, controller, trial, result,
+                 scheduler) -> Optional[Dict[str, float]]:
+        import ray_tpu
+
+        try:
+            total = ray_tpu.cluster_resources().get("CPU", 1.0)
+        except Exception:  # noqa: BLE001 — no cluster (unit tests)
+            total = 1.0
+        # Count PENDING too: a trial mid-restart still owns its share —
+        # otherwise allocations oscillate and can oversubscribe.
+        live = max(1, sum(
+            1 for t in getattr(controller, "trials", [trial])
+            if getattr(t, "status", "RUNNING") in ("RUNNING", "PENDING")))
+        per = max(1.0, math.floor(total / live))
+        # Merge over the trial's current allocation so non-CPU resources
+        # (e.g. TPU) from resources_per_trial survive the update.
+        base = dict(getattr(trial, "resources", None)
+                    or getattr(controller, "_resources", None) or {})
+        base["CPU"] = per
+        return base
+
+
+class ResourceChangingScheduler(TrialScheduler):
+    def __init__(self, base_scheduler: Optional[TrialScheduler] = None,
+                 resources_allocation_function: Optional[Callable] = None):
+        base = base_scheduler or TrialScheduler()
+        super().__init__(base.time_attr, base.metric, base.mode)
+        self.base_scheduler = base
+        self.alloc_fn = resources_allocation_function or DistributeResources()
+        self._controller = None
+
+    def set_search_properties(self, metric, mode) -> bool:
+        super().set_search_properties(metric, mode)
+        return self.base_scheduler.set_search_properties(metric, mode)
+
+    def set_controller(self, controller) -> None:
+        self._controller = controller
+
+    def on_trial_add(self, trial):
+        self.base_scheduler.on_trial_add(trial)
+
+    def on_trial_result(self, trial, result: Dict[str, Any]) -> str:
+        decision = self.base_scheduler.on_trial_result(trial, result)
+        new = self.alloc_fn(self._controller, trial, result,
+                            self.base_scheduler)
+        if new:
+            old = getattr(trial, "resources", None)
+            if old != new:
+                trial.resources = new
+        return decision
+
+    def on_trial_complete(self, trial, result):
+        self.base_scheduler.on_trial_complete(trial, result)

@@ -109,6 +109,13 @@ class HyperBandScheduler(AsyncHyperBandScheduler):
     async_hyperband.py)."""
 
 
+class HyperBandForBOHB(AsyncHyperBandScheduler):
+    """Multi-fidelity scheduler to pair with the TuneBOHB searcher
+    (reference: schedulers/hb_bohb.py — hyperband whose rung culls feed the
+    model; our TuneBOHB learns from on_trial_result directly, so the rung
+    logic is shared with ASHA)."""
+
+
 class MedianStoppingRule(TrialScheduler):
     def __init__(self, time_attr="training_iteration", metric=None,
                  mode="max", grace_period: int = 3, min_samples_required: int = 3):

@@ -3,6 +3,9 @@ BasicVariantGenerator grid/random in basic_variant.py, Searcher base in
 searcher.py, ConcurrencyLimiter in search_generator.py)."""
 
 from ray_tpu.tune.search.basic_variant import BasicVariantGenerator  # noqa: F401
+from ray_tpu.tune.search.bayesopt import BayesOptSearch  # noqa: F401
+from ray_tpu.tune.search.bohb import TuneBOHB  # noqa: F401
+from ray_tpu.tune.search.tpe import TPESearcher  # noqa: F401
 from ray_tpu.tune.search.sample import (  # noqa: F401
     Categorical,
     Domain,
@@ -22,12 +25,15 @@ from ray_tpu.tune.search.searcher import ConcurrencyLimiter, Searcher  # noqa: F
 
 __all__ = [
     "BasicVariantGenerator",
+    "BayesOptSearch",
     "Categorical",
     "ConcurrencyLimiter",
     "Domain",
     "Float",
     "Integer",
     "Searcher",
+    "TPESearcher",
+    "TuneBOHB",
     "choice",
     "grid_search",
     "lograndint",

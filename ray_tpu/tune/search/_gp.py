@@ -1,0 +1,57 @@
+"""Tiny numpy Gaussian process used by BayesOptSearch and PB2 — RBF kernel,
+Cholesky solve, UCB acquisition. Replaces the reference's external deps
+(bayes_opt / GPy) with ~80 self-contained lines."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+
+class GP:
+    """Zero-mean GP with RBF kernel on inputs normalized to [0, 1]^d.
+
+    Targets are standardized internally; lengthscale is a fixed fraction of
+    the unit cube (robust default for the <100-point regimes HPO lives in).
+    """
+
+    def __init__(self, lengthscale: float = 0.25, noise: float = 1e-4):
+        self.lengthscale = lengthscale
+        self.noise = noise
+        self._x: np.ndarray = None
+        self._alpha: np.ndarray = None
+        self._chol: np.ndarray = None
+        self._y_mean = 0.0
+        self._y_std = 1.0
+
+    def _kernel(self, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+        d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+        return np.exp(-0.5 * d2 / self.lengthscale**2)
+
+    def fit(self, x: np.ndarray, y: np.ndarray) -> "GP":
+        x = np.atleast_2d(np.asarray(x, dtype=np.float64))
+        y = np.asarray(y, dtype=np.float64).ravel()
+        self._y_mean = float(y.mean())
+        self._y_std = float(y.std()) or 1.0
+        yn = (y - self._y_mean) / self._y_std
+        k = self._kernel(x, x) + self.noise * np.eye(len(x))
+        self._chol = np.linalg.cholesky(k)
+        self._alpha = np.linalg.solve(
+            self._chol.T, np.linalg.solve(self._chol, yn))
+        self._x = x
+        return self
+
+    def predict(self, x: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """-> (mean, std) in original target units."""
+        x = np.atleast_2d(np.asarray(x, dtype=np.float64))
+        ks = self._kernel(x, self._x)
+        mean = ks @ self._alpha
+        v = np.linalg.solve(self._chol, ks.T)
+        var = np.clip(1.0 - (v * v).sum(0), 1e-12, None)
+        return (mean * self._y_std + self._y_mean,
+                np.sqrt(var) * self._y_std)
+
+    def ucb(self, x: np.ndarray, kappa: float = 2.0) -> np.ndarray:
+        mean, std = self.predict(x)
+        return mean + kappa * std

@@ -1,0 +1,114 @@
+"""GP-UCB Bayesian optimization searcher.
+
+Reference counterpart: ray python/ray/tune/search/bayesopt/bayesopt_search.py
+(wraps the external `bayes_opt` package) — reimplemented on the native GP in
+`_gp.py`. Continuous/integer dims are normalized to the unit cube (log-warped
+where the Domain is log); categorical dims are chosen by the good/bad
+frequency ratio over past observations (TPE-style), since a stationary RBF
+GP has no useful metric over categories."""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.tune.search._gp import GP
+from ray_tpu.tune.search.sample import Categorical, Domain, Integer
+from ray_tpu.tune.search.searcher import Searcher
+
+
+class BayesOptSearch(Searcher):
+    def __init__(self, space: Optional[Dict[str, Any]] = None,
+                 metric: Optional[str] = None, mode: str = "max",
+                 n_initial_points: int = 6, kappa: float = 2.0,
+                 n_candidates: int = 256, seed: Optional[int] = None):
+        super().__init__(metric, mode)
+        self._space = space or {}
+        self.n_initial = n_initial_points
+        self.kappa = kappa
+        self.n_candidates = n_candidates
+        self._rng = random.Random(seed)
+        self._np_rng = np.random.default_rng(seed)
+        self._live: Dict[str, Dict[str, Any]] = {}
+        self._obs: List[tuple] = []
+
+    def set_search_properties(self, metric, mode, config) -> bool:
+        super().set_search_properties(metric, mode, config)
+        if config:
+            self._space = config
+        return True
+
+    def _numeric_dims(self) -> List[str]:
+        return [k for k, v in self._space.items()
+                if isinstance(v, Domain) and not isinstance(v, Categorical)]
+
+    def _warp(self, name: str, value: float) -> float:
+        d = self._space[name]
+        lo, hi = d.lower, d.upper
+        if getattr(d, "log", False):
+            return ((math.log(value) - math.log(lo))
+                    / (math.log(hi) - math.log(lo)))
+        return (value - lo) / (hi - lo)
+
+    def _unwarp(self, name: str, u: float) -> Any:
+        d = self._space[name]
+        u = float(np.clip(u, 0.0, 1.0))
+        lo, hi = d.lower, d.upper
+        if getattr(d, "log", False):
+            v = math.exp(math.log(lo) + u * (math.log(hi) - math.log(lo)))
+        else:
+            v = lo + u * (hi - lo)
+        if isinstance(d, Integer):
+            return int(round(v))
+        if getattr(d, "q", None):
+            v = round(v / d.q) * d.q
+        return v
+
+    def _random_config(self) -> Dict[str, Any]:
+        return {k: (v.sample(self._rng) if isinstance(v, Domain) else v)
+                for k, v in self._space.items()}
+
+    def _pick_categorical(self, name: str) -> Any:
+        """Good/bad frequency ratio with +1 smoothing (TPE-style)."""
+        domain = self._space[name]
+        scored = sorted(self._obs, key=lambda o: -o[1])
+        n_good = max(1, len(scored) // 4)
+        counts_g = {c: 1.0 for c in domain.categories}
+        counts_b = {c: 1.0 for c in domain.categories}
+        for i, (cfg, _) in enumerate(scored):
+            if cfg.get(name) in counts_g:
+                (counts_g if i < n_good else counts_b)[cfg[name]] += 1
+        zg, zb = sum(counts_g.values()), sum(counts_b.values())
+        return max(domain.categories,
+                   key=lambda c: (counts_g[c] / zg / (counts_b[c] / zb),
+                                  self._rng.random()))
+
+    def suggest(self, trial_id: str):
+        dims = self._numeric_dims()
+        if len(self._obs) < self.n_initial or not dims:
+            config = self._random_config()
+        else:
+            x = np.array([[self._warp(k, c[k]) for k in dims]
+                          for c, _ in self._obs])
+            y = np.array([s for _, s in self._obs])
+            gp = GP().fit(x, y)
+            cand_u = self._np_rng.random((self.n_candidates, len(dims)))
+            best = cand_u[int(np.argmax(gp.ucb(cand_u, self.kappa)))]
+            config = self._random_config()  # constants + cold categoricals
+            for k, v in self._space.items():
+                if isinstance(v, Categorical):
+                    config[k] = self._pick_categorical(k)
+            for i, k in enumerate(dims):
+                config[k] = self._unwarp(k, best[i])
+        self._live[trial_id] = config
+        return config
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        config = self._live.pop(trial_id, None)
+        if config is None or error or not result or self.metric not in result:
+            return
+        score = result[self.metric]
+        self._obs.append((config, score if self.mode == "max" else -score))

@@ -1,0 +1,34 @@
+"""BOHB searcher (reference: ray python/ray/tune/search/bohb/bohb_search.py
+wrapping hpbandster's TPE model; paired with HyperBandForBOHB). Here the
+model-based half reuses the native TPESearcher, extended to learn from
+intermediate (rung) results so it can exploit partial training runs like
+BOHB does — pair it with `HyperBandForBOHB` (schedulers)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ray_tpu.tune.search.tpe import TPESearcher
+
+
+class TuneBOHB(TPESearcher):
+    def __init__(self, space: Optional[Dict[str, Any]] = None,
+                 metric: Optional[str] = None, mode: str = "max",
+                 **kwargs):
+        super().__init__(space, metric, mode, **kwargs)
+        self._latest: Dict[str, float] = {}
+
+    def on_trial_result(self, trial_id: str, result: Dict[str, Any]) -> None:
+        # Track running best so early-stopped (rung-culled) trials still
+        # contribute an observation at their achieved fidelity.
+        if self.metric in result:
+            score = result[self.metric]
+            self._latest[trial_id] = score if self.mode == "max" else -score
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        if (not error and (not result or self.metric not in result)
+                and trial_id in self._latest):
+            result = {self.metric: self._latest[trial_id]
+                      if self.mode == "max" else -self._latest[trial_id]}
+        self._latest.pop(trial_id, None)
+        super().on_trial_complete(trial_id, result, error)

@@ -1,0 +1,150 @@
+"""Tree-structured Parzen Estimator searcher.
+
+Reference counterpart: ray python/ray/tune/search/hyperopt/hyperopt_search.py
+(and optuna's default TPE sampler behind tune's OptunaSearch) — reimplemented
+natively so no external HPO dependency is needed. Algorithm per Bergstra et
+al. 2011: split observations into good (top gamma quantile) and bad, model
+each set with a kernel density, and pick the candidate maximizing l(x)/g(x).
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.tune.search.sample import Categorical, Domain, Float, Integer
+from ray_tpu.tune.search.searcher import Searcher
+
+
+class _ParamCodec:
+    """Map one Domain to/from the real line for KDE (log-warped if log)."""
+
+    def __init__(self, domain: Domain):
+        self.domain = domain
+        self.categorical = isinstance(domain, Categorical)
+
+    def encode(self, value: Any) -> float:
+        if self.categorical:
+            return float(self.domain.categories.index(value))
+        if getattr(self.domain, "log", False):
+            return math.log(value)
+        return float(value)
+
+    def decode(self, x: float) -> Any:
+        d = self.domain
+        if self.categorical:
+            idx = int(np.clip(round(x), 0, len(d.categories) - 1))
+            return d.categories[idx]
+        if getattr(d, "log", False):
+            x = math.exp(x)
+        x = float(np.clip(x, d.lower, d.upper))
+        if isinstance(d, Integer):
+            return int(round(x))
+        if getattr(d, "q", None):
+            x = round(x / d.q) * d.q
+        return x
+
+
+def _kde_logpdf(x: float, samples: List[float], bw: float) -> float:
+    if not samples:
+        return 0.0
+    arr = np.asarray(samples)
+    z = (x - arr) / bw
+    return float(np.log(np.mean(np.exp(-0.5 * z * z) / bw + 1e-12)))
+
+
+class TPESearcher(Searcher):
+    def __init__(self, space: Optional[Dict[str, Any]] = None,
+                 metric: Optional[str] = None, mode: str = "max",
+                 n_initial_points: int = 8, gamma: float = 0.25,
+                 n_candidates: int = 24, seed: Optional[int] = None):
+        super().__init__(metric, mode)
+        self._space = space or {}
+        self.n_initial = n_initial_points
+        self.gamma = gamma
+        self.n_candidates = n_candidates
+        self._rng = random.Random(seed)
+        self._live: Dict[str, Dict[str, Any]] = {}
+        self._obs: List[tuple] = []  # (config, score)
+
+    def set_search_properties(self, metric, mode, config) -> bool:
+        super().set_search_properties(metric, mode, config)
+        if config:
+            self._space = config
+        return True
+
+    def _domains(self) -> Dict[str, Domain]:
+        return {k: v for k, v in self._space.items()
+                if isinstance(v, Domain)}
+
+    def _random_config(self) -> Dict[str, Any]:
+        out = {}
+        for k, v in self._space.items():
+            out[k] = v.sample(self._rng) if isinstance(v, Domain) else v
+        return out
+
+    def _suggest_tpe(self) -> Dict[str, Any]:
+        scored = sorted(self._obs, key=lambda o: -o[1])
+        n_good = max(1, int(len(scored) * self.gamma))
+        good, bad = scored[:n_good], scored[n_good:]
+        config = {}
+        for name, domain in self._space.items():
+            if not isinstance(domain, Domain):
+                config[name] = domain
+                continue
+            codec = _ParamCodec(domain)
+            g = [codec.encode(c[name]) for c, _ in good if name in c]
+            b = [codec.encode(c[name]) for c, _ in bad if name in c]
+            if codec.categorical:
+                # categorical TPE: P(cat|good)+prior vs P(cat|bad)+prior
+                counts_g = {c: 1.0 for c in range(len(domain.categories))}
+                for x in g:
+                    counts_g[int(x)] += 1
+                counts_b = {c: 1.0 for c in range(len(domain.categories))}
+                for x in b:
+                    counts_b[int(x)] += 1
+                ratio = {c: counts_g[c] / sum(counts_g.values())
+                         / (counts_b[c] / sum(counts_b.values()))
+                         for c in counts_g}
+                best = max(ratio, key=lambda c: (ratio[c],
+                                                 self._rng.random()))
+                config[name] = domain.categories[best]
+                continue
+            span = (codec.encode(domain.upper) - codec.encode(domain.lower)
+                    ) if not codec.categorical else 1.0
+            bw = max(span / 10.0, 1e-6)
+            # candidates: sample around good points + a few fresh draws
+            cands = []
+            for _ in range(self.n_candidates):
+                if g and self._rng.random() < 0.8:
+                    center = self._rng.choice(g)
+                    cands.append(self._rng.gauss(center, bw))
+                else:
+                    cands.append(codec.encode(domain.sample(self._rng)))
+            best_x, best_score = None, -math.inf
+            for x in cands:
+                score = (_kde_logpdf(x, g, bw)
+                         - _kde_logpdf(x, b, bw) if b else
+                         _kde_logpdf(x, g, bw))
+                if score > best_score:
+                    best_x, best_score = x, score
+            config[name] = codec.decode(best_x)
+        return config
+
+    def suggest(self, trial_id: str):
+        if len(self._obs) < self.n_initial or not self._domains():
+            config = self._random_config()
+        else:
+            config = self._suggest_tpe()
+        self._live[trial_id] = config
+        return config
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        config = self._live.pop(trial_id, None)
+        if config is None or error or not result or self.metric not in result:
+            return
+        score = result[self.metric]
+        self._obs.append((config, score if self.mode == "max" else -score))

@@ -1,0 +1,214 @@
+"""Tests for the new datasources/sinks: tfrecords codec, sql, torch,
+webdataset (reference patterns: ray python/ray/data/tests/test_tfrecords.py,
+test_sql.py, test_from_torch.py, test_webdataset.py)."""
+
+import os
+import sqlite3
+
+import numpy as np
+import pytest
+
+from ray_tpu import data
+from ray_tpu.data._internal import tfrecords as tfr
+
+
+def test_crc32c_known_vectors():
+    # Standard CRC32C test vectors (RFC 3720 appendix; "123456789").
+    assert tfr.crc32c(b"123456789") == 0xE3069283
+    assert tfr.crc32c(b"") == 0
+
+
+def test_example_codec_roundtrip():
+    row = {
+        "name": b"abc",
+        "score": np.array([1.5, -2.0], dtype=np.float32),
+        "ids": np.array([3, -7, 1 << 40], dtype=np.int64),
+        "flag": 1,
+    }
+    decoded = tfr.decode_example(tfr.encode_example(row))
+    assert decoded["name"] == b"abc"
+    np.testing.assert_allclose(decoded["score"], [1.5, -2.0])
+    assert list(decoded["ids"]) == [3, -7, 1 << 40]
+    assert decoded["flag"] == 1
+
+
+def test_tfrecords_write_read_roundtrip(ray_start_regular, tmp_path):
+    ds = data.from_items(
+        [{"x": i, "y": float(i) / 2, "s": f"row{i}"} for i in range(10)])
+    out = str(tmp_path / "tfr")
+    ds.write_tfrecords(out)
+    files = os.listdir(out)
+    assert files and all(f.endswith(".tfrecords") for f in files)
+
+    back = data.read_tfrecords(out).take_all()
+    assert len(back) == 10
+    xs = sorted(r["x"] for r in back)
+    assert xs == list(range(10))
+    by_x = {r["x"]: r for r in back}
+    assert by_x[4]["s"] == b"row4"  # bytes features round-trip as bytes
+    assert abs(by_x[4]["y"] - 2.0) < 1e-6
+
+
+def test_read_write_sql(ray_start_regular, tmp_path):
+    db = str(tmp_path / "t.db")
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE items (id INTEGER, name TEXT)")
+    conn.executemany("INSERT INTO items VALUES (?, ?)",
+                     [(i, f"n{i}") for i in range(20)])
+    conn.commit()
+    conn.close()
+
+    ds = data.read_sql("SELECT id, name FROM items ORDER BY id",
+                       lambda: sqlite3.connect(db), parallelism=3)
+    rows = ds.take_all()
+    assert sorted(r["id"] for r in rows) == list(range(20))
+
+    # write back to a second table
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE copy (id INTEGER, name TEXT)")
+    conn.commit()
+    conn.close()
+    ds.write_sql("INSERT INTO copy VALUES (?, ?)",
+                 lambda: sqlite3.connect(db))
+    conn = sqlite3.connect(db)
+    n = conn.execute("SELECT COUNT(*) FROM copy").fetchone()[0]
+    conn.close()
+    assert n == 20
+
+
+def test_from_torch_map_style(ray_start_regular):
+    import torch.utils.data
+
+    class Squares(torch.utils.data.Dataset):
+        def __len__(self):
+            return 12
+
+        def __getitem__(self, i):
+            return i * i
+
+    ds = data.from_torch(Squares())
+    items = sorted(r["item"] for r in ds.take_all())
+    assert items == [i * i for i in range(12)]
+
+
+def test_from_torch_iterable(ray_start_regular):
+    ds = data.from_torch(iter([10, 20, 30]))
+    assert [r["item"] for r in ds.take_all()] == [10, 20, 30]
+
+
+def test_from_torch_tensor_tuples(ray_start_regular):
+    """The MNIST-style case: (image tensor, label) tuples."""
+    import torch
+    import torch.utils.data
+
+    class ImgDs(torch.utils.data.Dataset):
+        def __len__(self):
+            return 6
+
+        def __getitem__(self, i):
+            return torch.full((1, 4, 4), float(i)), i % 2
+
+    ds = data.from_torch(ImgDs())
+    rows = ds.take_all()
+    assert len(rows) == 6
+    assert np.asarray(rows[0]["item_0"]).shape == (1, 4, 4)
+    assert {r["item_1"] for r in rows} == {0, 1}
+
+
+def test_tensor_rows_block_roundtrip():
+    from ray_tpu.data.block import BlockAccessor
+
+    rows = [{"x": np.full((3, 4), float(i), np.float32), "y": i}
+            for i in range(5)]
+    block = BlockAccessor.rows_to_block(rows)
+    batch = BlockAccessor.for_block(block).to_numpy_batch()
+    assert batch["x"].shape == (5, 3, 4)
+    np.testing.assert_allclose(batch["x"][2], 2.0)
+    assert batch["y"].tolist() == list(range(5))
+
+
+def test_crc32c_native_matches_python():
+    import os as _os
+
+    from ray_tpu.data._internal import tfrecords as tfr
+
+    data_ = _os.urandom(100_000)
+    native = tfr._load_native()
+    # pure-python fallback
+    table = tfr._crc_table()
+    crc = 0xFFFFFFFF
+    for b in np.frombuffer(data_[:1000], dtype=np.uint8):
+        crc = int(table[(crc ^ int(b)) & 0xFF]) ^ (crc >> 8)
+    py = crc ^ 0xFFFFFFFF
+    if native is not None:
+        assert native(data_[:1000], 1000, 0) == py
+        # throughput sanity: native handles 100KB instantly
+        assert isinstance(tfr.crc32c(data_), int)
+
+
+def test_read_sql_no_order_by_partition_is_exact(ray_start_regular, tmp_path):
+    """Striping must be stable under per-connection row order (hash-based,
+    not positional) — including duplicate rows."""
+    db = str(tmp_path / "u.db")
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE v (x INTEGER)")
+    # 30 rows with duplicates
+    conn.executemany("INSERT INTO v VALUES (?)",
+                     [(i % 10,) for i in range(30)])
+    conn.commit()
+    conn.close()
+    ds = data.read_sql("SELECT x FROM v", lambda: sqlite3.connect(db),
+                       parallelism=4)
+    xs = sorted(r["x"] for r in ds.take_all())
+    assert xs == sorted(i % 10 for i in range(30))
+
+
+def test_webdataset_dotted_dirs_group_by_basename(ray_start_regular,
+                                                  tmp_path):
+    """Dots in directory components must not affect sample grouping."""
+    import io
+    import tarfile
+
+    tar_path = str(tmp_path / "shard.tar")
+    with tarfile.open(tar_path, "w") as tf:
+        for name, payload in [("v1.0/a.jpg", b"A"), ("v1.0/a.cls", b"0"),
+                              ("v1.0/b.jpg", b"B")]:
+            info = tarfile.TarInfo(name)
+            info.size = len(payload)
+            tf.addfile(info, io.BytesIO(payload))
+    rows = data.read_webdataset(tar_path).take_all()
+    assert len(rows) == 2
+    by_key = {r["__key__"]: r for r in rows}
+    assert by_key["v1.0/a"]["jpg"] == b"A"
+    assert by_key["v1.0/a"]["cls"] == b"0"
+    assert by_key["v1.0/b"]["jpg"] == b"B"
+
+
+def test_webdataset_tensor_column_full_fidelity(ray_start_regular, tmp_path):
+    """ndarray columns must round-trip via .npy bytes, not truncated str()."""
+    import io
+
+    big = np.arange(5000, dtype=np.int64)
+    ds = data.from_items([{"__key__": "s0"}]).map(
+        lambda r: {"__key__": r["__key__"], "arr": big})
+    out = str(tmp_path / "wt")
+    ds.write_webdataset(out)
+    row = data.read_webdataset(out).take_all()[0]
+    back = np.load(io.BytesIO(row["arr"]))
+    np.testing.assert_array_equal(back, big)
+
+
+def test_webdataset_roundtrip(ray_start_regular, tmp_path):
+    ds = data.from_items(
+        [{"__key__": f"s{i:03d}", "txt": f"hello {i}", "cls": str(i % 2)}
+         for i in range(6)])
+    out = str(tmp_path / "wds")
+    ds.write_webdataset(out)
+    files = os.listdir(out)
+    assert files and all(f.endswith(".tar") for f in files)
+
+    back = data.read_webdataset(out).take_all()
+    assert len(back) == 6
+    by_key = {r["__key__"]: r for r in back}
+    assert by_key["s002"]["txt"] == b"hello 2"
+    assert by_key["s003"]["cls"] == b"1"

@@ -1,0 +1,147 @@
+"""Preprocessor tests (reference patterns: ray
+python/ray/data/tests/preprocessors/)."""
+
+import numpy as np
+import pytest
+
+from ray_tpu import data
+from ray_tpu.data.preprocessors import (
+    BatchMapper,
+    Chain,
+    Concatenator,
+    LabelEncoder,
+    MaxAbsScaler,
+    MinMaxScaler,
+    Normalizer,
+    OneHotEncoder,
+    OrdinalEncoder,
+    Preprocessor,
+    PreprocessorNotFittedError,
+    RobustScaler,
+    SimpleImputer,
+    StandardScaler,
+)
+
+
+def test_standard_scaler(ray_start_regular):
+    ds = data.from_items([{"a": float(i), "b": 2.0} for i in range(5)])
+    sc = StandardScaler(columns=["a", "b"])
+    out = sc.fit_transform(ds).take_all()
+    a = np.array([r["a"] for r in out])
+    assert abs(a.mean()) < 1e-9 and abs(a.std() - 1.0) < 1e-9
+    # constant column: std treated as 1, so values center to 0
+    assert all(r["b"] == 0.0 for r in out)
+
+
+def test_min_max_and_max_abs(ray_start_regular):
+    ds = data.from_items([{"a": float(i)} for i in range(11)])
+    out = MinMaxScaler(columns=["a"]).fit_transform(ds).take_all()
+    vals = [r["a"] for r in out]
+    assert min(vals) == 0.0 and max(vals) == 1.0
+
+    ds2 = data.from_items([{"a": -4.0}, {"a": 2.0}])
+    out2 = MaxAbsScaler(columns=["a"]).fit_transform(ds2).take_all()
+    assert [r["a"] for r in out2] == [-1.0, 0.5]
+
+
+def test_robust_scaler(ray_start_regular):
+    ds = data.from_items([{"a": float(i)} for i in range(1, 10)])
+    sc = RobustScaler(columns=["a"]).fit(ds)
+    assert sc.stats_["median(a)"] == 5.0
+    out = sc.transform_batch({"a": np.array([5.0])})
+    assert out["a"][0] == 0.0
+
+
+def test_normalizer_stateless():
+    n = Normalizer(columns=["x", "y"], norm="l2")
+    out = n.transform_batch({"x": np.array([3.0]), "y": np.array([4.0])})
+    assert abs(out["x"][0] - 0.6) < 1e-9 and abs(out["y"][0] - 0.8) < 1e-9
+
+
+def test_ordinal_and_onehot(ray_start_regular):
+    ds = data.from_items([{"c": "red"}, {"c": "blue"}, {"c": "red"}])
+    enc = OrdinalEncoder(columns=["c"]).fit(ds)
+    out = enc.transform_batch({"c": np.array(["red", "blue", "green"])})
+    assert out["c"].tolist() == [1, 0, -1]  # sorted: blue=0, red=1
+
+    oh = OneHotEncoder(columns=["c"]).fit(ds)
+    b = oh.transform_batch({"c": np.array(["red", "green"])})
+    assert b["c_red"].tolist() == [1, 0]
+    assert b["c_blue"].tolist() == [0, 0]
+    assert "c" not in b
+
+
+def test_label_encoder_roundtrip(ray_start_regular):
+    ds = data.from_items([{"y": "cat"}, {"y": "dog"}, {"y": "cat"}])
+    le = LabelEncoder(label_column="y").fit(ds)
+    enc = le.transform_batch({"y": np.array(["dog", "cat"])})
+    assert enc["y"].tolist() == [1, 0]
+    dec = le.inverse_transform_batch(enc)
+    assert dec["y"].tolist() == ["dog", "cat"]
+
+
+def test_simple_imputer_strategies(ray_start_regular):
+    ds = data.from_items(
+        [{"a": 1.0, "b": "x"}, {"a": np.nan, "b": "x"}, {"a": 3.0, "b": None}])
+    mean_imp = SimpleImputer(columns=["a"], strategy="mean").fit(ds)
+    out = mean_imp.transform_batch({"a": np.array([np.nan, 5.0])})
+    assert out["a"].tolist() == [2.0, 5.0]
+
+    mf = SimpleImputer(columns=["b"], strategy="most_frequent").fit(ds)
+    out2 = mf.transform_batch({"b": np.array([None, "z"], dtype=object)})
+    assert out2["b"].tolist() == ["x", "z"]
+
+    const = SimpleImputer(columns=["a"], strategy="constant", fill_value=9.0)
+    const.fit(ds)
+    assert const.transform_batch(
+        {"a": np.array([np.nan])})["a"].tolist() == [9.0]
+
+
+def test_concatenator_and_batch_mapper():
+    cat = Concatenator(columns=["a", "b"], output_column_name="feat")
+    cat.fit(None)
+    out = cat.transform_batch(
+        {"a": np.array([1.0, 2.0]), "b": np.array([3.0, 4.0]),
+         "keep": np.array([0, 0])})
+    assert out["feat"].shape == (2, 2)
+    assert "a" not in out and "keep" in out
+
+    bm = BatchMapper(lambda b: {"v": b["v"] * 2}).fit(None)
+    assert bm.transform_batch({"v": np.array([2])})["v"].tolist() == [4]
+
+
+def test_chain_fit_on_transformed(ray_start_regular):
+    ds = data.from_items([{"a": float(i)} for i in range(5)])
+    chain = Chain(
+        MinMaxScaler(columns=["a"]),          # -> [0, 1]
+        StandardScaler(columns=["a"]),        # fit must see scaled values
+    )
+    out = chain.fit_transform(ds).take_all()
+    a = np.array([r["a"] for r in out])
+    assert abs(a.mean()) < 1e-9
+    # transform_batch composes both stages
+    mid = chain.transform_batch({"a": np.array([2.0])})
+    assert abs(mid["a"][0]) < 1e-9  # 2 -> 0.5 -> 0 (centered)
+
+
+def test_unfitted_raises():
+    sc = StandardScaler(columns=["a"])
+    with pytest.raises(PreprocessorNotFittedError):
+        sc.transform_batch({"a": np.array([1.0])})
+
+
+def test_serialize_roundtrip(ray_start_regular):
+    ds = data.from_items([{"a": float(i)} for i in range(4)])
+    sc = StandardScaler(columns=["a"]).fit(ds)
+    sc2 = Preprocessor.deserialize(sc.serialize())
+    np.testing.assert_allclose(
+        sc2.transform_batch({"a": np.array([1.0])})["a"],
+        sc.transform_batch({"a": np.array([1.0])})["a"])
+
+
+def test_transform_is_lazy_dataset_op(ray_start_regular):
+    ds = data.from_items([{"a": float(i)} for i in range(6)])
+    sc = StandardScaler(columns=["a"]).fit(ds)
+    out = sc.transform(ds)
+    assert isinstance(out, data.Dataset)
+    assert len(out.take_all()) == 6

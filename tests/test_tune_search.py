@@ -1,0 +1,200 @@
+"""Tests for the model-based searchers and new schedulers (reference
+patterns: ray python/ray/tune/tests/test_searchers.py,
+test_trial_scheduler_pbt.py)."""
+
+import numpy as np
+import pytest
+
+from ray_tpu.tune.schedulers import (
+    PB2,
+    DistributeResources,
+    HyperBandForBOHB,
+    ResourceChangingScheduler,
+    TrialScheduler,
+)
+from ray_tpu.tune.search import (
+    BayesOptSearch,
+    TPESearcher,
+    TuneBOHB,
+    uniform,
+    loguniform,
+    choice,
+)
+from ray_tpu.tune.search._gp import GP
+
+
+class _FakeTrial:
+    def __init__(self, tid, config):
+        self.trial_id = tid
+        self.config = config
+        self.status = "RUNNING"
+        self.resources = None
+        self.latest_checkpoint = None
+        self.pbt_exploit = None
+
+
+def _drive(searcher, objective, n=30):
+    """Run a sequential optimization loop; returns best config seen."""
+    best_cfg, best_val = None, -np.inf
+    for i in range(n):
+        cfg = searcher.suggest(f"t{i}")
+        assert cfg is not None
+        val = objective(cfg)
+        searcher.on_trial_complete(f"t{i}", {"score": val})
+        if val > best_val:
+            best_cfg, best_val = cfg, val
+    return best_cfg, best_val
+
+
+def test_gp_fits_and_predicts():
+    x = np.linspace(0, 1, 10)[:, None]
+    y = np.sin(4 * x.ravel())
+    gp = GP().fit(x, y)
+    mean, std = gp.predict(x)
+    np.testing.assert_allclose(mean, y, atol=0.05)
+    # uncertainty grows away from data
+    _, far_std = gp.predict(np.array([[3.0]]))
+    assert far_std[0] > std.max()
+
+
+def test_tpe_beats_random_on_quadratic():
+    space = {"x": uniform(-5.0, 5.0), "lr": loguniform(1e-5, 1e-1),
+             "arch": choice(["a", "b"])}
+
+    def objective(cfg):
+        bonus = 1.0 if cfg["arch"] == "b" else 0.0
+        return -(cfg["x"] - 2.0) ** 2 + bonus
+
+    searcher = TPESearcher(space, metric="score", mode="max", seed=0,
+                           n_initial_points=8)
+    best_cfg, best_val = _drive(searcher, objective, n=40)
+    assert abs(best_cfg["x"] - 2.0) < 1.5
+    assert best_val > -1.0
+
+
+def test_tpe_respects_mode_min():
+    space = {"x": uniform(0.0, 10.0)}
+    searcher = TPESearcher(space, metric="loss", mode="min", seed=1,
+                           n_initial_points=6)
+    for i in range(30):
+        cfg = searcher.suggest(f"t{i}")
+        searcher.on_trial_complete(f"t{i}", {"loss": (cfg["x"] - 7.0) ** 2})
+    # late suggestions should cluster near the minimum at x=7
+    late = [searcher.suggest(f"late{i}") for i in range(8)]
+    assert np.median([abs(c["x"] - 7.0) for c in late]) < 2.5
+
+
+def test_bayesopt_converges_1d():
+    space = {"x": uniform(0.0, 1.0)}
+    searcher = BayesOptSearch(space, metric="score", mode="max", seed=0,
+                              n_initial_points=5)
+    best_cfg, _ = _drive(
+        searcher, lambda c: -(c["x"] - 0.3) ** 2, n=25)
+    assert abs(best_cfg["x"] - 0.3) < 0.15
+
+
+def test_bohb_learns_from_intermediate_results():
+    space = {"x": uniform(-1.0, 1.0)}
+    s = TuneBOHB(space, metric="score", mode="max", n_initial_points=3)
+    cfg = s.suggest("t0")
+    s.on_trial_result("t0", {"score": 0.9})
+    # culled without a final result: must still record the observation
+    s.on_trial_complete("t0", None)
+    assert len(s._obs) == 1
+    assert s._obs[0][1] == 0.9
+
+
+def test_pb2_explore_within_bounds():
+    pb2 = PB2(metric="score", mode="max", perturbation_interval=1,
+              hyperparam_bounds={"lr": [1e-4, 1e-1]}, seed=0)
+    trials = [_FakeTrial(f"t{i}", {"lr": 0.01}) for i in range(4)]
+    for t in trials:
+        pb2.on_trial_add(t)
+    # feed results so GP data accumulates (improvement needs 2 results each)
+    for step in range(1, 4):
+        for i, t in enumerate(trials):
+            pb2.on_trial_result(t, {"score": step * (i + 1),
+                                    "training_iteration": step})
+    new = pb2._explore({"lr": 0.01})
+    assert 1e-4 <= new["lr"] <= 1e-1
+    assert len(pb2._gp_data) > 0
+
+
+def test_pb2_exploit_decision():
+    pb2 = PB2(metric="score", mode="max", perturbation_interval=1,
+              hyperparam_bounds={"lr": [0.001, 0.1]}, seed=0)
+    trials = [_FakeTrial(f"t{i}", {"lr": 0.01}) for i in range(4)]
+    for t in trials:
+        pb2.on_trial_add(t)
+    decisions = {}
+    for step in (1, 2):
+        for i, t in enumerate(trials):
+            decisions[t.trial_id] = pb2.on_trial_result(
+                t, {"score": float(i), "training_iteration": step})
+    # worst trial should be told to pause for exploit
+    assert decisions["t0"] == TrialScheduler.PAUSE
+    assert trials[0].pbt_exploit is not None
+    assert 0.001 <= trials[0].pbt_exploit["config"]["lr"] <= 0.1
+
+
+def test_resource_changing_scheduler_sets_trial_resources():
+    calls = []
+
+    def alloc(controller, trial, result, base):
+        calls.append(trial.trial_id)
+        return {"CPU": 2.0}
+
+    sched = ResourceChangingScheduler(resources_allocation_function=alloc)
+    t = _FakeTrial("t0", {})
+    sched.on_trial_add(t)
+    decision = sched.on_trial_result(t, {"score": 1.0})
+    assert decision == TrialScheduler.CONTINUE
+    assert t.resources == {"CPU": 2.0}
+    assert calls == ["t0"]
+
+
+def test_distribute_resources_default():
+    alloc = DistributeResources()
+    t = _FakeTrial("t0", {})
+
+    class _Ctrl:
+        trials = [t]
+
+    out = alloc(_Ctrl(), t, {}, None)
+    assert out["CPU"] >= 1.0
+
+
+def test_explicit_basic_variant_not_capped(ray_start_regular):
+    """An explicitly passed BasicVariantGenerator keeps its own queue
+    budget — the controller must not truncate it at TuneConfig.num_samples
+    (default 1)."""
+    from ray_tpu import tune
+    from ray_tpu.tune import TuneConfig, Tuner
+    from ray_tpu.tune.search import BasicVariantGenerator
+
+    def trainable(config):
+        tune.report({"score": config["x"]})
+
+    tuner = Tuner(
+        trainable,
+        tune_config=TuneConfig(
+            metric="score", mode="max",
+            search_alg=BasicVariantGenerator(
+                {"x": tune.grid_search([1, 2, 3])}),
+        ),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 3
+
+
+def test_hyperband_for_bohb_culls():
+    sched = HyperBandForBOHB(metric="score", mode="max", max_t=9,
+                             grace_period=1, reduction_factor=3)
+    trials = [_FakeTrial(f"t{i}", {}) for i in range(6)]
+    stopped = 0
+    for i, t in enumerate(trials):
+        d = sched.on_trial_result(
+            t, {"score": float(len(trials) - i), "training_iteration": 1})
+        if d == TrialScheduler.STOP:
+            stopped += 1
+    assert stopped > 0  # late arrivals below the rung cutoff get culled
