@@ -96,13 +96,19 @@ def read_records(fp) -> Iterator[bytes]:
         if len(header) != 8:
             raise ValueError("truncated TFRecord length header")
         (length,) = struct.unpack("<Q", header)
-        (crc,) = struct.unpack("<I", fp.read(4))
+        crc_buf = fp.read(4)
+        if len(crc_buf) != 4:
+            raise ValueError("truncated TFRecord length CRC")
+        (crc,) = struct.unpack("<I", crc_buf)
         if _masked_crc(header) != crc:
             raise ValueError("TFRecord length CRC mismatch")
         data = fp.read(length)
         if len(data) != length:
             raise ValueError("truncated TFRecord payload")
-        (dcrc,) = struct.unpack("<I", fp.read(4))
+        dcrc_buf = fp.read(4)
+        if len(dcrc_buf) != 4:
+            raise ValueError("truncated TFRecord data CRC")
+        (dcrc,) = struct.unpack("<I", dcrc_buf)
         if _masked_crc(data) != dcrc:
             raise ValueError("TFRecord data CRC mismatch")
         yield data

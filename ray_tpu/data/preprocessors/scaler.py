@@ -11,17 +11,27 @@ from ray_tpu.data.preprocessors.preprocessor import Preprocessor
 
 
 def _column_moments(dataset, columns: List[str]):
-    """One streaming pass: per-column count/sum/sumsq (float64)."""
+    """One streaming pass: per-column (count, mean, M2) via Chan's parallel
+    Welford update — numerically stable for large-offset columns, where
+    sumsq/n - mean^2 catastrophically cancels (e.g. unix timestamps)."""
     count = {c: 0 for c in columns}
-    total = {c: 0.0 for c in columns}
-    sumsq = {c: 0.0 for c in columns}
+    mean = {c: 0.0 for c in columns}
+    m2 = {c: 0.0 for c in columns}
     for batch in dataset.iter_batches(batch_format="numpy"):
         for c in columns:
-            col = np.asarray(batch[c], dtype=np.float64)
-            count[c] += col.size
-            total[c] += float(col.sum())
-            sumsq[c] += float((col * col).sum())
-    return count, total, sumsq
+            col = np.asarray(batch[c], dtype=np.float64).ravel()
+            if not col.size:
+                continue
+            nb = col.size
+            mb = float(col.mean())
+            m2b = float(((col - mb) ** 2).sum())
+            n = count[c]
+            delta = mb - mean[c]
+            tot = n + nb
+            mean[c] += delta * nb / tot
+            m2[c] += m2b + delta * delta * n * nb / tot
+            count[c] = tot
+    return count, mean, m2
 
 
 class StandardScaler(Preprocessor):
@@ -32,13 +42,11 @@ class StandardScaler(Preprocessor):
         self.columns = columns
 
     def _fit(self, dataset):
-        count, total, sumsq = _column_moments(dataset, self.columns)
+        count, mean, m2 = _column_moments(dataset, self.columns)
         for c in self.columns:
             n = max(count[c], 1)
-            mean = total[c] / n
-            var = max(sumsq[c] / n - mean * mean, 0.0)
-            std = float(np.sqrt(var))
-            self.stats_[f"mean({c})"] = mean
+            std = float(np.sqrt(m2[c] / n))
+            self.stats_[f"mean({c})"] = mean[c]
             self.stats_[f"std({c})"] = std if std > 0 else 1.0
 
     def _transform_numpy(self, batch):

@@ -39,6 +39,13 @@ class AlgorithmConfig:
         self.num_steps_sampled_before_learning_starts: int = 1000
         # learners
         self.num_learners: int = 0
+        # offline (BC/MARWIL/CQL: input_ = episode-JSON paths/dirs)
+        self.input_: Optional[Any] = None
+        self.beta: float = 1.0  # MARWIL advantage coefficient (0 == BC)
+        self.cql_alpha: float = 1.0  # CQL conservative penalty weight
+        # evaluation
+        self.evaluation_interval: Optional[int] = None
+        self.evaluation_duration: int = 5
         # misc
         self.seed: Optional[int] = None
         self.explore: bool = True
@@ -71,6 +78,21 @@ class AlgorithmConfig:
             if not hasattr(self, key):
                 raise ValueError(f"unknown training option {k!r}")
             setattr(self, key, v)
+        return self
+
+    def offline_data(self, *, input_: Optional[Any] = None,
+                     **_kw) -> "AlgorithmConfig":
+        if input_ is not None:
+            self.input_ = input_
+        return self
+
+    def evaluation(self, *, evaluation_interval: Optional[int] = None,
+                   evaluation_duration: Optional[int] = None,
+                   **_kw) -> "AlgorithmConfig":
+        if evaluation_interval is not None:
+            self.evaluation_interval = evaluation_interval
+        if evaluation_duration is not None:
+            self.evaluation_duration = evaluation_duration
         return self
 
     def learners(self, *, num_learners: Optional[int] = None,

@@ -1,7 +1,20 @@
+from ray_tpu.rllib.algorithms.cql import CQL, CQLConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig  # noqa: F401
-from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.impala import (  # noqa: F401
+    APPO,
+    APPOConfig,
+    IMPALA,
+    IMPALAConfig,
+)
+from ray_tpu.rllib.algorithms.marwil import (  # noqa: F401
+    BC,
+    BCConfig,
+    MARWIL,
+    MARWILConfig,
+)
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig  # noqa: F401
 
-__all__ = ["DQN", "DQNConfig", "IMPALA", "IMPALAConfig", "PPO", "PPOConfig",
-           "SAC", "SACConfig"]
+__all__ = ["APPO", "APPOConfig", "BC", "BCConfig", "CQL", "CQLConfig",
+           "DQN", "DQNConfig", "IMPALA", "IMPALAConfig", "MARWIL",
+           "MARWILConfig", "PPO", "PPOConfig", "SAC", "SACConfig"]

@@ -1,0 +1,160 @@
+"""CQL — Conservative Q-Learning on offline data (reference: ray
+rllib/algorithms/cql/cql.py; Kumar et al. 2020).
+
+Discrete-action CQL(H): the double-Q TD loss of DQN plus
+alpha * E[logsumexp_a Q(s,a) - Q(s, a_data)], which pushes down
+out-of-distribution action values so the greedy policy stays inside the
+dataset's support. (The reference builds CQL on SAC for continuous control;
+on a discrete action space the same penalty applies exactly, without the
+sampling approximation the continuous version needs.)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm
+from ray_tpu.rllib.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.algorithms.dqn import DQNLearner
+
+
+class CQLConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(algo_class=CQL)
+        self.cql_alpha = 1.0
+        self.lr = 5e-4
+        self.train_batch_size = 256
+        self.num_updates_per_iteration = 200
+        self.target_network_update_freq = 100
+
+
+class CQLLearner(DQNLearner):
+    def _build_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        gamma = self.config.get("gamma", 0.99)
+        alpha = self.config.get("cql_alpha", 1.0)
+
+        def loss_fn(params, target_params, batch):
+            q = self.module.forward(params, batch["obs"])
+            idx = jnp.arange(q.shape[0])
+            q_data = q[idx, batch["actions"]]
+            # double-Q TD target
+            q_next_online = self.module.forward(params, batch["next_obs"])
+            best = jnp.argmax(q_next_online, axis=-1)
+            q_next = self.module.forward(target_params, batch["next_obs"])
+            target = batch["rewards"] + gamma * q_next[idx, best] * (
+                1.0 - batch["terminateds"])
+            td_loss = jnp.mean(
+                (q_data - jax.lax.stop_gradient(target)) ** 2)
+            # CQL(H) conservative penalty
+            cql_penalty = jnp.mean(
+                jax.scipy.special.logsumexp(q, axis=-1) - q_data)
+            loss = td_loss + alpha * cql_penalty
+            return loss, {"td_loss": td_loss, "cql_penalty": cql_penalty,
+                          "qf_mean": jnp.mean(q_data)}
+
+        def update(params, opt_state, target_params, batch):
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, target_params, batch)
+            updates, opt_state = self.optimizer.update(
+                grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            aux["total_loss"] = loss
+            return params, opt_state, aux
+
+        return jax.jit(update, donate_argnums=(1,))
+
+    def update_from_batch(self, batch: Dict[str, np.ndarray]
+                          ) -> Dict[str, Any]:
+        self.params, self.opt_state, aux = self._update(
+            self.params, self.opt_state, self.target_params, batch)
+        return {k: float(v) for k, v in aux.items()}
+
+
+class CQL(Algorithm):
+    def setup(self, config: AlgorithmConfig) -> None:
+        from ray_tpu.rllib.offline import load_episode_batches
+
+        obs_dim, num_actions = self._env_spaces(config.env, config.env_config)
+        self.module_spec = {
+            "obs_dim": obs_dim, "num_actions": num_actions,
+            "hiddens": tuple(config.model.get("fcnet_hiddens", (64, 64))),
+        }
+        self.learner = CQLLearner(self.module_spec, config.to_dict())
+        episodes = load_episode_batches(config.input_)
+        cols = {"obs": [], "next_obs": [], "actions": [], "rewards": [],
+                "terminateds": []}
+        for ep in episodes:
+            cols["obs"].append(np.asarray(ep["obs"], dtype=np.float32))
+            cols["next_obs"].append(
+                np.asarray(ep["next_obs"], dtype=np.float32))
+            cols["actions"].append(np.asarray(ep["actions"], dtype=np.int32))
+            cols["rewards"].append(
+                np.asarray(ep["rewards"], dtype=np.float32))
+            cols["terminateds"].append(
+                np.asarray(ep["terminateds"], dtype=np.float32))
+        self._data = {k: np.concatenate(v) for k, v in cols.items()}
+        self._rng = np.random.default_rng(config.seed)
+        self._steps_since_sync = 0
+        self._eval_env = None
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        n = len(self._data["obs"])
+        metrics: Dict[str, Any] = {}
+        for _ in range(cfg.num_updates_per_iteration):
+            idx = self._rng.integers(0, n,
+                                     size=min(cfg.train_batch_size, n))
+            metrics = self.learner.update_from_batch(
+                {k: v[idx] for k, v in self._data.items()})
+            self._steps_since_sync += 1
+            if self._steps_since_sync >= cfg.target_network_update_freq:
+                self.learner.sync_target()
+                self._steps_since_sync = 0
+        metrics["num_offline_transitions"] = n
+        if (cfg.evaluation_interval
+                and self.iteration % cfg.evaluation_interval == 0):
+            metrics["evaluation"] = self.evaluate()
+        return metrics
+
+    def evaluate(self) -> Dict[str, Any]:
+        import gymnasium as gym
+        import jax
+
+        cfg = self.config
+        if self._eval_env is None:
+            self._eval_env = gym.make(cfg.env, **(cfg.env_config or {}))
+            self._fwd = jax.jit(self.learner.module.forward)
+        returns = []
+        for _ in range(cfg.evaluation_duration):
+            obs, _ = self._eval_env.reset()
+            done = trunc = False
+            total = 0.0
+            while not (done or trunc):
+                q = self._fwd(self.learner.params,
+                              np.asarray(obs, dtype=np.float32)[None, :])
+                action = int(np.argmax(np.asarray(q)[0]))
+                obs, r, done, trunc, _ = self._eval_env.step(action)
+                total += float(r)
+            returns.append(total)
+        return {"episode_return_mean": float(np.mean(returns)),
+                "num_episodes": len(returns)}
+
+    def get_state(self) -> Dict[str, Any]:
+        state = super().get_state()
+        state["learner"] = self.learner.get_state()
+        return state
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        super().set_state(state)
+        if "learner" in state:
+            self.learner.set_state(state["learner"])
+
+    def stop(self) -> None:
+        if self._eval_env is not None:
+            self._eval_env.close()

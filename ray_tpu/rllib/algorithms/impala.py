@@ -47,6 +47,10 @@ def make_vtrace_update(module, optimizer, config: Dict[str, Any]):
     vf_coeff = config.get("vf_loss_coeff", 0.5)
     ent_coeff = config.get("entropy_coeff", 0.01)
     normalize_adv = config.get("normalize_advantages", True)
+    # APPO: PPO clipped surrogate on the v-trace advantages instead of the
+    # plain policy gradient (reference: appo.py / appo_learner).
+    appo_clip = config.get("appo_clip", False)
+    clip_param = config.get("clip_param", 0.2)
 
     def loss_fn(params, batch):
         # batch arrays are [B, T] (+ trailing dims); flatten for the module.
@@ -90,7 +94,14 @@ def make_vtrace_update(module, optimizer, config: Dict[str, Any]):
             adv_mean = jnp.sum(pg_adv * mask) / denom
             adv_var = jnp.sum(mask * (pg_adv - adv_mean) ** 2) / denom
             pg_adv = (pg_adv - adv_mean) * jax.lax.rsqrt(adv_var + 1e-8)
-        pg_loss = -jnp.sum(logp * pg_adv * mask) / denom
+        if appo_clip:
+            ratio = jnp.exp(logp - behaviour_logp)
+            surr = jnp.minimum(
+                ratio * pg_adv,
+                jnp.clip(ratio, 1 - clip_param, 1 + clip_param) * pg_adv)
+            pg_loss = -jnp.sum(surr * mask) / denom
+        else:
+            pg_loss = -jnp.sum(logp * pg_adv * mask) / denom
         vf_loss = 0.5 * jnp.sum(
             mask * (values - jax.lax.stop_gradient(vs)) ** 2) / denom
         ent = jnp.sum(entropy * mask) / denom
@@ -245,3 +256,17 @@ class IMPALA(Algorithm):
 
     def stop(self) -> None:
         self.runner_group.stop()
+
+
+class APPOConfig(IMPALAConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = APPO
+        self.appo_clip = True
+        self.clip_param = 0.2
+
+
+class APPO(IMPALA):
+    """Asynchronous PPO (reference: ray rllib/algorithms/appo/appo.py —
+    IMPALA's async actor-learner architecture with the PPO clipped
+    surrogate applied to v-trace-corrected advantages)."""

@@ -1,0 +1,165 @@
+"""MARWIL + BC (reference: ray rllib/algorithms/marwil/marwil.py —
+Monotonic Advantage Re-Weighted Imitation Learning; BC (algorithms/bc/bc.py)
+is MARWIL with beta=0, exactly as in the reference).
+
+Offline episode batches (rllib/offline/io.py) are loaded once at setup;
+Monte-Carlo returns are computed per episode; the jitted update trains the
+value head to regress returns and re-weights the imitation cross-entropy by
+exp(beta * normalized advantage).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm
+from ray_tpu.rllib.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.learner import JaxLearner
+
+
+class MARWILConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class=algo_class or MARWIL)
+        self.beta = 1.0
+        self.vf_coeff = 1.0
+        self.train_batch_size = 2000
+        self.minibatch_size = 256
+        self.num_updates_per_iteration = 20
+        self.lr = 1e-3
+
+
+class BCConfig(MARWILConfig):
+    def __init__(self):
+        super().__init__(algo_class=BC)
+        self.beta = 0.0  # pure imitation: no advantage weighting
+
+
+class MARWILLearner(JaxLearner):
+    def __init__(self, module_spec: Dict[str, Any], config: Dict[str, Any]):
+        from ray_tpu.rllib.rl_module import DiscreteActorCriticModule
+
+        module = DiscreteActorCriticModule(
+            module_spec["obs_dim"], module_spec["num_actions"],
+            module_spec.get("hiddens", (64, 64)))
+        super().__init__(module, config)
+
+    def loss_fn(self, params, batch):
+        import jax
+        import jax.numpy as jnp
+
+        beta = self.config.get("beta", 1.0)
+        vf_coeff = self.config.get("vf_coeff", 1.0)
+        logits, values = self.module.forward(params, batch["obs"])
+        logp_all = jax.nn.log_softmax(logits)
+        logp = logp_all[jnp.arange(logits.shape[0]), batch["actions"]]
+        returns = batch["returns"]
+        vf_loss = jnp.mean((values - returns) ** 2)
+        if beta > 0:
+            adv = returns - jax.lax.stop_gradient(values)
+            # normalize by RMS like the reference's moving ma_adv_norm
+            adv = adv / jnp.sqrt(jnp.mean(adv ** 2) + 1e-8)
+            weight = jnp.exp(jnp.clip(beta * adv, -10.0, 10.0))
+        else:
+            weight = jnp.ones_like(logp)
+        policy_loss = -jnp.mean(weight * logp)
+        total = policy_loss + vf_coeff * vf_loss
+        return total, {"policy_loss": policy_loss, "vf_loss": vf_loss,
+                       "mean_weight": jnp.mean(weight)}
+
+
+def compute_mc_returns(batch: Dict[str, np.ndarray],
+                       gamma: float) -> np.ndarray:
+    r = np.asarray(batch["rewards"], dtype=np.float32)
+    out = np.zeros_like(r)
+    acc = 0.0
+    for t in range(len(r) - 1, -1, -1):
+        acc = r[t] + gamma * acc
+        out[t] = acc
+    return out
+
+
+class MARWIL(Algorithm):
+    def setup(self, config: AlgorithmConfig) -> None:
+        from ray_tpu.rllib.offline import load_episode_batches
+
+        obs_dim, num_actions = self._env_spaces(config.env, config.env_config)
+        self.module_spec = {
+            "obs_dim": obs_dim, "num_actions": num_actions,
+            "hiddens": tuple(config.model.get("fcnet_hiddens", (64, 64))),
+        }
+        self.learner = MARWILLearner(self.module_spec, config.to_dict())
+        episodes = load_episode_batches(config.input_)
+        obs, actions, returns = [], [], []
+        for ep in episodes:
+            obs.append(np.asarray(ep["obs"], dtype=np.float32))
+            actions.append(np.asarray(ep["actions"], dtype=np.int32))
+            returns.append(compute_mc_returns(ep, config.gamma))
+        self._obs = np.concatenate(obs)
+        self._actions = np.concatenate(actions)
+        self._returns = np.concatenate(returns)
+        self._rng = np.random.default_rng(config.seed)
+        self._eval_env = None
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        n = len(self._obs)
+        metrics: Dict[str, Any] = {}
+        for _ in range(cfg.num_updates_per_iteration):
+            idx = self._rng.integers(0, n, size=min(cfg.minibatch_size, n))
+            metrics = self.learner.update_from_batch({
+                "obs": self._obs[idx],
+                "actions": self._actions[idx],
+                "returns": self._returns[idx],
+            })
+        metrics["num_offline_transitions"] = n
+        if (cfg.evaluation_interval
+                and self.iteration % cfg.evaluation_interval == 0):
+            metrics["evaluation"] = self.evaluate()
+        return metrics
+
+    def evaluate(self) -> Dict[str, Any]:
+        """Greedy rollouts in the real env (reference:
+        Algorithm.evaluate)."""
+        import gymnasium as gym
+        import jax
+
+        cfg = self.config
+        if self._eval_env is None:
+            self._eval_env = gym.make(cfg.env, **(cfg.env_config or {}))
+            self._fwd = jax.jit(self.learner.module.forward)
+        returns = []
+        for _ in range(cfg.evaluation_duration):
+            obs, _ = self._eval_env.reset(seed=None)
+            done = trunc = False
+            total = 0.0
+            while not (done or trunc):
+                logits, _v = self._fwd(
+                    self.learner.params,
+                    np.asarray(obs, dtype=np.float32)[None, :])
+                action = int(np.argmax(np.asarray(logits)[0]))
+                obs, r, done, trunc, _ = self._eval_env.step(action)
+                total += float(r)
+            returns.append(total)
+        return {"episode_return_mean": float(np.mean(returns)),
+                "num_episodes": len(returns)}
+
+    def get_state(self) -> Dict[str, Any]:
+        state = super().get_state()
+        state["learner"] = self.learner.get_state()
+        return state
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        super().set_state(state)
+        if "learner" in state:
+            self.learner.set_state(state["learner"])
+
+    def stop(self) -> None:
+        if self._eval_env is not None:
+            self._eval_env.close()
+
+
+class BC(MARWIL):
+    """Behavior cloning — MARWIL with beta=0 (reference:
+    rllib/algorithms/bc/bc.py)."""

@@ -1,0 +1,26 @@
+"""Offline RL I/O + estimators.
+
+Reference counterpart: ray rllib/offline/ — JsonWriter (json_writer.py),
+JsonReader (json_reader.py:221), InputReader (input_reader.py:18),
+off-policy estimators (offline/estimators/).
+"""
+
+from ray_tpu.rllib.offline.estimators import (  # noqa: F401
+    DirectMethod,
+    ImportanceSampling,
+    WeightedImportanceSampling,
+)
+from ray_tpu.rllib.offline.io import (  # noqa: F401
+    JsonReader,
+    JsonWriter,
+    load_episode_batches,
+)
+
+__all__ = [
+    "DirectMethod",
+    "ImportanceSampling",
+    "JsonReader",
+    "JsonWriter",
+    "WeightedImportanceSampling",
+    "load_episode_batches",
+]

@@ -88,8 +88,14 @@ class BayesOptSearch(Searcher):
 
     def suggest(self, trial_id: str):
         dims = self._numeric_dims()
-        if len(self._obs) < self.n_initial or not dims:
+        if len(self._obs) < self.n_initial:
             config = self._random_config()
+        elif not dims:
+            # purely categorical space: frequency-ratio exploitation only
+            config = self._random_config()
+            for k, v in self._space.items():
+                if isinstance(v, Categorical):
+                    config[k] = self._pick_categorical(k)
         else:
             x = np.array([[self._warp(k, c[k]) for k in dims]
                           for c, _ in self._obs])

@@ -113,8 +113,7 @@ class TPESearcher(Searcher):
                                                  self._rng.random()))
                 config[name] = domain.categories[best]
                 continue
-            span = (codec.encode(domain.upper) - codec.encode(domain.lower)
-                    ) if not codec.categorical else 1.0
+            span = codec.encode(domain.upper) - codec.encode(domain.lower)
             bw = max(span / 10.0, 1e-6)
             # candidates: sample around good points + a few fresh draws
             cands = []

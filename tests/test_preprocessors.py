@@ -33,6 +33,17 @@ def test_standard_scaler(ray_start_regular):
     assert all(r["b"] == 0.0 for r in out)
 
 
+def test_standard_scaler_large_offset_stability(ray_start_regular):
+    """Variance must survive a huge mean offset (no sumsq-mean^2
+    cancellation): unix-timestamp-like column with true std 1."""
+    base = 1.7e9
+    vals = [base + float(i) for i in range(-5, 6)]
+    ds = data.from_items([{"t": v} for v in vals])
+    sc = StandardScaler(columns=["t"]).fit(ds)
+    true_std = np.std(vals)
+    assert abs(sc.stats_["std(t)"] - true_std) / true_std < 1e-6
+
+
 def test_min_max_and_max_abs(ray_start_regular):
     ds = data.from_items([{"a": float(i)} for i in range(11)])
     out = MinMaxScaler(columns=["a"]).fit_transform(ds).take_all()

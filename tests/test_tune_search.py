@@ -93,6 +93,18 @@ def test_bayesopt_converges_1d():
     assert abs(best_cfg["x"] - 0.3) < 0.15
 
 
+def test_bayesopt_pure_categorical_exploits():
+    space = {"arch": choice(["a", "b", "c"])}
+    s = BayesOptSearch(space, metric="score", mode="max", seed=0,
+                       n_initial_points=6)
+    for i in range(18):
+        cfg = s.suggest(f"t{i}")
+        s.on_trial_complete(
+            f"t{i}", {"score": 10.0 if cfg["arch"] == "b" else 0.0})
+    late = [s.suggest(f"late{i}")["arch"] for i in range(12)]
+    assert late.count("b") > 8  # learned preference, not uniform random
+
+
 def test_bohb_learns_from_intermediate_results():
     space = {"x": uniform(-1.0, 1.0)}
     s = TuneBOHB(space, metric="score", mode="max", n_initial_points=3)
