@@ -1,0 +1,157 @@
+"""Multi-process progress bars (reference: ray
+python/ray/experimental/tqdm_ray.py — tqdm-compatible bars whose updates
+flow from task/actor workers to the driver, which renders one line per bar
+instead of interleaved garbage).
+
+Here updates flow through a named detached manager actor
+(get_if_exists=True, so any process lazily creates/joins it); the manager
+renders all bars to stderr, rate-limited.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+import uuid
+from typing import Dict, Optional
+
+_MANAGER_NAME = "_tqdm_ray_manager"
+
+
+class _BarState:
+    __slots__ = ("desc", "total", "n", "closed")
+
+    def __init__(self, desc, total):
+        self.desc = desc
+        self.total = total
+        self.n = 0
+        self.closed = False
+
+
+class _TqdmManager:
+    """Aggregates bar states and renders them (one line per bar)."""
+
+    def __init__(self):
+        self._bars: Dict[str, _BarState] = {}
+        self._closed_order: list = []
+        self._last_render = 0.0
+
+    def update(self, bar_id: str, desc: str, total: Optional[int],
+               delta: int, closed: bool) -> None:
+        bar = self._bars.get(bar_id)
+        if bar is None:
+            bar = self._bars[bar_id] = _BarState(desc, total)
+        bar.desc = desc
+        bar.total = total
+        bar.n += delta
+        bar.closed = bar.closed or closed
+        now = time.monotonic()
+        if closed or now - self._last_render > 0.2:
+            self._last_render = now
+            self._render()
+        if closed:
+            # final counts live briefly for observers, then evict — the
+            # manager is detached and outlives jobs, so closed bars must
+            # not accumulate forever
+            self._closed_order.append(bar_id)
+            while len(self._closed_order) > 256:
+                self._bars.pop(self._closed_order.pop(0), None)
+
+    def _render(self) -> None:
+        lines = []
+        for bar in self._bars.values():
+            if bar.closed:
+                continue
+            if bar.total:
+                frac = min(1.0, bar.n / bar.total)
+                filled = int(frac * 20)
+                lines.append(f"{bar.desc}: {bar.n}/{bar.total} "
+                             f"[{'#' * filled}{'.' * (20 - filled)}] "
+                             f"{frac * 100:.0f}%")
+            else:
+                lines.append(f"{bar.desc}: {bar.n}it")
+        if lines:
+            print("\r" + " | ".join(lines), end="\n", file=sys.stderr)
+
+    def state(self) -> Dict[str, dict]:
+        return {k: {"desc": b.desc, "total": b.total, "n": b.n,
+                    "closed": b.closed} for k, b in self._bars.items()}
+
+
+def _manager():
+    import ray_tpu
+
+    # max_concurrency=1: updates are tiny and the manager mutates shared
+    # dict state — serial execution is the synchronization
+    return ray_tpu.remote(_TqdmManager).options(
+        name=_MANAGER_NAME, get_if_exists=True,
+        lifetime="detached").remote()
+
+
+class tqdm:  # noqa: N801 — tqdm-compatible name
+    """Drop-in subset of tqdm: iterable wrapping, update(), close()."""
+
+    def __init__(self, iterable=None, desc: Optional[str] = None,
+                 total: Optional[int] = None, flush_interval_s: float = 0.1):
+        self._iterable = iterable
+        self.desc = desc or "progress"
+        if total is None and iterable is not None:
+            try:
+                total = len(iterable)
+            except TypeError:
+                total = None
+        self.total = total
+        self.n = 0
+        self._bar_id = uuid.uuid4().hex
+        self._pending = 0
+        self._last_flush = 0.0
+        self._flush_every = flush_interval_s
+        self._mgr = None
+
+    def _send(self, delta: int, closed: bool = False, force: bool = False):
+        self._pending += delta
+        now = time.monotonic()
+        if not (closed or force or now - self._last_flush
+                > self._flush_every):
+            return
+        try:
+            if self._mgr is None:
+                self._mgr = _manager()
+            self._mgr.update.remote(self._bar_id, self.desc, self.total,
+                                    self._pending, closed)
+            self._pending = 0
+            self._last_flush = now
+        except Exception:  # noqa: BLE001 — no cluster: degrade silently
+            if self._pending and (closed or self.total is None
+                                  or self.n % max(1, (self.total or 100)
+                                                  // 10) == 0):
+                print(f"{self.desc}: {self.n}"
+                      + (f"/{self.total}" if self.total else ""),
+                      file=sys.stderr)
+            self._pending = 0
+            self._last_flush = now
+
+    def update(self, n: int = 1) -> None:
+        self.n += n
+        self._send(n)
+
+    def close(self) -> None:
+        self._send(0, closed=True)
+
+    def __iter__(self):
+        for item in self._iterable:
+            yield item
+            self.update(1)
+        self.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+
+def safe_print(*args, **kwargs):
+    """Print without tearing bar lines (reference: tqdm_ray.safe_print)."""
+    print(*args, **kwargs)

@@ -277,6 +277,31 @@ def cmd_serve(args) -> int:
     return 0
 
 
+def cmd_debug(args) -> int:
+    """Attach to a waiting RemotePdb session (reference: ray debug —
+    scripts.py:205 + util/rpdb.py)."""
+    _connect(args)
+    from ray_tpu.util import rpdb
+
+    sessions = rpdb.list_sessions()
+    if args.list:  # machine-readable, even (especially) when empty
+        print(json.dumps(sessions, indent=2))
+        return 0
+    if not sessions:
+        print("No active debug sessions (tasks call "
+              "ray_tpu.util.rpdb.set_trace() to open one).")
+        return 0
+    choice = args.session
+    if choice is None:
+        for i, s in enumerate(sessions):
+            print(f"[{i}] session {s['session_id']} "
+                  f"pid={s['pid']} {s['host']}:{s['port']}")
+        choice = 0 if len(sessions) == 1 else int(
+            input("attach to which session? "))
+    rpdb.connect(sessions[int(choice)])
+    return 0
+
+
 def cmd_microbenchmark(args) -> int:
     from ray_tpu._private.ray_perf import main as perf_main
 
@@ -345,6 +370,13 @@ def main(argv=None) -> int:
     sp.add_argument("config", nargs="?", help="JSON config (deploy)")
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_serve)
+
+    sp = sub.add_parser("debug", help="attach to a remote pdb session")
+    sp.add_argument("--address")
+    sp.add_argument("--list", action="store_true",
+                    help="list sessions as JSON and exit")
+    sp.add_argument("--session", help="session index to attach")
+    sp.set_defaults(fn=cmd_debug)
 
     sp = sub.add_parser("microbenchmark", help="run the core benchmark suite")
     sp.add_argument("--quick", action="store_true")

@@ -31,6 +31,12 @@ from ray_tpu.train.backend import (  # noqa: F401
 )
 from ray_tpu.train.checkpoint import Checkpoint  # noqa: F401
 from ray_tpu.train.context import TrainContext  # noqa: F401
+from ray_tpu.train.predictor import (  # noqa: F401
+    BatchPredictor,
+    JaxPredictor,
+    Predictor,
+    TorchPredictor,
+)
 from ray_tpu.train.step import (  # noqa: F401
     TrainState,
     init_train_state,
@@ -47,18 +53,22 @@ __all__ = [
     "Backend",
     "BackendConfig",
     "BaseTrainer",
+    "BatchPredictor",
     "Checkpoint",
     "CheckpointConfig",
     "DataParallelTrainer",
     "FailureConfig",
     "JaxBackend",
     "JaxConfig",
+    "JaxPredictor",
     "JaxTrainer",
+    "Predictor",
     "Result",
     "RunConfig",
     "ScalingConfig",
     "TorchBackend",
     "TorchConfig",
+    "TorchPredictor",
     "TorchTrainer",
     "TrainContext",
     "TrainState",

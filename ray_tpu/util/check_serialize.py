@@ -1,0 +1,88 @@
+"""Serializability inspection (reference: ray
+python/ray/util/check_serialize.py — inspect_serializability walks an object
+graph, pinpointing which attribute/closure member fails to pickle)."""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Optional, Set, Tuple
+
+from ray_tpu._private.serialization import serialize
+
+
+class FailureTuple:
+    """One non-serializable leaf: the object, its name, and its parent."""
+
+    def __init__(self, obj: Any, name: str, parent: Any):
+        self.obj = obj
+        self.name = name
+        self.parent = parent
+
+    def __repr__(self):
+        return f"FailureTuple(obj={self.obj!r}, name={self.name!r})"
+
+
+def _try_serialize(obj: Any) -> bool:
+    try:
+        serialize(obj)  # the same path task args/returns take
+        return True
+    except Exception:  # noqa: BLE001 — any failure means "not serializable"
+        return False
+
+
+def _children(obj: Any):
+    """(name, child) pairs to descend into: closures, attrs, containers."""
+    if inspect.isfunction(obj):
+        if obj.__closure__:
+            for var, cell in zip(obj.__code__.co_freevars, obj.__closure__):
+                try:
+                    yield f"closure:{var}", cell.cell_contents
+                except ValueError:
+                    pass
+        for name, val in (obj.__globals__ or {}).items():
+            if name in obj.__code__.co_names and not inspect.ismodule(val):
+                yield f"global:{name}", val
+    elif isinstance(obj, dict):
+        for k, v in obj.items():
+            yield f"[{k!r}]", v
+    elif isinstance(obj, (list, tuple, set)):
+        for i, v in enumerate(obj):
+            yield f"[{i}]", v
+    elif hasattr(obj, "__dict__"):
+        for k, v in vars(obj).items():
+            yield f".{k}", v
+
+
+def inspect_serializability(
+        obj: Any, name: Optional[str] = None, depth: int = 3,
+        _failures: Optional[list] = None,
+        _seen: Optional[Set[int]] = None) -> Tuple[bool, list]:
+    """-> (serializable, [FailureTuple...]) — failures name the smallest
+    non-serializable members found."""
+    top = _failures is None
+    failures = _failures if _failures is not None else []
+    seen = _seen if _seen is not None else set()
+    name = name or getattr(obj, "__name__", type(obj).__name__)
+    if id(obj) in seen:
+        return True, failures
+    seen.add(id(obj))
+    if _try_serialize(obj):
+        return True, failures
+    found_child = False
+    if depth > 0:
+        for child_name, child in _children(obj):
+            if id(child) in seen:
+                continue
+            ok, _ = inspect_serializability(
+                child, f"{name}{child_name}", depth - 1, failures, seen)
+            if not ok:
+                found_child = True
+    if not found_child:
+        failures.append(FailureTuple(obj, name, None))
+    if top and failures:
+        import sys
+
+        for f in failures:
+            print(f"serialization failure: {f.name} "
+                  f"({type(f.obj).__name__})", file=sys.stderr)
+    return False, failures

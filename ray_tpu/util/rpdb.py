@@ -1,0 +1,201 @@
+"""Distributed debugger (reference: ray python/ray/util/rpdb.py:66,278 —
+`ray_tpu.util.rpdb.set_trace()` inside a task/actor opens a pdb session on
+a TCP socket and registers it in the GCS KV; `ray-tpu debug` (or
+`connect(...)` from any driver) lists active sessions and attaches).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pdb
+import socket
+import sys
+import uuid
+from typing import Dict, List, Optional
+
+_NAMESPACE = b"rpdb"
+
+
+class _SocketIO:
+    """File-like stdin/stdout over one socket for Pdb."""
+
+    def __init__(self, conn: socket.socket):
+        self._conn = conn
+        self._rfile = conn.makefile("r")
+        self._wfile = conn.makefile("w")
+
+    def readline(self):
+        return self._rfile.readline()
+
+    def write(self, data):
+        self._wfile.write(data)
+        return len(data)
+
+    def flush(self):
+        try:
+            self._wfile.flush()
+        except (BrokenPipeError, OSError):
+            pass
+
+    def close(self):
+        for f in (self._rfile, self._wfile, self._conn):
+            try:
+                f.close()
+            except OSError:
+                pass
+
+
+class RemotePdb(pdb.Pdb):
+    def __init__(self, conn: socket.socket, cleanup=None):
+        self._io = _SocketIO(conn)
+        self._cleanup = cleanup
+        super().__init__(stdin=self._io, stdout=self._io)
+        self.prompt = "(ray-tpu pdb) "
+
+    def _teardown(self):
+        # session over: deregister + close the listener (set_trace must be
+        # its caller's final statement, so cleanup lives here)
+        cleanup, self._cleanup = self._cleanup, None
+        if cleanup:
+            try:
+                cleanup()
+            except Exception:  # noqa: BLE001
+                pass
+
+    def do_continue(self, arg):
+        self._teardown()
+        try:
+            return super().do_continue(arg)
+        finally:
+            # close the client socket too — the attached terminal reads
+            # until EOF, and the task may run long after 'c'
+            self._io.close()
+
+    do_c = do_cont = do_continue
+
+    def do_quit(self, arg):
+        try:
+            self._teardown()
+            return super().do_quit(arg)
+        finally:
+            self._io.close()
+
+    do_q = do_exit = do_quit
+
+
+def _node_ip() -> str:
+    """This node's routable IP (remote drivers must be able to attach —
+    loopback only works single-node). UDP-connect trick: no packet is sent."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("8.8.8.8", 80))
+        return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+    finally:
+        s.close()
+
+
+def set_trace(frame=None) -> None:
+    """Block in the worker until a debugger client attaches, then hand the
+    calling frame to pdb over the socket."""
+    from ray_tpu.experimental import internal_kv
+
+    server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    server.bind(("0.0.0.0", 0))
+    server.listen(1)
+    host, port = _node_ip(), server.getsockname()[1]
+    session_id = uuid.uuid4().hex[:8]
+    info = {"host": host, "port": port, "pid": os.getpid(),
+            "session_id": session_id}
+    registered = False
+    try:
+        if internal_kv.internal_kv_initialized():
+            internal_kv.internal_kv_put(
+                session_id, json.dumps(info), namespace=_NAMESPACE)
+            registered = True
+    except Exception:  # noqa: BLE001 — debugging must not kill the task
+        pass
+    print(f"RemotePdb session {session_id} waiting on {host}:{port} "
+          f"(attach: ray-tpu debug)", file=sys.stderr, flush=True)
+
+    def cleanup():
+        server.close()
+        if registered:
+            try:
+                internal_kv.internal_kv_del(session_id, namespace=_NAMESPACE)
+            except Exception:  # noqa: BLE001
+                pass
+
+    try:
+        conn, _addr = server.accept()
+    except OSError:
+        cleanup()
+        raise
+    debugger = RemotePdb(conn, cleanup=cleanup)
+    # MUST be the last statement: Bdb.set_trace enters step mode, so any
+    # further line here would become the first stop instead of the caller.
+    debugger.set_trace(frame or sys._getframe().f_back)
+
+
+def list_sessions() -> List[Dict]:
+    """Active debug sessions registered in the cluster KV."""
+    from ray_tpu.experimental import internal_kv
+
+    out = []
+    for key in internal_kv.internal_kv_list(b"", namespace=_NAMESPACE):
+        raw = internal_kv.internal_kv_get(
+            key.split(b"::")[-1], namespace=_NAMESPACE)
+        if raw:
+            try:
+                out.append(json.loads(raw))
+            except json.JSONDecodeError:
+                pass
+    return out
+
+
+def connect(session: Optional[Dict] = None) -> None:
+    """Attach the current terminal to a waiting RemotePdb session."""
+    if session is None:
+        sessions = list_sessions()
+        if not sessions:
+            print("no active debug sessions")
+            return
+        session = sessions[-1]
+    sock = socket.create_connection(
+        (session["host"], session["port"]), timeout=10)
+    sock_file = sock.makefile("rw")
+    print(f"attached to session {session.get('session_id')} — "
+          "'q' to detach")
+    import threading
+
+    done = threading.Event()
+
+    def pump_output():
+        try:
+            while not done.is_set():
+                ch = sock_file.read(1)
+                if not ch:
+                    break
+                sys.stdout.write(ch)
+                sys.stdout.flush()
+        except (OSError, ValueError):
+            pass
+        done.set()
+
+    t = threading.Thread(target=pump_output, daemon=True)
+    t.start()
+    try:
+        while not done.is_set():
+            line = sys.stdin.readline()
+            if not line:
+                break
+            sock_file.write(line)
+            sock_file.flush()
+            if line.strip() in ("q", "quit", "exit"):
+                break
+    finally:
+        done.set()
+        sock.close()

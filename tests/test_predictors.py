@@ -1,0 +1,103 @@
+"""Predictor tests (reference patterns: ray
+python/ray/train/tests/test_torch_predictor.py, test_batch_predictor.py)."""
+
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from ray_tpu import data, train
+from ray_tpu.train import (
+    BatchPredictor,
+    Checkpoint,
+    JaxPredictor,
+    TorchPredictor,
+)
+
+
+# a lambda (pickled by value) so map_batches workers don't need to import
+# this test module
+_linear_apply = lambda params, x: x @ params["w"] + params["b"]  # noqa: E731
+
+
+@pytest.fixture
+def jax_checkpoint(tmp_path):
+    params = {"w": np.array([[2.0], [1.0]], np.float32),
+              "b": np.array([0.5], np.float32)}
+    d = str(tmp_path / "ck")
+    os.makedirs(d)
+    with open(os.path.join(d, "params.pkl"), "wb") as f:
+        pickle.dump(params, f)
+    return Checkpoint(d)
+
+
+def test_jax_predictor(jax_checkpoint):
+    p = JaxPredictor.from_checkpoint(jax_checkpoint,
+                                     apply_fn=_linear_apply)
+    out = p.predict({"inputs": np.array([[1.0, 2.0], [3.0, 4.0]],
+                                        np.float32)})
+    np.testing.assert_allclose(out["predictions"].ravel(), [4.5, 10.5])
+
+
+def test_jax_predictor_bucketing(jax_checkpoint):
+    """Odd batch sizes must pad to the bucket, then slice back exactly."""
+    p = JaxPredictor.from_checkpoint(jax_checkpoint,
+                                     apply_fn=_linear_apply)
+    x = np.random.rand(7, 2).astype(np.float32)
+    out = p.predict({"inputs": x})
+    assert out["predictions"].shape == (7, 1)
+    np.testing.assert_allclose(
+        out["predictions"], x @ [[2.0], [1.0]] + 0.5, rtol=1e-5)
+
+
+def test_jax_predictor_with_preprocessor(jax_checkpoint):
+    from ray_tpu.data.preprocessors import BatchMapper
+
+    pre = BatchMapper(lambda b: {"inputs": b["inputs"] * 2}).fit(None)
+    p = JaxPredictor.from_checkpoint(jax_checkpoint, apply_fn=_linear_apply,
+                                     preprocessor=pre)
+    out = p.predict({"inputs": np.array([[1.0, 0.0]], np.float32)})
+    np.testing.assert_allclose(out["predictions"].ravel(), [4.5])
+
+
+def test_torch_predictor(tmp_path):
+    import torch
+
+    model = torch.nn.Linear(2, 1)
+    with torch.no_grad():
+        model.weight.copy_(torch.tensor([[2.0, 1.0]]))
+        model.bias.copy_(torch.tensor([0.5]))
+    d = str(tmp_path / "tck")
+    os.makedirs(d)
+    torch.save(model, os.path.join(d, "model.pt"))
+    p = TorchPredictor.from_checkpoint(Checkpoint(d))
+    out = p.predict({"inputs": np.array([[1.0, 2.0]], np.float32)})
+    np.testing.assert_allclose(out["predictions"].ravel(), [4.5], rtol=1e-6)
+
+
+def test_torch_predictor_state_dict(tmp_path):
+    import torch
+
+    model = torch.nn.Linear(2, 1)
+    d = str(tmp_path / "tck2")
+    os.makedirs(d)
+    torch.save(model.state_dict(), os.path.join(d, "model_state.pt"))
+    fresh = torch.nn.Linear(2, 1)
+    p = TorchPredictor.from_checkpoint(Checkpoint(d), model=fresh)
+    x = np.random.rand(3, 2).astype(np.float32)
+    out = p.predict({"inputs": x})
+    expected = model(torch.as_tensor(x)).detach().numpy()
+    np.testing.assert_allclose(out["predictions"], expected, rtol=1e-6)
+
+
+def test_batch_predictor_over_dataset(ray_start_regular, jax_checkpoint):
+    bp = BatchPredictor(jax_checkpoint, JaxPredictor,
+                        apply_fn=_linear_apply)
+    ds = data.from_items(
+        [{"inputs": np.array([float(i), 0.0], np.float32)}
+         for i in range(10)])
+    out = bp.predict(ds, batch_size=4).take_all()
+    assert len(out) == 10
+    preds = sorted(float(np.ravel(r["predictions"])[0]) for r in out)
+    np.testing.assert_allclose(preds, [2.0 * i + 0.5 for i in range(10)])

@@ -1,0 +1,171 @@
+"""Tests for tqdm_ray, check_serialize, rpdb, experimental.array
+(reference patterns: ray python/ray/tests/test_tqdm.py,
+test_check_serialize.py, test_rpdb.py, experimental/array tests)."""
+
+import json
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+def test_tqdm_ray_aggregates_updates(ray_start_regular):
+    from ray_tpu.experimental import tqdm_ray
+
+    @ray_tpu.remote
+    def work(n):
+        bar = tqdm_ray.tqdm(desc=f"job{n}", total=10, flush_interval_s=0.0)
+        for _ in range(10):
+            bar.update(1)
+        bar.close()
+        return n
+
+    assert sorted(ray_tpu.get([work.remote(i) for i in range(3)])) == [0, 1, 2]
+    mgr = ray_tpu.get_actor("_tqdm_ray_manager")
+    done = []
+    for _ in range(100):  # updates are fire-and-forget: poll
+        state = ray_tpu.get(mgr.state.remote())
+        done = [b for b in state.values() if b["closed"]]
+        if len(done) == 3:
+            break
+        time.sleep(0.1)
+    assert len(done) == 3
+    assert all(b["n"] == 10 for b in done)
+
+
+def test_tqdm_ray_iterable_wrapper(ray_start_regular):
+    from ray_tpu.experimental import tqdm_ray
+
+    out = list(tqdm_ray.tqdm(range(5), desc="iter"))
+    assert out == [0, 1, 2, 3, 4]
+
+
+def test_check_serialize_finds_bad_member():
+    from ray_tpu.util.check_serialize import inspect_serializability
+
+    ok, failures = inspect_serializability(lambda x: x + 1)
+    assert ok and not failures
+
+    lock = threading.Lock()
+
+    def f(x):
+        with lock:
+            return x
+
+    ok, failures = inspect_serializability(f)
+    assert not ok
+    assert any("lock" in fail.name for fail in failures)
+
+
+def test_check_serialize_object_attr():
+    from ray_tpu.util.check_serialize import inspect_serializability
+
+    class Holder:
+        def __init__(self):
+            self.fine = 42
+            self.bad = socket.socket()
+
+    h = Holder()
+    try:
+        ok, failures = inspect_serializability(h)
+        assert not ok
+        assert any(".bad" in fail.name for fail in failures)
+    finally:
+        h.bad.close()
+
+
+def test_rpdb_session_roundtrip(ray_start_regular):
+    """set_trace in a task registers a session; a client can attach, step,
+    inspect a variable, and continue."""
+    from ray_tpu.util import rpdb
+
+    @ray_tpu.remote
+    def buggy():
+        secret = 1234  # noqa: F841 — inspected through the debugger
+        rpdb.set_trace()
+        return secret + 1
+
+    ref = buggy.remote()
+    sessions = []
+    for _ in range(100):
+        sessions = rpdb.list_sessions()
+        if sessions:
+            break
+        time.sleep(0.1)
+    assert sessions, "no debug session registered"
+    info = sessions[-1]
+
+    sock = socket.create_connection((info["host"], info["port"]), timeout=10)
+    f = sock.makefile("rw")
+    # read until prompt, query the local, then continue
+    f.write("p secret\nc\n")
+    f.flush()
+    out = []
+    sock.settimeout(5)
+    try:
+        while True:
+            ch = f.read(1)
+            if not ch:
+                break
+            out.append(ch)
+    except (TimeoutError, OSError):
+        pass
+    text = "".join(out)
+    sock.close()
+    assert "1234" in text
+    assert ray_tpu.get(ref, timeout=30) == 1235
+    # session deregistered after continue
+    for _ in range(50):
+        if not rpdb.list_sessions():
+            break
+        time.sleep(0.1)
+    assert not rpdb.list_sessions()
+
+
+def test_dist_array_ops(ray_start_regular):
+    from ray_tpu.experimental import array as da
+
+    a = np.arange(30, dtype=np.float64).reshape(5, 6)
+    b = np.ones((6, 4))
+    xa = da.from_numpy(a, block=3)
+    xb = da.from_numpy(b, block=3)
+    assert xa.grid_shape() == (2, 2)
+    np.testing.assert_allclose(xa.assemble(), a)
+    np.testing.assert_allclose(da.dot(xa, xb).assemble(), a @ b)
+    np.testing.assert_allclose(
+        da.add(xa, xa).assemble(), a * 2)
+    np.testing.assert_allclose(
+        da.multiply(xa, xa).assemble(), a * a)
+    np.testing.assert_allclose(da.transpose(xa).assemble(), a.T)
+    assert da.sum(xa) == a.sum()
+    assert abs(da.mean(xa) - a.mean()) < 1e-12
+
+
+def test_dist_array_constructors(ray_start_regular):
+    from ray_tpu.experimental import array as da
+
+    z = da.zeros((7, 5), block=4)
+    assert z.assemble().shape == (7, 5)
+    assert z.assemble().sum() == 0
+    o = da.ones((4,), block=3)
+    assert o.assemble().sum() == 4
+    e = da.eye(6, block=4)
+    np.testing.assert_allclose(e.assemble(), np.eye(6))
+
+
+def test_debug_cli_lists_sessions(ray_start_regular, capsys):
+    from ray_tpu.scripts.scripts import cmd_debug
+
+    class Args:
+        address = "auto"
+        list = True
+        session = None
+
+    rc = cmd_debug(Args())
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "No active debug sessions" in out or json.loads(out) == []
