@@ -45,6 +45,11 @@ def main():
         sys.exit(0)
 
     signal.signal(signal.SIGTERM, _term)
+    # `ray-tpu stack` support: SIGUSR1 dumps all thread stacks to stderr
+    # (captured in the worker's log file) — dependency-free py-spy analog.
+    import faulthandler
+
+    faulthandler.register(signal.SIGUSR1, all_threads=True)
 
     # The RPC loop threads do the work; park the main thread.
     try:

@@ -161,9 +161,11 @@ def dot(x: DistArray, y: DistArray) -> DistArray:
     output block (the k-reduction happens inside the task)."""
     if x.ndim != 2 or y.ndim != 2 or x.shape[1] != y.shape[0]:
         raise ValueError(f"dot shapes {x.shape} x {y.shape}")
+    if x.block != y.block:
+        raise ValueError(
+            f"dot requires matching block sizes, got {x.block} vs {y.block}")
     gi, gk = x.refs.shape
-    gk2, gj = y.refs.shape
-    assert gk == gk2
+    _gk2, gj = y.refs.shape
     refs = np.empty((gi, gj), dtype=object)
     for i in range(gi):
         for j in range(gj):

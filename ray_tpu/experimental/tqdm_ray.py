@@ -19,14 +19,18 @@ from typing import Dict, Optional
 _MANAGER_NAME = "_tqdm_ray_manager"
 
 
+_STALE_BAR_S = 600.0  # evict bars that stopped updating without close()
+
+
 class _BarState:
-    __slots__ = ("desc", "total", "n", "closed")
+    __slots__ = ("desc", "total", "n", "closed", "last_update")
 
     def __init__(self, desc, total):
         self.desc = desc
         self.total = total
         self.n = 0
         self.closed = False
+        self.last_update = time.monotonic()
 
 
 class _TqdmManager:
@@ -47,6 +51,13 @@ class _TqdmManager:
         bar.n += delta
         bar.closed = bar.closed or closed
         now = time.monotonic()
+        bar.last_update = now
+        # crashed/cancelled tasks never close their bars — evict by age so
+        # the detached manager doesn't render or hold them forever
+        stale = [k for k, b in self._bars.items()
+                 if not b.closed and now - b.last_update > _STALE_BAR_S]
+        for k in stale:
+            del self._bars[k]
         if closed or now - self._last_render > 0.2:
             self._last_render = now
             self._render()

@@ -277,6 +277,56 @@ def cmd_serve(args) -> int:
     return 0
 
 
+def cmd_stack(args) -> int:
+    """Dump python stacks of this node's worker processes (reference: ray
+    stack — scripts.py:1833; py-spy there, SIGUSR1+faulthandler here: every
+    worker registers a faulthandler dump on SIGUSR1 at startup)."""
+    import glob as _glob
+    import signal
+    import time as _time
+
+    # node-local (like `ray stack`): find worker processes via /proc —
+    # the state API only lists actor processes, not idle task workers
+    pids = []
+    for p in _glob.glob("/proc/[0-9]*/cmdline"):
+        try:
+            with open(p, "rb") as fh:
+                cmdline = fh.read().replace(b"\0", b" ")
+        except OSError:
+            continue
+        if b"ray_tpu._private.workers.default_worker" in cmdline:
+            pids.append(int(p.split("/")[2]))
+    if not pids:
+        print("no live workers")
+        return 0
+    from ray_tpu._private.config import CONFIG
+
+    log_dir = args.log_dir or os.path.join(CONFIG.log_dir, "workers")
+    marks = {}
+    for f in _glob.glob(os.path.join(log_dir, "worker-*.log")):
+        marks[f] = os.path.getsize(f)
+    signaled = []
+    for pid in pids:
+        try:
+            os.kill(pid, signal.SIGUSR1)
+            signaled.append(pid)
+        except (ProcessLookupError, PermissionError):
+            pass
+    _time.sleep(0.5)  # give faulthandler time to write
+    print(f"signaled {len(signaled)} workers: {signaled}")
+    for f, start in sorted(marks.items()):
+        try:
+            size = os.path.getsize(f)
+        except OSError:
+            continue
+        if size > start:
+            with open(f, "rb") as fh:
+                fh.seek(start)
+                new = fh.read().decode(errors="replace")
+            print(f"\n===== {os.path.basename(f)} =====\n{new}")
+    return 0
+
+
 def cmd_debug(args) -> int:
     """Attach to a waiting RemotePdb session (reference: ray debug —
     scripts.py:205 + util/rpdb.py)."""
@@ -370,6 +420,11 @@ def main(argv=None) -> int:
     sp.add_argument("config", nargs="?", help="JSON config (deploy)")
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_serve)
+
+    sp = sub.add_parser("stack", help="dump python stacks of node workers")
+    sp.add_argument("--address")
+    sp.add_argument("--log-dir")
+    sp.set_defaults(fn=cmd_stack)
 
     sp = sub.add_parser("debug", help="attach to a remote pdb session")
     sp.add_argument("--address")

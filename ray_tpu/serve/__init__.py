@@ -14,6 +14,7 @@ from ray_tpu.serve.api import (  # noqa: F401
     deployment,
     get_app_handle,
     get_deployment_handle,
+    ingress,
     run,
     shutdown,
     start,
@@ -21,7 +22,11 @@ from ray_tpu.serve.api import (  # noqa: F401
 )
 from ray_tpu.serve.batching import batch  # noqa: F401
 from ray_tpu.serve.context import get_multiplexed_model_id  # noqa: F401
-from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse  # noqa: F401
+from ray_tpu.serve.handle import (  # noqa: F401
+    DeploymentHandle,
+    DeploymentResponse,
+    DeploymentResponseGenerator,
+)
 from ray_tpu.serve.multiplex import multiplexed  # noqa: F401
 
 __all__ = [
@@ -29,12 +34,14 @@ __all__ = [
     "Deployment",
     "DeploymentHandle",
     "DeploymentResponse",
+    "DeploymentResponseGenerator",
     "batch",
     "delete",
     "deployment",
     "get_app_handle",
     "get_deployment_handle",
     "get_multiplexed_model_id",
+    "ingress",
     "multiplexed",
     "run",
     "shutdown",

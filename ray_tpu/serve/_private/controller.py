@@ -70,12 +70,16 @@ class ServeController:
 
     def deploy_application(self, app_name: str,
                            deployments: List[Dict[str, Any]],
-                           ingress: str, route_prefix: str) -> None:
+                           ingress: str, route_prefix: str,
+                           ingress_flags: Optional[Dict[str, Any]] = None,
+                           ) -> None:
         with self._lock:
             self._apps[app_name] = {
                 "ingress": ingress,
                 "route_prefix": route_prefix,
                 "deployments": [d["name"] for d in deployments],
+                # proxy behavior switches: {"asgi": bool, "streaming": bool}
+                "ingress_flags": ingress_flags or {},
             }
             for cfg in deployments:
                 key = f"{app_name}#{cfg['name']}"

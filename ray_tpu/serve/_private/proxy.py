@@ -18,6 +18,8 @@ import ray_tpu
 
 logger = logging.getLogger(__name__)
 
+_SENTINEL = object()  # end-of-stream marker for the chunked path
+
 
 class ProxyActor:
     def __init__(self, host: str = "127.0.0.1", port: int = 8000):
@@ -46,17 +48,18 @@ class ProxyActor:
         apps = ray_tpu.get(controller.list_applications.remote())
         routes = {}
         for app_name, info in apps.items():
-            routes[info["route_prefix"]] = DeploymentHandle(
-                info["ingress"], app_name)
+            handle = DeploymentHandle(info["ingress"], app_name)
+            routes[info["route_prefix"]] = (
+                handle, info.get("ingress_flags") or {})
         self._routes = routes
 
     def _match_route(self, path: str):
         best = None
-        for prefix, handle in self._routes.items():
+        for prefix, (handle, flags) in self._routes.items():
             if path == prefix or path.startswith(
                     prefix.rstrip("/") + "/") or prefix == "/":
                 if best is None or len(prefix) > len(best[0]):
-                    best = (prefix, handle)
+                    best = (prefix, handle, flags)
         return best
 
     def _serve_forever(self) -> None:
@@ -70,8 +73,39 @@ class ProxyActor:
             match = self._match_route(request.path)
             if match is None:
                 return web.Response(status=404, text="no matching route")
-            _, handle = match
+            prefix, handle, flags = match
             body = await request.read()
+
+            if flags.get("asgi"):
+                # forward the raw request; the ASGI app (e.g. FastAPI)
+                # runs inside the replica and returns status/headers/body
+                sub_path = request.path[len(prefix.rstrip("/")):] or "/"
+                raw = {
+                    "method": request.method,
+                    "path": sub_path,
+                    "query_string": request.query_string.encode(),
+                    "headers": [[k, v] for k, v in request.headers.items()],
+                    "body": body,
+                }
+                try:
+                    resp = await loop.run_in_executor(
+                        None, lambda: handle.remote(raw).result(timeout_s=60))
+                except Exception as e:  # noqa: BLE001 — surface as 500
+                    logger.exception("asgi request failed")
+                    return web.Response(status=500, text=str(e))
+                if isinstance(resp, dict) and resp.get("__serve_http__"):
+                    from multidict import CIMultiDict
+
+                    # multidict preserves repeated names (e.g. Set-Cookie)
+                    hdrs = CIMultiDict(
+                        (k, v) for k, v in resp.get("headers", [])
+                        if k.lower() not in
+                        ("content-length", "transfer-encoding"))
+                    return web.Response(
+                        status=resp["status"], body=resp["body"],
+                        headers=hdrs)
+                return web.json_response(resp)
+
             arg: Any
             if body:
                 try:
@@ -80,6 +114,46 @@ class ProxyActor:
                     arg = body
             else:
                 arg = dict(request.query) if request.query else None
+
+            if flags.get("streaming"):
+                # chunked transfer: one HTTP chunk per yielded value
+                stream = web.StreamResponse()
+                stream.enable_chunked_encoding()
+                await stream.prepare(request)
+                # routing blocks (queue-len probes, replica wait): keep it
+                # off the event loop like the unary paths
+                gen = await loop.run_in_executor(
+                    None, lambda: handle.options(stream=True).remote(arg))
+                it = iter(gen)
+
+                def next_chunk():
+                    try:
+                        return next(it)
+                    except StopIteration:
+                        return _SENTINEL
+
+                try:
+                    while True:
+                        chunk = await loop.run_in_executor(None, next_chunk)
+                        if chunk is _SENTINEL:
+                            break
+                        if isinstance(chunk, bytes):
+                            pass
+                        elif isinstance(chunk, str):
+                            chunk = chunk.encode()
+                        else:
+                            chunk = (json.dumps(chunk) + "\n").encode()
+                        await stream.write(chunk)
+                except Exception as e:  # noqa: BLE001 — mid-stream failure
+                    # status is already committed; signal the error in-band
+                    # instead of masking it as a clean end-of-stream
+                    logger.exception("streaming request failed mid-stream")
+                    await stream.write(
+                        f"\n[stream error] {e}\n".encode())
+                finally:
+                    await stream.write_eof()
+                return stream
+
             try:
                 response = await loop.run_in_executor(
                     None, lambda: handle.remote(arg).result(timeout_s=60))

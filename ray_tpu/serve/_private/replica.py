@@ -76,22 +76,53 @@ class ReplicaActor:
             self._loop = loop
         return loop
 
+    def _target(self, method_name: str):
+        if self._is_function or method_name in ("__call__", ""):
+            return self._callable
+        return getattr(self._callable, method_name)
+
     def handle_request(self, method_name: str, args: tuple,
                        kwargs: dict) -> Any:
         with self._lock:
             self._num_ongoing += 1
         try:
-            if self._is_function or method_name in ("__call__", ""):
-                target = self._callable
-            else:
-                target = getattr(self._callable, method_name)
-            out = target(*args, **kwargs)
+            out = self._target(method_name)(*args, **kwargs)
             if inspect.iscoroutine(out):
                 fut = asyncio.run_coroutine_threadsafe(out, self._user_loop())
                 out = fut.result()
             if inspect.isgenerator(out):
                 return list(out)
             return out
+        finally:
+            with self._lock:
+                self._num_ongoing -= 1
+                self._num_processed += 1
+
+    def handle_request_streaming(self, method_name: str, args: tuple,
+                                 kwargs: dict):
+        """Generator variant: chunks stream back as a streaming-generator
+        task (reference: replica.py handle_request_streaming — backs both
+        handle .options(stream=True) and HTTP streaming responses)."""
+        with self._lock:
+            self._num_ongoing += 1
+        try:
+            out = self._target(method_name)(*args, **kwargs)
+            if inspect.iscoroutine(out):
+                fut = asyncio.run_coroutine_threadsafe(out, self._user_loop())
+                out = fut.result()
+            if inspect.isasyncgen(out):
+                loop = self._user_loop()
+                while True:
+                    try:
+                        chunk = asyncio.run_coroutine_threadsafe(
+                            out.__anext__(), loop).result()
+                    except StopAsyncIteration:
+                        return
+                    yield chunk
+            elif inspect.isgenerator(out):
+                yield from out
+            else:
+                yield out
         finally:
             with self._lock:
                 self._num_ongoing -= 1

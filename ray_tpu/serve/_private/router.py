@@ -80,18 +80,28 @@ class Router:
                 self._app, self._deployment))
         self._scheduler.update_replicas(replicas)
 
-    def assign_request(self, method_name: str, args: tuple, kwargs: dict):
-        """Returns an ObjectRef for the response."""
+    def _choose(self):
         self._refresh()
         deadline = time.monotonic() + 30.0
         while True:
             replica = self._scheduler.choose_replica()
             if replica is not None:
-                return replica.handle_request.remote(
-                    method_name, args, kwargs)
+                return replica
             if time.monotonic() > deadline:
                 raise RuntimeError(
                     f"no replicas available for deployment "
                     f"{self._deployment!r} after 30s")
             time.sleep(0.1)
             self._refresh(force=True)
+
+    def assign_request(self, method_name: str, args: tuple, kwargs: dict):
+        """Returns an ObjectRef for the response."""
+        return self._choose().handle_request.remote(
+            method_name, args, kwargs)
+
+    def assign_request_streaming(self, method_name: str, args: tuple,
+                                 kwargs: dict):
+        """Returns an ObjectRefGenerator of response chunks."""
+        replica = self._choose()
+        return replica.handle_request_streaming.options(
+            num_returns="streaming").remote(method_name, args, kwargs)

@@ -109,6 +109,41 @@ def deployment(func_or_class=None, **options):
     return wrap
 
 
+def ingress(asgi_app):
+    """@serve.ingress(app) — host a FastAPI/Starlette/any-ASGI app inside
+    the ingress deployment (reference: serve/api.py @serve.ingress +
+    http_util.ASGIAppReplicaWrapper). The proxy forwards raw requests; the
+    app runs in the replica. Routes are plain ASGI/FastAPI handlers (module
+    functions or closures over the app) — self-injecting method routes are
+    not supported.
+
+        fastapi_app = FastAPI()
+
+        @fastapi_app.get("/hello")
+        def hello():
+            return {"ok": True}
+
+        @serve.deployment
+        @serve.ingress(fastapi_app)
+        class Api:
+            pass
+    """
+    def wrap(cls):
+        from ray_tpu.serve._private.asgi import run_asgi
+
+        class ASGIIngress(cls):
+            __serve_asgi__ = True
+
+            async def __call__(self, request: dict):
+                return await run_asgi(asgi_app, request or {})
+
+        ASGIIngress.__name__ = getattr(cls, "__name__", "ASGIIngress")
+        ASGIIngress.__qualname__ = ASGIIngress.__name__
+        return ASGIIngress
+
+    return wrap
+
+
 def start(detached: bool = True, http_options: Optional[dict] = None,
           **_kw) -> None:
     serve_context.get_controller(create=True)
@@ -165,8 +200,21 @@ def run(app: Application, *, name: str = "default", route_prefix: str = "/",
             "max_ongoing_requests": d.max_ongoing_requests,
             "autoscaling_config": d.autoscaling_config,
         })
+    import inspect as _inspect
+
+    root_fc = app.root.deployment.func_or_class
+    call_target = root_fc if not _inspect.isclass(root_fc) else getattr(
+        root_fc, "__call__", None)
+    ingress_flags = {
+        "asgi": bool(getattr(root_fc, "__serve_asgi__", False)),
+        "streaming": bool(
+            call_target is not None
+            and (_inspect.isgeneratorfunction(call_target)
+                 or _inspect.isasyncgenfunction(call_target))),
+    }
     ray_tpu.get(controller.deploy_application.remote(
-        name, deployments, app.root.deployment.name, route_prefix))
+        name, deployments, app.root.deployment.name, route_prefix,
+        ingress_flags))
     if http_port is not None:
         proxy = _ensure_proxy({"port": http_port})
         ray_tpu.get(proxy.update_routes.remote())

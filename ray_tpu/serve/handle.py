@@ -27,13 +27,30 @@ class DeploymentResponse:
         return self._ref.__await__()
 
 
+class DeploymentResponseGenerator:
+    """Iterator over a streaming deployment call's chunks (reference:
+    handle.py DeploymentResponseGenerator — .options(stream=True))."""
+
+    def __init__(self, ref_gen):
+        self._gen = ref_gen
+
+    def __iter__(self):
+        for ref in self._gen:
+            yield ray_tpu.get(ref)
+
+    def __next__(self):
+        return ray_tpu.get(next(self._gen))
+
+
 class DeploymentHandle:
     def __init__(self, deployment_name: str, app_name: str = "",
-                 method_name: str = "__call__", controller=None):
+                 method_name: str = "__call__", controller=None,
+                 stream: bool = False):
         self.deployment_name = deployment_name
         self.app_name = app_name
         self._method_name = method_name
         self._controller = controller
+        self._stream = stream
         self._router = None
 
     def _get_router(self):
@@ -47,10 +64,11 @@ class DeploymentHandle:
         return self._router
 
     def options(self, *, method_name: Optional[str] = None,
-                **_kw) -> "DeploymentHandle":
+                stream: Optional[bool] = None, **_kw) -> "DeploymentHandle":
         h = DeploymentHandle(
             self.deployment_name, self.app_name,
-            method_name or self._method_name, self._controller)
+            method_name or self._method_name, self._controller,
+            self._stream if stream is None else stream)
         h._router = self._router
         return h
 
@@ -65,10 +83,15 @@ class DeploymentHandle:
                      else a for a in args)
         kwargs = {k: v._to_object_ref() if isinstance(v, DeploymentResponse)
                   else v for k, v in kwargs.items()}
+        if self._stream:
+            gen = self._get_router().assign_request_streaming(
+                self._method_name, args, kwargs)
+            return DeploymentResponseGenerator(gen)
         ref = self._get_router().assign_request(
             self._method_name, args, kwargs)
         return DeploymentResponse(ref)
 
     def __reduce__(self):
         return (DeploymentHandle,
-                (self.deployment_name, self.app_name, self._method_name))
+                (self.deployment_name, self.app_name, self._method_name,
+                 None, self._stream))

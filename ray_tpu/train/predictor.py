@@ -164,6 +164,11 @@ class BatchPredictor:
             p = _PREDICTOR_CACHE.get(cache_key)
             if p is None:
                 p = cls.from_checkpoint(checkpoint, **kwargs)
+                # bounded: many-checkpoint sweeps must not pin every model
+                # in worker memory forever (FIFO, small — one entry is the
+                # common case)
+                while len(_PREDICTOR_CACHE) >= 4:
+                    _PREDICTOR_CACHE.pop(next(iter(_PREDICTOR_CACHE)))
                 _PREDICTOR_CACHE[cache_key] = p
             return p.predict(batch)
 

@@ -1,0 +1,108 @@
+"""Serve ASGI ingress + streaming tests (reference patterns: ray
+serve/tests/test_fastapi.py, test_streaming_response.py)."""
+
+import json
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def serve_shutdown():
+    yield
+    try:
+        serve.shutdown()
+    except Exception:
+        pass
+
+
+def _http_get(url, timeout=30):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.read()
+
+
+def test_streaming_handle(ray_start_regular, serve_shutdown):
+    @serve.deployment
+    def counter(n):
+        for i in range(int(n)):
+            yield i * 10
+
+    handle = serve.run(counter.bind(), name="stream_h",
+                       route_prefix="/stream_h")
+    chunks = list(handle.options(stream=True).remote(4))
+    assert chunks == [0, 10, 20, 30]
+
+
+def test_streaming_http_chunks(ray_start_regular, serve_shutdown):
+    @serve.deployment
+    def gen(arg):
+        for i in range(3):
+            yield {"i": i}
+
+    serve.run(gen.bind(), name="stream_app", route_prefix="/gen",
+              http_port=18111)
+    status, body = _http_get("http://127.0.0.1:18111/gen")
+    assert status == 200
+    lines = [json.loads(ln) for ln in body.decode().splitlines() if ln]
+    assert lines == [{"i": 0}, {"i": 1}, {"i": 2}]
+
+
+def test_asgi_ingress_minimal_app(ray_start_regular, serve_shutdown):
+    """A hand-written ASGI app (no framework dep) served via
+    @serve.ingress."""
+
+    async def tiny_asgi(scope, receive, send):
+        assert scope["type"] == "http"
+        event = await receive()
+        body = event.get("body", b"")
+        payload = json.dumps({
+            "path": scope["path"],
+            "method": scope["method"],
+            "echo": body.decode() if body else None,
+        }).encode()
+        await send({"type": "http.response.start", "status": 201,
+                    "headers": [(b"content-type", b"application/json"),
+                                (b"x-custom", b"yes")]})
+        await send({"type": "http.response.body", "body": payload})
+
+    @serve.deployment
+    @serve.ingress(tiny_asgi)
+    class Api:
+        pass
+
+    serve.run(Api.bind(), name="asgi_app", route_prefix="/api",
+              http_port=18112)
+    req = urllib.request.Request(
+        "http://127.0.0.1:18112/api/hello?x=1", data=b"ping",
+        method="POST")
+    with urllib.request.urlopen(req, timeout=30) as r:
+        assert r.status == 201
+        assert r.headers["x-custom"] == "yes"
+        out = json.loads(r.read())
+    assert out["path"] == "/hello"
+    assert out["method"] == "POST"
+    assert out["echo"] == "ping"
+
+
+def test_fastapi_ingress(ray_start_regular, serve_shutdown):
+    fastapi = pytest.importorskip("fastapi")
+
+    app = fastapi.FastAPI()
+
+    @app.get("/hello")
+    def hello():
+        return {"msg": "hi"}
+
+    @serve.deployment
+    @serve.ingress(app)
+    class Api:
+        pass
+
+    serve.run(Api.bind(), name="fastapi_app", route_prefix="/f",
+              http_port=18113)
+    status, body = _http_get("http://127.0.0.1:18113/f/hello")
+    assert status == 200
+    assert json.loads(body) == {"msg": "hi"}

@@ -157,6 +157,27 @@ def test_dist_array_constructors(ray_start_regular):
     np.testing.assert_allclose(e.assemble(), np.eye(6))
 
 
+def test_stack_cli_dumps_worker_stacks(ray_start_regular, capsys):
+    from ray_tpu.scripts.scripts import cmd_stack
+
+    @ray_tpu.remote
+    def sleepy():
+        time.sleep(20)
+
+    ref = sleepy.remote()
+    time.sleep(2.0)  # worker spawned and inside sleep
+
+    class Args:
+        address = "auto"
+        log_dir = None
+
+    assert cmd_stack(Args()) == 0
+    out = capsys.readouterr().out
+    assert "signaled" in out
+    assert "sleepy" in out  # the running task's frame appears in a dump
+    ray_tpu.cancel(ref)
+
+
 def test_debug_cli_lists_sessions(ray_start_regular, capsys):
     from ray_tpu.scripts.scripts import cmd_debug
 
