@@ -19,7 +19,7 @@ from typing import Dict, Optional
 _MANAGER_NAME = "_tqdm_ray_manager"
 
 
-_STALE_BAR_S = 600.0  # evict bars that stopped updating without close()
+_MAX_OPEN_BARS = 1024  # cap never-closed bars (crashed tasks leak them)
 
 
 class _BarState:
@@ -52,12 +52,15 @@ class _TqdmManager:
         bar.closed = bar.closed or closed
         now = time.monotonic()
         bar.last_update = now
-        # crashed/cancelled tasks never close their bars — evict by age so
-        # the detached manager doesn't render or hold them forever
-        stale = [k for k, b in self._bars.items()
-                 if not b.closed and now - b.last_update > _STALE_BAR_S]
-        for k in stale:
-            del self._bars[k]
+        # crashed/cancelled tasks never close their bars. Evicting by age
+        # would reset slow-but-alive bars, so instead cap the open set and
+        # drop the LEAST-recently-updated when it overflows.
+        open_bars = [(b.last_update, k) for k, b in self._bars.items()
+                     if not b.closed]
+        if len(open_bars) > _MAX_OPEN_BARS:
+            open_bars.sort()
+            for _, k in open_bars[:len(open_bars) - _MAX_OPEN_BARS]:
+                del self._bars[k]
         if closed or now - self._last_render > 0.2:
             self._last_render = now
             self._render()

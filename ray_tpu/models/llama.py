@@ -20,6 +20,7 @@ from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name as _checkpoint_name
 
 from ray_tpu.ops.flash_attention import flash_attention
 from ray_tpu.parallel.sharding import LogicalAxisRules, with_logical_constraint
@@ -144,6 +145,15 @@ def _remat_policy(config):
     name = getattr(config, "remat_policy", "full")
     if name == "dots":
         return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    if name == "dots_attn":
+        # "dots" + save the flash-attention outputs by name: pallas_call is
+        # not a dot, so under plain "dots" the whole attention forward
+        # kernel reruns inside the backward pass. Saving it costs
+        # B*S*H*D bf16 per layer (64 MB at bench shapes).
+        return jax.checkpoint_policies.save_from_both_policies(
+            jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            jax.checkpoint_policies.save_only_these_names("attn_out"),
+        )
     return None
 
 
@@ -216,6 +226,7 @@ def _attn_sublayer(x, params, positions, config: LlamaConfig, mesh=None,
         new_cache = (k_cache, v_cache)
     else:
         attn = _attention(q, k, v, c, mesh)
+        attn = _checkpoint_name(attn, "attn_out")
     x = x + jnp.einsum("bshk,hkd->bsd", attn, params["wo"])
     return lc(x, ("batch", "seq", "act_embed")), new_cache
 

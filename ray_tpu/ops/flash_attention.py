@@ -353,10 +353,11 @@ def flash_attention(
     q, k, v,
     causal: bool = True,
     scale: Optional[float] = None,
-    # 512x512 halves fwd+bwd attention time vs 128x128 on v5e at seq 2048
-    # (measured: grad 21.3ms -> 9.5ms at B8/H16/D128); clamped to seq below.
+    # Measured on v5e at B8/H16/D128 seq 2048 (fwd+bwd): 128x128 ~2x slower
+    # than 512x512 (14.2ms); 512x1024 is best (12.3ms; 1024x512 12.5ms,
+    # 1024x1024 and k=1536+ exceed VMEM). Clamped to seq below.
     block_q: int = 512,
-    block_k: int = 512,
+    block_k: int = 1024,
     use_pallas: Optional[bool] = None,
     interpret: bool = False,
 ):

@@ -40,7 +40,10 @@ async def run_asgi(app, request: Dict[str, Any]) -> Dict[str, Any]:
 
     async def receive():
         if received["done"]:
-            await asyncio.sleep(3600)  # no more events (disconnect never sent)
+            # no further events ever arrive (the request is fully buffered
+            # and disconnect is not modeled) — block forever, never replay
+            while True:
+                await asyncio.sleep(3600)
         received["done"] = True
         return {"type": "http.request", "body": body, "more_body": False}
 

@@ -49,17 +49,21 @@ class ProxyActor:
         routes = {}
         for app_name, info in apps.items():
             handle = DeploymentHandle(info["ingress"], app_name)
+            # one long-lived stream-enabled handle per route, so streaming
+            # requests share the router (and its replica/queue-len cache)
+            # instead of rebuilding one per request
             routes[info["route_prefix"]] = (
-                handle, info.get("ingress_flags") or {})
+                handle, handle.options(stream=True),
+                info.get("ingress_flags") or {})
         self._routes = routes
 
     def _match_route(self, path: str):
         best = None
-        for prefix, (handle, flags) in self._routes.items():
+        for prefix, (handle, stream_handle, flags) in self._routes.items():
             if path == prefix or path.startswith(
                     prefix.rstrip("/") + "/") or prefix == "/":
                 if best is None or len(prefix) > len(best[0]):
-                    best = (prefix, handle, flags)
+                    best = (prefix, handle, stream_handle, flags)
         return best
 
     def _serve_forever(self) -> None:
@@ -73,7 +77,7 @@ class ProxyActor:
             match = self._match_route(request.path)
             if match is None:
                 return web.Response(status=404, text="no matching route")
-            prefix, handle, flags = match
+            prefix, handle, stream_handle, flags = match
             body = await request.read()
 
             if flags.get("asgi"):
@@ -116,15 +120,20 @@ class ProxyActor:
                 arg = dict(request.query) if request.query else None
 
             if flags.get("streaming"):
-                # chunked transfer: one HTTP chunk per yielded value
+                # Route BEFORE committing the 200: replica assignment can
+                # fail (no replicas) and must surface as a 500, not a
+                # truncated stream. Routing blocks (queue-len probes), so
+                # keep it off the event loop like the unary paths.
+                try:
+                    gen = await loop.run_in_executor(
+                        None, lambda: stream_handle.remote(arg))
+                except Exception as e:  # noqa: BLE001 — surface as 500
+                    logger.exception("streaming route failed")
+                    return web.Response(status=500, text=str(e))
+                it = iter(gen)
                 stream = web.StreamResponse()
                 stream.enable_chunked_encoding()
                 await stream.prepare(request)
-                # routing blocks (queue-len probes, replica wait): keep it
-                # off the event loop like the unary paths
-                gen = await loop.run_in_executor(
-                    None, lambda: handle.options(stream=True).remote(arg))
-                it = iter(gen)
 
                 def next_chunk():
                     try:

@@ -163,12 +163,12 @@ class BatchPredictor:
         def infer(batch):
             p = _PREDICTOR_CACHE.get(cache_key)
             if p is None:
-                p = cls.from_checkpoint(checkpoint, **kwargs)
                 # bounded: many-checkpoint sweeps must not pin every model
-                # in worker memory forever (FIFO, small — one entry is the
-                # common case)
+                # in worker memory forever. Evict BEFORE loading so peak
+                # memory stays at the cap, not cap+1 models.
                 while len(_PREDICTOR_CACHE) >= 4:
                     _PREDICTOR_CACHE.pop(next(iter(_PREDICTOR_CACHE)))
+                p = cls.from_checkpoint(checkpoint, **kwargs)
                 _PREDICTOR_CACHE[cache_key] = p
             return p.predict(batch)
 
