@@ -33,6 +33,8 @@ class DashboardHead:
         self.gcs_address = gcs_address
         self._lt = EventLoopThread("dashboard")
         self._gcs = RpcClient(gcs_address, self._lt)
+        self._jobs_lock = threading.Lock()
+        self._jobs_sdk = None
         dash = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -51,6 +53,18 @@ class DashboardHead:
                     except Exception:  # noqa: BLE001
                         pass
 
+            def do_POST(self):  # noqa: N802 — http.server API
+                try:
+                    dash._route_post(self)
+                except BrokenPipeError:
+                    pass
+                except Exception as e:  # noqa: BLE001
+                    logger.exception("dashboard POST failed")
+                    try:
+                        self.send_error(500, str(e))
+                    except Exception:  # noqa: BLE001
+                        pass
+
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._httpd.daemon_threads = True
         self.url = f"http://{host}:{self._httpd.server_address[1]}"
@@ -61,8 +75,91 @@ class DashboardHead:
 
     # -- routing -------------------------------------------------------------
 
+    # -- job submission REST API (reference: dashboard/modules/job/
+    # job_head.py — POST/GET /api/jobs/) ------------------------------------
+
+    def _jobs_client(self):
+        """Lazy driver connection for the submission API: actor calls need
+        a core worker, which `start --head` processes don't have until the
+        first job request arrives. Locked: ThreadingHTTPServer handlers run
+        concurrently and double-init raises."""
+        with self._jobs_lock:
+            if self._jobs_sdk is None:
+                import ray_tpu
+                from ray_tpu.job_submission import JobSubmissionClient
+
+                if not ray_tpu.is_initialized():
+                    ray_tpu.init(address=self.gcs_address)
+                self._jobs_sdk = JobSubmissionClient()
+            return self._jobs_sdk
+
+    @staticmethod
+    def _job_json(details) -> Dict[str, Any]:
+        return {
+            "submission_id": details.submission_id,
+            "entrypoint": details.entrypoint,
+            "status": details.status.value,
+            "message": details.message,
+            "metadata": details.metadata,
+            "start_time": details.start_time,
+            "end_time": details.end_time,
+            "driver_exit_code": details.driver_exit_code,
+        }
+
+    def _route_jobs_get(self, req, parts) -> None:
+        client = self._jobs_client()
+        if not parts:  # GET /api/jobs/  — list submissions
+            self._json(req, [self._job_json(d)
+                             for d in client.list_jobs()])
+        elif len(parts) == 1:  # GET /api/jobs/<sid>
+            try:
+                details = client.get_job_info(parts[0])
+            except RuntimeError:
+                req.send_error(404, f"job {parts[0]!r} not found")
+                return
+            self._json(req, self._job_json(details))
+        elif len(parts) == 2 and parts[1] == "logs":
+            # ?offset=N serves only the tail past N bytes so tailers don't
+            # re-download the whole file each poll
+            from urllib.parse import parse_qs, urlparse
+
+            q = parse_qs(urlparse(req.path).query)
+            offset = int(q.get("offset", ["0"])[0])
+            text = client.get_job_logs(parts[0])
+            self._json(req, {"logs": text[offset:], "total_len": len(text)})
+        else:
+            req.send_error(404)
+
+    def _route_post(self, req: BaseHTTPRequestHandler) -> None:
+        path = req.path.split("?")[0].rstrip("/")
+        length = int(req.headers.get("Content-Length") or 0)
+        body = json.loads(req.rfile.read(length) or b"{}") if length else {}
+        if path == "/api/jobs":
+            if not body.get("entrypoint"):
+                req.send_error(400, "missing required field 'entrypoint'")
+                return
+            client = self._jobs_client()
+            sid = client.submit_job(
+                entrypoint=body["entrypoint"],
+                submission_id=body.get("submission_id"),
+                runtime_env=body.get("runtime_env"),
+                metadata=body.get("metadata"))
+            self._json(req, {"submission_id": sid})
+        elif path.startswith("/api/jobs/") and path.endswith("/stop"):
+            sid = path[len("/api/jobs/"):-len("/stop")]
+            self._json(req, {"stopped": self._jobs_client().stop_job(sid)})
+        else:
+            req.send_error(404)
+
     def _route(self, req: BaseHTTPRequestHandler) -> None:
         path = req.path.split("?")[0].rstrip("/") or "/"
+        # submission API: /api/jobs/<...> (GET /api/jobs without a subpath
+        # keeps serving cluster job info from the GCS, like /api/nodes)
+        if path.startswith("/api/jobs/") or (
+                req.path.split("?")[0] == "/api/jobs/"):
+            self._route_jobs_get(
+                req, [p for p in path[len("/api/jobs/"):].split("/") if p])
+            return
         if path == "/":
             self._respond(req, self._index_html(), "text/html")
         elif path == "/metrics":

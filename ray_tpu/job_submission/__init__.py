@@ -176,24 +176,68 @@ def _manager_handle():
 
 
 class JobSubmissionClient:
-    """SDK + CLI face (reference: dashboard/modules/job/sdk.py:39). The
-    `address` is the cluster GCS address (or None to use the current/ambient
-    connection)."""
+    """SDK + CLI face (reference: dashboard/modules/job/sdk.py:39).
+
+    `address` is either the cluster GCS address (or None for the ambient
+    connection) — actor-backed mode — or an `http(s)://` dashboard URL,
+    which talks to the dashboard's REST job API without joining the
+    cluster (the reference's only mode)."""
 
     def __init__(self, address: Optional[str] = None):
+        self._http: Optional[str] = None
+        if address and address.startswith(("http://", "https://")):
+            self._http = address.rstrip("/")
+            self._mgr = None
+            return
         import ray_tpu
 
         if not ray_tpu.is_initialized():
             ray_tpu.init(address=address or os.environ.get("RT_ADDRESS"))
         self._mgr = _manager_handle()
 
+    # -- REST transport ------------------------------------------------------
+
+    def _rest(self, method: str, path: str, body: Optional[dict] = None):
+        import json as _json
+        import urllib.error
+        import urllib.request
+
+        data = _json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            self._http + path, data=data, method=method,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=60) as r:
+                return _json.loads(r.read() or b"null")
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                raise RuntimeError(
+                    f"Job not found ({path}).") from None
+            raise
+
+    @staticmethod
+    def _details_from_json(d: dict) -> JobDetails:
+        return JobDetails(
+            submission_id=d["submission_id"], entrypoint=d["entrypoint"],
+            status=JobStatus(d["status"]), message=d.get("message", ""),
+            metadata=d.get("metadata") or {},
+            start_time=d.get("start_time"), end_time=d.get("end_time"),
+            driver_exit_code=d.get("driver_exit_code"))
+
+    # -- API -----------------------------------------------------------------
+
     def submit_job(self, *, entrypoint: str,
                    submission_id: Optional[str] = None,
                    runtime_env: Optional[dict] = None,
                    metadata: Optional[dict] = None) -> str:
+        sid = submission_id or f"raysubmit_{uuid.uuid4().hex[:12]}"
+        if self._http:
+            return self._rest("POST", "/api/jobs", {
+                "entrypoint": entrypoint, "submission_id": sid,
+                "runtime_env": runtime_env, "metadata": metadata,
+            })["submission_id"]
         import ray_tpu
 
-        sid = submission_id or f"raysubmit_{uuid.uuid4().hex[:12]}"
         return ray_tpu.get(self._mgr.submit.remote(
             entrypoint, sid, runtime_env, metadata))
 
@@ -202,6 +246,9 @@ class JobSubmissionClient:
         return details.status
 
     def get_job_info(self, submission_id: str) -> JobDetails:
+        if self._http:
+            return self._details_from_json(
+                self._rest("GET", f"/api/jobs/{submission_id}"))
         import ray_tpu
 
         details = ray_tpu.get(self._mgr.status.remote(submission_id))
@@ -210,33 +257,52 @@ class JobSubmissionClient:
         return details
 
     def list_jobs(self) -> List[JobDetails]:
+        if self._http:
+            return [self._details_from_json(d)
+                    for d in self._rest("GET", "/api/jobs/")]
         import ray_tpu
 
         return ray_tpu.get(self._mgr.list.remote())
 
     def stop_job(self, submission_id: str) -> bool:
+        if self._http:
+            return self._rest(
+                "POST", f"/api/jobs/{submission_id}/stop")["stopped"]
         import ray_tpu
 
         return ray_tpu.get(self._mgr.stop.remote(submission_id))
 
     def get_job_logs(self, submission_id: str) -> str:
+        if self._http:
+            return self._rest(
+                "GET", f"/api/jobs/{submission_id}/logs")["logs"]
         import ray_tpu
 
         return ray_tpu.get(self._mgr.logs.remote(submission_id))
+
+    def _logs_from(self, submission_id: str, offset: int):
+        """-> (new_text, new_total_len); http mode fetches only the tail."""
+        if self._http:
+            out = self._rest(
+                "GET", f"/api/jobs/{submission_id}/logs?offset={offset}")
+            return out["logs"], out.get(
+                "total_len", offset + len(out["logs"]))
+        text = self.get_job_logs(submission_id)
+        return text[offset:], len(text)
 
     def tail_job_logs(self, submission_id: str,
                       poll_interval_s: float = 0.5) -> Iterator[str]:
         """Yield log increments until the job reaches a terminal state."""
         offset = 0
         while True:
-            text = self.get_job_logs(submission_id)
-            if len(text) > offset:
-                yield text[offset:]
-                offset = len(text)
+            new, offset_new = self._logs_from(submission_id, offset)
+            if new:
+                yield new
+            offset = offset_new
             status = self.get_job_status(submission_id)
             if status.is_terminal():
-                text = self.get_job_logs(submission_id)
-                if len(text) > offset:
-                    yield text[offset:]
+                new, _ = self._logs_from(submission_id, offset)
+                if new:
+                    yield new
                 return
             time.sleep(poll_interval_s)

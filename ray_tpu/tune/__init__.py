@@ -22,6 +22,12 @@ from ray_tpu.tune.search.sample import (  # noqa: F401
     randn,
     uniform,
 )
+from ray_tpu.tune.logger import (  # noqa: F401
+    Callback,
+    CSVLoggerCallback,
+    JsonLoggerCallback,
+    TBXLoggerCallback,
+)
 from ray_tpu.tune.tuner import ResultGrid, TuneConfig, Tuner  # noqa: F401
 
 
@@ -63,8 +69,12 @@ def run(trainable, *, config=None, num_samples=1, metric=None, mode="max",
 
 
 __all__ = [
+    "CSVLoggerCallback",
+    "Callback",
     "Checkpoint",
+    "JsonLoggerCallback",
     "ResultGrid",
+    "TBXLoggerCallback",
     "TuneConfig",
     "Tuner",
     "choice",

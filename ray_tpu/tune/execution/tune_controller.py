@@ -55,6 +55,7 @@ class TuneController:
         experiment_name: Optional[str] = None,
         stop: Optional[Dict[str, Any]] = None,
         trial_executor_cls=None,
+        callbacks: Optional[List[Any]] = None,
     ):
         self._trainable = trainable
         self._searcher = searcher or BasicVariantGenerator(
@@ -82,8 +83,19 @@ class TuneController:
         self._actor_cls = ray_tpu.remote(trial_executor_cls or TrainWorker)
         self.trials: List[Trial] = []
         self._pending_result: Dict[Any, Trial] = {}  # ref -> trial
+        self._pending_start: Dict[Any, Trial] = {}  # start_training refs
         self._search_done = False
         self._num_suggested = 0
+        self._callbacks = callbacks or []
+        self._iteration = 0
+
+    def _invoke_callbacks(self, hook: str, *args, **kwargs) -> None:
+        for cb in self._callbacks:
+            try:
+                getattr(cb, hook)(*args, **kwargs)
+            except Exception:  # noqa: BLE001 — callbacks must not kill runs
+                logger.exception("callback %s.%s failed",
+                                 type(cb).__name__, hook)
 
     # -- experiment state checkpoint ----------------------------------------
 
@@ -147,8 +159,15 @@ class TuneController:
         # whole event loop.
         ray_tpu.get(actor.init_session.remote(
             ctx_kwargs, trial.latest_checkpoint), timeout=120.0)
-        actor.start_training.remote(self._trainable, trial.config)
+        # Track the start ref too: if the trainable can't even deserialize
+        # in the worker (e.g. a module-level function whose module the
+        # worker can't import), the error lands HERE — next_result would
+        # block forever.
+        start_ref = actor.start_training.remote(self._trainable, trial.config)
+        self._pending_start[start_ref] = trial
         trial.status = RUNNING
+        self._invoke_callbacks(
+            "on_trial_start", self._iteration, self.trials, trial)
         ref = actor.next_result.remote()
         self._pending_result[ref] = trial
 
@@ -165,6 +184,8 @@ class TuneController:
         self._scheduler.on_trial_complete(trial, trial.last_result)
         self._searcher.on_trial_complete(
             trial.trial_id, trial.last_result, error=status == ERROR)
+        self._invoke_callbacks(
+            "on_trial_complete", self._iteration, self.trials, trial)
 
     def _maybe_create_trials(self) -> None:
         while (not self._search_done
@@ -214,6 +235,8 @@ class TuneController:
             trial.latest_checkpoint = Checkpoint(
                 trial.storage.checkpoint_path(payload["checkpoint_dir_name"]))
         trial.storage.append_result(result)
+        self._invoke_callbacks(
+            "on_trial_result", self._iteration, self.trials, trial, result)
         self._searcher.on_trial_result(trial.trial_id, result)
         decision = self._scheduler.on_trial_result(trial, result)
         if self._check_stop_criteria(result):
@@ -250,6 +273,7 @@ class TuneController:
 
     def step(self) -> bool:
         """One event-loop turn. Returns False when everything is done."""
+        self._iteration += 1
         self._maybe_create_trials()
         for trial in self.trials:
             if trial.status == PENDING and (
@@ -260,12 +284,25 @@ class TuneController:
                 except Exception as e:  # noqa: BLE001 — actor start failure
                     logger.exception("failed to launch trial %s", trial)
                     self._stop_trial(trial, ERROR, str(e))
-        if not self._pending_result:
+        if not self._pending_result and not self._pending_start:
             return any(t.status in (PENDING, RUNNING) for t in self.trials) \
                 or not self._search_done
         ready, _ = ray_tpu.wait(
-            list(self._pending_result), num_returns=1, timeout=1.0)
+            list(self._pending_result) + list(self._pending_start),
+            num_returns=1, timeout=1.0)
         for ref in ready:
+            if ref in self._pending_start:
+                trial = self._pending_start.pop(ref)
+                try:
+                    ray_tpu.get(ref)  # None on success
+                except Exception as e:  # noqa: BLE001 — bad trainable
+                    if trial.status == RUNNING:
+                        # drop the now-dead next_result ref too
+                        for r, t in list(self._pending_result.items()):
+                            if t is trial:
+                                self._pending_result.pop(r)
+                        self._stop_trial(trial, ERROR, str(e))
+                continue
             trial = self._pending_result.pop(ref)
             try:
                 payload = ray_tpu.get(ref)
@@ -287,4 +324,5 @@ class TuneController:
                 if t.status == RUNNING:
                     self._stop_trial(t, ERROR, "controller exited")
             self.save_experiment_state()
+            self._invoke_callbacks("on_experiment_end", self.trials)
         return self.trials

@@ -1,0 +1,142 @@
+"""Output loggers + callback hooks (reference: ray python/ray/tune/logger/ —
+CSVLoggerCallback csv.py, JsonLoggerCallback json.py, TBXLoggerCallback
+tensorboardx.py; callback base python/ray/tune/callback.py). Attach via
+RunConfig(callbacks=[...])."""
+
+from __future__ import annotations
+
+import csv
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+
+class Callback:
+    """Experiment-loop hooks; all optional."""
+
+    def on_trial_start(self, iteration: int, trials: List, trial,
+                       **info) -> None:
+        pass
+
+    def on_trial_result(self, iteration: int, trials: List, trial,
+                        result: Dict[str, Any], **info) -> None:
+        pass
+
+    def on_trial_complete(self, iteration: int, trials: List, trial,
+                          **info) -> None:
+        pass
+
+    def on_experiment_end(self, trials: List, **info) -> None:
+        pass
+
+
+def _trial_dir(trial) -> Optional[str]:
+    storage = getattr(trial, "storage", None)
+    return getattr(storage, "trial_dir", None) if storage else None
+
+
+def _flatten(result: Dict[str, Any], prefix: str = "") -> Dict[str, Any]:
+    out = {}
+    for k, v in result.items():
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            out.update(_flatten(v, key + "/"))
+        else:
+            out[key] = v
+    return out
+
+
+class CSVLoggerCallback(Callback):
+    """progress.csv per trial, one row per result (reference: csv.py).
+    The header is fixed from the first result; later-appearing keys are
+    dropped (same as the reference)."""
+
+    def __init__(self):
+        self._files: Dict[str, Any] = {}
+        self._writers: Dict[str, Any] = {}
+
+    def on_trial_result(self, iteration, trials, trial, result, **info):
+        d = _trial_dir(trial)
+        if d is None:
+            return
+        flat = {k: v for k, v in _flatten(result).items()
+                if not isinstance(v, (list, tuple))}
+        tid = trial.trial_id
+        if tid not in self._files:
+            os.makedirs(d, exist_ok=True)
+            f = open(os.path.join(d, "progress.csv"), "w", newline="")
+            w = csv.DictWriter(f, fieldnames=sorted(flat))
+            w.writeheader()
+            self._files[tid], self._writers[tid] = f, w
+        self._writers[tid].writerow(
+            {k: flat.get(k) for k in self._writers[tid].fieldnames})
+        self._files[tid].flush()
+
+    def on_trial_complete(self, iteration, trials, trial, **info):
+        f = self._files.pop(trial.trial_id, None)
+        self._writers.pop(trial.trial_id, None)
+        if f:
+            f.close()
+
+    def on_experiment_end(self, trials, **info):
+        for f in self._files.values():
+            f.close()
+        self._files.clear()
+        self._writers.clear()
+
+
+class JsonLoggerCallback(Callback):
+    """result.json per trial: one JSON line per result (reference:
+    json.py). Managed trials already get result.json from the controller's
+    StorageContext, so for those this callback is a no-op; pass `log_dir`
+    to log storage-less trials (e.g. custom controllers) to
+    <log_dir>/<trial_id>/result.json."""
+
+    def __init__(self, log_dir: Optional[str] = None):
+        self.log_dir = log_dir
+
+    def on_trial_result(self, iteration, trials, trial, result, **info):
+        if getattr(trial, "storage", None) is not None:
+            return  # StorageContext.append_result already logs JSON lines
+        if self.log_dir is None:
+            return
+        d = os.path.join(self.log_dir, str(trial.trial_id))
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, "result.json"), "a") as f:
+            f.write(json.dumps(result, default=str) + "\n")
+
+
+class TBXLoggerCallback(Callback):
+    """TensorBoard event files per trial — requires tensorboardX (gated:
+    raises ImportError at construction when unavailable, like the
+    reference)."""
+
+    def __init__(self):
+        import tensorboardX  # noqa: F401 — availability check
+
+        self._writers: Dict[str, Any] = {}
+
+    def on_trial_result(self, iteration, trials, trial, result, **info):
+        d = _trial_dir(trial)
+        if d is None:
+            return
+        import tensorboardX
+
+        tid = trial.trial_id
+        if tid not in self._writers:
+            self._writers[tid] = tensorboardX.SummaryWriter(d)
+        step = result.get("training_iteration", iteration)
+        for k, v in _flatten(result).items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                self._writers[tid].add_scalar(k, v, global_step=step)
+        self._writers[tid].flush()
+
+    def on_trial_complete(self, iteration, trials, trial, **info):
+        w = self._writers.pop(trial.trial_id, None)
+        if w:
+            w.close()
+
+    def on_experiment_end(self, trials, **info):
+        for w in self._writers.values():
+            w.close()
+        self._writers.clear()

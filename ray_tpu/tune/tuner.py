@@ -100,6 +100,7 @@ class Tuner:
             storage_path=self._run_config.storage_path,
             experiment_name=self._run_config.name,
             stop=self._run_config.stop,
+            callbacks=self._run_config.callbacks,
         )
         if self._restored_trials:
             controller.restore_trials(self._restored_trials)

@@ -55,6 +55,46 @@ def test_dashboard_endpoints(dash_cluster):
     assert "gcs_address" in version
 
 
+def test_rest_job_submission_api(dash_cluster):
+    """POST/GET /api/jobs/ — the reference's REST surface
+    (dashboard/modules/job/job_head.py), consumed here through
+    JobSubmissionClient in http mode."""
+    import time
+
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+    base = dash_cluster.dashboard_url
+    client = JobSubmissionClient(base)  # http:// → REST mode
+    sid = client.submit_job(
+        entrypoint="python -c \"print('rest job ran')\"")
+    assert sid.startswith("raysubmit_")
+    for _ in range(120):
+        status = client.get_job_status(sid)
+        if status.is_terminal():
+            break
+        time.sleep(0.25)
+    assert status == JobStatus.SUCCEEDED
+    assert "rest job ran" in client.get_job_logs(sid)
+    listed = client.list_jobs()
+    assert any(d.submission_id == sid for d in listed)
+    info = client.get_job_info(sid)
+    assert info.driver_exit_code == 0
+
+    # client-error mapping: unknown job -> 404, missing entrypoint -> 400
+    import urllib.error
+    import urllib.request
+
+    with pytest.raises(urllib.error.HTTPError) as e404:
+        _get(base + "/api/jobs/nonexistent_id")
+    assert e404.value.code == 404
+    req = urllib.request.Request(base + "/api/jobs", data=b"{}",
+                                 method="POST",
+                                 headers={"Content-Type": "application/json"})
+    with pytest.raises(urllib.error.HTTPError) as e400:
+        urllib.request.urlopen(req, timeout=10)
+    assert e400.value.code == 400
+
+
 def test_dashboard_prometheus_metrics(dash_cluster):
     from ray_tpu.util.metrics import Counter
 

@@ -1,0 +1,153 @@
+"""Tune logger-callback tests (reference pattern: ray
+python/ray/tune/tests/test_logger.py)."""
+
+import csv
+import json
+import os
+
+import pytest
+
+from ray_tpu import tune
+from ray_tpu.air import RunConfig
+from ray_tpu.tune import (
+    Callback,
+    CSVLoggerCallback,
+    JsonLoggerCallback,
+    TuneConfig,
+    Tuner,
+)
+
+
+# NB: trainables are closures (pickled by value) — workers cannot import
+# this test module. A module-level trainable fails fast (see
+# test_bad_trainable_errors_not_hangs).
+def _make_trainable():
+    def trainable(config):
+        for i in range(3):
+            tune.report({"score": config["x"] * (i + 1),
+                         "nested": {"a": i}})
+
+    return trainable
+
+
+_trainable = _make_trainable()
+
+
+def test_logger_callbacks_write_files(ray_start_regular, tmp_path):
+    events = []
+
+    class Recorder(Callback):
+        def on_trial_start(self, iteration, trials, trial, **info):
+            events.append(("start", trial.trial_id))
+
+        def on_trial_result(self, iteration, trials, trial, result, **info):
+            events.append(("result", trial.trial_id))
+
+        def on_trial_complete(self, iteration, trials, trial, **info):
+            events.append(("complete", trial.trial_id))
+
+        def on_experiment_end(self, trials, **info):
+            events.append(("end", None))
+
+    tuner = Tuner(
+        _trainable,
+        param_space={"x": tune.grid_search([1, 2])},
+        tune_config=TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(
+            name="logger_test", storage_path=str(tmp_path),
+            callbacks=[CSVLoggerCallback(), JsonLoggerCallback(),
+                       Recorder()]),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 2
+
+    starts = [e for e in events if e[0] == "start"]
+    completes = [e for e in events if e[0] == "complete"]
+    results = [e for e in events if e[0] == "result"]
+    assert len(starts) == 2 and len(completes) == 2
+    assert len(results) == 6  # 3 reports x 2 trials
+    assert events[-1] == ("end", None)
+
+    exp_dir = os.path.join(str(tmp_path), "logger_test")
+    trial_dirs = [d for d in os.listdir(exp_dir)
+                  if os.path.isdir(os.path.join(exp_dir, d))]
+    csv_found = json_found = 0
+    for d in trial_dirs:
+        p = os.path.join(exp_dir, d, "progress.csv")
+        if os.path.exists(p):
+            with open(p) as f:
+                rows = list(csv.DictReader(f))
+            assert len(rows) == 3
+            assert "score" in rows[0]
+            assert "nested/a" in rows[0]  # flattened
+            csv_found += 1
+        j = os.path.join(exp_dir, d, "result.json")
+        if os.path.exists(j):
+            # written once per result (the built-in StorageContext writer;
+            # JsonLoggerCallback must not double-log managed trials)
+            with open(j) as f:
+                lines = [json.loads(ln) for ln in f if ln.strip()]
+            assert len(lines) == 3
+            assert lines[-1]["score"] in (3, 6)
+            json_found += 1
+    assert csv_found == 2 and json_found == 2
+
+
+def test_json_logger_storage_less_fallback(tmp_path):
+    """With log_dir set, storage-less trials get result.json from the
+    callback itself (managed trials are handled by StorageContext)."""
+
+    class T:
+        trial_id = "t0"
+        storage = None
+
+    cb = JsonLoggerCallback(log_dir=str(tmp_path))
+    cb.on_trial_result(1, [], T(), {"score": 5})
+    cb.on_trial_result(2, [], T(), {"score": 6})
+    with open(os.path.join(str(tmp_path), "t0", "result.json")) as f:
+        lines = [json.loads(ln) for ln in f if ln.strip()]
+    assert [ln["score"] for ln in lines] == [5, 6]
+
+
+def test_bad_trainable_errors_not_hangs(ray_start_regular, tmp_path):
+    """A trainable the worker cannot deserialize (here: a function pickled
+    by reference to an unimportable module) must fail the trial, not hang
+    the controller forever."""
+    import sys
+    import types
+
+    mod = types.ModuleType("_not_on_workers")
+
+    def bad_trainable(config):
+        tune.report({"score": 1})
+
+    bad_trainable.__module__ = "_not_on_workers"
+    bad_trainable.__qualname__ = "bad_trainable"
+    mod.bad_trainable = bad_trainable
+    sys.modules["_not_on_workers"] = mod
+    try:
+        tuner = Tuner(
+            bad_trainable, param_space={"x": 1},
+            tune_config=TuneConfig(metric="score", mode="max"),
+            run_config=RunConfig(name="bad_t", storage_path=str(tmp_path)),
+        )
+        grid = tuner.fit()
+        assert len(grid.errors) == 1
+    finally:
+        del sys.modules["_not_on_workers"]
+
+
+def test_callback_errors_do_not_kill_run(ray_start_regular, tmp_path):
+    class Broken(Callback):
+        def on_trial_result(self, *a, **k):
+            raise RuntimeError("boom")
+
+    tuner = Tuner(
+        _trainable,
+        param_space={"x": 1},
+        tune_config=TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(name="broken_cb", storage_path=str(tmp_path),
+                             callbacks=[Broken()]),
+    )
+    grid = tuner.fit()
+    assert grid.get_best_result().metrics["score"] == 3
