@@ -101,6 +101,7 @@ class DashboardHead:
             "status": details.status.value,
             "message": details.message,
             "metadata": details.metadata,
+            "runtime_env": details.runtime_env,
             "start_time": details.start_time,
             "end_time": details.end_time,
             "driver_exit_code": details.driver_exit_code,
@@ -119,14 +120,15 @@ class DashboardHead:
                 return
             self._json(req, self._job_json(details))
         elif len(parts) == 2 and parts[1] == "logs":
-            # ?offset=N serves only the tail past N bytes so tailers don't
-            # re-download the whole file each poll
+            # ?offset=N: the manager seeks past N bytes — neither the actor
+            # RPC nor the HTTP response carries the already-seen prefix
             from urllib.parse import parse_qs, urlparse
 
             q = parse_qs(urlparse(req.path).query)
             offset = int(q.get("offset", ["0"])[0])
-            text = client.get_job_logs(parts[0])
-            self._json(req, {"logs": text[offset:], "total_len": len(text)})
+            text = client.get_job_logs(parts[0], offset)
+            self._json(req, {"logs": text,
+                             "total_len": offset + len(text.encode())})
         else:
             req.send_error(404)
 

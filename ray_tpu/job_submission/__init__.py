@@ -154,12 +154,20 @@ class _JobManager:
             pass
         return True
 
-    def logs(self, submission_id: str) -> str:
+    def logs(self, submission_id: str, offset: int = 0) -> str:
         try:
             with open(self._log_path(submission_id), "rb") as f:
+                if offset:
+                    f.seek(offset)
                 return f.read().decode(errors="replace")
         except OSError:
             return ""
+
+    def log_len(self, submission_id: str) -> int:
+        try:
+            return os.path.getsize(self._log_path(submission_id))
+        except OSError:
+            return 0
 
 
 def _manager_handle():
@@ -221,6 +229,7 @@ class JobSubmissionClient:
             submission_id=d["submission_id"], entrypoint=d["entrypoint"],
             status=JobStatus(d["status"]), message=d.get("message", ""),
             metadata=d.get("metadata") or {},
+            runtime_env=d.get("runtime_env") or {},
             start_time=d.get("start_time"), end_time=d.get("end_time"),
             driver_exit_code=d.get("driver_exit_code"))
 
@@ -272,23 +281,29 @@ class JobSubmissionClient:
 
         return ray_tpu.get(self._mgr.stop.remote(submission_id))
 
-    def get_job_logs(self, submission_id: str) -> str:
+    def get_job_logs(self, submission_id: str, offset: int = 0) -> str:
         if self._http:
             return self._rest(
-                "GET", f"/api/jobs/{submission_id}/logs")["logs"]
+                "GET",
+                f"/api/jobs/{submission_id}/logs?offset={offset}")["logs"]
         import ray_tpu
 
-        return ray_tpu.get(self._mgr.logs.remote(submission_id))
+        return ray_tpu.get(self._mgr.logs.remote(submission_id, offset))
 
     def _logs_from(self, submission_id: str, offset: int):
-        """-> (new_text, new_total_len); http mode fetches only the tail."""
+        """-> (new_text, new_total_len); both modes fetch only the tail."""
         if self._http:
             out = self._rest(
                 "GET", f"/api/jobs/{submission_id}/logs?offset={offset}")
             return out["logs"], out.get(
                 "total_len", offset + len(out["logs"]))
-        text = self.get_job_logs(submission_id)
-        return text[offset:], len(text)
+        import ray_tpu
+
+        # byte offsets (the file is read with seek): take the authoritative
+        # length from the manager so multi-byte chars don't skew tracking
+        new = self.get_job_logs(submission_id, offset)
+        total = ray_tpu.get(self._mgr.log_len.remote(submission_id))
+        return new, max(total, offset)
 
     def tail_job_logs(self, submission_id: str,
                       poll_interval_s: float = 0.5) -> Iterator[str]:

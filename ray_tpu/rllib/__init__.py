@@ -11,7 +11,13 @@ donated buffers, and multi-learner data parallelism is a mesh sharding
 
 from ray_tpu.rllib.algorithm import Algorithm  # noqa: F401
 from ray_tpu.rllib.algorithm_config import AlgorithmConfig  # noqa: F401
+from ray_tpu.rllib.env import MultiAgentEnv  # noqa: F401
 from ray_tpu.rllib.episode import SingleAgentEpisode  # noqa: F401
+from ray_tpu.rllib.multi_agent import (  # noqa: F401
+    MultiAgentEpisode,
+    MultiAgentPPO,
+    MultiAgentPPOConfig,
+)
 from ray_tpu.rllib.replay_buffer import (  # noqa: F401
     PrioritizedReplayBuffer,
     ReplayBuffer,
@@ -20,6 +26,10 @@ from ray_tpu.rllib.replay_buffer import (  # noqa: F401
 __all__ = [
     "Algorithm",
     "AlgorithmConfig",
+    "MultiAgentEnv",
+    "MultiAgentEpisode",
+    "MultiAgentPPO",
+    "MultiAgentPPOConfig",
     "PrioritizedReplayBuffer",
     "ReplayBuffer",
     "SingleAgentEpisode",

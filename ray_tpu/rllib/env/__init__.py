@@ -1,0 +1,6 @@
+"""Env APIs (reference: ray rllib/env/ — MultiAgentEnv multi_agent_env.py;
+single-agent runners live in ray_tpu.rllib.env_runner)."""
+
+from ray_tpu.rllib.env.multi_agent_env import MultiAgentEnv  # noqa: F401
+
+__all__ = ["MultiAgentEnv"]

@@ -64,9 +64,18 @@ class CSVLoggerCallback(Callback):
         tid = trial.trial_id
         if tid not in self._files:
             os.makedirs(d, exist_ok=True)
-            f = open(os.path.join(d, "progress.csv"), "w", newline="")
-            w = csv.DictWriter(f, fieldnames=sorted(flat))
-            w.writeheader()
+            path = os.path.join(d, "progress.csv")
+            # append on resume (restored trials reuse their dir) — the
+            # existing header defines the columns
+            existing_header = None
+            if os.path.exists(path) and os.path.getsize(path) > 0:
+                with open(path, newline="") as rf:
+                    existing_header = next(csv.reader(rf), None)
+            f = open(path, "a", newline="")
+            w = csv.DictWriter(
+                f, fieldnames=existing_header or sorted(flat))
+            if existing_header is None:
+                w.writeheader()
             self._files[tid], self._writers[tid] = f, w
         self._writers[tid].writerow(
             {k: flat.get(k) for k in self._writers[tid].fieldnames})
@@ -125,10 +134,13 @@ class TBXLoggerCallback(Callback):
         tid = trial.trial_id
         if tid not in self._writers:
             self._writers[tid] = tensorboardX.SummaryWriter(d)
+        import numbers
+
         step = result.get("training_iteration", iteration)
         for k, v in _flatten(result).items():
-            if isinstance(v, (int, float)) and not isinstance(v, bool):
-                self._writers[tid].add_scalar(k, v, global_step=step)
+            # numbers.Number admits numpy scalars too (np.float32 etc.)
+            if isinstance(v, numbers.Number) and not isinstance(v, bool):
+                self._writers[tid].add_scalar(k, float(v), global_step=step)
         self._writers[tid].flush()
 
     def on_trial_complete(self, iteration, trials, trial, **info):

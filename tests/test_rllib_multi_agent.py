@@ -1,0 +1,140 @@
+"""Multi-agent RLlib tests (reference patterns: ray
+rllib/examples/multi_agent/, rllib/tests/test_multi_agent_env.py)."""
+
+import numpy as np
+import pytest
+
+from ray_tpu.rllib import (
+    MultiAgentEnv,
+    MultiAgentEpisode,
+    MultiAgentPPOConfig,
+)
+
+
+class _Box:
+    def __init__(self, dim):
+        self.shape = (dim,)
+
+
+class _Discrete:
+    def __init__(self, n):
+        self.n = n
+
+
+class TargetMatch(MultiAgentEnv):
+    """Two agents; each observes a one-hot target in {0,1} and gets +1 for
+    picking the matching action, -1 otherwise. Learnable to ~+1/step/agent;
+    a random policy averages 0."""
+
+    possible_agents = ["a0", "a1"]
+
+    def __init__(self, horizon: int = 16):
+        self.observation_spaces = {a: _Box(2) for a in self.possible_agents}
+        self.action_spaces = {a: _Discrete(2) for a in self.possible_agents}
+        self.horizon = horizon
+        self._rng = np.random.default_rng(0)
+
+    def _obs(self):
+        self._targets = {a: int(self._rng.integers(2))
+                         for a in self.possible_agents}
+        return {a: np.eye(2, dtype=np.float32)[t]
+                for a, t in self._targets.items()}
+
+    def reset(self, *, seed=None, options=None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._t = 0
+        return self._obs(), {a: {} for a in self.possible_agents}
+
+    def step(self, action_dict):
+        rewards = {a: (1.0 if action_dict[a] == self._targets[a] else -1.0)
+                   for a in action_dict}
+        self._t += 1
+        done = self._t >= self.horizon
+        obs = self._obs()
+        terms = {a: False for a in action_dict}
+        terms["__all__"] = done
+        truncs = {a: False for a in action_dict}
+        truncs["__all__"] = False
+        return obs, rewards, terms, truncs, {a: {} for a in action_dict}
+
+
+def test_multi_agent_episode_bookkeeping():
+    mae = MultiAgentEpisode()
+    mae.agent("a0").add_env_reset(np.zeros(2))
+    mae.agent("a0").add_env_step(np.ones(2), 1, 0.5)
+    mae.agent("a1").add_env_reset(np.zeros(2))
+    assert len(mae) == 1
+    assert mae.total_reward == 0.5
+
+
+def test_multi_agent_ppo_shared_policy_learns():
+    config = (MultiAgentPPOConfig()
+              .environment(TargetMatch)
+              .training(lr=3e-3, train_batch_size=512, minibatch_size=128,
+                        num_epochs=4, entropy_coeff=0.0, gamma=0.0)
+              .multi_agent(policies=["shared"],
+                           policy_mapping_fn=lambda aid: "shared")
+              .debugging(seed=0))
+    algo = config.build()
+    try:
+        best = -np.inf
+        for _ in range(30):
+            result = algo.train()
+            ret = result.get("episode_return_mean")
+            if ret is not None:
+                best = max(best, ret)
+            if best > 8.0:  # horizon 16, ~+1/step when learned (max 16)
+                break
+        assert best > 8.0, f"best mean episode return {best}"
+    finally:
+        algo.stop()
+
+
+def test_multi_agent_ppo_per_agent_policies():
+    config = (MultiAgentPPOConfig()
+              .environment(TargetMatch)
+              .training(lr=3e-3, train_batch_size=256, minibatch_size=64,
+                        num_epochs=2, gamma=0.0)
+              .multi_agent(policies=["p0", "p1"],
+                           policy_mapping_fn=lambda aid:
+                           "p0" if aid == "a0" else "p1")
+              .debugging(seed=0))
+    algo = config.build()
+    try:
+        result = algo.train()
+        # both policies produced learner metrics
+        assert "p0" in result and "p1" in result
+        assert "total_loss" in result["p0"]
+        # distinct learner states
+        import jax
+
+        l0 = jax.tree_util.tree_leaves(algo.learners["p0"].params)
+        l1 = jax.tree_util.tree_leaves(algo.learners["p1"].params)
+        assert any(not np.allclose(np.asarray(a), np.asarray(b))
+                   for a, b in zip(l0, l1))
+    finally:
+        algo.stop()
+
+
+def test_multi_agent_checkpoint_roundtrip(tmp_path):
+    config = (MultiAgentPPOConfig()
+              .environment(TargetMatch)
+              .training(train_batch_size=128, minibatch_size=64,
+                        num_epochs=1)
+              .multi_agent(policies=["shared"],
+                           policy_mapping_fn=lambda aid: "shared")
+              .debugging(seed=0))
+    algo = config.build()
+    algo.train()
+    ck = algo.save(str(tmp_path / "ma"))
+    algo2 = config.build()
+    algo2.restore(ck)
+    import jax
+
+    p1 = jax.tree_util.tree_leaves(algo.learners["shared"].params)
+    p2 = jax.tree_util.tree_leaves(algo2.learners["shared"].params)
+    for a, b in zip(p1, p2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    algo.stop()
+    algo2.stop()
