@@ -1,0 +1,20 @@
+"""Experiment-tracking integrations (reference: ray
+python/ray/air/integrations/{wandb,mlflow}.py). Gated: constructing a
+callback raises ImportError when the tracker isn't installed, same as the
+reference."""
+
+from ray_tpu.air.integrations.mlflow import (  # noqa: F401
+    MLflowLoggerCallback,
+    setup_mlflow,
+)
+from ray_tpu.air.integrations.wandb import (  # noqa: F401
+    WandbLoggerCallback,
+    setup_wandb,
+)
+
+__all__ = [
+    "MLflowLoggerCallback",
+    "WandbLoggerCallback",
+    "setup_mlflow",
+    "setup_wandb",
+]

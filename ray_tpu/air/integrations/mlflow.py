@@ -1,0 +1,89 @@
+"""MLflow integration (reference: ray
+python/ray/air/integrations/mlflow.py — MLflowLoggerCallback mirrors trial
+results into MLflow runs; setup_mlflow configures tracking inside a train
+fn)."""
+
+from __future__ import annotations
+
+import numbers
+from typing import Any, Dict, Optional
+
+from ray_tpu.tune.logger import Callback, _flatten
+
+
+def _import_mlflow():
+    try:
+        import mlflow
+    except ImportError as e:
+        raise ImportError(
+            "mlflow is not installed; `pip install mlflow` to use the "
+            "MLflow integration") from e
+    return mlflow
+
+
+def setup_mlflow(config: Optional[Dict[str, Any]] = None, *,
+                 tracking_uri: Optional[str] = None,
+                 experiment_name: Optional[str] = None, **_kw):
+    """Configure MLflow inside a train fn and start a run (reference:
+    mlflow.py setup_mlflow)."""
+    mlflow = _import_mlflow()
+    if tracking_uri:
+        mlflow.set_tracking_uri(tracking_uri)
+    if experiment_name:
+        mlflow.set_experiment(experiment_name)
+    run = mlflow.start_run(nested=True)
+    if config:
+        mlflow.log_params(
+            {k: v for k, v in config.items()
+             if isinstance(v, (str, int, float, bool))})
+    return run
+
+
+class MLflowLoggerCallback(Callback):
+    """One MLflow run per trial. Uses MlflowClient with explicit run ids —
+    NOT the fluent global-run API — because trials run concurrently and the
+    fluent "active run" would cross-wire their metric streams (the
+    reference does the same)."""
+
+    def __init__(self, tracking_uri: Optional[str] = None,
+                 experiment_name: Optional[str] = None, **_kw):
+        mlflow = _import_mlflow()
+        self._client = mlflow.tracking.MlflowClient(
+            tracking_uri=tracking_uri)
+        if experiment_name:
+            exp = self._client.get_experiment_by_name(experiment_name)
+            self._experiment_id = (exp.experiment_id if exp else
+                                   self._client.create_experiment(
+                                       experiment_name))
+        else:
+            self._experiment_id = "0"
+        self._runs: Dict[str, str] = {}  # trial_id -> run_id
+
+    def on_trial_start(self, iteration, trials, trial, **info):
+        run = self._client.create_run(
+            self._experiment_id,
+            tags={"mlflow.runName": str(trial.trial_id)})
+        self._runs[trial.trial_id] = run.info.run_id
+        for k, v in dict(trial.config).items():
+            if isinstance(v, (str, int, float, bool)):
+                self._client.log_param(run.info.run_id, k, v)
+
+    def on_trial_result(self, iteration, trials, trial, result, **info):
+        run_id = self._runs.get(trial.trial_id)
+        if run_id is None:
+            return
+        step = int(result.get("training_iteration", iteration))
+        for k, v in _flatten(result).items():
+            if isinstance(v, numbers.Number) and not isinstance(v, bool):
+                self._client.log_metric(
+                    run_id, k.replace("/", "."), float(v), step=step)
+
+    def on_trial_complete(self, iteration, trials, trial, **info):
+        run_id = self._runs.pop(trial.trial_id, None)
+        if run_id is not None:
+            self._client.set_terminated(run_id)
+
+    def on_experiment_end(self, trials, **info):
+        for run_id in self._runs.values():
+            self._client.set_terminated(run_id)
+        self._runs.clear()

@@ -1,0 +1,67 @@
+"""Weights & Biases integration (reference: ray
+python/ray/air/integrations/wandb.py — WandbLoggerCallback logs every trial
+result to a W&B run; setup_wandb initializes a run inside a train fn)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ray_tpu.tune.logger import Callback, _flatten
+
+
+def _import_wandb():
+    try:
+        import wandb
+    except ImportError as e:
+        raise ImportError(
+            "wandb is not installed; `pip install wandb` to use the W&B "
+            "integration") from e
+    return wandb
+
+
+def setup_wandb(config: Optional[Dict[str, Any]] = None, *,
+                project: Optional[str] = None, **kwargs):
+    """Init a W&B run inside a train fn, named after the trial (reference:
+    wandb.py setup_wandb)."""
+    wandb = _import_wandb()
+    from ray_tpu.train import get_context
+
+    ctx = get_context()
+    name = getattr(ctx, "trial_name", None)
+    return wandb.init(project=project, name=name, config=config, **kwargs)
+
+
+class WandbLoggerCallback(Callback):
+    """One W&B run per trial; every reported result becomes a wandb.log."""
+
+    def __init__(self, project: Optional[str] = None,
+                 group: Optional[str] = None, **init_kwargs):
+        self._wandb = _import_wandb()
+        self.project = project
+        self.group = group
+        self.init_kwargs = init_kwargs
+        self._runs: Dict[str, Any] = {}
+
+    def on_trial_start(self, iteration, trials, trial, **info):
+        # reinit="create_new": trials run concurrently, and the legacy
+        # reinit=True would FINISH the previous trial's still-running run
+        self._runs[trial.trial_id] = self._wandb.init(
+            project=self.project, group=self.group, name=trial.trial_id,
+            config=dict(trial.config), reinit="create_new",
+            **self.init_kwargs)
+
+    def on_trial_result(self, iteration, trials, trial, result, **info):
+        run = self._runs.get(trial.trial_id)
+        if run is not None:
+            run.log({k: v for k, v in _flatten(result).items()
+                     if not isinstance(v, (list, tuple, dict))})
+
+    def on_trial_complete(self, iteration, trials, trial, **info):
+        run = self._runs.pop(trial.trial_id, None)
+        if run is not None:
+            run.finish()
+
+    def on_experiment_end(self, trials, **info):
+        for run in self._runs.values():
+            run.finish()
+        self._runs.clear()

@@ -126,9 +126,8 @@ class DashboardHead:
 
             q = parse_qs(urlparse(req.path).query)
             offset = int(q.get("offset", ["0"])[0])
-            text = client.get_job_logs(parts[0], offset)
-            self._json(req, {"logs": text,
-                             "total_len": offset + len(text.encode())})
+            text, end = client.get_job_logs_from(parts[0], offset)
+            self._json(req, {"logs": text, "total_len": end})
         else:
             req.send_error(404)
 

@@ -191,6 +191,55 @@ class Dataset:
             yield {k: torch.as_tensor(np.ascontiguousarray(v))
                    for k, v in batch.items()}
 
+    def iter_tf_batches(self, *, batch_size: int = 256,
+                        drop_last: bool = False) -> Iterator[Dict[str, Any]]:
+        """numpy batches as tf tensors (reference: dataset.py
+        iter_tf_batches)."""
+        import tensorflow as tf
+
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format="numpy",
+                                       drop_last=drop_last):
+            yield {k: tf.convert_to_tensor(v) for k, v in batch.items()}
+
+    def to_tf(self, feature_columns, label_columns, *,
+              batch_size: int = 256):
+        """A tf.data.Dataset over (features, labels) tuples (reference:
+        dataset.py to_tf). Columns may be a name or list of names; a single
+        name yields the bare tensor, a list yields a dict."""
+        import tensorflow as tf
+
+        def norm(cols):
+            return [cols] if isinstance(cols, str) else list(cols)
+
+        fcols, lcols = norm(feature_columns), norm(label_columns)
+        probe = next(
+            self.iter_batches(batch_size=2, batch_format="numpy"), None)
+        if probe is None:
+            raise ValueError("to_tf cannot infer a schema from an empty "
+                             "dataset")
+
+        def spec(cols):
+            specs = {
+                c: tf.TensorSpec(
+                    shape=(None,) + probe[c].shape[1:],
+                    dtype=tf.as_dtype(probe[c].dtype))
+                for c in cols}
+            return specs[cols[0]] if len(cols) == 1 else specs
+
+        def pick(batch, cols):
+            if len(cols) == 1:
+                return tf.convert_to_tensor(batch[cols[0]])
+            return {c: tf.convert_to_tensor(batch[c]) for c in cols}
+
+        def gen():
+            for batch in self.iter_batches(batch_size=batch_size,
+                                           batch_format="numpy"):
+                yield pick(batch, fcols), pick(batch, lcols)
+
+        return tf.data.Dataset.from_generator(
+            gen, output_signature=(spec(fcols), spec(lcols)))
+
     # -- consumption ---------------------------------------------------------
 
     def take(self, limit: int = 20) -> List[Dict[str, Any]]:

@@ -163,11 +163,18 @@ class _JobManager:
         except OSError:
             return ""
 
-    def log_len(self, submission_id: str) -> int:
+    def logs_from(self, submission_id: str, offset: int = 0):
+        """-> (text, end_byte_offset) from ONE read, so the end offset is
+        exactly where this read stopped (no lost bytes between calls, no
+        decode-length skew)."""
         try:
-            return os.path.getsize(self._log_path(submission_id))
+            with open(self._log_path(submission_id), "rb") as f:
+                if offset:
+                    f.seek(offset)
+                raw = f.read()
+            return raw.decode(errors="replace"), offset + len(raw)
         except OSError:
-            return 0
+            return "", offset
 
 
 def _manager_handle():
@@ -291,7 +298,9 @@ class JobSubmissionClient:
         return ray_tpu.get(self._mgr.logs.remote(submission_id, offset))
 
     def _logs_from(self, submission_id: str, offset: int):
-        """-> (new_text, new_total_len); both modes fetch only the tail."""
+        """-> (new_text, end_byte_offset); both modes fetch only the tail
+        and the offset comes from the same single read that produced the
+        text (no window for lost bytes)."""
         if self._http:
             out = self._rest(
                 "GET", f"/api/jobs/{submission_id}/logs?offset={offset}")
@@ -299,11 +308,11 @@ class JobSubmissionClient:
                 "total_len", offset + len(out["logs"]))
         import ray_tpu
 
-        # byte offsets (the file is read with seek): take the authoritative
-        # length from the manager so multi-byte chars don't skew tracking
-        new = self.get_job_logs(submission_id, offset)
-        total = ray_tpu.get(self._mgr.log_len.remote(submission_id))
-        return new, max(total, offset)
+        return ray_tpu.get(self._mgr.logs_from.remote(submission_id, offset))
+
+    def get_job_logs_from(self, submission_id: str, offset: int = 0):
+        """Public tail API: -> (text, end_byte_offset)."""
+        return self._logs_from(submission_id, offset)
 
     def tail_job_logs(self, submission_id: str,
                       poll_interval_s: float = 0.5) -> Iterator[str]:

@@ -155,6 +155,15 @@ class MultiAgentPPO(Algorithm):
             env = env(**(config.env_config or {}))
         self.env = env
         policies = config.policies or ["default_policy"]
+        # fail fast on an inconsistent mapping: every agent must map into
+        # the declared policy set (a bad fn would otherwise surface as a
+        # KeyError deep inside sampling)
+        for aid in env.possible_agents:
+            mapped = config.policy_mapping_fn(aid)
+            if mapped not in policies:
+                raise ValueError(
+                    f"policy_mapping_fn({aid!r}) -> {mapped!r}, which is "
+                    f"not in policies {policies}")
         self.learners: Dict[str, PPOLearner] = {}
         modules, params = {}, {}
         for pid in policies:

@@ -1,0 +1,90 @@
+"""gRPC ingress proxy (reference: ray python/ray/serve/_private/proxy.py:540
+gRPCProxy — gRPC requests route to deployment replicas like HTTP ones).
+
+Generic byte-level service: an RPC to `/<app_name>/<Method>` routes to that
+serve application's ingress deployment, invoking `Method` (unary-unary,
+request bytes in, bytes out — non-bytes returns are JSON-encoded). User
+deployments deal in their own proto bytes, so no schema compilation is
+needed cluster-side; typed stubs on the client call through
+`grpc.UnaryUnaryMultiCallable` with the same paths.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from typing import Any, Dict, Optional
+
+import ray_tpu
+
+logger = logging.getLogger(__name__)
+
+
+class GrpcProxyActor:
+    def __init__(self, host: str = "127.0.0.1", port: int = 9000):
+        import grpc
+
+        self._routes: Dict[str, Any] = {}  # app name -> handle
+        proxy = self
+
+        class Handler(grpc.GenericRpcHandler):
+            def service(self, handler_call_details):
+                # full method: "/<app>/<Method>"
+                parts = handler_call_details.method.strip("/").split("/")
+                if len(parts) != 2:
+                    return None
+                app, method = parts
+                handle = proxy._routes.get(app)
+                if handle is None:
+                    proxy.update_routes()
+                    handle = proxy._routes.get(app)
+                if handle is None:
+                    return None
+
+                def unary(request: bytes, context):
+                    try:
+                        resp = handle.options(
+                            method_name=method).remote(request).result(
+                                timeout_s=60)
+                    except Exception as e:  # noqa: BLE001 — surface as error
+                        logger.exception("grpc request failed")
+                        context.abort(grpc.StatusCode.INTERNAL, str(e))
+                        return b""
+                    if isinstance(resp, bytes):
+                        return resp
+                    if isinstance(resp, str):
+                        return resp.encode()
+                    return json.dumps(resp, default=str).encode()
+
+                return grpc.unary_unary_rpc_method_handler(
+                    unary,
+                    request_deserializer=None,  # raw bytes through
+                    response_serializer=None)
+
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._server = grpc.server(
+            ThreadPoolExecutor(max_workers=16), handlers=(Handler(),))
+        self._port = self._server.add_insecure_port(f"{host}:{port}")
+        self._server.start()
+        self.update_routes()
+
+    def ready(self) -> int:
+        return self._port
+
+    def update_routes(self) -> None:
+        from ray_tpu.serve.context import get_controller
+        from ray_tpu.serve.handle import DeploymentHandle
+
+        try:
+            controller = get_controller()
+        except RuntimeError:
+            return
+        apps = ray_tpu.get(controller.list_applications.remote())
+        self._routes = {
+            app_name: DeploymentHandle(info["ingress"], app_name)
+            for app_name, info in apps.items()}
+
+    def stop(self) -> None:
+        self._server.stop(grace=1.0)

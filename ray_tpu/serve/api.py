@@ -152,6 +152,25 @@ def start(detached: bool = True, http_options: Optional[dict] = None,
 
 
 _proxy = None
+_grpc_proxy = None
+
+
+def _ensure_grpc_proxy(grpc_options: Optional[dict] = None):
+    """Per-cluster gRPC ingress (reference: proxy.py:540 gRPCProxy)."""
+    global _grpc_proxy
+    import ray_tpu
+    from ray_tpu.serve._private.grpc_proxy import GrpcProxyActor
+
+    if _grpc_proxy is None:
+        opts = grpc_options or {}
+        actor = ray_tpu.remote(GrpcProxyActor).options(
+            name="SERVE_GRPC_PROXY", lifetime="detached", num_cpus=0.1,
+            get_if_exists=True, max_concurrency=64,
+        ).remote(host=opts.get("host", "127.0.0.1"),
+                 port=opts.get("port", 9000))
+        port = ray_tpu.get(actor.ready.remote())
+        _grpc_proxy = (actor, port)
+    return _grpc_proxy
 
 
 def _ensure_proxy(http_options: Optional[dict] = None):
@@ -171,8 +190,8 @@ def _ensure_proxy(http_options: Optional[dict] = None):
 
 
 def run(app: Application, *, name: str = "default", route_prefix: str = "/",
-        _blocking: bool = False, http_port: Optional[int] = None
-        ) -> DeploymentHandle:
+        _blocking: bool = False, http_port: Optional[int] = None,
+        grpc_port: Optional[int] = None) -> DeploymentHandle:
     controller = serve_context.get_controller(create=True)
     import ray_tpu
 
@@ -218,6 +237,9 @@ def run(app: Application, *, name: str = "default", route_prefix: str = "/",
     if http_port is not None:
         proxy = _ensure_proxy({"port": http_port})
         ray_tpu.get(proxy.update_routes.remote())
+    if grpc_port is not None:
+        actor, _port = _ensure_grpc_proxy({"port": grpc_port})
+        ray_tpu.get(actor.update_routes.remote())
     return DeploymentHandle(app.root.deployment.name, name)
 
 
@@ -260,7 +282,7 @@ def status() -> Dict[str, Any]:
 
 
 def shutdown() -> None:
-    global _proxy
+    global _proxy, _grpc_proxy
     import ray_tpu
 
     try:
@@ -278,4 +300,12 @@ def shutdown() -> None:
         except Exception:  # noqa: BLE001
             pass
         _proxy = None
+    if _grpc_proxy is not None:
+        actor, _port = _grpc_proxy
+        try:
+            ray_tpu.get(actor.stop.remote(), timeout=5)
+            ray_tpu.kill(actor)
+        except Exception:  # noqa: BLE001
+            pass
+        _grpc_proxy = None
     serve_context.clear_controller_cache()
