@@ -31,14 +31,46 @@ DEFAULT_MAX_IN_FLIGHT = 16
 
 # -- per-block stage application (runs inside a task) ------------------------
 
+# Callable-class transforms (reference: actor_pool_map_operator.py): one
+# instance per worker process per stage, cached by the stage's plan-time id.
+_CALLABLE_CACHE: dict = {}
+
+
+def _resolve_fn(op: Operator) -> Callable:
+    if not op.options.get("is_class"):
+        return op.fn
+    key = op.options["instance_key"]
+    inst = _CALLABLE_CACHE.get(key)
+    if inst is None:
+        while len(_CALLABLE_CACHE) >= 8:  # bound worker memory
+            _CALLABLE_CACHE.pop(next(iter(_CALLABLE_CACHE)))
+        inst = op.fn(*(op.options.get("ctor_args") or ()),
+                     **(op.options.get("ctor_kwargs") or {}))
+        _CALLABLE_CACHE[key] = inst
+    call_args = op.options.get("call_args") or ()
+    call_kwargs = op.options.get("call_kwargs") or {}
+    if call_args or call_kwargs:
+        import functools
+
+        return functools.partial(
+            _call_with_trailing_args, inst, call_args, call_kwargs)
+    return inst
+
+
+def _call_with_trailing_args(inst, call_args, call_kwargs, batch):
+    # reference semantics: fn(batch, *fn_args, **fn_kwargs)
+    return inst(batch, *call_args, **call_kwargs)
+
+
 def _apply_map_ops(block: Block, ops: List[Operator]) -> Block:
     for op in ops:
         acc = BlockAccessor.for_block(block)
+        fn = _resolve_fn(op)
         if op.kind == "map_batches":
             fmt = op.options.get("batch_format", "numpy")
             bsz = op.options.get("batch_size")
             if bsz is None or acc.num_rows() <= bsz:
-                out = op.fn(acc.to_batch(fmt))
+                out = fn(acc.to_batch(fmt))
                 block = BlockAccessor.batch_to_block(out)
             else:
                 pieces = []
@@ -46,19 +78,19 @@ def _apply_map_ops(block: Block, ops: List[Operator]) -> Block:
                     piece = BlockAccessor.for_block(
                         acc.slice(s, min(s + bsz, acc.num_rows())))
                     pieces.append(BlockAccessor.batch_to_block(
-                        op.fn(piece.to_batch(fmt))))
+                        fn(piece.to_batch(fmt))))
                 block = BlockAccessor.concat(pieces)
         elif op.kind == "map_rows":
             block = BlockAccessor.rows_to_block(
-                [op.fn(r) for r in acc.iter_rows()])
+                [fn(r) for r in acc.iter_rows()])
         elif op.kind == "flat_map":
             out_rows: List[dict] = []
             for r in acc.iter_rows():
-                out_rows.extend(op.fn(r))
+                out_rows.extend(fn(r))
             block = BlockAccessor.rows_to_block(out_rows)
         elif op.kind == "filter":
             block = BlockAccessor.rows_to_block(
-                [r for r in acc.iter_rows() if op.fn(r)])
+                [r for r in acc.iter_rows() if fn(r)])
         elif op.kind == "write":
             op.fn(block, **op.options)
             block = BlockAccessor.rows_to_block(

@@ -36,14 +36,30 @@ class Dataset:
 
     def map_batches(self, fn: Callable, *, batch_size: Optional[int] = None,
                     batch_format: str = "numpy", fn_args=None, fn_kwargs=None,
+                    fn_constructor_args=None, fn_constructor_kwargs=None,
                     **_kw) -> "Dataset":
-        if fn_args or fn_kwargs:
+        options: Dict[str, Any] = {"batch_size": batch_size,
+                                   "batch_format": batch_format}
+        if isinstance(fn, type):
+            # callable class (reference: actor-pool map — one instance per
+            # worker process per stage, constructed lazily in the worker);
+            # fn_args/fn_kwargs go to __call__, ctor args to __init__
+            import uuid as _uuid
+
+            options.update({
+                "is_class": True,
+                "instance_key": _uuid.uuid4().hex,
+                "ctor_args": tuple(fn_constructor_args or ()),
+                "ctor_kwargs": dict(fn_constructor_kwargs or {}),
+                "call_args": tuple(fn_args or ()),
+                "call_kwargs": dict(fn_kwargs or {}),
+            })
+        elif fn_args or fn_kwargs:
             import functools
 
             fn = functools.partial(fn, *(fn_args or ()), **(fn_kwargs or {}))
         return Dataset(self._plan.with_operator(Operator(
-            "map_batches", fn,
-            {"batch_size": batch_size, "batch_format": batch_format})))
+            "map_batches", fn, options)))
 
     def flat_map(self, fn: Callable[[dict], List[dict]], **_kw) -> "Dataset":
         return Dataset(self._plan.with_operator(Operator("flat_map", fn)))

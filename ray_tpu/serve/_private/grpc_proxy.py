@@ -28,6 +28,11 @@ class GrpcProxyActor:
         self._routes: Dict[str, Any] = {}  # app name -> handle
         proxy = self
 
+        # The method segment comes off the wire: never dispatch to private
+        # attributes or replica lifecycle hooks (the HTTP proxy only ever
+        # calls __call__; gRPC adds named methods, so it needs the guard).
+        _blocked = {"check_health", "reconfigure", "shutdown"}
+
         class Handler(grpc.GenericRpcHandler):
             def service(self, handler_call_details):
                 # full method: "/<app>/<Method>"
@@ -35,6 +40,8 @@ class GrpcProxyActor:
                 if len(parts) != 2:
                     return None
                 app, method = parts
+                if method.startswith("_") or method in _blocked:
+                    return None
                 handle = proxy._routes.get(app)
                 if handle is None:
                     proxy.update_routes()

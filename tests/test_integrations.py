@@ -49,6 +49,11 @@ def test_grpc_ingress(ray_start_regular, serve_shutdown):
     with pytest.raises(grpc.RpcError) as e:
         bogus(b"x", timeout=10)
     assert e.value.code() == grpc.StatusCode.UNIMPLEMENTED
+    # lifecycle hooks and private attrs are not callable over the wire
+    for blocked in ("/echo_grpc/shutdown", "/echo_grpc/_private"):
+        with pytest.raises(grpc.RpcError) as eb:
+            channel.unary_unary(blocked)(b"x", timeout=10)
+        assert eb.value.code() == grpc.StatusCode.UNIMPLEMENTED
     channel.close()
 
 
