@@ -28,6 +28,7 @@ from ray_tpu.api import (  # noqa: F401
     wait,
 )
 from ray_tpu.remote_function import RemoteFunction  # noqa: F401
+from ray_tpu.actor import exit_actor  # noqa: F401
 from ray_tpu.runtime_context import get_runtime_context  # noqa: F401
 
 __version__ = "0.1.0"
@@ -44,6 +45,7 @@ __all__ = [
     "exceptions",
     "get",
     "get_actor",
+    "exit_actor",
     "get_runtime_context",
     "init",
     "is_initialized",

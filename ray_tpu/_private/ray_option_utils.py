@@ -14,7 +14,8 @@ _UNSET = object()  # sentinel: "use the per-kind default CPU"
 
 _COMMON_OPTIONS = {
     "num_cpus", "num_gpus", "num_tpus", "resources", "max_retries",
-    "retry_exceptions", "num_returns", "scheduling_strategy", "name",
+    "retry_exceptions", "max_calls", "num_returns",
+    "scheduling_strategy", "name",
     "namespace", "lifetime", "max_restarts", "max_task_retries",
     "max_concurrency", "get_if_exists", "runtime_env", "memory",
     "placement_group", "placement_group_bundle_index",
@@ -22,7 +23,7 @@ _COMMON_OPTIONS = {
     "_metadata",
 }
 
-TASK_ONLY = {"max_retries", "retry_exceptions"}
+TASK_ONLY = {"max_retries", "retry_exceptions", "max_calls"}
 ACTOR_ONLY = {
     "max_restarts", "max_task_retries", "max_concurrency", "lifetime",
     "get_if_exists", "max_pending_calls", "concurrency_groups",
@@ -40,6 +41,10 @@ def validate_options(options: Dict[str, Any], *, is_actor: bool) -> Dict[str, An
     nr = options.get("num_returns")
     if nr is not None and nr != "streaming" and (not isinstance(nr, int) or nr < 0):
         raise ValueError("num_returns must be a non-negative int or 'streaming'")
+    mc = options.get("max_calls")
+    if mc is not None and (not isinstance(mc, int) or isinstance(mc, bool)
+                           or mc < 0):
+        raise ValueError("max_calls must be a non-negative int (0 = unlimited)")
     for key in ("num_cpus", "num_gpus", "num_tpus", "memory"):
         v = options.get(key)
         if v is not None and (not isinstance(v, (int, float)) or v < 0):

@@ -120,6 +120,9 @@ class TaskSpec:
     owner_address: Optional[Address] = None
     max_retries: int = 0
     retry_exceptions: bool = False
+    # worker recycling: the executing worker exits after running this many
+    # tasks of the function (0 = unlimited; reference: @ray.remote(max_calls=))
+    max_calls: int = 0
     scheduling_strategy: SchedulingStrategySpec = field(
         default_factory=SchedulingStrategySpec
     )

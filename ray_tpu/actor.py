@@ -138,3 +138,19 @@ class ActorClass:
     @property
     def _underlying(self):
         return self._cls
+
+
+def exit_actor() -> None:
+    """Intentionally exit the current actor process (reference: ray
+    python/ray/actor.py exit_actor). Call from inside an actor method; the
+    in-flight call completes (callers see a normal return of None for the
+    terminating call pattern used by __ray_terminate__) and the process
+    exits without being treated as a failure, so max_restarts is NOT
+    consumed by an intentional exit."""
+    from ray_tpu._raylet import get_core_worker
+    from ray_tpu.exceptions import AsyncioActorExit
+
+    cw = get_core_worker()
+    if not getattr(cw, "is_actor_worker", False):
+        raise RuntimeError("exit_actor() called outside an actor")
+    raise AsyncioActorExit("exit_actor() called")

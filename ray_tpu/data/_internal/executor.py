@@ -33,7 +33,12 @@ DEFAULT_MAX_IN_FLIGHT = 16
 
 # Callable-class transforms (reference: actor_pool_map_operator.py): one
 # instance per worker process per stage, cached by the stage's plan-time id.
-_CALLABLE_CACHE: dict = {}
+# LRU (move-to-end on hit) so many concurrent stages don't thrash — FIFO
+# would reconstruct per block once the live set exceeds the cap.
+from collections import OrderedDict
+
+_CALLABLE_CACHE: "OrderedDict" = OrderedDict()
+_CALLABLE_CACHE_CAP = 32
 
 
 def _resolve_fn(op: Operator) -> Callable:
@@ -41,9 +46,11 @@ def _resolve_fn(op: Operator) -> Callable:
         return op.fn
     key = op.options["instance_key"]
     inst = _CALLABLE_CACHE.get(key)
-    if inst is None:
-        while len(_CALLABLE_CACHE) >= 8:  # bound worker memory
-            _CALLABLE_CACHE.pop(next(iter(_CALLABLE_CACHE)))
+    if inst is not None:
+        _CALLABLE_CACHE.move_to_end(key)
+    else:
+        while len(_CALLABLE_CACHE) >= _CALLABLE_CACHE_CAP:
+            _CALLABLE_CACHE.popitem(last=False)
         inst = op.fn(*(op.options.get("ctor_args") or ()),
                      **(op.options.get("ctor_kwargs") or {}))
         _CALLABLE_CACHE[key] = inst

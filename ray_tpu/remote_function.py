@@ -64,6 +64,7 @@ class RemoteFunction:
             resources=opts.resources_from_options(o, is_actor=False),
             max_retries=o.get("max_retries", 3),
             retry_exceptions=bool(o.get("retry_exceptions", False)),
+            max_calls=int(o.get("max_calls", 0)),
             scheduling_strategy=strategy,
             name=o.get("name") or self._function.__name__,
             function_id=self._function_id,

@@ -664,6 +664,7 @@ class CoreWorker:
         function_id: Optional[str] = None,
         runtime_env: Optional[dict] = None,
         runtime_env_prepared: bool = False,
+        max_calls: int = 0,
     ):
         fid = function_id or self.register_function(fn)
         if not runtime_env_prepared:
@@ -683,6 +684,7 @@ class CoreWorker:
             owner_address=self.address,
             max_retries=max_retries,
             retry_exceptions=retry_exceptions,
+            max_calls=max_calls,
             scheduling_strategy=scheduling_strategy or SchedulingStrategySpec(),
             runtime_env=runtime_env,
         )
@@ -871,6 +873,14 @@ class CoreWorker:
             await self._pump(key)
             return
         self._on_task_reply(spec, reply)
+        if reply.get("worker_retiring"):
+            # max_calls recycling: the worker exits right after this reply —
+            # never reuse the lease, and don't hand it back as "idle"
+            st.leases.pop(lease.address.rpc_address, None)
+            self._peers.invalidate(lease.address.rpc_address)
+            if st.pending:
+                await self._pump(key)
+            return
         lease.busy = False
         lease.idle_since = time.monotonic()
         if st.pending:

@@ -18,7 +18,9 @@ from __future__ import annotations
 import asyncio
 import ctypes
 import logging
+import os
 import threading
+import time
 import traceback
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Tuple
@@ -83,6 +85,8 @@ class Executor:
         self._running_threads: Dict[TaskID, int] = {}  # task -> thread ident
         self._cancelled: set = set()
         self._env_context = None  # applied RuntimeEnvContext (sticky)
+        self._calls_by_function: Dict[str, int] = {}  # max_calls counting
+        self._retiring = False  # set when max_calls is reached
 
     def _apply_runtime_env(self, env: dict) -> None:
         from ray_tpu import runtime_env as re_mod
@@ -111,7 +115,12 @@ class Executor:
             return await loop.run_in_executor(self._pool, self._run_actor_task, spec)
         if spec.task_type == TaskType.ACTOR_CREATION_TASK:
             return await loop.run_in_executor(self._pool, self._run_actor_creation, spec)
-        return await loop.run_in_executor(self._pool, self._run_normal_task, spec)
+        reply = await loop.run_in_executor(
+            self._pool, self._run_normal_task, spec)
+        if self._retiring:
+            # tell the owner to drop this lease (max_calls recycling)
+            reply["worker_retiring"] = True
+        return reply
 
     def cancel(self, task_id: TaskID, force: bool) -> bool:
         self._cancelled.add(task_id)
@@ -231,6 +240,30 @@ class Executor:
         finally:
             self._running_threads.pop(spec.task_id, None)
             self.cw.exit_task_context(token)
+            self._maybe_recycle_worker(spec)
+
+    def _maybe_recycle_worker(self, spec: TaskSpec) -> None:
+        """max_calls worker recycling (reference: @ray.remote(max_calls=) —
+        the worker exits after N executions of the function, e.g. to release
+        leaked memory/accelerator state; the raylet spawns a fresh one)."""
+        limit = getattr(spec, "max_calls", 0)
+        if not limit:
+            return
+        n = self._calls_by_function.get(spec.function_id, 0) + 1
+        self._calls_by_function[spec.function_id] = n
+        if n >= limit and not self._retiring:
+            logger.info("worker reached max_calls=%d for %s; exiting",
+                        limit, spec.function_name)
+            self._retiring = True  # reply carries worker_retiring (execute)
+            # Delayed exit so the in-flight task reply flushes first (the
+            # reply is small — large returns go to the shm store, see
+            # _package_value — so 1s is orders of magnitude above local
+            # socket flush time). The owner drops the lease on seeing the
+            # flag, so no new task races the exit.
+            threading.Thread(
+                target=lambda: (time.sleep(1.0), os._exit(0)),
+                daemon=True,
+            ).start()
 
     def _run_generator(self, spec: TaskSpec, fn, args, kwargs) -> dict:
         """Streaming generator: report each item to the owner as produced."""
@@ -319,7 +352,10 @@ class Executor:
                 self.cw.exit_task_context(token)
         except (AsyncioActorExit, SystemExit):
             self.cw.exit_actor_process(intended=True)
-            return {"status": "ok", "returns": []}
+            # resolve the terminating call's ref with None — empty returns
+            # would leave the caller's get() hanging forever
+            return {"status": "ok",
+                    "returns": self._package_returns(spec, None)}
         except TaskCancelledError:
             return {"status": "cancelled", "return_ids": spec.return_ids()}
         except BaseException as e:  # noqa: BLE001

@@ -157,6 +157,63 @@ def test_dist_array_constructors(ray_start_regular):
     np.testing.assert_allclose(e.assemble(), np.eye(6))
 
 
+def test_max_calls_recycles_worker(ray_start_regular):
+    """@remote(max_calls=N): the worker exits after N executions and a
+    fresh worker takes over — pids change across the boundary."""
+    import os as _os
+
+    @ray_tpu.remote(max_calls=2)
+    def whoami():
+        return _os.getpid()
+
+    pids = [ray_tpu.get(whoami.remote()) for _ in range(6)]
+    assert len(set(pids)) >= 3  # a new worker at least every 2 calls
+    # consecutive pairs share a worker; boundaries switch
+    assert pids[0] == pids[1] or pids[1] == pids[2]
+
+
+def test_exit_actor(ray_start_regular):
+    @ray_tpu.remote(max_restarts=3)
+    class Quitter:
+        def ping(self):
+            return "pong"
+
+        def leave(self):
+            ray_tpu.exit_actor()
+
+    a = Quitter.remote()
+    assert ray_tpu.get(a.ping.remote()) == "pong"
+    a.leave.remote()
+    time.sleep(1.0)  # the worker exits ~0.1s after the reply flushes
+    # intentional exit: the actor must NOT restart (max_restarts untouched)
+    with pytest.raises(Exception):
+        ray_tpu.get(a.ping.remote(), timeout=20)
+
+
+def test_exit_actor_outside_actor_raises(ray_start_regular):
+    with pytest.raises(RuntimeError, match="outside an actor"):
+        ray_tpu.exit_actor()
+
+
+def test_exit_actor_terminating_call_resolves(ray_start_regular):
+    """get() on the terminating call's ref must return None, not hang."""
+
+    @ray_tpu.remote
+    class Q:
+        def leave(self):
+            ray_tpu.exit_actor()
+
+    a = Q.remote()
+    assert ray_tpu.get(a.leave.remote(), timeout=30) is None
+
+
+def test_max_calls_validation():
+    with pytest.raises(ValueError, match="max_calls"):
+        ray_tpu.remote(max_calls=-1)(lambda: 1)
+    with pytest.raises(ValueError, match="max_calls"):
+        ray_tpu.remote(max_calls="3")(lambda: 1)
+
+
 def test_stack_cli_dumps_worker_stacks(ray_start_regular, capsys):
     from ray_tpu.scripts.scripts import cmd_stack
 
