@@ -148,9 +148,11 @@ def exit_actor() -> None:
     exits without being treated as a failure, so max_restarts is NOT
     consumed by an intentional exit."""
     from ray_tpu._raylet import get_core_worker
-    from ray_tpu.exceptions import AsyncioActorExit
 
     cw = get_core_worker()
     if not getattr(cw, "is_actor_worker", False):
         raise RuntimeError("exit_actor() called outside an actor")
-    raise AsyncioActorExit("exit_actor() called")
+    # SystemExit (a BaseException), NOT an Exception subclass: user code's
+    # broad `except Exception` must not be able to swallow the exit
+    # (reference raises SystemExit for sync actors for the same reason).
+    raise SystemExit(0)

@@ -31,6 +31,24 @@ from ray_tpu.tune.logger import (  # noqa: F401
 from ray_tpu.tune.tuner import ResultGrid, TuneConfig, Tuner  # noqa: F401
 
 
+def with_resources(trainable, resources):
+    """Attach per-trial resource requirements to a trainable (reference:
+    tune/trainable/util.py with_resources). `resources` is a dict like
+    {"CPU": 2, "TPU": 1}; the controller launches each trial's actor with
+    them. Always returns a wrapper — the input is never mutated, so the
+    same function can be annotated differently for different Tuners."""
+    if callable(resources):
+        raise TypeError("callable resources are not supported; pass a dict")
+    import functools
+
+    @functools.wraps(trainable)
+    def wrapped(*a, **kw):
+        return trainable(*a, **kw)
+
+    wrapped._tune_resources = dict(resources)
+    return wrapped
+
+
 def with_parameters(fn, **kwargs):
     """Bind large constant objects to a trainable (reference:
     tune/trainable/util.py with_parameters — objects go through the object
@@ -91,4 +109,5 @@ __all__ = [
     "run",
     "uniform",
     "with_parameters",
+    "with_resources",
 ]

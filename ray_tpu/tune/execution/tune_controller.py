@@ -56,6 +56,7 @@ class TuneController:
         stop: Optional[Dict[str, Any]] = None,
         trial_executor_cls=None,
         callbacks: Optional[List[Any]] = None,
+        time_budget_s: Optional[float] = None,
     ):
         self._trainable = trainable
         self._searcher = searcher or BasicVariantGenerator(
@@ -75,7 +76,9 @@ class TuneController:
         self._metric = metric
         self._mode = mode
         self._max_concurrent = max_concurrent_trials or 8
-        self._resources = resources_per_trial or {"CPU": 1.0}
+        # tune.with_resources annotation wins over the plain default
+        annotated = getattr(trainable, "_tune_resources", None)
+        self._resources = resources_per_trial or annotated or {"CPU": 1.0}
         self._experiment_name = experiment_name or (
             getattr(trainable, "__name__", "exp") + time.strftime("_%H%M%S"))
         self._storage_root = os.path.abspath(os.path.expanduser(storage_path))
@@ -88,6 +91,8 @@ class TuneController:
         self._num_suggested = 0
         self._callbacks = callbacks or []
         self._iteration = 0
+        self._time_budget_s = time_budget_s
+        self._start_time = time.monotonic()
 
     def _invoke_callbacks(self, hook: str, *args, **kwargs) -> None:
         for cb in self._callbacks:
@@ -274,6 +279,19 @@ class TuneController:
     def step(self) -> bool:
         """One event-loop turn. Returns False when everything is done."""
         self._iteration += 1
+        if (self._time_budget_s is not None
+                and time.monotonic() - self._start_time
+                > self._time_budget_s):
+            # budget exhausted: stop creating AND terminate live trials
+            # (reference: TuneConfig.time_budget_s)
+            self._search_done = True
+            for t in self.trials:
+                if t.status in (PENDING, RUNNING):
+                    self._pending_result = {
+                        r: tr for r, tr in self._pending_result.items()
+                        if tr is not t}
+                    self._stop_trial(t, TERMINATED)
+            return False
         self._maybe_create_trials()
         for trial in self.trials:
             if trial.status == PENDING and (

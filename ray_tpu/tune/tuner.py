@@ -101,6 +101,7 @@ class Tuner:
             experiment_name=self._run_config.name,
             stop=self._run_config.stop,
             callbacks=self._run_config.callbacks,
+            time_budget_s=tc.time_budget_s,
         )
         if self._restored_trials:
             controller.restore_trials(self._restored_trials)

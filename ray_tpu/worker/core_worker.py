@@ -1586,8 +1586,10 @@ class CoreWorker:
         )
 
     def exit_actor_process(self, intended: bool = True):
+        # 1s margin so the terminating call's reply flushes before the hard
+        # exit even on a loaded worker (matches max_calls retirement).
         threading.Thread(
-            target=lambda: (time.sleep(0.1), os._exit(0 if intended else 1)),
+            target=lambda: (time.sleep(1.0), os._exit(0 if intended else 1)),
             daemon=True,
         ).start()
 

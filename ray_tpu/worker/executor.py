@@ -87,6 +87,7 @@ class Executor:
         self._env_context = None  # applied RuntimeEnvContext (sticky)
         self._calls_by_function: Dict[str, int] = {}  # max_calls counting
         self._retiring = False  # set when max_calls is reached
+        self._will_retire_after_task = False  # set pre-execution
 
     def _apply_runtime_env(self, env: dict) -> None:
         from ray_tpu import runtime_env as re_mod
@@ -197,6 +198,12 @@ class Executor:
             self.cw.memory_store.put_serialized(
                 oid, None, value=value, in_plasma=True,
                 plasma_node=plasma_node)
+        elif self._will_retire_after_task:
+            # max_calls: this worker exits right after the reply — a
+            # memory-store primary copy would die with it, so ship the
+            # value inline (the shm store, when available above, survives
+            # the worker: it lives in the raylet).
+            return {"inline": s}
         else:
             self.cw.memory_store.put_serialized(oid, s, value=value)
         self.cw.hold_secondary_copy(oid)
@@ -226,6 +233,12 @@ class Executor:
             }
         token = self.cw.enter_task_context(spec)
         self._running_threads[spec.task_id] = threading.get_ident()
+        limit = getattr(spec, "max_calls", 0)
+        if limit:
+            # known before execution: packaging uses it to avoid leaving a
+            # primary copy in the about-to-exit worker's memory store
+            n = self._calls_by_function.get(spec.function_id, 0) + 1
+            self._will_retire_after_task = n >= limit
         try:
             fn = self._load_function(spec.function_id)
             args, kwargs = self._resolve_args(spec.args, getattr(spec, "kwarg_specs", {}) or {})
@@ -352,10 +365,15 @@ class Executor:
                 self.cw.exit_task_context(token)
         except (AsyncioActorExit, SystemExit):
             self.cw.exit_actor_process(intended=True)
-            # resolve the terminating call's ref with None — empty returns
-            # would leave the caller's get() hanging forever
+            # resolve the terminating call's ref(s) with None — empty
+            # returns would leave the caller's get() hanging forever
+            if spec.is_streaming_generator():
+                return {"status": "ok", "returns": [],
+                        "streaming_num_items": 0}
+            n = max(spec.num_returns, 1)
+            value = None if spec.num_returns <= 1 else tuple([None] * n)
             return {"status": "ok",
-                    "returns": self._package_returns(spec, None)}
+                    "returns": self._package_returns(spec, value)}
         except TaskCancelledError:
             return {"status": "cancelled", "return_ids": spec.return_ids()}
         except BaseException as e:  # noqa: BLE001

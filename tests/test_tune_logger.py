@@ -137,6 +137,43 @@ def test_bad_trainable_errors_not_hangs(ray_start_regular, tmp_path):
         del sys.modules["_not_on_workers"]
 
 
+def test_time_budget_stops_experiment(ray_start_regular, tmp_path):
+    def slow(config):
+        import time as _t
+
+        for i in range(1000):
+            tune.report({"score": i})
+            _t.sleep(0.25)
+
+    import time as _t
+
+    t0 = _t.monotonic()
+    tuner = Tuner(
+        slow, param_space={"x": 1},
+        tune_config=TuneConfig(metric="score", mode="max", num_samples=4,
+                               time_budget_s=6.0),
+        run_config=RunConfig(name="budget", storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    elapsed = _t.monotonic() - t0
+    assert elapsed < 40.0, f"budget not enforced ({elapsed:.0f}s)"
+    assert len(grid) >= 1
+
+
+def test_with_resources_annotation(ray_start_regular, tmp_path):
+    def trainable(config):
+        tune.report({"score": 1})
+
+    annotated = tune.with_resources(trainable, {"CPU": 2})
+    tuner = Tuner(
+        annotated, param_space={"x": 1},
+        tune_config=TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(name="res", storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    assert grid.get_best_result().metrics["score"] == 1
+
+
 def test_callback_errors_do_not_kill_run(ray_start_regular, tmp_path):
     class Broken(Callback):
         def on_trial_result(self, *a, **k):
