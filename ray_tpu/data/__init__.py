@@ -24,11 +24,14 @@ from ray_tpu.data.read_api import (  # noqa: F401
     from_pandas,
     from_torch,
     range,
+    read_bigquery,
     read_binary_files,
     read_csv,
+    read_databricks_tables,
     read_datasource,
     read_images,
     read_json,
+    read_mongo,
     read_numpy,
     read_parquet,
     read_sql,
@@ -55,11 +58,14 @@ __all__ = [
     "from_pandas",
     "from_torch",
     "range",
+    "read_bigquery",
     "read_binary_files",
     "read_csv",
+    "read_databricks_tables",
     "read_datasource",
     "read_images",
     "read_json",
+    "read_mongo",
     "read_numpy",
     "read_parquet",
     "read_sql",

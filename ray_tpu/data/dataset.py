@@ -484,6 +484,11 @@ class Dataset:
 
         self._write(path, w, ".tar")
 
+    def write_datasource(self, datasource, **kwargs) -> None:
+        """Custom sink: an object with write(block_iterator, **kwargs)
+        (reference: Dataset.write_datasource / Datasource.write)."""
+        datasource.write(self.iter_blocks(), **kwargs)
+
     def write_sql(self, sql: str, connection_factory: Callable, **_kw) -> None:
         """Run a parameterized INSERT per row over a DBAPI connection
         (reference: dataset.py write_sql — e.g. "INSERT INTO t VALUES (?, ?)")."""

@@ -422,3 +422,28 @@ def read_datasource(datasource, *, parallelism: int = -1, **kwargs) -> Dataset:
     tasks = datasource.get_read_tasks(
         parallelism if parallelism > 0 else 8, **kwargs)
     return _plan_from_tasks(list(tasks))
+
+
+def _gated_reader(name: str, dep: str):
+    def reader(*_a, **_kw):
+        try:
+            __import__(dep)
+        except ImportError as e:
+            raise ImportError(
+                f"{name} requires the {dep!r} package, which is not "
+                f"installed") from e
+        raise NotImplementedError(
+            f"{name}: the {dep!r} client is installed but this connector "
+            "is not yet wired; use read_sql/read_datasource with a custom "
+            "read task")
+
+    reader.__name__ = name
+    reader.__doc__ = (f"{name} (reference: ray data/read_api.py) — gated on "
+                      f"the {dep!r} package like the reference.")
+    return reader
+
+
+read_bigquery = _gated_reader("read_bigquery", "google.cloud.bigquery")
+read_mongo = _gated_reader("read_mongo", "pymongo")
+read_databricks_tables = _gated_reader(
+    "read_databricks_tables", "databricks.sql")

@@ -204,6 +204,28 @@ def test_map_batches_class_call_args(ray_start_regular):
         [i * 3 + 1.0 for i in range(8)]
 
 
+def test_write_datasource_and_gated_readers(ray_start_regular):
+    class CollectSink:
+        def __init__(self):
+            self.rows = 0
+
+        def write(self, blocks, **kwargs):
+            from ray_tpu.data.block import BlockAccessor
+
+            for b in blocks:
+                self.rows += BlockAccessor.for_block(b).num_rows()
+
+    sink = CollectSink()
+    data.range(25, override_num_blocks=3).write_datasource(sink)
+    assert sink.rows == 25
+
+    # connector stubs are gated on their client packages, like the reference
+    with pytest.raises((ImportError, NotImplementedError)):
+        data.read_bigquery("project.dataset.table")
+    with pytest.raises((ImportError, NotImplementedError)):
+        data.read_mongo(uri="mongodb://x")
+
+
 def test_webdataset_dotted_dirs_group_by_basename(ray_start_regular,
                                                   tmp_path):
     """Dots in directory components must not affect sample grouping."""
