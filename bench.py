@@ -2,8 +2,9 @@
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
-Metric: training tokens/sec/chip on a ~350M-param Llama-family model
-(bf16, flash-attention Pallas kernels, remat, donated buffers) at seq 2048.
+Metric: training tokens/sec/chip on an 8B-width-proxy Llama-family model
+(true Llama-3-8B layer shapes at reduced depth, ~1.35B params; bf16,
+flash-attention Pallas kernels, remat, donated buffers) at seq 2048.
 The reference publishes no absolute model-training numbers
 (BASELINE.md: `published: {}`), so vs_baseline is MFU relative to the
 A100-class 40% MFU bar named in BASELINE.json's north-star
@@ -33,14 +34,22 @@ def main():
     on_tpu = platform == "tpu"
 
     if on_tpu:
+        # 8B-width proxy (VERDICT r1 #1): true Llama-3-8B layer shapes
+        # (d_model=4096, d_ff=14336, 32 heads / 8 kv heads x 128) at reduced
+        # depth so params+AdamW state fit one 16 GB v5e chip. Per-layer
+        # arithmetic intensity — the thing MFU depends on — matches the 8B
+        # target; vocab reduced to 32k to keep the embedding from dominating
+        # the HBM budget at depth. Chunked CE avoids materializing [B,S,V]
+        # fp32 logits.
         cfg = llama.LlamaConfig(
-            vocab_size=32_000, d_model=2048, n_layers=8, n_heads=16,
-            n_kv_heads=8, d_head=128, d_ff=5632, max_seq_len=2048,
+            vocab_size=32_000, d_model=4096, n_layers=5, n_heads=32,
+            n_kv_heads=8, d_head=128, d_ff=14_336, max_seq_len=2048,
+            loss_chunk_size=1024,
         )
-        # Per-chip batch of 8: global batch scales with the dp width so the
+        # Per-chip batch of 4: global batch scales with the dp width so the
         # batch dim always divides the mesh (fixed global batch would fail
         # device_put on slices wider than 8 chips).
-        batch, seq, steps = 8 * n_devices, 2048, 20
+        batch, seq, steps = 4 * n_devices, 2048, 20
         peak_flops = 197e12  # v5e bf16 peak per chip
     else:  # CPU smoke fallback so the script always emits a line
         cfg = llama.LlamaConfig.tiny()

@@ -258,7 +258,14 @@ def forward_hidden(params, tokens, config: LlamaConfig, mesh=None,
     lc = partial(with_logical_constraint, mesh=mesh, rules=rules)
     b, s = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(s), (b, s))
-    x = params["embed"][tokens].astype(c.dtype)
+    # Constrain the table's embed dim to the ACTIVATION layout (replicated)
+    # before the lookup: a gather from an fsdp-sharded embed dim makes the
+    # output D-sharded, and XLA can only reach the (batch, seq, None)
+    # activation layout from there via involuntary full rematerialization
+    # (replicate-then-repartition). With embed replicated at the gather the
+    # reshard to the activation spec is a local slice.
+    table = lc(params["embed"], ("vocab", "act_embed"))
+    x = table[tokens].astype(c.dtype)
     x = lc(x, ("batch", "seq", "act_embed"))
 
     layer_fn = partial(_layer, positions=positions, config=c, mesh=mesh,
@@ -363,7 +370,10 @@ def forward_with_cache(params, tokens, cache, lengths, config: LlamaConfig):
     c = config
     b, s = tokens.shape
     positions = lengths[:, None] + jnp.arange(s)[None, :]
-    x = params["embed"][tokens].astype(c.dtype)
+    # Same embed-dim constraint as forward_hidden: under an ambient sharded
+    # mesh a gather from an fsdp-sharded table forces a full-remat reshard.
+    table = with_logical_constraint(params["embed"], ("vocab", "act_embed"))
+    x = table[tokens].astype(c.dtype)
 
     def scan_body(x, layer_in):
         layer_p, k_cache, v_cache = layer_in
