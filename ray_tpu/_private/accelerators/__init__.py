@@ -1,0 +1,12 @@
+"""Accelerator detection (reference: python/ray/_private/accelerators/).
+
+Only the TPU manager is implemented natively — this is a TPU-first framework;
+GPU/other accelerators pass through as plain custom resources.
+"""
+
+from ray_tpu._private.accelerators.tpu import (  # noqa: F401
+    TpuSliceInfo,
+    apply_tpu_detection,
+    detect_tpu,
+    tpu_head_resource_name,
+)

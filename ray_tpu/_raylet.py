@@ -147,4 +147,13 @@ class ObjectRefGenerator:
     def completed(self):
         return self
 
+    def close(self):
+        """Stop the producing task: cancel it so it stops generating items
+        nobody will consume (reference: ObjectRefGenerator cancellation via
+        ray.cancel on the generator task)."""
+        try:
+            get_core_worker().cancel_task_by_id(self._task_id, force=False)
+        except Exception:  # noqa: BLE001 — best-effort on teardown
+            pass
+
 DynamicObjectRefGenerator = ObjectRefGenerator

@@ -57,6 +57,12 @@ class Cluster:
             resources=node_resources or None,
             is_head=_is_head,
             labels=labels,
+            # e.g. accelerator_env={"TPU_ACCELERATOR_TYPE": "v5litepod-16",
+            # "TPU_NAME": "slice-0", "TPU_WORKER_ID": "1"} models a TPU-slice
+            # host in an in-process test cluster. Default {} (NOT os.environ):
+            # N fake nodes on one real TPU host must not each inherit the
+            # host's slice markers and advertise N full hosts' worth of chips.
+            **{"accelerator_env": {}, **kwargs},
         )
         raylet.start(0)
         self.raylets.append(raylet)

@@ -70,11 +70,15 @@ class BlockAccessor:
         if not rows:
             return pa.table({})
         # Tensor-valued rows can't go through from_pylist; route uniform
-        # ndarray columns through the fixed-shape tensor path.
+        # ndarray columns through the fixed-shape tensor path. The column set
+        # is the UNION of keys across all rows (from_pylist semantics): keys
+        # absent from some rows null-fill rather than silently dropping
+        # columns that first appear after row 0.
         if any(isinstance(v, np.ndarray) and v.ndim >= 1
-               for v in rows[0].values()):
+               for r in rows for v in r.values()):
+            keys = list(dict.fromkeys(k for r in rows for k in r))
             cols = {}
-            for k in rows[0]:
+            for k in keys:
                 vals = [r.get(k) for r in rows]
                 v0 = vals[0]
                 if (isinstance(v0, np.ndarray) and v0.ndim >= 1
@@ -83,7 +87,12 @@ class BlockAccessor:
                     # stacked is ndim>=2 (v0.ndim>=1), always tensor-typed
                     cols[k] = pa.FixedShapeTensorArray.from_numpy_ndarray(
                         np.ascontiguousarray(np.stack(vals)))
-                else:  # ragged / mixed: nested lists
+                else:
+                    # ragged / mixed / partially-absent: nested lists with
+                    # nulls. Deliberate: FixedShapeTensorArray cannot carry
+                    # null rows, so a column missing from some rows stays
+                    # list-typed even when its present values are uniform
+                    # tensors.
                     cols[k] = pa.array([
                         v.tolist() if isinstance(v, np.ndarray) else v
                         for v in vals])

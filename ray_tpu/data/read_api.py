@@ -424,26 +424,124 @@ def read_datasource(datasource, *, parallelism: int = -1, **kwargs) -> Dataset:
     return _plan_from_tasks(list(tasks))
 
 
-def _gated_reader(name: str, dep: str):
-    def reader(*_a, **_kw):
+def _require(dep: str, name: str):
+    try:
+        return __import__(dep, fromlist=["_"])
+    except ImportError as e:
+        raise ImportError(
+            f"{name} requires the {dep!r} package, which is not "
+            f"installed") from e
+
+
+def read_bigquery(project_id: str, dataset: Optional[str] = None,
+                  query: Optional[str] = None, **_kw) -> Dataset:
+    """Read a BigQuery table or query result (reference: ray
+    data/read_api.py:559 read_bigquery). Exactly one of `dataset`
+    ("dataset.table") or `query` must be given. The read runs as a single
+    task materializing one Arrow block (the Storage-API-backed `to_arrow()`
+    download is internally parallel; per-stream read tasks are future
+    work)."""
+    _require("google.cloud.bigquery", "read_bigquery")
+    if (dataset is None) == (query is None):
+        raise ValueError(
+            "read_bigquery: exactly one of `dataset` or `query` is required")
+
+    def read():
+        from google.cloud import bigquery
+
+        client = bigquery.Client(project=project_id)
+        if query is not None:
+            rows = client.query(query).result()
+        else:
+            rows = client.list_rows(dataset)
+        table = rows.to_arrow()
+        return [table]
+
+    return _plan_from_tasks([read])
+
+
+def read_mongo(uri: str, database: str, collection: str, *,
+               pipeline: Optional[List[Dict[str, Any]]] = None,
+               parallelism: int = 1, **_kw) -> Dataset:
+    """Read a MongoDB collection, optionally through an aggregation pipeline
+    (reference: ray data/read_api.py:459 read_mongo). With parallelism > 1
+    the collection is striped across tasks by a stable hash of each
+    document's `_id` — each task still runs the full scan and keeps 1/N of
+    it (like read_sql's striping), so use parallelism=1 for network-bound
+    reads. Aggregation pipelines always run as ONE task: a pipeline may be
+    non-deterministic (e.g. $sample), so per-task re-execution could not
+    stripe it exactly-once."""
+    import builtins
+
+    _require("pymongo", "read_mongo")
+    total = max(1, parallelism) if pipeline is None else 1
+
+    def make_task(shard: int):
+        def read():
+            import zlib
+
+            import pymongo
+
+            client = pymongo.MongoClient(uri)
+            try:
+                coll = client[database][collection]
+                docs = (coll.aggregate(pipeline) if pipeline is not None
+                        else coll.find())
+                rows = []
+                for doc in docs:
+                    if total > 1 and zlib.crc32(
+                            repr(doc.get("_id")).encode()) % total != shard:
+                        continue
+                    doc = dict(doc)
+                    _id = doc.get("_id")
+                    if _id is not None and not isinstance(
+                            _id, (str, int, float, bytes, bool)):
+                        doc["_id"] = str(_id)  # ObjectId -> str for Arrow
+                    rows.append(doc)
+                return [BlockAccessor.rows_to_block(rows)] if rows else []
+            finally:
+                client.close()
+
+        return read
+
+    return _plan_from_tasks([make_task(i) for i in builtins.range(total)])
+
+
+def read_databricks_tables(*, warehouse_id: str, table: Optional[str] = None,
+                           query: Optional[str] = None,
+                           catalog: Optional[str] = None,
+                           schema: Optional[str] = None,
+                           parallelism: int = 1, **_kw) -> Dataset:
+    """Read a Databricks SQL-warehouse table or query (reference: ray
+    data/read_api.py:2176 read_databricks_tables). Credentials come from the
+    DATABRICKS_HOST / DATABRICKS_TOKEN env vars, as in the reference; rows
+    arrive as Arrow via the connector's `fetchall_arrow()`."""
+    _require("databricks.sql", "read_databricks_tables")
+    if (table is None) == (query is None):
+        raise ValueError("read_databricks_tables: exactly one of `table` or "
+                         "`query` is required")
+
+    def read():
+        import os
+
+        from databricks import sql as dbsql
+
+        host = os.environ.get("DATABRICKS_HOST")
+        token = os.environ.get("DATABRICKS_TOKEN")
+        if not host or not token:
+            raise ValueError(
+                "read_databricks_tables requires DATABRICKS_HOST and "
+                "DATABRICKS_TOKEN environment variables")
+        conn = dbsql.connect(
+            server_hostname=host,
+            http_path=f"/sql/1.0/warehouses/{warehouse_id}",
+            access_token=token, catalog=catalog, schema=schema)
         try:
-            __import__(dep)
-        except ImportError as e:
-            raise ImportError(
-                f"{name} requires the {dep!r} package, which is not "
-                f"installed") from e
-        raise NotImplementedError(
-            f"{name}: the {dep!r} client is installed but this connector "
-            "is not yet wired; use read_sql/read_datasource with a custom "
-            "read task")
+            cur = conn.cursor()
+            cur.execute(query if query is not None
+                        else f"SELECT * FROM {table}")
+            return [cur.fetchall_arrow()]
+        finally:
+            conn.close()
 
-    reader.__name__ = name
-    reader.__doc__ = (f"{name} (reference: ray data/read_api.py) — gated on "
-                      f"the {dep!r} package like the reference.")
-    return reader
-
-
-read_bigquery = _gated_reader("read_bigquery", "google.cloud.bigquery")
-read_mongo = _gated_reader("read_mongo", "pymongo")
-read_databricks_tables = _gated_reader(
-    "read_databricks_tables", "databricks.sql")
+    return _plan_from_tasks([read])

@@ -97,9 +97,10 @@ def _manager():
     import ray_tpu
 
     # max_concurrency=1: updates are tiny and the manager mutates shared
-    # dict state — serial execution is the synchronization
+    # dict state — serial execution is the synchronization (passed
+    # explicitly; do not rely on the framework default staying 1)
     return ray_tpu.remote(_TqdmManager).options(
-        name=_MANAGER_NAME, get_if_exists=True,
+        name=_MANAGER_NAME, get_if_exists=True, max_concurrency=1,
         lifetime="detached").remote()
 
 

@@ -140,6 +140,23 @@ class GcsPlacementGroupManager:
             self._ready_events[pg_id] = asyncio.Event()
             for i in lost:
                 info.bundle_locations.pop(i, None)
+            if (info.spec.strategy == "STRICT_PACK"
+                    and any(b.get("TPU", 0) > 0 for b in info.spec.bundles)):
+                # TPU gang: rescheduling ONLY the lost bundle could land it
+                # on a different slice (the surviving slice hosts are full),
+                # silently straddling ICI domains. A gang is all-or-nothing
+                # (SURVEY §7: a failed host restarts the whole gang): release
+                # every surviving bundle and re-place the gang as a unit.
+                for surv_node in set(info.bundle_locations.values()):
+                    addr = self._nodes.raylet_address(surv_node)
+                    if addr is None:
+                        continue
+                    try:
+                        await self._pool.get(addr).send_async(
+                            "cancel_bundles", {"placement_group_id": pg_id})
+                    except (ConnectionLost, OSError):
+                        pass
+                info.bundle_locations.clear()
             self._pub.publish(ps.PG_CHANNEL, pg_id, info)
             asyncio.ensure_future(self._schedule(pg_id, partial=True))
 
@@ -162,6 +179,11 @@ class GcsPlacementGroupManager:
             return [k for k, _ in items]
 
         if strategy == "STRICT_PACK":
+            # TPU gang: STRICT_PACK of TPU bundles means ONE SLICE (one ICI
+            # domain), not one host — a multi-host slice is the TPU analogue
+            # of a single NVLink box. Delegated to the slice-aware path.
+            if any(b.get("TPU", 0) > 0 for b in bundles.values()):
+                return self._place_on_single_slice(bundles, view)
             total: Resources = {}
             for b in bundles.values():
                 for k, v in b.items():
@@ -201,6 +223,57 @@ class GcsPlacementGroupManager:
             used_nodes[chosen] = used_nodes.get(chosen, 0) + 1
             subtract_resources(view[chosen], demand)
         return placement
+
+    def _place_on_single_slice(
+        self, bundles: Dict[int, Resources], view: Dict[NodeID, Resources]
+    ) -> Optional[Dict[int, NodeID]]:
+        """Place a TPU gang so every bundle lands on hosts of ONE slice.
+
+        Nodes carrying the ray.io/tpu-slice-name label group by slice;
+        unlabeled TPU nodes each form their own singleton group (a dev box
+        with chips is its own ICI domain). Groups are tried smallest-first
+        (leave big slices for big gangs); within a group bundles pack
+        per-host. A gang that fits no single group fails placement — it
+        NEVER straddles slices, because cross-slice traffic would ride DCN,
+        not ICI. Reference analogue: the TPU-<topo>-head pod resource +
+        slice bookkeeping in ray tpu.py:75-210; here placement is
+        topology-aware directly (SURVEY §7).
+        """
+        from ray_tpu._private.accelerators.tpu import SLICE_NAME_LABEL
+
+        labels = self._nodes.label_view()
+        groups: Dict[str, List[NodeID]] = {}
+        for node_id, avail in view.items():
+            if avail.get("TPU", 0) <= 0:
+                continue  # CPU-only bundles of the gang also pack onto slice hosts
+            slice_name = labels.get(node_id, {}).get(SLICE_NAME_LABEL)
+            key = slice_name or f"__node__{node_id.hex()}"
+            groups.setdefault(key, []).append(node_id)
+
+        def group_tpu(nodes: List[NodeID]) -> float:
+            return sum(view[n].get("TPU", 0) for n in nodes)
+
+        for _, nodes in sorted(groups.items(),
+                               key=lambda kv: group_tpu(kv[1])):
+            scratch = {n: dict(view[n]) for n in nodes}
+            placement: Dict[int, NodeID] = {}
+            ok = True
+            for index, demand in sorted(bundles.items()):
+                chosen = None
+                # pack: least-available first so partial hosts fill up
+                for node_id in sorted(
+                        scratch, key=lambda n: sum(scratch[n].values())):
+                    if resources_fit(scratch[node_id], demand):
+                        chosen = node_id
+                        break
+                if chosen is None:
+                    ok = False
+                    break
+                placement[index] = chosen
+                subtract_resources(scratch[chosen], demand)
+            if ok:
+                return placement
+        return None
 
     async def _schedule(self, pg_id: PlacementGroupID, partial: bool = False):
         info = self._groups.get(pg_id)

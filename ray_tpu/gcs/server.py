@@ -133,6 +133,13 @@ class GcsNodeManager:
             if n.alive
         }
 
+    def label_view(self) -> Dict[NodeID, Dict[str, str]]:
+        return {
+            nid: dict(n.labels)
+            for nid, n in self._nodes.items()
+            if n.alive
+        }
+
     def raylet_address(self, node_id: NodeID) -> Optional[str]:
         info = self._nodes.get(node_id)
         return info.raylet_address if info is not None and info.alive else None

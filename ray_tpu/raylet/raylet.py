@@ -91,6 +91,7 @@ class Raylet:
         labels: Optional[Dict[str, str]] = None,
         log_dir: Optional[str] = None,
         worker_env: Optional[dict] = None,
+        accelerator_env: Optional[Dict[str, str]] = None,
     ):
         self.node_id = NodeID.from_random()
         self.gcs_address = gcs_address
@@ -104,7 +105,14 @@ class Raylet:
         resources = dict(resources)
         resources.setdefault("CPU", float(os.cpu_count() or 1))
         resources.setdefault("memory", 4.0 * 1024**3)
-        self.labels = labels or {}
+        self.labels = dict(labels or {})
+        # TPU slice detection (reference: _private/accelerators/tpu.py:75):
+        # GKE/GCE markers become TPU + TPU-<type>-head resources and slice
+        # labels used for single-slice gang placement. `accelerator_env`
+        # lets in-process test clusters model multiple slices on one host.
+        from ray_tpu._private.accelerators import apply_tpu_detection
+
+        apply_tpu_detection(resources, self.labels, env=accelerator_env)
         # node:<ip> affinity resource like the reference.
         self.total: Resources = resources
         self.available: Resources = dict(resources)
@@ -619,6 +627,20 @@ class Raylet:
                 # currently consumed by still-running leases are returned when
                 # those leases end (guarded in _release_alloc by pg removal).
                 add_resources(self.available, b.available)
+            # Evict workers still running inside the released bundles: the
+            # gang's reservation is gone, so its actors/tasks must not keep
+            # holding chips outside any PG (reference: PG removal kills
+            # leased workers; also the TPU-gang wholesale reschedule path —
+            # gcs/pg_manager.on_node_death — relies on this to free the
+            # surviving hosts before re-placing the gang).
+            for lease in list(self._leases.values()):
+                if lease.pg_id != pg_id:
+                    continue
+                handle = self.worker_pool.get_by_worker_id(lease.worker_id)
+                if handle is not None:
+                    # reaper observes the exit -> on_worker_death releases
+                    # the lease and reports actor death (restart FSM)
+                    self.worker_pool.kill_worker(handle)
         self._kick()
         return True
 

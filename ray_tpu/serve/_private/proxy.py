@@ -21,6 +21,17 @@ logger = logging.getLogger(__name__)
 _SENTINEL = object()  # end-of-stream marker for the chunked path
 
 
+def _close_generator(gen) -> None:
+    """Best-effort cancel of a replica-side streaming generator after the
+    HTTP client disconnects (nobody will consume further chunks)."""
+    try:
+        close = getattr(gen, "close", None)
+        if close is not None:
+            close()
+    except Exception:  # noqa: BLE001 — teardown must not raise
+        logger.debug("generator close failed", exc_info=True)
+
+
 class ProxyActor:
     def __init__(self, host: str = "127.0.0.1", port: int = 8000):
         self._host = host
@@ -155,12 +166,26 @@ class ProxyActor:
                         await stream.write(chunk)
                 except Exception as e:  # noqa: BLE001 — mid-stream failure
                     # status is already committed; signal the error in-band
-                    # instead of masking it as a clean end-of-stream
+                    # instead of masking it as a clean end-of-stream. The
+                    # client may be the thing that failed (disconnect), so
+                    # the in-band write itself must not escape the handler.
                     logger.exception("streaming request failed mid-stream")
-                    await stream.write(
-                        f"\n[stream error] {e}\n".encode())
+                    try:
+                        await stream.write(
+                            f"\n[stream error] {e}\n".encode())
+                    except Exception:  # noqa: BLE001 — client gone
+                        # cancel RPC off the event loop: it may block
+                        await loop.run_in_executor(
+                            None, _close_generator, gen)
                 finally:
-                    await stream.write_eof()
+                    try:
+                        await stream.write_eof()
+                    except Exception:  # noqa: BLE001 — client gone
+                        # stop the replica-side generator: nobody is
+                        # consuming its chunks anymore (run_in_executor —
+                        # the cancel RPC must not stall other requests)
+                        await loop.run_in_executor(
+                            None, _close_generator, gen)
                 return stream
 
             try:

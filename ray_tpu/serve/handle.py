@@ -41,6 +41,12 @@ class DeploymentResponseGenerator:
     def __next__(self):
         return ray_tpu.get(next(self._gen))
 
+    def close(self):
+        """Cancel the replica-side generator task (e.g. client went away)."""
+        close = getattr(self._gen, "close", None)
+        if close is not None:
+            close()
+
 
 class DeploymentHandle:
     def __init__(self, deployment_name: str, app_name: str = "",

@@ -1261,7 +1261,11 @@ class CoreWorker:
         )
 
     def cancel_task(self, ref: ObjectRef, force: bool = False):
-        task_id = ref.object_id().task_id()
+        self.cancel_task_by_id(ref.object_id().task_id(), force)
+
+    def cancel_task_by_id(self, task_id, force: bool = False):
+        """Cancel by TaskID directly — used by ObjectRefGenerator.close(),
+        where the consumer holds a generator (task) rather than a ref."""
         pending = self._pending_tasks.get(task_id)
         if pending is None:
             return

@@ -86,8 +86,15 @@ class Executor:
         self._cancelled: set = set()
         self._env_context = None  # applied RuntimeEnvContext (sticky)
         self._calls_by_function: Dict[str, int] = {}  # max_calls counting
+        self._recycle_lock = threading.Lock()  # guards the 2 fields above/below:
+        # the executor pool has many threads; even though leases serialize
+        # tasks one-at-a-time today, the retire bookkeeping must not depend
+        # on that implicit invariant.
         self._retiring = False  # set when max_calls is reached
-        self._will_retire_after_task = False  # set pre-execution
+        # Per-TASK retire flag: thread-local, NOT an instance field — with a
+        # shared field a concurrent max_calls=0 task could clobber the flag
+        # between this task's pre-execution set and its return packaging.
+        self._task_tls = threading.local()
 
     def _apply_runtime_env(self, env: dict) -> None:
         from ray_tpu import runtime_env as re_mod
@@ -198,7 +205,7 @@ class Executor:
             self.cw.memory_store.put_serialized(
                 oid, None, value=value, in_plasma=True,
                 plasma_node=plasma_node)
-        elif self._will_retire_after_task:
+        elif getattr(self._task_tls, "will_retire", False):
             # max_calls: this worker exits right after the reply — a
             # memory-store primary copy would die with it, so ship the
             # value inline (the shm store, when available above, survives
@@ -234,11 +241,16 @@ class Executor:
         token = self.cw.enter_task_context(spec)
         self._running_threads[spec.task_id] = threading.get_ident()
         limit = getattr(spec, "max_calls", 0)
-        if limit:
-            # known before execution: packaging uses it to avoid leaving a
-            # primary copy in the about-to-exit worker's memory store
+        with self._recycle_lock:
+            # CLAIM the call slot now (not at completion): two concurrent
+            # tasks of a max_calls=N function must not both read the same
+            # pre-increment count, or the one that actually reaches the
+            # limit would skip the inline-return path below and lose its
+            # result when the worker exits. Packaging uses the flag to avoid
+            # leaving a primary copy in the about-to-exit memory store.
             n = self._calls_by_function.get(spec.function_id, 0) + 1
-            self._will_retire_after_task = n >= limit
+            self._calls_by_function[spec.function_id] = n
+            self._task_tls.will_retire = bool(limit) and n >= limit
         try:
             fn = self._load_function(spec.function_id)
             args, kwargs = self._resolve_args(spec.args, getattr(spec, "kwarg_specs", {}) or {})
@@ -262,21 +274,24 @@ class Executor:
         limit = getattr(spec, "max_calls", 0)
         if not limit:
             return
-        n = self._calls_by_function.get(spec.function_id, 0) + 1
-        self._calls_by_function[spec.function_id] = n
-        if n >= limit and not self._retiring:
-            logger.info("worker reached max_calls=%d for %s; exiting",
-                        limit, spec.function_name)
+        with self._recycle_lock:
+            # the call slot was already claimed pre-execution; the tls flag
+            # says whether THIS task was the one that reached the limit
+            if not getattr(self._task_tls, "will_retire", False) \
+                    or self._retiring:
+                return
             self._retiring = True  # reply carries worker_retiring (execute)
-            # Delayed exit so the in-flight task reply flushes first (the
-            # reply is small — large returns go to the shm store, see
-            # _package_value — so 1s is orders of magnitude above local
-            # socket flush time). The owner drops the lease on seeing the
-            # flag, so no new task races the exit.
-            threading.Thread(
-                target=lambda: (time.sleep(1.0), os._exit(0)),
-                daemon=True,
-            ).start()
+        logger.info("worker reached max_calls=%d for %s; exiting",
+                    limit, spec.function_name)
+        # Delayed exit so the in-flight task reply flushes first (the
+        # reply is small — large returns go to the shm store, see
+        # _package_value — so 1s is orders of magnitude above local
+        # socket flush time). The owner drops the lease on seeing the
+        # flag, so no new task races the exit.
+        threading.Thread(
+            target=lambda: (time.sleep(1.0), os._exit(0)),
+            daemon=True,
+        ).start()
 
     def _run_generator(self, spec: TaskSpec, fn, args, kwargs) -> dict:
         """Streaming generator: report each item to the owner as produced."""
