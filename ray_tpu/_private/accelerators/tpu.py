@@ -117,7 +117,7 @@ def detect_tpu(env: Optional[Mapping[str, str]] = None,
 
     accel_type = env.get(_GKE_ACCEL_TYPE)
     if accel_type:
-        worker_id = int(env.get(_GKE_WORKER_ID, "0") or "0")
+        worker_id = _parse_worker_id(env.get(_GKE_WORKER_ID))
         hostnames = [h for h in env.get(_GKE_HOSTNAMES, "").split(",") if h]
         slice_name = env.get(_GKE_NAME) or (
             hostnames[0] if hostnames else f"tpu-{accel_type}")
@@ -130,21 +130,52 @@ def detect_tpu(env: Optional[Mapping[str, str]] = None,
         )
 
     if probe_gce:
-        accel_type = _gce_metadata("instance/attributes/accelerator-type")
-        if accel_type:
-            worker_str = _gce_metadata(
-                "instance/attributes/agent-worker-number") or "0"
-            name = (_gce_metadata("instance/attributes/instance-id")
-                    or _gce_metadata("instance/name")
-                    or f"tpu-{accel_type}")
-            return TpuSliceInfo(
-                accelerator_type=accel_type,
-                slice_name=name,
-                worker_id=int(worker_str),
-                num_chips=_chips_per_host(env, accel_type),
-                num_workers=1,
-            )
+        return _probe_gce_cached(env)
     return None
+
+
+_GCE_PROBE_RESULT = ...  # Ellipsis = not probed yet (None is a valid result)
+
+
+def _probe_gce_cached(env) -> Optional[TpuSliceInfo]:
+    """One metadata probe per process: several raylets/inits in one process
+    (tests, head node) must not each pay the network round trip."""
+    global _GCE_PROBE_RESULT
+    if _GCE_PROBE_RESULT is not ...:
+        return _GCE_PROBE_RESULT
+    _GCE_PROBE_RESULT = _probe_gce(env)
+    return _GCE_PROBE_RESULT
+
+
+def _probe_gce(env) -> Optional[TpuSliceInfo]:
+    accel_type = _gce_metadata("instance/attributes/accelerator-type")
+    if not accel_type:
+        return None
+    worker_str = _gce_metadata(
+        "instance/attributes/agent-worker-number") or "0"
+    name = (_gce_metadata("instance/attributes/instance-id")
+            or _gce_metadata("instance/name")
+            or f"tpu-{accel_type}")
+    return TpuSliceInfo(
+        accelerator_type=accel_type,
+        slice_name=name,
+        worker_id=_parse_worker_id(worker_str),
+        num_chips=_chips_per_host(env, accel_type),
+        num_workers=1,
+    )
+
+
+def _parse_worker_id(raw) -> int:
+    """Tolerant parse: a garbled TPU_WORKER_ID must degrade (worker 0, with
+    a warning), not crash node startup — detection is supposed to be a
+    no-op-or-better on any host."""
+    if not raw:
+        return 0
+    try:
+        return int(str(raw).strip())
+    except ValueError:
+        logger.warning("unparseable TPU worker id %r; assuming 0", raw)
+        return 0
 
 
 def apply_tpu_detection(

@@ -22,28 +22,39 @@ class _Config:
     def __init__(self):
         self._defaults: Dict[str, Any] = {}
         self._values: Dict[str, Any] = {}
+        # Resolved-value memo: config reads sit on the task-submit hot path
+        # (several per task), and an os.environ lookup per read costs ~25µs.
+        # Env overrides are read ONCE per process, like the reference's
+        # RayConfig (ray_config_def.h) — set() updates the memo.
+        self._resolved: Dict[str, Any] = {}
         self._lock = threading.Lock()
 
     def define(self, name: str, default: Any) -> None:
         self._defaults[name] = default
 
     def get(self, name: str) -> Any:
+        try:
+            return self._resolved[name]
+        except KeyError:
+            pass
         with self._lock:
             if name in self._values:
-                return self._values[name]
-        if name not in self._defaults:
-            raise KeyError(f"unknown config {name}")
-        default = self._defaults[name]
-        env = os.environ.get(_ENV_PREFIX + name.upper())
-        if env is not None:
-            return _coerce(env, default)
-        return default
+                val = self._values[name]
+            elif name not in self._defaults:
+                raise KeyError(f"unknown config {name}")
+            else:
+                default = self._defaults[name]
+                env = os.environ.get(_ENV_PREFIX + name.upper())
+                val = _coerce(env, default) if env is not None else default
+            self._resolved[name] = val
+            return val
 
     def set(self, name: str, value: Any) -> None:
         if name not in self._defaults:
             raise KeyError(f"unknown config {name}")
         with self._lock:
             self._values[name] = value
+            self._resolved[name] = value
 
     def apply_system_config(self, overrides: Dict[str, Any]) -> None:
         for k, v in overrides.items():
@@ -108,6 +119,9 @@ _d("default_actor_num_cpus", 1.0)
 _d("task_retry_delay_ms", 0)
 _d("actor_restart_delay_ms", 100)
 _d("max_pending_lease_requests_per_scheduling_key", 10)
+_d("max_tasks_per_push", 32)            # normal-task specs per batched push RPC
+_d("task_batch_latency_ms", 5.0)        # batch pushes only for keys faster than this
+_d("tpu_probe_gce_metadata", True)      # probe GCE metadata for TPU topology at node start
 _d("streaming_generator_backpressure_objects", -1)  # -1 = unbounded
 
 # --- scheduling --------------------------------------------------------------

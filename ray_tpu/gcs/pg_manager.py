@@ -260,9 +260,11 @@ class GcsPlacementGroupManager:
             ok = True
             for index, demand in sorted(bundles.items()):
                 chosen = None
-                # pack: least-available first so partial hosts fill up
+                # pack: fewest free CHIPS first so partial hosts fill up —
+                # ranking by sum of all resources would be dominated by the
+                # ~1e9-scale memory term and can strand a feasible gang
                 for node_id in sorted(
-                        scratch, key=lambda n: sum(scratch[n].values())):
+                        scratch, key=lambda n: scratch[n].get("TPU", 0.0)):
                     if resources_fit(scratch[node_id], demand):
                         chosen = node_id
                         break

@@ -109,10 +109,15 @@ class Raylet:
         # TPU slice detection (reference: _private/accelerators/tpu.py:75):
         # GKE/GCE markers become TPU + TPU-<type>-head resources and slice
         # labels used for single-slice gang placement. `accelerator_env`
-        # lets in-process test clusters model multiple slices on one host.
+        # lets in-process test clusters model multiple slices on one host;
+        # the GCE metadata probe (non-GKE TPU VMs) only runs for real nodes
+        # reading the ambient environment.
         from ray_tpu._private.accelerators import apply_tpu_detection
 
-        apply_tpu_detection(resources, self.labels, env=accelerator_env)
+        apply_tpu_detection(
+            resources, self.labels, env=accelerator_env,
+            probe_gce=(accelerator_env is None
+                       and CONFIG.tpu_probe_gce_metadata))
         # node:<ip> affinity resource like the reference.
         self.total: Resources = resources
         self.available: Resources = dict(resources)
@@ -586,6 +591,13 @@ class Raylet:
             bundles = self._bundles.get(pg_id)
             if bundles is not None and bundle_index in bundles:
                 add_resources(bundles[bundle_index].available, resources)
+            else:
+                # The PG was cancelled while this lease ran: cancel_bundles
+                # returned only the UNUSED bundle portion to the node pool,
+                # so the lease-held portion must come back here — otherwise
+                # every PG removal with running workers permanently leaks
+                # the consumed chips/CPUs.
+                add_resources(self.available, resources)
         else:
             add_resources(self.available, resources)
         self._kick()

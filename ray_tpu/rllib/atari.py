@@ -1,0 +1,101 @@
+"""Atari / image-observation env helpers.
+
+Reference: ray rllib's Atari pipeline (benchmark_atari_ppo.py builds envs
+with gymnasium's AtariPreprocessing: grayscale, 84x84 resize, frame-skip 4,
+max-pooled frames) + the frame-stacking env-to-module connector
+(rllib/connectors/env_to_module/frame_stacking.py). Here preprocessing is
+env-side gymnasium wrappers: the stacked uint8 frames flow straight into
+the jitted CNN forward, which normalizes on-device (a host-side float32
+conversion would quadruple the sample-transport bytes).
+
+Real Atari needs ale_py (import-gated, like every optional integration);
+`SyntheticImageEnv` provides a CPU-only image env with learnable structure
+for CI and benchmarks on machines without ROMs.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+def make_atari_env(env_id: str, *, frame_stack: int = 4,
+                   screen_size: int = 84, frameskip: int = 4,
+                   env_config: Optional[dict] = None):
+    """Standard Atari pipeline: AtariPreprocessing + frame stack.
+
+    -> obs uint8 [screen_size, screen_size, frame_stack]
+    """
+    import gymnasium as gym
+
+    try:
+        import ale_py  # noqa: F401 — registers ALE-prefixed envs
+        gym.register_envs(ale_py)
+    except ImportError as e:
+        raise ImportError(
+            "Atari environments require the 'ale-py' package") from e
+    env = gym.make(env_id, frameskip=1, **(env_config or {}))
+    env = gym.wrappers.AtariPreprocessing(
+        env, frame_skip=frameskip, screen_size=screen_size,
+        grayscale_obs=True, grayscale_newaxis=False, scale_obs=False)
+    env = gym.wrappers.FrameStackObservation(env, stack_size=frame_stack)
+    # FrameStackObservation emits [stack, H, W]; the CNN expects
+    # channels-last [H, W, stack].
+    env = gym.wrappers.TransformObservation(
+        env, lambda obs: np.moveaxis(obs, 0, -1),
+        observation_space=gym.spaces.Box(
+            0, 255, (screen_size, screen_size, frame_stack), np.uint8))
+    return env
+
+
+class SyntheticImageEnv:
+    """Tiny image-obs env with learnable optimal policy, for CI/bench.
+
+    Each step shows a HxWx1 uint8 image with one bright quadrant; the
+    action matching the quadrant index scores +1, else 0. Optimal return
+    over an episode of length T is T. A conv policy must actually read the
+    image to beat the 1/num_quadrants random baseline — this is the
+    CPU-testable stand-in for Atari learning regressions (reference uses
+    tuned_examples thresholds the same way).
+    """
+
+    metadata = {"render_modes": []}
+
+    def __init__(self, size: int = 16, episode_len: int = 32,
+                 seed: Optional[int] = None):
+        import gymnasium as gym
+
+        self.size = size
+        self.episode_len = episode_len
+        self.observation_space = gym.spaces.Box(
+            0, 255, (size, size, 1), np.uint8)
+        self.action_space = gym.spaces.Discrete(4)
+        self._rng = np.random.default_rng(seed)
+        self._t = 0
+        self._target = 0
+
+    def _obs(self):
+        img = np.zeros((self.size, self.size, 1), np.uint8)
+        h = self.size // 2
+        r, c = divmod(self._target, 2)
+        img[r * h:(r + 1) * h, c * h:(c + 1) * h, 0] = 255
+        return img
+
+    def reset(self, *, seed=None, options=None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._t = 0
+        self._target = int(self._rng.integers(4))
+        return self._obs(), {}
+
+    def step(self, action):
+        reward = 1.0 if int(action) == self._target else 0.0
+        self._t += 1
+        self._target = int(self._rng.integers(4))
+        terminated = False
+        truncated = self._t >= self.episode_len
+        return self._obs(), reward, terminated, truncated, {}
+
+    def close(self):
+        pass

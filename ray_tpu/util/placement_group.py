@@ -13,6 +13,27 @@ from ray_tpu._private.ids import PlacementGroupID
 from ray_tpu._raylet import get_core_worker
 
 
+_READY_TASK = None
+
+
+def _pg_ready_task():
+    """Module-level remote fn shared by every PlacementGroup.ready() call:
+    a per-call closure would mint a fresh function id (= fresh scheduling
+    key) each time, so no lease is ever reused and every ready() pays a
+    worker spawn (~200ms instead of ~1ms)."""
+    global _READY_TASK
+    if _READY_TASK is None:
+        from ray_tpu.api import remote
+
+        @remote
+        def _wait_placement_group_ready(pg_id):
+            get_core_worker().wait_placement_group_ready(pg_id)
+            return True
+
+        _READY_TASK = _wait_placement_group_ready
+    return _READY_TASK
+
+
 class PlacementGroup:
     def __init__(self, pg_id: PlacementGroupID, bundles: Optional[List[dict]] = None):
         self.id = pg_id
@@ -21,16 +42,7 @@ class PlacementGroup:
     def ready(self):
         """ObjectRef-style awaitable: returns a ref resolved when ready
         (reference returns a task ref; we run the wait in a task)."""
-        from ray_tpu.api import remote
-
-        pg_id = self.id
-
-        @remote
-        def _wait_ready():
-            get_core_worker().wait_placement_group_ready(pg_id)
-            return True
-
-        return _wait_ready.options(num_cpus=0).remote()
+        return _pg_ready_task().options(num_cpus=0).remote(self.id)
 
     def wait(self, timeout_seconds: Optional[float] = None) -> bool:
         return get_core_worker().wait_placement_group_ready(

@@ -130,6 +130,34 @@ class Executor:
             reply["worker_retiring"] = True
         return reply
 
+    def execute_batch_sync(self, specs) -> list:
+        """Blocking batch execution for owner-batched normal-task pushes —
+        runs in ONE thread-pool job (an event-loop hop per task would cost
+        more than a noop task itself). Returns one reply per spec; specs
+        after a worker-retiring task are returned {"not_run": True}."""
+        replies = []
+        retired = False
+        for spec in specs:
+            if retired:
+                replies.append({"not_run": True})
+                continue
+            if spec.runtime_env and self._env_context is None:
+                try:
+                    self._apply_runtime_env(spec.runtime_env)
+                except Exception as e:  # noqa: BLE001 — surface as task error
+                    from ray_tpu.exceptions import RuntimeEnvSetupError
+
+                    err = (e if isinstance(e, RuntimeEnvSetupError)
+                           else RuntimeEnvSetupError(str(e)))
+                    replies.append(self._error_reply(spec, err))
+                    continue
+            reply = self._run_normal_task(spec)
+            if self._retiring:
+                reply["worker_retiring"] = True
+                retired = True
+            replies.append(reply)
+        return replies
+
     def cancel(self, task_id: TaskID, force: bool) -> bool:
         self._cancelled.add(task_id)
         ident = self._running_threads.get(task_id)
@@ -233,6 +261,15 @@ class Executor:
 
     # ---------------------------------------------------------- normal tasks
     def _run_normal_task(self, spec: TaskSpec) -> dict:
+        t0 = time.monotonic()
+        reply = self._run_normal_task_inner(spec)
+        # worker-measured execution time: the owner's push-batching gate
+        # needs task duration EXCLUDING network RTT (an RTT-inclusive
+        # sample would lock remote owners out of batching forever)
+        reply["exec_s"] = time.monotonic() - t0
+        return reply
+
+    def _run_normal_task_inner(self, spec: TaskSpec) -> dict:
         if spec.task_id in self._cancelled:
             return {
                 "status": "cancelled",

@@ -8,6 +8,9 @@ needed to exercise sharding/collective code paths.
 
 import os
 
+# Hermetic tests: never probe the GCE metadata server for TPU topology.
+os.environ.setdefault("RT_TPU_PROBE_GCE_METADATA", "0")
+
 # Must be set before anything imports jax (including this host's
 # sitecustomize in spawned workers — handled by worker env).
 os.environ["JAX_PLATFORMS"] = "cpu"  # force: host env may say "axon" (TPU)

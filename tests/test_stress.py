@@ -1,0 +1,142 @@
+"""Scalability-envelope stress tests (reference: release/benchmarks/README.md
+5-31 — many tasks/actors/PGs, large objects — scaled to a single CI box).
+
+VERDICT r1 #3: the envelope was entirely unverified. These are the in-CI
+versions; set RT_STRESS_FULL=1 to run the release-scale variants.
+"""
+
+import os
+import time
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.util.placement_group import (
+    placement_group,
+    remove_placement_group,
+)
+
+FULL = os.environ.get("RT_STRESS_FULL") == "1"
+
+
+def test_10k_queued_tasks(ray_start_regular):
+    """10k tasks queued on one owner, batched pushes drain them."""
+    n = 100_000 if FULL else 10_000
+
+    @ray_tpu.remote
+    def noop():
+        return 1
+
+    ray_tpu.get([noop.remote() for _ in range(50)])  # warm leases
+    t0 = time.perf_counter()
+    refs = [noop.remote() for _ in range(n)]
+    out = ray_tpu.get(refs, timeout=300)
+    dt = time.perf_counter() - t0
+    assert len(out) == n and out[0] == 1
+    rate = n / dt
+    # envelope guard: batched async submission must stay well above the
+    # sync round-trip rate (~1.3k/s); regression here means batching broke
+    assert rate > 2000, f"only {rate:.0f} tasks/s"
+
+
+def test_100_concurrent_placement_groups(ray_start_regular):
+    n = 1000 if FULL else 100
+    pgs = [placement_group([{"CPU": 0.01}], strategy="PACK")
+           for _ in range(n)]
+    for pg in pgs:
+        assert pg.wait(timeout_seconds=60)
+    for pg in pgs:
+        remove_placement_group(pg)
+    # all reservations released: a full-CPU task must still be schedulable
+    # (bundle release is async on the raylet — allow a heartbeat)
+
+    @ray_tpu.remote(num_cpus=4)
+    def needs_all():
+        return "ok"
+
+    assert ray_tpu.get(needs_all.remote(), timeout=60) == "ok"
+
+
+def test_pg_create_remove_rate(ray_start_regular):
+    """VERDICT r1 target: PG create+ready+remove ≥ 50/s (was 3.9/s)."""
+    # warm the ready-task lease so the loop measures steady state
+    pg = placement_group([{"CPU": 0.1}])
+    ray_tpu.get(pg.ready(), timeout=30)
+    remove_placement_group(pg)
+    n = 30
+    t0 = time.perf_counter()
+    for _ in range(n):
+        pg = placement_group([{"CPU": 0.1}])
+        ray_tpu.get(pg.ready(), timeout=30)
+        remove_placement_group(pg)
+    rate = n / (time.perf_counter() - t0)
+    assert rate > 50, f"only {rate:.0f} pg cycles/s"
+
+
+def test_1gib_object_through_shm_store(ray_start_regular):
+    """1 GiB object: put -> shm store -> zero-copy get; ends must survive."""
+    size = 1 << 30
+    arr = np.empty(size, dtype=np.uint8)
+    arr[:4096] = 7
+    arr[-4096:] = 9
+    ref = ray_tpu.put(arr)
+    got = ray_tpu.get(ref, timeout=120)
+    assert got.nbytes == size
+    assert got[:4096].sum() == 7 * 4096 and got[-4096:].sum() == 9 * 4096
+
+    # and through a task (worker -> owner large return)
+    @ray_tpu.remote
+    def head(x):
+        return x[:1024].copy()
+
+    assert head.remote(ref) is not None
+    out = ray_tpu.get(head.remote(ref), timeout=120)
+    assert out.sum() == 7 * 1024
+    del got, ref
+
+
+def test_many_actors(ray_start_regular):
+    """Many concurrent placement-only actors on one node (envelope:
+    reference holds 40k across 64 nodes; per-node that is ~600 — here we
+    hold enough to prove registration/dispatch scale past the worker pool
+    prestart size, full scale via RT_STRESS_FULL)."""
+    n = 1000 if FULL else 60
+
+    @ray_tpu.remote(num_cpus=0)
+    class Member:
+        def __init__(self, i):
+            self.i = i
+
+        def ping(self):
+            return self.i
+
+    actors = [Member.remote(i) for i in range(n)]
+    got = ray_tpu.get([a.ping.remote() for a in actors], timeout=500)
+    assert got == list(range(n))
+    # second round-trip: all actors stay live and callable
+    got = ray_tpu.get([a.ping.remote() for a in actors], timeout=500)
+    assert got == list(range(n))
+    for a in actors:
+        ray_tpu.kill(a)
+
+
+def test_many_args_and_returns(ray_start_regular):
+    """Reference envelope: 10k+ object args to one task, 3k+ returns —
+    CI-scaled to 1k args / 500 returns."""
+    n_args = 10_000 if FULL else 1_000
+
+    @ray_tpu.remote
+    def consume(*xs):
+        return len(xs)
+
+    refs = [ray_tpu.put(i) for i in range(n_args)]
+    assert ray_tpu.get(consume.remote(*refs), timeout=120) == n_args
+
+    n_ret = 3000 if FULL else 500
+
+    @ray_tpu.remote(num_returns=n_ret)
+    def produce():
+        return list(range(n_ret))
+
+    outs = ray_tpu.get(list(produce.remote()), timeout=120)
+    assert outs == list(range(n_ret))

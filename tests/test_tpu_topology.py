@@ -83,6 +83,37 @@ def test_apply_tpu_detection_resources_and_labels():
     assert resources3["TPU"] == 8.0
 
 
+def test_detect_tpu_gce_metadata_probe(monkeypatch):
+    """Non-GKE GCE TPU VMs expose topology via the metadata server."""
+    from ray_tpu._private.accelerators import tpu as tpu_mod
+
+    values = {
+        "instance/attributes/accelerator-type": "v5p-16",
+        "instance/attributes/agent-worker-number": "1",
+        "instance/attributes/instance-id": "my-tpu-vm",
+    }
+    monkeypatch.setattr(tpu_mod, "_gce_metadata",
+                        lambda path, timeout=0.5: values.get(path))
+    monkeypatch.setattr(tpu_mod, "_GCE_PROBE_RESULT", ...)
+    info = detect_tpu({}, probe_gce=True)
+    assert info is not None
+    assert info.accelerator_type == "v5p-16"
+    assert info.slice_name == "my-tpu-vm"
+    assert info.worker_id == 1
+    assert info.num_chips == 4
+    # probe result is memoized per process
+    monkeypatch.setattr(tpu_mod, "_gce_metadata",
+                        lambda path, timeout=0.5: 1 / 0)
+    assert detect_tpu({}, probe_gce=True).slice_name == "my-tpu-vm"
+
+
+def test_garbled_worker_id_degrades_not_crashes():
+    env = _slice_env("slice-a", worker_id=0)
+    env["TPU_WORKER_ID"] = "not-a-number"
+    info = detect_tpu(env)
+    assert info is not None and info.worker_id == 0
+
+
 # ---------------------------------------------------------------- placement
 
 def test_tpu_gang_lands_on_single_slice(ray_start_cluster):
