@@ -45,7 +45,11 @@ class Algorithm:
     def _record_episodes(self, episodes) -> None:
         for ep in episodes:
             self._num_env_steps_sampled_lifetime += len(ep)
-            if ep.is_done:
+            # terminated AND env-truncated (TimeLimit) episodes have a
+            # complete return; boundary fragments do not
+            if ep.is_done or (ep.is_truncated
+                              and not getattr(ep, "is_boundary_fragment",
+                                              False)):
                 self._episode_returns.append(ep.total_reward)
 
     def get_state(self) -> Dict[str, Any]:
@@ -69,13 +73,42 @@ class Algorithm:
 
     @staticmethod
     def _env_spaces(env_id: str, env_config: Optional[dict] = None):
-        """(obs_dim, num_actions) for a discrete-action env."""
-        import gymnasium as gym
+        """(obs, num_actions) for a discrete-action env — obs is a flat dim
+        (int) for vector observations or a shape tuple for image (ndim>1)
+        observations."""
+        from ray_tpu.rllib.env_runner import make_env
 
-        env = gym.make(env_id, **(env_config or {}))
+        env = make_env(env_id, env_config)
         try:
-            obs_dim = int(env.observation_space.shape[0])
+            shape = env.observation_space.shape
+            obs = tuple(int(d) for d in shape) if len(shape) > 1 \
+                else int(shape[0])
             num_actions = int(env.action_space.n)
         finally:
             env.close()
-        return obs_dim, num_actions
+        return obs, num_actions
+
+    def _actor_critic_spec(self, config) -> dict:
+        """Module spec for actor-critic algorithms: picks the conv encoder
+        for image observations (reference: the model catalog's encoder
+        selection, rllib core/models/configs.py:637 CNNEncoderConfig)."""
+        obs, num_actions = self._env_spaces(config.env, config.env_config)
+        if isinstance(obs, tuple):
+            return {
+                "obs_shape": obs, "num_actions": num_actions,
+                "module_class":
+                    "ray_tpu.rllib.rl_module:ConvActorCriticModule",
+                "conv_filters": tuple(
+                    tuple(f) for f in config.model.get(
+                        "conv_filters",
+                        ((32, 8, 4), (64, 4, 2), (64, 3, 1)))),
+                # reference key: post_fcnet_hiddens = dense layers AFTER the
+                # conv encoder (fcnet_hiddens' [64,64] default is the MLP
+                # torso's and would silently undersize the conv head)
+                "hiddens": tuple(
+                    config.model.get("post_fcnet_hiddens", (512,))),
+            }
+        return {
+            "obs_dim": obs, "num_actions": num_actions,
+            "hiddens": tuple(config.model.get("fcnet_hiddens", (64, 64))),
+        }

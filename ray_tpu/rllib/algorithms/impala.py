@@ -55,7 +55,8 @@ def make_vtrace_update(module, optimizer, config: Dict[str, Any]):
     def loss_fn(params, batch):
         # batch arrays are [B, T] (+ trailing dims); flatten for the module.
         b, t = batch["actions"].shape
-        obs = batch["obs"].reshape(b * t, -1)
+        # flatten [B, T] rows only — image obs keep their [H, W, C] tail
+        obs = batch["obs"].reshape((b * t,) + batch["obs"].shape[2:])
         out = module.forward_train(
             params, {"obs": obs, "actions": batch["actions"].reshape(-1)})
         logp = out["logp"].reshape(b, t)
@@ -126,15 +127,10 @@ class IMPALA(Algorithm):
         import jax
         import optax
 
-        from ray_tpu.rllib.rl_module import DiscreteActorCriticModule
+        from ray_tpu.rllib.rl_module import resolve_module
 
-        obs_dim, num_actions = self._env_spaces(config.env, config.env_config)
-        self.module_spec = {
-            "obs_dim": obs_dim, "num_actions": num_actions,
-            "hiddens": tuple(config.model.get("fcnet_hiddens", (64, 64))),
-        }
-        self.module = DiscreteActorCriticModule(
-            obs_dim, num_actions, self.module_spec["hiddens"])
+        self.module_spec = self._actor_critic_spec(config)
+        self.module = resolve_module(self.module_spec)
         self.params = self.module.init(jax.random.PRNGKey(config.seed or 0))
         self.optimizer = optax.adam(config.lr)
         self.opt_state = self.optimizer.init(self.params)
@@ -175,10 +171,11 @@ class IMPALA(Algorithm):
             terms = np.zeros(len(ep), np.float32)
             terms[-1] = 1.0
             if not ep.is_done:
-                last_obs = np.asarray(ep.obs[-1], np.float32)
+                # keep the env dtype: uint8 image obs normalize on-device
+                last_obs = np.asarray(ep.obs[-1])
                 rews[-1] += self.config.gamma * float(self._value_fn(
-                    self.params, last_obs[None, :])[0])
-            stream["obs"].append(np.asarray(ep.obs[:-1], np.float32))
+                    self.params, last_obs[None])[0])
+            stream["obs"].append(np.asarray(ep.obs[:-1]))
             stream["actions"].append(np.asarray(ep.actions, np.int64))
             stream["rewards"].append(rews)
             stream["logp"].append(

@@ -48,12 +48,10 @@ def compute_gae(rewards: np.ndarray, values: np.ndarray,
 
 class PPOLearner(JaxLearner):
     def __init__(self, module_spec: Dict[str, Any], config: Dict[str, Any]):
-        from ray_tpu.rllib.rl_module import DiscreteActorCriticModule
+        from ray_tpu.rllib.rl_module import resolve_module
 
-        module = DiscreteActorCriticModule(
-            module_spec["obs_dim"], module_spec["num_actions"],
-            module_spec.get("hiddens", (64, 64)))
-        super().__init__(module, config)
+        # resolve_module picks the conv encoder for image obs_shape specs
+        super().__init__(resolve_module(module_spec), config)
 
     def loss_fn(self, params, batch):
         import jax.numpy as jnp
@@ -80,11 +78,7 @@ class PPOLearner(JaxLearner):
 
 class PPO(Algorithm):
     def setup(self, config: AlgorithmConfig) -> None:
-        obs_dim, num_actions = self._env_spaces(config.env, config.env_config)
-        self.module_spec = {
-            "obs_dim": obs_dim, "num_actions": num_actions,
-            "hiddens": tuple(config.model.get("fcnet_hiddens", (64, 64))),
-        }
+        self.module_spec = self._actor_critic_spec(config)
         cfg = config.to_dict()
         self.env_runner_group = EnvRunnerGroup(cfg, self.module_spec)
         self.learner_group = LearnerGroup(PPOLearner, self.module_spec, cfg)
@@ -120,9 +114,19 @@ class PPO(Algorithm):
             b["value_targets"] = targets
             batches.append(b)
         keys = ("obs", "actions", "logp", "advantages", "value_targets")
+
+        def cast(k, v):
+            if k == "actions":
+                return v.astype(np.int32)
+            if k == "obs":
+                # keep the env dtype: uint8 image obs normalize on-device
+                # inside the conv module (a host float32 cast would skip
+                # the /255 and quadruple the batch bytes)
+                return v
+            return v.astype(np.float32)
+
         train_batch = {
-            k: np.concatenate([b[k] for b in batches]).astype(
-                np.float32 if k != "actions" else np.int32)
+            k: cast(k, np.concatenate([b[k] for b in batches]))
             for k in keys}
 
         # 3. minibatch SGD epochs

@@ -49,7 +49,13 @@ def make_atari_env(env_id: str, *, frame_stack: int = 4,
     return env
 
 
-class SyntheticImageEnv:
+def _gym_env_base():
+    import gymnasium as gym
+
+    return gym.Env
+
+
+class SyntheticImageEnv(_gym_env_base()):
     """Tiny image-obs env with learnable optimal policy, for CI/bench.
 
     Each step shows a HxWx1 uint8 image with one bright quadrant; the
@@ -99,3 +105,14 @@ class SyntheticImageEnv:
 
     def close(self):
         pass
+
+
+def register_synthetic_env() -> str:
+    """Register ray_tpu/SyntheticImage-v0 with gymnasium (idempotent);
+    returns the env id. make_env auto-registers it on first use."""
+    import gymnasium as gym
+
+    env_id = "ray_tpu/SyntheticImage-v0"
+    if env_id not in gym.registry:
+        gym.register(id=env_id, entry_point=SyntheticImageEnv)
+    return env_id

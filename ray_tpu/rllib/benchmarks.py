@@ -1,0 +1,54 @@
+"""RLlib sampling/training benchmarks (reference:
+rllib/benchmarks/ppo/benchmark_atari_ppo.py — env-steps/sec with the conv
+policy in the loop). Run: python -m ray_tpu.rllib.benchmarks [env_id]."""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Optional
+
+
+def benchmark_env_steps(env_id: Optional[str] = None, *, num_envs: int = 8,
+                        steps: int = 256, conv_filters=None,
+                        hiddens=(256,)) -> dict:
+    """env-steps/sec through EnvRunner.sample with a jitted conv policy."""
+    import jax
+
+    from ray_tpu.rllib.env_runner import EnvRunner, make_env
+
+    if env_id is None:
+        from ray_tpu.rllib.atari import register_synthetic_env
+
+        env_id = register_synthetic_env()
+        conv_filters = conv_filters or ((16, 3, 2), (32, 3, 2))
+    conv_filters = conv_filters or ((32, 8, 4), (64, 4, 2), (64, 3, 1))
+    probe = make_env(env_id)
+    obs_shape = tuple(probe.observation_space.shape)
+    num_actions = int(probe.action_space.n)
+    probe.close()
+    spec = {"obs_shape": obs_shape, "num_actions": num_actions,
+            "module_class": "ray_tpu.rllib.rl_module:ConvActorCriticModule",
+            "conv_filters": conv_filters, "hiddens": tuple(hiddens)}
+    runner = EnvRunner({"env": env_id, "num_envs_per_env_runner": num_envs,
+                        "rollout_fragment_length": steps, "seed": 0}, spec)
+    runner.set_weights(runner.module.init(jax.random.PRNGKey(0)))
+    runner.sample(num_steps=8)  # compile
+    t0 = time.perf_counter()
+    runner.sample(num_steps=steps)
+    dt = time.perf_counter() - t0
+    runner.stop()
+    return {
+        "metric": "rllib_env_steps_per_sec",
+        "value": round(num_envs * steps / dt, 1),
+        "unit": "env-steps/s",
+        "detail": {"env": env_id, "num_envs": num_envs,
+                   "obs_shape": list(obs_shape)},
+    }
+
+
+if __name__ == "__main__":
+    import sys
+
+    env = sys.argv[1] if len(sys.argv) > 1 else None
+    print(json.dumps(benchmark_env_steps(env)))

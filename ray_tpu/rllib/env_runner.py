@@ -18,9 +18,28 @@ import ray_tpu
 from ray_tpu.rllib.episode import SingleAgentEpisode
 
 
-def make_env(env_id: str, env_config: Optional[dict] = None):
+def make_env(env_id, env_config: Optional[dict] = None):
+    """env_id: a gym id, an env-creator callable, or "ALE/..." (routed
+    through the Atari preprocessing pipeline). ray_tpu/-prefixed built-in
+    envs self-register on first use."""
     import gymnasium as gym
 
+    if callable(env_id):
+        return env_id(**(env_config or {}))
+    if isinstance(env_id, str) and env_id.startswith("ALE/"):
+        from ray_tpu.rllib.atari import make_atari_env
+
+        # pipeline knobs route to the wrapper; everything else is a plain
+        # gym.make kwarg (full_action_space, mode, ...)
+        cfg = dict(env_config or {})
+        pipeline = {k: cfg.pop(k)
+                    for k in ("frame_stack", "screen_size", "frameskip")
+                    if k in cfg}
+        return make_atari_env(env_id, **pipeline, env_config=cfg)
+    if isinstance(env_id, str) and env_id.startswith("ray_tpu/"):
+        from ray_tpu.rllib.atari import register_synthetic_env
+
+        register_synthetic_env()
     return gym.make(env_id, **(env_config or {}))
 
 
@@ -107,14 +126,16 @@ class EnvRunner:
                 extra: Dict[str, np.ndarray] = {}
             else:
                 self._key, sub = jax.random.split(self._key)
+                # uint8 image obs ship raw (1 byte/pixel) and normalize
+                # on-device inside the module; everything else goes float32
+                obs_in = (self._obs if self._obs.dtype == np.uint8
+                          else self._obs.astype(np.float32))
                 if explore:
-                    out = self._act(self.params,
-                                    self._obs.astype(np.float32), sub)
+                    out = self._act(self.params, obs_in, sub)
                     extra = {"logp": np.asarray(out["logp"]),
                              "vf_preds": np.asarray(out["vf_preds"])}
                 else:
-                    out = self._act_greedy(
-                        self.params, self._obs.astype(np.float32))
+                    out = self._act_greedy(self.params, obs_in)
                     extra = {}
                 actions = np.asarray(out["actions"])
             if env_actions is None:
@@ -140,6 +161,7 @@ class EnvRunner:
             if len(self._episodes[i]) > 0:
                 frag = self._episodes[i]
                 frag.is_truncated = True
+                frag.is_boundary_fragment = True
                 done_episodes.append(frag)
                 self._episodes[i] = SingleAgentEpisode()
                 self._episodes[i].add_env_reset(self._obs[i])

@@ -17,6 +17,10 @@ class SingleAgentEpisode:
         self.extra: Dict[str, List[Any]] = {}
         self.is_done = False
         self.is_truncated = False
+        # True for fragments cut at a sample() boundary (the episode keeps
+        # running in the env) — distinct from ENV truncation (TimeLimit),
+        # whose return is complete and counts toward episode_return_mean.
+        self.is_boundary_fragment = False
 
     def add_env_reset(self, obs) -> None:
         self.obs.append(np.asarray(obs))

@@ -80,6 +80,83 @@ class DiscreteActorCriticModule:
                 "logits": logits}
 
 
+class ConvActorCriticModule:
+    """Conv torso (Nature-CNN shape family) + policy/value heads for
+    image observations (reference: rllib core/models/configs.py:637
+    CNNEncoderConfig + the torch CNN encoder; here NHWC lax convs so XLA
+    tiles them onto the MXU, bf16-friendly, uint8 obs normalized on-device
+    to keep sample transport at 1 byte/pixel).
+
+    obs: [B, H, W, C] uint8 (or float); conv_filters: (out_ch, kernel,
+    stride) triples, VALID padding.
+    """
+
+    def __init__(self, obs_shape: Sequence[int], num_actions: int,
+                 conv_filters: Sequence[Tuple[int, int, int]] = (
+                     (32, 8, 4), (64, 4, 2), (64, 3, 1)),
+                 hiddens: Sequence[int] = (512,)):
+        self.obs_shape = tuple(obs_shape)
+        self.num_actions = num_actions
+        self.conv_filters = tuple(tuple(f) for f in conv_filters)
+        self.hiddens = tuple(hiddens)
+        # VALID-padding output spatial dims -> flatten width for the dense
+        # stack (shape math here so init needs no tracing).
+        h, w, c = self.obs_shape
+        for _out, k, s in self.conv_filters:
+            h = (h - k) // s + 1
+            w = (w - k) // s + 1
+            if h <= 0 or w <= 0:
+                raise ValueError(
+                    f"conv_filters {conv_filters} reduce a {self.obs_shape}"
+                    " observation below 1x1; use smaller kernels/strides")
+        self._flat_dim = h * w * self.conv_filters[-1][0]
+
+    def init(self, key) -> Dict[str, Any]:
+        params: Dict[str, Any] = {"convs": [], "torso": []}
+        n_conv = len(self.conv_filters)
+        keys = jax.random.split(key, n_conv + len(self.hiddens) + 2)
+        in_ch = self.obs_shape[-1]
+        for i, (out_ch, k, _s) in enumerate(self.conv_filters):
+            fan_in = k * k * in_ch
+            params["convs"].append({
+                "w": jax.random.normal(keys[i], (k, k, in_ch, out_ch))
+                * np.sqrt(2.0 / fan_in),
+                "b": jnp.zeros((out_ch,)),
+            })
+            in_ch = out_ch
+        dims = [self._flat_dim] + list(self.hiddens)
+        for i in range(len(dims) - 1):
+            params["torso"].append(
+                _dense_init(keys[n_conv + i], dims[i], dims[i + 1]))
+        params["pi"] = _dense_init(keys[-2], dims[-1], self.num_actions,
+                                   scale=0.01)
+        params["vf"] = _dense_init(keys[-1], dims[-1], 1, scale=1.0)
+        return params
+
+    def _torso(self, params, obs):
+        x = obs.astype(jnp.float32)
+        if obs.dtype == jnp.uint8:
+            x = x / 255.0
+        for layer, (_out, _k, s) in zip(params["convs"], self.conv_filters):
+            x = jax.lax.conv_general_dilated(
+                x, layer["w"], window_strides=(s, s), padding="VALID",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            x = jax.nn.relu(x + layer["b"])
+        x = x.reshape(x.shape[0], -1)
+        for layer in params["torso"]:
+            x = jax.nn.relu(_dense(layer, x))
+        return x
+
+    def forward(self, params, obs) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        x = self._torso(params, obs)
+        return _dense(params["pi"], x), _dense(params["vf"], x)[..., 0]
+
+    # same RLModule API as DiscreteActorCriticModule
+    forward_inference = DiscreteActorCriticModule.forward_inference
+    forward_exploration = DiscreteActorCriticModule.forward_exploration
+    forward_train = DiscreteActorCriticModule.forward_train
+
+
 class QModule:
     """MLP Q-network for DQN (discrete actions)."""
 
@@ -195,6 +272,11 @@ def resolve_module(module_spec: Dict[str, Any]):
         mod, _, name = cls.rpartition(":")
         cls = getattr(importlib.import_module(mod or __name__), name)
     kwargs = dict(module_spec.get("module_kwargs") or {})
+    if cls is ConvActorCriticModule:
+        return cls(module_spec["obs_shape"], module_spec["num_actions"],
+                   module_spec.get("conv_filters",
+                                   ((32, 8, 4), (64, 4, 2), (64, 3, 1))),
+                   module_spec.get("hiddens", (512,)))
     if cls is DiscreteActorCriticModule:
         return cls(module_spec["obs_dim"], module_spec["num_actions"],
                    module_spec.get("hiddens", (64, 64)))

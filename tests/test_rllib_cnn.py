@@ -1,0 +1,118 @@
+"""Conv/Atari path tests (VERDICT r1 #4): CNN encoder RLModule, image-obs
+plumbing end-to-end through PPO/IMPALA, learning regression on a synthetic
+image env (CPU stand-in for the Atari tuned-example regressions), and an
+env-steps/sec measurement.
+
+Reference: rllib core/models/configs.py:637 (CNNEncoderConfig),
+rllib/benchmarks/ppo/benchmark_atari_ppo.py.
+"""
+
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from ray_tpu.rllib.atari import SyntheticImageEnv, register_synthetic_env
+from ray_tpu.rllib.rl_module import ConvActorCriticModule
+
+SMALL_CONVS = ((16, 3, 2), (32, 3, 2))
+
+
+def test_conv_module_shapes_and_uint8_normalization():
+    mod = ConvActorCriticModule((16, 16, 1), 4, SMALL_CONVS, hiddens=(64,))
+    params = mod.init(jax.random.PRNGKey(0))
+    obs_u8 = np.random.default_rng(0).integers(
+        0, 256, (5, 16, 16, 1), dtype=np.uint8)
+    logits, value = mod.forward(params, obs_u8)
+    assert logits.shape == (5, 4) and value.shape == (5,)
+    # uint8 and its /255 float equivalent must produce identical outputs
+    logits_f, _ = mod.forward(params, obs_u8.astype(np.float32) / 255.0)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(logits_f),
+                               rtol=1e-5, atol=1e-5)
+    # train/exploration APIs shared with the MLP module
+    out = mod.forward_train(params, {"obs": obs_u8,
+                                     "actions": np.zeros(5, np.int32)})
+    assert set(out) >= {"logp", "vf_preds", "entropy", "logits"}
+
+
+def test_conv_filters_validation():
+    with pytest.raises(ValueError, match="below 1x1"):
+        ConvActorCriticModule((8, 8, 1), 4,
+                              conv_filters=((32, 8, 4), (64, 4, 2)))
+
+
+def test_synthetic_env_registration():
+    import gymnasium as gym
+
+    env_id = register_synthetic_env()
+    env = gym.make(env_id)
+    obs, _ = env.reset(seed=0)
+    assert obs.shape == (16, 16, 1) and obs.dtype == np.uint8
+    env.close()
+
+
+def test_ppo_learns_synthetic_image_env():
+    """The conv policy must beat the random baseline (0.25 reward/step)
+    by actually reading the image — the CPU-testable Atari stand-in."""
+    from ray_tpu.rllib.algorithms import PPOConfig
+
+    register_synthetic_env()
+    algo = (PPOConfig()
+            .environment("ray_tpu/SyntheticImage-v0")
+            .env_runners(num_env_runners=0, num_envs_per_env_runner=4,
+                         rollout_fragment_length=64)
+            .training(lr=3e-3, train_batch_size=512, minibatch_size=128,
+                      num_epochs=6, entropy_coeff=0.01, gamma=0.5,
+                      model={"conv_filters": SMALL_CONVS,
+                             "post_fcnet_hiddens": (128,)})
+            .debugging(seed=0)
+            ).build()
+    assert "obs_shape" in algo.module_spec  # conv path selected
+    best = 0.0
+    for _ in range(12):
+        result = algo.train()
+        # episode return over 32 steps; random play gives ~8, optimal 32
+        best = max(best, result.get("episode_return_mean", 0.0))
+    algo.stop()
+    assert best > 14.0, f"conv PPO failed to learn: best return {best}"
+
+
+def test_impala_trains_image_env_smoke():
+    from ray_tpu.rllib.algorithms import IMPALAConfig
+
+    register_synthetic_env()
+    algo = (IMPALAConfig()
+            .environment("ray_tpu/SyntheticImage-v0")
+            .env_runners(num_env_runners=0, num_envs_per_env_runner=2,
+                         rollout_fragment_length=32)
+            .training(lr=5e-4, train_batch_size=128,
+                      model={"conv_filters": SMALL_CONVS,
+                             "post_fcnet_hiddens": (64,)})
+            .debugging(seed=0)
+            ).build()
+    for _ in range(2):
+        result = algo.train()
+    algo.stop()
+    assert result["num_env_steps_sampled_lifetime"] > 0
+    assert "episode_return_mean" in result
+
+
+def test_env_steps_per_sec_measurement():
+    """env-steps/sec with the conv policy in the loop — the metric the
+    Atari PPO benchmark records (committed via ray_perf/BENCH detail)."""
+    from ray_tpu.rllib.env_runner import EnvRunner
+
+    spec = {"obs_shape": (16, 16, 1), "num_actions": 4,
+            "module_class": "ray_tpu.rllib.rl_module:ConvActorCriticModule",
+            "conv_filters": SMALL_CONVS, "hiddens": (64,)}
+    runner = EnvRunner({"env": "ray_tpu/SyntheticImage-v0",
+                        "num_envs_per_env_runner": 8,
+                        "rollout_fragment_length": 64, "seed": 0}, spec)
+    runner.set_weights(runner.module.init(jax.random.PRNGKey(0)))
+    runner.sample(num_steps=8)  # compile the act step
+    t0 = time.perf_counter()
+    runner.sample(num_steps=64)
+    dt = time.perf_counter() - t0
+    rate = 8 * 64 / dt
+    assert rate > 200, f"only {rate:.0f} env-steps/s"
