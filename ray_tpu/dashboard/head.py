@@ -22,7 +22,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
 
-from ray_tpu._private.rpc import EventLoopThread, RpcClient
+from ray_tpu._private.rpc import ClientPool, EventLoopThread, RpcClient
 
 logger = logging.getLogger(__name__)
 
@@ -33,6 +33,7 @@ class DashboardHead:
         self.gcs_address = gcs_address
         self._lt = EventLoopThread("dashboard")
         self._gcs = RpcClient(gcs_address, self._lt)
+        self._raylets = ClientPool(self._lt)  # reused across /api/logs calls
         self._jobs_lock = threading.Lock()
         self._jobs_sdk = None
         dash = self
@@ -163,6 +164,15 @@ class DashboardHead:
             return
         if path == "/":
             self._respond(req, self._index_html(), "text/html")
+        elif path == "/api/logs":
+            # worker log tails, fanned out over each raylet's
+            # tail_worker_logs RPC (reference: dashboard log routes)
+            from urllib.parse import parse_qs, urlparse
+
+            q = parse_qs(urlparse(req.path).query)
+            self._json(req, self._worker_logs(
+                lines=int(q.get("lines", ["100"])[0]),
+                node_id=(q.get("node_id", [None])[0])))
         elif path == "/metrics":
             self._respond(req, self._metrics_text(),
                           "text/plain; version=0.0.4")
@@ -193,6 +203,26 @@ class DashboardHead:
         self._respond(req, json.dumps(obj, default=str), "application/json")
 
     # -- data ----------------------------------------------------------------
+
+    def _worker_logs(self, lines: int = 100,
+                     node_id: Optional[str] = None) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for n in self._gcs.call("get_all_node_info", {}, timeout=10):
+            if not n.alive:
+                continue
+            nid = n.node_id.hex()
+            if node_id and not nid.startswith(node_id):
+                continue
+            try:
+                # short per-node timeout: one wedged raylet must not stall
+                # the whole fan-out (calls are sequential on this thread)
+                reply = self._raylets.get(n.raylet_address).call(
+                    "tail_worker_logs", {"lines": lines}, timeout=5)
+            except Exception as e:  # noqa: BLE001 — report per-node failure
+                out[nid] = {"error": str(e)}
+                continue
+            out[nid] = {str(pid): info for pid, info in reply.items()}
+        return out
 
     def _cluster_status(self) -> Dict[str, Any]:
         load = self._gcs.call("get_cluster_load", {}, timeout=10)
@@ -301,5 +331,6 @@ class DashboardHead:
     def stop(self) -> None:
         self._httpd.shutdown()
         self._httpd.server_close()
+        self._raylets.close_all()
         self._gcs.close()
         self._lt.stop()

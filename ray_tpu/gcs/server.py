@@ -352,6 +352,8 @@ class GcsServer:
         self._server.register("subscribe", self._handle_subscribe)
         self._server.register("unsubscribe", self._handle_unsubscribe)
         self._server.register("gcs_ping", self._handle_ping)
+        self._server.register("publish_logs", self._handle_publish_logs)
+        self._server.register("report_error", self._handle_report_error)
         self.address = self._server.start(port)
         self._health_task = self._lt.submit(self.node_manager.health_check_loop())
         return self.address
@@ -369,6 +371,20 @@ class GcsServer:
 
     async def _handle_ping(self, payload):
         return {"status": "ok", "time": time.time()}
+
+    async def _handle_publish_logs(self, payload):
+        """Raylet log monitors push worker-log batches here; fan out to
+        every subscribed driver (reference: the LOG pubsub channel that
+        worker.py:2003 print_worker_logs consumes)."""
+        self.publisher.publish(ps.LOG_CHANNEL, payload.get("node"), payload)
+        return True
+
+    async def _handle_report_error(self, payload):
+        """Task/actor errors pushed by workers; fan out to drivers
+        (reference: ERROR channel, worker.py:2115 listen_error_messages)."""
+        self.publisher.publish(
+            ps.ERROR_CHANNEL, payload.get("job_id"), payload)
+        return True
 
     def stop(self):
         if self._health_task is not None:

@@ -36,6 +36,8 @@ class WorkerHandle:
     lease_task_id = None
     is_driver: bool = False
     needs_accelerator: bool = False
+    log_path: str = ""  # stdout+stderr file (tailed by the raylet monitor)
+    last_job_hex: Optional[str] = None  # job of the latest lease (log attribution)
     # Runtime-env hash applied in this worker ("" = pristine). A worker that
     # ran under an env can ONLY serve that env again — the reference
     # dedicates workers per runtime env; returning one to the general pool
@@ -119,16 +121,16 @@ class WorkerPool:
             "--gcs-address", self._gcs_address,
             "--node-id", self._node_id_hex,
         ]
-        logfile = open(
-            os.path.join(self._log_dir, f"worker-{time.monotonic_ns()}.log"), "ab"
-        )
+        log_path = os.path.join(
+            self._log_dir, f"worker-{time.monotonic_ns()}.log")
+        logfile = open(log_path, "ab")
         proc = subprocess.Popen(
             cmd, stdout=logfile, stderr=subprocess.STDOUT, env=env,
             start_new_session=True,
         )
         handle = WorkerHandle(
             pid=proc.pid, proc=proc, state="starting",
-            needs_accelerator=needs_accelerator,
+            needs_accelerator=needs_accelerator, log_path=log_path,
         )
         self._workers[proc.pid] = handle
 

@@ -27,7 +27,7 @@ from typing import Any, Dict, List, Optional
 
 from ray_tpu.exceptions import RuntimeEnvSetupError
 
-_SUPPORTED = {"env_vars", "working_dir", "py_modules", "pip", "conda",
+_SUPPORTED = {"env_vars", "working_dir", "py_modules", "pip", "uv", "conda",
               "config", "worker_process_setup_hook"}
 _PKG_PREFIX = b"pkg:"
 _CACHE_ROOT = "/tmp/rt_session/runtime_envs"
@@ -106,6 +106,11 @@ def package_local_dirs(env: Optional[dict], kv_put) -> Optional[dict]:
         env["working_dir"] = upload(env["working_dir"])
     if env.get("py_modules"):
         env["py_modules"] = [upload(p) for p in env["py_modules"]]
+    for field in ("pip", "uv"):
+        # requirements-file form resolves HERE (driver side) — the path
+        # does not exist on worker nodes
+        if isinstance(env.get(field), str):
+            env[field] = _read_requirements(env[field])
     return env
 
 
@@ -131,6 +136,92 @@ def _materialize(uri: str, kv_get) -> str:
     return dest
 
 
+# ------------------------------------------------------------- pip/uv venvs
+
+
+def _normalize_pip_spec(spec) -> tuple:
+    """pip field forms (reference: runtime_env/pip.py): ["pkg", ...] or
+    {"packages": [...], "pip_install_options": [...]} -> (packages, opts)."""
+    if isinstance(spec, (list, tuple)):
+        return [str(p) for p in spec], []
+    if isinstance(spec, dict):
+        return ([str(p) for p in spec.get("packages", [])],
+                [str(o) for o in spec.get("pip_install_options", [])])
+    raise RuntimeEnvSetupError(f"invalid pip spec: {spec!r}")
+
+
+def _read_requirements(path: str) -> List[str]:
+    """requirements.txt -> package list. DRIVER-side only: the path is
+    local to wherever the spec was written, not to worker nodes."""
+    with open(path) as f:
+        lines = [ln.strip() for ln in f]
+    return [ln for ln in lines if ln and not ln.startswith("#")]
+
+
+def build_pip_env(spec, use_uv: bool = False) -> str:
+    """Build (or reuse) a venv for a pip/uv spec; returns its site-packages.
+
+    Reference: _private/runtime_env/agent/runtime_env_agent.py — per-env
+    virtualenvs built on the node, cached by content hash. Built with
+    --system-site-packages so baked-in deps (numpy, jax, ...) resolve
+    without reinstall; a `.ready` marker commits the cache entry, and
+    failures surface as RuntimeEnvSetupError (the task fails, the worker
+    survives).
+    """
+    import shutil
+    import subprocess
+
+    packages, options = _normalize_pip_spec(spec)
+    if not packages:
+        raise RuntimeEnvSetupError("pip spec lists no packages")
+    key = hashlib.sha1(json.dumps(
+        [packages, options, use_uv], sort_keys=True).encode()).hexdigest()[:16]
+    venv_dir = os.path.join(_CACHE_ROOT, "venvs", key)
+    site = os.path.join(
+        venv_dir, "lib",
+        f"python{sys.version_info[0]}.{sys.version_info[1]}",
+        "site-packages")
+    ready = os.path.join(venv_dir, ".ready")
+    if os.path.exists(ready):
+        return site
+
+    if use_uv and shutil.which("uv") is None:
+        raise RuntimeEnvSetupError(
+            "runtime_env['uv'] requires the uv binary, which is not "
+            "installed; use runtime_env['pip']")
+    tmp = f"{venv_dir}.tmp.{os.getpid()}"
+    shutil.rmtree(tmp, ignore_errors=True)
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "venv", "--system-site-packages", tmp],
+            capture_output=True, text=True, timeout=300)
+        if proc.returncode != 0:
+            raise RuntimeEnvSetupError(f"venv creation failed: "
+                                       f"{proc.stderr[-2000:]}")
+        py = os.path.join(tmp, "bin", "python")
+        if use_uv:
+            cmd = ["uv", "pip", "install", "--python", py,
+                   *options, *packages]
+        else:
+            cmd = [py, "-m", "pip", "install", "--disable-pip-version-check",
+                   *options, *packages]
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=1800)
+        if proc.returncode != 0:
+            raise RuntimeEnvSetupError(
+                f"pip install failed for {packages}: "
+                f"{(proc.stderr or proc.stdout)[-2000:]}")
+        with open(os.path.join(tmp, ".ready"), "w") as f:
+            f.write("ok")
+        try:
+            os.rename(tmp, venv_dir)
+        except OSError:  # lost the build race: another worker's env wins
+            shutil.rmtree(tmp, ignore_errors=True)
+        return site
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 # ---------------------------------------------------------------- worker side
 
 
@@ -148,12 +239,19 @@ def setup_runtime_env(env: Optional[dict], kv_get) -> Optional[RuntimeEnvContext
     if not env:
         return None
     ctx = RuntimeEnvContext(env)
-    for field in ("pip", "conda"):
+    if env.get("conda"):
+        raise RuntimeEnvSetupError(
+            "runtime_env['conda'] requires a conda binary, which this "
+            "image does not ship; use runtime_env['pip'] (venv-based) "
+            "instead")
+    for field in ("pip", "uv"):
         if env.get(field):
-            raise RuntimeEnvSetupError(
-                f"runtime_env[{field!r}] needs package installation, which "
-                "is unavailable in this zero-egress image; bake dependencies "
-                "into the base environment instead")
+            site = build_pip_env(env[field], use_uv=(field == "uv"))
+            # the worker process already runs; the env's site-packages
+            # prepends to sys.path (workers are DEDICATED per env hash, so
+            # this never leaks across envs)
+            sys.path.insert(0, site)
+            ctx.paths.append(site)
     for k, v in (env.get("env_vars") or {}).items():
         os.environ[k] = v
     if env.get("working_dir"):

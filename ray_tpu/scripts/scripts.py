@@ -277,6 +277,42 @@ def cmd_serve(args) -> int:
     return 0
 
 
+def cmd_logs(args) -> int:
+    """Tail worker logs across the cluster (reference: `ray logs` /
+    dashboard log routes; data comes from each raylet's
+    tail_worker_logs RPC over the live cluster)."""
+    ray_tpu = _connect(args)
+    from ray_tpu._raylet import get_core_worker
+
+    cw = get_core_worker()
+    nodes = cw._gcs.call("get_all_node_info", {})
+    shown = 0
+    for n in nodes:
+        if not n.alive:
+            continue
+        if args.node_id and not n.node_id.hex().startswith(args.node_id):
+            continue
+        try:
+            reply = cw._peers.get(n.raylet_address).call(
+                "tail_worker_logs",
+                {"pid": args.pid, "lines": args.lines}, timeout=30)
+        except Exception as e:  # noqa: BLE001
+            print(f"node {n.node_id.hex()[:8]}: unreachable ({e})")
+            continue
+        for pid, info in sorted(reply.items()):
+            if not info["lines"] and not args.all:
+                continue
+            print(f"--- node {n.node_id.hex()[:8]} pid={pid} "
+                  f"state={info['state']} ({info['path']})")
+            for line in info["lines"]:
+                print(f"    {line}")
+            shown += 1
+    if shown == 0:
+        print("no worker logs found")
+    ray_tpu.shutdown()
+    return 0
+
+
 def cmd_stack(args) -> int:
     """Dump python stacks of this node's worker processes (reference: ray
     stack — scripts.py:1833; py-spy there, SIGUSR1+faulthandler here: every
@@ -420,6 +456,15 @@ def main(argv=None) -> int:
     sp.add_argument("config", nargs="?", help="JSON config (deploy)")
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_serve)
+
+    sp = sub.add_parser("logs", help="tail worker logs across the cluster")
+    sp.add_argument("--address")
+    sp.add_argument("--pid", type=int, help="only this worker pid")
+    sp.add_argument("--node-id", help="node id (prefix) filter")
+    sp.add_argument("--lines", type=int, default=50)
+    sp.add_argument("--all", action="store_true",
+                    help="include workers with empty logs")
+    sp.set_defaults(fn=cmd_logs)
 
     sp = sub.add_parser("stack", help="dump python stacks of node workers")
     sp.add_argument("--address")

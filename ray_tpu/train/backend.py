@@ -49,6 +49,9 @@ class JaxConfig(BackendConfig):
     distributed: bool = False
     coordinator_port: int = 0
     platform: Optional[str] = None  # force e.g. "cpu" in tests
+    # Applied in each worker BEFORE its first jax import (e.g. XLA_FLAGS
+    # to fake per-process device counts in multi-process CPU tests).
+    env_vars: Optional[dict] = None
 
     @property
     def backend_cls(self):
@@ -64,9 +67,12 @@ def _find_free_port() -> int:
 
 
 def _init_jax_worker(platform: Optional[str], coordinator: Optional[str],
-                     world_size: int, rank: int) -> str:
+                     world_size: int, rank: int,
+                     env_vars: Optional[dict] = None) -> str:
     import os
 
+    for k, v in (env_vars or {}).items():
+        os.environ[k] = v
     if platform:
         os.environ["JAX_PLATFORMS"] = platform
     if coordinator is not None:
@@ -95,7 +101,7 @@ class JaxBackend(Backend):
         platforms = [
             worker_group.workers[rank].execute.remote(
                 _init_jax_worker, backend_config.platform, coordinator,
-                world, rank)
+                world, rank, backend_config.env_vars)
             for rank in range(world)
         ]
         import ray_tpu

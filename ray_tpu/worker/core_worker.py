@@ -20,6 +20,7 @@ import asyncio
 import hashlib
 import logging
 import os
+import sys
 import threading
 import time
 from collections import deque
@@ -154,6 +155,7 @@ class CoreWorker:
         self._put_index = 0
         self._put_lock = threading.Lock()
         self._subscriptions: Dict[str, list] = {}
+        self._printed_errors: set = set()  # ERROR-channel dedup (task ids)
         self._node_addr_cache: Dict[NodeID, str] = {}
         self._pg_cache: Dict[PlacementGroupID, Any] = {}
         self._task_events: deque = deque(maxlen=10_000)
@@ -206,6 +208,16 @@ class CoreWorker:
             "subscribe",
             {"channel": ps.NODE_CHANNEL, "subscriber_address": self.address_str},
         )
+        if self.mode == "driver" and CONFIG.log_to_driver:
+            # worker stdout/stderr + error reports stream to the driver
+            # console (reference: worker.py:2003 print_worker_logs /
+            # :2115 listen_error_messages)
+            self.subscribe(ps.LOG_CHANNEL, self._on_worker_logs)
+            self.subscribe(ps.ERROR_CHANNEL, self._on_error_message)
+            for chan in (ps.LOG_CHANNEL, ps.ERROR_CHANNEL):
+                self._gcs.call("subscribe", {
+                    "channel": chan,
+                    "subscriber_address": self.address_str})
 
     def _connect_plasma(self, store_socket: Optional[str]) -> None:
         if not store_socket or not CONFIG.enable_plasma_store:
@@ -749,7 +761,19 @@ class CoreWorker:
                 break
             fair = -(-len(st.pending) // (len(idle) - i))  # ceil split
             n = min(cap_batch, fair, len(st.pending))
-            specs = [st.pending.popleft() for _ in range(n)]
+            # A spec with by-REFERENCE args never joins a batch: its args
+            # may be returns of tasks earlier in the same batch, whose
+            # values reach this owner only in the batch's single reply —
+            # the executing worker would long-poll us for them and
+            # deadlock the batch (chained `f.remote(f.remote(...))`).
+            specs = []
+            while len(specs) < n and st.pending:
+                spec = st.pending[0]
+                if not self._batchable(spec):
+                    if not specs:
+                        specs.append(st.pending.popleft())  # ship alone
+                    break
+                specs.append(st.pending.popleft())
             lease.busy = True
             asyncio.ensure_future(self._push(key, lease, specs))
         # Request more leases if there is unassigned work.
@@ -878,6 +902,14 @@ class CoreWorker:
         while st.pending:
             spec = st.pending.popleft()
             self._store_error_for_task(spec, error)
+
+    @staticmethod
+    def _batchable(spec: TaskSpec) -> bool:
+        """Inline-args-only specs may share a batched push (see _pump)."""
+        if not all(a.is_inline for a in spec.args):
+            return False
+        kwargs = getattr(spec, "kwarg_specs", None) or {}
+        return all(a.is_inline for a in kwargs.values())
 
     async def _push(self, key, lease: _Lease, specs: List[TaskSpec]):
         st = self._key_states[key]
@@ -1107,6 +1139,47 @@ class CoreWorker:
         rec.max_task_retries = max_task_retries
         self._ensure_actor_subscription()
         return info.actor_id
+
+    def _on_worker_logs(self, key, batch):
+        """LOG channel: print worker output on the driver console (only
+        lines attributed to THIS driver's job — multi-job clusters must
+        not interleave consoles)."""
+        batch_job = batch.get("job_id")
+        if (batch_job is not None and self.job_id
+                and batch_job != self.job_id.hex()):
+            return
+        pid = batch.get("pid")
+        node = (batch.get("node") or "")[:8]
+        prefix = f"(worker pid={pid}, node={node})"
+        out = sys.stderr
+        for line in batch.get("lines", []):
+            print(f"{prefix} {line}", file=out)
+
+    def _on_error_message(self, key, err):
+        """ERROR channel: print this job's task errors once per task (the
+        same error also surfaces at ray.get — dedup keeps retries quiet)."""
+        if self.job_id and err.get("job_id") != self.job_id.hex():
+            return
+        task_id = err.get("task_id")
+        if task_id in self._printed_errors:
+            return
+        self._printed_errors.add(task_id)
+        if len(self._printed_errors) > 10_000:
+            self._printed_errors.clear()
+        print(f"(task error) {err.get('name')}: {err.get('message')}",
+              file=sys.stderr)
+
+    def report_error(self, spec, err: BaseException) -> None:
+        """Fire-and-forget error publication to the GCS ERROR channel."""
+        try:
+            self._lt.submit(self._gcs.send_async("report_error", {
+                "job_id": spec.job_id.hex() if spec.job_id else None,
+                "task_id": spec.task_id.hex(),
+                "name": spec.function_name,
+                "message": str(err),
+            }))
+        except Exception:  # noqa: BLE001 — reporting must not mask the error
+            pass
 
     def _on_node_event(self, key, info):
         if info.alive:

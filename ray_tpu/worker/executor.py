@@ -250,6 +250,9 @@ class Executor:
             err = exc
         else:
             err = RayTaskError.from_exception(spec.function_name, exc)
+        # stream the failure to subscribed drivers (ERROR pubsub channel) —
+        # fire-and-forget, the reply below is the authoritative path
+        self.cw.report_error(spec, exc)
         s = ser.serialize(err)
         return {
             "status": "error",

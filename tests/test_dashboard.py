@@ -95,6 +95,29 @@ def test_rest_job_submission_api(dash_cluster):
     assert e400.value.code == 400
 
 
+def test_dashboard_logs_route(dash_cluster):
+    import time
+
+    import ray_tpu
+
+    @ray_tpu.remote
+    def speak():
+        print("dash-log-line-77")
+        return 1
+
+    assert ray_tpu.get(speak.remote(), timeout=60) == 1
+    base = dash_cluster.dashboard_url
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        logs = json.loads(_get(base + "/api/logs?lines=50"))
+        text = json.dumps(logs)
+        if "dash-log-line-77" in text:
+            break
+        time.sleep(0.5)
+    else:
+        raise AssertionError(f"log line never appeared: {logs}")
+
+
 def test_dashboard_prometheus_metrics(dash_cluster):
     from ray_tpu.util.metrics import Counter
 

@@ -1,0 +1,102 @@
+"""Worker→driver log/error streaming (VERDICT r1 #6).
+
+Reference: python/ray/_private/log_monitor.py:134 (per-node tail →
+LOG pubsub), worker.py:2115 listen_error_messages / :2003
+print_worker_logs. Here the raylet tails its workers' files and drivers
+subscribe to the LOG/ERROR channels.
+"""
+
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+
+
+def _wait_for(predicate, timeout=20.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.2)
+    return False
+
+
+def test_worker_prints_reach_driver(ray_start_regular, capfd):
+    @ray_tpu.remote
+    def chatty(i):
+        print(f"hello-from-worker-{i}")
+        return i
+
+    assert ray_tpu.get([chatty.remote(i) for i in range(3)],
+                       timeout=60) == [0, 1, 2]
+
+    def seen():
+        err = capfd.readouterr().err
+        seen.buf += err
+        return all(f"hello-from-worker-{i}" in seen.buf for i in range(3))
+
+    seen.buf = ""
+    assert _wait_for(seen), f"worker prints never reached driver: {seen.buf!r}"
+    # lines carry the worker attribution prefix
+    assert "(worker pid=" in seen.buf
+
+
+def test_task_errors_stream_to_driver(ray_start_regular, capfd):
+    @ray_tpu.remote(max_retries=0)
+    def boom():
+        raise ValueError("deliberate-failure-xyz")
+
+    ref = boom.remote()
+    with pytest.raises(Exception):
+        ray_tpu.get(ref, timeout=60)
+
+    def seen():
+        seen.buf += capfd.readouterr().err
+        return "deliberate-failure-xyz" in seen.buf
+
+    seen.buf = ""
+    assert _wait_for(seen), "task error never streamed to driver"
+    assert "(task error)" in seen.buf
+
+
+def test_tail_worker_logs_rpc_and_cli(ray_start_regular, capsys):
+    @ray_tpu.remote
+    def noisy():
+        print("tailme-123")
+        sys.stdout.flush()
+        return 1
+
+    assert ray_tpu.get(noisy.remote(), timeout=60) == 1
+
+    from ray_tpu._raylet import get_core_worker
+
+    cw = get_core_worker()
+    nodes = cw._gcs.call("get_all_node_info", {})
+
+    def tail_has_line():
+        for n in nodes:
+            if not n.alive:
+                continue
+            reply = cw._peers.get(n.raylet_address).call(
+                "tail_worker_logs", {"lines": 50}, timeout=30)
+            for info in reply.values():
+                if any("tailme-123" in ln for ln in info["lines"]):
+                    return True
+        return False
+
+    assert _wait_for(tail_has_line), "tail_worker_logs never saw the line"
+
+    from ray_tpu.scripts.scripts import cmd_logs
+
+    class Args:
+        address = None
+        pid = None
+        node_id = None
+        lines = 50
+        all = False
+
+    cmd_logs(Args())
+    out = capsys.readouterr().out
+    assert "tailme-123" in out

@@ -69,16 +69,85 @@ def test_working_dir_ships_local_files(ray_start_regular, tmp_path):
     assert ray_tpu.get(use.remote()) == (123, "payload")
 
 
-def test_pip_rejected_with_clear_error(ray_start_regular):
+def _make_wheel(dest_dir, name="rtenv_demo_pkg", version="0.1",
+                body="VALUE = 42\n") -> str:
+    """Handcraft a minimal pure-python wheel (zero-egress: no build
+    backend, no index — pip installs it via --no-index --find-links)."""
+    import base64
+    import hashlib
+    import zipfile
+
+    whl = os.path.join(dest_dir, f"{name}-{version}-py3-none-any.whl")
+    dist = f"{name}-{version}.dist-info"
+    files = {
+        f"{name}/__init__.py": body,
+        f"{dist}/METADATA": (f"Metadata-Version: 2.1\nName: {name}\n"
+                             f"Version: {version}\n"),
+        f"{dist}/WHEEL": ("Wheel-Version: 1.0\nGenerator: test\n"
+                          "Root-Is-Purelib: true\nTag: py3-none-any\n"),
+    }
+    record_lines = []
+    for path, content in files.items():
+        digest = base64.urlsafe_b64encode(
+            hashlib.sha256(content.encode()).digest()).rstrip(b"=").decode()
+        record_lines.append(
+            f"{path},sha256={digest},{len(content.encode())}")
+    record_lines.append(f"{dist}/RECORD,,")
+    with zipfile.ZipFile(whl, "w") as z:
+        for path, content in files.items():
+            z.writestr(path, content)
+        z.writestr(f"{dist}/RECORD", "\n".join(record_lines) + "\n")
+    return whl
+
+
+def test_pip_env_installs_package_driver_lacks(ray_start_regular, tmp_path):
+    """VERDICT r1 #8: a task imports a package the driver cannot import,
+    via a per-env venv built on the worker-pool path."""
+    import ray_tpu
+
+    _make_wheel(str(tmp_path))
+    with pytest.raises(ImportError):
+        import rtenv_demo_pkg  # noqa: F401 — must NOT exist in the driver
+
+    env = {"pip": {"packages": ["rtenv_demo_pkg"],
+                   "pip_install_options": [
+                       "--no-index", f"--find-links={tmp_path}"]}}
+
+    @ray_tpu.remote(runtime_env=env)
+    def use_pkg():
+        import rtenv_demo_pkg
+
+        return rtenv_demo_pkg.VALUE
+
+    assert ray_tpu.get(use_pkg.remote(), timeout=120) == 42
+    # venv is cached by env hash: second task reuses it
+    assert ray_tpu.get(use_pkg.remote(), timeout=120) == 42
+
+
+def test_pip_install_failure_surfaces_setup_error(ray_start_regular):
     import ray_tpu
     from ray_tpu.exceptions import RuntimeEnvSetupError
 
-    @ray_tpu.remote(runtime_env={"pip": ["some-package"]})
+    @ray_tpu.remote(runtime_env={"pip": {
+        "packages": ["definitely-not-a-real-package-xyz"],
+        "pip_install_options": ["--no-index"]}})
     def f():
         return 1
 
     with pytest.raises(RuntimeEnvSetupError):
-        ray_tpu.get(f.remote())
+        ray_tpu.get(f.remote(), timeout=120)
+
+
+def test_conda_rejected_with_clear_error(ray_start_regular):
+    import ray_tpu
+    from ray_tpu.exceptions import RuntimeEnvSetupError
+
+    @ray_tpu.remote(runtime_env={"conda": {"dependencies": ["x"]}})
+    def f():
+        return 1
+
+    with pytest.raises(RuntimeEnvSetupError):
+        ray_tpu.get(f.remote(), timeout=120)
 
 
 def test_job_level_runtime_env(tmp_path):

@@ -120,6 +120,24 @@ def test_many_actors(ray_start_regular):
         ray_tpu.kill(a)
 
 
+def test_chained_tasks_never_batch_deadlock(ray_start_regular):
+    """Dependency chains must not share a batched push: a task whose arg is
+    an earlier batch member's return would long-poll the owner for a value
+    that only arrives in the batch's single reply (regression: deadlock
+    exposed when driver-loop load let the backlog build)."""
+
+    @ray_tpu.remote
+    def inc(x):
+        return x + 1
+
+    # warm the key's latency EMA so batching would engage if allowed
+    ray_tpu.get([inc.remote(i) for i in range(64)], timeout=120)
+    ref = inc.remote(0)
+    for _ in range(30):
+        ref = inc.remote(ref)
+    assert ray_tpu.get(ref, timeout=60) == 31
+
+
 def test_many_args_and_returns(ray_start_regular):
     """Reference envelope: 10k+ object args to one task, 3k+ returns —
     CI-scaled to 1k args / 500 returns."""
