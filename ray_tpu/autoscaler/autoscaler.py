@@ -45,9 +45,9 @@ class StandardAutoscaler:
 
     # -- helpers -------------------------------------------------------------
 
-    def _counts_by_type(self) -> Dict[str, int]:
+    def _counts_by_type(self, alive_ids) -> Dict[str, int]:
         counts: Dict[str, int] = {}
-        for nid in self.provider.non_terminated_nodes():
+        for nid in alive_ids:
             t = self.provider.node_tags(nid).get(TAG_NODE_TYPE, "")
             counts[t] = counts.get(t, 0) + 1
         return counts
@@ -70,7 +70,21 @@ class StandardAutoscaler:
         for bundle in load.get("pending_pg_bundles", []):
             demands.append((dict(bundle), 1))
 
-        counts = self._counts_by_type()
+        # ONE provider scan per reconcile cycle (batching providers flush
+        # their previous cycle's request on scan — a second scan mid-cycle
+        # would submit half-built intent)
+        alive_ids = self.provider.non_terminated_nodes()
+        counts = self._counts_by_type(alive_ids)
+        # in-flight launches (declarative providers): count as supply so a
+        # slice that takes minutes to boot isn't re-launched every cycle
+        pending_fn = getattr(self.provider, "pending_nodes", None)
+        pending: Dict[str, int] = pending_fn() if pending_fn else {}
+        pending_avail = []
+        for t, num in pending.items():
+            counts[t] = counts.get(t, 0) + num
+            res = (self.config.get("node_types", {})
+                   .get(t, {}).get("resources") or {})
+            pending_avail.extend(dict(res) for _ in range(num))
 
         # 1. min_workers floor per type.
         for name, cfg in self.config.get("node_types", {}).items():
@@ -79,9 +93,11 @@ class StandardAutoscaler:
                 self._launch(name, deficit)
                 counts[name] = counts.get(name, 0) + deficit
 
-        # 2. demand-driven scale-up (bin-packing over free capacity).
+        # 2. demand-driven scale-up (bin-packing over free capacity,
+        #    including the capacity of nodes still provisioning).
         if demands:
             avail = [dict(n["available"]) for n in nodes.values() if n["alive"]]
+            avail.extend(pending_avail)
             to_launch = get_nodes_to_launch(
                 self.config.get("node_types", {}), avail, demands, counts)
             total_cap = self.config.get("max_workers", 2**31)
@@ -96,11 +112,18 @@ class StandardAutoscaler:
         # 3. idle-node termination (whole-node idle only; respects
         #    min_workers; never touches the head node — provider nodes only).
         now = time.monotonic()
-        alive_ids = self.provider.non_terminated_nodes()
         by_gcs_id = {}
         raylet_id = getattr(self.provider, "raylet_node_id", None)
+        # cloud providers can't map pods to GCS nodes directly; raylets on
+        # k8s advertise their pod name as a node label (ray.io/pod-name)
+        # and join here
+        by_pod_label = {
+            info.get("labels", {}).get("ray.io/pod-name"): gid
+            for gid, info in nodes.items()}
         for pid in alive_ids:
             gid = raylet_id(pid) if raylet_id else None
+            if gid is None:
+                gid = by_pod_label.get(pid)
             if gid is not None:
                 by_gcs_id[pid] = gid
         for pid in alive_ids:

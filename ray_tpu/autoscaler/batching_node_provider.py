@@ -1,0 +1,147 @@
+"""Declarative batching NodeProvider base.
+
+Reference behavior: ray python/ray/autoscaler/batching_node_provider.py:1 —
+imperative create/terminate calls from the autoscaler collect into ONE
+scale request per reconcile cycle, submitted as a declarative patch (the
+kuberay pattern: set each worker group's replica count + the precise pods
+to delete, let the operator converge). This suits cloud APIs where node
+lifecycle is owned by a controller rather than by individual VM calls —
+GKE TPU slices especially, where a multi-host slice scales as one unit.
+
+Subclasses implement two methods:
+- get_node_data() -> {node_id: NodeData}: current cloud view.
+- submit_scale_request(req): apply the desired counts + deletions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Dict, List, Optional, Set
+
+from ray_tpu.autoscaler.node_provider import (
+    STATUS_UP,
+    TAG_NODE_STATUS,
+    TAG_NODE_TYPE,
+    NodeProvider,
+)
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class NodeData:
+    node_type: str
+    status: str = STATUS_UP
+    ip: str = ""
+
+
+@dataclasses.dataclass
+class ScaleRequest:
+    desired: Dict[str, int] = dataclasses.field(default_factory=dict)
+    workers_to_delete: Set[str] = dataclasses.field(default_factory=set)
+
+
+class BatchingNodeProvider(NodeProvider):
+    def __init__(self, provider_config: dict, cluster_name: str):
+        super().__init__(provider_config, cluster_name)
+        self._node_data: Dict[str, NodeData] = {}
+        self._scale: ScaleRequest = ScaleRequest()
+        self._dirty = False
+        # last SUBMITTED desired counts: the declarative intent the cloud
+        # controller is still converging toward. Fresh scale requests start
+        # from this, not from observed pods — otherwise a scan between
+        # submit and pod creation would read 0 observed and the next flush
+        # would cancel the in-flight scale-up (TPU slices provision in
+        # minutes; the reconcile period is seconds).
+        self._submitted_desired: Optional[Dict[str, int]] = None
+
+    # -- abstract ------------------------------------------------------------
+
+    def get_node_data(self) -> Dict[str, NodeData]:
+        raise NotImplementedError
+
+    def submit_scale_request(self, req: ScaleRequest) -> None:
+        raise NotImplementedError
+
+    # -- NodeProvider API ----------------------------------------------------
+
+    def non_terminated_nodes(self, tag_filters: Optional[dict] = None
+                             ) -> List[str]:
+        # Submit the previous cycle's accumulated request as one batch,
+        # then refresh the view (reference: flush-on-next-scan semantics).
+        if self._dirty:
+            logger.info("submitting scale request: desired=%s delete=%s",
+                        self._scale.desired,
+                        sorted(self._scale.workers_to_delete))
+            self.submit_scale_request(self._scale)
+            self._submitted_desired = dict(self._scale.desired)
+            self._dirty = False
+        self._node_data = self.get_node_data()
+        base = (dict(self._submitted_desired)
+                if self._submitted_desired is not None
+                else self._count_types())
+        # deletions already converged drop out of the carry-over set
+        pending_delete = {
+            nid for nid in self._scale.workers_to_delete
+            if nid in self._node_data}
+        self._scale = ScaleRequest(desired=base,
+                                   workers_to_delete=pending_delete)
+        out = []
+        for nid, data in self._node_data.items():
+            tags = {TAG_NODE_TYPE: data.node_type,
+                    TAG_NODE_STATUS: data.status}
+            if all(tags.get(k) == v
+                   for k, v in (tag_filters or {}).items()):
+                out.append(nid)
+        return out
+
+    def _count_types(self) -> Dict[str, int]:
+        counts: Dict[str, int] = {}
+        for data in self._node_data.values():
+            counts[data.node_type] = counts.get(data.node_type, 0) + 1
+        return counts
+
+    def node_tags(self, node_id: str) -> dict:
+        data = self._node_data.get(node_id)
+        if data is None:
+            return {}
+        return {TAG_NODE_TYPE: data.node_type,
+                TAG_NODE_STATUS: data.status}
+
+    def internal_ip(self, node_id: str) -> str:
+        data = self._node_data.get(node_id)
+        return data.ip if data else node_id
+
+    def create_node(self, node_config: dict, tags: dict, count: int) -> None:
+        node_type = tags.get(TAG_NODE_TYPE, "")
+        self._scale.desired[node_type] = (
+            self._scale.desired.get(node_type, 0) + count)
+        self._dirty = True
+
+    def terminate_node(self, node_id: str) -> None:
+        data = self._node_data.get(node_id)
+        if data is None:
+            return
+        self._scale.desired[data.node_type] = max(
+            0, self._scale.desired.get(data.node_type, 0) - 1)
+        self._scale.workers_to_delete.add(node_id)
+        self._dirty = True
+
+    def pending_nodes(self) -> Dict[str, int]:
+        """Nodes requested but not yet observed (cloud still provisioning)
+        — the autoscaler counts these as upcoming supply so a slow TPU
+        slice isn't re-launched every cycle while it boots."""
+        observed = self._count_types()
+        out: Dict[str, int] = {}
+        for t, want in self._scale.desired.items():
+            pending = want - observed.get(t, 0)
+            if pending > 0:
+                out[t] = pending
+        return out
+
+    def flush(self) -> None:
+        """Force-submit any pending request (shutdown path)."""
+        if self._dirty:
+            self.submit_scale_request(self._scale)
+            self._dirty = False

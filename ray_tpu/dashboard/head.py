@@ -206,23 +206,15 @@ class DashboardHead:
 
     def _worker_logs(self, lines: int = 100,
                      node_id: Optional[str] = None) -> Dict[str, Any]:
-        out: Dict[str, Any] = {}
-        for n in self._gcs.call("get_all_node_info", {}, timeout=10):
-            if not n.alive:
-                continue
-            nid = n.node_id.hex()
-            if node_id and not nid.startswith(node_id):
-                continue
-            try:
-                # short per-node timeout: one wedged raylet must not stall
-                # the whole fan-out (calls are sequential on this thread)
-                reply = self._raylets.get(n.raylet_address).call(
-                    "tail_worker_logs", {"lines": lines}, timeout=5)
-            except Exception as e:  # noqa: BLE001 — report per-node failure
-                out[nid] = {"error": str(e)}
-                continue
-            out[nid] = {str(pid): info for pid, info in reply.items()}
-        return out
+        from ray_tpu.util.state.api import collect_worker_logs
+
+        # short per-node timeout: one wedged raylet must not stall the
+        # whole fan-out (calls are sequential on this thread)
+        return collect_worker_logs(
+            self._gcs.call("get_all_node_info", {}, timeout=10),
+            lambda addr, payload: self._raylets.get(addr).call(
+                "tail_worker_logs", payload, timeout=5),
+            node_id=node_id, lines=lines)
 
     def _cluster_status(self) -> Dict[str, Any]:
         load = self._gcs.call("get_cluster_load", {}, timeout=10)

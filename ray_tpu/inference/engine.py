@@ -38,6 +38,18 @@ class GenerationConfig:
     eos_token_id: Optional[int] = None
 
 
+def shard_params_for_inference(params, config, mesh, rules=None):
+    """device_put llama-family params into their TP layout for a sharded
+    engine (heads/mlp dims over the mesh's tp axis; everything else
+    replicated — no fsdp at inference: weights are read-only)."""
+    from ray_tpu.models.llama import param_logical_axes
+    from ray_tpu.parallel.sharding import LogicalAxisRules, shard_params
+
+    rules = rules or LogicalAxisRules().replace(
+        embed=None, vocab=None)  # no fsdp/vocab sharding at decode
+    return shard_params(params, param_logical_axes(config), mesh, rules)
+
+
 def _default_buckets(max_len: int) -> Tuple[int, ...]:
     out, b = [], 64
     while b < max_len:
@@ -55,10 +67,17 @@ class InferenceEngine:
         *,
         forward_with_cache: Optional[Callable] = None,
         init_kv_cache: Optional[Callable] = None,
-        max_batch: int = 4,
+        max_batch: int = 8,
         max_len: int = 1024,
         prefill_buckets: Optional[Tuple[int, ...]] = None,
+        mesh: Any = None,
+        decode_chunk: int = 16,
     ):
+        """With `mesh`, decode runs tensor-parallel over it: pass params
+        already sharded (see shard_params_for_inference) and the KV cache
+        shards over the mesh's `tp` axis on its kv-heads dim — XLA
+        propagates the layout through prefill/decode and inserts the ICI
+        collectives (psum after wo/w_down) itself."""
         if forward_with_cache is None or init_kv_cache is None:
             from ray_tpu.models import llama
 
@@ -70,7 +89,17 @@ class InferenceEngine:
         self.max_len = max_len
         self.buckets = prefill_buckets or _default_buckets(max_len)
         self._fwd = forward_with_cache
+        self.mesh = mesh
         self.cache = init_kv_cache(config, max_batch, max_len)
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            tp = "tp" if mesh.shape.get("tp", 1) > 1 else None
+            # [layers, batch, time, kv_heads, head_dim]: kv heads over tp
+            kv_sharding = NamedSharding(
+                mesh, PartitionSpec(None, None, None, tp, None))
+            self.cache = jax.tree.map(
+                lambda x: jax.device_put(x, kv_sharding), self.cache)
         # slot state (host side)
         self.lengths = np.zeros(max_batch, dtype=np.int32)
         self.free_slots = list(range(max_batch))
@@ -102,18 +131,36 @@ class InferenceEngine:
             last = logits[0, true_len - 1]
             return new_cache, last
 
-        @partial(jax.jit, donate_argnums=(1,), static_argnames=("temperature", "top_k", "top_p"))
-        def decode(params, cache, tokens, lengths, key,
+        @partial(jax.jit, donate_argnums=(1,),
+                 static_argnames=("steps", "temperature", "top_k", "top_p"))
+        def decode(params, cache, tokens, lengths, key, steps=1,
                    temperature=0.0, top_k=0, top_p=1.0):
-            """tokens: [B,1] current token per slot -> next token per slot."""
-            logits, cache = self._fwd(params, tokens, cache, lengths,
-                                      self.config)
-            nxt = sample_token(logits[:, -1], key, temperature=temperature,
-                               top_k=top_k, top_p=top_p)
-            return cache, nxt
+            """tokens: [B,1] current token per slot -> [steps, B] next
+            tokens. `steps` > 1 runs a lax.scan of decode steps in ONE
+            dispatch — the host is out of the loop for `steps` tokens,
+            which is what makes decode throughput survive dispatch latency
+            (remote/tunneled runtimes especially; ~100x there). Tokens a
+            request produces past its EOS within a chunk are discarded
+            host-side; freed slots' rows are rebuilt at next prefill, so
+            the uniform progression never corrupts live state."""
+
+            def body(carry, _):
+                cache, tok, lens, k = carry
+                logits, cache = self._fwd(params, tok, cache, lens,
+                                          self.config)
+                k, sub = jax.random.split(k)
+                nxt = sample_token(logits[:, -1], sub,
+                                   temperature=temperature,
+                                   top_k=top_k, top_p=top_p)
+                return (cache, nxt[:, None], lens + 1, k), nxt
+
+            (cache, _, _, _), toks = jax.lax.scan(
+                body, (cache, tokens, lengths, key), None, length=steps)
+            return cache, toks
 
         self._prefill = prefill
         self._decode = decode
+        self.decode_chunk = max(1, decode_chunk)
 
     # -- internals ----------------------------------------------------------
 
@@ -192,28 +239,46 @@ class InferenceEngine:
             # token goes at index lengths[slot].
             lengths = jnp.asarray(self.lengths)
             self._key, sub = jax.random.split(self._key)
-            self.cache, nxt = self._decode(
+            # clamp the chunk to what the active requests can still use,
+            # rounded up to a power of two so compile count stays
+            # log2(decode_chunk) (static `steps` = one program per bucket)
+            need = max(
+                min(gen.max_new_tokens - st["produced"],
+                    self.max_len - 1 - self.lengths[slot])
+                for slot, st in active.items())
+            steps = 1
+            while steps < min(self.decode_chunk, max(1, need)):
+                steps *= 2
+            self.cache, chunk = self._decode(
                 self.params, self.cache, jnp.asarray(tokens), lengths, sub,
-                temperature=gen.temperature, top_k=gen.top_k, top_p=gen.top_p)
-            nxt = np.asarray(nxt)
-            for slot in list(active):
-                st = active[slot]
-                self.lengths[slot] += 1
-                token = int(nxt[slot])
-                done = False
-                st["produced"] += 1
-                st["current"] = token
-                if gen.eos_token_id is not None and token == gen.eos_token_id:
-                    done = True
-                if st["produced"] >= gen.max_new_tokens:
-                    done = True
-                if self.lengths[slot] + 1 >= self.max_len:
-                    done = True
-                yield st["req"], token
-                if done:
-                    del active[slot]
-                    self._release(slot)
-                    yield from admit_all()
+                steps=steps, temperature=gen.temperature, top_k=gen.top_k,
+                top_p=gen.top_p)
+            chunk = np.asarray(chunk)  # [steps, B]
+            finished = []
+            for step in range(steps):
+                if not active:
+                    break
+                for slot in list(active):
+                    st = active[slot]
+                    self.lengths[slot] += 1
+                    token = int(chunk[step, slot])
+                    st["produced"] += 1
+                    st["current"] = token
+                    done = (
+                        (gen.eos_token_id is not None
+                         and token == gen.eos_token_id)
+                        or st["produced"] >= gen.max_new_tokens
+                        or self.lengths[slot] + 1 >= self.max_len)
+                    yield st["req"], token
+                    if done:
+                        # the chunk's remaining tokens for this slot are
+                        # discarded; the slot re-prefills before reuse
+                        del active[slot]
+                        finished.append(slot)
+            for slot in finished:
+                self._release(slot)
+            if finished:
+                yield from admit_all()
 
     def generate(self, prompts: List[List[int]],
                  gen: Optional[GenerationConfig] = None) -> List[List[int]]:

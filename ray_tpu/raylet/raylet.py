@@ -118,6 +118,11 @@ class Raylet:
             resources, self.labels, env=accelerator_env,
             probe_gce=(accelerator_env is None
                        and CONFIG.tpu_probe_gce_metadata))
+        # On k8s, the autoscaler joins provider pods to GCS nodes via this
+        # label (downward-API env; see autoscaler.update's label join).
+        pod_name = os.environ.get("RT_POD_NAME") or os.environ.get("POD_NAME")
+        if pod_name and accelerator_env is None:
+            self.labels.setdefault("ray.io/pod-name", pod_name)
         # node:<ip> affinity resource like the reference.
         self.total: Resources = resources
         self.available: Resources = dict(resources)
@@ -224,12 +229,22 @@ class Raylet:
                 logger.debug("log monitor scan failed", exc_info=True)
                 continue
             for batch in batches:
+                path = batch.pop("path")
+                new_offset = batch.pop("new_offset")
                 try:
                     await self._gcs.send_async("publish_logs", batch)
                 except (ConnectionLost, OSError):
+                    # offset NOT committed: these lines re-read and re-send
+                    # next cycle (a GCS blip loses nothing)
                     break
+                offsets[path] = new_offset
 
     def _collect_new_log_lines(self, offsets: Dict[str, int]):
+        """-> batches carrying "path"/"new_offset" so the caller commits an
+        offset only AFTER its batch is sent (transient GCS failures lose
+        nothing). Lines split into per-JOB segments by the worker's
+        job_marks — attribution is by write position, not by whoever holds
+        the worker at scan time."""
         batches = []
         node = self.node_id.hex()
         live_paths = set()
@@ -260,22 +275,44 @@ class Raylet:
             cut = data.rfind(b"\n")
             if cut < 0:
                 continue
-            offsets[path] = start + cut + 1
-            lines = data[:cut].decode("utf-8", "replace").splitlines()
-            if len(lines) > 1000:  # flood guard: keep the newest
-                skipped += 1  # at least; exact line count unknown
-                lines = lines[-1000:]
-            if skipped:
-                lines.insert(0, f"... ({skipped} bytes/lines of log "
-                                "backlog skipped)")
-            batches.append({
-                "node": node,
-                "pid": handle.pid,
-                "worker_id": handle.worker_id.hex()
-                if handle.worker_id else None,
-                "job_id": handle.last_job_hex,
-                "lines": lines,
-            })
+            data = data[:cut + 1]
+            end = start + cut + 1
+            # split [start, end) into per-job segments at the marks
+            marks = list(handle.job_marks)
+            base_job = None
+            for off, job in marks:
+                if off <= start:
+                    base_job = job
+            cuts = [(off, job) for off, job in marks if start < off < end]
+            segs = []
+            prev, prev_job = start, base_job
+            for off, job in cuts:
+                segs.append((prev, off, prev_job))
+                prev, prev_job = off, job
+            segs.append((prev, end, prev_job))
+            first = True
+            for s, e, job in segs:
+                lines = data[s - start:e - start].decode(
+                    "utf-8", "replace").splitlines()
+                if len(lines) > 1000:  # flood guard: keep the newest
+                    skipped += 1
+                    lines = lines[-1000:]
+                if first and skipped:
+                    lines.insert(0, f"... ({skipped} bytes/lines of log "
+                                    "backlog skipped)")
+                first = False
+                if not lines:
+                    continue
+                batches.append({
+                    "node": node,
+                    "pid": handle.pid,
+                    "worker_id": handle.worker_id.hex()
+                    if handle.worker_id else None,
+                    "job_id": job,
+                    "lines": lines,
+                    "path": path,
+                    "new_offset": e,
+                })
         for path in list(offsets):
             if path not in live_paths:
                 del offsets[path]
@@ -641,9 +678,10 @@ class Raylet:
                 q.future.set_result({"rejected": True, "reason": "no worker available"})
             return
         is_actor = q.spec.task_type == TaskType.ACTOR_CREATION_TASK
-        # job attribution for log streaming: a driver only prints lines
-        # from workers last leased to ITS job
-        worker.last_job_hex = q.spec.job_id.hex() if q.spec.job_id else None
+        # job attribution for log streaming, marked at the current file
+        # offset: lines already written belong to the PREVIOUS job even if
+        # the monitor scans after this re-lease
+        worker.mark_job(q.spec.job_id.hex() if q.spec.job_id else None)
         owner = q.spec.owner_address
         self._leases[worker.worker_id] = _Lease(
             worker_id=worker.worker_id,

@@ -37,7 +37,25 @@ class WorkerHandle:
     is_driver: bool = False
     needs_accelerator: bool = False
     log_path: str = ""  # stdout+stderr file (tailed by the raylet monitor)
-    last_job_hex: Optional[str] = None  # job of the latest lease (log attribution)
+    last_job_hex: Optional[str] = None  # job of the latest lease
+    # (file_offset, job_hex) marks appended when the leased job CHANGES:
+    # log attribution is by WRITE position, so a re-leased worker's old
+    # output still goes to the job that produced it.
+    job_marks: list = field(default_factory=list)
+
+    def mark_job(self, job_hex: Optional[str]) -> None:
+        if job_hex == self.last_job_hex:
+            return
+        self.last_job_hex = job_hex
+        offset = 0
+        if self.log_path:
+            try:
+                offset = os.path.getsize(self.log_path)
+            except OSError:
+                pass
+        self.job_marks.append((offset, job_hex))
+        if len(self.job_marks) > 64:  # bounded; monitor prunes consumed
+            del self.job_marks[:-64]
     # Runtime-env hash applied in this worker ("" = pristine). A worker that
     # ran under an env can ONLY serve that env again — the reference
     # dedicates workers per runtime env; returning one to the general pool

@@ -283,26 +283,23 @@ def cmd_logs(args) -> int:
     tail_worker_logs RPC over the live cluster)."""
     ray_tpu = _connect(args)
     from ray_tpu._raylet import get_core_worker
+    from ray_tpu.util.state.api import collect_worker_logs
 
     cw = get_core_worker()
-    nodes = cw._gcs.call("get_all_node_info", {})
+    result = collect_worker_logs(
+        cw._gcs.call("get_all_node_info", {}),
+        lambda addr, payload: cw._peers.get(addr).call(
+            "tail_worker_logs", payload, timeout=30),
+        node_id=args.node_id, pid=args.pid, lines=args.lines)
     shown = 0
-    for n in nodes:
-        if not n.alive:
+    for nid, workers in sorted(result.items()):
+        if "error" in workers:
+            print(f"node {nid[:8]}: unreachable ({workers['error']})")
             continue
-        if args.node_id and not n.node_id.hex().startswith(args.node_id):
-            continue
-        try:
-            reply = cw._peers.get(n.raylet_address).call(
-                "tail_worker_logs",
-                {"pid": args.pid, "lines": args.lines}, timeout=30)
-        except Exception as e:  # noqa: BLE001
-            print(f"node {n.node_id.hex()[:8]}: unreachable ({e})")
-            continue
-        for pid, info in sorted(reply.items()):
+        for pid, info in sorted(workers.items()):
             if not info["lines"] and not args.all:
                 continue
-            print(f"--- node {n.node_id.hex()[:8]} pid={pid} "
+            print(f"--- node {nid[:8]} pid={pid} "
                   f"state={info['state']} ({info['path']})")
             for line in info["lines"]:
                 print(f"    {line}")

@@ -1,0 +1,103 @@
+"""Serve data-plane microbenchmarks (VERDICT r1 #10).
+
+Measures what the reference's serve release benchmarks measure
+(reference: python/ray/serve/_private/benchmarks/): end-to-end HTTP RPS +
+latency percentiles through the proxy, handle-call RPS, and the
+power-of-two router's queue-probe overhead vs a raw actor call.
+
+Run: python -m ray_tpu.serve.benchmarks
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Dict
+
+
+def _percentiles(samples_ms):
+    xs = sorted(samples_ms)
+
+    def pct(p):
+        return round(xs[min(len(xs) - 1, int(p / 100 * len(xs)))], 2)
+
+    return {"p50_ms": pct(50), "p90_ms": pct(90), "p99_ms": pct(99)}
+
+
+def run_serve_benchmarks(n_requests: int = 200,
+                         http_port: int = 0) -> Dict[str, dict]:
+    import urllib.request
+
+    import ray_tpu
+    from ray_tpu import serve
+
+    out: Dict[str, dict] = {}
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    http_port = http_port or 18431
+
+    @serve.deployment
+    def echo(body=None):
+        return "ok"
+
+    serve.run(echo.bind(), name="bench", http_port=http_port)
+    handle = serve.get_deployment_handle("echo", "bench")
+
+    # warm the replica + route
+    assert handle.remote(None).result(timeout_s=30) == "ok"
+    url = f"http://127.0.0.1:{http_port}/bench"
+    with urllib.request.urlopen(url, timeout=10) as r:
+        r.read()
+
+    # -- handle path (router + replica actor call) --------------------------
+    lat = []
+    t0 = time.perf_counter()
+    for _ in range(n_requests):
+        s = time.perf_counter()
+        handle.remote(None).result(timeout_s=30)
+        lat.append((time.perf_counter() - s) * 1e3)
+    dt = time.perf_counter() - t0
+    out["serve_handle"] = {"rps": round(n_requests / dt, 1),
+                           **_percentiles(lat)}
+
+    # -- HTTP proxy path ----------------------------------------------------
+    lat = []
+    t0 = time.perf_counter()
+    for _ in range(n_requests):
+        s = time.perf_counter()
+        with urllib.request.urlopen(url, timeout=30) as r:
+            r.read()
+        lat.append((time.perf_counter() - s) * 1e3)
+    dt = time.perf_counter() - t0
+    out["serve_http"] = {"rps": round(n_requests / dt, 1),
+                         **_percentiles(lat)}
+
+    # -- router probe overhead ----------------------------------------------
+    # the pow-2 router probes replica queue lengths before assignment
+    # (reference: pow_2_scheduler.py:49); quantify it against a raw actor
+    # round trip with no routing at all
+    @ray_tpu.remote
+    class Raw:
+        def ping(self):
+            return "ok"
+
+    raw = Raw.remote()
+    ray_tpu.get(raw.ping.remote())
+    t0 = time.perf_counter()
+    for _ in range(n_requests):
+        ray_tpu.get(raw.ping.remote())
+    raw_ms = (time.perf_counter() - t0) / n_requests * 1e3
+    handle_ms = out["serve_handle"]["p50_ms"]
+    out["router_probe_overhead"] = {
+        "raw_actor_call_ms": round(raw_ms, 2),
+        "handle_call_p50_ms": handle_ms,
+        "overhead_ms": round(handle_ms - raw_ms, 2),
+    }
+    serve.shutdown()
+    return out
+
+
+if __name__ == "__main__":
+    import os
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    print(json.dumps(run_serve_benchmarks()))

@@ -192,3 +192,26 @@ def _apply_filters(rows: List[Dict[str, Any]], filters) -> List[Dict[str, Any]]:
         else:
             raise ValueError(f"unsupported filter op {op!r}")
     return rows
+
+
+def collect_worker_logs(nodes, rpc_call, *, node_id=None, pid=None,
+                        lines: int = 100) -> Dict[str, Any]:
+    """Cluster-wide worker-log fan-out shared by the `ray-tpu logs` CLI
+    and the dashboard /api/logs route: per alive node, tail_worker_logs
+    over `rpc_call(raylet_address, payload)`; per-node failures are
+    reported in-band, never raised."""
+    out: Dict[str, Any] = {}
+    for n in nodes:
+        if not n.alive:
+            continue
+        nid = n.node_id.hex()
+        if node_id and not nid.startswith(node_id):
+            continue
+        try:
+            reply = rpc_call(n.raylet_address,
+                             {"pid": pid, "lines": lines})
+        except Exception as e:  # noqa: BLE001 — report per-node failure
+            out[nid] = {"error": str(e)}
+            continue
+        out[nid] = {str(p): info for p, info in reply.items()}
+    return out

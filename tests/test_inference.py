@@ -147,3 +147,23 @@ def test_llm_serve_deployment(ray_start_regular, tiny):
         assert again == outs[0]
     finally:
         serve.shutdown()
+
+
+def test_tp_sharded_engine_matches_unsharded(tiny):
+    """Decode over a tp=2 mesh (VERDICT r1 #10: sharded decode wired to the
+    engine): params in TP layout, KV cache sharded on kv-heads — greedy
+    output must match the single-device engine exactly."""
+    from ray_tpu.inference.engine import shard_params_for_inference
+    from ray_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    cfg, params = tiny
+    prompts = [[3, 17, 42, 9], [5, 7]]
+    gen = GenerationConfig(max_new_tokens=5)
+    expected = InferenceEngine(params, cfg, max_batch=2,
+                               max_len=64).generate(prompts, gen)
+
+    mesh = build_mesh(MeshConfig(tp=2), devices=jax.devices()[:2])
+    sharded = shard_params_for_inference(params, cfg, mesh)
+    eng = InferenceEngine(sharded, cfg, max_batch=2, max_len=64, mesh=mesh)
+    out = eng.generate(prompts, gen)
+    assert out == expected
