@@ -420,7 +420,9 @@ def flash_attention_sharded(q, k, v, mesh, causal: bool = True,
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
 
-    batch_axes = tuple(a for a in ("dp", "fsdp")
+    # incl. the inter-slice dcn axis: a replicated batch dim would
+    # all-gather q/k/v across DCN before every attention call
+    batch_axes = tuple(a for a in ("dcn", "dp", "fsdp")
                        if mesh.shape.get(a, 1) > 1)
     batch_div = 1
     for a in batch_axes:

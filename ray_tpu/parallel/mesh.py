@@ -75,6 +75,62 @@ def build_mesh(config: MeshConfig = MeshConfig(), devices=None):
     return Mesh(dev_array, AXIS_ORDER)
 
 
+def build_multislice_mesh(config: MeshConfig = MeshConfig(),
+                          num_slices: int = 1, devices=None):
+    """Mesh spanning multiple TPU slices: a leading `dcn` axis maps onto
+    the slow inter-slice network, and the per-slice MeshConfig axes map
+    onto each slice's ICI torus.
+
+    Layout doctrine (SURVEY §7 "Multi-slice (DCN) collectives"): only DATA
+    parallelism crosses slices — its per-step collective is one gradient
+    all-reduce, which XLA's multi-slice lowering runs hierarchically
+    (reduce-scatter on ICI per slice -> small cross-slice DCN all-reduce ->
+    all-gather on ICI). Model axes (tp/sp/fsdp/pp/ep) stay inside a slice,
+    so their frequent collectives never touch DCN. Sharding rules map the
+    batch axis over ("dcn", "dp", "fsdp") — size-1 axes drop out, so the
+    same model code runs on single-slice meshes unchanged.
+
+    Device order: on real multi-slice TPU, jax.devices() groups by
+    slice_index; `jax.experimental.mesh_utils.create_hybrid_device_mesh`
+    orders granules DCN-outer. Where slice structure is unavailable (CPU
+    tests, single-slice), a plain reshape produces the same logical layout.
+    """
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    if num_slices <= 1:
+        return build_mesh(config, devices=devices)
+    if len(devices) % num_slices != 0:
+        raise ValueError(
+            f"{len(devices)} devices not divisible by {num_slices} slices")
+    per_slice = len(devices) // num_slices
+    config = config.resolved(per_slice)
+    sizes = config.axis_sizes()
+    ici_shape = tuple(sizes[a] for a in AXIS_ORDER)
+    axes = ("dcn",) + AXIS_ORDER
+    if getattr(devices[0], "slice_index", None) is not None:
+        # real multi-slice hardware: the hybrid util orders granules by
+        # slice. Errors here are REAL config mistakes (num_slices vs the
+        # actual slice count, granule mismatch) and must propagate — a
+        # silent reshape fallback would run tp/sp collectives over DCN.
+        from jax.experimental import mesh_utils
+
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            (1,) + ici_shape,  # per-granule (per-slice) ICI shape
+            (num_slices,) + (1,) * len(AXIS_ORDER),  # DCN split: dcn axis
+            devices=devices)
+        dev_array = np.asarray(dev_array).reshape(
+            (num_slices,) + ici_shape)
+    else:
+        # no slice metadata (CPU tests / single-slice): plain reshape
+        # yields the same logical layout
+        dev_array = np.asarray(devices).reshape((num_slices,) + ici_shape)
+    return Mesh(dev_array, axes)
+
+
 def local_device_mesh(config: Optional[MeshConfig] = None):
     """Mesh over this process's local devices only (single-host)."""
     import jax

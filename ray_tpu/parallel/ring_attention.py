@@ -114,10 +114,12 @@ def ring_attention_sharded(q, k, v, mesh, axis_name: str = "sp",
     from jax.sharding import PartitionSpec as P
     from jax import shard_map
 
-    # Shard batch over dp/fsdp and heads over tp too — replicating those dims
-    # would all-gather the activations and redo attention on every dp/tp
-    # shard, defeating the O(S_local) memory point of the ring.
-    batch_axes = tuple(a for a in ("dp", "fsdp") if mesh.shape.get(a, 1) > 1)
+    # Shard batch over every data-parallel axis (incl. the inter-slice dcn
+    # axis of multi-slice meshes) and heads over tp — replicating those
+    # dims would all-gather the activations (across DCN, for dcn!) and
+    # redo attention on every shard, defeating the O(S_local) point.
+    batch_axes = tuple(a for a in ("dcn", "dp", "fsdp")
+                       if mesh.shape.get(a, 1) > 1)
     bdiv = 1
     for a in batch_axes:
         bdiv *= mesh.shape[a]

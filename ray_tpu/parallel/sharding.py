@@ -20,7 +20,12 @@ PhysicalAxes = Union[None, str, Tuple[str, ...]]
 # map one mesh axis twice, so activations never reuse parameter rules.
 DEFAULT_RULES: List[Tuple[str, PhysicalAxes]] = [
     # activations
-    ("batch", ("dp", "fsdp")),   # batch sharded over both DP axes
+    # batch over ALL data-parallel axes, incl. the inter-slice `dcn` axis
+    # of multi-slice meshes (absent/size-1 axes drop out, so single-slice
+    # meshes are unaffected). Only dp crosses DCN: its one gradient
+    # all-reduce per step lowers hierarchically (ICI reduce-scatter ->
+    # DCN all-reduce -> ICI all-gather); model axes stay on ICI.
+    ("batch", ("dcn", "dp", "fsdp")),
     ("seq", "sp"),               # sequence/context parallel
     ("act_embed", None),         # activations: embed replicated
     ("act_heads", "tp"),         # attention activations: heads over TP

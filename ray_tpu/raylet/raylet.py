@@ -807,6 +807,22 @@ class Raylet:
             }
         return out
 
+    async def handle_profile_worker(self, payload):
+        """Fan a CPU/heap profile request to one of this node's workers
+        (reference: dashboard reporter profile endpoints). payload:
+        {pid, kind: "cpu"|"memory", duration_s?, interval_ms?, top?}."""
+        want_pid = payload.get("pid")
+        kind = payload.get("kind", "cpu")
+        for handle in list(self.worker_pool._workers.values()):
+            if handle.pid != want_pid or handle.address is None:
+                continue
+            method = "profile_cpu" if kind == "cpu" else "profile_memory"
+            timeout = float(payload.get("duration_s", 5.0)) + 30
+            return await self._pool.get(
+                handle.address.rpc_address).call_async(
+                    method, payload, timeout=timeout)
+        return {"error": f"no live worker with pid {want_pid} on this node"}
+
     # ------------------------------------------------------------ RPC: stats
     async def handle_get_node_stats(self, payload):
         return {

@@ -310,6 +310,51 @@ def cmd_logs(args) -> int:
     return 0
 
 
+def cmd_profile(args) -> int:
+    """Live CPU flamegraph / heap snapshot of a worker (reference: the
+    dashboard's py-spy and memray endpoints, profile_manager.py:83/:192)."""
+    import json as _json
+
+    ray_tpu = _connect(args)
+    from ray_tpu._raylet import get_core_worker
+    from ray_tpu.util.profiling import folded_to_text
+
+    cw = get_core_worker()
+    payload = {"pid": args.pid,
+               "kind": "memory" if args.memory else "cpu",
+               "duration_s": args.duration, "top": args.top}
+    reply = None
+    try:
+        for n in cw._gcs.call("get_all_node_info", {}):
+            if not n.alive:
+                continue
+            try:
+                r = cw._peers.get(n.raylet_address).call(
+                    "profile_worker", payload, timeout=args.duration + 60)
+            except Exception as e:  # noqa: BLE001 — keep trying other nodes
+                print(f"node {n.node_id.hex()[:8]}: unreachable ({e})",
+                      file=sys.stderr)
+                continue
+            if "error" not in r:
+                reply = r
+                break
+    finally:
+        if reply is None:
+            ray_tpu.shutdown()
+    if reply is None:
+        print(f"no live worker with pid {args.pid}")
+        return 1
+    if args.memory:
+        print(_json.dumps(reply, indent=2))
+    else:
+        # flamegraph.pl / speedscope-compatible folded stacks
+        print(folded_to_text(reply, top=args.top))
+        print(f"# {reply['samples']} samples over {reply['duration_s']}s",
+              file=sys.stderr)
+    ray_tpu.shutdown()
+    return 0
+
+
 def cmd_stack(args) -> int:
     """Dump python stacks of this node's worker processes (reference: ray
     stack — scripts.py:1833; py-spy there, SIGUSR1+faulthandler here: every
@@ -462,6 +507,16 @@ def main(argv=None) -> int:
     sp.add_argument("--all", action="store_true",
                     help="include workers with empty logs")
     sp.set_defaults(fn=cmd_logs)
+
+    sp = sub.add_parser("profile",
+                        help="CPU flamegraph / heap snapshot of a worker")
+    sp.add_argument("--address")
+    sp.add_argument("--pid", type=int, required=True)
+    sp.add_argument("--duration", type=float, default=5.0)
+    sp.add_argument("--memory", action="store_true",
+                    help="heap snapshot (tracemalloc) instead of CPU")
+    sp.add_argument("--top", type=int, default=40)
+    sp.set_defaults(fn=cmd_profile)
 
     sp = sub.add_parser("stack", help="dump python stacks of node workers")
     sp.add_argument("--address")

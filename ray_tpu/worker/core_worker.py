@@ -279,6 +279,8 @@ class CoreWorker:
         s.register("cancel_task", self._handle_cancel_task)
         s.register("exit", self._handle_exit)
         s.register("ping", self._handle_ping)
+        s.register("profile_cpu", self._handle_profile_cpu)
+        s.register("profile_memory", self._handle_profile_memory)
         s.register("pubsub_message", self._handle_pubsub_message)
         s.register("reconstruct_object", self._handle_reconstruct_object)
 
@@ -1589,6 +1591,23 @@ class CoreWorker:
 
     async def _handle_ping(self, payload):
         return {"status": "ok", "worker_id": self.worker_id.hex(), "pid": os.getpid()}
+
+    async def _handle_profile_cpu(self, payload):
+        """Live CPU flamegraph sampling (reference: dashboard py-spy,
+        profile_manager.py:83). Runs in a thread so the worker keeps
+        serving RPCs while being sampled."""
+        from ray_tpu.util.profiling import sample_cpu_profile
+
+        return await asyncio.to_thread(
+            sample_cpu_profile,
+            float(payload.get("duration_s", 5.0)),
+            float(payload.get("interval_ms", 10.0)))
+
+    async def _handle_profile_memory(self, payload):
+        from ray_tpu.util.profiling import heap_snapshot
+
+        return await asyncio.to_thread(
+            heap_snapshot, int(payload.get("top", 30)))
 
     # ---------------------------------------------- generator streaming (owner)
     async def _handle_report_generator_item(self, payload):
