@@ -108,6 +108,20 @@ def init(
             )
         if address is None:
             address = os.environ.get("RT_ADDRESS")
+        if address and address.startswith("client://"):
+            # proxied remote driver (reference: ray.init("ray://host:port")
+            # through util/client) — token-authenticated; the proxy hosts
+            # this session's actual driver
+            from ray_tpu.util.client import connect
+
+            cw = connect(
+                address[len("client://"):],
+                token=_kwargs.get("token")
+                or os.environ.get("RT_CLIENT_TOKEN"),
+                namespace=namespace or "",
+                runtime_env=runtime_env)
+            atexit.register(shutdown)
+            return RayContext(cw.gcs_address, cw.node_id, cw.namespace)
         gcs_address = None
         raylet_address = None
         if address is None:

@@ -310,6 +310,29 @@ def cmd_logs(args) -> int:
     return 0
 
 
+def cmd_client_server(args) -> int:
+    """Run the client proxy (reference: `ray start --ray-client-server-port`
+    / util/client/server): remote drivers connect with
+    ray_tpu.init("client://host:port", token=...)."""
+    from ray_tpu.util.client import ClientProxyServer
+
+    gcs = args.address or os.environ.get("RT_ADDRESS")
+    if not gcs:
+        print("--address (or RT_ADDRESS) is required")
+        return 1
+    token = args.token or os.environ.get("RT_CLIENT_TOKEN")
+    server = ClientProxyServer(gcs, host=args.host, token=token)
+    addr = server.start(args.port)
+    print(f"client proxy serving at client://{addr}"
+          + (" (token required)" if token else " (NO token — open access)"))
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
 def cmd_profile(args) -> int:
     """Live CPU flamegraph / heap snapshot of a worker (reference: the
     dashboard's py-spy and memray endpoints, profile_manager.py:83/:192)."""
@@ -507,6 +530,14 @@ def main(argv=None) -> int:
     sp.add_argument("--all", action="store_true",
                     help="include workers with empty logs")
     sp.set_defaults(fn=cmd_logs)
+
+    sp = sub.add_parser("client-server",
+                        help="run the client proxy for remote drivers")
+    sp.add_argument("--address", help="GCS address of the cluster")
+    sp.add_argument("--host", default="127.0.0.1")
+    sp.add_argument("--port", type=int, default=10001)
+    sp.add_argument("--token", help="shared auth token (RT_CLIENT_TOKEN)")
+    sp.set_defaults(fn=cmd_client_server)
 
     sp = sub.add_parser("profile",
                         help="CPU flamegraph / heap snapshot of a worker")

@@ -1,0 +1,24 @@
+"""Ray-Client-style proxy: token-authenticated remote drivers.
+
+Reference: ray python/ray/util/client (ARCHITECTURE.md, server/proxier.py)
+— remote drivers talk ONLY to a proxy endpoint instead of joining the
+cluster's control plane directly; the proxy authenticates them and hosts a
+per-session driver on their behalf (auth + isolation boundary: clients
+never get raw GCS/raylet/TCP access, and a dropped client tears down
+exactly its own session).
+
+Here: `ClientProxyServer` (server.py) hosts one real CoreWorker per
+authenticated session; the client side installs a `ClientCoreWorker` whose
+public-API surface forwards over a single RPC connection, so every
+`ray_tpu.*` call works unchanged via `ray_tpu.init("client://host:port",
+token=...)`. Function/actor payloads travel via cloudpickle; ObjectRefs
+round-trip by id and are owned by the session's server-side driver (the
+client holds no distributed refcounts — the session is the lifetime).
+
+Limitations vs a direct driver (documented, reference has analogues):
+worker log streaming doesn't reach the client console, and `working_dir`
+uploads go through the proxy's KV forwarding.
+"""
+
+from ray_tpu.util.client.client import ClientCoreWorker, connect  # noqa: F401
+from ray_tpu.util.client.server import ClientProxyServer  # noqa: F401

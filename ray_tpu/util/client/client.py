@@ -1,0 +1,205 @@
+"""Client-side CoreWorker stand-in for proxy-connected drivers.
+
+Installed into the process-global worker slot by
+`ray_tpu.init("client://host:port", token=...)`, so the whole public API
+(`remote/get/put/wait/actors/PGs`) runs unchanged — every call forwards
+over ONE authenticated RPC connection to the proxy's per-session driver
+(reference: ray util/client — the client-mode `ray.init("ray://...")`).
+
+Distributed refcounting stays server-side: the session CoreWorker owns
+every object the client creates, and the session (closed on shutdown or
+client death) is the lifetime. The client's ref hooks are no-ops.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+import cloudpickle
+
+from ray_tpu.util.client.server import ALLOWED_GCS_METHODS, ALLOWED_METHODS
+
+
+class _StubRefCounter:
+    """Client refs have no distributed lifetime of their own."""
+
+    def __getattr__(self, name):
+        return lambda *a, **kw: None
+
+
+class _GcsShim:
+    """cw._gcs.call(...) surface for state APIs (ray_tpu.nodes() etc.)."""
+
+    def __init__(self, client: "ClientCoreWorker"):
+        self._client = client
+
+    def call(self, method: str, payload=None, timeout=None):
+        if method not in ALLOWED_GCS_METHODS:
+            raise PermissionError(
+                f"GCS method {method!r} is not available over the client "
+                "proxy")
+        return self._client._roundtrip(
+            "client_gcs", {"method": method, "payload": payload or {}},
+            timeout=timeout)
+
+
+class ClientCoreWorker:
+    is_client = True
+
+    def __init__(self, proxy_address: str, token: Optional[str] = None,
+                 namespace: str = "", runtime_env: Optional[dict] = None):
+        from ray_tpu._private.rpc import EventLoopThread, RpcClient
+
+        self._lt = EventLoopThread("client-driver")
+        self._rpc = RpcClient(proxy_address, self._lt)
+        self._token = token
+        self._lock = threading.Lock()
+        reply = self._rpc.call(
+            "client_init", {"token": token, "namespace": namespace},
+            timeout=60)
+        if reply.get("status") != "ok":
+            self._lt.stop()
+            raise ConnectionError(
+                f"client connect failed: {reply.get('message')}")
+        self._session_id = reply["session_id"]
+        for name, value in reply["attrs"].items():
+            setattr(self, name, value)
+        self.mode = "driver"
+        self.plasma = None
+        self.reference_counter = _StubRefCounter()
+        self._gcs = _GcsShim(self)
+        self._shutdown = False
+        if runtime_env:
+            # job-level env: validate + package CLIENT-side (local paths
+            # live here), install on the session driver, and apply its
+            # env_vars to this process like api.init does for local drivers
+            import os as _os
+
+            from ray_tpu import runtime_env as re_mod
+
+            env = re_mod.validate(runtime_env)
+            env = re_mod.package_local_dirs(
+                env, lambda key, value: self._call("kv_put", key, value))
+            self._call("set_job_runtime_env", env)
+            self.job_runtime_env = env
+            for k, v in (env or {}).get("env_vars", {}).items():
+                _os.environ[k] = v
+
+    # -- plumbing ------------------------------------------------------------
+
+    def _roundtrip(self, rpc: str, payload: dict, timeout=None):
+        payload = {**payload, "token": self._token,
+                   "session_id": self._session_id}
+        reply = self._rpc.call(rpc, payload, timeout=timeout)
+        status = reply.get("status")
+        if status == "ok":
+            data = reply.get("data")
+            return cloudpickle.loads(data) if data is not None else None
+        if status == "exception":
+            raise cloudpickle.loads(reply["data"])
+        raise RuntimeError(reply.get("message", "client proxy error"))
+
+    # methods whose wall time is the USER's wait, not an RPC bound: their
+    # `timeout` kwarg forwards to the server untouched, and the transport
+    # deadline tracks it (or is effectively unbounded for blocking waits —
+    # a 2h training task must not trip the 60s RPC default)
+    _BLOCKING = frozenset({"get", "get_objects_by_id", "wait",
+                           "wait_placement_group_ready",
+                           "next_generator_item"})
+    _UNBOUNDED_S = 7 * 24 * 3600.0
+
+    def _call(self, method: str, *args, **kwargs):
+        rpc_timeout = None  # non-blocking calls: the 60s RPC default is fine
+        if method in self._BLOCKING:
+            user_t = kwargs.get("timeout")
+            if isinstance(user_t, (int, float)) and user_t > 0:
+                rpc_timeout = float(user_t) + 30.0  # slack for transport
+            else:  # None / -1: the USER wait is unbounded
+                rpc_timeout = self._UNBOUNDED_S
+        return self._roundtrip(
+            "client_call",
+            {"method": method, "data": cloudpickle.dumps((args, kwargs))},
+            timeout=rpc_timeout)
+
+    def __getattr__(self, name: str):
+        # forwarded public surface; anything else is a real AttributeError
+        if name in ALLOWED_METHODS:
+            return lambda *a, **kw: self._call(name, *a, **kw)
+        raise AttributeError(
+            f"{name!r} is not available on a client-mode driver")
+
+    # -- local implementations ----------------------------------------------
+
+    def register_deserialized_ref(self, ref) -> None:
+        pass  # session-owned; no client-side refcounting
+
+    def on_completed(self, ref, callback) -> None:
+        def poll():
+            try:
+                self._call("get", [ref])  # get takes a LIST of refs
+            except BaseException:  # noqa: BLE001 — errors still complete
+                pass
+            callback(ref)
+
+        threading.Thread(target=poll, daemon=True).start()
+
+    def as_future(self, ref):
+        import concurrent.futures
+
+        fut: "concurrent.futures.Future" = concurrent.futures.Future()
+
+        def poll():
+            try:
+                fut.set_result(self._call("get", [ref])[0])
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+        threading.Thread(target=poll, daemon=True).start()
+        return fut
+
+    def as_asyncio_future(self, ref):
+        import asyncio
+
+        return asyncio.wrap_future(self.as_future(ref))
+
+    def prepare_runtime_env(self, env):
+        """Validate + package locally; zips upload through the proxy's KV
+        forwarding so `working_dir` works from the client machine."""
+        from ray_tpu import runtime_env as re_mod
+
+        env = re_mod.validate(env)
+        if env is None:
+            return getattr(self, "job_runtime_env", None)
+        return re_mod.package_local_dirs(
+            env, lambda key, value: self._call("kv_put", key, value))
+
+    def shutdown(self, mark_job_finished: bool = True) -> None:
+        if self._shutdown:
+            return
+        self._shutdown = True
+        try:
+            self._rpc.call("client_close",
+                           {"token": self._token,
+                            "session_id": self._session_id}, timeout=30)
+        except Exception:  # noqa: BLE001 — proxy may already be gone
+            pass
+        self._rpc.close()
+        self._lt.stop()
+        from ray_tpu._raylet import global_state
+
+        if global_state.core_worker is self:
+            global_state.core_worker = None
+
+
+def connect(proxy_address: str, token: Optional[str] = None,
+            namespace: str = "",
+            runtime_env: Optional[dict] = None) -> ClientCoreWorker:
+    """Connect this process as a proxied driver and install the client
+    worker into the global slot (used by ray_tpu.init for client:// URLs)."""
+    from ray_tpu._raylet import global_state
+
+    cw = ClientCoreWorker(proxy_address, token=token, namespace=namespace,
+                          runtime_env=runtime_env)
+    global_state.core_worker = cw
+    return cw

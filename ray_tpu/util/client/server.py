@@ -1,0 +1,273 @@
+"""Client proxy server: per-session driver CoreWorkers behind one
+authenticated RPC endpoint (reference: ray util/client/server/proxier.py —
+a SpecificServer per client; here a per-session in-process CoreWorker,
+torn down with the session)."""
+
+from __future__ import annotations
+
+import logging
+import secrets
+import threading
+import time
+from typing import Dict, Optional
+
+import cloudpickle
+
+logger = logging.getLogger(__name__)
+
+# Methods a client may invoke on its session CoreWorker. Everything else —
+# internal state, raylet clients, the shm store — is unreachable by design.
+ALLOWED_METHODS = frozenset({
+    "submit_task", "submit_actor_task", "create_actor", "get_named_actor",
+    "put", "get", "get_objects_by_id", "wait", "cancel_task",
+    "cancel_task_by_id", "kill_actor", "register_function",
+    "next_generator_item", "kv_get", "kv_put",
+    "create_placement_group", "remove_placement_group",
+    "wait_placement_group_ready", "set_job_runtime_env",
+})
+
+# GCS control-plane calls a client may proxy (read-mostly state surface).
+ALLOWED_GCS_METHODS = frozenset({
+    "get_all_node_info", "get_cluster_load", "get_all_job_info",
+    "list_placement_groups", "get_placement_group", "get_task_events",
+    "list_actors",
+})
+
+
+class _Session:
+    def __init__(self, core_worker, namespace: str):
+        self.cw = core_worker
+        self.namespace = namespace
+        self.last_seen = time.monotonic()
+        self.inflight = 0  # RPCs currently executing (reaper skips active)
+        # ObjectRefs handed to the client, pinned server-side: the client
+        # keeps no distributed refcounts, so the SESSION is each object's
+        # lifetime (dropped wholesale at close — reference: the client
+        # server holds refs for its client the same way)
+        self.held_refs: Dict[bytes, object] = {}
+
+    def pin_refs(self, value) -> None:
+        from ray_tpu._raylet import ObjectRef, ObjectRefGenerator
+
+        if isinstance(value, ObjectRef):
+            self.held_refs[value.object_id().binary()] = value
+        elif isinstance(value, ObjectRefGenerator):
+            pass  # items pin as the client fetches them
+        elif isinstance(value, (list, tuple)):
+            for v in value:
+                self.pin_refs(v)
+        elif isinstance(value, dict):
+            for v in value.values():
+                self.pin_refs(v)
+
+
+class ClientProxyServer:
+    def __init__(self, gcs_address: str, host: str = "127.0.0.1",
+                 token: Optional[str] = None,
+                 session_timeout_s: float = 1800.0):
+        from ray_tpu._private.rpc import EventLoopThread, RpcServer
+
+        self.gcs_address = gcs_address
+        self.token = token
+        self.session_timeout_s = session_timeout_s
+        self._lt = EventLoopThread("client-proxy")
+        self._server = RpcServer(self._lt, host)
+        self._sessions: Dict[str, _Session] = {}
+        self._lock = threading.Lock()
+        self.address: Optional[str] = None
+        self._reaper = None
+
+    def start(self, port: int = 0) -> str:
+        self._server.register("client_init", self._handle_init)
+        self._server.register("client_call", self._handle_call)
+        self._server.register("client_gcs", self._handle_gcs)
+        self._server.register("client_attr", self._handle_attr)
+        self._server.register("client_close", self._handle_close)
+        self.address = self._server.start(port)
+        self._reaper = self._lt.submit(self._reaper_loop())
+        logger.info("client proxy serving at %s", self.address)
+        return self.address
+
+    async def _reaper_loop(self):
+        """Tear down sessions whose client vanished without client_close
+        (SIGKILL, network drop): idle past session_timeout_s with no RPC in
+        flight — otherwise their driver CoreWorkers, jobs, and pinned
+        objects leak until proxy restart. A session blocked in a long get
+        has inflight > 0 and is never reaped."""
+        import asyncio
+
+        while True:
+            await asyncio.sleep(min(60.0, self.session_timeout_s / 4))
+            now = time.monotonic()
+            stale = []
+            with self._lock:
+                for sid, sess in list(self._sessions.items()):
+                    if (sess.inflight == 0
+                            and now - sess.last_seen
+                            > self.session_timeout_s):
+                        stale.append((sid, self._sessions.pop(sid)))
+            for sid, sess in stale:
+                logger.info("reaping idle client session %s", sid)
+                try:
+                    await asyncio.to_thread(
+                        sess.cw.shutdown, mark_job_finished=True)
+                except Exception:  # noqa: BLE001 — best-effort teardown
+                    pass
+
+    def _auth(self, payload):
+        if self.token and not secrets.compare_digest(
+                str(payload.get("token") or ""), self.token):
+            return {"status": "error", "message": "invalid client token"}
+        return None
+
+    def _session(self, payload) -> _Session:
+        sess = self._sessions.get(payload.get("session_id"))
+        if sess is None:
+            raise RuntimeError("unknown or closed client session")
+        sess.last_seen = time.monotonic()
+        return sess
+
+    async def _handle_init(self, payload):
+        denied = self._auth(payload)
+        if denied:
+            return denied
+        import asyncio
+
+        namespace = payload.get("namespace") or ""
+        session_id = secrets.token_hex(8)
+        # CoreWorker construction does blocking connects; keep the proxy
+        # loop responsive
+        cw = await asyncio.to_thread(
+            self._make_session_worker, namespace)
+        with self._lock:
+            self._sessions[session_id] = _Session(cw, namespace)
+        return {"status": "ok", "session_id": session_id,
+                "attrs": {
+                    "job_id": cw.job_id,
+                    "namespace": cw.namespace,
+                    "gcs_address": cw.gcs_address,
+                    "node_id": cw.node_id,
+                    "worker_id": cw.worker_id,
+                    "address_str": cw.address_str,
+                }}
+
+    def _make_session_worker(self, namespace: str):
+        from ray_tpu._private.rpc import RpcClient
+        from ray_tpu._private.specs import JobInfo
+        from ray_tpu.worker.core_worker import CoreWorker
+
+        gcs = RpcClient(self.gcs_address, self._lt)
+        try:
+            nodes = gcs.call("get_all_node_info", {})
+            head = next((n for n in nodes if n.alive and n.is_head), None) \
+                or next((n for n in nodes if n.alive), None)
+            if head is None:
+                raise ConnectionError(
+                    f"no alive nodes in cluster at {self.gcs_address}")
+            cw = CoreWorker(
+                mode="driver", gcs_address=self.gcs_address,
+                raylet_address=head.raylet_address, namespace=namespace)
+            gcs.call("add_job", {"info": JobInfo(
+                job_id=cw.job_id, driver_address=cw.address_str,
+                namespace=namespace)})
+        finally:
+            gcs.close()
+        return cw
+
+    async def _handle_call(self, payload):
+        import asyncio
+
+        denied = self._auth(payload)
+        if denied:
+            return denied
+        sess = self._session(payload)
+        method = payload["method"]
+        if method not in ALLOWED_METHODS:
+            return {"status": "error",
+                    "message": f"method {method!r} is not allowed over the "
+                               "client proxy"}
+        args, kwargs = cloudpickle.loads(payload["data"])
+
+        def run():
+            return getattr(sess.cw, method)(*args, **kwargs)
+
+        sess.inflight += 1
+        try:
+            result = await asyncio.to_thread(run)
+            sess.pin_refs(result)
+            return {"status": "ok", "data": cloudpickle.dumps(result)}
+        except BaseException as e:  # noqa: BLE001 — errors are data here
+            try:
+                blob = cloudpickle.dumps(e)
+            except Exception:  # noqa: BLE001 — unpicklable exception
+                blob = cloudpickle.dumps(RuntimeError(str(e)))
+            return {"status": "exception", "data": blob}
+        finally:
+            sess.inflight -= 1
+            sess.last_seen = time.monotonic()
+
+    async def _handle_gcs(self, payload):
+        import asyncio
+
+        denied = self._auth(payload)
+        if denied:
+            return denied
+        sess = self._session(payload)
+        method = payload["method"]
+        if method not in ALLOWED_GCS_METHODS:
+            return {"status": "error",
+                    "message": f"GCS method {method!r} is not allowed over "
+                               "the client proxy"}
+
+        def run():
+            return sess.cw._gcs.call(method, payload.get("payload") or {})
+
+        sess.inflight += 1
+        try:
+            return {"status": "ok",
+                    "data": cloudpickle.dumps(await asyncio.to_thread(run))}
+        except BaseException as e:  # noqa: BLE001
+            return {"status": "exception",
+                    "data": cloudpickle.dumps(RuntimeError(str(e)))}
+        finally:
+            sess.inflight -= 1
+            sess.last_seen = time.monotonic()
+
+    async def _handle_attr(self, payload):
+        denied = self._auth(payload)
+        if denied:
+            return denied
+        sess = self._session(payload)
+        name = payload["name"]
+        if name not in ("job_id", "namespace", "gcs_address", "node_id",
+                        "worker_id", "address_str", "job_runtime_env"):
+            return {"status": "error", "message": f"attr {name!r} not allowed"}
+        return {"status": "ok",
+                "data": cloudpickle.dumps(getattr(sess.cw, name))}
+
+    async def _handle_close(self, payload):
+        import asyncio
+
+        denied = self._auth(payload)
+        if denied:
+            return denied
+        with self._lock:
+            sess = self._sessions.pop(payload.get("session_id"), None)
+        if sess is not None:
+            await asyncio.to_thread(
+                sess.cw.shutdown, mark_job_finished=True)
+        return {"status": "ok"}
+
+    def stop(self) -> None:
+        if self._reaper is not None:
+            self._reaper.cancel()
+        with self._lock:
+            sessions = list(self._sessions.values())
+            self._sessions.clear()
+        for sess in sessions:
+            try:
+                sess.cw.shutdown(mark_job_finished=True)
+            except Exception:  # noqa: BLE001
+                pass
+        self._server.stop()
+        self._lt.stop()

@@ -239,6 +239,12 @@ class CoreWorker:
     # ---------------------------------------------------------- runtime envs
     job_runtime_env: Optional[dict] = None  # job default (init(runtime_env=))
 
+    def set_job_runtime_env(self, env: Optional[dict]) -> None:
+        """Install the job-level runtime env (client-proxy sessions set it
+        over RPC after client-side packaging; api.init sets the attribute
+        directly for local drivers)."""
+        self.job_runtime_env = env
+
     def prepare_runtime_env(self, env: Optional[dict]) -> Optional[dict]:
         """Driver-side: merge over the job default, validate, and upload any
         local working_dir/py_modules to the GCS KV (packaging.py role)."""

@@ -28,15 +28,22 @@ def test_10k_queued_tasks(ray_start_regular):
         return 1
 
     ray_tpu.get([noop.remote() for _ in range(50)])  # warm leases
+    # sync baseline measured in-test so the guard is load-relative (this
+    # box runs the whole suite on one core; absolute rates halve under
+    # load but the async:sync RATIO is what batching buys)
+    t0 = time.perf_counter()
+    for _ in range(60):
+        ray_tpu.get(noop.remote())
+    sync_rate = 60 / (time.perf_counter() - t0)
+
     t0 = time.perf_counter()
     refs = [noop.remote() for _ in range(n)]
-    out = ray_tpu.get(refs, timeout=300)
+    out = ray_tpu.get(refs, timeout=600)
     dt = time.perf_counter() - t0
     assert len(out) == n and out[0] == 1
     rate = n / dt
-    # envelope guard: batched async submission must stay well above the
-    # sync round-trip rate (~1.3k/s); regression here means batching broke
-    assert rate > 2000, f"only {rate:.0f} tasks/s"
+    assert rate > 1.5 * sync_rate, (
+        f"async {rate:.0f}/s vs sync {sync_rate:.0f}/s — batching broke")
 
 
 def test_100_concurrent_placement_groups(ray_start_regular):
@@ -80,7 +87,7 @@ def test_1gib_object_through_shm_store(ray_start_regular):
     arr[:4096] = 7
     arr[-4096:] = 9
     ref = ray_tpu.put(arr)
-    got = ray_tpu.get(ref, timeout=120)
+    got = ray_tpu.get(ref, timeout=300)
     assert got.nbytes == size
     assert got[:4096].sum() == 7 * 4096 and got[-4096:].sum() == 9 * 4096
 
@@ -90,7 +97,7 @@ def test_1gib_object_through_shm_store(ray_start_regular):
         return x[:1024].copy()
 
     assert head.remote(ref) is not None
-    out = ray_tpu.get(head.remote(ref), timeout=120)
+    out = ray_tpu.get(head.remote(ref), timeout=300)
     assert out.sum() == 7 * 1024
     del got, ref
 
