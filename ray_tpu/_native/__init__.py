@@ -28,12 +28,20 @@ class NativeBuildError(RuntimeError):
 
 
 def build_library(name: str, sources: Optional[list] = None) -> str:
-    """Compile ray_tpu/_native/src/<name>.cc into a cached .so; return path."""
+    """Compile ray_tpu/_native/src/<name>.cc into a cached .so; return path.
+
+    RT_NATIVE_SANITIZE=thread|address builds with the matching
+    -fsanitize flag (reference: the TSAN/ASAN bazel configs,
+    .bazelrc:104-121); sanitized builds cache under a distinct tag and
+    report races/UB on the processes' stderr at runtime.
+    """
     sources = sources or [os.path.join(_SRC_DIR, f"{name}.cc")]
+    sanitize = os.environ.get("RT_NATIVE_SANITIZE", "")
     with _lock:
-        if name in _built:
-            return _built[name]
-        h = hashlib.sha256()
+        key = (name, sanitize)
+        if key in _built:
+            return _built[key]
+        h = hashlib.sha256(sanitize.encode())
         for s in sources:
             with open(s, "rb") as f:
                 h.update(f.read())
@@ -42,16 +50,20 @@ def build_library(name: str, sources: Optional[list] = None) -> str:
         if not os.path.exists(out):
             os.makedirs(_BUILD_DIR, exist_ok=True)
             tmp = out + f".tmp.{os.getpid()}"
+            extra = []
+            if sanitize in ("thread", "address"):
+                extra = [f"-fsanitize={sanitize}", "-fno-omit-frame-pointer",
+                         "-O1"]
             cmd = [
                 "g++", "-O2", "-g", "-std=c++17", "-shared", "-fPIC",
-                "-pthread", "-o", tmp, *sources,
+                "-pthread", *extra, "-o", tmp, *sources,
             ]
             proc = subprocess.run(cmd, capture_output=True, text=True)
             if proc.returncode != 0:
                 raise NativeBuildError(
                     f"g++ failed for {name}:\n{proc.stderr[-4000:]}")
             os.replace(tmp, out)
-        _built[name] = out
+        _built[key] = out
         return out
 
 

@@ -94,12 +94,14 @@ class ActorCreationSpec:
 class SchedulingStrategySpec:
     """DEFAULT / SPREAD / node-affinity / placement-group strategies."""
 
-    kind: str = "DEFAULT"  # DEFAULT | SPREAD | NODE_AFFINITY | PLACEMENT_GROUP
+    kind: str = "DEFAULT"  # DEFAULT | SPREAD | NODE_AFFINITY | NODE_LABEL | PLACEMENT_GROUP
     node_id: Optional[NodeID] = None
     soft: bool = False
     placement_group_id: Optional[PlacementGroupID] = None
     bundle_index: int = -1
     capture_child_tasks: bool = False
+    hard_labels: Optional[Dict[str, Any]] = None  # NODE_LABEL constraints
+    soft_labels: Optional[Dict[str, Any]] = None
 
 
 @dataclass
@@ -164,8 +166,20 @@ class TaskSpec:
             self.scheduling_strategy.node_id,
             self.scheduling_strategy.placement_group_id,
             self.scheduling_strategy.bundle_index,
+            # label constraints route leases to different nodes — tasks with
+            # different constraints must never share a lease
+            _freeze(self.scheduling_strategy.hard_labels),
+            _freeze(self.scheduling_strategy.soft_labels),
             env_key,
         )
+
+
+def _freeze(labels: Optional[Dict[str, Any]]):
+    if not labels:
+        return None
+    return tuple(sorted(
+        (k, tuple(v) if isinstance(v, (list, set)) else v)
+        for k, v in labels.items()))
 
 
 class ActorState(Enum):

@@ -82,7 +82,8 @@ class GcsNodeManager:
         return {
             "status": "ok",
             "cluster_view": {
-                nid: (n.raylet_address, n.resources_total, n.resources_available)
+                nid: (n.raylet_address, n.resources_total,
+                      n.resources_available, n.labels)
                 for nid, n in self._nodes.items()
                 if n.alive
             },
@@ -160,17 +161,32 @@ class GcsNodeManager:
             out = [n.node_id for n in alive if n.node_id == strat.node_id]
             if out or not strat.soft:
                 return out
+        soft_pref: set = set()
+        if strat.kind == "NODE_LABEL":
+            from ray_tpu.raylet.scheduling_policy import _labels_match
+
+            alive = [n for n in alive
+                     if _labels_match(n.labels, strat.hard_labels or {})]
+            # soft constraints PREFER (sort first below) but never exclude:
+            # a preferred node that can't fit must fall back to the other
+            # hard-eligible nodes, matching the raylet's tiered policy
+            soft_pref = {
+                n.node_id for n in alive
+                if _labels_match(n.labels, strat.soft_labels or {})}
         candidates = [
             n.node_id
             for n in alive
             if resources_fit(n.resources_available, spec.resources)
             or resources_fit(n.resources_total, spec.resources)
         ]
-        # Most-available first (actors spread by default here; per-task
-        # fine-grained policy lives in the raylet's cluster task manager).
+        # Soft-label-preferred first, then most-available (actors spread by
+        # default here; per-task fine-grained policy lives in the raylet's
+        # cluster task manager).
         candidates.sort(
-            key=lambda nid: sum(self._nodes[nid].resources_available.values()),
-            reverse=True,
+            key=lambda nid: (
+                nid not in soft_pref,
+                -sum(self._nodes[nid].resources_available.values()),
+            ),
         )
         return candidates
 

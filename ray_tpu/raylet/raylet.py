@@ -131,6 +131,7 @@ class Raylet:
         self._queue: List[_QueuedLease] = []
         self._dispatch_event: Optional[asyncio.Event] = None
         self._cluster_view: policy.View = {}
+        self._cluster_labels: Dict[NodeID, Dict[str, str]] = {}
         self._spread_rr = 0
         self._log_dir = log_dir or os.path.join(CONFIG.log_dir, "workers")
         self._worker_env = worker_env
@@ -596,6 +597,12 @@ class Raylet:
         if strat.kind == "SPREAD":
             self._spread_rr += 1
             return policy.spread_policy(view, res, self._spread_rr)
+        if strat.kind == "NODE_LABEL":
+            labels = dict(self._cluster_labels)
+            labels.setdefault(self.node_id, self.labels)
+            return policy.node_label_policy(
+                view, res, labels, strat.hard_labels, strat.soft_labels,
+                self.node_id)
         return policy.hybrid_policy(view, res, self.node_id)
 
     def _raylet_addr_for(self, node_id: NodeID) -> Optional[str]:
@@ -853,9 +860,11 @@ class Raylet:
                     dict(info.resources_available),
                 )
                 self._cluster_addrs[info.node_id] = info.raylet_address
+                self._cluster_labels[info.node_id] = dict(info.labels)
             else:
                 self._cluster_view.pop(info.node_id, None)
                 self._cluster_addrs.pop(info.node_id, None)
+                self._cluster_labels.pop(info.node_id, None)
         return True
 
     # ------------------------------------------------------- background loops
@@ -894,8 +903,10 @@ class Raylet:
                 if reply.get("status") == "ok":
                     view = reply["cluster_view"]
                     self._cluster_addrs = {nid: v[0] for nid, v in view.items()}
+                    self._cluster_labels = {
+                        nid: v[3] for nid, v in view.items()}
                     new_view = {}
-                    for nid, (addr, total, avail) in view.items():
+                    for nid, (addr, total, avail, _labels) in view.items():
                         if nid == self.node_id:
                             new_view[nid] = (dict(self.total), dict(self.available))
                         else:

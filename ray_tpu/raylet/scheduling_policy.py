@@ -90,3 +90,50 @@ def node_affinity_policy(
     if soft:
         return hybrid_policy(view, demand, local_node)
     return None
+
+
+def _labels_match(labels: Dict[str, str], constraints: Dict[str, object]
+                  ) -> bool:
+    """{key: value} = equality, {key: None} = key exists,
+    {key: [v1, v2]} = value in set (reference: node-label scheduling's
+    In/Exists operators, node_label_scheduling_policy.cc)."""
+    for key, want in (constraints or {}).items():
+        have = labels.get(key)
+        if want is None:
+            if key not in labels:
+                return False
+        elif isinstance(want, (list, tuple, set)):
+            if have not in want:
+                return False
+        elif have != want:
+            return False
+    return True
+
+
+def node_label_policy(
+    view: View,
+    demand: Resources,
+    labels: Dict[NodeID, Dict[str, str]],
+    hard: Dict[str, object],
+    soft: Dict[str, object],
+    local_node: Optional[NodeID],
+) -> Optional[NodeID]:
+    """Hard label constraints filter; soft constraints prefer. Within each
+    tier, hybrid pack-then-spread ordering (reference:
+    scheduling/policy/node_label_scheduling_policy.cc)."""
+    eligible = {
+        nid: ta for nid, ta in view.items()
+        if _labels_match(labels.get(nid, {}), hard)
+    }
+    if not eligible:
+        return None
+    preferred = {
+        nid: ta for nid, ta in eligible.items()
+        if _labels_match(labels.get(nid, {}), soft)
+    }
+    for tier in (preferred, eligible):
+        if tier:
+            pick = hybrid_policy(tier, demand, local_node)
+            if pick is not None:
+                return pick
+    return None

@@ -76,12 +76,19 @@ class ClientProxyServer:
         self._lock = threading.Lock()
         self.address: Optional[str] = None
         self._reaper = None
+        # Dedicated pool for forwarded calls: blocking gets/waits can hold
+        # a thread for hours, and the event loop's DEFAULT executor is tiny
+        # (cpu+4) — a handful of blocked clients would starve client_init/
+        # client_close for every other session.
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._exec = ThreadPoolExecutor(
+            max_workers=128, thread_name_prefix="client-call")
 
     def start(self, port: int = 0) -> str:
         self._server.register("client_init", self._handle_init)
         self._server.register("client_call", self._handle_call)
         self._server.register("client_gcs", self._handle_gcs)
-        self._server.register("client_attr", self._handle_attr)
         self._server.register("client_close", self._handle_close)
         self.address = self._server.start(port)
         self._reaper = self._lt.submit(self._reaper_loop())
@@ -193,7 +200,8 @@ class ClientProxyServer:
 
         sess.inflight += 1
         try:
-            result = await asyncio.to_thread(run)
+            result = await asyncio.get_event_loop().run_in_executor(
+                self._exec, run)
             sess.pin_refs(result)
             return {"status": "ok", "data": cloudpickle.dumps(result)}
         except BaseException as e:  # noqa: BLE001 — errors are data here
@@ -225,25 +233,15 @@ class ClientProxyServer:
         sess.inflight += 1
         try:
             return {"status": "ok",
-                    "data": cloudpickle.dumps(await asyncio.to_thread(run))}
+                    "data": cloudpickle.dumps(
+                        await asyncio.get_event_loop().run_in_executor(
+                            self._exec, run))}
         except BaseException as e:  # noqa: BLE001
             return {"status": "exception",
                     "data": cloudpickle.dumps(RuntimeError(str(e)))}
         finally:
             sess.inflight -= 1
             sess.last_seen = time.monotonic()
-
-    async def _handle_attr(self, payload):
-        denied = self._auth(payload)
-        if denied:
-            return denied
-        sess = self._session(payload)
-        name = payload["name"]
-        if name not in ("job_id", "namespace", "gcs_address", "node_id",
-                        "worker_id", "address_str", "job_runtime_env"):
-            return {"status": "error", "message": f"attr {name!r} not allowed"}
-        return {"status": "ok",
-                "data": cloudpickle.dumps(getattr(sess.cw, name))}
 
     async def _handle_close(self, payload):
         import asyncio
@@ -261,6 +259,7 @@ class ClientProxyServer:
     def stop(self) -> None:
         if self._reaper is not None:
             self._reaper.cancel()
+        self._exec.shutdown(wait=False)
         with self._lock:
             sessions = list(self._sessions.values())
             self._sessions.clear()

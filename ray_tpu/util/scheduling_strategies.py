@@ -71,4 +71,8 @@ def to_spec(strategy: SchedulingStrategyT, options: dict) -> SchedulingStrategyS
         return SchedulingStrategySpec(
             kind="NODE_AFFINITY", node_id=node_id, soft=strategy.soft
         )
+    if isinstance(strategy, NodeLabelSchedulingStrategy):
+        return SchedulingStrategySpec(
+            kind="NODE_LABEL", hard_labels=dict(strategy.hard),
+            soft_labels=dict(strategy.soft))
     raise ValueError(f"unsupported scheduling strategy: {strategy!r}")

@@ -32,24 +32,31 @@ def proxy_cluster():
     ray_tpu.shutdown()
 
 
-def _run_client(addr: str, body: str, token: str = "sekrit-token") -> str:
-    script = textwrap.dedent(f"""
-        import os
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        import ray_tpu
-        ray_tpu.init("client://{addr}", token={token!r})
-        {textwrap.indent(textwrap.dedent(body), '        ').strip()}
-        ray_tpu.shutdown()
-        print("CLIENT-OK")
-    """)
+def _run_script(script: str, *, expect_ok: bool = True):
+    """One place for the subprocess-client env/timeout plumbing."""
     env = dict(os.environ, JAX_PLATFORMS="cpu",
                PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
     env.pop("PALLAS_AXON_POOL_IPS", None)
     proc = subprocess.run([sys.executable, "-c", script],
                           capture_output=True, text=True, timeout=180,
                           env=env)
-    assert proc.returncode == 0, proc.stderr[-3000:]
-    return proc.stdout
+    if expect_ok:
+        assert proc.returncode == 0, proc.stderr[-3000:]
+    return proc
+
+
+def _run_client(addr: str, body: str, token: str = "sekrit-token",
+                init_kwargs: str = "") -> str:
+    script = textwrap.dedent(f"""
+        import os
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import ray_tpu
+        ray_tpu.init("client://{addr}", token={token!r}{init_kwargs})
+        {textwrap.indent(textwrap.dedent(body), '        ').strip()}
+        ray_tpu.shutdown()
+        print("CLIENT-OK")
+    """)
+    return _run_script(script).stdout
 
 
 def test_client_tasks_put_get_wait(proxy_cluster):
@@ -136,35 +143,17 @@ def test_client_timeout_semantics_and_futures(proxy_cluster):
 
 
 def test_client_job_runtime_env(proxy_cluster):
-    out = _run_client_with_env(proxy_cluster)
-    assert "CLIENT-OK" in out and "envval=xyz" in out
-
-
-def _run_client_with_env(addr, token="sekrit-token"):
-    script = textwrap.dedent(f"""
-        import os
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        import ray_tpu
-        ray_tpu.init("client://{addr}", token={token!r},
-                     runtime_env={{"env_vars": {{"RT_CLIENT_TEST": "xyz"}}}})
-
+    out = _run_client(
+        proxy_cluster, """
         @ray_tpu.remote
         def readenv():
             import os
             return os.environ.get("RT_CLIENT_TEST")
 
         print("envval=" + str(ray_tpu.get(readenv.remote(), timeout=60)))
-        ray_tpu.shutdown()
-        print("CLIENT-OK")
-    """)
-    env = dict(os.environ, JAX_PLATFORMS="cpu",
-               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    proc = subprocess.run([sys.executable, "-c", script],
-                          capture_output=True, text=True, timeout=180,
-                          env=env)
-    assert proc.returncode == 0, proc.stderr[-3000:]
-    return proc.stdout
+        """,
+        init_kwargs=', runtime_env={"env_vars": {"RT_CLIENT_TEST": "xyz"}}')
+    assert "CLIENT-OK" in out and "envval=xyz" in out
 
 
 def test_client_bad_token_rejected(proxy_cluster):
@@ -178,12 +167,7 @@ def test_client_bad_token_rejected(proxy_cluster):
         except ConnectionError as e:
             print("REJECTED:", e)
     """)
-    env = dict(os.environ, JAX_PLATFORMS="cpu",
-               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    proc = subprocess.run([sys.executable, "-c", script],
-                          capture_output=True, text=True, timeout=120,
-                          env=env)
+    proc = _run_script(script, expect_ok=False)
     assert "REJECTED" in proc.stdout and "CONNECTED" not in proc.stdout
 
 

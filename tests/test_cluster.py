@@ -221,3 +221,52 @@ def test_gcs_state_survives_restart(tmp_path):
             gcs2.stop()
     finally:
         lt.stop()
+
+
+def test_node_label_scheduling(ray_start_cluster):
+    """NodeLabelSchedulingStrategy (reference: scheduling/policy/
+    node_label_scheduling_policy.cc + util/scheduling_strategies.py):
+    hard constraints filter nodes, soft constraints prefer, tasks AND
+    actors route by label."""
+    from ray_tpu.util.scheduling_strategies import NodeLabelSchedulingStrategy
+
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2, labels={"zone": "us-a", "disk": "ssd"})
+    cluster.add_node(num_cpus=2, labels={"zone": "us-b"})
+    cluster.wait_for_nodes()
+    cluster.connect()
+
+    labels_by_node = {n["NodeID"]: n["Labels"] for n in ray_tpu.nodes()}
+
+    @ray_tpu.remote(num_cpus=1)
+    def whereami():
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    # hard equality
+    nid = ray_tpu.get(whereami.options(
+        scheduling_strategy=NodeLabelSchedulingStrategy(
+            hard={"zone": "us-b"})).remote(), timeout=60)
+    assert labels_by_node[nid].get("zone") == "us-b"
+
+    # hard exists + soft preference
+    nid = ray_tpu.get(whereami.options(
+        scheduling_strategy=NodeLabelSchedulingStrategy(
+            hard={"zone": None}, soft={"disk": "ssd"})).remote(), timeout=60)
+    assert labels_by_node[nid].get("disk") == "ssd"
+
+    # "in"-style list constraint
+    nid = ray_tpu.get(whereami.options(
+        scheduling_strategy=NodeLabelSchedulingStrategy(
+            hard={"zone": ["us-a"]})).remote(), timeout=60)
+    assert labels_by_node[nid].get("zone") == "us-a"
+
+    # actor placement honors labels too
+    @ray_tpu.remote(num_cpus=1)
+    class Pin:
+        def where(self):
+            return ray_tpu.get_runtime_context().get_node_id()
+
+    a = Pin.options(scheduling_strategy=NodeLabelSchedulingStrategy(
+        hard={"zone": "us-a"})).remote()
+    assert labels_by_node[ray_tpu.get(a.where.remote(), timeout=60)][
+        "zone"] == "us-a"

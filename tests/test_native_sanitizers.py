@@ -1,0 +1,75 @@
+"""Sanitizer builds of the C++ shm store (SURVEY §5 race detection —
+reference: the TSAN/ASAN bazel configs, .bazelrc:104-121).
+
+The store compiles with -fsanitize=thread/address via
+RT_NATIVE_SANITIZE; the exercise (concurrent clients hammering
+create/seal/get/release on one server) runs in a subprocess with the
+sanitizer runtime preloaded, and any "ThreadSanitizer:"/"AddressSanitizer:"
+report fails the test.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_EXERCISE = r"""
+import os, threading, tempfile
+from ray_tpu._private.shm_store import StoreServer, StoreClient
+
+sock = os.path.join(tempfile.mkdtemp(), "store.sock")
+server = StoreServer(sock, capacity=64 << 20)
+
+def hammer(tid):
+    client = StoreClient(sock)
+    for i in range(200):
+        oid = bytes([tid]) * 4 + i.to_bytes(4, "little") + bytes(20)
+        client.put(oid, b"x" * (1024 + i))
+        data, _ = client.get(oid)
+        assert bytes(data[:1]) == b"x"
+        client.release(oid)
+    client.disconnect()
+
+threads = [threading.Thread(target=hammer, args=(t,)) for t in range(4)]
+for t in threads:
+    t.start()
+for t in threads:
+    t.join()
+server.stop()
+print("SANITIZED-RUN-OK")
+"""
+
+
+def _libsan(name: str):
+    out = subprocess.run(["g++", f"-print-file-name=lib{name}.so"],
+                         capture_output=True, text=True)
+    path = out.stdout.strip()
+    return path if os.path.isabs(path) and os.path.exists(path) else None
+
+
+@pytest.mark.parametrize("sanitizer,lib", [("thread", "tsan"),
+                                           ("address", "asan")])
+def test_shm_store_under_sanitizer(sanitizer, lib):
+    libpath = _libsan(lib)
+    if libpath is None:
+        pytest.skip(f"lib{lib} not available")
+    env = dict(os.environ,
+               RT_NATIVE_SANITIZE=sanitizer,
+               LD_PRELOAD=libpath,
+               JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    if sanitizer == "address":
+        # ctypes/python leak noise is not what this test is about
+        env["ASAN_OPTIONS"] = "detect_leaks=0"
+    proc = subprocess.run([sys.executable, "-c", _EXERCISE],
+                          capture_output=True, text=True, timeout=600,
+                          env=env)
+    assert "SANITIZED-RUN-OK" in proc.stdout, (
+        proc.stdout[-1500:] + proc.stderr[-3000:])
+    for marker in ("ThreadSanitizer:", "AddressSanitizer:"):
+        assert marker not in proc.stderr, proc.stderr[-4000:]
