@@ -1,0 +1,86 @@
+"""Grafana dashboard generation (reference: ray
+dashboard/modules/metrics/grafana_dashboard_factory.py — the dashboard
+writes ready-to-import Grafana JSON for the cluster's Prometheus series).
+
+Panels target the series the ray_tpu dashboard's /metrics endpoint
+exposes: ray_tpu_cluster_resource_total/available{resource=...},
+ray_tpu_cluster_nodes_alive, plus any user-defined util.metrics series.
+Import via Grafana -> Dashboards -> Import, with a Prometheus data source
+scraping the dashboard's /metrics.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Optional
+
+
+def _panel(panel_id: int, title: str, exprs: List[dict], y: int,
+           unit: str = "short") -> dict:
+    return {
+        "id": panel_id,
+        "title": title,
+        "type": "timeseries",
+        "datasource": {"type": "prometheus", "uid": "${datasource}"},
+        "gridPos": {"h": 8, "w": 12, "x": 12 * (panel_id % 2), "y": y},
+        "fieldConfig": {"defaults": {"unit": unit}, "overrides": []},
+        "targets": [
+            {"expr": t["expr"], "legendFormat": t.get("legend", ""),
+             "refId": chr(ord("A") + i)}
+            for i, t in enumerate(exprs)
+        ],
+    }
+
+
+def generate_grafana_dashboard(
+        extra_metric_names: Optional[List[str]] = None) -> dict:
+    """-> importable Grafana dashboard dict for the core cluster series."""
+    panels = [
+        _panel(0, "Alive nodes",
+               [{"expr": "ray_tpu_cluster_nodes_alive", "legend": "nodes"}],
+               y=0),
+        _panel(1, "CPU total vs available", [
+            {"expr": 'ray_tpu_cluster_resource_total{resource="CPU"}',
+             "legend": "total"},
+            {"expr": 'ray_tpu_cluster_resource_available{resource="CPU"}',
+             "legend": "available"},
+        ], y=0),
+        _panel(2, "TPU chips total vs available", [
+            {"expr": 'ray_tpu_cluster_resource_total{resource="TPU"}',
+             "legend": "total"},
+            {"expr": 'ray_tpu_cluster_resource_available{resource="TPU"}',
+             "legend": "available"},
+        ], y=8),
+        _panel(3, "Object store memory (bytes)", [
+            {"expr": 'ray_tpu_cluster_resource_total{resource="memory"}',
+             "legend": "total"},
+            {"expr": 'ray_tpu_cluster_resource_available{resource="memory"}',
+             "legend": "available"},
+        ], y=8, unit="bytes"),
+    ]
+    next_id = 4
+    for name in extra_metric_names or []:
+        panels.append(_panel(next_id, name, [{"expr": name}],
+                             y=16 + 8 * ((next_id - 4) // 2)))
+        next_id += 1
+    return {
+        "title": "ray_tpu cluster",
+        "uid": "ray-tpu-cluster",
+        "schemaVersion": 36,
+        "refresh": "10s",
+        "time": {"from": "now-30m", "to": "now"},
+        "templating": {"list": [{
+            "name": "datasource", "type": "datasource",
+            "query": "prometheus",
+        }]},
+        "panels": panels,
+    }
+
+
+def write_grafana_dashboard(path: str,
+                            extra_metric_names: Optional[List[str]] = None
+                            ) -> str:
+    with open(path, "w") as f:
+        json.dump(generate_grafana_dashboard(extra_metric_names), f,
+                  indent=2)
+    return path

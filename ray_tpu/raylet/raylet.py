@@ -569,6 +569,16 @@ class Raylet:
 
         if spillback_count == 0:
             target = self._cluster_decision(spec)
+            if target is None and strat.kind == "NODE_LABEL":
+                # hard label constraints are HARD: falling through to the
+                # local queue would run the task on a non-matching node.
+                # Reject so the submitter keeps retrying (pending until a
+                # matching node joins) and the shape reads as infeasible
+                # demand for the autoscaler.
+                shape = tuple(sorted(_placement_res(spec).items()))
+                self._infeasible[shape] = time.monotonic()
+                return {"rejected": True,
+                        "reason": "no node satisfies the label constraints"}
             if target is not None and target != self.node_id:
                 addr = self._raylet_addr_for(target)
                 if addr is not None:
@@ -781,6 +791,16 @@ class Raylet:
                     # the lease and reports actor death (restart FSM)
                     self.worker_pool.kill_worker(handle)
         self._kick()
+        return True
+
+    async def handle_die(self, payload):
+        """Chaos RPC (`ray-tpu kill-random-node`): ungraceful PROCESS death
+        — the GCS discovers it via missed heartbeats, exercising the same
+        recovery paths as a crashed host. Only meaningful for raylets
+        running as their own process (`python -m ray_tpu start`)."""
+        threading.Thread(
+            target=lambda: (time.sleep(0.05), os._exit(1)),
+            daemon=True).start()
         return True
 
     async def handle_tail_worker_logs(self, payload):

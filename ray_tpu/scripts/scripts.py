@@ -310,6 +310,60 @@ def cmd_logs(args) -> int:
     return 0
 
 
+def cmd_metrics(args) -> int:
+    """`metrics grafana-dashboard`: write importable Grafana JSON for the
+    cluster's Prometheus series (reference: `ray metrics` + the dashboard's
+    grafana_dashboard_factory.py)."""
+    if args.metrics_cmd == "grafana-dashboard":
+        from ray_tpu.dashboard.grafana import write_grafana_dashboard
+
+        out = args.output or "ray_tpu_grafana_dashboard.json"
+        write_grafana_dashboard(out)
+        print(f"wrote {out} (import in Grafana with a Prometheus data "
+              "source scraping the dashboard /metrics endpoint)")
+        return 0
+    print(f"unknown metrics subcommand {args.metrics_cmd!r}")
+    return 1
+
+
+def cmd_kill_random_node(args) -> int:
+    """Chaos helper (reference: `ray kill-random-node`, scripts.py:1384):
+    ungracefully kill one random non-head node's raylet process so
+    failure-recovery paths can be exercised on a live cluster."""
+    import random
+
+    ray_tpu = _connect(args)
+    from ray_tpu._raylet import get_core_worker
+
+    cw = get_core_worker()
+    nodes = [n for n in cw._gcs.call("get_all_node_info", {})
+             if n.alive and not n.is_head]
+    if not nodes:
+        print("no non-head nodes to kill")
+        ray_tpu.shutdown()
+        return 1
+    victim = random.choice(nodes)
+    if not args.yes:
+        print(f"would kill node {victim.node_id.hex()[:12]} at "
+              f"{victim.raylet_address}; pass --yes to proceed")
+        ray_tpu.shutdown()
+        return 1
+    try:
+        # a successful send never raises (the raylet delays its os._exit
+        # past the reply), so any exception here is genuine non-delivery
+        cw._peers.get(victim.raylet_address).send("die", {})
+    except Exception as e:  # noqa: BLE001
+        print(f"FAILED to reach node {victim.node_id.hex()[:12]} at "
+              f"{victim.raylet_address}: {e}")
+        ray_tpu.shutdown()
+        return 1
+    print(f"killed node {victim.node_id.hex()[:12]} "
+          f"({victim.raylet_address}); the GCS will notice via missed "
+          "heartbeats")
+    ray_tpu.shutdown()
+    return 0
+
+
 def cmd_client_server(args) -> int:
     """Run the client proxy (reference: `ray start --ray-client-server-port`
     / util/client/server): remote drivers connect with
@@ -530,6 +584,17 @@ def main(argv=None) -> int:
     sp.add_argument("--all", action="store_true",
                     help="include workers with empty logs")
     sp.set_defaults(fn=cmd_logs)
+
+    sp = sub.add_parser("metrics", help="metrics tooling")
+    sp.add_argument("metrics_cmd", choices=["grafana-dashboard"])
+    sp.add_argument("-o", "--output")
+    sp.set_defaults(fn=cmd_metrics)
+
+    sp = sub.add_parser("kill-random-node",
+                        help="chaos: ungracefully kill a random worker node")
+    sp.add_argument("--address")
+    sp.add_argument("--yes", action="store_true")
+    sp.set_defaults(fn=cmd_kill_random_node)
 
     sp = sub.add_parser("client-server",
                         help="run the client proxy for remote drivers")

@@ -38,7 +38,8 @@ class NodeLabelSchedulingStrategy:
 
 
 SchedulingStrategyT = Union[
-    None, str, PlacementGroupSchedulingStrategy, NodeAffinitySchedulingStrategy
+    None, str, PlacementGroupSchedulingStrategy,
+    NodeAffinitySchedulingStrategy, NodeLabelSchedulingStrategy,
 ]
 
 

@@ -270,3 +270,34 @@ def test_node_label_scheduling(ray_start_cluster):
         hard={"zone": "us-a"})).remote()
     assert labels_by_node[ray_tpu.get(a.where.remote(), timeout=60)][
         "zone"] == "us-a"
+
+
+def test_node_label_hard_constraint_never_violated(ray_start_cluster):
+    """A hard label constraint no node satisfies must leave the task
+    PENDING (infeasible demand for the autoscaler) — never silently run on
+    a non-matching node."""
+    import pytest
+
+    from ray_tpu import exceptions as exc
+    from ray_tpu.util.scheduling_strategies import NodeLabelSchedulingStrategy
+
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2, labels={"zone": "us-a"})
+    cluster.wait_for_nodes()
+    cluster.connect()
+
+    @ray_tpu.remote(num_cpus=1)
+    def whereami():
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    ref = whereami.options(
+        scheduling_strategy=NodeLabelSchedulingStrategy(
+            hard={"zone": "mars"})).remote()
+    with pytest.raises(exc.GetTimeoutError):
+        ray_tpu.get(ref, timeout=3)
+
+    # a matching node joins -> the pending task schedules there
+    cluster.add_node(num_cpus=2, labels={"zone": "mars"})
+    nid = ray_tpu.get(ref, timeout=60)
+    labels = {n["NodeID"]: n["Labels"] for n in ray_tpu.nodes()}
+    assert labels[nid].get("zone") == "mars"
