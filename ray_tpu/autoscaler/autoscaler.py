@@ -56,7 +56,8 @@ class StandardAutoscaler:
         cfg = self.config["node_types"][node_type]
         logger.info("autoscaler launching %d x %s", count, node_type)
         self.provider.create_node(
-            {"resources": cfg.get("resources") or {}},
+            {"resources": cfg.get("resources") or {},
+             "labels": cfg.get("labels") or {}},
             {TAG_NODE_TYPE: node_type, TAG_NODE_STATUS: STATUS_UP},
             count,
         )
@@ -66,9 +67,12 @@ class StandardAutoscaler:
     def update(self) -> None:
         load = self.gcs.call("get_cluster_load", {})
         nodes = load["nodes"]
-        demands = [(dict(s), c) for s, c in load.get("demands", [])]
+        # demand entries: (shape, count, hard_labels_or_None), normalized at
+        # the GCS boundary — labeled demand only counts against nodes/types
+        # with matching labels
+        demands = [(dict(s), c, lbl) for s, c, lbl in load.get("demands", [])]
         for bundle in load.get("pending_pg_bundles", []):
-            demands.append((dict(bundle), 1))
+            demands.append((dict(bundle), 1, None))
 
         # ONE provider scan per reconcile cycle (batching providers flush
         # their previous cycle's request on scan — a second scan mid-cycle
@@ -80,11 +84,14 @@ class StandardAutoscaler:
         pending_fn = getattr(self.provider, "pending_nodes", None)
         pending: Dict[str, int] = pending_fn() if pending_fn else {}
         pending_avail = []
+        pending_avail_labels = []
         for t, num in pending.items():
             counts[t] = counts.get(t, 0) + num
-            res = (self.config.get("node_types", {})
-                   .get(t, {}).get("resources") or {})
+            cfg = self.config.get("node_types", {}).get(t, {})
+            res = cfg.get("resources") or {}
             pending_avail.extend(dict(res) for _ in range(num))
+            pending_avail_labels.extend(
+                dict(cfg.get("labels") or {}) for _ in range(num))
 
         # 1. min_workers floor per type.
         for name, cfg in self.config.get("node_types", {}).items():
@@ -94,12 +101,17 @@ class StandardAutoscaler:
                 counts[name] = counts.get(name, 0) + deficit
 
         # 2. demand-driven scale-up (bin-packing over free capacity,
-        #    including the capacity of nodes still provisioning).
+        #    including the capacity of nodes still provisioning; labeled
+        #    demand packs only onto label-matching nodes).
         if demands:
-            avail = [dict(n["available"]) for n in nodes.values() if n["alive"]]
+            live = [n for n in nodes.values() if n["alive"]]
+            avail = [dict(n["available"]) for n in live]
+            avail_labels = [dict(n.get("labels") or {}) for n in live]
             avail.extend(pending_avail)
+            avail_labels.extend(pending_avail_labels)
             to_launch = get_nodes_to_launch(
-                self.config.get("node_types", {}), avail, demands, counts)
+                self.config.get("node_types", {}), avail, demands, counts,
+                existing_labels=avail_labels)
             total_cap = self.config.get("max_workers", 2**31)
             total_now = sum(counts.values())
             for name, count in to_launch.items():

@@ -90,6 +90,7 @@ class LocalNodeProvider(NodeProvider):
             raylet = Raylet(
                 gcs_address=self.gcs_address,
                 resources=dict(node_config.get("resources") or {}),
+                labels=dict(node_config.get("labels") or {}),
             )
             raylet.start(0)
             with self._lock:

@@ -27,29 +27,44 @@ def _subtract(avail: Resources, demand: Resources) -> None:
 def get_nodes_to_launch(
     node_types: Dict[str, dict],
     existing_available: List[Resources],
-    demands: List[Tuple[Resources, int]],
+    demands: List[Tuple],
     counts_by_type: Dict[str, int],
+    existing_labels: Optional[List[dict]] = None,
 ) -> Dict[str, int]:
     """-> {node_type: count to launch}.
 
-    node_types: {name: {"resources": {...}, "max_workers": int}}
+    node_types: {name: {"resources": {...}, "max_workers": int,
+                        "labels": {...} (optional)}}
     existing_available: free resources of live nodes (simulated mutable)
-    demands: [(shape, count)] pending demand aggregated by shape
+    existing_labels: node labels parallel to existing_available (labeled
+        demand only packs onto nodes whose labels match)
+    demands: [(shape, count)] or [(shape, count, hard_labels)] — pending
+        demand aggregated by shape; label-constrained demand only counts
+        against matching existing/planned capacity or node types whose
+        declared labels match.
     counts_by_type: current node count per type (for max_workers caps)
     """
+    from ray_tpu.raylet.scheduling_policy import _labels_match
+
     sim = [dict(a) for a in existing_available]
+    sim_labels: List[dict] = [dict(lbl) for lbl in (existing_labels or [])]
+    sim_labels += [{}] * (len(sim) - len(sim_labels))
     planned: Dict[str, int] = {}
 
-    flat: List[Resources] = []
-    for shape, count in demands:
-        flat.extend([shape] * min(count, 1000))
+    flat: List[Tuple[Resources, Optional[dict]]] = []
+    for entry in demands:
+        shape, count = entry[0], entry[1]
+        labels = entry[2] if len(entry) > 2 else None
+        flat.extend([(shape, labels)] * min(count, 1000))
     # Pack big demands first — reduces fragmentation, like the reference's
     # sorted bin-packing.
-    flat.sort(key=lambda d: -sum(d.values()))
+    flat.sort(key=lambda d: -sum(d[0].values()))
 
-    for demand in flat:
+    for demand, labels in flat:
         placed = False
-        for avail in sim:
+        for i, avail in enumerate(sim):
+            if labels and not _labels_match(sim_labels[i], labels):
+                continue
             if _fits(avail, demand):
                 _subtract(avail, demand)
                 placed = True
@@ -57,7 +72,7 @@ def get_nodes_to_launch(
         if placed:
             continue
         # Choose the feasible type with the least total resources (cheapest
-        # that fits), respecting max_workers.
+        # that fits), respecting max_workers and label constraints.
         best: Optional[str] = None
         best_size = float("inf")
         for name, cfg in node_types.items():
@@ -65,6 +80,8 @@ def get_nodes_to_launch(
             cap = cfg.get("max_workers", 0)
             current = counts_by_type.get(name, 0) + planned.get(name, 0)
             if current >= cap:
+                continue
+            if labels and not _labels_match(cfg.get("labels") or {}, labels):
                 continue
             if _fits(dict(res), demand):
                 size = sum(res.values())
@@ -76,4 +93,5 @@ def get_nodes_to_launch(
         avail = dict(node_types[best].get("resources") or {})
         _subtract(avail, demand)
         sim.append(avail)
+        sim_labels.append(dict(node_types[best].get("labels") or {}))
     return planned

@@ -51,7 +51,7 @@ def generate_grafana_dashboard(
             {"expr": 'ray_tpu_cluster_resource_available{resource="TPU"}',
              "legend": "available"},
         ], y=8),
-        _panel(3, "Object store memory (bytes)", [
+        _panel(3, "Node heap memory resource (bytes)", [
             {"expr": 'ray_tpu_cluster_resource_total{resource="memory"}',
              "legend": "total"},
             {"expr": 'ray_tpu_cluster_resource_available{resource="memory"}',

@@ -101,8 +101,10 @@ class GcsNodeManager:
             info = self._nodes.get(nid)
             if info is None or not info.alive:
                 continue
-            for shape, count in shapes:
-                key = tuple(sorted(shape.items()))
+            for shape, count, labels in shapes:
+                from ray_tpu._private.specs import _freeze
+
+                key = (tuple(sorted(shape.items())), _freeze(labels) or ())
                 demands[key] = demands.get(key, 0) + count
         pending_pgs = []
         if self.pg_locator is not None:
@@ -118,7 +120,8 @@ class GcsNodeManager:
                 }
                 for nid, n in self._nodes.items()
             },
-            "demands": [(dict(k), v) for k, v in demands.items()],
+            "demands": [(dict(res), v, dict(labels) or None)
+                        for (res, labels), v in demands.items()],
             "pending_pg_bundles": pending_pgs,
         }
 

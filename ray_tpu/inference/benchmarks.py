@@ -15,7 +15,7 @@ from typing import Any, Dict, Optional
 
 def benchmark_engine(config: Optional[Any] = None, *, max_batch: int = 8,
                      max_len: int = 512, new_tokens: int = 64,
-                     mesh=None) -> Dict[str, Any]:
+                     decode_chunk: int = 32, mesh=None) -> Dict[str, Any]:
     import jax
 
     from ray_tpu.inference.engine import GenerationConfig, InferenceEngine
@@ -26,8 +26,12 @@ def benchmark_engine(config: Optional[Any] = None, *, max_batch: int = 8,
         config = (llama.LlamaConfig.small_1b() if on_tpu
                   else llama.LlamaConfig.tiny())
     params = llama.init(config, jax.random.PRNGKey(0))
+    # large decode chunk: the bench chip sits behind a high-latency tunnel
+    # (~100ms+/dispatch), so throughput is dispatch-bound — more scan steps
+    # per dispatch isolates the number from tunnel weather
     eng = InferenceEngine(params, config, max_batch=max_batch,
-                          max_len=max_len, mesh=mesh)
+                          max_len=max_len, mesh=mesh,
+                          decode_chunk=decode_chunk)
     gen = GenerationConfig(max_new_tokens=new_tokens)
     prompts = [[1 + (i % 31)] * 16 for i in range(max_batch)]
 

@@ -573,9 +573,13 @@ class Raylet:
                 # hard label constraints are HARD: falling through to the
                 # local queue would run the task on a non-matching node.
                 # Reject so the submitter keeps retrying (pending until a
-                # matching node joins) and the shape reads as infeasible
-                # demand for the autoscaler.
-                shape = tuple(sorted(_placement_res(spec).items()))
+                # matching node joins); the shape + its label constraint
+                # read as infeasible demand, which the autoscaler only
+                # counts against node types declaring matching labels.
+                from ray_tpu._private.specs import _freeze
+
+                shape = (tuple(sorted(_placement_res(spec).items())),
+                         _freeze(strat.hard_labels) or ())
                 self._infeasible[shape] = time.monotonic()
                 return {"rejected": True,
                         "reason": "no node satisfies the label constraints"}
@@ -591,7 +595,7 @@ class Raylet:
             # the autoscaler (reference: the infeasible-task queue in
             # cluster_task_manager is reported as load), otherwise a task no
             # node can host never triggers scale-up.
-            shape = tuple(sorted(_placement_res(spec).items()))
+            shape = (tuple(sorted(_placement_res(spec).items())), ())
             self._infeasible[shape] = time.monotonic()
             return {"rejected": True, "reason": "infeasible on this node"}
         return await self._queue_local(spec)
@@ -895,9 +899,15 @@ class Raylet:
                 # Aggregate queued lease shapes so the autoscaler can
                 # bin-pack unfulfilled demand (reference: load reported to
                 # GCS drives resource_demand_scheduler.py).
+                from ray_tpu._private.specs import _freeze
+
                 demand_counts: Dict[tuple, int] = {}
                 for q in self._queue[:200]:
-                    shape = tuple(sorted(_placement_res(q.spec).items()))
+                    strat = q.spec.scheduling_strategy
+                    labels = ((_freeze(strat.hard_labels) or ())
+                              if strat.kind == "NODE_LABEL" else ())
+                    shape = (tuple(sorted(_placement_res(q.spec).items())),
+                             labels)
                     demand_counts[shape] = demand_counts.get(shape, 0) + 1
                 # Infeasible shapes seen in the last 5s count as demand
                 # (the submitter is still retrying them against us).
@@ -915,7 +925,8 @@ class Raylet:
                         "total": dict(self.total),
                         "load": len(self._queue),
                         "pending_demands": [
-                            (dict(shape), n) for shape, n in demand_counts.items()
+                            (dict(res), n, dict(labels) or None)
+                            for (res, labels), n in demand_counts.items()
                         ],
                     },
                     timeout=5.0,

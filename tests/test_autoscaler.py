@@ -147,3 +147,43 @@ def test_min_workers_floor():
         assert len(cluster.provider.non_terminated_nodes()) == 2
     finally:
         cluster.shutdown()
+
+
+def test_labeled_demand_scales_matching_node_type():
+    """A NODE_LABEL task no live node satisfies must autoscale a node type
+    DECLARING matching labels (plain resource bin-packing would wrongly
+    conclude existing idle CPUs suffice), then schedule onto it."""
+    import ray_tpu
+    from ray_tpu.cluster_utils import AutoscalingCluster
+    from ray_tpu.util.scheduling_strategies import NodeLabelSchedulingStrategy
+
+    cluster = AutoscalingCluster(
+        head_resources={"CPU": 4},
+        worker_node_types={
+            "plain": {"resources": {"CPU": 4}, "min_workers": 0,
+                      "max_workers": 2},
+            "gpu-zone": {"resources": {"CPU": 2}, "min_workers": 0,
+                         "max_workers": 2, "labels": {"zone": "mars"}},
+        },
+        idle_timeout_s=60.0)
+    try:
+        cluster.start()
+        cluster.connect()
+
+        @ray_tpu.remote(num_cpus=1)
+        def constrained():
+            return ray_tpu.get_runtime_context().get_node_id()
+
+        nid = ray_tpu.get(constrained.options(
+            scheduling_strategy=NodeLabelSchedulingStrategy(
+                hard={"zone": "mars"})).remote(), timeout=90)
+        labels = {n["NodeID"]: n["Labels"] for n in ray_tpu.nodes()}
+        assert labels[nid].get("zone") == "mars"
+        # the unlabeled type was NOT launched for this demand
+        from ray_tpu.autoscaler.node_provider import TAG_NODE_TYPE
+
+        types = [cluster.provider.node_tags(n).get(TAG_NODE_TYPE)
+                 for n in cluster.provider.non_terminated_nodes()]
+        assert "plain" not in types
+    finally:
+        cluster.shutdown()

@@ -146,7 +146,7 @@ def test_no_relaunch_while_slice_provisions():
                        "max_workers": 4}}}
     autoscaler = StandardAutoscaler(config, provider, gcs,
                                     idle_timeout_s=60.0)
-    gcs.demands = [({"TPU": 4.0}, 2)]
+    gcs.demands = [({"TPU": 4.0}, 2, None)]
     for _ in range(5):  # many cycles, operator hasn't created pods yet
         autoscaler.update()
     api.reconcile()
@@ -167,7 +167,7 @@ def test_autoscaler_scales_fake_gke_cluster_end_to_end():
                                     idle_timeout_s=0.0)
 
     # demand for two 4-chip gang bundles -> scale up 2 workers
-    gcs.demands = [({"TPU": 4.0}, 2)]
+    gcs.demands = [({"TPU": 4.0}, 2, None)]
     autoscaler.update()   # buffers the create
     autoscaler.update()   # flush on next scan (batching semantics)
     api.reconcile()
