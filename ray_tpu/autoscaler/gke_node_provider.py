@@ -122,8 +122,11 @@ class GkeNodeProvider(BatchingNodeProvider):
                 nid for nid in req.workers_to_delete
                 if self._node_data.get(nid)
                 and self._node_data[nid].node_type == name)
-            if to_delete:
-                group["scaleStrategy"] = {"workersToDelete": to_delete}
+            # ALWAYS set scaleStrategy: the GET above may carry a stale
+            # workersToDelete list from a prior cycle, and re-PATCHing it
+            # verbatim on a later scale-up would re-delete recovered pods.
+            # An empty list clears stale entries.
+            group["scaleStrategy"] = {"workersToDelete": to_delete}
         self.api.request(
             "PATCH", path, {"spec": {"workerGroupSpecs": groups}},
             content_type="application/merge-patch+json")

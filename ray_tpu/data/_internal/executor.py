@@ -15,7 +15,9 @@ produces many blocks yields them to downstream stages as they materialize.
 
 from __future__ import annotations
 
+import functools
 import logging
+import os
 from typing import Any, Callable, Iterator, List
 
 import numpy as np
@@ -284,8 +286,9 @@ def _exchange_map(block: Block, n_out: int, spec: dict, block_index: int):
         return tuple(empty for _ in range(n_out)) if n_out > 1 else empty
     if kind == "shuffle":
         seed = spec.get("seed")
-        # unseeded shuffles draw fresh OS entropy per task (a fixed stand-in
-        # seed would repeat the same permutation every epoch)
+        # the seed is always concrete by the time a task runs (resolved
+        # driver-side per execution) so fault-recovery re-runs of this map
+        # task reproduce the identical partition assignment
         rng = np.random.default_rng(
             None if seed is None else (seed, block_index))
         assign = rng.integers(n_out, size=rows)
@@ -339,11 +342,24 @@ def _sample_sort_key(block: Block, key: str, max_samples: int = 100):
     return col
 
 
+@functools.lru_cache(maxsize=None)
+def _exchange_task(name: str, num_returns: int = 1):
+    """Memoized module-level remote wrappers for the exchange tasks.
+
+    Minting a fresh ``ray_tpu.remote(...)`` per execution re-serializes the
+    function and re-runs the prepare-once branch on every exchange; memoizing
+    keeps one wrapper (and one lease-cache scheduling key) per
+    (function, num_returns) for the process lifetime.
+    """
+    fn = {"map": _exchange_map, "reduce": _exchange_reduce,
+          "count": _block_num_rows, "sample": _sample_sort_key}[name]
+    task = ray_tpu.remote(fn)
+    return task.options(num_returns=num_returns) if num_returns > 1 else task
+
+
 def _exchange(refs: List[Any], n_out: int, spec: dict) -> Iterator[Any]:
-    map_task = ray_tpu.remote(_exchange_map)
-    reduce_task = ray_tpu.remote(_exchange_reduce)
-    if n_out > 1:
-        map_task = map_task.options(num_returns=n_out)
+    map_task = _exchange_task("map", n_out if n_out > 1 else 1)
+    reduce_task = _exchange_task("reduce")
     parts = []
     for i, ref in enumerate(refs):
         out = map_task.remote(ref, n_out, spec, i)
@@ -366,7 +382,7 @@ def _repartition_stage(stream, num_blocks: int):
         return
     # metadata pass: per-block counts -> global offsets, so output
     # partitions are contiguous global slices (order preserved)
-    count = ray_tpu.remote(_block_num_rows)
+    count = _exchange_task("count")
     counts = ray_tpu.get([count.remote(r) for r in refs])
     offsets = [0]
     for c in counts[:-1]:
@@ -381,6 +397,13 @@ def _shuffle_stage(stream, seed):
     if not refs:
         yield ray_tpu.put(BlockAccessor.rows_to_block([]))
         return
+    if seed is None:
+        # Resolve a concrete seed per EXECUTION (not per task run): an
+        # unseeded map task that re-executes for fault recovery must
+        # reproduce the same partition assignment, or reduce outputs
+        # silently duplicate/drop rows. Fresh entropy here keeps each
+        # epoch's permutation distinct.
+        seed = int.from_bytes(os.urandom(8), "little")
     yield from _exchange(refs, len(refs), {"kind": "shuffle", "seed": seed})
 
 
@@ -395,7 +418,7 @@ def _sort_stage(stream, key, descending: bool):
             "descending": descending, "bounds": []}
     if n_out > 1:
         # sample the primary key across blocks -> quantile range bounds
-        sample = ray_tpu.remote(_sample_sort_key)
+        sample = _exchange_task("sample")
         cols = ray_tpu.get([sample.remote(r, keys[0]) for r in refs])
         allv = np.sort(np.concatenate([c for c in cols if len(c)]))
         if len(allv) == 0:

@@ -232,12 +232,13 @@ class Raylet:
             for batch in batches:
                 path = batch.pop("path")
                 new_offset = batch.pop("new_offset")
-                try:
-                    await self._gcs.send_async("publish_logs", batch)
-                except (ConnectionLost, OSError):
-                    # offset NOT committed: these lines re-read and re-send
-                    # next cycle (a GCS blip loses nothing)
-                    break
+                if not batch.pop("skip", False):
+                    try:
+                        await self._gcs.send_async("publish_logs", batch)
+                    except (ConnectionLost, OSError):
+                        # offset NOT committed: these lines re-read and
+                        # re-send next cycle (a GCS blip loses nothing)
+                        break
                 offsets[path] = new_offset
 
     def _collect_new_log_lines(self, offsets: Dict[str, int]):
@@ -279,11 +280,31 @@ class Raylet:
             data = data[:cut + 1]
             end = start + cut + 1
             # split [start, end) into per-job segments at the marks
-            marks = list(handle.job_marks)
+            with handle.marks_lock:
+                marks = list(handle.job_marks)
+            unattributed = False
+            if not marks:
+                # Never-leased worker: no mark to attribute against. While
+                # it lives, DEFER (offset uncommitted; its startup output
+                # attributes to its first lease next scan). If it died
+                # without ever leasing — a startup crash — ship the output
+                # explicitly unattributed so drivers can surface it.
+                if handle.state != "dead":
+                    continue
+                unattributed = True
             base_job = None
             for off, job in marks:
                 if off <= start:
                     base_job = job
+            if base_job is None and marks:
+                # bytes before the first mark: startup output of a worker
+                # that went on to lease — attribute to that first job
+                base_job = marks[0][1]
+            # prune marks superseded by the base: offsets only move
+            # forward, so anything older than the mark covering `start`
+            # can never attribute future bytes (keeps the 64-entry bound
+            # in mark_job from ever evicting the live base mark)
+            handle.prune_job_marks(start)
             cuts = [(off, job) for off, job in marks if start < off < end]
             segs = []
             prev, prev_job = start, base_job
@@ -293,6 +314,13 @@ class Raylet:
             segs.append((prev, end, prev_job))
             first = True
             for s, e, job in segs:
+                if job is None and not unattributed:
+                    # attribution was dropped (mark-overflow collapse, or a
+                    # job-less system lease): advance past these bytes
+                    # without publishing — never misattribute them
+                    batches.append({"path": path, "new_offset": e,
+                                    "skip": True})
+                    continue
                 lines = data[s - start:e - start].decode(
                     "utf-8", "replace").splitlines()
                 if len(lines) > 1000:  # flood guard: keep the newest
@@ -310,6 +338,7 @@ class Raylet:
                     "worker_id": handle.worker_id.hex()
                     if handle.worker_id else None,
                     "job_id": job,
+                    "unattributed": unattributed,
                     "lines": lines,
                     "path": path,
                     "new_offset": e,

@@ -13,6 +13,7 @@ import logging
 import os
 import subprocess
 import sys
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
@@ -42,6 +43,8 @@ class WorkerHandle:
     # log attribution is by WRITE position, so a re-leased worker's old
     # output still goes to the job that produced it.
     job_marks: list = field(default_factory=list)
+    marks_lock: threading.Lock = field(default_factory=threading.Lock)
+    dead_since: float = 0.0  # monotonic time the reaper saw the exit
 
     def mark_job(self, job_hex: Optional[str]) -> None:
         if job_hex == self.last_job_hex:
@@ -53,9 +56,32 @@ class WorkerHandle:
                 offset = os.path.getsize(self.log_path)
             except OSError:
                 pass
-        self.job_marks.append((offset, job_hex))
-        if len(self.job_marks) > 64:  # bounded; monitor prunes consumed
-            del self.job_marks[:-64]
+        with self.marks_lock:
+            self.job_marks.append((offset, job_hex))
+            # Bounded: the log monitor prunes consumed marks; if 64+ job
+            # switches pile up between scans (GCS publish outage), collapse
+            # the two OLDEST marks into one unattributed (job=None) region.
+            # The monitor skips None regions rather than shipping them —
+            # bounded loss of the oldest unshipped lines, never a cross-job
+            # misattribution.
+            while len(self.job_marks) > 64:
+                self.job_marks[0:2] = [(self.job_marks[0][0], None)]
+
+    def prune_job_marks(self, base_off: int) -> None:
+        """Drop marks strictly older than the last one at/below
+        ``base_off`` (the log monitor's uncommitted read offset). The
+        monitor calls this from a worker thread while mark_job mutates on
+        the event loop — marks_lock serializes both."""
+        with self.marks_lock:
+            marks = self.job_marks
+            keep = 0
+            for i in range(len(marks)):
+                if marks[i][0] <= base_off:
+                    keep = i
+                else:
+                    break
+            if keep > 0:
+                del marks[:keep]
     # Runtime-env hash applied in this worker ("" = pristine). A worker that
     # ran under an env can ONLY serve that env again — the reference
     # dedicates workers per runtime env; returning one to the general pool
@@ -286,13 +312,20 @@ class WorkerPool:
                     if handle.state != "dead":
                         prev_state = handle.state
                         handle.state = "dead"
+                        handle.dead_since = now
                         try:
                             self._on_worker_death(handle, prev_state)
                         except Exception:
                             logger.exception("worker-death callback failed")
                     if handle.worker_id is not None:
                         self._registered.pop(handle.worker_id, None)
-                    del self._workers[pid]
+                    # Keep the dead handle visible for a grace period: the
+                    # log monitor (scan period ~500ms) must get at least one
+                    # scan over the corpse to ship its final output — for a
+                    # never-leased worker that's the only chance its startup
+                    # crash traceback reaches any driver.
+                    if now - handle.dead_since > 1.5:
+                        del self._workers[pid]
                 elif (
                     handle.state == "idle"
                     and now - handle.idle_since > idle_timeout

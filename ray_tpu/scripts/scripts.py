@@ -398,8 +398,10 @@ def cmd_profile(args) -> int:
 
     cw = get_core_worker()
     payload = {"pid": args.pid,
-               "kind": "memory" if args.memory else "cpu",
-               "duration_s": args.duration, "top": args.top}
+               "kind": "memory" if (args.memory or getattr(
+                   args, "memory_stop", False)) else "cpu",
+               "duration_s": args.duration, "top": args.top,
+               "stop": bool(getattr(args, "memory_stop", False))}
     reply = None
     try:
         for n in cw._gcs.call("get_all_node_info", {}):
@@ -421,7 +423,7 @@ def cmd_profile(args) -> int:
     if reply is None:
         print(f"no live worker with pid {args.pid}")
         return 1
-    if args.memory:
+    if args.memory or getattr(args, "memory_stop", False):
         print(_json.dumps(reply, indent=2))
     else:
         # flamegraph.pl / speedscope-compatible folded stacks
@@ -611,6 +613,10 @@ def main(argv=None) -> int:
     sp.add_argument("--duration", type=float, default=5.0)
     sp.add_argument("--memory", action="store_true",
                     help="heap snapshot (tracemalloc) instead of CPU")
+    sp.add_argument("--memory-stop", action="store_true",
+                    help="take a final heap snapshot and STOP tracemalloc "
+                         "in the worker (disarms the per-allocation "
+                         "overhead a prior --memory run left behind)")
     sp.add_argument("--top", type=int, default=40)
     sp.set_defaults(fn=cmd_profile)
 

@@ -60,13 +60,18 @@ def folded_to_text(profile: Dict[str, object], top: int = 0) -> str:
     return "\n".join(f"{stack} {count}" for stack, count in items)
 
 
-def heap_snapshot(top: int = 30) -> Dict[str, object]:
+def heap_snapshot(top: int = 30, stop: bool = False) -> Dict[str, object]:
     """Top allocation sites by retained size. First call starts
     tracemalloc (only subsequent allocations are tracked — same contract
-    as attaching memray to a live process)."""
+    as attaching memray to a live process). Pass ``stop=True`` to disarm
+    tracing afterwards — tracemalloc taxes every allocation for as long
+    as it runs, so profiled workers need a way back to full speed."""
     import tracemalloc
 
     if not tracemalloc.is_tracing():
+        if stop:
+            return {"started": False, "stats": [], "stopped": True,
+                    "note": "tracemalloc was not running"}
         tracemalloc.start(10)
         return {"started": True, "stats": [],
                 "note": "tracemalloc started; snapshot again to see "
@@ -79,5 +84,7 @@ def heap_snapshot(top: int = 30) -> Dict[str, object]:
         out.append({"file": frame.filename, "line": frame.lineno,
                     "size_bytes": s.size, "count": s.count})
     current, peak = tracemalloc.get_traced_memory()
-    return {"started": False, "stats": out,
+    if stop:
+        tracemalloc.stop()
+    return {"started": False, "stats": out, "stopped": stop,
             "traced_current_bytes": current, "traced_peak_bytes": peak}

@@ -77,6 +77,19 @@ class _PendingTask:
 
 
 @dataclass
+class _DepWait:
+    """A task parked until its by-reference args materialize (reference:
+    core_worker/transport/dependency_resolver.cc:83 — tasks are not
+    dispatched until owned deps resolve). Without this, a worker executing
+    a dependent task blocks on the arg fetch while HOLDING its CPU slot;
+    enough such tasks starve the pool and deadlock the upstream producers
+    (e.g. Data's shuffle reduce tasks vs map tasks at n_blocks >= n_cpus).
+    """
+    spec: TaskSpec
+    missing: set
+
+
+@dataclass
 class _GeneratorState:
     total: Optional[int] = None      # known once the task completes
     reported: int = 0
@@ -146,6 +159,7 @@ class CoreWorker:
         self._pending_tasks: Dict[TaskID, _PendingTask] = {}
         self._generators: Dict[TaskID, _GeneratorState] = {}
         self._key_states: Dict[tuple, _KeyState] = {}
+        self._dep_waiters: Dict[ObjectID, List[_DepWait]] = {}
         self._actors: Dict[ActorID, _ActorRecord] = {}
         self._actor_sub_started = False
         self._secondary_copies: set = set()
@@ -742,10 +756,55 @@ class CoreWorker:
         self._lt.submit(self._submit_async(spec))
 
     async def _submit_async(self, spec: TaskSpec):
+        # Dependency resolution: dispatching a task whose owned args are
+        # still pending would make the worker long-poll us for them while
+        # holding its CPU — park until every owned by-ref arg has an entry
+        # (value, error, or plasma location). Borrowed args (owner
+        # elsewhere) dispatch immediately: their readiness is unobservable
+        # locally and the producing side is another owner's pool.
+        missing = {
+            a.object_id
+            for a in (list(spec.args)
+                      + list(getattr(spec, "kwarg_specs", {}).values()))
+            if not a.is_inline
+            and self.reference_counter.owns(a.object_id)
+            and not self.memory_store.contains(a.object_id)
+        }
+        if missing:
+            wait = _DepWait(spec=spec, missing=missing)
+            for oid in missing:
+                self._dep_waiters.setdefault(oid, []).append(wait)
+            return
+        await self._enqueue_ready(spec)
+
+    async def _enqueue_ready(self, spec: TaskSpec):
         key = spec.scheduling_key()
         st = self._key_states.setdefault(key, _KeyState())
         st.pending.append(spec)
         await self._pump(key)
+
+    def _release_deps(self, oid: ObjectID):
+        """An owned object materialized: unpark tasks that waited on it."""
+        waiters = self._dep_waiters.pop(oid, None)
+        if not waiters:
+            return
+        for w in waiters:
+            w.missing.discard(oid)
+            if not w.missing:
+                self._lt.submit(self._enqueue_ready(w.spec))
+
+    def _cancel_parked(self, task_id) -> bool:
+        """Remove a dep-parked spec (cancel path). True if it was parked."""
+        found = False
+        for oid, waiters in list(self._dep_waiters.items()):
+            kept = [w for w in waiters if w.spec.task_id != task_id]
+            if len(kept) != len(waiters):
+                found = True
+                if kept:
+                    self._dep_waiters[oid] = kept
+                else:
+                    del self._dep_waiters[oid]
+        return found
 
     async def _pump(self, key):
         st = self._key_states.get(key)
@@ -1071,11 +1130,13 @@ class CoreWorker:
                 in_plasma=payload.get("plasma_node") is not None,
                 plasma_node=payload.get("plasma_node"))
             self.reference_counter.set_location(oid, payload["location"])
+        self._release_deps(oid)
 
     def _store_error_for_task(self, spec: TaskSpec, error: BaseException):
         s = ser.serialize(error)
         for oid in spec.return_ids():
             self.memory_store.put_serialized(oid, s, value=error, is_exception=True)
+            self._release_deps(oid)
 
     def _finalize_task(self, spec: TaskSpec, state: str):
         pending = self._pending_tasks.pop(spec.task_id, None)
@@ -1153,12 +1214,20 @@ class CoreWorker:
         lines attributed to THIS driver's job — multi-job clusters must
         not interleave consoles)."""
         batch_job = batch.get("job_id")
-        if (batch_job is not None and self.job_id
+        startup_crash = batch.get("unattributed", False)
+        if batch_job is None and not startup_crash:
+            # Unattributed output: broadcasting it would leak lines onto
+            # every connected driver's console on multi-job clusters — drop.
+            # (The raylet attributes normal startup output to the worker's
+            # first lease; only marked startup-CRASH batches pass through.)
+            return
+        if (not startup_crash and self.job_id
                 and batch_job != self.job_id.hex()):
             return
         pid = batch.get("pid")
         node = (batch.get("node") or "")[:8]
-        prefix = f"(worker pid={pid}, node={node})"
+        tag = ", startup-crash" if startup_crash else ""
+        prefix = f"(worker pid={pid}, node={node}{tag})"
         out = sys.stderr
         for line in batch.get("lines", []):
             print(f"{prefix} {line}", file=out)
@@ -1416,18 +1485,39 @@ class CoreWorker:
             except ConnectionLost:
                 pass
         else:
-            # Still queued locally: drop it.
-            key = pending.spec.scheduling_key()
-            st = self._key_states.get(key)
-            if st is not None:
-                try:
-                    st.pending.remove(pending.spec)
-                except ValueError:
-                    pass
-                else:
-                    self._store_error_for_task(
-                        pending.spec, exc.TaskCancelledError(task_id))
-                    self._finalize_task(pending.spec, "CANCELLED")
+            # Still queued locally (or parked on unresolved deps): drop it.
+            # Marshaled onto the event loop — _dep_waiters and _key_states
+            # are loop-owned; mutating them from the caller's thread races
+            # _submit_async registration (lost waiters -> hung gets).
+            async def _cancel_local():
+                if self._cancel_parked(task_id):
+                    self._cancel_queued_spec(pending.spec, task_id)
+                    return
+                key = pending.spec.scheduling_key()
+                st = self._key_states.get(key)
+                if st is not None:
+                    try:
+                        st.pending.remove(pending.spec)
+                    except ValueError:
+                        pass
+                    else:
+                        self._cancel_queued_spec(pending.spec, task_id)
+
+            try:
+                self._lt.submit(_cancel_local()).result(timeout=10)
+            except TimeoutError:
+                pass
+
+    def _cancel_queued_spec(self, spec: TaskSpec, task_id):
+        """Finalize a spec cancelled before dispatch (loop thread only)."""
+        self._store_error_for_task(spec, exc.TaskCancelledError(task_id))
+        if spec.is_streaming_generator():
+            # wake consumers blocked in next_generator_item — the error
+            # entry alone never signals the generator's condition variable
+            self._finish_generator(
+                task_id, 0,
+                error=ser.serialize(exc.TaskCancelledError(task_id)))
+        self._finalize_task(spec, "CANCELLED")
 
     # ------------------------------------------------------ placement groups
     def create_placement_group(
@@ -1613,7 +1703,8 @@ class CoreWorker:
         from ray_tpu.util.profiling import heap_snapshot
 
         return await asyncio.to_thread(
-            heap_snapshot, int(payload.get("top", 30)))
+            heap_snapshot, int(payload.get("top", 30)),
+            bool(payload.get("stop", False)))
 
     # ---------------------------------------------- generator streaming (owner)
     async def _handle_report_generator_item(self, payload):

@@ -253,3 +253,61 @@ def test_streaming_generator_error(ray_start_regular):
     with pytest.raises(Exception):
         for ref in it:
             ray_tpu.get(ref, timeout=30)
+
+
+def test_dependent_tasks_dont_starve_worker_pool(ray_start_2_cpus):
+    """Regression: consumers whose args are pending upstream tasks must NOT
+    be dispatched (they would hold a CPU while long-polling the owner for
+    the arg, starving the producers — a pool-wide deadlock once
+    n_consumers >= n_cpus). The owner parks them until deps resolve
+    (reference: dependency_resolver.cc:83)."""
+    import time as _time
+
+    @ray_tpu.remote
+    def produce(i):
+        _time.sleep(0.3)
+        return i
+
+    @ray_tpu.remote
+    def consume(*xs):
+        return sum(xs)
+
+    # 4 producers and 4 consumers on 2 CPUs: without dep-parking the two
+    # slots can fill with consumers that wait forever on unscheduled
+    # producers.
+    prods = [produce.remote(i) for i in range(4)]
+    cons = [consume.remote(*prods) for _ in range(4)]
+    assert ray_tpu.get(cons, timeout=60) == [6, 6, 6, 6]
+
+
+def test_dep_parked_task_gets_upstream_error(ray_start_2_cpus):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("upstream failed")
+
+    @ray_tpu.remote
+    def consume(x):
+        return x
+
+    with pytest.raises(Exception, match="upstream failed"):
+        ray_tpu.get(consume.remote(boom.remote()), timeout=30)
+
+
+def test_cancel_dep_parked_task(ray_start_2_cpus):
+    import time as _time
+
+    @ray_tpu.remote
+    def slow():
+        _time.sleep(5)
+        return 1
+
+    @ray_tpu.remote
+    def consume(x):
+        return x
+
+    up = slow.remote()
+    ref = consume.remote(up)
+    _time.sleep(0.2)  # let the consumer park on the pending dep
+    ray_tpu.cancel(ref)
+    with pytest.raises((exc.TaskCancelledError, exc.GetTimeoutError)):
+        ray_tpu.get(ref, timeout=10)
