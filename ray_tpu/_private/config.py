@@ -112,6 +112,11 @@ _d("worker_killing_policy", "retriable_lifo")  # or "group_by_owner"
 _d("fetch_retry_interval_ms", 100)
 _d("max_lineage_bytes", 64 * 1024**2)
 _d("enable_lineage_reconstruction", True)
+# chunked object transfer (reference: object_manager chunked pulls,
+# object_manager.proto chunk_size / pull_manager.h admission control)
+_d("fetch_chunk_size_bytes", 4 * 1024**2)      # chunk granularity
+_d("fetch_max_inflight_bytes", 256 * 1024**2)  # admission cap across fetches
+_d("fetch_pipeline_depth", 4)                  # in-flight chunks per source
 
 # --- tasks / actors ----------------------------------------------------------
 _d("default_task_num_cpus", 1.0)

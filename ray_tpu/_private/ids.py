@@ -15,6 +15,7 @@ owner can name return objects before execution finishes.
 from __future__ import annotations
 
 import os
+import random
 import threading
 
 _JOB_ID_LEN = 4
@@ -23,11 +24,21 @@ _TASK_ID_LEN = 24
 _OBJECT_ID_LEN = 28
 
 _rand_lock = threading.Lock()
+# urandom-seeded PRNG instead of a per-call urandom syscall: TaskID minting
+# is on the submit hot path (ray_perf tasks async), and IDs need uniqueness,
+# not cryptographic strength. 256 bits of seed entropy per process keeps
+# cross-process collision odds at the same 2^-64-per-pair scale as urandom.
+_rng = random.Random(os.urandom(32))
+_rng_pid = os.getpid()
 
 
 def _random_bytes(n: int) -> bytes:
+    global _rng, _rng_pid
     with _rand_lock:
-        return os.urandom(n)
+        if _rng_pid != os.getpid():  # forked child must not replay the parent
+            _rng = random.Random(os.urandom(32))
+            _rng_pid = os.getpid()
+        return _rng.randbytes(n)
 
 
 class BaseID:

@@ -84,6 +84,15 @@ class SerializedObject:
             off += n
         return off
 
+    def wire_segments(self) -> List:
+        """The flat wire format as an ordered list of buffer segments
+        (no concatenation): lets a chunk server slice arbitrary [off, len)
+        ranges of a memory-store-resident object without materializing the
+        whole flat payload per chunk."""
+        header, raw_buffers = self._wire_parts()
+        return [len(header).to_bytes(4, "little"), header,
+                memoryview(self.inband), *raw_buffers]
+
     def to_bytes(self) -> bytes:
         """Flatten to a single contiguous wire format (copies buffers)."""
         out = io.BytesIO()

@@ -182,6 +182,213 @@ def _freeze(labels: Optional[Dict[str, Any]]):
         for k, v in labels.items()))
 
 
+# ---- compact wire codec (task-push hot path) --------------------------------
+#
+# Pickling the TaskSpec dataclass graph costs ~35us per task round trip
+# (nested dataclasses, ID objects, enum lookups); the flat tuples below
+# pickle in ~3us. This is the analogue of the reference's fixed protobuf
+# encoding for TaskSpec (protobuf/common.proto TaskSpec) vs pickling Python
+# objects. Used by push_task_w / its replies (core_worker); everything else
+# still pickles specs directly — the codec must stay loss-free for every
+# field, but only the push path needs the speed.
+
+def _id_w(i):
+    return None if i is None else i.binary()
+
+
+def _addr_w(a: Optional[Address]):
+    if a is None:
+        return None
+    return (_id_w(a.node_id), _id_w(a.worker_id), a.rpc_address)
+
+
+def _addr_r(t) -> Optional[Address]:
+    if t is None:
+        return None
+    return Address(
+        None if t[0] is None else NodeID(t[0]),
+        None if t[1] is None else WorkerID(t[1]),
+        t[2],
+    )
+
+
+def _ser_w(s):
+    # mirrors SerializedObject.__reduce__: contained_refs are metadata
+    # carried in nested_ids; rebuilding them mid-decode would register
+    # borrows on the RPC loop (deadlock)
+    if s is None:
+        return None
+    return (s.inband, [bytes(b.raw()) for b in s.buffers])
+
+
+def _ser_r(t):
+    if t is None:
+        return None
+    import pickle
+
+    from ray_tpu._private.serialization import SerializedObject
+
+    return SerializedObject(t[0], [pickle.PickleBuffer(b) for b in t[1]], [])
+
+
+def _arg_w(a: TaskArg):
+    return (
+        a.is_inline,
+        _ser_w(a.data) if a.is_inline else None,
+        _id_w(a.object_id),
+        _addr_w(a.owner_address),
+        [i.binary() for i in a.nested_ids],
+    )
+
+
+def _arg_r(t) -> TaskArg:
+    return TaskArg(
+        is_inline=t[0],
+        data=_ser_r(t[1]),
+        object_id=None if t[2] is None else ObjectID(t[2]),
+        owner_address=_addr_r(t[3]),
+        nested_ids=[ObjectID(b) for b in t[4]],
+    )
+
+
+def _strat_w(s: SchedulingStrategySpec):
+    if (s.kind == "DEFAULT" and s.node_id is None
+            and s.placement_group_id is None
+            and s.hard_labels is None and s.soft_labels is None):
+        return None  # the overwhelmingly common default strategy
+    return (s.kind, _id_w(s.node_id), s.soft, _id_w(s.placement_group_id),
+            s.bundle_index, s.capture_child_tasks, s.hard_labels,
+            s.soft_labels)
+
+
+def _strat_r(t) -> SchedulingStrategySpec:
+    if t is None:
+        return SchedulingStrategySpec()
+    return SchedulingStrategySpec(
+        kind=t[0],
+        node_id=None if t[1] is None else NodeID(t[1]),
+        soft=t[2],
+        placement_group_id=None if t[3] is None else PlacementGroupID(t[3]),
+        bundle_index=t[4],
+        capture_child_tasks=t[5],
+        hard_labels=t[6],
+        soft_labels=t[7],
+    )
+
+
+def spec_to_wire(sp: TaskSpec) -> tuple:
+    return (
+        sp.task_id.binary(),
+        sp.job_id.binary() if sp.job_id is not None else None,
+        sp.task_type.value,
+        sp.function_id,
+        sp.function_name,
+        [_arg_w(a) for a in sp.args],
+        sp.num_returns,
+        sp.resources,
+        sp.placement_resources,
+        _addr_w(sp.owner_address),
+        sp.max_retries,
+        sp.retry_exceptions,
+        sp.max_calls,
+        _strat_w(sp.scheduling_strategy),
+        sp.runtime_env,
+        _id_w(sp.actor_id),
+        sp.sequence_number,
+        sp.method_name,
+        sp.concurrency_group,
+        sp.actor_creation,  # rare (creation only): pickled as-is
+        sp.attempt_number,
+        sp.generator_backpressure_num_objects,
+        [(k, _arg_w(a))
+         for k, a in getattr(sp, "kwarg_specs", {}).items()] or None,
+    )
+
+
+def spec_from_wire(t: tuple) -> TaskSpec:
+    sp = TaskSpec(
+        task_id=TaskID(t[0]),
+        job_id=None if t[1] is None else JobID(t[1]),
+        task_type=TaskType(t[2]),
+        function_id=t[3],
+        function_name=t[4],
+        args=[_arg_r(a) for a in t[5]],
+        num_returns=t[6],
+        resources=t[7],
+        placement_resources=t[8],
+        owner_address=_addr_r(t[9]),
+        max_retries=t[10],
+        retry_exceptions=t[11],
+        max_calls=t[12],
+        scheduling_strategy=_strat_r(t[13]),
+        runtime_env=t[14],
+        actor_id=None if t[15] is None else ActorID(t[15]),
+        sequence_number=t[16],
+        method_name=t[17],
+        concurrency_group=t[18],
+        actor_creation=t[19],
+        attempt_number=t[20],
+        generator_backpressure_num_objects=t[21],
+    )
+    sp.kwarg_specs = {} if t[22] is None else {
+        k: _arg_r(a) for k, a in t[22]}
+    return sp
+
+
+def reply_to_wire(r: dict) -> tuple:
+    """PushTaskReply dict -> flat tuple (see reply_from_wire for shape)."""
+    if r.get("not_run"):
+        return ("not_run",)
+    status = r.get("status")
+    if status == "ok":
+        returns = [
+            (oid.binary(), *(_ser_w(p["inline"]) if "inline" in p
+                             else (None, None)),
+             p.get("location"), p.get("plasma_node"))
+            for oid, p in r.get("returns", [])
+        ]
+        return ("ok", returns, r.get("exec_s"),
+                r.get("streaming_num_items"), r.get("worker_retiring"))
+    if status == "cancelled":
+        return ("cancelled", [o.binary() for o in r.get("return_ids", [])])
+    return ("error", _ser_w(r.get("error")), r.get("error_str"),
+            [o.binary() for o in r.get("return_ids", [])],
+            r.get("exec_s"), r.get("worker_retiring"))
+
+
+def reply_from_wire(t: tuple) -> dict:
+    kind = t[0]
+    if kind == "not_run":
+        return {"not_run": True}
+    if kind == "ok":
+        returns = []
+        for oid_b, inband, bufs, location, plasma_node in t[1]:
+            if inband is not None:
+                payload = {"inline": _ser_r((inband, bufs))}
+            else:
+                payload = {"location": location, "plasma_node": plasma_node}
+            returns.append((ObjectID(oid_b), payload))
+        out = {"status": "ok", "returns": returns}
+        if t[2] is not None:
+            out["exec_s"] = t[2]
+        if t[3] is not None:
+            out["streaming_num_items"] = t[3]
+        if t[4]:
+            out["worker_retiring"] = True
+        return out
+    if kind == "cancelled":
+        return {"status": "cancelled",
+                "return_ids": [ObjectID(b) for b in t[1]]}
+    out = {"status": "error", "error": _ser_r(t[1]), "error_str": t[2],
+           "is_application_error": True,
+           "return_ids": [ObjectID(b) for b in t[3]]}
+    if t[4] is not None:
+        out["exec_s"] = t[4]
+    if t[5]:
+        out["worker_retiring"] = True
+    return out
+
+
 class ActorState(Enum):
     """GCS actor lifecycle FSM (reference: gcs_actor_manager.h:251-281)."""
 

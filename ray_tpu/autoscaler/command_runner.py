@@ -1,0 +1,228 @@
+"""Command runners: how the cluster launcher reaches a node.
+
+Reference: ray python/ray/autoscaler/_private/command_runner.py (SSH options,
+rsync invocation, the CommandRunnerInterface contract in
+autoscaler/command_runner.py:9). SSH is subprocess `ssh`/`rsync` — no
+paramiko-style dependency — so a fake `ssh` on PATH substitutes cleanly in
+tests (and rsync rides the same transport via `-e`).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+from typing import Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+_SSH_OPTS = [
+    "-o", "ConnectTimeout=10s",
+    "-o", "StrictHostKeyChecking=no",
+    "-o", "UserKnownHostsFile=/dev/null",
+    "-o", "LogLevel=ERROR",
+    # control-master connection reuse: one TCP+auth handshake per node,
+    # every later command multiplexes (the reference does the same,
+    # command_runner.py:110)
+    "-o", "ControlMaster=auto",
+    "-o", "ControlPersist=60s",
+]
+
+
+class CommandRunnerInterface:
+    def run(self, cmd: str, *, env: Optional[Dict[str, str]] = None,
+            timeout: Optional[float] = None,
+            capture: bool = True) -> subprocess.CompletedProcess:
+        raise NotImplementedError
+
+    def run_rsync_up(self, source: str, target: str) -> None:
+        raise NotImplementedError
+
+    def run_rsync_down(self, source: str, target: str) -> None:
+        raise NotImplementedError
+
+    def remote_shell_argv(self) -> List[str]:
+        """argv for an INTERACTIVE shell on the node (`attach`)."""
+        raise NotImplementedError
+
+
+def _export_prefix(env: Optional[Dict[str, str]]) -> str:
+    if not env:
+        return ""
+    import shlex
+
+    return "".join(f"export {k}={shlex.quote(str(v))}; "
+                   for k, v in env.items())
+
+
+class SSHCommandRunner(CommandRunnerInterface):
+    def __init__(self, node_ip: str, auth: dict,
+                 ssh_binary: str = "ssh", rsync_binary: str = "rsync"):
+        self.node_ip = node_ip
+        self.ssh_user = auth.get("ssh_user") or os.environ.get("USER", "root")
+        self.ssh_key = auth.get("ssh_private_key")
+        self.ssh_port = auth.get("ssh_port")
+        self.ssh_binary = ssh_binary
+        self.rsync_binary = rsync_binary
+
+    def _ssh_base(self) -> List[str]:
+        cmd = [self.ssh_binary] + _SSH_OPTS
+        if self.ssh_key:
+            cmd += ["-i", os.path.expanduser(self.ssh_key)]
+        if self.ssh_port:
+            cmd += ["-p", str(self.ssh_port)]
+        return cmd
+
+    def _target(self) -> str:
+        return f"{self.ssh_user}@{self.node_ip}"
+
+    def run(self, cmd: str, *, env=None, timeout=None, capture=True):
+        full = self._ssh_base() + [self._target(),
+                                   f"bash -c {_sq(_export_prefix(env) + cmd)}"]
+        logger.debug("ssh %s: %s", self.node_ip, cmd)
+        return subprocess.run(
+            full, capture_output=capture, text=True, timeout=timeout)
+
+    def run_rsync_up(self, source: str, target: str) -> None:
+        source = os.path.expanduser(source)
+        if self._have_rsync():
+            self._rsync(source, f"{self._target()}:{target}")
+            return
+        # tar-over-ssh fallback (this image ships no rsync): stream a
+        # gzipped tar through the same transport
+        if os.path.isdir(source):
+            data = _tar_dir_bytes(source)
+            self._run_with_input(
+                f"mkdir -p {_sq(target)} && tar -C {_sq(target)} -xzf -",
+                data)
+        else:
+            with open(source, "rb") as f:
+                data = f.read()
+            self._run_with_input(
+                f"mkdir -p $(dirname {_sq(target)}) && cat > {_sq(target)}",
+                data)
+
+    def run_rsync_down(self, source: str, target: str) -> None:
+        target = os.path.expanduser(target)
+        if self._have_rsync():
+            self._rsync(f"{self._target()}:{source}", target)
+            return
+        probe = self.run(f"test -d {_sq(source)}")
+        if probe.returncode == 0:
+            data = self._run_capture_bytes(
+                f"tar -C {_sq(source)} -czf - .")
+            _untar_bytes(data, target)
+        else:
+            data = self._run_capture_bytes(f"cat {_sq(source)}")
+            os.makedirs(os.path.dirname(target) or ".", exist_ok=True)
+            with open(target, "wb") as f:
+                f.write(data)
+
+    def _have_rsync(self) -> bool:
+        import shutil
+
+        return shutil.which(self.rsync_binary) is not None
+
+    def _run_with_input(self, cmd: str, data: bytes) -> None:
+        full = self._ssh_base() + [self._target(), f"bash -c {_sq(cmd)}"]
+        r = subprocess.run(full, input=data, capture_output=True)
+        if r.returncode != 0:
+            raise RuntimeError(
+                f"transfer failed ({r.returncode}): {r.stderr.decode()}")
+
+    def _run_capture_bytes(self, cmd: str) -> bytes:
+        full = self._ssh_base() + [self._target(), f"bash -c {_sq(cmd)}"]
+        r = subprocess.run(full, capture_output=True)
+        if r.returncode != 0:
+            raise RuntimeError(
+                f"transfer failed ({r.returncode}): {r.stderr.decode()}")
+        return r.stdout
+
+    def _rsync(self, src: str, dst: str) -> None:
+        ssh_cmd = " ".join(self._ssh_base())
+        cmd = [self.rsync_binary, "-az", "--delete", "-e", ssh_cmd, src, dst]
+        r = subprocess.run(cmd, capture_output=True, text=True)
+        if r.returncode != 0:
+            raise RuntimeError(f"rsync failed ({r.returncode}): {r.stderr}")
+
+    def remote_shell_argv(self) -> List[str]:
+        return self._ssh_base() + ["-tt", self._target()]
+
+
+class LocalCommandRunner(CommandRunnerInterface):
+    """Runs "node" commands as local subprocesses (provider head_ip on this
+    machine, or single-box clusters — no SSH round trip)."""
+
+    def __init__(self, node_ip: str = "127.0.0.1"):
+        self.node_ip = node_ip
+
+    def run(self, cmd: str, *, env=None, timeout=None, capture=True):
+        full_env = dict(os.environ)
+        full_env.update({k: str(v) for k, v in (env or {}).items()})
+        return subprocess.run(
+            ["bash", "-c", cmd], capture_output=capture, text=True,
+            timeout=timeout, env=full_env)
+
+    def run_rsync_up(self, source: str, target: str) -> None:
+        self._copy(source, target)
+
+    def run_rsync_down(self, source: str, target: str) -> None:
+        self._copy(source, target)
+
+    @staticmethod
+    def _copy(src: str, dst: str) -> None:
+        import shutil
+
+        src = os.path.expanduser(src)
+        dst = os.path.expanduser(dst)
+        if os.path.isdir(src.rstrip("/")):
+            shutil.copytree(src.rstrip("/"), dst.rstrip("/"),
+                            dirs_exist_ok=True)
+        else:
+            os.makedirs(os.path.dirname(dst) or ".", exist_ok=True)
+            shutil.copy2(src, dst)
+
+    def remote_shell_argv(self) -> List[str]:
+        return ["bash", "-i"]
+
+
+def _sq(s: str) -> str:
+    import shlex
+
+    return shlex.quote(s)
+
+
+def _tar_dir_bytes(src_dir: str) -> bytes:
+    import io
+    import tarfile
+
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:gz") as tf:
+        for name in sorted(os.listdir(src_dir)):
+            tf.add(os.path.join(src_dir, name), arcname=name)
+    return buf.getvalue()
+
+
+def _untar_bytes(data: bytes, dst_dir: str) -> None:
+    import io
+    import tarfile
+
+    os.makedirs(dst_dir, exist_ok=True)
+    with tarfile.open(fileobj=io.BytesIO(data), mode="r:gz") as tf:
+        tf.extractall(dst_dir, filter="data")
+
+
+def make_command_runner(node_ip: str, config: dict) -> CommandRunnerInterface:
+    """Pick the runner for a node from the cluster config. `ssh_binary`
+    override (provider.ssh_binary or RT_SSH_BINARY) lets tests route
+    "ssh" through a local stub."""
+    provider = config.get("provider", {})
+    if provider.get("type") == "subprocess" or node_ip in (
+            "127.0.0.1", "localhost"):
+        return LocalCommandRunner(node_ip)
+    ssh_binary = (os.environ.get("RT_SSH_BINARY")
+                  or provider.get("ssh_binary") or "ssh")
+    rsync_binary = (os.environ.get("RT_RSYNC_BINARY")
+                    or provider.get("rsync_binary") or "rsync")
+    return SSHCommandRunner(node_ip, config.get("auth", {}),
+                            ssh_binary=ssh_binary, rsync_binary=rsync_binary)

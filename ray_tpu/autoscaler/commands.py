@@ -1,0 +1,255 @@
+"""Cluster launcher: `ray-tpu up / down / exec / attach / rsync`.
+
+Reference: ray python/ray/autoscaler/_private/commands.py
+(create_or_update_cluster:707, teardown_cluster:807, exec_cluster:1313,
+attach_cluster:1281, rsync:1410) and scripts.py:1282 (`ray up`). The
+provider here is the on-prem shape (static head_ip + worker_ips reached
+over SSH, like the reference's "local" provider,
+autoscaler/_private/local/node_provider.py); cloud-managed TPU pods go
+through the GKE/KubeRay provider instead (gke_node_provider.py), where the
+operator owns node lifecycle and `up` is a `kubectl apply`.
+
+Cluster state (which IP serves which role) persists in
+``~/.ray_tpu/clusters/<name>.json`` (override dir: RT_CLUSTER_STATE_DIR)
+so `down`/`exec` work from a fresh shell.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import subprocess
+import time
+from typing import Dict, List, Optional
+
+from ray_tpu.autoscaler.command_runner import make_command_runner
+from ray_tpu.autoscaler.updater import NodeUpdater, NodeUpdaterError
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_HEAD_PORT = 7001
+
+_REQUIRED_KEYS = ("cluster_name", "provider")
+_KNOWN_KEYS = {
+    "cluster_name", "max_workers", "min_workers", "provider", "auth",
+    "file_mounts", "initialization_commands", "setup_commands",
+    "head_setup_commands", "worker_setup_commands",
+    "head_start_ray_commands", "worker_start_ray_commands",
+    "stop_ray_commands", "env",
+}
+
+
+def load_cluster_config(path: str) -> dict:
+    import yaml
+
+    with open(os.path.expanduser(path)) as f:
+        config = yaml.safe_load(f)
+    validate_cluster_config(config)
+    return config
+
+
+def validate_cluster_config(config: dict) -> None:
+    for key in _REQUIRED_KEYS:
+        if key not in config:
+            raise ValueError(f"cluster config missing required key: {key}")
+    unknown = set(config) - _KNOWN_KEYS
+    if unknown:
+        raise ValueError(f"unknown cluster config keys: {sorted(unknown)}")
+    provider = config["provider"]
+    ptype = provider.get("type")
+    if ptype in ("local", "subprocess"):
+        if not provider.get("head_ip"):
+            raise ValueError("provider.head_ip is required for "
+                             f"type: {ptype}")
+    elif ptype == "gke":
+        raise ValueError(
+            "provider type 'gke' clusters are operator-managed: apply the "
+            "RayCluster CR (see ray_tpu.autoscaler.gke_node_provider) "
+            "instead of `ray-tpu up`")
+    else:
+        raise ValueError(f"unknown provider.type: {ptype!r} "
+                         "(expected 'local' or 'subprocess')")
+
+
+# ---- cluster state ----------------------------------------------------------
+
+def _state_dir() -> str:
+    d = os.environ.get("RT_CLUSTER_STATE_DIR") or os.path.expanduser(
+        "~/.ray_tpu/clusters")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _state_path(cluster_name: str) -> str:
+    return os.path.join(_state_dir(), f"{cluster_name}.json")
+
+
+def _load_state(cluster_name: str) -> dict:
+    try:
+        with open(_state_path(cluster_name)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {"head": None, "workers": []}
+
+
+def _save_state(cluster_name: str, state: dict) -> None:
+    with open(_state_path(cluster_name), "w") as f:
+        json.dump(state, f, indent=2)
+
+
+def _delete_state(cluster_name: str) -> None:
+    try:
+        os.remove(_state_path(cluster_name))
+    except OSError:
+        pass
+
+
+# ---- commands ---------------------------------------------------------------
+
+def _head_address(config: dict) -> str:
+    provider = config["provider"]
+    port = provider.get("head_port", DEFAULT_HEAD_PORT)
+    return f"{provider['head_ip']}:{port}"
+
+
+def _updater_for(config: dict, ip: str, is_head: bool,
+                 restart: bool = True) -> NodeUpdater:
+    runner = make_command_runner(ip, config)
+    env = dict(config.get("env") or {})
+    env["RAY_HEAD_IP"] = config["provider"]["head_ip"]
+    env["RT_HEAD_ADDRESS"] = _head_address(config)
+    start_key = ("head_start_ray_commands" if is_head
+                 else "worker_start_ray_commands")
+    setup_key = "head_setup_commands" if is_head else "worker_setup_commands"
+    start = config.get(start_key)
+    if start is None:
+        port = config["provider"].get("head_port", DEFAULT_HEAD_PORT)
+        start = ([f"python -m ray_tpu start --head --port={port} --block "
+                  "> /tmp/rt_head.log 2>&1 & sleep 2"] if is_head else
+                 ["python -m ray_tpu start --address=$RT_HEAD_ADDRESS "
+                  "--block > /tmp/rt_worker_$$.log 2>&1 & sleep 2"])
+    return NodeUpdater(
+        ip, runner,
+        file_mounts=config.get("file_mounts"),
+        initialization_commands=config.get("initialization_commands"),
+        setup_commands=(config.get("setup_commands", [])
+                        + config.get(setup_key, [])),
+        start_commands=start if restart else [],
+        env=env,
+    )
+
+
+def create_or_update_cluster(config_path: str, *, no_restart: bool = False,
+                             min_workers: Optional[int] = None) -> dict:
+    """`ray-tpu up`: bring the head (and min_workers workers) to running.
+    Idempotent — re-running re-syncs mounts and re-runs setup; pass
+    no_restart to keep the running ray-tpu processes."""
+    config = load_cluster_config(config_path)
+    name = config["cluster_name"]
+    provider = config["provider"]
+    state = _load_state(name)
+
+    head_ip = provider["head_ip"]
+    head_running = state.get("head") == head_ip
+    _updater_for(config, head_ip, is_head=True,
+                 restart=not (no_restart and head_running)).update()
+    state["head"] = head_ip
+    _save_state(name, state)
+
+    want = min_workers
+    if want is None:
+        want = config.get("min_workers", len(provider.get("worker_ips", [])))
+    worker_ips = list(provider.get("worker_ips", []))[:want]
+    failed: List[str] = []
+    for ip in worker_ips:
+        already = ip in state.get("workers", [])
+        try:
+            _updater_for(config, ip, is_head=False,
+                         restart=not (no_restart and already)).update()
+            if not already:
+                state.setdefault("workers", []).append(ip)
+        except NodeUpdaterError as e:
+            logger.error("worker %s failed to start: %s", ip, e)
+            failed.append(ip)
+        _save_state(name, state)
+    logger.info("cluster %s up: head=%s workers=%s%s", name, head_ip,
+                state.get("workers", []),
+                f" FAILED={failed}" if failed else "")
+    return {"head": head_ip, "workers": state.get("workers", []),
+            "failed": failed, "address": _head_address(config)}
+
+
+def teardown_cluster(config_path: str,
+                     workers_only: bool = False) -> None:
+    """`ray-tpu down`: stop ray-tpu on every node and forget the cluster."""
+    config = load_cluster_config(config_path)
+    name = config["cluster_name"]
+    state = _load_state(name)
+    stop_cmds = config.get("stop_ray_commands") or [
+        "python -m ray_tpu stop || true"]
+    nodes = list(state.get("workers", []))
+    if not workers_only and state.get("head"):
+        nodes.append(state["head"])
+    for ip in nodes:
+        runner = make_command_runner(ip, config)
+        for cmd in stop_cmds:
+            try:
+                runner.run(cmd, timeout=60)
+            except Exception as e:  # noqa: BLE001 — dead node: nothing to stop
+                logger.warning("stop on %s failed: %s", ip, e)
+    if workers_only:
+        state["workers"] = []
+        _save_state(name, state)
+    else:
+        _delete_state(name)
+    logger.info("cluster %s torn down (%d nodes)", name, len(nodes))
+
+
+def exec_cluster(config_path: str, cmd: str,
+                 run_env: Optional[Dict[str, str]] = None) -> int:
+    """`ray-tpu exec`: run a shell command on the head node, streaming
+    output. Returns the remote exit code."""
+    config = load_cluster_config(config_path)
+    state = _load_state(config["cluster_name"])
+    head = state.get("head") or config["provider"]["head_ip"]
+    runner = make_command_runner(head, config)
+    env = dict(config.get("env") or {})
+    env["RT_HEAD_ADDRESS"] = _head_address(config)
+    env.update(run_env or {})
+    r = runner.run(cmd, env=env, timeout=None)
+    if r.stdout:
+        print(r.stdout, end="")
+    if r.stderr:
+        import sys
+
+        print(r.stderr, end="", file=sys.stderr)
+    return r.returncode
+
+
+def attach_cluster(config_path: str) -> int:
+    """`ray-tpu attach`: interactive shell on the head node."""
+    config = load_cluster_config(config_path)
+    state = _load_state(config["cluster_name"])
+    head = state.get("head") or config["provider"]["head_ip"]
+    runner = make_command_runner(head, config)
+    return subprocess.call(runner.remote_shell_argv())
+
+
+def rsync(config_path: str, source: str, target: str, *,
+          down: bool = False) -> None:
+    """`ray-tpu rsync-up/-down` between the local machine and the head."""
+    config = load_cluster_config(config_path)
+    state = _load_state(config["cluster_name"])
+    head = state.get("head") or config["provider"]["head_ip"]
+    runner = make_command_runner(head, config)
+    if down:
+        runner.run_rsync_down(source, target)
+    else:
+        runner.run_rsync_up(source, target)
+
+
+def get_head_node_ip(config_path: str) -> str:
+    config = load_cluster_config(config_path)
+    state = _load_state(config["cluster_name"])
+    return state.get("head") or config["provider"]["head_ip"]

@@ -1,0 +1,84 @@
+"""NodeUpdater: bring one node from bare machine to running ray-tpu.
+
+Reference: ray python/ray/autoscaler/_private/updater.py (NodeUpdater.run —
+wait for SSH, sync file mounts, initialization_commands, setup_commands,
+start_ray_commands) compressed to the parts that matter without docker.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, List, Optional
+
+from ray_tpu.autoscaler.command_runner import CommandRunnerInterface
+
+logger = logging.getLogger(__name__)
+
+
+class NodeUpdaterError(RuntimeError):
+    pass
+
+
+class NodeUpdater:
+    def __init__(
+        self,
+        node_ip: str,
+        runner: CommandRunnerInterface,
+        file_mounts: Optional[Dict[str, str]] = None,
+        initialization_commands: Optional[List[str]] = None,
+        setup_commands: Optional[List[str]] = None,
+        start_commands: Optional[List[str]] = None,
+        env: Optional[Dict[str, str]] = None,
+        ssh_wait_timeout: float = 120.0,
+    ):
+        self.node_ip = node_ip
+        self.runner = runner
+        self.file_mounts = file_mounts or {}
+        self.initialization_commands = initialization_commands or []
+        self.setup_commands = setup_commands or []
+        self.start_commands = start_commands or []
+        self.env = env or {}
+        self.ssh_wait_timeout = ssh_wait_timeout
+
+    def wait_ready(self) -> None:
+        deadline = time.monotonic() + self.ssh_wait_timeout
+        delay = 1.0
+        last = ""
+        while time.monotonic() < deadline:
+            try:
+                r = self.runner.run("uptime", timeout=15)
+                if r.returncode == 0:
+                    return
+                last = r.stderr
+            except Exception as e:  # noqa: BLE001 — ssh not up yet
+                last = str(e)
+            time.sleep(delay)
+            delay = min(5.0, delay * 1.5)
+        raise NodeUpdaterError(
+            f"node {self.node_ip} never became reachable: {last}")
+
+    def sync_file_mounts(self) -> None:
+        for remote, local in self.file_mounts.items():
+            self.runner.run(f"mkdir -p {remote}")
+            # trailing slash: sync directory CONTENTS into the mount point
+            src = local.rstrip("/") + "/"
+            self.runner.run_rsync_up(src, remote.rstrip("/") + "/")
+
+    def run_commands(self, commands: List[str], phase: str) -> None:
+        for cmd in commands:
+            r = self.runner.run(cmd, env=self.env, timeout=600)
+            if r.returncode != 0:
+                raise NodeUpdaterError(
+                    f"{phase} command failed on {self.node_ip} "
+                    f"(exit {r.returncode}): {cmd}\n"
+                    f"stdout: {r.stdout}\nstderr: {r.stderr}")
+
+    def update(self) -> None:
+        logger.info("updating node %s", self.node_ip)
+        self.wait_ready()
+        self.run_commands(self.initialization_commands, "initialization")
+        self.sync_file_mounts()
+        self.run_commands(self.setup_commands, "setup")
+        self.run_commands(self.start_commands, "start")
+        logger.info("node %s up", self.node_ip)

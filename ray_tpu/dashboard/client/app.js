@@ -1,0 +1,265 @@
+/* ray_tpu dashboard SPA: hash-routed pages over the JSON state API
+   (/api/cluster_status, /api/nodes, /api/actors, /api/tasks, /api/jobs/,
+   /api/placement_groups, /api/serve, /api/logs). Vanilla JS, no build. */
+"use strict";
+
+const $ = (sel) => document.querySelector(sel);
+const esc = (s) => String(s ?? "").replace(/[&<>"]/g,
+  (c) => ({"&": "&amp;", "<": "&lt;", ">": "&gt;", '"': "&quot;"}[c]));
+
+async function getJSON(path) {
+  const r = await fetch(path);
+  if (!r.ok) throw new Error(`${path}: HTTP ${r.status}`);
+  return r.json();
+}
+
+function table(headers, rows) {
+  if (!rows.length) return '<p class="muted">none</p>';
+  const head = headers.map((h) => `<th>${esc(h)}</th>`).join("");
+  const body = rows.map((r) => `<tr>${r.join("")}</tr>`).join("");
+  return `<table><tr>${head}</tr>${body}</table>`;
+}
+
+const td = (v, cls) => `<td${cls ? ` class="${cls}"` : ""}>${v}</td>`;
+
+function statusCell(text) {
+  const t = String(text).toUpperCase();
+  const cls = t === "ALIVE" || t === "RUNNING" || t === "FINISHED" ||
+      t === "SUCCEEDED" || t === "CREATED" || t === "OK" ? "ok"
+    : t === "DEAD" || t === "FAILED" || t === "REMOVED" ? "dead"
+    : "warn";
+  return td(`<span class="status ${cls}">${esc(text)}</span>`);
+}
+
+function meter(name, used, total) {
+  const pct = total > 0 ? Math.min(100, 100 * used / total) : 0;
+  return `<div class="meter">
+    <div class="label"><span>${esc(name)}</span>
+      <span>${used.toFixed(1)} / ${total.toFixed(1)}</span></div>
+    <div class="track"><div class="fill" style="width:${pct}%"></div></div>
+  </div>`;
+}
+
+// ---- pages -----------------------------------------------------------------
+
+async function pageOverview() {
+  const s = await getJSON("/api/cluster_status");
+  const nodes = Object.values(s.nodes || {});
+  const alive = nodes.filter((n) => n.alive).length;
+  let actors = [], version = {};
+  try { actors = await getJSON("/api/actors"); } catch {}
+  try { version = await getJSON("/api/version"); } catch {}
+  const tiles = [
+    ["nodes alive", `${alive} / ${nodes.length}`],
+    ["actors", actors.filter((a) => a.state === "ALIVE").length],
+    ["pending demands", (s.pending_demands || []).length],
+    ["GCS", esc(version.gcs_address || "?")],
+  ].map(([k, v]) =>
+    `<div class="tile"><div class="v">${v}</div>
+     <div class="k">${k}</div></div>`).join("");
+  const meters = Object.keys(s.resources_total || {}).sort().map((k) => {
+    const total = s.resources_total[k] || 0;
+    const used = total - (s.resources_available[k] || 0);
+    return meter(k, used, total);
+  }).join("");
+  return `<h2>Cluster</h2><div class="tiles">${tiles}</div>
+    <h3>Resource utilization</h3>${meters || '<p class="muted">none</p>'}`;
+}
+
+async function pageNodes() {
+  const nodes = await getJSON("/api/nodes");
+  return `<h2>Nodes</h2>` + table(
+    ["node id", "state", "role", "address", "resources (avail / total)"],
+    nodes.map((n) => [
+      td(esc(n.node_id.slice(0, 12)), "mono"),
+      statusCell(n.state),
+      td(n.is_head_node ? "head" : "worker"),
+      td(esc(n.raylet_address), "mono"),
+      td(esc(fmtRes(n.resources_available)) + " / " +
+         esc(fmtRes(n.resources_total)), "mono"),
+    ]));
+}
+
+function fmtRes(r) {
+  return Object.entries(r || {}).sort()
+    .map(([k, v]) => `${k}:${(+v).toFixed(1)}`).join(" ") || "-";
+}
+
+async function pageActors() {
+  const actors = await getJSON("/api/actors");
+  return `<h2>Actors</h2>` + table(
+    ["actor id", "class", "name", "state", "pid", "restarts"],
+    actors.map((a) => [
+      td(esc(a.actor_id.slice(0, 12)), "mono"),
+      td(esc(a.class_name)),
+      td(esc(a.name || "-")),
+      statusCell(a.state),
+      td(a.pid || "-"),
+      td(a.restarts),
+    ]));
+}
+
+async function pageTasks() {
+  const tasks = await getJSON("/api/tasks");
+  tasks.sort((a, b) => (b.ts || 0) - (a.ts || 0));
+  return `<h2>Tasks <span class="muted">(latest state, newest first,
+    up to 10k)</span></h2>` + table(
+    ["task", "type", "state", "job"],
+    tasks.slice(0, 500).map((t) => [
+      td(esc(t.name || t.func || "?")),
+      td(esc(t.type || "")),
+      statusCell(t.state || "?"),
+      td(esc(String(t.job_id || "").slice(0, 8)), "mono"),
+    ]));
+}
+
+async function pageJobs() {
+  let subs = [];
+  try { subs = await getJSON("/api/jobs/"); } catch {}
+  const drivers = await getJSON("/api/jobs");
+  const form = `
+    <form class="inline" onsubmit="return submitJob(event)">
+      <input type="text" id="entrypoint"
+             placeholder="entrypoint, e.g. python my_job.py">
+      <button>Submit job</button>
+    </form><div id="submit-out" class="muted"></div>`;
+  const subTable = table(
+    ["submission", "entrypoint", "status", "message", ""],
+    subs.map((j) => [
+      td(esc(j.submission_id), "mono"),
+      td(esc(j.entrypoint)),
+      statusCell(j.status),
+      td(esc(j.message || "")),
+      td(`<button class="secondary"
+           onclick="jobLogs('${esc(j.submission_id)}')">logs</button>`),
+    ]));
+  const drvTable = table(
+    ["job id", "driver", "state"],
+    drivers.map((j) => [
+      td(esc(j.job_id), "mono"),
+      td(esc(j.driver_address), "mono"),
+      statusCell(j.is_dead ? "DEAD" : "ALIVE"),
+    ]));
+  return `<h2>Jobs</h2>${form}
+    <h3>Submissions</h3>${subTable}
+    <div id="job-logs"></div>
+    <h3>Drivers</h3>${drvTable}`;
+}
+
+window.submitJob = async (ev) => {
+  ev.preventDefault();
+  const entrypoint = $("#entrypoint").value.trim();
+  if (!entrypoint) return false;
+  $("#submit-out").textContent = "submitting…";
+  try {
+    const r = await fetch("/api/jobs", {
+      method: "POST", headers: {"Content-Type": "application/json"},
+      body: JSON.stringify({entrypoint}),
+    });
+    const body = await r.json();
+    $("#submit-out").textContent =
+      r.ok ? `submitted: ${body.submission_id}` : `error: ${body}`;
+  } catch (e) { $("#submit-out").textContent = `error: ${e}`; }
+  return false;
+};
+
+window.jobLogs = async (sid) => {
+  const out = $("#job-logs");
+  out.innerHTML = `<h3>logs: ${esc(sid)}</h3>
+    <pre class="logbox">loading…</pre>`;
+  try {
+    const r = await getJSON(`/api/jobs/${sid}/logs`);
+    out.querySelector("pre").textContent = r.logs || "(empty)";
+  } catch (e) { out.querySelector("pre").textContent = String(e); }
+};
+
+async function pagePGs() {
+  const pgs = await getJSON("/api/placement_groups");
+  return `<h2>Placement groups</h2>` + table(
+    ["pg id", "name", "strategy", "state", "bundles"],
+    pgs.map((p) => [
+      td(esc(String(p.placement_group_id || p.id || "")).slice(0, 12),
+         "mono"),
+      td(esc(p.name || "-")),
+      td(esc(p.strategy || "")),
+      statusCell(p.state || "?"),
+      td(esc(JSON.stringify(p.bundles || [])), "mono"),
+    ]));
+}
+
+async function pageServe() {
+  let s;
+  try { s = await getJSON("/api/serve"); }
+  catch { return `<h2>Serve</h2><p class="muted">serve is not running
+    (or the controller is unreachable).</p>`; }
+  const apps = Object.entries(s.applications || {});
+  if (!apps.length) {
+    return `<h2>Serve</h2><p class="muted">no applications deployed.</p>`;
+  }
+  const rows = [];
+  for (const [app, info] of apps) {
+    for (const [dep, d] of Object.entries(info.deployments || {})) {
+      rows.push([
+        td(esc(app)), td(esc(dep)), statusCell(d.status || "?"),
+        td(d.replica_states ? esc(JSON.stringify(d.replica_states))
+           : String(d.num_replicas ?? "-")),
+        td(esc(d.message || "")),
+      ]);
+    }
+  }
+  return `<h2>Serve</h2>` + table(
+    ["application", "deployment", "status", "replicas", "message"], rows);
+}
+
+async function pageLogs() {
+  const data = await getJSON("/api/logs?lines=200");
+  const blocks = Object.entries(data.nodes || data || {}).map(
+    ([node, files]) => {
+      const inner = Object.entries(files || {}).map(
+        ([f, text]) => `<h3 class="mono">${esc(f)}</h3>
+          <pre class="logbox">${esc(
+            Array.isArray(text) ? text.join("\n") : text)}</pre>`).join("");
+      return `<h3>node ${esc(node.slice ? node.slice(0, 12) : node)}</h3>
+        ${inner || '<p class="muted">no worker logs</p>'}`;
+    }).join("");
+  return `<h2>Worker logs <span class="muted">(last 200 lines)</span></h2>
+    ${blocks || '<p class="muted">no logs</p>'}`;
+}
+
+// ---- router ----------------------------------------------------------------
+
+const PAGES = {
+  overview: pageOverview, nodes: pageNodes, actors: pageActors,
+  tasks: pageTasks, jobs: pageJobs, pgs: pagePGs, serve: pageServe,
+  logs: pageLogs,
+};
+let timer = null;
+
+async function render() {
+  const page = (location.hash || "#overview").slice(1);
+  const fn = PAGES[page] || pageOverview;
+  document.querySelectorAll("#nav a").forEach((a) =>
+    a.classList.toggle("active", a.hash === `#${page}`));
+  try {
+    const html = await fn();
+    // jobs page holds form state + log panes: skip auto-rerender clobber
+    if ((location.hash || "#overview").slice(1) === page) {
+      const active = document.activeElement;
+      if (page !== "jobs" || !(active && active.tagName === "INPUT")) {
+        $("#main").innerHTML = html;
+      }
+    }
+    $("#refresh-state").textContent =
+      `updated ${new Date().toLocaleTimeString()}`;
+  } catch (e) {
+    $("#main").innerHTML = `<p class="error">${esc(e)}</p>`;
+  }
+}
+
+function loop() {
+  clearInterval(timer);
+  render();
+  timer = setInterval(render, 5000);
+}
+window.addEventListener("hashchange", loop);
+loop();

@@ -163,7 +163,23 @@ class DashboardHead:
                 req, [p for p in path[len("/api/jobs/"):].split("/") if p])
             return
         if path == "/":
-            self._respond(req, self._index_html(), "text/html")
+            html = self._client_file("index.html")
+            if html is not None:
+                self._respond(req, html, "text/html")
+            else:  # packaged frontend missing: keep the minimal overview
+                self._respond(req, self._index_html(), "text/html")
+        elif path.startswith("/static/"):
+            name = path[len("/static/"):]
+            body = self._client_file(name)
+            if body is None:
+                req.send_error(404)
+            else:
+                ctype = ("text/css" if name.endswith(".css")
+                         else "application/javascript"
+                         if name.endswith(".js") else "text/plain")
+                self._respond(req, body, ctype)
+        elif path == "/api/serve":
+            self._json(req, self._serve_status(req))
         elif path == "/api/logs":
             # worker log tails, fanned out over each raylet's
             # tail_worker_logs RPC (reference: dashboard log routes)
@@ -201,6 +217,35 @@ class DashboardHead:
 
     def _json(self, req, obj: Any) -> None:
         self._respond(req, json.dumps(obj, default=str), "application/json")
+
+    @staticmethod
+    def _client_file(name: str) -> Optional[str]:
+        """Read a packaged frontend file (dashboard/client/) — no build
+        step, no extra server: the same stdlib handler serves the SPA
+        (reference capability: dashboard/client/src React app)."""
+        import os
+
+        base = os.path.join(os.path.dirname(__file__), "client")
+        path = os.path.normpath(os.path.join(base, name))
+        # trailing separator: plain startswith(base) would admit sibling
+        # paths like .../client_extra
+        if not path.startswith(base + os.sep):  # no traversal
+            return None
+        try:
+            with open(path, encoding="utf-8") as f:
+                return f.read()
+        except OSError:
+            return None
+
+    def _serve_status(self, req) -> Dict[str, Any]:
+        """Serve application/deployment states for the Serve page."""
+        self._jobs_client()  # ensures a connected driver
+        from ray_tpu.serve import api as serve_api
+
+        try:
+            return {"applications": serve_api.status()}
+        except Exception:  # noqa: BLE001 — serve not running
+            return {"applications": {}}
 
     # -- data ----------------------------------------------------------------
 

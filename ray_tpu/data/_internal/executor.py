@@ -342,14 +342,16 @@ def _sample_sort_key(block: Block, key: str, max_samples: int = 100):
     return col
 
 
-@functools.lru_cache(maxsize=None)
+@functools.lru_cache(maxsize=64)
 def _exchange_task(name: str, num_returns: int = 1):
     """Memoized module-level remote wrappers for the exchange tasks.
 
-    Minting a fresh ``ray_tpu.remote(...)`` per execution re-serializes the
-    function and re-runs the prepare-once branch on every exchange; memoizing
-    keeps one wrapper (and one lease-cache scheduling key) per
-    (function, num_returns) for the process lifetime.
+    Minting a fresh ``ray_tpu.remote(...)`` (or ``.options()`` variant,
+    which drops the cached export state) per execution re-serializes the
+    function on every exchange. Keyed by (function, num_returns) under a
+    BOUNDED cache: distinct block counts each get a reusable wrapper, but
+    a long-lived driver cycling through many dataset sizes evicts old
+    entries instead of growing forever.
     """
     fn = {"map": _exchange_map, "reduce": _exchange_reduce,
           "count": _block_num_rows, "sample": _sample_sort_key}[name]

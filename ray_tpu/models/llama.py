@@ -355,6 +355,89 @@ def _cached_attention(q, k_cache, v_cache, lengths, config: LlamaConfig):
     return out
 
 
+def init_paged_kv_cache(config: LlamaConfig, n_blocks: int,
+                        block_size: int, dtype=None) -> Dict[str, Any]:
+    """Block-pool KV cache (PagedAttention layout, TPU-shaped): arrays
+    [n_layers, n_blocks, block_size, n_kv_heads, d_head]. Sequences map
+    logical positions onto pool blocks through a block table, so HBM is
+    budgeted by TOTAL tokens in flight instead of batch x max_seq_len
+    (ragged/long sequences stop reserving worst-case rows). Block 0 is
+    reserved as a scratch target for masked writes."""
+    c = config
+    dtype = dtype or c.dtype
+    shape = (c.n_layers, n_blocks, block_size, c.n_kv_heads, c.d_head)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def _attn_sublayer_paged(x, params, positions, config: LlamaConfig,
+                         k_pool, v_pool, block_table, lengths, valid):
+    """Attention over a paged KV pool for ONE layer.
+
+    k_pool/v_pool: [n_blocks, bs, kv, d]; block_table: [B, max_blocks];
+    positions: [B, S] logical positions of the new tokens; valid: [B, S]
+    bool (False rows scatter into the reserved scratch block 0).
+    The per-layer gather materializes [B, max_blocks*bs, kv, d]
+    transiently — 1/n_layers of a dense cache's resident footprint — and
+    logical position t lands at gathered index t, so _cached_attention's
+    length masking applies unchanged."""
+    c = config
+    h = _rms_norm(x, params["attn_norm"], c.norm_eps)
+    q = jnp.einsum("bsd,dhk->bshk", h, params["wq"])
+    k = jnp.einsum("bsd,dhk->bshk", h, params["wk"])
+    v = jnp.einsum("bsd,dhk->bshk", h, params["wv"])
+    q = _rope(q, positions, c.rope_theta)
+    k = _rope(k, positions, c.rope_theta)
+    n_blocks, bs, kvh, d = k_pool.shape
+    b, s = positions.shape
+    blk = jnp.take_along_axis(block_table, positions // bs, axis=1)
+    flat = jnp.where(valid, blk * bs + positions % bs, 0)  # 0 = scratch
+    kf = k_pool.reshape(n_blocks * bs, kvh, d)
+    vf = v_pool.reshape(n_blocks * bs, kvh, d)
+    kf = kf.at[flat.reshape(-1)].set(
+        k.reshape(b * s, kvh, d).astype(kf.dtype))
+    vf = vf.at[flat.reshape(-1)].set(
+        v.reshape(b * s, kvh, d).astype(vf.dtype))
+    k_pool = kf.reshape(n_blocks, bs, kvh, d)
+    v_pool = vf.reshape(n_blocks, bs, kvh, d)
+    k_all = jnp.take(k_pool, block_table, axis=0).reshape(
+        b, -1, kvh, d)
+    v_all = jnp.take(v_pool, block_table, axis=0).reshape(
+        b, -1, kvh, d)
+    attn = _cached_attention(q, k_all, v_all, lengths, c)
+    x = x + jnp.einsum("bshk,hkd->bsd", attn, params["wo"])
+    return x, (k_pool, v_pool)
+
+
+def forward_with_paged_cache(params, tokens, pool, block_table, lengths,
+                             config: LlamaConfig, valid=None):
+    """forward_with_cache over a paged pool (see init_paged_kv_cache).
+
+    tokens: [B, S] new tokens at positions lengths..lengths+S; valid:
+    optional [B, S] bool for padded prefill tails (invalid positions write
+    to the scratch block and are masked from attention by `lengths`).
+    -> (logits [B, S, vocab] fp32, new_pool)"""
+    c = config
+    b, s = tokens.shape
+    positions = lengths[:, None] + jnp.arange(s)[None, :]
+    if valid is None:
+        valid = jnp.ones((b, s), bool)
+    table = with_logical_constraint(params["embed"], ("vocab", "act_embed"))
+    x = table[tokens].astype(c.dtype)
+
+    def scan_body(x, layer_in):
+        layer_p, kp, vp = layer_in
+        x, (kp, vp) = _attn_sublayer_paged(
+            x, layer_p, positions, c, kp, vp, block_table, lengths, valid)
+        x = _mlp_sublayer(x, layer_p, c)
+        return x, (kp, vp)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        scan_body, x, (params["layers"], pool["k"], pool["v"]))
+    x = _rms_norm(x, params["final_norm"], c.norm_eps)
+    logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"])
+    return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
+
+
 def forward_with_cache(params, tokens, cache, lengths, config: LlamaConfig):
     """Incremental forward for generation (prefill when S>1, decode at S=1).
 

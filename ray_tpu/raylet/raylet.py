@@ -187,6 +187,7 @@ class Raylet:
         self._gcs.call("register_node", {"info": info})
         self._cluster_view[self.node_id] = (dict(self.total), dict(self.available))
         self._cluster_addrs: Dict[NodeID, str] = {self.node_id: self.address}
+        self._view_version = 0  # delta-heartbeat cursor (see _apply_view_reply)
         # Event-driven view updates: heartbeats sync resources every period,
         # but node joins/deaths must reflect immediately (a lease burst right
         # after cluster bring-up would otherwise see a stale one-node view).
@@ -953,6 +954,7 @@ class Raylet:
                         "available": dict(self.available),
                         "total": dict(self.total),
                         "load": len(self._queue),
+                        "known_version": self._view_version,
                         "pending_demands": [
                             (dict(res), n, dict(labels) or None)
                             for (res, labels), n in demand_counts.items()
@@ -961,20 +963,42 @@ class Raylet:
                     timeout=5.0,
                 )
                 if reply.get("status") == "ok":
-                    view = reply["cluster_view"]
-                    self._cluster_addrs = {nid: v[0] for nid, v in view.items()}
-                    self._cluster_labels = {
-                        nid: v[3] for nid, v in view.items()}
-                    new_view = {}
-                    for nid, (addr, total, avail, _labels) in view.items():
-                        if nid == self.node_id:
-                            new_view[nid] = (dict(self.total), dict(self.available))
-                        else:
-                            new_view[nid] = (total, avail)
-                    self._cluster_view = new_view
+                    self._apply_view_reply(reply)
             except (ConnectionLost, OSError, asyncio.TimeoutError):
                 pass
             await asyncio.sleep(period)
+
+    def _apply_view_reply(self, reply: dict) -> None:
+        """Sync the local cluster view from a heartbeat reply: a delta
+        (changed entries + removals since our version — reference:
+        ray_syncer.h versioned snapshot relay) or a full view (legacy
+        shape, or GCS-declared version gap)."""
+        if "cluster_view" in reply:  # legacy full-view shape
+            view = reply["cluster_view"]
+            replace = True
+        else:
+            view = reply.get("cluster_delta", {})
+            replace = bool(reply.get("full"))
+            self._view_version = reply.get("view_version",
+                                           self._view_version)
+        if replace:
+            self._cluster_addrs = {}
+            self._cluster_labels = {}
+            self._cluster_view = {}
+        for nid in reply.get("removed", []):
+            self._cluster_addrs.pop(nid, None)
+            self._cluster_labels.pop(nid, None)
+            self._cluster_view.pop(nid, None)
+        for nid, (addr, total, avail, labels) in view.items():
+            self._cluster_addrs[nid] = addr
+            self._cluster_labels[nid] = labels
+            if nid == self.node_id:
+                # our own availability moved since the report was sent;
+                # trust local state over the (already stale) echo
+                self._cluster_view[nid] = (dict(self.total),
+                                           dict(self.available))
+            else:
+                self._cluster_view[nid] = (total, avail)
 
     # ------------------------------------------------------------ worker death
     def _on_worker_death(self, handle: WorkerHandle, prev_state: str):

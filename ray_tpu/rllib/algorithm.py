@@ -89,26 +89,18 @@ class Algorithm:
         return obs, num_actions
 
     def _actor_critic_spec(self, config) -> dict:
-        """Module spec for actor-critic algorithms: picks the conv encoder
-        for image observations (reference: the model catalog's encoder
-        selection, rllib core/models/configs.py:637 CNNEncoderConfig)."""
-        obs, num_actions = self._env_spaces(config.env, config.env_config)
-        if isinstance(obs, tuple):
-            return {
-                "obs_shape": obs, "num_actions": num_actions,
-                "module_class":
-                    "ray_tpu.rllib.rl_module:ConvActorCriticModule",
-                "conv_filters": tuple(
-                    tuple(f) for f in config.model.get(
-                        "conv_filters",
-                        ((32, 8, 4), (64, 4, 2), (64, 3, 1)))),
-                # reference key: post_fcnet_hiddens = dense layers AFTER the
-                # conv encoder (fcnet_hiddens' [64,64] default is the MLP
-                # torso's and would silently undersize the conv head)
-                "hiddens": tuple(
-                    config.model.get("post_fcnet_hiddens", (512,))),
-            }
-        return {
-            "obs_dim": obs, "num_actions": num_actions,
-            "hiddens": tuple(config.model.get("fcnet_hiddens", (64, 64))),
-        }
+        """Module spec for actor-critic algorithms, built by the model
+        catalog from the env's observation/action spaces (reference:
+        rllib core/models/catalog.py — MLP/CNN/flatten/one-hot/dict-concat
+        encoder selection)."""
+        from ray_tpu.rllib.catalog import Catalog
+
+        return Catalog.from_env(config.env, config.env_config,
+                                config.model).actor_critic_spec()
+
+    def _q_module_spec(self, config) -> dict:
+        """Module spec for Q-learning algorithms, via the catalog."""
+        from ray_tpu.rllib.catalog import Catalog
+
+        return Catalog.from_env(config.env, config.env_config,
+                                config.model).q_spec()

@@ -1,5 +1,9 @@
 from ray_tpu.rllib.algorithms.cql import CQL, CQLConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.dreamerv3 import (  # noqa: F401
+    DreamerV3,
+    DreamerV3Config,
+)
 from ray_tpu.rllib.algorithms.impala import (  # noqa: F401
     APPO,
     APPOConfig,
@@ -16,5 +20,6 @@ from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig  # noqa: F401
 
 __all__ = ["APPO", "APPOConfig", "BC", "BCConfig", "CQL", "CQLConfig",
-           "DQN", "DQNConfig", "IMPALA", "IMPALAConfig", "MARWIL",
-           "MARWILConfig", "PPO", "PPOConfig", "SAC", "SACConfig"]
+           "DQN", "DQNConfig", "DreamerV3", "DreamerV3Config", "IMPALA",
+           "IMPALAConfig", "MARWIL", "MARWILConfig", "PPO", "PPOConfig",
+           "SAC", "SACConfig"]

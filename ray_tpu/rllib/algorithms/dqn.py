@@ -24,11 +24,14 @@ class DQNConfig(AlgorithmConfig):
 
 class DQNLearner(JaxLearner):
     def __init__(self, module_spec: Dict[str, Any], config: Dict[str, Any]):
-        from ray_tpu.rllib.rl_module import QModule
+        from ray_tpu.rllib.rl_module import resolve_module
 
-        module = QModule(
-            module_spec["obs_dim"], module_spec["num_actions"],
-            module_spec.get("hiddens", (64, 64)))
+        # Q-learners default to QModule — resolve_module's global default
+        # is the actor-critic module, wrong for bare specs (CQL builds one)
+        module_spec = dict(module_spec)
+        module_spec.setdefault("module_class",
+                               "ray_tpu.rllib.rl_module:QModule")
+        module = resolve_module(module_spec)
         super().__init__(module, config)
         self.target_params = self.params
 
@@ -81,15 +84,19 @@ class DQNLearner(JaxLearner):
 
 class DQN(Algorithm):
     def setup(self, config: AlgorithmConfig) -> None:
-        from ray_tpu.rllib.env_runner import EnvRunnerGroup
-        from ray_tpu.rllib.rl_module import QModule
+        from ray_tpu.rllib.exploration import EpsilonGreedy, make_exploration
 
-        obs_dim, num_actions = self._env_spaces(config.env, config.env_config)
-        self.module_spec = {
-            "obs_dim": obs_dim, "num_actions": num_actions,
-            "hiddens": tuple(config.model.get("fcnet_hiddens", (64, 64))),
-        }
+        self.module_spec = self._q_module_spec(config)
+        num_actions = self.module_spec["num_actions"]
         cfg = config.to_dict()
+        # exploration_config (reference: utils/exploration/) takes priority;
+        # the legacy `epsilon` piecewise schedule maps onto EpsilonGreedy
+        expl_cfg = cfg.get("exploration_config")
+        if expl_cfg:
+            self.exploration = make_exploration(expl_cfg,
+                                                default="EpsilonGreedy")
+        else:
+            self.exploration = EpsilonGreedy(schedule=config.epsilon)
         self.learner = DQNLearner(self.module_spec, cfg)
         buf_cfg = config.replay_buffer_config
         buf_cls = PrioritizedReplayBuffer \
@@ -109,25 +116,24 @@ class DQN(Algorithm):
         self._steps_since_target_sync = 0
 
     def _epsilon(self) -> float:
-        sched = self.config.epsilon
-        t = self._num_env_steps_sampled_lifetime
-        (t0, e0), (t1, e1) = sched[0], sched[-1]
-        if t >= t1:
-            return e1
-        frac = (t - t0) / max(1, t1 - t0)
-        return e0 + frac * (e1 - e0)
+        if hasattr(self.exploration, "epsilon"):
+            return self.exploration.epsilon(
+                self._num_env_steps_sampled_lifetime)
+        return 0.0
 
     def training_step(self) -> Dict[str, Any]:
         cfg = self.config
         metrics: Dict[str, Any] = {}
         for _ in range(cfg.num_steps_per_iteration):
-            if self._rng.random() < self._epsilon():
-                action = int(self._rng.integers(self._num_actions))
-            else:
+            def _greedy():
                 q = self._q_fwd(
                     self.learner.params,
                     self._obs.astype(np.float32)[None, :])
-                action = int(np.argmax(np.asarray(q)[0]))
+                return int(np.argmax(np.asarray(q)[0]))
+
+            action = self.exploration.select_discrete(
+                self._num_env_steps_sampled_lifetime, _greedy,
+                self._num_actions, self._rng)
             next_obs, reward, term, trunc, _ = self.env.step(action)
             self.buffer.add({
                 "obs": self._obs.astype(np.float32),

@@ -44,12 +44,15 @@ class SAC(Algorithm):
         )
 
         env = gym.make(config.env, **(config.env_config or {}))
-        obs_dim = int(env.observation_space.shape[0])
-        act_dim = int(env.action_space.shape[0])
+        from ray_tpu.rllib.catalog import Catalog
+
+        spec = Catalog(env.observation_space, env.action_space,
+                       config.model).sac_specs()
+        obs_dim, act_dim = spec["obs_dim"], spec["act_dim"]
         self._act_low = np.asarray(env.action_space.low, np.float32)
         self._act_high = np.asarray(env.action_space.high, np.float32)
         self.env = env
-        hid = tuple(config.model.get("fcnet_hiddens", (256, 256)))
+        hid = spec["hiddens"]
         self.actor = GaussianActorModule(obs_dim, act_dim, hid)
         self.q1 = ContinuousQModule(obs_dim, act_dim, hid)
         self.q2 = ContinuousQModule(obs_dim, act_dim, hid)

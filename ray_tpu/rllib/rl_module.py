@@ -262,6 +262,59 @@ class ContinuousQModule:
         return _dense(layers[-1], x)[..., 0]
 
 
+def apply_encoder(enc: Dict[str, Any], obs):
+    """Pure-JAX obs encoding for catalog-built composite/odd-shaped
+    observation spaces (reference: the catalog's flatten/one-hot encoder
+    configs, rllib core/models/configs.py). Returns a [B, D] float array.
+    """
+    kind = enc["kind"]
+    if kind in ("mlp",):
+        return obs
+    if kind == "flatten":
+        return obs.reshape(obs.shape[0], -1).astype(jnp.float32)
+    if kind == "onehot":
+        return jax.nn.one_hot(obs.astype(jnp.int32), enc["n"])
+    if kind == "concat":
+        parts = []
+        for key, leaf in enc["leaves"]:
+            sub = obs[key] if enc["container"] == "dict" else obs[int(key)]
+            parts.append(apply_encoder(leaf, sub))
+        return jnp.concatenate(parts, axis=-1)
+    raise ValueError(f"unknown encoder kind {kind!r}")
+
+
+class EncodedActorCriticModule(DiscreteActorCriticModule):
+    """Actor-critic over a catalog encoder (one-hot / flatten /
+    dict-concat observations)."""
+
+    def __init__(self, encoder_spec: Dict[str, Any], num_actions: int,
+                 hiddens: Sequence[int] = (64, 64)):
+        from ray_tpu.rllib.catalog import Catalog
+
+        super().__init__(Catalog.encoded_dim(encoder_spec), num_actions,
+                         hiddens)
+        self.encoder_spec = encoder_spec
+
+    def _torso(self, params, obs):
+        return super()._torso(params, apply_encoder(self.encoder_spec, obs))
+
+
+class EncodedQModule(QModule):
+    """Q-network over a catalog encoder."""
+
+    def __init__(self, encoder_spec: Dict[str, Any], num_actions: int,
+                 hiddens: Sequence[int] = (64, 64)):
+        from ray_tpu.rllib.catalog import Catalog
+
+        super().__init__(Catalog.encoded_dim(encoder_spec), num_actions,
+                         hiddens)
+        self.encoder_spec = encoder_spec
+
+    def forward(self, params, obs) -> jnp.ndarray:
+        return super().forward(params,
+                               apply_encoder(self.encoder_spec, obs))
+
+
 def resolve_module(module_spec: Dict[str, Any]):
     """Build the RLModule named by module_spec['module_class'] (defaults to
     DiscreteActorCriticModule). Accepts a class or "module:ClassName"."""

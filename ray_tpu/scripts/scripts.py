@@ -129,6 +129,63 @@ def cmd_stop(args) -> int:
     return 0
 
 
+def cmd_up(args) -> int:
+    """Launch/refresh a cluster from a YAML (reference: ray up,
+    scripts.py:1282 -> commands.create_or_update_cluster:707)."""
+    from ray_tpu.autoscaler.commands import create_or_update_cluster
+
+    result = create_or_update_cluster(
+        args.config, no_restart=args.no_restart,
+        min_workers=args.min_workers)
+    print(f"head: {result['head']}  address: {result['address']}")
+    print(f"workers: {result['workers']}")
+    if result["failed"]:
+        print(f"FAILED workers: {result['failed']}", file=sys.stderr)
+        return 1
+    return 0
+
+
+def cmd_down(args) -> int:
+    from ray_tpu.autoscaler.commands import teardown_cluster
+
+    teardown_cluster(args.config, workers_only=args.workers_only)
+    print("cluster down.")
+    return 0
+
+
+def cmd_exec(args) -> int:
+    from ray_tpu.autoscaler.commands import exec_cluster
+
+    return exec_cluster(args.config, args.command)
+
+
+def cmd_attach(args) -> int:
+    from ray_tpu.autoscaler.commands import attach_cluster
+
+    return attach_cluster(args.config)
+
+
+def cmd_rsync_up(args) -> int:
+    from ray_tpu.autoscaler.commands import rsync
+
+    rsync(args.config, args.source, args.target, down=False)
+    return 0
+
+
+def cmd_rsync_down(args) -> int:
+    from ray_tpu.autoscaler.commands import rsync
+
+    rsync(args.config, args.source, args.target, down=True)
+    return 0
+
+
+def cmd_get_head_ip(args) -> int:
+    from ray_tpu.autoscaler.commands import get_head_node_ip
+
+    print(get_head_node_ip(args.config))
+    return 0
+
+
 def _connect(args):
     import ray_tpu
 
@@ -537,6 +594,41 @@ def main(argv=None) -> int:
 
     sp = sub.add_parser("stop", help="stop locally-started node processes")
     sp.set_defaults(fn=cmd_stop)
+
+    sp = sub.add_parser("up", help="launch a cluster from a YAML config")
+    sp.add_argument("config", help="cluster YAML path")
+    sp.add_argument("--no-restart", action="store_true",
+                    help="re-sync/setup without restarting running nodes")
+    sp.add_argument("--min-workers", type=int, default=None)
+    sp.set_defaults(fn=cmd_up)
+
+    sp = sub.add_parser("down", help="tear down a launched cluster")
+    sp.add_argument("config", help="cluster YAML path")
+    sp.add_argument("--workers-only", action="store_true")
+    sp.set_defaults(fn=cmd_down)
+
+    sp = sub.add_parser("exec", help="run a command on the head node")
+    sp.add_argument("config", help="cluster YAML path")
+    sp.add_argument("command", help="shell command to run")
+    sp.set_defaults(fn=cmd_exec)
+
+    sp = sub.add_parser("attach", help="interactive shell on the head node")
+    sp.add_argument("config", help="cluster YAML path")
+    sp.set_defaults(fn=cmd_attach)
+
+    sp = sub.add_parser("rsync-up", help="copy local files to the head")
+    sp.add_argument("config"); sp.add_argument("source")
+    sp.add_argument("target")
+    sp.set_defaults(fn=cmd_rsync_up)
+
+    sp = sub.add_parser("rsync-down", help="copy files from the head")
+    sp.add_argument("config"); sp.add_argument("source")
+    sp.add_argument("target")
+    sp.set_defaults(fn=cmd_rsync_down)
+
+    sp = sub.add_parser("get-head-ip", help="print the head node IP")
+    sp.add_argument("config")
+    sp.set_defaults(fn=cmd_get_head_ip)
 
     sp = sub.add_parser("status", help="cluster nodes + resources")
     sp.add_argument("--address")

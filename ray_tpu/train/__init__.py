@@ -42,6 +42,10 @@ from ray_tpu.train.step import (  # noqa: F401
     init_train_state,
     make_train_step,
 )
+from ray_tpu.train.gbdt import (  # noqa: F401
+    LightGBMTrainer,
+    XGBoostTrainer,
+)
 from ray_tpu.train.trainer import (  # noqa: F401
     BaseTrainer,
     DataParallelTrainer,
@@ -62,6 +66,7 @@ __all__ = [
     "JaxConfig",
     "JaxPredictor",
     "JaxTrainer",
+    "LightGBMTrainer",
     "Predictor",
     "Result",
     "RunConfig",
@@ -70,6 +75,7 @@ __all__ = [
     "TorchConfig",
     "TorchPredictor",
     "TorchTrainer",
+    "XGBoostTrainer",
     "TrainContext",
     "TrainState",
     "get_checkpoint",

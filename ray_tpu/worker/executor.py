@@ -158,6 +158,12 @@ class Executor:
             replies.append(reply)
         return replies
 
+    def execute_actor_batch_sync(self, specs) -> list:
+        """Blocking batch execution for owner-batched ORDERED actor calls:
+        they serialize on the sequencing gate regardless, so one pool job
+        running them in seq order avoids a loop+thread hop per call."""
+        return [self._run_actor_task(spec) for spec in specs]
+
     def cancel(self, task_id: TaskID, force: bool) -> bool:
         self._cancelled.add(task_id)
         ident = self._running_threads.get(task_id)

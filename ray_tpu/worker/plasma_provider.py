@@ -33,33 +33,42 @@ class PlasmaProvider:
 
     # -- write --------------------------------------------------------------
 
+    def _create_with_spill_retry(self, oid: ObjectID, size: int,
+                                 primary: bool):
+        """Allocate a writable view, asking the raylet to spill cold
+        primaries once on ShmStoreFull. None when it still doesn't fit."""
+        key = oid.binary()
+        for attempt in (0, 1):
+            try:
+                return self._client.create(key, size, primary=primary)
+            except ShmStoreFull:
+                if attempt == 0 and self._raylet_call is not None:
+                    try:
+                        self._raylet_call("spill_objects", {"need": size})
+                        continue
+                    except Exception:  # noqa: BLE001 — spill best-effort
+                        return None
+                return None
+            except ShmStoreError:
+                return None
+        return None
+
     def put_serialized(self, oid: ObjectID, s: ser.SerializedObject,
                        primary: bool = True) -> bool:
         """Write the flat payload into shm. Returns False when it doesn't fit
         (caller falls back to in-memory bytes)."""
-        key = oid.binary()
         size = s.wire_size()
-        for attempt in (0, 1):
-            try:
-                view = self._client.create(key, size, primary=primary)
-            except ShmStoreFull:
-                if attempt == 0 and self._raylet_call is not None:
-                    try:  # ask the raylet to spill cold primaries, then retry
-                        self._raylet_call("spill_objects", {"need": size})
-                        continue
-                    except Exception:  # noqa: BLE001 — spill is best-effort
-                        return False
-                return False
-            except ShmStoreError:
-                return False
-            try:
-                s.write_into(view)
-            finally:
-                del view
-            self._client.seal(key)
-            self._client.release(key)
-            return True
-        return False
+        view = self._create_with_spill_retry(oid, size, primary)
+        if view is None:
+            return False
+        try:
+            s.write_into(view)
+        finally:
+            del view
+        key = oid.binary()
+        self._client.seal(key)
+        self._client.release(key)
+        return True
 
     # -- read ---------------------------------------------------------------
 
@@ -67,6 +76,19 @@ class PlasmaProvider:
                        restore: bool = True) -> Optional[ser.SerializedObject]:
         """Zero-copy read; the underlying slot stays pinned while any
         deserialized value aliases it (GC-tied ref, see StoreClient.get)."""
+        view = self.get_raw_view(oid, restore=restore)
+        if view is None:
+            return None
+        return ser.SerializedObject.from_bytes(view)
+
+    def contains(self, oid: ObjectID) -> bool:
+        return self._client.contains(oid.binary())
+
+    # -- chunked transfer support -------------------------------------------
+
+    def get_raw_view(self, oid: ObjectID, restore: bool = True):
+        """Pinned zero-copy view of the FLAT wire payload (for serving
+        chunk ranges). Same pinning contract as get_serialized."""
         key = oid.binary()
         view = self._client.get(key, timeout_ms=0)
         if view is None and restore and self._raylet_call is not None:
@@ -76,12 +98,24 @@ class PlasmaProvider:
                 ok = False
             if ok:
                 view = self._client.get(key, timeout_ms=1000)
-        if view is None:
-            return None
-        return ser.SerializedObject.from_bytes(view)
+        return view
 
-    def contains(self, oid: ObjectID) -> bool:
-        return self._client.contains(oid.binary())
+    def create_for_receive(self, oid: ObjectID, size: int):
+        """Writable shm view for a chunked fetch to land into (secondary
+        copy: evictable). None when it doesn't fit — caller falls back to
+        heap bytes. seal_received()/abort_receive() finish the protocol."""
+        return self._create_with_spill_retry(oid, size, primary=False)
+
+    def seal_received(self, oid: ObjectID) -> None:
+        key = oid.binary()
+        self._client.seal(key)
+        self._client.release(key)
+
+    def abort_receive(self, oid: ObjectID) -> None:
+        try:
+            self._client.abort(oid.binary())
+        except Exception:  # noqa: BLE001
+            pass
 
     # -- lifecycle ----------------------------------------------------------
 

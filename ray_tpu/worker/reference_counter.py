@@ -38,6 +38,9 @@ class Reference:
     borrowers: Set[str] = field(default_factory=set)  # worker rpc addresses
     # Where the primary (large-object) copy lives, if not inline at the owner.
     location: Optional[str] = None
+    # Additional full-copy holders (chunked-fetch receivers that registered
+    # back) — extra pull sources and broadcast fan-out points.
+    locations: Set[str] = field(default_factory=set)
     lineage_task = None     # TaskSpec that produces this object (owned only)
     pinned: bool = False    # e.g. detached-actor handles, named refs
     freed: bool = False
@@ -49,7 +52,8 @@ class ReferenceCounter:
         free_callback: Callable[[ObjectID, Optional[str]], None],
         notify_owner_release: Callable[[ObjectID, object], None],
     ):
-        """free_callback(object_id, location): owner-side, actually frees.
+        """free_callback(object_id, locations: list): owner-side, actually
+        frees the primary and every registered replica.
         notify_owner_release(object_id, owner_address): borrower-side."""
         self._refs: Dict[ObjectID, Reference] = {}
         self._lock = threading.RLock()
@@ -95,6 +99,29 @@ class ReferenceCounter:
         with self._lock:
             ref = self._refs.get(object_id)
             return ref.location if ref else None
+
+    def add_location(self, object_id: ObjectID, location: str):
+        """A chunked-fetch receiver now holds a full copy."""
+        with self._lock:
+            ref = self._refs.get(object_id)
+            if ref is not None and location != ref.location:
+                ref.locations.add(location)
+
+    def drop_location(self, object_id: ObjectID, location: str):
+        with self._lock:
+            ref = self._refs.get(object_id)
+            if ref is not None:
+                ref.locations.discard(location)
+
+    def get_all_locations(self, object_id: ObjectID) -> list:
+        """Primary first, then replicas (pull sources, in preference order)."""
+        with self._lock:
+            ref = self._refs.get(object_id)
+            if ref is None:
+                return []
+            out = [] if ref.location is None else [ref.location]
+            out.extend(sorted(ref.locations - {ref.location}))
+            return out
 
     def get_lineage(self, object_id: ObjectID):
         with self._lock:
@@ -143,7 +170,7 @@ class ReferenceCounter:
                 if ref.owned:
                     if not ref.borrowers and not ref.freed:
                         ref.freed = True
-                        to_free = (object_id, ref.location)
+                        to_free = (object_id, self._locations_of(ref))
                         del self._refs[object_id]
                 else:
                     notify = (object_id, ref.owner_address)
@@ -158,6 +185,12 @@ class ReferenceCounter:
                 self._notify_release(*notify)
             except Exception:
                 pass
+
+    @staticmethod
+    def _locations_of(ref: Reference) -> list:
+        out = [] if ref.location is None else [ref.location]
+        out.extend(sorted(ref.locations - {ref.location}))
+        return out
 
     # ---- borrower bookkeeping (owner side) ----------------------------------
 
@@ -183,7 +216,7 @@ class ReferenceCounter:
                 and not ref.freed
             ):
                 ref.freed = True
-                to_free = (object_id, ref.location)
+                to_free = (object_id, self._locations_of(ref))
                 del self._refs[object_id]
         if to_free is not None:
             try:
@@ -199,6 +232,11 @@ class ReferenceCounter:
             self.remove_borrower(oid, borrower_address)
 
     # ---- introspection ------------------------------------------------------
+
+    def get_owner_address(self, object_id: ObjectID):
+        with self._lock:
+            ref = self._refs.get(object_id)
+            return ref.owner_address if ref else None
 
     def owns(self, object_id: ObjectID) -> bool:
         with self._lock:

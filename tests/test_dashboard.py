@@ -49,7 +49,7 @@ def test_dashboard_endpoints(dash_cluster):
     assert len(jobs) >= 1
 
     html = _get(base + "/")
-    assert "ray_tpu cluster" in html
+    assert "ray_tpu" in html  # SPA shell (falls back to the mini overview)
 
     version = json.loads(_get(base + "/api/version"))
     assert "gcs_address" in version
@@ -134,3 +134,29 @@ def test_dashboard_404(dash_cluster):
 
     with pytest.raises(urllib.error.HTTPError):
         _get(dash_cluster.dashboard_url + "/api/bogus")
+
+
+def test_dashboard_frontend_assets(dash_cluster):
+    """The packaged no-build SPA (reference capability:
+    dashboard/client/src): shell at /, assets under /static/, and the
+    serve-status route the Serve page reads."""
+    base = dash_cluster.dashboard_url
+
+    html = _get(base + "/")
+    assert "/static/app.js" in html and "/static/style.css" in html
+    for page in ("#nodes", "#actors", "#jobs", "#serve", "#logs"):
+        assert page in html
+
+    js = _get(base + "/static/app.js")
+    assert "pageOverview" in js and "/api/cluster_status" in js
+    css = _get(base + "/static/style.css")
+    assert "--surface-1" in css
+
+    serve_status = json.loads(_get(base + "/api/serve"))
+    assert serve_status == {"applications": {}}
+
+    # no path traversal out of the client dir
+    import urllib.error
+
+    with pytest.raises(urllib.error.HTTPError):
+        _get(base + "/static/../head.py")

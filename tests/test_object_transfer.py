@@ -1,0 +1,175 @@
+"""Chunked object-transfer data plane (reference:
+src/ray/object_manager/pull_manager.h:52 chunked pulls with admission
+control, push_manager.h:30, object_buffer_pool.cc chunk assembly).
+
+Covers: multi-chunk cross-node fetch integrity, bounded receiver memory
+(chunks land in shm, never a whole-object heap buffer), replica
+registration (completed receivers become pull sources — the broadcast
+fan-out path), and the wire-slice helper."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private import serialization as ser
+from ray_tpu._private.config import CONFIG
+
+
+def test_slice_segments_matches_flat():
+    from ray_tpu.worker.core_worker import _slice_segments
+
+    s = ser.serialize({"a": np.arange(100_000, dtype=np.int64),
+                       "b": b"y" * 10_000})
+    flat = s.to_bytes()
+    segs = s.wire_segments()
+    total = sum(memoryview(x).nbytes for x in segs)
+    assert total == len(flat)
+    step = 7_321
+    out = b"".join(_slice_segments(segs, off, min(step, total - off))
+                   for off in range(0, total, step))
+    assert out == flat
+
+
+def test_cross_node_chunked_fetch_integrity(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2, resources={"A": 1})
+    cluster.add_node(num_cpus=2, resources={"B": 1})
+    cluster.wait_for_nodes()
+    cluster.connect()
+
+    n_bytes = 3 * CONFIG.fetch_chunk_size_bytes + 12_345  # 4 chunks
+
+    @ray_tpu.remote(resources={"A": 1})
+    def produce():
+        return np.arange(n_bytes // 8, dtype=np.int64)
+
+    @ray_tpu.remote(resources={"B": 1})
+    def consume(arr):
+        # crosses nodes: the arg fetch takes the chunked path
+        return int(arr[0]), int(arr[-1]), int(arr.sum() % 1_000_000_007)
+
+    ref = produce.remote()
+    expect = np.arange(n_bytes // 8, dtype=np.int64)
+    got = ray_tpu.get(consume.remote(ref), timeout=120)
+    assert got == (0, int(expect[-1]), int(expect.sum() % 1_000_000_007))
+
+
+def test_chunked_fetch_bounded_receiver_heap(ray_start_cluster):
+    """The receiver must stream chunks into its node shm store — a full
+    heap materialization of the payload (the old monolithic RPC) would
+    show up as an RSS spike of ~object size."""
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2, resources={"A": 1})
+    cluster.add_node(num_cpus=2, resources={"B": 1})
+    cluster.wait_for_nodes()
+    cluster.connect()
+
+    n_bytes = 192 * 1024 * 1024  # 48 chunks at the default 4 MiB
+
+    @ray_tpu.remote(resources={"A": 1})
+    def produce():
+        return np.zeros(n_bytes // 8, dtype=np.int64)
+
+    @ray_tpu.remote(resources={"B": 1})
+    def consume(arr):
+        import resource
+
+        peak_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        # arr aliases the shm mapping (zero-copy deserialize); shm pages
+        # count toward RSS, so subtract the array itself: the assertion
+        # is that no SECOND whole-object buffer was ever materialized.
+        return int(arr.nbytes), int(peak_kb * 1024)
+
+    ref = produce.remote()
+    nbytes, peak = ray_tpu.get(consume.remote(ref), timeout=300)
+    assert nbytes == n_bytes
+    # worker baseline is ~120-200 MB; one extra full copy would add 192 MB
+    # on top of the shm mapping. Bound: baseline + mapping + ~1.4 chunks
+    # of transfer buffers, with headroom — NOT baseline + 2x object.
+    assert peak < 620 * 1024 * 1024, (
+        f"receiver peak RSS {peak/1e6:.0f} MB suggests a whole-object "
+        "heap buffer (monolithic fetch)")
+
+
+def test_completed_receiver_registers_as_replica(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2, resources={"A": 1})
+    cluster.add_node(num_cpus=2, resources={"B": 1})
+    cluster.wait_for_nodes()
+    cluster.connect()
+
+    n_bytes = 2 * CONFIG.fetch_chunk_size_bytes + 99
+
+    @ray_tpu.remote(resources={"A": 1})
+    def produce():
+        return np.ones(n_bytes // 8, dtype=np.int64)
+
+    @ray_tpu.remote(resources={"B": 1})
+    def consume(arr):
+        return int(arr.sum())
+
+    ref = produce.remote()
+    assert ray_tpu.get(consume.remote(ref), timeout=120) == n_bytes // 8
+
+    # the driver owns `ref`; after the cross-node fetch the B-node worker
+    # must have registered itself as a copy holder with the owner
+    from ray_tpu._raylet import get_core_worker
+
+    cw = get_core_worker()
+
+    def replicas():
+        return cw.reference_counter.get_all_locations(ref.object_id())
+
+    from ray_tpu._private.rpc import wait_until
+
+    assert wait_until(lambda: len(replicas()) >= 2, timeout=60), (
+        f"no replica registered: {replicas()}")
+
+    # a second reader on node B must still see correct data (it may now
+    # pull striped across primary + replica)
+    assert ray_tpu.get(consume.remote(ref), timeout=120) == n_bytes // 8
+
+
+def test_many_readers_broadcast(ray_start_cluster):
+    """N readers of one large object: all fetches complete and agree —
+    the fan-out path (replica striping) must not corrupt chunks."""
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=1, resources={"A": 1})
+    for _ in range(3):
+        cluster.add_node(num_cpus=2)
+    cluster.wait_for_nodes()
+    cluster.connect()
+
+    n_bytes = 2 * CONFIG.fetch_chunk_size_bytes + 7
+
+    @ray_tpu.remote(resources={"A": 1})
+    def produce():
+        rng = np.random.default_rng(0)
+        return rng.integers(0, 2**62, size=n_bytes // 8, dtype=np.int64)
+
+    @ray_tpu.remote(num_cpus=1, scheduling_strategy="SPREAD")
+    def consume(arr):
+        return int(arr.sum() % 1_000_000_007)
+
+    ref = produce.remote()
+    sums = ray_tpu.get([consume.remote(ref) for _ in range(6)], timeout=300)
+    assert len(set(sums)) == 1
+
+
+def test_chunked_fetch_small_objects_unchanged(ray_start_cluster):
+    """Sub-chunk objects keep the single-RPC fast path."""
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2, resources={"A": 1})
+    cluster.add_node(num_cpus=2, resources={"B": 1})
+    cluster.wait_for_nodes()
+    cluster.connect()
+
+    @ray_tpu.remote(resources={"A": 1})
+    def produce():
+        return b"z" * 200_000  # > inline threshold, < one chunk
+
+    @ray_tpu.remote(resources={"B": 1})
+    def consume(b):
+        return len(b)
+
+    assert ray_tpu.get(consume.remote(produce.remote()), timeout=60) == 200_000

@@ -1,0 +1,152 @@
+"""Model catalog + exploration API + DreamerV3 (reference:
+rllib/core/models/catalog.py, rllib/utils/exploration/,
+rllib/algorithms/dreamerv3/)."""
+
+import numpy as np
+import pytest
+
+
+class _Box:
+    def __init__(self, shape):
+        self.shape = shape
+
+
+class _Discrete:
+    def __init__(self, n):
+        self.n = n
+
+
+class _Dict:
+    def __init__(self, spaces):
+        self.spaces = spaces
+
+
+def test_catalog_encoder_selection():
+    from ray_tpu.rllib.catalog import Catalog
+
+    act = _Discrete(3)
+    assert Catalog(_Box((7,)), act).encoder_spec() == {
+        "kind": "mlp", "obs_dim": 7}
+    cnn = Catalog(_Box((84, 84, 4)), act).encoder_spec()
+    assert cnn["kind"] == "cnn" and cnn["obs_shape"] == (84, 84, 4)
+    flat = Catalog(_Box((5, 6)), act).encoder_spec()
+    assert flat == {"kind": "flatten", "obs_dim": 30, "obs_shape": (5, 6)}
+    oh = Catalog(_Discrete(11), act).encoder_spec()
+    assert oh == {"kind": "onehot", "n": 11}
+    comp = Catalog(_Dict({"b": _Box((4,)), "a": _Discrete(5)}),
+                   act).encoder_spec()
+    assert comp["kind"] == "concat"
+    assert [k for k, _ in comp["leaves"]] == ["a", "b"]  # sorted keys
+    assert Catalog.encoded_dim(comp) == 9
+
+
+def test_catalog_module_specs_build():
+    import jax
+
+    from ray_tpu.rllib.catalog import Catalog
+    from ray_tpu.rllib.rl_module import resolve_module
+
+    act = _Discrete(4)
+    # dict observation -> EncodedActorCriticModule, end to end through jit
+    cat = Catalog(_Dict({"pos": _Box((3,)), "goal": _Discrete(5)}), act)
+    spec = cat.actor_critic_spec()
+    module = resolve_module(spec)
+    params = module.init(jax.random.PRNGKey(0))
+    obs = {"pos": np.ones((2, 3), np.float32),
+           "goal": np.array([1, 4])}
+    out = jax.jit(module.forward_inference)(params, {"obs": obs})
+    assert out["actions"].shape == (2,)
+
+    # 2-D observation -> flatten path
+    spec2 = Catalog(_Box((4, 5)), act).actor_critic_spec()
+    m2 = resolve_module(spec2)
+    p2 = m2.init(jax.random.PRNGKey(1))
+    out2 = m2.forward_inference(p2, {"obs": np.zeros((3, 4, 5),
+                                                     np.float32)})
+    assert out2["actions"].shape == (3,)
+
+    # Q path with one-hot obs
+    qspec = Catalog(_Discrete(6), act).q_spec()
+    qm = resolve_module(qspec)
+    qp = qm.init(jax.random.PRNGKey(2))
+    q = qm.forward(qp, np.array([0, 5]))
+    assert q.shape == (2, 4)
+
+
+def test_exploration_strategies():
+    from ray_tpu.rllib.exploration import (
+        EpsilonGreedy,
+        GaussianNoise,
+        OrnsteinUhlenbeckNoise,
+        make_exploration,
+    )
+
+    rng = np.random.default_rng(0)
+    eg = EpsilonGreedy(initial_epsilon=1.0, final_epsilon=0.0,
+                       epsilon_timesteps=100)
+    assert eg.epsilon(0) == 1.0
+    assert abs(eg.epsilon(50) - 0.5) < 1e-6
+    assert eg.epsilon(1000) == 0.0
+    # fully random at t=0; fully greedy at t>=100
+    acts = {eg.select_discrete(0, lambda: 7, 3, rng) for _ in range(40)}
+    assert acts - {7}, "epsilon=1 never explored"
+    assert all(eg.select_discrete(200, lambda: 7, 3, rng) == 7
+               for _ in range(5))
+
+    gn = GaussianNoise(stddev=0.1)
+    a = gn.perturb_continuous(0, np.zeros(3), rng)
+    assert a.shape == (3,) and np.all(np.abs(a) <= 1.0)
+
+    ou = OrnsteinUhlenbeckNoise()
+    b1 = ou.perturb_continuous(0, np.zeros(2), rng)
+    b2 = ou.perturb_continuous(1, np.zeros(2), rng)
+    assert b1.shape == (2,) and not np.allclose(b1, b2)
+
+    e = make_exploration({"type": "EpsilonGreedy", "final_epsilon": 0.2})
+    assert isinstance(e, EpsilonGreedy)
+    with pytest.raises(ValueError, match="unknown exploration type"):
+        make_exploration({"type": "Bogus"})
+
+
+def test_dqn_uses_exploration_config():
+    from ray_tpu.rllib.algorithms import DQNConfig
+
+    algo = (DQNConfig()
+            .environment("CartPole-v1")
+            .training(num_steps_per_iteration=50,
+                      num_steps_sampled_before_learning_starts=1_000_000)
+            .build())
+    try:
+        from ray_tpu.rllib.exploration import EpsilonGreedy
+
+        assert isinstance(algo.exploration, EpsilonGreedy)
+        algo.train()
+        assert algo._num_env_steps_sampled_lifetime == 50
+    finally:
+        algo.stop()
+
+
+def test_dreamerv3_learns():
+    """World-model regression: DreamerV3's CartPole return must clear the
+    random baseline (~22) by a real margin — evidence the model +
+    imagination loop trains (a reference run reaches ~96 mean return at
+    60 iterations / 12k env steps on this config)."""
+    from ray_tpu.rllib.algorithms import DreamerV3Config
+
+    algo = (DreamerV3Config()
+            .environment("CartPole-v1")
+            .training(num_steps_per_iteration=200, train_ratio=48,
+                      batch_size_B=8, batch_length_T=16, horizon_H=10,
+                      entropy_coeff=1e-2, actor_lr=5e-5)
+            .build())
+    algo.config.seed = 0
+    best = 0.0
+    try:
+        for i in range(70):
+            result = algo.train()
+            best = max(best, result.get("episode_return_mean", 0.0))
+            if best >= 60.0:
+                break
+        assert best >= 60.0, f"DreamerV3 never beat random: best={best}"
+    finally:
+        algo.stop()
