@@ -127,6 +127,9 @@ _d("max_pending_lease_requests_per_scheduling_key", 10)
 _d("max_tasks_per_push", 32)            # normal-task specs per batched push RPC
 _d("task_batch_latency_ms", 5.0)        # batch pushes only for keys faster than this
 _d("tpu_probe_gce_metadata", True)      # probe GCE metadata for TPU topology at node start
+# container runtime for runtime_env image_uri workers (reference:
+# _private/runtime_env/image_uri.py uses podman); "" = first of podman/docker
+_d("container_runtime", "")
 _d("log_to_driver", True)               # stream worker stdout/stderr to the driver
 _d("log_monitor_period_ms", 500)        # worker-logfile tail interval
 _d("streaming_generator_backpressure_objects", -1)  # -1 = unbounded

@@ -452,6 +452,10 @@ class NodeInfo:
     alive: bool = True
     start_time: float = field(default_factory=time.time)
     is_head: bool = False
+    # Graceful drain (reference: `ray drain-node`, scripts.py:2268): a
+    # draining node accepts no new leases and is excluded from scheduling;
+    # it unregisters once its running leases finish (or the deadline hits).
+    draining: bool = False
 
 
 class WorkerExitType(Enum):

@@ -98,6 +98,9 @@ class GcsNodeManager:
             self._bump_node(node_id)
         info.resources_available = payload["available"]
         info.resources_total = payload.get("total", info.resources_total)
+        if payload.get("draining") and not getattr(info, "draining", False):
+            info.draining = True
+            self._bump_node(node_id)
         self._last_heartbeat[node_id] = time.monotonic()
         self._pending_demands[node_id] = payload.get("pending_demands", [])
         known = payload.get("known_version")
@@ -166,6 +169,7 @@ class GcsNodeManager:
                     "available": dict(n.resources_available),
                     "alive": n.alive,
                     "is_head": n.is_head,
+                    "draining": getattr(n, "draining", False),
                     "labels": dict(n.labels),
                 }
                 for nid, n in self._nodes.items()
@@ -184,7 +188,7 @@ class GcsNodeManager:
         return {
             nid: dict(n.resources_available)
             for nid, n in self._nodes.items()
-            if n.alive
+            if n.alive and not getattr(n, "draining", False)
         }
 
     def label_view(self) -> Dict[NodeID, Dict[str, str]]:
@@ -201,7 +205,8 @@ class GcsNodeManager:
     def pick_nodes_for(self, spec: TaskSpec) -> List[NodeID]:
         """Feasible nodes for a task spec, best-first (GCS-side scheduling)."""
         strat = spec.scheduling_strategy
-        alive = [n for n in self._nodes.values() if n.alive]
+        alive = [n for n in self._nodes.values()
+                 if n.alive and not getattr(n, "draining", False)]
         if strat.kind == "PLACEMENT_GROUP" and self.pg_locator is not None:
             info = self.pg_locator._groups.get(strat.placement_group_id)
             if info is None:
@@ -425,6 +430,7 @@ class GcsServer:
             self.task_event_manager,
         ):
             self._server.register_all(mgr)
+        self._server.register("drain_node", self._handle_drain_node)
         self._server.register("subscribe", self._handle_subscribe)
         self._server.register("unsubscribe", self._handle_unsubscribe)
         self._server.register("gcs_ping", self._handle_ping)
@@ -433,6 +439,27 @@ class GcsServer:
         self.address = self._server.start(port)
         self._health_task = self._lt.submit(self.node_manager.health_check_loop())
         return self.address
+
+    async def _handle_drain_node(self, payload):
+        """Graceful drain entry point (reference: `ray drain-node` →
+        GcsNodeManager DrainNode). Marks the node draining (excluded from
+        GCS-side scheduling immediately) and forwards the drain to its
+        raylet, which stops leasing and unregisters once idle."""
+        nid: NodeID = payload["node_id"]
+        info = self.node_manager._nodes.get(nid)
+        if info is None or not info.alive:
+            return {"status": "not_found"}
+        info.draining = True
+        self.node_manager._bump_node(nid)
+        try:
+            reply = await self._pool.get(info.raylet_address).call_async(
+                "drain_node",
+                {"reason": payload.get("reason", ""),
+                 "deadline_s": payload.get("deadline_s", 300.0)},
+                timeout=10.0)
+        except Exception as e:  # noqa: BLE001 — report, don't crash the GCS
+            return {"status": "unreachable", "error": str(e)}
+        return {"status": "ok", "raylet": reply}
 
     async def _handle_subscribe(self, payload):
         self.publisher.subscribe(payload["channel"], payload["subscriber_address"])

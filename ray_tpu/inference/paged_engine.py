@@ -169,7 +169,10 @@ class PagedInferenceEngine:
         if len(self.free_blocks) < self._blocks_for(n) + 1:
             return None
         slot = self.free_slots.pop()
-        assert self._ensure_capacity(slot, n + 1)
+        if not self._ensure_capacity(slot, n + 1):
+            # raced out of blocks despite the pre-check above
+            self.free_slots.append(slot)
+            return None
         toks = np.zeros((1, bucket), np.int32)
         toks[0, :n] = prefix
         row = self.block_table[slot:slot + 1]

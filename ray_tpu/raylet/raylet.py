@@ -153,6 +153,15 @@ class Raylet:
         # Recently-rejected infeasible demand shapes -> last-seen time;
         # reported to the GCS while fresh so the autoscaler sees them.
         self._infeasible: Dict[tuple, float] = {}
+        # Graceful drain (reference: scripts.py:2268 drain-node +
+        # node_manager's DrainRaylet): once draining, no new leases; the
+        # drain watcher unregisters the node when running leases finish.
+        self._draining = False
+        self.drain_reason = ""
+        self.drain_complete = threading.Event()
+        # set by `python -m ray_tpu start` so a drained worker PROCESS
+        # exits instead of lingering unregistered
+        self._exit_on_drain = False
 
     # ------------------------------------------------------------------ start
     def start(self, port: int = 0, max_workers: Optional[int] = None) -> str:
@@ -560,7 +569,8 @@ class Raylet:
     # ------------------------------------------------------------- RPC: pool
     async def handle_register_worker(self, payload):
         self.worker_pool.register_worker(
-            payload["worker_id"], payload["pid"], payload["address"]
+            payload["worker_id"], payload["pid"], payload["address"],
+            spawn_token=payload.get("spawn_token", ""),
         )
         self._kick()
         return {"status": "ok", "node_id": self.node_id,
@@ -590,6 +600,12 @@ class Raylet:
         spec: TaskSpec = payload["spec"]
         spillback_count = payload.get("spillback_count", 0)
         strat = spec.scheduling_strategy
+
+        if self._draining:
+            # A draining node takes no new work; the submitter retries
+            # against the rest of the cluster (whose views drop this node
+            # as its heartbeats report zero availability).
+            return {"rejected": True, "reason": "node is draining"}
 
         if strat.kind == "PLACEMENT_GROUP":
             # The submitter routes PG leases to the node holding the bundle.
@@ -713,13 +729,15 @@ class Raylet:
         resources, pg_id, bundle_index = alloc
         needs_accel = q.spec.resources.get("TPU", 0) > 0
         env_key = ""
+        image_uri = None
         if q.spec.runtime_env:
             from ray_tpu.runtime_env import env_hash as _env_hash
 
             env_key = _env_hash(q.spec.runtime_env)
+            image_uri = q.spec.runtime_env.get("image_uri")
         worker = await self.worker_pool.pop_worker(
             CONFIG.worker_register_timeout_s, needs_accelerator=needs_accel,
-            env_hash=env_key,
+            env_hash=env_key, image_uri=image_uri,
         )
         if worker is None or q.future.done():
             self._release_alloc(resources, pg_id, bundle_index)
@@ -826,6 +844,56 @@ class Raylet:
                     self.worker_pool.kill_worker(handle)
         self._kick()
         return True
+
+    async def handle_drain_node(self, payload):
+        """Graceful drain (reference: NodeManager::HandleDrainRaylet +
+        `ray drain-node`, scripts.py:2268). Stops accepting leases, rejects
+        queued ones so their submitters retry elsewhere, then unregisters
+        once running leases finish — or kills the stragglers when the
+        deadline passes (their actors restart elsewhere via the GCS FSM)."""
+        if self._draining:
+            return {"status": "already_draining"}
+        self._draining = True
+        self.drain_reason = payload.get("reason", "")
+        deadline_s = float(payload.get("deadline_s", 300.0))
+        for q in list(self._queue):
+            if not q.future.done():
+                q.future.set_result(
+                    {"rejected": True, "reason": "node is draining"})
+        self._queue.clear()
+        self._tasks.append(
+            self._lt.loop.create_task(self._drain_watch(deadline_s)))
+        return {"status": "draining", "active_leases": len(self._leases)}
+
+    async def _drain_watch(self, deadline_s: float):
+        deadline = time.monotonic() + deadline_s
+        while self._leases and time.monotonic() < deadline:
+            await asyncio.sleep(0.1)
+        if self._leases:
+            logger.warning(
+                "drain deadline passed with %d leases running; killing "
+                "their workers", len(self._leases))
+            for lease in list(self._leases.values()):
+                handle = self.worker_pool.get_by_worker_id(lease.worker_id)
+                if handle is not None:
+                    self.worker_pool.kill_worker(handle)
+            # let the reaper observe the deaths so actor-death reports and
+            # lease releases happen through the normal path
+            t0 = time.monotonic()
+            while self._leases and time.monotonic() - t0 < 5.0:
+                await asyncio.sleep(0.1)
+        try:
+            await self._gcs.call_async(
+                "unregister_node", {"node_id": self.node_id}, timeout=5.0)
+        except (ConnectionLost, OSError, asyncio.TimeoutError):
+            pass  # GCS will notice via missed heartbeats
+        logger.info("node %s drained (%s)", self.node_id.hex()[:8],
+                    self.drain_reason or "no reason given")
+        self.drain_complete.set()
+        if self._exit_on_drain:
+            threading.Thread(
+                target=lambda: (time.sleep(0.05), os._exit(0)),
+                daemon=True).start()
 
     async def handle_die(self, payload):
         """Chaos RPC (`ray-tpu kill-random-node`): ungraceful PROCESS death
@@ -951,8 +1019,12 @@ class Raylet:
                     "report_resources",
                     {
                         "node_id": self.node_id,
-                        "available": dict(self.available),
+                        # a draining node advertises zero availability so no
+                        # peer's cluster decision picks it
+                        "available": ({} if self._draining
+                                      else dict(self.available)),
                         "total": dict(self.total),
+                        "draining": self._draining,
                         "load": len(self._queue),
                         "known_version": self._view_version,
                         "pending_demands": [

@@ -87,6 +87,10 @@ class WorkerHandle:
     # dedicates workers per runtime env; returning one to the general pool
     # would leak env vars/cwd/sys.path into unrelated tasks.
     env_hash: str = ""
+    # Registration rendezvous for wrapped spawns: a worker started inside a
+    # container reports its IN-CONTAINER pid, so registration matches on
+    # this token (passed via RT_SPAWN_TOKEN) instead.
+    spawn_token: str = ""
 
 
 class WorkerPool:
@@ -137,7 +141,21 @@ class WorkerPool:
                    if w.state in ("starting", "idle", "leased")
                    and not w.is_driver)
 
-    def _spawn(self, needs_accelerator: bool = False):
+    @staticmethod
+    def _container_runtime() -> Optional[str]:
+        import shutil
+
+        configured = CONFIG.container_runtime
+        if configured:
+            return shutil.which(configured)
+        for name in ("podman", "docker"):
+            path = shutil.which(name)
+            if path:
+                return path
+        return None
+
+    def _spawn(self, needs_accelerator: bool = False,
+               image_uri: Optional[str] = None, env_hash: str = ""):
         if self._closed:
             return
         env = dict(os.environ)
@@ -156,6 +174,8 @@ class WorkerPool:
             env["JAX_PLATFORMS"] = "cpu"
         env.update(self._extra_env)
         env["RT_SYSTEM_CONFIG"] = CONFIG.serialized_overrides()
+        token = f"{self._node_id_hex[:8]}-{time.monotonic_ns()}"
+        env["RT_SPAWN_TOKEN"] = token
         # Keep worker start light: no JAX/accelerator init at import time.
         cmd = [
             sys.executable,
@@ -165,6 +185,31 @@ class WorkerPool:
             "--gcs-address", self._gcs_address,
             "--node-id", self._node_id_hex,
         ]
+        if image_uri:
+            # Container worker (reference: runtime_env/image_uri.py wraps
+            # the worker command in `podman run`). Host networking so the
+            # worker's RPC server and the raylet/GCS addresses resolve;
+            # /tmp mounted for the session dir + shm-store socket; the
+            # wire-level env vars forwarded explicitly.
+            runtime = self._container_runtime()
+            if runtime is None:
+                logger.error(
+                    "runtime_env image_uri=%r requires podman or docker "
+                    "on PATH (or RT_CONTAINER_RUNTIME); cannot start a "
+                    "container worker", image_uri)
+                return
+            forwarded = ["RT_SYSTEM_CONFIG", "RT_SPAWN_TOKEN",
+                         "JAX_PLATFORMS", *self._extra_env.keys()]
+            wrap = [runtime, "run", "--rm", "--network=host",
+                    "-v", "/tmp:/tmp"]
+            for key in dict.fromkeys(forwarded):
+                if key in env:
+                    wrap += ["-e", f"{key}={env[key]}"]
+            cmd = [*wrap, image_uri, "python", "-m",
+                   "ray_tpu._private.workers.default_worker",
+                   "--raylet-address", self._raylet_address,
+                   "--gcs-address", self._gcs_address,
+                   "--node-id", self._node_id_hex]
         log_path = os.path.join(
             self._log_dir, f"worker-{time.monotonic_ns()}.log")
         logfile = open(log_path, "ab")
@@ -175,12 +220,25 @@ class WorkerPool:
         handle = WorkerHandle(
             pid=proc.pid, proc=proc, state="starting",
             needs_accelerator=needs_accelerator, log_path=log_path,
+            env_hash=env_hash if image_uri else "", spawn_token=token,
         )
         self._workers[proc.pid] = handle
 
     # -- registration (RPC from the worker once its server is up) --
-    def register_worker(self, worker_id: WorkerID, pid: int, address: Address) -> bool:
+    def register_worker(self, worker_id: WorkerID, pid: int, address: Address,
+                        spawn_token: str = "") -> bool:
         handle = self._workers.get(pid)
+        if (handle is None or (spawn_token and handle.spawn_token
+                               and handle.spawn_token != spawn_token)):
+            # A wrapped spawn (container) reports its in-container pid,
+            # which either misses our table or collides with an unrelated
+            # host pid — the spawn token is the authoritative match.
+            handle = None
+            if spawn_token:
+                for h in self._workers.values():
+                    if h.spawn_token == spawn_token:
+                        handle = h
+                        break
         if handle is None:
             # Worker not spawned by us (e.g. driver); track it anyway.
             handle = WorkerHandle(pid=pid)
@@ -207,22 +265,27 @@ class WorkerPool:
             if not fut.done():
                 fut.set_result(None)
 
-    def _num_starting(self, needs_accelerator: bool) -> int:
+    def _num_starting(self, needs_accelerator: bool,
+                      env_hash: Optional[str] = None) -> int:
         return sum(
             1
             for w in self._workers.values()
-            if w.state == "starting" and w.needs_accelerator == needs_accelerator
+            if w.state == "starting"
+            and w.needs_accelerator == needs_accelerator
+            and (env_hash is None or w.env_hash == env_hash)
         )
 
     async def pop_worker(
         self, timeout: float, needs_accelerator: bool = False,
-        env_hash: str = "",
+        env_hash: str = "", image_uri: Optional[str] = None,
     ) -> Optional[WorkerHandle]:
         """Get an idle worker, spawning if below the cap. None on timeout.
 
         env-matched idle workers are preferred; a pristine worker may be
         claimed for any env (it becomes dedicated to it); an idle worker
-        carrying a DIFFERENT env is never handed out."""
+        carrying a DIFFERENT env is never handed out. Container envs
+        (image_uri) never claim pristine workers — those already run
+        outside the image — so they wait for a dedicated container spawn."""
         deadline = time.monotonic() + timeout
         self._pop_waiters = getattr(self, "_pop_waiters", 0) + 1
         try:
@@ -237,17 +300,20 @@ class WorkerPool:
                         break
                     if w.env_hash == "" and pristine is None:
                         pristine = w
-                if claimed is None and pristine is not None:
+                if claimed is None and pristine is not None and not image_uri:
                     claimed = pristine
                     claimed.env_hash = env_hash
                 if claimed is not None:
                     claimed.state = "leased"
                     return claimed
+                spawn_filter = env_hash if image_uri else None
                 if (
                     self.num_poolable < self._max_workers
-                    and self._num_starting(needs_accelerator) < self._pop_waiters
+                    and self._num_starting(needs_accelerator, spawn_filter)
+                    < self._pop_waiters
                 ):
-                    self._spawn(needs_accelerator)
+                    self._spawn(needs_accelerator, image_uri=image_uri,
+                                env_hash=env_hash)
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     return None

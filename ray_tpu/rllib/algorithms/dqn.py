@@ -87,6 +87,13 @@ class DQN(Algorithm):
         from ray_tpu.rllib.exploration import EpsilonGreedy, make_exploration
 
         self.module_spec = self._q_module_spec(config)
+        enc = (self.module_spec.get("module_kwargs") or {}).get(
+            "encoder_spec") or {}
+        if enc.get("kind") == "concat":
+            raise NotImplementedError(
+                "DQN's sampling loop supports Box/Discrete observations; "
+                "Dict/Tuple observation spaces are not wired here yet "
+                "(PPO's connector path handles them)")
         num_actions = self.module_spec["num_actions"]
         cfg = config.to_dict()
         # exploration_config (reference: utils/exploration/) takes priority;
@@ -128,7 +135,7 @@ class DQN(Algorithm):
             def _greedy():
                 q = self._q_fwd(
                     self.learner.params,
-                    self._obs.astype(np.float32)[None, :])
+                    np.asarray(self._obs, np.float32)[None, ...])
                 return int(np.argmax(np.asarray(q)[0]))
 
             action = self.exploration.select_discrete(
@@ -136,7 +143,9 @@ class DQN(Algorithm):
                 self._num_actions, self._rng)
             next_obs, reward, term, trunc, _ = self.env.step(action)
             self.buffer.add({
-                "obs": self._obs.astype(np.float32),
+                # asarray: Discrete envs emit plain ints (the catalog
+                # encoder one-hots them on device)
+                "obs": np.asarray(self._obs, np.float32),
                 "next_obs": np.asarray(next_obs, dtype=np.float32),
                 "actions": np.int32(action),
                 "rewards": np.float32(reward),

@@ -28,7 +28,7 @@ from typing import Any, Dict, List, Optional
 from ray_tpu.exceptions import RuntimeEnvSetupError
 
 _SUPPORTED = {"env_vars", "working_dir", "py_modules", "pip", "uv", "conda",
-              "config", "worker_process_setup_hook"}
+              "config", "worker_process_setup_hook", "image_uri"}
 _PKG_PREFIX = b"pkg:"
 _CACHE_ROOT = "/tmp/rt_session/runtime_envs"
 
@@ -51,6 +51,14 @@ class RuntimeEnv(dict):
         wd = kwargs.get("working_dir")
         if wd is not None and not isinstance(wd, str):
             raise TypeError("working_dir must be a path or gcs:// URI string")
+        img = kwargs.get("image_uri")
+        if img is not None and not isinstance(img, str):
+            raise TypeError("image_uri must be a container image string")
+        if img is not None and (kwargs.get("pip") or kwargs.get("uv")
+                                or kwargs.get("conda")):
+            # same restriction as the reference (image_uri.py): the image
+            # defines the python environment; venvs don't compose with it
+            raise ValueError("image_uri cannot be combined with pip/uv/conda")
         super().__init__(**{k: v for k, v in kwargs.items() if v is not None})
 
 

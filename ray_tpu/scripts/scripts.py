@@ -95,6 +95,7 @@ def cmd_start(args) -> int:
     from ray_tpu.raylet.raylet import Raylet
 
     raylet = Raylet(gcs_address=args.address, resources=resources or None)
+    raylet._exit_on_drain = True  # a drained worker process exits cleanly
     raylet.start(0)
     _write_pidfile("worker", {"address": args.address})
     print(f"Started worker node; joined {args.address}")
@@ -379,6 +380,35 @@ def cmd_metrics(args) -> int:
         print(f"wrote {out} (import in Grafana with a Prometheus data "
               "source scraping the dashboard /metrics endpoint)")
         return 0
+    if args.metrics_cmd == "launch-prometheus":
+        # Reference: `ray metrics launch-prometheus` (scripts.py:2539)
+        # downloads + starts Prometheus against generated scrape configs.
+        # Zero-egress here: generate the config, then start a locally
+        # installed `prometheus` binary if one exists.
+        import shutil
+        import subprocess
+
+        target = args.scrape_target or "127.0.0.1:8265"
+        out = args.output or "ray_tpu_prometheus.yml"
+        with open(out, "w") as f:
+            f.write(
+                "global:\n"
+                "  scrape_interval: 10s\n"
+                "scrape_configs:\n"
+                "  - job_name: ray_tpu\n"
+                "    metrics_path: /metrics\n"
+                "    static_configs:\n"
+                f"      - targets: ['{target}']\n"
+            )
+        print(f"wrote {out} (scraping {target})")
+        binary = shutil.which("prometheus")
+        if binary is None:
+            print("no `prometheus` binary on PATH; install it and run:\n"
+                  f"  prometheus --config.file={out}")
+            return 0
+        proc = subprocess.Popen([binary, f"--config.file={out}"])
+        print(f"started prometheus (pid {proc.pid})")
+        return 0
     print(f"unknown metrics subcommand {args.metrics_cmd!r}")
     return 1
 
@@ -573,6 +603,83 @@ def cmd_microbenchmark(args) -> int:
     return 0
 
 
+def cmd_drain_node(args) -> int:
+    """Gracefully drain a node (reference: `ray drain-node`,
+    scripts.py:2268): the node stops taking leases, running work finishes
+    (or is killed at the deadline), then the node unregisters."""
+    from ray_tpu._private.rpc import EventLoopThread, RpcClient
+
+    gcs_addr = args.address or os.environ.get("RT_ADDRESS")
+    if not gcs_addr:
+        print("--address (or RT_ADDRESS) is required", file=sys.stderr)
+        return 1
+    lt = EventLoopThread("drain-cli")
+    try:
+        gcs = RpcClient(gcs_addr, lt)
+        nodes = gcs.call("get_all_node_info", {}, timeout=10)
+        matches = [n for n in nodes
+                   if n.alive and n.node_id.hex().startswith(args.node_id)]
+        if not matches:
+            print(f"no alive node with id prefix {args.node_id!r}",
+                  file=sys.stderr)
+            return 1
+        if len(matches) > 1:
+            print(f"ambiguous node id prefix {args.node_id!r} matches "
+                  f"{len(matches)} nodes", file=sys.stderr)
+            return 1
+        node = matches[0]
+        reply = gcs.call(
+            "drain_node",
+            {"node_id": node.node_id, "reason": args.reason,
+             "deadline_s": args.deadline},
+            timeout=15)
+        if reply.get("status") not in ("ok", "already_draining"):
+            print(f"drain failed: {reply}", file=sys.stderr)
+            return 1
+        print(f"node {node.node_id.hex()[:12]} draining "
+              f"({reply.get('raylet', {}).get('active_leases', 0)} leases "
+              "still running)")
+        if args.wait:
+            deadline = time.time() + args.deadline + 30
+            while time.time() < deadline:
+                alive = gcs.call(
+                    "check_alive", {"node_ids": [node.node_id]}, timeout=10)
+                if not alive.get(node.node_id, False):
+                    print("node drained and unregistered")
+                    return 0
+                time.sleep(0.5)
+            print("timed out waiting for the drain to finish",
+                  file=sys.stderr)
+            return 1
+        return 0
+    finally:
+        lt.stop()
+
+
+def cmd_healthcheck(args) -> int:
+    """Liveness probe (reference: `ray health-check`, scripts.py:2365):
+    exit 0 iff the GCS answers a ping — usable as a container/systemd
+    health check without starting a driver."""
+    from ray_tpu._private.rpc import EventLoopThread, RpcClient
+
+    gcs_addr = args.address or os.environ.get("RT_ADDRESS")
+    if not gcs_addr:
+        print("--address (or RT_ADDRESS) is required", file=sys.stderr)
+        return 1
+    lt = EventLoopThread("healthcheck-cli")
+    try:
+        reply = RpcClient(gcs_addr, lt).call(
+            "gcs_ping", {}, timeout=args.timeout)
+        ok = reply.get("status") == "ok"
+        print("ok" if ok else f"unhealthy: {reply}")
+        return 0 if ok else 1
+    except Exception as e:  # noqa: BLE001 — any failure means unhealthy
+        print(f"unhealthy: {e}", file=sys.stderr)
+        return 1
+    finally:
+        lt.stop()
+
+
 # --------------------------------------------------------------------- main
 
 
@@ -680,9 +787,28 @@ def main(argv=None) -> int:
     sp.set_defaults(fn=cmd_logs)
 
     sp = sub.add_parser("metrics", help="metrics tooling")
-    sp.add_argument("metrics_cmd", choices=["grafana-dashboard"])
+    sp.add_argument("metrics_cmd",
+                    choices=["grafana-dashboard", "launch-prometheus"])
     sp.add_argument("-o", "--output")
+    sp.add_argument("--scrape-target",
+                    help="host:port of the dashboard /metrics endpoint")
     sp.set_defaults(fn=cmd_metrics)
+
+    sp = sub.add_parser("drain-node", help="gracefully drain a node")
+    sp.add_argument("--address")
+    sp.add_argument("--node-id", required=True,
+                    help="node id (hex, prefix ok)")
+    sp.add_argument("--reason", default="")
+    sp.add_argument("--deadline", type=float, default=300.0,
+                    help="seconds before running work is killed")
+    sp.add_argument("--wait", action="store_true",
+                    help="block until the node unregisters")
+    sp.set_defaults(fn=cmd_drain_node)
+
+    sp = sub.add_parser("healthcheck", help="exit 0 iff the GCS is healthy")
+    sp.add_argument("--address")
+    sp.add_argument("--timeout", type=float, default=5.0)
+    sp.set_defaults(fn=cmd_healthcheck)
 
     sp = sub.add_parser("kill-random-node",
                         help="chaos: ungracefully kill a random worker node")

@@ -1,0 +1,138 @@
+"""HuggingFace Transformers integration for Train.
+
+Reference: ray python/ray/train/huggingface/ — `TransformersTrainer`
+(transformers_trainer.py) runs a user-built `transformers.Trainer` on every
+gang worker over the torch.distributed process group, and
+`RayTrainReportCallback` + `prepare_trainer`
+(transformers/_transformers_utils.py) bridge HF's callback stream into
+`ray_tpu.train.report` (metrics + checkpoints).
+
+Import-gated on transformers (baked into this image): the module imports
+without it, and fit() raises a clear error if it is missing on workers.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Callable, Optional
+
+from ray_tpu.train.backend import TorchConfig
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.trainer import DataParallelTrainer
+
+__all__ = ["TransformersTrainer", "RayTrainReportCallback",
+           "prepare_trainer", "transformers_available"]
+
+
+def transformers_available() -> bool:
+    try:
+        import transformers  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+_callback_cls = None
+
+
+def _make_report_callback():
+    global _callback_cls
+    if _callback_cls is not None:
+        return _callback_cls
+    from transformers.trainer_callback import TrainerCallback
+
+    import ray_tpu.train as train
+
+    class RayTrainReportCallback(TrainerCallback):
+        """Bridges HF trainer events into the Train session (reference:
+        transformers/_transformers_utils.py RayTrainReportCallback): every
+        log becomes a metrics report; every save reports the checkpoint
+        directory (rank 0 persists it — session convention)."""
+
+        def on_log(self, args, state, control, logs=None, **kwargs):
+            if logs and not control.should_save:
+                # saves report below with the checkpoint attached; plain
+                # logs report metrics-only
+                train.report(
+                    {**logs, "step": state.global_step,
+                     "epoch": state.epoch or 0.0})
+
+        def on_save(self, args, state, control, **kwargs):
+            logs = dict(state.log_history[-1]) if state.log_history else {}
+            logs.setdefault("step", state.global_step)
+            ckpt_dir = os.path.join(
+                args.output_dir, f"checkpoint-{state.global_step}")
+            if os.path.isdir(ckpt_dir):
+                train.report(logs, checkpoint=Checkpoint(ckpt_dir))
+            else:  # non-zero ranks don't write checkpoint files
+                train.report(logs)
+
+    _callback_cls = RayTrainReportCallback
+    return RayTrainReportCallback
+
+
+def RayTrainReportCallback(*args, **kwargs):  # noqa: N802 — class factory
+    """Instantiate the HF callback (requires transformers)."""
+    return _make_report_callback()(*args, **kwargs)
+
+
+def prepare_trainer(trainer):
+    """Prepare a transformers.Trainer for gang execution: attach the
+    report callback (if absent) and silence per-worker progress bars on
+    non-zero ranks. Returns the same trainer (reference:
+    ray.train.huggingface.transformers.prepare_trainer)."""
+    import ray_tpu.train as train
+
+    cls = _make_report_callback()
+    if not any(isinstance(cb, cls)
+               for cb in trainer.callback_handler.callbacks):
+        trainer.add_callback(cls())
+    if train.get_context().get_world_rank() != 0:
+        trainer.args.disable_tqdm = True
+    return trainer
+
+
+def _transformers_train_loop(config: dict) -> None:
+    if not transformers_available():
+        raise ImportError(
+            "TransformersTrainer requires the transformers library on "
+            "every worker (runtime_env={'pip': ['transformers']})")
+    init_fn = config["_trainer_init_per_worker"]
+    user_config = config.get("_user_config") or {}
+    trainer = init_fn(user_config)
+    trainer = prepare_trainer(trainer)
+    trainer.train()
+
+
+class TransformersTrainer(DataParallelTrainer):
+    """Runs a user-constructed ``transformers.Trainer`` on each gang worker.
+
+    ``trainer_init_per_worker(config) -> transformers.Trainer`` builds the
+    model/args/datasets on the worker; the gang's torch.distributed (gloo)
+    process group is already initialized when it runs, so HF/accelerate
+    pick up distributed data parallelism automatically.
+
+    Reference: python/ray/train/huggingface/transformers_trainer.py.
+    """
+
+    _default_backend_config = TorchConfig()
+
+    def __init__(
+        self,
+        trainer_init_per_worker: Callable[[dict], "object"],
+        *,
+        trainer_init_config: Optional[dict] = None,
+        torch_config: Optional[TorchConfig] = None,
+        **kwargs,
+    ):
+        kwargs.setdefault("backend_config", torch_config or TorchConfig())
+        super().__init__(
+            _transformers_train_loop,
+            train_loop_config={
+                "_trainer_init_per_worker": trainer_init_per_worker,
+                "_user_config": trainer_init_config or {},
+            },
+            **kwargs,
+        )

@@ -1,0 +1,191 @@
+"""PyTorch Lightning integration for Train.
+
+Reference: ray python/ray/train/lightning/ — `RayDDPStrategy`,
+`RayLightningEnvironment` (cluster-provided rank/world-size/address), and
+`RayTrainReportCallback` let a `lightning.Trainer` run unmodified on a
+Train worker gang; `prepare_trainer` validates the wiring.
+
+Fully import-gated: lightning is not bundled in this image, so every
+factory raises a clear ImportError when the library is missing — the
+module itself always imports.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ray_tpu.train.backend import TorchConfig
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.trainer import DataParallelTrainer
+
+__all__ = [
+    "RayDDPStrategy", "RayLightningEnvironment", "RayTrainReportCallback",
+    "prepare_trainer", "LightningTrainer", "lightning_available",
+]
+
+
+def lightning_available() -> bool:
+    try:
+        import lightning  # noqa: F401
+
+        return True
+    except ImportError:
+        try:
+            import pytorch_lightning  # noqa: F401
+
+            return True
+        except ImportError:
+            return False
+
+
+def _lightning():
+    try:
+        import lightning
+
+        return lightning
+    except ImportError:
+        try:
+            import pytorch_lightning
+
+            return pytorch_lightning
+        except ImportError as e:
+            raise ImportError(
+                "this API requires lightning; install it on every worker "
+                "(runtime_env={'pip': ['lightning']})") from e
+
+
+def RayLightningEnvironment():  # noqa: N802 — class factory
+    """ClusterEnvironment sourcing rank/world-size from the Train context
+    (reference: lightning/_lightning_utils.py RayLightningEnvironment)."""
+    pl = _lightning()
+    from ray_tpu import train
+
+    class _Env(pl.fabric.plugins.environments.ClusterEnvironment
+               if hasattr(pl, "fabric")
+               else pl.plugins.environments.ClusterEnvironment):
+        @property
+        def creates_processes_externally(self) -> bool:
+            return True  # the gang already exists; lightning must not fork
+
+        @property
+        def main_address(self) -> str:
+            return os.environ.get("MASTER_ADDR", "127.0.0.1")
+
+        @property
+        def main_port(self) -> int:
+            return int(os.environ.get("MASTER_PORT", 0))
+
+        def world_size(self) -> int:
+            return train.get_context().get_world_size()
+
+        def set_world_size(self, size: int) -> None:
+            pass
+
+        def global_rank(self) -> int:
+            return train.get_context().get_world_rank()
+
+        def set_global_rank(self, rank: int) -> None:
+            pass
+
+        def local_rank(self) -> int:
+            return train.get_context().get_local_rank()
+
+        def node_rank(self) -> int:
+            return train.get_context().get_node_rank()
+
+        @staticmethod
+        def detect() -> bool:
+            return True
+
+        def teardown(self) -> None:
+            pass
+
+    return _Env()
+
+
+def RayDDPStrategy(**kwargs):  # noqa: N802 — class factory
+    """DDP strategy bound to the gang's pre-initialized (gloo) process
+    group (reference: lightning/_lightning_utils.py RayDDPStrategy)."""
+    pl = _lightning()
+    strategies = (pl.pytorch.strategies if hasattr(pl, "pytorch")
+                  else pl.strategies)
+    return strategies.DDPStrategy(
+        cluster_environment=RayLightningEnvironment(),
+        process_group_backend="gloo", **kwargs)
+
+
+def RayTrainReportCallback():  # noqa: N802 — class factory
+    """Reports every `trainer.validate`/epoch-end metrics dict plus the
+    latest checkpoint to the Train session."""
+    pl = _lightning()
+    from ray_tpu import train
+
+    callback_base = (pl.pytorch.callbacks.Callback
+                     if hasattr(pl, "pytorch") else pl.callbacks.Callback)
+
+    class _Report(callback_base):
+        def on_train_epoch_end(self, trainer, pl_module):
+            metrics = {k: float(v) for k, v in
+                       trainer.callback_metrics.items()}
+            metrics["epoch"] = trainer.current_epoch
+            metrics["step"] = trainer.global_step
+            ckpt_dir = None
+            if trainer.is_global_zero and trainer.checkpoint_callback:
+                path = trainer.checkpoint_callback.best_model_path
+                if path and os.path.exists(path):
+                    ckpt_dir = os.path.dirname(path)
+            if ckpt_dir:
+                train.report(metrics, checkpoint=Checkpoint(ckpt_dir))
+            else:
+                train.report(metrics)
+
+    return _Report()
+
+
+def prepare_trainer(trainer):
+    """Validate a lightning Trainer is gang-ready (reference:
+    ray.train.lightning.prepare_trainer)."""
+    _lightning()
+    env = getattr(trainer.strategy, "cluster_environment", None)
+    if env is not None and not env.creates_processes_externally:
+        raise RuntimeError(
+            "lightning Trainer must use RayDDPStrategy (or another "
+            "strategy with a Ray cluster environment) so it does not "
+            "spawn its own processes inside the worker gang")
+    return trainer
+
+
+def _lightning_train_loop(config: dict) -> None:
+    if not lightning_available():
+        raise ImportError(
+            "LightningTrainer requires lightning on every worker "
+            "(runtime_env={'pip': ['lightning']})")
+    init_fn = config["_trainer_init_per_worker"]
+    trainer, module, fit_kwargs = init_fn(config.get("_user_config") or {})
+    prepare_trainer(trainer)
+    trainer.fit(module, **(fit_kwargs or {}))
+
+
+class LightningTrainer(DataParallelTrainer):
+    """Runs a user-built lightning Trainer+module on each gang worker.
+
+    ``trainer_init_per_worker(config) -> (trainer, module, fit_kwargs)``;
+    build the Trainer with ``strategy=RayDDPStrategy()`` and
+    ``callbacks=[RayTrainReportCallback()]``.
+    """
+
+    _default_backend_config = TorchConfig()
+
+    def __init__(self, trainer_init_per_worker, *,
+                 trainer_init_config: Optional[dict] = None,
+                 torch_config: Optional[TorchConfig] = None, **kwargs):
+        kwargs.setdefault("backend_config", torch_config or TorchConfig())
+        super().__init__(
+            _lightning_train_loop,
+            train_loop_config={
+                "_trainer_init_per_worker": trainer_init_per_worker,
+                "_user_config": trainer_init_config or {},
+            },
+            **kwargs,
+        )

@@ -1,0 +1,163 @@
+"""Dask-on-ray_tpu: execute dask task graphs on the cluster.
+
+Reference: ray python/ray/util/dask/ — `ray_dask_get` is a drop-in dask
+scheduler (`dask.compute(..., scheduler=ray_dask_get)`) that runs every
+task in the graph as a cluster task, with graph edges becoming ObjectRef
+dependencies.
+
+The scheduler core works on plain dask graph dicts (key -> computation),
+so it needs no dask import; `enable_dask_on_ray()` registers it as the
+default dask scheduler when dask itself is installed.
+
+Dask graph protocol: a computation is either a literal, a key of another
+graph entry, a task tuple ``(callable, arg0, arg1, ...)``, or a (possibly
+nested) list of computations.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Hashable, List
+
+import ray_tpu
+
+__all__ = ["ray_dask_get", "enable_dask_on_ray", "dask_available"]
+
+
+def dask_available() -> bool:
+    try:
+        import dask  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+def _ishashable(x: Any) -> bool:
+    try:
+        hash(x)
+        return True
+    except TypeError:
+        return False
+
+
+@ray_tpu.remote
+def _exec_dask_task(packed: Any, *dep_values: Any) -> Any:
+    """Rebuild the computation with dependency placeholders substituted by
+    their (ray-resolved) values, then evaluate it."""
+
+    def rebuild(node: Any) -> Any:
+        if isinstance(node, _Dep):
+            return dep_values[node.index]
+        if isinstance(node, tuple) and node and callable(node[0]):
+            func, *args = node
+            return func(*[rebuild(a) for a in args])
+        if isinstance(node, list):
+            return [rebuild(n) for n in node]
+        return node
+
+    return rebuild(packed)
+
+
+class _Dep:
+    """Placeholder for a graph dependency, by position in the ref list."""
+
+    __slots__ = ("index",)
+
+    def __init__(self, index: int):
+        self.index = index
+
+    def __reduce__(self):
+        return (_Dep, (self.index,))
+
+
+def _toposort(dsk: Dict[Hashable, Any]) -> List[Hashable]:
+    seen: Dict[Hashable, int] = {}  # 0 = visiting, 1 = done
+    order: List[Hashable] = []
+
+    def deps_of(comp: Any) -> List[Hashable]:
+        out = []
+
+        def walk(node: Any):
+            if _ishashable(node) and node in dsk:
+                out.append(node)
+                return
+            if isinstance(node, tuple) and node and callable(node[0]):
+                for a in node[1:]:
+                    walk(a)
+            elif isinstance(node, list):
+                for n in node:
+                    walk(n)
+
+        walk(comp)
+        return out
+
+    def visit(key: Hashable):
+        state = seen.get(key)
+        if state == 1:
+            return
+        if state == 0:
+            raise ValueError(f"cycle in dask graph at {key!r}")
+        seen[key] = 0
+        for dep in deps_of(dsk[key]):
+            visit(dep)
+        seen[key] = 1
+        order.append(key)
+
+    for key in dsk:
+        visit(key)
+    return order
+
+
+def ray_dask_get(dsk: Dict[Hashable, Any], keys: Any, **kwargs) -> Any:
+    """Dask scheduler: execute ``dsk`` on the cluster, return the values
+    for ``keys`` (which may be a single key or a nested list of keys).
+
+    Every graph task becomes one cluster task; its graph dependencies are
+    passed as ObjectRefs so the cluster resolves them wherever the task
+    runs (no driver-side materialization of intermediates).
+    """
+    refs: Dict[Hashable, Any] = {}
+
+    for key in _toposort(dsk):
+        comp = dsk[key]
+        dep_refs: List[Any] = []
+
+        def pack(node: Any):
+            if _ishashable(node) and node in dsk:
+                dep_refs.append(refs[node])
+                return _Dep(len(dep_refs) - 1)
+            if isinstance(node, tuple) and node and callable(node[0]):
+                return (node[0], *[pack(a) for a in node[1:]])
+            if isinstance(node, list):
+                return [pack(n) for n in node]
+            return node
+
+        packed = pack(comp)
+        if isinstance(packed, _Dep):
+            # pure alias of another key
+            refs[key] = dep_refs[0]
+        elif not dep_refs and not (
+                isinstance(comp, tuple) and comp and callable(comp[0])):
+            # plain literal: no task needed
+            refs[key] = ray_tpu.put(comp)
+        else:
+            refs[key] = _exec_dask_task.remote(packed, *dep_refs)
+
+    def gather(k: Any) -> Any:
+        if isinstance(k, list):
+            return [gather(x) for x in k]
+        return ray_tpu.get(refs[k])
+
+    return gather(keys)
+
+
+def enable_dask_on_ray():
+    """Register ray_dask_get as dask's default scheduler (requires dask)."""
+    try:
+        import dask
+    except ImportError as e:
+        raise ImportError(
+            "enable_dask_on_ray() requires dask; `pip install dask` "
+            "(ray_dask_get itself also works directly: "
+            "dask.compute(x, scheduler=ray_dask_get))") from e
+    return dask.config.set(scheduler=ray_dask_get)

@@ -229,6 +229,9 @@ class CoreWorker:
                 {
                     "worker_id": self.worker_id,
                     "pid": os.getpid(),
+                    # container workers report an in-container pid; the pool
+                    # matches on the spawn token instead (worker_pool.py)
+                    "spawn_token": os.environ.get("RT_SPAWN_TOKEN", ""),
                     "address": Address(
                         node_id=None, worker_id=self.worker_id, rpc_address=self.address_str
                     ),
@@ -664,21 +667,30 @@ class CoreWorker:
         falls back to reconstruction)."""
         import concurrent.futures as cf
 
-        with self._fetch_dedup_lock:
-            fut = self._inflight_fetches.get(oid)
-            if fut is None:
-                fut = cf.Future()
-                self._inflight_fetches[oid] = fut
-                leader = True
-            else:
-                leader = False
-        if not leader:
+        while True:
+            with self._fetch_dedup_lock:
+                fut = self._inflight_fetches.get(oid)
+                if fut is None:
+                    fut = cf.Future()
+                    self._inflight_fetches[oid] = fut
+                    leader = True
+                else:
+                    leader = False
+            if leader:
+                break
             try:
                 return fut.result(
                     timeout=None if deadline is None
                     else max(0.1, deadline - time.monotonic()))
             except TimeoutError:
                 raise exc.GetTimeoutError("get() timed out")
+            except exc.GetTimeoutError:
+                # the LEADER's deadline expired, not necessarily ours: a
+                # follower with time left takes over as the new leader
+                # instead of inheriting a timeout it never asked for
+                if (deadline is not None
+                        and time.monotonic() >= deadline):
+                    raise
         try:
             result = self._lt.run_coro(
                 self._chunked_fetch_async(oid, size, sources, deadline,
@@ -1947,6 +1959,10 @@ class CoreWorker:
                     return {"status": "not_found"}
                 if view.nbytes > max_inline:
                     return {"status": "chunked", "size": view.nbytes}
+                # serve from the already-pinned view (a second
+                # get_serialized would redo the store lookup + pin)
+                return {"status": "ok",
+                        "data": ser.SerializedObject.from_bytes(view)}
             s = await asyncio.to_thread(self._read_local_plasma, oid)
             if s is None:
                 return {"status": "not_found"}

@@ -117,11 +117,7 @@ class ReferenceCounter:
         """Primary first, then replicas (pull sources, in preference order)."""
         with self._lock:
             ref = self._refs.get(object_id)
-            if ref is None:
-                return []
-            out = [] if ref.location is None else [ref.location]
-            out.extend(sorted(ref.locations - {ref.location}))
-            return out
+            return [] if ref is None else self._locations_of(ref)
 
     def get_lineage(self, object_id: ObjectID):
         with self._lock:

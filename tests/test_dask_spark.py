@@ -1,0 +1,75 @@
+"""dask-on-ray scheduler + spark-on-ray gating.
+
+Reference: ray python/ray/util/dask/tests/test_dask_scheduler.py (graph
+execution through ray), util/spark. The dask scheduler core consumes the
+plain dask graph-dict protocol, so it is exercised here without dask
+installed; dask's own collections plug in via scheduler=ray_dask_get.
+"""
+
+from operator import add, mul
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.dask import enable_dask_on_ray, ray_dask_get
+from ray_tpu.util.spark import setup_spark_on_ray, spark_available
+
+
+def test_ray_dask_get_graph(ray_start_regular):
+    dsk = {
+        "a": 1,
+        "b": 2,
+        "sum": (add, "a", "b"),
+        "prod": (mul, "sum", 10),
+        "alias": "prod",
+        "pair": ["sum", "prod"],
+    }
+    assert ray_dask_get(dsk, "sum") == 3
+    assert ray_dask_get(dsk, "alias") == 30
+    assert ray_dask_get(dsk, ["pair"]) == [[3, 30]]
+    assert ray_dask_get(dsk, [["sum", "prod"]]) == [[3, 30]]
+
+
+def test_ray_dask_get_nested_tasks(ray_start_regular):
+    # nested task tuples evaluate inline within one cluster task
+    dsk = {"x": 4, "y": (add, (mul, "x", 2), 1)}
+    assert ray_dask_get(dsk, "y") == 9
+
+
+def test_ray_dask_get_cycle_detected(ray_start_regular):
+    with pytest.raises(ValueError, match="cycle"):
+        ray_dask_get({"a": (add, "b", 1), "b": (add, "a", 1)}, "a")
+
+
+def test_dask_intermediates_stay_distributed(ray_start_regular):
+    # each graph task runs as its own cluster task (different workers
+    # possible); the driver only materializes the requested keys
+    import numpy as np
+
+    dsk = {
+        "m": (np.ones, (256, 256)),
+        "s": (np.sum, "m"),
+        "twice": (mul, "s", 2.0),
+    }
+    assert ray_dask_get(dsk, "twice") == 2.0 * 256 * 256
+
+
+@pytest.mark.skipif(spark_available(), reason="pyspark installed")
+def test_spark_on_ray_requires_pyspark():
+    with pytest.raises(ImportError, match="pyspark"):
+        setup_spark_on_ray(master_url="spark://localhost:7077")
+
+
+def test_enable_dask_on_ray_gated():
+    try:
+        import dask  # noqa: F401
+
+        has_dask = True
+    except ImportError:
+        has_dask = False
+    if has_dask:
+        ctx = enable_dask_on_ray()
+        assert ctx is not None
+    else:
+        with pytest.raises(ImportError, match="dask"):
+            enable_dask_on_ray()

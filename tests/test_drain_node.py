@@ -1,0 +1,129 @@
+"""Graceful node drain + healthcheck/prometheus CLI.
+
+Reference: `ray drain-node` (scripts.py:2268) — node stops accepting work,
+running leases finish (or die at the deadline), then the node leaves the
+cluster; `ray health-check` (scripts.py:2365); `ray metrics
+launch-prometheus` (scripts.py:2539).
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.scripts.scripts import main as cli_main
+
+
+def _drain(node, reason="test", deadline_s=60.0):
+    from ray_tpu._raylet import get_core_worker
+
+    cw = get_core_worker()
+    return cw._gcs.call(
+        "drain_node",
+        {"node_id": node.node_id, "reason": reason, "deadline_s": deadline_s},
+        timeout=15)
+
+
+def _wait_dead(cluster, node, timeout=30.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        info = cluster.gcs.node_manager._nodes.get(node.node_id)
+        if info is not None and not info.alive:
+            return True
+        time.sleep(0.1)
+    return False
+
+
+def test_drain_node_graceful(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)
+    n2 = cluster.add_node(num_cpus=2, resources={"B": 1})
+    cluster.wait_for_nodes()
+    cluster.connect()
+
+    @ray_tpu.remote(num_cpus=0, resources={"B": 0.001})
+    def slow():
+        time.sleep(1.0)
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    ref = slow.remote()
+    time.sleep(0.4)  # let it lease on n2
+    reply = _drain(n2)
+    assert reply["status"] == "ok"
+
+    # the running lease finishes normally despite the drain
+    assert ray_tpu.get(ref, timeout=30) == n2.node_id.hex()
+
+    # new work never lands on the draining node
+    @ray_tpu.remote(num_cpus=1)
+    def whereami():
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    for _ in range(4):
+        assert ray_tpu.get(whereami.remote(), timeout=30) != n2.node_id.hex()
+
+    # once idle, the node unregisters itself
+    assert _wait_dead(cluster, n2)
+    assert n2.drain_complete.is_set()
+
+
+def test_drain_deadline_kills_stragglers(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)
+    n2 = cluster.add_node(num_cpus=2, resources={"B": 1})
+    cluster.wait_for_nodes()
+    cluster.connect()
+
+    @ray_tpu.remote(num_cpus=0, resources={"B": 0.001}, max_restarts=0)
+    class Sleeper:
+        def ready(self):
+            return True
+
+        def forever(self):
+            time.sleep(600)
+
+    a = Sleeper.remote()
+    assert ray_tpu.get(a.ready.remote(), timeout=30)
+    a.forever.remote()
+    time.sleep(0.2)
+
+    reply = _drain(n2, deadline_s=0.5)
+    assert reply["status"] == "ok"
+    # the straggler actor is killed at the deadline and the node leaves
+    assert _wait_dead(cluster, n2)
+    with pytest.raises(ray_tpu.exceptions.RayActorError):
+        ray_tpu.get(a.ready.remote(), timeout=30)
+
+
+def test_drain_node_cli(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)
+    n2 = cluster.add_node(num_cpus=1, resources={"B": 1})
+    cluster.wait_for_nodes()
+    cluster.connect()
+
+    rc = cli_main([
+        "drain-node", "--address", cluster.gcs_address,
+        "--node-id", n2.node_id.hex()[:12], "--reason", "cli test",
+        "--deadline", "30", "--wait",
+    ])
+    assert rc == 0
+    info = cluster.gcs.node_manager._nodes.get(n2.node_id)
+    assert info is not None and not info.alive
+
+
+def test_healthcheck_cli(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=1)
+    assert cli_main(["healthcheck", "--address", cluster.gcs_address]) == 0
+    assert cli_main(["healthcheck", "--address", "127.0.0.1:1",
+                     "--timeout", "1"]) == 1
+
+
+def test_launch_prometheus_writes_config(tmp_path):
+    out = tmp_path / "prom.yml"
+    rc = cli_main(["metrics", "launch-prometheus", "-o", str(out),
+                   "--scrape-target", "127.0.0.1:9999"])
+    assert rc == 0
+    text = out.read_text()
+    assert "127.0.0.1:9999" in text and "/metrics" in text
