@@ -96,6 +96,15 @@ _d("worker_register_timeout_s", 30.0)
 _d("worker_lease_idle_timeout_ms", 1000)  # submitter returns cached leases after this
 _d("worker_pool_idle_timeout_s", 60.0)    # raylet kills idle spare workers
 _d("worker_pool_prestart", 0)
+# cap on simultaneously-STARTING worker processes (reference:
+# maximum_startup_concurrency = num CPUs): an unthrottled 1k-actor burst
+# fork/imports 1k pythons at once and starves the raylet of CPU until the
+# GCS declares the node dead. 0 = auto (max(4, cores)).
+_d("worker_maximum_startup_concurrency", 0)
+# fork-server worker spawn (workers/zygote.py): one preimported process
+# per node forks workers in ~10-30ms instead of ~0.25s of fresh-python
+# imports each. Accelerator/container workers always use fresh spawns.
+_d("enable_worker_zygote", True)
 _d("rpc_connect_timeout_s", 10.0)
 _d("rpc_call_timeout_s", 60.0)
 

@@ -16,16 +16,12 @@ import sys
 import time
 
 
-def main():
-    parser = argparse.ArgumentParser()
-    parser.add_argument("--raylet-address", required=True)
-    parser.add_argument("--gcs-address", required=True)
-    parser.add_argument("--node-id", required=True)
-    parser.add_argument("--log-level", default="INFO")
-    args = parser.parse_args()
-
+def run_worker(raylet_address: str, gcs_address: str, node_id: str,
+               log_level: str = "INFO"):
+    """Connect a CoreWorker and serve until terminated. Shared by the
+    direct-spawn path (main below) and zygote fork-server children."""
     logging.basicConfig(
-        level=getattr(logging, args.log_level.upper(), logging.INFO),
+        level=getattr(logging, log_level.upper(), logging.INFO),
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",
     )
     from ray_tpu._private.config import CONFIG
@@ -36,9 +32,9 @@ def main():
 
     core_worker = CoreWorker(
         mode="worker",
-        gcs_address=args.gcs_address,
-        raylet_address=args.raylet_address,
-        node_id=NodeID.from_hex(args.node_id),
+        gcs_address=gcs_address,
+        raylet_address=raylet_address,
+        node_id=NodeID.from_hex(node_id),
     )
 
     def _term(_sig, _frm):
@@ -57,6 +53,17 @@ def main():
             time.sleep(3600)
     except (KeyboardInterrupt, SystemExit):
         pass
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--raylet-address", required=True)
+    parser.add_argument("--gcs-address", required=True)
+    parser.add_argument("--node-id", required=True)
+    parser.add_argument("--log-level", default="INFO")
+    args = parser.parse_args()
+    run_worker(args.raylet_address, args.gcs_address, args.node_id,
+               args.log_level)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,114 @@
+"""Worker fork-server ("zygote"): fast worker spawn via preimported fork.
+
+A fresh `python -m ...default_worker` pays ~0.25s of interpreter + package
+import per worker; a 1k-actor burst on a small host serializes into
+minutes of pure import CPU (and that is the measured bottleneck — see
+tools/stress_report.py). The zygote imports the worker stack ONCE, then
+`os.fork()`s per spawn request, so a worker costs a fork + CoreWorker
+connect (~10-30ms).
+
+The reference hides the same cost with worker prestart
+(worker_pool.h:155); the fork-server removes it instead of hiding it —
+prestart still helps for the accelerator/container workers that must
+keep using fresh spawns (the TPU plugin registers at import time, which
+a pre-TPU-import fork cannot replay).
+
+Protocol (line-JSON on stdio, single-threaded and fork-safe):
+  stdin  <- {"spawn": {"token": ..., "log_path": ..., "env": {...}}}
+  stdout -> {"spawned": <pid>, "token": ...}
+  stdout -> {"exited": <pid>, "status": <waitpid exit code>}
+Children are reaped HERE (they are the zygote's children); the worker
+pool converts exit reports into its normal death handling. EOF on stdin
+shuts the zygote down (children keep running; the pool owns their
+lifecycle).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import select
+import signal
+import sys
+
+
+def _child(req: dict, args) -> None:
+    """Runs in the forked child: detach, redirect output, become a worker."""
+    os.setsid()
+    fd = os.open(req["log_path"], os.O_WRONLY | os.O_CREAT | os.O_APPEND,
+                 0o644)
+    os.dup2(fd, 1)
+    os.dup2(fd, 2)
+    os.close(fd)
+    devnull = os.open(os.devnull, os.O_RDONLY)
+    os.dup2(devnull, 0)
+    os.close(devnull)
+    for k, v in (req.get("env") or {}).items():
+        os.environ[k] = v
+    # default SIGTERM disposition; run_worker installs its own handler
+    signal.signal(signal.SIGTERM, signal.SIG_DFL)
+    from ray_tpu._private.workers.default_worker import run_worker
+
+    try:
+        run_worker(args.raylet_address, args.gcs_address, args.node_id)
+    finally:
+        os._exit(0)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--raylet-address", required=True)
+    parser.add_argument("--gcs-address", required=True)
+    parser.add_argument("--node-id", required=True)
+    args = parser.parse_args()
+
+    # Preimport the worker stack so forked children inherit a warm module
+    # cache. NOTHING here may start threads or event loops — fork() only
+    # duplicates the calling thread, and a lock held elsewhere at fork
+    # time would deadlock the child.
+    import ray_tpu.worker.core_worker  # noqa: F401
+    import ray_tpu.worker.executor  # noqa: F401
+    import ray_tpu._private.serialization  # noqa: F401
+
+    out = sys.stdout
+    stdin_fd = sys.stdin.fileno()
+    buf = b""
+    while True:
+        readable, _, _ = select.select([stdin_fd], [], [], 0.2)
+        # reap exited children and report them
+        while True:
+            try:
+                pid, status = os.waitpid(-1, os.WNOHANG)
+            except ChildProcessError:
+                break
+            if pid == 0:
+                break
+            code = (os.waitstatus_to_exitcode(status)
+                    if hasattr(os, "waitstatus_to_exitcode") else status)
+            out.write(json.dumps({"exited": pid, "status": code}) + "\n")
+            out.flush()
+        if not readable:
+            continue
+        chunk = os.read(stdin_fd, 65536)
+        if not chunk:
+            return  # pool closed our stdin: shut down
+        buf += chunk
+        while b"\n" in buf:
+            line, buf = buf.split(b"\n", 1)
+            if not line.strip():
+                continue
+            req = json.loads(line)["spawn"]
+            pid = os.fork()
+            if pid == 0:
+                try:
+                    _child(req, args)
+                except BaseException:  # noqa: BLE001 — never return to loop
+                    os._exit(1)
+            out.write(json.dumps({"spawned": pid, "token": req["token"]})
+                      + "\n")
+            out.flush()
+
+
+if __name__ == "__main__":
+    main()

@@ -206,14 +206,23 @@ class GcsActorManager:
         attempt = 0
         target_node: Optional[NodeID] = None
         while attempt < 60:
-            attempt += 1
+            info = self._actors.get(actor_id)
+            if info is None or info.state == ActorState.DEAD:
+                return
             candidates = self._nodes.pick_nodes_for(spec)
             if target_node is not None:
                 candidates = [target_node] + [c for c in candidates if c != target_node]
                 target_node = None
             if not candidates:
+                # No feasible node RIGHT NOW (cluster scaling, PG bundles
+                # re-placing after a drain, ...): stay PENDING without
+                # burning the attempt budget — the reference keeps
+                # pending actors queued until resources appear. The
+                # budget guards against failing LEASES, not missing
+                # capacity.
                 await asyncio.sleep(0.25)
                 continue
+            attempt += 1
             node_id = candidates[0]
             raylet_addr = self._nodes.raylet_address(node_id)
             if raylet_addr is None:
@@ -230,6 +239,12 @@ class GcsActorManager:
                 await asyncio.sleep(0.2)
                 continue
             if reply.get("rejected"):
+                if reply.get("runtime_env_error"):
+                    # permanent env misconfiguration — the actor can never
+                    # be placed on this (or likely any) node
+                    await self._mark_dead(actor_id,
+                                          reply["runtime_env_error"])
+                    return
                 await asyncio.sleep(0.2)
                 continue
             if reply.get("retry_at"):

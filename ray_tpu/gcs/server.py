@@ -458,7 +458,19 @@ class GcsServer:
                  "deadline_s": payload.get("deadline_s", 300.0)},
                 timeout=10.0)
         except Exception as e:  # noqa: BLE001 — report, don't crash the GCS
+            # the raylet never received the drain: undo the mark, or the
+            # node would be excluded from scheduling forever while still
+            # accepting direct leases (half-drained wedge)
+            info.draining = False
+            self.node_manager._bump_node(nid)
             return {"status": "unreachable", "error": str(e)}
+        # Re-place any placement-group bundles living on the draining node
+        # (reference: drain reschedules bundles like node removal). Leases
+        # targeted at those bundles would otherwise spin on 'draining'
+        # rejections behind unrelated work until the deadline. This kills
+        # the bundles' leased workers on the drained node (cancel_bundles);
+        # gang actors restart with their group elsewhere.
+        await self.pg_manager.on_node_death(nid)
         return {"status": "ok", "raylet": reply}
 
     async def _handle_subscribe(self, payload):

@@ -170,8 +170,10 @@ class PagedInferenceEngine:
             return None
         slot = self.free_slots.pop()
         if not self._ensure_capacity(slot, n + 1):
-            # raced out of blocks despite the pre-check above
-            self.free_slots.append(slot)
+            # raced out of blocks despite the pre-check above; _release
+            # returns both the slot AND any blocks the partial allocation
+            # already consumed
+            self._release(slot)
             return None
         toks = np.zeros((1, bucket), np.int32)
         toks[0, :n] = prefix

@@ -735,6 +735,19 @@ class Raylet:
 
             env_key = _env_hash(q.spec.runtime_env)
             image_uri = q.spec.runtime_env.get("image_uri")
+        if image_uri and self.worker_pool._container_runtime() is None:
+            # permanent configuration error: fail the task (as the
+            # runtime-env layer would) instead of rejecting into an
+            # endless lease retry loop
+            self._release_alloc(resources, pg_id, bundle_index)
+            q.future.set_result({
+                "rejected": True,
+                "reason": "no container runtime",
+                "runtime_env_error":
+                    f"runtime_env image_uri={image_uri!r} requires podman "
+                    "or docker on the node's PATH (or RT_CONTAINER_RUNTIME)",
+            })
+            return
         worker = await self.worker_pool.pop_worker(
             CONFIG.worker_register_timeout_s, needs_accelerator=needs_accel,
             env_hash=env_key, image_uri=image_uri,
@@ -861,6 +874,12 @@ class Raylet:
                 q.future.set_result(
                     {"rejected": True, "reason": "node is draining"})
         self._queue.clear()
+        # Release local placement-group bundles (killing their leased
+        # workers): the gang reservation cannot 'finish' the way a task
+        # does, and the GCS re-places these bundles on other nodes right
+        # after this RPC returns (gcs/server.py::_handle_drain_node).
+        for pg_id in list(self._bundles):
+            await self.handle_cancel_bundles({"placement_group_id": pg_id})
         self._tasks.append(
             self._lt.loop.create_task(self._drain_watch(deadline_s)))
         return {"status": "draining", "active_leases": len(self._leases)}

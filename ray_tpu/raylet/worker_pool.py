@@ -9,8 +9,10 @@ exits so the raylet can report worker/actor deaths.
 from __future__ import annotations
 
 import asyncio
+import json
 import logging
 import os
+import signal
 import subprocess
 import sys
 import threading
@@ -23,6 +25,59 @@ from ray_tpu._private.ids import WorkerID
 from ray_tpu._private.specs import Address
 
 logger = logging.getLogger(__name__)
+
+
+class _ForkedProc:
+    """Popen-like shim for zygote-forked workers. They are the ZYGOTE's
+    children, not ours, so poll() probes liveness with signal 0; the real
+    exit code arrives via the zygote's exit report (reader sets
+    `returncode`). A just-died worker stays a zombie until the zygote
+    reaps it, so the probe flips only at/after the report — the grace
+    window below covers a zygote that died without reporting."""
+
+    def __init__(self, pid: int):
+        self.pid = pid
+        self.returncode: Optional[int] = None
+        self._gone_since = 0.0
+
+    def poll(self) -> Optional[int]:
+        if self.returncode is not None:
+            return self.returncode
+        try:
+            os.kill(self.pid, 0)
+            return None
+        except ProcessLookupError:
+            now = time.monotonic()
+            if not self._gone_since:
+                self._gone_since = now
+                return None
+            if now - self._gone_since < 0.5:
+                return None  # give the exit report time to land
+            self.returncode = -1
+            return self.returncode
+        except PermissionError:  # pid reused by another user: treat alive
+            return None
+
+    def terminate(self):
+        try:
+            os.kill(self.pid, signal.SIGTERM)
+        except ProcessLookupError:
+            pass
+
+    def kill(self):
+        try:
+            os.kill(self.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+
+    def wait(self, timeout: Optional[float] = None) -> Optional[int]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while self.poll() is None:
+            if deadline is not None and time.monotonic() >= deadline:
+                raise subprocess.TimeoutExpired("zygote-forked worker",
+                                                timeout)
+            time.sleep(0.02)
+        return self.returncode
 
 
 @dataclass
@@ -119,6 +174,10 @@ class WorkerPool:
         self._waiters: List[asyncio.Future] = []
         self._monitor_task: Optional[asyncio.Task] = None
         self._closed = False
+        # fork-server for plain workers (see workers/zygote.py)
+        self._zygote: Optional[subprocess.Popen] = None
+        self._pending_forks: Dict[str, WorkerHandle] = {}  # token -> handle
+        self._zygote_failures = 0  # crash-looping zygote disables itself
         os.makedirs(log_dir, exist_ok=True)
 
     def start(self):
@@ -141,6 +200,110 @@ class WorkerPool:
                    if w.state in ("starting", "idle", "leased")
                    and not w.is_driver)
 
+    # ----------------------------------------------------- zygote fork-server
+    def _worker_base_env(self) -> dict:
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.update(self._extra_env)
+        env["RT_SYSTEM_CONFIG"] = CONFIG.serialized_overrides()
+        return env
+
+    def _ensure_zygote(self) -> bool:
+        if self._zygote is not None and self._zygote.poll() is None:
+            return True
+        if not CONFIG.enable_worker_zygote or self._closed:
+            return False
+        if self._zygote_failures >= 3:
+            # crash-looping (bad install, import error): stop restarting it
+            # every spawn attempt and let direct spawns carry the node
+            return False
+        cmd = [
+            sys.executable, "-m", "ray_tpu._private.workers.zygote",
+            "--raylet-address", self._raylet_address,
+            "--gcs-address", self._gcs_address,
+            "--node-id", self._node_id_hex,
+        ]
+        zlog = open(os.path.join(self._log_dir, "zygote.log"), "ab")
+        try:
+            self._zygote = subprocess.Popen(
+                cmd, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                stderr=zlog, env=self._worker_base_env(),
+                start_new_session=True)
+        except Exception:  # noqa: BLE001 — fall back to direct spawns
+            logger.exception("zygote start failed; using direct spawns")
+            self._zygote = None
+            return False
+        finally:
+            zlog.close()
+        self._loop.create_task(self._zygote_reader(self._zygote))
+        return True
+
+    async def _zygote_reader(self, z: subprocess.Popen):
+        """Consume spawn/exit reports from one zygote process."""
+        while True:
+            line = await asyncio.to_thread(z.stdout.readline)
+            if not line:
+                break
+            try:
+                msg = json.loads(line)
+            except ValueError:
+                continue
+            if "spawned" in msg:
+                self._zygote_failures = 0  # forking ⇒ healthy zygote
+                handle = self._pending_forks.pop(msg.get("token", ""), None)
+                if handle is None:
+                    continue
+                handle.proc = _ForkedProc(msg["spawned"])
+                handle.pid = msg["spawned"]
+                for key, h in list(self._workers.items()):
+                    if h is handle and key != handle.pid:
+                        del self._workers[key]
+                        break
+                self._workers[handle.pid] = handle
+                if self._closed:
+                    handle.proc.terminate()
+            elif "exited" in msg:
+                handle = self._workers.get(msg["exited"])
+                if handle is not None and isinstance(handle.proc,
+                                                    _ForkedProc):
+                    # monitor loop picks this up and runs death handling
+                    handle.proc.returncode = msg.get("status", -1)
+        # zygote gone: drop pending forks so their waiters respawn direct
+        if self._zygote is z:
+            self._zygote = None
+        if not self._closed:
+            self._zygote_failures += 1
+            if self._zygote_failures >= 3:
+                logger.error(
+                    "worker zygote died %d times; disabling the "
+                    "fork-server for this node (direct spawns only)",
+                    self._zygote_failures)
+        for token, handle in list(self._pending_forks.items()):
+            del self._pending_forks[token]
+            for key, h in list(self._workers.items()):
+                if h is handle:
+                    del self._workers[key]
+                    break
+        self._wake_waiters()
+
+    def _spawn_via_zygote(self, token: str, log_path: str,
+                          handle: WorkerHandle) -> bool:
+        if not self._ensure_zygote():
+            return False
+        req = {"spawn": {"token": token, "log_path": log_path,
+                         "env": {"RT_SPAWN_TOKEN": token,
+                                 "RT_SYSTEM_CONFIG":
+                                     CONFIG.serialized_overrides()}}}
+        try:
+            self._zygote.stdin.write((json.dumps(req) + "\n").encode())
+            self._zygote.stdin.flush()
+        except Exception:  # noqa: BLE001 — broken pipe etc.
+            logger.warning("zygote write failed; using direct spawn")
+            return False
+        self._pending_forks[token] = handle
+        return True
+
     @staticmethod
     def _container_runtime() -> Optional[str]:
         import shutil
@@ -158,6 +321,28 @@ class WorkerPool:
                image_uri: Optional[str] = None, env_hash: str = ""):
         if self._closed:
             return
+        token = f"{self._node_id_hex[:8]}-{time.monotonic_ns()}"
+        log_path = os.path.join(
+            self._log_dir, f"worker-{time.monotonic_ns()}.log")
+        # The placeholder handle keeps spawn gating exact (_num_starting
+        # counts it immediately); it is re-keyed to the real pid once the
+        # process exists.
+        placeholder_key = -time.monotonic_ns()
+        handle = WorkerHandle(
+            pid=0, proc=None, state="starting",
+            needs_accelerator=needs_accelerator, log_path=log_path,
+            env_hash=env_hash if image_uri else "", spawn_token=token,
+        )
+        self._workers[placeholder_key] = handle
+
+        # Plain workers fork from the preimported zygote (~10-30ms);
+        # accelerator workers need the TPU plugin registered at import
+        # time and container workers need the image — both use fresh
+        # spawns below.
+        if (not needs_accelerator and not image_uri
+                and self._spawn_via_zygote(token, log_path, handle)):
+            return
+
         env = dict(os.environ)
         if not needs_accelerator:
             # This host's sitecustomize registers the TPU PJRT plugin (and
@@ -174,7 +359,6 @@ class WorkerPool:
             env["JAX_PLATFORMS"] = "cpu"
         env.update(self._extra_env)
         env["RT_SYSTEM_CONFIG"] = CONFIG.serialized_overrides()
-        token = f"{self._node_id_hex[:8]}-{time.monotonic_ns()}"
         env["RT_SPAWN_TOKEN"] = token
         # Keep worker start light: no JAX/accelerator init at import time.
         cmd = [
@@ -197,6 +381,7 @@ class WorkerPool:
                     "runtime_env image_uri=%r requires podman or docker "
                     "on PATH (or RT_CONTAINER_RUNTIME); cannot start a "
                     "container worker", image_uri)
+                self._workers.pop(placeholder_key, None)
                 return
             forwarded = ["RT_SYSTEM_CONFIG", "RT_SPAWN_TOKEN",
                          "JAX_PLATFORMS", *self._extra_env.keys()]
@@ -210,19 +395,38 @@ class WorkerPool:
                    "--raylet-address", self._raylet_address,
                    "--gcs-address", self._gcs_address,
                    "--node-id", self._node_id_hex]
-        log_path = os.path.join(
-            self._log_dir, f"worker-{time.monotonic_ns()}.log")
-        logfile = open(log_path, "ab")
-        proc = subprocess.Popen(
-            cmd, stdout=logfile, stderr=subprocess.STDOUT, env=env,
-            start_new_session=True,
-        )
-        handle = WorkerHandle(
-            pid=proc.pid, proc=proc, state="starting",
-            needs_accelerator=needs_accelerator, log_path=log_path,
-            env_hash=env_hash if image_uri else "", spawn_token=token,
-        )
-        self._workers[proc.pid] = handle
+        # The fork/exec itself runs OFF the event loop: on a loaded box a
+        # Popen can take tens of ms, and a burst of spawns on the loop
+        # starves heartbeats until the GCS declares the node dead.
+        def do_popen():
+            logfile = open(log_path, "ab")
+            try:
+                return subprocess.Popen(
+                    cmd, stdout=logfile, stderr=subprocess.STDOUT, env=env,
+                    start_new_session=True,
+                )
+            finally:
+                logfile.close()  # the child holds its own copy
+
+        async def finish():
+            try:
+                proc = await asyncio.to_thread(do_popen)
+            except Exception:  # noqa: BLE001 — spawn failure, drop the slot
+                logger.exception("worker spawn failed")
+                self._workers.pop(placeholder_key, None)
+                self._wake_waiters()
+                return
+            handle.proc = proc
+            handle.pid = proc.pid
+            if self._workers.pop(placeholder_key, None) is not None:
+                self._workers[proc.pid] = handle
+            if self._closed:
+                try:
+                    proc.terminate()
+                except Exception:  # noqa: BLE001
+                    pass
+
+        self._loop.create_task(finish())
 
     # -- registration (RPC from the worker once its server is up) --
     def register_worker(self, worker_id: WorkerID, pid: int, address: Address,
@@ -307,10 +511,14 @@ class WorkerPool:
                     claimed.state = "leased"
                     return claimed
                 spawn_filter = env_hash if image_uri else None
+                startup_cap = (CONFIG.worker_maximum_startup_concurrency
+                               or max(4, os.cpu_count() or 4))
                 if (
                     self.num_poolable < self._max_workers
                     and self._num_starting(needs_accelerator, spawn_filter)
                     < self._pop_waiters
+                    and sum(1 for w in self._workers.values()
+                            if w.state == "starting") < startup_cap
                 ):
                     self._spawn(needs_accelerator, image_uri=image_uri,
                                 env_hash=env_hash)
@@ -403,6 +611,16 @@ class WorkerPool:
         self._closed = True
         if self._monitor_task is not None:
             self._monitor_task.cancel()
+        if self._zygote is not None:
+            try:
+                self._zygote.stdin.close()  # EOF = clean zygote exit
+            except Exception:  # noqa: BLE001
+                pass
+            try:
+                self._zygote.terminate()
+            except Exception:  # noqa: BLE001
+                pass
+            self._zygote = None
         for handle in self._workers.values():
             if handle.proc is not None and handle.proc.poll() is None:
                 try:

@@ -1,0 +1,172 @@
+"""External HPO library searcher wrappers: Optuna / HyperOpt.
+
+Reference: ray python/ray/tune/search/optuna/optuna_search.py and
+hyperopt/hyperopt_search.py — adapters that translate the Tune search
+space + trial lifecycle onto the external library's ask/tell interface.
+
+Import-gated like the reference: the classes construct only when their
+library is importable and raise a clear ImportError otherwise; the
+native TPE/GP searchers (tpe.py, bayesopt.py) cover the same capability
+with no extra dependency.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ray_tpu.tune.search import sample
+from ray_tpu.tune.search.searcher import Searcher
+
+__all__ = ["OptunaSearch", "HyperOptSearch"]
+
+
+def _metric_sign(mode: str) -> float:
+    return 1.0 if mode == "max" else -1.0
+
+
+class OptunaSearch(Searcher):
+    """Tune searcher over optuna's ask/tell API (requires optuna)."""
+
+    def __init__(self, space: Optional[Dict[str, Any]] = None,
+                 metric: Optional[str] = None, mode: str = "max",
+                 seed: Optional[int] = None, **optuna_kwargs):
+        try:
+            import optuna
+        except ImportError as e:
+            raise ImportError(
+                "OptunaSearch requires optuna (`pip install optuna`); the "
+                "built-in TPESearch/BayesOptSearch provide dependency-free "
+                "alternatives") from e
+        super().__init__(metric=metric, mode=mode)
+        self._optuna = optuna
+        sampler = optuna_kwargs.pop(
+            "sampler", optuna.samplers.TPESampler(seed=seed))
+        self._study = optuna.create_study(
+            direction="maximize" if mode == "max" else "minimize",
+            sampler=sampler, **optuna_kwargs)
+        self._space = space or {}
+        self._trials: Dict[str, Any] = {}
+
+    def set_search_properties(self, metric, mode, config) -> bool:
+        if config and not self._space:
+            self._space = config
+        return super().set_search_properties(metric, mode, config)
+
+    def _suggest_param(self, trial, name: str, dist: Any):
+        if isinstance(dist, sample.Categorical):
+            return trial.suggest_categorical(name, list(dist.categories))
+        if isinstance(dist, sample.Integer):
+            return trial.suggest_int(name, dist.lower, dist.upper - 1,
+                                     log=bool(dist.log))
+        if isinstance(dist, sample.Float):
+            if dist.normal:  # (mean, sd) — optuna has no gaussian: widen
+                return trial.suggest_float(
+                    name, dist.lower - 4 * dist.upper,
+                    dist.lower + 4 * dist.upper)
+            val = trial.suggest_float(name, dist.lower, dist.upper,
+                                      log=bool(dist.log))
+            return round(val / dist.q) * dist.q if dist.q else val
+        return dist  # constant
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        trial = self._study.ask()
+        self._trials[trial_id] = trial
+        return {name: self._suggest_param(trial, name, dist)
+                for name, dist in self._space.items()}
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        trial = self._trials.pop(trial_id, None)
+        if trial is None:
+            return
+        state = self._optuna.trial.TrialState.FAIL
+        value = None
+        if not error and result is not None and self.metric in result:
+            state = self._optuna.trial.TrialState.COMPLETE
+            value = float(result[self.metric])
+        self._study.tell(trial, value, state=state)
+
+
+class HyperOptSearch(Searcher):
+    """Tune searcher over hyperopt's TPE (requires hyperopt)."""
+
+    def __init__(self, space: Optional[Dict[str, Any]] = None,
+                 metric: Optional[str] = None, mode: str = "max",
+                 seed: Optional[int] = None, n_initial_points: int = 20):
+        try:
+            import hyperopt
+        except ImportError as e:
+            raise ImportError(
+                "HyperOptSearch requires hyperopt (`pip install "
+                "hyperopt`); the built-in TPESearch provides a "
+                "dependency-free alternative") from e
+        super().__init__(metric=metric, mode=mode)
+        import numpy as np
+
+        self._hp = hyperopt
+        self._rng = np.random.default_rng(seed)
+        self._space = {}
+        if space:
+            self._space = {k: self._to_hp(k, v) for k, v in space.items()}
+        self._domain = None
+        self._hp_trials = hyperopt.Trials()
+        self._ids: Dict[str, int] = {}
+        self._n_initial = n_initial_points
+
+    def _to_hp(self, name: str, dist: Any):
+        import math
+
+        hp = self._hp.hp
+        if isinstance(dist, sample.Categorical):
+            return hp.choice(name, list(dist.categories))
+        if isinstance(dist, sample.Integer):
+            return self._hp.pyll.scope.int(
+                hp.quniform(name, dist.lower, dist.upper - 1, 1))
+        if isinstance(dist, sample.Float):
+            if dist.normal:
+                return hp.normal(name, dist.lower, dist.upper)  # (mean, sd)
+            if dist.log:
+                return hp.loguniform(name, math.log(dist.lower),
+                                     math.log(dist.upper))
+            if dist.q:
+                return hp.quniform(name, dist.lower, dist.upper, dist.q)
+            return hp.uniform(name, dist.lower, dist.upper)
+        return dist
+
+    def set_search_properties(self, metric, mode, config) -> bool:
+        if config and not self._space:
+            self._space = {k: self._to_hp(k, v) for k, v in config.items()}
+        return super().set_search_properties(metric, mode, config)
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        hp = self._hp
+        if self._domain is None:
+            self._domain = hp.base.Domain(lambda c: 0.0, self._space)
+        new_id = len(self._hp_trials.trials)
+        seed = int(self._rng.integers(2**31 - 1))
+        docs = hp.tpe.suggest(
+            [new_id], self._domain, self._hp_trials, seed,
+            n_startup_jobs=self._n_initial)
+        self._hp_trials.insert_trial_docs(docs)
+        self._hp_trials.refresh()
+        self._ids[trial_id] = new_id
+        vals = {k: v[0] for k, v in
+                docs[0]["misc"]["vals"].items() if v}
+        cfg = hp.space_eval(self._space, vals)
+        return dict(cfg)
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        hp_id = self._ids.pop(trial_id, None)
+        if hp_id is None:
+            return
+        for t in self._hp_trials.trials:
+            if t["tid"] != hp_id:
+                continue
+            if error or result is None or self.metric not in result:
+                t["state"] = self._hp.JOB_STATE_ERROR
+                t["result"] = {"status": self._hp.STATUS_FAIL}
+            else:
+                # hyperopt minimizes its loss
+                loss = -_metric_sign(self.mode) * float(result[self.metric])
+                t["state"] = self._hp.JOB_STATE_DONE
+                t["result"] = {"status": self._hp.STATUS_OK, "loss": loss}
+        self._hp_trials.refresh()

@@ -121,12 +121,15 @@ def ray_dask_get(dsk: Dict[Hashable, Any], keys: Any, **kwargs) -> Any:
     for key in _toposort(dsk):
         comp = dsk[key]
         dep_refs: List[Any] = []
+        saw_task = False
 
         def pack(node: Any):
+            nonlocal saw_task
             if _ishashable(node) and node in dsk:
                 dep_refs.append(refs[node])
                 return _Dep(len(dep_refs) - 1)
             if isinstance(node, tuple) and node and callable(node[0]):
+                saw_task = True
                 return (node[0], *[pack(a) for a in node[1:]])
             if isinstance(node, list):
                 return [pack(n) for n in node]
@@ -136,11 +139,11 @@ def ray_dask_get(dsk: Dict[Hashable, Any], keys: Any, **kwargs) -> Any:
         if isinstance(packed, _Dep):
             # pure alias of another key
             refs[key] = dep_refs[0]
-        elif not dep_refs and not (
-                isinstance(comp, tuple) and comp and callable(comp[0])):
+        elif not dep_refs and not saw_task:
             # plain literal: no task needed
             refs[key] = ray_tpu.put(comp)
         else:
+            # task tuple, or any structure containing task tuples / deps
             refs[key] = _exec_dask_task.remote(packed, *dep_refs)
 
     def gather(k: Any) -> Any:

@@ -682,15 +682,17 @@ class CoreWorker:
                 return fut.result(
                     timeout=None if deadline is None
                     else max(0.1, deadline - time.monotonic()))
-            except TimeoutError:
-                raise exc.GetTimeoutError("get() timed out")
             except exc.GetTimeoutError:
                 # the LEADER's deadline expired, not necessarily ours: a
                 # follower with time left takes over as the new leader
-                # instead of inheriting a timeout it never asked for
+                # instead of inheriting a timeout it never asked for.
+                # (This clause must precede TimeoutError — GetTimeoutError
+                # subclasses it.)
                 if (deadline is not None
                         and time.monotonic() >= deadline):
                     raise
+            except TimeoutError:
+                raise exc.GetTimeoutError("get() timed out")
         try:
             result = self._lt.run_coro(
                 self._chunked_fetch_async(oid, size, sources, deadline,
@@ -1221,6 +1223,11 @@ class CoreWorker:
                 spillback = 1
                 continue
             if reply.get("rejected"):
+                if reply.get("runtime_env_error"):
+                    # permanent env misconfiguration — fail, don't retry
+                    self._fail_queued(key, exc.RuntimeEnvSetupError(
+                        reply["runtime_env_error"]))
+                    return
                 now = time.monotonic()
                 if now - warned > 10:
                     warned = now

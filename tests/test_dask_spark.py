@@ -73,3 +73,10 @@ def test_enable_dask_on_ray_gated():
     else:
         with pytest.raises(ImportError, match="dask"):
             enable_dask_on_ray()
+
+
+def test_ray_dask_get_list_of_tasks(ray_start_regular):
+    # a bare list CONTAINING task tuples is a computation, not a literal
+    dsk = {"z": [(add, 1, 2), (mul, 2, 5)], "w": (sum, "z")}
+    assert ray_dask_get(dsk, "z") == [3, 10]
+    assert ray_dask_get(dsk, "w") == 13

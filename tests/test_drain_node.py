@@ -127,3 +127,50 @@ def test_launch_prometheus_writes_config(tmp_path):
     assert rc == 0
     text = out.read_text()
     assert "127.0.0.1:9999" in text and "/metrics" in text
+
+
+def test_drain_reschedules_pg_bundles(ray_start_cluster):
+    """A draining node's PG bundles are released and re-placed (reference:
+    drain treats bundles like node removal) — gang actors follow their
+    group to a new node instead of pinning the drain open."""
+    from ray_tpu.util.placement_group import placement_group
+    from ray_tpu.util.scheduling_strategies import (
+        PlacementGroupSchedulingStrategy,
+    )
+
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=0)  # driver/head node: no task capacity
+    n2 = cluster.add_node(num_cpus=2)
+    cluster.wait_for_nodes()
+    cluster.connect()
+
+    pg = placement_group([{"CPU": 1}])
+    assert pg.wait(timeout_seconds=60)
+
+    @ray_tpu.remote(num_cpus=1, max_restarts=-1, max_task_retries=-1)
+    class Member:
+        def node(self):
+            return ray_tpu.get_runtime_context().get_node_id()
+
+    a = Member.options(
+        scheduling_strategy=PlacementGroupSchedulingStrategy(
+            placement_group=pg)).remote()
+    assert ray_tpu.get(a.node.remote(), timeout=60) == n2.node_id.hex()
+
+    reply = _drain(n2, deadline_s=60)
+    assert reply["status"] == "ok"
+    # the drained node leaves even though it hosted a PG gang
+    assert _wait_dead(cluster, n2)
+
+    # capacity returns: the gang re-places and the actor restarts there
+    n3 = cluster.add_node(num_cpus=2)
+    deadline = time.time() + 60
+    where = None
+    while time.time() < deadline:
+        try:
+            where = ray_tpu.get(a.node.remote(), timeout=30)
+            if where == n3.node_id.hex():
+                break
+        except Exception:
+            time.sleep(0.5)
+    assert where == n3.node_id.hex()

@@ -75,3 +75,20 @@ def test_no_container_runtime_found(tmp_path, monkeypatch):
 
     monkeypatch.setenv("PATH", str(tmp_path))  # nothing on PATH
     assert WorkerPool._container_runtime() is None
+
+
+def test_image_uri_without_runtime_fails_fast(tmp_path, monkeypatch):
+    """No podman/docker on the node -> RuntimeEnvSetupError, not an
+    endless lease retry loop."""
+    monkeypatch.setenv("PATH", str(tmp_path))  # no container runtime
+    ray_tpu.init(num_cpus=1)
+    try:
+        @ray_tpu.remote(runtime_env={"image_uri": "img:1"})
+        def f():
+            return 1
+
+        with pytest.raises(Exception) as ei:
+            ray_tpu.get(f.remote(), timeout=60)
+        assert "podman or docker" in str(ei.value)
+    finally:
+        ray_tpu.shutdown()

@@ -48,10 +48,14 @@ def test_10k_queued_tasks(ray_start_regular):
 
 def test_100_concurrent_placement_groups(ray_start_regular):
     n = 1000 if FULL else 100
-    pgs = [placement_group([{"CPU": 0.01}], strategy="PACK")
+    # bundle sized so n simultaneous reservations FIT the 4-CPU node
+    # (reference envelope: 1000+ concurrent PGs cluster-wide, not
+    # 10-CPU-on-a-4-CPU-node — that would be infeasible by construction)
+    cpu = 0.002 if FULL else 0.01
+    pgs = [placement_group([{"CPU": cpu}], strategy="PACK")
            for _ in range(n)]
     for pg in pgs:
-        assert pg.wait(timeout_seconds=60)
+        assert pg.wait(timeout_seconds=120 if FULL else 60)
     for pg in pgs:
         remove_placement_group(pg)
     # all reservations released: a full-CPU task must still be schedulable

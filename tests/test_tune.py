@@ -212,3 +212,34 @@ def test_pbt_exploit(ray_start_regular, tmp_path):
     results = tuner.fit()
     best = results.get_best_result()
     assert best.metrics["score"] >= 12.0 * 0.5
+
+
+def test_external_searchers_gate_cleanly():
+    """Optuna/HyperOpt wrappers (reference: tune/search/optuna, hyperopt)
+    construct only when their library is importable."""
+    import pytest as _pytest
+
+    from ray_tpu.tune.search import sample
+    from ray_tpu.tune.search.external import HyperOptSearch, OptunaSearch
+
+    space = {"lr": sample.loguniform(1e-4, 1e-1), "bs": sample.choice([8, 16])}
+    try:
+        import optuna  # noqa: F401
+
+        s = OptunaSearch(space, metric="loss", mode="min")
+        cfg = s.suggest("t1")
+        assert 1e-4 <= cfg["lr"] <= 1e-1 and cfg["bs"] in (8, 16)
+        s.on_trial_complete("t1", {"loss": 0.5})
+    except ImportError:
+        with _pytest.raises(ImportError, match="optuna"):
+            OptunaSearch(space)
+    try:
+        import hyperopt  # noqa: F401
+
+        s = HyperOptSearch(space, metric="loss", mode="min")
+        cfg = s.suggest("t1")
+        assert 1e-4 <= cfg["lr"] <= 1e-1 and cfg["bs"] in (8, 16)
+        s.on_trial_complete("t1", {"loss": 0.5})
+    except ImportError:
+        with _pytest.raises(ImportError, match="hyperopt"):
+            HyperOptSearch(space)

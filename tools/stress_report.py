@@ -1,0 +1,160 @@
+"""Generate the committed scale/perf artifact (STRESS_r{N}.json).
+
+Reproduces the reference's scalability-envelope workloads
+(release/benchmarks/README.md:5-31) at the largest scale this box holds,
+plus the core microbenchmark suite (ray_perf.py), and records measured
+rates. Run: `python tools/stress_report.py [output.json]`.
+
+Scales are the RT_STRESS_FULL test scales (tests/test_stress.py) — the
+same workloads CI runs, here with their rates captured for the round
+artifact instead of only asserted.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import platform
+import sys
+import time
+
+import numpy as np
+
+
+def _fresh_cluster(num_cpus=4):
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=num_cpus, ignore_reinit_error=False)
+    return ray_tpu
+
+
+def envelope() -> dict:
+    import ray_tpu
+    from ray_tpu.util.placement_group import (
+        placement_group,
+        remove_placement_group,
+    )
+
+    out = {}
+    ray = _fresh_cluster()
+
+    @ray_tpu.remote
+    def noop():
+        return 1
+
+    ray_tpu.get([noop.remote() for _ in range(50)])
+    n = 100_000
+    t0 = time.perf_counter()
+    refs = [noop.remote() for _ in range(n)]
+    assert len(ray_tpu.get(refs, timeout=900)) == n
+    dt = time.perf_counter() - t0
+    out["queued_tasks"] = {"n": n, "seconds": round(dt, 2),
+                           "tasks_per_sec": round(n / dt, 1)}
+
+    n = 1000
+    t0 = time.perf_counter()
+    pgs = [placement_group([{"CPU": 0.002}], strategy="PACK")
+           for _ in range(n)]
+    for pg in pgs:
+        assert pg.wait(timeout_seconds=300)
+    dt = time.perf_counter() - t0
+    t1 = time.perf_counter()
+    for pg in pgs:
+        remove_placement_group(pg)
+    out["concurrent_placement_groups"] = {
+        "n": n, "create_ready_seconds": round(dt, 2),
+        "create_per_sec": round(n / dt, 1),
+        "remove_seconds": round(time.perf_counter() - t1, 2)}
+
+    @ray_tpu.remote(num_cpus=0)
+    class Member:
+        def __init__(self, i):
+            self.i = i
+
+        def ping(self):
+            return self.i
+
+    n = 1000
+    t0 = time.perf_counter()
+    actors = [Member.remote(i) for i in range(n)]
+    got = ray_tpu.get([a.ping.remote() for a in actors], timeout=900)
+    assert got == list(range(n))
+    dt = time.perf_counter() - t0
+    t1 = time.perf_counter()
+    assert ray_tpu.get([a.ping.remote() for a in actors],
+                       timeout=900) == list(range(n))
+    call_dt = time.perf_counter() - t1
+    out["concurrent_actors"] = {
+        "n": n, "create_and_first_call_seconds": round(dt, 2),
+        "actors_per_sec": round(n / dt, 1),
+        "round_trip_calls_per_sec": round(n / call_dt, 1)}
+    for a in actors:
+        ray_tpu.kill(a)
+
+    size = 1 << 30
+    arr = np.empty(size, dtype=np.uint8)
+    t0 = time.perf_counter()
+    ref = ray_tpu.put(arr)
+    put_dt = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    got = ray_tpu.get(ref)
+    get_dt = time.perf_counter() - t0
+    assert got.nbytes == size
+    del got, ref, arr
+    out["one_gib_object"] = {
+        "put_gb_per_sec": round(1.0 / put_dt, 2),
+        "get_gb_per_sec": round(1.0 / get_dt, 2)}
+
+    @ray_tpu.remote
+    def consume(*args):
+        return len(args)
+
+    n_args = 10_000
+    args = [ray_tpu.put(i) for i in range(n_args)]
+    t0 = time.perf_counter()
+    assert ray_tpu.get(consume.remote(*args), timeout=600) == n_args
+    out["args_to_one_task"] = {"n": n_args,
+                               "seconds": round(time.perf_counter() - t0, 2)}
+
+    @ray_tpu.remote(num_returns=3000)
+    def produce():
+        return tuple(range(3000))
+
+    t0 = time.perf_counter()
+    refs = produce.remote()
+    assert ray_tpu.get(refs[-1], timeout=600) == 2999
+    out["returns_from_one_task"] = {
+        "n": 3000, "seconds": round(time.perf_counter() - t0, 2)}
+
+    ray.shutdown()
+    return out
+
+
+def main():
+    out_path = sys.argv[1] if len(sys.argv) > 1 else "STRESS_r03.json"
+    report = {
+        "generated_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "host": {"cores": os.cpu_count(),
+                 "platform": platform.platform(),
+                 "note": "single-host CI box; reference envelope numbers "
+                         "(release/benchmarks/README.md:5-31) are for "
+                         "64-node clusters — these are the per-host "
+                         "equivalents at RT_STRESS_FULL scale"},
+    }
+    report["envelope"] = envelope()
+
+    from ray_tpu._private.ray_perf import main as perf_main
+
+    results = perf_main(quick=False)
+    report["microbenchmark"] = {
+        name: {"per_sec": round(mean, 1), "stddev": round(std, 1)}
+        for name, mean, std in results if results}
+
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=2)
+    print(json.dumps(report["envelope"], indent=2))
+    print(f"wrote {out_path}")
+
+
+if __name__ == "__main__":
+    main()
