@@ -11,7 +11,7 @@ the cluster kernel starts fast in worker processes.
 
 from ray_tpu import exceptions  # noqa: F401
 from ray_tpu._raylet import ObjectRef, ObjectRefGenerator  # noqa: F401
-from ray_tpu.actor import ActorClass, ActorHandle  # noqa: F401
+from ray_tpu.actor import ActorClass, ActorHandle, method  # noqa: F401
 from ray_tpu.api import (  # noqa: F401
     available_resources,
     cancel,
@@ -25,6 +25,7 @@ from ray_tpu.api import (  # noqa: F401
     put,
     remote,
     shutdown,
+    timeline,
     wait,
 )
 from ray_tpu.remote_function import RemoteFunction  # noqa: F401

@@ -18,6 +18,38 @@ from ray_tpu._raylet import get_core_worker
 from ray_tpu.util.scheduling_strategies import to_spec
 
 
+def method(*args, **method_options):
+    """Per-method option decorator (reference: ray.method — worker.py
+    `method`): `@ray_tpu.method(num_returns=2)` on an actor method makes
+    every `handle.m.remote()` mint that many ObjectRefs without a
+    per-call `.options()`. Options travel WITH handles (including
+    serialized ones); `get_actor` handles fall back to defaults."""
+    if args and callable(args[0]) and not method_options:
+        return args[0]  # bare @method
+
+    supported = {"num_returns"}
+    unknown = set(method_options) - supported
+    if unknown:
+        raise ValueError(
+            f"unsupported @method option(s) {sorted(unknown)}; "
+            f"supported: {sorted(supported)}")
+
+    def decorate(fn):
+        fn.__ray_method_options__ = dict(method_options)
+        return fn
+
+    return decorate
+
+
+def _collect_method_options(cls) -> Dict[str, Dict[str, Any]]:
+    out = {}
+    for name, fn in inspect.getmembers(cls, inspect.isfunction):
+        o = getattr(fn, "__ray_method_options__", None)
+        if o:
+            out[name] = dict(o)
+    return out
+
+
 def _is_asyncio_class(cls) -> bool:
     for _name, method in inspect.getmembers(cls, inspect.isfunction):
         if inspect.iscoroutinefunction(method):
@@ -61,13 +93,14 @@ class ActorMethod:
         return ClassMethodNode(self._handle, self._method_name, args, kwargs)
 
 
-def _reconstruct_handle(actor_id_bytes: bytes):
-    return ActorHandle(ActorID(actor_id_bytes))
+def _reconstruct_handle(actor_id_bytes: bytes, method_options=None):
+    return ActorHandle(ActorID(actor_id_bytes), method_options)
 
 
 class ActorHandle:
-    def __init__(self, actor_id: ActorID):
+    def __init__(self, actor_id: ActorID, method_options=None):
         object.__setattr__(self, "_actor_id", actor_id)
+        object.__setattr__(self, "_method_options", method_options or {})
 
     @property
     def _id(self) -> ActorID:
@@ -76,13 +109,16 @@ class ActorHandle:
     def __getattr__(self, name: str) -> ActorMethod:
         if name.startswith("__") and name.endswith("__") and name != "__ray_terminate__":
             raise AttributeError(name)
-        return ActorMethod(self, name)
+        o = self._method_options.get(name, {})
+        return ActorMethod(self, name,
+                           num_returns=o.get("num_returns", 1))
 
     def __repr__(self):
         return f"ActorHandle({self._actor_id.hex()})"
 
     def __reduce__(self):
-        return (_reconstruct_handle, (self._actor_id.binary(),))
+        return (_reconstruct_handle,
+                (self._actor_id.binary(), self._method_options))
 
     def __eq__(self, other):
         return isinstance(other, ActorHandle) and other._actor_id == self._actor_id
@@ -128,7 +164,7 @@ class ActorClass:
             is_asyncio=_is_asyncio_class(self._cls),
             runtime_env=o.get("runtime_env"),
         )
-        return ActorHandle(actor_id)
+        return ActorHandle(actor_id, _collect_method_options(self._cls))
 
     def bind(self, *args, **kwargs):
         from ray_tpu.dag import ClassNode

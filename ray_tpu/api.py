@@ -303,6 +303,21 @@ def cluster_resources() -> dict:
     return out
 
 
+def timeline(filename: Optional[str] = None) -> list:
+    """Chrome-trace events of task execution so far (reference: ray.timeline,
+    worker.py — same data as the `ray-tpu timeline` CLI). Writes JSON when
+    `filename` is given; always returns the event list."""
+    from ray_tpu.util.state.api import task_timeline_events
+
+    trace = task_timeline_events()
+    if filename:
+        import json as _json
+
+        with open(filename, "w") as f:
+            _json.dump(trace, f)
+    return trace
+
+
 def nodes() -> List[dict]:
     cw = get_core_worker()
     infos = cw._gcs.call("get_all_node_info", {})

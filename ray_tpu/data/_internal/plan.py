@@ -30,9 +30,16 @@ class Plan:
     operators: List[Operator]
     # Datasets produced by union/zip hold the other plans here:
     other_plans: List["Plan"] = dataclasses.field(default_factory=list)
+    # source files of a file-based read, for Dataset.input_files()
+    input_files: List[str] = dataclasses.field(default_factory=list)
 
     def with_operator(self, op: Operator) -> "Plan":
-        return Plan(self.read_tasks, self.operators + [op], self.other_plans)
+        return Plan(self.read_tasks, self.operators + [op],
+                    self.other_plans, self.input_files)
+
+    def copy(self) -> "Plan":
+        return Plan(list(self.read_tasks), list(self.operators),
+                    list(self.other_plans), list(self.input_files))
 
     def fused_stages(self) -> List[List[Operator]]:
         """Group consecutive map-like operators into single task stages."""

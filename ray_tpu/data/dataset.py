@@ -136,6 +136,142 @@ class Dataset:
 
     # -- execution -----------------------------------------------------------
 
+    # -- breadth API (reference: data/dataset.py take_batch/copy/
+    #    input_files/size_bytes/randomize_block_order/split_proportionately/
+    #    aggregate/to_*_refs/to_torch/to_dask/write_images/write_mongo) ----
+
+    def take_batch(self, batch_size: int = 20,
+                   batch_format: str = "numpy"):
+        """First `batch_size` rows as ONE batch (reference: take_batch)."""
+        for batch in self.limit(batch_size).iter_batches(
+                batch_size=batch_size, batch_format=batch_format):
+            return batch
+        return {}
+
+    def copy(self) -> "Dataset":
+        """Dataset with an independent plan — transforms applied to the
+        copy never affect the original (reference: copy)."""
+        return Dataset(self._plan.copy())
+
+    def input_files(self) -> List[str]:
+        """Source files of a file-based read ([] otherwise)."""
+        return list(self._plan.input_files)
+
+    def size_bytes(self) -> int:
+        """Total block bytes after execution (reference: size_bytes)."""
+        return builtins.sum(
+            BlockAccessor.for_block(b).size_bytes()
+            for b in self.iter_blocks())
+
+    def randomize_block_order(self, *, seed: Optional[int] = None
+                              ) -> "Dataset":
+        """Shuffle BLOCK order without moving rows — cheap decorrelation
+        (reference: randomize_block_order). Executes the upstream plan to
+        block refs (blocks stay in the object store, never on the driver);
+        the result reads from the reordered refs."""
+        import ray_tpu
+
+        refs = list(self.iter_internal_block_refs())
+        rng = np.random.default_rng(seed)
+        refs = [refs[i] for i in rng.permutation(len(refs))]
+        return Dataset(Plan([(lambda r=r: [ray_tpu.get(r)]) for r in refs],
+                            []))
+
+    def split_proportionately(self, proportions: List[float]
+                              ) -> List["MaterializedDataset"]:
+        """Split by fractions; the remainder is a final extra split
+        (reference: split_proportionately)."""
+        if not proportions or any(p <= 0 for p in proportions):
+            raise ValueError("proportions must be positive")
+        if builtins.sum(proportions) >= 1.0:
+            raise ValueError("proportions must sum to < 1 (the remainder "
+                             "becomes the last split)")
+        n = self.count()
+        indices, acc = [], 0.0
+        for p in proportions:
+            acc += p
+            indices.append(int(n * acc))
+        return self.split_at_indices(indices)
+
+    def aggregate(self, *aggs) -> Dict[str, Any]:
+        """Whole-dataset aggregation -> {agg_name: value} (reference:
+        aggregate; AggregateFns from ray_tpu.data.grouped_data)."""
+        accs = [a.init() for a in aggs]
+        for block in self.iter_blocks():
+            batch = BlockAccessor.for_block(block).to_numpy_batch()
+            for i, a in enumerate(aggs):
+                on = getattr(a, "_on", None)
+                col = (batch[on] if on is not None
+                       else next(iter(batch.values()), np.empty(0)))
+                accs[i] = a.accumulate(accs[i], col)
+        return {a.name: a.finalize(acc) for a, acc in zip(aggs, accs)}
+
+    def to_arrow_refs(self) -> List[Any]:
+        """One ObjectRef per block; blocks ARE arrow tables here, so this
+        is the zero-conversion path (reference: to_arrow_refs)."""
+        return list(self.iter_internal_block_refs())
+
+    def to_numpy_refs(self) -> List[Any]:
+        """One ObjectRef per block of {col: ndarray} (reference:
+        to_numpy_refs); conversion runs as cluster tasks."""
+        return [_block_converter("numpy").remote(r)
+                for r in self.iter_internal_block_refs()]
+
+    def to_pandas_refs(self) -> List[Any]:
+        """One ObjectRef per block as a DataFrame (reference:
+        to_pandas_refs)."""
+        return [_block_converter("pandas").remote(r)
+                for r in self.iter_internal_block_refs()]
+
+    def to_torch(self, *, label_column: Optional[str] = None,
+                 feature_columns: Optional[List[str]] = None,
+                 batch_size: int = 256, drop_last: bool = False):
+        """Torch IterableDataset over this Dataset (reference: to_torch);
+        yields (features[B, F], labels[B]) — or features only when no
+        label_column is given."""
+        import torch
+
+        outer = self
+
+        class _IterableTorch(torch.utils.data.IterableDataset):
+            def __iter__(self):
+                for batch in outer.iter_batches(batch_size=batch_size,
+                                                drop_last=drop_last):
+                    cols = feature_columns or [
+                        c for c in batch if c != label_column]
+                    feats = torch.stack(
+                        [torch.as_tensor(
+                            np.ascontiguousarray(batch[c]).astype(
+                                np.float32))
+                         for c in cols], dim=1)
+                    if label_column is None:
+                        yield feats
+                    else:
+                        # np.array copies: arrow-backed batches are
+                        # read-only, which torch tensors must not alias
+                        yield feats, torch.as_tensor(
+                            np.array(batch[label_column]))
+
+        return _IterableTorch()
+
+    def to_dask(self):
+        """dask.dataframe over this Dataset (reference: to_dask; requires
+        dask — see also ray_tpu.util.dask for running dask graphs ON the
+        cluster). Materializes through the driver."""
+        try:
+            import dask.dataframe as dd
+        except ImportError as e:
+            raise ImportError(
+                "to_dask() requires dask (`pip install dask[dataframe]`)"
+            ) from e
+        return dd.from_pandas(self.to_pandas(),
+                              npartitions=max(1, self.num_blocks()))
+
+    def iterator(self) -> "DataIterator":
+        """Iteration handle decoupled from the Dataset (reference:
+        Dataset.iterator -> DataIterator, data/iterator.py:68)."""
+        return DataIterator(self)
+
     def iter_internal_block_refs(self) -> Iterator[Any]:
         yield from execute_refs(self._plan)
 
@@ -484,6 +620,73 @@ class Dataset:
 
         self._write(path, w, ".tar")
 
+    def write_images(self, path: str, *, column: str,
+                     file_format: str = "png", **_kw) -> None:
+        """Write the image column as one file per row (reference:
+        write_images; requires pillow)."""
+        try:
+            from PIL import Image  # noqa: F401
+        except ImportError as e:
+            raise ImportError("write_images requires pillow") from e
+
+        def w(block, p):
+            from PIL import Image as PILImage
+
+            batch = BlockAccessor.for_block(block).to_numpy_batch()
+            base, _ = p.rsplit(".", 1)
+            for i, arr in enumerate(batch[column]):
+                PILImage.fromarray(np.asarray(arr)).save(
+                    f"{base}-{i:06d}.{file_format}")
+
+        self._write(path, w, f".{file_format}")
+
+    def write_mongo(self, *, uri: str, database: str, collection: str,
+                    **_kw) -> None:
+        """Insert rows into MongoDB (reference: write_mongo; requires
+        pymongo)."""
+        try:
+            import pymongo  # noqa: F401
+        except ImportError as e:
+            raise ImportError("write_mongo requires pymongo") from e
+
+        def insert(batch: Dict[str, np.ndarray]):
+            import pymongo as pm
+
+            client = pm.MongoClient(uri)
+            rows = [dict(zip(batch.keys(), vals))
+                    for vals in builtins.zip(*[v.tolist()
+                                               for v in batch.values()])]
+            client[database][collection].insert_many(rows)
+            client.close()
+            return batch
+
+        # runs distributed like any map stage; output discarded
+        for _ in self.map_batches(insert).iter_blocks():
+            pass
+
+    def write_bigquery(self, *, project_id: str, dataset: str,
+                       **_kw) -> None:
+        """Write to a BigQuery table (reference: write_bigquery; requires
+        google-cloud-bigquery)."""
+        try:
+            from google.cloud import bigquery  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "write_bigquery requires google-cloud-bigquery") from e
+
+        def load(batch: Dict[str, np.ndarray]):
+            import pandas as pd
+            from google.cloud import bigquery as bq
+
+            client = bq.Client(project=project_id.split(".")[0])
+            client.load_table_from_dataframe(
+                pd.DataFrame({k: v.tolist() for k, v in batch.items()}),
+                f"{project_id}.{dataset}").result()
+            return batch
+
+        for _ in self.map_batches(load).iter_blocks():
+            pass
+
     def write_datasource(self, datasource, **kwargs) -> None:
         """Custom sink: an object with write(block_iterator, **kwargs)
         (reference: Dataset.write_datasource / Datasource.write)."""
@@ -542,3 +745,48 @@ class MaterializedDataset(Dataset):
 
     def count(self) -> int:
         return builtins.sum(b.num_rows for b in self._blocks)
+
+
+# ---- module-level helpers for the breadth API ------------------------------
+
+_BLOCK_CONVERTERS: Dict[str, Any] = {}
+
+
+def _block_converter(kind: str):
+    """Memoized remote block converters (fresh wrappers per call would mint
+    new function ids and forfeit lease caching — see ADVICE r2)."""
+    if kind not in _BLOCK_CONVERTERS:
+        import ray_tpu
+
+        if kind == "numpy":
+            def convert(block):
+                return BlockAccessor.for_block(block).to_numpy_batch()
+        else:
+            def convert(block):
+                return BlockAccessor.for_block(block).to_pandas()
+
+        _BLOCK_CONVERTERS[kind] = ray_tpu.remote(convert)
+    return _BLOCK_CONVERTERS[kind]
+
+
+class DataIterator:
+    """Iteration facade over a Dataset (reference: data/iterator.py:68 —
+    what `streaming_split` shards and `Dataset.iterator()` hand out)."""
+
+    def __init__(self, dataset: Dataset):
+        self._ds = dataset
+
+    def iter_rows(self):
+        return self._ds.iter_rows()
+
+    def iter_batches(self, **kwargs):
+        return self._ds.iter_batches(**kwargs)
+
+    def iter_torch_batches(self, **kwargs):
+        return self._ds.iter_torch_batches(**kwargs)
+
+    def iter_jax_batches(self, **kwargs):
+        return self._ds.iter_jax_batches(**kwargs)
+
+    def materialize(self):
+        return self._ds.materialize()

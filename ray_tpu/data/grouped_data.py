@@ -16,12 +16,13 @@ class AggregateFn:
                  accumulate: Callable[[Any, np.ndarray], Any],
                  merge: Callable[[Any, Any], Any],
                  finalize: Callable[[Any], Any] = lambda a: a,
-                 name: str = "agg"):
+                 name: str = "agg", on: str = None):
         self.init = init
         self.accumulate = accumulate
         self.merge = merge
         self.finalize = finalize
         self.name = name
+        self._on = on  # column this aggregate reads (None = row count)
 
 
 def Count() -> AggregateFn:  # noqa: N802 — reference naming
@@ -31,19 +32,19 @@ def Count() -> AggregateFn:  # noqa: N802 — reference naming
 
 def Sum(on: str) -> AggregateFn:  # noqa: N802
     return AggregateFn(lambda: 0.0, lambda a, col: a + float(np.sum(col)),
-                       lambda a, b: a + b, name=f"sum({on})")
+                       lambda a, b: a + b, name=f"sum({on})", on=on)
 
 
 def Min(on: str) -> AggregateFn:  # noqa: N802
     return AggregateFn(lambda: float("inf"),
                        lambda a, col: min(a, float(np.min(col))),
-                       min, name=f"min({on})")
+                       min, name=f"min({on})", on=on)
 
 
 def Max(on: str) -> AggregateFn:  # noqa: N802
     return AggregateFn(lambda: float("-inf"),
                        lambda a, col: max(a, float(np.max(col))),
-                       max, name=f"max({on})")
+                       max, name=f"max({on})", on=on)
 
 
 def Mean(on: str) -> AggregateFn:  # noqa: N802
@@ -52,7 +53,20 @@ def Mean(on: str) -> AggregateFn:  # noqa: N802
         lambda a, col: (a[0] + float(np.sum(col)), a[1] + len(col)),
         lambda a, b: (a[0] + b[0], a[1] + b[1]),
         lambda a: a[0] / a[1] if a[1] else None,
-        name=f"mean({on})")
+        name=f"mean({on})", on=on)
+
+
+def Std(on: str, ddof: int = 1) -> AggregateFn:  # noqa: N802
+    """Streaming stddev via (sum, sumsq, n) — reference: Std aggregate."""
+    return AggregateFn(
+        lambda: (0.0, 0.0, 0),
+        lambda a, col: (a[0] + float(np.sum(col)),
+                        a[1] + float(np.sum(np.square(col, dtype=float))),
+                        a[2] + len(col)),
+        lambda a, b: (a[0] + b[0], a[1] + b[1], a[2] + b[2]),
+        lambda a: (((a[1] - a[0] * a[0] / a[2]) / (a[2] - ddof)) ** 0.5
+                   if a[2] > ddof else None),
+        name=f"std({on})", on=on)
 
 
 class GroupedData:
@@ -99,6 +113,9 @@ class GroupedData:
 
     def mean(self, on: str):
         return self._aggregate_on([(on, Mean(on))])
+
+    def std(self, on: str, ddof: int = 1):
+        return self._aggregate_on([(on, Std(on, ddof))])
 
     def aggregate(self, *aggs: AggregateFn):
         return self._aggregate_on([(getattr(a, "_on", None), a)

@@ -42,8 +42,9 @@ def _expand_paths(paths, suffix: Optional[str] = None) -> List[str]:
     return out
 
 
-def _plan_from_tasks(tasks: List[Callable]) -> Dataset:
-    return Dataset(Plan(tasks, []))
+def _plan_from_tasks(tasks: List[Callable],
+                     input_files: Optional[List[str]] = None) -> Dataset:
+    return Dataset(Plan(tasks, [], input_files=list(input_files or [])))
 
 
 def range(n: int, *, override_num_blocks: Optional[int] = None) -> Dataset:  # noqa: A001
@@ -130,7 +131,8 @@ def read_parquet(paths, *, columns: Optional[List[str]] = None,
 
         return read
 
-    return _plan_from_tasks([make_task(f) for f in files])
+    return _plan_from_tasks([make_task(f) for f in files],
+                        input_files=files)
 
 
 def read_csv(paths, **_kw) -> Dataset:
@@ -144,7 +146,8 @@ def read_csv(paths, **_kw) -> Dataset:
 
         return read
 
-    return _plan_from_tasks([make_task(f) for f in files])
+    return _plan_from_tasks([make_task(f) for f in files],
+                        input_files=files)
 
 
 def read_json(paths, **_kw) -> Dataset:
@@ -158,7 +161,8 @@ def read_json(paths, **_kw) -> Dataset:
 
         return read
 
-    return _plan_from_tasks([make_task(f) for f in files])
+    return _plan_from_tasks([make_task(f) for f in files],
+                        input_files=files)
 
 
 def read_text(paths, **_kw) -> Dataset:
@@ -172,7 +176,8 @@ def read_text(paths, **_kw) -> Dataset:
 
         return read
 
-    return _plan_from_tasks([make_task(f) for f in files])
+    return _plan_from_tasks([make_task(f) for f in files],
+                        input_files=files)
 
 
 def read_numpy(paths, **_kw) -> Dataset:
@@ -185,7 +190,8 @@ def read_numpy(paths, **_kw) -> Dataset:
 
         return read
 
-    return _plan_from_tasks([make_task(f) for f in files])
+    return _plan_from_tasks([make_task(f) for f in files],
+                        input_files=files)
 
 
 def read_binary_files(paths, *, include_paths: bool = False,
@@ -229,7 +235,8 @@ def read_binary_files(paths, *, include_paths: bool = False,
 
         return read
 
-    return _plan_from_tasks([make_task(g) for g in groups])
+    return _plan_from_tasks([make_task(g) for g in groups],
+                            input_files=files)
 
 
 def read_images(paths, *, size=None, mode: Optional[str] = None,
@@ -254,7 +261,8 @@ def read_images(paths, *, size=None, mode: Optional[str] = None,
 
         return read
 
-    return _plan_from_tasks([make_task(f) for f in files])
+    return _plan_from_tasks([make_task(f) for f in files],
+                        input_files=files)
 
 
 def read_tfrecords(paths, **_kw) -> Dataset:
@@ -274,7 +282,8 @@ def read_tfrecords(paths, **_kw) -> Dataset:
 
         return read
 
-    return _plan_from_tasks([make_task(f) for f in files])
+    return _plan_from_tasks([make_task(f) for f in files],
+                        input_files=files)
 
 
 def read_sql(sql: str, connection_factory: Callable[[], Any],
@@ -413,7 +422,8 @@ def read_webdataset(paths, **_kw) -> Dataset:
 
         return read
 
-    return _plan_from_tasks([make_task(f) for f in files])
+    return _plan_from_tasks([make_task(f) for f in files],
+                        input_files=files)
 
 
 def read_datasource(datasource, *, parallelism: int = -1, **kwargs) -> Dataset:

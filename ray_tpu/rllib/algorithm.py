@@ -14,11 +14,17 @@ from ray_tpu.rllib.algorithm_config import AlgorithmConfig
 
 class Algorithm:
     def __init__(self, config: AlgorithmConfig):
+        from ray_tpu.rllib.callbacks import make_callbacks
+
         self.config = config
         self.iteration = 0
         self._num_env_steps_sampled_lifetime = 0
         self._episode_returns = deque(maxlen=100)
+        self.callbacks = make_callbacks(
+            getattr(config, "callbacks_class", None))
         self.setup(config)
+        if self.callbacks is not None:
+            self.callbacks.on_algorithm_init(algorithm=self)
 
     # -- subclass API --------------------------------------------------------
 
@@ -40,10 +46,20 @@ class Algorithm:
             result.setdefault(
                 "episode_return_mean",
                 sum(self._episode_returns) / len(self._episode_returns))
+        if self.callbacks is not None:
+            self.callbacks.on_train_result(algorithm=self, result=result)
         return result
 
     def _record_episodes(self, episodes) -> None:
         for ep in episodes:
+            if self.callbacks is not None and (
+                    ep.is_done or (getattr(ep, "is_truncated", False)
+                                   and not getattr(ep,
+                                                   "is_boundary_fragment",
+                                                   False))):
+                # boundary fragments are still-running episodes cut at a
+                # sample() edge — not ends
+                self.callbacks.on_episode_end(episode=ep, algorithm=self)
             self._num_env_steps_sampled_lifetime += len(ep)
             # terminated AND env-truncated (TimeLimit) episodes have a
             # complete return; boundary fragments do not
@@ -62,11 +78,17 @@ class Algorithm:
         os.makedirs(checkpoint_dir, exist_ok=True)
         with open(os.path.join(checkpoint_dir, "algo_state.pkl"), "wb") as f:
             pickle.dump(self.get_state(), f)
+        if self.callbacks is not None:
+            self.callbacks.on_checkpoint_saved(
+                algorithm=self, checkpoint_dir=checkpoint_dir)
         return checkpoint_dir
 
     def restore(self, checkpoint_dir: str) -> None:
         with open(os.path.join(checkpoint_dir, "algo_state.pkl"), "rb") as f:
             self.set_state(pickle.load(f))
+        if self.callbacks is not None:
+            self.callbacks.on_checkpoint_loaded(
+                algorithm=self, checkpoint_dir=checkpoint_dir)
 
     def stop(self) -> None:
         pass

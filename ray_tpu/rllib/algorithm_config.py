@@ -49,6 +49,7 @@ class AlgorithmConfig:
         # misc
         self.seed: Optional[int] = None
         self.explore: bool = True
+        self.callbacks_class = None  # RLlibCallback subclass/instance
 
     # -- builder methods -----------------------------------------------------
 
@@ -99,6 +100,12 @@ class AlgorithmConfig:
                  **_kw) -> "AlgorithmConfig":
         if num_learners is not None:
             self.num_learners = num_learners
+        return self
+
+    def callbacks(self, callbacks_class) -> "AlgorithmConfig":
+        """Install an RLlibCallback (reference:
+        algorithm_config.py callbacks())."""
+        self.callbacks_class = callbacks_class
         return self
 
     def debugging(self, *, seed: Optional[int] = None,

@@ -1,0 +1,31 @@
+"""Algorithm registry: resolve algorithms by name.
+
+Reference: ray rllib/algorithms/registry.py (get_algorithm_class) — used
+by Tune string trainables ("PPO") and the CLI.
+"""
+
+from __future__ import annotations
+
+__all__ = ["get_algorithm_class", "ALGORITHMS"]
+
+
+def _table():
+    from ray_tpu.rllib import algorithms as a
+
+    return {
+        "PPO": a.PPO, "APPO": a.APPO, "IMPALA": a.IMPALA, "DQN": a.DQN,
+        "SAC": a.SAC, "BC": a.BC, "MARWIL": a.MARWIL, "CQL": a.CQL,
+        "DreamerV3": a.DreamerV3,
+    }
+
+
+ALGORITHMS = tuple(("PPO", "APPO", "IMPALA", "DQN", "SAC", "BC", "MARWIL",
+                    "CQL", "DreamerV3"))
+
+
+def get_algorithm_class(name: str):
+    table = _table()
+    if name not in table:
+        raise ValueError(
+            f"unknown algorithm {name!r}; available: {sorted(table)}")
+    return table[name]

@@ -47,6 +47,19 @@ class RuntimeContext:
     def gcs_address(self) -> str:
         return self._cw.gcs_address
 
+    def get_accelerator_ids(self) -> dict:
+        """Accelerator devices visible to this worker (reference:
+        runtime_context.py get_accelerator_ids — {"GPU": [...]} there,
+        {"TPU": [...]} here, from TPU_VISIBLE_CHIPS or the assigned TPU
+        resource count)."""
+        import os
+
+        visible = os.environ.get("TPU_VISIBLE_CHIPS")
+        if visible:
+            return {"TPU": [c for c in visible.split(",") if c != ""]}
+        n = int(self.get_assigned_resources().get("TPU", 0))
+        return {"TPU": [str(i) for i in range(n)]}
+
     def get_assigned_resources(self) -> dict:
         spec = self._cw.current_spec()
         return dict(spec.resources) if spec is not None else {}

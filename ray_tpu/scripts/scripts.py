@@ -286,24 +286,9 @@ def cmd_timeline(args) -> int:
     """Dump task events as a chrome://tracing file (reference: ray timeline
     -> chrome_tracing_dump, _private/state.py:434)."""
     _connect(args)
-    from ray_tpu.util.state import list_tasks
+    from ray_tpu.util.state.api import task_timeline_events
 
-    events = list_tasks(limit=100_000, raw_events=True)
-    trace = []
-    starts = {}
-    for ev in events:
-        key = (ev["task_id"], ev["worker_id"])
-        if ev["state"] == "RUNNING":
-            starts[key] = ev["time"]
-        elif ev["state"] in ("FINISHED", "FAILED") and key in starts:
-            t0 = starts.pop(key)
-            trace.append({
-                "cat": "task", "ph": "X", "name": ev["name"],
-                "pid": ev.get("node") or "driver",
-                "tid": ev["worker_id"][:12],
-                "ts": int(t0 * 1e6), "dur": int((ev["time"] - t0) * 1e6),
-                "args": {"task_id": ev["task_id"], "state": ev["state"]},
-            })
+    trace = task_timeline_events()
     out = args.output or "timeline.json"
     with open(out, "w") as f:
         json.dump(trace, f)

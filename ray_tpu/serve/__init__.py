@@ -21,7 +21,11 @@ from ray_tpu.serve.api import (  # noqa: F401
     status,
 )
 from ray_tpu.serve.batching import batch  # noqa: F401
-from ray_tpu.serve.context import get_multiplexed_model_id  # noqa: F401
+from ray_tpu.serve.context import (  # noqa: F401
+    ReplicaContext,
+    get_multiplexed_model_id,
+    get_replica_context,
+)
 from ray_tpu.serve.handle import (  # noqa: F401
     DeploymentHandle,
     DeploymentResponse,
@@ -30,6 +34,8 @@ from ray_tpu.serve.handle import (  # noqa: F401
 from ray_tpu.serve.multiplex import multiplexed  # noqa: F401
 
 __all__ = [
+    "ReplicaContext",
+    "get_replica_context",
     "Application",
     "Deployment",
     "DeploymentHandle",

@@ -215,6 +215,7 @@ class ServeController:
                     "init_args": cfg.get("init_args", ()),
                     "init_kwargs": cfg.get("init_kwargs", {}),
                     "deployment": state.name,
+                    "app": state.app,
                     "replica_id": replica_id,
                 })
             if cfg.get("user_config") is not None:

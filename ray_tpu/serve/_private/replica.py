@@ -24,12 +24,24 @@ class ReplicaActor:
         kwargs = serialized_init.get("init_kwargs", {})
         self._deployment = serialized_init.get("deployment", "")
         self._replica_id = serialized_init.get("replica_id", "")
+        # publish the replica context BEFORE constructing the user callable
+        # so serve.get_replica_context() works inside __init__ too
+        from ray_tpu.serve import context as serve_ctx
+
+        ctx = serve_ctx.ReplicaContext(
+            app_name=serialized_init.get("app", ""),
+            deployment=self._deployment,
+            replica_tag=self._replica_id,
+            servable_object=None,
+        )
+        serve_ctx.set_replica_context(ctx)
         if inspect.isclass(cls_or_fn):
             self._callable = cls_or_fn(*args, **kwargs)
             self._is_function = False
         else:
             self._callable = cls_or_fn
             self._is_function = True
+        ctx.servable_object = self._callable
         self._num_ongoing = 0
         self._num_processed = 0
         self._lock = threading.Lock()

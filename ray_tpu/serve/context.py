@@ -53,3 +53,38 @@ def get_multiplexed_model_id() -> str:
 
 def set_multiplexed_model_id(model_id: str) -> None:
     _replica_context.multiplexed_model_id = model_id
+
+
+class ReplicaContext:
+    """What a deployment can learn about itself from inside a replica
+    (reference: serve/context.py ReplicaContext + api.py:140
+    get_replica_context)."""
+
+    def __init__(self, app_name: str, deployment: str, replica_tag: str,
+                 servable_object: Any):
+        self.app_name = app_name
+        self.deployment = deployment
+        self.replica_tag = replica_tag
+        self.servable_object = servable_object
+
+    @property
+    def replica_id(self) -> str:  # newer-API alias
+        return self.replica_tag
+
+
+_process_replica_context: Optional[ReplicaContext] = None
+
+
+def get_replica_context() -> ReplicaContext:
+    """Inside a replica: this replica's identity. Raises elsewhere
+    (reference: api.py:164)."""
+    if _process_replica_context is None:
+        raise RuntimeError(
+            "`serve.get_replica_context()` may only be called from within "
+            "a Serve replica")
+    return _process_replica_context
+
+
+def set_replica_context(ctx: Optional[ReplicaContext]) -> None:
+    global _process_replica_context
+    _process_replica_context = ctx

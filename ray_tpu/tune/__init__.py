@@ -16,12 +16,45 @@ from ray_tpu.tune.search.sample import (  # noqa: F401
     grid_search,
     lograndint,
     loguniform,
+    qlograndint,
+    qloguniform,
     qrandint,
+    qrandn,
     quniform,
     randint,
     randn,
+    sample_from,
     uniform,
 )
+from ray_tpu.tune.analysis import (  # noqa: F401
+    Experiment,
+    ExperimentAnalysis,
+    run_experiments,
+)
+from ray_tpu.tune.progress_reporter import (  # noqa: F401
+    CLIReporter,
+    JupyterNotebookReporter,
+    ProgressReporter,
+)
+from ray_tpu.tune.registry import (  # noqa: F401
+    create_scheduler,
+    create_searcher,
+    register_env,
+    register_trainable,
+)
+from ray_tpu.tune.stopper import (  # noqa: F401
+    CombinedStopper,
+    FunctionStopper,
+    MaximumIterationStopper,
+    Stopper,
+    TimeoutStopper,
+    TrialPlateauStopper,
+)
+
+
+class TuneError(Exception):
+    """Tune-level error (reference: tune/error.py)."""
+
 from ray_tpu.tune.logger import (  # noqa: F401
     Callback,
     CSVLoggerCallback,
@@ -87,7 +120,28 @@ def run(trainable, *, config=None, num_samples=1, metric=None, mode="max",
 
 
 __all__ = [
+    "CLIReporter",
     "CSVLoggerCallback",
+    "CombinedStopper",
+    "Experiment",
+    "ExperimentAnalysis",
+    "FunctionStopper",
+    "JupyterNotebookReporter",
+    "MaximumIterationStopper",
+    "ProgressReporter",
+    "Stopper",
+    "TimeoutStopper",
+    "TrialPlateauStopper",
+    "TuneError",
+    "create_scheduler",
+    "create_searcher",
+    "qlograndint",
+    "qloguniform",
+    "qrandn",
+    "register_env",
+    "register_trainable",
+    "run_experiments",
+    "sample_from",
     "Callback",
     "Checkpoint",
     "JsonLoggerCallback",

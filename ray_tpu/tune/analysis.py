@@ -1,0 +1,172 @@
+"""Legacy experiment surface: Experiment / run_experiments /
+ExperimentAnalysis.
+
+Reference: ray python/ray/tune/experiment/experiment.py,
+tune/analysis/experiment_analysis.py, tune/tune.py run_experiments. The
+modern path is Tuner/ResultGrid; these shims let reference users keep
+their entry points. ExperimentAnalysis reads the on-disk experiment
+layout (trial dirs with result.json line files) so it also works on
+results from a previous process.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+__all__ = ["Experiment", "run_experiments", "ExperimentAnalysis"]
+
+
+class Experiment:
+    """Named experiment spec (reference: experiment.py Experiment)."""
+
+    def __init__(self, name: str, run, *, config: Optional[dict] = None,
+                 stop=None, num_samples: int = 1,
+                 storage_path: Optional[str] = None, **settings):
+        self.name = name
+        self.run_identifier = run
+        self.config = config or {}
+        self.stop = stop
+        self.num_samples = num_samples
+        self.storage_path = storage_path
+        self.settings = settings
+
+
+def run_experiments(experiments, **kwargs):
+    """Run one or several Experiments sequentially (reference:
+    tune/tune.py run_experiments); returns the concatenated trial list."""
+    from ray_tpu import tune
+
+    if isinstance(experiments, Experiment):
+        experiments = [experiments]
+    elif isinstance(experiments, dict):
+        experiments = [
+            Experiment(name, spec.pop("run"), **spec)
+            if isinstance(spec, dict) else Experiment(name, spec)
+            for name, spec in experiments.items()
+        ]
+    all_trials = []
+    for exp in experiments:
+        trainable = exp.run_identifier
+        if isinstance(trainable, str):
+            from ray_tpu.tune.registry import get_trainable_cls
+
+            trainable = get_trainable_cls(trainable)
+        grid = tune.run(
+            trainable, config=exp.config, num_samples=exp.num_samples,
+            stop=exp.stop, storage_path=exp.storage_path, name=exp.name,
+            **{**exp.settings, **kwargs})
+        all_trials.extend(getattr(grid, "_results", grid))
+    return all_trials
+
+
+class ExperimentAnalysis:
+    """Analysis over an experiment directory (reference:
+    experiment_analysis.py): per-trial result history from each trial
+    dir's result.json (one JSON line per report)."""
+
+    def __init__(self, experiment_path: str,
+                 default_metric: Optional[str] = None,
+                 default_mode: Optional[str] = None):
+        self._path = os.path.expanduser(experiment_path)
+        self.default_metric = default_metric
+        self.default_mode = default_mode
+        self._histories: Dict[str, List[dict]] = {}
+        self._configs: Dict[str, dict] = {}
+        for result_file in sorted(glob.glob(
+                os.path.join(self._path, "*", "result.json"))):
+            trial_dir = os.path.dirname(result_file)
+            trial_id = os.path.basename(trial_dir)
+            rows = []
+            with open(result_file) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        try:
+                            rows.append(json.loads(line))
+                        except ValueError:
+                            continue
+            if isinstance(rows, list) and rows and not isinstance(
+                    rows[0], dict):
+                rows = []
+            if not rows:
+                continue
+            self._histories[trial_id] = rows
+            cfg_file = os.path.join(trial_dir, "params.json")
+            if os.path.exists(cfg_file):
+                with open(cfg_file) as f:
+                    self._configs[trial_id] = json.load(f)
+            else:
+                self._configs[trial_id] = rows[-1].get("config", {})
+
+    # -- queries -----------------------------------------------------------
+    @property
+    def trial_ids(self) -> List[str]:
+        return sorted(self._histories)
+
+    def trial_dataframes(self):
+        import pandas as pd
+
+        return {tid: pd.DataFrame(rows)
+                for tid, rows in self._histories.items()}
+
+    def dataframe(self, metric: Optional[str] = None,
+                  mode: Optional[str] = None):
+        """One row per trial: its best (or last) result."""
+        import pandas as pd
+
+        rows = [self._pick(tid, metric or self.default_metric,
+                           mode or self.default_mode)
+                for tid in self.trial_ids]
+        return pd.DataFrame(rows)
+
+    def _pick(self, trial_id: str, metric: Optional[str],
+              mode: Optional[str]) -> dict:
+        history = self._histories[trial_id]
+        if not metric:
+            row = dict(history[-1])
+        else:
+            scored = [h for h in history
+                      if isinstance(h.get(metric), (int, float))]
+            if not scored:
+                row = dict(history[-1])
+            else:
+                row = dict(max(scored, key=lambda h: h[metric])
+                           if mode != "min"
+                           else min(scored, key=lambda h: h[metric]))
+        row["trial_id"] = trial_id
+        return row
+
+    def get_best_trial(self, metric: Optional[str] = None,
+                       mode: Optional[str] = None) -> Optional[str]:
+        metric = metric or self.default_metric
+        mode = mode or self.default_mode or "max"
+        if metric is None:
+            raise ValueError("metric is required (or set default_metric)")
+        best_tid, best_val = None, None
+        for tid in self.trial_ids:
+            row = self._pick(tid, metric, mode)
+            val = row.get(metric)
+            if not isinstance(val, (int, float)):
+                continue
+            if (best_val is None or (val > best_val if mode == "max"
+                                     else val < best_val)):
+                best_tid, best_val = tid, val
+        return best_tid
+
+    def get_best_config(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> Optional[dict]:
+        tid = self.get_best_trial(metric, mode)
+        return self._configs.get(tid) if tid else None
+
+    @property
+    def best_config(self) -> Optional[dict]:
+        return self.get_best_config()
+
+    @property
+    def best_result(self) -> Optional[dict]:
+        tid = self.get_best_trial()
+        return self._pick(tid, self.default_metric,
+                          self.default_mode) if tid else None

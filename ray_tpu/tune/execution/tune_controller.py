@@ -141,6 +141,16 @@ class TuneController:
     def _launch_trial(self, trial: Trial) -> None:
         trial.storage = StorageContext(
             self._storage_root, self._experiment_name, trial.trial_id)
+        # params.json: the trial's config (reference writes it per trial;
+        # ExperimentAnalysis reads it back from disk)
+        try:
+            import json as _json
+
+            with open(os.path.join(trial.storage.trial_dir,
+                                   "params.json"), "w") as f:
+                _json.dump(trial.config, f, default=str)
+        except OSError:
+            pass
         # Per-trial override (ResourceChangingScheduler) wins over the
         # experiment-wide default; applied whenever the actor (re)starts.
         res = getattr(trial, "resources", None) or self._resources
@@ -220,8 +230,18 @@ class TuneController:
             self._scheduler.on_trial_add(trial)
             self.trials.append(trial)
 
-    def _check_stop_criteria(self, result: Dict[str, Any]) -> bool:
-        for k, v in self._stop_criteria.items():
+    def _check_stop_criteria(self, trial: "Trial",
+                             result: Dict[str, Any]) -> bool:
+        crit = self._stop_criteria
+        if callable(crit):  # Stopper API (tune/stopper.py) or plain fn
+            if getattr(crit, "stop_all", lambda: False)():
+                self._search_done = True
+                return True
+            try:
+                return bool(crit(trial.trial_id, result))
+            except TypeError:
+                return bool(crit(result))
+        for k, v in crit.items():
             if k in result and result[k] >= v:
                 return True
         return False
@@ -244,7 +264,7 @@ class TuneController:
             "on_trial_result", self._iteration, self.trials, trial, result)
         self._searcher.on_trial_result(trial.trial_id, result)
         decision = self._scheduler.on_trial_result(trial, result)
-        if self._check_stop_criteria(result):
+        if self._check_stop_criteria(trial, result):
             decision = TrialScheduler.STOP
         if decision == TrialScheduler.STOP:
             self._stop_trial(trial, TERMINATED)
@@ -284,6 +304,18 @@ class TuneController:
                 > self._time_budget_s):
             # budget exhausted: stop creating AND terminate live trials
             # (reference: TuneConfig.time_budget_s)
+            self._search_done = True
+            for t in self.trials:
+                if t.status in (PENDING, RUNNING):
+                    self._pending_result = {
+                        r: tr for r, tr in self._pending_result.items()
+                        if tr is not t}
+                    self._stop_trial(t, TERMINATED)
+            return False
+        crit = self._stop_criteria
+        if (callable(crit)
+                and getattr(crit, "stop_all", lambda: False)()):
+            # experiment-wide Stopper (e.g. TimeoutStopper)
             self._search_done = True
             for t in self.trials:
                 if t.status in (PENDING, RUNNING):

@@ -1,0 +1,92 @@
+"""Console progress reporting for experiments.
+
+Reference: ray python/ray/tune/progress_reporter.py — CLIReporter /
+JupyterNotebookReporter print a trial-status table on a throttle. Here
+reporters are Callbacks (RunConfig(callbacks=[CLIReporter()])), which is
+where the reference's reporting hooks land in the controller anyway.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.tune.logger import Callback
+
+__all__ = ["ProgressReporter", "CLIReporter", "JupyterNotebookReporter"]
+
+
+class ProgressReporter(Callback):
+    """Base reporter: collects per-trial latest results and prints a table
+    every `max_report_frequency` seconds."""
+
+    def __init__(self, metric_columns: Optional[List[str]] = None,
+                 parameter_columns: Optional[List[str]] = None,
+                 max_report_frequency: float = 5.0,
+                 max_progress_rows: int = 20):
+        self._metric_columns = metric_columns
+        self._parameter_columns = parameter_columns
+        self._freq = max_report_frequency
+        self._max_rows = max_progress_rows
+        self._last = 0.0
+        self._latest: Dict[str, Dict[str, Any]] = {}
+
+    # -- Callback hooks --
+    def on_trial_result(self, iteration, trials, trial, result, **info):
+        self._latest[trial.trial_id] = result
+        now = time.monotonic()
+        if now - self._last >= self._freq:
+            self._last = now
+            self.report(trials)
+
+    def on_experiment_end(self, trials, **info):
+        self.report(trials, final=True)
+
+    # -- rendering --
+    def _rows(self, trials) -> List[List[str]]:
+        rows = []
+        for t in trials[: self._max_rows]:
+            result = self._latest.get(t.trial_id, {})
+            metrics = (self._metric_columns
+                       or [k for k in result
+                           if isinstance(result[k], (int, float))][:4])
+            params = self._parameter_columns or list(t.config)[:3]
+            row = [t.trial_id[:12], t.status]
+            row += [f"{t.config.get(p)}" for p in params]
+            row += [f"{result.get(m):.4g}" if isinstance(
+                result.get(m), (int, float)) else "-" for m in metrics]
+            rows.append(row)
+        return rows
+
+    def render(self, trials, final: bool) -> str:
+        by_status: Dict[str, int] = {}
+        for t in trials:
+            by_status[t.status] = by_status.get(t.status, 0) + 1
+        head = ("== Status: " + ", ".join(
+            f"{v} {k}" for k, v in sorted(by_status.items())) + " ==")
+        lines = [head] + ["  " + " | ".join(r) for r in self._rows(trials)]
+        if len(trials) > self._max_rows:
+            lines.append(f"  ... {len(trials) - self._max_rows} more trials")
+        return "\n".join(lines)
+
+    def report(self, trials, final: bool = False) -> None:
+        print(self.render(trials, final), file=sys.stderr)
+
+
+class CLIReporter(ProgressReporter):
+    """Terminal reporter (reference: progress_reporter.py CLIReporter)."""
+
+
+class JupyterNotebookReporter(ProgressReporter):
+    """Notebook variant: overwrites the cell output instead of appending
+    (reference: JupyterNotebookReporter)."""
+
+    def report(self, trials, final: bool = False) -> None:
+        try:
+            from IPython.display import clear_output
+
+            clear_output(wait=True)
+        except ImportError:
+            pass
+        print(self.render(trials, final))

@@ -1,0 +1,98 @@
+"""Trainable/env registries + string factories.
+
+Reference: ray python/ray/tune/registry.py (register_trainable,
+register_env, get_trainable_cls) and tune/schedulers/__init__.py /
+search/__init__.py `create_scheduler` / `create_searcher` string
+factories.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict
+
+_TRAINABLES: Dict[str, Any] = {}
+_ENVS: Dict[str, Callable] = {}
+
+
+def register_trainable(name: str, trainable) -> None:
+    _TRAINABLES[name] = trainable
+
+
+def get_trainable_cls(name: str):
+    if name not in _TRAINABLES:
+        raise ValueError(f"unknown trainable {name!r}; "
+                         f"registered: {sorted(_TRAINABLES)}")
+    return _TRAINABLES[name]
+
+
+def is_registered_trainable(name: str) -> bool:
+    return name in _TRAINABLES
+
+
+def register_env(name: str, env_creator: Callable) -> None:
+    """Register a gym env constructor under a name usable as
+    AlgorithmConfig.environment(name) (reference: tune/registry.py
+    register_env). Registers with gymnasium so `gym.make(name)` works."""
+    _ENVS[name] = env_creator
+    try:
+        import gymnasium as gym
+
+        gym.register(id=name, entry_point=lambda **kw: env_creator(kw))
+    except Exception:  # noqa: BLE001 — already registered is fine
+        pass
+
+
+def get_env_creator(name: str) -> Callable:
+    if name not in _ENVS:
+        raise ValueError(f"unknown env {name!r}")
+    return _ENVS[name]
+
+
+def create_scheduler(name: str, **kwargs):
+    """Scheduler by name (reference: tune/schedulers/__init__.py
+    create_scheduler)."""
+    from ray_tpu.tune import schedulers as s
+
+    table = {
+        "fifo": s.FIFOScheduler,
+        "async_hyperband": s.ASHAScheduler,
+        "asha": s.ASHAScheduler,
+        "hyperband": s.HyperBandScheduler,
+        "median_stopping_rule": s.MedianStoppingRule,
+        "pbt": s.PopulationBasedTraining,
+        "pb2": s.PB2,
+        "hb_bohb": s.HyperBandForBOHB,
+        "resource_changing": s.ResourceChangingScheduler,
+    }
+    if name not in table:
+        raise ValueError(f"unknown scheduler {name!r}; "
+                         f"available: {sorted(table)}")
+    return table[name](**kwargs)
+
+
+def create_searcher(name: str, **kwargs):
+    """Searcher by name (reference: tune/search/__init__.py
+    create_searcher)."""
+    from ray_tpu.tune.search import (
+        BasicVariantGenerator,
+        BayesOptSearch,
+        TPESearcher,
+        TuneBOHB,
+    )
+
+    table = {
+        "random": BasicVariantGenerator,
+        "variant_generator": BasicVariantGenerator,
+        "tpe": TPESearcher,
+        "hyperopt": TPESearcher,  # native TPE stands in when hyperopt absent
+        "bayesopt": BayesOptSearch,
+        "bohb": TuneBOHB,
+    }
+    if name == "optuna":
+        from ray_tpu.tune.search.external import OptunaSearch
+
+        return OptunaSearch(**kwargs)
+    if name not in table:
+        raise ValueError(f"unknown searcher {name!r}; "
+                         f"available: {sorted(table) + ['optuna']}")
+    return table[name](**kwargs)

@@ -95,12 +95,55 @@ def choice(categories: Sequence[Any]) -> Categorical:
     return Categorical(categories)
 
 
+def qloguniform(lower: float, upper: float, q: float) -> Float:
+    return Float(lower, upper, log=True, q=q)
+
+
+def qrandn(mean: float = 0.0, sd: float = 1.0, q: float = 1.0) -> Float:
+    return Float(mean, sd, normal=True, q=q)
+
+
+def qlograndint(lower: int, upper: int, q: int) -> Integer:
+    return Integer(lower, upper, log=True, q=q)
+
+
+class Function(Domain):
+    """Config-dependent sampling: tune.sample_from(lambda spec: ...)
+    (reference: tune/search/sample.py Function). The callable receives a
+    `spec` namespace whose .config holds the leaves resolved SO FAR (dict
+    order), like the reference."""
+
+    def __init__(self, fn):
+        import inspect
+
+        self.fn = fn
+        try:
+            self._wants_spec = bool(inspect.signature(fn).parameters)
+        except (TypeError, ValueError):
+            self._wants_spec = True
+
+    def sample(self, rng: random.Random, config: Dict[str, Any] = None):
+        import types
+
+        if not self._wants_spec:
+            return self.fn()
+        spec = types.SimpleNamespace(config=types.SimpleNamespace(
+            **(config or {})))
+        return self.fn(spec)
+
+
+def sample_from(fn) -> Function:
+    return Function(fn)
+
+
 def resolve_config(space: Dict[str, Any], rng: random.Random) -> Dict[str, Any]:
     """Sample every Domain leaf; grid_search markers must be expanded first
     (BasicVariantGenerator does that)."""
     out = {}
     for k, v in space.items():
-        if isinstance(v, Domain):
+        if isinstance(v, Function):
+            out[k] = v.sample(rng, out)
+        elif isinstance(v, Domain):
             out[k] = v.sample(rng)
         elif isinstance(v, dict) and "grid_search" not in v:
             out[k] = resolve_config(v, rng)

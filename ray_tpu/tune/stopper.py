@@ -1,0 +1,116 @@
+"""Stopper API: programmatic experiment/trial stopping criteria.
+
+Reference: ray python/ray/tune/stopper/ — `Stopper.__call__(trial_id,
+result) -> bool` per trial plus `stop_all()` for the whole experiment;
+passed as `RunConfig(stop=...)` (dicts still work for threshold stops).
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from typing import Any, Dict
+
+__all__ = [
+    "Stopper", "MaximumIterationStopper", "TrialPlateauStopper",
+    "TimeoutStopper", "FunctionStopper", "CombinedStopper",
+]
+
+
+class Stopper:
+    def __call__(self, trial_id: str, result: Dict[str, Any]) -> bool:
+        raise NotImplementedError
+
+    def stop_all(self) -> bool:
+        return False
+
+
+class MaximumIterationStopper(Stopper):
+    """Stop each trial after max_iter results (reference:
+    stopper/maximum_iteration.py)."""
+
+    def __init__(self, max_iter: int):
+        self._max_iter = max_iter
+        self._counts: Dict[str, int] = collections.defaultdict(int)
+
+    def __call__(self, trial_id, result) -> bool:
+        self._counts[trial_id] += 1
+        return self._counts[trial_id] >= self._max_iter
+
+
+class TrialPlateauStopper(Stopper):
+    """Stop a trial whose metric stopped moving (reference:
+    stopper/trial_plateau.py): std of the last `num_results` values below
+    `std`, after at least `grace_period` results."""
+
+    def __init__(self, metric: str, std: float = 0.01,
+                 num_results: int = 4, grace_period: int = 4,
+                 metric_threshold: float = None, mode: str = None):
+        self._metric = metric
+        self._std = std
+        self._num_results = num_results
+        self._grace = grace_period
+        self._threshold = metric_threshold
+        self._mode = mode
+        self._window: Dict[str, collections.deque] = {}
+        self._iters: Dict[str, int] = collections.defaultdict(int)
+
+    def __call__(self, trial_id, result) -> bool:
+        if self._metric not in result:
+            return False
+        value = float(result[self._metric])
+        win = self._window.setdefault(
+            trial_id, collections.deque(maxlen=self._num_results))
+        win.append(value)
+        self._iters[trial_id] += 1
+        # grace counts RESULTS, not window length (the deque is capped at
+        # num_results, so grace_period > num_results could never fire)
+        if (len(win) < self._num_results
+                or self._iters[trial_id] < self._grace):
+            return False
+        if self._threshold is not None:
+            if self._mode == "min" and value > self._threshold:
+                return False
+            if self._mode == "max" and value < self._threshold:
+                return False
+        mean = sum(win) / len(win)
+        var = sum((v - mean) ** 2 for v in win) / len(win)
+        return var ** 0.5 <= self._std
+
+
+class TimeoutStopper(Stopper):
+    """Stop the WHOLE experiment after a wall-clock budget (reference:
+    stopper/timeout.py)."""
+
+    def __init__(self, timeout_s: float):
+        self._deadline = time.monotonic() + timeout_s
+
+    def __call__(self, trial_id, result) -> bool:
+        return False
+
+    def stop_all(self) -> bool:
+        return time.monotonic() >= self._deadline
+
+
+class FunctionStopper(Stopper):
+    """Wrap a plain `fn(trial_id, result) -> bool` (reference:
+    stopper/function_stopper.py)."""
+
+    def __init__(self, fn):
+        self._fn = fn
+
+    def __call__(self, trial_id, result) -> bool:
+        return bool(self._fn(trial_id, result))
+
+
+class CombinedStopper(Stopper):
+    """OR of several stoppers (reference: stopper/__init__.py)."""
+
+    def __init__(self, *stoppers: Stopper):
+        self._stoppers = stoppers
+
+    def __call__(self, trial_id, result) -> bool:
+        return any(s(trial_id, result) for s in self._stoppers)
+
+    def stop_all(self) -> bool:
+        return any(s.stop_all() for s in self._stoppers)

@@ -215,3 +215,28 @@ def collect_worker_logs(nodes, rpc_call, *, node_id=None, pid=None,
             continue
         out[nid] = {str(p): info for p, info in reply.items()}
     return out
+
+
+def task_timeline_events(limit: int = 100_000) -> list:
+    """Chrome-trace 'X' events built from GCS task events (reference:
+    _private/state.py:434 chrome_tracing_dump — what `ray timeline` and
+    `ray.timeline()` emit)."""
+    # list_tasks returns newest-first; pairing needs chronological order
+    events = sorted(list_tasks(limit=limit, raw_events=True),
+                    key=lambda e: e["time"])
+    trace = []
+    starts = {}
+    for ev in events:
+        key = (ev["task_id"], ev["worker_id"])
+        if ev["state"] == "RUNNING":
+            starts[key] = ev["time"]
+        elif ev["state"] in ("FINISHED", "FAILED") and key in starts:
+            t0 = starts.pop(key)
+            trace.append({
+                "cat": "task", "ph": "X", "name": ev["name"],
+                "pid": ev.get("node") or "driver",
+                "tid": ev["worker_id"][:12],
+                "ts": int(t0 * 1e6), "dur": int((ev["time"] - t0) * 1e6),
+                "args": {"task_id": ev["task_id"], "state": ev["state"]},
+            })
+    return trace

@@ -205,3 +205,36 @@ def test_namespaces(ray_start_cluster):
         ray_tpu.get_actor("c")  # wrong namespace (ns1)
     c2 = ray_tpu.get_actor("c", namespace="ns2")
     assert ray_tpu.get(c2.value.remote(), timeout=30) == 1
+
+
+def test_method_decorator_num_returns(ray_start_regular):
+    """reference: ray.method (@ray.method(num_returns=2)) — per-method
+    options baked into the class, carried by (serialized) handles."""
+
+    @ray_tpu.remote
+    class Pair:
+        @ray_tpu.method(num_returns=2)
+        def split(self, x):
+            return x, x * 10
+
+        def plain(self, x):
+            return x
+
+    p = Pair.remote()
+    a, b = p.split.remote(3)
+    assert ray_tpu.get(a, timeout=30) == 3
+    assert ray_tpu.get(b, timeout=30) == 30
+    assert ray_tpu.get(p.plain.remote(1), timeout=30) == 1
+
+    # options survive handle serialization through the cluster
+    @ray_tpu.remote
+    def use(handle):
+        x, y = handle.split.remote(2)
+        return ray_tpu.get(x) + ray_tpu.get(y)
+
+    assert ray_tpu.get(use.remote(p), timeout=30) == 22
+
+
+def test_method_decorator_rejects_unknown_options():
+    with pytest.raises(ValueError, match="unsupported"):
+        ray_tpu.method(num_return=2)  # typo must fail at decoration time

@@ -311,3 +311,20 @@ def test_cancel_dep_parked_task(ray_start_2_cpus):
     ray_tpu.cancel(ref)
     with pytest.raises((exc.TaskCancelledError, exc.GetTimeoutError)):
         ray_tpu.get(ref, timeout=10)
+
+
+def test_timeline_api(ray_start_regular, tmp_path):
+    """reference: ray.timeline — chrome-trace events for executed tasks."""
+    import json as _json
+
+    @ray_tpu.remote
+    def traced_task():
+        return 1
+
+    ray_tpu.get([traced_task.remote() for _ in range(3)], timeout=60)
+    time.sleep(1.0)  # task-event flush interval
+    out = tmp_path / "trace.json"
+    events = ray_tpu.timeline(str(out))
+    assert any(e["name"] == "traced_task" for e in events)
+    disk = _json.loads(out.read_text())
+    assert disk == events

@@ -298,3 +298,42 @@ def test_connector_pipeline():
     a = pipe(dict(probe), update_stats=False)["obs"]
     b = pipe2(dict(probe), update_stats=False)["obs"]
     np.testing.assert_allclose(a, b)
+
+
+def test_callbacks_and_registry():
+    """reference: rllib/algorithms/callbacks.py RLlibCallback hooks +
+    registry.py get_algorithm_class."""
+    from ray_tpu.rllib.algorithms import PPOConfig
+    from ray_tpu.rllib.algorithms.registry import get_algorithm_class
+    from ray_tpu.rllib.callbacks import RLlibCallback
+
+    events = []
+
+    class Recorder(RLlibCallback):
+        def on_algorithm_init(self, *, algorithm, **kw):
+            events.append("init")
+
+        def on_train_result(self, *, algorithm, result, **kw):
+            events.append(("result", result["training_iteration"]))
+
+        def on_episode_end(self, *, episode, **kw):
+            events.append("episode")
+
+        def on_checkpoint_saved(self, *, algorithm, checkpoint_dir, **kw):
+            events.append("saved")
+
+    algo = (PPOConfig()
+            .environment("CartPole-v1")
+            .training(train_batch_size=200, minibatch_size=64, num_epochs=1)
+            .callbacks(Recorder)
+            .build())
+    algo.train()
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        algo.save(td)
+    assert events[0] == "init"
+    assert ("result", 1) in events
+    assert "episode" in events
+    assert events[-1] == "saved"
+    assert get_algorithm_class("PPO") is type(algo)

@@ -248,3 +248,26 @@ def test_serve_benchmarks_produce_sane_numbers(ray_start_regular):
     assert out["serve_handle"]["p50_ms"] < 1000
     # probe overhead is the routing cost on top of a raw actor call
     assert "overhead_ms" in out["router_probe_overhead"]
+
+
+def test_get_replica_context(serve_instance):
+    """reference: serve/api.py:140 get_replica_context — a replica can
+    introspect its app/deployment/replica identity; outside a replica the
+    call raises."""
+    from ray_tpu import serve
+
+    @serve.deployment
+    class WhoAmI:
+        def __call__(self):
+            ctx = serve.get_replica_context()
+            return (ctx.app_name, ctx.deployment, ctx.replica_tag,
+                    ctx.servable_object is self)
+
+    handle = serve.run(WhoAmI.bind(), name="ctxapp")
+    app, dep, tag, is_self = handle.remote().result()
+    assert app == "ctxapp"
+    assert dep == "WhoAmI"
+    assert "WhoAmI" in tag
+    assert is_self
+    with pytest.raises(RuntimeError, match="replica"):
+        serve.get_replica_context()

@@ -1,0 +1,137 @@
+"""Tune breadth: stoppers, sample_from/q-variants, registries, reporters,
+legacy Experiment/run_experiments/ExperimentAnalysis.
+
+Reference: ray python/ray/tune/stopper/, search/sample.py, registry.py,
+progress_reporter.py, experiment/experiment_analysis.py.
+"""
+
+import random
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.air import RunConfig
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_sample_variants_and_sample_from():
+    from ray_tpu.tune.search.sample import resolve_config
+
+    space = {
+        "a": tune.choice([2, 8]),
+        "b": tune.sample_from(lambda spec: spec.config.a * 3),
+        "q": tune.qloguniform(1e-3, 1e-1, 1e-3),
+        "n": tune.qrandn(10.0, 2.0, 0.5),
+        "i": tune.qlograndint(4, 256, 4),
+    }
+    cfg = resolve_config(space, random.Random(0))
+    assert cfg["b"] == cfg["a"] * 3
+    assert abs(cfg["q"] / 1e-3 - round(cfg["q"] / 1e-3)) < 1e-9
+    assert cfg["i"] % 4 == 0
+
+
+def test_stopper_classes():
+    s = tune.MaximumIterationStopper(3)
+    assert [s("t", {}) for _ in range(3)] == [False, False, True]
+    p = tune.TrialPlateauStopper(metric="loss", std=1e-3, num_results=3,
+                                 grace_period=3)
+    assert not p("t", {"loss": 1.0})
+    assert not p("t", {"loss": 0.5})
+    assert not p("t", {"loss": 0.5})  # window [1.0, .5, .5]: std too big
+    assert p("t", {"loss": 0.5})  # [.5, .5, .5] flat
+    c = tune.CombinedStopper(tune.FunctionStopper(
+        lambda tid, r: r.get("x", 0) > 5), tune.MaximumIterationStopper(99))
+    assert not c("t", {"x": 1})
+    assert c("t", {"x": 9})
+    # grace_period beyond the window must still be honored
+    g = tune.TrialPlateauStopper(metric="loss", std=1e-3, num_results=2,
+                                 grace_period=5)
+    fires = [g("t", {"loss": 1.0}) for _ in range(6)]
+    assert fires == [False] * 4 + [True, True]
+
+
+def test_stopper_in_experiment(cluster, tmp_path):
+    def train_fn(config):
+        for i in range(50):
+            tune.report({"iter": i})
+
+    tuner = tune.Tuner(
+        train_fn,
+        tune_config=tune.TuneConfig(num_samples=2),
+        run_config=RunConfig(name="stopex", storage_path=str(tmp_path),
+                             stop=tune.MaximumIterationStopper(4)),
+    )
+    results = tuner.fit()
+    for r in results:
+        assert r.metrics["iter"] <= 4  # stopped early, not at 49
+
+
+def test_registry_and_factories():
+    tune.register_trainable("my_trainable", lambda config: None)
+    from ray_tpu.tune.registry import get_trainable_cls
+
+    assert callable(get_trainable_cls("my_trainable"))
+    with pytest.raises(ValueError):
+        get_trainable_cls("nope")
+    assert type(tune.create_scheduler("pbt",
+                                      time_attr="iter",
+                                      metric="m", mode="max",
+                                      hyperparam_mutations={"lr": [1, 2]})
+                ).__name__ == "PopulationBasedTraining"
+    with pytest.raises(ValueError):
+        tune.create_scheduler("nope")
+    assert tune.create_searcher("random") is not None
+
+
+def test_cli_reporter_renders():
+    class FakeTrial:
+        def __init__(self, i):
+            self.trial_id = f"trial_{i}"
+            self.status = "RUNNING"
+            self.config = {"lr": 0.1 * i}
+
+    rep = tune.CLIReporter(metric_columns=["loss"], max_report_frequency=0)
+    trials = [FakeTrial(i) for i in range(3)]
+    rep.on_trial_result(1, trials, trials[0], {"loss": 0.25})
+    text = rep.render(trials, final=False)
+    assert "RUNNING" in text and "trial_0" in text and "0.25" in text
+
+
+def test_experiment_analysis_roundtrip(cluster, tmp_path):
+    def train_fn(config):
+        for i in range(3):
+            tune.report({"score": config["x"] * (i + 1)})
+
+    tuner = tune.Tuner(
+        train_fn,
+        param_space={"x": tune.grid_search([1.0, 5.0, 3.0])},
+        run_config=RunConfig(name="ana_exp",
+                             storage_path=str(tmp_path)),
+    )
+    tuner.fit()
+    exp_dirs = [d for d in (tmp_path).iterdir() if d.is_dir()]
+    assert len(exp_dirs) == 1
+    ana = tune.ExperimentAnalysis(str(exp_dirs[0]), default_metric="score",
+                                  default_mode="max")
+    assert len(ana.trial_ids) == 3
+    best = ana.get_best_config()
+    assert best["x"] == 5.0
+    df = ana.dataframe()
+    assert len(df) == 3 and df["score"].max() == 15.0
+
+
+def test_run_experiments_legacy(cluster, tmp_path):
+    tune.register_trainable(
+        "quick_fn", lambda config: tune.report({"v": config["x"]}))
+    trials = tune.run_experiments({
+        "legacy_exp": {"run": "quick_fn", "config": {"x": 7},
+                       "storage_path": str(tmp_path)},
+    })
+    assert len(trials) == 1
