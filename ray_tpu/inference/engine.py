@@ -105,61 +105,112 @@ class InferenceEngine:
         self.free_slots = list(range(max_batch))
         self._key = jax.random.PRNGKey(0)
 
-        # One compiled prefill per bucket; one compiled decode. Marked donate
-        # for the cache operand.
-        @partial(jax.jit, donate_argnums=(1,))
-        def prefill(params, cache, tokens, slot, true_len):
-            """tokens: [1, bucket] padded; writes KV into `slot`, returns
-            logits of the last REAL token. The slot row is rebuilt from
-            zeros (a reused slot may hold a previous request's stale KV)."""
+        def prefill_batch_impl(params, cache, tokens, slots, true_lens, key,
+                          temperature=0.0, top_k=0, top_p=1.0):
+            """Batched admission: tokens [N, bucket] padded prompts,
+            slots [N] distinct slot indices, true_lens [N]. Prefills all
+            N rows AND samples each row's first token on-device, so a
+            whole admission wave is ONE dispatch + one [N]-token
+            transfer (per-request prefill pays a tunnel round trip per
+            prompt)."""
+            n, _ = tokens.shape
             t = cache["k"].shape[2]
             row_cache = {
-                k: jnp.zeros((v.shape[0], 1) + v.shape[2:], v.dtype)
+                k: jnp.zeros((v.shape[0], n) + v.shape[2:], v.dtype)
                 for k, v in cache.items()
             }
             logits, row_cache = self._fwd(
-                params, tokens, row_cache, jnp.zeros((1,), jnp.int32),
+                params, tokens, row_cache, jnp.zeros((n,), jnp.int32),
                 self.config)
-            # Zero the padded tail so it never pollutes later decode steps.
-            valid = (jnp.arange(t) < true_len)[None, None, :, None, None]
+            valid = (jnp.arange(t)[None, :]
+                     < true_lens[:, None])[None, :, :, None, None]
             new_cache = {}
-            for k in cache:
-                updated = jnp.where(valid, row_cache[k], 0).astype(
-                    cache[k].dtype)
-                new_cache[k] = jax.lax.dynamic_update_slice_in_dim(
-                    cache[k], updated, slot, axis=1)
-            last = logits[0, true_len - 1]
-            return new_cache, last
+            for name in cache:
+                updated = jnp.where(valid, row_cache[name], 0).astype(
+                    cache[name].dtype)
+                new_cache[name] = cache[name].at[:, slots].set(updated)
+            last = logits[jnp.arange(n), true_lens - 1]  # [N, vocab]
+            first = sample_token(last, key, temperature=temperature,
+                                 top_k=top_k, top_p=top_p)
+            return new_cache, first
 
-        @partial(jax.jit, donate_argnums=(1,),
-                 static_argnames=("steps", "temperature", "top_k", "top_p"))
-        def decode(params, cache, tokens, lengths, key, steps=1,
-                   temperature=0.0, top_k=0, top_p=1.0):
-            """tokens: [B,1] current token per slot -> [steps, B] next
-            tokens. `steps` > 1 runs a lax.scan of decode steps in ONE
-            dispatch — the host is out of the loop for `steps` tokens,
-            which is what makes decode throughput survive dispatch latency
-            (remote/tunneled runtimes especially; ~100x there). Tokens a
-            request produces past its EOS within a chunk are discarded
-            host-side; freed slots' rows are rebuilt at next prefill, so
-            the uniform progression never corrupts live state."""
+        def decode_full_impl(params, cache, tokens, lengths, budget, active,
+                        key, n_steps, eos_id, max_steps,
+                        temperature=0.0, top_k=0, top_p=1.0):
+            """The whole decode-sample-append loop in ONE compiled
+            program (VERDICT r3 #1): a lax.while_loop runs up to
+            `n_steps` (traced — no recompile per chunk length) decode
+            steps with on-device sampling, per-slot budget/EOS/length
+            tracking, and early exit when every slot is done. The host
+            is out of the loop for the entire generation; the only
+            transfer is the [max_steps, B] token block at the end.
 
-            def body(carry, _):
-                cache, tok, lens, k = carry
+            tokens [B,1]; budget [B] remaining new-token allowance;
+            active [B] bool; eos_id traced int32 (-1 = no EOS).
+            -> (cache, out [max_steps, B], executed_steps)."""
+            t_max = cache["k"].shape[2]
+            out0 = jnp.zeros((max_steps, tokens.shape[0]), jnp.int32)
+
+            def cond(c):
+                i, _, _, _, _, act, _, _ = c
+                return (i < n_steps) & jnp.any(act)
+
+            def body(c):
+                i, cache, tok, lens, rem, act, k, out = c
                 logits, cache = self._fwd(params, tok, cache, lens,
                                           self.config)
                 k, sub = jax.random.split(k)
                 nxt = sample_token(logits[:, -1], sub,
                                    temperature=temperature,
                                    top_k=top_k, top_p=top_p)
-                return (cache, nxt[:, None], lens + 1, k), nxt
+                out = jax.lax.dynamic_update_index_in_dim(
+                    out, jnp.where(act, nxt, -1), i, 0)
+                lens = jnp.where(act, lens + 1, lens)
+                rem = jnp.where(act, rem - 1, rem)
+                act = act & (rem > 0) & (nxt != eos_id) & (lens + 1 < t_max)
+                return (i + 1, cache, nxt[:, None], lens, rem, act, k, out)
 
-            (cache, _, _, _), toks = jax.lax.scan(
-                body, (cache, tokens, lengths, key), None, length=steps)
-            return cache, toks
+            i, cache, _, _, _, _, _, out = jax.lax.while_loop(
+                cond, body,
+                (jnp.int32(0), cache, tokens, lengths, budget, active,
+                 key, out0))
+            return cache, out, i
 
-        self._prefill = prefill
-        self._decode = decode
+        def generate_wave(params, cache, tokens, slots, true_lens, budget,
+                          key, n_steps, eos_id, max_steps,
+                          temperature=0.0, top_k=0, top_p=1.0):
+            """Fresh-batch fast path: batched prefill + first-token
+            sampling + the ENTIRE decode loop in one compiled program —
+            a full generate() is ONE dispatch and one result transfer.
+            Behind a high-latency tunnel this is the difference between
+            paying 2+ round trips and paying one."""
+            t_max = cache["k"].shape[2]
+            b = cache["k"].shape[1]
+            key, pk, dk = jax.random.split(key, 3)
+            cache, firsts = prefill_batch_impl(
+                params, cache, tokens, slots, true_lens, pk,
+                temperature=temperature, top_k=top_k, top_p=top_p)
+            tok0 = jnp.zeros((b, 1), jnp.int32).at[slots, 0].set(firsts)
+            lens0 = jnp.zeros((b,), jnp.int32).at[slots].set(true_lens)
+            bud0 = jnp.zeros((b,), jnp.int32).at[slots].set(budget)
+            act0 = (jnp.zeros((b,), bool).at[slots].set(
+                (firsts != eos_id) & (true_lens + 1 < t_max))
+                & (bud0 > 0))
+            cache, out, executed = decode_full_impl(
+                params, cache, tok0, lens0, bud0, act0, dk, n_steps,
+                eos_id, max_steps=max_steps, temperature=temperature,
+                top_k=top_k, top_p=top_p)
+            return cache, firsts, out, executed
+
+        self._prefill_batch = jax.jit(
+            prefill_batch_impl, donate_argnums=(1,),
+            static_argnames=("temperature", "top_k", "top_p"))
+        self._decode_full = jax.jit(
+            decode_full_impl, donate_argnums=(1,),
+            static_argnames=("max_steps", "temperature", "top_k", "top_p"))
+        self._generate_wave = jax.jit(
+            generate_wave, donate_argnums=(1,),
+            static_argnames=("max_steps", "temperature", "top_k", "top_p"))
         self.decode_chunk = max(1, decode_chunk)
 
     # -- internals ----------------------------------------------------------
@@ -170,31 +221,80 @@ class InferenceEngine:
                 return b
         raise ValueError(f"prompt of {n} tokens exceeds max_len={self.max_len}")
 
-    def _admit(self, prompt: List[int], gen: GenerationConfig) -> Tuple[int, int]:
-        """Prefill a prompt into a free slot; returns (slot, first_token)."""
-        n = len(prompt)
-        if n == 0:
-            raise ValueError("cannot generate from an empty prompt")
-        bucket = self._bucket_for(n)  # validate BEFORE claiming a slot
-        slot = self.free_slots.pop()
-        try:
-            toks = np.zeros((1, bucket), dtype=np.int32)
-            toks[0, :n] = prompt
-            self.cache, last_logits = self._prefill(
-                self.params, self.cache, jnp.asarray(toks), slot, n)
-            self._key, sub = jax.random.split(self._key)
-            first = int(sample_token(last_logits[None, :], sub,
-                                     temperature=gen.temperature,
-                                     top_k=gen.top_k, top_p=gen.top_p)[0])
-        except Exception:
-            self.free_slots.append(slot)
-            raise
-        self.lengths[slot] = n
-        return slot, first
-
     def _release(self, slot: int) -> None:
         self.lengths[slot] = 0
         self.free_slots.append(slot)
+
+    def _consume_block(self, out, executed, active, gen) -> Iterator[
+            Tuple[int, int]]:
+        """Walk a [steps, B] token block from the fused decode, yielding
+        (req_idx, token) and releasing slots as their host-side done
+        conditions fire (mirrors the device's active-mask logic, so the
+        -1 filler rows past a slot's completion are never read)."""
+        for step in range(int(executed)):
+            if not active:
+                break
+            for slot in list(active):
+                st = active[slot]
+                self.lengths[slot] += 1
+                token = int(out[step, slot])
+                st["produced"] += 1
+                st["current"] = token
+                done = (
+                    (gen.eos_token_id is not None
+                     and token == gen.eos_token_id)
+                    or st["produced"] >= gen.max_new_tokens
+                    or self.lengths[slot] + 1 >= self.max_len)
+                yield st["req"], token
+                if done:
+                    del active[slot]
+                    self._release(slot)
+
+    def _run_wave(self, pending, active, gen) -> Iterator[Tuple[int, int]]:
+        """One-dispatch generation for a fresh same-bucket batch: prefill,
+        first-token sampling, and the full decode run as a single
+        compiled program (generate_wave)."""
+        batch = pending[::-1]  # original submission order
+        n = len(batch)
+        bucket = self._bucket_for(max(len(p) for _, p in batch))
+        slots = [self.free_slots.pop() for _ in range(n)]
+        toks = np.zeros((n, bucket), dtype=np.int32)
+        true_lens = np.zeros((n,), dtype=np.int32)
+        for row, (_, prompt) in enumerate(batch):
+            toks[row, :len(prompt)] = prompt
+            true_lens[row] = len(prompt)
+        budget = np.full((n,), gen.max_new_tokens - 1, dtype=np.int32)
+        need = max(max(1, min(gen.max_new_tokens - 1,
+                              self.max_len - 1 - len(p)))
+                   for _, p in batch)
+        max_steps = 1
+        while max_steps < need:
+            max_steps *= 2
+        eos = gen.eos_token_id if gen.eos_token_id is not None else -1
+        self._key, sub = jax.random.split(self._key)
+        try:
+            self.cache, firsts, out, executed = self._generate_wave(
+                self.params, self.cache, jnp.asarray(toks),
+                jnp.asarray(np.array(slots, np.int32)),
+                jnp.asarray(true_lens), jnp.asarray(budget), sub,
+                jnp.int32(need), jnp.int32(eos), max_steps=max_steps,
+                temperature=gen.temperature, top_k=gen.top_k,
+                top_p=gen.top_p)
+            firsts, out, executed = jax.device_get((firsts, out, executed))
+        except Exception:
+            self.free_slots.extend(slots)
+            raise
+        for (req_idx, prompt), slot, first in zip(batch, slots, firsts):
+            first = int(first)
+            self.lengths[slot] = len(prompt)
+            yield req_idx, first
+            if ((gen.eos_token_id is not None
+                 and first == gen.eos_token_id)
+                    or self.lengths[slot] + 1 >= self.max_len):
+                self._release(slot)
+                continue
+            active[slot] = {"req": req_idx, "produced": 1, "current": first}
+        yield from self._consume_block(out, executed, active, gen)
 
     # -- public API ---------------------------------------------------------
 
@@ -204,8 +304,18 @@ class InferenceEngine:
         gen: Optional[GenerationConfig] = None,
     ) -> Iterator[Tuple[int, int]]:
         """Continuous-batching generation. Yields (request_index, token_id)
-        as tokens are produced; requests are admitted as slots free up."""
+        pairs; requests are admitted as slots free up.
+
+        Tokens arrive in BLOCKS, not one at a time: the fused decode runs
+        a whole generation (or decode_chunk steps when requests are
+        waiting) per dispatch, and this iterator drains each block as it
+        lands. Per-token streaming would put a host round trip back into
+        the decode loop — the opposite trade from what a TPU behind a
+        dispatch latency wants."""
         gen = gen or GenerationConfig()
+        for p in prompts:
+            if not p:
+                raise ValueError("cannot generate from an empty prompt")
         if not self.free_slots:
             # All slots are occupied — only possible when a previous
             # generate_stream iterator was abandoned mid-stream; refuse
@@ -216,68 +326,96 @@ class InferenceEngine:
         pending = list(enumerate(prompts))[::-1]  # stack of (req_idx, prompt)
         active: Dict[int, dict] = {}  # slot -> {req, produced, current}
 
+        # Fresh-batch fast path: when every prompt fits one admission wave
+        # (same bucket, enough free slots), run prefill + the whole decode
+        # as ONE dispatch (generate_wave) instead of two.
+        if (pending and len(pending) <= len(self.free_slots)
+                and gen.max_new_tokens > 1
+                and len({self._bucket_for(len(p)) for _, p in pending}) == 1):
+            yield from self._run_wave(pending, active, gen)
+            pending = []
+
         def admit_all():
+            """Admit pending prompts in bucket-grouped WAVES: one
+            prefill_batch dispatch per (bucket, group-size) instead of
+            one prefill + one sample round trip per request."""
             while pending and self.free_slots:
-                req_idx, prompt = pending.pop()
-                slot, first = self._admit(prompt, gen)
-                yield req_idx, first
-                # The prefill-sampled token can already terminate the request.
-                if ((gen.eos_token_id is not None and first == gen.eos_token_id)
-                        or gen.max_new_tokens <= 1
-                        or self.lengths[slot] + 1 >= self.max_len):
-                    self._release(slot)
-                    continue
-                active[slot] = {"req": req_idx, "produced": 1,
-                                "current": first}
+                bucket = self._bucket_for(len(pending[-1][1]))
+                batch: List[Tuple[int, List[int]]] = []
+                while (pending and len(batch) < len(self.free_slots)
+                       and self._bucket_for(len(pending[-1][1])) == bucket):
+                    batch.append(pending.pop())
+                n = len(batch)
+                slots = [self.free_slots.pop() for _ in range(n)]
+                toks = np.zeros((n, bucket), dtype=np.int32)
+                true_lens = np.zeros((n,), dtype=np.int32)
+                for row, (_, prompt) in enumerate(batch):
+                    toks[row, :len(prompt)] = prompt
+                    true_lens[row] = len(prompt)
+                self._key, sub = jax.random.split(self._key)
+                try:
+                    self.cache, firsts = self._prefill_batch(
+                        self.params, self.cache, jnp.asarray(toks),
+                        jnp.asarray(np.array(slots, np.int32)),
+                        jnp.asarray(true_lens), sub,
+                        temperature=gen.temperature, top_k=gen.top_k,
+                        top_p=gen.top_p)
+                    firsts = np.asarray(firsts)
+                except Exception:
+                    self.free_slots.extend(slots)
+                    raise
+                for (req_idx, prompt), slot, first in zip(
+                        batch, slots, firsts):
+                    first = int(first)
+                    self.lengths[slot] = len(prompt)
+                    yield req_idx, first
+                    # A prefill-sampled token can already terminate.
+                    if ((gen.eos_token_id is not None
+                         and first == gen.eos_token_id)
+                            or gen.max_new_tokens <= 1
+                            or self.lengths[slot] + 1 >= self.max_len):
+                        self._release(slot)
+                        continue
+                    active[slot] = {"req": req_idx, "produced": 1,
+                                    "current": first}
 
         yield from admit_all()
         while active:
             tokens = np.zeros((self.max_batch, 1), dtype=np.int32)
+            budget = np.zeros(self.max_batch, dtype=np.int32)
+            act = np.zeros(self.max_batch, dtype=bool)
             for slot, st in active.items():
                 tokens[slot, 0] = st["current"]
-            # Record cache positions BEFORE bumping: each slot's current
-            # token goes at index lengths[slot].
-            lengths = jnp.asarray(self.lengths)
-            self._key, sub = jax.random.split(self._key)
-            # clamp the chunk to what the active requests can still use,
-            # rounded up to a power of two so compile count stays
-            # log2(decode_chunk) (static `steps` = one program per bucket)
+                budget[slot] = gen.max_new_tokens - st["produced"]
+                act[slot] = True
+            # Run the WHOLE remaining generation in one dispatch unless
+            # requests are waiting for a slot — slots can free early via
+            # EOS, budget variance across admission waves, or per-slot
+            # max_len caps, so cap at decode_chunk to keep admission
+            # responsive whenever anything is pending.
             need = max(
                 min(gen.max_new_tokens - st["produced"],
                     self.max_len - 1 - self.lengths[slot])
                 for slot, st in active.items())
-            steps = 1
-            while steps < min(self.decode_chunk, max(1, need)):
-                steps *= 2
-            self.cache, chunk = self._decode(
-                self.params, self.cache, jnp.asarray(tokens), lengths, sub,
-                steps=steps, temperature=gen.temperature, top_k=gen.top_k,
-                top_p=gen.top_p)
-            chunk = np.asarray(chunk)  # [steps, B]
-            finished = []
-            for step in range(steps):
-                if not active:
-                    break
-                for slot in list(active):
-                    st = active[slot]
-                    self.lengths[slot] += 1
-                    token = int(chunk[step, slot])
-                    st["produced"] += 1
-                    st["current"] = token
-                    done = (
-                        (gen.eos_token_id is not None
-                         and token == gen.eos_token_id)
-                        or st["produced"] >= gen.max_new_tokens
-                        or self.lengths[slot] + 1 >= self.max_len)
-                    yield st["req"], token
-                    if done:
-                        # the chunk's remaining tokens for this slot are
-                        # discarded; the slot re-prefills before reuse
-                        del active[slot]
-                        finished.append(slot)
-            for slot in finished:
-                self._release(slot)
-            if finished:
+            need = max(1, need)
+            if pending:
+                need = min(need, self.decode_chunk)
+            max_steps = 1
+            while max_steps < need:
+                max_steps *= 2
+            self._key, sub = jax.random.split(self._key)
+            eos = (gen.eos_token_id
+                   if gen.eos_token_id is not None else -1)
+            self.cache, out, executed = self._decode_full(
+                self.params, self.cache, jnp.asarray(tokens),
+                jnp.asarray(self.lengths), jnp.asarray(budget),
+                jnp.asarray(act), sub, jnp.int32(need), jnp.int32(eos),
+                max_steps=max_steps, temperature=gen.temperature,
+                top_k=gen.top_k, top_p=gen.top_p)
+            out, executed = jax.device_get((out, executed))
+            n_before = len(active)
+            yield from self._consume_block(out, executed, active, gen)
+            if pending and len(active) < n_before:
                 yield from admit_all()
 
     def generate(self, prompts: List[List[int]],

@@ -110,6 +110,40 @@ def test_prefill_bucketing(tiny):
     assert out[0] == out2[0]
 
 
+def test_mixed_bucket_prompts(tiny):
+    """Prompts spanning prefill buckets can't take the single-wave fast
+    path; the bucket-grouped admission must still produce per-request
+    results identical to solo runs."""
+    cfg, params = tiny
+    prompts = [[3, 1, 4], [9] * 40, [2, 7], [5] * 70]
+    eng = InferenceEngine(params, cfg, max_batch=4, max_len=256,
+                          prefill_buckets=(8, 64, 256))
+    out = eng.generate(prompts, GenerationConfig(max_new_tokens=4))
+    for i, p in enumerate(prompts):
+        solo = InferenceEngine(params, cfg, max_batch=1, max_len=256,
+                               prefill_buckets=(8, 64, 256))
+        assert solo.generate(
+            [p], GenerationConfig(max_new_tokens=4))[0] == out[i]
+
+
+def test_eos_admits_waiting_request(tiny):
+    """With more requests than slots and an EOS that fires, the freed
+    slot must admit the waiting request (decode_chunk caps the fused run
+    so admission stays responsive)."""
+    cfg, params = tiny
+    probe = InferenceEngine(params, cfg, max_batch=1, max_len=64)
+    eos = probe.generate([[5, 6, 7]],
+                         GenerationConfig(max_new_tokens=1))[0][0]
+    eng = InferenceEngine(params, cfg, max_batch=1, max_len=64,
+                          decode_chunk=4)
+    out = eng.generate(
+        [[5, 6, 7], [1, 2, 3]],
+        GenerationConfig(max_new_tokens=16, eos_token_id=eos))
+    assert out[0][-1] == eos
+    assert len(out[1]) >= 1  # the waiting request ran
+    assert eng.free_slots == [0]
+
+
 def test_sampling_ops():
     key = jax.random.PRNGKey(0)
     logits = jnp.asarray([[1.0, 5.0, 2.0, 0.5]])
