@@ -77,6 +77,30 @@ def _load():
     lib.rtps_list.restype = ctypes.c_int64
     lib.rtps_list.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
                               ctypes.c_uint64, ctypes.c_char_p]
+    # SPSC channels (client-side atomics; see shm_store.cc ChanHeader)
+    lib.rtps_chan_region_size.restype = ctypes.c_uint64
+    lib.rtps_chan_region_size.argtypes = [ctypes.c_uint64, ctypes.c_uint64]
+    lib.rtps_chan_init.restype = ctypes.c_int64
+    lib.rtps_chan_init.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                   ctypes.c_uint64, ctypes.c_uint64]
+    lib.rtps_chan_send.restype = ctypes.c_int64
+    lib.rtps_chan_send.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                   ctypes.c_uint64, ctypes.c_char_p,
+                                   ctypes.c_uint64, ctypes.c_uint64]
+    lib.rtps_chan_recv.restype = ctypes.c_int64
+    lib.rtps_chan_recv.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                   ctypes.c_uint64, ctypes.c_char_p,
+                                   ctypes.c_uint64, u64p, u64p, u64p]
+    lib.rtps_chan_recv_acquire.restype = ctypes.c_int64
+    lib.rtps_chan_recv_acquire.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                           ctypes.c_uint64, u64p, u64p]
+    lib.rtps_chan_recv_release.restype = ctypes.c_int64
+    lib.rtps_chan_recv_release.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.rtps_chan_close.restype = ctypes.c_int64
+    lib.rtps_chan_close.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.rtps_chan_geometry.restype = ctypes.c_int64
+    lib.rtps_chan_geometry.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                       u64p, u64p]
     _lib = lib
     return lib
 
@@ -199,6 +223,23 @@ class StoreClient:
             raise ShmStoreError(f"create failed: {st}")
         return self._view(off.value, size, readonly=False)
 
+    def create_raw(self, object_id: bytes, size: int,
+                   primary: bool = True) -> int:
+        """Like create() but returns the arena OFFSET of the writable
+        region (channel setup needs the offset before sealing)."""
+        off = ctypes.c_uint64()
+        st = self._lib.rtps_create(
+            self._handle, _pad_id(object_id), ctypes.c_uint64(size),
+            ctypes.c_uint64(FLAG_PRIMARY if primary else 0),
+            ctypes.byref(off))
+        if st == ST_FULL:
+            raise ShmStoreFull(f"store full creating {size} bytes")
+        if st == ST_EXISTS:
+            raise ShmStoreError("object already exists")
+        if st != ST_OK:
+            raise ShmStoreError(f"create failed: {st}")
+        return int(off.value)
+
     def seal(self, object_id: bytes) -> None:
         st = self._lib.rtps_seal(self._handle, _pad_id(object_id))
         if st != ST_OK:
@@ -230,6 +271,96 @@ class StoreClient:
         if st != ST_OK:
             raise ShmStoreError(f"get failed: {st}")
         return self._view(off.value, size.value, readonly=True, pin_key=key)
+
+    def get_raw(self, object_id: bytes,
+                timeout_ms: Optional[int] = 0
+                ) -> Optional[Tuple[int, int]]:
+        """Like get() but returns the (arena_offset, size) of the object
+        instead of a view, holding a store ref until an explicit
+        release(id). Channel endpoints use this: the offset feeds the
+        rtps_chan_* client-side ops."""
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        t = (2**64 - 1) if timeout_ms is None else int(timeout_ms)
+        st = self._lib.rtps_get(
+            self._handle, _pad_id(object_id), ctypes.c_uint64(t),
+            ctypes.byref(off), ctypes.byref(size))
+        if st in (ST_TIMEOUT, ST_NOT_FOUND):
+            return None
+        if st != ST_OK:
+            raise ShmStoreError(f"get failed: {st}")
+        return int(off.value), int(size.value)
+
+    def view_at(self, offset: int, size: int,
+                readonly: bool = True) -> memoryview:
+        """Raw arena view (channel slot access); no ref management."""
+        return self._view(offset, size, readonly=readonly)
+
+    # -- channel ops (SPSC rings inside sealed objects) ---------------------
+
+    def chan_region_size(self, slot_size: int, n_slots: int) -> int:
+        return int(self._lib.rtps_chan_region_size(
+            ctypes.c_uint64(slot_size), ctypes.c_uint64(n_slots)))
+
+    def chan_init(self, offset: int, slot_size: int, n_slots: int) -> None:
+        st = self._lib.rtps_chan_init(
+            self._handle, ctypes.c_uint64(offset),
+            ctypes.c_uint64(slot_size), ctypes.c_uint64(n_slots))
+        if st != ST_OK:
+            raise ShmStoreError(f"chan_init failed: {st}")
+
+    def chan_send(self, offset: int, kind: int, data,
+                  timeout_ms: Optional[int]) -> int:
+        t = (2**64 - 1) if timeout_ms is None else int(timeout_ms)
+        return int(self._lib.rtps_chan_send(
+            self._handle, ctypes.c_uint64(offset), ctypes.c_uint64(kind),
+            bytes(data), ctypes.c_uint64(len(data)), ctypes.c_uint64(t)))
+
+    def chan_recv_acquire(self, offset: int, timeout_ms: Optional[int]
+                          ) -> Tuple[int, Optional[Tuple[int, int]]]:
+        """-> (status, (payload_offset, length) | None)."""
+        t = (2**64 - 1) if timeout_ms is None else int(timeout_ms)
+        poff = ctypes.c_uint64()
+        plen = ctypes.c_uint64()
+        st = int(self._lib.rtps_chan_recv_acquire(
+            self._handle, ctypes.c_uint64(offset), ctypes.c_uint64(t),
+            ctypes.byref(poff), ctypes.byref(plen)))
+        if st != ST_OK:
+            return st, None
+        return st, (int(poff.value), int(plen.value))
+
+    def chan_recv(self, offset: int, buf, timeout_ms: Optional[int]
+                  ) -> Tuple[int, int, int, int]:
+        """One-call receive into `buf` (a ctypes char buffer):
+        -> (status, length, kind, released). released=0 means the caller
+        must chan_recv_release() after consuming (spilled messages)."""
+        t = (2**64 - 1) if timeout_ms is None else int(timeout_ms)
+        ln = ctypes.c_uint64()
+        kind = ctypes.c_uint64()
+        rel = ctypes.c_uint64()
+        st = int(self._lib.rtps_chan_recv(
+            self._handle, ctypes.c_uint64(offset), ctypes.c_uint64(t),
+            buf, ctypes.c_uint64(len(buf)), ctypes.byref(ln),
+            ctypes.byref(kind), ctypes.byref(rel)))
+        return st, int(ln.value), int(kind.value), int(rel.value)
+
+    def chan_recv_release(self, offset: int) -> None:
+        self._lib.rtps_chan_recv_release(
+            self._handle, ctypes.c_uint64(offset))
+
+    def chan_close(self, offset: int) -> None:
+        self._lib.rtps_chan_close(self._handle, ctypes.c_uint64(offset))
+
+    def chan_geometry(self, offset: int) -> Tuple[int, int]:
+        """-> (slot_size, n_slots) from the ring header."""
+        ss = ctypes.c_uint64()
+        ns = ctypes.c_uint64()
+        st = self._lib.rtps_chan_geometry(
+            self._handle, ctypes.c_uint64(offset),
+            ctypes.byref(ss), ctypes.byref(ns))
+        if st != ST_OK:
+            raise ShmStoreError(f"chan_geometry failed: {st}")
+        return int(ss.value), int(ns.value)
 
     def release(self, object_id: bytes) -> None:
         self._lib.rtps_release(self._handle, _pad_id(object_id))

@@ -223,6 +223,10 @@ def get(
     *,
     timeout: Optional[float] = None,
 ) -> Any:
+    # Compiled-DAG channel results resolve through their shm channel, not
+    # the object store (dag/compiled_channels.py CompiledDAGRef).
+    if hasattr(refs, "_rt_dag_get"):
+        return refs._rt_dag_get(timeout)
     cw = get_core_worker()
     if isinstance(refs, ObjectRef):
         return cw.get([refs], timeout=timeout)[0]
@@ -230,6 +234,8 @@ def get(
         raise TypeError("pass generator items, not the generator, to get()")
     if not isinstance(refs, (list, tuple)):
         raise TypeError(f"get() expects an ObjectRef or list of them, got {type(refs)}")
+    if refs and all(hasattr(r, "_rt_dag_get") for r in refs):
+        return [r._rt_dag_get(timeout) for r in refs]
     for r in refs:
         if not isinstance(r, ObjectRef):
             raise TypeError(f"get() list items must be ObjectRefs, got {type(r)}")

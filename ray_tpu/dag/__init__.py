@@ -44,8 +44,9 @@ class DAGNode:
                   for k, v in self._bound_kwargs.items()}
         return args, kwargs
 
-    def experimental_compile(self, **_kw) -> "CompiledDAG":
-        return CompiledDAG(self)
+    def experimental_compile(self, _buffer_size_bytes: int = 1 << 20,
+                             **_kw) -> "CompiledDAG":
+        return CompiledDAG(self, _buffer_size_bytes=_buffer_size_bytes)
 
     # -- traversal -----------------------------------------------------------
 
@@ -194,31 +195,55 @@ class ClassMethodNode(DAGNode):
 
 class CompiledDAG:
     """Repeated execution of a static DAG (reference: compiled_dag_node.py:374
-    CompiledDAG). Actors in the graph are instantiated once; each execute()
-    re-walks only the method-call chain with fresh inputs, submitting the
-    whole chain without waiting on intermediate results (refs flow as task
-    args, so the chain pipelines server-side)."""
+    CompiledDAG). Actors in the graph are instantiated once. When every
+    stage is an actor method and the node-local shm store is up, the graph
+    compiles to per-actor loops connected by shared-memory SPSC channels
+    (dag/compiled_channels.py) — each execute() is a channel send, with no
+    per-iteration task submission or object-store traffic. Otherwise each
+    execute() re-walks the method-call chain with fresh inputs (refs flow
+    as task args, so the chain still pipelines server-side)."""
 
-    def __init__(self, root: DAGNode):
+    def __init__(self, root: DAGNode, *, _buffer_size_bytes: int = 1 << 20,
+                 _num_slots: int = 4):
         self._root = root
         # Pre-create any actors so execute() is pure method-call submission.
         def warm(node: DAGNode):
             for child in node._children():
                 warm(child)
+            if isinstance(node, ClassMethodNode) and isinstance(
+                    node._handle, ClassNode):
+                warm(node._handle)
             if isinstance(node, ClassNode):
                 node._execute_with({"input": None})
 
         warm(root)
+        self._pipeline = None
+        try:
+            from ray_tpu.dag.compiled_channels import ChannelPipeline
+
+            self._pipeline = ChannelPipeline(
+                root, _buffer_size_bytes, _num_slots)
+        except Exception:  # noqa: BLE001 — any failure → ref-chain path
+            self._pipeline = None
 
     def execute(self, *input_args, **input_kwargs):
+        if self._pipeline is not None:
+            return self._pipeline.execute(*input_args, **input_kwargs)
         return self._root.execute(*input_args, **input_kwargs)
 
     def teardown(self) -> None:
         import ray_tpu
 
+        if self._pipeline is not None:
+            self._pipeline.teardown()
+            self._pipeline = None
+
         def kill_actors(node: DAGNode):
             for child in node._children():
                 kill_actors(child)
+            if isinstance(node, ClassMethodNode) and isinstance(
+                    node._handle, ClassNode):
+                kill_actors(node._handle)
             if isinstance(node, ClassNode) and node._cached_handle is not None:
                 try:
                     ray_tpu.kill(node._cached_handle)

@@ -1,0 +1,383 @@
+"""Channel-compiled actor pipelines (aDAG over processes).
+
+The reference's compiled DAG (`/root/reference/python/ray/dag/
+compiled_dag_node.py:374`) turns a static actor graph into long-running
+per-actor loops connected by mutable shared-memory channels, so each
+execute() moves data actor→actor with zero per-iteration task submissions
+or object-store puts. This module is the ray_tpu equivalent on top of the
+SPSC shm channels (ray_tpu/experimental/channel.py):
+
+  * every ClassMethodNode becomes a STAGE: a `__rt_pipeline_loop__` task
+    pinned on its actor that recv()s its channel inputs, runs the bound
+    method, and send()s the result to each consumer's channel;
+  * the driver writes execute() inputs into driver→stage channels and
+    reads results from stage→driver channels (CompiledDAGRef);
+  * exceptions flow through the channels as messages, stop sentinels
+    propagate teardown down the pipeline.
+
+Falls back (CompiledDAG keeps the plain ref-chain path) when the topology
+is unsupported, the native store is unavailable, or a stage cannot attach
+its channels (e.g. actors placed on another node).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.experimental.channel import (Channel, ChannelClosed,
+                                          ChannelTimeout)
+
+_ATTACH_TIMEOUT_S = 10.0
+
+
+# ---------------------------------------------------------------- stage loop
+
+
+def _stage_loop(instance, method_name: str, arg_specs, kwarg_specs,
+                out_names: List[str], slot_bytes: int) -> int:
+    """Runs ON the stage's actor (executor intercepts the reserved
+    `__rt_pipeline_loop__` method name and passes the live instance).
+    Returns the number of completed iterations at teardown."""
+    ins: Dict[int, Channel] = {}
+    kwins: Dict[str, Channel] = {}
+    outs: List[Channel] = []
+    try:
+        for i, spec in enumerate(arg_specs):
+            if spec[0] == "chan":
+                ins[i] = Channel(spec[1], slot_bytes=slot_bytes,
+                                 attach_timeout_s=_ATTACH_TIMEOUT_S)
+        for k, spec in kwarg_specs.items():
+            if spec[0] == "chan":
+                kwins[k] = Channel(spec[1], slot_bytes=slot_bytes,
+                                   attach_timeout_s=_ATTACH_TIMEOUT_S)
+        for name in out_names:
+            outs.append(Channel(name, slot_bytes=slot_bytes,
+                                attach_timeout_s=_ATTACH_TIMEOUT_S))
+        # Bring-up handshake: wait for READY from every upstream edge,
+        # then signal downstream. The driver seeds READY into the input
+        # channels and waits for it on the output channels, proving the
+        # WHOLE pipeline attached before any execute() is accepted.
+        for ch in list(ins.values()) + list(kwins.values()):
+            ch.recv_ready(timeout=_ATTACH_TIMEOUT_S)
+        for o in outs:
+            o.send_ready(timeout=_ATTACH_TIMEOUT_S)
+        method = getattr(instance, method_name)
+        iterations = 0
+        while True:
+            args: List[Any] = []
+            kwargs: Dict[str, Any] = {}
+            upstream_exc: Optional[BaseException] = None
+            stopped = False
+            # One message from EVERY channel input per iteration keeps the
+            # graph in lockstep; an upstream exception still consumes the
+            # other inputs' messages for this iteration.
+            for i, spec in enumerate(arg_specs):
+                if spec[0] == "const":
+                    args.append(spec[1])
+                    continue
+                try:
+                    args.append(ins[i].recv(timeout=None))
+                except ChannelClosed:
+                    stopped = True
+                    break
+                except BaseException as e:  # noqa: BLE001
+                    upstream_exc = e
+                    args.append(None)
+            if not stopped:
+                for k, spec in kwarg_specs.items():
+                    if spec[0] == "const":
+                        kwargs[k] = spec[1]
+                        continue
+                    try:
+                        kwargs[k] = kwins[k].recv(timeout=None)
+                    except ChannelClosed:
+                        stopped = True
+                        break
+                    except BaseException as e:  # noqa: BLE001
+                        upstream_exc = e
+                        kwargs[k] = None
+            if stopped:
+                break
+            if upstream_exc is not None:
+                for o in outs:
+                    o.send_exception(upstream_exc)
+                continue
+            try:
+                result = method(*args, **kwargs)
+            except BaseException as e:  # noqa: BLE001
+                for o in outs:
+                    o.send_exception(e)
+                continue
+            for o in outs:
+                o.send(result)
+            iterations += 1
+        return iterations
+    finally:
+        for o in outs:
+            try:
+                o.send_stop(timeout=1.0)
+            except Exception:  # noqa: BLE001 — downstream may be gone
+                pass
+        for ch in list(ins.values()) + list(kwins.values()) + outs:
+            ch.detach()
+
+
+# ------------------------------------------------------------- driver plumbing
+
+
+class _OutputReader:
+    """Orders concurrent CompiledDAGRef.get()s on one output channel:
+    message i on the channel belongs to execution i."""
+
+    def __init__(self, channel: Channel):
+        self._channel = channel
+        self._buffer: Dict[int, Tuple[bool, Any]] = {}
+        self._next = 0
+        self._lock = threading.Lock()
+
+    def get(self, seq: int, timeout: Optional[float]) -> Any:
+        # honour finite timeouts even while another get() holds the lock
+        # inside a blocking recv
+        if not self._lock.acquire(
+                timeout=-1 if timeout is None else timeout):
+            raise ChannelTimeout("another get() holds the channel")
+        try:
+            while seq not in self._buffer:
+                try:
+                    value = (False, self._channel.recv(timeout=timeout))
+                except (ChannelClosed, ChannelTimeout):
+                    # nothing was consumed from the ring: re-raise without
+                    # advancing the sequence (a buffered timeout would
+                    # shift every later result by one)
+                    raise
+                except BaseException as e:  # noqa: BLE001
+                    value = (True, e)
+                self._buffer[self._next] = value
+                self._next += 1
+            is_exc, value = self._buffer.pop(seq)
+        finally:
+            self._lock.release()
+        if is_exc:
+            raise value
+        return value
+
+
+class CompiledDAGRef:
+    """Result handle for one execute() output; resolved via ray_tpu.get()
+    (api.get duck-types on _rt_dag_get) or .get()."""
+
+    def __init__(self, reader: _OutputReader, seq: int):
+        self._reader = reader
+        self._seq = seq
+
+    def get(self, timeout: Optional[float] = None) -> Any:
+        return self._reader.get(self._seq, timeout)
+
+    _rt_dag_get = get
+
+
+class ChannelPipeline:
+    """Driver-side handle: channels + per-actor loop tasks for one
+    compiled DAG."""
+
+    def __init__(self, root, slot_bytes: int, num_slots: int):
+        from ray_tpu.actor import ActorHandle, ActorMethod
+        from ray_tpu.dag import (ClassMethodNode, ClassNode, DAGNode,
+                                 InputAttributeNode, InputNode,
+                                 MultiOutputNode)
+
+        self._dag_id = uuid.uuid4().hex[:12]
+        self._slot_bytes = slot_bytes
+        self._seq = 0
+        self._channels: List[Channel] = []
+        self._loop_refs = []
+        self._torn_down = False
+        self._pump_error: Optional[BaseException] = None
+        self._input_queue: "queue.Queue" = queue.Queue()
+
+        outputs = (list(root._bound_args)
+                   if isinstance(root, MultiOutputNode) else [root])
+        # ---- collect stages (ClassMethodNodes) in dependency order
+        stages: List[ClassMethodNode] = []
+        seen: Dict[int, bool] = {}
+
+        def walk(node):
+            if id(node) in seen:
+                return
+            seen[id(node)] = True
+            if isinstance(node, (InputNode, InputAttributeNode)):
+                return
+            if isinstance(node, ClassNode):
+                return  # actor ctor args were resolved at warm time
+            if isinstance(node, ClassMethodNode):
+                for child in node._children():
+                    walk(child)
+                stages.append(node)
+                return
+            raise _Unsupported(f"node type {type(node).__name__}")
+
+        for out in outputs:
+            if not isinstance(out, ClassMethodNode):
+                raise _Unsupported("outputs must be actor method calls")
+            walk(out)
+        if not stages:
+            raise _Unsupported("no actor stages")
+        idx = {id(s): i for i, s in enumerate(stages)}
+
+        # one loop per actor: two stages sharing an actor would deadlock
+        # the ordered execution queue
+        handles = {}
+        for s in stages:
+            h = s._handle
+            if isinstance(h, ClassNode):
+                h = h._cached_handle
+            if h is None:
+                raise _Unsupported("actor not created")
+            if h._actor_id in handles:
+                raise _Unsupported("two stages on one actor")
+            handles[h._actor_id] = h
+
+        # ---- build edges
+        # stage arg spec: ("const", value) | ("chan", name)
+        def edge_name(kind: str, consumer: int, slot) -> str:
+            return f"{self._dag_id}:{kind}:{consumer}:{slot}"
+
+        self._input_feeds: List[Tuple[Channel, Any]] = []  # (chan, projector)
+        stage_specs: List[dict] = [
+            {"args": [], "kwargs": {}, "outs": []} for _ in stages]
+
+        def bind_arg(consumer: int, slot, value):
+            if isinstance(value, (InputNode, InputAttributeNode)):
+                name = edge_name("in", consumer, slot)
+                ch = Channel(name, create=True, slot_bytes=slot_bytes,
+                             num_slots=num_slots)
+                self._channels.append(ch)
+                projector = (value._project
+                             if isinstance(value, InputAttributeNode)
+                             else (lambda x: x))
+                self._input_feeds.append((ch, projector))
+                return ("chan", name)
+            if isinstance(value, ClassMethodNode):
+                name = edge_name("e", consumer, slot)
+                ch = Channel(name, create=True, slot_bytes=slot_bytes,
+                             num_slots=num_slots)
+                self._channels.append(ch)
+                stage_specs[idx[id(value)]]["outs"].append(name)
+                return ("chan", name)
+            if isinstance(value, DAGNode):
+                raise _Unsupported(f"arg node {type(value).__name__}")
+            return ("const", value)
+
+        for i, s in enumerate(stages):
+            for slot, a in enumerate(s._bound_args):
+                stage_specs[i]["args"].append(bind_arg(i, slot, a))
+            for k, v in s._bound_kwargs.items():
+                stage_specs[i]["kwargs"][k] = bind_arg(i, k, v)
+
+        # driver-facing output channels
+        self._readers: List[_OutputReader] = []
+        for j, out in enumerate(outputs):
+            name = edge_name("out", idx[id(out)], f"drv{j}")
+            ch = Channel(name, create=True, slot_bytes=slot_bytes,
+                         num_slots=num_slots)
+            self._channels.append(ch)
+            stage_specs[idx[id(out)]]["outs"].append(name)
+            self._readers.append(_OutputReader(ch))
+        self._multi_output = isinstance(root, MultiOutputNode)
+
+        # a stage with no channel inputs has nothing pacing its loop
+        for spec in stage_specs:
+            specs = list(spec["args"]) + list(spec["kwargs"].values())
+            if not any(s[0] == "chan" for s in specs):
+                raise _Unsupported("stage without channel inputs")
+
+        # ---- launch the per-actor loops
+        for s, spec in zip(stages, stage_specs):
+            h = s._handle
+            if isinstance(h, ClassNode):
+                h = h._cached_handle
+            self._loop_refs.append(
+                ActorMethod(h, "__rt_pipeline_loop__").remote(
+                    _stage_loop, s._method_name, spec["args"],
+                    spec["kwargs"], spec["outs"], slot_bytes))
+
+        # End-to-end bring-up handshake (see _stage_loop): seed READY into
+        # the input edges and require it back on every output edge. If any
+        # stage failed to attach (e.g. actor on another node, store down),
+        # this times out, we tear the channels down, and CompiledDAG falls
+        # back to the ref-chain path instead of handing out refs that
+        # would hang forever.
+        try:
+            for ch, _ in self._input_feeds:
+                ch.send_ready(timeout=_ATTACH_TIMEOUT_S)
+            for r in self._readers:
+                r._channel.recv_ready(timeout=_ATTACH_TIMEOUT_S + 5.0)
+        except Exception:
+            for ch in self._channels:
+                ch.close()
+            raise _Unsupported("pipeline bring-up handshake failed")
+
+        self._pump_thread = threading.Thread(
+            target=self._pump, name=f"rt-dag-pump-{self._dag_id}",
+            daemon=True)
+        self._pump_thread.start()
+
+    # -- public ---------------------------------------------------------------
+
+    _STOP = object()
+
+    def _pump(self):
+        """Feeds queued inputs into the driver→stage rings. Runs on its
+        own thread so execute() never blocks on ring backpressure — the
+        rings bound what's IN the pipeline, the queue holds the rest."""
+        while True:
+            item = self._input_queue.get()
+            if item is self._STOP:
+                break
+            for ch, projector in self._input_feeds:
+                try:
+                    ch.send(projector(item))
+                except Exception as e:  # noqa: BLE001
+                    self._pump_error = e
+                    return
+
+    def execute(self, *input_args, **input_kwargs):
+        if self._torn_down:
+            raise RuntimeError("pipeline torn down")
+        if self._pump_error is not None:
+            raise RuntimeError(
+                f"pipeline input feed failed: {self._pump_error!r}")
+        x = input_args[0] if input_args else None
+        self._input_queue.put(x)
+        seq = self._seq
+        self._seq += 1
+        refs = [CompiledDAGRef(r, seq) for r in self._readers]
+        return refs if self._multi_output else refs[0]
+
+    def teardown(self, timeout: float = 10.0) -> None:
+        if self._torn_down:
+            return
+        self._torn_down = True
+        import ray_tpu
+
+        self._input_queue.put(self._STOP)
+        self._pump_thread.join(timeout=timeout)
+        for ch, _ in self._input_feeds:
+            try:
+                ch.send_stop(timeout=1.0)
+            except Exception:  # noqa: BLE001
+                pass
+        try:
+            ray_tpu.wait(self._loop_refs, num_returns=len(self._loop_refs),
+                         timeout=timeout)
+        except Exception:  # noqa: BLE001
+            pass
+        for ch in self._channels:
+            ch.close()
+
+
+class _Unsupported(Exception):
+    pass

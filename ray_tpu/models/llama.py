@@ -215,25 +215,15 @@ def _attn_sublayer(x, params, positions, config: LlamaConfig, mesh=None,
     k = _rope(k, positions, c.rope_theta)
     new_cache = None
     if kv_cache is not None:
+        # Prefill path (decode S=1 goes through _attn_sublayer_decode):
+        # additive one-hot scatter at each row's offset (target slots are
+        # still zero in append-only generation) — a single MXU matmul
+        # over the padded block.
         k_cache, v_cache = kv_cache
-        if positions.shape[1] == 1:
-            # Decode (S=1): per-row scatter of one [kv,K] vector. A
-            # one-hot matmul add here would read+write the whole cache
-            # per layer per token; the scatter writes B rows and lets
-            # XLA update the donated cache in place.
-            b_idx = jnp.arange(positions.shape[0])
-            k_cache = k_cache.at[b_idx, positions[:, 0]].set(
-                k[:, 0].astype(k_cache.dtype), mode="drop")
-            v_cache = v_cache.at[b_idx, positions[:, 0]].set(
-                v[:, 0].astype(v_cache.dtype), mode="drop")
-        else:
-            # Prefill: additive one-hot scatter at each row's offset
-            # (target slots are still zero in append-only generation) —
-            # a single MXU matmul over the padded block.
-            t = k_cache.shape[1]
-            onehot = jax.nn.one_hot(positions, t, dtype=k.dtype)  # [B,S,T]
-            k_cache = k_cache + jnp.einsum("bst,bshk->bthk", onehot, k)
-            v_cache = v_cache + jnp.einsum("bst,bshk->bthk", onehot, v)
+        t = k_cache.shape[1]
+        onehot = jax.nn.one_hot(positions, t, dtype=k.dtype)  # [B,S,T]
+        k_cache = k_cache + jnp.einsum("bst,bshk->bthk", onehot, k)
+        v_cache = v_cache + jnp.einsum("bst,bshk->bthk", onehot, v)
         attn = _cached_attention(q, k_cache, v_cache, lengths, c)
         new_cache = (k_cache, v_cache)
     else:

@@ -390,6 +390,25 @@ class Executor:
         if spec.method_name == "__ray_terminate__":
             self.cw.exit_actor_process(intended=True)
             return {"status": "ok", "returns": []}
+        if spec.method_name == "__rt_pipeline_loop__":
+            # Compiled-DAG stage loop (dag/compiled_channels.py): args are
+            # (loop_fn, *loop_args); the loop gets the LIVE actor instance
+            # and runs until its channels close. It occupies the actor's
+            # ordered queue on purpose — a compiled pipeline dedicates its
+            # actors (reference: compiled_dag_node.py actor loops).
+            token = self.cw.enter_task_context(spec)
+            try:
+                if self.actor_instance is None:
+                    raise RuntimeError("actor instance not initialized")
+                args, kwargs = self._resolve_args(
+                    spec.args, getattr(spec, "kwarg_specs", {}) or {})
+                result = args[0](self.actor_instance, *args[1:], **kwargs)
+                return {"status": "ok",
+                        "returns": self._package_returns(spec, result)}
+            except BaseException as e:  # noqa: BLE001
+                return self._error_reply(spec, e)
+            finally:
+                self.cw.exit_task_context(token)
         caller = spec.owner_address.worker_id.binary() if spec.owner_address else b""
         creation = self._actor_spec
         ordered = creation is None or (
