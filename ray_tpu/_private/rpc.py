@@ -59,9 +59,24 @@ class EventLoopThread:
         self._started.wait()
 
     def _run(self):
+        import os
+        prof_dir = os.environ.get("RT_LOOP_PROFILE_DIR")
+        pr = None
+        if prof_dir:
+            # env-gated loop profiling (ray-tpu profile's in-process
+            # cousin): dump per-loop cProfile stats at loop stop
+            import cProfile
+
+            pr = cProfile.Profile()
+            pr.enable()
         asyncio.set_event_loop(self.loop)
         self.loop.call_soon(self._started.set)
         self.loop.run_forever()
+        if pr is not None:
+            pr.disable()
+            name = self._thread.name.replace("/", "_")
+            pr.dump_stats(os.path.join(
+                prof_dir, f"loop-{name}-{os.getpid()}.prof"))
 
     def run_coro(self, coro: Awaitable, timeout: Optional[float] = None):
         """Run a coroutine on the loop from another thread; block for result."""
@@ -325,6 +340,17 @@ class RpcClient:
         from ray_tpu._private.config import CONFIG
         t = timeout if timeout is not None else CONFIG.rpc_call_timeout_s
         return self._lt.run_coro(self.call_async(method, payload, timeout=t), timeout=t + 5)
+
+    def call_future(self, method: str, payload: Any = None,
+                    timeout: Optional[float] = None):
+        """Pipelined call: enqueue the request and return a
+        concurrent.futures.Future for the reply. The connection already
+        multiplexes by request id, so N calls in flight cost one round
+        trip of latency instead of N (burst actor registration relies on
+        this)."""
+        from ray_tpu._private.config import CONFIG
+        t = timeout if timeout is not None else CONFIG.rpc_call_timeout_s
+        return self._lt.submit(self.call_async(method, payload, timeout=t))
 
     def send(self, method: str, payload: Any = None):
         self._lt.run_coro(self.send_async(method, payload), timeout=10)

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import os
 import signal
 import sys
 import time
@@ -29,6 +30,29 @@ def run_worker(raylet_address: str, gcs_address: str, node_id: str,
 
     from ray_tpu._private.ids import NodeID
     from ray_tpu.worker.core_worker import CoreWorker
+
+    # RT_WORKER_PROFILE_DIR=<dir>: profile this worker and dump cProfile
+    # stats at (graceful) exit — how the zygote preimport set and the
+    # spawn hot path were measured (see workers/zygote.py). atexit runs
+    # on this same thread, so disable()/dump see a quiesced profiler
+    # (cProfile hooks are per-thread).
+    prof_dir = os.environ.get("RT_WORKER_PROFILE_DIR")
+    if prof_dir:
+        import atexit
+        import cProfile
+
+        _pr = cProfile.Profile()
+        _pr.enable()
+
+        def _dump():
+            try:
+                _pr.disable()
+                _pr.dump_stats(
+                    os.path.join(prof_dir, f"worker-{os.getpid()}.prof"))
+            except Exception:  # noqa: BLE001 — diagnostics only
+                pass
+
+        atexit.register(_dump)
 
     core_worker = CoreWorker(
         mode="worker",

@@ -67,9 +67,24 @@ def main():
     # cache. NOTHING here may start threads or event loops — fork() only
     # duplicates the calling thread, and a lock held elsewhere at fork
     # time would deadlock the child.
+    #
+    # The set below covers everything a worker touches through its first
+    # actor task (measured with RT_WORKER_PROFILE_DIR at 1k-actor scale:
+    # post-fork imports — plasma_provider, the ctypes store binding, the
+    # public ray_tpu surface that unpickled user classes reference — were
+    # ~40ms of compile per child because CI inherits
+    # PYTHONDONTWRITEBYTECODE=1).
+    import ray_tpu  # noqa: F401  (public surface: user code references it)
     import ray_tpu.worker.core_worker  # noqa: F401
     import ray_tpu.worker.executor  # noqa: F401
+    import ray_tpu.worker.memory_store  # noqa: F401
+    import ray_tpu.worker.plasma_provider  # noqa: F401
     import ray_tpu._private.serialization  # noqa: F401
+    from ray_tpu._private import shm_store
+
+    # dlopen the store binding once; children inherit the mapping (~7ms
+    # per worker otherwise)
+    shm_store.native_store_available()
 
     out = sys.stdout
     stdin_fd = sys.stdin.fileno()

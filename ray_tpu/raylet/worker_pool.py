@@ -17,6 +17,7 @@ import subprocess
 import sys
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
@@ -146,6 +147,10 @@ class WorkerHandle:
     # container reports its IN-CONTAINER pid, so registration matches on
     # this token (passed via RT_SPAWN_TOKEN) instead.
     spawn_token: str = ""
+    # True for fresh interpreter spawns (accelerator/container/zygote-down);
+    # False for zygote forks. Startup caps are per-mechanism: forks are
+    # ~ms-cheap, full boots are not.
+    direct_spawn: bool = True
 
 
 class WorkerPool:
@@ -171,7 +176,8 @@ class WorkerPool:
         self._workers: Dict[int, WorkerHandle] = {}  # pid -> handle
         self._registered: Dict[WorkerID, WorkerHandle] = {}
         self._pop_waiters = 0
-        self._waiters: List[asyncio.Future] = []
+        self._plain_waiters = 0
+        self._waiters: "deque[asyncio.Future]" = deque()
         self._monitor_task: Optional[asyncio.Task] = None
         self._closed = False
         # fork-server for plain workers (see workers/zygote.py)
@@ -201,10 +207,24 @@ class WorkerPool:
                    and not w.is_driver)
 
     # ----------------------------------------------------- zygote fork-server
-    def _worker_base_env(self) -> dict:
+    def _worker_base_env(self, needs_accelerator: bool = False) -> dict:
         env = dict(os.environ)
-        env.pop("PALLAS_AXON_POOL_IPS", None)
-        env["JAX_PLATFORMS"] = "cpu"
+        if not needs_accelerator:
+            # This host's sitecustomize registers the TPU PJRT plugin
+            # (and imports JAX, ~2s) in every python process when
+            # PALLAS_AXON_POOL_IPS is set. Plain workers don't need the
+            # accelerator; dropping the trigger keeps spawn latency low.
+            # JAX_PLATFORMS is forced (not setdefault): the host may
+            # export 'axon', which would fail without the plugin trigger.
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            env["JAX_PLATFORMS"] = "cpu"
+        # Let spawned processes cache bytecode: with the flag inherited
+        # from a CI environment, every direct-spawn worker re-parses the
+        # whole package (~40ms of compile per process at 1k-worker scale).
+        env.pop("PYTHONDONTWRITEBYTECODE", None)
+        # head-process diagnostics only: profiling every worker's loops
+        # would smother a busy host
+        env.pop("RT_LOOP_PROFILE_DIR", None)
         env.update(self._extra_env)
         env["RT_SYSTEM_CONFIG"] = CONFIG.serialized_overrides()
         return env
@@ -341,24 +361,10 @@ class WorkerPool:
         # spawns below.
         if (not needs_accelerator and not image_uri
                 and self._spawn_via_zygote(token, log_path, handle)):
+            handle.direct_spawn = False
             return
 
-        env = dict(os.environ)
-        if not needs_accelerator:
-            # This host's sitecustomize registers the TPU PJRT plugin (and
-            # imports JAX, ~2s) in every python process when
-            # PALLAS_AXON_POOL_IPS is set. Plain workers don't need the
-            # accelerator; dropping the trigger keeps spawn latency ~100ms.
-            # Leases whose task demands a `TPU` resource get a dedicated
-            # worker spawned with the accelerator env preserved.
-            env.pop("PALLAS_AXON_POOL_IPS", None)
-            # Force, don't setdefault: the host env may export
-            # JAX_PLATFORMS=axon (TPU plugin), but we just stripped the
-            # plugin trigger — a worker inheriting 'axon' would die on its
-            # first jax import ("backend 'axon' not in the list").
-            env["JAX_PLATFORMS"] = "cpu"
-        env.update(self._extra_env)
-        env["RT_SYSTEM_CONFIG"] = CONFIG.serialized_overrides()
+        env = self._worker_base_env(needs_accelerator)
         env["RT_SPAWN_TOKEN"] = token
         # Keep worker start light: no JAX/accelerator init at import time.
         cmd = [
@@ -452,7 +458,20 @@ class WorkerPool:
         handle.state = "idle"
         handle.idle_since = time.monotonic()
         self._registered[worker_id] = handle
-        self._wake_waiters()
+        self._wake_waiters(n=1, needs_accelerator=handle.needs_accelerator)
+        # Demand-driven replenish: under a lease burst, keep the zygote
+        # spawn pipeline at depth without routing the decision through
+        # another waiter wakeup. Counts PLAIN waiters only — accelerator
+        # and container waiters cannot use a pristine plain worker, so
+        # spawning for them here would fill the pool with workers nobody
+        # claims and starve their own direct spawns.
+        if self._zygote_eligible(False, None):
+            z_starting, _, dp_starting = self._starting_by_mechanism()
+            if (self._plain_waiters > z_starting
+                    and z_starting < self._startup_cap(False)
+                    and dp_starting < self._startup_cap(True)
+                    and self.num_poolable < self._max_workers):
+                self._spawn()
         return True
 
     def register_driver(self, worker_id: WorkerID, pid: int, address: Address):
@@ -463,11 +482,70 @@ class WorkerPool:
         self._workers[pid] = handle
         self._registered[worker_id] = handle
 
-    def _wake_waiters(self):
-        waiters, self._waiters = self._waiters, []
-        for fut in waiters:
-            if not fut.done():
-                fut.set_result(None)
+    def _wake_waiters(self, n: Optional[int] = None,
+                      needs_accelerator: Optional[bool] = None):
+        """Wake up to `n` LIVE pop_worker() waiters (all when n is None).
+
+        Events that free ONE worker wake ONE waiter: waking everyone made
+        a 1k-actor burst quadratic (every registration re-ran every
+        waiter's O(workers) idle scan). Futures already done (timed-out
+        waiters that will re-loop on their own) are skipped so a wakeup
+        is never wasted on them. With `needs_accelerator` given, the
+        wakeup targets a waiter whose flavor can actually CLAIM the
+        freed worker (image waiters never claim pristine workers) —
+        mismatched waiters are left queued rather than burning the
+        wakeup; the pop_worker poll remains the fairness backstop."""
+        if n is None:
+            entries, self._waiters = self._waiters, deque()
+            for fut, _, _ in entries:
+                if not fut.done():
+                    fut.set_result(None)
+            return
+        skipped = []
+        while n > 0 and self._waiters:
+            fut, accel, has_image = self._waiters.popleft()
+            if fut.done():
+                continue
+            if needs_accelerator is not None and (
+                    accel != needs_accelerator or has_image):
+                skipped.append((fut, accel, has_image))
+                continue
+            fut.set_result(None)
+            n -= 1
+        for entry in reversed(skipped):
+            self._waiters.appendleft(entry)
+
+    def _startup_cap(self, direct: bool) -> int:
+        """Per-mechanism startup concurrency: zygote forks are ~ms-cheap
+        and keep a deep pipeline; direct spawns (accelerator/container/
+        zygote-down) pay a full interpreter boot each and keep the small
+        cap so a burst cannot thrash the host."""
+        if CONFIG.worker_maximum_startup_concurrency:
+            return CONFIG.worker_maximum_startup_concurrency
+        base = max(4, os.cpu_count() or 4)
+        return base if direct else max(base, 16)
+
+    def _zygote_eligible(self, needs_accelerator: bool,
+                         image_uri: Optional[str]) -> bool:
+        return (not needs_accelerator and not image_uri
+                and CONFIG.enable_worker_zygote
+                and self._zygote_failures < 3)
+
+    def _starting_by_mechanism(self):
+        """-> (zygote_starting, direct_starting, direct_plain_starting).
+        The last term counts full-interpreter boots of PLAIN workers —
+        i.e. zygote-fallback spawns — which plain waiters must brake on
+        even while the zygote looks eligible."""
+        z = d = dp = 0
+        for w in self._workers.values():
+            if w.state == "starting":
+                if w.direct_spawn:
+                    d += 1
+                    if not w.needs_accelerator:
+                        dp += 1
+                else:
+                    z += 1
+        return z, d, dp
 
     def _num_starting(self, needs_accelerator: bool,
                       env_hash: Optional[str] = None) -> int:
@@ -492,6 +570,9 @@ class WorkerPool:
         outside the image — so they wait for a dedicated container spawn."""
         deadline = time.monotonic() + timeout
         self._pop_waiters = getattr(self, "_pop_waiters", 0) + 1
+        plain = not needs_accelerator and not image_uri
+        if plain:
+            self._plain_waiters += 1
         try:
             while not self._closed:
                 pristine = None
@@ -511,14 +592,22 @@ class WorkerPool:
                     claimed.state = "leased"
                     return claimed
                 spawn_filter = env_hash if image_uri else None
-                startup_cap = (CONFIG.worker_maximum_startup_concurrency
-                               or max(4, os.cpu_count() or 4))
+                direct = not self._zygote_eligible(
+                    needs_accelerator, image_uri)
+                z_starting, d_starting, dp_starting = (
+                    self._starting_by_mechanism())
+                starting = d_starting if direct else z_starting
                 if (
                     self.num_poolable < self._max_workers
                     and self._num_starting(needs_accelerator, spawn_filter)
                     < self._pop_waiters
-                    and sum(1 for w in self._workers.values()
-                            if w.state == "starting") < startup_cap
+                    and starting < self._startup_cap(direct)
+                    # brake on zygote-FALLBACK boots: a wobbling zygote
+                    # makes _spawn fall back to full interpreter boots,
+                    # which must never exceed the direct pipeline depth
+                    # (accelerator/container boots gate themselves above)
+                    and (direct
+                         or dp_starting < self._startup_cap(True))
                 ):
                     self._spawn(needs_accelerator, image_uri=image_uri,
                                 env_hash=env_hash)
@@ -526,14 +615,23 @@ class WorkerPool:
                 if remaining <= 0:
                     return None
                 fut = self._loop.create_future()
-                self._waiters.append(fut)
+                self._waiters.append(
+                    (fut, needs_accelerator, bool(image_uri)))
                 try:
-                    await asyncio.wait_for(fut, min(remaining, 0.5))
+                    # 2s fairness backstop: waiters are woken individually
+                    # as workers free up; a short poll here made 1k
+                    # concurrent lease waiters re-scan the pool twice a
+                    # second each (quadratic at burst scale). A timed-out
+                    # waiter leaves a done future behind; _wake_waiters
+                    # skips those, so wakeups are never lost to them.
+                    await asyncio.wait_for(fut, min(remaining, 2.0))
                 except asyncio.TimeoutError:
                     pass
             return None
         finally:
             self._pop_waiters -= 1
+            if plain:
+                self._plain_waiters -= 1
 
     def return_worker(self, worker_id: WorkerID, disconnect: bool = False):
         handle = self._registered.get(worker_id)
@@ -544,7 +642,7 @@ class WorkerPool:
             return
         handle.state = "idle"
         handle.idle_since = time.monotonic()
-        self._wake_waiters()
+        self._wake_waiters(n=1, needs_accelerator=handle.needs_accelerator)
 
     def mark_actor_worker(self, worker_id: WorkerID, actor_id):
         handle = self._registered.get(worker_id)

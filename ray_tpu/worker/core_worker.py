@@ -48,6 +48,7 @@ from ray_tpu._private.rpc import (
 )
 from ray_tpu._private.specs import (
     ActorCreationSpec,
+    ActorInfo,
     ActorState,
     Address,
     PlacementGroupSpec,
@@ -1481,16 +1482,57 @@ class CoreWorker:
             runtime_env=runtime_env,
         )
         spec.kwarg_specs = kwarg_specs
-        reply = self._gcs.call("register_actor", {"spec": spec, "get_if_exists": get_if_exists})
-        if reply["status"] == "error":
-            raise ValueError(reply["message"])
-        info = reply["info"]
+        if name or get_if_exists:
+            # named path stays synchronous: the reply decides between
+            # "use the existing actor" and a name-conflict error
+            reply = self._gcs.call(
+                "register_actor",
+                {"spec": spec, "get_if_exists": get_if_exists})
+            if reply["status"] == "error":
+                raise ValueError(reply["message"])
+            registered_id = reply["info"].actor_id
+        else:
+            # Unnamed actors register PIPELINED (reference: CreateActor's
+            # GCS registration is async, core_worker.cc:2224): the
+            # request is enqueued and .remote() returns immediately, so a
+            # burst of N creations pays one round trip of latency, not N.
+            # A lost registration (GCS blip) retries once, then marks the
+            # local record DEAD so queued method calls fail with
+            # ActorDiedError instead of hanging.
+            def _register(attempt: int = 0):
+                fut = self._gcs.call_future(
+                    "register_actor",
+                    {"spec": spec, "get_if_exists": False})
+
+                def _on_reply(f, aid=actor_id):
+                    err = f.exception()
+                    if err is None:
+                        return
+                    if attempt == 0:
+                        logger.warning(
+                            "actor %s registration failed (%s); retrying",
+                            aid, err)
+                        self._gcs._lt.loop.call_later(
+                            0.5, lambda: _register(1))
+                        return
+                    logger.warning(
+                        "actor %s registration failed permanently: %s",
+                        aid, err)
+                    dead = ActorInfo(
+                        actor_id=aid, state=ActorState.DEAD,
+                        death_cause=f"actor registration failed: {err}")
+                    asyncio.ensure_future(self._on_actor_event_async(dead))
+
+                fut.add_done_callback(_on_reply)
+
+            _register()
+            registered_id = actor_id
         rec = self._actors.setdefault(
-            info.actor_id, _ActorRecord(actor_id=info.actor_id)
+            registered_id, _ActorRecord(actor_id=registered_id)
         )
         rec.max_task_retries = max_task_retries
         self._ensure_actor_subscription()
-        return info.actor_id
+        return registered_id
 
     def _on_worker_logs(self, key, batch):
         """LOG channel: print worker output on the driver console (only

@@ -14,6 +14,7 @@ from ray_tpu.workflow.api import (  # noqa: F401
     get_output,
     get_status,
     list_all,
+    options,
     resume,
     resume_all,
     run,
@@ -27,6 +28,7 @@ __all__ = [
     "get_output",
     "get_status",
     "list_all",
+    "options",
     "resume",
     "resume_all",
     "run",

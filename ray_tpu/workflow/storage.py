@@ -61,14 +61,21 @@ class WorkflowStorage:
         return os.path.exists(os.path.join(self.dir, f"step_{step_id}.pkl"))
 
     def save_step_result(self, step_id: str, result: Any) -> None:
+        # cloudpickle via the framework serializer: step results can hold
+        # dynamically generated classes (e.g. RayTaskError(ValueError)
+        # pairs from catch_exceptions steps) that plain pickle rejects
+        from ray_tpu._private import serialization as ser
+
         tmp = os.path.join(self.dir, f".step_{step_id}.tmp")
         with open(tmp, "wb") as f:
-            pickle.dump(result, f)
+            f.write(ser.dumps_function(result))
         os.replace(tmp, os.path.join(self.dir, f"step_{step_id}.pkl"))
 
     def load_step_result(self, step_id: str) -> Any:
+        from ray_tpu._private import serialization as ser
+
         with open(os.path.join(self.dir, f"step_{step_id}.pkl"), "rb") as f:
-            return pickle.load(f)
+            return ser.loads_function(f.read())
 
     def delete(self) -> None:
         shutil.rmtree(self.dir, ignore_errors=True)

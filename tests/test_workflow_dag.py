@@ -114,6 +114,81 @@ def test_workflow_list_delete(ray_start_regular, wf_storage):
     assert all(w != "wlist" for w, _ in workflow.list_all())
 
 
+def test_workflow_branches_run_concurrently(ray_start_regular, wf_storage):
+    """Diamond DAG: the two independent branches must overlap in
+    wall-clock (the executor submits every ready step, not a post-order
+    walk)."""
+    import time
+
+    @ray_tpu.remote
+    def slow(x):
+        time.sleep(1.0)
+        return x
+
+    @ray_tpu.remote
+    def join(a, b):
+        return a + b
+
+    dag = join.bind(slow.bind(1), slow.bind(2))
+    t0 = time.perf_counter()
+    assert workflow.run(dag, workflow_id="wconc") == 3
+    dt = time.perf_counter() - t0
+    # sequential would be >= 2s; concurrent ~1s plus overhead
+    assert dt < 1.9, f"branches ran sequentially ({dt:.2f}s)"
+
+
+def test_workflow_diamond_shared_step_runs_once(ray_start_regular,
+                                                wf_storage, tmp_path):
+    cnt = str(tmp_path / "shared_count")
+
+    @ray_tpu.remote
+    def counted(x):
+        n = int(open(cnt).read()) if os.path.exists(cnt) else 0
+        open(cnt, "w").write(str(n + 1))
+        return x * 2
+
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    shared = counted.bind(10)
+    dag = add.bind(shared, shared)  # diamond: shared feeds both args
+    assert workflow.run(dag, workflow_id="wdiamond") == 40
+    assert int(open(cnt).read()) == 1, "shared step executed twice"
+
+
+def test_workflow_catch_exceptions(ray_start_regular, wf_storage):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("nope")
+
+    @ray_tpu.remote
+    def handle(pair):
+        value, err = pair
+        return "fallback" if err is not None else value
+
+    dag = handle.bind(
+        boom.options(**workflow.options(catch_exceptions=True)).bind())
+    assert workflow.run(dag, workflow_id="wcatch") == "fallback"
+    assert workflow.get_status("wcatch") == "SUCCESSFUL"
+
+
+def test_workflow_step_max_retries(ray_start_regular, wf_storage, tmp_path):
+    marker = str(tmp_path / "attempts")
+
+    @ray_tpu.remote
+    def flaky():
+        n = int(open(marker).read()) if os.path.exists(marker) else 0
+        open(marker, "w").write(str(n + 1))
+        if n < 2:
+            raise RuntimeError(f"attempt {n} fails")
+        return "ok"
+
+    dag = flaky.options(**workflow.options(max_retries=3)).bind()
+    assert workflow.run(dag, workflow_id="wretry") == "ok"
+    assert int(open(marker).read()) == 3  # 2 failures + 1 success
+
+
 def test_dag_input_attribute_node(ray_start_regular):
     import ray_tpu
     from ray_tpu.dag import InputNode

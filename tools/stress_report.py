@@ -131,7 +131,7 @@ def envelope() -> dict:
 
 
 def main():
-    out_path = sys.argv[1] if len(sys.argv) > 1 else "STRESS_r03.json"
+    out_path = sys.argv[1] if len(sys.argv) > 1 else "STRESS_r04.json"
     report = {
         "generated_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "host": {"cores": os.cpu_count(),
