@@ -53,6 +53,13 @@ class GcsActorManager:
         name = creation.name
         namespace = creation.namespace or ""
         async with self._lock:
+            # Idempotent: actor ids are client-generated, so a retried
+            # registration (lost reply / timeout on a pipelined register)
+            # must NOT re-schedule — rerunning __init__ in a second
+            # worker would double side effects and leak a lease.
+            existing = self._actors.get(creation.actor_id)
+            if existing is not None:
+                return {"status": "registered", "info": existing}
             if name:
                 existing_id = self._named.get((namespace, name))
                 if existing_id is not None:

@@ -458,7 +458,8 @@ class WorkerPool:
         handle.state = "idle"
         handle.idle_since = time.monotonic()
         self._registered[worker_id] = handle
-        self._wake_waiters(n=1, needs_accelerator=handle.needs_accelerator)
+        self._wake_waiters(n=1, needs_accelerator=handle.needs_accelerator,
+                           env_hash=handle.env_hash)
         # Demand-driven replenish: under a lease burst, keep the zygote
         # spawn pipeline at depth without routing the decision through
         # another waiter wakeup. Counts PLAIN waiters only — accelerator
@@ -483,32 +484,46 @@ class WorkerPool:
         self._registered[worker_id] = handle
 
     def _wake_waiters(self, n: Optional[int] = None,
-                      needs_accelerator: Optional[bool] = None):
+                      needs_accelerator: Optional[bool] = None,
+                      env_hash: Optional[str] = None):
         """Wake up to `n` LIVE pop_worker() waiters (all when n is None).
 
         Events that free ONE worker wake ONE waiter: waking everyone made
         a 1k-actor burst quadratic (every registration re-ran every
         waiter's O(workers) idle scan). Futures already done (timed-out
         waiters that will re-loop on their own) are skipped so a wakeup
-        is never wasted on them. With `needs_accelerator` given, the
-        wakeup targets a waiter whose flavor can actually CLAIM the
-        freed worker (image waiters never claim pristine workers) —
-        mismatched waiters are left queued rather than burning the
-        wakeup; the pop_worker poll remains the fairness backstop."""
+        is never wasted on them. With a flavor (`needs_accelerator` +
+        `env_hash` of the freed worker) given, the wakeup targets a
+        waiter that can actually CLAIM it — plain waiters claim pristine
+        or same-env workers, image waiters only their own env's
+        container worker; mismatched waiters are left queued rather than
+        burning the wakeup, with the pop_worker poll as the fairness
+        backstop."""
         if n is None:
             entries, self._waiters = self._waiters, deque()
-            for fut, _, _ in entries:
-                if not fut.done():
-                    fut.set_result(None)
+            for entry in entries:
+                if not entry[0].done():
+                    entry[0].set_result(None)
             return
+
+        def matches(accel: bool, has_image: bool, want_env: str) -> bool:
+            if needs_accelerator is None:
+                return True
+            if accel != needs_accelerator:
+                return False
+            worker_env = env_hash or ""
+            if has_image:
+                return worker_env == want_env
+            return worker_env in ("", want_env)
+
         skipped = []
         while n > 0 and self._waiters:
-            fut, accel, has_image = self._waiters.popleft()
+            entry = self._waiters.popleft()
+            fut, accel, has_image, want_env = entry
             if fut.done():
                 continue
-            if needs_accelerator is not None and (
-                    accel != needs_accelerator or has_image):
-                skipped.append((fut, accel, has_image))
+            if not matches(accel, has_image, want_env):
+                skipped.append(entry)
                 continue
             fut.set_result(None)
             n -= 1
@@ -616,7 +631,7 @@ class WorkerPool:
                     return None
                 fut = self._loop.create_future()
                 self._waiters.append(
-                    (fut, needs_accelerator, bool(image_uri)))
+                    (fut, needs_accelerator, bool(image_uri), env_hash))
                 try:
                     # 2s fairness backstop: waiters are woken individually
                     # as workers free up; a short poll here made 1k
@@ -642,7 +657,8 @@ class WorkerPool:
             return
         handle.state = "idle"
         handle.idle_since = time.monotonic()
-        self._wake_waiters(n=1, needs_accelerator=handle.needs_accelerator)
+        self._wake_waiters(n=1, needs_accelerator=handle.needs_accelerator,
+                           env_hash=handle.env_hash)
 
     def mark_actor_worker(self, worker_id: WorkerID, actor_id):
         handle = self._registered.get(worker_id)

@@ -10,6 +10,15 @@ metrics).
 The controller is a plain threaded actor: a daemon reconcile thread runs
 ~5Hz. Replica gangs per deployment; handles are served to routers from the
 live-replica table.
+
+Routers learn about replica-set changes through LONG-POLL PUSH
+(reference: serve/_private/long_poll.py:173 LongPollHost): they park a
+`listen_for_change(key, last_version)` call on the controller, which
+returns the moment the key's version moves (replica started/stopped/
+health flip) — scale-downs reach routers in one RPC latency instead of a
+poll interval. Replies piggyback the controller's latest per-replica
+ongoing-request counts so routers never probe queue lengths on the
+request path.
 """
 
 from __future__ import annotations
@@ -60,6 +69,12 @@ class ServeController:
         self._deployments: Dict[str, _DeploymentState] = {}
         self._apps: Dict[str, Dict[str, Any]] = {}
         self._lock = threading.RLock()
+        # long-poll state: per-deployment change versions + parked waiters
+        self._versions: Dict[str, int] = {}
+        self._change_cv = threading.Condition()
+        # replica_id -> last reported num_ongoing_requests (piggybacked
+        # to routers on long-poll replies)
+        self._replica_metrics: Dict[str, int] = {}
         self._shutdown = threading.Event()
         self._reconcile_thread = threading.Thread(
             target=self._run_control_loop, name="serve-controller",
@@ -119,6 +134,37 @@ class ServeController:
                 if state:
                     for r in state.replicas:
                         self._stop_replica(r)
+                    self._bump(state.full_name)
+
+    def _bump(self, key: str) -> None:
+        """Mark `key`'s replica set changed; wakes parked long-polls."""
+        with self._change_cv:
+            self._versions[key] = self._versions.get(key, 0) + 1
+            self._change_cv.notify_all()
+
+    def listen_for_change(self, key: str, last_version: int,
+                          timeout: float = 30.0) -> Dict[str, Any]:
+        """Long-poll endpoint: parks until the deployment's replica set
+        changes from `last_version` (or timeout), then returns the fresh
+        snapshot. key = "<app>#<deployment>"."""
+        deadline = time.monotonic() + timeout
+        with self._change_cv:
+            while (self._versions.get(key, 0) == last_version
+                   and not self._shutdown.is_set()):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._change_cv.wait(remaining)
+            version = self._versions.get(key, 0)
+        with self._lock:
+            state = self._deployments.get(key)
+            replicas = ([(r.replica_id, r.handle)
+                         for r in state.replicas if r.healthy]
+                        if state is not None else [])
+            metrics = {rid: self._replica_metrics.get(rid, 0)
+                       for rid, _ in replicas}
+        return {"version": version, "replicas": replicas,
+                "metrics": metrics}
 
     def get_replica_handles(self, app_name: str,
                             deployment_name: str) -> List[Any]:
@@ -152,6 +198,8 @@ class ServeController:
 
     def shutdown(self) -> None:
         self._shutdown.set()
+        with self._change_cv:
+            self._change_cv.notify_all()
         with self._lock:
             for state in self._deployments.values():
                 for r in state.replicas:
@@ -191,6 +239,8 @@ class ServeController:
                 self._stop_replica(r)
                 with self._lock:
                     state.replicas.remove(r)
+            if dead:
+                self._bump(state.full_name)
             for _ in range(max(0, to_start)):
                 self._start_replica(state)
             if to_start < 0:
@@ -200,6 +250,8 @@ class ServeController:
                         state.replicas.remove(r)
                 for r in excess:
                     self._stop_replica(r)
+                if excess:
+                    self._bump(state.full_name)
 
     def _start_replica(self, state: _DeploymentState) -> None:
         cfg = state.config
@@ -222,6 +274,7 @@ class ServeController:
                 handle.reconfigure.remote(cfg["user_config"])
             with self._lock:
                 state.replicas.append(_ReplicaState(handle, replica_id))
+            self._bump(state.full_name)
         except Exception:  # noqa: BLE001
             logger.exception("failed to start replica for %s",
                              state.full_name)
@@ -244,25 +297,48 @@ class ServeController:
             except Exception:  # noqa: BLE001 — mark dead, reconcile restarts
                 logger.warning("replica %s failed health check",
                                replica.replica_id)
-                replica.healthy = False
+                if replica.healthy:
+                    replica.healthy = False
+                    self._bump(state.full_name)
 
     def _autoscale(self) -> None:
         """Default policy (reference: serve/autoscaling_policy.py:12):
-        target = ceil(total_ongoing / target_ongoing_requests), clamped."""
+        target = ceil(total_ongoing / target_ongoing_requests), clamped.
+        The per-replica ongoing counts are also cached for the long-poll
+        metrics piggyback (probe-free routing). Metric RPCs fan out and
+        are harvested with ONE bounded wait so a single wedged replica
+        cannot stall the control loop 2s at a time."""
         with self._lock:
-            states = [s for s in self._deployments.values() if s.autoscaling]
-        for state in states:
-            cfg = state.autoscaling
-            total = 0
-            for r in list(state.replicas):
-                if not r.healthy:
+            all_states = list(self._deployments.values())
+            probes = [(s, r, r.handle.get_metrics.remote())
+                      for s in all_states
+                      for r in s.replicas if r.healthy]
+        ongoing: Dict[str, int] = {}
+        if probes:
+            refs = [ref for _, _, ref in probes]
+            try:
+                done, _ = ray_tpu.wait(refs, num_returns=len(refs),
+                                       timeout=2.0)
+            except Exception:  # noqa: BLE001
+                done = []
+            done_set = set(done)
+            for _, r, ref in probes:
+                if ref not in done_set:
                     continue
                 try:
-                    m = ray_tpu.get(r.handle.get_metrics.remote(),
-                                    timeout=2.0)
-                    total += m["num_ongoing_requests"]
+                    m = ray_tpu.get(ref, timeout=0.1)
+                    ongoing[r.replica_id] = m["num_ongoing_requests"]
                 except Exception:  # noqa: BLE001
                     pass
+        live_ids = {r.replica_id for s in all_states for r in s.replicas}
+        self._replica_metrics = {
+            rid: n for rid, n in {**self._replica_metrics, **ongoing}.items()
+            if rid in live_ids}  # prune churned replicas: no slow leak
+        states = [s for s in all_states if s.autoscaling]
+        for state in states:
+            cfg = state.autoscaling
+            total = sum(ongoing.get(r.replica_id, 0)
+                        for r in list(state.replicas) if r.healthy)
             target_per = cfg.get("target_ongoing_requests", 2)
             desired = math.ceil(total / max(target_per, 1)) if total else \
                 cfg.get("min_replicas", 1)

@@ -165,7 +165,7 @@ def _ensure_grpc_proxy(grpc_options: Optional[dict] = None):
         opts = grpc_options or {}
         actor = ray_tpu.remote(GrpcProxyActor).options(
             name="SERVE_GRPC_PROXY", lifetime="detached", num_cpus=0.1,
-            get_if_exists=True, max_concurrency=64,
+            get_if_exists=True, max_concurrency=256,
         ).remote(host=opts.get("host", "127.0.0.1"),
                  port=opts.get("port", 9000))
         port = ray_tpu.get(actor.ready.remote())
@@ -182,7 +182,7 @@ def _ensure_proxy(http_options: Optional[dict] = None):
         opts = http_options or {}
         _proxy = ray_tpu.remote(ProxyActor).options(
             name="SERVE_PROXY", lifetime="detached", num_cpus=0.1,
-            get_if_exists=True, max_concurrency=64,
+            get_if_exists=True, max_concurrency=256,
         ).remote(host=opts.get("host", "127.0.0.1"),
                  port=opts.get("port", 8000))
         ray_tpu.get(_proxy.ready.remote())

@@ -32,7 +32,7 @@ def get_controller(create: bool = False):
 
         _controller = ray_tpu.remote(ServeController).options(
             name=CONTROLLER_NAME, lifetime="detached", num_cpus=0.1,
-            max_concurrency=32,
+            max_concurrency=256,
         ).remote()
         ray_tpu.get(_controller.ping.remote())
         return _controller

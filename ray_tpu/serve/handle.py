@@ -14,17 +14,34 @@ import ray_tpu
 class DeploymentResponse:
     """Future for a deployment request (awaitable via .result())."""
 
-    def __init__(self, ref):
+    def __init__(self, ref, router=None):
         self._ref = ref
+        self._router = router
+
+    def _done(self):
+        # releases the router's in-flight charge (probe-free load signal)
+        if self._router is not None:
+            self._router.notify_done(self._ref)
+            self._router = None
 
     def result(self, timeout_s: Optional[float] = None) -> Any:
-        return ray_tpu.get(self._ref, timeout=timeout_s)
+        try:
+            return ray_tpu.get(self._ref, timeout=timeout_s)
+        finally:
+            self._done()
 
     def _to_object_ref(self):
+        # composed into another deployment's args: the downstream replica
+        # resolves it; release the charge here
+        self._done()
         return self._ref
 
     def __await__(self):
-        return self._ref.__await__()
+        try:
+            result = yield from self._ref.__await__()
+        finally:
+            self._done()
+        return result
 
 
 class DeploymentResponseGenerator:
@@ -61,11 +78,11 @@ class DeploymentHandle:
 
     def _get_router(self):
         if self._router is None:
-            from ray_tpu.serve._private.router import Router
+            from ray_tpu.serve._private.router import shared_router
             from ray_tpu.serve.context import get_controller
 
             controller = self._controller or get_controller()
-            self._router = Router(
+            self._router = shared_router(
                 controller, self.deployment_name, self.app_name)
         return self._router
 
@@ -93,9 +110,9 @@ class DeploymentHandle:
             gen = self._get_router().assign_request_streaming(
                 self._method_name, args, kwargs)
             return DeploymentResponseGenerator(gen)
-        ref = self._get_router().assign_request(
-            self._method_name, args, kwargs)
-        return DeploymentResponse(ref)
+        router = self._get_router()
+        ref = router.assign_request(self._method_name, args, kwargs)
+        return DeploymentResponse(ref, router)
 
     def __reduce__(self):
         return (DeploymentHandle,
