@@ -132,6 +132,7 @@ class RpcServer:
         self._server: Optional[asyncio.AbstractServer] = None
         self.address: Optional[str] = None
         self._conn_lost_cb: Optional[Callable] = None
+        self._conn_writers: set = set()
 
     def register(self, method: str, handler: Callable[[Any], Awaitable[Any]]):
         self._handlers[method] = handler
@@ -162,7 +163,23 @@ class RpcServer:
         async def _stop():
             if self._server is not None:
                 self._server.close()
-                await self._server.wait_closed()
+            # Close ESTABLISHED connections too — BEFORE wait_closed():
+            # Server.close() only stops the listener, and since 3.12
+            # wait_closed() blocks until every connection handler exits,
+            # so it must come after the writers are closed. Without this,
+            # clients keep writing into zombie connections forever (a
+            # restarted server at the same address never hears from them).
+            for w in list(self._conn_writers):
+                try:
+                    w.close()
+                except Exception:  # noqa: BLE001
+                    pass
+            await asyncio.sleep(0.05)  # let the transports flush FINs
+            if self._server is not None:
+                try:
+                    await asyncio.wait_for(self._server.wait_closed(), 1.0)
+                except asyncio.TimeoutError:
+                    pass
 
         try:
             self._lt.run_coro(_stop(), timeout=2.0)
@@ -172,6 +189,7 @@ class RpcServer:
     async def _handle_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         peer_meta: Dict[str, Any] = {}
         write_lock = asyncio.Lock()
+        self._conn_writers.add(writer)
         try:
             while True:
                 msg = await _read_frame(reader)
@@ -198,6 +216,7 @@ class RpcServer:
         except Exception:
             logger.exception("rpc server connection error")
         finally:
+            self._conn_writers.discard(writer)
             try:
                 writer.close()
             except Exception:
@@ -260,6 +279,20 @@ class RpcClient:
                 return
             host, port = parse_addr(self.address)
             self._reader, self._writer = await asyncio.open_connection(host, port)
+            sock = self._writer.get_extra_info("socket")
+            if sock is not None:
+                # detect silently-dead peers (killed process, lost host) in
+                # ~9s: idle 3s, then 3 probes 2s apart
+                try:
+                    sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
+                    for opt, val in (("TCP_KEEPIDLE", 3),
+                                     ("TCP_KEEPINTVL", 2),
+                                     ("TCP_KEEPCNT", 3)):
+                        if hasattr(socket, opt):  # Linux names; mac differs
+                            sock.setsockopt(socket.IPPROTO_TCP,
+                                            getattr(socket, opt), val)
+                except OSError:
+                    pass
             asyncio.ensure_future(self._read_loop(self._reader))
             if self._peer_meta:
                 await self._call_async_locked("_register_peer", self._peer_meta)

@@ -26,8 +26,10 @@ class Cluster:
         head_node_args: Optional[dict] = None,
         connect: bool = False,
         namespace: str = "",
+        gcs_storage_path: str = "",
     ):
-        self.gcs = GcsServer()
+        self._gcs_storage_path = gcs_storage_path
+        self.gcs = GcsServer(storage_path=gcs_storage_path)
         self.gcs_address = self.gcs.start(0)
         self.raylets: List[Raylet] = []
         self.head_node: Optional[Raylet] = None
@@ -73,6 +75,31 @@ class Cluster:
 
         ray_tpu.init(address=self.gcs_address, namespace=namespace)
         self._connected = True
+
+    def kill_gcs(self) -> None:
+        """Stop the GCS process (HA chaos path). Raylets keep running and
+        retry their heartbeats; call restart_gcs() to bring a new GCS
+        incarnation up at the SAME address from persisted state."""
+        self.gcs.stop()
+
+    def restart_gcs(self) -> None:
+        """Start a fresh GCS at the previous address from the persisted
+        append-log store (requires gcs_storage_path). Raylets re-register
+        on their next heartbeat; subscriptions and actor/PG/job/KV tables
+        reload from storage."""
+        if not self._gcs_storage_path:
+            raise ValueError("restart_gcs needs gcs_storage_path")
+        port = int(self.gcs_address.rsplit(":", 1)[1])
+        self.gcs = GcsServer(storage_path=self._gcs_storage_path)
+        deadline = time.monotonic() + 10.0
+        while True:
+            try:
+                self.gcs.start(port)
+                break
+            except Exception:  # noqa: BLE001 — port still in TIME_WAIT
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.2)
 
     def remove_node(self, raylet: Raylet, allow_graceful: bool = True):
         """Kill a node. allow_graceful=False skips GCS unregistration so death

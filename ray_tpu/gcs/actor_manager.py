@@ -31,11 +31,13 @@ logger = logging.getLogger(__name__)
 
 
 class GcsActorManager:
-    def __init__(self, node_view, publisher: ps.Publisher, client_pool: ClientPool):
+    def __init__(self, node_view, publisher: ps.Publisher,
+                 client_pool: ClientPool, store=None):
         # node_view: GcsNodeManager (cluster resource view + raylet addresses)
         self._nodes = node_view
         self._pub = publisher
         self._pool = client_pool
+        self._store = store
         self._actors: Dict[ActorID, ActorInfo] = {}
         self._creation_specs: Dict[ActorID, TaskSpec] = {}
         # (namespace, name) -> actor_id
@@ -43,6 +45,50 @@ class GcsActorManager:
         # node_id -> set of actor ids placed there
         self._by_node: Dict[NodeID, set] = {}
         self._lock = asyncio.Lock()
+        self._load_persisted()
+
+    # ---- persistence (reference: gcs_table_storage.cc actor table over the
+    # Redis store client; here the append-log store) ------------------------
+
+    def _persist(self, actor_id: ActorID) -> None:
+        if self._store is None:
+            return
+        import pickle
+
+        info = self._actors.get(actor_id)
+        if info is None:
+            return
+        spec = self._creation_specs.get(actor_id)
+        self._store.put("actors", actor_id.binary(),
+                        pickle.dumps((info, spec), protocol=5))
+
+    def _load_persisted(self) -> None:
+        if self._store is None:
+            return
+        import pickle
+
+        for key in self._store.keys("actors"):
+            try:
+                info, spec = pickle.loads(self._store.get("actors", key))
+            except Exception:  # noqa: BLE001 — skip torn records
+                continue
+            self._actors[info.actor_id] = info
+            if spec is not None:
+                self._creation_specs[info.actor_id] = spec
+            if info.name and info.state != ActorState.DEAD:
+                self._named[(info.namespace or "", info.name)] = info.actor_id
+            if info.address is not None and info.state == ActorState.ALIVE:
+                self._by_node.setdefault(
+                    info.address.node_id, set()).add(info.actor_id)
+
+    def recover(self) -> None:
+        """Called once after a GCS restart: actors persisted mid-creation
+        (or mid-restart) resume scheduling; ALIVE actors keep serving at
+        their recorded addresses untouched."""
+        for actor_id, info in list(self._actors.items()):
+            if info.state in (ActorState.PENDING_CREATION,
+                              ActorState.RESTARTING):
+                asyncio.ensure_future(self._schedule_actor(actor_id))
 
     # ---- RPC handlers -------------------------------------------------------
 
@@ -85,6 +131,7 @@ class GcsActorManager:
             )
             self._actors[creation.actor_id] = info
             self._creation_specs[creation.actor_id] = spec
+            self._persist(creation.actor_id)
         asyncio.ensure_future(self._schedule_actor(creation.actor_id))
         return {"status": "registered", "info": info}
 
@@ -142,6 +189,7 @@ class GcsActorManager:
         info.address = address
         info.pid = payload.get("pid", 0)
         self._by_node.setdefault(address.node_id, set()).add(actor_id)
+        self._persist(actor_id)
         self._pub.publish(ps.ACTOR_CHANNEL, actor_id, info)
         return True
 
@@ -184,6 +232,7 @@ class GcsActorManager:
             info.state = ActorState.RESTARTING
             info.num_restarts += 1
             info.address = None
+            self._persist(actor_id)
             self._pub.publish(ps.ACTOR_CHANNEL, actor_id, info)
             await asyncio.sleep(CONFIG.actor_restart_delay_ms / 1000.0)
             asyncio.ensure_future(self._schedule_actor(actor_id))
@@ -202,6 +251,7 @@ class GcsActorManager:
         if info.name:
             self._named.pop((info.namespace, info.name), None)
         self._creation_specs.pop(actor_id, None)
+        self._persist(actor_id)
         self._pub.publish(ps.ACTOR_CHANNEL, actor_id, info)
 
     async def _schedule_actor(self, actor_id: ActorID):

@@ -32,13 +32,59 @@ logger = logging.getLogger(__name__)
 
 
 class GcsPlacementGroupManager:
-    def __init__(self, node_view, publisher: ps.Publisher, client_pool: ClientPool):
+    def __init__(self, node_view, publisher: ps.Publisher,
+                 client_pool: ClientPool, store=None):
         self._nodes = node_view
         self._pub = publisher
         self._pool = client_pool
+        self._store = store
         self._groups: Dict[PlacementGroupID, PlacementGroupInfo] = {}
         self._ready_events: Dict[PlacementGroupID, asyncio.Event] = {}
         self._named: Dict[str, PlacementGroupID] = {}
+        self._load_persisted()
+
+    # ---- persistence (append-log store; reference: PG table in
+    # gcs_table_storage.cc) --------------------------------------------------
+
+    def _persist(self, pg_id) -> None:
+        if self._store is None:
+            return
+        import pickle
+
+        info = self._groups.get(pg_id)
+        if info is None:
+            self._store.delete("pgs", pg_id.binary())
+        else:
+            self._store.put("pgs", pg_id.binary(),
+                            pickle.dumps(info, protocol=5))
+
+    def _load_persisted(self) -> None:
+        if self._store is None:
+            return
+        import pickle
+
+        for key in self._store.keys("pgs"):
+            try:
+                info = pickle.loads(self._store.get("pgs", key))
+            except Exception:  # noqa: BLE001
+                continue
+            pg_id = info.spec.placement_group_id
+            self._groups[pg_id] = info
+            ev = asyncio.Event()
+            if info.state == PlacementGroupState.CREATED:
+                ev.set()
+            self._ready_events[pg_id] = ev
+            if info.spec.name and info.state != PlacementGroupState.REMOVED:
+                self._named[info.spec.name] = pg_id
+
+    def recover(self) -> None:
+        """After a GCS restart: placed groups keep their reservations
+        (the raylets still hold the bundles); groups caught mid-placement
+        resume scheduling."""
+        for pg_id, info in list(self._groups.items()):
+            if info.state in (PlacementGroupState.PENDING,
+                              PlacementGroupState.RESCHEDULING):
+                asyncio.ensure_future(self._schedule(pg_id))
 
     def pending_bundle_shapes(self):
         """Bundle resource shapes of PGs not yet fully placed — gang demand
@@ -66,6 +112,7 @@ class GcsPlacementGroupManager:
         self._ready_events[spec.placement_group_id] = asyncio.Event()
         if spec.name:
             self._named[spec.name] = spec.placement_group_id
+        self._persist(spec.placement_group_id)
         asyncio.ensure_future(self._schedule(spec.placement_group_id))
         return {"status": "ok"}
 
@@ -77,6 +124,7 @@ class GcsPlacementGroupManager:
         info.state = PlacementGroupState.REMOVED
         if info.spec.name:
             self._named.pop(info.spec.name, None)
+        self._persist(pg_id)
         # Release bundle reservations on every involved raylet.
         for node_id in set(info.bundle_locations.values()):
             addr = self._nodes.raylet_address(node_id)
@@ -308,6 +356,7 @@ class GcsPlacementGroupManager:
             return
         if len(info.bundle_locations) == len(info.spec.bundles):
             info.state = PlacementGroupState.CREATED
+            self._persist(pg_id)
             ev = self._ready_events.get(pg_id)
             if ev is not None:
                 ev.set()

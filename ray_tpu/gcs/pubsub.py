@@ -35,6 +35,9 @@ class Publisher:
         self._pool = ClientPool(loop_thread)
         # channel -> set of subscriber rpc addresses
         self._subs: Dict[str, Set[str]] = {}
+        # invoked as on_drop(channel, addr) when a dead subscriber is
+        # discarded (lets the GCS prune its persisted subscription table)
+        self.on_drop = None
 
     def subscribe(self, channel: str, subscriber_address: str) -> None:
         self._subs.setdefault(channel, set()).add(subscriber_address)
@@ -57,6 +60,11 @@ class Publisher:
         except (ConnectionLost, OSError):
             self._subs.get(channel, set()).discard(addr)
             self._pool.invalidate(addr)
+            if self.on_drop is not None:
+                try:
+                    self.on_drop(channel, addr)
+                except Exception:  # noqa: BLE001
+                    logger.exception("pubsub on_drop failed")
 
     def close(self):
         self._pool.close_all()

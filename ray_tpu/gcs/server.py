@@ -45,8 +45,9 @@ logger = logging.getLogger(__name__)
 class GcsNodeManager:
     """Node registry + cluster resource view + failure detection."""
 
-    def __init__(self, publisher: ps.Publisher):
+    def __init__(self, publisher: ps.Publisher, store=None):
         self._pub = publisher
+        self._store = store
         self._nodes: Dict[NodeID, NodeInfo] = {}
         self._last_heartbeat: Dict[NodeID, float] = {}
         self._pending_demands: Dict[NodeID, list] = {}
@@ -61,6 +62,36 @@ class GcsNodeManager:
         self._node_versions: Dict[NodeID, int] = {}
         self._removed_log: deque = deque(maxlen=10_000)  # (version, nid)
         self._removed_pruned_below = 0
+        self._load_persisted()
+
+    def _persist_node(self, node_id: NodeID) -> None:
+        if self._store is None:
+            return
+        import pickle
+
+        info = self._nodes.get(node_id)
+        if info is not None:
+            self._store.put("nodes", node_id.binary(),
+                            pickle.dumps(info, protocol=5))
+
+    def _load_persisted(self) -> None:
+        """Reload the node registry after a GCS restart: live raylets keep
+        heartbeating the same address, so their entries pick right back
+        up (a fresh heartbeat grace period applies); truly dead nodes age
+        out through the normal health check."""
+        if self._store is None:
+            return
+        import pickle
+
+        for key in self._store.keys("nodes"):
+            try:
+                info = pickle.loads(self._store.get("nodes", key))
+            except Exception:  # noqa: BLE001
+                continue
+            if info.alive:
+                self._nodes[info.node_id] = info
+                self._last_heartbeat[info.node_id] = time.monotonic()
+                self._bump_node(info.node_id)
 
     def _bump_node(self, node_id: NodeID) -> None:
         self._view_version += 1
@@ -75,6 +106,7 @@ class GcsNodeManager:
         self._nodes[info.node_id] = info
         self._last_heartbeat[info.node_id] = time.monotonic()
         self._bump_node(info.node_id)
+        self._persist_node(info.node_id)
         self._pub.publish(ps.NODE_CHANNEL, info.node_id, info)
         logger.info("node %s registered (%s)", info.node_id.hex()[:8], info.raylet_address)
         return True
@@ -279,6 +311,7 @@ class GcsNodeManager:
             self._removed_pruned_below = self._removed_log[0][0] + 1
         self._pending_demands.pop(node_id, None)
         self._last_heartbeat.pop(node_id, None)
+        self._persist_node(node_id)
         self._pub.publish(ps.NODE_CHANNEL, node_id, info)
         for cb in self._death_listeners:
             try:
@@ -334,22 +367,51 @@ class GcsKvManager:
 
 
 class GcsJobManager:
-    def __init__(self, publisher: ps.Publisher):
+    def __init__(self, publisher: ps.Publisher, store=None):
         self._pub = publisher
+        self._store = store
         self._jobs: Dict[JobID, JobInfo] = {}
         self._counter = 0
         self._finish_listeners = []
+        if store is not None:
+            import pickle
+
+            raw = store.get("meta", b"next_job_id")
+            if raw is not None:
+                # never reuse job ids across GCS incarnations: task/actor
+                # ids embed the job id, so a reset counter would collide
+                self._counter = int.from_bytes(raw, "little")
+            for key in store.keys("jobs"):
+                try:
+                    info = pickle.loads(store.get("jobs", key))
+                    self._jobs[info.job_id] = info
+                except Exception:  # noqa: BLE001
+                    pass
 
     def add_finish_listener(self, cb):
         self._finish_listeners.append(cb)
 
+    def _persist_job(self, job_id) -> None:
+        if self._store is None:
+            return
+        import pickle
+
+        info = self._jobs.get(job_id)
+        if info is not None:
+            self._store.put("jobs", job_id.binary(),
+                            pickle.dumps(info, protocol=5))
+
     async def handle_get_next_job_id(self, payload):
         self._counter += 1
+        if self._store is not None:
+            self._store.put("meta", b"next_job_id",
+                            self._counter.to_bytes(8, "little"))
         return JobID.from_int(self._counter)
 
     async def handle_add_job(self, payload):
         info: JobInfo = payload["info"]
         self._jobs[info.job_id] = info
+        self._persist_job(info.job_id)
         self._pub.publish(ps.JOB_CHANNEL, info.job_id, info)
         return True
 
@@ -359,6 +421,7 @@ class GcsJobManager:
         if info is not None:
             info.is_dead = True
             info.end_time = time.time()
+            self._persist_job(job_id)
             self._pub.publish(ps.JOB_CHANNEL, job_id, info)
         for cb in self._finish_listeners:
             try:
@@ -407,11 +470,26 @@ class GcsServer:
         self._pool = ClientPool(self._lt)
         self.publisher = ps.Publisher(self._lt)
         store = make_store(storage_path or CONFIG.gcs_storage_path)
-        self.node_manager = GcsNodeManager(self.publisher)
+        self._store = store
+        self.node_manager = GcsNodeManager(self.publisher, store=store)
         self.kv_manager = GcsKvManager(store)
-        self.job_manager = GcsJobManager(self.publisher)
-        self.actor_manager = GcsActorManager(self.node_manager, self.publisher, self._pool)
-        self.pg_manager = GcsPlacementGroupManager(self.node_manager, self.publisher, self._pool)
+        self.job_manager = GcsJobManager(self.publisher, store=store)
+        self.actor_manager = GcsActorManager(
+            self.node_manager, self.publisher, self._pool, store=store)
+        self.pg_manager = GcsPlacementGroupManager(
+            self.node_manager, self.publisher, self._pool, store=store)
+        # pubsub subscriptions persist so a restarted GCS resumes pushing
+        # actor/node/log events without clients re-subscribing; dead
+        # subscribers prune back OUT of the table when a push fails, so
+        # worker churn can't grow it without bound
+        self.publisher.on_drop = lambda channel, addr: store.delete(
+            "pubsub", f"{channel}|{addr}".encode())
+        for key in store.keys("pubsub"):
+            try:
+                channel, addr = key.decode().split("|", 1)
+                self.publisher.subscribe(channel, addr)
+            except Exception:  # noqa: BLE001
+                pass
         self.task_event_manager = GcsTaskEventManager()
         self.node_manager.pg_locator = self.pg_manager
         self.node_manager.add_death_listener(self.actor_manager.on_node_death)
@@ -438,6 +516,10 @@ class GcsServer:
         self._server.register("report_error", self._handle_report_error)
         self.address = self._server.start(port)
         self._health_task = self._lt.submit(self.node_manager.health_check_loop())
+        # resume actors/PGs that were mid-schedule when a previous GCS
+        # incarnation stopped (no-ops on a fresh start)
+        self._lt.loop.call_soon_threadsafe(self.actor_manager.recover)
+        self._lt.loop.call_soon_threadsafe(self.pg_manager.recover)
         return self.address
 
     async def _handle_drain_node(self, payload):
@@ -474,14 +556,23 @@ class GcsServer:
         return {"status": "ok", "raylet": reply}
 
     async def _handle_subscribe(self, payload):
-        self.publisher.subscribe(payload["channel"], payload["subscriber_address"])
+        channel = payload["channel"]
+        addr = payload["subscriber_address"]
+        self.publisher.subscribe(channel, addr)
+        self._store.put("pubsub", f"{channel}|{addr}".encode(), b"1")
         return True
 
     async def _handle_unsubscribe(self, payload):
+        addr = payload["subscriber_address"]
         if payload.get("all"):
-            self.publisher.unsubscribe_all(payload["subscriber_address"])
+            self.publisher.unsubscribe_all(addr)
+            for key in self._store.keys("pubsub"):
+                if key.decode().split("|", 1)[1] == addr:
+                    self._store.delete("pubsub", key)
         else:
-            self.publisher.unsubscribe(payload["channel"], payload["subscriber_address"])
+            self.publisher.unsubscribe(payload["channel"], addr)
+            self._store.delete(
+                "pubsub", f"{payload['channel']}|{addr}".encode())
         return True
 
     async def _handle_ping(self, payload):
@@ -508,6 +599,9 @@ class GcsServer:
         self._pool.close_all()
         self._server.stop()
         self._lt.stop()
+        close = getattr(self._store, "close", None)
+        if close is not None:
+            close()
 
 
 def main():

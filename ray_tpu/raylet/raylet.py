@@ -1055,9 +1055,42 @@ class Raylet:
                 )
                 if reply.get("status") == "ok":
                     self._apply_view_reply(reply)
+                elif reply.get("status") == "unknown_node":
+                    # A restarted GCS (or one that declared us dead during
+                    # a partition) no longer knows this node: re-register
+                    # and re-subscribe, then keep heartbeating — the
+                    # reference's raylets reconnect to a restarted GCS the
+                    # same way (gcs_redis_failure_detector.h). NEVER from
+                    # a draining node: it unregistered on purpose and
+                    # re-registering would resurrect a zombie the GCS
+                    # would keep routing leases to.
+                    if not self._draining:
+                        await self._reconnect_gcs()
             except (ConnectionLost, OSError, asyncio.TimeoutError):
                 pass
             await asyncio.sleep(period)
+
+    async def _reconnect_gcs(self) -> None:
+        info = NodeInfo(
+            node_id=self.node_id,
+            raylet_address=self.address,
+            resources_total=dict(self.total),
+            resources_available=dict(self.available),
+            labels=self.labels,
+            is_head=self.is_head,
+        )
+        try:
+            await self._gcs.call_async("register_node", {"info": info},
+                                       timeout=5.0)
+            await self._gcs.call_async(
+                "subscribe",
+                {"channel": "NODE", "subscriber_address": self.address},
+                timeout=5.0)
+            self._view_version = 0  # force a full view on the next beat
+            logger.warning("re-registered with restarted GCS at %s",
+                           self.gcs_address)
+        except (ConnectionLost, OSError, asyncio.TimeoutError):
+            pass  # next heartbeat retries
 
     def _apply_view_reply(self, reply: dict) -> None:
         """Sync the local cluster view from a heartbeat reply: a delta
@@ -1098,13 +1131,22 @@ class Raylet:
             self._release_lease_resources(lease)
         if prev_state == "actor" and handle.actor_id is not None:
             code = handle.proc.returncode if handle.proc else None
+            # An eviction kill (bundle cancel, drain, OOM policy) is NOT an
+            # intended actor death even though SIGTERM exits cleanly (code
+            # 0): the restart FSM must re-place the actor. Only a
+            # self-initiated clean exit counts as intended.
+            intended = code == 0 and not handle.evicted
+            reason = (f"actor worker evicted by raylet "
+                      f"({self.drain_reason or 'bundle released'})"
+                      if handle.evicted
+                      else f"actor worker process died (exit code {code})")
             self._lt.submit(
                 self._gcs.send_async(
                     "report_actor_death",
                     {
                         "actor_id": handle.actor_id,
-                        "reason": f"actor worker process died (exit code {code})",
-                        "intended": code == 0,
+                        "reason": reason,
+                        "intended": intended,
                     },
                 )
             )

@@ -151,6 +151,11 @@ class WorkerHandle:
     # False for zygote forks. Startup caps are per-mechanism: forks are
     # ~ms-cheap, full boots are not.
     direct_spawn: bool = True
+    # Set when the RAYLET kills this worker to reclaim resources (bundle
+    # cancel, drain deadline, OOM policy): the death report must read as
+    # UNINTENDED so the GCS restart FSM re-places the actor, even though
+    # SIGTERM makes the worker exit 0.
+    evicted: bool = False
 
 
 class WorkerPool:
@@ -675,6 +680,7 @@ class WorkerPool:
         the lease/resources and reporting actor death. (_kill pre-marks the
         handle dead, which suppresses the callback; that is only correct for
         workers whose lease was already released.)"""
+        handle.evicted = True
         if handle.proc is not None and handle.proc.poll() is None:
             try:
                 handle.proc.terminate()

@@ -196,6 +196,17 @@ _shared_routers: Dict[Tuple[Any, str], Router] = {}
 _shared_lock = threading.Lock()
 
 
+def shutdown_routers() -> None:
+    """Stop every shared router (serve.shutdown): without this, each
+    router's long-poll thread would retry the dead controller forever and
+    the registry would leak an entry per controller incarnation."""
+    with _shared_lock:
+        routers = list(_shared_routers.values())
+        _shared_routers.clear()
+    for r in routers:
+        r.stop()
+
+
 def shared_router(controller, deployment_name: str,
                   app_name: str = "") -> Router:
     """One Router (and long-poll thread) per (controller, deployment) per

@@ -285,6 +285,9 @@ def shutdown() -> None:
     global _proxy, _grpc_proxy
     import ray_tpu
 
+    from ray_tpu.serve._private.router import shutdown_routers
+
+    shutdown_routers()
     try:
         controller = serve_context.get_controller()
     except RuntimeError:

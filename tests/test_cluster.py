@@ -190,6 +190,87 @@ def test_actor_restart_after_node_death(ray_start_cluster):
     assert ray_tpu.get(r.node.remote(), timeout=60) is not None
 
 
+def test_gcs_restart_live_cluster(tmp_path):
+    """GCS HA (VERDICT r3 #3): kill the GCS mid-workload on a live
+    3-node cluster, restart it at the same address from the append-log
+    store — running actors keep serving THROUGH the outage, detached
+    actors and PGs survive into the new incarnation, raylets re-register
+    on their next heartbeat, and fresh tasks drain."""
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(head_node_args={"num_cpus": 2},
+                      gcs_storage_path=str(tmp_path / "gcs.db"))
+    try:
+        cluster.add_node(num_cpus=2)
+        cluster.add_node(num_cpus=2)
+        cluster.wait_for_nodes()
+        cluster.connect()
+
+        @ray_tpu.remote
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def incr(self):
+                self.n += 1
+                return self.n
+
+        detached = Counter.options(name="ha_survivor",
+                                   lifetime="detached").remote()
+        assert ray_tpu.get(detached.incr.remote()) == 1
+        plain = Counter.remote()
+        assert ray_tpu.get(plain.incr.remote()) == 1
+        pg = placement_group([{"CPU": 0.5}], name="ha_pg")
+        assert pg.wait(timeout_seconds=30)
+
+        @ray_tpu.remote
+        def f(x):
+            return x * 2
+
+        assert ray_tpu.get(f.remote(3)) == 6
+
+        cluster.kill_gcs()
+        # Direct actor RPC doesn't touch the GCS: both actors keep
+        # serving through the outage.
+        assert ray_tpu.get(detached.incr.remote(), timeout=10) == 2
+        assert ray_tpu.get(plain.incr.remote(), timeout=10) == 2
+        # Plain tasks lease straight from the raylet; pre-registered
+        # functions keep draining too.
+        assert ray_tpu.get(f.remote(4), timeout=15) == 8
+
+        cluster.restart_gcs()
+        # raylets re-register on their next heartbeat
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            alive = sum(1 for i in cluster.gcs.node_manager._nodes.values()
+                        if i.alive)
+            if alive >= 3:
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError("raylets did not re-register")
+
+        # detached actor resolvable by name through the NEW GCS
+        handle = ray_tpu.get_actor("ha_survivor")
+        assert ray_tpu.get(handle.incr.remote(), timeout=10) == 3
+        # the plain actor's handle still works
+        assert ray_tpu.get(plain.incr.remote(), timeout=10) == 3
+        # the PG survived: schedule into it through the new GCS
+        @ray_tpu.remote
+        def where():
+            return ray_tpu.get_runtime_context().get_node_id()
+
+        assert ray_tpu.get(
+            where.options(
+                num_cpus=0.5,
+                scheduling_strategy=PlacementGroupSchedulingStrategy(
+                    placement_group=pg)).remote(), timeout=30) is not None
+        # fresh tasks drain normally
+        assert ray_tpu.get(f.remote(5), timeout=30) == 10
+    finally:
+        cluster.shutdown()
+
+
 def test_gcs_state_survives_restart(tmp_path):
     """GCS fault tolerance (reference: Redis-backed gcs store_client —
     SURVEY §5): KV state written before a GCS stop is visible after a new
