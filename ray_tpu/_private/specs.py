@@ -140,6 +140,11 @@ class TaskSpec:
     attempt_number: int = 0
     # Dynamic/streaming generator backpressure:
     generator_backpressure_num_objects: int = -1
+    # Trace-context propagation (reference: util/tracing/tracing_helper.py
+    # :36-57 inject/propagate through submission): the SUBMITTER's task id
+    # (or driver root id). A task's own span id is its task_id, so the
+    # timeline joins driver -> task -> nested task into a tree.
+    trace_parent: Optional[str] = None
 
     def return_ids(self) -> List[ObjectID]:
         n = max(self.num_returns, 1) if self.num_returns != 0 else 0

@@ -226,17 +226,38 @@ def task_timeline_events(limit: int = 100_000) -> list:
                     key=lambda e: e["time"])
     trace = []
     starts = {}
+    spans = {}  # task_id -> its X event (for flow-arrow endpoints)
+    flow_id = 0
     for ev in events:
         key = (ev["task_id"], ev["worker_id"])
         if ev["state"] == "RUNNING":
             starts[key] = ev["time"]
         elif ev["state"] in ("FINISHED", "FAILED") and key in starts:
             t0 = starts.pop(key)
-            trace.append({
+            entry = {
                 "cat": "task", "ph": "X", "name": ev["name"],
                 "pid": ev.get("node") or "driver",
                 "tid": ev["worker_id"][:12],
                 "ts": int(t0 * 1e6), "dur": int((ev["time"] - t0) * 1e6),
-                "args": {"task_id": ev["task_id"], "state": ev["state"]},
-            })
+                "args": {"task_id": ev["task_id"], "state": ev["state"],
+                         # propagated trace context: the submitter's span
+                         # (task id, or the driver root) — joins the
+                         # events into a driver->task->nested-task tree
+                         "parent": ev.get("parent")},
+            }
+            trace.append(entry)
+            spans[ev["task_id"]] = entry
+    # chrome flow arrows parent -> child so the tree renders visually
+    for entry in list(trace):
+        parent = entry["args"].get("parent")
+        src = spans.get(parent)
+        if src is None:
+            continue
+        flow_id += 1
+        trace.append({"cat": "submit", "ph": "s", "id": flow_id,
+                      "name": "submit", "pid": src["pid"],
+                      "tid": src["tid"], "ts": src["ts"]})
+        trace.append({"cat": "submit", "ph": "f", "id": flow_id,
+                      "name": "submit", "bp": "e", "pid": entry["pid"],
+                      "tid": entry["tid"], "ts": entry["ts"]})
     return trace

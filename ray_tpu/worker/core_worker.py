@@ -968,6 +968,7 @@ class CoreWorker:
             num_returns=-1 if streaming else num_returns,
             resources=resources or {"CPU": CONFIG.default_task_num_cpus},
             owner_address=self.address,
+            trace_parent=self.current_task_id().hex(),
             max_retries=max_retries,
             retry_exceptions=retry_exceptions,
             max_calls=max_calls,
@@ -1477,6 +1478,7 @@ class CoreWorker:
             else {"CPU": CONFIG.default_actor_num_cpus},
             placement_resources=placement_resources,
             owner_address=self.address,
+            trace_parent=self.current_task_id().hex(),
             scheduling_strategy=scheduling_strategy or SchedulingStrategySpec(),
             actor_creation=creation,
             runtime_env=runtime_env,
@@ -1683,6 +1685,7 @@ class CoreWorker:
             args=arg_specs,
             num_returns=-1 if streaming else num_returns,
             owner_address=self.address,
+            trace_parent=self.current_task_id().hex(),
             actor_id=actor_id,
         )
         spec.kwarg_specs = kwarg_specs
@@ -2370,7 +2373,7 @@ class CoreWorker:
         # once per flush batch in _flush_task_events.
         self._task_events.append(
             (spec.task_id, spec.function_name, spec.task_type.name,
-             spec.job_id, state, time.time()))
+             spec.job_id, state, time.time(), spec.trace_parent))
 
     async def _task_event_loop(self):
         while True:
@@ -2386,13 +2389,14 @@ class CoreWorker:
         while self._task_events:
             events = []
             while self._task_events and len(events) < 5000:
-                task_id, name, type_name, job_id, state, ts = \
+                task_id, name, type_name, job_id, state, ts, parent = \
                     self._task_events.popleft()
                 events.append({
                     "task_id": task_id.hex(),
                     "name": name,
                     "type": type_name,
                     "state": state,
+                    "parent": parent,
                     "job_id": job_id.hex() if job_id else None,
                     "node": node,
                     "worker_id": worker,

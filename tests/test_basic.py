@@ -328,3 +328,32 @@ def test_timeline_api(ray_start_regular, tmp_path):
     assert any(e["name"] == "traced_task" for e in events)
     disk = _json.loads(out.read_text())
     assert disk == events
+
+
+def test_timeline_trace_context_joins_nested_tasks(ray_start_regular):
+    """Trace-context propagation (VERDICT r3 #9): the submitter's span
+    rides the TaskSpec, so the timeline joins driver -> task -> nested
+    task into a tree (with chrome flow arrows)."""
+    @ray_tpu.remote
+    def child():
+        return 1
+
+    @ray_tpu.remote
+    def parent():
+        return ray_tpu.get(child.remote())
+
+    assert ray_tpu.get(parent.remote(), timeout=60) == 1
+    time.sleep(1.2)  # task-event flush interval
+    from ray_tpu.util.state.api import task_timeline_events
+
+    events = [e for e in task_timeline_events() if e["ph"] == "X"]
+    by_name = {e["name"]: e for e in events}
+    assert "parent" in by_name and "child" in by_name
+    # child's trace parent is the parent task's span (its task id)
+    assert (by_name["child"]["args"]["parent"]
+            == by_name["parent"]["args"]["task_id"])
+    # the parent task's own parent is the driver root (present, non-null)
+    assert by_name["parent"]["args"]["parent"]
+    # and the tree renders as chrome flow arrows
+    flows = [e for e in task_timeline_events() if e["ph"] in ("s", "f")]
+    assert len(flows) >= 2
