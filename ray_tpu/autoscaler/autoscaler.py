@@ -55,9 +55,14 @@ class StandardAutoscaler:
     def _launch(self, node_type: str, count: int):
         cfg = self.config["node_types"][node_type]
         logger.info("autoscaler launching %d x %s", count, node_type)
+        # the type's cloud node_config (machine/accelerator shape) rides
+        # along with the scheduling metadata; cloud providers read it,
+        # the local provider reads resources/labels
+        node_config = dict(cfg.get("node_config") or {})
+        node_config.setdefault("resources", cfg.get("resources") or {})
+        node_config.setdefault("labels", cfg.get("labels") or {})
         self.provider.create_node(
-            {"resources": cfg.get("resources") or {},
-             "labels": cfg.get("labels") or {}},
+            node_config,
             {TAG_NODE_TYPE: node_type, TAG_NODE_STATUS: STATUS_UP},
             count,
         )
@@ -127,11 +132,17 @@ class StandardAutoscaler:
         by_gcs_id = {}
         raylet_id = getattr(self.provider, "raylet_node_id", None)
         # cloud providers can't map pods to GCS nodes directly; raylets on
-        # k8s advertise their pod name as a node label (ray.io/pod-name)
-        # and join here
-        by_pod_label = {
-            info.get("labels", {}).get("ray.io/pod-name"): gid
-            for gid, info in nodes.items()}
+        # k8s advertise their pod name as a node label (ray.io/pod-name),
+        # TPU-VM raylets their slice name (ray.io/tpu-slice-name, set by
+        # the TPU accelerator detector from the metadata server), and
+        # custom providers may set provider-node-id — all join here
+        by_pod_label = {}
+        for gid, info in nodes.items():
+            labels = info.get("labels", {})
+            for key in ("ray.io/pod-name", "ray.io/tpu-slice-name",
+                        "provider-node-id"):
+                if labels.get(key):
+                    by_pod_label[labels[key]] = gid
         for pid in alive_ids:
             gid = raylet_id(pid) if raylet_id else None
             if gid is None:

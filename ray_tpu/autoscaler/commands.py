@@ -67,9 +67,14 @@ def validate_cluster_config(config: dict) -> None:
             "provider type 'gke' clusters are operator-managed: apply the "
             "RayCluster CR (see ray_tpu.autoscaler.gke_node_provider) "
             "instead of `ray-tpu up`")
+    elif ptype == "gce_tpu":
+        for key in ("project", "zone"):
+            if not provider.get(key):
+                raise ValueError(
+                    f"provider.{key} is required for type: gce_tpu")
     else:
         raise ValueError(f"unknown provider.type: {ptype!r} "
-                         "(expected 'local' or 'subprocess')")
+                         "(expected 'local', 'subprocess', or 'gce_tpu')")
 
 
 # ---- cluster state ----------------------------------------------------------

@@ -1,0 +1,219 @@
+"""GCE TPU-VM node provider: creates/deletes real Cloud TPU slices.
+
+Reference: ray python/ray/autoscaler/_private/gcp/node_provider.py:63
+(GCPNodeProvider) and its TPU resource class (gcp/node.py) — here rebuilt
+TPU-first: the provider's unit is a SLICE, not a VM. One provider node =
+one Cloud TPU "node" resource (tpu.googleapis.com/v2), which for a
+multi-host accelerator type (e.g. v5litepod-16) materializes a GANG of
+host VMs sharing ICI. Topology therefore lives in the node type's config:
+
+    node_types:
+      v5e-16:
+        node_config:
+          acceleratorType: v5litepod-16
+          runtimeVersion: tpu-ubuntu2204-base
+        # resources the WHOLE slice gang contributes, pre-declared so the
+        # bin-packer can match TPU/PG gang demand before the slice exists
+        resources: {"TPU": 16.0, "TPU-v5litepod-16-head": 1.0}
+        max_workers: 4
+
+Scale-up = POST nodes (a long-running operation; the slice shows CREATING
+until every host is provisioned), scale-down = DELETE of the whole slice —
+there is no partial-slice scaling, matching how ICI topology works.
+
+The REST transport is a tiny urllib wrapper authenticated from the GCE
+metadata server; tests inject a fake with the same request() surface
+(tests/test_gce_tpu_provider.py), mirroring the GKE provider's fake-K8s
+pattern.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import re
+import time
+import uuid
+from typing import Dict, List, Optional
+
+from ray_tpu.autoscaler.node_provider import (
+    STATUS_SETTING_UP,
+    STATUS_UP,
+    TAG_NODE_STATUS,
+    TAG_NODE_TYPE,
+    NodeProvider,
+)
+
+logger = logging.getLogger(__name__)
+
+TPU_API = "https://tpu.googleapis.com/v2"
+METADATA_TOKEN_URL = ("http://metadata.google.internal/computeMetadata/v1/"
+                      "instance/service-accounts/default/token")
+
+# GCE label keys/values: lowercase letters, digits, -, _; 63 chars max.
+CLUSTER_LABEL = "ray-cluster-name"
+TYPE_LABEL = "ray-node-type"
+
+_READY_STATES = {"READY"}
+_PENDING_STATES = {"CREATING", "STARTING", "RESTARTING", "REPAIRING"}
+_GONE_STATES = {"DELETING", "TERMINATED", "STOPPED", "STOPPING", "PREEMPTED"}
+
+
+def _gce_label(value: str) -> str:
+    return re.sub(r"[^a-z0-9_-]", "-", value.lower())[:63]
+
+
+class GceTpuApi:
+    """Minimal Cloud TPU v2 REST client (metadata-server auth)."""
+
+    def __init__(self, project: str, zone: str,
+                 token: Optional[str] = None):
+        self.base = f"/projects/{project}/locations/{zone}"
+        self._token = token
+        self._token_expiry = 0.0
+
+    def _auth(self) -> str:
+        import urllib.request
+
+        if self._token and time.time() < self._token_expiry:
+            return self._token
+        req = urllib.request.Request(
+            METADATA_TOKEN_URL, headers={"Metadata-Flavor": "Google"})
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            payload = json.loads(resp.read().decode())
+        self._token = payload["access_token"]
+        self._token_expiry = time.time() + payload.get("expires_in", 300) - 60
+        return self._token
+
+    def request(self, method: str, path: str,
+                body: Optional[dict] = None) -> dict:
+        import urllib.request
+
+        req = urllib.request.Request(
+            TPU_API + path,
+            data=json.dumps(body).encode() if body is not None else None,
+            method=method,
+            headers={
+                "Authorization": f"Bearer {self._auth()}",
+                "Content-Type": "application/json",
+            })
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            return json.loads(resp.read().decode() or "{}")
+
+
+class GceTpuNodeProvider(NodeProvider):
+    """provider_config: {"project": str, "zone": str}; optional "api" for
+    tests. Node ids are the short TPU node names."""
+
+    def __init__(self, provider_config: dict, cluster_name: str,
+                 api: Optional[GceTpuApi] = None):
+        super().__init__(provider_config, cluster_name)
+        self.project = provider_config.get("project", "")
+        self.zone = provider_config.get("zone", "")
+        self.api = api or GceTpuApi(self.project, self.zone)
+        self._nodes: Dict[str, dict] = {}  # name -> TPU node resource
+
+    # -- helpers -------------------------------------------------------------
+
+    def _refresh(self) -> None:
+        reply = self.api.request("GET", f"{self.api.base}/nodes")
+        out: Dict[str, dict] = {}
+        for node in reply.get("nodes", []):
+            labels = node.get("labels", {})
+            if labels.get(CLUSTER_LABEL) != _gce_label(self.cluster_name):
+                continue
+            if node.get("state") in _GONE_STATES:
+                continue
+            name = node.get("name", "").rsplit("/", 1)[-1]
+            out[name] = node
+        self._nodes = out
+
+    # -- NodeProvider API ----------------------------------------------------
+
+    def non_terminated_nodes(self, tag_filters: Optional[dict] = None
+                             ) -> List[str]:
+        """READY slices only. Provisioning slices are reported through
+        pending_nodes() instead — the autoscaler sums both as supply, so
+        listing a CREATING slice in both would double-count it."""
+        self._refresh()
+        out = []
+        for name, node in self._nodes.items():
+            if node.get("state") in _PENDING_STATES:
+                continue
+            tags = self.node_tags(name)
+            if tag_filters and any(tags.get(k) != v
+                                   for k, v in tag_filters.items()):
+                continue
+            out.append(name)
+        return sorted(out)
+
+    def pending_nodes(self) -> Dict[str, int]:
+        """Per-type counts of slices still provisioning (CREATING can
+        take minutes for a multi-host gang; the autoscaler counts these
+        as supply so it doesn't re-launch meanwhile)."""
+        out: Dict[str, int] = {}
+        for node in self._nodes.values():
+            if node.get("state") in _PENDING_STATES:
+                t = node.get("labels", {}).get(TYPE_LABEL, "")
+                out[t] = out.get(t, 0) + 1
+        return out
+
+    def node_tags(self, node_id: str) -> dict:
+        node = self._nodes.get(node_id, {})
+        labels = node.get("labels", {})
+        status = (STATUS_UP if node.get("state") in _READY_STATES
+                  else STATUS_SETTING_UP)
+        return {
+            TAG_NODE_TYPE: labels.get(TYPE_LABEL, ""),
+            TAG_NODE_STATUS: status,
+        }
+
+    def create_node(self, node_config: dict, tags: dict, count: int) -> None:
+        node_type = tags.get(TAG_NODE_TYPE, "worker")
+        for _ in range(count):
+            # truncate the PREFIX, never the unique suffix: a 63-char cap
+            # applied after the uuid would make long cluster/type names
+            # collide on every create
+            prefix = _gce_label(f"{self.cluster_name}-{node_type}")[:54]
+            name = f"{prefix}-{uuid.uuid4().hex[:8]}"
+            body = {
+                "acceleratorType": node_config.get(
+                    "acceleratorType", "v5litepod-8"),
+                "runtimeVersion": node_config.get(
+                    "runtimeVersion", "tpu-ubuntu2204-base"),
+                "labels": {
+                    CLUSTER_LABEL: _gce_label(self.cluster_name),
+                    TYPE_LABEL: _gce_label(node_type),
+                },
+            }
+            for key in ("networkConfig", "schedulingConfig", "metadata",
+                        "serviceAccount", "tags", "dataDisks"):
+                if key in node_config:
+                    body[key] = node_config[key]
+            logger.info("creating TPU slice %s (%s)", name,
+                        body["acceleratorType"])
+            self.api.request(
+                "POST", f"{self.api.base}/nodes?nodeId={name}", body)
+
+    def terminate_node(self, node_id: str) -> None:
+        logger.info("deleting TPU slice %s", node_id)
+        try:
+            self.api.request(
+                "DELETE", f"{self.api.base}/nodes/{node_id}")
+        except Exception:  # noqa: BLE001 — already gone is fine
+            logger.warning("delete of TPU slice %s failed", node_id,
+                           exc_info=True)
+        self._nodes.pop(node_id, None)
+
+    def internal_ip(self, node_id: str) -> str:
+        node = self._nodes.get(node_id, {})
+        endpoints = node.get("networkEndpoints", [])
+        return endpoints[0].get("ipAddress", "") if endpoints else ""
+
+    def worker_ips(self, node_id: str) -> List[str]:
+        """All host VMs of the slice gang (multi-host slices have one
+        endpoint per worker; the cluster launcher starts a raylet on
+        each)."""
+        node = self._nodes.get(node_id, {})
+        return [e.get("ipAddress", "")
+                for e in node.get("networkEndpoints", [])]

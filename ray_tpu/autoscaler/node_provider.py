@@ -19,6 +19,7 @@ from typing import Dict, List, Optional
 TAG_NODE_TYPE = "node-type"
 TAG_NODE_STATUS = "node-status"
 STATUS_UP = "up-to-date"
+STATUS_SETTING_UP = "setting-up"
 
 
 class NodeProvider:

@@ -1,0 +1,170 @@
+"""GCE TPU-VM provider tests (VERDICT r3 #4).
+
+A FakeTpuApi plays the Cloud TPU v2 REST service: POST creates a slice in
+CREATING state, reconcile() brings it READY with one network endpoint per
+host VM, DELETE removes it. The autoscaler scales a v5e-16 slice group up
+on placement-group gang demand and back down when idle — no cloud needed,
+mirroring the GKE provider's fake-K8s pattern.
+
+Reference: python/ray/autoscaler/_private/gcp/node_provider.py:63.
+"""
+
+import json
+
+from ray_tpu.autoscaler.autoscaler import StandardAutoscaler
+from ray_tpu.autoscaler.gce_tpu_node_provider import GceTpuNodeProvider
+from ray_tpu.autoscaler.node_provider import TAG_NODE_STATUS, TAG_NODE_TYPE
+
+HOSTS_PER_SLICE = {"v5litepod-16": 4, "v5litepod-8": 2}
+
+
+class FakeTpuApi:
+    """In-memory Cloud TPU v2 API: nodes provision asynchronously."""
+
+    def __init__(self, project="proj", zone="us-central2-b"):
+        self.base = f"/projects/{project}/locations/{zone}"
+        self.nodes = {}  # name -> resource dict
+        self.creates = []
+        self.deletes = []
+        self._ip = 0
+
+    def request(self, method, path, body=None):
+        if method == "GET" and path.endswith("/nodes"):
+            return {"nodes": [json.loads(json.dumps(n))
+                              for n in self.nodes.values()]}
+        if method == "POST" and "/nodes?nodeId=" in path:
+            name = path.split("nodeId=")[1]
+            self.creates.append((name, json.loads(json.dumps(body))))
+            self.nodes[name] = {
+                "name": f"{self.base}/nodes/{name}",
+                "state": "CREATING",
+                "acceleratorType": body["acceleratorType"],
+                "labels": dict(body.get("labels", {})),
+                "networkEndpoints": [],
+            }
+            return {"name": f"{self.base}/operations/op-{name}"}
+        if method == "DELETE":
+            name = path.rsplit("/", 1)[-1]
+            self.deletes.append(name)
+            self.nodes.pop(name, None)
+            return {}
+        raise AssertionError(f"unexpected request {method} {path}")
+
+    def reconcile(self):
+        """Provisioner: CREATING slices come READY with their host gang."""
+        for node in self.nodes.values():
+            if node["state"] == "CREATING":
+                node["state"] = "READY"
+                hosts = HOSTS_PER_SLICE.get(node["acceleratorType"], 1)
+                node["networkEndpoints"] = []
+                for _ in range(hosts):
+                    self._ip += 1
+                    node["networkEndpoints"].append(
+                        {"ipAddress": f"10.1.0.{self._ip}"})
+
+
+class FakeGcs:
+    def __init__(self):
+        self.nodes = {}
+        self.demands = []
+        self.pending_pg_bundles = []
+
+    def call(self, method, payload, **kw):
+        assert method == "get_cluster_load"
+        return {"nodes": self.nodes, "demands": self.demands,
+                "pending_pg_bundles": self.pending_pg_bundles}
+
+
+def _mk(api=None):
+    api = api or FakeTpuApi()
+    provider = GceTpuNodeProvider(
+        {"project": "proj", "zone": "us-central2-b"}, "rt", api=api)
+    return api, provider
+
+
+def test_create_refresh_terminate_slice():
+    api, provider = _mk()
+    provider.create_node({"acceleratorType": "v5litepod-16"},
+                         {TAG_NODE_TYPE: "v5e-16"}, 1)
+    assert len(api.creates) == 1
+    name, body = api.creates[0]
+    assert body["labels"]["ray-cluster-name"] == "rt"
+    assert body["labels"]["ray-node-type"] == "v5e-16"
+
+    # while CREATING the slice is PENDING supply only: the autoscaler
+    # sums non_terminated + pending, so listing it in both would
+    # double-count it (and satisfy demand with phantom capacity)
+    assert provider.non_terminated_nodes() == []
+    assert provider.pending_nodes() == {"v5e-16": 1}
+    assert provider.node_tags(name)[TAG_NODE_STATUS] == "setting-up"
+
+    api.reconcile()
+    assert provider.non_terminated_nodes() == [name]
+    assert provider.pending_nodes() == {}
+    assert provider.node_tags(name)[TAG_NODE_STATUS] == "up-to-date"
+    # multi-host gang: one endpoint per host VM
+    assert len(provider.worker_ips(name)) == 4
+    assert provider.internal_ip(name) == provider.worker_ips(name)[0]
+
+    provider.terminate_node(name)
+    assert api.deletes == [name]
+    assert provider.non_terminated_nodes() == []
+
+
+def test_foreign_and_deleted_slices_filtered():
+    api, provider = _mk()
+    api.nodes["other"] = {"name": "x/nodes/other", "state": "READY",
+                          "labels": {"ray-cluster-name": "not-us"},
+                          "acceleratorType": "v5litepod-8",
+                          "networkEndpoints": []}
+    api.nodes["dying"] = {"name": "x/nodes/dying", "state": "DELETING",
+                          "labels": {"ray-cluster-name": "rt"},
+                          "acceleratorType": "v5litepod-8",
+                          "networkEndpoints": []}
+    assert provider.non_terminated_nodes() == []
+
+
+def test_autoscaler_scales_v5e16_on_pg_demand():
+    """End-to-end against the fake GCE API: gang PG demand scales a
+    v5e-16 slice group up; idle scales it back down (VERDICT r3 #4
+    done-criterion)."""
+    api, provider = _mk()
+    gcs = FakeGcs()
+    config = {"max_workers": 4, "node_types": {
+        "v5e-16": {
+            "node_config": {"acceleratorType": "v5litepod-16",
+                            "runtimeVersion": "tpu-ubuntu2204-base"},
+            "resources": {"TPU": 16.0, "TPU-v5litepod-16-head": 1.0},
+            "min_workers": 0, "max_workers": 2}}}
+    autoscaler = StandardAutoscaler(config, provider, gcs,
+                                    idle_timeout_s=0.0)
+
+    # a STRICT_PACK TPU gang waiting for placement
+    gcs.pending_pg_bundles = [{"TPU": 16.0}]
+    autoscaler.update()
+    assert len(api.creates) == 1
+    assert api.creates[0][1]["acceleratorType"] == "v5litepod-16"
+
+    # while the slice provisions (CREATING), no duplicate launch
+    autoscaler.update()
+    assert len(api.creates) == 1
+
+    # slice comes up, registers its resources, gang placed: no more demand
+    api.reconcile()
+    slice_name = api.creates[0][0]
+    gcs.pending_pg_bundles = []
+    gcs.nodes["gcs-1"] = {
+        "total": {"TPU": 16.0, "TPU-v5litepod-16-head": 1.0},
+        "available": {"TPU": 0.0, "TPU-v5litepod-16-head": 0.0},
+        # the label a real TPU-VM raylet advertises (accelerators/tpu.py
+        # SLICE_NAME_LABEL via the metadata server)
+        "alive": True, "labels": {"ray.io/tpu-slice-name": slice_name}}
+    autoscaler.update()
+    assert len(api.creates) == 1
+    assert api.deletes == []
+
+    # gang done, slice idle -> scale to zero deletes the whole slice
+    gcs.nodes["gcs-1"]["available"] = dict(gcs.nodes["gcs-1"]["total"])
+    autoscaler.update()
+    assert api.deletes == [slice_name]
+    assert provider.non_terminated_nodes() == []
