@@ -37,9 +37,18 @@ class Dataset:
     def map_batches(self, fn: Callable, *, batch_size: Optional[int] = None,
                     batch_format: str = "numpy", fn_args=None, fn_kwargs=None,
                     fn_constructor_args=None, fn_constructor_kwargs=None,
-                    **_kw) -> "Dataset":
+                    concurrency=None, **_kw) -> "Dataset":
         options: Dict[str, Any] = {"batch_size": batch_size,
                                    "batch_format": batch_format}
+        if concurrency is not None:
+            # callable classes with explicit concurrency run on an
+            # autoscaling ACTOR POOL (reference:
+            # actor_pool_map_operator.py + execution/autoscaler/
+            # default_autoscaler.py): int = fixed size, (min, max) =
+            # autoscale between bounds on queue depth
+            options["concurrency"] = (
+                tuple(concurrency) if isinstance(concurrency, (tuple, list))
+                else (int(concurrency), int(concurrency)))
         if isinstance(fn, type):
             # callable class (reference: actor-pool map — one instance per
             # worker process per stage, constructed lazily in the worker);
