@@ -80,6 +80,18 @@ class EventLoopThread:
 
     def run_coro(self, coro: Awaitable, timeout: Optional[float] = None):
         """Run a coroutine on the loop from another thread; block for result."""
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        if running is self.loop:
+            # Blocking on our own loop can never complete — the loop is
+            # this very thread. Fail loudly instead of deadlocking the
+            # whole transport (the serve long-poll starvation bug).
+            coro.close()
+            raise RuntimeError(
+                "blocking run_coro() called from its own event-loop "
+                "thread; use submit()/await instead")
         fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
         return fut.result(timeout)
 

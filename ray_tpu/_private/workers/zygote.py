@@ -63,6 +63,12 @@ def main():
     parser.add_argument("--node-id", required=True)
     args = parser.parse_args()
 
+    # `ray-tpu stack` signals every worker-shaped process (fork children
+    # keep this cmdline); without a handler SIGUSR1's default action
+    # would kill the fork-server.
+    import faulthandler
+    faulthandler.register(signal.SIGUSR1, all_threads=True)
+
     # Preimport the worker stack so forked children inherit a warm module
     # cache. NOTHING here may start threads or event loops — fork() only
     # duplicates the calling thread, and a lock held elsewhere at fork

@@ -523,7 +523,10 @@ def cmd_stack(args) -> int:
                 cmdline = fh.read().replace(b"\0", b" ")
         except OSError:
             continue
-        if b"ray_tpu._private.workers.default_worker" in cmdline:
+        # zygote-forked workers keep the fork-server's cmdline, so match
+        # both spawn paths (a fork only rewrites argv if the child execs)
+        if (b"ray_tpu._private.workers.default_worker" in cmdline
+                or b"ray_tpu._private.workers.zygote" in cmdline):
             pids.append(int(p.split("/")[2]))
     if not pids:
         print("no live workers")

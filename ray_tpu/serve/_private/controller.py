@@ -36,14 +36,33 @@ logger = logging.getLogger(__name__)
 
 RECONCILE_INTERVAL_S = 0.2
 HEALTH_CHECK_INTERVAL_S = 2.0
+# A replica is STARTING until its constructor finishes (first
+# check_health reply); user __init__ may compile models for minutes, so
+# init gets its own generous deadline and is NOT health-checked
+# (reference: deployment_state.py replica startup vs health-check split —
+# probing during init killed LLM replicas mid-compile).
+REPLICA_INIT_TIMEOUT_S = 300.0
+HEALTH_CHECK_FAILURE_THRESHOLD = 3
 
 
 class _ReplicaState:
+    STARTING = "STARTING"
+    RUNNING = "RUNNING"
+    UNHEALTHY = "UNHEALTHY"
+
     def __init__(self, handle, replica_id: str):
         self.handle = handle
         self.replica_id = replica_id
-        self.healthy = True
+        self.state = _ReplicaState.STARTING
+        self.started_at = time.monotonic()
+        # check_health queued behind __init__: resolves iff init succeeded
+        self.init_ref = None
+        self.consecutive_failures = 0
         self.last_health_check = time.monotonic()
+
+    @property
+    def healthy(self) -> bool:
+        return self.state == _ReplicaState.RUNNING
 
 
 class _DeploymentState:
@@ -110,7 +129,8 @@ class ServeController:
                         app_name, cfg["name"], cfg)
         self._wait_for_ready(app_name)
 
-    def _wait_for_ready(self, app_name: str, timeout: float = 60.0) -> None:
+    def _wait_for_ready(self, app_name: str,
+                        timeout: float = REPLICA_INIT_TIMEOUT_S) -> None:
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             with self._lock:
@@ -226,15 +246,42 @@ class ServeController:
                 logger.exception("reconcile error")
             self._shutdown.wait(RECONCILE_INTERVAL_S)
 
+    def _check_starting(self, state: _DeploymentState) -> None:
+        """Promote STARTING replicas whose constructor finished; fail the
+        ones whose init raised or overran REPLICA_INIT_TIMEOUT_S."""
+        with self._lock:
+            starting = [r for r in state.replicas
+                        if r.state == _ReplicaState.STARTING]
+        for r in starting:
+            try:
+                done, _ = ray_tpu.wait([r.init_ref], timeout=0)
+            except Exception:  # noqa: BLE001 — owner died etc.
+                done = [r.init_ref]
+            if done:
+                try:
+                    ray_tpu.get(r.init_ref, timeout=1.0)
+                    r.state = _ReplicaState.RUNNING
+                    self._bump(state.full_name)
+                except Exception:  # noqa: BLE001 — init raised
+                    logger.warning("replica %s failed to initialize",
+                                   r.replica_id)
+                    r.state = _ReplicaState.UNHEALTHY
+            elif time.monotonic() - r.started_at > REPLICA_INIT_TIMEOUT_S:
+                logger.warning("replica %s init timed out", r.replica_id)
+                r.state = _ReplicaState.UNHEALTHY
+
     def _reconcile(self) -> None:
         with self._lock:
             states = list(self._deployments.values())
         for state in states:
+            self._check_starting(state)
             with self._lock:
-                healthy = [r for r in state.replicas if r.healthy]
+                alive = [r for r in state.replicas
+                         if r.state != _ReplicaState.UNHEALTHY]
                 want = state.target_num_replicas
-                to_start = want - len(healthy)
-                dead = [r for r in state.replicas if not r.healthy]
+                to_start = want - len(alive)
+                dead = [r for r in state.replicas
+                        if r.state == _ReplicaState.UNHEALTHY]
             for r in dead:
                 self._stop_replica(r)
                 with self._lock:
@@ -245,7 +292,13 @@ class ServeController:
                 self._start_replica(state)
             if to_start < 0:
                 with self._lock:
-                    excess = [r for r in state.replicas if r.healthy][to_start:]
+                    # prefer stopping still-starting replicas: nothing is
+                    # routed to them yet
+                    ranked = sorted(
+                        (r for r in state.replicas
+                         if r.state != _ReplicaState.UNHEALTHY),
+                        key=lambda r: r.state == _ReplicaState.RUNNING)
+                    excess = ranked[:-to_start]
                     for r in excess:
                         state.replicas.remove(r)
                 for r in excess:
@@ -272,9 +325,11 @@ class ServeController:
                 })
             if cfg.get("user_config") is not None:
                 handle.reconfigure.remote(cfg["user_config"])
+            replica = _ReplicaState(handle, replica_id)
+            # queued behind __init__: resolves exactly when init completes
+            replica.init_ref = handle.check_health.remote()
             with self._lock:
-                state.replicas.append(_ReplicaState(handle, replica_id))
-            self._bump(state.full_name)
+                state.replicas.append(replica)
         except Exception:  # noqa: BLE001
             logger.exception("failed to start replica for %s",
                              state.full_name)
@@ -289,16 +344,21 @@ class ServeController:
     def _health_check(self) -> None:
         with self._lock:
             all_replicas = [(s, r) for s in self._deployments.values()
-                            for r in s.replicas]
+                            for r in s.replicas
+                            if r.state == _ReplicaState.RUNNING]
         for state, replica in all_replicas:
             try:
                 ray_tpu.get(replica.handle.check_health.remote(), timeout=5.0)
-                replica.healthy = True
-            except Exception:  # noqa: BLE001 — mark dead, reconcile restarts
-                logger.warning("replica %s failed health check",
-                               replica.replica_id)
-                if replica.healthy:
-                    replica.healthy = False
+                replica.consecutive_failures = 0
+            except Exception:  # noqa: BLE001 — tolerate transient stalls
+                replica.consecutive_failures += 1
+                logger.warning(
+                    "replica %s failed health check (%d/%d)",
+                    replica.replica_id, replica.consecutive_failures,
+                    HEALTH_CHECK_FAILURE_THRESHOLD)
+                if (replica.consecutive_failures
+                        >= HEALTH_CHECK_FAILURE_THRESHOLD):
+                    replica.state = _ReplicaState.UNHEALTHY
                     self._bump(state.full_name)
 
     def _autoscale(self) -> None:

@@ -2272,19 +2272,29 @@ class CoreWorker:
         )
 
     def _free_owned_object(self, oid: ObjectID, locations):
+        # Runs from arbitrary contexts — including ON the submission event
+        # loop (ref drops in _on_task_reply when a task's last plasma arg
+        # dies). Every outbound notification here must therefore be
+        # fire-and-forget: one blocking raylet/peer RPC from the loop
+        # thread wedges the entire actor-task transport (the long-poll
+        # starvation bug this replaced).
         entry = self.memory_store.get_entry(oid)
         self.memory_store.delete([oid])
         if (entry is not None and entry.in_plasma and self.plasma is not None
                 and (entry.plasma_node is None or self.node_id is None
                      or entry.plasma_node == self.node_id.hex())):
-            self.plasma.free(oid)
+            self.plasma.free_local(oid)
+            if self._raylet is not None:
+                self._fire(self._raylet.send_async(
+                    "free_spilled", {"object_ids": [oid]}))
         if isinstance(locations, str):  # tolerate old single-location form
             locations = [locations]
         for location in locations or []:
             if location == self.address_str:
                 continue
             try:
-                self._peers.get(location).send("free_objects", {"object_ids": [oid]})
+                self._fire(self._peers.get(location).send_async(
+                    "free_objects", {"object_ids": [oid]}))
             except ConnectionLost:
                 pass
 

@@ -119,10 +119,17 @@ class PlasmaProvider:
 
     # -- lifecycle ----------------------------------------------------------
 
-    def free(self, oid: ObjectID) -> None:
-        """Delete the object (server defers the slot free until the last
-        pinned reader view dies) and drop any spilled copy."""
+    def free_local(self, oid: ObjectID) -> None:
+        """Delete the local store copy only (server defers the slot free
+        until the last pinned reader view dies). Safe from the event loop:
+        one non-blocking UDS message, no RPC round trip — the caller is
+        responsible for notifying the raylet about spilled copies."""
         self._client.delete(oid.binary())
+
+    def free(self, oid: ObjectID) -> None:
+        """Delete the object and drop any spilled copy. Blocking (raylet
+        round trip): never call from an event-loop thread."""
+        self.free_local(oid)
         if self._raylet_call is not None:
             try:
                 self._raylet_call("free_spilled", {"object_ids": [oid]})
