@@ -15,6 +15,11 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+import pytest
+
+pytestmark = pytest.mark.slow  # stress/e2e tier (see pytest.ini)
+
+
 def _env():
     env = dict(os.environ, JAX_PLATFORMS="cpu",
                PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))

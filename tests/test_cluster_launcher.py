@@ -47,6 +47,9 @@ FAKE_SSH = textwrap.dedent("""\
     """)
 
 
+pytestmark = pytest.mark.slow  # stress/e2e tier (see pytest.ini)
+
+
 @pytest.fixture
 def fake_ssh_env(tmp_path, monkeypatch):
     ssh = tmp_path / "fakessh"

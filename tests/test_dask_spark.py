@@ -15,6 +15,9 @@ from ray_tpu.util.dask import enable_dask_on_ray, ray_dask_get
 from ray_tpu.util.spark import setup_spark_on_ray, spark_available
 
 
+pytestmark = pytest.mark.slow  # stress/e2e tier (see pytest.ini)
+
+
 def test_ray_dask_get_graph(ray_start_regular):
     dsk = {
         "a": 1,

@@ -12,6 +12,9 @@ from ray_tpu import data
 from ray_tpu.data._internal import tfrecords as tfr
 
 
+pytestmark = pytest.mark.slow  # stress/e2e tier (see pytest.ini)
+
+
 def test_crc32c_known_vectors():
     # Standard CRC32C test vectors (RFC 3720 appendix; "123456789").
     assert tfr.crc32c(b"123456789") == 0xE3069283

@@ -14,6 +14,9 @@ import ray_tpu
 from ray_tpu.scripts.scripts import main as cli_main
 
 
+pytestmark = pytest.mark.slow  # stress/e2e tier (see pytest.ini)
+
+
 def _drain(node, reason="test", deadline_s=60.0):
     from ray_tpu._raylet import get_core_worker
 

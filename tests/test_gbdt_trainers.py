@@ -107,6 +107,9 @@ LGBM_STUB = textwrap.dedent("""\
     """)
 
 
+pytestmark = pytest.mark.slow  # stress/e2e tier (see pytest.ini)
+
+
 @pytest.fixture
 def stub_libs(tmp_path, monkeypatch):
     (tmp_path / "xgboost.py").write_text(XGB_STUB)

@@ -21,6 +21,9 @@ from ray_tpu.train.lightning import (
 )
 
 
+pytestmark = pytest.mark.slow  # stress/e2e tier (see pytest.ini)
+
+
 def _make_tiny_bert_trainer_init():
     """Returns the per-worker init fn as a LOCAL closure so it serializes
     by value (a test-module global would need the test file importable on

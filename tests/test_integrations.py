@@ -13,6 +13,9 @@ import ray_tpu
 from ray_tpu import data, serve, tune
 
 
+pytestmark = pytest.mark.slow  # stress/e2e tier (see pytest.ini)
+
+
 @pytest.fixture
 def serve_shutdown():
     yield

@@ -16,6 +16,9 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+pytestmark = pytest.mark.slow  # stress/e2e tier (see pytest.ini)
+
+
 @pytest.fixture()
 def job_client(ray_start_regular):
     from ray_tpu.job_submission import JobSubmissionClient

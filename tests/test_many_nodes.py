@@ -14,6 +14,9 @@ from ray_tpu._private.ids import NodeID
 from ray_tpu._private.specs import NodeInfo
 
 
+pytestmark = pytest.mark.slow  # stress/e2e tier (see pytest.ini)
+
+
 def _mk_manager():
     from ray_tpu.gcs import pubsub as ps
     from ray_tpu.gcs.server import GcsNodeManager

@@ -14,6 +14,9 @@ from ray_tpu.parallel.sharding import LogicalAxisRules, logical_sharding
 from ray_tpu.train.step import init_train_state, make_train_step
 
 
+pytestmark = pytest.mark.slow  # stress/e2e tier (see pytest.ini)
+
+
 def _f32(cfg_cls, **kw):
     base = cfg_cls.tiny()
     return cfg_cls(**{**base.__dict__, "dtype": jnp.float32,

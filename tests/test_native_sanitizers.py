@@ -14,6 +14,8 @@ import sys
 
 import pytest
 
+pytestmark = pytest.mark.slow  # stress/e2e tier (see pytest.ini)
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 _EXERCISE = r"""
@@ -22,6 +24,7 @@ from ray_tpu._private.shm_store import StoreServer, StoreClient
 
 sock = os.path.join(tempfile.mkdtemp(), "store.sock")
 server = StoreServer(sock, capacity=64 << 20)
+
 
 def hammer(tid):
     client = StoreClient(sock)

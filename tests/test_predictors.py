@@ -21,6 +21,9 @@ from ray_tpu.train import (
 _linear_apply = lambda params, x: x @ params["w"] + params["b"]  # noqa: E731
 
 
+pytestmark = pytest.mark.slow  # stress/e2e tier (see pytest.ini)
+
+
 @pytest.fixture
 def jax_checkpoint(tmp_path):
     params = {"w": np.array([[2.0], [1.0]], np.float32),

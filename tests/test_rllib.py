@@ -5,6 +5,9 @@ import numpy as np
 import pytest
 
 
+pytestmark = pytest.mark.slow  # stress/e2e tier (see pytest.ini)
+
+
 def test_replay_buffer():
     from ray_tpu.rllib import ReplayBuffer
 

@@ -11,6 +11,9 @@ from ray_tpu.rllib import (
 )
 
 
+pytestmark = pytest.mark.slow  # stress/e2e tier (see pytest.ini)
+
+
 class _Box:
     def __init__(self, dim):
         self.shape = (dim,)

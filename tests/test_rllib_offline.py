@@ -14,6 +14,9 @@ from ray_tpu.rllib.offline import (
 )
 
 
+pytestmark = pytest.mark.slow  # stress/e2e tier (see pytest.ini)
+
+
 def _cartpole_expert_episodes(n_episodes=40, seed=0, noise=0.0):
     """Scripted CartPole expert (angle+angular-velocity controller,
     ~500 return) with optional epsilon-noise; returns episode batches with

@@ -17,6 +17,9 @@ import pytest
 import ray_tpu
 
 
+pytestmark = pytest.mark.slow  # stress/e2e tier (see pytest.ini)
+
+
 def _write_stub_docker(tmp_path):
     log = tmp_path / "docker_invocations.log"
     stub = tmp_path / "docker"

@@ -19,6 +19,11 @@ from ray_tpu.util.placement_group import (
 FULL = os.environ.get("RT_STRESS_FULL") == "1"
 
 
+import pytest
+
+pytestmark = pytest.mark.slow  # stress/e2e tier (see pytest.ini)
+
+
 def test_10k_queued_tasks(ray_start_regular):
     """10k tasks queued on one owner, batched pushes drain them."""
     n = 100_000 if FULL else 10_000

@@ -17,6 +17,9 @@ from ray_tpu.air import (
 from ray_tpu.train import Checkpoint, DataParallelTrainer, JaxConfig, JaxTrainer
 
 
+pytestmark = pytest.mark.slow  # stress/e2e tier (see pytest.ini)
+
+
 @pytest.fixture
 def storage(tmp_path):
     return str(tmp_path / "results")
