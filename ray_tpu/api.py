@@ -51,12 +51,20 @@ class _HeadNode:
         )
         self.raylet_address = self.raylet.start(0)
         self.dashboard = None
+        self.dashboard_agent = None
         if include_dashboard:
             from ray_tpu.dashboard import DashboardHead
+            from ray_tpu.dashboard.agent import DashboardAgent
 
             self.dashboard = DashboardHead(self.gcs_address, port=0)
+            self.dashboard_agent = DashboardAgent(
+                self.gcs_address, self.raylet.node_id.hex(),
+                self.raylet_address)
 
     def stop(self):
+        if self.dashboard_agent is not None:
+            self.dashboard_agent.stop()
+            self.dashboard_agent = None
         if self.dashboard is not None:
             self.dashboard.stop()
             self.dashboard = None

@@ -1,0 +1,306 @@
+"""Per-node dashboard agent.
+
+Reference: ray dashboard/agent.py (DashboardAgent) + the reporter module
+(dashboard/modules/reporter/reporter_agent.py — /proc stats; and
+profile_manager.py — py-spy/memray endpoints). One agent runs next to each
+raylet and owns the NODE-LOCAL views the head process can't see: per-worker
+process stats from /proc, log file tails, and live profiling of local
+workers. The head dashboard discovers agents through a GCS KV registration
+(`dashboard_agent:<node_id>` -> http address) and transparently proxies
+`/api/nodes/<node_id>/...` to them.
+
+Design notes (TPU-first, single-language): the reference runs the agent as
+a raylet-supervised child process with its own gRPC + HTTP servers; here
+the agent is an HTTP thread inside the node process (raylet and agent
+share a pid — one fewer process per host on small nodes), talking to its
+raylet over the same asyncio RPC every other component uses. Profiling
+needs no ptrace helper (py-spy) because workers self-sample
+(util/profiling.py) behind the raylet's profile_worker RPC.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import logging
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional
+from urllib.parse import parse_qs, urlparse
+
+logger = logging.getLogger(__name__)
+
+AGENT_KV_PREFIX = "dashboard_agent:"
+
+_WORKER_CMDLINE_MARKS = (
+    b"ray_tpu._private.workers.default_worker",
+    b"ray_tpu._private.workers.zygote",
+)
+
+
+def _read_proc_stat(pid: int) -> Optional[Dict[str, Any]]:
+    """One process's rss/cpu ticks from /proc/<pid>/stat (no psutil in
+    the image; the fields are stable kernel ABI)."""
+    try:
+        with open(f"/proc/{pid}/stat", "rb") as fh:
+            raw = fh.read().decode("ascii", "replace")
+        # comm may contain spaces/parens: split after the LAST ')'
+        rest = raw[raw.rindex(")") + 2:].split()
+        utime, stime = int(rest[11]), int(rest[12])
+        rss_pages = int(rest[21])
+        return {
+            "pid": pid,
+            "cpu_ticks": utime + stime,
+            "rss_bytes": rss_pages * os.sysconf("SC_PAGE_SIZE"),
+        }
+    except (OSError, ValueError, IndexError):
+        return None
+
+
+def _node_cpu_ticks() -> Optional[tuple]:
+    try:
+        with open("/proc/stat", "rb") as fh:
+            first = fh.readline().split()
+        vals = [int(v) for v in first[1:]]
+        idle = vals[3] + (vals[4] if len(vals) > 4 else 0)
+        return sum(vals), idle
+    except (OSError, ValueError, IndexError):
+        return None
+
+
+def _meminfo() -> Dict[str, int]:
+    out = {}
+    try:
+        with open("/proc/meminfo", "rb") as fh:
+            for line in fh:
+                k, _, v = line.decode().partition(":")
+                if k in ("MemTotal", "MemAvailable"):
+                    out[k] = int(v.split()[0]) * 1024
+    except (OSError, ValueError):
+        pass
+    return {"total_bytes": out.get("MemTotal", 0),
+            "available_bytes": out.get("MemAvailable", 0)}
+
+
+def _worker_pids() -> List[int]:
+    pids = []
+    for p in glob.glob("/proc/[0-9]*/cmdline"):
+        try:
+            with open(p, "rb") as fh:
+                cmdline = fh.read()
+        except OSError:
+            continue
+        if any(m in cmdline for m in _WORKER_CMDLINE_MARKS):
+            pids.append(int(p.split("/")[2]))
+    return pids
+
+
+class DashboardAgent:
+    """Node-local stats/logs/profiling over HTTP; self-registers in GCS KV
+    so the head can proxy to it."""
+
+    def __init__(self, gcs_address: str, node_id: str,
+                 raylet_address: str, host: str = "127.0.0.1",
+                 port: int = 0):
+        from ray_tpu._private.config import CONFIG
+        from ray_tpu._private.rpc import EventLoopThread, RpcClient
+
+        self.node_id = node_id
+        self.log_dir = CONFIG.log_dir
+        self._lt = EventLoopThread(f"dash-agent-{node_id[:8]}")
+        self._gcs = RpcClient(gcs_address, self._lt)
+        self._raylet = RpcClient(raylet_address, self._lt)
+        # previous cpu sample, for utilization deltas between requests;
+        # ThreadingHTTPServer handles requests concurrently, so the
+        # read-modify-write of the baseline needs the lock
+        self._stats_lock = threading.Lock()
+        self._last_node = _node_cpu_ticks()
+        self._last_proc: Dict[int, int] = {}
+        self._last_t = time.monotonic()
+        agent = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # noqa: N802 — quiet
+                pass
+
+            def do_GET(self):  # noqa: N802 — http.server API
+                try:
+                    agent._route(self)
+                except BrokenPipeError:
+                    pass
+                except Exception as e:  # noqa: BLE001
+                    logger.exception("agent request failed")
+                    try:
+                        self.send_error(500, str(e))
+                    except Exception:  # noqa: BLE001
+                        pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.url = f"http://{host}:{self._httpd.server_address[1]}"
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name=f"dash-agent-{node_id[:8]}")
+        self._thread.start()
+        self._register()
+
+    def _register(self) -> None:
+        try:
+            self._gcs.call("kv_put", {
+                "key": f"{AGENT_KV_PREFIX}{self.node_id}",
+                "value": self.url.encode(), "overwrite": True}, timeout=10)
+        except Exception:  # noqa: BLE001 — head just won't proxy to us
+            logger.warning("agent KV registration failed", exc_info=True)
+
+    # -- routing -------------------------------------------------------------
+
+    def _route(self, req: BaseHTTPRequestHandler) -> None:
+        parsed = urlparse(req.path)
+        q = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+        if parsed.path == "/api/local/stats":
+            self._json(req, self.stats())
+        elif parsed.path == "/api/local/logs":
+            self._json(req, self.log_tail(q.get("name", ""),
+                                          int(q.get("lines", 200))))
+        elif parsed.path == "/api/local/profile":
+            self._json(req, self.profile(
+                int(q.get("pid", 0)),
+                kind=q.get("kind", "cpu"),
+                duration_s=float(q.get("duration", 5.0))))
+        else:
+            req.send_error(404, "unknown agent path")
+
+    def _json(self, req, obj: Any) -> None:
+        body = json.dumps(obj).encode()
+        req.send_response(200)
+        req.send_header("Content-Type", "application/json")
+        req.send_header("Content-Length", str(len(body)))
+        req.end_headers()
+        req.wfile.write(body)
+
+    # -- endpoints -----------------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        """Node + per-worker-process utilization since the last call."""
+        with self._stats_lock:
+            return self._stats_locked()
+
+    def _stats_locked(self) -> Dict[str, Any]:
+        now = time.monotonic()
+        dt = max(1e-3, now - self._last_t)
+        node_now = _node_cpu_ticks()
+        node_cpu_pct = None
+        if node_now and self._last_node:
+            total = node_now[0] - self._last_node[0]
+            idle = node_now[1] - self._last_node[1]
+            if total > 0:
+                node_cpu_pct = round(100.0 * (total - idle) / total, 1)
+        self._last_node = node_now
+
+        tick_hz = os.sysconf("SC_CLK_TCK")
+        try:
+            registered = set(self._raylet.call("list_worker_pids", {},
+                                               timeout=10))
+        except Exception:  # noqa: BLE001 — tag everything unregistered
+            registered = set()
+        workers = []
+        seen = {}
+        for pid in _worker_pids():
+            st = _read_proc_stat(pid)
+            if st is None:
+                continue
+            seen[pid] = st["cpu_ticks"]
+            prev = self._last_proc.get(pid)
+            cpu_pct = (round(100.0 * (st["cpu_ticks"] - prev)
+                             / tick_hz / dt, 1)
+                       if prev is not None else None)
+            workers.append({"pid": pid, "rss_bytes": st["rss_bytes"],
+                            "cpu_percent": cpu_pct,
+                            # registered workers are profile-able; the rest
+                            # are fork-servers sharing the worker cmdline
+                            "registered": pid in registered})
+        self._last_proc = seen
+        self._last_t = now
+        try:
+            load1, load5, load15 = os.getloadavg()
+        except OSError:
+            load1 = load5 = load15 = None
+        return {
+            "node_id": self.node_id,
+            "now": time.time(),
+            "cpu_percent": node_cpu_pct,
+            "load_avg": [load1, load5, load15],
+            "mem": _meminfo(),
+            "workers": sorted(workers, key=lambda w: -(w["rss_bytes"])),
+        }
+
+    def log_tail(self, name: str, lines: int = 200) -> Dict[str, Any]:
+        """Tail one node-local log file by basename (no path traversal:
+        the name is resolved under log_dir and must stay there)."""
+        roots = [self.log_dir, os.path.join(self.log_dir, "workers"),
+                 os.path.join(self.log_dir, "jobs")]
+        if not name:
+            files = []
+            for root in roots:
+                for f in sorted(glob.glob(os.path.join(root, "*.log"))):
+                    files.append(os.path.relpath(f, self.log_dir))
+            return {"files": files}
+        for root in roots:
+            path = os.path.realpath(os.path.join(root, os.path.basename(name)))
+            if not path.startswith(os.path.realpath(self.log_dir) + os.sep):
+                continue
+            if os.path.isfile(path):
+                with open(path, "r", errors="replace") as fh:
+                    tail = fh.readlines()[-lines:]
+                return {"name": name, "lines": tail}
+        return {"error": f"no such log: {name}"}
+
+    def profile(self, pid: int, kind: str = "cpu",
+                duration_s: float = 5.0) -> Dict[str, Any]:
+        """Live-profile a local worker through the raylet (the worker
+        self-samples; no ptrace)."""
+        try:
+            return self._raylet.call(
+                "profile_worker",
+                {"pid": pid, "kind": kind, "duration_s": duration_s,
+                 "top": 0, "stop": False},
+                timeout=duration_s + 30)
+        except Exception as e:  # noqa: BLE001 — surface to the caller
+            return {"error": str(e)}
+
+    def stop(self) -> None:
+        try:
+            self._gcs.call("kv_del", {
+                "key": f"{AGENT_KV_PREFIX}{self.node_id}"}, timeout=5)
+        except Exception:  # noqa: BLE001
+            pass
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._lt.stop()
+
+
+def main() -> int:
+    """Standalone agent (`python -m ray_tpu.dashboard.agent`) for setups
+    that want it out-of-process like the reference's."""
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--gcs-address", required=True)
+    parser.add_argument("--node-id", required=True)
+    parser.add_argument("--raylet-address", required=True)
+    parser.add_argument("--port", type=int, default=0)
+    args = parser.parse_args()
+    agent = DashboardAgent(args.gcs_address, args.node_id,
+                           args.raylet_address, port=args.port)
+    print(f"agent listening on {agent.url}")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        agent.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -68,8 +68,10 @@ async function pageOverview() {
 
 async function pageNodes() {
   const nodes = await getJSON("/api/nodes");
+  let agents = {};
+  try { agents = await getJSON("/api/agents"); } catch {}
   return `<h2>Nodes</h2>` + table(
-    ["node id", "state", "role", "address", "resources (avail / total)"],
+    ["node id", "state", "role", "address", "resources (avail / total)", ""],
     nodes.map((n) => [
       td(esc(n.node_id.slice(0, 12)), "mono"),
       statusCell(n.state),
@@ -77,8 +79,127 @@ async function pageNodes() {
       td(esc(n.raylet_address), "mono"),
       td(esc(fmtRes(n.resources_available)) + " / " +
          esc(fmtRes(n.resources_total)), "mono"),
+      td(agents[n.node_id]
+         ? `<a href="#node-${esc(n.node_id)}">detail</a>` : ""),
     ]));
 }
+
+async function pageNode(nodeId) {
+  const short = nodeId.slice(0, 12);
+  let s;
+  try { s = await getJSON(`/api/nodes/${nodeId}/stats`); }
+  catch (e) {
+    return `<h2>Node ${esc(short)}</h2>
+      <p class="error">agent unreachable: ${esc(e)}</p>`;
+  }
+  const mem = s.mem || {};
+  const gib = (b) => (b / 2 ** 30).toFixed(2);
+  const tiles = [
+    ["node CPU %", s.cpu_percent ?? "…"],
+    ["load (1m)", (s.load_avg || [])[0]?.toFixed?.(2) ?? "-"],
+    ["mem avail", `${gib(mem.available_bytes || 0)} /
+                   ${gib(mem.total_bytes || 0)} GiB`],
+    ["workers", (s.workers || []).length],
+  ].map(([k, v]) => `<div class="tile"><div class="v">${v}</div>
+      <div class="k">${k}</div></div>`).join("");
+  const workers = table(
+    ["pid", "kind", "rss", "cpu %", "profile"],
+    (s.workers || []).map((w) => [
+      td(w.pid, "mono"),
+      td(w.registered ? "worker" : "fork-server"),
+      td(`${(w.rss_bytes / 2 ** 20).toFixed(1)} MiB`),
+      td(w.cpu_percent ?? "…"),
+      td(w.registered
+         ? `<button class="secondary"
+             onclick="profileWorker('${esc(nodeId)}', ${w.pid})">
+             cpu 5s</button>` : ""),
+    ]));
+  return `<h2>Node ${esc(short)}</h2><div class="tiles">${tiles}</div>
+    <h3>Worker processes</h3>${workers}
+    <div id="profile-out"></div>`;
+}
+
+window.profileWorker = async (nodeId, pid) => {
+  const out = $("#profile-out");
+  window._busy = true;  // pause auto-rerender while sampling
+  out.innerHTML = `<h3>profile pid ${pid}</h3>
+    <pre class="logbox">sampling 5s…</pre>`;
+  try {
+    const r = await getJSON(
+      `/api/nodes/${nodeId}/profile?pid=${pid}&duration=5`);
+    const folded = Object.entries(r.folded || {})
+      .sort((a, b) => b[1] - a[1])
+      .map(([k, v]) => `${k} ${v}`).join("\n");
+    out.querySelector("pre").textContent =
+      r.error ? `error: ${r.error}`
+      : folded || JSON.stringify(r, null, 2);
+  } catch (e) { out.querySelector("pre").textContent = String(e); }
+  window._busy = false;
+};
+
+const TIMELINE_MAX_SPANS = 2000;
+
+async function pageTimeline() {
+  const trace = await getJSON("/api/timeline");
+  window._trace = trace;  // for the on-click chrome-trace download
+  let spans = trace.filter((e) => e.ph === "X");
+  const total = spans.length;
+  if (!total) {
+    return `<h2>Task timeline</h2>
+      <p class="muted">no finished tasks recorded yet.</p>`;
+  }
+  // keep the DOM bounded on long histories: newest spans win
+  spans.sort((a, b) => a.ts - b.ts);
+  spans = spans.slice(-TIMELINE_MAX_SPANS);
+  const t0 = Math.min(...spans.map((e) => e.ts));
+  const t1 = Math.max(...spans.map((e) => e.ts + (e.dur || 0)));
+  const range = Math.max(1, t1 - t0);
+  // one swimlane per worker thread, grouped by node
+  const lanes = new Map();
+  for (const e of spans) {
+    const key = `${e.pid} · ${e.tid}`;
+    if (!lanes.has(key)) lanes.set(key, []);
+    lanes.get(key).push(e);
+  }
+  const laneHtml = [...lanes.entries()].map(([key, evs]) => {
+    const bars = evs.map((e) => {
+      const left = (100 * (e.ts - t0) / range).toFixed(3);
+      const width = Math.max(0.15, 100 * (e.dur || 0) / range).toFixed(3);
+      const ms = ((e.dur || 0) / 1000).toFixed(1);
+      const parent = e.args?.parent
+        ? ` ← ${String(e.args.parent).slice(0, 8)}` : "";
+      return `<div class="span" style="left:${left}%;width:${width}%"
+        title="${esc(e.name)} (${ms} ms)${esc(parent)}
+task ${esc(String(e.args?.task_id || "").slice(0, 12))}">
+        ${esc(e.name)}</div>`;
+    }).join("");
+    return `<div class="lane"><div class="lane-label mono">
+      ${esc(key)}</div><div class="lane-track">${bars}</div></div>`;
+  }).join("");
+  const shown = spans.length < total
+    ? ` (showing newest ${spans.length} of ${total})` : "";
+  return `<h2>Task timeline
+    <span class="muted">(${total} spans${shown},
+     ${((t1 - t0) / 1e6).toFixed(2)}s)</span></h2>
+    <p><a href="#" onclick="return downloadTrace()">download chrome
+      trace</a>
+      <span class="muted"> — open in Perfetto / chrome://tracing for the
+      full flow-arrow tree</span></p>
+    <div class="timeline">${laneHtml}</div>`;
+}
+
+window.downloadTrace = () => {
+  // built on demand: serializing the whole trace into an href on every
+  // 5s auto-refresh would churn MBs of attribute data
+  const blob = new Blob([JSON.stringify(window._trace || [])],
+                        {type: "application/json"});
+  const a = document.createElement("a");
+  a.href = URL.createObjectURL(blob);
+  a.download = "timeline.json";
+  a.click();
+  setTimeout(() => URL.revokeObjectURL(a.href), 5000);
+  return false;
+};
 
 function fmtRes(r) {
   return Object.entries(r || {}).sort()
@@ -231,28 +352,38 @@ async function pageLogs() {
 const PAGES = {
   overview: pageOverview, nodes: pageNodes, actors: pageActors,
   tasks: pageTasks, jobs: pageJobs, pgs: pagePGs, serve: pageServe,
-  logs: pageLogs,
+  logs: pageLogs, timeline: pageTimeline,
 };
 let timer = null;
 
 async function render() {
   const page = (location.hash || "#overview").slice(1);
-  const fn = PAGES[page] || pageOverview;
+  const fn = page.startsWith("node-")
+    ? () => pageNode(page.slice(5))
+    : PAGES[page] || pageOverview;
   document.querySelectorAll("#nav a").forEach((a) =>
-    a.classList.toggle("active", a.hash === `#${page}`));
+    a.classList.toggle("active", a.hash === `#${page}` ||
+      (a.hash === "#nodes" && page.startsWith("node-"))));
   try {
     const html = await fn();
-    // jobs page holds form state + log panes: skip auto-rerender clobber
+    // jobs page holds form state + log/profile panes: skip auto-rerender
+    // clobber (and never clobber while a profile is sampling)
     if ((location.hash || "#overview").slice(1) === page) {
       const active = document.activeElement;
-      if (page !== "jobs" || !(active && active.tagName === "INPUT")) {
+      if (window._busy) { /* keep current DOM */ }
+      else if (page !== "jobs" || !(active && active.tagName === "INPUT")) {
         $("#main").innerHTML = html;
       }
     }
     $("#refresh-state").textContent =
       `updated ${new Date().toLocaleTimeString()}`;
   } catch (e) {
-    $("#main").innerHTML = `<p class="error">${esc(e)}</p>`;
+    // same guards as the success path: a transient fetch error must not
+    // clobber an in-flight profile pane or a page we've navigated off
+    if (!window._busy &&
+        (location.hash || "#overview").slice(1) === page) {
+      $("#main").innerHTML = `<p class="error">${esc(e)}</p>`;
+    }
   }
 }
 

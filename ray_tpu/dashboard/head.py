@@ -197,6 +197,24 @@ class DashboardHead:
                              "gcs_address": self.gcs_address})
         elif path == "/api/cluster_status":
             self._json(req, self._cluster_status())
+        elif path == "/api/timeline":
+            # chrome-trace task timeline (load in Perfetto / chrome://tracing,
+            # or the SPA's Timeline page)
+            from ray_tpu.util.state.api import build_chrome_trace
+
+            events = self._gcs.call(
+                "get_task_events", {"job_id": None, "limit": 100_000},
+                timeout=30)
+            self._json(req, build_chrome_trace(events))
+        elif path == "/api/agents":
+            self._json(req, self._agents())
+        elif path.startswith("/api/nodes/") and path.count("/") >= 4:
+            # per-node agent proxy: /api/nodes/<node_id>/<stats|logs|profile>
+            _, _, _, node_id, sub = path.split("/", 4)
+            self._proxy_agent(req, node_id, sub)
+        elif path.startswith("/api/nodes/"):
+            req.send_error(
+                404, "expected /api/nodes/<node_id>/<stats|logs|profile>")
         elif path.startswith("/api/"):
             kind = path[len("/api/"):]
             data = self._list(kind)
@@ -206,6 +224,44 @@ class DashboardHead:
                 self._json(req, data)
         else:
             req.send_error(404)
+
+    def _agents(self) -> Dict[str, str]:
+        """node_id -> agent http url, from the agents' KV registrations."""
+        from ray_tpu.dashboard.agent import AGENT_KV_PREFIX
+
+        out: Dict[str, str] = {}
+        try:
+            keys = self._gcs.call(
+                "kv_keys", {"prefix": AGENT_KV_PREFIX}, timeout=10)
+            vals = self._gcs.call(
+                "kv_multi_get", {"keys": list(keys)}, timeout=10)
+        except Exception:  # noqa: BLE001 — no agents registered
+            return out
+        for key, val in (vals or {}).items():
+            if val is None:
+                continue
+            k = key.decode() if isinstance(key, bytes) else key
+            v = val.decode() if isinstance(val, bytes) else val
+            out[k[len(AGENT_KV_PREFIX):]] = v
+        return out
+
+    def _proxy_agent(self, req, node_id: str, sub: str) -> None:
+        """Forward /api/nodes/<id>/<sub>?... to that node's agent
+        (reference: the head's DataOrganizer pulling per-node agent data)."""
+        import urllib.request
+        from urllib.parse import urlparse
+
+        url = self._agents().get(node_id)
+        if url is None:
+            req.send_error(404, f"no agent registered for node {node_id}")
+            return
+        query = urlparse(req.path).query
+        target = f"{url}/api/local/{sub}" + (f"?{query}" if query else "")
+        try:
+            with urllib.request.urlopen(target, timeout=60) as resp:
+                self._respond(req, resp.read().decode(), "application/json")
+        except Exception as e:  # noqa: BLE001 — agent down
+            req.send_error(502, f"agent unreachable: {e}")
 
     def _respond(self, req, body: str, ctype: str) -> None:
         data = body.encode()

@@ -330,38 +330,50 @@ class GcsKvManager:
     def _table(ns: Optional[str]) -> str:
         return "kv:" + (ns or "")
 
+    @staticmethod
+    def _key(k) -> bytes:
+        """Canonical bytes keys: clients pass str or bytes freely, but a
+        table mixing both would break prefix scans (str.startswith(bytes)
+        raises) and make the same key a silent miss."""
+        return k.encode() if isinstance(k, str) else k
+
     async def handle_kv_put(self, payload):
         overwrite = payload.get("overwrite", True)
         table = self._table(payload.get("namespace"))
-        if not overwrite and self._store.get(table, payload["key"]) is not None:
+        key = self._key(payload["key"])
+        if not overwrite and self._store.get(table, key) is not None:
             return False
-        self._store.put(table, payload["key"], payload["value"])
+        self._store.put(table, key, payload["value"])
         return True
 
     async def handle_kv_get(self, payload):
-        return self._store.get(self._table(payload.get("namespace")), payload["key"])
+        return self._store.get(self._table(payload.get("namespace")),
+                               self._key(payload["key"]))
 
     async def handle_kv_multi_get(self, payload):
         table = self._table(payload.get("namespace"))
-        return {k: self._store.get(table, k) for k in payload["keys"]}
+        return {k: self._store.get(table, self._key(k))
+                for k in payload["keys"]}
 
     async def handle_kv_del(self, payload):
         table = self._table(payload.get("namespace"))
+        key = self._key(payload["key"])
         if payload.get("del_by_prefix"):
             n = 0
-            for k in self._store.keys(table, payload["key"]):
+            for k in self._store.keys(table, key):
                 n += int(self._store.delete(table, k))
             return n
-        return int(self._store.delete(table, payload["key"]))
+        return int(self._store.delete(table, key))
 
     async def handle_kv_keys(self, payload):
         return self._store.keys(
-            self._table(payload.get("namespace")), payload.get("prefix", b"")
-        )
+            self._table(payload.get("namespace")),
+            self._key(payload.get("prefix", b"")))
 
     async def handle_kv_exists(self, payload):
         return (
-            self._store.get(self._table(payload.get("namespace")), payload["key"])
+            self._store.get(self._table(payload.get("namespace")),
+                            self._key(payload["key"]))
             is not None
         )
 

@@ -955,6 +955,13 @@ class Raylet:
             }
         return out
 
+    async def handle_list_worker_pids(self, payload):
+        """Registered (profile-able) worker pids on this node — lets the
+        dashboard agent distinguish real workers from fork-servers, which
+        share the same cmdline in /proc."""
+        return sorted(h.pid for h in self.worker_pool._workers.values()
+                      if h.pid is not None)
+
     async def handle_profile_worker(self, payload):
         """Fan a CPU/heap profile request to one of this node's workers
         (reference: dashboard reporter profile endpoints). payload:

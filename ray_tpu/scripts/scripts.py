@@ -64,6 +64,7 @@ def cmd_start(args) -> int:
                         resources=resources or None, is_head=True)
         raylet.start(0)
         dashboard = None
+        agent = None
         if args.dashboard_port >= 0:
             try:
                 from ray_tpu.dashboard import DashboardHead
@@ -73,6 +74,13 @@ def cmd_start(args) -> int:
                 print(f"Dashboard: {dashboard.url}")
             except OSError as e:
                 print(f"dashboard disabled: {e}", file=sys.stderr)
+            try:
+                from ray_tpu.dashboard.agent import DashboardAgent
+
+                agent = DashboardAgent(gcs_address, raylet.node_id.hex(),
+                                       raylet.address)
+            except Exception as e:  # noqa: BLE001 — node runs without one
+                print(f"dashboard agent disabled: {e}", file=sys.stderr)
         _write_pidfile("head", {"address": gcs_address})
         print(f"Started head node.\n\n  GCS address: {gcs_address}\n\n"
               f"To add a worker node:\n"
@@ -82,6 +90,8 @@ def cmd_start(args) -> int:
               f"RT_ADDRESS={gcs_address}")
         if args.block:
             _block_forever()
+            if agent is not None:
+                agent.stop()
             if dashboard is not None:
                 dashboard.stop()
             raylet.stop()
@@ -97,10 +107,20 @@ def cmd_start(args) -> int:
     raylet = Raylet(gcs_address=args.address, resources=resources or None)
     raylet._exit_on_drain = True  # a drained worker process exits cleanly
     raylet.start(0)
+    agent = None
+    try:
+        from ray_tpu.dashboard.agent import DashboardAgent
+
+        agent = DashboardAgent(args.address, raylet.node_id.hex(),
+                               raylet.address)
+    except Exception as e:  # noqa: BLE001 — node runs without one
+        print(f"dashboard agent disabled: {e}", file=sys.stderr)
     _write_pidfile("worker", {"address": args.address})
     print(f"Started worker node; joined {args.address}")
     if args.block:
         _block_forever()
+        if agent is not None:
+            agent.stop()
         raylet.stop()
     return 0
 

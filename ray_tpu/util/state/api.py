@@ -221,9 +221,15 @@ def task_timeline_events(limit: int = 100_000) -> list:
     """Chrome-trace 'X' events built from GCS task events (reference:
     _private/state.py:434 chrome_tracing_dump — what `ray timeline` and
     `ray.timeline()` emit)."""
-    # list_tasks returns newest-first; pairing needs chronological order
-    events = sorted(list_tasks(limit=limit, raw_events=True),
-                    key=lambda e: e["time"])
+    return build_chrome_trace(list_tasks(limit=limit, raw_events=True))
+
+
+def build_chrome_trace(events: list) -> list:
+    """Pure event-stream -> chrome-trace transform, callable from
+    processes without a core worker (the dashboard head fetches the raw
+    events over its own GCS client)."""
+    # task-event streams arrive newest-first; pairing needs chronological
+    events = sorted(events, key=lambda e: e["time"])
     trace = []
     starts = {}
     spans = {}  # task_id -> its X event (for flow-arrow endpoints)

@@ -160,3 +160,71 @@ def test_dashboard_frontend_assets(dash_cluster):
 
     with pytest.raises(urllib.error.HTTPError):
         _get(base + "/static/../head.py")
+
+
+def test_node_agent_stats_logs_profile(dash_cluster):
+    """Per-node agent (reference: dashboard/agent.py + reporter module):
+    the head proxies /api/nodes/<id>/... to the node's agent for /proc
+    stats, log tails, and live worker profiling."""
+    import time
+
+    import ray_tpu
+
+    base = dash_cluster.dashboard_url
+
+    # run something so a worker process exists to see/profile
+    @ray_tpu.remote
+    def spin(sec):
+        t0 = time.time()
+        while time.time() - t0 < sec:
+            sum(range(1000))
+        return 1
+
+    ref = spin.remote(8.0)
+    time.sleep(1.0)
+
+    agents = json.loads(_get(base + "/api/agents"))
+    assert len(agents) == 1
+    node_id = next(iter(agents))
+
+    stats = json.loads(_get(base + f"/api/nodes/{node_id}/stats"))
+    assert stats["node_id"] == node_id
+    assert stats["mem"]["total_bytes"] > 0
+    assert stats["workers"], "agent saw no worker processes"
+    pids = [w["pid"] for w in stats["workers"] if w["registered"]]
+    assert pids, "no registered (profile-able) workers in agent stats"
+
+    logs = json.loads(_get(base + f"/api/nodes/{node_id}/logs"))
+    assert isinstance(logs["files"], list)
+
+    prof = json.loads(_get(
+        base + f"/api/nodes/{node_id}/profile?pid={pids[0]}&duration=1.5"))
+    assert "folded" in prof and prof["samples"] > 0
+    assert ray_tpu.get(ref, timeout=60) == 1
+
+
+def test_dashboard_timeline_endpoint(dash_cluster):
+    """Chrome-trace timeline over HTTP, built head-side from GCS task
+    events (no core worker in the dashboard process)."""
+    import time
+
+    import ray_tpu
+
+    @ray_tpu.remote
+    def child():
+        return 2
+
+    @ray_tpu.remote
+    def parent():
+        return ray_tpu.get(child.remote())
+
+    assert ray_tpu.get(parent.remote(), timeout=60) == 2
+    time.sleep(1.2)  # task-event flush interval
+
+    base = dash_cluster.dashboard_url
+    trace = json.loads(_get(base + "/api/timeline"))
+    spans = [e for e in trace if e.get("ph") == "X"]
+    names = {e["name"] for e in spans}
+    assert {"parent", "child"} <= names
+    # flow arrows from the propagated trace context render the tree
+    assert any(e.get("ph") == "s" for e in trace)
