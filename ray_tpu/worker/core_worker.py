@@ -2090,6 +2090,13 @@ class CoreWorker:
         spec: TaskSpec = payload["spec"]
         self._record_task_event(spec, "EXECUTING")
         reply = await self.executor.execute(spec)
+        if spec.task_type == TaskType.ACTOR_CREATION_TASK:
+            # creation tasks have no owner-side _finalize_task (the GCS
+            # pushes them); record completion here or the timeline shows
+            # every __init__ as never finishing
+            ok = (reply.get("status") == "ok" if isinstance(reply, dict)
+                  else True)
+            self._record_task_event(spec, "FINISHED" if ok else "FAILED")
         return reply
 
     async def _handle_push_task_batch(self, payload):
