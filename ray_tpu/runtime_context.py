@@ -16,7 +16,7 @@ class RuntimeContext:
         self._cw = cw
 
     def get_job_id(self) -> str:
-        return self._cw.job_id.hex()
+        return self._cw.current_job_id().hex()
 
     def get_node_id(self) -> str:
         return self._cw.node_id.hex() if self._cw.node_id else ""

@@ -214,7 +214,14 @@ class CoreWorker:
         self._register_handlers()
         self.address_str = self._server.start(0)
         if job_id is None:
-            job_id = self._gcs.call("get_next_job_id", {})
+            if mode == "driver":
+                job_id = self._gcs.call("get_next_job_id", {})
+            else:
+                # workers inherit job context from the tasks they execute
+                # (current_job_id); allocating one here cost every worker
+                # spawn a blocking GCS round trip and mis-attributed
+                # nested submissions to a phantom job
+                job_id = JobID.nil()
         self.job_id = job_id
         self._root_task_id = TaskID.for_normal_task(job_id)
         self.address = Address(
@@ -389,6 +396,12 @@ class CoreWorker:
     def enter_task_context(self, spec: TaskSpec):
         prev = getattr(_task_ctx, "spec", None)
         _task_ctx.spec = spec
+        # process-wide fallback for threads with no task context (user
+        # threads spawned inside a task): a task worker serves one job at
+        # a time, so the last-entered job is the right attribution
+        if (spec.job_id is not None and not spec.job_id.is_nil()
+                and not self.is_actor_worker):
+            self.job_id = spec.job_id
         return prev
 
     def exit_task_context(self, token):
@@ -397,6 +410,15 @@ class CoreWorker:
     def current_task_id(self) -> TaskID:
         spec = getattr(_task_ctx, "spec", None)
         return spec.task_id if spec is not None else self._root_task_id
+
+    def current_job_id(self) -> JobID:
+        """The job this code runs under: the executing task's job inside a
+        task/actor, else the process's own (driver) job."""
+        spec = getattr(_task_ctx, "spec", None)
+        if spec is not None and spec.job_id is not None \
+                and not spec.job_id.is_nil():
+            return spec.job_id
+        return self.job_id
 
     def current_spec(self) -> Optional[TaskSpec]:
         return getattr(_task_ctx, "spec", None)
@@ -955,12 +977,13 @@ class CoreWorker:
         fid = function_id or self.register_function(fn)
         if not runtime_env_prepared:
             runtime_env = self.prepare_runtime_env(runtime_env)
-        task_id = TaskID.for_normal_task(self.job_id)
+        job_id = self.current_job_id()
+        task_id = TaskID.for_normal_task(job_id)
         streaming = num_returns == "streaming" or num_returns == -1
         arg_specs, kwarg_specs, arg_ids = self._build_args(args, kwargs)
         spec = TaskSpec(
             task_id=task_id,
-            job_id=self.job_id,
+            job_id=job_id,
             task_type=TaskType.NORMAL_TASK,
             function_id=fid,
             function_name=name or getattr(fn, "__name__", "task"),
@@ -1450,7 +1473,8 @@ class CoreWorker:
         is_asyncio: bool = False,
         runtime_env: Optional[dict] = None,
     ) -> ActorID:
-        actor_id = ActorID.of(self.job_id)
+        job_id = self.current_job_id()
+        actor_id = ActorID.of(job_id)
         fid = self.register_function(cls)
         runtime_env = self.prepare_runtime_env(runtime_env)
         if max_concurrency is None:
@@ -1468,7 +1492,7 @@ class CoreWorker:
         arg_specs, kwarg_specs, arg_ids = self._build_args(args, kwargs)
         spec = TaskSpec(
             task_id=TaskID.for_actor_creation_task(actor_id),
-            job_id=self.job_id,
+            job_id=job_id,
             task_type=TaskType.ACTOR_CREATION_TASK,
             function_id=fid,
             function_name=getattr(cls, "__name__", "Actor") + ".__init__",
@@ -1677,7 +1701,7 @@ class CoreWorker:
         arg_specs, kwarg_specs, arg_ids = self._build_args(args, kwargs)
         spec = TaskSpec(
             task_id=task_id,
-            job_id=self.job_id,
+            job_id=self.current_job_id(),
             task_type=TaskType.ACTOR_TASK,
             function_id="",
             function_name=method_name,
@@ -1885,14 +1909,15 @@ class CoreWorker:
     def create_placement_group(
         self, bundles, strategy="PACK", name="", lifetime=None
     ) -> PlacementGroupID:
-        pg_id = PlacementGroupID.of(self.job_id)
+        job_id = self.current_job_id()
+        pg_id = PlacementGroupID.of(job_id)
         spec = PlacementGroupSpec(
             placement_group_id=pg_id,
             bundles=[dict(b) for b in bundles],
             strategy=strategy,
             name=name,
             lifetime=lifetime,
-            job_id=self.job_id,
+            job_id=job_id,
         )
         reply = self._gcs.call("create_placement_group", {"spec": spec})
         if reply["status"] != "ok":
@@ -2330,6 +2355,10 @@ class CoreWorker:
     def become_actor(self, creation: ActorCreationSpec):
         self.is_actor_worker = True
         self.current_actor_id = creation.actor_id
+        # pin the actor's job as this process's own: submissions from
+        # async-actor coroutines / user threads have no _task_ctx, and the
+        # nil fallback would mis-attribute them (and escape job cleanup)
+        self.job_id = creation.actor_id.job_id()
         self._gcs.call(
             "report_actor_alive",
             {"actor_id": creation.actor_id, "address": self.address, "pid": os.getpid()},
