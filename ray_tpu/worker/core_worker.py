@@ -149,6 +149,11 @@ class _ActorRecord:
     death_cause: Optional[str] = None
     max_task_retries: int = 0
     incarnation: int = 0  # observed num_restarts; seq resets per incarnation
+    # per-method MAX observed execution time: only provably-short methods
+    # may share a batched push (see _push_actor_tasks). Max, not mean — a
+    # bimodal long-poll method (usually 1ms, sometimes an hour) must never
+    # re-qualify as short.
+    method_time_max: Dict[str, float] = field(default_factory=dict)
 
 
 class CoreWorker:
@@ -1353,6 +1358,7 @@ class CoreWorker:
 
     async def _lease_reaper_loop(self):
         timeout = CONFIG.worker_lease_idle_timeout_ms / 1000.0
+        last_actor_sweep = 0.0
         while True:
             await asyncio.sleep(timeout / 2)
             now = time.monotonic()
@@ -1361,6 +1367,24 @@ class CoreWorker:
                     if not lease.busy and now - lease.idle_since > timeout:
                         st.leases.pop(addr, None)
                         asyncio.ensure_future(self._return_lease(lease))
+            if now - last_actor_sweep > 5.0:
+                last_actor_sweep = now
+                await self._sweep_stalled_actor_queues()
+
+    async def _sweep_stalled_actor_queues(self):
+        """Lost-pubsub backstop: an actor record stuck PENDING/RESTARTING
+        with queued calls re-polls the GCS. Without this, one dropped
+        ALIVE/DEAD event (subscription raced the publish) hangs every
+        caller of the queued tasks forever."""
+        for rec in list(self._actors.values()):
+            if not rec.queue or rec.state in ("ALIVE", "DEAD"):
+                continue
+            try:
+                info = await self._gcs.call_async(
+                    "get_actor_info", {"actor_id": rec.actor_id})
+            except Exception:  # noqa: BLE001 — GCS restarting; retry later
+                continue
+            self._apply_actor_info(rec, info)
 
     async def _return_lease(self, lease: _Lease):
         node = lease.address.node_id
@@ -1666,14 +1690,22 @@ class CoreWorker:
             rec.state = "DEAD"
             rec.death_cause = info.death_cause
             rec.address = None
-            while rec.queue:
-                spec = rec.queue.popleft()
-                self._store_error_for_task(
-                    spec,
-                    exc.ActorDiedError(rec.actor_id, error_message=(
-                        f"Actor {rec.actor_id.hex()[:12]} is dead: {rec.death_cause}")),
-                )
-                self._finalize_task(spec, "FAILED")
+            self._fail_actor_queue(rec)
+
+    def _fail_actor_queue(self, rec: _ActorRecord) -> None:
+        """Fail every task queued on a DEAD actor. Callable from any point
+        that discovers the death — queueing a spec after the DEAD pubsub
+        event already drained the queue would otherwise strand it (and the
+        caller's ray.get) forever."""
+        while rec.queue:
+            spec = rec.queue.popleft()
+            self._store_error_for_task(
+                spec,
+                exc.ActorDiedError(rec.actor_id, error_message=(
+                    f"Actor {rec.actor_id.hex()[:12]} is dead: "
+                    f"{rec.death_cause}")),
+            )
+            self._finalize_task(spec, "FAILED")
 
     def submit_actor_task(
         self, actor_id: ActorID, method_name: str, args: tuple, kwargs: dict,
@@ -1747,16 +1779,34 @@ class CoreWorker:
                 self._finalize_task(spec, "FAILED")
             return
         rec.queue.extend(specs)
-        # Poll GCS once in case we missed the ALIVE event.
+        # Poll GCS once in case we missed the ALIVE (or DEAD) event.
         info = await self._gcs.call_async(
             "get_actor_info", {"actor_id": actor_id})
-        if info is not None and info.state == ActorState.ALIVE and rec.state != "ALIVE":
+        self._apply_actor_info(rec, info)
+
+    def _apply_actor_info(self, rec: _ActorRecord, info) -> None:
+        """Reconcile a GCS-polled ActorInfo into the record — the polled
+        twin of _on_actor_event_async, for when the pubsub event was lost
+        or raced the subscription. A missed DEAD here left queued specs
+        (and their callers' ray.get) hanging forever."""
+        if info is None:
+            return
+        if (info.state == ActorState.ALIVE
+                and rec.state not in ("ALIVE", "DEAD")):
+            # DEAD is terminal: a stale poll reply racing the DEAD pubsub
+            # event must not resurrect the record (new submits would stop
+            # raising ActorDiedError and push to a dead address)
             rec.state = "ALIVE"
             rec.address = info.address
             if info.num_restarts > rec.incarnation:
                 rec.incarnation = info.num_restarts
                 rec.seq = 0
-            await self._flush_actor_queue(rec)
+            asyncio.ensure_future(self._flush_actor_queue(rec))
+        elif info.state == ActorState.DEAD and rec.state != "DEAD":
+            rec.state = "DEAD"
+            rec.death_cause = info.death_cause
+            rec.address = None
+            self._fail_actor_queue(rec)
 
     async def _flush_actor_queue(self, rec: _ActorRecord):
         if rec.queue and rec.state == "ALIVE" and rec.address is not None:
@@ -1782,20 +1832,81 @@ class CoreWorker:
             rec.seq += 1
             self._record_task_event(spec, "RUNNING")
         cap = max(1, CONFIG.max_tasks_per_push)
-        for chunk_start in range(0, len(specs), cap):
-            chunk = specs[chunk_start:chunk_start + cap]
-            client = self._peers.get(rec.address.rpc_address)
+        # Chunking: a batched RPC replies once, AFTER every call in it
+        # completed — so a long-running call in the batch holds every
+        # batch-mate's reply hostage (observed deadlock: tune's quick
+        # start_training batched with the hour-long next_result long-poll;
+        # tune needed start_training's error to cancel next_result).
+        # Only methods MEASURED short may share a chunk; unknown or slow
+        # methods ride their own pipelined RPC. Chunks are all in flight
+        # concurrently on the multiplexed connection (frames written in
+        # seq order; the worker's sequencing gate orders execution), so
+        # splitting costs framing bytes, not round trips.
+        # at least 50ms: scheduler preemption on a loaded host shows up as
+        # tens-of-ms execution blips, and one blip must not permanently
+        # unbatch a microsecond method
+        threshold = max(0.05, CONFIG.task_batch_latency_ms / 1000.0)
+        chunks: List[List[TaskSpec]] = []
+        cur: List[TaskSpec] = []
+        for spec in specs:
+            worst = rec.method_time_max.get(spec.method_name)
+            short = worst is not None and worst < threshold
+            if short and len(cur) < cap:
+                cur.append(spec)
+                continue
+            if cur:
+                chunks.append(cur)
+                cur = []
+            if short:
+                cur.append(spec)
+            else:
+                chunks.append([spec])
+        if cur:
+            chunks.append(cur)
+        client = self._peers.get(rec.address.rpc_address)
+
+        async def _push_chunk(chunk: List[TaskSpec]):
+            t0 = time.monotonic()
             try:
                 wire = await client.call_async(
                     "push_task_w", [spec_to_wire(s) for s in chunk],
                     timeout=None)
                 replies = [reply_from_wire(t) for t in wire]
-            except ConnectionLost:
-                await self._on_actor_push_failure(
-                    rec, specs[chunk_start:])  # this chunk + unsent rest
-                return
+            except Exception:  # noqa: BLE001 — ConnectionLost, remote
+                # handler error, reply decode failure: all mean these
+                # specs got no usable reply. Route them ALL through the
+                # push-failure path; letting any exception escape would
+                # blow up the gather and strand the OTHER chunks' specs.
+                logger.debug("actor push chunk failed", exc_info=True)
+                return chunk
+            per_call = (time.monotonic() - t0) / max(1, len(chunk))
             for spec, reply in zip(chunk, replies):
+                # prefer the worker-measured execution time: the round
+                # trip includes sequencing-gate queueing behind earlier
+                # calls, which would inflate fast methods into
+                # "long" and permanently defeat batching
+                dur = (reply.get("exec_s", per_call)
+                       if isinstance(reply, dict) else per_call)
+                prev = rec.method_time_max.get(spec.method_name, 0.0)
+                if prev >= 1.0 or dur >= 1.0:
+                    # a method that ever blocked a full second is a
+                    # long-poller: sticky, never re-batches
+                    rec.method_time_max[spec.method_name] = max(prev, dur)
+                else:
+                    # sub-second worst decays, so one preemption blip
+                    # doesn't permanently defeat batching
+                    rec.method_time_max[spec.method_name] = max(
+                        dur, prev * 0.8)
                 self._on_task_reply(spec, reply)
+            return None
+
+        if len(chunks) == 1:  # hot path: no gather/task machinery
+            failed = await _push_chunk(chunks[0]) or []
+        else:
+            results = await asyncio.gather(*(map(_push_chunk, chunks)))
+            failed = [s for chunk in results if chunk for s in chunk]
+        if failed:
+            await self._on_actor_push_failure(rec, failed)
 
     async def _on_actor_push_failure(self, rec: _ActorRecord,
                                      specs: List[TaskSpec]):
@@ -1819,6 +1930,11 @@ class CoreWorker:
         if not retry_specs:
             return
         rec.queue.extend(retry_specs)
+        if rec.state == "DEAD":
+            # the DEAD pubsub event already drained the queue before we
+            # re-queued these specs — fail them now or they hang forever
+            self._fail_actor_queue(rec)
+            return
         if rec.state == "ALIVE":
             rec.state = "RESTARTING"  # wait for pubsub to re-resolve
         # The address may simply be stale (actor already restarted):
@@ -1840,6 +1956,14 @@ class CoreWorker:
                 rec.incarnation = info.num_restarts
                 rec.seq = 0
             await self._flush_actor_queue(rec)
+            return
+        if info is not None and info.state == ActorState.DEAD:
+            # no restart coming (pubsub DEAD may have been processed before
+            # our specs were queued, or the subscription raced creation)
+            rec.state = "DEAD"
+            rec.death_cause = info.death_cause
+            rec.address = None
+            self._fail_actor_queue(rec)
 
     # -------------------------------------------------------- actor controls
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True):

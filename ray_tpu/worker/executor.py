@@ -416,6 +416,17 @@ class Executor:
         )
         if ordered:
             self._seq_gate.wait_turn(caller, spec.sequence_number)
+        # execution time EXCLUDING the gate wait, reported to the owner:
+        # its push batcher must classify methods by what they actually
+        # cost, not by how long they queued behind earlier calls
+        exec_started = time.monotonic()
+        reply = self._run_actor_body(spec, caller, ordered)
+        if isinstance(reply, dict):
+            reply["exec_s"] = time.monotonic() - exec_started
+        return reply
+
+    def _run_actor_body(self, spec: TaskSpec, caller: bytes,
+                        ordered: bool) -> dict:
         try:
             if self.actor_instance is None:
                 raise RuntimeError("actor instance not initialized")
