@@ -122,19 +122,23 @@ def test_workflow_branches_run_concurrently(ray_start_regular, wf_storage):
 
     @ray_tpu.remote
     def slow(x):
+        start = time.time()
         time.sleep(1.0)
-        return x
+        return (x, start, time.time())
 
     @ray_tpu.remote
     def join(a, b):
-        return a + b
+        return (a[0] + b[0], (a[1], a[2]), (b[1], b[2]))
 
     dag = join.bind(slow.bind(1), slow.bind(2))
-    t0 = time.perf_counter()
-    assert workflow.run(dag, workflow_id="wconc") == 3
-    dt = time.perf_counter() - t0
-    # sequential would be >= 2s; concurrent ~1s plus overhead
-    assert dt < 1.9, f"branches ran sequentially ({dt:.2f}s)"
+    total, (a0, a1), (b0, b1) = workflow.run(dag, workflow_id="wconc")
+    assert total == 3
+    # the branches' EXECUTION intervals must overlap — asserting on total
+    # wall clock flaked under CI load (worker spawn latency ate the
+    # sequential-vs-concurrent margin); interval overlap is load-proof
+    assert max(a0, b0) < min(a1, b1), (
+        f"branches ran sequentially: ({a0:.2f},{a1:.2f}) vs "
+        f"({b0:.2f},{b1:.2f})")
 
 
 def test_workflow_diamond_shared_step_runs_once(ray_start_regular,
