@@ -238,3 +238,32 @@ def test_method_decorator_num_returns(ray_start_regular):
 def test_method_decorator_rejects_unknown_options():
     with pytest.raises(ValueError, match="unsupported"):
         ray_tpu.method(num_return=2)  # typo must fail at decoration time
+
+
+def test_quick_call_reply_not_held_by_long_poll_batchmate(ray_start_regular):
+    """A quick method's reply must not wait for a long-poll method pushed
+    in the same burst (regression: batched push_task_w replied once per
+    batch, AFTER every call finished — tune's start_training error sat
+    behind next_result's hour-long poll, deadlocking the controller)."""
+    import time
+
+    @ray_tpu.remote
+    class Server:
+        def quick(self):
+            return "quick"
+
+        def long_poll(self, sleep_s: float):
+            time.sleep(sleep_s)
+            return "poll-done"
+
+    s = Server.remote()
+    # same-burst submission: both specs land in one owner pump flush.
+    # The ordered actor EXECUTES quick first (seq order) and then parks
+    # in long_poll — quick's already-computed reply must come back while
+    # long_poll is still parked, not ride the batch's combined reply.
+    quick_ref = s.quick.remote()
+    poll_ref = s.long_poll.remote(20.0)
+    t0 = time.perf_counter()
+    assert ray_tpu.get(quick_ref, timeout=15) == "quick"
+    assert time.perf_counter() - t0 < 15
+    assert ray_tpu.get(poll_ref, timeout=60) == "poll-done"
