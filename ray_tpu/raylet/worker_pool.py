@@ -267,7 +267,12 @@ class WorkerPool:
     async def _zygote_reader(self, z: subprocess.Popen):
         """Consume spawn/exit reports from one zygote process."""
         while True:
-            line = await asyncio.to_thread(z.stdout.readline)
+            try:
+                line = await asyncio.to_thread(z.stdout.readline)
+            except RuntimeError:
+                # loop's default executor already shut down (raylet
+                # teardown racing this reader): nothing left to read for
+                return
             if not line:
                 break
             try:
