@@ -202,7 +202,11 @@ class ProxyActor:
 
         app = web.Application()
         app.router.add_route("*", "/{tail:.*}", handler)
-        runner = web.AppRunner(app)
+        # no per-request INFO access log: each line would be formatted,
+        # pushed through the GCS LOG channel, and printed on the driver
+        # console — a measurable per-request tax and pure spam at serving
+        # rates (operators get request metrics from /metrics instead)
+        runner = web.AppRunner(app, access_log=None)
         loop.run_until_complete(runner.setup())
         site = web.TCPSite(runner, self._host, self._port)
         loop.run_until_complete(site.start())
