@@ -56,7 +56,40 @@ def _subprocess_benches() -> dict:
     return out
 
 
+def _backend_alive(timeout_s: float = 180.0) -> bool:
+    """Probe jax.devices() in a SUBPROCESS: on a wedged TPU tunnel it
+    blocks forever (no error), which would hang the whole bench run.
+    The timeout covers a legitimately slow first tunnel contact."""
+    import os
+    import subprocess
+
+    try:
+        subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s, check=True, capture_output=True,
+            env=dict(os.environ))
+        return True
+    except Exception:  # noqa: BLE001 — timeout / crash: backend unusable
+        return False
+
+
 def main():
+    import os
+
+    if not _backend_alive():
+        # degrade to the CPU smoke numbers rather than hanging: a dead
+        # tunnel should still produce the JSON line (with platform: cpu
+        # in the detail marking the fallback)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        print("bench: accelerator backend unreachable; falling back to "
+              "cpu smoke", file=sys.stderr)
+        # the host sitecustomize pins the platform from env at interpreter
+        # start; only the config API overrides it this late
+        import jax as _jax
+
+        _jax.config.update("jax_platforms", "cpu")
+
     import jax
     import jax.numpy as jnp
     import optax
