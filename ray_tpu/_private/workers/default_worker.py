@@ -54,12 +54,23 @@ def run_worker(raylet_address: str, gcs_address: str, node_id: str,
 
         atexit.register(_dump)
 
+    # RT_SPAWN_TIMING=<file>: append one line of bring-up phase timings
+    # per worker — how spawn-path regressions at burst scale get located
+    # (cProfile dumps don't survive the zygote children's os._exit)
+    timing_path = os.environ.get("RT_SPAWN_TIMING")
+    t0 = time.perf_counter()
     core_worker = CoreWorker(
         mode="worker",
         gcs_address=gcs_address,
         raylet_address=raylet_address,
         node_id=NodeID.from_hex(node_id),
     )
+    if timing_path:
+        try:
+            with open(timing_path, "a") as fh:
+                fh.write(f"{os.getpid()} ctor={time.perf_counter()-t0:.4f}\n")
+        except OSError:
+            pass
 
     def _term(_sig, _frm):
         sys.exit(0)
