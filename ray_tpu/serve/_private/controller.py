@@ -48,13 +48,16 @@ HEALTH_CHECK_FAILURE_THRESHOLD = 3
 class _ReplicaState:
     STARTING = "STARTING"
     RUNNING = "RUNNING"
+    DRAINING = "DRAINING"  # deregistered from routers, kill pending
     UNHEALTHY = "UNHEALTHY"
 
-    def __init__(self, handle, replica_id: str):
+    def __init__(self, handle, replica_id: str, version: str = ""):
         self.handle = handle
         self.replica_id = replica_id
+        self.version = version
         self.state = _ReplicaState.STARTING
         self.started_at = time.monotonic()
+        self.drain_since = 0.0
         # check_health queued behind __init__: resolves iff init succeeded
         self.init_ref = None
         self.consecutive_failures = 0
@@ -119,11 +122,28 @@ class ServeController:
                 key = f"{app_name}#{cfg['name']}"
                 existing = self._deployments.get(key)
                 if existing is not None:
+                    same_version = (existing.config.get("version")
+                                    == cfg.get("version"))
+                    user_cfg_changed = (existing.config.get("user_config")
+                                        != cfg.get("user_config"))
                     existing.config = cfg
                     if not existing.autoscaling:
                         existing.target_num_replicas = cfg.get(
                             "num_replicas", 1)
                     existing.autoscaling = cfg.get("autoscaling_config")
+                    if (same_version and user_cfg_changed
+                            and cfg.get("user_config") is not None):
+                        # same code, new user_config: reconfigure the
+                        # live replicas in place (reference semantics —
+                        # only a code/option change rolls replicas)
+                        for r in existing.replicas:
+                            try:
+                                r.handle.reconfigure.remote(
+                                    cfg["user_config"])
+                            except Exception:  # noqa: BLE001
+                                pass
+                    # a version change needs no action here: _reconcile
+                    # rolls outdated replicas one at a time
                 else:
                     self._deployments[key] = _DeploymentState(
                         app_name, cfg["name"], cfg)
@@ -275,9 +295,11 @@ class ServeController:
             states = list(self._deployments.values())
         for state in states:
             self._check_starting(state)
+            self._reap_draining(state)
             with self._lock:
                 alive = [r for r in state.replicas
-                         if r.state != _ReplicaState.UNHEALTHY]
+                         if r.state in (_ReplicaState.STARTING,
+                                        _ReplicaState.RUNNING)]
                 want = state.target_num_replicas
                 to_start = want - len(alive)
                 dead = [r for r in state.replicas
@@ -288,6 +310,17 @@ class ServeController:
                     state.replicas.remove(r)
             if dead:
                 self._bump(state.full_name)
+            want_v = state.config.get("version", "")
+            with self._lock:
+                rolling = any(r.version != want_v for r in state.replicas
+                              if r.state in (_ReplicaState.STARTING,
+                                             _ReplicaState.RUNNING))
+            if rolling:
+                # version change in progress: the roll manages the count
+                # (incl. its +1 surge and any simultaneous scale-down) —
+                # neither the start loop nor the trim below may fight it
+                self._roll_outdated(state)
+                continue
             for _ in range(max(0, to_start)):
                 self._start_replica(state)
             if to_start < 0:
@@ -296,7 +329,8 @@ class ServeController:
                     # routed to them yet
                     ranked = sorted(
                         (r for r in state.replicas
-                         if r.state != _ReplicaState.UNHEALTHY),
+                         if r.state in (_ReplicaState.STARTING,
+                                        _ReplicaState.RUNNING)),
                         key=lambda r: r.state == _ReplicaState.RUNNING)
                     excess = ranked[:-to_start]
                     for r in excess:
@@ -305,6 +339,63 @@ class ServeController:
                     self._stop_replica(r)
                 if excess:
                     self._bump(state.full_name)
+
+    def _roll_outdated(self, state: _DeploymentState) -> None:
+        """Rolling code update (reference: deployment_state.py versioned
+        replica replacement): when the deployment's version changed, surge
+        ONE new-version replica at a time and retire an outdated one only
+        after a new-version replica is RUNNING — the replica set never
+        dips below target, so updates are zero-downtime. Retirement
+        drains first (deregister from routers, kill after a grace tick).
+        A simultaneous count decrease retires outdated replicas directly
+        down to the new target."""
+        want_v = state.config.get("version", "")
+        with self._lock:
+            alive = [r for r in state.replicas
+                     if r.state in (_ReplicaState.STARTING,
+                                    _ReplicaState.RUNNING)]
+            outdated = [r for r in alive if r.version != want_v]
+            updated = [r for r in alive if r.version == want_v]
+            want = state.target_num_replicas
+            updated_running = [r for r in updated
+                               if r.state == _ReplicaState.RUNNING]
+        if not outdated:
+            return
+        if len(alive) > want:
+            # excess capacity: above want+1 it's a count decrease riding
+            # the roll (retire outdated freely); at exactly the surge
+            # slot, retire only once a new-version replica is serving
+            if len(alive) > want + 1 or updated_running or not updated:
+                self._drain_replica(state, outdated[0])
+            return
+        if (len(updated) < want
+                and not any(r.state == _ReplicaState.STARTING
+                            for r in updated)):
+            self._start_replica(state)  # the surge replica (new version)
+
+    def _drain_replica(self, state: _DeploymentState,
+                       replica: _ReplicaState) -> None:
+        """Deregister a replica from routers NOW; the kill happens a
+        grace period later (_reap_draining) so requests assigned from the
+        previous long-poll snapshot still complete."""
+        replica.state = _ReplicaState.DRAINING
+        replica.drain_since = time.monotonic()
+        try:
+            replica.handle.prepare_shutdown.remote()
+        except Exception:  # noqa: BLE001
+            pass
+        self._bump(state.full_name)
+
+    def _reap_draining(self, state: _DeploymentState) -> None:
+        now = time.monotonic()
+        with self._lock:
+            expired = [r for r in state.replicas
+                       if r.state == _ReplicaState.DRAINING
+                       and now - r.drain_since > 1.0]
+            for r in expired:
+                state.replicas.remove(r)
+        for r in expired:
+            self._stop_replica(r)
 
     def _start_replica(self, state: _DeploymentState) -> None:
         cfg = state.config
@@ -325,7 +416,8 @@ class ServeController:
                 })
             if cfg.get("user_config") is not None:
                 handle.reconfigure.remote(cfg["user_config"])
-            replica = _ReplicaState(handle, replica_id)
+            replica = _ReplicaState(handle, replica_id,
+                                    version=cfg.get("version", ""))
             # queued behind __init__: resolves exactly when init completes
             replica.init_ref = handle.check_health.remote()
             with self._lock:

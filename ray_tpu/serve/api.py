@@ -10,6 +10,7 @@ constructor (handle.py composition).
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 from typing import Any, Callable, Dict, List, Optional, Union
 
 from ray_tpu._private import serialization as ser
@@ -208,9 +209,23 @@ def run(app: Application, *, name: str = "default", route_prefix: str = "/",
             k: DeploymentHandle(_as_bound(v).deployment.name, name)
             if _as_bound(v) is not None else v
             for k, v in node.init_kwargs.items()}
+        code_blob = ser.dumps_function(d.func_or_class)
+        # code version (reference: deployment_state.py versioned replicas):
+        # identifies WHAT a replica would be constructed from. A redeploy
+        # with a different version rolls replicas; a user_config VALUE
+        # change reconfigures in place — but removing user_config rolls
+        # (live replicas can't be un-configured), hence the presence flag.
+        # cloudpickle, not stdlib pickle: init args are routinely local
+        # closures, and a repr() fallback would embed memory addresses,
+        # making every redeploy look like a code change.
+        extras = ser.dumps_function(
+            (init_args, init_kwargs, d.ray_actor_options,
+             d.max_ongoing_requests, d.user_config is None))
+        version = hashlib.sha1(code_blob + extras).hexdigest()[:12]
         deployments.append({
             "name": d.name,
-            "callable": ser.dumps_function(d.func_or_class),
+            "callable": code_blob,
+            "version": version,
             "init_args": init_args,
             "init_kwargs": init_kwargs,
             "num_replicas": d.num_replicas,

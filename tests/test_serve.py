@@ -305,3 +305,76 @@ def test_get_replica_context(serve_instance):
     assert is_self
     with pytest.raises(RuntimeError, match="replica"):
         serve.get_replica_context()
+
+
+def test_redeploy_rolls_replicas_to_new_code(serve_instance):
+    """Redeploying changed code replaces replicas one at a time with a +1
+    surge (reference: deployment_state.py versioned replicas): the new
+    behavior takes over, and the replica set never dips below target —
+    requests keep succeeding throughout the roll."""
+
+    def make_app(tag):
+        @serve.deployment(num_replicas=2)
+        class Svc:
+            def __call__(self, _x=None):
+                return tag
+
+        return Svc.bind()
+
+    handle = serve.run(make_app("v1"), name="roll_app")
+    assert handle.remote(None).result(timeout_s=60) == "v1"
+
+    serve.run(make_app("v2"), name="roll_app")
+    deadline = time.monotonic() + 60
+    saw_v2 = False
+    while time.monotonic() < deadline:
+        # every request during the roll must succeed (old or new code)
+        out = handle.remote(None).result(timeout_s=30)
+        assert out in ("v1", "v2")
+        if out == "v2":
+            saw_v2 = True
+            # drain: once rolled, old replicas disappear entirely
+            outs = {handle.remote(None).result(timeout_s=30)
+                    for _ in range(8)}
+            if outs == {"v2"}:
+                return
+        time.sleep(0.2)
+    assert saw_v2, "new version never served within 60s"
+    raise AssertionError("old-version replicas still serving after 60s")
+
+
+def test_redeploy_same_code_reconfigures_in_place(serve_instance):
+    """A user_config-only redeploy must reconfigure live replicas, not
+    restart them (same pid before and after)."""
+    import os as _os
+
+    @serve.deployment(user_config={"factor": 2})
+    class Mul:
+        def __init__(self):
+            self.factor = 1
+
+        def reconfigure(self, cfg):
+            self.factor = cfg["factor"]
+
+        def __call__(self, x):
+            return (x * self.factor, _os.getpid())
+
+    handle = serve.run(Mul.bind(), name="cfg_app")
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        out, pid1 = handle.remote(10).result(timeout_s=30)
+        if out == 20:
+            break
+        time.sleep(0.1)
+    assert out == 20
+
+    Mul2 = Mul.options(user_config={"factor": 5})
+    serve.run(Mul2.bind(), name="cfg_app")
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        out, pid2 = handle.remote(10).result(timeout_s=30)
+        if out == 50:
+            assert pid2 == pid1, "replica was restarted, not reconfigured"
+            return
+        time.sleep(0.1)
+    raise AssertionError(f"user_config change never applied (last={out})")
