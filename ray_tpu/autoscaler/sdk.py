@@ -1,0 +1,31 @@
+"""Programmatic autoscaler API.
+
+Reference: ray.autoscaler.sdk.request_resources
+(python/ray/autoscaler/sdk/sdk.py) — ask the cluster to scale to fit a
+set of resource bundles immediately, without queueing tasks that need
+them. Each call REPLACES the previous request; an empty call cancels it.
+The request is standing demand: matching nodes are launched (and kept —
+requested capacity never idle-terminates) until overridden.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+
+def request_resources(num_cpus: Optional[int] = None,
+                      bundles: Optional[List[Dict[str, float]]] = None
+                      ) -> int:
+    """Request the cluster scale to fit `num_cpus` CPUs and/or the given
+    resource bundles (e.g. ``[{"TPU": 4.0}] * 2``). Returns the number of
+    standing demand shapes now registered."""
+    from ray_tpu._raylet import get_core_worker
+
+    shapes: List[Dict[str, float]] = []
+    if num_cpus:
+        shapes.append({"CPU": float(num_cpus)})
+    for b in bundles or []:
+        if b:
+            shapes.append({k: float(v) for k, v in b.items()})
+    cw = get_core_worker()
+    return cw._gcs.call("request_resources", {"shapes": shapes}, timeout=30)

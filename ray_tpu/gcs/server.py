@@ -51,6 +51,9 @@ class GcsNodeManager:
         self._nodes: Dict[NodeID, NodeInfo] = {}
         self._last_heartbeat: Dict[NodeID, float] = {}
         self._pending_demands: Dict[NodeID, list] = {}
+        # explicit autoscaler.sdk.request_resources() demand: shapes the
+        # cluster must be able to fit even with no tasks queued
+        self._requested_resources: list = []
         self._death_listeners = []
         self.pg_locator = None  # wired to GcsPlacementGroupManager by GcsServer
         # Versioned view for delta heartbeats (reference:
@@ -177,6 +180,18 @@ class GcsNodeManager:
     async def handle_get_all_node_info(self, payload):
         return list(self._nodes.values())
 
+    async def handle_request_resources(self, payload):
+        """Programmatic scale-up hint (reference:
+        ray.autoscaler.sdk.request_resources — python/ray/autoscaler/
+        sdk/sdk.py): the given bundle shapes become standing demand the
+        autoscaler must satisfy, REPLACING any previous request (so
+        request_resources() with no shapes cancels). Not persisted: like
+        the reference, the hint is advisory runtime state."""
+        shapes = payload.get("shapes") or []
+        self._requested_resources = [
+            (dict(s), 1, None) for s in shapes if s]
+        return len(self._requested_resources)
+
     async def handle_get_cluster_load(self, payload):
         """Autoscaler snapshot: per-node usage + aggregated unfulfilled
         demand shapes (reference: GCS load feeding load_metrics.py and the
@@ -207,7 +222,9 @@ class GcsNodeManager:
                 for nid, n in self._nodes.items()
             },
             "demands": [(dict(res), v, dict(labels) or None)
-                        for (res, labels), v in demands.items()],
+                        for (res, labels), v in demands.items()]
+                       + [(dict(s), c, lbl)
+                          for s, c, lbl in self._requested_resources],
             "pending_pg_bundles": pending_pgs,
         }
 

@@ -187,3 +187,49 @@ def test_labeled_demand_scales_matching_node_type():
         assert "plain" not in types
     finally:
         cluster.shutdown()
+
+
+def test_request_resources_scales_without_tasks():
+    """autoscaler.sdk.request_resources (reference: ray.autoscaler.sdk):
+    explicit demand launches nodes with NO tasks queued, holds them
+    against idle termination, and an empty request releases them."""
+    import ray_tpu
+    from ray_tpu.autoscaler.sdk import request_resources
+    from ray_tpu.cluster_utils import AutoscalingCluster
+
+    cluster = AutoscalingCluster(
+        head_resources={"CPU": 0.1},
+        worker_node_types={
+            "worker": {"resources": {"CPU": 2},
+                       "min_workers": 0, "max_workers": 4},
+        },
+        idle_timeout_s=1.0,
+        update_interval_s=0.25,
+    )
+    try:
+        cluster.start()
+        cluster.connect()
+
+        assert request_resources(num_cpus=2) == 1
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if cluster.provider.non_terminated_nodes():
+                break
+            time.sleep(0.25)
+        assert cluster.provider.non_terminated_nodes(), \
+            "requested resources never launched a node"
+
+        # the standing request pins the (idle) node well past idle_timeout
+        time.sleep(3.0)
+        assert cluster.provider.non_terminated_nodes()
+
+        # cancel: the node is now idle and scales away
+        assert request_resources() == 0
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if not cluster.provider.non_terminated_nodes():
+                break
+            time.sleep(0.5)
+        assert cluster.provider.non_terminated_nodes() == []
+    finally:
+        cluster.shutdown()
