@@ -61,7 +61,6 @@ class _ReplicaState:
         # check_health queued behind __init__: resolves iff init succeeded
         self.init_ref = None
         self.consecutive_failures = 0
-        self.last_health_check = time.monotonic()
 
     @property
     def healthy(self) -> bool:
@@ -76,6 +75,7 @@ class _DeploymentState:
         self.target_num_replicas = config.get("num_replicas", 1)
         self.replicas: List[_ReplicaState] = []
         self.next_replica_idx = 0
+        self.last_health_check = 0.0
         self.autoscaling = config.get("autoscaling_config")
         if self.autoscaling:
             self.target_num_replicas = self.autoscaling.get(
@@ -258,8 +258,8 @@ class ServeController:
             try:
                 self._reconcile()
                 now = time.monotonic()
+                self._health_check()  # self-gated per deployment period
                 if now - last_health > HEALTH_CHECK_INTERVAL_S:
-                    self._health_check()
                     self._autoscale()
                     last_health = now
             except Exception:  # noqa: BLE001 — loop must survive
@@ -434,24 +434,60 @@ class ServeController:
             pass
 
     def _health_check(self) -> None:
+        now = time.monotonic()
         with self._lock:
-            all_replicas = [(s, r) for s in self._deployments.values()
-                            for r in s.replicas
+            # per-deployment period/timeout (reference: @serve.deployment
+            # health_check_period_s / health_check_timeout_s)
+            due = [s for s in self._deployments.values()
+                   if now - s.last_health_check
+                   >= s.config.get("health_check_period_s",
+                                   HEALTH_CHECK_INTERVAL_S)]
+            for s in due:
+                s.last_health_check = now
+            all_replicas = [(s, r) for s in due for r in s.replicas
                             if r.state == _ReplicaState.RUNNING]
-        for state, replica in all_replicas:
+        if not all_replicas:
+            return
+        # Fan out ALL probes, then harvest with ONE bounded wait (same
+        # pattern as _autoscale): probing serially would let one wedged
+        # replica stall the reconcile thread — and every other
+        # deployment's checks — for its full timeout, every tick.
+        probes = []
+        for s, r in all_replicas:
             try:
-                ray_tpu.get(replica.handle.check_health.remote(), timeout=5.0)
+                probes.append((s, r, r.handle.check_health.remote()))
+            except Exception:  # noqa: BLE001 — actor already dead:
+                probes.append((s, r, None))  # counts as a failed probe
+        max_timeout = max(s.config.get("health_check_timeout_s", 5.0)
+                          for s, _ in all_replicas)
+        refs = [ref for _, _, ref in probes if ref is not None]
+        done_set = set()
+        if refs:
+            try:
+                done, _ = ray_tpu.wait(refs, num_returns=len(refs),
+                                       timeout=max_timeout)
+                done_set = set(done)
+            except Exception:  # noqa: BLE001
+                pass
+        for state, replica, ref in probes:
+            ok = ref is not None and ref in done_set
+            if ok:
+                try:
+                    ray_tpu.get(ref, timeout=0.1)
+                except Exception:  # noqa: BLE001 — user check raised
+                    ok = False
+            if ok:
                 replica.consecutive_failures = 0
-            except Exception:  # noqa: BLE001 — tolerate transient stalls
-                replica.consecutive_failures += 1
-                logger.warning(
-                    "replica %s failed health check (%d/%d)",
-                    replica.replica_id, replica.consecutive_failures,
-                    HEALTH_CHECK_FAILURE_THRESHOLD)
-                if (replica.consecutive_failures
-                        >= HEALTH_CHECK_FAILURE_THRESHOLD):
-                    replica.state = _ReplicaState.UNHEALTHY
-                    self._bump(state.full_name)
+                continue
+            replica.consecutive_failures += 1
+            logger.warning(
+                "replica %s failed health check (%d/%d)",
+                replica.replica_id, replica.consecutive_failures,
+                HEALTH_CHECK_FAILURE_THRESHOLD)
+            if (replica.consecutive_failures
+                    >= HEALTH_CHECK_FAILURE_THRESHOLD):
+                replica.state = _ReplicaState.UNHEALTHY
+                self._bump(state.full_name)
 
     def _autoscale(self) -> None:
         """Default policy (reference: serve/autoscaling_policy.py:12):

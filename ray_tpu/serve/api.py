@@ -65,6 +65,7 @@ class Deployment:
                  max_ongoing_requests: int = 8,
                  autoscaling_config: Optional[dict] = None,
                  health_check_period_s: float = 2.0,
+                 health_check_timeout_s: float = 5.0,
                  **_kw):
         self.func_or_class = func_or_class
         self.name = name or getattr(func_or_class, "__name__", "deployment")
@@ -78,6 +79,8 @@ class Deployment:
         self.user_config = user_config
         self.max_ongoing_requests = max_ongoing_requests
         self.autoscaling_config = autoscaling_config
+        self.health_check_period_s = health_check_period_s
+        self.health_check_timeout_s = health_check_timeout_s
 
     def options(self, **overrides) -> "Deployment":
         merged = dict(
@@ -86,6 +89,8 @@ class Deployment:
             user_config=self.user_config,
             max_ongoing_requests=self.max_ongoing_requests,
             autoscaling_config=self.autoscaling_config,
+            health_check_period_s=self.health_check_period_s,
+            health_check_timeout_s=self.health_check_timeout_s,
         )
         merged.update(overrides)
         return Deployment(self.func_or_class, **merged)
@@ -233,6 +238,8 @@ def run(app: Application, *, name: str = "default", route_prefix: str = "/",
             "user_config": d.user_config,
             "max_ongoing_requests": d.max_ongoing_requests,
             "autoscaling_config": d.autoscaling_config,
+            "health_check_period_s": d.health_check_period_s,
+            "health_check_timeout_s": d.health_check_timeout_s,
         })
     import inspect as _inspect
 

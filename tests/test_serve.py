@@ -378,3 +378,42 @@ def test_redeploy_same_code_reconfigures_in_place(serve_instance):
             return
         time.sleep(0.1)
     raise AssertionError(f"user_config change never applied (last={out})")
+
+
+def test_per_deployment_health_check_options(serve_instance):
+    """health_check_period_s / health_check_timeout_s are per-deployment
+    options (reference: @serve.deployment): a replica whose health check
+    keeps failing is replaced on the configured cadence."""
+
+    @serve.deployment(health_check_period_s=0.3, health_check_timeout_s=1.0)
+    class Flaky:
+        def __init__(self):
+            self.fail = False
+
+        def check_health(self):
+            if self.fail:
+                raise RuntimeError("unhealthy")
+
+        def poison(self):
+            self.fail = True
+            return "poisoned"
+
+        def __call__(self, _x=None):
+            import os
+
+            return os.getpid()
+
+    handle = serve.run(Flaky.bind(), name="hc_app")
+    pid1 = handle.remote(None).result(timeout_s=60)
+    assert handle.poison.remote().result(timeout_s=30) == "poisoned"
+    # 3 consecutive failures at 0.3s cadence -> replaced within ~a few s
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        try:
+            pid2 = handle.remote(None).result(timeout_s=10)
+            if pid2 != pid1:
+                return
+        except Exception:
+            pass  # mid-replacement
+        time.sleep(0.3)
+    raise AssertionError("unhealthy replica was never replaced")
