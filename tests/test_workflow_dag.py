@@ -123,12 +123,22 @@ def test_workflow_branches_run_concurrently(ray_start_regular, wf_storage):
     @ray_tpu.remote
     def slow(x):
         start = time.time()
-        time.sleep(1.0)
+        time.sleep(1.5)
         return (x, start, time.time())
 
     @ray_tpu.remote
     def join(a, b):
         return (a[0] + b[0], (a[1], a[2]), (b[1], b[2]))
+
+    # pre-warm two workers: under CI load a worker spawn can exceed the
+    # sleep, which would serialize EXECUTION even though the executor
+    # submitted both branches concurrently (the thing under test)
+    @ray_tpu.remote
+    def warm():
+        time.sleep(0.3)
+        return 1
+
+    assert ray_tpu.get([warm.remote(), warm.remote()], timeout=60) == [1, 1]
 
     dag = join.bind(slow.bind(1), slow.bind(2))
     total, (a0, a1), (b0, b1) = workflow.run(dag, workflow_id="wconc")
