@@ -1,0 +1,50 @@
+"""Subprocess probe for the ambient jax backend.
+
+On a wedged TPU tunnel, jax.devices() blocks forever inside PJRT client
+creation (no error, no timeout). Any driver-side code that would touch the
+ambient backend must first probe it OUT OF PROCESS with a timeout; both
+bench.py and __graft_entry__.dryrun_multichip share this helper so the two
+hang defenses cannot drift apart.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import subprocess
+import sys
+from typing import Dict, Optional
+
+
+def backend_alive(min_devices: int = 1, timeout_s: float = 180.0) -> bool:
+    """True iff the ambient backend comes up within timeout_s and exposes
+    at least `min_devices` devices. The generous default covers a
+    legitimately slow first tunnel contact."""
+    try:
+        subprocess.run(
+            [sys.executable, "-c",
+             "import jax, sys; "
+             f"sys.exit(0 if len(jax.devices()) >= {min_devices} else 3)"],
+            timeout=timeout_s, check=True, capture_output=True,
+            env=dict(os.environ))
+        return True
+    except Exception:  # noqa: BLE001 — timeout / crash / too few devices
+        return False
+
+
+def force_cpu_env(env: Optional[Dict[str, str]] = None,
+                  n_devices: Optional[int] = None) -> Dict[str, str]:
+    """Return a copy of `env` (default os.environ) with the accelerator
+    pin stripped and the platform forced to CPU; with `n_devices`, also
+    force that many virtual CPU host devices (replacing, not appending,
+    any existing count flag — the ambient value may be smaller)."""
+    env = dict(os.environ if env is None else env)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    if n_devices is not None:
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                       env.get("XLA_FLAGS", ""))
+        env["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    return env

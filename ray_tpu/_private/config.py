@@ -151,6 +151,27 @@ _d("max_tasks_in_flight_per_worker", 1)
 # --- gcs ---------------------------------------------------------------------
 _d("gcs_storage_path", "")  # "" = pure in-memory; path = snapshot for restart
 _d("maximum_gcs_dead_node_cache_count", 1000)
+# External KV store (Redis-equivalent; gcs/external_store.py). "" = disabled.
+# "host:port" parks GCS state off the head so head-disk loss is recoverable.
+_d("gcs_external_store", "")
+_d("gcs_external_store_op_timeout_s", 10.0)
+# write-through (default): while the external store is REACHABLE, a
+# mutation is acked only after the server acks it — a head crash loses no
+# acknowledged state (matches the reference's reply-in-Redis-callback
+# semantics). During a store outage mutations divert to an ordered retry
+# queue, so the loss window on a head crash equals the outage duration —
+# bounded by the failure detector (gcs_external_store_down_after_s), which
+# is when the reference would have killed the GCS anyway. False =
+# write-behind batching: faster, but a crash loses the unshipped tail even
+# with a healthy store.
+_d("gcs_external_store_write_through", True)
+# inline write timeout: bounds how long ONE failing write-through mutation
+# can stall the gcs-io loop when the store first dies (later mutations
+# divert to the queue without blocking)
+_d("gcs_external_store_inline_timeout_s", 2.0)
+_d("gcs_external_store_max_queue", 1_000_000)  # retry backlog cap while down
+_d("gcs_external_store_ping_interval_s", 2.0)   # failure-detector probe cadence
+_d("gcs_external_store_down_after_s", 20.0)     # unreachable window before on_down
 
 # --- logging -----------------------------------------------------------------
 _d("log_dir", "/tmp/rt_session/logs")

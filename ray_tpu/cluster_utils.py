@@ -27,9 +27,12 @@ class Cluster:
         connect: bool = False,
         namespace: str = "",
         gcs_storage_path: str = "",
+        gcs_external_store: str = "",
     ):
         self._gcs_storage_path = gcs_storage_path
-        self.gcs = GcsServer(storage_path=gcs_storage_path)
+        self._gcs_external_store = gcs_external_store
+        self.gcs = GcsServer(storage_path=gcs_storage_path,
+                             external_store=gcs_external_store)
         self.gcs_address = self.gcs.start(0)
         self.raylets: List[Raylet] = []
         self.head_node: Optional[Raylet] = None
@@ -87,10 +90,12 @@ class Cluster:
         append-log store (requires gcs_storage_path). Raylets re-register
         on their next heartbeat; subscriptions and actor/PG/job/KV tables
         reload from storage."""
-        if not self._gcs_storage_path:
-            raise ValueError("restart_gcs needs gcs_storage_path")
+        if not (self._gcs_storage_path or self._gcs_external_store):
+            raise ValueError(
+                "restart_gcs needs gcs_storage_path or gcs_external_store")
         port = int(self.gcs_address.rsplit(":", 1)[1])
-        self.gcs = GcsServer(storage_path=self._gcs_storage_path)
+        self.gcs = GcsServer(storage_path=self._gcs_storage_path,
+                             external_store=self._gcs_external_store)
         deadline = time.monotonic() + 10.0
         while True:
             try:

@@ -1,0 +1,287 @@
+"""External GCS persistence: a standalone KV store process + store client.
+
+Reference: ray parks GCS state in external Redis
+(src/ray/gcs/store_client/redis_store_client.cc) so a replacement head can
+recover the whole cluster after losing its own disk, and watches the store
+with a failure detector (src/ray/gcs/gcs_server/gcs_redis_failure_detector.h:34)
+that takes the GCS down when the store is unreachable so a supervisor can
+restart it somewhere healthy.
+
+This module is the single-language equivalent: `ExternalStoreServer` is a
+small authoritative KV process (same asyncio RPC stack as the rest of the
+control plane; it may itself persist to an append-log on ITS disk, which can
+live on a different host than the GCS head). `ExternalStore` is the GCS-side
+client: reads come from a full in-memory mirror (same read performance as
+the in-memory store), mutations are shipped in order to the external server
+by a write-behind batcher — matching the reference's async Redis writes —
+and a ping-based failure detector fires `on_down` after a configurable
+window of unreachability.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ray_tpu._private.config import CONFIG
+from ray_tpu._private.rpc import EventLoopThread, RpcClient, RpcServer
+from ray_tpu.gcs.storage import _OP_PUT, InMemoryStore, make_store
+
+logger = logging.getLogger(__name__)
+
+
+class ExternalStoreServer:
+    """Authoritative KV server holding the cluster's GCS state.
+
+    Run it on a host other than the GCS head (or at minimum as a separate
+    process) and point the GCS at it via RT_GCS_EXTERNAL_STORE; then head
+    disk loss no longer loses the cluster. With `storage_path` set, the
+    server additionally journals to its own append-log so IT can restart
+    in place too.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", storage_path: str = ""):
+        self._lt = EventLoopThread("xstore-io")
+        self._server = RpcServer(self._lt, host)
+        self._store = make_store(storage_path)
+        self.address: Optional[str] = None
+
+    def start(self, port: int = 0) -> str:
+        self._server.register("xs_apply", self._handle_apply)
+        self._server.register("xs_dump", self._handle_dump)
+        self._server.register("xs_ping", self._handle_ping)
+        self.address = self._server.start(port)
+        return self.address
+
+    async def _handle_apply(self, payload):
+        records: List[Tuple[int, str, bytes, bytes]] = payload["records"]
+        for op, table, key, value in records:
+            if op == _OP_PUT:
+                self._store.put(table, key, value)
+            else:
+                self._store.delete(table, key)
+        return len(records)
+
+    async def _handle_dump(self, payload):
+        return {t: self._store.get_all(t) for t in list(self._store._tables)}
+
+    async def _handle_ping(self, payload):
+        return {"status": "ok", "time": time.time()}
+
+    def stop(self):
+        self._server.stop()
+        self._lt.stop()
+        close = getattr(self._store, "close", None)
+        if close is not None:
+            close()
+
+
+class ExternalStore(InMemoryStore):
+    """GCS store client backed by an ExternalStoreServer.
+
+    Reads hit the local mirror. Mutations are WRITE-THROUGH by default:
+    while the store is reachable, `put`/`delete` return only after the
+    external server acks, so state a client observed as committed survives
+    a head crash (the reference replies from the Redis write callback for
+    the same reason). The inline write runs on the caller's thread — for
+    the GCS that is the gcs-io loop, which therefore pays one store RTT
+    per mutation (same shape as FileBackedStore's fsync-per-append) and at
+    most `gcs_external_store_inline_timeout_s` ONCE when the store first
+    dies. While the store is unreachable, mutations divert to an ordered,
+    bounded retry queue drained by the shipper thread on recovery — during
+    that window acks are NOT durable (loss window = outage duration,
+    bounded by the failure detector firing `on_down`).
+    `gcs_external_store_write_through=False` selects write-behind batching
+    (faster, crash loses the unshipped tail). Recovery = full `xs_dump` at
+    construction, so a brand-new GCS on a brand-new host reconstructs the
+    whole cluster state from the external server alone.
+    """
+
+    BATCH = 512
+
+    def __init__(self, address: str,
+                 on_down: Optional[Callable[[], None]] = None):
+        super().__init__()
+        self._address = address
+        self._on_down = on_down
+        self._lt = EventLoopThread("xstore-client")
+        self._client = RpcClient(address, self._lt)
+        # Seed the mirror from the authoritative copy (recovery path).
+        dump: Dict[str, Dict[bytes, bytes]] = self._client.call(
+            "xs_dump", {}, timeout=CONFIG.gcs_external_store_op_timeout_s)
+        with self._lock:
+            self._tables = {t: dict(kv) for t, kv in dump.items()}
+        self._queue: deque = deque()
+        self._cv = threading.Condition()
+        self._inflight = 0
+        self._closed = False
+        self._down_since: Optional[float] = None
+        self._down_fired = False
+        self._shipper = threading.Thread(
+            target=self._ship_loop, name="xstore-shipper", daemon=True)
+        self._shipper.start()
+
+    # -- mutation shipping ---------------------------------------------------
+
+    def put(self, table: str, key: bytes, value: bytes) -> None:
+        self._check_capacity()
+        super().put(table, key, value)
+
+    def delete(self, table: str, key: bytes) -> bool:
+        self._check_capacity()
+        return super().delete(table, key)
+
+    def _check_capacity(self) -> None:
+        # refuse BEFORE mutating the local mirror: raising after the
+        # mirror write would leave live state permanently ahead of the
+        # authoritative copy. Refusal is the reference's behavior too —
+        # a dead Redis stalls GCS writes until the failure detector kills
+        # the server.
+        with self._cv:
+            if len(self._queue) >= CONFIG.gcs_external_store_max_queue:
+                raise RuntimeError(
+                    "external GCS store unreachable and retry queue full")
+
+    def _append(self, op: int, table: str, key: bytes, value: bytes) -> None:
+        # called under InMemoryStore._lock, which serializes all mutations
+        rec = (op, table, key, value)
+        if not CONFIG.gcs_external_store_write_through:
+            with self._cv:
+                self._queue.append(rec)
+                self._cv.notify()
+            return
+        with self._cv:
+            if self._queue or self._inflight or self._down_since is not None:
+                # a backlog exists (store down or recovering): never ship
+                # inline ahead of queued records — order must hold
+                self._queue.append(rec)
+                self._cv.notify()
+                return
+        try:
+            self._client.call(
+                "xs_apply", {"records": [rec]},
+                timeout=CONFIG.gcs_external_store_inline_timeout_s)
+        except Exception as e:  # noqa: BLE001 — divert to the retry queue
+            with self._cv:
+                if self._down_since is None:
+                    self._down_since = time.monotonic()
+                    logger.warning(
+                        "external GCS store write failed (queued for "
+                        "retry): %s", e)
+                self._queue.append(rec)
+                self._cv.notify()
+
+    def _ship_loop(self) -> None:
+        ping_interval = CONFIG.gcs_external_store_ping_interval_s
+        while True:
+            with self._cv:
+                while not self._queue and not self._closed:
+                    if not self._cv.wait(timeout=ping_interval):
+                        break  # idle: fall through to a health ping
+                if self._closed and not self._queue:
+                    return
+                batch = []
+                while self._queue and len(batch) < self.BATCH:
+                    batch.append(self._queue.popleft())
+                self._inflight = len(batch)
+            try:
+                if batch:
+                    self._client.call(
+                        "xs_apply", {"records": batch},
+                        timeout=CONFIG.gcs_external_store_op_timeout_s)
+                else:
+                    self._client.call(
+                        "xs_ping", {},
+                        timeout=CONFIG.gcs_external_store_op_timeout_s)
+                self._down_since = None
+                self._down_fired = False
+                with self._cv:
+                    self._inflight = 0
+                    self._cv.notify_all()
+            except Exception as e:  # noqa: BLE001 — store unreachable
+                if self._closed:
+                    return
+                with self._cv:
+                    # requeue IN ORDER ahead of anything newer
+                    self._queue.extendleft(reversed(batch))
+                    self._inflight = 0
+                now = time.monotonic()
+                if self._down_since is None:
+                    self._down_since = now
+                    logger.warning("external GCS store unreachable: %s", e)
+                down_for = now - self._down_since
+                if (not self._down_fired
+                        and down_for >= CONFIG.gcs_external_store_down_after_s):
+                    self._down_fired = True
+                    logger.critical(
+                        "external GCS store down for %.0fs — failure "
+                        "detector fired (reference: "
+                        "gcs_redis_failure_detector.h:34)", down_for)
+                    if self._on_down is not None:
+                        try:
+                            self._on_down()
+                        except Exception:  # noqa: BLE001
+                            logger.exception("on_down callback failed")
+                time.sleep(min(1.0, ping_interval))
+
+    # -- utilities -----------------------------------------------------------
+
+    def flush(self, timeout: float = 30.0) -> bool:
+        """Block until every queued mutation has been acked (tests, stop)."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while self._queue or self._inflight:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cv.notify()
+                self._cv.wait(timeout=min(0.1, remaining))
+        return True
+
+    def ping(self) -> bool:
+        try:
+            self._client.call("xs_ping", {}, timeout=2.0)
+            return True
+        except Exception:  # noqa: BLE001
+            return False
+
+    def close(self) -> None:
+        self.flush(timeout=5.0)
+        self._closed = True
+        with self._cv:
+            self._cv.notify_all()
+        self._shipper.join(timeout=5.0)
+        try:
+            self._client.close()
+        except Exception:  # noqa: BLE001
+            pass
+        self._lt.stop()
+
+
+def main():
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        description="Standalone external GCS KV store (Redis-equivalent)")
+    parser.add_argument("--port", type=int, default=6381)
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--storage-path", default="",
+                        help="append-log path for the server's own restarts")
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    server = ExternalStoreServer(host=args.host,
+                                 storage_path=args.storage_path)
+    addr = server.start(args.port)
+    logger.info("external GCS store serving at %s", addr)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

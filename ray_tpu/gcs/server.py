@@ -493,12 +493,21 @@ class GcsTaskEventManager:
 class GcsServer:
     """Assembles all managers onto one RpcServer + loop."""
 
-    def __init__(self, host: str = "127.0.0.1", storage_path: str = ""):
+    def __init__(self, host: str = "127.0.0.1", storage_path: str = "",
+                 external_store: str = ""):
         self._lt = EventLoopThread("gcs-io")
         self._server = RpcServer(self._lt, host)
         self._pool = ClientPool(self._lt)
         self.publisher = ps.Publisher(self._lt)
-        store = make_store(storage_path or CONFIG.gcs_storage_path)
+        # Set when the external-store failure detector fires; a supervisor
+        # (or the standalone main) watches this to take the GCS down so it
+        # can be restarted against a healthy store (reference:
+        # gcs_redis_failure_detector.h:34 FATALs the GCS).
+        self.store_down = False
+        store = make_store(storage_path or CONFIG.gcs_storage_path,
+                           external_address=(external_store
+                                             or CONFIG.gcs_external_store),
+                           on_down=self._on_store_down)
         self._store = store
         self.node_manager = GcsNodeManager(self.publisher, store=store)
         self.kv_manager = GcsKvManager(store)
@@ -605,7 +614,18 @@ class GcsServer:
         return True
 
     async def _handle_ping(self, payload):
-        return {"status": "ok", "time": time.time()}
+        # store_down surfaces the external-store failure detector to
+        # embedded deployments and `ray-tpu healthcheck`: a supervisor that
+        # cannot watch the attribute can still poll the ping
+        return {"status": "degraded" if self.store_down else "ok",
+                "time": time.time(), "store_down": self.store_down}
+
+    def _on_store_down(self) -> None:
+        self.store_down = True
+        logger.critical(
+            "external GCS store unreachable past the failure-detector "
+            "window; GCS state writes are stalled — restart the GCS "
+            "against a healthy store")
 
     async def _handle_publish_logs(self, payload):
         """Raylet log monitors push worker-log batches here; fan out to
@@ -640,14 +660,24 @@ def main():
     parser.add_argument("--port", type=int, default=6380)
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--storage-path", default="")
+    parser.add_argument("--external-store", default="",
+                        help="host:port of an ExternalStoreServer "
+                             "(gcs/external_store.py); overrides "
+                             "--storage-path")
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
-    server = GcsServer(host=args.host, storage_path=args.storage_path)
+    server = GcsServer(host=args.host, storage_path=args.storage_path,
+                       external_store=args.external_store)
     addr = server.start(args.port)
     logger.info("GCS serving at %s", addr)
     try:
-        while True:
-            time.sleep(3600)
+        while not server.store_down:
+            time.sleep(1.0)
+        # reference behavior: the redis failure detector FATALs the GCS so
+        # a supervisor restarts it against a healthy store
+        logger.critical("exiting: external store failure detector fired")
+        server.stop()
+        raise SystemExit(1)
     except KeyboardInterrupt:
         server.stop()
 

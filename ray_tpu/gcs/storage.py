@@ -135,5 +135,14 @@ class FileBackedStore(InMemoryStore):
             pass
 
 
-def make_store(path: str = "") -> InMemoryStore:
+def make_store(path: str = "", external_address: str = "",
+               on_down=None) -> InMemoryStore:
+    """external_address ("host:port" of an ExternalStoreServer) wins over
+    a local file path: with an external store the authoritative copy lives
+    off-host and the head keeps nothing durable locally (reference: Redis
+    replaces the local store entirely, redis_store_client.cc)."""
+    if external_address:
+        from ray_tpu.gcs.external_store import ExternalStore
+
+        return ExternalStore(external_address, on_down=on_down)
     return FileBackedStore(path) if path else InMemoryStore()

@@ -1,0 +1,92 @@
+"""Driver-entry hardening tests.
+
+The r4 regression: on a wedged TPU tunnel, jax.devices() blocks forever
+inside PJRT client creation (no error, no timeout), and the driver's
+multichip dryrun hung until rc=124. The dryrun parent must never touch the
+ambient jax backend directly — it probes it in a subprocess with a timeout
+(mirroring bench.py's _backend_alive) and falls back to forced-CPU virtual
+devices when the probe fails.
+
+The hang is simulated with a fake `jax` package on PYTHONPATH that delegates
+to the real jax but replaces `devices()` with a blocking stub unless
+JAX_PLATFORMS=cpu — exactly the shape of the real failure (import works,
+client creation blocks; the forced-CPU child escapes the poison).
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_FAKE_JAX = textwrap.dedent(
+    """
+    import os as _os, sys as _sys
+    _dir = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+    _sys.path = [p for p in _sys.path
+                 if _os.path.abspath(p or ".") != _dir]
+    del _sys.modules["jax"]
+    import importlib as _il
+    _real = _il.import_module("jax")
+    _sys.modules["jax"] = _real
+    if _os.environ.get("JAX_PLATFORMS", "") != "cpu":
+        def _hang(*a, **k):
+            import time
+            time.sleep(3600)
+        _real.devices = _hang
+    """
+)
+
+
+def test_dryrun_multichip_survives_hung_backend(tmp_path):
+    fake_root = tmp_path / "fakejax"
+    (fake_root / "jax").mkdir(parents=True)
+    (fake_root / "jax" / "__init__.py").write_text(_FAKE_JAX)
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(fake_root)
+    env["JAX_PLATFORMS"] = "axon"  # poisoned: any non-cpu platform
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("_RT_DRYRUN_CHILD", None)
+    env["RT_DRYRUN_PROBE_TIMEOUT"] = "3"
+
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import __graft_entry__ as g; g.dryrun_multichip(1)"],
+        cwd=REPO_ROOT, env=env, timeout=600,
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    assert "llama train step OK on 1 devices" in proc.stdout
+    assert "all multichip checks passed" in proc.stdout
+
+
+def test_backend_probe_rejects_hung_backend_quickly(tmp_path):
+    fake_root = tmp_path / "fakejax"
+    (fake_root / "jax").mkdir(parents=True)
+    (fake_root / "jax" / "__init__.py").write_text(_FAKE_JAX)
+
+    probe = textwrap.dedent(
+        """
+        import sys
+        sys.path.insert(0, {root!r})
+        import __graft_entry__ as g
+        import time
+        t0 = time.monotonic()
+        ok = g._ambient_backend_has(1)
+        print("probe_ok", ok, "elapsed", time.monotonic() - t0)
+        assert not ok
+        assert time.monotonic() - t0 < 30
+        """
+    ).format(root=REPO_ROOT)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(fake_root)
+    env["JAX_PLATFORMS"] = "axon"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["RT_DRYRUN_PROBE_TIMEOUT"] = "3"
+    proc = subprocess.run(
+        [sys.executable, "-c", probe], cwd=REPO_ROOT, env=env,
+        timeout=120, capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])

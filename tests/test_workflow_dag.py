@@ -114,25 +114,37 @@ def test_workflow_list_delete(ray_start_regular, wf_storage):
     assert all(w != "wlist" for w, _ in workflow.list_all())
 
 
-def test_workflow_branches_run_concurrently(ray_start_regular, wf_storage):
+def test_workflow_branches_run_concurrently(ray_start_regular, wf_storage,
+                                            tmp_path):
     """Diamond DAG: the two independent branches must overlap in
     wall-clock (the executor submits every ready step, not a post-order
-    walk)."""
+    walk). Proven by an event handshake, not timing margins: each branch
+    drops a start marker and then waits to SEE the other's marker while
+    still running. Both returning True is possible only if their execution
+    intervals overlapped; if the executor serialized them, the first
+    branch times out before the second ever starts."""
     import time
 
+    rdv = str(tmp_path / "rdv")
+    os.makedirs(rdv, exist_ok=True)
+
     @ray_tpu.remote
-    def slow(x):
-        start = time.time()
-        time.sleep(1.5)
-        return (x, start, time.time())
+    def meet(me, other):
+        open(os.path.join(rdv, me), "w").close()
+        deadline = time.time() + 30  # load-proof margin, not a race window
+        while time.time() < deadline:
+            if os.path.exists(os.path.join(rdv, other)):
+                return True
+            time.sleep(0.01)
+        return False
 
     @ray_tpu.remote
     def join(a, b):
-        return (a[0] + b[0], (a[1], a[2]), (b[1], b[2]))
+        return (a, b)
 
     # pre-warm two workers: under CI load a worker spawn can exceed the
-    # sleep, which would serialize EXECUTION even though the executor
-    # submitted both branches concurrently (the thing under test)
+    # handshake timeout, which would serialize EXECUTION even though the
+    # executor submitted both branches concurrently (the thing under test)
     @ray_tpu.remote
     def warm():
         time.sleep(0.3)
@@ -140,15 +152,10 @@ def test_workflow_branches_run_concurrently(ray_start_regular, wf_storage):
 
     assert ray_tpu.get([warm.remote(), warm.remote()], timeout=60) == [1, 1]
 
-    dag = join.bind(slow.bind(1), slow.bind(2))
-    total, (a0, a1), (b0, b1) = workflow.run(dag, workflow_id="wconc")
-    assert total == 3
-    # the branches' EXECUTION intervals must overlap — asserting on total
-    # wall clock flaked under CI load (worker spawn latency ate the
-    # sequential-vs-concurrent margin); interval overlap is load-proof
-    assert max(a0, b0) < min(a1, b1), (
-        f"branches ran sequentially: ({a0:.2f},{a1:.2f}) vs "
-        f"({b0:.2f},{b1:.2f})")
+    dag = join.bind(meet.bind("a", "b"), meet.bind("b", "a"))
+    saw_a, saw_b = workflow.run(dag, workflow_id="wconc")
+    assert saw_a and saw_b, (
+        f"branches ran sequentially (a saw b: {saw_a}, b saw a: {saw_b})")
 
 
 def test_workflow_diamond_shared_step_runs_once(ray_start_regular,
