@@ -147,6 +147,9 @@ _d("streaming_generator_backpressure_objects", -1)  # -1 = unbounded
 _d("scheduler_spread_threshold", 0.5)  # hybrid policy: pack below this utilization
 _d("scheduler_top_k_fraction", 0.2)
 _d("max_tasks_in_flight_per_worker", 1)
+# actor-creation specs carry serialized class defs up to this size inline,
+# sparing every fresh actor worker a GCS function-table round trip
+_d("max_inline_function_bytes", 64 * 1024)
 
 # --- gcs ---------------------------------------------------------------------
 _d("gcs_storage_path", "")  # "" = pure in-memory; path = snapshot for restart

@@ -95,7 +95,21 @@ def main():
     out = sys.stdout
     stdin_fd = sys.stdin.fileno()
     buf = b""
+    boot_ppid = os.getppid()
+    children: set = set()
     while True:
+        # Orphan defense: a clean pool shutdown closes our stdin (EOF
+        # below), but a SIGKILLed host process leaves us reparented to
+        # init with nobody to close anything — round-4 leftovers showed
+        # zygotes + their idle workers surviving for hours. On reparent,
+        # take the (now-useless) workers down with us.
+        if os.getppid() != boot_ppid:
+            for pid in children:
+                try:
+                    os.kill(pid, signal.SIGTERM)
+                except OSError:
+                    pass
+            os._exit(0)
         readable, _, _ = select.select([stdin_fd], [], [], 0.2)
         # reap exited children and report them
         while True:
@@ -105,6 +119,7 @@ def main():
                 break
             if pid == 0:
                 break
+            children.discard(pid)
             code = (os.waitstatus_to_exitcode(status)
                     if hasattr(os, "waitstatus_to_exitcode") else status)
             out.write(json.dumps({"exited": pid, "status": code}) + "\n")
@@ -126,6 +141,7 @@ def main():
                     _child(req, args)
                 except BaseException:  # noqa: BLE001 — never return to loop
                     os._exit(1)
+            children.add(pid)
             out.write(json.dumps({"spawned": pid, "token": req["token"]})
                       + "\n")
             out.flush()

@@ -182,6 +182,9 @@ class Raylet:
             on_worker_death=self._on_worker_death,
             env=self._worker_env,
         )
+        # spawned workers learn the socket from their env, which lets them
+        # register one-way (no reply round trip on the ctor path)
+        self.worker_pool.store_socket = self.store_socket
         from ray_tpu._private.rpc import RpcClient
 
         self._gcs = RpcClient(self.gcs_address, self._lt)

@@ -189,6 +189,9 @@ class WorkerPool:
         self._zygote: Optional[subprocess.Popen] = None
         self._pending_forks: Dict[str, WorkerHandle] = {}  # token -> handle
         self._zygote_failures = 0  # crash-looping zygote disables itself
+        # set by the raylet once the shm store is up: spawned workers read
+        # it from RT_STORE_SOCKET and register one-way (no reply needed)
+        self.store_socket: Optional[str] = None
         os.makedirs(log_dir, exist_ok=True)
 
     def start(self):
@@ -321,10 +324,12 @@ class WorkerPool:
                           handle: WorkerHandle) -> bool:
         if not self._ensure_zygote():
             return False
+        spawn_env = {"RT_SPAWN_TOKEN": token,
+                     "RT_SYSTEM_CONFIG": CONFIG.serialized_overrides()}
+        if self.store_socket:
+            spawn_env["RT_STORE_SOCKET"] = self.store_socket
         req = {"spawn": {"token": token, "log_path": log_path,
-                         "env": {"RT_SPAWN_TOKEN": token,
-                                 "RT_SYSTEM_CONFIG":
-                                     CONFIG.serialized_overrides()}}}
+                         "env": spawn_env}}
         try:
             self._zygote.stdin.write((json.dumps(req) + "\n").encode())
             self._zygote.stdin.flush()
@@ -376,6 +381,8 @@ class WorkerPool:
 
         env = self._worker_base_env(needs_accelerator)
         env["RT_SPAWN_TOKEN"] = token
+        if self.store_socket:
+            env["RT_STORE_SOCKET"] = self.store_socket
         # Keep worker start light: no JAX/accelerator init at import time.
         cmd = [
             sys.executable,
@@ -400,7 +407,8 @@ class WorkerPool:
                 self._workers.pop(placeholder_key, None)
                 return
             forwarded = ["RT_SYSTEM_CONFIG", "RT_SPAWN_TOKEN",
-                         "JAX_PLATFORMS", *self._extra_env.keys()]
+                         "RT_STORE_SOCKET", "JAX_PLATFORMS",
+                         *self._extra_env.keys()]
             wrap = [runtime, "run", "--rm", "--network=host",
                     "-v", "/tmp:/tmp"]
             for key in dict.fromkeys(forwarded):
@@ -701,13 +709,28 @@ class WorkerPool:
                 pass
 
     async def _monitor_loop(self):
-        """Reap dead children + idle-timeout spares (worker_pool.cc analog)."""
+        """Reap dead children + idle-timeout spares (worker_pool.cc analog).
+
+        Zygote-fork workers report exits through the zygote pipe (which
+        sets handle.proc.returncode), so their os.kill(pid, 0) liveness
+        probe is only a fallback for a zygote that died silently — probing
+        every one of them every tick made the loop O(workers) in SYSCALLS
+        (20k/s at 1k actors). Probe pid-based handles on a ~1s cadence;
+        returncode-set handles and real Popen handles stay on the fast
+        tick."""
         idle_timeout = CONFIG.worker_pool_idle_timeout_s
+        tick = 0
         while not self._closed:
             await asyncio.sleep(0.05)
+            tick += 1
+            probe_pids = (tick % 20 == 0)
             now = time.monotonic()
             for pid, handle in list(self._workers.items()):
-                if handle.proc is not None and handle.proc.poll() is not None:
+                proc = handle.proc
+                skip_probe = (isinstance(proc, _ForkedProc)
+                              and proc.returncode is None and not probe_pids)
+                if (proc is not None and not skip_probe
+                        and proc.poll() is not None):
                     if handle.state != "dead":
                         prev_state = handle.state
                         handle.state = "dead"

@@ -168,6 +168,20 @@ class CoreWorker:
         node_id: Optional[NodeID] = None,
         host: str = "127.0.0.1",
     ):
+        # RT_SPAWN_TIMING: per-phase ctor timing (burst-scale spawn
+        # diagnostics; the file is appended by default_worker.py too)
+        _timing = os.environ.get("RT_SPAWN_TIMING")
+        _marks: List = []
+        _t_prev = time.perf_counter()
+        _c_prev = time.process_time()
+
+        def _mark(name: str) -> None:
+            nonlocal _t_prev, _c_prev
+            if _timing:
+                now, cnow = time.perf_counter(), time.process_time()
+                _marks.append((name, now - _t_prev, cnow - _c_prev))
+                _t_prev, _c_prev = now, cnow
+
         self.mode = mode
         self.namespace = namespace
         self.worker_id = WorkerID.from_random()
@@ -199,6 +213,7 @@ class CoreWorker:
         self._actor_sub_started = False
         self._secondary_copies: set = set()
         self._registered_fns: set = set()
+        self._fn_blobs: Dict[str, bytes] = {}  # small defs inlined in specs
         self._fn_kv_cache: Dict[bytes, bytes] = {}
         self._prepared_envs: Dict[str, dict] = {}
         self._put_index = 0
@@ -215,9 +230,11 @@ class CoreWorker:
         # raylet registration hands us the store socket.
         self.plasma = None
 
+        _mark("fields")
         # -- connect --
         self._register_handlers()
         self.address_str = self._server.start(0)
+        _mark("server_start")
         if job_id is None:
             if mode == "driver":
                 job_id = self._gcs.call("get_next_job_id", {})
@@ -235,27 +252,54 @@ class CoreWorker:
         # Publish the global worker BEFORE raylet registration: the raylet may
         # lease this worker and push a task the instant registration lands.
         global_state.core_worker = self
+        _mark("job_id")
         if self._raylet is not None:
-            method = "register_driver" if mode == "driver" else "register_worker"
-            reply = self._raylet.call(
-                method,
-                {
-                    "worker_id": self.worker_id,
-                    "pid": os.getpid(),
-                    # container workers report an in-container pid; the pool
-                    # matches on the spawn token instead (worker_pool.py)
-                    "spawn_token": os.environ.get("RT_SPAWN_TOKEN", ""),
-                    "address": Address(
-                        node_id=None, worker_id=self.worker_id, rpc_address=self.address_str
-                    ),
-                },
-            )
-            self.node_id = reply.get("node_id", node_id)
-            self.address = Address(
-                node_id=self.node_id, worker_id=self.worker_id,
-                rpc_address=self.address_str,
-            )
-            self._connect_plasma(reply.get("store_socket"))
+            payload = {
+                "worker_id": self.worker_id,
+                "pid": os.getpid(),
+                # container workers report an in-container pid; the pool
+                # matches on the spawn token instead (worker_pool.py)
+                "spawn_token": os.environ.get("RT_SPAWN_TOKEN", ""),
+                "address": Address(
+                    node_id=None, worker_id=self.worker_id,
+                    rpc_address=self.address_str),
+            }
+            env_socket = os.environ.get("RT_STORE_SOCKET")
+            if mode == "worker" and self.node_id is not None:
+                # One-way registration: everything the reply would carry is
+                # already known (node_id from argv, store socket from the
+                # spawn env), so the ctor skips a raylet round trip — under
+                # a spawn burst that wait was the longest raylet phase.
+                # Plasma connects BEFORE the announce so a task pushed the
+                # instant registration lands can never observe plasma=None
+                # (with the blocking call this was a narrow race).
+                self._connect_plasma(env_socket)
+                _mark("plasma")
+
+                def _register_failed(e, _self=self):
+                    # an unregistered worker is invisible to the raylet but
+                    # its pool handle would sit 'starting' forever; dying
+                    # restores the blocking-call semantics (process exits,
+                    # pool reaps the pid and respawns)
+                    logger.error("worker registration failed: %s", e)
+                    os._exit(1)
+
+                self._post_oneway(self._raylet, "register_worker", payload,
+                                  retries=2, retry_delay_s=0.5,
+                                  on_failure=_register_failed)
+                _mark("register")
+            else:
+                method = ("register_driver" if mode == "driver"
+                          else "register_worker")
+                reply = self._raylet.call(method, payload)
+                self.node_id = reply.get("node_id", node_id)
+                self.address = Address(
+                    node_id=self.node_id, worker_id=self.worker_id,
+                    rpc_address=self.address_str,
+                )
+                _mark("register")
+                self._connect_plasma(reply.get("store_socket") or env_socket)
+                _mark("plasma")
         self._lease_reaper = self._lt.submit(self._lease_reaper_loop())
         self._event_flusher = self._lt.submit(self._task_event_loop())
         # Node-death awareness: a dead raylet's TCP connections can linger
@@ -263,10 +307,20 @@ class CoreWorker:
         # would hang. Invalidate its clients the moment the GCS declares it
         # dead, and fail the local raylet over if it was ours.
         self.subscribe(ps.NODE_CHANNEL, self._on_node_event)
-        self._gcs.call(
-            "subscribe",
-            {"channel": ps.NODE_CHANNEL, "subscriber_address": self.address_str},
-        )
+        # fire-and-forget: the reply carries nothing, and a blocking wait
+        # here queued every spawned worker behind the busy GCS loop
+        # retries cover a GCS restart window: without the subscription this
+        # process never learns of node deaths (stale clients to a dead
+        # raylet would hang instead of failing over)
+        self._post_oneway(self._gcs, "subscribe", {
+            "channel": ps.NODE_CHANNEL,
+            "subscriber_address": self.address_str}, retries=5)
+        _mark("subscribe")
+        if _timing and mode == "worker":
+            from ray_tpu._private.spawn_diag import spawn_timing_write
+
+            spawn_timing_write("phases " + " ".join(
+                f"{n}={dt:.4f}/{cdt:.4f}" for n, dt, cdt in _marks))
         if self.mode == "driver" and CONFIG.log_to_driver:
             # worker stdout/stderr + error reports stream to the driver
             # console (reference: worker.py:2003 print_worker_logs /
@@ -277,6 +331,31 @@ class CoreWorker:
                 self._gcs.call("subscribe", {
                     "channel": chan,
                     "subscriber_address": self.address_str})
+
+    def _post_oneway(self, client, method: str, payload, *,
+                     retries: int = 0, retry_delay_s: float = 1.0,
+                     on_failure=None) -> None:
+        """Schedule a one-way message on the loop without waiting for the
+        write to drain (ctor hot path: a cross-thread wait per message is
+        pure overhead when no reply is coming). Transient connect failures
+        retry with a delay; after the budget, `on_failure` runs (default:
+        log) — fire-and-forget must not mean fail-silent for messages the
+        process cannot function without."""
+
+        async def _attempt(remaining: int):
+            try:
+                await client.send_async(method, payload)
+            except Exception as e:  # noqa: BLE001 — peer down / connecting
+                if remaining > 0:
+                    await asyncio.sleep(retry_delay_s)
+                    await _attempt(remaining - 1)
+                elif on_failure is not None:
+                    on_failure(e)
+                else:
+                    logger.warning("one-way %s to %s failed: %s",
+                                   method, client.address, e)
+
+        self._lt.submit(_attempt(retries))
 
     def _connect_plasma(self, store_socket: Optional[str]) -> None:
         if not store_socket or not CONFIG.enable_plasma_store:
@@ -466,6 +545,8 @@ class CoreWorker:
         if fid not in self._registered_fns:
             self.kv_put(b"fun:" + fid.encode(), data, overwrite=False)
             self._registered_fns.add(fid)
+            if len(data) <= CONFIG.max_inline_function_bytes:
+                self._fn_blobs[fid] = data
         return fid
 
     # ------------------------------------------------------------------- put
@@ -1530,6 +1611,7 @@ class CoreWorker:
             scheduling_strategy=scheduling_strategy or SchedulingStrategySpec(),
             actor_creation=creation,
             runtime_env=runtime_env,
+            function_blob=self._fn_blobs.get(fid),
         )
         spec.kwarg_specs = kwarg_specs
         if name or get_if_exists:

@@ -172,10 +172,11 @@ class Executor:
         return True
 
     # ---------------------------------------------------------------- helpers
-    def _load_function(self, function_id: str):
+    def _load_function(self, function_id: str, blob=None):
         fn = self._fn_cache.get(function_id)
         if fn is None:
-            data = self.cw.kv_get(b"fun:" + function_id.encode())
+            data = (blob if blob is not None
+                    else self.cw.kv_get(b"fun:" + function_id.encode()))
             if data is None:
                 raise RuntimeError(f"function {function_id} not found in GCS")
             fn = ser.loads_function(data)
@@ -364,7 +365,8 @@ class Executor:
         token = self.cw.enter_task_context(spec)
         try:
             creation = spec.actor_creation
-            cls = self._load_function(spec.function_id)
+            cls = self._load_function(spec.function_id,
+                                      getattr(spec, 'function_blob', None))
             args, kwargs = self._resolve_args(spec.args, getattr(spec, "kwarg_specs", {}) or {})
             self.actor_instance = cls(*args, **kwargs)
             self.actor_id = creation.actor_id
@@ -374,6 +376,11 @@ class Executor:
             if creation.is_asyncio:
                 self._start_async_loop()
             self.cw.become_actor(creation)
+            # companion line to the ctor phases (core_worker.__init__):
+            # the cpu delta is the creation-task execution cost
+            from ray_tpu._private.spawn_diag import spawn_timing_write
+
+            spawn_timing_write("created")
             return {"status": "ok", "returns": []}
         except BaseException as e:  # noqa: BLE001
             return self._error_reply(spec, e)
