@@ -29,6 +29,7 @@ def run_worker(raylet_address: str, gcs_address: str, node_id: str,
     CONFIG.load_from_env()
 
     from ray_tpu._private.ids import NodeID
+    from ray_tpu._private.spawn_diag import spawn_timing_write
     from ray_tpu.worker.core_worker import CoreWorker
 
     # RT_WORKER_PROFILE_DIR=<dir>: profile this worker and dump cProfile
@@ -57,7 +58,6 @@ def run_worker(raylet_address: str, gcs_address: str, node_id: str,
     # RT_SPAWN_TIMING=<file>: append one line of bring-up phase timings
     # per worker — how spawn-path regressions at burst scale get located
     # (cProfile dumps don't survive the zygote children's os._exit)
-    timing_path = os.environ.get("RT_SPAWN_TIMING")
     t0 = time.perf_counter()
     core_worker = CoreWorker(
         mode="worker",
@@ -65,12 +65,7 @@ def run_worker(raylet_address: str, gcs_address: str, node_id: str,
         raylet_address=raylet_address,
         node_id=NodeID.from_hex(node_id),
     )
-    if timing_path:
-        try:
-            with open(timing_path, "a") as fh:
-                fh.write(f"{os.getpid()} ctor={time.perf_counter()-t0:.4f}\n")
-        except OSError:
-            pass
+    spawn_timing_write(f"ctor={time.perf_counter() - t0:.4f}")
 
     def _term(_sig, _frm):
         sys.exit(0)

@@ -1,51 +1,129 @@
 """gRPC ingress proxy (reference: ray python/ray/serve/_private/proxy.py:540
 gRPCProxy — gRPC requests route to deployment replicas like HTTP ones).
 
-Generic byte-level service: an RPC to `/<app_name>/<Method>` routes to that
-serve application's ingress deployment, invoking `Method` (unary-unary,
-request bytes in, bytes out — non-bytes returns are JSON-encoded). User
-deployments deal in their own proto bytes, so no schema compilation is
-needed cluster-side; typed stubs on the client call through
-`grpc.UnaryUnaryMultiCallable` with the same paths.
+Two tiers, one server:
+
+* **Typed servicers** (reference: `grpc_servicer_functions` in
+  python/ray/serve/schema.py gRPCOptions, wired in proxy.py:540): users hand
+  the proxy their protoc-generated ``add_XServicer_to_server`` functions.
+  Each is invoked against a recording server, capturing the generated
+  ``grpc.RpcMethodHandler``s — which carry the user's typed
+  request_deserializer / response_serializer and the unary/streaming shape.
+  The proxy re-wraps each handler's behavior with a routing callable, so
+  replicas receive real deserialized proto messages and return proto
+  messages; the target application comes from ``application`` request
+  metadata (sole running app as fallback). Because dispatch goes through one
+  mutable GenericRpcHandler installed before ``server.start()``, servicers
+  can be registered on a live proxy (late ``serve.run`` calls) without
+  restarting the gRPC server.
+
+* **Byte-level fallback**: an RPC to ``/<app_name>/<Method>`` routes to that
+  application's ingress deployment with raw request bytes in / bytes out —
+  no schema compilation needed anywhere cluster-side.
 """
 
 from __future__ import annotations
 
+import functools
+import importlib
 import json
 import logging
-import threading
-from typing import Any, Dict, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 import ray_tpu
 
 logger = logging.getLogger(__name__)
 
+# The method segment comes off the wire: never dispatch to private
+# attributes or replica lifecycle hooks (the HTTP proxy only ever calls
+# __call__; gRPC adds named methods, so it needs the guard).
+_BLOCKED_METHODS = {"check_health", "reconfigure", "shutdown"}
+
+_DEFAULT_TIMEOUT_S = 60.0
+
+
+class _ServicerRecorder:
+    """Stands in for a grpc.Server while an add_XServicer_to_server runs,
+    capturing the generic handlers the generated code builds (public
+    GenericRpcHandler objects wrapping the typed RpcMethodHandlers)."""
+
+    def __init__(self):
+        self.generic_handlers: List[Any] = []
+
+    def add_generic_rpc_handlers(self, handlers) -> None:
+        self.generic_handlers.extend(handlers)
+
+    # Newer grpc generated code also registers methods for the C-core fast
+    # path; dispatch here goes through the generic handler, so ignore it.
+    def add_registered_method_handlers(self, *_a, **_kw) -> None:
+        pass
+
+
+class _NullServicer:
+    """Servicer instance handed to user add-functions. The generated code
+    only getattrs method callables off it to build handlers; the proxy
+    replaces every behavior before serving, so these are never called."""
+
+    def __getattr__(self, name: str) -> Callable:
+        if name.startswith("__"):
+            raise AttributeError(name)
+        return lambda *a, **kw: None
+
+
+def _import_servicer_fn(target: Any) -> Callable:
+    if callable(target):
+        return target
+    path = str(target)
+    if ":" in path:
+        module_name, _, attr = path.partition(":")
+    else:
+        module_name, _, attr = path.rpartition(".")
+    if not module_name:
+        raise ValueError(
+            f"servicer function {path!r} must be 'module.attr' or "
+            "'module:attr'")
+    return getattr(importlib.import_module(module_name), attr)
+
 
 class GrpcProxyActor:
-    def __init__(self, host: str = "127.0.0.1", port: int = 9000):
+    def __init__(self, host: str = "127.0.0.1", port: int = 9000,
+                 servicer_functions: Optional[List[Any]] = None):
         import grpc
 
         self._routes: Dict[str, Any] = {}  # app name -> handle
+        self._typed_handlers: List[Any] = []   # user generic handlers
+        self._handler_cache: Dict[str, Any] = {}  # method path -> rewrapped
+        self._registered_servicers: set = set()
         proxy = self
 
-        # The method segment comes off the wire: never dispatch to private
-        # attributes or replica lifecycle hooks (the HTTP proxy only ever
-        # calls __call__; gRPC adds named methods, so it needs the guard).
-        _blocked = {"check_health", "reconfigure", "shutdown"}
+        class TypedHandler(grpc.GenericRpcHandler):
+            def service(self, handler_call_details):
+                path = handler_call_details.method
+                wrapped = proxy._handler_cache.get(path)
+                if wrapped is not None:
+                    return wrapped
+                user_handler = None
+                for gh in proxy._typed_handlers:
+                    user_handler = gh.service(handler_call_details)
+                    if user_handler is not None:
+                        break
+                if user_handler is None:
+                    return None
+                method = path.rsplit("/", 1)[-1]
+                wrapped = proxy._rewrap(user_handler, method)
+                proxy._handler_cache[path] = wrapped
+                return wrapped
 
-        class Handler(grpc.GenericRpcHandler):
+        class ByteHandler(grpc.GenericRpcHandler):
             def service(self, handler_call_details):
                 # full method: "/<app>/<Method>"
                 parts = handler_call_details.method.strip("/").split("/")
                 if len(parts) != 2:
                     return None
                 app, method = parts
-                if method.startswith("_") or method in _blocked:
+                if method.startswith("_") or method in _BLOCKED_METHODS:
                     return None
-                handle = proxy._routes.get(app)
-                if handle is None:
-                    proxy.update_routes()
-                    handle = proxy._routes.get(app)
+                handle = proxy._resolve_app(app)
                 if handle is None:
                     return None
 
@@ -53,7 +131,7 @@ class GrpcProxyActor:
                     try:
                         resp = handle.options(
                             method_name=method).remote(request).result(
-                                timeout_s=60)
+                                timeout_s=_DEFAULT_TIMEOUT_S)
                     except Exception as e:  # noqa: BLE001 — surface as error
                         logger.exception("grpc request failed")
                         context.abort(grpc.StatusCode.INTERNAL, str(e))
@@ -72,13 +150,201 @@ class GrpcProxyActor:
         from concurrent.futures import ThreadPoolExecutor
 
         self._server = grpc.server(
-            ThreadPoolExecutor(max_workers=16), handlers=(Handler(),))
+            ThreadPoolExecutor(max_workers=16),
+            handlers=(TypedHandler(), ByteHandler()))
         self._port = self._server.add_insecure_port(f"{host}:{port}")
         self._server.start()
+        if servicer_functions:
+            self.register_servicers(servicer_functions)
         self.update_routes()
 
     def ready(self) -> int:
         return self._port
+
+    # -- typed dispatch ---------------------------------------------------
+
+    def register_servicers(self, servicer_functions: List[Any]) -> int:
+        """Install user add_XServicer_to_server functions (dotted-path
+        strings or callables). Idempotent per path/callable; safe on a live
+        server. Returns the number of typed services now registered."""
+        for target in servicer_functions or []:
+            key = target if isinstance(target, str) else (
+                getattr(target, "__module__", "") + "."
+                + getattr(target, "__qualname__", repr(target)))
+            if key in self._registered_servicers:
+                continue
+            add_fn = _import_servicer_fn(target)
+            recorder = _ServicerRecorder()
+            add_fn(_NullServicer(), recorder)
+            if not recorder.generic_handlers:
+                raise ValueError(
+                    f"servicer function {key!r} registered no handlers")
+            self._typed_handlers.extend(recorder.generic_handlers)
+            self._handler_cache.clear()
+            self._registered_servicers.add(key)
+        return len(self._typed_handlers)
+
+    def _rewrap(self, h, method: str):
+        """Rebuild a generated RpcMethodHandler with the same typed
+        (de)serializers but a behavior that routes to a deployment."""
+        import grpc
+
+        if h.request_streaming and h.response_streaming:
+            behavior = functools.partial(self._route_stream, method, True)
+            return grpc.stream_stream_rpc_method_handler(
+                behavior, h.request_deserializer, h.response_serializer)
+        if h.request_streaming:
+            behavior = functools.partial(self._route_unary, method, True)
+            return grpc.stream_unary_rpc_method_handler(
+                behavior, h.request_deserializer, h.response_serializer)
+        if h.response_streaming:
+            behavior = functools.partial(self._route_stream, method, False)
+            return grpc.unary_stream_rpc_method_handler(
+                behavior, h.request_deserializer, h.response_serializer)
+        behavior = functools.partial(self._route_unary, method, False)
+        return grpc.unary_unary_rpc_method_handler(
+            behavior, h.request_deserializer, h.response_serializer)
+
+    def _typed_target(self, method: str, context):
+        import grpc
+
+        if method.startswith("_") or method in _BLOCKED_METHODS:
+            context.abort(grpc.StatusCode.UNIMPLEMENTED,
+                          f"method {method!r} is not callable over gRPC")
+        md = dict(context.invocation_metadata())
+        app = md.get("application")
+        if app is None:
+            if not self._routes:
+                self.update_routes()
+            if len(self._routes) == 1:
+                app = next(iter(self._routes))
+            elif "default" in self._routes:
+                app = "default"
+            elif not self._routes:
+                context.abort(grpc.StatusCode.NOT_FOUND,
+                              "no serve applications are deployed")
+            else:
+                context.abort(
+                    grpc.StatusCode.NOT_FOUND,
+                    "multiple applications running; set 'application' "
+                    "request metadata to pick one of "
+                    f"{sorted(self._routes)}")
+        handle = self._resolve_app(app)
+        if handle is None:
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"no serve application named {app!r}")
+        timeout = context.time_remaining()
+        if timeout is None or timeout <= 0:
+            timeout = _DEFAULT_TIMEOUT_S
+        # Cap at the proxy bound regardless of the client deadline: 16
+        # pool threads shared by every tier must not be pinnable for a
+        # client-chosen eternity by a hung replica.
+        return handle, min(timeout, _DEFAULT_TIMEOUT_S)
+
+    def _route_unary(self, method: str, request_streaming: bool,
+                     request, context):
+        import grpc
+
+        handle, timeout = self._typed_target(method, context)
+        args = (list(request),) if request_streaming else (request,)
+        try:
+            return handle.options(method_name=method).remote(
+                *args).result(timeout_s=timeout)
+        except Exception as e:  # noqa: BLE001 — surface as status
+            logger.exception("typed grpc request failed")
+            context.abort(grpc.StatusCode.INTERNAL, str(e))
+
+    def _route_stream(self, method: str, request_streaming: bool,
+                      request, context):
+        """Server-streaming route. Chunks are pulled on a dedicated thread
+        and handed over via a bounded queue, so the gRPC pool thread always
+        waits with a timeout: a replica that hangs mid-stream, or a client
+        that cancels/expires, frees the pool slot instead of pinning one of
+        the 16 server threads forever (the pull thread unblocks once the
+        replica-side generator task is cancelled by close())."""
+        import queue
+        import threading
+        import time
+
+        import grpc
+
+        handle, _timeout = self._typed_target(method, context)
+        args = (list(request),) if request_streaming else (request,)
+        done = object()
+        q: queue.Queue = queue.Queue(maxsize=64)  # backpressure to replica
+        stop = threading.Event()
+        gen_box: Dict[str, Any] = {}
+
+        def offer(item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.25)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def close_gen():
+            gen = gen_box.get("gen")
+            if gen is not None:
+                try:
+                    gen.close()  # cancel replica task if unfinished
+                except Exception:  # noqa: BLE001 — already torn down
+                    pass
+
+        def pull():
+            try:
+                gen_box["gen"] = handle.options(
+                    method_name=method, stream=True).remote(*args)
+                for item in gen_box["gen"]:
+                    if not offer(item):
+                        return
+                offer(done)
+            except BaseException as e:  # noqa: BLE001 — relay to consumer
+                offer(e)
+            finally:
+                close_gen()
+
+        threading.Thread(target=pull, daemon=True,
+                         name=f"grpc-stream-{method}").start()
+        last_chunk = time.monotonic()
+        try:
+            while True:
+                if not context.is_active():
+                    return  # client cancelled or deadline passed
+                if time.monotonic() - last_chunk > _DEFAULT_TIMEOUT_S:
+                    context.abort(
+                        grpc.StatusCode.DEADLINE_EXCEEDED,
+                        f"no stream chunk within {_DEFAULT_TIMEOUT_S:.0f}s")
+                try:
+                    item = q.get(timeout=0.25)
+                except queue.Empty:
+                    continue
+                if item is done:
+                    return
+                if isinstance(item, BaseException):
+                    logger.error("typed grpc stream failed: %s", item)
+                    context.abort(grpc.StatusCode.INTERNAL, str(item))
+                yield item
+                # Stamped on resume, not before the yield: time the client
+                # spends draining under gRPC flow control must not count
+                # against the replica's chunk-gap watchdog.
+                last_chunk = time.monotonic()
+        finally:
+            # The pull thread may be wedged inside next(gen) (hung
+            # replica) and can never reach its own close — cancel the
+            # replica task from here so it unblocks and exits.
+            stop.set()
+            close_gen()
+
+    # -- routing ----------------------------------------------------------
+
+    def _resolve_app(self, app: str):
+        handle = self._routes.get(app)
+        if handle is None:
+            self.update_routes()
+            handle = self._routes.get(app)
+        return handle
 
     def update_routes(self) -> None:
         from ray_tpu.serve.context import get_controller

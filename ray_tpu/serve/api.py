@@ -162,13 +162,15 @@ _grpc_proxy = None
 
 
 def _ensure_grpc_proxy(grpc_options: Optional[dict] = None):
-    """Per-cluster gRPC ingress (reference: proxy.py:540 gRPCProxy)."""
+    """Per-cluster gRPC ingress (reference: proxy.py:540 gRPCProxy;
+    `grpc_servicer_functions` from schema.py gRPCOptions)."""
     global _grpc_proxy
     import ray_tpu
     from ray_tpu.serve._private.grpc_proxy import GrpcProxyActor
 
+    opts = grpc_options or {}
+    servicers = opts.get("grpc_servicer_functions") or []
     if _grpc_proxy is None:
-        opts = grpc_options or {}
         actor = ray_tpu.remote(GrpcProxyActor).options(
             name="SERVE_GRPC_PROXY", lifetime="detached", num_cpus=0.1,
             get_if_exists=True, max_concurrency=256,
@@ -176,6 +178,14 @@ def _ensure_grpc_proxy(grpc_options: Optional[dict] = None):
                  port=opts.get("port", 9000))
         port = ray_tpu.get(actor.ready.remote())
         _grpc_proxy = (actor, port)
+    actor, _port = _grpc_proxy
+    if servicers:
+        # Registered out of band, never via ctor args: get_if_exists may
+        # have attached to a proxy another driver already created (whose
+        # ctor args would be silently discarded). The dispatch table is
+        # mutable and registration idempotent, so this path covers fresh
+        # and pre-existing proxies alike without a gRPC server restart.
+        ray_tpu.get(actor.register_servicers.remote(servicers))
     return _grpc_proxy
 
 
@@ -197,7 +207,8 @@ def _ensure_proxy(http_options: Optional[dict] = None):
 
 def run(app: Application, *, name: str = "default", route_prefix: str = "/",
         _blocking: bool = False, http_port: Optional[int] = None,
-        grpc_port: Optional[int] = None) -> DeploymentHandle:
+        grpc_port: Optional[int] = None,
+        grpc_servicer_functions: Optional[list] = None) -> DeploymentHandle:
     controller = serve_context.get_controller(create=True)
     import ray_tpu
 
@@ -259,8 +270,10 @@ def run(app: Application, *, name: str = "default", route_prefix: str = "/",
     if http_port is not None:
         proxy = _ensure_proxy({"port": http_port})
         ray_tpu.get(proxy.update_routes.remote())
-    if grpc_port is not None:
-        actor, _port = _ensure_grpc_proxy({"port": grpc_port})
+    if grpc_port is not None or grpc_servicer_functions:
+        actor, _port = _ensure_grpc_proxy({
+            "port": grpc_port if grpc_port is not None else 9000,
+            "grpc_servicer_functions": grpc_servicer_functions})
         ray_tpu.get(actor.update_routes.remote())
     return DeploymentHandle(app.root.deployment.name, name)
 

@@ -67,13 +67,17 @@ class ServeApplicationSchema:
 @dataclasses.dataclass
 class ServeDeploySchema:
     applications: List[ServeApplicationSchema]
+    # Typed gRPC ingress (reference: schema.py gRPCOptions — port +
+    # grpc_servicer_functions, dotted paths to protoc-generated
+    # add_XServicer_to_server functions importable on the cluster).
+    grpc_options: Optional[Dict[str, Any]] = None
 
     @staticmethod
     def from_dict(d: Dict[str, Any]) -> "ServeDeploySchema":
         return ServeDeploySchema(applications=[
             ServeApplicationSchema.from_dict(a)
             for a in d.get("applications", [])
-        ])
+        ], grpc_options=d.get("grpc_options"))
 
     @staticmethod
     def parse_file(path: str) -> "ServeDeploySchema":
@@ -109,6 +113,13 @@ def deploy_config(config: ServeDeploySchema) -> Dict[str, Any]:
             _apply_overrides(app, overrides)
         handles[app_schema.name] = serve.run(
             app, name=app_schema.name, route_prefix=app_schema.route_prefix)
+    if config.grpc_options:
+        from ray_tpu.serve.api import _ensure_grpc_proxy
+
+        actor, _port = _ensure_grpc_proxy(config.grpc_options)
+        import ray_tpu
+
+        ray_tpu.get(actor.update_routes.remote())
     return handles
 
 

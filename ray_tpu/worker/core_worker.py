@@ -276,7 +276,7 @@ class CoreWorker:
                 self._connect_plasma(env_socket)
                 _mark("plasma")
 
-                def _register_failed(e, _self=self):
+                def _register_failed(e):
                     # an unregistered worker is invisible to the raylet but
                     # its pool handle would sit 'starting' forever; dying
                     # restores the blocking-call semantics (process exits,
@@ -308,13 +308,16 @@ class CoreWorker:
         # dead, and fail the local raylet over if it was ours.
         self.subscribe(ps.NODE_CHANNEL, self._on_node_event)
         # fire-and-forget: the reply carries nothing, and a blocking wait
-        # here queued every spawned worker behind the busy GCS loop
-        # retries cover a GCS restart window: without the subscription this
-        # process never learns of node deaths (stale clients to a dead
-        # raylet would hang instead of failing over)
+        # here queued every spawned worker behind the busy GCS loop.
+        # retries=-1 (capped backoff, forever): without the subscription
+        # this process never learns of node deaths (stale clients to a dead
+        # raylet would hang instead of failing over), and a GCS outage
+        # longer than any finite budget must not leave a long-lived worker
+        # permanently unsubscribed — while the GCS is down there are no
+        # node events to miss, so retrying until it returns loses nothing.
         self._post_oneway(self._gcs, "subscribe", {
             "channel": ps.NODE_CHANNEL,
-            "subscriber_address": self.address_str}, retries=5)
+            "subscriber_address": self.address_str}, retries=-1)
         _mark("subscribe")
         if _timing and mode == "worker":
             from ray_tpu._private.spawn_diag import spawn_timing_write
@@ -338,24 +341,30 @@ class CoreWorker:
         """Schedule a one-way message on the loop without waiting for the
         write to drain (ctor hot path: a cross-thread wait per message is
         pure overhead when no reply is coming). Transient connect failures
-        retry with a delay; after the budget, `on_failure` runs (default:
-        log) — fire-and-forget must not mean fail-silent for messages the
-        process cannot function without."""
+        retry with a delay; retries=-1 retries forever with backoff capped
+        at 15s (for messages the process cannot function without). After a
+        finite budget, `on_failure` runs (default: log) — fire-and-forget
+        must not mean fail-silent."""
 
-        async def _attempt(remaining: int):
-            try:
-                await client.send_async(method, payload)
-            except Exception as e:  # noqa: BLE001 — peer down / connecting
-                if remaining > 0:
-                    await asyncio.sleep(retry_delay_s)
-                    await _attempt(remaining - 1)
-                elif on_failure is not None:
-                    on_failure(e)
-                else:
-                    logger.warning("one-way %s to %s failed: %s",
-                                   method, client.address, e)
+        async def _attempt():
+            remaining, delay = retries, retry_delay_s
+            while True:
+                try:
+                    await client.send_async(method, payload)
+                    return
+                except Exception as e:  # noqa: BLE001 — peer down
+                    if remaining == 0:
+                        if on_failure is not None:
+                            on_failure(e)
+                        else:
+                            logger.warning("one-way %s to %s failed: %s",
+                                           method, client.address, e)
+                        return
+                    remaining -= 1
+                    await asyncio.sleep(delay)
+                    delay = min(delay * 2, 15.0)
 
-        self._lt.submit(_attempt(retries))
+        self._lt.submit(_attempt())
 
     def _connect_plasma(self, store_socket: Optional[str]) -> None:
         if not store_socket or not CONFIG.enable_plasma_store:
