@@ -56,6 +56,7 @@ def _load():
     lib.rtps_client_connect.argtypes = [ctypes.c_char_p]
     lib.rtps_client_disconnect.argtypes = [ctypes.c_void_p]
     lib.rtps_client_close_socket.argtypes = [ctypes.c_void_p]
+    lib.rtps_client_prefault.argtypes = [ctypes.c_void_p]
     lib.rtps_client_base.restype = ctypes.POINTER(ctypes.c_ubyte)
     lib.rtps_client_base.argtypes = [ctypes.c_void_p]
     u64p = ctypes.POINTER(ctypes.c_uint64)
@@ -160,6 +161,15 @@ class StoreClient:
             raise ShmStoreError(f"failed to connect to store {socket_path}")
         self._base = lib.rtps_client_base(self._handle)
         self._closed = False
+
+    def prefault(self) -> None:
+        """Fault the arena into this process's page table (background
+        thread, idempotent). Call for long-lived clients that move big
+        objects — a cold mapping writes at ~1.2 GB/s (minor fault per
+        page) vs ~6+ GB/s warm. Not for per-worker clients: 1k workers'
+        worth of redundant PTE population would swamp a small host."""
+        if self._handle and not self._closed:
+            self._lib.rtps_client_prefault(self._handle)
 
     def disconnect(self):
         """Close the control socket. The server then releases every ref this

@@ -18,10 +18,12 @@ def spawn_timing_write(text: str) -> None:
         return
     try:
         import resource
+        import time
 
         ru = resource.getrusage(resource.RUSAGE_SELF)
         with open(path, "a") as fh:
             fh.write(f"{os.getpid()} {text} "
-                     f"cpu={ru.ru_utime + ru.ru_stime:.4f}\n")
+                     f"cpu={ru.ru_utime + ru.ru_stime:.4f} "
+                     f"t={time.time():.4f}\n")
     except OSError:
         pass

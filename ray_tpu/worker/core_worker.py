@@ -223,6 +223,12 @@ class CoreWorker:
         self._node_addr_cache: Dict[NodeID, str] = {}
         self._pg_cache: Dict[PlacementGroupID, Any] = {}
         self._task_events: deque = deque(maxlen=10_000)
+        # Demand wakeups for the periodic loops (created on the loop by
+        # each loop coroutine): at 1k workers/host, fixed-cadence wakeups
+        # in every idle worker add up to a measurable slice of the host
+        # (~400us/s/worker), so idle workers must cost ~zero.
+        self._task_events_wakeup = None
+        self._reaper_wakeup = None
         self._shutdown = False
         self.current_actor_id: Optional[ActorID] = None
         self.is_actor_worker = False
@@ -376,6 +382,12 @@ class CoreWorker:
                 return self._raylet.call(method, payload, timeout=60)
 
             self.plasma = PlasmaProvider(store_socket, _raylet_call)
+            if self.mode == "driver":
+                # Drivers are long-lived and feed checkpoints/weights
+                # through the store; pre-faulting the arena mapping makes
+                # the first big put run at memcpy speed instead of
+                # page-fault speed. Workers skip it (see prefault()).
+                self.plasma.prefault()
         except Exception as e:  # noqa: BLE001 — degrade to in-memory objects
             logger.warning("plasma store unavailable: %s", e)
             self.plasma = None
@@ -1362,6 +1374,7 @@ class CoreWorker:
             addr: Address = reply["worker_address"]
             st.leases[addr.rpc_address] = _Lease(address=addr, busy=False,
                                                 idle_since=time.monotonic())
+            self._poke_reaper()
             await self._pump(key)
             return
 
@@ -1446,10 +1459,26 @@ class CoreWorker:
         if st.pending:
             await self._pump(key)
 
+    def _poke_reaper(self) -> None:
+        """Wake the lease reaper (new lease / queued actor call). Safe from
+        any thread; no-op before the loop starts."""
+        ev = self._reaper_wakeup
+        if ev is not None and not ev.is_set():
+            self._lt.loop.call_soon_threadsafe(ev.set)
+
     async def _lease_reaper_loop(self):
         timeout = CONFIG.worker_lease_idle_timeout_ms / 1000.0
+        self._reaper_wakeup = ev = asyncio.Event()
         last_actor_sweep = 0.0
         while True:
+            if (not any(st.leases for st in self._key_states.values())
+                    and not any(
+                        rec.queue and rec.state not in ("ALIVE", "DEAD")
+                        for rec in self._actors.values())):
+                # Nothing to reap or sweep: park until a lease is taken or
+                # an actor call queues behind a non-ALIVE actor.
+                await ev.wait()
+            ev.clear()
             await asyncio.sleep(timeout / 2)
             now = time.monotonic()
             for key, st in list(self._key_states.items()):
@@ -1777,6 +1806,11 @@ class CoreWorker:
         elif info.state == ActorState.RESTARTING:
             rec.state = "RESTARTING"
             rec.address = None
+            if rec.queue:
+                # The reaper may have parked while this actor looked
+                # ALIVE; queued calls now depend on the lost-ALIVE sweep
+                # backstop, so make sure it is running.
+                self._poke_reaper()
         elif info.state == ActorState.DEAD:
             rec.state = "DEAD"
             rec.death_cause = info.death_cause
@@ -1870,6 +1904,7 @@ class CoreWorker:
                 self._finalize_task(spec, "FAILED")
             return
         rec.queue.extend(specs)
+        self._poke_reaper()  # sweep backstop for a lost ALIVE event
         # Poll GCS once in case we missed the ALIVE (or DEAD) event.
         info = await self._gcs.call_async(
             "get_actor_info", {"actor_id": actor_id})
@@ -2021,6 +2056,7 @@ class CoreWorker:
         if not retry_specs:
             return
         rec.queue.extend(retry_specs)
+        self._poke_reaper()  # sweep backstop while the actor restarts
         if rec.state == "DEAD":
             # the DEAD pubsub event already drained the queue before we
             # re-queued these specs — fail them now or they hang forever
@@ -2635,10 +2671,17 @@ class CoreWorker:
         self._task_events.append(
             (spec.task_id, spec.function_name, spec.task_type.name,
              spec.job_id, state, time.time(), spec.trace_parent))
+        ev = self._task_events_wakeup
+        if ev is not None and not ev.is_set():
+            self._lt.loop.call_soon_threadsafe(ev.set)
 
     async def _task_event_loop(self):
+        self._task_events_wakeup = ev = asyncio.Event()
         while True:
-            await asyncio.sleep(1.0)
+            if not self._task_events:
+                await ev.wait()  # idle workers: zero periodic wakeups
+            ev.clear()
+            await asyncio.sleep(1.0)  # batch window (same flush latency)
             await self._flush_task_events()
 
     async def _flush_task_events(self):

@@ -362,6 +362,12 @@ class Executor:
 
     # ---------------------------------------------------------------- actors
     def _run_actor_creation(self, spec: TaskSpec) -> dict:
+        # companion lines to the ctor phases (core_worker.__init__): the
+        # cpu delta start→created is the creation-task execution cost; the
+        # ctor-phases→start gap is main-loop bring-up + task receive
+        from ray_tpu._private.spawn_diag import spawn_timing_write
+
+        spawn_timing_write("creation_start")
         token = self.cw.enter_task_context(spec)
         try:
             creation = spec.actor_creation
@@ -376,10 +382,6 @@ class Executor:
             if creation.is_asyncio:
                 self._start_async_loop()
             self.cw.become_actor(creation)
-            # companion line to the ctor phases (core_worker.__init__):
-            # the cpu delta is the creation-task execution cost
-            from ray_tpu._private.spawn_diag import spawn_timing_write
-
             spawn_timing_write("created")
             return {"status": "ok", "returns": []}
         except BaseException as e:  # noqa: BLE001

@@ -31,6 +31,10 @@ class PlasmaProvider:
         self._client = StoreClient(socket_path)
         self._raylet_call = raylet_call
 
+    def prefault(self) -> None:
+        """See StoreClient.prefault: warm this process's arena mapping."""
+        self._client.prefault()
+
     # -- write --------------------------------------------------------------
 
     def _create_with_spill_retry(self, oid: ObjectID, size: int,
