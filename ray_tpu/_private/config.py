@@ -112,6 +112,11 @@ _d("rpc_call_timeout_s", 60.0)
 _d("max_direct_call_object_size", 100 * 1024)  # inline threshold (bytes)
 _d("object_store_memory_bytes", 2 * 1024**3)   # per-node plasma capacity
 _d("object_store_fallback_dir", "/tmp/ray_tpu_spill")
+# External spill target (reference: external_storage.py:451 smart_open
+# URIs). "" = node-local disk; "file:///mnt/..." = shared mount;
+# "s3://..."/"gs://..." = object store via fsspec. Remote targets register
+# spill URIs in the GCS so restores survive the spilling node.
+_d("object_spilling_uri", "")
 _d("enable_plasma_store", True)                # node-local C++ shm store
 _d("object_spilling_high_watermark", 0.80)     # spill above this fill ratio
 _d("object_spilling_low_watermark", 0.60)      # ...down to this ratio

@@ -372,6 +372,13 @@ class GcsKvManager:
         return {k: self._store.get(table, self._key(k))
                 for k in payload["keys"]}
 
+    async def handle_kv_multi_put(self, payload):
+        """Batch put (one round trip per spill batch, not per object)."""
+        table = self._table(payload.get("namespace"))
+        for k, v in payload["entries"].items():
+            self._store.put(table, self._key(k), v)
+        return True
+
     async def handle_kv_del(self, payload):
         table = self._table(payload.get("namespace"))
         key = self._key(payload["key"])

@@ -145,8 +145,13 @@ class Raylet:
         self._store_server = None
         self._store_client = None
         self.store_socket: Optional[str] = None
-        self._spilled: Dict[bytes, str] = {}  # store key -> spill file path
+        self._spilled: Dict[bytes, str] = {}  # store key -> spill URI/path
         self._spill_dir: Optional[str] = None
+        self._spill_backend = None  # set with the store (external_storage)
+        # Remote spill URIs not yet confirmed by the GCS registry
+        # (flushed from the spill thread and the heartbeat loop).
+        self._pending_spill_uris: Dict[str, str] = {}
+        self._spill_uri_lock = threading.Lock()
         # Serializes _spill_until across the watermark loop and per-worker
         # spill_objects RPCs (both run via asyncio.to_thread).
         self._spill_lock = threading.Lock()
@@ -427,8 +432,12 @@ class Raylet:
                 sock, CONFIG.object_store_memory_bytes)
             self._store_client = StoreClient(sock)
             self.store_socket = sock
-            self._spill_dir = os.path.join(
-                CONFIG.object_store_fallback_dir, self.node_id.hex()[:12])
+            from ray_tpu.raylet.external_storage import backend_from_config
+
+            self._spill_backend = backend_from_config(self.node_id.hex()[:12])
+            self._spill_dir = getattr(self._spill_backend, "directory",
+                                      getattr(self._spill_backend,
+                                              "base_uri", None))
         except Exception as e:  # noqa: BLE001 — degrade to memory-only store
             logger.warning("node object store unavailable: %s", e)
             self._store_server = None
@@ -445,26 +454,58 @@ class Raylet:
             _, used, cap = c.stats()
             if used <= target_bytes:
                 return 0
-            os.makedirs(self._spill_dir, exist_ok=True)
+            batch_uris = {}
             for key in c.list_ids(primaries=True):
                 view = c.get(key, timeout_ms=0)
                 if view is None:
                     continue
-                path = os.path.join(self._spill_dir, key.hex())
-                tmp = f"{path}.tmp.{threading.get_ident()}"
                 try:
-                    with open(tmp, "wb") as f:
-                        f.write(view)
-                    os.replace(tmp, path)
+                    uri = self._spill_backend.put(key.hex(), view)
                 finally:
                     c.release(key)
-                self._spilled[key] = path
+                self._spilled[key] = uri
+                if self._spill_backend.is_remote:
+                    batch_uris[key.hex()] = uri
                 c.delete(key)
                 spilled += len(view)
                 _, used, cap = c.stats()
                 if used <= target_bytes:
                     break
+            if batch_uris:
+                self._register_spill_uris(batch_uris)
             return spilled
+
+    def _register_spill_uris(self, uris: Dict[str, str]) -> None:
+        """Record remote spill URIs in the cluster-wide GCS registry so a
+        later raylet incarnation (same node or another) can restore them
+        after this node/process is gone. Runs on the spill thread. A
+        failed registration (GCS restarting) stays in the pending set and
+        is retried from the heartbeat loop — an unregistered remote spill
+        is data loss waiting for a raylet replacement."""
+        with self._spill_uri_lock:
+            self._pending_spill_uris.update(uris)
+        self._flush_spill_uris()
+
+    def _flush_spill_uris(self) -> None:
+        """Attempt to push every pending spill URI to the GCS (blocking;
+        call off the event loop). Entries leave the pending set only once
+        the GCS confirmed the batch."""
+        from ray_tpu.raylet.external_storage import SPILL_KV_NAMESPACE
+
+        with self._spill_uri_lock:
+            batch = dict(self._pending_spill_uris)
+        if not batch:
+            return
+        try:
+            self._gcs.call("kv_multi_put", {
+                "namespace": SPILL_KV_NAMESPACE, "entries": batch})
+        except Exception:  # noqa: BLE001 — GCS restarting; retried later
+            logger.warning("failed to register %d spill URIs (will retry)",
+                           len(batch))
+            return
+        with self._spill_uri_lock:
+            for k in batch:
+                self._pending_spill_uris.pop(k, None)
 
     async def _spill_loop(self):
         """Watermark-driven background spilling (reference: plasma create
@@ -500,17 +541,20 @@ class Raylet:
 
         oid = payload["object_id"]
         key = _pad_id(oid.binary())
-        path = self._spilled.get(key)
-        if path is None or self._store_client is None:
+        uri = self._spilled.get(key)
+        if uri is None and self._store_client is not None:
+            # Not in the in-memory map (fresh raylet incarnation, or the
+            # spilling node is gone and this raylet shares the remote
+            # target): fall back to the cluster-wide registry.
+            uri = await self._lookup_spill_uri(key)
+        if uri is None or self._store_client is None:
             return False
 
         def _restore() -> bool:
             from ray_tpu._private.shm_store import ShmStoreFull
 
-            try:
-                with open(path, "rb") as f:
-                    data = f.read()
-            except OSError:
+            data = self._spill_backend.get(uri)
+            if data is None:
                 return False
             for attempt in (0, 1):
                 try:
@@ -529,18 +573,49 @@ class Raylet:
                     return self._store_client.contains(key)
             return False
 
-        return await asyncio.to_thread(_restore)
+        ok = await asyncio.to_thread(_restore)
+        if ok:
+            self._spilled[key] = uri  # cache for the next restore/free
+        return ok
+
+    async def _lookup_spill_uri(self, key: bytes) -> Optional[str]:
+        from ray_tpu.raylet.external_storage import SPILL_KV_NAMESPACE
+
+        if not self._spill_backend.is_remote:
+            return None
+        try:
+            return await self._gcs.call_async("kv_get", {
+                "namespace": SPILL_KV_NAMESPACE, "key": key.hex()})
+        except Exception:  # noqa: BLE001 — GCS restarting
+            return None
 
     async def handle_free_spilled(self, payload):
         from ray_tpu._private.shm_store import _pad_id
+        from ray_tpu.raylet.external_storage import SPILL_KV_NAMESPACE
 
+        to_delete = []
         for oid in payload["object_ids"]:
             key = _pad_id(oid.binary())
-            path = self._spilled.pop(key, None)
-            if path is not None:
+            uri = self._spilled.pop(key, None)
+            if uri is not None:
+                to_delete.append((key, uri))
+        if not to_delete:
+            return True
+
+        def _delete_batch():
+            # Off-loop: a remote backend's delete is a network round trip
+            # per object; a batch of frees must not stall lease/restore
+            # handling for its duration.
+            for _key, uri in to_delete:
+                self._spill_backend.delete(uri)
+
+        await asyncio.to_thread(_delete_batch)
+        if self._spill_backend.is_remote:
+            for key, _uri in to_delete:
                 try:
-                    os.unlink(path)
-                except OSError:
+                    await self._gcs.send_async("kv_del", {
+                        "namespace": SPILL_KV_NAMESPACE, "key": key.hex()})
+                except Exception:  # noqa: BLE001 — best-effort GC
                     pass
         return True
 
@@ -1023,6 +1098,10 @@ class Raylet:
         period = CONFIG.heartbeat_period_ms / 1000.0
         while True:
             try:
+                if self._pending_spill_uris:
+                    # Spill-registry retry backstop (GCS was unreachable
+                    # when the spill thread tried); off-loop, it blocks.
+                    await asyncio.to_thread(self._flush_spill_uris)
                 # Aggregate queued lease shapes so the autoscaler can
                 # bin-pack unfulfilled demand (reference: load reported to
                 # GCS drives resource_demand_scheduler.py).
