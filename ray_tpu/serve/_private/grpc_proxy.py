@@ -42,6 +42,14 @@ _BLOCKED_METHODS = {"check_health", "reconfigure", "shutdown"}
 _DEFAULT_TIMEOUT_S = 60.0
 
 
+class _Failure:
+    """Wraps an exception crossing a handover queue, so replica RETURN
+    VALUES that happen to be exception instances are never misread."""
+
+    def __init__(self, error: BaseException):
+        self.error = error
+
+
 class _ServicerRecorder:
     """Stands in for a grpc.Server while an add_XServicer_to_server runs,
     capturing the generic handlers the generated code builds (public
@@ -91,6 +99,7 @@ class GrpcProxyActor:
         import grpc
 
         self._routes: Dict[str, Any] = {}  # app name -> handle
+        self._routes_stamp = 0.0           # last update_routes() time
         self._typed_handlers: List[Any] = []   # user generic handlers
         self._handler_cache: Dict[str, Any] = {}  # method path -> rewrapped
         self._registered_servicers: set = set()
@@ -214,7 +223,14 @@ class GrpcProxyActor:
         md = dict(context.invocation_metadata())
         app = md.get("application")
         if app is None:
-            if not self._routes:
+            # No explicit target: the pick below depends on the FULL app
+            # set (deleted apps must drop out, new ones appear), so a
+            # cached map can misroute. Refresh on a short TTL — named
+            # lookups stay cache-first via _resolve_app.
+            import time as _time
+
+            now = _time.monotonic()
+            if not self._routes or now - self._routes_stamp > 2.0:
                 self.update_routes()
             if len(self._routes) == 1:
                 app = next(iter(self._routes))
@@ -246,13 +262,49 @@ class GrpcProxyActor:
         import grpc
 
         handle, timeout = self._typed_target(method, context)
-        args = (list(request),) if request_streaming else (request,)
-        try:
-            return handle.options(method_name=method).remote(
-                *args).result(timeout_s=timeout)
-        except Exception as e:  # noqa: BLE001 — surface as status
-            logger.exception("typed grpc request failed")
-            context.abort(grpc.StatusCode.INTERNAL, str(e))
+        if not request_streaming:
+            try:
+                return handle.options(method_name=method).remote(
+                    request).result(timeout_s=timeout)
+            except Exception as e:  # noqa: BLE001 — surface as status
+                logger.exception("typed grpc request failed")
+                context.abort(grpc.StatusCode.INTERNAL, str(e))
+            return None
+        # Client-streaming: draining the request iterator can block for as
+        # long as the client dawdles, so it runs on a side thread and the
+        # pool thread waits with a bound — a never-half-closing client
+        # must not pin one of the 16 shared server threads.
+        import queue
+        import threading
+        import time
+
+        result_q: queue.Queue = queue.Queue(maxsize=1)
+
+        def work():
+            try:
+                result_q.put(handle.options(method_name=method).remote(
+                    list(request)).result(timeout_s=timeout))
+            except BaseException as e:  # noqa: BLE001 — relay to consumer
+                result_q.put(_Failure(e))
+
+        threading.Thread(target=work, daemon=True,
+                         name=f"grpc-drain-{method}").start()
+        deadline = time.monotonic() + timeout
+        while True:
+            if not context.is_active():
+                return None  # client gone; grpc raises in the iterator
+            if time.monotonic() > deadline:
+                context.abort(
+                    grpc.StatusCode.DEADLINE_EXCEEDED,
+                    f"client stream not completed within {timeout:.0f}s")
+            try:
+                item = result_q.get(timeout=0.25)
+            except queue.Empty:
+                continue
+            if isinstance(item, _Failure):
+                logger.error("typed grpc request failed: %s", item.error)
+                context.abort(grpc.StatusCode.INTERNAL, str(item.error))
+            return item
 
     def _route_stream(self, method: str, request_streaming: bool,
                       request, context):
@@ -269,7 +321,6 @@ class GrpcProxyActor:
         import grpc
 
         handle, _timeout = self._typed_target(method, context)
-        args = (list(request),) if request_streaming else (request,)
         done = object()
         q: queue.Queue = queue.Queue(maxsize=64)  # backpressure to replica
         stop = threading.Event()
@@ -294,6 +345,10 @@ class GrpcProxyActor:
 
         def pull():
             try:
+                # For bidi, draining the client stream happens HERE too:
+                # it can block on a dawdling client and must not run on
+                # the shared pool thread.
+                args = (list(request),) if request_streaming else (request,)
                 gen_box["gen"] = handle.options(
                     method_name=method, stream=True).remote(*args)
                 for item in gen_box["gen"]:
@@ -301,7 +356,7 @@ class GrpcProxyActor:
                         return
                 offer(done)
             except BaseException as e:  # noqa: BLE001 — relay to consumer
-                offer(e)
+                offer(_Failure(e))
             finally:
                 close_gen()
 
@@ -322,9 +377,9 @@ class GrpcProxyActor:
                     continue
                 if item is done:
                     return
-                if isinstance(item, BaseException):
-                    logger.error("typed grpc stream failed: %s", item)
-                    context.abort(grpc.StatusCode.INTERNAL, str(item))
+                if isinstance(item, _Failure):
+                    logger.error("typed grpc stream failed: %s", item.error)
+                    context.abort(grpc.StatusCode.INTERNAL, str(item.error))
                 yield item
                 # Stamped on resume, not before the yield: time the client
                 # spends draining under gRPC flow control must not count
@@ -358,6 +413,9 @@ class GrpcProxyActor:
         self._routes = {
             app_name: DeploymentHandle(info["ingress"], app_name)
             for app_name, info in apps.items()}
+        import time as _time
+
+        self._routes_stamp = _time.monotonic()
 
     def stop(self) -> None:
         self._server.stop(grace=1.0)
