@@ -40,6 +40,11 @@ class _ForkedProc:
         self.pid = pid
         self.returncode: Optional[int] = None
         self._gone_since = 0.0
+        # Flipped off once the zygote is gone (pool shutdown, zygote
+        # crash): no exit report can arrive anymore, so the grace window
+        # below would only stall every waiter by 0.5s per worker — the
+        # dominant cost of cluster shutdown before this flag existed.
+        self.report_expected = True
 
     def poll(self) -> Optional[int]:
         if self.returncode is not None:
@@ -48,6 +53,9 @@ class _ForkedProc:
             os.kill(self.pid, 0)
             return None
         except ProcessLookupError:
+            if not self.report_expected:
+                self.returncode = -1
+                return self.returncode
             now = time.monotonic()
             if not self._gone_since:
                 self._gone_since = now
@@ -305,6 +313,10 @@ class WorkerPool:
         # zygote gone: drop pending forks so their waiters respawn direct
         if self._zygote is z:
             self._zygote = None
+            for h in self._workers.values():
+                # Its exit reports die with it; see _ForkedProc.poll.
+                if isinstance(h.proc, _ForkedProc):
+                    h.proc.report_expected = False
         if not self._closed:
             self._zygote_failures += 1
             if self._zygote_failures >= 3:
@@ -759,6 +771,33 @@ class WorkerPool:
         self._closed = True
         if self._monitor_task is not None:
             self._monitor_task.cancel()
+        # Terminate workers BEFORE the zygote: forked workers are the
+        # zygote's children, and only a live zygote reaps them and reports
+        # their exits (setting _ForkedProc.returncode). Killing the zygote
+        # first left every worker a zombie under init, whose slow reap made
+        # each wait() burn its poll deadline — cluster shutdown cost ~2s of
+        # pure waiting before this ordering.
+        # Snapshots, not live views: the zygote reader is still running on
+        # the loop thread (by design — it reaps and reports the exits the
+        # wait loop below consumes) and re-keys _workers when a pending
+        # fork lands mid-shutdown.
+        handles = list(self._workers.values())
+        for handle in handles:
+            if handle.proc is not None and handle.proc.poll() is None:
+                try:
+                    handle.proc.terminate()
+                except Exception:
+                    pass
+        deadline = time.monotonic() + 2.0
+        for handle in handles:
+            if handle.proc is not None:
+                try:
+                    handle.proc.wait(timeout=max(0.05, deadline - time.monotonic()))
+                except Exception:
+                    try:
+                        handle.proc.kill()
+                    except Exception:
+                        pass
         if self._zygote is not None:
             try:
                 self._zygote.stdin.close()  # EOF = clean zygote exit
@@ -769,19 +808,3 @@ class WorkerPool:
             except Exception:  # noqa: BLE001
                 pass
             self._zygote = None
-        for handle in self._workers.values():
-            if handle.proc is not None and handle.proc.poll() is None:
-                try:
-                    handle.proc.terminate()
-                except Exception:
-                    pass
-        deadline = time.monotonic() + 2.0
-        for handle in self._workers.values():
-            if handle.proc is not None:
-                try:
-                    handle.proc.wait(timeout=max(0.05, deadline - time.monotonic()))
-                except Exception:
-                    try:
-                        handle.proc.kill()
-                    except Exception:
-                        pass

@@ -126,6 +126,7 @@ def test_dqn_uses_exploration_config():
         algo.stop()
 
 
+@pytest.mark.slow  # ~170s of model-based training: stress/e2e tier
 def test_dreamerv3_learns():
     """World-model regression: DreamerV3's CartPole return must clear the
     random baseline (~22) by a real margin — evidence the model +
