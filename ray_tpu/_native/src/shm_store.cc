@@ -285,18 +285,13 @@ class StoreServer {
     }
     accept_thread_ = std::thread([this] { AcceptLoop(); });
 
-    // Pre-fault the arena in the background: a first-touch write into
-    // cold shmem pages is zero-fill + page-fault bound (~1.2 GB/s on the
-    // CI host) while warm pages take memcpy at ~8.5 GB/s. Faulting every
-    // page once up front moves that cost off the first large put. Gate:
-    // RT_STORE_PREFAULT=0 disables (memory-constrained hosts).
-    // madvise-only, no byte-touch fallback: the accept thread is already
-    // serving puts, and writing even one byte per page would race (and
-    // corrupt) live object data. Populate is best-effort — without
-    // MADV_POPULATE_WRITE (pre-5.14) first puts just stay fault-bound.
+    // Opt-in whole-arena pre-fault (RT_STORE_PREFAULT=1): see
+    // StoreClient::Prefault for why this must never be the default --
+    // populating the full capacity on every cluster init melts a test
+    // farm of short-lived clusters.
 #ifdef MADV_POPULATE_WRITE
     const char* pf = getenv("RT_STORE_PREFAULT");
-    if (pf == nullptr || strcmp(pf, "0") != 0) {
+    if (pf != nullptr && strcmp(pf, "1") == 0) {
       uint64_t cap = arena_.capacity();
       prefault_thread_ = std::thread([this, cap] {
         madvise(base_, cap, MADV_POPULATE_WRITE);
@@ -650,27 +645,6 @@ class StoreClient {
     }
   }
 
-  // Fault the arena into THIS process's page table in the background.
-  // A fresh mapping pays a minor fault per 4 KiB page on first touch
-  // (~3us/page on the CI host => ~1.2 GB/s effective for a cold 1 GiB
-  // write); pre-populating moves that off the first large put/get. Only
-  // worth it for long-lived clients that move big objects (the driver) —
-  // per-worker clients skip it (1k workers x 2 GiB of PTE work is not).
-  void Prefault() {
-#ifdef MADV_POPULATE_WRITE
-    bool expected = false;
-    if (!prefault_started_.compare_exchange_strong(expected, true)) return;
-    prefault_thread_ = std::thread([this] {
-      // madvise-only (no touch fallback): POPULATE_WRITE installs PTEs
-      // without writing data, so it cannot race live objects. A read-
-      // touch fallback would only map the shared zero page for holes —
-      // no populate effect for later writes — and a write-touch would
-      // corrupt concurrent writers' bytes.
-      madvise(base_, capacity_, MADV_POPULATE_WRITE);
-    });
-#endif
-  }
-
   ~StoreClient() {
     CloseSocket();
     if (prefault_thread_.joinable()) prefault_thread_.join();
@@ -705,6 +679,26 @@ class StoreClient {
       if (want > extra_cap || !ReadFull(fd_, extra, want)) return ST_ERR;
     }
     return rsp.status;
+  }
+
+  // Fault the whole arena into THIS process's page table in the
+  // background (opt-in: RT_STORE_PREFAULT=1). Zero-fill of fresh shmem
+  // pages runs at ~1 GB/s on the CI host no matter how it is triggered,
+  // so per-allocation populate cannot beat plain write faults; paying
+  // the cost ONCE per long-lived process in the background is the only
+  // real win (first big put then runs at memcpy speed). Default-off
+  // because populating object_store_memory_bytes on every cluster init
+  // melts a test farm that starts hundreds of short-lived clusters.
+  // madvise-only: POPULATE_WRITE installs pages/PTEs without writing
+  // data, so it cannot race live objects (a touch loop would).
+  void Prefault() {
+#ifdef MADV_POPULATE_WRITE
+    bool expected = false;
+    if (!prefault_started_.compare_exchange_strong(expected, true)) return;
+    prefault_thread_ = std::thread([this] {
+      madvise(base_, capacity_, MADV_POPULATE_WRITE);
+    });
+#endif
   }
 
   uint8_t* base() const { return base_; }
@@ -829,6 +823,7 @@ void* rtps_client_connect(const char* socket_path) {
 void rtps_client_disconnect(void* cli) {
   delete static_cast<StoreClient*>(cli);
 }
+
 
 void rtps_client_prefault(void* cli) {
   static_cast<StoreClient*>(cli)->Prefault();

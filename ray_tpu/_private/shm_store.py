@@ -163,11 +163,14 @@ class StoreClient:
         self._closed = False
 
     def prefault(self) -> None:
-        """Fault the arena into this process's page table (background
-        thread, idempotent). Call for long-lived clients that move big
-        objects — a cold mapping writes at ~1.2 GB/s (minor fault per
-        page) vs ~6+ GB/s warm. Not for per-worker clients: 1k workers'
-        worth of redundant PTE population would swamp a small host."""
+        """Fault the whole arena into this process's page table
+        (background thread, idempotent). Zero-fill of fresh shmem pages
+        runs at ~1 GB/s regardless of mechanism, so the only real win is
+        paying it ONCE per long-lived process — after which big puts run
+        at memcpy speed (~5-6 GB/s vs ~1.2 cold). Opt-in by design
+        (RT_STORE_PREFAULT=1 drives the callers): populating the full
+        capacity on every cluster init melts a farm of short-lived test
+        clusters."""
         if self._handle and not self._closed:
             self._lib.rtps_client_prefault(self._handle)
 

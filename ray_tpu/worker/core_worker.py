@@ -382,11 +382,12 @@ class CoreWorker:
                 return self._raylet.call(method, payload, timeout=60)
 
             self.plasma = PlasmaProvider(store_socket, _raylet_call)
-            if self.mode == "driver":
-                # Drivers are long-lived and feed checkpoints/weights
-                # through the store; pre-faulting the arena mapping makes
-                # the first big put run at memcpy speed instead of
-                # page-fault speed. Workers skip it (see prefault()).
+            if (self.mode == "driver"
+                    and os.environ.get("RT_STORE_PREFAULT") == "1"):
+                # Opt-in (long-lived perf contexts): warm the driver's
+                # arena mapping so the first checkpoint/weights-sized put
+                # runs at memcpy speed. See StoreClient.prefault for why
+                # this must not be default-on.
                 self.plasma.prefault()
         except Exception as e:  # noqa: BLE001 — degrade to in-memory objects
             logger.warning("plasma store unavailable: %s", e)

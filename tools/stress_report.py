@@ -24,6 +24,9 @@ import numpy as np
 def _fresh_cluster(num_cpus=4):
     import ray_tpu
 
+    # Long-lived perf context: pre-fault the store arena in the background
+    # so the 1 GiB put measures the store, not first-touch page zero-fill.
+    os.environ.setdefault("RT_STORE_PREFAULT", "1")
     ray_tpu.init(num_cpus=num_cpus, ignore_reinit_error=False)
     return ray_tpu
 
