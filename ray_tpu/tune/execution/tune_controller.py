@@ -202,6 +202,38 @@ class TuneController:
         self._invoke_callbacks(
             "on_trial_complete", self._iteration, self.trials, trial)
 
+    def _launchable_concurrency(self) -> int:
+        """max_concurrent additionally bounded by what the cluster can
+        actually host. Launching a trial the cluster has no CPUs for
+        deadlocks the loop: the actor pends, the blocking init_session
+        get() wedges the controller, and the running trials whose
+        completion would free CPUs are never processed (their actors hold
+        their CPUs until _stop_trial kills them). Counts the RUNNING
+        trials' actual launched resources, not the experiment default —
+        ResourceChanging overrides would otherwise re-open the wedge."""
+        cpu_per = (self._resources or {}).get("CPU", 1.0)
+        if not cpu_per or cpu_per <= 0:
+            return self._max_concurrent
+        try:
+            total = ray_tpu.cluster_resources().get("CPU", 0.0)
+        except Exception:  # noqa: BLE001 — no cluster view: trust config
+            return self._max_concurrent
+        if total <= 0:
+            return self._max_concurrent
+        running = [t for t in self.trials if t.status == RUNNING]
+        held = sum(
+            (getattr(t, "_launched_resources", None)
+             or self._resources or {}).get("CPU", 1.0)
+            for t in running)
+        headroom = max(0.0, total - held)
+        cap = len(running) + int(headroom // cpu_per)
+        if not running:
+            # A trial that can NEVER fit must still launch once so the
+            # bounded init_session wait surfaces the infeasibility as a
+            # trial error instead of the loop spinning forever at cap 0.
+            cap = max(cap, 1)
+        return min(self._max_concurrent, cap)
+
     def _maybe_create_trials(self) -> None:
         while (not self._search_done
                and sum(1 for t in self.trials if t.status == RUNNING)
@@ -325,10 +357,11 @@ class TuneController:
                     self._stop_trial(t, TERMINATED)
             return False
         self._maybe_create_trials()
+        launch_cap = self._launchable_concurrency()
         for trial in self.trials:
             if trial.status == PENDING and (
                     sum(1 for t in self.trials if t.status == RUNNING)
-                    < self._max_concurrent):
+                    < launch_cap):
                 try:
                     self._launch_trial(trial)
                 except Exception as e:  # noqa: BLE001 — actor start failure

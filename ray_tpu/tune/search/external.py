@@ -17,7 +17,8 @@ from typing import Any, Dict, Optional
 from ray_tpu.tune.search import sample
 from ray_tpu.tune.search.searcher import Searcher
 
-__all__ = ["OptunaSearch", "HyperOptSearch"]
+__all__ = ["OptunaSearch", "HyperOptSearch", "NevergradSearch",
+           "ZOOptSearch", "HEBOSearch", "AxSearch"]
 
 
 def _metric_sign(mode: str) -> float:
@@ -170,3 +171,298 @@ class HyperOptSearch(Searcher):
                 t["state"] = self._hp.JOB_STATE_DONE
                 t["result"] = {"status": self._hp.STATUS_OK, "loss": loss}
         self._hp_trials.refresh()
+
+
+class NevergradSearch(Searcher):
+    """Tune searcher over nevergrad's ask/tell optimizers (requires
+    nevergrad). Reference: ray tune/search/nevergrad/nevergrad_search.py —
+    space translates to an ng parametrization; ng minimizes, so "max"
+    negates the objective."""
+
+    def __init__(self, space: Optional[Dict[str, Any]] = None,
+                 metric: Optional[str] = None, mode: str = "max",
+                 optimizer=None, budget: Optional[int] = None,
+                 **optimizer_kwargs):
+        try:
+            import nevergrad as ng
+        except ImportError as e:
+            raise ImportError(
+                "NevergradSearch requires nevergrad (`pip install "
+                "nevergrad`); the built-in TPESearch/BayesOptSearch "
+                "provide dependency-free alternatives") from e
+        super().__init__(metric=metric, mode=mode)
+        self._ng = ng
+        self._budget = budget
+        self._opt_cls = optimizer or ng.optimizers.NGOpt
+        self._opt_kwargs = optimizer_kwargs
+        self._opt = None
+        self._candidates: Dict[str, Any] = {}
+        self._space = space or {}
+        if self._space:
+            self._build()
+
+    def _build(self) -> None:
+        ng = self._ng
+        params = {}
+        for name, dist in self._space.items():
+            if isinstance(dist, sample.Categorical):
+                params[name] = ng.p.Choice(list(dist.categories))
+            elif isinstance(dist, sample.Integer):
+                p = ng.p.Scalar(lower=dist.lower, upper=dist.upper - 1)
+                params[name] = p.set_integer_casting()
+            elif isinstance(dist, sample.Float):
+                if dist.log:
+                    params[name] = ng.p.Log(lower=dist.lower,
+                                            upper=dist.upper)
+                else:
+                    params[name] = ng.p.Scalar(lower=dist.lower,
+                                               upper=dist.upper)
+            else:  # constant
+                params[name] = ng.p.Choice([dist])
+        self._opt = self._opt_cls(
+            parametrization=ng.p.Dict(**params), budget=self._budget,
+            **self._opt_kwargs)
+
+    def set_search_properties(self, metric, mode, config) -> bool:
+        if config and not self._space:
+            self._space = config
+            self._build()
+        return super().set_search_properties(metric, mode, config)
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if self._opt is None:
+            return None
+        cand = self._opt.ask()
+        self._candidates[trial_id] = cand
+        return dict(cand.value)
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        cand = self._candidates.pop(trial_id, None)
+        if cand is None or error or result is None \
+                or self.metric not in result:
+            return
+        loss = -_metric_sign(self.mode) * float(result[self.metric])
+        self._opt.tell(cand, loss)
+
+
+class ZOOptSearch(Searcher):
+    """Tune searcher over ZOOpt's SRacosTune (requires zoopt >= 0.4.1).
+    Reference: ray tune/search/zoopt/zoopt_search.py — Dimension2 space,
+    suggest()/complete() lifecycle, minimizing the signed metric."""
+
+    def __init__(self, space: Optional[Dict[str, Any]] = None,
+                 metric: Optional[str] = None, mode: str = "max",
+                 budget: int = 100, parallel_num: int = 1, **zoopt_kwargs):
+        try:
+            import zoopt
+        except ImportError as e:
+            raise ImportError(
+                "ZOOptSearch requires zoopt (`pip install -U zoopt`); the "
+                "built-in TPESearch provides a dependency-free "
+                "alternative") from e
+        super().__init__(metric=metric, mode=mode)
+        self._zoopt = zoopt
+        self._budget = budget
+        self._parallel_num = parallel_num
+        self._zoopt_kwargs = zoopt_kwargs
+        self._solutions: Dict[str, Any] = {}
+        self.optimizer = None
+        self._space = space or {}
+        if self._space:
+            self._build()
+
+    def _build(self) -> None:
+        zoopt = self._zoopt
+        dim_list = []
+        for _name, dist in self._space.items():
+            if isinstance(dist, sample.Categorical):
+                dim_list.append((zoopt.ValueType.GRID,
+                                 list(dist.categories)))
+            elif isinstance(dist, sample.Integer):
+                dim_list.append((zoopt.ValueType.DISCRETE,
+                                 [dist.lower, dist.upper - 1], True))
+            elif isinstance(dist, sample.Float):
+                dim_list.append((zoopt.ValueType.CONTINUOUS,
+                                 [dist.lower, dist.upper], 1e-10))
+            else:
+                dim_list.append((zoopt.ValueType.GRID, [dist]))
+        dim = zoopt.Dimension2(dim_list)
+        par = zoopt.Parameter(budget=self._budget, **self._zoopt_kwargs)
+        from zoopt.algos.opt_algorithms.racos.sracos import SRacosTune
+
+        self.optimizer = SRacosTune(dimension=dim, parameter=par,
+                                    parallel_num=self._parallel_num)
+
+    def set_search_properties(self, metric, mode, config) -> bool:
+        if config and not self._space:
+            self._space = config
+            self._build()
+        return super().set_search_properties(metric, mode, config)
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if self.optimizer is None:
+            return None
+        solution = self.optimizer.suggest()
+        if solution == "FINISHED":
+            return Searcher.FINISHED
+        if solution is None:
+            return None
+        self._solutions[trial_id] = solution
+        x = solution.get_x()
+        return dict(zip(self._space.keys(), x))
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        solution = self._solutions.pop(trial_id, None)
+        if solution is None or error or result is None \
+                or self.metric not in result:
+            return
+        loss = -_metric_sign(self.mode) * float(result[self.metric])
+        self.optimizer.complete(solution, loss)
+
+
+class HEBOSearch(Searcher):
+    """Tune searcher over HEBO (requires HEBO). Reference: ray
+    tune/search/hebo/hebo_search.py — DesignSpace from the Tune space,
+    suggest()/observe() with the loss minimized."""
+
+    def __init__(self, space: Optional[Dict[str, Any]] = None,
+                 metric: Optional[str] = None, mode: str = "max",
+                 **hebo_kwargs):
+        try:
+            from hebo.design_space.design_space import DesignSpace
+            from hebo.optimizers.hebo import HEBO
+        except ImportError as e:
+            raise ImportError(
+                "HEBOSearch requires hebo (`pip install HEBO`); the "
+                "built-in BayesOptSearch provides a dependency-free "
+                "alternative") from e
+        super().__init__(metric=metric, mode=mode)
+        self._DesignSpace = DesignSpace
+        self._HEBO = HEBO
+        self._hebo_kwargs = hebo_kwargs
+        self._opt = None
+        self._suggestions: Dict[str, Any] = {}
+        self._space = space or {}
+        if self._space:
+            self._build()
+
+    def _build(self) -> None:
+        specs = []
+        for name, dist in self._space.items():
+            if isinstance(dist, sample.Categorical):
+                specs.append({"name": name, "type": "cat",
+                              "categories": list(dist.categories)})
+            elif isinstance(dist, sample.Integer):
+                specs.append({"name": name, "type": "int",
+                              "lb": dist.lower, "ub": dist.upper - 1})
+            elif isinstance(dist, sample.Float):
+                specs.append({
+                    "name": name,
+                    "type": "pow" if dist.log else "num",
+                    "lb": dist.lower, "ub": dist.upper})
+            else:
+                specs.append({"name": name, "type": "cat",
+                              "categories": [dist]})
+        self._opt = self._HEBO(self._DesignSpace().parse_space(specs),
+                               **self._hebo_kwargs)
+
+    def set_search_properties(self, metric, mode, config) -> bool:
+        if config and not self._space:
+            self._space = config
+            self._build()
+        return super().set_search_properties(metric, mode, config)
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if self._opt is None:
+            return None
+        df = self._opt.suggest(n_suggestions=1)
+        self._suggestions[trial_id] = df
+        row = df.iloc[0]
+        return {k: row[k] for k in self._space}
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        df = self._suggestions.pop(trial_id, None)
+        if df is None or error or result is None \
+                or self.metric not in result:
+            return
+        import numpy as np
+
+        loss = -_metric_sign(self.mode) * float(result[self.metric])
+        self._opt.observe(df, np.array([[loss]]))
+
+
+class AxSearch(Searcher):
+    """Tune searcher over the Ax service API (requires ax-platform).
+    Reference: ray tune/search/ax/ax_search.py — AxClient experiment per
+    run, get_next_trial()/complete_trial() lifecycle."""
+
+    def __init__(self, space: Optional[Dict[str, Any]] = None,
+                 metric: Optional[str] = None, mode: str = "max",
+                 ax_client=None, **ax_kwargs):
+        try:
+            from ax.service.ax_client import AxClient
+        except ImportError as e:
+            raise ImportError(
+                "AxSearch requires ax-platform (`pip install "
+                "ax-platform`); the built-in BayesOptSearch provides a "
+                "dependency-free alternative") from e
+        super().__init__(metric=metric, mode=mode)
+        self._ax = ax_client or AxClient(**ax_kwargs)
+        self._trial_indices: Dict[str, int] = {}
+        self._experiment_created = ax_client is not None
+        self._space = space or {}
+        if self._space and not self._experiment_created:
+            self._build()
+
+    def _build(self) -> None:
+        parameters = []
+        for name, dist in self._space.items():
+            if isinstance(dist, sample.Categorical):
+                parameters.append({"name": name, "type": "choice",
+                                   "values": list(dist.categories)})
+            elif isinstance(dist, sample.Integer):
+                parameters.append({
+                    "name": name, "type": "range",
+                    "bounds": [dist.lower, dist.upper - 1],
+                    "value_type": "int",
+                    "log_scale": bool(dist.log)})
+            elif isinstance(dist, sample.Float):
+                parameters.append({
+                    "name": name, "type": "range",
+                    "bounds": [dist.lower, dist.upper],
+                    "value_type": "float",
+                    "log_scale": bool(dist.log)})
+            else:
+                parameters.append({"name": name, "type": "fixed",
+                                   "value": dist})
+        self._ax.create_experiment(
+            name="ray_tpu_tune", parameters=parameters,
+            objective_name=self.metric,
+            minimize=self.mode == "min")
+        self._experiment_created = True
+
+    def set_search_properties(self, metric, mode, config) -> bool:
+        ok = super().set_search_properties(metric, mode, config)
+        if config and not self._space:
+            self._space = config
+        if self._space and not self._experiment_created:
+            self._build()
+        return ok
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if not self._experiment_created:
+            return None
+        params, index = self._ax.get_next_trial()
+        self._trial_indices[trial_id] = index
+        return dict(params)
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        index = self._trial_indices.pop(trial_id, None)
+        if index is None:
+            return
+        if error or result is None or self.metric not in result:
+            self._ax.log_trial_failure(trial_index=index)
+            return
+        self._ax.complete_trial(
+            trial_index=index,
+            raw_data={self.metric: (float(result[self.metric]), 0.0)})

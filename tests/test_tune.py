@@ -243,3 +243,31 @@ def test_external_searchers_gate_cleanly():
     except ImportError:
         with _pytest.raises(ImportError, match="hyperopt"):
             HyperOptSearch(space)
+
+
+def test_more_samples_than_cluster_cpus_completes(ray_start_2_cpus,
+                                                  tmp_path):
+    """Trial launches must be bounded by what the cluster can host
+    (regression: with num_samples > cluster CPUs the controller launched
+    an unschedulable actor and blocked on its init_session while the
+    running trials' actors held every CPU — a 120s-per-trial wedge that
+    ERRORED healthy trials)."""
+    import time as _time
+
+    def objective(config):
+        tune.report({"score": config["x"]})
+
+    t0 = _time.perf_counter()
+    results = tune.Tuner(
+        objective,
+        param_space={"x": tune.uniform(0, 1)},
+        tune_config=tune.TuneConfig(num_samples=5, metric="score",
+                                    mode="max"),
+        run_config=RunConfig(name="cap", storage_path=str(tmp_path)),
+    ).fit()
+    took = _time.perf_counter() - t0
+    assert len(results) == 5
+    assert all(r.metrics.get("score") is not None for r in results), [
+        r.error for r in results]
+    # Far below the 120s-per-wedged-trial regression regime.
+    assert took < 90, took
