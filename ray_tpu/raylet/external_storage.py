@@ -143,9 +143,12 @@ def backend_from_config(node_id_hex: str) -> SpillBackend:
         return FileUriBackend(uri)
     try:
         return FsspecBackend(uri)
-    except ImportError:
+    except Exception as e:  # noqa: BLE001 — missing fsspec OR a bad URI:
+        # either way the node must degrade to local-disk spill, never
+        # lose its whole object store to a config typo (the caller's
+        # blanket except would null the store server AND client).
         logger.warning(
-            "RT_OBJECT_SPILLING_URI=%s needs fsspec, which is not "
-            "installed; falling back to node-local disk spill", uri)
+            "RT_OBJECT_SPILLING_URI=%s unusable (%s); falling back to "
+            "node-local disk spill", uri, e)
         return LocalDirBackend(os.path.join(
             CONFIG.object_store_fallback_dir, node_id_hex))

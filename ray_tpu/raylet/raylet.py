@@ -449,42 +449,39 @@ class Raylet:
         c = self._store_client
         if c is None:
             return 0
-        with self._spill_lock:
-            spilled = 0
-            _, used, cap = c.stats()
-            if used <= target_bytes:
-                return 0
-            batch_uris = {}
-            for key in c.list_ids(primaries=True):
-                view = c.get(key, timeout_ms=0)
-                if view is None:
-                    continue
-                try:
-                    uri = self._spill_backend.put(key.hex(), view)
-                finally:
-                    c.release(key)
-                self._spilled[key] = uri
-                if self._spill_backend.is_remote:
-                    batch_uris[key.hex()] = uri
-                c.delete(key)
-                spilled += len(view)
+        try:
+            with self._spill_lock:
+                spilled = 0
                 _, used, cap = c.stats()
                 if used <= target_bytes:
-                    break
-            if batch_uris:
-                self._register_spill_uris(batch_uris)
-            return spilled
-
-    def _register_spill_uris(self, uris: Dict[str, str]) -> None:
-        """Record remote spill URIs in the cluster-wide GCS registry so a
-        later raylet incarnation (same node or another) can restore them
-        after this node/process is gone. Runs on the spill thread. A
-        failed registration (GCS restarting) stays in the pending set and
-        is retried from the heartbeat loop — an unregistered remote spill
-        is data loss waiting for a raylet replacement."""
-        with self._spill_uri_lock:
-            self._pending_spill_uris.update(uris)
-        self._flush_spill_uris()
+                    return 0
+                for key in c.list_ids(primaries=True):
+                    view = c.get(key, timeout_ms=0)
+                    if view is None:
+                        continue
+                    try:
+                        uri = self._spill_backend.put(key.hex(), view)
+                    finally:
+                        c.release(key)
+                    self._spilled[key] = uri
+                    if self._spill_backend.is_remote:
+                        # Recorded per object, BEFORE anything that can
+                        # fail later in the batch: a spilled-and-deleted
+                        # object the registry never learns about is data
+                        # loss waiting for a raylet replacement.
+                        with self._spill_uri_lock:
+                            self._pending_spill_uris[key.hex()] = uri
+                    c.delete(key)
+                    spilled += len(view)
+                    _, used, cap = c.stats()
+                    if used <= target_bytes:
+                        break
+                return spilled
+        finally:
+            # Outside _spill_lock: the GCS round trip may block for the
+            # RPC timeout, and restores/worker-spill RPCs must not queue
+            # behind it. The heartbeat loop retries whatever this misses.
+            self._flush_spill_uris()
 
     def _flush_spill_uris(self) -> None:
         """Attempt to push every pending spill URI to the GCS (blocking;
