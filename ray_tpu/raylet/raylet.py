@@ -151,6 +151,9 @@ class Raylet:
         # Remote spill URIs not yet confirmed by the GCS registry
         # (flushed from the spill thread and the heartbeat loop).
         self._pending_spill_uris: Dict[str, str] = {}
+        # Keys freed while a registry flush may have been in flight with
+        # an older snapshot; the next flush un-registers them.
+        self._freed_spill_keys: set = set()
         self._spill_uri_lock = threading.Lock()
         # Serializes _spill_until across the watermark loop and per-worker
         # spill_objects RPCs (both run via asyncio.to_thread).
@@ -491,18 +494,26 @@ class Raylet:
 
         with self._spill_uri_lock:
             batch = dict(self._pending_spill_uris)
-        if not batch:
+            stale = list(self._freed_spill_keys)
+        if not batch and not stale:
             return
         try:
-            self._gcs.call("kv_multi_put", {
-                "namespace": SPILL_KV_NAMESPACE, "entries": batch})
+            if batch:
+                self._gcs.call("kv_multi_put", {
+                    "namespace": SPILL_KV_NAMESPACE, "entries": batch})
+            # Un-register keys freed while an older flush snapshot may
+            # already have landed their entries.
+            for k in stale:
+                self._gcs.call("kv_del", {
+                    "namespace": SPILL_KV_NAMESPACE, "key": k})
         except Exception:  # noqa: BLE001 — GCS restarting; retried later
-            logger.warning("failed to register %d spill URIs (will retry)",
-                           len(batch))
+            logger.warning("failed to sync %d spill URIs (will retry)",
+                           len(batch) + len(stale))
             return
         with self._spill_uri_lock:
             for k in batch:
                 self._pending_spill_uris.pop(k, None)
+            self._freed_spill_keys.difference_update(stale)
 
     async def _spill_loop(self):
         """Watermark-driven background spilling (reference: plasma create
@@ -598,6 +609,14 @@ class Raylet:
                 to_delete.append((key, uri))
         if not to_delete:
             return True
+        with self._spill_uri_lock:
+            for key, _uri in to_delete:
+                # Raced the spill batch before its registry flush: drop
+                # the pending entry so the flush can't register a freed
+                # object; remember the key so a flush whose snapshot
+                # predates this free gets un-registered afterwards.
+                self._pending_spill_uris.pop(key.hex(), None)
+                self._freed_spill_keys.add(key.hex())
 
         def _delete_batch():
             # Off-loop: a remote backend's delete is a network round trip
@@ -1095,7 +1114,7 @@ class Raylet:
         period = CONFIG.heartbeat_period_ms / 1000.0
         while True:
             try:
-                if self._pending_spill_uris:
+                if self._pending_spill_uris or self._freed_spill_keys:
                     # Spill-registry retry backstop (GCS was unreachable
                     # when the spill thread tried); off-loop, it blocks.
                     await asyncio.to_thread(self._flush_spill_uris)

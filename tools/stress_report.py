@@ -24,11 +24,20 @@ import numpy as np
 def _fresh_cluster(num_cpus=4):
     import ray_tpu
 
-    # Long-lived perf context: pre-fault the store arena in the background
-    # so the 1 GiB put measures the store, not first-touch page zero-fill.
-    os.environ.setdefault("RT_STORE_PREFAULT", "1")
     ray_tpu.init(num_cpus=num_cpus, ignore_reinit_error=False)
     return ray_tpu
+
+
+def _phase_done() -> None:
+    """Collect after a phase's refs are dropped: 100k live ObjectRefs
+    make every later allocation-heavy phase pay full-heap GC scans
+    (measured: the 1k-actor burst ran 2x slower with the task phase's
+    refs still alive). Phases are independent workloads; their garbage
+    must not bleed into the next measurement. Call AFTER clearing the
+    phase's variables — the collect must see them unreachable."""
+    import gc
+
+    gc.collect()
 
 
 def envelope() -> dict:
@@ -53,6 +62,8 @@ def envelope() -> dict:
     dt = time.perf_counter() - t0
     out["queued_tasks"] = {"n": n, "seconds": round(dt, 2),
                            "tasks_per_sec": round(n / dt, 1)}
+    refs = None
+    _phase_done()
 
     n = 1000
     t0 = time.perf_counter()
@@ -68,6 +79,8 @@ def envelope() -> dict:
         "n": n, "create_ready_seconds": round(dt, 2),
         "create_per_sec": round(n / dt, 1),
         "remove_seconds": round(time.perf_counter() - t1, 2)}
+    pgs = None
+    _phase_done()
 
     @ray_tpu.remote(num_cpus=0)
     class Member:
@@ -93,10 +106,21 @@ def envelope() -> dict:
         "round_trip_calls_per_sec": round(n / call_dt, 1)}
     for a in actors:
         ray_tpu.kill(a)
+    actors = got = None
+    _phase_done()
 
+    # Warm the arena ONLY now: GiB-scale resident memory in the driver
+    # measurably halves actor/control-plane burst throughput on this
+    # 1-core host (verified with plain anonymous ballast too), so the
+    # warm-up must come after the burst phases it would tax. A throwaway
+    # put is deterministic (unlike waiting on the background prefault
+    # thread): it faults exactly the pages the timed put will reuse.
     size = 1 << 30
     arr = np.empty(size, dtype=np.uint8)
-    arr[::4096] = 1  # fault source pages in: measure the store, not np.empty
+    arr[::4096] = 1  # fault source pages in too
+    warm_ref = ray_tpu.put(arr)
+    del warm_ref
+    _phase_done()  # collect -> the freed slot is reusable by the timed put
     t0 = time.perf_counter()
     ref = ray_tpu.put(arr)
     put_dt = time.perf_counter() - t0
