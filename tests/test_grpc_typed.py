@@ -231,3 +231,68 @@ def test_typed_grpc_ingress(proto_pkg, serve_shutdown):
         except Exception:
             pass
         ray_tpu.shutdown()
+
+
+def test_deploy_config_grpc_options(proto_pkg, serve_shutdown, tmp_path):
+    """The declarative deploy path wires typed servicers too (reference:
+    schema.py gRPCOptions in ServeDeploySchema): a JSON config with
+    grpc_options.grpc_servicer_functions serves compiled-proto RPCs."""
+    import importlib
+
+    import ray_tpu
+    from ray_tpu.serve.schema import ServeDeploySchema, deploy_config
+
+    pb2 = importlib.import_module(f"{_PKG}.inference_pb2")
+    pb2_grpc = importlib.import_module(f"{_PKG}.inference_pb2_grpc")
+
+    app_mod = tmp_path / "graft_grpc_cfg_app.py"
+    app_mod.write_text(
+        "from ray_tpu import serve\n"
+        f"from {_PKG} import inference_pb2 as pb2\n\n\n"
+        "@serve.deployment\n"
+        "class Scorer:\n"
+        "    def Predict(self, request):\n"
+        "        return pb2.PredictReply(name=request.name,\n"
+        "                                total=2 * sum(request.values))\n\n\n"
+        "app = Scorer.bind()\n")
+    sys.path.insert(0, str(tmp_path))
+    old_pp = os.environ.get("PYTHONPATH", "")
+    os.environ["PYTHONPATH"] = f"{tmp_path}{os.pathsep}{old_pp}"
+    try:
+        ray_tpu.init(num_cpus=4)
+        config = ServeDeploySchema.from_dict({
+            "applications": [{
+                "import_path": "graft_grpc_cfg_app:app",
+                "name": "scored",
+                "route_prefix": "/scored",
+            }],
+            "grpc_options": {
+                "port": 0,
+                "grpc_servicer_functions": [
+                    f"{_PKG}.inference_pb2_grpc"
+                    ".add_InferenceServicer_to_server"],
+            },
+        })
+        handles = deploy_config(config)
+        assert "scored" in handles
+        from ray_tpu.serve.api import _grpc_proxy
+
+        assert _grpc_proxy is not None
+        _actor, port = _grpc_proxy
+        channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+        stub = pb2_grpc.InferenceStub(channel)
+        reply = stub.Predict(
+            pb2.PredictRequest(name="cfg", values=[1.0, 2.0]), timeout=60)
+        assert reply.name == "cfg" and reply.total == pytest.approx(6.0)
+        channel.close()
+    finally:
+        sys.path.remove(str(tmp_path))
+        sys.modules.pop("graft_grpc_cfg_app", None)
+        os.environ["PYTHONPATH"] = old_pp
+        try:
+            from ray_tpu import serve
+
+            serve.shutdown()
+        except Exception:
+            pass
+        ray_tpu.shutdown()
