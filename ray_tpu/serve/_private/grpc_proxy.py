@@ -123,6 +123,42 @@ class GrpcProxyActor:
                 proxy._handler_cache[path] = wrapped
                 return wrapped
 
+        class ServeApiHandler(grpc.GenericRpcHandler):
+            """Built-in service (reference: proxy.py:561
+            ray.serve.RayServeAPIService — ListApplications + Healthz).
+            Responses are hand-encoded protobuf wire format — both
+            messages are a single repeated/singular string field — so
+            generated RayServeAPIService stubs parse them, without any
+            cluster-side proto codegen."""
+
+            @staticmethod
+            def _pb_strings(values) -> bytes:
+                # field 1, wire type 2 (length-delimited), per value.
+                def varint(n: int) -> bytes:
+                    out = b""
+                    while True:
+                        b7, n = n & 0x7F, n >> 7
+                        out += bytes([b7 | (0x80 if n else 0)])
+                        if not n:
+                            return out
+
+                return b"".join(b"\x0a" + varint(len(v.encode()))
+                                + v.encode() for v in values)
+
+            def service(self, handler_call_details):
+                method = handler_call_details.method
+                if method == "/ray.serve.RayServeAPIService/Healthz":
+                    return grpc.unary_unary_rpc_method_handler(
+                        lambda _req, _ctx: self._pb_strings(["success"]))
+                if method == ("/ray.serve.RayServeAPIService"
+                              "/ListApplications"):
+                    def list_apps(_req, _ctx):
+                        proxy._refresh_routes_if_stale()
+                        return self._pb_strings(sorted(proxy._routes))
+
+                    return grpc.unary_unary_rpc_method_handler(list_apps)
+                return None
+
         class ByteHandler(grpc.GenericRpcHandler):
             def service(self, handler_call_details):
                 # full method: "/<app>/<Method>"
@@ -160,7 +196,7 @@ class GrpcProxyActor:
 
         self._server = grpc.server(
             ThreadPoolExecutor(max_workers=16),
-            handlers=(TypedHandler(), ByteHandler()))
+            handlers=(ServeApiHandler(), TypedHandler(), ByteHandler()))
         self._port = self._server.add_insecure_port(f"{host}:{port}")
         self._server.start()
         if servicer_functions:
@@ -227,11 +263,7 @@ class GrpcProxyActor:
             # set (deleted apps must drop out, new ones appear), so a
             # cached map can misroute. Refresh on a short TTL — named
             # lookups stay cache-first via _resolve_app.
-            import time as _time
-
-            now = _time.monotonic()
-            if not self._routes or now - self._routes_stamp > 2.0:
-                self.update_routes()
+            self._refresh_routes_if_stale()
             if len(self._routes) == 1:
                 app = next(iter(self._routes))
             elif "default" in self._routes:
@@ -393,6 +425,15 @@ class GrpcProxyActor:
             close_gen()
 
     # -- routing ----------------------------------------------------------
+
+    def _refresh_routes_if_stale(self) -> None:
+        """Controller round trip at most every 2s: full-app-set readers
+        (metadata-less fallback, ListApplications) must not turn into a
+        per-RPC controller call on the shared pool threads."""
+        import time as _time
+
+        if not self._routes or _time.monotonic() - self._routes_stamp > 2.0:
+            self.update_routes()
 
     def _resolve_app(self, app: str):
         handle = self._routes.get(app)

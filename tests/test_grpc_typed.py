@@ -37,6 +37,14 @@ _PROTO = textwrap.dedent("""\
       float total = 2;
     }
 
+    message HealthzResponse {
+      string message = 1;
+    }
+
+    message ListApplicationsResponse {
+      repeated string application_names = 1;
+    }
+
     service Inference {
       rpc Predict (PredictRequest) returns (PredictReply);
       rpc StreamPredict (PredictRequest) returns (stream PredictReply);
@@ -201,6 +209,20 @@ def test_typed_grpc_ingress(proto_pkg, serve_shutdown):
         # The byte-level fallback still serves on the same port.
         echo = channel.unary_unary("/typed/Echo")
         assert echo(b"hi", timeout=60) == b"hi!"
+
+        # Built-in RayServeAPIService endpoints (reference: proxy.py:561).
+        # Parsed with REAL protobuf classes matching Ray's serve.proto
+        # shapes, proving the hand-encoded replies are wire-compatible
+        # with generated RayServeAPIService stubs.
+        healthz = channel.unary_unary(
+            "/ray.serve.RayServeAPIService/Healthz",
+            response_deserializer=pb2.HealthzResponse.FromString)
+        assert healthz(b"", timeout=60).message == "success"
+        list_apps = channel.unary_unary(
+            "/ray.serve.RayServeAPIService/ListApplications",
+            response_deserializer=pb2.ListApplicationsResponse.FromString)
+        assert list(list_apps(b"", timeout=60).application_names) == [
+            "typed"]
 
         # Lifecycle methods stay unreachable through the typed path too:
         # a second servicer registration naming a blocked method aborts.
