@@ -95,6 +95,7 @@ _d("health_check_failure_threshold", 5)
 _d("worker_register_timeout_s", 30.0)
 _d("worker_lease_idle_timeout_ms", 1000)  # submitter returns cached leases after this
 _d("worker_pool_idle_timeout_s", 60.0)    # raylet kills idle spare workers
+_d("worker_log_max_files", 2000)          # prune oldest dead-worker logs past this
 _d("worker_pool_prestart", 0)
 # cap on simultaneously-STARTING worker processes (reference:
 # maximum_startup_concurrency = num CPUs): an unthrottled 1k-actor burst

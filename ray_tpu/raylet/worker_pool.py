@@ -766,6 +766,49 @@ class WorkerPool:
                     and not handle.is_driver
                 ):
                     self._kill(handle)
+            if tick % 1200 == 0:  # ~once a minute
+                await asyncio.to_thread(self.prune_worker_logs)
+
+    def prune_worker_logs(self) -> int:
+        """Cap the worker-log directory at CONFIG.worker_log_max_files
+        (reference: per-file log rotation in ray_constants — bounded log
+        disk either way). A day of actor churn leaves tens of thousands
+        of dead workers' logs behind; oldest files go first, live
+        workers' logs are never touched. Returns files removed."""
+        cap = CONFIG.worker_log_max_files
+        if not cap or cap <= 0:
+            return 0
+        start = time.time()
+        # list() of a dict's values is a single GIL-held C operation, so
+        # this snapshot cannot interleave with the event loop registering
+        # new workers (this method runs on a to_thread worker); a plain
+        # set comprehension over the live dict could raise mid-iteration.
+        live = {h.log_path for h in list(self._workers.values())
+                if h.log_path}
+        try:
+            with os.scandir(self._log_dir) as it:
+                entries = [(e.stat().st_mtime, e.path) for e in it
+                           if e.is_file() and e.name.startswith("worker-")]
+        except OSError:
+            return 0
+        excess = len(entries) - cap
+        if excess <= 0:
+            return 0
+        entries.sort()
+        removed = 0
+        for mtime, path in entries:
+            if removed >= excess:
+                break
+            # Fresh files may belong to workers spawned after the live
+            # snapshot — never delete anything newer than the prune start.
+            if path in live or mtime >= start - 1.0:
+                continue
+            try:
+                os.unlink(path)
+                removed += 1
+            except OSError:
+                pass
+        return removed
 
     def shutdown(self):
         self._closed = True

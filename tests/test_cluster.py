@@ -382,3 +382,39 @@ def test_node_label_hard_constraint_never_violated(ray_start_cluster):
     nid = ray_tpu.get(ref, timeout=60)
     labels = {n["NodeID"]: n["Labels"] for n in ray_tpu.nodes()}
     assert labels[nid].get("zone") == "mars"
+
+
+def test_worker_log_pruning(tmp_path):
+    """Dead workers' log files are capped (a day of actor churn leaves
+    tens of thousands behind); live workers' logs are never pruned."""
+    import os
+    import time as _time
+
+    from ray_tpu._private.config import CONFIG
+    from ray_tpu.raylet.worker_pool import WorkerHandle, WorkerPool
+
+    log_dir = tmp_path / "workers"
+    log_dir.mkdir()
+    old = []
+    for i in range(30):
+        p = log_dir / f"worker-{i}.log"
+        p.write_text("x")
+        os.utime(p, (1_000_000 + i, 1_000_000 + i))
+        old.append(str(p))
+    pool = WorkerPool.__new__(WorkerPool)  # no cluster needed
+    pool._log_dir = str(log_dir)
+    live = WorkerHandle(pid=1, proc=None, state="idle",
+                        log_path=old[0])  # oldest file, but LIVE
+    pool._workers = {1: live}
+    saved = CONFIG.worker_log_max_files
+    CONFIG.worker_log_max_files = 10
+    try:
+        removed = pool.prune_worker_logs()
+        remaining = sorted(f.name for f in log_dir.iterdir())
+        assert removed == 20
+        assert len(remaining) == 10
+        assert "worker-0.log" in remaining  # live survives despite age
+        # idempotent at the cap
+        assert pool.prune_worker_logs() == 0
+    finally:
+        CONFIG.worker_log_max_files = saved
