@@ -96,6 +96,11 @@ _d("worker_register_timeout_s", 30.0)
 _d("worker_lease_idle_timeout_ms", 1000)  # submitter returns cached leases after this
 _d("worker_pool_idle_timeout_s", 60.0)    # raylet kills idle spare workers
 _d("worker_log_max_files", 2000)          # prune oldest dead-worker logs past this
+# Per-worker log rotation (reference: ray_constants LOGGING_ROTATE_BYTES
+# 512 MiB / LOGGING_ROTATE_BACKUP_COUNT 5): a long-lived chatty worker
+# must not grow its log unboundedly. 0 bytes disables rotation.
+_d("worker_log_rotate_bytes", 512 * 1024 * 1024)
+_d("worker_log_rotate_backups", 5)
 _d("worker_pool_prestart", 0)
 # cap on simultaneously-STARTING worker processes (reference:
 # maximum_startup_concurrency = num CPUs): an unthrottled 1k-actor burst

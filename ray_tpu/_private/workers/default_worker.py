@@ -17,6 +17,46 @@ import sys
 import time
 
 
+def _start_log_rotator(config) -> None:
+    """Size-rotate this worker's own log file (reference: ray_constants
+    LOGGING_ROTATE_BYTES/BACKUP_COUNT — bounded per-worker log disk).
+    fds 1/2 point at the log; rotation renames the file and dup2s a
+    fresh one under them, so writers never notice. The raylet's log
+    monitor detects the size drop and restarts its tail offset."""
+    import threading
+    import time as _time
+
+    log_path = os.environ.get("RT_WORKER_LOG_PATH")
+    max_bytes = config.worker_log_rotate_bytes
+    backups = max(1, config.worker_log_rotate_backups)
+    if not log_path or not max_bytes or max_bytes <= 0:
+        return
+
+    period = float(os.environ.get("RT_WORKER_LOG_ROTATE_CHECK_S", "30"))
+
+    def rotate_loop():
+        while True:
+            _time.sleep(period)
+            try:
+                if os.path.getsize(log_path) < max_bytes:
+                    continue
+                for i in range(backups - 1, 0, -1):
+                    src = f"{log_path}.{i}"
+                    if os.path.exists(src):
+                        os.replace(src, f"{log_path}.{i + 1}")
+                os.replace(log_path, f"{log_path}.1")
+                fd = os.open(log_path,
+                             os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+                os.dup2(fd, 1)
+                os.dup2(fd, 2)
+                os.close(fd)
+            except OSError:
+                pass
+
+    threading.Thread(target=rotate_loop, daemon=True,
+                     name="rt-log-rotator").start()
+
+
 def run_worker(raylet_address: str, gcs_address: str, node_id: str,
                log_level: str = "INFO"):
     """Connect a CoreWorker and serve until terminated. Shared by the
@@ -31,6 +71,8 @@ def run_worker(raylet_address: str, gcs_address: str, node_id: str,
     from ray_tpu._private.ids import NodeID
     from ray_tpu._private.spawn_diag import spawn_timing_write
     from ray_tpu.worker.core_worker import CoreWorker
+
+    _start_log_rotator(CONFIG)
 
     # RT_WORKER_PROFILE_DIR=<dir>: profile this worker and dump cProfile
     # stats at (graceful) exit — how the zygote preimport set and the

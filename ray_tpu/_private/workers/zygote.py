@@ -46,6 +46,7 @@ def _child(req: dict, args) -> None:
     os.close(devnull)
     for k, v in (req.get("env") or {}).items():
         os.environ[k] = v
+    os.environ["RT_WORKER_LOG_PATH"] = req["log_path"]  # for self-rotation
     # default SIGTERM disposition; run_worker installs its own handler
     signal.signal(signal.SIGTERM, signal.SIG_DFL)
     from ray_tpu._private.workers.default_worker import run_worker

@@ -393,6 +393,7 @@ class WorkerPool:
 
         env = self._worker_base_env(needs_accelerator)
         env["RT_SPAWN_TOKEN"] = token
+        env["RT_WORKER_LOG_PATH"] = log_path  # for self-rotation
         if self.store_socket:
             env["RT_STORE_SOCKET"] = self.store_socket
         # Keep worker start light: no JAX/accelerator init at import time.
@@ -420,6 +421,9 @@ class WorkerPool:
                 return
             forwarded = ["RT_SYSTEM_CONFIG", "RT_SPAWN_TOKEN",
                          "RT_STORE_SOCKET", "JAX_PLATFORMS",
+                         # /tmp is bind-mounted, so in-container rotation
+                         # works on the same log file the raylet tails
+                         "RT_WORKER_LOG_PATH",
                          *self._extra_env.keys()]
             wrap = [runtime, "run", "--rm", "--network=host",
                     "-v", "/tmp:/tmp"]
@@ -785,6 +789,14 @@ class WorkerPool:
         # set comprehension over the live dict could raise mid-iteration.
         live = {h.log_path for h in list(self._workers.values())
                 if h.log_path}
+
+        def is_live(path: str) -> bool:
+            if path in live:
+                return True
+            # Rotation backups (<log>.N) of a live worker are part of its
+            # log, not dead-worker residue.
+            stem, dot, suffix = path.rpartition(".")
+            return bool(dot) and suffix.isdigit() and stem in live
         try:
             with os.scandir(self._log_dir) as it:
                 entries = [(e.stat().st_mtime, e.path) for e in it
@@ -801,7 +813,7 @@ class WorkerPool:
                 break
             # Fresh files may belong to workers spawned after the live
             # snapshot — never delete anything newer than the prune start.
-            if path in live or mtime >= start - 1.0:
+            if is_live(path) or mtime >= start - 1.0:
                 continue
             try:
                 os.unlink(path)

@@ -418,3 +418,48 @@ def test_worker_log_pruning(tmp_path):
         assert pool.prune_worker_logs() == 0
     finally:
         CONFIG.worker_log_max_files = saved
+
+
+def test_worker_log_rotation():
+    """A chatty long-lived worker's log rotates at the size cap
+    (reference: LOGGING_ROTATE_BYTES), keeping backups, without breaking
+    the driver-bound log stream."""
+    import os
+    import time as _time
+
+    os.environ["RT_WORKER_LOG_ROTATE_BYTES"] = "20000"
+    os.environ["RT_WORKER_LOG_ROTATE_CHECK_S"] = "0.3"
+    import ray_tpu
+
+    try:
+        ray_tpu.init(num_cpus=2)
+
+        @ray_tpu.remote(num_cpus=0)
+        class Chatty:
+            def spam(self, n):
+                for i in range(n):
+                    print(f"line {i} " + "x" * 100)
+                return os.getpid()
+
+            def log_path(self):
+                return os.environ.get("RT_WORKER_LOG_PATH")
+
+        a = Chatty.remote()
+        path = ray_tpu.get(a.log_path.remote())
+        assert path, "worker did not receive RT_WORKER_LOG_PATH"
+        for _ in range(4):
+            ray_tpu.get(a.spam.remote(200))  # ~21KB per call > cap
+            _time.sleep(0.6)
+        deadline = _time.monotonic() + 10
+        while _time.monotonic() < deadline:
+            if os.path.exists(path + ".1"):
+                break
+            _time.sleep(0.3)
+        assert os.path.exists(path + ".1"), "log never rotated"
+        assert os.path.getsize(path) < 80_000
+        # The worker still works and logs after rotation.
+        assert ray_tpu.get(a.spam.remote(1)) > 0
+    finally:
+        os.environ.pop("RT_WORKER_LOG_ROTATE_BYTES", None)
+        os.environ.pop("RT_WORKER_LOG_ROTATE_CHECK_S", None)
+        ray_tpu.shutdown()
