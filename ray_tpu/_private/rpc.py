@@ -36,7 +36,15 @@ class RpcError(Exception):
 
 
 class ConnectionLost(RpcError):
-    pass
+    """Peer unreachable. `maybe_delivered` distinguishes a request that
+    MAY have reached the peer (connection died awaiting the reply — the
+    peer might be executing it) from one that certainly did not (connect
+    or frame-write failed): callers can retry the latter without
+    consuming at-most-once retry budgets."""
+
+    def __init__(self, msg: str, maybe_delivered: bool = True):
+        super().__init__(msg)
+        self.maybe_delivered = maybe_delivered
 
 
 def _addr_str(addr: Tuple[str, int]) -> str:
@@ -352,7 +360,8 @@ class RpcClient:
         try:
             await self._ensure_connected()
         except OSError as e:
-            raise ConnectionLost(f"cannot connect to {self.address}: {e}")
+            raise ConnectionLost(f"cannot connect to {self.address}: {e}",
+                                 maybe_delivered=False)
         msg_id = next(self._msg_ids)
         fut = asyncio.get_event_loop().create_future()
         self._pending[msg_id] = fut
@@ -361,6 +370,10 @@ class RpcClient:
             await self._writer.drain()
         except (ConnectionResetError, BrokenPipeError, AttributeError):
             self._pending.pop(msg_id, None)
+            # maybe_delivered stays True: TCP gives no delivery receipt —
+            # the full frame may have reached (and started executing on)
+            # the peer before the local write/drain observed the reset.
+            # Only a CONNECT failure (above) proves non-delivery.
             raise ConnectionLost(f"connection to {self.address} lost")
         if timeout is None:
             return await fut

@@ -759,13 +759,35 @@ class CoreWorker:
                         return data
             except ConnectionLost:
                 self._peers.invalidate(location)
-            # Primary copy lost. Try lineage reconstruction via the owner.
+            # Primary copy lost. Before lineage reconstruction, ask the
+            # LOCAL raylet to restore from spill: with a remote spill
+            # backend (file:// mount, s3://), the dead node may have
+            # spilled this object to shared storage and registered the
+            # URI cluster-wide — a storage read beats re-executing the
+            # task tree (the preemptible-node recovery path).
+            if self.plasma is not None:
+                s = self.plasma.get_serialized(oid, restore=True)
+                if s is not None:
+                    return s
+            # Try lineage reconstruction via the owner.
             if owner is not None and owner.rpc_address == self.address_str:
                 if not self._try_reconstruct(oid):
                     raise exc.ObjectLostError(oid.hex())
                 entry = self.memory_store.wait_entry(oid, 60)
                 if entry is None:
                     raise exc.ObjectLostError(oid.hex())
+                if entry.is_exception and (entry.value is not _SENTINEL
+                                           or entry.serialized is not None):
+                    # The re-execution itself failed (e.g. retries
+                    # exhausted against a dying node): raise the stored
+                    # error — returning its serialized form here would
+                    # hand the caller an exception VALUE, unchecked
+                    # because the caller's entry snapshot predates it.
+                    # (Stored errors are always inline; anything else
+                    # falls through to the location re-resolve below.)
+                    value = (entry.value if entry.value is not _SENTINEL
+                             else ser.deserialize(entry.serialized)[0])
+                    self._raise_stored_error(value)
                 if entry.location is not None and entry.serialized is None:
                     location = entry.location
                     continue
@@ -1412,11 +1434,31 @@ class CoreWorker:
                 "push_task_w", [spec_to_wire(s) for s in specs],
                 timeout=None)
             replies = [reply_from_wire(t) for t in wire]
-        except ConnectionLost:
+        except ConnectionLost as e:
             st.leases.pop(lease.address.rpc_address, None)
             self._peers.invalidate(lease.address.rpc_address)
-            for spec in specs:
-                self._on_worker_failure(spec)
+            if not e.maybe_delivered:
+                # The push never reached the worker (connect refused —
+                # cached lease to an already-dead process, e.g.
+                # reconstruction right after a node death): nothing
+                # executed, so requeue for a fresh lease WITHOUT
+                # consuming at-most-once retry budget. Bounded: a target
+                # that refuses connections persistently must still
+                # terminate via the normal failure path, not spin.
+                for spec in reversed(specs):
+                    pending = self._pending_tasks.get(spec.task_id)
+                    if pending is None:
+                        continue
+                    pending.undelivered_failures = getattr(
+                        pending, "undelivered_failures", 0) + 1
+                    if pending.undelivered_failures > 20:
+                        self._on_worker_failure(spec)
+                        continue
+                    pending.pushed_to = None
+                    st.pending.appendleft(spec)
+            else:
+                for spec in specs:
+                    self._on_worker_failure(spec)
             await self._pump(key)
             return
         # Per-task latency EMA for the batching gate. Prefer the WORKER's
