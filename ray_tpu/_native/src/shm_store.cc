@@ -340,26 +340,63 @@ class StoreServer {
         close(fd);
         continue;
       }
-      int id = conn_id++;
-      {
-        std::lock_guard<std::mutex> g(mu_);
-        // Stop() may have run between accept4 and here; registering now
-        // would miss its shutdown pass and leave a Serve thread blocked in
-        // read() forever (deadlocking Stop's join).
-        if (stopping_.load()) {
-          close(fd);
-          break;
-        }
-        ReapFinishedLocked();
-        conn_fds_.push_back(fd);
-        conn_threads_.emplace_back(
-            new Conn{std::thread(), {false}});
-        Conn* c = conn_threads_.back().get();
-        c->thread = std::thread([this, fd, id, c] {
-          Serve(fd, id);
-          c->done.store(true);
-        });
+      try {
+        RegisterConn(fd, conn_id++);
+      } catch (...) {
+        // Allocation failure under host memory pressure: refuse the
+        // connection rather than std::terminate the host process.
+        close(fd);
       }
+    }
+  }
+
+  void RegisterConn(int fd, int id) {
+    std::lock_guard<std::mutex> g(mu_);
+    // Stop() may have run between accept4 and here; registering now
+    // would miss its shutdown pass and leave a Serve thread blocked in
+    // read() forever (deadlocking Stop's join).
+    if (stopping_.load()) {
+      close(fd);
+      return;
+    }
+    ReapFinishedLocked();
+    conn_fds_.push_back(fd);
+    Conn* c = nullptr;
+    try {
+      conn_threads_.emplace_back(new Conn{std::thread(), {false}});
+      c = conn_threads_.back().get();
+    } catch (...) {
+      // Roll the fd registration back before rethrowing to AcceptLoop's
+      // close(fd): a registered-but-threadless fd would later have
+      // Stop() shutdown() a possibly-reused descriptor number.
+      conn_fds_.erase(
+          std::remove(conn_fds_.begin(), conn_fds_.end(), fd),
+          conn_fds_.end());
+      throw;
+    }
+    try {
+      c->thread = std::thread([this, fd, id, c] {
+      // An exception escaping a thread body is std::terminate — and
+      // this store runs INSIDE the raylet host process, so that
+      // would abort the whole node (seen once as a pytest SIGABRT
+      // under the OOM-killer tests' memory pressure: bad_alloc in a
+      // map insert). Drop the connection instead; the client sees a
+      // closed socket and its pins auto-release.
+      try {
+        Serve(fd, id);
+      } catch (...) {
+        // Serve's own Cleanup closes the fd on every unwind path; if
+        // even Cleanup threw, fd ownership is ambiguous — leak the
+        // descriptor rather than risk closing a reused one.
+      }
+      c->done.store(true);
+      });
+    } catch (...) {
+      conn_threads_.pop_back();
+      conn_fds_.erase(
+          std::remove(conn_fds_.begin(), conn_fds_.end(), fd),
+          conn_fds_.end());
+      throw;
     }
   }
 
@@ -378,7 +415,18 @@ class StoreServer {
 
   void Serve(int fd, int conn_id) {
     std::unordered_map<ObjectId, uint64_t, IdHash> held;  // id -> refs
+    ServeLoop(fd, conn_id, &held);
+    Cleanup(fd, conn_id, held);
+  }
+
+  // The request loop, separated so an exception (bad_alloc under host
+  // memory pressure) unwinds into Serve's cleanup instead of
+  // std::terminate-ing the host process.
+  void ServeLoop(int fd, int conn_id,
+                 std::unordered_map<ObjectId, uint64_t, IdHash>* held_p) {
+    auto& held = *held_p;
     Request req;
+    try {
     while (ReadFull(fd, &req, sizeof(req))) {
       Response rsp = {ST_ERR, 0, 0};
       std::vector<uint8_t> extra;
@@ -427,6 +475,14 @@ class StoreServer {
       if (!WriteFull(fd, &rsp, sizeof(rsp))) break;
       if (!extra.empty() && !WriteFull(fd, extra.data(), extra.size())) break;
     }
+    } catch (...) {
+      // bad_alloc under host memory pressure mid-request: fall through
+      // to Cleanup with whatever `held` recorded so far.
+    }
+  }
+
+  void Cleanup(int fd, int conn_id,
+               std::unordered_map<ObjectId, uint64_t, IdHash>& held) {
     // Client died or disconnected: release everything it held, abort its
     // unsealed creates (plasma disconnect semantics).
     {

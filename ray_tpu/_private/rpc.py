@@ -356,7 +356,7 @@ class RpcClient:
     async def call_async(self, method: str, payload: Any = None,
                          timeout: Optional[float] = None):
         if self._closed:
-            raise ConnectionLost("client closed")
+            raise ConnectionLost("client closed", maybe_delivered=False)
         try:
             await self._ensure_connected()
         except OSError as e:
@@ -382,7 +382,7 @@ class RpcClient:
     async def send_async(self, method: str, payload: Any = None):
         """One-way message (no reply)."""
         if self._closed:
-            raise ConnectionLost("client closed")
+            raise ConnectionLost("client closed", maybe_delivered=False)
         try:
             await self._ensure_connected()
         except OSError as e:

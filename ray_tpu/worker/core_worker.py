@@ -79,6 +79,9 @@ class _PendingTask:
     is_actor_task: bool = False
     pushed_to: Optional[str] = None  # worker rpc address while running
     arg_ids: List[ObjectID] = field(default_factory=list)
+    # Pushes that provably never reached a worker (connect refused):
+    # requeued without consuming retries_left, bounded by this counter.
+    undelivered_failures: int = 0
 
 
 def _slice_segments(segments, off: int, length: int) -> bytes:
@@ -1449,8 +1452,7 @@ class CoreWorker:
                     pending = self._pending_tasks.get(spec.task_id)
                     if pending is None:
                         continue
-                    pending.undelivered_failures = getattr(
-                        pending, "undelivered_failures", 0) + 1
+                    pending.undelivered_failures += 1
                     if pending.undelivered_failures > 20:
                         self._on_worker_failure(spec)
                         continue
