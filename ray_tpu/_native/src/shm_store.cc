@@ -156,7 +156,9 @@ bool SendHandshake(int sock, int arena_fd, uint64_t capacity) {
   cmsg->cmsg_type = SCM_RIGHTS;
   cmsg->cmsg_len = CMSG_LEN(sizeof(int));
   memcpy(CMSG_DATA(cmsg), &arena_fd, sizeof(int));
-  return sendmsg(sock, &msg, 0) == sizeof(payload);
+  // MSG_NOSIGNAL: the peer may already be gone (e.g. Stop()'s throwaway
+  // wake connection) — surface EPIPE as a failed handshake, not SIGPIPE.
+  return sendmsg(sock, &msg, MSG_NOSIGNAL) == sizeof(payload);
 }
 
 bool RecvHandshake(int sock, int* arena_fd, uint64_t* capacity) {
@@ -305,6 +307,24 @@ class StoreServer {
   void Stop() {
     bool expected = false;
     if (!stopping_.compare_exchange_strong(expected, true)) return;
+    // Wake a blocked accept4 with a throwaway self-connect BEFORE tearing
+    // the listen socket down: on some kernels (gVisor/runsc sandboxes)
+    // neither shutdown() nor close() of a listening unix socket wakes a
+    // blocked accept, and the join below would hang the host process
+    // forever. The connect completes against the backlog regardless of
+    // whether accept ever returns it; AcceptLoop re-checks stopping_
+    // before blocking again.
+    int wake_fd = socket(AF_UNIX, SOCK_STREAM | SOCK_CLOEXEC, 0);
+    if (wake_fd >= 0) {
+      struct sockaddr_un wake_addr;
+      memset(&wake_addr, 0, sizeof(wake_addr));
+      wake_addr.sun_family = AF_UNIX;
+      snprintf(wake_addr.sun_path, sizeof(wake_addr.sun_path), "%s",
+               path_.c_str());
+      connect(wake_fd, reinterpret_cast<struct sockaddr*>(&wake_addr),
+              sizeof(wake_addr));
+      close(wake_fd);
+    }
     shutdown(listen_fd_, SHUT_RDWR);
     close(listen_fd_);
     unlink(path_.c_str());

@@ -1,0 +1,36 @@
+"""Version-portability shims for the installed jax.
+
+The repo targets the modern jax surface; older releases ship the same
+capability under different names. Centralizing the translation here keeps
+call sites on ONE spelling instead of per-module try/except drift.
+
+Imports jax lazily — `import ray_tpu` must never pull jax in.
+"""
+
+from __future__ import annotations
+
+
+def _resolve_shard_map():
+    try:
+        from jax import shard_map  # jax >= 0.5
+        return shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map  # jax < 0.5
+        return shard_map
+
+
+def shard_map(*args, **kwargs):
+    """jax.shard_map with the MODERN keyword surface on any jax: older
+    releases spell `check_vma` as `check_rep` (the replication checker was
+    renamed when varying-manual-axes landed)."""
+    sm = _resolve_shard_map()
+    if "check_vma" in kwargs:
+        import inspect
+
+        try:
+            params = inspect.signature(sm).parameters
+        except (TypeError, ValueError):  # C-accelerated / no signature
+            params = {}
+        if "check_vma" not in params and "check_rep" in params:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+    return sm(*args, **kwargs)

@@ -1,0 +1,193 @@
+"""Cross-layer task latency tracing: per-stage breakdowns of the
+submit -> lease -> dispatch -> execute -> reply path.
+
+Reference capability: ray's task-event timelines (Ray: A Distributed
+Framework..., arXiv:1712.05889 treats per-component timing as first-class)
+and the C++ core worker's task profiling events. Here the OWNER stamps its
+side of every task (submit / queue / push) with `time.monotonic()`, the
+WORKER returns its own durations (dispatch / execute / pack) in the
+PushTaskReply, and the owner stitches both into one six-stage breakdown —
+no cross-process clock sync needed, the wire time falls out as
+`rpc = owner_rtt - worker_wall`.
+
+Stages of a task round trip:
+
+  submit    owner: .remote() entry -> spec queued (arg build/serialize,
+            dependency resolution, submit-buffer drain)
+  queue     owner: queued -> pushed (worker-lease wait + pending queue)
+  rpc       both directions on the wire: owner round trip minus the
+            worker-measured wall time
+  dispatch  worker: push received -> function body starts (wire decode,
+            thread-pool hop, arg fetch, actor sequencing gate)
+  execute   worker: the function body itself
+  reply     worker return packaging + owner reply processing (store puts)
+
+Breakdowns feed three consumers: tagged Histogram metrics (p50/p90/p99
+exported by `prometheus_text()`), the process-local chrome-trace buffer
+(`ray-tpu timeline` stage-segmented spans), and a ring buffer behind
+`recent()` / the `ray-tpu latency` CLI.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+STAGES = ("submit", "queue", "rpc", "dispatch", "execute", "reply")
+
+# Sub-millisecond buckets matter here: the whole control-plane budget is
+# ~100us/task (SURVEY §3.2), so the default Histogram boundaries (5ms+)
+# would collapse every interesting sample into the first bucket.
+STAGE_BOUNDARIES = [
+    1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3, 5e-3,
+    0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 15.0, 60.0,
+]
+
+_lock = threading.Lock()
+_recent: deque = deque(maxlen=2048)
+_stage_hist = None
+_total_hist = None
+
+
+def _metrics():
+    """Lazily create the per-process stage histograms (importing
+    util.metrics at module load would register metrics in processes that
+    never run tasks)."""
+    global _stage_hist, _total_hist
+    if _stage_hist is None:
+        from ray_tpu.util.metrics import get_or_create_histogram
+
+        _stage_hist = get_or_create_histogram(
+            "ray_tpu_task_stage_seconds",
+            "Per-stage task latency (submit/queue/rpc/dispatch/execute/"
+            "reply)",
+            boundaries=STAGE_BOUNDARIES,
+            tag_keys=("stage", "type"),
+        )
+        _total_hist = get_or_create_histogram(
+            "ray_tpu_task_total_seconds",
+            "End-to-end task latency (submit -> reply processed)",
+            boundaries=STAGE_BOUNDARIES,
+            tag_keys=("type",),
+        )
+    return _stage_hist, _total_hist
+
+
+def owner_breakdown(
+    t_submit: Optional[float],
+    t_queued: Optional[float],
+    t_pushed: Optional[float],
+    t_reply: float,
+    t_done: float,
+    worker_stages: Optional[Dict[str, float]],
+) -> Optional[Dict[str, float]]:
+    """Stitch owner stamps + worker durations into the six-stage
+    breakdown. Returns None when any stamp is missing (e.g. lineage
+    reconstruction re-submits, which skip the user submit path)."""
+    if t_submit is None or t_queued is None or t_pushed is None:
+        return None
+    w = worker_stages or {}
+    wall = w.get("wall", 0.0) or 0.0
+    return {
+        "submit": max(0.0, t_queued - t_submit),
+        "queue": max(0.0, t_pushed - t_queued),
+        "rpc": max(0.0, (t_reply - t_pushed) - wall),
+        "dispatch": max(0.0, w.get("dispatch", 0.0) or 0.0),
+        "execute": max(0.0, w.get("exec", 0.0) or 0.0),
+        "reply": max(0.0, (w.get("pack", 0.0) or 0.0)
+                     + max(0.0, t_done - t_reply)),
+    }
+
+
+def record_breakdown(task_id_hex: str, name: str, task_type: str,
+                     stages: Dict[str, float]) -> None:
+    """Observe one task's breakdown into metrics, the trace buffer, and
+    the ring buffer. Runs on the owner's RPC loop — keep it cheap."""
+    stage_hist, total_hist = _metrics()
+    total = 0.0
+    for stage in STAGES:
+        dur = stages.get(stage)
+        if dur is None:
+            continue
+        total += dur
+        stage_hist.observe(dur, tags={"stage": stage, "type": task_type})
+    total_hist.observe(total, tags={"type": task_type})
+    now = time.time()
+    entry = {
+        "task_id": task_id_hex,
+        "name": name,
+        "type": task_type,
+        "time": now,
+        "total": total,
+        "stages": {s: stages.get(s, 0.0) for s in STAGES},
+    }
+    with _lock:
+        _recent.append(entry)
+    # Stage-segmented spans into the local chrome-trace buffer: the six
+    # stages laid out back-to-back, ending at the reply-processed instant.
+    from ray_tpu.util.tracing.tracing_helper import record_event
+
+    t = now - total
+    for stage in STAGES:
+        dur = stages.get(stage, 0.0) or 0.0
+        record_event(f"{name}:{stage}", t, t + dur,
+                     attributes={"task_id": task_id_hex, "stage": stage},
+                     thread="task-stages")
+        t += dur
+
+
+def recent(n: int = 100) -> List[Dict[str, Any]]:
+    """The last n recorded breakdowns in this process (newest last)."""
+    with _lock:
+        out = list(_recent)
+    return out[-n:]
+
+
+def clear_recent() -> None:
+    with _lock:
+        _recent.clear()
+
+
+def format_breakdowns(entries: List[Dict[str, Any]],
+                      summarize: bool = True) -> str:
+    """Fixed-width stage table for the `ray-tpu latency` CLI. `entries`
+    are breakdown dicts (recent() shape, or task events carrying
+    'stages')."""
+    header = (f"{'task':<28} {'type':<14} {'total':>9} "
+              + " ".join(f"{s:>9}" for s in STAGES))
+    lines = [header, "-" * len(header)]
+    per_stage: Dict[str, List[float]] = {s: [] for s in STAGES}
+    totals: List[float] = []
+    for e in entries:
+        stages = e.get("stages") or {}
+        total = e.get("total")
+        if total is None:
+            total = sum(stages.get(s, 0.0) or 0.0 for s in STAGES)
+        name = str(e.get("name") or e.get("task_id", "?"))[:28]
+        cells = []
+        for s in STAGES:
+            v = stages.get(s, 0.0) or 0.0
+            per_stage[s].append(v)
+            cells.append(f"{v * 1e3:>8.2f}m")
+        totals.append(total)
+        lines.append(f"{name:<28} {str(e.get('type', ''))[:14]:<14} "
+                     f"{total * 1e3:>8.2f}m " + " ".join(cells))
+    if summarize and totals:
+        lines.append("-" * len(header))
+        for q, label in ((0.5, "p50"), (0.9, "p90"), (0.99, "p99")):
+            cells = [f"{_quantile(per_stage[s], q) * 1e3:>8.2f}m"
+                     for s in STAGES]
+            lines.append(f"{'[' + label + ']':<28} {'':<14} "
+                         f"{_quantile(totals, q) * 1e3:>8.2f}m "
+                         + " ".join(cells))
+    return "\n".join(lines)
+
+
+def _quantile(values: List[float], q: float) -> float:
+    if not values:
+        return 0.0
+    vs = sorted(values)
+    idx = min(len(vs) - 1, int(q * len(vs)))
+    return vs[idx]

@@ -30,6 +30,28 @@ _REPLY_OK = 1
 _REPLY_ERR = 2
 _ONEWAY = 3
 
+_handler_hist = None
+_handler_hist_failed = False
+
+
+def _rpc_handler_hist():
+    """Per-method server handler latency histogram, created lazily so the
+    transport keeps zero hard deps on the metrics layer (and processes
+    that only run clients never register it)."""
+    global _handler_hist, _handler_hist_failed
+    if _handler_hist is None and not _handler_hist_failed:
+        try:
+            from ray_tpu.util.metrics import get_or_create_histogram
+
+            _handler_hist = get_or_create_histogram(
+                "ray_tpu_rpc_handler_seconds",
+                "Server-side RPC handler latency by method",
+                tag_keys=("method",),
+            )
+        except Exception:  # noqa: BLE001 — never break the transport
+            _handler_hist_failed = True
+    return _handler_hist
+
 
 class RpcError(Exception):
     pass
@@ -248,8 +270,16 @@ class RpcServer:
                     logger.exception("connection-lost callback failed")
 
     async def _dispatch(self, handler, kind, msg_id, method, payload, writer, write_lock):
+        t0 = time.monotonic()
         try:
             reply = await handler(payload)
+            try:
+                hist = _rpc_handler_hist()
+                if hist is not None:
+                    hist.observe(time.monotonic() - t0,
+                                 tags={"method": method})
+            except Exception:  # noqa: BLE001 — a metrics failure must not
+                pass           # turn a successful reply into _REPLY_ERR
             if kind == _REQUEST:
                 frame = _frame((_REPLY_OK, msg_id, None, reply))
         except Exception as e:

@@ -365,12 +365,13 @@ def reply_to_wire(r: dict) -> tuple:
             for oid, p in r.get("returns", [])
         ]
         return ("ok", returns, r.get("exec_s"),
-                r.get("streaming_num_items"), r.get("worker_retiring"))
+                r.get("streaming_num_items"), r.get("worker_retiring"),
+                r.get("stages"))
     if status == "cancelled":
         return ("cancelled", [o.binary() for o in r.get("return_ids", [])])
     return ("error", _ser_w(r.get("error")), r.get("error_str"),
             [o.binary() for o in r.get("return_ids", [])],
-            r.get("exec_s"), r.get("worker_retiring"))
+            r.get("exec_s"), r.get("worker_retiring"), r.get("stages"))
 
 
 def reply_from_wire(t: tuple) -> dict:
@@ -392,6 +393,8 @@ def reply_from_wire(t: tuple) -> dict:
             out["streaming_num_items"] = t[3]
         if t[4]:
             out["worker_retiring"] = True
+        if len(t) > 5 and t[5] is not None:
+            out["stages"] = t[5]
         return out
     if kind == "cancelled":
         return {"status": "cancelled",
@@ -403,6 +406,8 @@ def reply_from_wire(t: tuple) -> dict:
         out["exec_s"] = t[4]
     if t[5]:
         out["worker_retiring"] = True
+    if len(t) > 6 and t[6] is not None:
+        out["stages"] = t[6]
     return out
 
 

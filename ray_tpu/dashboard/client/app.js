@@ -332,6 +332,87 @@ async function pageServe() {
     ["application", "deployment", "status", "replicas", "message"], rows);
 }
 
+// ---- live metrics ----------------------------------------------------------
+
+const CHART_COLORS = ["#4f86f7", "#e0723c", "#3cb371", "#c95fcf",
+                      "#d9b036", "#56b8c9", "#e05c6c", "#8a8f98"];
+
+function svgChart(title, series, fmt) {
+  // series: [{name, points: [[t, v], ...]}]; vanilla inline SVG, no deps
+  const W = 560, H = 150, PAD = 36;
+  const all = series.flatMap((s) => s.points);
+  if (!all.length) {
+    return `<div class="chart"><h4>${esc(title)}</h4>
+      <p class="muted">no samples yet</p></div>`;
+  }
+  const t0 = Math.min(...all.map((p) => p[0]));
+  const t1 = Math.max(...all.map((p) => p[0]));
+  const vmax = Math.max(...all.map((p) => p[1]), 1e-12);
+  const sx = (t) => PAD + (W - PAD - 6) * (t1 > t0 ? (t - t0) / (t1 - t0) : 1);
+  const sy = (v) => H - 18 - (H - 30) * (v / vmax);
+  const lines = series.map((s, i) => {
+    const color = CHART_COLORS[i % CHART_COLORS.length];
+    if (s.points.length === 1) {
+      const [t, v] = s.points[0];
+      return `<circle cx="${sx(t).toFixed(1)}" cy="${sy(v).toFixed(1)}"
+        r="2.5" fill="${color}"/>`;
+    }
+    const pts = s.points.map(
+      (p) => `${sx(p[0]).toFixed(1)},${sy(p[1]).toFixed(1)}`).join(" ");
+    return `<polyline points="${pts}" fill="none" stroke="${color}"
+      stroke-width="1.5"/>`;
+  }).join("");
+  const legend = series.map((s, i) => {
+    const color = CHART_COLORS[i % CHART_COLORS.length];
+    const last = s.points.length ? s.points[s.points.length - 1][1] : 0;
+    return `<span class="legend-item">
+      <span class="swatch" style="background:${color}"></span>
+      ${esc(s.name)} <span class="muted">${fmt(last)}</span></span>`;
+  }).join(" ");
+  const span = Math.max(1, t1 - t0);
+  return `<div class="chart"><h4>${esc(title)}</h4>
+    <svg viewBox="0 0 ${W} ${H}" preserveAspectRatio="none">
+      <line x1="${PAD}" y1="${H - 18}" x2="${W - 4}" y2="${H - 18}"
+        class="axis"/>
+      <line x1="${PAD}" y1="6" x2="${PAD}" y2="${H - 18}" class="axis"/>
+      <text x="4" y="14" class="axis-label">${esc(fmt(vmax))}</text>
+      <text x="4" y="${H - 22}" class="axis-label">0</text>
+      <text x="${W - 4}" y="${H - 4}" class="axis-label"
+        text-anchor="end">last ${(span).toFixed(0)}s</text>
+      ${lines}
+    </svg>
+    <div class="legend">${legend}</div></div>`;
+}
+
+async function pageMetrics() {
+  const data = await getJSON("/api/metrics_timeseries");
+  const series = data.series || {};
+  const pick = (re) => Object.keys(series).filter((k) => re.test(k)).sort()
+    .map((k) => ({name: k, points: series[k]}));
+  const ms = (v) => `${(v * 1e3).toFixed(2)}ms`;
+  const num = (v) => v >= 100 ? v.toFixed(0) : v.toFixed(2);
+  const mib = (v) => `${(v / 2 ** 20).toFixed(1)}MiB`;
+  const pct = (v) => `${num(v)}%`;
+  const charts = [
+    svgChart("Task throughput (tasks/s)",
+             pick(/^task_throughput$/), num),
+    svgChart("Stage latency p50 (submit/queue/rpc/dispatch/execute/reply)",
+             pick(/^stage_.*_p50$/), ms),
+    svgChart("Stage latency p99", pick(/^stage_.*_p99$/), ms),
+    svgChart("End-to-end task latency",
+             pick(/^task_total_.*_p(50|90|99)$/), ms),
+    svgChart("Object store used",
+             pick(/^store_(used|capacity)_bytes$/), mib),
+    svgChart("Worker leases (active / queued)",
+             pick(/^leases_/), num),
+    svgChart("Node CPU %", pick(/^node_cpu_percent_/), pct),
+  ].join("");
+  return `<h2>Live metrics
+    <span class="muted">(ring-buffered, ${data.sample_period_s ?? 5}s
+    cadence; stage series need task activity in the head's process)</span>
+    </h2><div class="charts">${charts}</div>`;
+}
+
 async function pageLogs() {
   const data = await getJSON("/api/logs?lines=200");
   const blocks = Object.entries(data.nodes || data || {}).map(
@@ -352,7 +433,7 @@ async function pageLogs() {
 const PAGES = {
   overview: pageOverview, nodes: pageNodes, actors: pageActors,
   tasks: pageTasks, jobs: pageJobs, pgs: pagePGs, serve: pageServe,
-  logs: pageLogs, timeline: pageTimeline,
+  logs: pageLogs, timeline: pageTimeline, metrics: pageMetrics,
 };
 let timer = null;
 

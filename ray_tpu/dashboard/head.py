@@ -11,6 +11,9 @@ Endpoints:
   GET /api/cluster_status   nodes + resource totals/available + demands
   GET /api/nodes|actors|jobs|placement_groups|tasks|workers
   GET /api/version
+  GET /api/metrics_timeseries  ring-buffered time series for the SPA's
+                               live metrics page (task throughput, stage
+                               latency percentiles, store bytes, node CPU)
   GET /metrics              Prometheus exposition (user metrics + core gauges)
 """
 
@@ -19,12 +22,19 @@ from __future__ import annotations
 import json
 import logging
 import threading
+import time
+from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
 
 from ray_tpu._private.rpc import ClientPool, EventLoopThread, RpcClient
 
 logger = logging.getLogger(__name__)
+
+# time-series ring buffers: one hour at the 5s background cadence
+TS_MAXLEN = 720
+TS_SAMPLE_PERIOD_S = 5.0
+TS_MIN_SAMPLE_GAP_S = 1.0  # on-demand endpoint sampling floor
 
 
 class DashboardHead:
@@ -69,10 +79,26 @@ class DashboardHead:
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._httpd.daemon_threads = True
         self.url = f"http://{host}:{self._httpd.server_address[1]}"
+        # Live metrics time series: a background sampler fills ring
+        # buffers; the endpoint also samples on demand so a freshly-polled
+        # page never sees an empty window. State must exist BEFORE the
+        # HTTP thread starts serving, or a scrape racing startup 500s.
+        self._ts_lock = threading.Lock()       # ring-buffer reads/writes
+        self._ts_sampling = threading.Lock()   # one sampler at a time
+        self._ts: Dict[str, deque] = {}
+        self._ts_last_sample = 0.0
+        self._ts_prev_t: Optional[float] = None
+        self._ts_tp_prev_t: Optional[float] = None
+        self._ts_finished_cum = 0
+        self._ts_event_watermarks: Dict[str, float] = {}
+        self._ts_stop = threading.Event()
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, name="dashboard-http",
             daemon=True)
         self._thread.start()
+        self._ts_thread = threading.Thread(
+            target=self._ts_loop, name="dashboard-ts", daemon=True)
+        self._ts_thread.start()
 
     # -- routing -------------------------------------------------------------
 
@@ -189,6 +215,8 @@ class DashboardHead:
             self._json(req, self._worker_logs(
                 lines=int(q.get("lines", ["100"])[0]),
                 node_id=(q.get("node_id", [None])[0])))
+        elif path == "/api/metrics_timeseries":
+            self._json(req, self._timeseries())
         elif path == "/metrics":
             self._respond(req, self._metrics_text(),
                           "text/plain; version=0.0.4")
@@ -381,6 +409,173 @@ class DashboardHead:
                 return []
         return None
 
+    # -- live metrics time series -------------------------------------------
+
+    def _ts_loop(self) -> None:
+        while not self._ts_stop.wait(TS_SAMPLE_PERIOD_S):
+            try:
+                self._ts_sample()
+            except Exception:  # noqa: BLE001 — sampler must never die
+                logger.debug("timeseries sample failed", exc_info=True)
+
+    def _ts_add(self, name: str, t: float, value: float) -> None:
+        buf = self._ts.get(name)
+        if buf is None:
+            buf = self._ts[name] = deque(maxlen=TS_MAXLEN)
+        buf.append((round(t, 3), value))
+
+    def _ts_sample(self) -> None:
+        """Collect one point of every series. Sources: the process-local
+        metrics registry (stage-latency histograms — the head runs in the
+        driver process for in-process clusters), GCS task events (task
+        throughput), per-raylet node stats (store bytes, leases), and
+        dashboard agents (per-node CPU). Every source is best-effort.
+
+        The cluster fan-out can block for seconds (per-node RPCs with
+        nodes mid-death), so it runs OUTSIDE _ts_lock — holding it here
+        would hang every /api/metrics_timeseries request on the HTTP
+        threads. _ts_sampling serializes samplers instead (an on-demand
+        request racing the background loop simply skips; the buffers are
+        at most one cycle stale)."""
+        if not self._ts_sampling.acquire(blocking=False):
+            return
+        try:
+            now = time.time()
+            if now - self._ts_last_sample < TS_MIN_SAMPLE_GAP_S:
+                return
+            points: list = []
+            self._ts_collect(now, points)
+            with self._ts_lock:
+                self._ts_last_sample = now
+                for name, value in points:
+                    self._ts_add(name, now, value)
+            self._ts_prev_t = now
+        finally:
+            self._ts_sampling.release()
+
+    def _ts_collect(self, now: float, points: list) -> None:
+        """Gather one (name, value) point per series into `points`.
+        Runs unlocked — must not touch the ring buffers."""
+        add = lambda name, value: points.append((name, value))  # noqa: E731
+        # 1) stage-latency percentiles from the local metrics registry
+        from ray_tpu.util.metrics import get_metric
+
+        hist = get_metric("ray_tpu_task_stage_seconds")
+        if hist is not None and hasattr(hist, "quantiles_by"):
+            for stage, qs in hist.quantiles_by("stage").items():
+                for q, label in ((0.5, "p50"), (0.9, "p90"), (0.99, "p99")):
+                    add(f"stage_{stage}_{label}", qs.get(q, 0.0))
+        total_hist = get_metric("ray_tpu_task_total_seconds")
+        if total_hist is not None and hasattr(total_hist, "quantiles_by"):
+            merged = total_hist.quantiles_by("type")
+            for ttype, qs in merged.items():
+                for q, label in ((0.5, "p50"), (0.9, "p90"), (0.99, "p99")):
+                    add(f"task_total_{ttype}_{label}", qs.get(q, 0.0))
+        # 2) task throughput from GCS task events. Count FINISHED events
+        # past a PER-JOB watermark over EVENT timestamps — a delta of the
+        # windowed count would flatline to zero once the event store holds
+        # more than the fetch window (exactly when the cluster is
+        # busiest), a sample-wall-time cutoff would drop every event still
+        # in an owner's ~1s flush buffer at fetch time, and one global
+        # watermark would drop a lagging driver's events whenever another
+        # driver's fresher flush landed first.
+        try:
+            events = self._gcs.call(
+                "get_task_events", {"job_id": None, "limit": 10_000},
+                timeout=5)
+            wms = self._ts_event_watermarks
+            fresh = 0
+            batch_max: Dict[str, float] = {}
+            for ev in events:
+                if ev.get("state") != "FINISHED":
+                    continue
+                job, t = ev.get("job_id", ""), ev.get("time", 0)
+                if t > wms.get(job, 0.0):
+                    fresh += 1
+                    if t > batch_max.get(job, 0.0):
+                        batch_max[job] = t
+            # marks advance only after the whole batch is counted — doing
+            # it mid-loop would drop same-batch events older than a
+            # fresher sibling
+            wms.update(batch_max)
+            self._ts_finished_cum += fresh
+            add("tasks_finished_total", self._ts_finished_cum)
+            # rate over the span since the last SUCCESSFUL fetch: using
+            # the plain sample time would divide a whole GCS outage's
+            # backlog by one 5s interval and render a phantom spike
+            prev = self._ts_tp_prev_t
+            if prev is not None and now > prev:
+                add("task_throughput", fresh / (now - prev))
+            self._ts_tp_prev_t = now
+        except Exception:  # noqa: BLE001 — GCS restarting
+            pass
+        # 3) per-node raylet stats: store usage + lease queue depth
+        try:
+            nodes = self._gcs.call("get_all_node_info", {}, timeout=5)
+        except Exception:  # noqa: BLE001
+            nodes = []
+        store_used = store_cap = 0
+        active = queued = 0
+        got_store = False
+        for n in nodes:
+            if not n.alive:
+                continue
+            try:
+                st = self._raylets.get(n.raylet_address).call(
+                    "get_node_stats", {}, timeout=3)
+            except Exception:  # noqa: BLE001 — node mid-death
+                continue
+            active += st.get("active_leases", 0)
+            queued += st.get("queued_leases", 0)
+            store = st.get("store")
+            if store:
+                got_store = True
+                store_used += store.get("used_bytes", 0)
+                store_cap += store.get("capacity_bytes", 0)
+        add("leases_active", active)
+        add("leases_queued", queued)
+        if got_store:
+            add("store_used_bytes", store_used)
+            add("store_capacity_bytes", store_cap)
+        # 4) per-node CPU via the dashboard agents
+        try:
+            agents = self._agents()
+        except Exception:  # noqa: BLE001
+            agents = {}
+        import urllib.request
+
+        for node_id, url in agents.items():
+            try:
+                with urllib.request.urlopen(
+                        f"{url}/api/local/stats", timeout=2) as resp:
+                    st = json.loads(resp.read().decode())
+                cpu = st.get("cpu_percent")
+                if cpu is not None:
+                    add(f"node_cpu_percent_{node_id[:8]}", cpu)
+            except Exception:  # noqa: BLE001 — agent down
+                continue
+
+    def _timeseries(self) -> Dict[str, Any]:
+        # Serve the ring buffers (at most one background cycle stale).
+        # Sample on demand ONLY while they are still empty — so the first
+        # page load has data, without paying the multi-second cluster
+        # fan-out on an HTTP request thread during an incident (nodes
+        # mid-death make the fan-out slowest exactly when the user opens
+        # the dashboard to look).
+        with self._ts_lock:
+            empty = not self._ts
+        if empty:
+            try:
+                self._ts_sample()
+            except Exception:  # noqa: BLE001
+                logger.debug("on-demand sample failed", exc_info=True)
+        with self._ts_lock:
+            return {
+                "now": time.time(),
+                "sample_period_s": TS_SAMPLE_PERIOD_S,
+                "series": {k: list(v) for k, v in self._ts.items()},
+            }
+
     def _metrics_text(self) -> str:
         from ray_tpu.util.metrics import prometheus_text
 
@@ -422,6 +617,7 @@ class DashboardHead:
             "</body></html>")
 
     def stop(self) -> None:
+        self._ts_stop.set()
         self._httpd.shutdown()
         self._httpd.server_close()
         self._raylets.close_all()

@@ -239,13 +239,94 @@ def _run_read_task(read_task: Callable, ops: List[Operator]):
         yield _apply_map_ops(b, ops) if ops else b
 
 
+def _run_read_task_stats(read_task: Callable, ops: List[Operator]):
+    """Stats-collecting twin of _run_read_task: times the read and each
+    fused operator per block, then yields ONE trailing sentinel dict with
+    the accumulated per-op entries (the driver's drain loop holds back
+    the last item, so blocks still stream without materializing)."""
+    import time as _time
+
+    from ray_tpu.data._internal.stats import STATS_SENTINEL_KEY, op_entry
+
+    t0, c0 = _time.perf_counter(), _time.process_time()
+    blocks = read_task()
+    if not isinstance(blocks, (list, tuple)):
+        blocks = [blocks]
+    read_entry = op_entry("read")
+    read_entry["wall_s"] = _time.perf_counter() - t0
+    read_entry["cpu_s"] = _time.process_time() - c0
+    entries = [op_entry(op.kind) for op in ops]
+    for b in blocks:
+        acc = BlockAccessor.for_block(b)
+        read_entry["rows"] += acc.num_rows()
+        read_entry["bytes"] += acc.size_bytes()
+        read_entry["blocks"] += 1
+        for op, entry in zip(ops, entries):
+            t1, c1 = _time.perf_counter(), _time.process_time()
+            b = _apply_map_ops(b, [op])
+            entry["wall_s"] += _time.perf_counter() - t1
+            entry["cpu_s"] += _time.process_time() - c1
+            out_acc = BlockAccessor.for_block(b)
+            entry["rows"] += out_acc.num_rows()
+            entry["bytes"] += out_acc.size_bytes()
+            entry["blocks"] += 1
+        yield b
+    yield {STATS_SENTINEL_KEY: [read_entry] + entries}
+
+
+def _apply_map_ops_stats(block: Block, ops: List[Operator]):
+    """Stats-collecting twin of _apply_map_ops for non-fused map stages:
+    runs with num_returns=2, so the block ref flows downstream untouched
+    while the driver collects the tiny per-op metadata ref separately."""
+    import time as _time
+
+    from ray_tpu.data._internal.stats import op_entry
+
+    entries = []
+    for op in ops:
+        t0, c0 = _time.perf_counter(), _time.process_time()
+        block = _apply_map_ops(block, [op])
+        acc = BlockAccessor.for_block(block)
+        e = op_entry(op.kind)
+        e["wall_s"] = _time.perf_counter() - t0
+        e["cpu_s"] = _time.process_time() - c0
+        e["rows"], e["bytes"], e["blocks"] = (
+            acc.num_rows(), acc.size_bytes(), 1)
+        entries.append(e)
+    return block, entries
+
+
+def _timed_stage(stream: Iterator[Any], entry: dict) -> Iterator[Any]:
+    """Accumulate the time the consumer spends blocked pulling from a
+    stage (the driver-observed wall of exchange/limit/actor-pool stages)."""
+    import time as _time
+
+    it = iter(stream)
+    while True:
+        t0 = _time.perf_counter()
+        try:
+            item = next(it)
+        except StopIteration:
+            entry["wall_s"] += _time.perf_counter() - t0
+            return
+        entry["wall_s"] += _time.perf_counter() - t0
+        yield item
+
+
 def execute_refs(plan: Plan, *, max_in_flight: int = DEFAULT_MAX_IN_FLIGHT,
-                 _store_stats=None) -> Iterator[Any]:
-    """Yield ObjectRefs to output blocks (order-preserving, streaming)."""
+                 _store_stats=None, stats=None) -> Iterator[Any]:
+    """Yield ObjectRefs to output blocks (order-preserving, streaming).
+
+    `stats`: optional ExecutionStats recorder (data/_internal/stats.py).
+    When set, map-like stages run their stats-collecting twins and the
+    recorder accumulates per-operator wall/cpu/rows/bytes."""
     stages = plan.fused_stages()
-    run_read = ray_tpu.remote(_run_read_task).options(
+    collect = stats is not None
+    run_read = ray_tpu.remote(
+        _run_read_task_stats if collect else _run_read_task).options(
         num_returns="streaming")
-    run_ops = ray_tpu.remote(_apply_map_ops)
+    run_ops = (ray_tpu.remote(_apply_map_ops_stats).options(num_returns=2)
+               if collect else ray_tpu.remote(_apply_map_ops))
 
     # Stage 0: read with fused leading map ops.
     rest_stages = list(stages)
@@ -281,49 +362,89 @@ def execute_refs(plan: Plan, *, max_in_flight: int = DEFAULT_MAX_IN_FLIGHT,
             yield from _drain_generator(g)
 
     def _drain_generator(gen) -> Iterator[Any]:
-        for item_ref in gen:
-            read_op.blocks_out += 1
-            yield item_ref
+        if not collect:
+            for item_ref in gen:
+                read_op.blocks_out += 1
+                yield item_ref
+        else:
+            # One-item lookahead: the stats producer yields its per-op
+            # entries as the trailing item — hold back the latest ref so
+            # the sentinel is recognized without materializing any block.
+            from ray_tpu.data._internal.stats import STATS_SENTINEL_KEY
+
+            prev = None
+            for item_ref in gen:
+                if prev is not None:
+                    read_op.blocks_out += 1
+                    yield prev
+                prev = item_ref
+            if prev is not None:
+                val = ray_tpu.get(prev)  # tiny dict when the sentinel
+                if isinstance(val, dict) and STATS_SENTINEL_KEY in val:
+                    stats.merge_entries(0, val[STATS_SENTINEL_KEY])
+                else:  # producer without a sentinel: a real block
+                    read_op.blocks_out += 1
+                    yield prev
         read_op.in_flight -= 1
         _publish_stats()
 
     stream: Iterator[Any] = read_stream()
 
-    for stage, op_state in zip(rest_stages, stage_ops):
+    for stage_idx, (stage, op_state) in enumerate(
+            zip(rest_stages, stage_ops), start=1):
         op = stage[0]
+        driver_walled = None  # stats entry for driver-observed stages
         if op.is_map_like and op.options.get("concurrency"):
             stream = _actor_map_stage(stream, stage, op_state, _publish_stats)
+            driver_walled = "actor_pool:" + op.kind
         elif op.is_map_like:
             stream = _map_stage(stream, stage, run_ops, rm, op_state,
-                                _publish_stats)
+                                _publish_stats, stats=stats,
+                                stage_idx=stage_idx)
         elif op.kind == "limit":
             stream = _limit_stage(stream, op.options["n"])
+            driver_walled = op.kind
         elif op.kind == "repartition":
             stream = _repartition_stage(stream, op.options["num_blocks"])
+            driver_walled = op.kind
         elif op.kind == "random_shuffle":
             stream = _shuffle_stage(stream, op.options.get("seed"))
+            driver_walled = op.kind
         elif op.kind == "sort":
             stream = _sort_stage(stream, op.options["key"],
                                  op.options.get("descending", False))
+            driver_walled = op.kind
         elif op.kind == "union":
             others = op.options["other_plans"]
             stream = _chain(stream, *(
                 execute_refs(p, max_in_flight=max_in_flight) for p in others))
+            driver_walled = op.kind
         elif op.kind == "zip":
             other = op.options["other_plan"]
             stream = _zip_stage(
                 stream, execute_refs(other, max_in_flight=max_in_flight))
+            driver_walled = op.kind
         else:
             raise ValueError(f"unknown operator {op.kind}")
-    yield from stream
+        if collect and driver_walled is not None:
+            stream = _timed_stage(
+                stream, stats.driver_entry(stage_idx, driver_walled))
+    try:
+        yield from stream
+    finally:
+        if collect:
+            stats.finish()
 
 
 def execute_streaming(plan: Plan, *,
-                      max_in_flight: int = DEFAULT_MAX_IN_FLIGHT
-                      ) -> Iterator[Block]:
+                      max_in_flight: int = DEFAULT_MAX_IN_FLIGHT,
+                      stats=None) -> Iterator[Block]:
     """Yield materialized output blocks in order, streaming through stages."""
-    for ref in execute_refs(plan, max_in_flight=max_in_flight):
-        yield ray_tpu.get(ref)
+    for ref in execute_refs(plan, max_in_flight=max_in_flight, stats=stats):
+        block = ray_tpu.get(ref)
+        if stats is not None:
+            stats.count_output(block)
+        yield block
 
 
 def _chain(*its):
@@ -332,14 +453,22 @@ def _chain(*its):
 
 
 def _map_stage(stream, ops: List[Operator], run_ops,
-               rm: "_ResourceManager", op_state: "_OpState", publish):
+               rm: "_ResourceManager", op_state: "_OpState", publish,
+               stats=None, stage_idx: int = 0):
     in_flight: List[Any] = []
     for ref in stream:
         while len(in_flight) >= rm.allowed(op_state):
             yield in_flight.pop(0)  # preserve order: emit the oldest
             op_state.finished()
             publish()
-        in_flight.append(run_ops.remote(ref, ops))
+        if stats is not None:
+            # stats twin runs with num_returns=2: the block ref flows
+            # downstream, the per-op metadata ref goes to the recorder
+            block_ref, meta_ref = run_ops.remote(ref, ops)
+            stats.add_meta_ref(stage_idx, meta_ref)
+            in_flight.append(block_ref)
+        else:
+            in_flight.append(run_ops.remote(ref, ops))
         op_state.launched()
     for r in in_flight:
         yield r

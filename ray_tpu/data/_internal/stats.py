@@ -1,0 +1,180 @@
+"""Per-operator execution statistics for Dataset pipelines.
+
+Reference: ray python/ray/data/_internal/stats.py — DatasetStats collected
+from per-block BlockMetadata and rendered by `Dataset.stats()`. Here the
+streaming executor collects per-OPERATOR wall/cpu time, rows, and bytes:
+
+- map-like stages measure each operator INSIDE the task (the task returns
+  `(block, entries)` with num_returns=2, so the driver collects tiny
+  metadata refs without ever materializing blocks);
+- the fused read stage streams blocks as before and yields ONE trailing
+  sentinel item carrying its accumulated per-op entries;
+- non-map stages (exchange barriers, limit, actor pools) record the
+  driver-observed wall time spent pulling from them;
+- the consuming iterator counts final output rows/bytes.
+
+`Dataset.stats()` renders the recorder of the most recent execution (and
+triggers one if the dataset was never consumed).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+# Key of the trailing sentinel item a stats-collecting read task yields
+# after its last block (see executor._run_read_task_stats).
+STATS_SENTINEL_KEY = "__rt_stage_stats__"
+
+
+def op_entry(name: str) -> Dict[str, Any]:
+    return {"op": name, "wall_s": 0.0, "cpu_s": 0.0,
+            "rows": 0, "bytes": 0, "blocks": 0}
+
+
+class ExecutionStats:
+    """Driver-side recorder for one `execute_refs` run."""
+
+    def __init__(self):
+        # (stage_idx, op_idx) -> entry; stage 0 is the fused read stage.
+        self._entries: Dict[Tuple[int, int], Dict[str, Any]] = {}
+        self._meta_refs: List[Tuple[int, Any]] = []
+        self._t0 = time.perf_counter()
+        self.total_wall_s: Optional[float] = None
+        self.output_rows = 0
+        self.output_bytes = 0
+        self.output_blocks = 0
+        self._finalized = False
+
+    # -- collection (executor-facing) ---------------------------------------
+
+    def driver_entry(self, stage_idx: int, name: str) -> Dict[str, Any]:
+        """Entry for a stage measured only from the driver (exchange
+        barriers, limit, actor pools): wall time is the time the consumer
+        spent blocked pulling from it; rows/bytes are unknown."""
+        e = self._entries.setdefault((stage_idx, 0), op_entry(name))
+        e["driver_side"] = True
+        return e
+
+    def add_meta_ref(self, stage_idx: int, ref: Any) -> None:
+        self._meta_refs.append((stage_idx, ref))
+        # Opportunistically fold in refs that already resolved (timeout=0:
+        # never blocks the consumption path) so a long pipeline doesn't
+        # pin one tiny store object per block until finalize().
+        if len(self._meta_refs) >= 256:
+            self._drain_ready()
+
+    def _drain_ready(self) -> None:
+        import ray_tpu
+
+        refs = [r for _, r in self._meta_refs]
+        try:
+            ready, _ = ray_tpu.wait(refs, num_returns=len(refs), timeout=0)
+            ready_set = set(ready)
+            done, pending = [], []
+            for stage_idx, ref in self._meta_refs:
+                (done if ref in ready_set else pending).append(
+                    (stage_idx, ref))
+            if done:
+                metas = ray_tpu.get([r for _, r in done], timeout=5)
+                for (stage_idx, _), meta in zip(done, metas):
+                    self.merge_entries(stage_idx, meta)
+                self._meta_refs = pending
+        except Exception:  # noqa: BLE001 — stats must never break iteration
+            pass
+
+    def merge_entries(self, stage_idx: int,
+                      entries: List[Dict[str, Any]]) -> None:
+        for op_idx, e in enumerate(entries or []):
+            cur = self._entries.setdefault(
+                (stage_idx, op_idx), op_entry(e.get("op", "?")))
+            for k in ("wall_s", "cpu_s", "rows", "bytes", "blocks"):
+                cur[k] += e.get(k, 0) or 0
+
+    def count_output(self, block: Any) -> None:
+        from ray_tpu.data.block import BlockAccessor
+
+        try:
+            acc = BlockAccessor.for_block(block)
+            self.output_rows += acc.num_rows()
+            self.output_bytes += acc.size_bytes()
+            self.output_blocks += 1
+        except Exception:  # noqa: BLE001 — stats must never break iteration
+            pass
+
+    def finish(self) -> None:
+        """Stream exhausted (or abandoned): freeze the total wall clock.
+        Meta refs are resolved lazily in finalize() so consumption paths
+        never block on stats bookkeeping."""
+        if self.total_wall_s is None:
+            self.total_wall_s = time.perf_counter() - self._t0
+
+    # -- rendering -----------------------------------------------------------
+
+    def finalize(self) -> None:
+        """Resolve the collected per-task metadata refs (tiny dicts; their
+        tasks completed before their blocks were consumed, so the gets are
+        instant — a short timeout covers abandoned streams)."""
+        if self._finalized:
+            return
+        self._finalized = True
+        self.finish()
+        if not self._meta_refs:
+            return
+        import ray_tpu
+
+        try:
+            # ONE batched round trip — per-ref gets would serialize
+            # len(refs) RPCs, each able to wait out its own timeout.
+            metas = ray_tpu.get([r for _, r in self._meta_refs], timeout=30)
+            for (stage_idx, _), meta in zip(self._meta_refs, metas):
+                self.merge_entries(stage_idx, meta)
+        except Exception:  # noqa: BLE001 — stream abandoned mid-flight:
+            # some refs never resolve; salvage whatever is ready now
+            self._drain_ready()
+        self._meta_refs = []
+
+    @staticmethod
+    def _fmt_bytes(n: int) -> str:
+        v = float(n)
+        for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+            if v < 1024 or unit == "TiB":
+                return f"{v:.1f}{unit}" if unit != "B" else f"{int(v)}B"
+            v /= 1024
+        return f"{v:.1f}TiB"
+
+    def to_string(self) -> str:
+        self.finalize()
+        lines = ["Execution stats (streaming):"]
+        for i, ((_stage, _op), e) in enumerate(
+                sorted(self._entries.items())):
+            if e.get("driver_side"):
+                lines.append(
+                    f"  op {i} {e['op']}: wall {e['wall_s']:.3f}s "
+                    "(driver-observed; rows/bytes n/a)")
+            else:
+                lines.append(
+                    f"  op {i} {e['op']}: {e['blocks']} blocks, "
+                    f"{e['rows']} rows, {self._fmt_bytes(e['bytes'])}, "
+                    f"wall {e['wall_s']:.3f}s, cpu {e['cpu_s']:.3f}s")
+        if not self._entries:
+            lines.append("  (no operators executed)")
+        total = self.total_wall_s if self.total_wall_s is not None else 0.0
+        out = (f"; output {self.output_rows} rows, "
+               f"{self._fmt_bytes(self.output_bytes)} in "
+               f"{self.output_blocks} blocks"
+               if self.output_blocks else "")
+        lines.append(f"Total wall time: {total:.3f}s{out}")
+        return "\n".join(lines)
+
+    # dict form for programmatic consumers / tests
+    def to_dict(self) -> Dict[str, Any]:
+        self.finalize()
+        return {
+            "operators": [dict(e) for _k, e in
+                          sorted(self._entries.items())],
+            "total_wall_s": self.total_wall_s,
+            "output_rows": self.output_rows,
+            "output_bytes": self.output_bytes,
+            "output_blocks": self.output_blocks,
+        }

@@ -28,6 +28,9 @@ from ray_tpu.data.block import Block, BlockAccessor
 class Dataset:
     def __init__(self, plan: Plan):
         self._plan = plan
+        # ExecutionStats of the most recent consumption of THIS dataset
+        # instance (rendered by .stats()).
+        self._last_stats = None
 
     # -- transforms (lazy) ---------------------------------------------------
 
@@ -281,11 +284,26 @@ class Dataset:
         Dataset.iterator -> DataIterator, data/iterator.py:68)."""
         return DataIterator(self)
 
+    def _new_stats(self):
+        from ray_tpu.data._internal.stats import ExecutionStats
+
+        stats = ExecutionStats()
+        self._last_stats = stats
+        return stats
+
     def iter_internal_block_refs(self) -> Iterator[Any]:
-        yield from execute_refs(self._plan)
+        stats = self._new_stats()
+        try:
+            yield from execute_refs(self._plan, stats=stats)
+        finally:
+            stats.finish()
 
     def iter_blocks(self) -> Iterator[Block]:
-        yield from execute_streaming(self._plan)
+        stats = self._new_stats()
+        try:
+            yield from execute_streaming(self._plan, stats=stats)
+        finally:
+            stats.finish()
 
     def materialize(self) -> "MaterializedDataset":
         import ray_tpu
@@ -447,7 +465,17 @@ class Dataset:
         return BlockAccessor.for_block(self.to_arrow()).to_numpy_batch()
 
     def stats(self) -> str:
-        return "streaming execution; per-op stats not yet collected"
+        """Per-operator execution stats of the most recent consumption of
+        this dataset (wall/cpu time, rows, bytes per operator — collected
+        by the streaming executor). Executes the plan if this dataset was
+        never consumed."""
+        if self._last_stats is None:
+            for _ in self.iter_blocks():
+                pass
+        if self._last_stats is None:  # e.g. MaterializedDataset override
+            return ("already materialized; no per-op execution stats "
+                    "recorded")
+        return self._last_stats.to_string()
 
     # -- aggregates ----------------------------------------------------------
 

@@ -410,7 +410,7 @@ def flash_attention_sharded(q, k, v, mesh, causal: bool = True,
     runs the kernel on its local [B/dp·fsdp, S, H/tp, D] block. KV heads are
     repeated to match q heads first so the tp shard is uniform under GQA.
     """
-    from jax import shard_map
+    from ray_tpu._private.jax_compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     h_kv = k.shape[2]

@@ -59,7 +59,14 @@ def collective_report(fn: Callable, *args,
     """
     import jax
 
-    lowered = jax.jit(fn, static_argnames=static_argnames).lower(*args)
+    # Pre-jitted callables (and make_train_step's wrapper, whose state
+    # argument is a plain dataclass that only ITS .lower knows how to
+    # pytree-ify) advertise a .lower hook — prefer it over re-jitting.
+    lower = getattr(fn, "lower", None)
+    if lower is not None:
+        lowered = lower(*args)
+    else:
+        lowered = jax.jit(fn, static_argnames=static_argnames).lower(*args)
     hlo = lowered.compile().as_text()
     report: Dict[str, Dict[str, int]] = {
         k: {"count": 0, "bytes": 0} for k in _COLLECTIVES}

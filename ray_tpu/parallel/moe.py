@@ -78,7 +78,7 @@ def moe_shard_map(x, gate_w, expert_fn, expert_params, mesh,
     buffers exchanged with lax.all_to_all."""
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    from ray_tpu._private.jax_compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     n_exp_total = gate_w.shape[1]

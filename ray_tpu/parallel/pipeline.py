@@ -76,7 +76,7 @@ def pipeline_sharded(stage_fn, mesh, axis_name: str = "pp"):
     """shard_map wrapper: params lead with a [pp, ...] stage axis, inputs are
     replicated microbatches; returns final outputs replicated."""
     import jax
-    from jax import shard_map
+    from ray_tpu._private.jax_compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     def wrapped(stacked_params, microbatches):

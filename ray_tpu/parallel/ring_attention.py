@@ -112,7 +112,7 @@ def ring_attention_sharded(q, k, v, mesh, axis_name: str = "sp",
     """
     import jax
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from ray_tpu._private.jax_compat import shard_map
 
     # Shard batch over every data-parallel axis (incl. the inter-slice dcn
     # axis of multi-slice meshes) and heads over tp — replicating those

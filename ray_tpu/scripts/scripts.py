@@ -317,6 +317,31 @@ def cmd_timeline(args) -> int:
     return 0
 
 
+def cmd_latency(args) -> int:
+    """Per-stage latency breakdown of recent tasks (submit/queue/rpc/
+    dispatch/execute/reply) from the GCS task-event stream — the numbers
+    the control-plane perf work optimizes against."""
+    _connect(args)
+    from ray_tpu._private import latency
+    from ray_tpu.util.state.api import list_tasks
+
+    events = list_tasks(limit=100_000, raw_events=True)
+    evs = [e for e in events if e.get("stages")]
+    evs.sort(key=lambda e: e.get("time", 0))
+    evs = evs[-args.n:]
+    if not evs:
+        print("no task breakdowns recorded yet (run some tasks first; "
+              "breakdowns ride the terminal task events)")
+        return 0
+    rows = [{"name": e.get("name"), "type": e.get("type"),
+             "task_id": e.get("task_id"), "stages": e["stages"]}
+            for e in evs]
+    print(f"stage breakdown of the last {len(rows)} finished tasks "
+          "(milliseconds):")
+    print(latency.format_breakdowns(rows))
+    return 0
+
+
 def cmd_serve(args) -> int:
     """serve deploy/status/shutdown (reference: serve/scripts.py CLI)."""
     _connect(args)
@@ -778,6 +803,13 @@ def main(argv=None) -> int:
     sp.add_argument("--address")
     sp.add_argument("-o", "--output")
     sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser(
+        "latency", help="per-stage latency breakdown of recent tasks")
+    sp.add_argument("--address")
+    sp.add_argument("-n", type=int, default=20,
+                    help="show the last N finished tasks")
+    sp.set_defaults(fn=cmd_latency)
 
     sp = sub.add_parser("serve", help="serve deploy/status/shutdown")
     sp.add_argument("serve_cmd", choices=["deploy", "status", "shutdown"])

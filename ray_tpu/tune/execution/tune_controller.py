@@ -202,7 +202,8 @@ class TuneController:
         self._invoke_callbacks(
             "on_trial_complete", self._iteration, self.trials, trial)
 
-    def _launchable_concurrency(self) -> int:
+    def _launchable_concurrency(self, trial: Optional["Trial"] = None,
+                                total: Optional[float] = None) -> int:
         """max_concurrent additionally bounded by what the cluster can
         actually host. Launching a trial the cluster has no CPUs for
         deadlocks the loop: the actor pends, the blocking init_session
@@ -210,14 +211,22 @@ class TuneController:
         completion would free CPUs are never processed (their actors hold
         their CPUs until _stop_trial kills them). Counts the RUNNING
         trials' actual launched resources, not the experiment default —
-        ResourceChanging overrides would otherwise re-open the wedge."""
-        cpu_per = (self._resources or {}).get("CPU", 1.0)
+        and sizes the headroom check by the SPECIFIC pending trial's
+        resources override (ResourceChangingScheduler trials whose
+        per-trial CPUs exceed the experiment default would otherwise
+        slip past the cap and re-open the pending-actor wedge)."""
+        res = ((getattr(trial, "resources", None) if trial is not None
+                else None) or self._resources or {})
+        cpu_per = res.get("CPU", 1.0)
         if not cpu_per or cpu_per <= 0:
             return self._max_concurrent
-        try:
-            total = ray_tpu.cluster_resources().get("CPU", 0.0)
-        except Exception:  # noqa: BLE001 — no cluster view: trust config
-            return self._max_concurrent
+        if total is None:
+            # `total` lets _step fetch the cluster view ONCE — calling
+            # this per pending trial must not mean one GCS RPC per trial.
+            try:
+                total = ray_tpu.cluster_resources().get("CPU", 0.0)
+            except Exception:  # noqa: BLE001 — no cluster view
+                return self._max_concurrent
         if total <= 0:
             return self._max_concurrent
         running = [t for t in self.trials if t.status == RUNNING]
@@ -357,11 +366,24 @@ class TuneController:
                     self._stop_trial(t, TERMINATED)
             return False
         self._maybe_create_trials()
-        launch_cap = self._launchable_concurrency()
+        # One cluster-view fetch per step, shared by every pending trial's
+        # headroom check below.
+        try:
+            total_cpu: float = ray_tpu.cluster_resources().get("CPU", 0.0)
+        except Exception:  # noqa: BLE001 — no cluster view: trust config
+            total_cpu = -1.0
+        default_cap = self._launchable_concurrency(total=total_cpu)
         for trial in self.trials:
-            if trial.status == PENDING and (
-                    sum(1 for t in self.trials if t.status == RUNNING)
-                    < launch_cap):
+            # per-trial cap: a ResourceChanging override makes headroom
+            # trial-specific, so the launchable check must use THIS
+            # trial's resources, not the experiment default
+            if trial.status != PENDING:
+                continue
+            cap = (default_cap
+                   if getattr(trial, "resources", None) is None
+                   else self._launchable_concurrency(trial, total=total_cpu))
+            if (sum(1 for t in self.trials if t.status == RUNNING)
+                    < cap):
                 try:
                     self._launch_trial(trial)
                 except Exception as e:  # noqa: BLE001 — actor start failure

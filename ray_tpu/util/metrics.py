@@ -129,7 +129,98 @@ class Histogram(Metric):
                             {**tags, "le": "+Inf"}, self._totals[key]))
                 out.append((f"{self._name}_sum", tags, self._sums[key]))
                 out.append((f"{self._name}_count", tags, self._totals[key]))
+                # Estimated p50/p90/p99 as companion series (the exact
+                # buckets stay above for real Prometheus aggregation;
+                # these pre-computed quantiles serve the dashboard's
+                # time-series page and humans curling /metrics).
+                for q in (0.5, 0.9, 0.99):
+                    out.append((f"{self._name}_quantile",
+                                {**tags, "quantile": str(q)},
+                                self._quantile_locked(key, q)))
         return out
+
+    @staticmethod
+    def _bucket_quantile(boundaries, buckets, total, q: float) -> float:
+        """Estimate a quantile from bucket counts (histogram_quantile
+        semantics: linear interpolation inside the bucket; the overflow
+        bucket clamps to the top boundary)."""
+        if not buckets or total <= 0:
+            return 0.0
+        target = q * total
+        cum = 0
+        lo = 0.0
+        for boundary, count in zip(boundaries, buckets):
+            if cum + count >= target:
+                frac = (target - cum) / count if count else 0.0
+                return lo + (boundary - lo) * frac
+            cum += count
+            lo = boundary
+        return boundaries[-1]
+
+    def _quantile_locked(self, key: Tuple, q: float) -> float:
+        return self._bucket_quantile(
+            self._boundaries, self._counts.get(key),
+            self._totals.get(key, 0), q)
+
+    def quantiles(self, qs: Sequence[float] = (0.5, 0.9, 0.99)
+                  ) -> Dict[Tuple, Dict[float, float]]:
+        """Per-tag-set quantile estimates: {tags_key: {q: seconds}}."""
+        with self._lock:
+            return {key: {q: self._quantile_locked(key, q) for q in qs}
+                    for key in self._counts}
+
+    def quantiles_by(self, tag_key: str,
+                     qs: Sequence[float] = (0.5, 0.9, 0.99)
+                     ) -> Dict[str, Dict[float, float]]:
+        """Quantiles with bucket counts MERGED across all tag sets sharing
+        a value of `tag_key` (e.g. per-stage latency regardless of task
+        type) — plus total counts under the 'count' key."""
+        with self._lock:
+            merged: Dict[str, List[int]] = {}
+            totals: Dict[str, int] = {}
+            for key, buckets in self._counts.items():
+                group = dict(key).get(tag_key, "")
+                agg = merged.setdefault(
+                    group, [0] * (len(self._boundaries) + 1))
+                for i, c in enumerate(buckets):
+                    agg[i] += c
+                totals[group] = totals.get(group, 0) + self._totals[key]
+            out: Dict[str, Dict] = {}
+            for group, agg in merged.items():
+                out[group] = {q: self._bucket_quantile(
+                    self._boundaries, agg, totals[group], q) for q in qs}
+                out[group]["count"] = totals[group]
+            return out
+
+
+def get_metric(name: str) -> Optional[Metric]:
+    """Look up a registered metric by name (newest registration wins)."""
+    with _registry_lock:
+        for m in reversed(_registry):
+            if m._name == name:
+                return m
+    return None
+
+
+# Control-plane latency bucket layout shared by the internal histograms
+# (RPC handlers, raylet lease stages): 10µs..30s, log-ish spacing.
+LATENCY_BOUNDARIES = [1e-5, 5e-5, 1e-4, 5e-4, 1e-3, 5e-3, 0.01, 0.05,
+                      0.1, 0.5, 1, 5, 30]
+
+
+def get_or_create_histogram(name: str, description: str = "",
+                            boundaries: Optional[Sequence[float]] = None,
+                            tag_keys: Optional[Sequence[str]] = None
+                            ) -> Histogram:
+    """The registered Histogram named `name`, or a fresh one — the shared
+    lazy-singleton shape the internal instrumentation points use, so each
+    doesn't re-implement its own module-global cache + boundaries copy."""
+    m = get_metric(name)
+    if isinstance(m, Histogram):
+        return m
+    return Histogram(name, description,
+                     boundaries=list(boundaries or LATENCY_BOUNDARIES),
+                     tag_keys=tag_keys)
 
 
 def prometheus_text() -> str:

@@ -253,6 +253,30 @@ def build_chrome_trace(events: list) -> list:
             }
             trace.append(entry)
             spans[ev["task_id"]] = entry
+            stages = ev.get("stages")
+            if stages:
+                # Stage-segmented companion lane: the six latency stages
+                # laid back-to-back, ending where the task span ends —
+                # the timeline shows WHERE the microseconds went instead
+                # of one opaque bar. (submit/queue precede the RUNNING
+                # stamp, so the lane may start earlier than the bar.)
+                entry["args"]["stages"] = dict(stages)
+                from ray_tpu._private.latency import STAGES
+
+                total = sum(stages.get(s, 0.0) or 0.0 for s in STAGES)
+                t = ev["time"] - total
+                for stage in STAGES:
+                    dur = stages.get(stage, 0.0) or 0.0
+                    trace.append({
+                        "cat": "stage", "ph": "X",
+                        "name": f"{ev['name']}:{stage}",
+                        "pid": entry["pid"],
+                        "tid": f"{entry['tid']}.stages",
+                        "ts": int(t * 1e6), "dur": int(dur * 1e6),
+                        "args": {"task_id": ev["task_id"],
+                                 "stage": stage},
+                    })
+                    t += dur
     # chrome flow arrows parent -> child so the tree renders visually
     for entry in list(trace):
         parent = entry["args"].get("parent")

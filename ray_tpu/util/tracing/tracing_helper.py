@@ -69,6 +69,22 @@ def trace_span(name: str, attributes: Optional[Dict[str, Any]] = None):
             })
 
 
+def record_event(name: str, start: float, end: float,
+                 attributes: Optional[Dict[str, Any]] = None,
+                 thread: Optional[str] = None) -> None:
+    """Record a span with EXPLICIT wall-clock bounds (for after-the-fact
+    instrumentation like per-stage task latency segments, where the span
+    is reconstructed from stamps rather than wrapped with trace_span)."""
+    with _lock:
+        _events.append({
+            "name": name,
+            "start": start,
+            "end": end,
+            "thread": thread or threading.current_thread().name,
+            "attributes": dict(attributes or {}),
+        })
+
+
 def profile(name: str):
     """Decorator form: @profile("stage") wraps calls in trace_span."""
 

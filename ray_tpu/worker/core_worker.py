@@ -82,6 +82,13 @@ class _PendingTask:
     # Pushes that provably never reached a worker (connect refused):
     # requeued without consuming retries_left, bounded by this counter.
     undelivered_failures: int = 0
+    # Latency-tracing stamps (time.monotonic, this process's clock):
+    # .remote() entry / queued for a lease / push RPC written. The worker
+    # returns its own durations in the reply; _on_task_reply stitches both
+    # into the per-stage breakdown (_private/latency.py).
+    t_submit: Optional[float] = None
+    t_queued: Optional[float] = None
+    t_pushed: Optional[float] = None
 
 
 def _slice_segments(segments, off: int, length: int) -> bytes:
@@ -1107,6 +1114,7 @@ class CoreWorker:
         runtime_env_prepared: bool = False,
         max_calls: int = 0,
     ):
+        t_submit = time.monotonic()
         fid = function_id or self.register_function(fn)
         if not runtime_env_prepared:
             runtime_env = self.prepare_runtime_env(runtime_env)
@@ -1133,7 +1141,8 @@ class CoreWorker:
         )
         spec.kwarg_specs = kwarg_specs
         self._pending_tasks[task_id] = _PendingTask(
-            spec=spec, retries_left=max_retries, arg_ids=arg_ids
+            spec=spec, retries_left=max_retries, arg_ids=arg_ids,
+            t_submit=t_submit,
         )
         lineage = spec if CONFIG.enable_lineage_reconstruction else None
         self._record_task_event(spec, "PENDING")
@@ -1175,8 +1184,12 @@ class CoreWorker:
             self._submit_scheduled = False
         task_keys = set()
         actor_groups: Dict[ActorID, List[TaskSpec]] = {}
+        now = time.monotonic()
         for is_actor, spec in items:
             if is_actor:
+                pending = self._pending_tasks.get(spec.task_id)
+                if pending is not None:
+                    pending.t_queued = now
                 actor_groups.setdefault(spec.actor_id, []).append(spec)
             else:
                 key = self._route_or_park(spec)
@@ -1208,12 +1221,19 @@ class CoreWorker:
             for oid in missing:
                 self._dep_waiters.setdefault(oid, []).append(wait)
             return None
+        pending = self._pending_tasks.get(spec.task_id)
+        if pending is not None:
+            # dependency-wait time lands in the 'submit' stage by design
+            pending.t_queued = time.monotonic()
         key = spec.scheduling_key()
         st = self._key_states.setdefault(key, _KeyState())
         st.pending.append(spec)
         return key
 
     async def _enqueue_ready(self, spec: TaskSpec):
+        pending = self._pending_tasks.get(spec.task_id)
+        if pending is not None:
+            pending.t_queued = time.monotonic()
         key = spec.scheduling_key()
         st = self._key_states.setdefault(key, _KeyState())
         st.pending.append(spec)
@@ -1422,10 +1442,12 @@ class CoreWorker:
 
     async def _push(self, key, lease: _Lease, specs: List[TaskSpec]):
         st = self._key_states[key]
+        now = time.monotonic()
         for spec in specs:
             pending = self._pending_tasks.get(spec.task_id)
             if pending is not None:
                 pending.pushed_to = lease.address.rpc_address
+                pending.t_pushed = now
             self._record_task_event(spec, "RUNNING")
         client = self._peers.get(lease.address.rpc_address)
         push_started = time.monotonic()
@@ -1566,6 +1588,7 @@ class CoreWorker:
 
     # ------------------------------------------------- task completion paths
     def _on_task_reply(self, spec: TaskSpec, reply: dict):
+        t_reply = time.monotonic()
         pending = self._pending_tasks.get(spec.task_id)
         if pending is None or pending.spec.attempt_number != spec.attempt_number:
             return
@@ -1575,7 +1598,8 @@ class CoreWorker:
                 self._store_return(oid, payload)
             if spec.is_streaming_generator():
                 self._finish_generator(spec.task_id, reply.get("streaming_num_items", 0))
-            self._finalize_task(spec, "FINISHED")
+            stages = self._task_breakdown(spec, pending, reply, t_reply)
+            self._finalize_task(spec, "FINISHED", stages)
         elif status == "cancelled":
             err = exc.TaskCancelledError(spec.task_id)
             self._store_error_for_task(spec, err)
@@ -1589,7 +1613,23 @@ class CoreWorker:
             self._store_error_for_task(spec, error_obj)
             if spec.is_streaming_generator():
                 self._finish_generator(spec.task_id, 0, error=reply["error"])
-            self._finalize_task(spec, "FAILED")
+            stages = self._task_breakdown(spec, pending, reply, t_reply)
+            self._finalize_task(spec, "FAILED", stages)
+
+    def _task_breakdown(self, spec: TaskSpec, pending: _PendingTask,
+                        reply: dict, t_reply: float) -> Optional[dict]:
+        """Stitch owner stamps + worker durations into the six-stage
+        latency breakdown; record it into metrics/trace/ring buffer."""
+        from ray_tpu._private import latency
+
+        stages = latency.owner_breakdown(
+            pending.t_submit, pending.t_queued, pending.t_pushed,
+            t_reply, time.monotonic(), reply.get("stages"))
+        if stages is not None:
+            latency.record_breakdown(
+                spec.task_id.hex(), spec.function_name,
+                spec.task_type.name, stages)
+        return stages
 
     def _on_worker_failure(self, spec: TaskSpec):
         pending = self._pending_tasks.get(spec.task_id)
@@ -1612,6 +1652,10 @@ class CoreWorker:
         pending = self._pending_tasks.get(spec.task_id)
         if pending is not None:
             pending.spec = spec
+            # fresh queue/push stamps for the retry; t_submit stays, so the
+            # final breakdown's total covers every attempt
+            pending.t_queued = None
+            pending.t_pushed = None
         if spec.task_type == TaskType.NORMAL_TASK:
             self._normal_submit(spec)
         else:
@@ -1634,12 +1678,13 @@ class CoreWorker:
             self.memory_store.put_serialized(oid, s, value=error, is_exception=True)
             self._release_deps(oid)
 
-    def _finalize_task(self, spec: TaskSpec, state: str):
+    def _finalize_task(self, spec: TaskSpec, state: str,
+                       stages: Optional[dict] = None):
         pending = self._pending_tasks.pop(spec.task_id, None)
         if pending is not None:
             for oid in pending.arg_ids:
                 self.reference_counter.remove_submitted_task_ref(oid)
-        self._record_task_event(spec, state)
+        self._record_task_event(spec, state, stages)
 
     # ------------------------------------------------------- actor submission
     def create_actor(
@@ -1881,6 +1926,7 @@ class CoreWorker:
         self, actor_id: ActorID, method_name: str, args: tuple, kwargs: dict,
         *, num_returns=1,
     ):
+        t_submit = time.monotonic()
         rec = self._actors.get(actor_id)
         if rec is None:
             rec = _ActorRecord(actor_id=actor_id)
@@ -1917,7 +1963,7 @@ class CoreWorker:
         spec.kwarg_specs = kwarg_specs
         self._pending_tasks[task_id] = _PendingTask(
             spec=spec, retries_left=rec.max_task_retries, is_actor_task=True,
-            arg_ids=arg_ids,
+            arg_ids=arg_ids, t_submit=t_submit,
         )
         if streaming:
             # See submit_task: item oids are owned at report time, not here.
@@ -2038,6 +2084,10 @@ class CoreWorker:
 
         async def _push_chunk(chunk: List[TaskSpec]):
             t0 = time.monotonic()
+            for spec in chunk:
+                p = self._pending_tasks.get(spec.task_id)
+                if p is not None:
+                    p.t_pushed = t0
             try:
                 wire = await client.call_async(
                     "push_task_w", [spec_to_wire(s) for s in chunk],
@@ -2407,10 +2457,38 @@ class CoreWorker:
     async def _handle_reconstruct_object(self, payload):
         return self._try_reconstruct(payload["object_id"])
 
+    @staticmethod
+    def _attach_worker_stages(replies, recv: float, shared: bool) -> None:
+        """Turn the executor's raw stamps into the reply's `stages` dict
+        (worker's own clock — durations only, so the owner can stitch
+        them against its stamps with no cross-process clock sync).
+        `shared`: the replies share one receive stamp (a batched push), so
+        per-reply pack time can't be isolated — later batchmates' waiting
+        shows up in their dispatch stage instead."""
+        wall = time.monotonic() - recv
+        for r in replies:
+            if not isinstance(r, dict):
+                continue
+            started = r.pop("_rt_exec_started", None)
+            fn_s = r.pop("_rt_fn_s", None)
+            if started is None:
+                continue
+            dispatch = max(0.0, started - recv)
+            execute = fn_s if fn_s is not None else (r.get("exec_s") or 0.0)
+            if shared:
+                pack = 0.0
+            else:
+                pack = max(0.0, wall - dispatch - execute)
+            r["stages"] = {"dispatch": dispatch, "exec": execute,
+                           "pack": pack,
+                           "wall": dispatch + execute + pack}
+
     async def _handle_push_task(self, payload):
+        recv = time.monotonic()
         spec: TaskSpec = payload["spec"]
         self._record_task_event(spec, "EXECUTING")
         reply = await self.executor.execute(spec)
+        self._attach_worker_stages([reply], recv, shared=False)
         if spec.task_type == TaskType.ACTOR_CREATION_TASK:
             # creation tasks have no owner-side _finalize_task (the GCS
             # pushes them); record completion here or the timeline shows
@@ -2425,12 +2503,14 @@ class CoreWorker:
         arrival order, in ONE thread-pool job. If a task retires the worker
         (max_calls), the rest of the batch is returned not_run so the owner
         re-queues it."""
+        recv = time.monotonic()
         specs = payload["specs"]
         for spec in specs:
             self._record_task_event(spec, "EXECUTING")
         loop = asyncio.get_event_loop()
         replies = await loop.run_in_executor(
             self.executor._pool, self.executor.execute_batch_sync, specs)
+        self._attach_worker_stages(replies, recv, shared=len(specs) > 1)
         return {"replies": replies}
 
     async def _handle_push_task_w(self, payload):
@@ -2440,17 +2520,20 @@ class CoreWorker:
         one thread-pool job; a batch of actor calls dispatches every spec
         concurrently so async/threaded actor semantics hold (ordered
         actors still serialize on the executor's sequencing gate)."""
+        recv = time.monotonic()
         specs = [spec_from_wire(t) for t in payload]
         for spec in specs:
             self._record_task_event(spec, "EXECUTING")
         if len(specs) == 1:
             reply = await self.executor.execute(specs[0])
+            self._attach_worker_stages([reply], recv, shared=False)
             return [reply_to_wire(reply)]
         if all(s.task_type == TaskType.NORMAL_TASK for s in specs):
             loop = asyncio.get_event_loop()
             replies = await loop.run_in_executor(
                 self.executor._pool, self.executor.execute_batch_sync,
                 specs)
+            self._attach_worker_stages(replies, recv, shared=True)
             return [reply_to_wire(r) for r in replies]
         creation = self.executor._actor_spec
         if creation is None or (creation.max_concurrency <= 1
@@ -2462,9 +2545,11 @@ class CoreWorker:
             replies = await loop.run_in_executor(
                 self.executor._pool, self.executor.execute_actor_batch_sync,
                 specs)
+            self._attach_worker_stages(replies, recv, shared=True)
             return [reply_to_wire(r) for r in replies]
         replies = await asyncio.gather(
             *(self.executor.execute(s) for s in specs))
+        self._attach_worker_stages(replies, recv, shared=True)
         return [reply_to_wire(r) for r in replies]
 
     async def _handle_kill_actor(self, payload):
@@ -2708,14 +2793,16 @@ class CoreWorker:
         self.memory_store.add_callback(ref.object_id(), _cb)
 
     # ------------------------------------------------------------ task events
-    def _record_task_event(self, spec: TaskSpec, state: str):
+    def _record_task_event(self, spec: TaskSpec, state: str,
+                           stages: Optional[dict] = None):
         # Hot path (2+ calls per task): append a small tuple of scalars —
         # NOT the spec itself, which pins inline arg payloads (up to 100KB
         # each) for the life of the bounded deque. Dict formatting happens
-        # once per flush batch in _flush_task_events.
+        # once per flush batch in _flush_task_events. `stages` rides only
+        # on terminal events (the per-stage latency breakdown).
         self._task_events.append(
             (spec.task_id, spec.function_name, spec.task_type.name,
-             spec.job_id, state, time.time(), spec.trace_parent))
+             spec.job_id, state, time.time(), spec.trace_parent, stages))
         ev = self._task_events_wakeup
         if ev is not None and not ev.is_set():
             self._lt.loop.call_soon_threadsafe(ev.set)
@@ -2738,9 +2825,9 @@ class CoreWorker:
         while self._task_events:
             events = []
             while self._task_events and len(events) < 5000:
-                task_id, name, type_name, job_id, state, ts, parent = \
-                    self._task_events.popleft()
-                events.append({
+                task_id, name, type_name, job_id, state, ts, parent, \
+                    stages = self._task_events.popleft()
+                ev = {
                     "task_id": task_id.hex(),
                     "name": name,
                     "type": type_name,
@@ -2750,7 +2837,10 @@ class CoreWorker:
                     "node": node,
                     "worker_id": worker,
                     "time": ts,
-                })
+                }
+                if stages is not None:
+                    ev["stages"] = stages
+                events.append(ev)
             try:
                 await self._gcs.send_async(
                     "add_task_events", {"events": events})

@@ -277,6 +277,9 @@ class Executor:
         # needs task duration EXCLUDING network RTT (an RTT-inclusive
         # sample would lock remote owners out of batching forever)
         reply["exec_s"] = time.monotonic() - t0
+        # when this pool thread picked the task up — the push handler
+        # turns it into the 'dispatch' stage of the latency breakdown
+        reply.setdefault("_rt_exec_started", t0)
         return reply
 
     def _run_normal_task_inner(self, spec: TaskSpec) -> dict:
@@ -303,8 +306,11 @@ class Executor:
             args, kwargs = self._resolve_args(spec.args, getattr(spec, "kwarg_specs", {}) or {})
             if spec.is_streaming_generator():
                 return self._run_generator(spec, fn, args, kwargs)
+            fn_t0 = time.monotonic()
             result = fn(*args, **kwargs)
-            return {"status": "ok", "returns": self._package_returns(spec, result)}
+            fn_s = time.monotonic() - fn_t0
+            return {"status": "ok", "_rt_fn_s": fn_s,
+                    "returns": self._package_returns(spec, result)}
         except TaskCancelledError:
             return {"status": "cancelled", "return_ids": spec.return_ids()}
         except BaseException as e:  # noqa: BLE001 — errors are data here
@@ -432,6 +438,9 @@ class Executor:
         reply = self._run_actor_body(spec, caller, ordered)
         if isinstance(reply, dict):
             reply["exec_s"] = time.monotonic() - exec_started
+            # dispatch stage = recv -> here; for ordered actors that
+            # includes the sequencing-gate wait, which IS dispatch queueing
+            reply.setdefault("_rt_exec_started", exec_started)
         return reply
 
     def _run_actor_body(self, spec: TaskSpec, caller: bytes,

@@ -77,14 +77,19 @@ def test_oom_kill_retries_task(ray_start_regular):
 
     @ray_tpu.remote(max_retries=2)
     def slow():
-        time.sleep(1.0)
+        time.sleep(3.0)
         return "done"
 
     ref = slow.remote()
-    time.sleep(0.4)  # task is running on some worker
-    # Simulate the monitor firing: kill the leased worker directly.
+    # Wait for the task to be running on some worker: lease grant includes
+    # a worker spawn, which takes whole seconds on a loaded 1-core host —
+    # a fixed sleep here flakes.
     node = ray_tpu.api._global_node
     raylet = node.raylet
+    deadline = time.time() + 30
+    while time.time() < deadline and not raylet._leases:
+        time.sleep(0.05)
+    # Simulate the monitor firing: kill the leased worker directly.
     leases = dict(raylet._leases)
     assert leases, "expected a leased worker"
     wid = next(iter(leases))
