@@ -29,10 +29,10 @@ def _subprocess_benches() -> dict:
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("PALLAS_AXON_POOL_IPS", None)
 
-    def run(mod, timeout):
+    def run(mod, timeout, *argv):
         r = subprocess.run(
-            [sys.executable, "-m", mod], capture_output=True, text=True,
-            timeout=timeout, env=env)
+            [sys.executable, "-m", mod, *argv], capture_output=True,
+            text=True, timeout=timeout, env=env)
         for line in reversed(r.stdout.strip().splitlines()):
             line = line.strip()
             if line.startswith("{"):
@@ -53,6 +53,17 @@ def _subprocess_benches() -> dict:
         out["serve_handle_rps"] = sv["serve_handle"]["rps"]
     except Exception as e:  # noqa: BLE001
         out["serve_error"] = str(e)[:200]
+    try:
+        # serving-level LLM numbers (TTFT + delivered tokens/sec under
+        # Poisson arrivals through serve.llm) so the perf trajectory
+        # tracks serving, not just on-device decode
+        lv = run("ray_tpu.inference.benchmarks", 900, "serving")
+        out["llm_serving_ttft_p50_ms"] = lv["value"]
+        out["llm_serving_ttft_p99_ms"] = lv["detail"]["ttft_p99_ms"]
+        out["llm_serving_tokens_per_sec"] = lv["detail"]["tokens_per_sec"]
+        out["llm_serving_detail"] = lv.get("detail", {})
+    except Exception as e:  # noqa: BLE001
+        out["llm_serving_error"] = str(e)[:200]
     return out
 
 

@@ -148,11 +148,23 @@ class ObjectRefGenerator:
         return self
 
     def close(self):
-        """Stop the producing task: cancel it so it stops generating items
-        nobody will consume (reference: ObjectRefGenerator cancellation via
-        ray.cancel on the generator task)."""
+        """Stop the producing task AND release owner-side stream state:
+        cancel the task so it stops generating items nobody will consume
+        (reference: ObjectRefGenerator cancellation via ray.cancel on the
+        generator task), then free the reported-but-unconsumed return
+        objects and the stream bookkeeping — an abandoned stream must not
+        leak its _generators entry, reference-counter rows, or buffered
+        values (tests/test_serve_llm.py hygiene test)."""
         try:
-            get_core_worker().cancel_task_by_id(self._task_id, force=False)
+            cw = get_core_worker()
+        except Exception:  # noqa: BLE001 — ray already shut down
+            return
+        try:
+            cw.cancel_task_by_id(self._task_id, force=False)
+        except Exception:  # noqa: BLE001 — best-effort on teardown
+            pass
+        try:
+            cw.release_generator(self._task_id, self._consumed)
         except Exception:  # noqa: BLE001 — best-effort on teardown
             pass
 

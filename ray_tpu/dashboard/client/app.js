@@ -406,6 +406,11 @@ async function pageMetrics() {
     svgChart("Worker leases (active / queued)",
              pick(/^leases_/), num),
     svgChart("Node CPU %", pick(/^node_cpu_percent_/), pct),
+    svgChart("LLM serving latency (TTFT / TPOT p50,p99)",
+             pick(/^llm_t(tft|pot)_/), ms),
+    svgChart("LLM queue depth (per engine replica)",
+             pick(/^llm_queue_depth_/), num),
+    svgChart("LLM batch occupancy", pick(/^llm_batch_occupancy_/), num),
   ].join("");
   return `<h2>Live metrics
     <span class="muted">(ring-buffered, ${data.sample_period_s ?? 5}s

@@ -87,7 +87,6 @@ class DashboardHead:
         self._ts_sampling = threading.Lock()   # one sampler at a time
         self._ts: Dict[str, deque] = {}
         self._ts_last_sample = 0.0
-        self._ts_prev_t: Optional[float] = None
         self._ts_tp_prev_t: Optional[float] = None
         self._ts_finished_cum = 0
         self._ts_event_watermarks: Dict[str, float] = {}
@@ -449,7 +448,6 @@ class DashboardHead:
                 self._ts_last_sample = now
                 for name, value in points:
                     self._ts_add(name, now, value)
-            self._ts_prev_t = now
         finally:
             self._ts_sampling.release()
 
@@ -471,6 +469,30 @@ class DashboardHead:
             for ttype, qs in merged.items():
                 for q, label in ((0.5, "p50"), (0.9, "p90"), (0.99, "p99")):
                     add(f"task_total_{ttype}_{label}", qs.get(q, 0.0))
+        # 1.5) LLM serving series (serve.llm): scrape replica metric
+        # snapshots into the local registry (no-op unless serve is
+        # running and reachable from this process), then sample the
+        # merged TTFT/TPOT quantiles and queue/occupancy gauges.
+        try:
+            from ray_tpu.serve.llm import metrics as llm_m
+
+            llm_m.maybe_collect_local(timeout_s=2.0)
+            for metric, label in ((llm_m.TTFT_NAME, "llm_ttft"),
+                                  (llm_m.TPOT_NAME, "llm_tpot")):
+                hist = get_metric(metric)
+                if hist is not None and hasattr(hist, "quantiles_by"):
+                    for dep, qs in hist.quantiles_by("deployment").items():
+                        for q, ql in ((0.5, "p50"), (0.99, "p99")):
+                            add(f"{label}_{dep}_{ql}", qs.get(q, 0.0))
+            for metric, label in (
+                    (llm_m.QUEUE_DEPTH_NAME, "llm_queue_depth"),
+                    (llm_m.OCCUPANCY_NAME, "llm_batch_occupancy")):
+                g = get_metric(metric)
+                if g is not None:
+                    for _, tags, v in g._samples():
+                        add(f"{label}_{tags.get('replica', '')[:24]}", v)
+        except Exception:  # noqa: BLE001 — serving stack not up
+            pass
         # 2) task throughput from GCS task events. Count FINISHED events
         # past a PER-JOB watermark over EVENT timestamps — a delta of the
         # windowed count would flatline to zero once the event store holds

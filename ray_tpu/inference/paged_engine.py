@@ -91,6 +91,11 @@ class PagedInferenceEngine:
         self._key = jax.random.PRNGKey(0)
         self.decode_chunk = max(1, decode_chunk)
         self.preemptions = 0  # observability: recompute-preemption count
+        self.peak_active = 0  # high-water mark of concurrently-decoding
+        # requests — the ground-truth continuous-batching signal
+        # serve_stream: req_id -> reason for requests the loop aborted
+        # (pool too small, prompt too long); read by the serving layer
+        self.abort_reasons: Dict[Any, str] = {}
 
         @partial(jax.jit, donate_argnums=(1,),
                  static_argnames=("temperature", "top_k", "top_p"))
@@ -213,36 +218,113 @@ class PagedInferenceEngine:
 
     # -- generation ----------------------------------------------------------
 
-    def generate_stream(
+    def stats(self) -> Dict[str, Any]:
+        """Host-side engine occupancy snapshot (serving observability)."""
+        return {
+            "max_batch": self.max_batch,
+            "active_slots": self.max_batch - len(self.free_slots),
+            "free_blocks": len(self.free_blocks),
+            "n_blocks": self.n_blocks,
+            "preemptions": self.preemptions,
+            "peak_active": self.peak_active,
+        }
+
+    def serve_stream(
         self,
-        prompts: List[List[int]],
+        feed: Callable[[bool], Tuple[list, list, bool]],
         gen: Optional[GenerationConfig] = None,
-    ) -> Iterator[Tuple[int, int]]:
-        """Yields (request_index, token_id) as tokens are produced
-        (block-at-a-time: see InferenceEngine.generate_stream)."""
+    ) -> Iterator[Tuple[Any, Optional[int], bool]]:
+        """Continuous-batching SERVICE loop: requests arrive over time
+        instead of as one fixed batch — the composition a serving replica
+        needs (admission between decode chunks, not between generations).
+
+        `feed(block)` is polled between device dispatches and returns
+        `(new, cancelled, stop)`:
+
+          * new: list of (req_id, prompt_tokens, max_new_tokens|None) —
+            max_new defaults to gen.max_new_tokens. Admission order is
+            FIFO (preempted requests re-admit ahead of new arrivals).
+          * cancelled: req_ids to abort (consumer went away): their slots
+            and blocks free immediately, nothing further is yielded.
+          * stop: no more requests will ever arrive; the loop drains and
+            returns.
+          * block: hint that the engine is idle — feed may wait for work.
+
+        Yields (req_id, token_id, done). A request the loop must reject
+        (prompt longer than max_len, pool too small to ever hold it)
+        yields (req_id, None, True) with the reason in
+        `self.abort_reasons[req_id]` — one bad request never kills the
+        service loop for its batch-mates.
+
+        Sampling params (temperature/top_k/top_p/eos) come from `gen` and
+        are shared by every request in the loop: they are compile-time
+        constants of the fused decode program, so per-request values would
+        recompile per change (serve one config per replica instead)."""
         gen = gen or GenerationConfig()
-        for p in prompts:
-            if not p:
-                raise ValueError("cannot generate from an empty prompt")
-        if not self.free_slots:
-            raise RuntimeError(
-                "no free engine slots (an earlier generate_stream was "
-                "abandoned mid-stream?); create a fresh engine")
-        # pending: (req_idx, prompt, emitted) — a preempted request carries
-        # its already-emitted tokens so recompute RESUMES, never re-emits
-        pending: List[Tuple[int, List[int], List[int]]] = [
-            (i, list(p), []) for i, p in enumerate(prompts)][::-1]
         active: Dict[int, dict] = {}
+        try:
+            yield from self._serve_stream_impl(feed, gen, active)
+        finally:
+            # The loop is dead (dispatch error, consumer closed the
+            # generator, shutdown): release every slot still held so the
+            # NEXT service loop starts with the full pool — without this
+            # a single transient dispatch failure would permanently leak
+            # the active requests' slots and KV blocks.
+            for slot in list(active):
+                del active[slot]
+                self._release(slot)
+
+    def _serve_stream_impl(self, feed, gen: GenerationConfig,
+                           active: Dict[int, dict]
+                           ) -> Iterator[Tuple[Any, Optional[int], bool]]:
+        # pending: (req_id, prompt, emitted, max_new) — a preempted request
+        # carries its already-emitted tokens so recompute RESUMES, never
+        # re-emits
+        pending: List[Tuple[Any, List[int], List[int], int]] = []
+        failed: List[Any] = []  # rejected at admission; yielded as aborts
+        stopped = False
+
+        def poll(block: bool) -> None:
+            nonlocal stopped
+            if stopped:
+                return
+            new, cancelled, stop = feed(block)
+            stopped = bool(stop)
+            for item in new or ():
+                req_id, prompt, max_new = item
+                max_new = gen.max_new_tokens if max_new is None else max_new
+                prompt = list(prompt)
+                if not prompt:
+                    self.abort_reasons[req_id] = "empty prompt"
+                    failed.append(req_id)
+                    continue
+                if len(prompt) >= self.max_len:
+                    self.abort_reasons[req_id] = (
+                        f"prompt of {len(prompt)} tokens exceeds "
+                        f"max_len={self.max_len}")
+                    failed.append(req_id)
+                    continue
+                # FIFO: pending is a stack popped from the end
+                pending.insert(0, (req_id, prompt, [], max_new))
+            for req_id in cancelled or ():
+                for i, item in enumerate(pending):
+                    if item[0] == req_id:
+                        del pending[i]
+                        break
+                for slot, st in list(active.items()):
+                    if st["req"] == req_id:
+                        del active[slot]
+                        self._release(slot)
 
         def admit_all():
             """Admit pending requests in bucket-grouped waves: reserve
             slot+blocks host-side for as many as fit, then ONE batched
             prefill dispatch samples every first token on-device."""
             while pending and self.free_slots:
-                wave = []  # (req_idx, prompt, emitted, slot, prefix)
+                wave = []  # (req_id, prompt, emitted, max_new, slot, prefix)
                 bucket = None
                 while pending:
-                    req_idx, prompt, emitted = pending[-1]
+                    req_id, prompt, emitted, max_new = pending[-1]
                     # cache must hold prompt + all emitted tokens EXCEPT
                     # the last (which is the next decode input)
                     prefix = prompt + emitted[:-1] if emitted else prompt
@@ -255,14 +337,15 @@ class PagedInferenceEngine:
                     if slot is None:
                         break  # pool full: wait for frees/preemption
                     pending.pop()
-                    wave.append((req_idx, prompt, emitted, slot, prefix))
+                    wave.append((req_id, prompt, emitted, max_new, slot,
+                                 prefix))
                 if not wave:
                     return
                 n = len(wave)
                 toks = np.zeros((n, bucket), np.int32)
                 true_lens = np.zeros((n,), np.int32)
                 rows = np.zeros((n, self.max_blocks_per_seq), np.int32)
-                for i, (_, _, _, slot, prefix) in enumerate(wave):
+                for i, (_, _, _, _, slot, prefix) in enumerate(wave):
                     toks[i, :len(prefix)] = prefix
                     true_lens[i] = len(prefix)
                     rows[i] = self.block_table[slot]
@@ -275,16 +358,23 @@ class PagedInferenceEngine:
                         top_p=gen.top_p)
                     firsts = np.asarray(firsts)
                 except Exception:
-                    for _, _, _, slot, _ in wave:
+                    for _, _, _, _, slot, _ in wave:
                         self._release(slot)
                     raise
-                for (req_idx, prompt, emitted, slot, prefix), first in zip(
-                        wave, firsts):
+                # Bookkeep the WHOLE wave (register/release every slot)
+                # before yielding anything: a consumer closing the
+                # generator at a yield must find each reserved slot
+                # either released or in `active` (which the outer
+                # finally releases) — yielding mid-bookkeeping would
+                # leak the not-yet-registered slots forever.
+                first_tokens = []
+                for (req_id, prompt, emitted, max_new, slot,
+                     prefix), first in zip(wave, firsts):
                     self.lengths[slot] = len(prefix)
                     tok = int(first)
-                    if not emitted:
+                    fresh = not emitted
+                    if fresh:
                         emitted = [tok]
-                        yield req_idx, tok
                     else:
                         # recompute path: discard the re-sampled token;
                         # the request continues from its original last
@@ -292,35 +382,53 @@ class PagedInferenceEngine:
                         tok = emitted[-1]
                     done = ((gen.eos_token_id is not None
                              and tok == gen.eos_token_id)
-                            or len(emitted) >= gen.max_new_tokens
+                            or len(emitted) >= max_new
                             or self.lengths[slot] + 1 >= self.max_len)
+                    if fresh:
+                        first_tokens.append((req_id, tok, done))
                     if done:
                         self._release(slot)
                         continue
-                    active[slot] = {"req": req_idx, "prompt": prompt,
-                                    "emitted": emitted, "current": tok}
+                    active[slot] = {"req": req_id, "prompt": prompt,
+                                    "emitted": emitted, "current": tok,
+                                    "max_new": max_new}
+                yield from first_tokens
 
-        yield from admit_all()
-        while active or pending:
+        poll(block=True)
+        while True:
+            while failed:
+                yield failed.pop(), None, True
+            yield from admit_all()
+            self.peak_active = max(self.peak_active, len(active))
             if not active:
-                # admission control guarantees an admitted request fits;
-                # reaching here means the pool cannot hold even one
-                raise RuntimeError(
-                    "paged pool deadlock: no active requests but pending "
-                    "work; increase n_blocks")
+                if pending:
+                    # admission made no progress with EVERY slot free: the
+                    # head request alone exceeds the pool. Reject it
+                    # instead of deadlocking the whole service loop.
+                    req_id, prompt, emitted, _ = pending.pop()
+                    self.abort_reasons[req_id] = (
+                        f"paged pool too small for a {len(prompt)}-token "
+                        f"prompt (n_blocks={self.n_blocks}); increase "
+                        "n_blocks")
+                    yield req_id, None, True
+                    continue
+                if stopped:
+                    return
+                poll(block=True)
+                continue
             # grow every active slot to cover the next chunk; preempt the
             # youngest request (fewest emitted tokens) until it fits.
             # The chunk covers each slot's full remaining budget when the
             # pool allows (one dispatch for the whole generation); the
             # pool-capacity loop below shrinks it if blocks run short.
             need = max(
-                min(gen.max_new_tokens - len(active[s]["emitted"]),
+                min(active[s]["max_new"] - len(active[s]["emitted"]),
                     self.max_len - 1 - int(self.lengths[s]))
                 for s in active)
             # slots can free mid-chunk (EOS, budget variance): cap the
-            # chunk whenever requests are waiting so admission stays
-            # responsive
-            if pending:
+            # chunk whenever requests are waiting — or could still arrive
+            # (live feed) — so admission stays responsive
+            if pending or not stopped:
                 need = min(need, self.decode_chunk)
             steps = 1
             while steps < max(1, need):
@@ -345,21 +453,31 @@ class PagedInferenceEngine:
                             slot, int(self.lengths[slot]) + steps + 1)
                     continue
                 if len(active) == 1:
-                    raise RuntimeError(
+                    # the lone request outgrew the whole pool mid-decode:
+                    # abort it (a serving replica must survive this)
+                    (slot, st), = active.items()
+                    del active[slot]
+                    self._release(slot)
+                    self.abort_reasons[st["req"]] = (
                         "paged pool exhausted by a single request; "
                         "increase n_blocks or lower max_new_tokens")
+                    yield st["req"], None, True
+                    break
                 victim = min(active, key=lambda s: len(active[s]["emitted"]))
                 st = active.pop(victim)
                 self.preemptions += 1
-                pending.append((st["req"], st["prompt"], st["emitted"]))
+                pending.append((st["req"], st["prompt"], st["emitted"],
+                                st["max_new"]))
                 self._release(victim)
+            if not active:
+                continue
             tokens = np.zeros((self.max_batch, 1), np.int32)
             budget = np.zeros(self.max_batch, np.int32)
             act = np.zeros(self.max_batch, bool)
             for slot, st in active.items():
                 tokens[slot, 0] = st["current"]
                 budget[slot] = min(
-                    gen.max_new_tokens - len(st["emitted"]),
+                    st["max_new"] - len(st["emitted"]),
                     self.max_len - 1 - int(self.lengths[slot]))
                 act[slot] = budget[slot] > 0
             lengths = jnp.asarray(self.lengths)
@@ -388,16 +506,46 @@ class PagedInferenceEngine:
                     st["current"] = token
                     done = ((gen.eos_token_id is not None
                              and token == gen.eos_token_id)
-                            or len(st["emitted"]) >= gen.max_new_tokens
+                            or len(st["emitted"]) >= st["max_new"]
                             or self.lengths[slot] + 1 >= self.max_len)
-                    yield st["req"], token
+                    yield st["req"], token, done
                     if done:
                         del active[slot]
                         finished.append(slot)
             for slot in finished:
                 self._release(slot)
+            poll(block=False)
             if finished or (pending and self.free_slots):
                 yield from admit_all()
+
+    def generate_stream(
+        self,
+        prompts: List[List[int]],
+        gen: Optional[GenerationConfig] = None,
+    ) -> Iterator[Tuple[int, int]]:
+        """Yields (request_index, token_id) as tokens are produced
+        (block-at-a-time: see InferenceEngine.generate_stream). One-shot
+        wrapper over serve_stream with the whole batch fed up front."""
+        gen = gen or GenerationConfig()
+        for p in prompts:
+            if not p:
+                raise ValueError("cannot generate from an empty prompt")
+            self._bucket_for(len(p))  # raises on prompts beyond max_len
+        if not self.free_slots:
+            raise RuntimeError(
+                "no free engine slots (an earlier generate_stream was "
+                "abandoned mid-stream?); create a fresh engine")
+        batch = [(i, list(p), None) for i, p in enumerate(prompts)]
+
+        def feed(_block: bool):
+            out, batch[:] = list(batch), []
+            return out, (), True
+
+        for req_idx, token, _done in self.serve_stream(feed, gen):
+            if token is None:
+                raise RuntimeError(
+                    self.abort_reasons.pop(req_idx, "request aborted"))
+            yield req_idx, token
 
     def generate(self, prompts: List[List[int]],
                  gen: Optional[GenerationConfig] = None) -> List[List[int]]:

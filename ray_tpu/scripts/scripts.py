@@ -365,6 +365,94 @@ def cmd_serve(args) -> int:
     return 0
 
 
+def cmd_llm(args) -> int:
+    """`ray-tpu llm status`: live serving health of every serve.llm app —
+    per-replica queue depth / batch occupancy / preemptions plus the
+    cluster-merged TTFT & TPOT percentiles (the numbers that say whether
+    the service is keeping up, before clients notice)."""
+    _connect(args)
+    import ray_tpu
+    from ray_tpu.serve import context as serve_ctx
+    from ray_tpu.serve.llm import metrics as llm_metrics
+
+    if args.llm_cmd != "status":
+        print(f"unknown llm subcommand {args.llm_cmd!r}", file=sys.stderr)
+        return 1
+    try:
+        controller = serve_ctx.get_controller()
+    except RuntimeError:
+        print("Serve is not running.")
+        return 1
+    apps = llm_metrics.find_llm_apps(controller)
+    if not apps:
+        print("no serve.llm applications deployed "
+              "(see serve.llm.build_llm_app)")
+        return 0
+    scraped = llm_metrics.collect_llm_metrics()
+    out = {"replicas_scraped": scraped, "applications": {}}
+    for app, names in apps.items():
+        info = {"engine_deployment": names["engine"],
+                "deployment_status": ray_tpu.get(
+                    controller.get_deployment_status.remote(
+                        app, names["engine"])),
+                "replicas": [], "router": None}
+        for h in ray_tpu.get(controller.get_replica_handles.remote(
+                app, names["engine"])):
+            try:
+                info["replicas"].append(ray_tpu.get(
+                    h.handle_request.remote("get_stats", (), {}),
+                    timeout=10))
+            except Exception as e:  # noqa: BLE001 — replica mid-restart
+                info["replicas"].append({"error": str(e)[:200]})
+        for h in ray_tpu.get(controller.get_replica_handles.remote(
+                app, names["ingress"])):
+            try:
+                info["router"] = ray_tpu.get(
+                    h.handle_request.remote("get_router_stats", (), {}),
+                    timeout=10)
+                break
+            except Exception as e:  # noqa: BLE001
+                info["router"] = {"error": str(e)[:200]}
+        out["applications"][app] = info
+    out["metrics"] = llm_metrics.serving_summary()
+    if args.json:
+        print(json.dumps(out, indent=2, default=str))
+        return 0
+    for app, info in out["applications"].items():
+        st = info["deployment_status"]
+        print(f"app {app!r}: engine={info['engine_deployment']} "
+              f"[{st.get('status')}] replicas="
+              f"{st.get('replicas')}/{st.get('target_replicas')}")
+        for rs in info["replicas"]:
+            if "error" in rs:
+                print(f"  replica: unreachable ({rs['error']})")
+                continue
+            eng = rs.get("engine", {})
+            print(f"  replica: queue={rs.get('queue_depth')} "
+                  f"in-flight={rs.get('outstanding_requests')} "
+                  f"done={rs.get('finished_requests')} "
+                  f"slots={eng.get('active_slots')}/{eng.get('max_batch')} "
+                  f"preemptions={eng.get('preemptions', 0)}")
+        router = info.get("router") or {}
+        if router and "error" not in router:
+            print(f"  router: assigned={router.get('assigned_total')} "
+                  f"outstanding_tokens={router.get('outstanding_tokens')} "
+                  f"shed={router.get('shed_total')} "
+                  f"sessions={router.get('sessions')}")
+    m = out["metrics"]
+    for name, label in (("ttft_s", "TTFT"), ("tpot_s", "TPOT")):
+        for dep, qs in (m.get(name) or {}).items():
+            print(f"{label} [{dep}]: "
+                  f"p50={qs.get(0.5, 0) * 1e3:.1f}ms "
+                  f"p99={qs.get(0.99, 0) * 1e3:.1f}ms "
+                  f"(n={qs.get('count', 0)})")
+    print(f"tokens_generated={m.get('tokens_generated', 0):.0f} "
+          f"preemptions={m.get('preemptions', 0):.0f} "
+          f"shed={m.get('requests_shed', 0):.0f} "
+          f"requests={m.get('requests', {})}")
+    return 0
+
+
 def cmd_logs(args) -> int:
     """Tail worker logs across the cluster (reference: `ray logs` /
     dashboard log routes; data comes from each raylet's
@@ -816,6 +904,13 @@ def main(argv=None) -> int:
     sp.add_argument("config", nargs="?", help="JSON config (deploy)")
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_serve)
+
+    sp = sub.add_parser("llm", help="LLM serving status (serve.llm apps)")
+    sp.add_argument("llm_cmd", choices=["status"])
+    sp.add_argument("--address")
+    sp.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    sp.set_defaults(fn=cmd_llm)
 
     sp = sub.add_parser("logs", help="tail worker logs across the cluster")
     sp.add_argument("--address")

@@ -491,11 +491,17 @@ class ServeController:
 
     def _autoscale(self) -> None:
         """Default policy (reference: serve/autoscaling_policy.py:12):
-        target = ceil(total_ongoing / target_ongoing_requests), clamped.
-        The per-replica ongoing counts are also cached for the long-poll
-        metrics piggyback (probe-free routing). Metric RPCs fan out and
-        are harvested with ONE bounded wait so a single wedged replica
-        cannot stall the control loop 2s at a time."""
+        target = ceil(total_load / target_ongoing_requests), clamped.
+        Per-replica load = max(ongoing requests, `queue_depth` reported
+        by the replica's callable via get_autoscaling_metrics) — an LLM
+        engine's admission backlog is demand the request counter can
+        undercount, but the two overlap (a queued streaming request IS
+        an ongoing call parked on its first token), so max, not sum:
+        summing would double-count every queued stream and persistently
+        over-scale. The per-replica loads are also cached for the
+        long-poll metrics piggyback (probe-free routing). Metric RPCs
+        fan out and are harvested with ONE bounded wait so a single
+        wedged replica cannot stall the control loop 2s at a time."""
         with self._lock:
             all_states = list(self._deployments.values())
             probes = [(s, r, r.handle.get_metrics.remote())
@@ -515,7 +521,9 @@ class ServeController:
                     continue
                 try:
                     m = ray_tpu.get(ref, timeout=0.1)
-                    ongoing[r.replica_id] = m["num_ongoing_requests"]
+                    ongoing[r.replica_id] = max(
+                        m["num_ongoing_requests"],
+                        int(m.get("queue_depth", 0) or 0))
                 except Exception:  # noqa: BLE001
                     pass
         live_ids = {r.replica_id for s in all_states for r in s.replicas}

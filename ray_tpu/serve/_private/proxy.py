@@ -32,6 +32,18 @@ def _close_generator(gen) -> None:
         logger.debug("generator close failed", exc_info=True)
 
 
+def _http_status_of(e: BaseException) -> int:
+    """Replica exceptions can carry an HTTP status (e.g. serve.llm's
+    LLMOverloadedError.status_code = 429 for load shedding). Task errors
+    arrive wrapped (RayTaskError subclassing the cause, with .cause the
+    original), so check both levels; anything unmarked is a 500."""
+    for exc in (e, getattr(e, "cause", None)):
+        status = getattr(exc, "status_code", None)
+        if isinstance(status, int) and 400 <= status < 600:
+            return status
+    return 500
+
+
 class ProxyActor:
     def __init__(self, host: str = "127.0.0.1", port: int = 8000):
         self._host = host
@@ -142,9 +154,6 @@ class ProxyActor:
                     logger.exception("streaming route failed")
                     return web.Response(status=500, text=str(e))
                 it = iter(gen)
-                stream = web.StreamResponse()
-                stream.enable_chunked_encoding()
-                await stream.prepare(request)
 
                 def next_chunk():
                     try:
@@ -152,9 +161,37 @@ class ProxyActor:
                     except StopIteration:
                         return _SENTINEL
 
+                # Pull the FIRST chunk before committing the status: a
+                # replica that rejects up front (load shed → 429, bad
+                # request → 400, raise before the first yield → 5xx)
+                # must produce a real HTTP error, not a 200 that
+                # truncates. Only failures AFTER the first chunk are
+                # signaled in-band.
                 try:
+                    first = await loop.run_in_executor(None, next_chunk)
+                except Exception as e:  # noqa: BLE001 — pre-stream failure
+                    logger.exception("streaming request rejected")
+                    await loop.run_in_executor(None, _close_generator, gen)
+                    return web.Response(
+                        status=_http_status_of(e),
+                        text=str(getattr(e, "cause", None) or e))
+                stream = web.StreamResponse()
+                if flags.get("sse"):
+                    stream.content_type = "text/event-stream"
+                    stream.headers["Cache-Control"] = "no-cache"
+                    stream.headers["X-Accel-Buffering"] = "no"
+                stream.enable_chunked_encoding()
+                try:
+                    await stream.prepare(request)
+                except Exception:  # noqa: BLE001 — client gone pre-commit
+                    # stop the replica-side generator before propagating:
+                    # nobody will ever consume its chunks
+                    await loop.run_in_executor(None, _close_generator, gen)
+                    raise
+
+                try:
+                    chunk = first
                     while True:
-                        chunk = await loop.run_in_executor(None, next_chunk)
                         if chunk is _SENTINEL:
                             break
                         if isinstance(chunk, bytes):
@@ -164,6 +201,7 @@ class ProxyActor:
                         else:
                             chunk = (json.dumps(chunk) + "\n").encode()
                         await stream.write(chunk)
+                        chunk = await loop.run_in_executor(None, next_chunk)
                 except Exception as e:  # noqa: BLE001 — mid-stream failure
                     # status is already committed; signal the error in-band
                     # instead of masking it as a clean end-of-stream. The
@@ -191,9 +229,10 @@ class ProxyActor:
             try:
                 response = await loop.run_in_executor(
                     None, lambda: handle.remote(arg).result(timeout_s=60))
-            except Exception as e:  # noqa: BLE001 — surface as 500
+            except Exception as e:  # noqa: BLE001 — surface as status
                 logger.exception("request failed")
-                return web.Response(status=500, text=str(e))
+                return web.Response(status=_http_status_of(e),
+                                    text=str(getattr(e, "cause", None) or e))
             if isinstance(response, bytes):
                 return web.Response(body=response)
             if isinstance(response, str):

@@ -53,11 +53,24 @@ class ReplicaActor:
         return self._num_ongoing
 
     def get_metrics(self) -> Dict[str, Any]:
-        return {
+        out = {
             "replica_id": self._replica_id,
             "num_ongoing_requests": self._num_ongoing,
             "num_processed": self._num_processed,
         }
+        # User callables can report load the request counter can't see
+        # (e.g. an LLM engine's admission backlog): merged here so the
+        # controller's autoscaler and the routers' piggybacked load
+        # scores both account for it.
+        hook = getattr(self._callable, "get_autoscaling_metrics", None)
+        if callable(hook):
+            try:
+                extra = hook()
+                if isinstance(extra, dict):
+                    out.update(extra)
+            except Exception:  # noqa: BLE001 — user hook must not break
+                pass            # the control loop
+        return out
 
     def check_health(self) -> bool:
         user_check = getattr(self._callable, "check_health", None)

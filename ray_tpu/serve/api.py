@@ -263,6 +263,13 @@ def run(app: Application, *, name: str = "default", route_prefix: str = "/",
             call_target is not None
             and (_inspect.isgeneratorfunction(call_target)
                  or _inspect.isasyncgenfunction(call_target))),
+        # streamed chunks are Server-Sent Events: the proxy sets
+        # text/event-stream and anti-buffering headers
+        "sse": bool(getattr(root_fc, "__serve_sse__", False)),
+        # serve.llm apps: name of the engine deployment backing this
+        # ingress, so any process can discover LLM apps (CLI/dashboard
+        # metric collection) from the controller alone
+        "llm_engine": getattr(root_fc, "__serve_llm_engine__", None),
     }
     ray_tpu.get(controller.deploy_application.remote(
         name, deployments, app.root.deployment.name, route_prefix,

@@ -223,6 +223,89 @@ def get_or_create_histogram(name: str, description: str = "",
                      tag_keys=tag_keys)
 
 
+def snapshot_metrics(prefix: str) -> List[Dict]:
+    """Serializable CUMULATIVE snapshot of every registered metric whose
+    name starts with `prefix`. Counterpart of merge_metrics_snapshot: a
+    worker process snapshots its registry, ships it over an RPC, and the
+    aggregating process merges it — how per-replica serving metrics
+    (serve/llm) reach the driver's prometheus_text() and dashboard."""
+    with _registry_lock:
+        metrics = [m for m in _registry if m._name.startswith(prefix)]
+    out: List[Dict] = []
+    for m in metrics:
+        entry: Dict = {
+            "name": m._name,
+            "type": type(m).__name__,
+            "description": m._description,
+            "tag_keys": list(m._tag_keys),
+        }
+        with m._lock:
+            if isinstance(m, Histogram):
+                entry["boundaries"] = list(m._boundaries)
+                entry["samples"] = [
+                    (list(key), list(counts), m._sums[key], m._totals[key])
+                    for key, counts in m._counts.items()]
+            else:
+                entry["samples"] = [(list(k), v)
+                                    for k, v in m._values.items()]
+        out.append(entry)
+    return out
+
+
+def merge_metrics_snapshot(snap: List[Dict],
+                           prev: Optional[List[Dict]] = None) -> None:
+    """Merge a remote process's cumulative snapshot into THIS process's
+    registry. Counters and histogram buckets add the DELTA against `prev`
+    (the last snapshot merged from the same source — without it a
+    periodic collector would double-count every scrape); gauges take the
+    latest value."""
+    prev_by_name = {e["name"]: e for e in (prev or [])}
+    for entry in snap:
+        name, kind = entry["name"], entry["type"]
+        tag_keys = tuple(entry.get("tag_keys") or ())
+        prev_samples = {
+            tuple(tuple(t) for t in s[0]): s
+            for s in (prev_by_name.get(name) or {}).get("samples", [])}
+        m = get_metric(name)
+        if kind == "Gauge":
+            if m is None:
+                m = Gauge(name, entry.get("description", ""), tag_keys)
+            for tags_items, value in entry["samples"]:
+                with m._lock:
+                    m._values[tuple(tuple(t) for t in tags_items)] = value
+        elif kind == "Counter":
+            if m is None:
+                m = Counter(name, entry.get("description", ""), tag_keys)
+            for tags_items, value in entry["samples"]:
+                key = tuple(tuple(t) for t in tags_items)
+                base = prev_samples.get(key)
+                delta = value - (base[1] if base else 0.0)
+                if delta > 0:
+                    with m._lock:
+                        m._values[key] += delta
+        elif kind == "Histogram":
+            if not isinstance(m, Histogram):
+                m = Histogram(name, entry.get("description", ""),
+                              boundaries=entry.get("boundaries"),
+                              tag_keys=tag_keys)
+            for tags_items, counts, total_sum, total in entry["samples"]:
+                key = tuple(tuple(t) for t in tags_items)
+                base = prev_samples.get(key)
+                d_counts = [c - (base[1][i] if base else 0)
+                            for i, c in enumerate(counts)]
+                d_sum = total_sum - (base[2] if base else 0.0)
+                d_total = total - (base[3] if base else 0)
+                if d_total <= 0 or any(c < 0 for c in d_counts):
+                    continue  # source restarted: skip this scrape's delta
+                with m._lock:
+                    buckets = m._counts.setdefault(
+                        key, [0] * (len(m._boundaries) + 1))
+                    for i, c in enumerate(d_counts[:len(buckets)]):
+                        buckets[i] += c
+                    m._sums[key] += d_sum
+                    m._totals[key] += d_total
+
+
 def prometheus_text() -> str:
     """All registered metrics in Prometheus exposition format."""
     lines: List[str] = []

@@ -128,6 +128,7 @@ class _GeneratorState:
     total: Optional[int] = None      # known once the task completes
     reported: int = 0
     error: Optional[ser.SerializedObject] = None
+    released: bool = False           # consumer closed the stream
     cv: threading.Condition = field(default_factory=threading.Condition)
 
 
@@ -1540,7 +1541,7 @@ class CoreWorker:
         while True:
             if (not any(st.leases for st in self._key_states.values())
                     and not any(
-                        rec.queue and rec.state not in ("ALIVE", "DEAD")
+                        rec.queue and rec.state != "DEAD"
                         for rec in self._actors.values())):
                 # Nothing to reap or sweep: park until a lease is taken or
                 # an actor call queues behind a non-ALIVE actor.
@@ -1563,7 +1564,12 @@ class CoreWorker:
         ALIVE/DEAD event (subscription raced the publish) hangs every
         caller of the queued tasks forever."""
         for rec in list(self._actors.values()):
-            if not rec.queue or rec.state in ("ALIVE", "DEAD"):
+            if not rec.queue or rec.state == "DEAD":
+                continue
+            if rec.state == "ALIVE":
+                # ALIVE with parked specs: a flush was lost to the
+                # first-contact thread race — push them now.
+                await self._flush_actor_queue(rec)
                 continue
             try:
                 info = await self._gcs.call_async(
@@ -1603,6 +1609,11 @@ class CoreWorker:
         elif status == "cancelled":
             err = exc.TaskCancelledError(spec.task_id)
             self._store_error_for_task(spec, err)
+            if spec.is_streaming_generator():
+                # wake any consumer still parked in next_generator_item —
+                # the error entry alone never signals the stream's cv
+                self._finish_generator(spec.task_id, 0,
+                                       error=ser.serialize(err))
             self._finalize_task(spec, "CANCELLED")
         else:  # application error
             if spec.retry_exceptions and pending.retries_left > 0:
@@ -1937,6 +1948,16 @@ class CoreWorker:
                 if info.state == ActorState.ALIVE:
                     rec.state = "ALIVE"
                     rec.address = info.address
+                    # First-contact race: a CONCURRENT submit from another
+                    # thread can find this record while the GCS call above
+                    # was in flight, see a non-ALIVE state, and park its
+                    # spec on rec.queue — and its own async poll then
+                    # no-ops because the state is ALIVE by the time it
+                    # lands. Whoever completes the first-contact poll owns
+                    # flushing the queue, or those parked calls hang
+                    # forever (observed: concurrent streaming calls from
+                    # serve.llm's router threads).
+                    self._lt.submit(self._flush_actor_queue(rec))
                 elif info.state == ActorState.DEAD:
                     rec.state = "DEAD"
                     rec.death_cause = info.death_cause
@@ -2018,6 +2039,11 @@ class CoreWorker:
             if info.num_restarts > rec.incarnation:
                 rec.incarnation = info.num_restarts
                 rec.seq = 0
+            asyncio.ensure_future(self._flush_actor_queue(rec))
+        elif (info.state == ActorState.ALIVE and rec.state == "ALIVE"
+              and rec.queue):
+            # Already ALIVE but specs are parked (another thread queued
+            # them while the first-contact poll was in flight): flush.
             asyncio.ensure_future(self._flush_actor_queue(rec))
         elif info.state == ActorState.DEAD and rec.state != "DEAD":
             rec.state = "DEAD"
@@ -2601,9 +2627,16 @@ class CoreWorker:
             return True
         index = payload["index"]
         oid = ObjectID.for_task_return(task_id, index + 1)
-        self.reference_counter.add_owned(oid, self.address)
-        self._store_return(oid, payload["item"])
+        # Own/store and publish under the stream's cv: release_generator
+        # marks `released` under the same lock before freeing, so an item
+        # report racing close() either lands before the release snapshot
+        # (and is freed by it) or sees the flag and drops the item —
+        # never an owned-but-orphaned object.
         with state.cv:
+            if state.released:
+                return False
+            self.reference_counter.add_owned(oid, self.address)
+            self._store_return(oid, payload["item"])
             state.reported = max(state.reported, index + 1)
             state.cv.notify_all()
         return True
@@ -2638,10 +2671,47 @@ class CoreWorker:
                 return ObjectRef(oid, owner_address=self.address)
             if state.error is not None:
                 err, _ = ser.deserialize(state.error)
+                state.released = True
+                reported = state.reported
                 self._generators.pop(task_id, None)
+                # items reported past the consumer's cursor were owned at
+                # report time and have no other holder — free them, or an
+                # errored/cancelled stream leaks them (same cleanup as
+                # release_generator, for the next()-observes-error path)
+                self._free_unconsumed_generator_items(
+                    task_id, consumed, reported)
                 self._raise_stored_error(err)
             self._generators.pop(task_id, None)
             return None
+
+    def _free_unconsumed_generator_items(self, task_id: TaskID,
+                                         consumed: int,
+                                         reported: int) -> None:
+        for index in range(consumed, reported):
+            oid = ObjectID.for_task_return(task_id, index + 1)
+            if self.reference_counter.owns(oid):
+                self.reference_counter.add_local_ref(oid)
+                self.reference_counter.remove_local_ref(oid)
+
+    def release_generator(self, task_id: TaskID, consumed: int) -> None:
+        """Drop an abandoned stream's owner-side state
+        (ObjectRefGenerator.close): the _generators entry, plus the
+        reported-but-unconsumed return objects — they were add_owned with
+        zero local refs when the executor reported them, so nothing else
+        will ever free them. A ref-pair bump routes through the reference
+        counter's normal zero-count path (which also clears the memory
+        store / plasma copy); items the consumer DID take stay alive
+        through the consumer's own ObjectRef."""
+        state = self._generators.pop(task_id, None)
+        if state is None:
+            return
+        with state.cv:
+            state.released = True  # in-flight item reports drop their item
+            reported = state.reported
+            if state.total is None:
+                state.total = reported  # unblock any parked consumer
+            state.cv.notify_all()
+        self._free_unconsumed_generator_items(task_id, consumed, reported)
 
     def report_generator_item(self, spec: TaskSpec, index: int, item, done: bool,
                               error: bool = False):

@@ -348,6 +348,7 @@ class Executor:
 
     def _run_generator(self, spec: TaskSpec, fn, args, kwargs) -> dict:
         """Streaming generator: report each item to the owner as produced."""
+        gen = None
         try:
             gen = fn(*args, **kwargs)
             index = 0
@@ -365,6 +366,18 @@ class Executor:
                 spec, -1, {"inline": ser.serialize(err)}, done=True, error=True
             )
             return self._error_reply(spec, e)
+        finally:
+            # Cancellation can land between yields (the injected
+            # TaskCancelledError hits report_generator_item, not the user
+            # frame): close the user generator EXPLICITLY so its cleanup
+            # (e.g. an LLM engine releasing the request's slot) runs now,
+            # not at a GC of unknown timing.
+            close = getattr(gen, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except Exception:  # noqa: BLE001 — teardown must not mask
+                    logger.debug("generator close failed", exc_info=True)
 
     # ---------------------------------------------------------------- actors
     def _run_actor_creation(self, spec: TaskSpec) -> dict:
