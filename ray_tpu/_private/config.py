@@ -162,6 +162,13 @@ _d("max_tasks_in_flight_per_worker", 1)
 # sparing every fresh actor worker a GCS function-table round trip
 _d("max_inline_function_bytes", 64 * 1024)
 
+# raylet->GCS heartbeat backoff while the GCS is unreachable: doubles per
+# consecutive failure up to the cap, with per-node seeded jitter (fraction
+# of the interval subtracted) so a restarted GCS isn't hit by a
+# synchronized reconnect storm from every node at once.
+_d("gcs_reconnect_backoff_max_s", 5.0)
+_d("gcs_reconnect_backoff_jitter", 0.5)
+
 # --- gcs ---------------------------------------------------------------------
 _d("gcs_storage_path", "")  # "" = pure in-memory; path = snapshot for restart
 _d("maximum_gcs_dead_node_cache_count", 1000)

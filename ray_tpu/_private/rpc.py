@@ -23,6 +23,8 @@ import threading
 import time
 from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
 
+from ray_tpu._private import fault_injection as _fi
+
 logger = logging.getLogger(__name__)
 
 _REQUEST = 0
@@ -167,9 +169,13 @@ class RpcServer:
     serving many roles, like the reference's asio services).
     """
 
-    def __init__(self, loop_thread: EventLoopThread, host: str = "127.0.0.1"):
+    def __init__(self, loop_thread: EventLoopThread, host: str = "127.0.0.1",
+                 label: str = ""):
         self._lt = loop_thread
         self._host = host
+        # chaos addressing: which component this endpoint serves
+        # ("gcs" / "raylet" / "driver" / "worker"); see fault_injection.py
+        self.label = label
         self._handlers: Dict[str, Callable[[Any], Awaitable[Any]]] = {}
         self._server: Optional[asyncio.AbstractServer] = None
         self.address: Optional[str] = None
@@ -251,7 +257,8 @@ class RpcServer:
                             await writer.drain()
                     continue
                 asyncio.ensure_future(
-                    self._dispatch(handler, kind, msg_id, method, payload, writer, write_lock)
+                    self._dispatch(handler, kind, msg_id, method, payload,
+                                   writer, write_lock, peer_meta)
                 )
         except (asyncio.IncompleteReadError, ConnectionResetError, BrokenPipeError):
             pass
@@ -269,9 +276,41 @@ class RpcServer:
                 except Exception:
                     logger.exception("connection-lost callback failed")
 
-    async def _dispatch(self, handler, kind, msg_id, method, payload, writer, write_lock):
+    @staticmethod
+    def _peer_id(peer_meta: Dict[str, Any], writer) -> str:
+        pid = peer_meta.get("label") or peer_meta.get("worker_id")
+        if pid:
+            return str(pid)
+        peername = writer.get_extra_info("peername")
+        return _addr_str(peername) if peername else ""
+
+    async def _dispatch(self, handler, kind, msg_id, method, payload, writer,
+                        write_lock, peer_meta=None):
         t0 = time.monotonic()
         try:
+            if _fi.PLAN is not None:
+                peer_id = self._peer_id(peer_meta or {}, writer)
+                act = await _fi.intercept(
+                    _fi.SITE_BEFORE_EXECUTE, method=method, label=self.label,
+                    peer=peer_id)
+                if act == "drop":
+                    return  # request lost before the handler: no reply ever
+                if act == "disconnect":
+                    # the request arrived but the connection dies before
+                    # anything executes (peer crash between accept and
+                    # dispatch)
+                    try:
+                        writer.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+                    return
+                if act == "duplicate":
+                    # redelivery: the handler runs an EXTRA time (reply
+                    # discarded) — flushes out non-idempotent handlers
+                    try:
+                        await handler(payload)
+                    except Exception:  # noqa: BLE001 — injected duplicate
+                        pass
             reply = await handler(payload)
             try:
                 hist = _rpc_handler_hist()
@@ -292,6 +331,31 @@ class RpcServer:
                 logger.exception("error in oneway handler %s", method)
                 return
         if kind == _REQUEST:
+            if _fi.PLAN is not None:
+                try:
+                    act = await _fi.intercept(
+                        _fi.SITE_AFTER_REPLY, method=method, label=self.label,
+                        peer=self._peer_id(peer_meta or {}, writer))
+                except Exception:  # noqa: BLE001 — injected "error" after the
+                    act = "drop"   # handler ran == the reply is lost
+                if act == "drop":
+                    return  # handler executed, reply lost: the at-most-once
+                            # ambiguity every owner/GCS retry path must survive
+                if act == "disconnect":
+                    try:
+                        writer.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+                    return
+                if act == "duplicate":
+                    # the reply frame arrives twice: the client's request-id
+                    # correlation must drop the second copy
+                    try:
+                        async with write_lock:
+                            writer.write(frame)
+                            await writer.drain()
+                    except (ConnectionResetError, BrokenPipeError):
+                        pass
             try:
                 async with write_lock:
                     writer.write(frame)
@@ -310,10 +374,15 @@ class RpcClient:
     """
 
     def __init__(self, address: str, loop_thread: EventLoopThread,
-                 peer_meta: Optional[dict] = None):
+                 peer_meta: Optional[dict] = None, label: str = ""):
         self.address = address
         self._lt = loop_thread
         self._peer_meta = peer_meta
+        # chaos addressing (fault_injection.py): `label` names the local
+        # component; `local_id` (settable once known) is its own address,
+        # used to match node-pair partitions.
+        self.label = label
+        self.local_id = label
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
         self._pending: Dict[int, asyncio.Future] = {}
@@ -387,6 +456,13 @@ class RpcClient:
                          timeout: Optional[float] = None):
         if self._closed:
             raise ConnectionLost("client closed", maybe_delivered=False)
+        act = None
+        if _fi.PLAN is not None:
+            # may sleep (delay), raise ConnectionLost (error/partition), or
+            # return a frame action applied below; zero work with no plan
+            act = await _fi.intercept(
+                _fi.SITE_CLIENT_REQUEST, method=method, label=self.label,
+                peer=self.address, local_id=self.local_id)
         try:
             await self._ensure_connected()
         except OSError as e:
@@ -395,31 +471,59 @@ class RpcClient:
         msg_id = next(self._msg_ids)
         fut = asyncio.get_event_loop().create_future()
         self._pending[msg_id] = fut
+        if act != "drop":  # "drop": frame never hits the wire — the caller
+            try:           # waits on silence, exactly like network loss
+                frame = _frame((_REQUEST, msg_id, method, payload))
+                self._writer.write(frame)
+                if act == "duplicate":
+                    self._writer.write(frame)  # peer executes it twice
+                await self._writer.drain()
+                if act == "disconnect":
+                    self._writer.close()  # reply can never arrive: pending
+                    # futures fail ConnectionLost(maybe_delivered=True)
+            except (ConnectionResetError, BrokenPipeError, AttributeError):
+                self._pending.pop(msg_id, None)
+                # maybe_delivered stays True: TCP gives no delivery receipt —
+                # the full frame may have reached (and started executing on)
+                # the peer before the local write/drain observed the reset.
+                # Only a CONNECT failure (above) proves non-delivery.
+                raise ConnectionLost(f"connection to {self.address} lost")
         try:
-            self._writer.write(_frame((_REQUEST, msg_id, method, payload)))
-            await self._writer.drain()
-        except (ConnectionResetError, BrokenPipeError, AttributeError):
+            if timeout is None:
+                return await fut
+            return await asyncio.wait_for(fut, timeout)
+        except (asyncio.TimeoutError, asyncio.CancelledError):
+            # Without this, a reply that never comes (peer wedged, chaos
+            # "drop") leaks the pending entry for the connection's whole
+            # life. A late reply after the pop is ignored by the read
+            # loop's fut-is-gone check.
             self._pending.pop(msg_id, None)
-            # maybe_delivered stays True: TCP gives no delivery receipt —
-            # the full frame may have reached (and started executing on)
-            # the peer before the local write/drain observed the reset.
-            # Only a CONNECT failure (above) proves non-delivery.
-            raise ConnectionLost(f"connection to {self.address} lost")
-        if timeout is None:
-            return await fut
-        return await asyncio.wait_for(fut, timeout)
+            raise
 
     async def send_async(self, method: str, payload: Any = None):
         """One-way message (no reply)."""
         if self._closed:
             raise ConnectionLost("client closed", maybe_delivered=False)
+        act = None
+        if _fi.PLAN is not None:
+            act = await _fi.intercept(
+                _fi.SITE_CLIENT_REQUEST, method=method, label=self.label,
+                peer=self.address, local_id=self.local_id)
         try:
             await self._ensure_connected()
         except OSError as e:
-            raise ConnectionLost(f"cannot connect to {self.address}: {e}")
+            raise ConnectionLost(f"cannot connect to {self.address}: {e}",
+                                 maybe_delivered=False)
+        if act == "drop":
+            return  # oneway frame lost in flight: sender never knows
         try:
-            self._writer.write(_frame((_ONEWAY, next(self._msg_ids), method, payload)))
+            frame = _frame((_ONEWAY, next(self._msg_ids), method, payload))
+            self._writer.write(frame)
+            if act == "duplicate":
+                self._writer.write(frame)
             await self._writer.drain()
+            if act == "disconnect":
+                self._writer.close()
         except (ConnectionResetError, BrokenPipeError, AttributeError):
             raise ConnectionLost(f"connection to {self.address} lost")
 
@@ -463,17 +567,30 @@ class RpcClient:
 class ClientPool:
     """Cache of RpcClients by address (one persistent connection per peer)."""
 
-    def __init__(self, loop_thread: EventLoopThread, peer_meta: Optional[dict] = None):
+    def __init__(self, loop_thread: EventLoopThread, peer_meta: Optional[dict] = None,
+                 label: str = ""):
         self._lt = loop_thread
         self._peer_meta = peer_meta
+        self.label = label
+        self.local_id = label  # set to the owning endpoint's address once bound
         self._clients: Dict[str, RpcClient] = {}
         self._lock = threading.Lock()
+
+    def set_local_id(self, local_id: str):
+        """Stamp chaos-partition identity on the pool and existing clients
+        (called once the owning component knows its own address)."""
+        with self._lock:
+            self.local_id = local_id
+            for client in self._clients.values():
+                client.local_id = local_id
 
     def get(self, address: str) -> RpcClient:
         with self._lock:
             client = self._clients.get(address)
             if client is None or client._closed:
-                client = RpcClient(address, self._lt, peer_meta=self._peer_meta)
+                client = RpcClient(address, self._lt, peer_meta=self._peer_meta,
+                                   label=self.label)
+                client.local_id = self.local_id
                 self._clients[address] = client
             return client
 

@@ -131,7 +131,12 @@ class TaskSpec:
     runtime_env: Optional[dict] = None
     # Actor tasks:
     actor_id: Optional[ActorID] = None
-    sequence_number: int = 0
+    # -1 = not yet assigned; stamped by the owner's actor push path per
+    # incarnation. A spec REQUEUED after a failed push keeps its number
+    # (same incarnation) so the worker's sequencing gate never sees a
+    # permanent gap — re-stamping a requeued call burned its old slot and
+    # stalled every later call 60s at the gate (chaos-harness find).
+    sequence_number: int = -1
     method_name: str = ""
     concurrency_group: str = ""
     # Actor creation:

@@ -1,0 +1,88 @@
+"""Public chaos-engineering API: deterministic, seeded fault injection.
+
+Wraps `ray_tpu._private.fault_injection` (the layer wired into the RPC
+chokepoint) for tests and operators:
+
+    import ray_tpu.chaos as chaos
+
+    plan = chaos.ChaosPlan(seed=7)
+    plan.add_rule(chaos.ChaosRule(
+        action="drop", site="after_reply", method="request_worker_lease",
+        label="raylet", times=2))
+    plan.partition("127.0.0.1:5001", "127.0.0.1:5002")
+    chaos.install(plan)          # this process only (tests)
+    ...
+    chaos.uninstall()
+    assert plan.fingerprint() == expected   # same seed => same sequence
+
+Cluster-wide, either export ``RAY_TPU_CHAOS`` (inline JSON or a path)
+before starting nodes — every process arms itself at import — or drive a
+live cluster through the GCS (`ray-tpu chaos start|stop|status`, or
+`chaos.start_cluster(...)` below).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ray_tpu._private.fault_injection import (  # noqa: F401
+    ACTIONS,
+    ENV_VAR,
+    SITE_AFTER_REPLY,
+    SITE_BEFORE_EXECUTE,
+    SITE_CLIENT_REQUEST,
+    SITE_MID_STREAM,
+    ChaosError,
+    ChaosPlan,
+    ChaosRule,
+    active_plan,
+    install,
+    load_env_plan,
+    uninstall,
+)
+
+__all__ = [
+    "ACTIONS", "ENV_VAR",
+    "SITE_AFTER_REPLY", "SITE_BEFORE_EXECUTE", "SITE_CLIENT_REQUEST",
+    "SITE_MID_STREAM",
+    "ChaosError", "ChaosPlan", "ChaosRule",
+    "active_plan", "install", "load_env_plan", "uninstall",
+    "start_cluster", "stop_cluster", "cluster_status",
+]
+
+
+def _gcs_call(gcs_address: str, method: str, payload: dict, timeout: float):
+    from ray_tpu._private.rpc import EventLoopThread, RpcClient
+
+    lt = EventLoopThread("chaos-ctl")
+    client = RpcClient(gcs_address, lt)
+    try:
+        return client.call(method, payload, timeout=timeout)
+    finally:
+        client.close()
+        lt.stop()
+
+
+def start_cluster(plan: "ChaosPlan | str", gcs_address: str,
+                  timeout: float = 30.0) -> dict:
+    """Install a plan on the GCS and every alive raylet of a live
+    cluster. `plan` may be a ChaosPlan or its JSON."""
+    plan_json = plan if isinstance(plan, str) else plan.to_json()
+    ChaosPlan.from_json(plan_json)  # fail fast on malformed input
+    return _gcs_call(gcs_address, "chaos_start", {"plan": plan_json}, timeout)
+
+
+def stop_cluster(gcs_address: str, timeout: float = 30.0) -> dict:
+    """Uninstall the plan cluster-wide; returns per-node stats."""
+    return _gcs_call(gcs_address, "chaos_stop", {}, timeout)
+
+
+def cluster_status(gcs_address: str, timeout: float = 30.0) -> dict:
+    """Plan installation state + fired-injection stats per node."""
+    return _gcs_call(gcs_address, "chaos_status", {}, timeout)
+
+
+def status() -> Optional[dict]:
+    """In-process plan stats (None when no plan is installed)."""
+    plan = active_plan()
+    return plan.stats() if plan is not None else None

@@ -261,6 +261,7 @@ class GcsActorManager:
         if spec is None or info is None or info.state == ActorState.DEAD:
             return
         attempt = 0
+        refunds = 0
         target_node: Optional[NodeID] = None
         while attempt < 60:
             info = self._actors.get(actor_id)
@@ -292,7 +293,19 @@ class GcsActorManager:
                     {"spec": spec, "grant_or_reject": False},
                     timeout=CONFIG.worker_register_timeout_s,
                 )
-            except (ConnectionLost, OSError, asyncio.TimeoutError):
+            except ConnectionLost as e:
+                if not e.maybe_delivered and refunds < 120:
+                    # The lease request provably never reached the raylet
+                    # (connect refused): nothing leased, nothing executed —
+                    # refund the attempt instead of burning the budget on
+                    # a raylet that is restarting (the health checker
+                    # removes a truly dead node from `candidates` long
+                    # before the bounded refund pool drains).
+                    attempt -= 1
+                    refunds += 1
+                await asyncio.sleep(0.2)
+                continue
+            except (OSError, asyncio.TimeoutError):
                 await asyncio.sleep(0.2)
                 continue
             if reply.get("rejected"):
@@ -322,7 +335,42 @@ class GcsActorManager:
             reply = await client.call_async(
                 "push_task", {"spec": spec}, timeout=CONFIG.rpc_call_timeout_s * 10
             )
-        except (ConnectionLost, OSError, asyncio.TimeoutError):
+        except ConnectionLost as e:
+            if not e.maybe_delivered:
+                return False  # provably never started: re-lease freely
+            # The connection died with the push possibly delivered: the
+            # worker MAY be running __init__ right now and will report
+            # itself ALIVE when it finishes (handle_report_actor_alive
+            # comes over the worker's own GCS connection, not this one).
+            # Re-leasing immediately would run __init__ a second time in
+            # another worker — double side effects for a creation that
+            # actually succeeded (flushed out by chaos `disconnect` on
+            # push_task). Wait for the actor to RESOLVE before declaring
+            # the push failed. "Resolved" must be judged against the
+            # state at push time: a restart-path push starts from
+            # RESTARTING (not PENDING_CREATION), so the test is
+            # ALIVE/DEAD/another-restart-cycle — NOT merely "state
+            # changed from PENDING_CREATION", which is instantly true
+            # mid-restart and would abandon the actor forever.
+            info = self._actors.get(actor_id)
+            restarts_at_push = info.num_restarts if info is not None else -1
+            deadline = (asyncio.get_event_loop().time()
+                        + CONFIG.worker_register_timeout_s)
+            while asyncio.get_event_loop().time() < deadline:
+                info = self._actors.get(actor_id)
+                if info is None or info.state in (ActorState.ALIVE,
+                                                  ActorState.DEAD):
+                    return True  # __init__ reported in, or a death path
+                    # terminally resolved it — nothing left to push
+                if info.num_restarts != restarts_at_push:
+                    # the worker died and _on_actor_failure already
+                    # spawned the next restart cycle's _schedule_actor:
+                    # that task owns scheduling now; bowing out prevents
+                    # two schedulers racing __init__ pushes
+                    return True
+                await asyncio.sleep(0.25)
+            return False
+        except (OSError, asyncio.TimeoutError):
             return False
         if reply.get("status") == "ok":
             # Worker reports itself alive (handle_report_actor_alive) with its

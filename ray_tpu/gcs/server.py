@@ -503,8 +503,9 @@ class GcsServer:
     def __init__(self, host: str = "127.0.0.1", storage_path: str = "",
                  external_store: str = ""):
         self._lt = EventLoopThread("gcs-io")
-        self._server = RpcServer(self._lt, host)
-        self._pool = ClientPool(self._lt)
+        self._server = RpcServer(self._lt, host, label="gcs")
+        self._pool = ClientPool(self._lt, peer_meta={"label": "gcs"},
+                                label="gcs")
         self.publisher = ps.Publisher(self._lt)
         # Set when the external-store failure detector fires; a supervisor
         # (or the standalone main) watches this to take the GCS down so it
@@ -559,7 +560,11 @@ class GcsServer:
         self._server.register("gcs_ping", self._handle_ping)
         self._server.register("publish_logs", self._handle_publish_logs)
         self._server.register("report_error", self._handle_report_error)
+        self._server.register("chaos_start", self._handle_chaos_start)
+        self._server.register("chaos_stop", self._handle_chaos_stop)
+        self._server.register("chaos_status", self._handle_chaos_status)
         self.address = self._server.start(port)
+        self._pool.set_local_id(self.address)
         self._health_task = self._lt.submit(self.node_manager.health_check_loop())
         # resume actors/PGs that were mid-schedule when a previous GCS
         # incarnation stopped (no-ops on a fresh start)
@@ -599,6 +604,66 @@ class GcsServer:
         # gang actors restart with their group elsewhere.
         await self.pg_manager.on_node_death(nid)
         return {"status": "ok", "raylet": reply}
+
+    # -- chaos control plane (`ray-tpu chaos`, ray_tpu.chaos) -----------------
+
+    def _alive_raylets(self):
+        return [(nid, info.raylet_address)
+                for nid, info in self.node_manager._nodes.items()
+                if info.alive]
+
+    async def _chaos_fanout(self, method: str, payload: dict) -> dict:
+        """Relay a chaos op to every alive raylet CONCURRENTLY; per-node
+        outcome map. Unreachable/partitioned nodes report as errors and
+        cost one shared 5s timeout, not 5s each — `chaos stop` on a
+        half-partitioned cluster must not leave faults firing for
+        N_dead*5s while it crawls the node list."""
+        nodes = self._alive_raylets()
+
+        async def _one(addr):
+            try:
+                return await self._pool.get(addr).call_async(
+                    method, dict(payload, scope="local"), timeout=5.0)
+            except Exception as e:  # noqa: BLE001 — chaos bites its own tail
+                return {"status": "unreachable", "error": str(e)}
+
+        replies = await asyncio.gather(*(_one(addr) for _, addr in nodes))
+        return {nid.hex()[:12]: reply
+                for (nid, _), reply in zip(nodes, replies)}
+
+    async def _handle_chaos_start(self, payload):
+        from ray_tpu._private import fault_injection as fi
+
+        plan_json = payload["plan"]
+        plan = fi.ChaosPlan.from_json(plan_json)  # validate before fan-out
+        nodes = {}
+        if payload.get("scope", "cluster") == "cluster":
+            nodes = await self._chaos_fanout("chaos_start",
+                                             {"plan": plan_json})
+        fi.install(plan)  # install on the GCS LAST so the fan-out itself
+        # is never subject to the plan it is installing
+        return {"status": "installed", "seed": plan.seed,
+                "rules": len(plan.rules), "nodes": nodes}
+
+    async def _handle_chaos_stop(self, payload):
+        from ray_tpu._private import fault_injection as fi
+
+        plan = fi.uninstall()  # uninstall FIRST so the fan-out runs clean
+        nodes = {}
+        if payload.get("scope", "cluster") == "cluster":
+            nodes = await self._chaos_fanout("chaos_stop", {})
+        return {"status": "uninstalled",
+                "stats": plan.stats() if plan else None, "nodes": nodes}
+
+    async def _handle_chaos_status(self, payload):
+        from ray_tpu._private import fault_injection as fi
+
+        plan = fi.active_plan()
+        nodes = {}
+        if payload.get("scope", "cluster") == "cluster":
+            nodes = await self._chaos_fanout("chaos_status", {})
+        return {"installed": plan is not None,
+                "stats": plan.stats() if plan else None, "nodes": nodes}
 
     async def _handle_subscribe(self, payload):
         channel = payload["channel"]

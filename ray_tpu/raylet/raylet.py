@@ -20,6 +20,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import os
+import random
 import threading
 import time
 from dataclasses import dataclass, field
@@ -120,8 +121,9 @@ class Raylet:
         self.gcs_address = gcs_address
         self.is_head = is_head
         self._lt = EventLoopThread(f"raylet-{self.node_id.hex()[:6]}")
-        self._server = RpcServer(self._lt, host)
-        self._pool = ClientPool(self._lt)
+        self._server = RpcServer(self._lt, host, label="raylet")
+        self._pool = ClientPool(self._lt, peer_meta={"label": "raylet"},
+                                label="raylet")
         self._gcs = None  # RpcClient, set on start
         if resources is None:
             resources = {}
@@ -190,6 +192,10 @@ class Raylet:
         self._draining = False
         self.drain_reason = ""
         self.drain_complete = threading.Event()
+        # Heartbeat-backoff jitter source: seeded by node id so one node's
+        # retry schedule is reproducible while different nodes stay
+        # decorrelated (no synchronized reconnect storm on GCS restart).
+        self._backoff_rng = random.Random(self.node_id.binary())
         # set by `python -m ray_tpu start` so a drained worker PROCESS
         # exits instead of lingering unregistered
         self._exit_on_drain = False
@@ -218,7 +224,10 @@ class Raylet:
         self.worker_pool.store_socket = self.store_socket
         from ray_tpu._private.rpc import RpcClient
 
-        self._gcs = RpcClient(self.gcs_address, self._lt)
+        self._gcs = RpcClient(self.gcs_address, self._lt,
+                              peer_meta={"label": "raylet"}, label="raylet")
+        self._gcs.local_id = self.address
+        self._pool.set_local_id(self.address)
         info = NodeInfo(
             node_id=self.node_id,
             raylet_address=self.address,
@@ -1178,6 +1187,32 @@ class Raylet:
                 target=lambda: (time.sleep(0.05), os._exit(0)),
                 daemon=True).start()
 
+    async def handle_chaos_start(self, payload):
+        """Install a fault-injection plan in this raylet's process
+        (message-level chaos; see _private/fault_injection.py). Workers
+        spawned AFTER installation inherit it via the RAY_TPU_CHAOS env
+        only if the operator exported it; in-process installs cover the
+        raylet/GCS/driver side of every worker conversation."""
+        from ray_tpu._private import fault_injection as fi
+
+        plan = fi.install(fi.ChaosPlan.from_json(payload["plan"]))
+        return {"status": "installed", "seed": plan.seed,
+                "rules": len(plan.rules)}
+
+    async def handle_chaos_stop(self, payload):
+        from ray_tpu._private import fault_injection as fi
+
+        plan = fi.uninstall()
+        return {"status": "uninstalled",
+                "stats": plan.stats() if plan else None}
+
+    async def handle_chaos_status(self, payload):
+        from ray_tpu._private import fault_injection as fi
+
+        plan = fi.active_plan()
+        return {"installed": plan is not None,
+                "stats": plan.stats() if plan else None}
+
     async def handle_die(self, payload):
         """Chaos RPC (`ray-tpu kill-random-node`): ungraceful PROCESS death
         — the GCS discovers it via missed heartbeats, exercising the same
@@ -1291,6 +1326,7 @@ class Raylet:
     # ------------------------------------------------------- background loops
     async def _heartbeat_loop(self):
         period = CONFIG.heartbeat_period_ms / 1000.0
+        gcs_failures = 0  # consecutive unreachable-GCS heartbeats
         while True:
             try:
                 if self._pending_spill_uris or self._freed_spill_keys:
@@ -1350,9 +1386,26 @@ class Raylet:
                     # would keep routing leases to.
                     if not self._draining:
                         await self._reconnect_gcs()
+                gcs_failures = 0
             except (ConnectionLost, OSError, asyncio.TimeoutError):
-                pass
-            await asyncio.sleep(period)
+                gcs_failures += 1
+            if gcs_failures:
+                # Exponential backoff with jitter while the GCS is
+                # unreachable: at a fixed period, every raylet of an
+                # N-node cluster would hammer a restarting GCS in
+                # lockstep (N reconnect attempts per 250ms, all phase-
+                # aligned with the moment it went down). Doubling per
+                # consecutive failure caps the aggregate load, and the
+                # per-node jitter (seeded by node id: deterministic per
+                # node, decorrelated across nodes) spreads the
+                # re-registration burst when the GCS comes back.
+                base = min(period * (2 ** min(gcs_failures, 10)),
+                           CONFIG.gcs_reconnect_backoff_max_s)
+                jitter = CONFIG.gcs_reconnect_backoff_jitter
+                await asyncio.sleep(
+                    base * (1.0 - jitter * self._backoff_rng.random()))
+            else:
+                await asyncio.sleep(period)
 
     async def _reconnect_gcs(self) -> None:
         info = NodeInfo(

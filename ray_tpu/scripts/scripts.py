@@ -569,6 +569,87 @@ def cmd_kill_random_node(args) -> int:
     return 0
 
 
+def cmd_chaos(args) -> int:
+    """Message-level chaos control (`ray-tpu chaos start|stop|status`):
+    installs a deterministic, seeded fault-injection plan on the GCS and
+    every alive raylet (see ray_tpu.chaos / _private/fault_injection.py).
+    Builds on `kill-random-node` — that kills processes, this drops,
+    delays, duplicates, errors, or disconnects individual RPCs."""
+    import json as _json
+
+    from ray_tpu import chaos
+
+    gcs_addr = args.address or os.environ.get("RT_ADDRESS")
+    if not gcs_addr:
+        print("--address (or RT_ADDRESS) is required", file=sys.stderr)
+        return 1
+    if args.chaos_cmd == "start":
+        if args.plan:
+            with open(args.plan) as f:
+                plan_json = f.read()
+            if args.seed is not None:
+                doc = _json.loads(plan_json)
+                doc["seed"] = args.seed
+                plan_json = _json.dumps(doc)
+        elif args.kill_point:
+            # quick single-rule plan without a file: kill a matching
+            # process at a lifecycle point (before_execute / after_reply /
+            # mid_stream), e.g. --kill-point mid_stream --label worker
+            plan_json = chaos.ChaosPlan(
+                seed=args.seed or 0,
+                rules=[chaos.ChaosRule(
+                    action="kill", site=args.kill_point,
+                    method=args.method, label=args.label,
+                    p=args.p, after=args.after, times=args.times or 1)],
+            ).to_json()
+        else:
+            print("chaos start needs --plan FILE or --kill-point SITE",
+                  file=sys.stderr)
+            return 1
+        if not args.yes:
+            print("this will inject faults into live cluster traffic; "
+                  "pass --yes to proceed")
+            return 1
+        # Cluster install covers the GCS + raylet PROCESSES only; worker
+        # (and driver) processes arm from RAY_TPU_CHAOS at their own
+        # start. A rule addressed at those endpoints would report
+        # "installed" yet never fire — say so instead of silently no-oping.
+        plan_obj = chaos.ChaosPlan.from_json(plan_json)
+        from fnmatch import fnmatchcase
+
+        def _cluster_reachable(r):
+            # A cluster install arms GCS + raylet processes at the three
+            # transport sites; a rule reaches them only if BOTH its label
+            # and site globs can match there. Default "*" globs match, so
+            # only rules pinned to worker/driver (or mid_stream-only)
+            # warn.
+            return (any(fnmatchcase(lb, r.label) for lb in ("gcs", "raylet"))
+                    and any(fnmatchcase(s, r.site)
+                            for s in (chaos.SITE_CLIENT_REQUEST,
+                                      chaos.SITE_BEFORE_EXECUTE,
+                                      chaos.SITE_AFTER_REPLY)))
+
+        unreachable = [r for r in plan_obj.rules if not _cluster_reachable(r)]
+        if unreachable:
+            print(f"WARNING: {len(unreachable)} rule(s) target worker/"
+                  "driver endpoints (label worker/driver or site "
+                  "mid_stream). `chaos start` installs on GCS + raylet "
+                  "processes only — those rules fire there ONLY if the "
+                  "label glob also matches gcs/raylet. To arm workers, "
+                  f"export {chaos.ENV_VAR} before starting nodes (workers "
+                  "inherit it at spawn).", file=sys.stderr)
+        reply = chaos.start_cluster(plan_json, gcs_addr)
+        print(_json.dumps(reply, indent=2, default=str))
+        return 0 if reply.get("status") == "installed" else 1
+    if args.chaos_cmd == "stop":
+        reply = chaos.stop_cluster(gcs_addr)
+        print(_json.dumps(reply, indent=2, default=str))
+        return 0
+    reply = chaos.cluster_status(gcs_addr)
+    print(_json.dumps(reply, indent=2, default=str))
+    return 0
+
+
 def cmd_client_server(args) -> int:
     """Run the client proxy (reference: `ray start --ray-client-server-port`
     / util/client/server): remote drivers connect with
@@ -950,6 +1031,25 @@ def main(argv=None) -> int:
     sp.add_argument("--address")
     sp.add_argument("--yes", action="store_true")
     sp.set_defaults(fn=cmd_kill_random_node)
+
+    sp = sub.add_parser(
+        "chaos", help="message-level fault injection (seeded, deterministic)")
+    sp.add_argument("chaos_cmd", choices=["start", "stop", "status"])
+    sp.add_argument("--address")
+    sp.add_argument("--plan", help="JSON chaos plan file (see README)")
+    sp.add_argument("--seed", type=int, help="override the plan's seed")
+    sp.add_argument("--kill-point",
+                    choices=["before_execute", "after_reply", "mid_stream"],
+                    help="one-rule plan: kill a process at this point")
+    sp.add_argument("--method", default="*",
+                    help="RPC method glob for --kill-point")
+    sp.add_argument("--label", default="*",
+                    help="endpoint label glob (gcs|raylet|driver|worker)")
+    sp.add_argument("--p", type=float, default=1.0)
+    sp.add_argument("--after", type=int, default=0)
+    sp.add_argument("--times", type=int)
+    sp.add_argument("--yes", action="store_true")
+    sp.set_defaults(fn=cmd_chaos)
 
     sp = sub.add_parser("client-server",
                         help="run the client proxy for remote drivers")

@@ -27,6 +27,7 @@ from typing import Optional
 from ray_tpu.serve.llm.engine import (  # noqa: F401
     LLMEngineReplica,
     LLMOverloadedError,
+    LLMReplicaUnavailableError,
 )
 from ray_tpu.serve.llm.metrics import (  # noqa: F401
     collect_llm_metrics,
@@ -101,6 +102,7 @@ __all__ = [
     "BadRequestError",
     "LLMEngineReplica",
     "LLMOverloadedError",
+    "LLMReplicaUnavailableError",
     "LLMRouter",
     "build_llm_app",
     "collect_llm_metrics",

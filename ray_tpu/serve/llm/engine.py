@@ -51,6 +51,17 @@ class LLMOverloadedError(Exception):
     status_code = 429
 
 
+class LLMReplicaUnavailableError(Exception):
+    """The engine replica serving a stream died (or became unreachable)
+    AFTER the first token was emitted, so the router cannot silently
+    retry — replaying the prompt on another replica would re-emit tokens
+    the client already consumed. HTTP ingress maps it to 503; clients
+    retry idempotently at the request level. Pre-first-token failures
+    never surface this: the router fails over to another replica."""
+
+    status_code = 503
+
+
 class _Abort:
     def __init__(self, reason: str):
         self.reason = reason

@@ -31,14 +31,37 @@ engine slot.
 from __future__ import annotations
 
 import json
+import logging
 import random
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import ray_tpu
+from ray_tpu import exceptions as exc
+from ray_tpu._private.rpc import ConnectionLost
 from ray_tpu.serve.llm import metrics as llm_metrics
-from ray_tpu.serve.llm.engine import LLMOverloadedError
+from ray_tpu.serve.llm.engine import (
+    LLMOverloadedError,
+    LLMReplicaUnavailableError,
+)
+
+# Transport/liveness failures that mean "the replica (or its node) is
+# gone", as opposed to an application error raised by the engine itself.
+# Only these trigger failover/typed-error handling; everything else
+# propagates untouched.
+_REPLICA_FAILURES = (
+    ConnectionLost,
+    exc.RayActorError,          # ActorDiedError / ActorUnavailableError
+    exc.WorkerCrashedError,
+    exc.RaySystemError,
+    exc.OwnerDiedError,
+    exc.NodeDiedError,
+    exc.ObjectLostError,
+)
+
+# Pre-first-token retries against OTHER replicas before giving up.
+_MAX_FAILOVERS = 2
 
 # Shorter than the generic router's 30s long-poll: the piggybacked load
 # metrics feed the SHED decision here, and listen_for_change only returns
@@ -46,6 +69,8 @@ from ray_tpu.serve.llm.engine import LLMOverloadedError
 # with 429 long after a burst drained. 3s caps load staleness at roughly
 # the controller's own 2s metric refresh.
 _LONG_POLL_TIMEOUT_S = 3.0
+
+logger = logging.getLogger(__name__)
 
 
 class BadRequestError(Exception):
@@ -130,10 +155,22 @@ class LLMRouter:
     def _score(self, rid: str) -> float:
         return self._out_tokens.get(rid, 0) + 64 * self._base_load.get(rid, 0)
 
-    def _choose(self, session_id: Optional[str],
-                cost: int) -> Tuple[str, Any]:
+    def _choose(self, session_id: Optional[str], cost: int,
+                excluded: frozenset = frozenset()) -> Tuple[str, Any]:
         if not self._have_replicas.is_set():
-            if not self._have_replicas.wait(timeout=30.0):
+            # On a FAILOVER retry (the caller just watched a replica die)
+            # an empty replica set is replica death, not slow startup:
+            # give the controller one short beat to push a replacement,
+            # then surface the typed 503 — never the 30s cold-start wait
+            # plus a generic RuntimeError the retry path would otherwise
+            # hit when the LAST replica died pre-first-token.
+            if excluded:
+                if not self._have_replicas.wait(timeout=5.0):
+                    raise LLMReplicaUnavailableError(
+                        f"all replicas of {self._deployment!r} are gone "
+                        f"({len(excluded)} failed this request); retry "
+                        "once replacements come up")
+            elif not self._have_replicas.wait(timeout=30.0):
                 raise RuntimeError(
                     f"no engine replicas for {self._deployment!r} after 30s")
         now = time.monotonic()
@@ -155,7 +192,11 @@ class LLMRouter:
                 raise LLMOverloadedError(
                     f"serving queue depth {agg} >= bound "
                     f"{self._shed_queue_depth}; retry later")
-            replicas = list(self._replicas)
+            replicas = [r for r in self._replicas if r[0] not in excluded]
+            if not replicas:
+                raise LLMReplicaUnavailableError(
+                    f"all {len(self._replicas)} replica(s) of "
+                    f"{self._deployment!r} failed this request")
             by_id = dict(replicas)
             choice = None
             if session_id is not None:
@@ -197,6 +238,29 @@ class LLMRouter:
             if rid in self._out_tokens and self._out_tokens[rid] > 0:
                 self._out_tokens[rid] -= 1
 
+    def _evict_replica(self, rid: str) -> None:
+        """A stream to `rid` died: drop it from the local view NOW so new
+        assignments (and session affinity) stop routing to it, instead of
+        waiting a long-poll round for the controller to notice. If the
+        failure was transient the next controller push re-adds it.
+
+        The outstanding-token/request counters are deliberately KEPT:
+        other streams to the same replica may still be in flight, and
+        their _pay_token/_release on exit must settle against their own
+        charges — popping here would let a survivor drain charges that
+        belong to requests assigned after a re-add (under-counting the
+        balance score and the 429 shed bound). A replica that never
+        returns has its counters pruned by the long-poll update once the
+        controller drops it from the live set."""
+        with self._lock:
+            self._replicas = [r for r in self._replicas if r[0] != rid]
+            self._base_load.pop(rid, None)
+            self._sessions = {sid: (r, exp)
+                              for sid, (r, exp) in self._sessions.items()
+                              if r != rid}
+            if not self._replicas:
+                self._have_replicas.clear()
+
     # -- request path --------------------------------------------------------
 
     @staticmethod
@@ -227,31 +291,70 @@ class LLMRouter:
                 "session_id": str(sid) if sid is not None else None}
 
     def _token_stream(self, rq: Dict[str, Any]):
-        """Assign + stream: yields token ids; releases charges on exit."""
+        """Assign + stream: yields token ids; releases charges on exit.
+
+        Replica-death failover: a stream whose replica dies BEFORE the
+        first token retries transparently on a different replica (the
+        client observes nothing); one that dies AFTER the first token
+        raises the typed LLMReplicaUnavailableError (503) — replaying on
+        another replica would re-emit tokens the client already has.
+        Either way the dead replica's outstanding-token accounting is
+        released and it is evicted from the local replica view."""
         cost = len(rq["prompt"]) + (rq["max_new_tokens"]
                                     or self._default_max_new)
-        rid, handle = self._choose(rq["session_id"], cost)
-        gen = handle.handle_request_streaming.options(
-            num_returns="streaming").remote(
-                "generate_stream", (rq["prompt"],),
-                {"max_new_tokens": rq["max_new_tokens"]})
-        produced = 0
-        try:
-            for ref in gen:
-                token = ray_tpu.get(ref)
-                produced += 1
-                if produced <= cost:
-                    # a request never pays back more than it was charged:
-                    # the replica counter is shared, and over-paying
-                    # would erase OTHER requests' outstanding charges
-                    self._pay_token(rid)
-                yield token
-        finally:
+        failed: set = set()
+        for failover in range(_MAX_FAILOVERS + 1):
+            rid, handle = self._choose(rq["session_id"], cost,
+                                       excluded=frozenset(failed))
+            produced = 0
+            gen = None
             try:
-                gen.close()  # no-op when exhausted; cancels when abandoned
-            except Exception:  # noqa: BLE001 — teardown
-                pass
-            self._release(rid, cost - produced)
+                try:
+                    # .remote() itself raises ActorDiedError when the
+                    # owner already learned of the death — same failover
+                    # treatment as a mid-stream transport failure
+                    gen = handle.handle_request_streaming.options(
+                        num_returns="streaming").remote(
+                            "generate_stream", (rq["prompt"],),
+                            {"max_new_tokens": rq["max_new_tokens"]})
+                    for ref in gen:
+                        token = ray_tpu.get(ref)
+                        produced += 1
+                        if produced <= cost:
+                            # a request never pays back more than it was
+                            # charged: the replica counter is shared, and
+                            # over-paying would erase OTHER requests'
+                            # outstanding charges
+                            self._pay_token(rid)
+                        yield token
+                    return
+                finally:
+                    # Runs on success, failure, AND consumer abandonment
+                    # (GeneratorExit): the outstanding charge is always
+                    # released, dead replica or not.
+                    if gen is not None:
+                        try:
+                            gen.close()  # no-op when exhausted; cancels
+                        except Exception:  # noqa: BLE001 — teardown
+                            pass
+                    self._release(rid, cost - produced)
+            except _REPLICA_FAILURES as e:
+                failed.add(rid)
+                self._evict_replica(rid)
+                logger.warning(
+                    "replica %s died serving a stream (%s tokens in, "
+                    "attempt %d): %s", rid, produced, failover + 1, e)
+                if produced > 0:
+                    raise LLMReplicaUnavailableError(
+                        f"engine replica {rid} became unavailable after "
+                        f"{produced} streamed token(s); retry the request"
+                    ) from e
+                if failover >= _MAX_FAILOVERS:
+                    raise LLMReplicaUnavailableError(
+                        f"engine replica {rid} (and {failover} failover "
+                        f"replica(s) before it) became unavailable before "
+                        "the first token") from e
+                # pre-first-token: silently fail over to another replica
 
     def stream_tokens(self, request: Any):
         """Raw token stream (handle callers / tests): yields ints."""

@@ -28,6 +28,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu import exceptions as exc
+from ray_tpu._private import fault_injection as _fi
 from ray_tpu._private import serialization as ser
 from ray_tpu._private.config import CONFIG
 from ray_tpu._private.ids import (
@@ -195,14 +196,23 @@ class CoreWorker:
 
         self.mode = mode
         self.namespace = namespace
+        # Chaos plans normally arm at fault_injection import; zygote-forked
+        # workers inherited the zygote's (possibly pre-plan) module state,
+        # so re-check the env here — still free when RAY_TPU_CHAOS is unset.
+        if _fi.PLAN is None:
+            _fi.load_env_plan()
         self.worker_id = WorkerID.from_random()
         self.node_id = node_id
         self._lt = EventLoopThread(f"cw-{self.worker_id.hex()[:6]}")
-        self._server = RpcServer(self._lt, host)
-        self._peers = ClientPool(self._lt, peer_meta={"worker_id": self.worker_id.hex()})
-        self._gcs = RpcClient(gcs_address, self._lt)
+        self._server = RpcServer(self._lt, host, label=mode)
+        self._peers = ClientPool(
+            self._lt,
+            peer_meta={"worker_id": self.worker_id.hex(), "label": mode},
+            label=mode)
+        self._gcs = RpcClient(gcs_address, self._lt, label=mode)
         self.gcs_address = gcs_address
-        self._raylet = RpcClient(raylet_address, self._lt) if raylet_address else None
+        self._raylet = (RpcClient(raylet_address, self._lt, label=mode)
+                        if raylet_address else None)
         self.raylet_address = raylet_address
         self.memory_store = MemoryStore()
         self.reference_counter = ReferenceCounter(
@@ -251,6 +261,11 @@ class CoreWorker:
         # -- connect --
         self._register_handlers()
         self.address_str = self._server.start(0)
+        # chaos partitions match on endpoint addresses (fault_injection.py)
+        self._peers.set_local_id(self.address_str)
+        self._gcs.local_id = self.address_str
+        if self._raylet is not None:
+            self._raylet.local_id = self.address_str
         _mark("server_start")
         if job_id is None:
             if mode == "driver":
@@ -1371,6 +1386,7 @@ class CoreWorker:
         target = await self._resolve_route(sample_spec)
         spillback = 0
         warned = 0.0
+        refused = blips = 0
         while not self._shutdown:
             if not st.pending:
                 return
@@ -1387,7 +1403,39 @@ class CoreWorker:
                      "spillback_count": spillback},
                     timeout=None,
                 )
-            except ConnectionLost:
+                refused = blips = 0
+            except ConnectionLost as e:
+                # Same-target retries apply only to the LOCAL raylet,
+                # where the alternative below is failing every queued
+                # task; a dead REMOTE target already has a free, instant
+                # fallback (re-route through the local raylet). The two
+                # budgets are SEPARATE counters: refused retries during a
+                # raylet restart must not consume the reset-blip budget
+                # needed the moment it comes back up.
+                if target == self.raylet_address:
+                    if not e.maybe_delivered and refused < 25:
+                        # The request provably never reached the raylet
+                        # (connect refused — e.g. it is restarting, or a
+                        # transient partition healed): retry after a beat
+                        # instead of escalating straight to "local raylet
+                        # lost". Bounded: a persistently refusing raylet
+                        # still escalates below after ~5s.
+                        refused += 1
+                        self._peers.invalidate(target)
+                        await asyncio.sleep(0.2)
+                        continue
+                    if e.maybe_delivered and blips < 3:
+                        # Connection reset with the request possibly in
+                        # flight. Leases are safe to re-ask (an orphaned
+                        # grant is reclaimed by the worker idle timeout),
+                        # and a reset on a healthy raylet (GCS restart
+                        # ripples, chaos disconnect) must not fail every
+                        # queued task; a DEAD raylet turns into connect-
+                        # refused on the retry and escalates above.
+                        blips += 1
+                        self._peers.invalidate(target)
+                        await asyncio.sleep(0.1)
+                        continue
                 if target == self.raylet_address:
                     new_local = await self._refresh_local_raylet()
                     if new_local is None or new_local == target:
@@ -1688,6 +1736,14 @@ class CoreWorker:
         for oid in spec.return_ids():
             self.memory_store.put_serialized(oid, s, value=error, is_exception=True)
             self._release_deps(oid)
+        if spec.is_streaming_generator():
+            # Wake consumers parked in next_generator_item: the error
+            # entries above never signal the stream's cv, so every
+            # terminal failure path that forgot an explicit
+            # _finish_generator (actor push failure, _fail_actor_queue)
+            # hung streaming consumers forever — chaos-harness find.
+            # Idempotent with the call sites that already finish.
+            self._finish_generator(spec.task_id, 0, error=s)
 
     def _finalize_task(self, spec: TaskSpec, state: str,
                        stages: Optional[dict] = None):
@@ -2070,9 +2126,18 @@ class CoreWorker:
         # and taking rec.seq there would hand later-submitted calls
         # earlier sequence numbers (the worker's sequencing gate executes
         # strictly by seq — ordered actors would run calls out of order).
+        # A spec requeued after a failed push to THIS incarnation keeps
+        # its number: its slot was already burned, and a fresh one would
+        # leave a permanent gap the worker's gate waits 60s on for every
+        # later call. Specs carried across an incarnation bump re-stamp
+        # from the reset counter (the new worker's gate starts at 0).
         for spec in specs:
-            spec.sequence_number = rec.seq
-            rec.seq += 1
+            if (spec.sequence_number < 0
+                    or getattr(spec, "_seq_incarnation", None)
+                    != rec.incarnation):
+                spec.sequence_number = rec.seq
+                rec.seq += 1
+                spec._seq_incarnation = rec.incarnation
             self._record_task_event(spec, "RUNNING")
         cap = max(1, CONFIG.max_tasks_per_push)
         # Chunking: a batched RPC replies once, AFTER every call in it
@@ -2119,13 +2184,20 @@ class CoreWorker:
                     "push_task_w", [spec_to_wire(s) for s in chunk],
                     timeout=None)
                 replies = [reply_from_wire(t) for t in wire]
-            except Exception:  # noqa: BLE001 — ConnectionLost, remote
-                # handler error, reply decode failure: all mean these
-                # specs got no usable reply. Route them ALL through the
-                # push-failure path; letting any exception escape would
-                # blow up the gather and strand the OTHER chunks' specs.
+            except ConnectionLost as e:
+                # maybe_delivered=False (connect refused: the actor worker
+                # process is already gone) means NOTHING in this chunk
+                # executed — the failure path may requeue without burning
+                # at-most-once retry budget.
                 logger.debug("actor push chunk failed", exc_info=True)
-                return chunk
+                return [(s, not e.maybe_delivered) for s in chunk]
+            except Exception:  # noqa: BLE001 — remote handler error,
+                # reply decode failure: these specs got no usable reply.
+                # Route them ALL through the push-failure path; letting
+                # any exception escape would blow up the gather and
+                # strand the OTHER chunks' specs.
+                logger.debug("actor push chunk failed", exc_info=True)
+                return [(s, False) for s in chunk]
             per_call = (time.monotonic() - t0) / max(1, len(chunk))
             for spec, reply in zip(chunk, replies):
                 # prefer the worker-measured execution time: the round
@@ -2156,10 +2228,22 @@ class CoreWorker:
             await self._on_actor_push_failure(rec, failed)
 
     async def _on_actor_push_failure(self, rec: _ActorRecord,
-                                     specs: List[TaskSpec]):
+                                     failures: List[Tuple[TaskSpec, bool]]):
+        """`failures`: (spec, undelivered) pairs. undelivered=True means
+        the push provably never reached the worker (ConnectionLost with
+        maybe_delivered=False): the call did not execute, so requeueing it
+        is safe for ANY method and must not consume the at-most-once
+        retry budget (bounded by undelivered_failures so a persistently
+        refusing address still terminates)."""
         retry_specs = []
-        for spec in specs:
+        for spec, undelivered in failures:
             pending = self._pending_tasks.get(spec.task_id)
+            if pending is not None and undelivered:
+                pending.undelivered_failures += 1
+                if pending.undelivered_failures <= 20:
+                    retry_specs.append(spec)
+                    continue
+                # persistent refusals: fall through to the budgeted path
             if pending is not None and pending.retries_left > 0:
                 pending.retries_left -= 1
                 retry_specs.append(spec)
@@ -2719,6 +2803,19 @@ class CoreWorker:
         owner = spec.owner_address
         client = self._peers.get(owner.rpc_address)
         try:
+            if _fi.PLAN is not None:
+                # `mid_stream` lifecycle point: a chaos plan can kill/drop/
+                # delay this worker between generator items — the replica-
+                # dies-mid-decode scenario serve.llm failover is tested
+                # against. Inside the try: an injected ConnectionLost must
+                # take the SAME OwnerDiedError translation as a real
+                # owner-connection failure, not surface as a novel
+                # application error no production path can produce.
+                act = _fi.intercept_sync(
+                    _fi.SITE_MID_STREAM, method=spec.function_name,
+                    label=self.mode, peer=owner.rpc_address)
+                if act == "drop":
+                    return  # this item report is lost in flight
             client.send(
                 "report_generator_item",
                 {"task_id": spec.task_id, "index": index, "item": item,

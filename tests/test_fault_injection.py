@@ -1,0 +1,527 @@
+"""Deterministic fault injection (ISSUE 3 tentpole) + the recovery paths
+it exercises.
+
+The seeded chaos layer (_private/fault_injection.py) intercepts every
+RPC at the rpc.py chokepoint; these tests pin down (a) the injection
+semantics themselves — determinism, rule addressing, the
+`maybe_delivered` contract on every injected failure mode — and (b) the
+framework recovery paths driven end-to-end under message-level faults:
+undelivered actor pushes retrying without burning at-most-once budget,
+lease requests surviving reply loss, lineage reconstruction under
+dropped messages, actor restart across a raylet<->GCS partition, and a
+GCS restart with in-flight traffic. No real process kills: nodes are
+in-process raylets (cluster_utils.Cluster), so everything runs in
+tier-1; `-m chaos` selects just this tier.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import chaos
+from ray_tpu._private.config import CONFIG
+from ray_tpu._private.rpc import (
+    ConnectionLost,
+    EventLoopThread,
+    RpcClient,
+    RpcServer,
+    find_free_port,
+    wait_until,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_plan():
+    chaos.uninstall()
+    yield
+    chaos.uninstall()
+
+
+# --------------------------------------------------------------------------
+# plan semantics (pure, no cluster)
+# --------------------------------------------------------------------------
+
+def _drive(plan, n=200):
+    for i in range(n):
+        plan.decide("client_request", method=f"m{i % 4}", label="driver",
+                    peer="127.0.0.1:1")
+    return plan.fingerprint()
+
+
+def test_same_seed_reproduces_identical_fault_sequence():
+    """Acceptance: same seed => identical fault sequence across runs."""
+    def rules():
+        return [chaos.ChaosRule(action="drop", method="m1", p=0.5),
+                chaos.ChaosRule(action="delay", method="m*", p=0.25,
+                                delay_s=0.0)]
+
+    fp1 = _drive(chaos.ChaosPlan(seed=11, rules=rules()))
+    fp2 = _drive(chaos.ChaosPlan(seed=11, rules=rules()))
+    assert fp1 == fp2
+    assert len(fp1) > 0
+    # 200 coin flips per rule: different seeds collide with p ~ 2^-100
+    fp3 = _drive(chaos.ChaosPlan(seed=12, rules=rules()))
+    assert fp3 != fp1
+
+
+def test_rule_addressing_after_times_and_labels():
+    plan = chaos.ChaosPlan(seed=0, rules=[
+        chaos.ChaosRule(action="drop", method="lease*", label="raylet",
+                        after=2, times=2),
+    ])
+    fired = []
+    for i in range(8):
+        fired.append(bool(plan.decide("before_execute", method="lease_x",
+                                      label="raylet", peer="w1")))
+    # skips matches 0-1 (after=2), fires on 2 and 3 (times=2), then stops
+    assert fired == [False, False, True, True, False, False, False, False]
+    # label / method globs filter
+    assert not plan.decide("before_execute", method="lease_x", label="gcs")
+    assert not plan.decide("before_execute", method="push", label="raylet")
+
+
+def test_plan_json_roundtrip_and_env_install(tmp_path, monkeypatch):
+    plan = chaos.ChaosPlan(seed=3, rules=[
+        chaos.ChaosRule(action="error", method="push_task*", times=1,
+                        maybe_delivered=True)])
+    plan.partition("127.0.0.1:1", "127.0.0.1:2")
+    clone = chaos.ChaosPlan.from_json(plan.to_json())
+    assert clone.seed == 3
+    assert clone.rules[0].action == "error"
+    assert clone.rules[0].maybe_delivered is True
+    assert clone.partitions == [("127.0.0.1:1", "127.0.0.1:2")]
+
+    # env install: inline JSON and @file forms (RAY_TPU_CHAOS)
+    monkeypatch.setenv(chaos.ENV_VAR, plan.to_json())
+    assert chaos.load_env_plan() is not None
+    assert chaos.active_plan().seed == 3
+    chaos.uninstall()
+    path = tmp_path / "plan.json"
+    path.write_text(plan.to_json())
+    monkeypatch.setenv(chaos.ENV_VAR, f"@{path}")
+    assert chaos.load_env_plan() is not None
+    chaos.uninstall()
+    # malformed plans must not break process bring-up
+    monkeypatch.setenv(chaos.ENV_VAR, "{not json")
+    assert chaos.load_env_plan() is None
+    assert chaos.active_plan() is None
+
+
+# --------------------------------------------------------------------------
+# transport semantics on a raw RpcServer/RpcClient pair
+# --------------------------------------------------------------------------
+
+@pytest.fixture()
+def rpc_pair():
+    lt = EventLoopThread("fi-test")
+    server = RpcServer(lt, label="raylet")
+    calls = []
+
+    async def echo(payload):
+        calls.append(payload)
+        return payload
+
+    server.register("echo", echo)
+    addr = server.start(0)
+    client = RpcClient(addr, lt, label="driver")
+    client.local_id = "driver@test"
+    yield server, client, addr, calls
+    client.close()
+    server.stop()
+    lt.stop()
+
+
+def test_client_request_drop_times_out_without_executing(rpc_pair):
+    _, client, _, calls = rpc_pair
+    chaos.install(chaos.ChaosPlan(seed=1, rules=[
+        chaos.ChaosRule(action="drop", site="client_request",
+                        method="echo", times=1)]))
+    with pytest.raises(Exception):  # asyncio.TimeoutError via sync facade
+        client.call("echo", "lost", timeout=0.4)
+    assert "lost" not in calls  # never reached the server
+    assert client.call("echo", "ok", timeout=5) == "ok"  # rule exhausted
+    plan = chaos.uninstall()
+    assert plan.fingerprint() == (("client_request", "echo", "drop"),)
+
+
+def test_after_reply_drop_executes_but_loses_the_reply(rpc_pair):
+    """The at-most-once ambiguity: handler ran, caller saw nothing."""
+    _, client, _, calls = rpc_pair
+    chaos.install(chaos.ChaosPlan(seed=1, rules=[
+        chaos.ChaosRule(action="drop", site="after_reply", method="echo",
+                        label="raylet", times=1)]))
+    with pytest.raises(Exception):
+        client.call("echo", "ghost", timeout=0.4)
+    assert "ghost" in calls  # executed server-side
+    assert client.call("echo", "ok", timeout=5) == "ok"
+
+
+def test_injected_error_and_disconnect_carry_maybe_delivered(rpc_pair):
+    """Satellite: unit coverage for BOTH ConnectionLost.maybe_delivered
+    values. `error` models connect-refused (provably undelivered);
+    `disconnect` kills the connection after the frame went out (the peer
+    may be executing it)."""
+    _, client, _, calls = rpc_pair
+    chaos.install(chaos.ChaosPlan(seed=1, rules=[
+        chaos.ChaosRule(action="error", site="client_request",
+                        method="echo", times=1, maybe_delivered=False)]))
+    with pytest.raises(ConnectionLost) as e1:
+        client.call("echo", 1, timeout=5)
+    assert e1.value.maybe_delivered is False
+
+    chaos.install(chaos.ChaosPlan(seed=1, rules=[
+        chaos.ChaosRule(action="disconnect", site="client_request",
+                        method="echo", times=1)]))
+    with pytest.raises(ConnectionLost) as e2:
+        client.call("echo", 2, timeout=5)
+    assert e2.value.maybe_delivered is True
+    assert client.call("echo", 3, timeout=5) == 3  # reconnects cleanly
+
+
+def test_real_connect_refused_is_provably_undelivered():
+    """The organic (non-injected) flag: a connect failure must report
+    maybe_delivered=False so callers retry budget-free."""
+    lt = EventLoopThread("fi-refused")
+    client = RpcClient(f"127.0.0.1:{find_free_port()}", lt)
+    try:
+        with pytest.raises(ConnectionLost) as e:
+            client.call("echo", 1, timeout=2)
+        assert e.value.maybe_delivered is False
+    finally:
+        client.close()
+        lt.stop()
+
+
+def test_duplicate_executes_handler_twice(rpc_pair):
+    _, client, _, calls = rpc_pair
+    chaos.install(chaos.ChaosPlan(seed=1, rules=[
+        chaos.ChaosRule(action="duplicate", site="client_request",
+                        method="echo", times=1)]))
+    assert client.call("echo", "dup", timeout=5) == "dup"
+    assert wait_until(lambda: calls.count("dup") == 2, timeout=5)
+
+
+def test_partition_blocks_both_ways_and_heals(rpc_pair):
+    _, client, addr, _ = rpc_pair
+    plan = chaos.install(chaos.ChaosPlan(seed=1))
+    plan.partition("driver@test", addr)
+    with pytest.raises(ConnectionLost) as e:
+        client.call("echo", 1, timeout=5)
+    assert e.value.maybe_delivered is False  # never sent
+    plan.heal("driver@test", addr)
+    assert client.call("echo", 2, timeout=5) == 2
+
+
+def test_server_delay_is_observable(rpc_pair):
+    _, client, _, _ = rpc_pair
+    chaos.install(chaos.ChaosPlan(seed=1, rules=[
+        chaos.ChaosRule(action="delay", site="before_execute",
+                        method="echo", times=1, delay_s=0.3)]))
+    t0 = time.monotonic()
+    assert client.call("echo", 1, timeout=5) == 1
+    assert time.monotonic() - t0 >= 0.29
+    t0 = time.monotonic()
+    assert client.call("echo", 2, timeout=5) == 2  # exhausted: fast again
+    assert time.monotonic() - t0 < 0.25
+
+
+# --------------------------------------------------------------------------
+# recovery paths under injected faults (in-process cluster, no real kills)
+# --------------------------------------------------------------------------
+
+def test_actor_call_survives_undelivered_push_without_retry_budget():
+    """Satellite (maybe_delivered audit): an actor push that provably
+    never reached the worker requeues WITHOUT consuming the at-most-once
+    budget — a method with zero retries still completes exactly once."""
+    ray_tpu.init(num_cpus=2)
+    try:
+        @ray_tpu.remote
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def bump(self):
+                self.n += 1
+                return self.n
+
+        c = Counter.remote()
+        assert ray_tpu.get(c.bump.remote(), timeout=60) == 1  # warmed up
+        chaos.install(chaos.ChaosPlan(seed=5, rules=[
+            chaos.ChaosRule(action="error", site="client_request",
+                            method="push_task_w", label="driver", times=1,
+                            maybe_delivered=False)]))
+        # would raise ActorUnavailableError if the budget path ran
+        assert ray_tpu.get(c.bump.remote(), timeout=60) == 2
+        plan = chaos.uninstall()
+        assert ("client_request", "push_task_w", "error") in plan.fingerprint()
+        assert ray_tpu.get(c.bump.remote(), timeout=60) == 3  # exactly-once
+    finally:
+        chaos.uninstall()
+        ray_tpu.shutdown()
+
+
+def test_task_survives_lease_connection_blip():
+    """A reply-lost disconnect on request_worker_lease must not fail the
+    queued tasks: the submitter re-asks the (healthy) raylet."""
+    ray_tpu.init(num_cpus=2)
+    try:
+        chaos.install(chaos.ChaosPlan(seed=5, rules=[
+            chaos.ChaosRule(action="disconnect", site="client_request",
+                            method="request_worker_lease", label="driver",
+                            times=1)]))
+
+        @ray_tpu.remote
+        def f(x):
+            return x * 2
+
+        assert ray_tpu.get(f.remote(21), timeout=60) == 42
+        plan = chaos.uninstall()
+        assert ("client_request", "request_worker_lease",
+                "disconnect") in plan.fingerprint()
+    finally:
+        chaos.uninstall()
+        ray_tpu.shutdown()
+
+
+def test_lineage_reconstruction_under_message_loss():
+    """Satellite: lineage reconstruction (core_worker._try_reconstruct)
+    converges while chaos drops/errors its messages. Deterministic: the
+    same seeded plan fires the same faults each run."""
+    import numpy as np
+
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 2})
+    try:
+        n2 = cluster.add_node(num_cpus=2)
+        cluster.wait_for_nodes()
+        cluster.connect()
+
+        @ray_tpu.remote(max_retries=3)
+        def payload(i):
+            import numpy as _np
+
+            return _np.full((512, 256), i, dtype=_np.float32)  # > inline cap
+
+        ref = payload.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                n2.node_id.hex(), soft=True)).remote(7)
+        first = ray_tpu.get(ref, timeout=60)
+        assert float(first[0, 0]) == 7.0
+
+        # message loss DURING recovery: first re-lease reply dies with the
+        # connection, first re-push provably never delivers
+        chaos.install(chaos.ChaosPlan(seed=9, rules=[
+            chaos.ChaosRule(action="disconnect", site="client_request",
+                            method="request_worker_lease", label="driver",
+                            times=1),
+            chaos.ChaosRule(action="error", site="client_request",
+                            method="push_task_w", label="driver", times=1,
+                            maybe_delivered=False),
+        ]))
+        cluster.kill_node(n2, allow_graceful=False)  # primary copy gone
+        again = ray_tpu.get(ref, timeout=120)        # lineage re-executes
+        assert float(again[0, 0]) == 7.0
+        assert np.array_equal(first, again)
+    finally:
+        chaos.uninstall()
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_actor_restart_under_gcs_partition():
+    """Satellite: a raylet partitioned from the GCS is declared dead (its
+    heartbeats stop arriving); its actor restarts once the partition
+    heals and the node re-registers — the RLAX-style preemption/partition
+    tolerance path, message-level only."""
+    from ray_tpu.cluster_utils import Cluster
+
+    old = (CONFIG.heartbeat_period_ms, CONFIG.health_check_period_ms,
+           CONFIG.health_check_failure_threshold)
+    CONFIG.set("heartbeat_period_ms", 100)
+    CONFIG.set("health_check_period_ms", 200)
+    CONFIG.set("health_check_failure_threshold", 3)
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 2})
+    try:
+        n2 = cluster.add_node(num_cpus=2, resources={"side": 2.0})
+        cluster.wait_for_nodes()
+        cluster.connect()
+
+        @ray_tpu.remote(max_restarts=1, resources={"side": 1.0})
+        class Stateful:
+            def __init__(self):
+                self.calls = 0
+
+            def bump(self):
+                self.calls += 1
+                return self.calls
+
+        a = Stateful.remote()
+        assert ray_tpu.get(a.bump.remote(), timeout=60) == 1
+
+        plan = chaos.install(chaos.ChaosPlan(seed=13))
+        plan.partition(n2.address, cluster.gcs_address)
+        # heartbeats from n2 now fail client-side -> the GCS health
+        # checker declares the node dead -> the actor goes RESTARTING
+        # (unplaceable while its resource is gone)
+        assert wait_until(
+            lambda: any(not n["Alive"] for n in ray_tpu.nodes()),
+            timeout=30), "partitioned node never declared dead"
+        plan.heal()
+        # the partitioned raylet's next heartbeat gets unknown_node,
+        # re-registers (with backoff+jitter), and the actor restarts there
+        deadline = time.monotonic() + 60
+        got = None
+        while time.monotonic() < deadline:
+            try:
+                got = ray_tpu.get(a.bump.remote(), timeout=10)
+                break
+            except Exception:  # noqa: BLE001 — restart still in flight
+                time.sleep(0.5)
+        assert got == 1, f"restarted actor state not fresh: {got}"
+    finally:
+        chaos.uninstall()
+        for name, val in zip(("heartbeat_period_ms", "health_check_period_ms",
+                              "health_check_failure_threshold"), old):
+            CONFIG.set(name, val)
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_gcs_restart_with_inflight_traffic(tmp_path):
+    """GCS restart recovery (gcs/server.py) under load: plain tasks keep
+    flowing through the outage (leases are raylet-direct), and control-
+    plane operations (new actor) work after the restart; heartbeat
+    backoff spreads the re-registration instead of storming."""
+    import threading
+
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 4},
+                      gcs_storage_path=str(tmp_path / "gcs"))
+    try:
+        cluster.connect()
+
+        @ray_tpu.remote
+        def sq(x):
+            return x * x
+
+        results, errors = [], []
+        stop = threading.Event()
+
+        def pump():
+            i = 0
+            while not stop.is_set():
+                try:
+                    results.append(ray_tpu.get(sq.remote(i), timeout=30))
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
+                i += 1
+
+        t = threading.Thread(target=pump, daemon=True)
+        t.start()
+        time.sleep(0.5)
+        cluster.kill_gcs()
+        time.sleep(1.0)
+        cluster.restart_gcs()
+        assert cluster.wait_for_nodes(timeout=30), "node never re-registered"
+        time.sleep(1.0)
+        stop.set()
+        t.join(timeout=60)
+        # Tasks flowed through the outage; a task that happened to need a
+        # control-plane RPC mid-outage may fail with ConnectionLost (the
+        # caller's retry responsibility), but nothing may WEDGE and
+        # nothing may fail with a non-transport error.
+        assert len(results) > 10, (len(results), errors[:3])
+        for e in errors:
+            assert "ConnectionLost" in type(e).__name__ + str(e), e
+
+        # after recovery the data plane is fully healthy again
+        assert ray_tpu.get(sq.remote(9), timeout=60) == 81
+
+        @ray_tpu.remote
+        class After:
+            def ping(self):
+                return "pong"
+
+        a = After.remote()
+        assert ray_tpu.get(a.ping.remote(), timeout=60) == "pong"
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_chaos_rpc_control_plane_and_cli_helpers():
+    """`ray-tpu chaos start/stop/status` backend: the GCS chaos_* RPCs
+    install/uninstall plans on itself + every alive raylet."""
+    ray_tpu.init(num_cpus=1)
+    try:
+        cw = ray_tpu._raylet.get_core_worker()
+        plan_json = chaos.ChaosPlan(seed=21, rules=[
+            chaos.ChaosRule(action="delay", method="never_called",
+                            delay_s=0.0)]).to_json()
+        reply = chaos.start_cluster(plan_json, cw.gcs_address)
+        assert reply["status"] == "installed" and reply["seed"] == 21
+        assert reply["nodes"], "no raylet acknowledged the plan"
+        assert chaos.active_plan() is not None  # in-process head shares it
+        status = chaos.cluster_status(cw.gcs_address)
+        assert status["installed"] is True
+        assert status["stats"]["seed"] == 21
+        reply = chaos.stop_cluster(cw.gcs_address)
+        assert reply["status"] == "uninstalled"
+        assert chaos.active_plan() is None
+    finally:
+        chaos.uninstall()
+        ray_tpu.shutdown()
+
+
+def test_mid_stream_site_semantics_unit():
+    """The mid_stream lifecycle point (executor-side generator item
+    reports): sync interception supports drop/delay and records events."""
+    from ray_tpu._private import fault_injection as fi
+
+    plan = chaos.install(chaos.ChaosPlan(seed=2, rules=[
+        chaos.ChaosRule(action="drop", site="mid_stream", label="worker",
+                        times=1),
+        chaos.ChaosRule(action="delay", site="mid_stream", label="worker",
+                        delay_s=0.0)]))
+    assert fi.intercept_sync(fi.SITE_MID_STREAM, method="gen",
+                             label="worker", peer="owner") == "drop"
+    # drop rule exhausted; only the (terminal-less) delay still fires
+    assert fi.intercept_sync(fi.SITE_MID_STREAM, method="gen",
+                             label="worker", peer="owner") is None
+    assert plan.fingerprint() == (
+        ("mid_stream", "gen", "drop"), ("mid_stream", "gen", "delay"),
+        ("mid_stream", "gen", "delay"))
+
+
+def test_env_plan_reaches_worker_processes(monkeypatch):
+    """RAY_TPU_CHAOS propagates: worker processes arm themselves from the
+    env at start, so one exported plan covers the whole node."""
+    plan_json = chaos.ChaosPlan(seed=77, rules=[
+        chaos.ChaosRule(action="delay", method="no_such_method",
+                        delay_s=0.0)]).to_json()
+    monkeypatch.setenv(chaos.ENV_VAR, plan_json)
+    ray_tpu.init(num_cpus=1)
+    try:
+        @ray_tpu.remote
+        def probe():
+            from ray_tpu import chaos as c
+
+            p = c.active_plan()
+            return None if p is None else p.seed
+
+        assert ray_tpu.get(probe.remote(), timeout=60) == 77
+    finally:
+        chaos.uninstall()
+        ray_tpu.shutdown()

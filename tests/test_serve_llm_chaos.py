@@ -1,0 +1,167 @@
+"""serve.llm under replica death (ISSUE 3 satellite + acceptance).
+
+A stream whose engine replica is killed mid-request must either complete
+via failover (replica died before the first token reached the client) or
+end with the typed LLMReplicaUnavailableError (died after first token —
+replaying would re-emit consumed tokens), and in BOTH cases the router's
+outstanding-token/request accounting for the dead replica is released.
+Replica death here is a real worker-process kill (`ray_tpu.kill`) —
+engine replicas are actor workers, so this is genuine mid-decode death,
+not a mock.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.models import llama
+from ray_tpu.serve.llm import LLMReplicaUnavailableError
+
+# Real worker-process kills => slow tier, next to test_chaos_cli.py (the
+# message-level seeded-injection tests in test_fault_injection.py are the
+# tier-1 chaos coverage). `-m chaos` still selects this file.
+pytestmark = [pytest.mark.chaos, pytest.mark.slow]
+
+
+@pytest.fixture(scope="module")
+def llm_handle():
+    cfg = llama.LlamaConfig.tiny(vocab_size=128)
+    cfg = cfg.__class__(**{**cfg.__dict__, "dtype": jnp.float32,
+                           "remat": False})
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    ray_tpu.init(num_cpus=4)
+    serve.start()
+    from ray_tpu.serve.llm import build_llm_app
+
+    def build():
+        from ray_tpu.inference.paged_engine import PagedInferenceEngine
+
+        return PagedInferenceEngine(params, cfg, max_batch=4, max_len=512,
+                                    block_size=16, decode_chunk=4)
+
+    # 3 replicas: each kill test downs one and still leaves a failover
+    # target; the controller restarts replacements in the background
+    app = build_llm_app(build, name="llm", num_replicas=3,
+                        default_config={"max_new_tokens": 8},
+                        shed_queue_depth=64)
+    handle = serve.run(app, name="llm")
+    # warm every replica's compiled programs
+    for i in range(3):
+        list(handle.options(method_name="stream_tokens", stream=True)
+             .remote({"prompt": [1 + i, 2, 3], "max_new_tokens": 4}))
+    yield handle
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def _stats(handle):
+    return handle.get_router_stats.remote().result(timeout_s=30)
+
+
+def _replica_handles():
+    """rid -> engine replica actor handle, straight from the controller's
+    long-poll table (the same source the router uses)."""
+    controller = ray_tpu.get_actor("SERVE_CONTROLLER")
+    update = ray_tpu.get(controller.listen_for_change.remote(
+        "llm#llm_engine", -1, timeout=1.0), timeout=30)
+    return dict(update["replicas"])
+
+
+def _wait_replicas(handle, n, timeout=90.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if len(_stats(handle)["replicas"]) >= n:
+            return True
+        time.sleep(0.5)
+    return False
+
+
+def _served_by(handle, before, after):
+    grew = [rid for rid in after
+            if after[rid] > before.get(rid, 0)]
+    assert len(grew) == 1, (before, after)
+    return grew[0]
+
+
+def test_pre_first_token_failover_completes_stream(llm_handle):
+    """Replica dead at assignment time (session affinity pins to it, the
+    router hasn't heard yet): the stream must fail over and complete —
+    the client never sees the death."""
+    assert _wait_replicas(llm_handle, 2)
+    before = _stats(llm_handle)["assigned_total"]
+    first = list(llm_handle.options(method_name="stream_tokens",
+                                    stream=True).remote(
+        {"prompt": [5, 6, 7], "max_new_tokens": 6, "session_id": "chaos-a"}))
+    assert len(first) == 6
+    after = _stats(llm_handle)["assigned_total"]
+    rid = _served_by(llm_handle, before, after)
+
+    handles = _replica_handles()
+    assert rid in handles, (rid, list(handles))
+    ray_tpu.kill(handles[rid])  # worker process dies; router learns late
+
+    # session affinity still points at the dead replica — the router must
+    # retry on another one before the first token, transparently
+    tokens = list(llm_handle.options(method_name="stream_tokens",
+                                     stream=True).remote(
+        {"prompt": [5, 6, 7], "max_new_tokens": 6, "session_id": "chaos-a"}))
+    assert len(tokens) == 6
+
+    # NOTE: no assertion that rid left stats["replicas"]: eviction is
+    # local and intentionally self-healing — the controller's next
+    # long-poll push re-lists the replica until the controller itself
+    # declares it dead, so that membership is racy by design. The
+    # guarantees under test are the completed failover stream above and
+    # the released accounting below.
+    stats = _stats(llm_handle)
+    assert sum(stats["outstanding_requests"].values()) == 0
+    assert all(v == 0 for v in stats["outstanding_tokens"].values()), stats
+
+
+def test_mid_decode_kill_raises_typed_error_and_frees_accounting(llm_handle):
+    """Acceptance: replica killed mid-decode after tokens were already
+    consumed -> typed LLMReplicaUnavailableError (not a raw
+    ConnectionLost/ActorUnavailableError), outstanding accounting freed,
+    and the next request succeeds on a surviving replica."""
+    assert _wait_replicas(llm_handle, 2)
+    before = _stats(llm_handle)["assigned_total"]
+    gen = llm_handle.options(method_name="stream_tokens",
+                             stream=True).remote(
+        {"prompt": [9, 8, 7], "max_new_tokens": 120})
+    it = iter(gen)
+    got = [next(it), next(it)]  # first tokens are out: no silent replay
+    assert all(isinstance(t, int) for t in got)
+    after = _stats(llm_handle)["assigned_total"]
+    rid = _served_by(llm_handle, before, after)
+    ray_tpu.kill(_replica_handles()[rid])
+
+    with pytest.raises(Exception) as err:
+        for _ in it:
+            pass
+    assert "LLMReplicaUnavailable" in type(err.value).__name__ + str(
+        err.value), err.value
+
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        stats = _stats(llm_handle)
+        if (sum(stats["outstanding_requests"].values()) == 0
+                and all(v == 0
+                        for v in stats["outstanding_tokens"].values())):
+            break
+        time.sleep(0.2)
+    else:
+        raise AssertionError(f"accounting not freed: {stats}")
+
+    # service still live on the survivors
+    tokens = list(llm_handle.options(method_name="stream_tokens",
+                                     stream=True).remote(
+        {"prompt": [3, 2, 1], "max_new_tokens": 5}))
+    assert len(tokens) == 5
+
+
+def test_typed_error_carries_http_status():
+    assert LLMReplicaUnavailableError.status_code == 503
