@@ -9,6 +9,13 @@ Import stays light: no JAX at import time — the compute-path modules
 the cluster kernel starts fast in worker processes.
 """
 
+# Arm the runtime lock-order / blocking-call sanitizer BEFORE any
+# submodule import creates a lock (RAY_TPU_SANITIZE=1; no-op otherwise).
+# Spawned workers inherit the env, so one export covers the whole node.
+from ray_tpu._private import lock_sanitizer as _lock_sanitizer
+
+_lock_sanitizer.maybe_install_from_env()
+
 from ray_tpu import exceptions  # noqa: F401
 from ray_tpu._raylet import ObjectRef, ObjectRefGenerator  # noqa: F401
 from ray_tpu.actor import ActorClass, ActorHandle, method  # noqa: F401

@@ -71,6 +71,7 @@ class GcsActorManager:
             try:
                 info, spec = pickle.loads(self._store.get("actors", key))
             except Exception:  # noqa: BLE001 — skip torn records
+                logger.warning("actor recovery: skipping torn record %r", key)
                 continue
             self._actors[info.actor_id] = info
             if spec is not None:

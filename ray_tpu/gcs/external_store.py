@@ -256,8 +256,8 @@ class ExternalStore(InMemoryStore):
         self._shipper.join(timeout=5.0)
         try:
             self._client.close()
-        except Exception:  # noqa: BLE001
-            pass
+        except Exception:  # noqa: BLE001 — peer may already be gone
+            logger.debug("external-store client close failed", exc_info=True)
         self._lt.stop()
 
 

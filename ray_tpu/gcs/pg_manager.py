@@ -66,7 +66,8 @@ class GcsPlacementGroupManager:
         for key in self._store.keys("pgs"):
             try:
                 info = pickle.loads(self._store.get("pgs", key))
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — skip torn records
+                logger.warning("pg recovery: skipping torn record %r", key)
                 continue
             pg_id = info.spec.placement_group_id
             self._groups[pg_id] = info

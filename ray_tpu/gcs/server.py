@@ -89,7 +89,8 @@ class GcsNodeManager:
         for key in self._store.keys("nodes"):
             try:
                 info = pickle.loads(self._store.get("nodes", key))
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — skip torn records
+                logger.warning("node recovery: skipping torn record %r", key)
                 continue
             if info.alive:
                 self._nodes[info.node_id] = info
@@ -421,8 +422,9 @@ class GcsJobManager:
                 try:
                     info = pickle.loads(store.get("jobs", key))
                     self._jobs[info.job_id] = info
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception:  # noqa: BLE001 — skip torn records
+                    logger.warning(
+                        "job recovery: skipping torn record %r", key)
 
     def add_finish_listener(self, cb):
         self._finish_listeners.append(cb)
@@ -534,8 +536,9 @@ class GcsServer:
             try:
                 channel, addr = key.decode().split("|", 1)
                 self.publisher.subscribe(channel, addr)
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception:  # noqa: BLE001 — skip torn records
+                logger.warning(
+                    "pubsub recovery: skipping torn subscription %r", key)
         self.task_event_manager = GcsTaskEventManager()
         self.node_manager.pg_locator = self.pg_manager
         self.node_manager.add_death_listener(self.actor_manager.on_node_death)

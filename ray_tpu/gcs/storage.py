@@ -15,11 +15,14 @@ snapshot, then replays the log.
 
 from __future__ import annotations
 
+import logging
 import os
 import pickle
 import struct
 import threading
 from typing import Dict, List, Optional
+
+logger = logging.getLogger(__name__)
 
 _OP_PUT = 0
 _OP_DEL = 1
@@ -107,7 +110,10 @@ class FileBackedStore(InMemoryStore):
                         else:
                             self._tables.get(table, {}).pop(key, None)
             except Exception:  # noqa: BLE001 — replay what we could
-                pass
+                logger.warning(
+                    "store recovery: log replay stopped after %d records "
+                    "(torn tail is expected after a crash)",
+                    self._replayed, exc_info=True)
 
     # -- logging -------------------------------------------------------------
 
@@ -131,8 +137,8 @@ class FileBackedStore(InMemoryStore):
     def close(self) -> None:
         try:
             self._log.close()
-        except Exception:  # noqa: BLE001
-            pass
+        except Exception:  # noqa: BLE001 — already closed / fs gone
+            logger.debug("store log close failed", exc_info=True)
 
 
 def make_store(path: str = "", external_address: str = "",

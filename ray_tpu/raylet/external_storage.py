@@ -129,7 +129,7 @@ class FsspecBackend(SpillBackend):
         try:
             self._fs.rm(uri)
         except Exception:  # noqa: BLE001 — already gone
-            pass
+            logger.debug("spill delete failed for %s", uri, exc_info=True)
 
 
 def backend_from_config(node_id_hex: str) -> SpillBackend:

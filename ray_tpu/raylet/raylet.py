@@ -803,7 +803,8 @@ class Raylet:
                     await self._gcs.send_async("kv_del", {
                         "namespace": SPILL_KV_NAMESPACE, "key": key.hex()})
                 except Exception:  # noqa: BLE001 — best-effort GC
-                    pass
+                    logger.debug("spill-key GC kv_del failed for %s",
+                                 key.hex(), exc_info=True)
         return True
 
     def stop(self, unregister: bool = True):
@@ -823,8 +824,8 @@ class Raylet:
         if unregister and self._gcs is not None:
             try:
                 self._gcs.call("unregister_node", {"node_id": self.node_id}, timeout=2)
-            except Exception:
-                pass
+            except Exception:  # noqa: BLE001 — GCS notices via heartbeats
+                logger.debug("unregister_node failed on stop", exc_info=True)
         self._pool.close_all()
         if self._gcs is not None:
             self._gcs.close()

@@ -463,8 +463,9 @@ class WorkerPool:
             if self._closed:
                 try:
                     proc.terminate()
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception:  # noqa: BLE001 — already exited
+                    logger.debug("terminate of late-spawned worker failed",
+                                 exc_info=True)
 
         self._loop.create_task(finish())
 
@@ -713,16 +714,18 @@ class WorkerPool:
         if handle.proc is not None and handle.proc.poll() is None:
             try:
                 handle.proc.terminate()
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception:  # noqa: BLE001 — already exited
+                logger.debug("terminate of evicted worker %s failed",
+                             handle.worker_id, exc_info=True)
 
     def _kill(self, handle: WorkerHandle):
         handle.state = "dead"
         if handle.proc is not None and handle.proc.poll() is None:
             try:
                 handle.proc.terminate()
-            except Exception:
-                pass
+            except Exception:  # noqa: BLE001 — already exited
+                logger.debug("terminate of worker %s failed",
+                             handle.worker_id, exc_info=True)
 
     async def _monitor_loop(self):
         """Reap dead children + idle-timeout spares (worker_pool.cc analog).
@@ -841,8 +844,9 @@ class WorkerPool:
             if handle.proc is not None and handle.proc.poll() is None:
                 try:
                     handle.proc.terminate()
-                except Exception:
-                    pass
+                except Exception:  # noqa: BLE001 — already exited
+                    logger.debug("terminate on shutdown failed",
+                                 exc_info=True)
         deadline = time.monotonic() + 2.0
         for handle in handles:
             if handle.proc is not None:
@@ -851,15 +855,16 @@ class WorkerPool:
                 except Exception:
                     try:
                         handle.proc.kill()
-                    except Exception:
-                        pass
+                    except Exception:  # noqa: BLE001 — exited post-timeout
+                        logger.debug("kill on shutdown failed",
+                                     exc_info=True)
         if self._zygote is not None:
             try:
                 self._zygote.stdin.close()  # EOF = clean zygote exit
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception:  # noqa: BLE001 — pipe already broken
+                logger.debug("zygote stdin close failed", exc_info=True)
             try:
                 self._zygote.terminate()
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception:  # noqa: BLE001 — zygote already exited
+                logger.debug("zygote terminate failed", exc_info=True)
             self._zygote = None

@@ -805,6 +805,41 @@ def cmd_microbenchmark(args) -> int:
     return 0
 
 
+def cmd_lint(args) -> int:
+    """Framework-invariant static analysis (`ray-tpu lint`): runs the
+    tools/raylint checks (blocking-in-handler, lock-order,
+    rpc-surface-drift, swallowed-recovery-error, spec-serialization-drift)
+    over the tree. Fast and JAX-free — this is the tier-1-adjacent CI
+    gate; the dynamic half is RAY_TPU_SANITIZE=1 (lock_sanitizer)."""
+    try:
+        from tools.raylint.__main__ import main as lint_main
+    except ImportError:
+        # installed-package invocation: tools/ lives next to ray_tpu/ in a
+        # source checkout, not on sys.path — add the repo root
+        import ray_tpu as _rt
+
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(_rt.__file__)))
+        if not os.path.isdir(os.path.join(repo_root, "tools", "raylint")):
+            print("ray-tpu lint needs a source checkout (tools/raylint/ "
+                  "not found)", file=sys.stderr)
+            return 2
+        sys.path.insert(0, repo_root)
+        from tools.raylint.__main__ import main as lint_main
+    argv = list(args.paths or [])
+    if args.json:
+        argv.append("--json")
+    if args.select:
+        argv += ["--select", args.select]
+    if args.disable:
+        argv += ["--disable", args.disable]
+    if args.root:
+        argv += ["--root", args.root]
+    if args.list_checks:
+        argv.append("--list-checks")
+    return lint_main(argv)
+
+
 def cmd_drain_node(args) -> int:
     """Gracefully drain a node (reference: `ray drain-node`,
     scripts.py:2268): the node stops taking leases, running work finishes
@@ -1088,6 +1123,16 @@ def main(argv=None) -> int:
     sp = sub.add_parser("microbenchmark", help="run the core benchmark suite")
     sp.add_argument("--quick", action="store_true")
     sp.set_defaults(fn=cmd_microbenchmark)
+
+    sp = sub.add_parser("lint", help="framework-invariant static analysis "
+                                     "(tools/raylint)")
+    sp.add_argument("paths", nargs="*", help="files/dirs (default: ray_tpu)")
+    sp.add_argument("--json", action="store_true")
+    sp.add_argument("--select", help="comma-separated check names")
+    sp.add_argument("--disable", help="comma-separated check names to skip")
+    sp.add_argument("--root", help="project root (default: auto-detect)")
+    sp.add_argument("--list-checks", action="store_true")
+    sp.set_defaults(fn=cmd_lint)
 
     args = p.parse_args(argv)
     return args.fn(args)

@@ -487,8 +487,9 @@ class CoreWorker:
         if self.mode == "driver" and mark_job_finished:
             try:
                 self._gcs.call("mark_job_finished", {"job_id": self.job_id}, timeout=5)
-            except Exception:
-                pass
+            except Exception:  # noqa: BLE001 — GCS reaps the job by driver death
+                logger.debug("mark_job_finished failed on shutdown",
+                             exc_info=True)
         self._lease_reaper.cancel()
         self._event_flusher.cancel()
         # Final event flush so short-lived drivers still show their tasks in
@@ -496,13 +497,14 @@ class CoreWorker:
         try:
             self._lt.submit(self._flush_task_events()).result(timeout=2)
         except Exception:  # noqa: BLE001 — best effort on teardown
-            pass
+            logger.debug("final task-event flush failed", exc_info=True)
         self.executor.shutdown()
         if self.plasma is not None:
             try:
                 self.plasma.close()
             except Exception:  # noqa: BLE001 — store may already be gone
-                pass
+                logger.debug("plasma close failed on shutdown",
+                             exc_info=True)
             self.plasma = None
         self._peers.close_all()
         self._gcs.close()
@@ -519,8 +521,8 @@ class CoreWorker:
         async def _safe():
             try:
                 await coro
-            except Exception:
-                pass
+            except Exception:  # noqa: BLE001 — fire-and-forget by contract
+                logger.debug("fire-and-forget RPC failed", exc_info=True)
 
         self._lt.submit(_safe())
 
@@ -999,7 +1001,8 @@ class CoreWorker:
                         "drop_object_location",
                         {"object_id": oid, "location": replica}))
         except Exception:  # noqa: BLE001 — healing is best-effort
-            pass
+            logger.debug("replica-healing notification failed",
+                         exc_info=True)
 
     def _register_as_copy_holder(self, oid: ObjectID,
                                  owner: Optional[Address] = None):
@@ -1014,7 +1017,7 @@ class CoreWorker:
                 "add_object_location",
                 {"object_id": oid, "location": self.address_str}))
         except Exception:  # noqa: BLE001 — registration is an optimization
-            pass
+            logger.debug("copy-holder registration failed", exc_info=True)
 
     def _try_reconstruct(self, oid: ObjectID) -> bool:
         """Owner-side lineage reconstruction (object_recovery_manager.h:41)."""
@@ -1622,7 +1625,10 @@ class CoreWorker:
             try:
                 info = await self._gcs.call_async(
                     "get_actor_info", {"actor_id": rec.actor_id})
-            except Exception:  # noqa: BLE001 — GCS restarting; retry later
+            except Exception:  # noqa: BLE001 — GCS restarting; the next
+                # reconcile tick retries this actor record
+                logger.debug("get_actor_info failed during reconcile",
+                             exc_info=True)
                 continue
             self._apply_actor_info(rec, info)
 
@@ -1908,7 +1914,7 @@ class CoreWorker:
                 "message": str(err),
             }))
         except Exception:  # noqa: BLE001 — reporting must not mask the error
-            pass
+            logger.debug("error-report publication failed", exc_info=True)
 
     def _on_node_event(self, key, info):
         if info.alive:

@@ -118,8 +118,8 @@ class PlasmaProvider:
     def abort_receive(self, oid: ObjectID) -> None:
         try:
             self._client.abort(oid.binary())
-        except Exception:  # noqa: BLE001
-            pass
+        except Exception:  # noqa: BLE001 — nothing was created to abort
+            logger.debug("plasma abort failed for %s", oid, exc_info=True)
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -137,8 +137,9 @@ class PlasmaProvider:
         if self._raylet_call is not None:
             try:
                 self._raylet_call("free_spilled", {"object_ids": [oid]})
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception:  # noqa: BLE001 — raylet gone; spill GC'd with it
+                logger.debug("free_spilled failed for %s", oid,
+                             exc_info=True)
 
     def close(self) -> None:
         """Deliberately leave the store connection OPEN: disconnecting would

@@ -179,8 +179,9 @@ class ReferenceCounter:
         if notify is not None and notify[1] is not None:
             try:
                 self._notify_release(*notify)
-            except Exception:
-                pass
+            except Exception:  # noqa: BLE001 — owner may already be gone
+                logger.debug("borrow-release notification failed",
+                             exc_info=True)
 
     @staticmethod
     def _locations_of(ref: Reference) -> list:

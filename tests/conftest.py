@@ -7,6 +7,7 @@ needed to exercise sharding/collective code paths.
 """
 
 import os
+import time
 
 # Hermetic tests: never probe the GCE metadata server for TPU topology.
 os.environ.setdefault("RT_TPU_PROBE_GCE_METADATA", "0")
@@ -41,6 +42,46 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", float(
     os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"]))
 
 import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def thread_hygiene(request):
+    """Fail any test that leaves non-daemon threads or an armed chaos plan
+    behind: a leaked non-daemon thread hangs the pytest process at exit,
+    and a leaked chaos plan silently injects faults into every later test
+    in the session. Opt out with @pytest.mark.thread_leak_ok (for tests
+    that intentionally leak, e.g. to exercise this fixture)."""
+    if request.node.get_closest_marker("thread_leak_ok"):
+        yield
+        return
+    import threading
+
+    before = set(threading.enumerate())
+    yield
+    from ray_tpu._private import fault_injection as fi
+
+    leaked_plan = fi.active_plan()
+    if leaked_plan is not None:
+        fi.uninstall()  # disarm so later tests aren't poisoned too
+        pytest.fail(
+            f"test left a chaos plan armed (seed={leaked_plan.seed}, "
+            f"{len(leaked_plan.rules)} rules); uninstall it in teardown "
+            "(ray_tpu.chaos.uninstall() or the chaos fixture)")
+    deadline = time.monotonic() + 2.0
+    leaked = []
+    for t in threading.enumerate():
+        if t in before or t.daemon or not t.is_alive():
+            continue
+        t.join(timeout=max(0.05, deadline - time.monotonic()))
+        if t.is_alive():
+            leaked.append(t)
+    if leaked:
+        names = ", ".join(f"{t.name} (target={getattr(t, '_target', None)})"
+                          for t in leaked)
+        pytest.fail(
+            f"test left {len(leaked)} non-daemon thread(s) running: "
+            f"{names}; join them in teardown or mark the test "
+            "thread_leak_ok")
 
 
 @pytest.fixture

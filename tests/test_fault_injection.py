@@ -55,6 +55,8 @@ def _drive(plan, n=200):
 def test_same_seed_reproduces_identical_fault_sequence():
     """Acceptance: same seed => identical fault sequence across runs."""
     def rules():
+        # raylint: disable=rpc-surface-drift — synthetic method names fed
+        # straight to plan.decide(); no real RPC surface involved
         return [chaos.ChaosRule(action="drop", method="m1", p=0.5),
                 chaos.ChaosRule(action="delay", method="m*", p=0.25,
                                 delay_s=0.0)]
@@ -70,6 +72,7 @@ def test_same_seed_reproduces_identical_fault_sequence():
 
 def test_rule_addressing_after_times_and_labels():
     plan = chaos.ChaosPlan(seed=0, rules=[
+        # raylint: disable=rpc-surface-drift — synthetic names for decide()
         chaos.ChaosRule(action="drop", method="lease*", label="raylet",
                         after=2, times=2),
     ])
@@ -468,6 +471,8 @@ def test_chaos_rpc_control_plane_and_cli_helpers():
     try:
         cw = ray_tpu._raylet.get_core_worker()
         plan_json = chaos.ChaosPlan(seed=21, rules=[
+            # raylint: disable=rpc-surface-drift — deliberately inert rule:
+            # the test exercises install/status/stop, not injection
             chaos.ChaosRule(action="delay", method="never_called",
                             delay_s=0.0)]).to_json()
         reply = chaos.start_cluster(plan_json, cw.gcs_address)
@@ -509,6 +514,8 @@ def test_env_plan_reaches_worker_processes(monkeypatch):
     """RAY_TPU_CHAOS propagates: worker processes arm themselves from the
     env at start, so one exported plan covers the whole node."""
     plan_json = chaos.ChaosPlan(seed=77, rules=[
+        # raylint: disable=rpc-surface-drift — deliberately inert rule: the
+        # test checks env propagation, not injection
         chaos.ChaosRule(action="delay", method="no_such_method",
                         delay_s=0.0)]).to_json()
     monkeypatch.setenv(chaos.ENV_VAR, plan_json)

@@ -1,0 +1,536 @@
+"""raylint self-tests: per-check positive/negative fixtures, suppression
+handling, and the real-tree gate (zero unsuppressed errors over ray_tpu/
+and tests/). All marked `lint`: `pytest -m lint` runs just the gate
+(~20-30s — conftest imports jax; the raw `python -m tools.raylint` CLI
+is the JAX-free <10s form)."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from tools.raylint.core import LintConfig, Project, run_lint
+
+pytestmark = pytest.mark.lint
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write(tmp_path, relpath: str, source: str) -> None:
+    path = tmp_path / relpath
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+
+
+def _lint(tmp_path, paths, options=None, select=None):
+    config = LintConfig(options=options or {}, reference_paths=[])
+    return run_lint(str(tmp_path), paths, config=config, select=select)
+
+
+def _ids(diags):
+    return sorted({d.check_id for d in diags})
+
+
+# ---------------------------------------------------------------- RTL001
+
+
+def test_blocking_in_handler_positive(tmp_path):
+    _write(tmp_path, "ray_tpu/gcs/svc.py", """
+        import time
+
+        class Svc:
+            async def handle_ping(self, payload):
+                time.sleep(1)
+                return True
+    """)
+    diags = _lint(tmp_path, ["ray_tpu"], select=["blocking-in-handler"])
+    assert _ids(diags) == ["RTL001"]
+    assert "time.sleep" in diags[0].message
+    assert "handle_ping" in diags[0].message
+
+
+def test_blocking_in_handler_one_level_call_graph(tmp_path):
+    _write(tmp_path, "ray_tpu/gcs/svc.py", """
+        import time
+
+        class Svc:
+            async def handle_ping(self, payload):
+                return self._slow()
+
+            def _slow(self):
+                time.sleep(0.5)
+                return True
+    """)
+    diags = _lint(tmp_path, ["ray_tpu"], select=["blocking-in-handler"])
+    assert len(diags) == 1
+    assert "reachable from handler Svc.handle_ping" in diags[0].message
+
+
+def test_blocking_in_handler_negatives(tmp_path):
+    # deferred lambdas, awaited async acquire, asyncio.sleep: all fine
+    _write(tmp_path, "ray_tpu/gcs/svc.py", """
+        import asyncio
+        import threading
+        import time
+
+        class Svc:
+            async def handle_die(self, payload):
+                threading.Thread(
+                    target=lambda: (time.sleep(0.05), None)).start()
+                await asyncio.sleep(0)
+                await self._sem.acquire()
+                self._lock.acquire(blocking=False)
+                self._lock.acquire(timeout=1.0)
+                return True
+    """)
+    assert _lint(tmp_path, ["ray_tpu"], select=["blocking-in-handler"]) == []
+
+
+def test_blocking_acquire_in_handler_positive(tmp_path):
+    _write(tmp_path, "ray_tpu/raylet/svc.py", """
+        class Svc:
+            async def handle_lease(self, payload):
+                self._lock.acquire()
+                return True
+    """)
+    diags = _lint(tmp_path, ["ray_tpu"], select=["blocking-in-handler"])
+    assert len(diags) == 1 and "acquire" in diags[0].message
+
+
+def test_blocking_sync_method_not_flagged(tmp_path):
+    _write(tmp_path, "ray_tpu/gcs/svc.py", """
+        import time
+
+        class Svc:
+            def shutdown(self):   # sync method: blocking is fine
+                time.sleep(0.1)
+    """)
+    assert _lint(tmp_path, ["ray_tpu"], select=["blocking-in-handler"]) == []
+
+
+# ---------------------------------------------------------------- RTL002
+
+
+def test_lock_order_cycle_detected(tmp_path):
+    _write(tmp_path, "ray_tpu/worker/m.py", """
+        class A:
+            def fwd(self):
+                with self.a_lock:
+                    with self.b_lock:
+                        pass
+
+            def rev(self):
+                with self.b_lock:
+                    with self.a_lock:
+                        pass
+    """)
+    diags = _lint(tmp_path, ["ray_tpu"], select=["lock-order"])
+    assert _ids(diags) == ["RTL002"]
+    assert "cycle" in diags[0].message
+
+
+def test_lock_order_consistent_order_clean(tmp_path):
+    _write(tmp_path, "ray_tpu/worker/m.py", """
+        class A:
+            def one(self):
+                with self.a_lock:
+                    with self.b_lock:
+                        pass
+
+            def two(self):
+                with self.a_lock:
+                    with self.b_lock:
+                        pass
+    """)
+    assert _lint(tmp_path, ["ray_tpu"], select=["lock-order"]) == []
+
+
+def test_lock_order_cross_function_call_edge(tmp_path):
+    # fwd holds a_lock and calls helper which takes b_lock; rev nests the
+    # other way: cycle through the one-level call graph
+    _write(tmp_path, "ray_tpu/worker/m.py", """
+        class A:
+            def fwd(self):
+                with self.a_lock:
+                    self._helper()
+
+            def _helper(self):
+                with self.b_lock:
+                    pass
+
+            def rev(self):
+                with self.b_lock:
+                    with self.a_lock:
+                        pass
+    """)
+    diags = _lint(tmp_path, ["ray_tpu"], select=["lock-order"])
+    assert _ids(diags) == ["RTL002"]
+
+
+# ---------------------------------------------------------------- RTL003
+
+
+def test_rpc_surface_missing_handler(tmp_path):
+    _write(tmp_path, "ray_tpu/gcs/svc.py", """
+        class Svc:
+            async def handle_ping(self, payload):
+                return True
+
+        async def caller(client):
+            await client.call_async("ping", {})
+            await client.call_async("pong", {})
+    """)
+    diags = _lint(tmp_path, ["ray_tpu"], select=["rpc-surface-drift"])
+    assert len(diags) == 1
+    assert "'pong'" in diags[0].message
+    assert "ping" in diags[0].message  # did-you-mean hint
+
+
+def test_rpc_surface_register_call_counts(tmp_path):
+    _write(tmp_path, "ray_tpu/gcs/svc.py", """
+        def setup(server, handler):
+            server.register("custom_op", handler)
+
+        async def caller(client):
+            await client.send_async("custom_op", {})
+    """)
+    assert _lint(tmp_path, ["ray_tpu"], select=["rpc-surface-drift"]) == []
+
+
+def test_rpc_surface_test_handlers_do_not_mask_prod_typos(tmp_path):
+    # a throwaway handler registered by a test must not satisfy a
+    # production call site with the same (typo'd) name
+    _write(tmp_path, "ray_tpu/gcs/svc.py", """
+        async def caller(client):
+            await client.call_async("only_in_tests", {})
+    """)
+    _write(tmp_path, "tests/test_x.py", """
+        class Throwaway:
+            async def handle_only_in_tests(self, payload):
+                return True
+    """)
+    diags = _lint(tmp_path, ["ray_tpu", "tests"],
+                  select=["rpc-surface-drift"])
+    assert len(diags) == 1 and "'only_in_tests'" in diags[0].message
+
+
+def test_rpc_surface_chaos_rule_may_target_file_local_handler(tmp_path):
+    # raw-transport tests register e.g. "echo" on their own server and
+    # aim chaos rules at it: legal within that file, still an error from
+    # another file
+    _write(tmp_path, "tests/test_transport.py", """
+        from ray_tpu import chaos
+
+        def setup(server, handler):
+            server.register("echo_local", handler)
+
+        def plan():
+            return [chaos.ChaosRule(action="drop", method="echo_local")]
+    """)
+    _write(tmp_path, "tests/test_other.py", """
+        from ray_tpu import chaos
+
+        def plan():
+            return [chaos.ChaosRule(action="drop", method="echo_local")]
+    """)
+    diags = _lint(tmp_path, ["tests"], select=["rpc-surface-drift"])
+    assert len(diags) == 1
+    assert diags[0].path == "tests/test_other.py"
+
+
+def test_rpc_surface_chaos_glob_validation(tmp_path):
+    _write(tmp_path, "ray_tpu/gcs/svc.py", """
+        class Svc:
+            async def handle_push_task(self, payload):
+                return True
+    """)
+    _write(tmp_path, "tests/test_x.py", """
+        from ray_tpu import chaos
+
+        def plan():
+            return [
+                chaos.ChaosRule(action="drop", method="push_*"),
+                chaos.ChaosRule(action="drop", method="pusj_task"),
+                chaos.ChaosRule(action="drop", site="before_exec"),
+            ]
+    """)
+    diags = _lint(tmp_path, ["ray_tpu", "tests"],
+                  select=["rpc-surface-drift"])
+    msgs = "\n".join(d.message for d in diags)
+    assert len(diags) == 2
+    assert "'pusj_task'" in msgs and "'before_exec'" in msgs
+
+
+# ---------------------------------------------------------------- RTL004
+
+
+def test_swallowed_error_positive_and_fixes(tmp_path):
+    _write(tmp_path, "ray_tpu/gcs/svc.py", """
+        import logging
+
+        logger = logging.getLogger(__name__)
+
+        def silent():
+            try:
+                risky()
+            except Exception:
+                pass
+
+        def bare():
+            try:
+                risky()
+            except:
+                raise
+
+        def logged():
+            try:
+                risky()
+            except Exception:
+                logger.debug("boom", exc_info=True)
+
+        def surfaced():
+            try:
+                risky()
+            except Exception as e:
+                return {"status": "error", "error": str(e)}
+    """)
+    diags = _lint(tmp_path, ["ray_tpu"], select=["swallowed-recovery-error"])
+    assert len(diags) == 2  # silent() swallow + bare except
+    assert any("bare" in d.message for d in diags)
+
+
+def test_swallowed_error_out_of_scope_clean(tmp_path):
+    # serve/ is not a recovery path for this check
+    _write(tmp_path, "ray_tpu/serve/svc.py", """
+        def silent():
+            try:
+                risky()
+            except Exception:
+                pass
+    """)
+    assert _lint(tmp_path, ["ray_tpu"],
+                 select=["swallowed-recovery-error"]) == []
+
+
+def test_narrow_except_not_flagged(tmp_path):
+    _write(tmp_path, "ray_tpu/gcs/svc.py", """
+        def narrow():
+            try:
+                risky()
+            except (ConnectionResetError, BrokenPipeError):
+                pass
+    """)
+    assert _lint(tmp_path, ["ray_tpu"],
+                 select=["swallowed-recovery-error"]) == []
+
+
+# ---------------------------------------------------------------- RTL005
+
+_SPECS_FIXTURE = """
+    from dataclasses import dataclass
+
+    @dataclass
+    class Spec:
+        a: int
+        b: str = ""
+        c: float = 0.0
+
+    def spec_w(sp):
+        return (sp.a, sp.b{write_c})
+
+    def spec_r(t):
+        sp = Spec(a=t[0], b=t[1])
+        {read_c}
+        return sp
+"""
+
+_SPEC_OPTS = {"spec-serialization-drift": {
+    "specs-module": "ray_tpu/_private/specs.py",
+    "codecs": [{"dataclass": "Spec", "writer": "spec_w",
+                "reader": "spec_r"}]}}
+
+
+def test_spec_serialization_roundtrip_clean(tmp_path):
+    _write(tmp_path, "ray_tpu/_private/specs.py", _SPECS_FIXTURE.format(
+        write_c=", sp.c", read_c="sp.c = t[2]"))
+    assert _lint(tmp_path, ["ray_tpu"], options=_SPEC_OPTS,
+                 select=["spec-serialization-drift"]) == []
+
+
+def test_spec_serialization_missing_writer_field(tmp_path):
+    _write(tmp_path, "ray_tpu/_private/specs.py", _SPECS_FIXTURE.format(
+        write_c="", read_c="sp.c = t[2]"))
+    diags = _lint(tmp_path, ["ray_tpu"], options=_SPEC_OPTS,
+                  select=["spec-serialization-drift"])
+    assert len(diags) == 1
+    assert "Spec.c" in diags[0].message and "spec_w" in diags[0].message
+
+
+def test_spec_serialization_missing_reader_field(tmp_path):
+    _write(tmp_path, "ray_tpu/_private/specs.py", _SPECS_FIXTURE.format(
+        write_c=", sp.c", read_c="pass"))
+    diags = _lint(tmp_path, ["ray_tpu"], options=_SPEC_OPTS,
+                  select=["spec-serialization-drift"])
+    assert len(diags) == 1
+    assert "never restored" in diags[0].message
+
+
+# ----------------------------------------------------------- suppressions
+
+
+def test_suppression_same_line(tmp_path):
+    _write(tmp_path, "ray_tpu/gcs/svc.py", """
+        def silent():
+            try:
+                risky()
+            except Exception:  # raylint: disable=swallowed-recovery-error
+                pass
+    """)
+    assert _lint(tmp_path, ["ray_tpu"],
+                 select=["swallowed-recovery-error"]) == []
+
+
+def test_suppression_comment_line_above(tmp_path):
+    _write(tmp_path, "ray_tpu/gcs/svc.py", """
+        import time
+
+        class Svc:
+            async def handle_ping(self, payload):
+                # raylint: disable=blocking-in-handler — deliberate, test
+                time.sleep(0)
+    """)
+    assert _lint(tmp_path, ["ray_tpu"], select=["blocking-in-handler"]) == []
+
+
+def test_suppression_is_check_specific(tmp_path):
+    _write(tmp_path, "ray_tpu/gcs/svc.py", """
+        def silent():
+            try:
+                risky()
+            except Exception:  # raylint: disable=lock-order
+                pass
+    """)
+    diags = _lint(tmp_path, ["ray_tpu"], select=["swallowed-recovery-error"])
+    assert len(diags) == 1  # wrong check name: not suppressed
+
+
+def test_file_level_suppression(tmp_path):
+    _write(tmp_path, "ray_tpu/gcs/svc.py", """
+        # raylint: disable-file=swallowed-recovery-error
+
+        def silent():
+            try:
+                risky()
+            except Exception:
+                pass
+    """)
+    assert _lint(tmp_path, ["ray_tpu"],
+                 select=["swallowed-recovery-error"]) == []
+
+
+# ------------------------------------------------------------- the gate
+
+
+def test_real_tree_is_clean():
+    """THE gate: zero unsuppressed errors over the real ray_tpu/ + tests/.
+    A new RPC handler without a caller, a reversed lock nesting, a silent
+    recovery swallow — any of these turns this test red."""
+    diags = run_lint(REPO_ROOT, ["ray_tpu", "tests"],
+                     config=LintConfig.load(REPO_ROOT))
+    assert diags == [], "\n".join(d.format() for d in diags)
+
+
+def test_cli_exit_codes(tmp_path):
+    _write(tmp_path, "ray_tpu/gcs/svc.py", """
+        def silent():
+            try:
+                risky()
+            except Exception:
+                pass
+    """)
+    env = dict(os.environ, PYTHONPATH=REPO_ROOT)
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.raylint", "ray_tpu",
+         "--root", str(tmp_path), "--json"],
+        capture_output=True, text=True, env=env, cwd=REPO_ROOT)
+    assert r.returncode == 1, r.stdout + r.stderr
+    payload = json.loads(r.stdout)
+    assert payload["count"] == 1
+    assert payload["errors"][0]["check_id"] == "RTL004"
+
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.raylint", "--list-checks"],
+        capture_output=True, text=True, env=env, cwd=REPO_ROOT)
+    assert r.returncode == 0
+    for cid in ("RTL001", "RTL002", "RTL003", "RTL004", "RTL005"):
+        assert cid in r.stdout
+
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.raylint", "--select", "no-such-check"],
+        capture_output=True, text=True, env=env, cwd=REPO_ROOT)
+    assert r.returncode == 2
+
+
+def test_unknown_check_raises():
+    with pytest.raises(ValueError, match="unknown check"):
+        run_lint(REPO_ROOT, ["tools"], select=["nope"])
+
+
+# ----------------------------------------------------- golden RPC corpus
+
+
+def test_rpc_surface_matches_golden():
+    """The extracted RPC surface must match tests/rpc_surface_golden.json
+    exactly. Adding a handler (or a new literal call site) without
+    updating the golden fails loudly — the golden review IS the moment a
+    human checks the new method has both sides. Regenerate with:
+    python -m tests.test_raylint (or copy the assert message)."""
+    from tools.raylint.checks.rpc_surface import RpcSurfaceCheck
+
+    cfg = LintConfig.load(REPO_ROOT)
+    proj = Project.build(REPO_ROOT, ["ray_tpu"], cfg)
+    check = RpcSurfaceCheck(cfg.check_options("rpc-surface-drift"))
+    handlers = sorted(check.extract_handlers(proj))
+    called = sorted({name for name, *_ in check.extract_calls(proj)})
+
+    golden_path = os.path.join(REPO_ROOT, "tests", "rpc_surface_golden.json")
+    with open(golden_path) as f:
+        golden = json.load(f)
+
+    assert handlers == golden["handlers"], (
+        "RPC handler surface drifted from tests/rpc_surface_golden.json.\n"
+        f"added: {sorted(set(handlers) - set(golden['handlers']))}\n"
+        f"removed: {sorted(set(golden['handlers']) - set(handlers))}\n"
+        "If intentional, regenerate the golden (see its header) and make "
+        "sure every new handler has a caller (and vice versa).")
+    assert called == golden["called"], (
+        "RPC call surface drifted from tests/rpc_surface_golden.json.\n"
+        f"added: {sorted(set(called) - set(golden['called']))}\n"
+        f"removed: {sorted(set(golden['called']) - set(called))}")
+    # every literal call has a handler (the linter enforces this too)
+    assert set(called) <= set(handlers)
+
+
+def _regen_golden():
+    from tools.raylint.checks.rpc_surface import RpcSurfaceCheck
+
+    cfg = LintConfig.load(REPO_ROOT)
+    proj = Project.build(REPO_ROOT, ["ray_tpu"], cfg)
+    check = RpcSurfaceCheck(cfg.check_options("rpc-surface-drift"))
+    golden = {
+        "handlers": sorted(check.extract_handlers(proj)),
+        "called": sorted({n for n, *_ in check.extract_calls(proj)}),
+    }
+    path = os.path.join(REPO_ROOT, "tests", "rpc_surface_golden.json")
+    with open(path, "w") as f:
+        json.dump(golden, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(f"regenerated {path}: {len(golden['handlers'])} handlers, "
+          f"{len(golden['called'])} called")
+
+
+if __name__ == "__main__":
+    _regen_golden()

@@ -1,0 +1,32 @@
+"""Slow-tier gate: a representative tier-1 slice must pass with the
+runtime lock sanitizer armed (RAY_TPU_SANITIZE=1) and ZERO lock-order
+cycle reports — the dynamic backstop behind tools/raylint's static
+lock-order check. The slice covers the lock-heavy paths: basic task/
+object flow (core_worker/memory_store/reference_counter) and the chaos
+suite (rpc + recovery under fault injection)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_tier1_slice_passes_under_lock_sanitizer():
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               RAY_TPU_SANITIZE="1",
+               RAY_TPU_SANITIZE_MODE="raise",
+               PYTHONPATH=REPO_ROOT)
+    r = subprocess.run(
+        [sys.executable, "-m", "pytest",
+         "tests/test_basic.py", "tests/test_fault_injection.py",
+         "-q", "-p", "no:cacheprovider"],
+        capture_output=True, text=True, env=env, cwd=REPO_ROOT,
+        timeout=600)
+    out = r.stdout + r.stderr
+    assert r.returncode == 0, out
+    assert "lock-order cycle" not in out, out

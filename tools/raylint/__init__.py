@@ -1,0 +1,33 @@
+"""raylint — framework-invariant static analyzer for ray_tpu.
+
+The reference system leans on TSan / C++ sanitizers to keep control-plane
+invariants honest; this is the Python reproduction's equivalent static
+half (the dynamic half is ray_tpu/_private/lock_sanitizer.py). Each check
+encodes a real ray_tpu invariant:
+
+  RTL001 blocking-in-handler       no blocking calls on RPC-handler /
+                                   event-loop code paths
+  RTL002 lock-order                the static `with lock:` acquisition
+                                   graph must stay acyclic
+  RTL003 rpc-surface-drift         every string-named RPC a client sends
+                                   must have a registered handler; chaos
+                                   globs must match real sites/methods
+  RTL004 swallowed-recovery-error  no silent `except Exception: pass` in
+                                   gcs/ raylet/ worker/ recovery paths
+  RTL005 spec-serialization-drift  spec dataclass fields must round-trip
+                                   through their wire codecs
+
+Run `python -m tools.raylint ray_tpu/` (or `ray-tpu lint`). Suppress a
+finding with `# raylint: disable=<check-name>` on (or directly above) the
+flagged line; config lives in raylint.toml (`[tool.raylint]` table).
+"""
+
+from tools.raylint.core import (  # noqa: F401
+    Diagnostic,
+    LintConfig,
+    Project,
+    all_checks,
+    run_lint,
+)
+
+__version__ = "0.1.0"

@@ -1,0 +1,98 @@
+"""CLI: `python -m tools.raylint [paths...]` (also behind `ray-tpu lint`).
+
+Exit status: 0 clean, 1 errors found, 2 usage/config error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="raylint",
+        description="framework-invariant static analyzer for ray_tpu")
+    p.add_argument("paths", nargs="*", default=["ray_tpu"],
+                   help="files/directories to lint (default: ray_tpu)")
+    p.add_argument("--root", default=None,
+                   help="project root (default: cwd, or the repo root "
+                        "containing ray_tpu/)")
+    p.add_argument("--config", default=None, help="explicit config file "
+                   "(raylint.toml / pyproject.toml with [tool.raylint])")
+    p.add_argument("--select", default=None,
+                   help="comma-separated check names to run (default: all)")
+    p.add_argument("--disable", default=None,
+                   help="comma-separated check names to skip")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable output")
+    p.add_argument("--list-checks", action="store_true")
+    args = p.parse_args(argv)
+
+    from tools.raylint.core import (
+        LintConfig,
+        all_checks,
+        format_human,
+        format_json,
+        run_lint,
+    )
+
+    if args.list_checks:
+        for name, cls in sorted(all_checks().items(),
+                                key=lambda kv: kv[1].check_id):
+            print(f"{cls.check_id}  {name:26s} {cls.description}")
+        return 0
+
+    root = args.root or _find_root()
+    paths = args.paths or ["ray_tpu"]
+    config = LintConfig.load(root, explicit=args.config)
+    t0 = time.monotonic()
+    try:
+        diags = run_lint(
+            root, paths, config=config,
+            select=_split(args.select), disable=_split(args.disable))
+    except ValueError as e:
+        print(f"raylint: {e}", file=sys.stderr)
+        return 2
+    if args.as_json:
+        print(format_json(diags))
+    else:
+        print(format_human(diags))
+        if not diags:
+            n_files = sum(1 for _ in _count_targets(root, paths))
+            print(f"  ({n_files} files, {time.monotonic() - t0:.2f}s)")
+    return 1 if diags else 0
+
+
+def _split(blob):
+    if not blob:
+        return None
+    return [s.strip() for s in blob.split(",") if s.strip()]
+
+
+def _find_root() -> str:
+    """cwd if it contains ray_tpu/, else walk up; falls back to the repo
+    root inferred from this file (tools/raylint/__main__.py)."""
+    d = os.getcwd()
+    while True:
+        if os.path.isdir(os.path.join(d, "ray_tpu")):
+            return d
+        parent = os.path.dirname(d)
+        if parent == d:
+            break
+        d = parent
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def _count_targets(root, paths):
+    from tools.raylint.core import _collect_py
+    for p in paths:
+        p = p if os.path.isabs(p) else os.path.join(root, p)
+        yield from _collect_py(p)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,9 @@
+"""Check registry: importing this package registers every built-in check."""
+
+from tools.raylint.checks import (  # noqa: F401
+    blocking_in_handler,
+    lock_order,
+    rpc_surface,
+    spec_serialization,
+    swallowed_error,
+)

@@ -1,0 +1,193 @@
+"""RTL001 blocking-in-handler.
+
+Invariant: RPC handler coroutines (and every coroutine that runs on a
+component EventLoopThread — raylet/GCS dispatch paths, serve replica event
+loops) must never make blocking calls. One wedged handler stalls the whole
+component: the transport multiplexes every peer over one loop, so a single
+`time.sleep` / `ray_tpu.get` / blocking `lock.acquire()` / `run_coro()` in
+a handler is the asyncio equivalent of holding the GIL in a signal handler.
+`EventLoopThread.run_coro` already raises at runtime when called from its
+own loop; this is the static version, caught before the code ever runs.
+
+Call-graph aware one level deep: a handler calling a same-module helper
+that blocks is flagged at the helper's blocking line (message names the
+handler path).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from tools.raylint.core import (
+    Check,
+    Diagnostic,
+    Module,
+    Project,
+    dotted_name,
+    register_check,
+    resolve_local_call,
+)
+
+# default blocking calls: matched against the dotted call target's suffix
+DEFAULT_BLOCKING_CALLS = [
+    "time.sleep",
+    "ray_tpu.get",
+    "ray_tpu.wait",
+    "ray.get",
+    "socket.create_connection",
+    "subprocess.run",
+    "subprocess.check_output",
+    "subprocess.check_call",
+]
+# method names that are blocking regardless of receiver
+DEFAULT_BLOCKING_METHODS = ["run_coro", "wait_until"]
+DEFAULT_HANDLER_PREFIXES = ["handle_", "_handle_"]
+# every async def in these relpath prefixes runs on an EventLoopThread
+DEFAULT_ASYNC_SCOPES = [
+    "ray_tpu/gcs/",
+    "ray_tpu/raylet/",
+    "ray_tpu/worker/",
+    "ray_tpu/serve/",
+    "ray_tpu/_private/rpc.py",
+    "ray_tpu/_private/fault_injection.py",
+]
+
+
+class _BlockingCallVisitor(ast.NodeVisitor):
+    """Collect blocking-call sites in one function body (not nested defs)."""
+
+    def __init__(self, check: "BlockingInHandlerCheck"):
+        self.check = check
+        self.hits: List[Tuple[ast.Call, str]] = []   # (node, description)
+        self.local_calls: List[Tuple[ast.Call, str]] = []  # helper candidates
+        self._awaited: set = set()
+
+    def visit_FunctionDef(self, node):   # do not descend into nested defs
+        pass
+
+    # lambdas are deferred too (e.g. threading.Thread(target=lambda: ...))
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    def visit_Await(self, node: ast.Await):
+        if isinstance(node.value, ast.Call):
+            self._awaited.add(id(node.value))
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        desc = self.check.classify_blocking(node)
+        if desc and "acquire" in desc and id(node) in self._awaited:
+            desc = None  # awaited .acquire() is an asyncio primitive
+        if desc:
+            self.hits.append((node, desc))
+        else:
+            target = dotted_name(node.func)
+            if target is not None:
+                self.local_calls.append((node, target))
+        self.generic_visit(node)
+
+
+@register_check
+class BlockingInHandlerCheck(Check):
+    name = "blocking-in-handler"
+    check_id = "RTL001"
+    description = ("blocking call (time.sleep / ray_tpu.get / lock.acquire / "
+                   "run_coro) inside an RPC handler or event-loop coroutine")
+
+    def __init__(self, options: dict):
+        super().__init__(options)
+        self.blocking_calls = list(options.get(
+            "blocking-calls", DEFAULT_BLOCKING_CALLS))
+        self.blocking_methods = set(options.get(
+            "blocking-methods", DEFAULT_BLOCKING_METHODS))
+        self.handler_prefixes = tuple(options.get(
+            "handler-prefixes", DEFAULT_HANDLER_PREFIXES))
+        self.async_scopes = tuple(options.get(
+            "async-scopes", DEFAULT_ASYNC_SCOPES))
+
+    # ------------------------------------------------------- classification
+    def classify_blocking(self, call: ast.Call) -> Optional[str]:
+        target = dotted_name(call.func)
+        if target is None:
+            return None
+        for known in self.blocking_calls:
+            if target == known or target.endswith("." + known):
+                return f"blocking call {known}()"
+        leaf = target.rsplit(".", 1)[-1]
+        if leaf in self.blocking_methods:
+            return f"blocking call {leaf}()"
+        if leaf == "acquire" and "." in target and self._is_blocking_acquire(call):
+            return "blocking lock.acquire() (no blocking=False / timeout)"
+        return None
+
+    @staticmethod
+    def _is_blocking_acquire(call: ast.Call) -> bool:
+        # lock.acquire() / lock.acquire(True) block; a timeout or
+        # blocking=False makes it bounded and is allowed.
+        for kw in call.keywords:
+            if kw.arg == "blocking" and isinstance(kw.value, ast.Constant) \
+                    and kw.value.value is False:
+                return False
+            if kw.arg == "timeout":
+                return False
+        if call.args:
+            first = call.args[0]
+            if isinstance(first, ast.Constant) and first.value is False:
+                return False
+            if len(call.args) >= 2:  # acquire(True, timeout)
+                return False
+        return True
+
+    # --------------------------------------------------------------- scope
+    def _is_handler(self, mod: Module, cls: Optional[str],
+                    fn: ast.AST) -> bool:
+        is_async = isinstance(fn, ast.AsyncFunctionDef)
+        if not is_async:
+            return False
+        if fn.name.startswith(self.handler_prefixes):
+            return True
+        return any(mod.relpath.startswith(scope) for scope in self.async_scopes)
+
+    # ----------------------------------------------------------------- run
+    def run(self, project: Project) -> Iterable[Diagnostic]:
+        for mod in project.target_modules():
+            yield from self._run_module(mod)
+
+    def _run_module(self, mod: Module) -> Iterable[Diagnostic]:
+        # index same-module functions for the one-level call graph
+        local_fns: Dict[Tuple[Optional[str], str], ast.AST] = {}
+        for cls, fn in mod.functions():
+            local_fns[(cls, fn.name)] = fn
+
+        for cls, fn in mod.functions():
+            if not self._is_handler(mod, cls, fn):
+                continue
+            visitor = _BlockingCallVisitor(self)
+            for stmt in fn.body:
+                visitor.visit(stmt)
+            handler = f"{cls + '.' if cls else ''}{fn.name}"
+            for node, desc in visitor.hits:
+                yield Diagnostic(
+                    self.check_id, self.name, mod.relpath,
+                    node.lineno, node.col_offset,
+                    f"{desc} in handler {handler}")
+            # one level deep: helpers defined in this module
+            for node, target in visitor.local_calls:
+                helper = resolve_local_call(local_fns, cls, target)
+                if helper is None:
+                    continue
+                hcls, hfn = helper
+                if isinstance(hfn, ast.AsyncFunctionDef) and \
+                        self._is_handler(mod, hcls, hfn):
+                    continue  # will be checked as a handler itself
+                sub = _BlockingCallVisitor(self)
+                for stmt in hfn.body:
+                    sub.visit(stmt)
+                for hnode, desc in sub.hits:
+                    yield Diagnostic(
+                        self.check_id, self.name, mod.relpath,
+                        hnode.lineno, hnode.col_offset,
+                        f"{desc} in {hfn.name}(), reachable from handler "
+                        f"{handler} (call at line {node.lineno})")
+

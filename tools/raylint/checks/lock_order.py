@@ -1,0 +1,203 @@
+"""RTL002 lock-order.
+
+Invariant: the global lock acquisition graph must be acyclic. Two code
+paths that take the same pair of locks in opposite orders deadlock the
+moment two threads interleave — the exact class of bug TSan's lock-order
+inversion detector catches in the reference's C++ core.
+
+Statically inferred, per module: every `with <lock>:` nesting inside one
+function adds edges outer->inner; a call under a held lock to a
+same-module function that itself opens `with <lock>:` adds the edge too
+(one level deep). Lock nodes are named `module:Class.attr` so distinct
+instances of the same site collapse onto one node, like a TSan lock class.
+
+The dynamic half of this invariant is ray_tpu/_private/lock_sanitizer.py,
+which watches real acquisition orders across threads under
+RAY_TPU_SANITIZE=1.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.raylint.core import (
+    Check,
+    Diagnostic,
+    Module,
+    Project,
+    dotted_name,
+    module_name_of,
+    register_check,
+    resolve_local_call,
+)
+
+DEFAULT_LOCK_NAME_RE = r"(?:^|_)(lock|rlock|mutex|cv|cond|condition)s?$"
+
+Edge = Tuple[str, str]           # (outer, inner) lock node names
+Site = Tuple[str, int]           # (relpath, lineno) where the edge closes
+
+
+@register_check
+class LockOrderCheck(Check):
+    name = "lock-order"
+    check_id = "RTL002"
+    description = ("cycle in the static `with lock:` acquisition graph "
+                   "(potential ABBA deadlock)")
+
+    def __init__(self, options: dict):
+        super().__init__(options)
+        self.lock_re = re.compile(
+            options.get("lock-name-regex", DEFAULT_LOCK_NAME_RE), re.I)
+
+    def _lock_node(self, mod: Module, cls: Optional[str],
+                   expr: ast.AST) -> Optional[str]:
+        """`with self._lock:` in class C of module m -> "m:C._lock"."""
+        name = dotted_name(expr)
+        if name is None:
+            return None
+        leaf = name.rsplit(".", 1)[-1]
+        if not self.lock_re.search(leaf):
+            return None
+        modname = module_name_of(mod.relpath)
+        if name.startswith("self."):
+            scope = cls or ""
+            return f"{modname}:{scope}.{name[len('self.'):]}"
+        return f"{modname}:{name}"
+
+    # ------------------------------------------------------------ per-func
+    def _function_acquisitions(self, mod: Module, cls: Optional[str],
+                               fn: ast.AST):
+        """Yields (held_stack_tuple, lock_node, lineno) for every `with`
+        acquisition, plus (held_stack_tuple, call_target, lineno, True)
+        entries for calls made while holding locks."""
+        acquisitions: List[Tuple[Tuple[str, ...], str, int]] = []
+        calls_under_lock: List[Tuple[Tuple[str, ...], str, int]] = []
+
+        def walk(node: ast.AST, held: Tuple[str, ...]):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                return  # nested scopes analysed separately
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                new_held = held
+                for item in node.items:
+                    lock = self._lock_node(mod, cls, item.context_expr)
+                    if lock is not None:
+                        acquisitions.append((new_held, lock, node.lineno))
+                        new_held = new_held + (lock,)
+                    else:
+                        walk(item.context_expr, held)
+                for stmt in node.body:
+                    walk(stmt, new_held)
+                return
+            if isinstance(node, ast.Call) and held:
+                target = dotted_name(node.func)
+                if target is not None:
+                    calls_under_lock.append((held, target, node.lineno))
+            for child in ast.iter_child_nodes(node):
+                walk(child, held)
+
+        for stmt in fn.body:
+            walk(stmt, ())
+        return acquisitions, calls_under_lock
+
+    # ----------------------------------------------------------------- run
+    def run(self, project: Project) -> Iterable[Diagnostic]:
+        edges: Dict[Edge, Site] = {}
+        for mod in project.modules:
+            local_fns: Dict[Tuple[Optional[str], str], ast.AST] = {}
+            for cls, fn in mod.functions():
+                local_fns[(cls, fn.name)] = fn
+            # one pass per function; reused for the call-graph edges below
+            per_fn = {}
+            for cls, fn in mod.functions():
+                per_fn[(cls, fn.name)] = self._function_acquisitions(
+                    mod, cls, fn)
+            for (cls, _fname), (acqs, calls) in per_fn.items():
+                for held, lock, lineno in acqs:
+                    for outer in held:
+                        if outer != lock:
+                            edges.setdefault((outer, lock),
+                                             (mod.relpath, lineno))
+                for held, target, lineno in calls:
+                    callee = resolve_local_call(local_fns, cls, target)
+                    if callee is None:
+                        continue
+                    ccls, cfn = callee
+                    callee_acqs, _ = per_fn.get((ccls, cfn.name), ((), ()))
+                    for c_held, inner, _l in callee_acqs:
+                        if c_held:   # only locks taken while holding nothing
+                            continue
+                        for outer in held:
+                            if outer != inner:
+                                edges.setdefault((outer, inner),
+                                                 (mod.relpath, lineno))
+
+        yield from self._report_cycles(project, edges)
+
+
+    def _report_cycles(self, project: Project,
+                       edges: Dict[Edge, Site]) -> Iterable[Diagnostic]:
+        graph: Dict[str, Set[str]] = {}
+        for outer, inner in edges:
+            graph.setdefault(outer, set()).add(inner)
+            graph.setdefault(inner, set())
+        seen_cycles: Set[Tuple[str, ...]] = set()
+        for start in sorted(graph):
+            cycle = _find_cycle(graph, start)
+            if cycle is None:
+                continue
+            canon = _canonical(cycle)
+            if canon in seen_cycles:
+                continue
+            seen_cycles.add(canon)
+            # anchor the report at a target-module edge of the cycle
+            site = None
+            for i in range(len(cycle)):
+                edge = (cycle[i], cycle[(i + 1) % len(cycle)])
+                s = edges.get(edge)
+                if s is not None:
+                    mod = project.module(s[0])
+                    if mod is not None and mod.is_target:
+                        site = s
+                        break
+                    site = site or s
+            if site is None:
+                continue
+            chain = " -> ".join(cycle + (cycle[0],))
+            yield Diagnostic(
+                self.check_id, self.name, site[0], site[1], 0,
+                f"lock-order cycle: {chain}")
+
+
+def _find_cycle(graph: Dict[str, Set[str]],
+                start: str) -> Optional[Tuple[str, ...]]:
+    """DFS from start; returns the node sequence of a cycle through start's
+    reach, or None."""
+    path: List[str] = []
+    on_path: Set[str] = set()
+    done: Set[str] = set()
+
+    def dfs(node: str) -> Optional[Tuple[str, ...]]:
+        path.append(node)
+        on_path.add(node)
+        for nxt in sorted(graph.get(node, ())):
+            if nxt in on_path:
+                i = path.index(nxt)
+                return tuple(path[i:])
+            if nxt not in done:
+                found = dfs(nxt)
+                if found:
+                    return found
+        on_path.discard(node)
+        done.add(node)
+        path.pop()
+        return None
+
+    return dfs(start)
+
+
+def _canonical(cycle: Tuple[str, ...]) -> Tuple[str, ...]:
+    i = cycle.index(min(cycle))
+    return cycle[i:] + cycle[:i]

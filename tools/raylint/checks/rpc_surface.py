@@ -1,0 +1,193 @@
+"""RTL003 rpc-surface-drift.
+
+Invariant: RPC dispatch is stringly typed — clients name methods by string
+(`call_async("push_task", ...)`) and servers register handlers by
+convention (`handle_push_task` via register_all, or explicit
+`.register("name", fn)`). Nothing at runtime checks the two surfaces
+against each other until a call fails with "no handler"; a typo'd method
+name is a silent 60s timeout, not an import error. This check extracts
+both surfaces from the AST and errors on drift.
+
+Also validates chaos-rule targeting: a `ChaosRule(site=..., method=...)`
+whose globs match no real injection site / RPC method would silently
+never fire, making a chaos test vacuously green (the rule-validation
+cousin of fault_injection.ChaosRule.__post_init__'s site typo guard, but
+for method names, at lint time).
+"""
+
+from __future__ import annotations
+
+import ast
+from fnmatch import fnmatchcase
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.raylint.core import (
+    Check,
+    Diagnostic,
+    Project,
+    dotted_name,
+    register_check,
+    str_const,
+)
+
+DEFAULT_CALL_METHODS = ["call_async", "send_async", "call", "send",
+                        "call_future"]
+# only these path prefixes contribute to the REAL server surface: a
+# test-only throwaway handler must never mask a production call-site typo
+DEFAULT_SURFACE_PATHS = ["ray_tpu/"]
+DEFAULT_HANDLER_PREFIX = "handle_"
+# methods dispatched inside the transport itself, before handler lookup
+DEFAULT_EXTRA_HANDLERS = ["_register_peer"]
+DEFAULT_CHAOS_SITES = ["client_request", "before_execute", "after_reply",
+                       "mid_stream"]
+_CHAOS_RULE_FIELDS = ["action", "site", "method", "label", "peer"]
+
+
+@register_check
+class RpcSurfaceCheck(Check):
+    name = "rpc-surface-drift"
+    check_id = "RTL003"
+    description = ("string-named RPC call with no matching handler, or a "
+                   "chaos rule whose site/method glob matches nothing")
+
+    def __init__(self, options: dict):
+        super().__init__(options)
+        self.call_methods = set(options.get(
+            "call-methods", DEFAULT_CALL_METHODS))
+        self.handler_prefix = options.get(
+            "handler-prefix", DEFAULT_HANDLER_PREFIX)
+        self.extra_handlers = set(options.get(
+            "extra-handlers", DEFAULT_EXTRA_HANDLERS))
+        self.chaos_sites = list(options.get(
+            "chaos-sites", DEFAULT_CHAOS_SITES))
+        self.surface_paths = tuple(options.get(
+            "surface-paths", DEFAULT_SURFACE_PATHS))
+
+    # ------------------------------------------------------------- extract
+    def extract_handlers(self, project: Project) -> Dict[str, List[str]]:
+        """RPC surface: method name -> [definition sites]. Built from the
+        production tree only (reference modules included, so linting a
+        subset still sees the whole server side) — handlers registered by
+        tests on throwaway servers are NOT part of the surface."""
+        surface: Dict[str, List[str]] = {}
+        for mod in project.modules:
+            if not any(mod.relpath.startswith(p) for p in self.surface_paths):
+                continue
+            for name, site in self._module_handlers(mod):
+                surface.setdefault(name, []).append(site)
+        for name in self.extra_handlers:
+            surface.setdefault(name, []).append("<transport-internal>")
+        return surface
+
+    def _module_handlers(self, mod) -> List[Tuple[str, str]]:
+        """(name, definition site) for handle_* methods and register()
+        literals in one module, regardless of path."""
+        out: List[Tuple[str, str]] = []
+        for cls, fn in mod.functions():
+            if cls is not None and fn.name.startswith(self.handler_prefix):
+                name = fn.name[len(self.handler_prefix):]
+                out.append((name,
+                            f"{mod.relpath}:{fn.lineno} ({cls}.{fn.name})"))
+        for node in mod.nodes():
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "register"
+                    and len(node.args) == 2):
+                continue
+            name = str_const(node.args[0])
+            if name is not None:
+                out.append((name, f"{mod.relpath}:{node.lineno} (register)"))
+        return out
+
+    def extract_calls(self, project: Project) -> List[Tuple[str, str, int, str]]:
+        """[(method_name, relpath, lineno, via)] for every literal-named
+        client call in ray_tpu/ (tests excluded: they register ad-hoc
+        handlers on throwaway servers)."""
+        out = []
+        for mod in project.modules:
+            if not mod.relpath.startswith("ray_tpu/"):
+                continue
+            for node in mod.nodes():
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in self.call_methods):
+                    continue
+                if not node.args:
+                    continue
+                name = str_const(node.args[0])
+                if name is None:
+                    continue  # dynamic dispatch (method passed as variable)
+                out.append((name, mod.relpath, node.lineno, node.func.attr))
+        return out
+
+    def extract_chaos_rules(self, project: Project):
+        """[(relpath, lineno, {field: glob})] for literal ChaosRule(...)"""
+        out = []
+        for mod in project.modules:
+            for node in mod.nodes():
+                if not isinstance(node, ast.Call):
+                    continue
+                target = dotted_name(node.func)
+                if target is None or target.rsplit(".", 1)[-1] != "ChaosRule":
+                    continue
+                fields: Dict[str, str] = {}
+                for i, arg in enumerate(node.args):
+                    v = str_const(arg)
+                    if v is not None and i < len(_CHAOS_RULE_FIELDS):
+                        fields[_CHAOS_RULE_FIELDS[i]] = v
+                for kw in node.keywords:
+                    v = str_const(kw.value)
+                    if kw.arg and v is not None:
+                        fields[kw.arg] = v
+                out.append((mod.relpath, node.lineno, fields))
+        return out
+
+    # ----------------------------------------------------------------- run
+    def run(self, project: Project) -> Iterable[Diagnostic]:
+        surface = self.extract_handlers(project)
+        names: Set[str] = set(surface)
+
+        for name, relpath, lineno, via in self.extract_calls(project):
+            if name not in names:
+                hint = _closest(name, names)
+                hint_s = f" (did you mean {hint!r}?)" if hint else ""
+                yield Diagnostic(
+                    self.check_id, self.name, relpath, lineno, 0,
+                    f"RPC method {name!r} sent via .{via}() has no "
+                    f"handle_{name} handler or register() site "
+                    f"anywhere{hint_s}")
+
+        # chaos rules may also target handlers their OWN file registers on
+        # a throwaway server (raw-transport tests) — test-local names
+        # augment the surface for that file only, never globally
+        local_names: Dict[str, Set[str]] = {}
+        for relpath, lineno, fields in self.extract_chaos_rules(project):
+            site = fields.get("site")
+            if site is not None and not any(
+                    fnmatchcase(s, site) for s in self.chaos_sites):
+                yield Diagnostic(
+                    self.check_id, self.name, relpath, lineno, 0,
+                    f"chaos rule site glob {site!r} matches no injection "
+                    f"site {self.chaos_sites}")
+            method = fields.get("method")
+            if method is None or method == "*":
+                continue
+            if relpath not in local_names:
+                mod = project.module(relpath)
+                local_names[relpath] = ({n for n, _ in
+                                         self._module_handlers(mod)}
+                                        if mod is not None else set())
+            scope = names | local_names[relpath]
+            if not any(fnmatchcase(n, method) for n in scope):
+                yield Diagnostic(
+                    self.check_id, self.name, relpath, lineno, 0,
+                    f"chaos rule method glob {method!r} matches no RPC "
+                    f"method on any server surface (incl. handlers "
+                    f"registered in {relpath})")
+
+
+def _closest(name: str, names: Set[str]) -> Optional[str]:
+    import difflib
+
+    matches = difflib.get_close_matches(name, names, n=1, cutoff=0.75)
+    return matches[0] if matches else None

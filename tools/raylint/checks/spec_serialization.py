@@ -1,0 +1,156 @@
+"""RTL005 spec-serialization-drift.
+
+Invariant: the spec dataclasses in _private/specs.py ARE the wire format,
+and the hot-path compact codec (spec_to_wire/spec_from_wire and friends)
+must cover every field. Adding a field to TaskSpec without touching the
+codec silently drops it on the push_task_w fast path — the worker sees the
+default value, which is exactly the class of bug that cost PR 3 a day
+(sequence_number re-stamping). Pickle round-trips everything by
+construction; the flat-tuple codec round-trips only what someone
+remembered to write, so the linter remembers for them.
+
+For each configured (dataclass, writer, reader) triple:
+  * every dataclass field must be READ in the writer (as `<arg>.<field>`
+    or `getattr(<arg>, "<field>")`);
+  * every field must be WRITTEN by the reader (keyword or positional arg
+    of a `Dataclass(...)` call, or a `<var>.<field> = ...` assignment).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set
+
+from tools.raylint.core import (
+    Check,
+    Diagnostic,
+    Project,
+    register_check,
+)
+
+DEFAULT_SPECS_MODULE = "ray_tpu/_private/specs.py"
+DEFAULT_CODECS = [
+    {"dataclass": "TaskSpec", "writer": "spec_to_wire",
+     "reader": "spec_from_wire"},
+    {"dataclass": "TaskArg", "writer": "_arg_w", "reader": "_arg_r"},
+    {"dataclass": "Address", "writer": "_addr_w", "reader": "_addr_r"},
+    {"dataclass": "SchedulingStrategySpec", "writer": "_strat_w",
+     "reader": "_strat_r"},
+]
+
+
+@register_check
+class SpecSerializationCheck(Check):
+    name = "spec-serialization-drift"
+    check_id = "RTL005"
+    description = ("spec dataclass field missing from its wire codec "
+                   "(writer or reader) — the field would silently drop "
+                   "on the fast path")
+
+    def __init__(self, options: dict):
+        super().__init__(options)
+        self.specs_module = options.get("specs-module", DEFAULT_SPECS_MODULE)
+        self.codecs = options.get("codecs", DEFAULT_CODECS)
+
+    def run(self, project: Project) -> Iterable[Diagnostic]:
+        mod = project.module(self.specs_module)
+        if mod is None:
+            return
+        classes: Dict[str, ast.ClassDef] = {}
+        functions: Dict[str, ast.FunctionDef] = {}
+        for node in mod.tree.body:
+            if isinstance(node, ast.ClassDef):
+                classes[node.name] = node
+            elif isinstance(node, ast.FunctionDef):
+                functions[node.name] = node
+
+        for codec in self.codecs:
+            cls = classes.get(codec["dataclass"])
+            writer = functions.get(codec["writer"])
+            reader = functions.get(codec["reader"])
+            if cls is None:
+                yield self._diag(mod, 1, f"codec dataclass "
+                                 f"{codec['dataclass']!r} not found")
+                continue
+            fields = _dataclass_fields(cls)
+            if writer is None or reader is None:
+                missing = codec["writer"] if writer is None else codec["reader"]
+                yield self._diag(mod, cls.lineno,
+                                 f"codec function {missing!r} for "
+                                 f"{codec['dataclass']} not found")
+                continue
+            written = _fields_read(writer)
+            for fname, flineno in fields.items():
+                if fname not in written:
+                    yield self._diag(
+                        mod, flineno,
+                        f"{codec['dataclass']}.{fname} is never read by "
+                        f"{codec['writer']}() — the field would not survive "
+                        f"the wire")
+            restored = _fields_written(reader, codec["dataclass"],
+                                       list(fields))
+            for fname, flineno in fields.items():
+                if fname not in restored:
+                    yield self._diag(
+                        mod, flineno,
+                        f"{codec['dataclass']}.{fname} is never restored by "
+                        f"{codec['reader']}() — decoded specs would carry "
+                        f"the default")
+
+    def _diag(self, mod, lineno: int, msg: str) -> Diagnostic:
+        return Diagnostic(self.check_id, self.name, mod.relpath, lineno, 0,
+                          msg)
+
+
+def _dataclass_fields(cls: ast.ClassDef) -> Dict[str, int]:
+    """Annotated class-level fields (dataclass convention) -> def line."""
+    out: Dict[str, int] = {}
+    for stmt in cls.body:
+        if isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target, ast.Name):
+            # ClassVar would not be a field, but specs.py doesn't use them
+            out[stmt.target.id] = stmt.lineno
+    return out
+
+
+def _fields_read(writer: ast.FunctionDef) -> Set[str]:
+    """Attribute reads off the writer's first argument + getattr literals."""
+    if not writer.args.args:
+        return set()
+    arg0 = writer.args.args[0].arg
+    read: Set[str] = set()
+    for node in ast.walk(writer):
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and node.value.id == arg0:
+            read.add(node.attr)
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                and node.func.id == "getattr" and len(node.args) >= 2:
+            tgt, key = node.args[0], node.args[1]
+            if isinstance(tgt, ast.Name) and tgt.id == arg0 and \
+                    isinstance(key, ast.Constant) and isinstance(key.value, str):
+                read.add(key.value)
+    return read
+
+
+def _fields_written(reader: ast.FunctionDef, class_name: str,
+                    field_order: List[str]) -> Set[str]:
+    """Fields covered by `ClassName(...)` args + `x.field = ...` stores."""
+    out: Set[str] = set()
+    for node in ast.walk(reader):
+        if isinstance(node, ast.Call):
+            callee: Optional[str] = None
+            if isinstance(node.func, ast.Name):
+                callee = node.func.id
+            elif isinstance(node.func, ast.Attribute):
+                callee = node.func.attr
+            if callee == class_name:
+                for i, _ in enumerate(node.args):
+                    if i < len(field_order):
+                        out.add(field_order[i])
+                for kw in node.keywords:
+                    if kw.arg:
+                        out.add(kw.arg)
+        elif isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Attribute):
+                    out.add(tgt.attr)
+    return out

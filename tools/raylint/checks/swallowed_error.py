@@ -1,0 +1,90 @@
+"""RTL004 swallowed-recovery-error.
+
+Invariant: recovery paths (gcs/, raylet/, worker/) must never swallow a
+broad exception silently. PR 3's chaos harness found three real bugs that
+all shared one trait — a failure signal vanished into `except Exception:
+pass` and the system wedged instead of recovering. A silent broad except
+in a recovery path converts every future bug in that path from a logged
+error into an unexplained stall.
+
+Flags, inside the configured scope paths:
+  * bare `except:` anywhere (catches KeyboardInterrupt/SystemExit too);
+  * `except Exception:` / `except BaseException:` (incl. as part of a
+    tuple) whose body is silent — only pass/continue/`...`/docstring, no
+    raise, no logging, no use of the bound exception.
+
+A body that logs, re-raises, returns an error payload, or otherwise uses
+the exception is fine: the check targets silence, not breadth.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from tools.raylint.core import (
+    Check,
+    Diagnostic,
+    Project,
+    register_check,
+)
+
+DEFAULT_SCOPE_PATHS = ["ray_tpu/gcs/", "ray_tpu/raylet/", "ray_tpu/worker/"]
+BROAD_NAMES = {"Exception", "BaseException"}
+
+
+def _is_broad(type_node) -> bool:
+    if type_node is None:
+        return True  # bare except
+    if isinstance(type_node, ast.Name):
+        return type_node.id in BROAD_NAMES
+    if isinstance(type_node, ast.Tuple):
+        return any(_is_broad(el) for el in type_node.elts)
+    return False
+
+
+def _is_silent(handler: ast.ExceptHandler) -> bool:
+    """True when the handler body does nothing observable."""
+    for stmt in handler.body:
+        if isinstance(stmt, (ast.Pass, ast.Continue, ast.Break)):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
+            continue  # docstring / ellipsis
+        return False
+    return True
+
+
+@register_check
+class SwallowedErrorCheck(Check):
+    name = "swallowed-recovery-error"
+    check_id = "RTL004"
+    description = ("silent broad `except` in a gcs/raylet/worker recovery "
+                   "path (must log, re-raise, or surface the error)")
+
+    def __init__(self, options: dict):
+        super().__init__(options)
+        self.scope_paths = tuple(options.get(
+            "scope-paths", DEFAULT_SCOPE_PATHS))
+
+    def run(self, project: Project) -> Iterable[Diagnostic]:
+        for mod in project.target_modules():
+            if not any(mod.relpath.startswith(p) for p in self.scope_paths):
+                continue
+            for node in mod.nodes():
+                if not isinstance(node, ast.ExceptHandler):
+                    continue
+                if node.type is None:
+                    yield Diagnostic(
+                        self.check_id, self.name, mod.relpath,
+                        node.lineno, node.col_offset,
+                        "bare `except:` in a recovery path (also catches "
+                        "KeyboardInterrupt/SystemExit); catch Exception "
+                        "and log")
+                    continue
+                if _is_broad(node.type) and _is_silent(node):
+                    yield Diagnostic(
+                        self.check_id, self.name, mod.relpath,
+                        node.lineno, node.col_offset,
+                        "broad `except Exception` swallowed silently in a "
+                        "recovery path; log (logger.debug at minimum), "
+                        "re-raise, or surface the error")

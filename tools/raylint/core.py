@@ -1,0 +1,405 @@
+"""raylint check framework: project model, config, suppressions, output.
+
+Deliberately dependency-free (stdlib + tomli fallback) and JAX-free so the
+lint gate runs in <10s on the CI host with zero framework imports.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set, Type
+
+try:  # 3.11+
+    import tomllib as _toml
+except ImportError:  # pragma: no cover - py3.10 path
+    try:
+        import tomli as _toml
+    except ImportError:
+        _toml = None
+
+_SUPPRESS_RE = re.compile(r"#\s*raylint:\s*disable=([A-Za-z0-9_,\-\s]+)")
+_SUPPRESS_FILE_RE = re.compile(r"#\s*raylint:\s*disable-file=([A-Za-z0-9_,\-\s]+)")
+
+DEFAULT_EXCLUDES = ("__pycache__", ".git", "build", "dist", ".eggs")
+
+
+@dataclass
+class Diagnostic:
+    check_id: str      # stable short id, e.g. "RTL001"
+    check_name: str    # human name, e.g. "blocking-in-handler"
+    path: str          # project-relative, forward slashes
+    line: int
+    col: int
+    message: str
+    severity: str = "error"
+
+    def format(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"{self.check_id} [{self.check_name}] {self.message}")
+
+    def as_dict(self) -> dict:
+        return {
+            "check_id": self.check_id,
+            "check": self.check_name,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "severity": self.severity,
+        }
+
+
+class Module:
+    """One parsed source file: AST + per-line suppression table."""
+
+    def __init__(self, root: str, path: str, source: str,
+                 is_target: bool = True):
+        self.path = path
+        self.relpath = os.path.relpath(path, root).replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.is_target = is_target  # emit diagnostics for this file?
+        self.suppressions: Dict[int, Set[str]] = {}
+        self.file_suppressions: Set[str] = set()
+        self._functions: Optional[list] = None
+        self._nodes: Optional[list] = None
+        self._scan_suppressions()
+
+    def functions(self) -> list:
+        """Cached [(enclosing_class_or_None, funcdef)] — every check needs
+        this walk, so it is paid once per module."""
+        if self._functions is None:
+            self._functions = list(iter_functions(self.tree))
+        return self._functions
+
+    def nodes(self) -> list:
+        """Cached flat ast.walk list (checks iterate it several times)."""
+        if self._nodes is None:
+            self._nodes = list(ast.walk(self.tree))
+        return self._nodes
+
+    def _scan_suppressions(self):
+        for i, line in enumerate(self.lines, start=1):
+            m = _SUPPRESS_FILE_RE.search(line)
+            if m:
+                self.file_suppressions |= _split_names(m.group(1))
+                continue
+            m = _SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            names = _split_names(m.group(1))
+            self.suppressions.setdefault(i, set()).update(names)
+            # a comment-only line suppresses the next code line too
+            if line.split("#", 1)[0].strip() == "":
+                self.suppressions.setdefault(i + 1, set()).update(names)
+
+    def is_suppressed(self, check_name: str, line: int) -> bool:
+        if check_name in self.file_suppressions or \
+                "all" in self.file_suppressions:
+            return True
+        for probe in (line, line - 1):
+            names = self.suppressions.get(probe)
+            if names and (check_name in names or "all" in names):
+                # line-1 only counts when that previous line is comment-only
+                # (handled at scan time by double-registration) or carries
+                # the trailing comment of a multi-line statement opener.
+                if probe == line or _is_comment_tail(self.lines, probe):
+                    return True
+        return False
+
+
+def _is_comment_tail(lines: List[str], lineno: int) -> bool:
+    if not (1 <= lineno <= len(lines)):
+        return False
+    code = lines[lineno - 1].split("#", 1)[0].rstrip()
+    # a trailing comment on the previous physical line of a wrapped
+    # statement (e.g. `except Exception:  # raylint: disable=x`) applies
+    return code.endswith((":", "(", ",", "\\")) or code == ""
+
+
+def _split_names(blob: str) -> Set[str]:
+    # first whitespace-separated token of each comma part: lets trailing
+    # prose ride on the same comment ("disable=lock-order - reason why")
+    out = set()
+    for part in blob.split(","):
+        tokens = part.strip().split()
+        if tokens:
+            out.add(tokens[0])
+    return out
+
+
+@dataclass
+class LintConfig:
+    select: Optional[List[str]] = None     # check names; None = all
+    disable: List[str] = field(default_factory=list)
+    exclude: List[str] = field(default_factory=list)  # relpath globs
+    reference_paths: List[str] = field(default_factory=lambda: ["ray_tpu"])
+    options: Dict[str, dict] = field(default_factory=dict)  # per-check tables
+
+    @classmethod
+    def load(cls, root: str, explicit: Optional[str] = None) -> "LintConfig":
+        """Read `[tool.raylint]` from raylint.toml or pyproject.toml."""
+        candidates = ([explicit] if explicit else
+                      [os.path.join(root, "raylint.toml"),
+                       os.path.join(root, "pyproject.toml")])
+        for path in candidates:
+            if path and os.path.isfile(path):
+                table = _read_tool_table(path)
+                if table is not None:
+                    return cls._from_table(table)
+        return cls()
+
+    @classmethod
+    def _from_table(cls, table: dict) -> "LintConfig":
+        cfg = cls()
+        cfg.select = table.get("select")
+        cfg.disable = list(table.get("disable", []))
+        cfg.exclude = list(table.get("exclude", []))
+        cfg.reference_paths = list(table.get("reference-paths", ["ray_tpu"]))
+        for key, value in table.items():
+            if isinstance(value, dict):
+                cfg.options[key] = value
+        return cfg
+
+    def check_options(self, name: str) -> dict:
+        return self.options.get(name, {})
+
+
+def _read_tool_table(path: str) -> Optional[dict]:
+    if _toml is None:
+        return None
+    with open(path, "rb") as f:
+        data = _toml.load(f)
+    tool = data.get("tool", {})
+    return tool.get("raylint")
+
+
+class Project:
+    """All parsed modules for one lint run.
+
+    `target` modules get diagnostics; `reference` modules (always including
+    ray_tpu/ so whole-program checks see the full RPC surface and lock
+    graph even when linting a subset) are parsed but never reported on.
+    """
+
+    def __init__(self, root: str, config: LintConfig):
+        self.root = os.path.abspath(root)
+        self.config = config
+        self.modules: List[Module] = []
+        self._by_relpath: Dict[str, Module] = {}
+        self.parse_errors: List[Diagnostic] = []
+
+    # ---------------------------------------------------------------- build
+    @classmethod
+    def build(cls, root: str, paths: Iterable[str],
+              config: Optional[LintConfig] = None) -> "Project":
+        config = config or LintConfig.load(root)
+        proj = cls(root, config)
+        target_files: List[str] = []
+        for p in paths:
+            p = p if os.path.isabs(p) else os.path.join(root, p)
+            target_files.extend(_collect_py(p))
+        seen = set()
+        for f in target_files:
+            if f not in seen and not proj._excluded(f):
+                seen.add(f)
+                proj._add(f, is_target=True)
+        # reference modules: whole-program context for surface/graph checks
+        for ref in config.reference_paths:
+            ref_abs = ref if os.path.isabs(ref) else os.path.join(root, ref)
+            for f in _collect_py(ref_abs):
+                if f not in seen and not proj._excluded(f):
+                    seen.add(f)
+                    proj._add(f, is_target=False)
+        return proj
+
+    def _excluded(self, path: str) -> bool:
+        rel = os.path.relpath(path, self.root).replace(os.sep, "/")
+        parts = rel.split("/")
+        if any(part in DEFAULT_EXCLUDES for part in parts):
+            return True
+        return any(fnmatch.fnmatch(rel, pat) for pat in self.config.exclude)
+
+    def _add(self, path: str, is_target: bool):
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                source = f.read()
+            mod = Module(self.root, path, source, is_target=is_target)
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            if is_target:
+                rel = os.path.relpath(path, self.root).replace(os.sep, "/")
+                lineno = getattr(e, "lineno", 1) or 1
+                self.parse_errors.append(Diagnostic(
+                    "RTL000", "parse-error", rel, lineno, 0, str(e)))
+            return
+        self.modules.append(mod)
+        self._by_relpath[mod.relpath] = mod
+
+    # ---------------------------------------------------------------- query
+    def target_modules(self) -> List[Module]:
+        return [m for m in self.modules if m.is_target]
+
+    def module(self, relpath: str) -> Optional[Module]:
+        return self._by_relpath.get(relpath)
+
+    def modules_under(self, *prefixes: str) -> List[Module]:
+        return [m for m in self.modules
+                if any(m.relpath.startswith(p) for p in prefixes)]
+
+
+def _collect_py(path: str) -> List[str]:
+    if os.path.isfile(path):
+        return [os.path.abspath(path)] if path.endswith(".py") else []
+    out = []
+    for dirpath, dirnames, filenames in os.walk(path):
+        dirnames[:] = [d for d in dirnames if d not in DEFAULT_EXCLUDES]
+        for fn in filenames:
+            if fn.endswith(".py"):
+                out.append(os.path.abspath(os.path.join(dirpath, fn)))
+    return sorted(out)
+
+
+# ------------------------------------------------------------------ registry
+
+class Check:
+    """Base class: subclasses set name/check_id/description and implement
+    run(project) yielding Diagnostics (suppressions applied by the driver)."""
+
+    name: str = ""
+    check_id: str = ""
+    description: str = ""
+
+    def __init__(self, options: dict):
+        self.options = options
+
+    def run(self, project: Project) -> Iterable[Diagnostic]:  # pragma: no cover
+        raise NotImplementedError
+
+
+_REGISTRY: Dict[str, Type[Check]] = {}
+
+
+def register_check(cls: Type[Check]) -> Type[Check]:
+    assert cls.name and cls.check_id, cls
+    _REGISTRY[cls.name] = cls
+    return cls
+
+
+def all_checks() -> Dict[str, Type[Check]]:
+    # import side effect: the checks package registers everything
+    from tools.raylint import checks  # noqa: F401
+    return dict(_REGISTRY)
+
+
+# -------------------------------------------------------------------- driver
+
+def run_lint(root: str, paths: Iterable[str],
+             config: Optional[LintConfig] = None,
+             select: Optional[Iterable[str]] = None,
+             disable: Optional[Iterable[str]] = None) -> List[Diagnostic]:
+    """Run every enabled check over `paths`; returns unsuppressed diagnostics
+    sorted by (path, line). CLI-level select/disable override the config."""
+    config = config or LintConfig.load(root)
+    registry = all_checks()
+    enabled = set(select) if select else (
+        set(config.select) if config.select else set(registry))
+    enabled -= set(disable or ())
+    enabled -= set(config.disable)
+    unknown = enabled - set(registry)
+    if unknown:
+        raise ValueError(f"unknown check(s): {sorted(unknown)}; "
+                         f"known: {sorted(registry)}")
+
+    project = Project.build(root, paths, config)
+    diags: List[Diagnostic] = list(project.parse_errors)
+    for name in sorted(enabled):
+        check = registry[name](config.check_options(name))
+        for d in check.run(project):
+            mod = project.module(d.path)
+            if mod is not None and not mod.is_target:
+                continue
+            if mod is not None and mod.is_suppressed(d.check_name, d.line):
+                continue
+            diags.append(d)
+    diags.sort(key=lambda d: (d.path, d.line, d.col, d.check_id))
+    return diags
+
+
+def format_human(diags: List[Diagnostic]) -> str:
+    if not diags:
+        return "raylint: clean"
+    lines = [d.format() for d in diags]
+    lines.append(f"raylint: {len(diags)} error(s)")
+    return "\n".join(lines)
+
+
+def format_json(diags: List[Diagnostic]) -> str:
+    return json.dumps({"errors": [d.as_dict() for d in diags],
+                       "count": len(diags)}, indent=2)
+
+
+# ------------------------------------------------------------- AST utilities
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """`a.b.c` -> "a.b.c"; None for non-name expressions."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def str_const(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def iter_functions(tree: ast.AST):
+    """Yield (enclosing_class_name_or_None, funcdef) for every def/async def,
+    visiting each exactly once (nested defs keep their class context).
+    Iterative: this runs over every module for several checks."""
+    stack = [(tree, None)]
+    while stack:
+        node, cls = stack.pop()
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                stack.append((child, child.name))
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield cls, child
+                stack.append((child, cls))
+            else:
+                stack.append((child, cls))
+
+
+def resolve_local_call(local_fns: Dict, cls: Optional[str], target: str):
+    """Resolve a dotted call target to a same-module function for the
+    one-level call graph: `self.x` -> method of the calling class, bare
+    `x` -> module-level function. Returns (cls, funcdef) or None."""
+    if target.startswith("self."):
+        name = target[len("self."):]
+        if "." in name:
+            return None
+        fn = local_fns.get((cls, name))
+        return (cls, fn) if fn is not None else None
+    if "." in target:
+        return None
+    fn = local_fns.get((None, target))
+    return (None, fn) if fn is not None else None
+
+
+def module_name_of(relpath: str) -> str:
+    name = relpath[:-3] if relpath.endswith(".py") else relpath
+    name = name.replace("/", ".")
+    if name.endswith(".__init__"):
+        name = name[: -len(".__init__")]
+    return name
