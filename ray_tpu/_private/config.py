@@ -198,4 +198,13 @@ _d("gcs_external_store_down_after_s", 20.0)     # unreachable window before on_d
 _d("log_dir", "/tmp/rt_session/logs")
 _d("log_to_driver", True)
 
+# --- event log / flight recorder (_private/event_log.py) ---------------------
+_d("event_log_max_events", 4096)        # per-process post-mortem ring size
+_d("event_log_max_pending", 20_000)     # unflushed-queue bound (overflow = drop)
+_d("event_log_flush_interval_s", 1.0)   # flusher batch window
+_d("flight_recorder_dir", "")           # "" = <session>/flight next to log_dir
+# dump the ring on EVERY process exit (worker/raylet/gcs mains pass
+# on_exit=True explicitly; this flips it for drivers too)
+_d("flight_recorder_on_exit", False)
+
 CONFIG.load_from_env()

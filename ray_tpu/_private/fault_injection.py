@@ -282,6 +282,20 @@ class ChaosPlan:
 
 # -- install / uninstall ------------------------------------------------------
 
+def _emit_event(etype: str, proc: str = "", **data) -> None:
+    """Chaos firings into the lifecycle event log (_private/event_log) so
+    an injection run's ACTUAL history is auditable after `chaos stop`
+    (`ray-tpu chaos status`, `ray-tpu debug postmortem`). Lazy import +
+    best-effort: chaos must keep working in a process where the event
+    log cannot."""
+    try:
+        from ray_tpu._private import event_log
+
+        event_log.emit(etype, proc=proc or None, **data)
+    except Exception:  # noqa: BLE001 — observability never blocks faults
+        pass
+
+
 def install(plan: ChaosPlan) -> ChaosPlan:
     """Install a plan process-wide. Replaces any existing plan."""
     global PLAN
@@ -291,6 +305,8 @@ def install(plan: ChaosPlan) -> ChaosPlan:
         logger.warning(
             "chaos plan INSTALLED (seed=%d, %d rules, %d partitions)",
             plan.seed, len(plan.rules), len(plan.partitions))
+    _emit_event("chaos.plan", op="install", seed=plan.seed,
+                rules=len(plan.rules))
     return plan
 
 
@@ -302,6 +318,8 @@ def uninstall() -> Optional[ChaosPlan]:
     if plan is not None:
         logger.warning("chaos plan UNINSTALLED (%d injections fired)",
                        plan._seq)
+        _emit_event("chaos.plan", op="uninstall", seed=plan.seed,
+                    rules=len(plan.rules))
     return plan
 
 
@@ -337,6 +355,27 @@ def _connection_lost(msg: str, maybe_delivered: bool):
     return ConnectionLost(msg, maybe_delivered=maybe_delivered)
 
 
+def _rule_index(plan: ChaosPlan, rule: ChaosRule) -> int:
+    """Identity (not equality) index: a plan may contain equal rules."""
+    for i, r in enumerate(plan.rules):
+        if r is rule:
+            return i
+    return -1
+
+
+def _flight_dump_before_kill(site: str, method: str) -> None:
+    """A chaos `kill` is os._exit — no atexit, no signal handler, no
+    chance for the flight recorder to fire on its own. Dump the ring
+    buffer explicitly so the simulated crash still leaves its black box
+    for `ray-tpu debug postmortem`."""
+    try:
+        from ray_tpu._private import event_log
+
+        event_log.flight_dump(f"chaos_kill:{site}:{method}")
+    except Exception:  # noqa: BLE001 — a dying process must still die
+        pass
+
+
 # The chaos control plane itself is exempt from injection: a plan that
 # matched these methods (e.g. drop-everything on a raylet) would destroy
 # the only remote off-switch — `ray-tpu chaos stop` could never uninstall.
@@ -354,11 +393,16 @@ async def intercept(site: str, method: str = "", label: str = "",
     if site == SITE_CLIENT_REQUEST and plan.partitions and plan.is_partitioned(
             local_id or label, peer):
         plan.record(site, method, label, peer, "partition")
+        _emit_event("chaos.partition", proc=label, site=site, method=method,
+                    label=local_id or label, peer=peer)
         raise _connection_lost(
             f"chaos: partition between {local_id or label!r} and {peer!r}",
             maybe_delivered=False)
     terminal: Optional[str] = None
     for rule in plan.decide(site, method, label, peer):
+        _emit_event("chaos.inject", proc=label, site=site, method=method,
+                    label=label, peer=peer, action=rule.action,
+                    rule=_rule_index(plan, rule))
         if rule.action == "delay":
             import asyncio
 
@@ -369,6 +413,7 @@ async def intercept(site: str, method: str = "", label: str = "",
                 maybe_delivered=rule.maybe_delivered)
         elif rule.action == "kill":
             logger.warning("chaos: killing process at %s (%s)", site, method)
+            _flight_dump_before_kill(site, method)
             os._exit(1)
         elif terminal is None:
             terminal = rule.action
@@ -384,6 +429,9 @@ def intercept_sync(site: str, method: str = "", label: str = "",
         return None
     terminal: Optional[str] = None
     for rule in plan.decide(site, method, label, peer):
+        _emit_event("chaos.inject", proc=label, site=site, method=method,
+                    label=label, peer=peer, action=rule.action,
+                    rule=_rule_index(plan, rule))
         if rule.action == "delay":
             time.sleep(rule.delay_s)
         elif rule.action == "error":
@@ -392,6 +440,7 @@ def intercept_sync(site: str, method: str = "", label: str = "",
                 maybe_delivered=rule.maybe_delivered)
         elif rule.action == "kill":
             logger.warning("chaos: killing process at %s (%s)", site, method)
+            _flight_dump_before_kill(site, method)
             os._exit(1)
         elif terminal is None:
             terminal = rule.action

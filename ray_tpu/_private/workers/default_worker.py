@@ -118,6 +118,10 @@ def run_worker(raylet_address: str, gcs_address: str, node_id: str,
     import faulthandler
 
     faulthandler.register(signal.SIGUSR1, all_threads=True)
+    # Crash flight recorder: the CoreWorker ctor armed atexit/excepthook
+    # (install_flight_recorder(on_exit=True)); the _term handler above
+    # routes SIGTERM through sys.exit(0) -> atexit, so even a pool
+    # `terminate()` leaves this worker's black box in the session dir.
 
     # The RPC loop threads do the work; park the main thread.
     try:

@@ -48,6 +48,7 @@ __all__ = [
     "ChaosError", "ChaosPlan", "ChaosRule",
     "active_plan", "install", "load_env_plan", "uninstall",
     "start_cluster", "stop_cluster", "cluster_status",
+    "injection_history",
 ]
 
 
@@ -86,3 +87,36 @@ def status() -> Optional[dict]:
     """In-process plan stats (None when no plan is installed)."""
     plan = active_plan()
     return plan.stats() if plan is not None else None
+
+
+def injection_history(gcs_address: str, timeout: float = 30.0,
+                      limit: int = 100_000) -> dict:
+    """A chaos run's ACTUAL injection history, sourced from the cluster
+    lifecycle EVENT LOG rather than the in-memory plan: per-rule match
+    counts stay auditable after `chaos stop` dropped the plan object (and
+    they include firings from worker processes whose plan stats never
+    reach the GCS)."""
+    events = _gcs_call(gcs_address, "get_cluster_events",
+                       {"type": "chaos.*", "limit": limit}, timeout)
+    by_rule: dict = {}
+    by_action: dict = {}
+    recent = []
+    for ev in reversed(events):  # chronological
+        data = ev.get("data") or {}
+        if ev.get("type") == "chaos.inject":
+            rule = data.get("rule", -1)
+            by_rule[rule] = by_rule.get(rule, 0) + 1
+            action = data.get("action", "?")
+        elif ev.get("type") == "chaos.partition":
+            action = "partition"
+        else:  # chaos.plan install/uninstall markers
+            action = f"plan.{data.get('op', '?')}"
+        by_action[action] = by_action.get(action, 0) + 1
+        recent.append({"time": ev.get("time"), "proc": ev.get("proc"),
+                       "type": ev.get("type"), **data})
+    return {
+        "injections": sum(by_rule.values()),
+        "by_rule": {str(k): v for k, v in sorted(by_rule.items())},
+        "by_action": by_action,
+        "recent": recent[-20:],
+    }

@@ -233,6 +233,25 @@ class DashboardHead:
                 "get_task_events", {"job_id": None, "limit": 100_000},
                 timeout=30)
             self._json(req, build_chrome_trace(events))
+        elif path == "/api/events":
+            # cluster-wide lifecycle event feed (same filters as the
+            # `ray-tpu events` CLI: type glob + id exact-matches)
+            from urllib.parse import parse_qs, urlparse
+
+            q = parse_qs(urlparse(req.path).query)
+
+            def _one(key):
+                return q.get(key, [None])[0]
+
+            self._json(req, {
+                "events": self._gcs.call("get_cluster_events", {
+                    "limit": int(_one("limit") or 1000),
+                    "type": _one("type"), "task_id": _one("task_id"),
+                    "actor_id": _one("actor_id"),
+                    "node_id": _one("node_id")}, timeout=30),
+                "stats": self._gcs.call("get_event_log_stats", {},
+                                        timeout=30),
+            })
         elif path == "/api/agents":
             self._json(req, self._agents())
         elif path.startswith("/api/nodes/") and path.count("/") >= 4:

@@ -26,8 +26,10 @@ from ray_tpu._private.specs import (
     TaskSpec,
 )
 from ray_tpu.gcs import pubsub as ps
+from ray_tpu._private import event_log
 
 logger = logging.getLogger(__name__)
+_elog = event_log.logger_for("gcs")
 
 
 class GcsActorManager:
@@ -133,6 +135,8 @@ class GcsActorManager:
             self._actors[creation.actor_id] = info
             self._creation_specs[creation.actor_id] = spec
             self._persist(creation.actor_id)
+        _elog.emit("actor.pending", actor_id=creation.actor_id.hex(),
+                   class_name=spec.function_name)
         asyncio.ensure_future(self._schedule_actor(creation.actor_id))
         return {"status": "registered", "info": info}
 
@@ -192,6 +196,10 @@ class GcsActorManager:
         self._by_node.setdefault(address.node_id, set()).add(actor_id)
         self._persist(actor_id)
         self._pub.publish(ps.ACTOR_CHANNEL, actor_id, info)
+        _elog.emit("actor.alive", actor_id=actor_id.hex(),
+                   node_id=(address.node_id.hex()
+                            if address.node_id else None),
+                   address=address.rpc_address, restarts=info.num_restarts)
         return True
 
     async def handle_report_actor_death(self, payload):
@@ -235,6 +243,10 @@ class GcsActorManager:
             info.address = None
             self._persist(actor_id)
             self._pub.publish(ps.ACTOR_CHANNEL, actor_id, info)
+            # THE restart decision: failure observed, budget allows another
+            # incarnation — the record chaos post-mortems pivot on
+            _elog.emit("actor.restarting", actor_id=actor_id.hex(),
+                       reason=reason, restarts=info.num_restarts)
             await asyncio.sleep(CONFIG.actor_restart_delay_ms / 1000.0)
             asyncio.ensure_future(self._schedule_actor(actor_id))
         else:
@@ -254,6 +266,7 @@ class GcsActorManager:
         self._creation_specs.pop(actor_id, None)
         self._persist(actor_id)
         self._pub.publish(ps.ACTOR_CHANNEL, actor_id, info)
+        _elog.emit("actor.dead", actor_id=actor_id.hex(), reason=reason)
 
     async def _schedule_actor(self, actor_id: ActorID):
         """Lease a worker somewhere and push the creation task to it."""

@@ -27,8 +27,10 @@ from ray_tpu._private.specs import (
     subtract_resources,
 )
 from ray_tpu.gcs import pubsub as ps
+from ray_tpu._private import event_log
 
 logger = logging.getLogger(__name__)
+_elog = event_log.logger_for("gcs")
 
 
 class GcsPlacementGroupManager:
@@ -123,6 +125,7 @@ class GcsPlacementGroupManager:
         if info is None:
             return False
         info.state = PlacementGroupState.REMOVED
+        _elog.emit("pg.state", state="REMOVED", pg=pg_id.hex())
         if info.spec.name:
             self._named.pop(info.spec.name, None)
         self._persist(pg_id)
@@ -186,6 +189,8 @@ class GcsPlacementGroupManager:
             if not lost:
                 continue
             info.state = PlacementGroupState.RESCHEDULING
+            _elog.emit("pg.state", state="RESCHEDULING",
+                       node_id=node_id.hex(), pg=pg_id.hex())
             self._ready_events[pg_id] = asyncio.Event()
             for i in lost:
                 info.bundle_locations.pop(i, None)
@@ -357,6 +362,7 @@ class GcsPlacementGroupManager:
             return
         if len(info.bundle_locations) == len(info.spec.bundles):
             info.state = PlacementGroupState.CREATED
+            _elog.emit("pg.state", state="CREATED", pg=pg_id.hex())
             self._persist(pg_id)
             ev = self._ready_events.get(pg_id)
             if ev is not None:

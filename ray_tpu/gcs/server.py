@@ -20,10 +20,13 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import threading
 import time
 from collections import deque
+from fnmatch import fnmatchcase
 from typing import Dict, List, Optional
 
+from ray_tpu._private import event_log
 from ray_tpu._private.config import CONFIG
 from ray_tpu._private.ids import JobID, NodeID
 from ray_tpu._private.rpc import ClientPool, EventLoopThread, RpcServer
@@ -40,6 +43,7 @@ from ray_tpu.gcs.pg_manager import GcsPlacementGroupManager
 from ray_tpu.gcs.storage import make_store
 
 logger = logging.getLogger(__name__)
+_elog = event_log.logger_for("gcs")
 
 
 class GcsNodeManager:
@@ -112,6 +116,8 @@ class GcsNodeManager:
         self._bump_node(info.node_id)
         self._persist_node(info.node_id)
         self._pub.publish(ps.NODE_CHANNEL, info.node_id, info)
+        _elog.emit("node.alive", node_id=info.node_id.hex(),
+                   address=info.raylet_address)
         logger.info("node %s registered (%s)", info.node_id.hex()[:8], info.raylet_address)
         return True
 
@@ -331,6 +337,7 @@ class GcsNodeManager:
         self._last_heartbeat.pop(node_id, None)
         self._persist_node(node_id)
         self._pub.publish(ps.NODE_CHANNEL, node_id, info)
+        _elog.emit("node.dead", node_id=node_id.hex(), expected=expected)
         for cb in self._death_listeners:
             try:
                 await cb(node_id)
@@ -489,14 +496,122 @@ class GcsTaskEventManager:
     async def handle_get_task_events(self, payload):
         limit = payload.get("limit", 10_000)
         job_id = payload.get("job_id")
+        # server-side task filter: per-task timelines must not ship the
+        # whole 100k-event deque over the wire to keep a handful of rows
+        task_id = payload.get("task_id")
         out = []
         for ev in reversed(self._events):
             if job_id is not None and ev.get("job_id") != job_id:
+                continue
+            if task_id is not None and ev.get("task_id") != task_id:
                 continue
             out.append(ev)
             if len(out) >= limit:
                 break
         return out
+
+
+class GcsEventManager:
+    """Cluster-wide structured lifecycle event store (the generalized
+    sibling of GcsTaskEventManager; reference lineage: gcs_task_manager.cc
+    fed by per-worker buffers — here fed by every process's
+    _private/event_log flusher).
+
+    Thread-safe: the embedded deployment's direct sink appends from the
+    event-log flusher THREAD while handlers read on the gcs-io loop.
+    """
+
+    def __init__(self, max_events: int = 200_000):
+        self._events = deque(maxlen=max_events)
+        self._lock = threading.Lock()
+        # "<source>#<pid>" -> last flush stats (depth / dropped / emitted)
+        self._sources: Dict[str, dict] = {}
+        self._type_counts: Dict[str, int] = {}
+
+    def add_local(self, events: List[dict], stats: Optional[dict]) -> None:
+        """Direct sink for an in-process event_log (embedded head node):
+        same path the RPC handler takes, minus the wire."""
+        with self._lock:
+            for ev in events:
+                self._events.append(ev)
+                t = ev.get("type", "?")
+                self._type_counts[t] = self._type_counts.get(t, 0) + 1
+            if stats:
+                # keyed by pid: a process whose label refines during
+                # bring-up ("proc:N" -> "driver:N") stays one row
+                now = time.time()
+                self._sources[stats.get("pid")] = dict(
+                    stats, received=now)
+                if len(self._sources) > 512:
+                    # worker churn: age out sources silent past the
+                    # staleness window (stats reporting marks them stale
+                    # first), evicting oldest-first past the cap so dead
+                    # pids can't grow this forever (and a recycled pid
+                    # can't inherit a dead process's counters for long)
+                    for pid, _ in sorted(
+                            self._sources.items(),
+                            key=lambda kv: kv[1].get("received", 0.0)
+                    )[:len(self._sources) - 512]:
+                        self._sources.pop(pid, None)
+
+    async def handle_add_cluster_events(self, payload):
+        self.add_local(payload.get("events") or [],
+                       payload.get("stats"))
+        return True
+
+    async def handle_get_cluster_events(self, payload):
+        """Filtered query, newest-first (callers re-sort for timelines).
+        Filters: type (glob), task_id/actor_id/node_id/object_id (exact),
+        since (wall time), limit."""
+        limit = payload.get("limit", 10_000)
+        type_glob = payload.get("type")
+        since = payload.get("since")
+        id_filters = [(k, payload[k]) for k in
+                      ("task_id", "actor_id", "node_id", "object_id")
+                      if payload.get(k)]
+        out = []
+        with self._lock:
+            events = list(self._events)
+        for ev in reversed(events):
+            if since is not None and ev.get("time", 0) < since:
+                continue  # arrival order only approximates event time
+            if type_glob and not fnmatchcase(ev.get("type", ""), type_glob):
+                continue
+            if any(ev.get(k) != v for k, v in id_filters):
+                continue
+            out.append(ev)
+            if len(out) >= limit:
+                break
+        return out
+
+    async def handle_get_event_log_stats(self, payload):
+        """Pipeline visibility: per-source buffer depth / flush lag /
+        cumulative drops (so silent drops are visible in `ray-tpu
+        status`), plus per-type totals."""
+        now = time.time()
+        with self._lock:
+            # prune sources silent for >10min: exited workers must not
+            # read as ever-worsening flush lag forever (a WEDGED live
+            # process still shows up — its own gauges keep exporting
+            # locally, and it stays listed as stale for the full window)
+            for pid in [p for p, st in self._sources.items()
+                        if now - st.get("received", now) > 600.0]:
+                self._sources.pop(pid, None)
+            return {
+                "total_events": len(self._events),
+                "by_type": dict(self._type_counts),
+                "sources": {
+                    f"{st.get('source')}#{pid}": {
+                        "depth": st.get("depth", 0),
+                        "dropped": st.get("dropped", 0),
+                        "emitted": st.get("emitted", 0),
+                        "flush_lag_s": max(0.0, now - st.get(
+                            "received", now)),
+                        "stale": now - st.get("received", now) > 30.0,
+                    }
+                    for pid, st in self._sources.items()
+                },
+            }
 
 
 class GcsServer:
@@ -540,6 +655,11 @@ class GcsServer:
                 logger.warning(
                     "pubsub recovery: skipping torn subscription %r", key)
         self.task_event_manager = GcsTaskEventManager()
+        self.event_manager = GcsEventManager()
+        # The head process's lifecycle events skip the wire entirely; the
+        # token scopes teardown so a later sink owner isn't clobbered.
+        self._event_sink_token = event_log.set_sink(
+            self.event_manager.add_local)
         self.node_manager.pg_locator = self.pg_manager
         self.node_manager.add_death_listener(self.actor_manager.on_node_death)
         self.node_manager.add_death_listener(self.pg_manager.on_node_death)
@@ -555,6 +675,7 @@ class GcsServer:
             self.actor_manager,
             self.pg_manager,
             self.task_event_manager,
+            self.event_manager,
         ):
             self._server.register_all(mgr)
         self._server.register("drain_node", self._handle_drain_node)
@@ -717,6 +838,8 @@ class GcsServer:
         return True
 
     def stop(self):
+        event_log.flush(timeout=0.5)  # pull in the head's own tail events
+        event_log.clear_sink(self._event_sink_token)
         if self._health_task is not None:
             self._health_task.cancel()
         self.publisher.close()
@@ -741,6 +864,8 @@ def main():
                              "--storage-path")
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
+    event_log.set_default_proc_label("gcs")
+    event_log.install_flight_recorder(on_exit=True)
     server = GcsServer(host=args.host, storage_path=args.storage_path,
                        external_store=args.external_store)
     addr = server.start(args.port)

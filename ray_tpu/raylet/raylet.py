@@ -26,6 +26,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
+from ray_tpu._private import event_log
 from ray_tpu._private.config import CONFIG
 from ray_tpu._private.ids import NodeID, PlacementGroupID, WorkerID
 from ray_tpu._private.rpc import (
@@ -120,6 +121,8 @@ class Raylet:
         self.node_id = NodeID.from_random()
         self.gcs_address = gcs_address
         self.is_head = is_head
+        self._elog = event_log.logger_for("raylet", self.node_id.hex()[:12])
+        self._event_sink_token = None
         self._lt = EventLoopThread(f"raylet-{self.node_id.hex()[:6]}")
         self._server = RpcServer(self._lt, host, label="raylet")
         self._pool = ClientPool(self._lt, peer_meta={"label": "raylet"},
@@ -228,6 +231,16 @@ class Raylet:
                               peer_meta={"label": "raylet"}, label="raylet")
         self._gcs.local_id = self.address
         self._pool.set_local_id(self.address)
+        # Lifecycle-event flush path for a standalone raylet process: one
+        # batched RPC per flush window. An embedded head already has the
+        # GCS's direct sink installed (set_sink is first-wins).
+        gcs_client = self._gcs
+
+        def _ship_events(events, stats):
+            gcs_client.send("add_cluster_events",
+                            {"events": events, "stats": stats})
+
+        self._event_sink_token = event_log.set_sink(_ship_events)
         info = NodeInfo(
             node_id=self.node_id,
             raylet_address=self.address,
@@ -618,6 +631,8 @@ class Raylet:
                     finally:
                         c.release(key)
                     self._spilled[key] = uri
+                    self._elog.emit("object.spill", object_id=key.hex(),
+                                    node_id=self.node_id.hex(), uri=uri)
                     if self._spill_backend.is_remote:
                         # Recorded per object, BEFORE anything that can
                         # fail later in the batch: a spilled-and-deleted
@@ -749,6 +764,8 @@ class Raylet:
         ok = await asyncio.to_thread(_restore)
         if ok:
             self._spilled[key] = uri  # cache for the next restore/free
+            self._elog.emit("object.restore", object_id=key.hex(),
+                            node_id=self.node_id.hex(), uri=uri)
         return ok
 
     async def _lookup_spill_uri(self, key: bytes) -> Optional[str]:
@@ -811,6 +828,9 @@ class Raylet:
         if self._stopped:
             return
         self._stopped = True
+        if self._event_sink_token is not None:
+            event_log.flush(timeout=0.5)
+            event_log.clear_sink(self._event_sink_token)
         for t in self._tasks:
             t.cancel()
         if self._store_client is not None:
@@ -871,6 +891,10 @@ class Raylet:
             # A draining node takes no new work; the submitter retries
             # against the rest of the cluster (whose views drop this node
             # as its heartbeats report zero availability).
+            self._elog.emit("lease.reject", task_id=spec.task_id.hex(),
+                            node_id=self.node_id.hex(),
+                            function=spec.function_name,
+                            reason="node is draining")
             return {"rejected": True, "reason": "node is draining"}
 
         if strat.kind == "PLACEMENT_GROUP":
@@ -898,6 +922,10 @@ class Raylet:
             if target is not None and target != self.node_id:
                 addr = self._raylet_addr_for(target)
                 if addr is not None:
+                    self._elog.emit(
+                        "lease.spillback", task_id=spec.task_id.hex(),
+                        node_id=self.node_id.hex(),
+                        function=spec.function_name, target=addr)
                     return {
                         "retry_at": addr,
                         "retry_at_node_id": target,
@@ -909,6 +937,10 @@ class Raylet:
             # node can host never triggers scale-up.
             shape = (tuple(sorted(_placement_res(spec).items())), ())
             self._infeasible[shape] = time.monotonic()
+            self._elog.emit("lease.reject", task_id=spec.task_id.hex(),
+                            node_id=self.node_id.hex(),
+                            function=spec.function_name,
+                            reason="infeasible on this node")
             return {"rejected": True, "reason": "infeasible on this node"}
         return await self._queue_local(spec)
 
@@ -1060,6 +1092,10 @@ class Raylet:
             worker_id=worker.worker_id,
             rpc_address=worker.address.rpc_address,
         )
+        self._elog.emit("lease.grant", task_id=q.spec.task_id.hex(),
+                        node_id=self.node_id.hex(),
+                        function=q.spec.function_name,
+                        worker_id=worker.worker_id.hex())
         q.future.set_result({"worker_address": addr})
 
     def _release_alloc(self, resources: Resources, pg_id, bundle_index):
@@ -1142,6 +1178,8 @@ class Raylet:
             return {"status": "already_draining"}
         self._draining = True
         self.drain_reason = payload.get("reason", "")
+        self._elog.emit("node.drain", node_id=self.node_id.hex(),
+                        reason=self.drain_reason)
         deadline_s = float(payload.get("deadline_s", 300.0))
         for q in list(self._queue):
             if not q.future.done():
@@ -1220,7 +1258,9 @@ class Raylet:
         recovery paths as a crashed host. Only meaningful for raylets
         running as their own process (`python -m ray_tpu start`)."""
         threading.Thread(
-            target=lambda: (time.sleep(0.05), os._exit(1)),
+            target=lambda: (time.sleep(0.05),
+                            event_log.flight_dump("die_rpc"),
+                            os._exit(1)),
             daemon=True).start()
         return True
 
@@ -1478,6 +1518,12 @@ class Raylet:
                       f"({self.drain_reason or 'bundle released'})"
                       if handle.evicted
                       else f"actor worker process died (exit code {code})")
+            # the recovery DECISION: intended deaths stay dead, the rest
+            # enter the GCS restart FSM (report_actor_death)
+            self._elog.emit("worker.death_report",
+                            actor_id=handle.actor_id.hex(),
+                            node_id=self.node_id.hex(),
+                            intended=intended, reason=reason)
             self._lt.submit(
                 self._gcs.send_async(
                     "report_actor_death",

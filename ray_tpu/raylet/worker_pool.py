@@ -21,6 +21,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
+from ray_tpu._private import event_log
 from ray_tpu._private.config import CONFIG
 from ray_tpu._private.ids import WorkerID
 from ray_tpu._private.specs import Address
@@ -179,6 +180,7 @@ class WorkerPool:
         env: Optional[dict] = None,
     ):
         self._node_id_hex = node_id_hex
+        self._elog = event_log.logger_for("raylet", node_id_hex[:12])
         self._raylet_address = raylet_address
         self._gcs_address = gcs_address
         self._loop = loop
@@ -201,6 +203,17 @@ class WorkerPool:
         # it from RT_STORE_SOCKET and register one-way (no reply needed)
         self.store_socket: Optional[str] = None
         os.makedirs(log_dir, exist_ok=True)
+
+    def _emit_state(self, handle: "WorkerHandle", **extra) -> None:
+        """Record a worker-handle FSM transition in the lifecycle event
+        log (idle/leased/actor/dead — the states post-mortems need to tie
+        a task's worker to its fate)."""
+        self._elog.emit(
+            "worker.state", node_id=self._node_id_hex,
+            actor_id=handle.actor_id.hex() if handle.actor_id else None,
+            state=handle.state, pid=handle.pid,
+            worker_id=handle.worker_id.hex() if handle.worker_id else "",
+            **extra)
 
     def start(self):
         self._monitor_task = self._loop.create_task(self._monitor_loop())
@@ -491,6 +504,7 @@ class WorkerPool:
         handle.worker_id = worker_id
         handle.address = address
         handle.state = "idle"
+        self._emit_state(handle)
         handle.idle_since = time.monotonic()
         self._registered[worker_id] = handle
         self._wake_waiters(n=1, needs_accelerator=handle.needs_accelerator,
@@ -640,6 +654,7 @@ class WorkerPool:
                     claimed.env_hash = env_hash
                 if claimed is not None:
                     claimed.state = "leased"
+                    self._emit_state(claimed)
                     return claimed
                 spawn_filter = env_hash if image_uri else None
                 direct = not self._zygote_eligible(
@@ -691,6 +706,7 @@ class WorkerPool:
             self._kill(handle)
             return
         handle.state = "idle"
+        self._emit_state(handle)
         handle.idle_since = time.monotonic()
         self._wake_waiters(n=1, needs_accelerator=handle.needs_accelerator,
                            env_hash=handle.env_hash)
@@ -700,6 +716,7 @@ class WorkerPool:
         if handle is not None:
             handle.state = "actor"
             handle.actor_id = actor_id
+            self._emit_state(handle)
 
     def get_by_worker_id(self, worker_id: WorkerID) -> Optional[WorkerHandle]:
         return self._registered.get(worker_id)
@@ -720,6 +737,7 @@ class WorkerPool:
 
     def _kill(self, handle: WorkerHandle):
         handle.state = "dead"
+        self._emit_state(handle, reason="killed by pool")
         if handle.proc is not None and handle.proc.poll() is None:
             try:
                 handle.proc.terminate()
@@ -753,6 +771,8 @@ class WorkerPool:
                     if handle.state != "dead":
                         prev_state = handle.state
                         handle.state = "dead"
+                        self._emit_state(
+                            handle, reason=f"process exit (was {prev_state})")
                         handle.dead_since = now
                         try:
                             self._on_worker_death(handle, prev_state)

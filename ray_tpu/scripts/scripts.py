@@ -217,7 +217,7 @@ def _connect(args):
 
 def cmd_status(args) -> int:
     ray_tpu = _connect(args)
-    from ray_tpu.util.state import list_nodes
+    from ray_tpu.util.state import cluster_event_stats, list_nodes
 
     nodes = list_nodes()
     total = ray_tpu.cluster_resources()
@@ -231,6 +231,19 @@ def cmd_status(args) -> int:
     print("\nResources:")
     for k in sorted(total):
         print(f"  {avail.get(k, 0):g}/{total[k]:g} {k}")
+    # Event-pipeline health: silent drops anywhere in the cluster must be
+    # visible here, not discovered during the next post-mortem.
+    try:
+        ev = cluster_event_stats()
+    except Exception as e:  # noqa: BLE001 — status degrades, not dies
+        print(f"\nEvent log: unavailable ({e})")
+        return 0
+    print(f"\nEvent log: {ev.get('total_events', 0)} events in the GCS "
+          "buffer")
+    for src, st in sorted((ev.get("sources") or {}).items()):
+        print(f"  {src.split('#')[0]:<22} depth={st['depth']} "
+              f"flush_lag={st['flush_lag_s']:.1f}s "
+              f"dropped={st['dropped']} emitted={st['emitted']}")
     return 0
 
 
@@ -308,7 +321,7 @@ def cmd_timeline(args) -> int:
     _connect(args)
     from ray_tpu.util.state.api import task_timeline_events
 
-    trace = task_timeline_events()
+    trace = task_timeline_events(limit=args.limit, task_id=args.task_id)
     out = args.output or "timeline.json"
     with open(out, "w") as f:
         json.dump(trace, f)
@@ -339,6 +352,40 @@ def cmd_latency(args) -> int:
     print(f"stage breakdown of the last {len(rows)} finished tasks "
           "(milliseconds):")
     print(latency.format_breakdowns(rows))
+    return 0
+
+
+def cmd_events(args) -> int:
+    """`ray-tpu events`: the cluster-wide structured lifecycle event log
+    (FSM transitions, retry/lease/recovery decisions, spills, chaos
+    firings) with filters — the first stop when a distributed failure
+    needs a WHO-did-WHAT-WHEN answer on a live cluster. Per-task causal
+    timelines (retries and lineage reconstruction included): --task-id
+    --causal."""
+    _connect(args)
+    from ray_tpu._private.event_log import format_events
+    from ray_tpu.util.state import list_cluster_events, task_causal_timeline
+
+    if args.causal:
+        if not args.task_id:
+            print("--causal requires --task-id", file=sys.stderr)
+            return 1
+        events = task_causal_timeline(args.task_id)
+    else:
+        events = list_cluster_events(
+            limit=args.limit, etype=args.type, task_id=args.task_id,
+            actor_id=args.actor_id, node_id=args.node_id)
+        events = sorted(events, key=lambda e: (e.get("time", 0),
+                                               e.get("pid") or 0,
+                                               e.get("seq") or 0))
+    if args.json:
+        print(json.dumps(events, indent=2, default=str))
+        return 0
+    if not events:
+        print("no matching events (lifecycle events flush within ~1s of "
+              "emission; check filters)")
+        return 0
+    print(format_events(events))
     return 0
 
 
@@ -646,6 +693,13 @@ def cmd_chaos(args) -> int:
         print(_json.dumps(reply, indent=2, default=str))
         return 0
     reply = chaos.cluster_status(gcs_addr)
+    # Per-rule match counts from the cluster EVENT LOG: the audit trail of
+    # what actually fired, durable past `chaos stop` and inclusive of
+    # worker-process firings the plan objects on GCS/raylets never saw.
+    try:
+        reply["injection_history"] = chaos.injection_history(gcs_addr)
+    except Exception as e:  # noqa: BLE001 — history is additive info
+        reply["injection_history"] = {"error": str(e)}
     print(_json.dumps(reply, indent=2, default=str))
     return 0
 
@@ -774,8 +828,13 @@ def cmd_stack(args) -> int:
 
 
 def cmd_debug(args) -> int:
-    """Attach to a waiting RemotePdb session (reference: ray debug —
-    scripts.py:205 + util/rpdb.py)."""
+    """`ray-tpu debug` — attach to a waiting RemotePdb session (reference:
+    ray debug — scripts.py:205 + util/rpdb.py); `ray-tpu debug postmortem`
+    — merge per-process crash flight-recorder dumps (plus the live GCS
+    event log when a cluster is reachable) into one causally ordered
+    cluster timeline."""
+    if getattr(args, "debug_cmd", None) == "postmortem":
+        return _cmd_debug_postmortem(args)
     _connect(args)
     from ray_tpu.util import rpdb
 
@@ -795,6 +854,52 @@ def cmd_debug(args) -> int:
         choice = 0 if len(sessions) == 1 else int(
             input("attach to which session? "))
     rpdb.connect(sessions[int(choice)])
+    return 0
+
+
+def _cmd_debug_postmortem(args) -> int:
+    """Reconstruct a chaos/crash scenario offline: every process that died
+    with its flight recorder armed left a flight-*.json in the session
+    dir (chaos `kill` dumps explicitly before os._exit); survivors'
+    events live in the GCS event manager. Merged and causally ordered,
+    the result reads as one story: the injection, the FSM transitions it
+    caused, and the recovery decision that followed."""
+    from ray_tpu._private import event_log
+
+    cluster_events = None
+    gcs_addr = args.address or os.environ.get("RT_ADDRESS")
+    if gcs_addr:
+        from ray_tpu._private.rpc import EventLoopThread, RpcClient
+
+        lt = EventLoopThread("postmortem-cli")
+        try:
+            cluster_events = RpcClient(gcs_addr, lt).call(
+                "get_cluster_events", {"limit": 100_000}, timeout=10)
+        except Exception as e:  # noqa: BLE001 — offline post-mortems are
+            # the point: a dead cluster must not block the merge
+            print(f"(GCS at {gcs_addr} unreachable: {e}; merging flight "
+                  "dumps only)", file=sys.stderr)
+        finally:
+            lt.stop()
+    flight = args.flight_dir or event_log.flight_dir()
+    dumps = event_log.load_flight_dumps(flight)
+    timeline = event_log.postmortem_timeline(
+        flight, cluster_events, task_id=args.task_id)
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(timeline, f, indent=2, default=str)
+        print(f"wrote {len(timeline)} merged events to {args.output}")
+        return 0
+    print(f"# {len(dumps)} flight dump(s) in {flight}; "
+          f"{len(cluster_events or [])} live GCS events; "
+          f"{len(timeline)} merged")
+    for d in dumps:
+        print(f"#   pid={d.get('pid')} proc={d.get('proc')} "
+              f"reason={d.get('reason')}")
+    if not timeline:
+        print("no events to merge (no dumps and no reachable GCS)")
+        return 1
+    print(event_log.format_events(timeline))
     return 0
 
 
@@ -1006,6 +1111,9 @@ def main(argv=None) -> int:
     sp = sub.add_parser("timeline", help="dump chrome trace of task events")
     sp.add_argument("--address")
     sp.add_argument("-o", "--output")
+    sp.add_argument("--limit", type=int, default=100_000,
+                    help="max raw task events to fetch (default 100000)")
+    sp.add_argument("--task-id", help="only this task's spans")
     sp.set_defaults(fn=cmd_timeline)
 
     sp = sub.add_parser(
@@ -1014,6 +1122,21 @@ def main(argv=None) -> int:
     sp.add_argument("-n", type=int, default=20,
                     help="show the last N finished tasks")
     sp.set_defaults(fn=cmd_latency)
+
+    sp = sub.add_parser(
+        "events", help="cluster-wide structured lifecycle event log")
+    sp.add_argument("--address")
+    sp.add_argument("--type", help='event-type glob (e.g. "actor.*", '
+                                   '"chaos.inject", "task.retry")')
+    sp.add_argument("--task-id", help="only events referencing this task")
+    sp.add_argument("--actor-id", help="only events referencing this actor")
+    sp.add_argument("--node-id", help="only events referencing this node")
+    sp.add_argument("--limit", type=int, default=1000)
+    sp.add_argument("--causal", action="store_true",
+                    help="with --task-id: the task's full causal timeline "
+                         "(state transitions + retries + decisions merged)")
+    sp.add_argument("--json", action="store_true")
+    sp.set_defaults(fn=cmd_events)
 
     sp = sub.add_parser("serve", help="serve deploy/status/shutdown")
     sp.add_argument("serve_cmd", choices=["deploy", "status", "shutdown"])
@@ -1113,11 +1236,21 @@ def main(argv=None) -> int:
     sp.add_argument("--log-dir")
     sp.set_defaults(fn=cmd_stack)
 
-    sp = sub.add_parser("debug", help="attach to a remote pdb session")
+    sp = sub.add_parser("debug", help="attach to a remote pdb session, or "
+                                      "`debug postmortem` to merge crash "
+                                      "flight-recorder dumps")
+    sp.add_argument("debug_cmd", nargs="?", choices=["postmortem"],
+                    help="postmortem: merge per-process flight dumps + the "
+                         "GCS event log into one causal cluster timeline")
     sp.add_argument("--address")
     sp.add_argument("--list", action="store_true",
                     help="list sessions as JSON and exit")
     sp.add_argument("--session", help="session index to attach")
+    sp.add_argument("--flight-dir",
+                    help="flight-dump dir (default: <session>/flight)")
+    sp.add_argument("--task-id", help="postmortem: only this task's events")
+    sp.add_argument("-o", "--output",
+                    help="postmortem: write merged JSON here")
     sp.set_defaults(fn=cmd_debug)
 
     sp = sub.add_parser("microbenchmark", help="run the core benchmark suite")

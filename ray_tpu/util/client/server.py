@@ -30,7 +30,7 @@ ALLOWED_METHODS = frozenset({
 ALLOWED_GCS_METHODS = frozenset({
     "get_all_node_info", "get_cluster_load", "get_all_job_info",
     "list_placement_groups", "get_placement_group", "get_task_events",
-    "list_actors",
+    "list_actors", "get_cluster_events", "get_event_log_stats",
 })
 
 

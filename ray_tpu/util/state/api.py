@@ -66,9 +66,11 @@ def list_actors(filters=None, limit: int = 100, **_kw) -> List[Dict[str, Any]]:
 
 def list_tasks(filters=None, limit: int = 100,
                job_id: Optional[str] = None,
+               task_id: Optional[str] = None,
                raw_events: bool = False, **_kw) -> List[Dict[str, Any]]:
     events = _gcs().call(
-        "get_task_events", {"job_id": job_id, "limit": max(limit, 10_000)})
+        "get_task_events", {"job_id": job_id, "task_id": task_id,
+                            "limit": max(limit, 10_000)})
     if raw_events:
         # Full state-transition stream (for `ray-tpu timeline`).
         return events[:limit]
@@ -131,6 +133,53 @@ def list_objects(filters=None, limit: int = 100, **_kw) -> List[Dict[str, Any]]:
             "location": ref.location,
         })
     return _apply_filters(out, filters)[:limit]
+
+
+def list_cluster_events(filters=None, limit: int = 1000,
+                        etype: Optional[str] = None,
+                        task_id: Optional[str] = None,
+                        actor_id: Optional[str] = None,
+                        node_id: Optional[str] = None,
+                        object_id: Optional[str] = None,
+                        since: Optional[float] = None,
+                        **_kw) -> List[Dict[str, Any]]:
+    """Cluster-wide structured lifecycle events (the _private/event_log
+    pipeline aggregated in the GCS event manager): FSM transitions,
+    retry/lease/recovery decisions, spills, chaos firings. Newest first;
+    `etype` is a glob over event types (e.g. "actor.*", "chaos.inject")."""
+    events = _gcs().call("get_cluster_events", {
+        "limit": limit, "type": etype, "task_id": task_id,
+        "actor_id": actor_id, "node_id": node_id, "object_id": object_id,
+        "since": since,
+    })
+    return _apply_filters(events, filters)[:limit]
+
+
+def cluster_event_stats() -> Dict[str, Any]:
+    """Event-pipeline health: per-source buffer depth / flush lag /
+    cumulative drops + per-type totals (`ray-tpu status` section)."""
+    return _gcs().call("get_event_log_stats", {})
+
+
+def task_causal_timeline(task_id: str) -> List[Dict[str, Any]]:
+    """One task's full causal history: every state-transition task event
+    (including retries — each attempt re-enters RUNNING) MERGED with the
+    lifecycle events that reference the task (retry decisions, lease
+    grants/rejections, reconstruction, chaos injections on its RPCs),
+    ordered by (time, pid, seq). This is the NOT-happy-path view: a task
+    that was retried, spilled back, or lineage-reconstructed shows every
+    decision along the way, not just its final state."""
+    from ray_tpu._private.event_log import merge_timeline
+
+    task_events = [
+        dict(ev, type=f"task.{ev['state']}", proc=f"worker:{ev.get('worker_id', '')[:8]}")
+        for ev in list_tasks(limit=100_000, raw_events=True,
+                             task_id=task_id)  # filtered at the GCS
+    ]
+    lifecycle = list_cluster_events(limit=10_000, task_id=task_id)
+    # a task's object reconstruction events carry the task id too; actor
+    # tasks additionally pull their actor's transitions in by actor id
+    return merge_timeline(task_events, lifecycle)
 
 
 def list_workers(filters=None, limit: int = 100, **_kw) -> List[Dict[str, Any]]:
@@ -217,11 +266,14 @@ def collect_worker_logs(nodes, rpc_call, *, node_id=None, pid=None,
     return out
 
 
-def task_timeline_events(limit: int = 100_000) -> list:
+def task_timeline_events(limit: int = 100_000,
+                         task_id: Optional[str] = None) -> list:
     """Chrome-trace 'X' events built from GCS task events (reference:
     _private/state.py:434 chrome_tracing_dump — what `ray timeline` and
-    `ray.timeline()` emit)."""
-    return build_chrome_trace(list_tasks(limit=limit, raw_events=True))
+    `ray.timeline()` emit). `limit` bounds the raw event fetch (CLI
+    --limit); `task_id` restricts the trace to one task's spans."""
+    events = list_tasks(limit=limit, raw_events=True, task_id=task_id)
+    return build_chrome_trace(events)
 
 
 def build_chrome_trace(events: list) -> list:

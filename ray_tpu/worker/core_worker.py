@@ -28,6 +28,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu import exceptions as exc
+from ray_tpu._private import event_log
 from ray_tpu._private import fault_injection as _fi
 from ray_tpu._private import serialization as ser
 from ray_tpu._private.config import CONFIG
@@ -203,6 +204,7 @@ class CoreWorker:
             _fi.load_env_plan()
         self.worker_id = WorkerID.from_random()
         self.node_id = node_id
+        self._elog = event_log.logger_for(mode, self.worker_id.hex()[:8])
         self._lt = EventLoopThread(f"cw-{self.worker_id.hex()[:6]}")
         self._server = RpcServer(self._lt, host, label=mode)
         self._peers = ClientPool(
@@ -334,6 +336,24 @@ class CoreWorker:
                 _mark("plasma")
         self._lease_reaper = self._lt.submit(self._lease_reaper_loop())
         self._event_flusher = self._lt.submit(self._task_event_loop())
+        # Lifecycle-event flush path: batched RPC to the GCS event manager.
+        # First-wins: an embedded head keeps the GCS's direct sink; pure
+        # worker/driver processes ship over their existing GCS connection.
+        gcs_client = self._gcs
+
+        def _ship_events(events, stats):
+            gcs_client.send("add_cluster_events",
+                            {"events": events, "stats": stats})
+
+        self._event_sink_token = event_log.set_sink(_ship_events)
+        if mode == "worker":
+            event_log.set_default_proc_label(f"worker:{os.getpid()}")
+            event_log.install_flight_recorder(on_exit=True)
+        else:
+            if event_log.default_proc_label().startswith("proc:"):
+                event_log.set_default_proc_label(f"driver:{os.getpid()}")
+            event_log.install_flight_recorder(
+                on_exit=CONFIG.flight_recorder_on_exit)
         # Node-death awareness: a dead raylet's TCP connections can linger
         # (especially for in-process test raylets), so lease requests to it
         # would hang. Invalidate its clients the moment the GCS declares it
@@ -498,6 +518,9 @@ class CoreWorker:
             self._lt.submit(self._flush_task_events()).result(timeout=2)
         except Exception:  # noqa: BLE001 — best effort on teardown
             logger.debug("final task-event flush failed", exc_info=True)
+        if self._event_sink_token is not None:
+            event_log.flush(timeout=0.5)
+            event_log.clear_sink(self._event_sink_token)
         self.executor.shutdown()
         if self.plasma is not None:
             try:
@@ -1030,6 +1053,8 @@ class CoreWorker:
         if tid in self._pending_tasks:
             return True  # already re-executing
         logger.info("reconstructing %s by re-executing %s", oid.hex()[:12], spec.function_name)
+        self._elog.emit("object.reconstruct", object_id=oid.hex(),
+                        task_id=tid.hex(), function=spec.function_name)
         self.memory_store.delete([o for o in spec.return_ids()])
         spec.attempt_number += 1
         self._pending_tasks[tid] = _PendingTask(
@@ -1672,7 +1697,7 @@ class CoreWorker:
         else:  # application error
             if spec.retry_exceptions and pending.retries_left > 0:
                 pending.retries_left -= 1
-                self._resubmit(spec)
+                self._resubmit(spec, reason="application error")
                 return
             error_obj, _ = ser.deserialize(reply["error"])
             self._store_error_for_task(spec, error_obj)
@@ -1704,17 +1729,24 @@ class CoreWorker:
             pending.retries_left -= 1
             logger.info("retrying task %s after worker failure (%d retries left)",
                         spec.function_name, pending.retries_left)
-            self._resubmit(spec)
+            self._resubmit(spec, reason="worker failure")
             return
+        # the other half of the retry FSM: budget exhausted, fail for good
+        self._elog.emit("task.giveup", task_id=spec.task_id.hex(),
+                        reason="worker failure, no retries left")
         err = exc.WorkerCrashedError(
             f"The worker executing task {spec.function_name} died unexpectedly."
         )
         self._store_error_for_task(spec, err)
         self._finalize_task(spec, "FAILED")
 
-    def _resubmit(self, spec: TaskSpec):
+    def _resubmit(self, spec: TaskSpec, reason: str = "resubmit"):
         spec.attempt_number += 1
         pending = self._pending_tasks.get(spec.task_id)
+        self._elog.emit(
+            "task.retry", task_id=spec.task_id.hex(), reason=reason,
+            attempt=spec.attempt_number,
+            retries_left=pending.retries_left if pending else 0)
         if pending is not None:
             pending.spec = spec
             # fresh queue/push stamps for the retry; t_submit stays, so the
@@ -1960,14 +1992,18 @@ class CoreWorker:
             return
         if info.state == ActorState.ALIVE:
             rec.state = "ALIVE"
+            self._emit_actor_state(rec, "pubsub event")
+            self._note_incarnation(rec, info)
             rec.address = info.address
-            if info.num_restarts > rec.incarnation:
-                # New incarnation: its sequencing gate starts at 0.
-                rec.incarnation = info.num_restarts
-                rec.seq = 0
             await self._flush_actor_queue(rec)
         elif info.state == ActorState.RESTARTING:
             rec.state = "RESTARTING"
+            self._emit_actor_state(rec, "pubsub event")
+            # the incarnation behind rec.address is DEAD (that is why it is
+            # restarting): drop its borrows NOW, before the address is
+            # nulled here / overwritten by the next ALIVE — afterwards
+            # nothing remembers which worker held them
+            self._drop_dead_borrower(rec.address)
             rec.address = None
             if rec.queue:
                 # The reaper may have parked while this actor looked
@@ -1976,9 +2012,42 @@ class CoreWorker:
                 self._poke_reaper()
         elif info.state == ActorState.DEAD:
             rec.state = "DEAD"
+            self._emit_actor_state(rec, "pubsub event")
             rec.death_cause = info.death_cause
+            self._drop_dead_borrower(rec.address)
             rec.address = None
             self._fail_actor_queue(rec)
+
+    def _drop_dead_borrower(self, address) -> None:
+        """A dead actor can never send its borrow releases: drop its
+        worker from every owned ref's borrower set, or each object it
+        borrowed stays pinned on this owner forever (reference: the owner
+        prunes borrowers on worker-failure notifications)."""
+        if address is not None:
+            self.reference_counter.remove_borrower_everywhere(
+                address.rpc_address)
+
+    def _note_incarnation(self, rec: "_ActorRecord", info) -> None:
+        """An ALIVE at a higher num_restarts means the PREVIOUS
+        incarnation died: reset the sequencing gate for the new worker
+        and drop the dead incarnation's borrows (a missed RESTARTING
+        pubsub event would otherwise overwrite the only record of which
+        address held them). Call BEFORE rec.address is updated."""
+        if info.num_restarts > rec.incarnation:
+            new_addr = (info.address.rpc_address
+                        if info.address is not None else None)
+            if (rec.address is not None
+                    and rec.address.rpc_address != new_addr):
+                self._drop_dead_borrower(rec.address)
+            rec.incarnation = info.num_restarts
+            rec.seq = 0
+
+    def _emit_actor_state(self, rec: "_ActorRecord", reason: str) -> None:
+        """Owner-side actor record FSM transition -> lifecycle event log
+        (the client's view can disagree with the GCS FSM during races —
+        post-mortems need both sides)."""
+        self._elog.emit("actor.client_state", actor_id=rec.actor_id.hex(),
+                        state=rec.state, reason=reason)
 
     def _fail_actor_queue(self, rec: _ActorRecord) -> None:
         """Fail every task queued on a DEAD actor. Callable from any point
@@ -2009,6 +2078,7 @@ class CoreWorker:
             if info is not None:
                 if info.state == ActorState.ALIVE:
                     rec.state = "ALIVE"
+                    self._emit_actor_state(rec, "first contact")
                     rec.address = info.address
                     # First-contact race: a CONCURRENT submit from another
                     # thread can find this record while the GCS call above
@@ -2022,6 +2092,7 @@ class CoreWorker:
                     self._lt.submit(self._flush_actor_queue(rec))
                 elif info.state == ActorState.DEAD:
                     rec.state = "DEAD"
+                    self._emit_actor_state(rec, "first contact")
                     rec.death_cause = info.death_cause
         if rec.state == "DEAD":
             raise exc.ActorDiedError(
@@ -2097,10 +2168,9 @@ class CoreWorker:
             # event must not resurrect the record (new submits would stop
             # raising ActorDiedError and push to a dead address)
             rec.state = "ALIVE"
+            self._emit_actor_state(rec, "GCS reconcile")
+            self._note_incarnation(rec, info)
             rec.address = info.address
-            if info.num_restarts > rec.incarnation:
-                rec.incarnation = info.num_restarts
-                rec.seq = 0
             asyncio.ensure_future(self._flush_actor_queue(rec))
         elif (info.state == ActorState.ALIVE and rec.state == "ALIVE"
               and rec.queue):
@@ -2109,7 +2179,9 @@ class CoreWorker:
             asyncio.ensure_future(self._flush_actor_queue(rec))
         elif info.state == ActorState.DEAD and rec.state != "DEAD":
             rec.state = "DEAD"
+            self._emit_actor_state(rec, "GCS reconcile")
             rec.death_cause = info.death_cause
+            self._drop_dead_borrower(rec.address)
             rec.address = None
             self._fail_actor_queue(rec)
 
@@ -2275,6 +2347,7 @@ class CoreWorker:
             return
         if rec.state == "ALIVE":
             rec.state = "RESTARTING"  # wait for pubsub to re-resolve
+            self._emit_actor_state(rec, "push failure")
         # The address may simply be stale (actor already restarted):
         # re-resolve once from the GCS.
         info = await self._gcs.call_async(
@@ -2289,17 +2362,18 @@ class CoreWorker:
                  or info.num_restarts > rec.incarnation)
         ):
             rec.state = "ALIVE"
+            self._emit_actor_state(rec, "re-resolved after push failure")
+            self._note_incarnation(rec, info)
             rec.address = info.address
-            if info.num_restarts > rec.incarnation:
-                rec.incarnation = info.num_restarts
-                rec.seq = 0
             await self._flush_actor_queue(rec)
             return
         if info is not None and info.state == ActorState.DEAD:
             # no restart coming (pubsub DEAD may have been processed before
             # our specs were queued, or the subscription raced creation)
             rec.state = "DEAD"
+            self._emit_actor_state(rec, "re-resolved after push failure")
             rec.death_cause = info.death_cause
+            self._drop_dead_borrower(rec.address)
             rec.address = None
             self._fail_actor_queue(rec)
 

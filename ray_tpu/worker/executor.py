@@ -222,11 +222,36 @@ class Executor:
                 )
         out = []
         for oid, value in zip(return_ids, values):
-            out.append((oid, self._package_value(oid, value)))
+            out.append((oid, self._package_value(
+                oid, value, recipient=spec.owner_address)))
         return out
 
-    def _package_value(self, oid: ObjectID, value: Any) -> dict:
+    def _pre_register_return_borrows(self, s, recipient) -> None:
+        """Close the return-borrow race: an ObjectRef serialized into a
+        RETURN value loses its last local ref the moment the task frame
+        exits, and the recipient's eager one-way add_borrower may arrive
+        AFTER this (owner) worker already freed the object — a borrowed
+        ref from a task return would then be flaky by design. Registering
+        the recipient as a borrower HERE, synchronously, before the value
+        leaves the process, keeps every contained owned ref alive until
+        the recipient releases it (remove_borrower on its last local ref)
+        or dies (the owner drops dead borrowers wholesale). A recipient
+        that never deserializes the value holds the borrow until death —
+        the price of not piggybacking registration on replies like the
+        reference does."""
+        if recipient is None or not s.contained_refs:
+            return
+        addr = getattr(recipient, "rpc_address", None)
+        if addr is None or addr == self.cw.address.rpc_address:
+            return  # self-call: local refcounts already cover it
+        for ref in s.contained_refs:
+            if self.cw.reference_counter.owns(ref.object_id()):
+                self.cw.reference_counter.add_borrower(ref.object_id(), addr)
+
+    def _package_value(self, oid: ObjectID, value: Any,
+                       recipient=None) -> dict:
         s = ser.serialize(value)
+        self._pre_register_return_borrows(s, recipient)
         if s.total_bytes() <= CONFIG.max_direct_call_object_size:
             return {"inline": s}
         # Keep the primary copy on this node; the owner records the location.
@@ -354,7 +379,8 @@ class Executor:
             index = 0
             for item in gen:
                 oid = ObjectID.for_task_return(spec.task_id, index + 1)
-                payload = self._package_value(oid, item)
+                payload = self._package_value(
+                    oid, item, recipient=spec.owner_address)
                 self.cw.report_generator_item(spec, index, payload, done=False)
                 index += 1
             self.cw.report_generator_item(spec, index, None, done=True)

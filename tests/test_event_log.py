@@ -1,0 +1,490 @@
+"""Cluster-wide structured event log + crash flight recorder
+(_private/event_log.py, gcs GcsEventManager, `ray-tpu events` /
+`ray-tpu debug postmortem`).
+
+Covers, per the PR's acceptance criteria:
+  * the per-process ring/pending pipeline: bounded, drop-counting, never
+    blocking the emitter even with a dead sink (saturation test);
+  * cluster aggregation: emits from every layer land in the GCS event
+    manager and come back through the state API with filters;
+  * the golden event-schema corpus: event types/fields are pinned
+    (regenerate with `python -m tests.test_event_log`), and every literal
+    emit site in the tree uses a known type with its required fields;
+  * the flight recorder + postmortem merge: a chaos-killed process leaves
+    its ring buffer on disk, and the merged timeline tells the whole
+    story (injection -> FSM transitions -> recovery decision) in causal
+    order;
+  * zero quiescent transport coupling: rpc.py never touches event_log.
+"""
+
+import ast
+import json
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import chaos
+from ray_tpu._private import event_log
+from ray_tpu._private.config import CONFIG
+from ray_tpu._private.rpc import wait_until
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_event_log():
+    event_log.clear_for_tests()
+    yield
+    event_log.clear_for_tests()
+
+
+# --------------------------------------------------------------------------
+# pipeline unit tests (no cluster)
+# --------------------------------------------------------------------------
+
+def test_emit_records_shape_and_order():
+    log = event_log.logger_for("raylet", "abc123")
+    log.emit("lease.grant", task_id="t1", node_id="n1",
+             function="f", worker_id="w1")
+    log.emit("lease.reject", task_id="t2", node_id="n1",
+             function="g", reason="draining")
+    events = event_log.recent()
+    assert len(events) == 2
+    first, second = events
+    assert first["type"] == "lease.grant"
+    assert first["proc"] == "raylet:abc123"
+    assert first["task_id"] == "t1"
+    assert first["data"] == {"function": "f", "worker_id": "w1"}
+    assert second["seq"] > first["seq"]
+    assert second["time"] >= first["time"]
+    assert first["pid"] == os.getpid()
+
+
+def test_ring_is_bounded_and_pending_overflow_counts_drops():
+    old = (CONFIG.event_log_max_events, CONFIG.event_log_max_pending)
+    CONFIG.set("event_log_max_events", 64)
+    CONFIG.set("event_log_max_pending", 32)
+    try:
+        for i in range(200):
+            event_log.emit("flight.dump", reason=f"r{i}")
+        stats = event_log.local_stats()
+        assert stats["ring"] == 64
+        assert stats["pending"] == 32
+        # no sink installed in this test process segment: overflow counted
+        assert stats["dropped"] == 200 - 32
+        # ring keeps the NEWEST window (post-mortem wants final moments)
+        assert event_log.recent(1)[0]["data"]["reason"] == "r199"
+    finally:
+        CONFIG.set("event_log_max_events", old[0])
+        CONFIG.set("event_log_max_pending", old[1])
+
+
+def test_unknown_event_type_is_tracked_not_fatal():
+    event_log.emit("no.such.type", foo=1)
+    assert "no.such.type" in event_log.unknown_types()
+    assert event_log.recent()[-1]["type"] == "no.such.type"
+
+
+def test_sink_flush_and_failure_requeue():
+    batches = []
+    fail = {"on": True}
+
+    def sink(events, stats):
+        if fail["on"]:
+            raise ConnectionError("sink down")
+        batches.append((list(events), dict(stats)))
+
+    token = event_log.set_sink(sink, force=True)
+    try:
+        event_log.emit("flight.dump", reason="a")
+        event_log.emit("flight.dump", reason="b")
+        # sink failing: events stay pending, nothing lost
+        assert not event_log.flush(timeout=0.3)
+        assert event_log.local_stats()["pending"] == 2
+        assert event_log.local_stats()["dropped"] == 0
+        fail["on"] = False
+        assert event_log.flush(timeout=2.0)
+        shipped = [e["data"]["reason"] for b, _ in batches for e in b]
+        assert shipped == ["a", "b"]  # order preserved through the requeue
+        assert batches[0][1]["pid"] == os.getpid()
+    finally:
+        event_log.clear_sink(token)
+
+
+def test_saturation_never_blocks_and_exports_drops():
+    """Acceptance criterion: a dead/slow sink backs events into the
+    bounded queue; overflow is counted and exported via util/metrics, and
+    emit() stays non-blocking throughout."""
+
+    def dead_sink(events, stats):
+        raise ConnectionError("always down")
+
+    old = CONFIG.event_log_max_pending
+    CONFIG.set("event_log_max_pending", 500)
+    token = event_log.set_sink(dead_sink, force=True)
+    try:
+        t0 = time.monotonic()
+        for i in range(20_000):
+            event_log.emit("flight.dump", reason="saturate")
+        elapsed = time.monotonic() - t0
+        # 20k emits against a dead sink: if emit ever blocked on the sink
+        # (10s+ of connect timeouts) this blows up; generous bound for a
+        # loaded CI host
+        assert elapsed < 5.0, f"emit path blocked under saturation: {elapsed:.1f}s"
+        stats = event_log.local_stats()
+        assert stats["dropped"] >= 20_000 - 500
+        assert stats["pending"] <= 500
+        # drops reach the exported metrics (flusher syncs the counter)
+        assert wait_until(
+            lambda: "ray_tpu_events_dropped_total" in _prom_text()
+            and _dropped_total() >= stats["dropped"], timeout=5)
+    finally:
+        event_log.clear_sink(token)
+        CONFIG.set("event_log_max_pending", old)
+
+
+def _prom_text() -> str:
+    from ray_tpu.util.metrics import prometheus_text
+
+    return prometheus_text()
+
+
+def _dropped_total() -> float:
+    from ray_tpu.util.metrics import get_metric
+
+    m = get_metric("ray_tpu_events_dropped_total")
+    return sum(v for _, _, v in m._samples()) if m is not None else 0.0
+
+
+def test_rpc_transport_has_no_event_log_coupling():
+    """The zero-quiescent-overhead guarantee is structural: the transport
+    module must not reference the event log at all (the echo-RTT
+    microbenchmark stays byte-identical on the hot path)."""
+    import ray_tpu._private.rpc as rpc
+
+    with open(rpc.__file__.replace(".pyc", ".py")) as f:
+        source = f.read()
+    assert "event_log" not in source
+
+
+# --------------------------------------------------------------------------
+# golden event-schema corpus
+# --------------------------------------------------------------------------
+
+def _load_golden():
+    with open(os.path.join(REPO_ROOT, "tests",
+                           "event_schema_golden.json")) as f:
+        return json.load(f)
+
+
+@pytest.mark.lint
+def test_event_schemas_match_golden():
+    """EVENT_SCHEMAS is pinned by tests/event_schema_golden.json: renaming
+    an event type or changing its required fields is an API break for
+    every log consumer (state API, postmortem, chaos audit, dashboards).
+    If intentional, regenerate: python -m tests.test_event_log."""
+    golden = _load_golden()["event_types"]
+    current = {k: sorted(v) for k, v in event_log.EVENT_SCHEMAS.items()}
+    assert current == golden, (
+        "event schema drifted from tests/event_schema_golden.json.\n"
+        f"added: {sorted(set(current) - set(golden))}\n"
+        f"removed: {sorted(set(golden) - set(current))}\n"
+        f"changed: {sorted(k for k in set(current) & set(golden) if current[k] != golden[k])}\n"
+        "If intentional, regenerate (python -m tests.test_event_log) and "
+        "update every consumer of the changed types.")
+
+
+def _iter_emit_calls():
+    """(path, lineno, etype, kwarg_names) for every emit call in ray_tpu/
+    whose event type is a string literal."""
+    for dirpath, dirnames, filenames in os.walk(
+            os.path.join(REPO_ROOT, "ray_tpu")):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            with open(path, encoding="utf-8") as f:
+                tree = ast.parse(f.read(), filename=path)
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                func = node.func
+                name = func.attr if isinstance(func, ast.Attribute) else (
+                    func.id if isinstance(func, ast.Name) else "")
+                if name != "emit" or not node.args:
+                    continue
+                first = node.args[0]
+                if not (isinstance(first, ast.Constant)
+                        and isinstance(first.value, str)):
+                    continue
+                kwargs = {kw.arg for kw in node.keywords if kw.arg}
+                yield (os.path.relpath(path, REPO_ROOT), node.lineno,
+                       first.value, kwargs)
+
+
+@pytest.mark.lint
+def test_every_emit_site_uses_a_known_schema():
+    """Static sweep of the real tree: every literal emit() call uses a
+    registered event type AND passes its required data fields as keyword
+    arguments — type/field drift at any call site fails here, not in a
+    3am post-mortem."""
+    id_fields = {"task_id", "actor_id", "node_id", "object_id", "proc"}
+    sites = list(_iter_emit_calls())
+    assert sites, "no emit sites found — the sweep itself broke"
+    for path, lineno, etype, kwargs in sites:
+        assert etype in event_log.EVENT_SCHEMAS, (
+            f"{path}:{lineno}: emit of unregistered event type {etype!r}; "
+            "add it to event_log.EVENT_SCHEMAS + the golden corpus")
+        missing = set(event_log.EVENT_SCHEMAS[etype]) - kwargs - id_fields
+        assert not missing, (
+            f"{path}:{lineno}: emit({etype!r}) missing required data "
+            f"fields {sorted(missing)}")
+
+
+# --------------------------------------------------------------------------
+# flight recorder + postmortem merge (no cluster)
+# --------------------------------------------------------------------------
+
+def test_flight_dump_roundtrip(tmp_path):
+    log = event_log.logger_for("gcs")
+    log.emit("node.dead", node_id="n1", expected=False)
+    path = event_log.flight_dump("unit_test", out_dir=str(tmp_path))
+    assert path is not None and os.path.exists(path)
+    dumps = event_log.load_flight_dumps(str(tmp_path))
+    assert len(dumps) == 1
+    d = dumps[0]
+    assert d["reason"] == "unit_test"
+    assert d["pid"] == os.getpid()
+    assert any(e["type"] == "node.dead" for e in d["events"])
+    # torn dumps (crash mid-write) are skipped, not fatal
+    (tmp_path / "flight-99999.json").write_text('{"pid": 99999, "ev')
+    assert len(event_log.load_flight_dumps(str(tmp_path))) == 1
+
+
+def test_merge_timeline_orders_and_dedupes():
+    a = [{"pid": 1, "seq": 2, "time": 10.0, "type": "x"},
+         {"pid": 1, "seq": 1, "time": 10.0, "type": "w"}]
+    b = [{"pid": 2, "seq": 1, "time": 9.0, "type": "v"},
+         {"pid": 1, "seq": 2, "time": 10.0, "type": "x"}]  # duplicate
+    merged = event_log.merge_timeline(a, b)
+    assert [e["type"] for e in merged] == ["v", "w", "x"]
+
+
+# --------------------------------------------------------------------------
+# cluster e2e
+# --------------------------------------------------------------------------
+
+def test_cluster_events_and_causal_timeline(ray_start_2_cpus):
+    """Lifecycle events from every layer (raylet lease decisions, GCS
+    actor FSM, owner-side client records) aggregate in the GCS and come
+    back through the state API with filters; a task's causal timeline
+    merges its state transitions with the decisions around them."""
+    from ray_tpu.util.state import (
+        cluster_event_stats,
+        list_cluster_events,
+        task_causal_timeline,
+    )
+
+    @ray_tpu.remote
+    def work(x):
+        return x + 1
+
+    ref = work.remote(1)
+    assert ray_tpu.get(ref) == 2
+
+    @ray_tpu.remote
+    class Counter:
+        def ping(self):
+            return "ok"
+
+    c = Counter.remote()
+    assert ray_tpu.get(c.ping.remote()) == "ok"
+    ray_tpu.kill(c)
+
+    assert wait_until(lambda: any(
+        e["type"] == "actor.dead"
+        for e in list_cluster_events(limit=5000)), timeout=15)
+    events = list_cluster_events(limit=5000)
+    types = {e["type"] for e in events}
+    assert {"node.alive", "lease.grant", "actor.pending", "actor.alive",
+            "actor.dead"} <= types
+    # type-glob + id filters
+    actor_events = list_cluster_events(etype="actor.*", limit=1000)
+    assert actor_events and all(
+        e["type"].startswith("actor.") for e in actor_events)
+    aid = next(e["actor_id"] for e in actor_events if e["actor_id"])
+    assert all(e["actor_id"] == aid
+               for e in list_cluster_events(actor_id=aid, limit=100))
+    # pipeline stats surface per-source depth/drops (ray-tpu status data)
+    stats = cluster_event_stats()
+    assert stats["total_events"] >= len(types)
+    assert stats["by_type"].get("actor.dead", 0) >= 1
+    assert any(src.get("dropped") == 0
+               for src in stats["sources"].values())
+    # causal timeline of the finished task: state transitions + the lease
+    # decision that placed it, in one ordered stream
+    task_id = ref.object_id().task_id().hex()
+    # task-state events ride the separate task-event buffer (1s batch
+    # window, like the lifecycle flusher)
+    assert wait_until(lambda: "task.FINISHED" in [
+        e["type"] for e in task_causal_timeline(task_id)], timeout=15)
+    timeline = task_causal_timeline(task_id)
+    ttypes = [e["type"] for e in timeline]
+    assert "task.FINISHED" in ttypes
+    assert any(t == "lease.grant" for t in ttypes)
+    times = [e.get("time", 0) for e in timeline]
+    assert times == sorted(times)
+
+
+def test_task_retry_events_reach_the_log(ray_start_2_cpus):
+    """The owner-side retry FSM leaves a record per decision: each
+    resubmit emits task.retry; the causal timeline shows the attempts."""
+    from ray_tpu.util.state import list_cluster_events, task_causal_timeline
+
+    @ray_tpu.remote(max_retries=2, retry_exceptions=True)
+    def flaky(marker_dir):
+        marker = os.path.join(marker_dir, "attempt")
+        if not os.path.exists(marker):
+            with open(marker, "w") as f:
+                f.write("1")
+            raise ValueError("first attempt fails")
+        return "recovered"
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        ref = flaky.remote(d)
+        assert ray_tpu.get(ref, timeout=60) == "recovered"
+        task_id = ref.object_id().task_id().hex()
+
+    assert wait_until(lambda: any(
+        e["type"] == "task.retry" and e["task_id"] == task_id
+        for e in list_cluster_events(etype="task.retry", limit=1000)),
+        timeout=15)
+    retry = next(e for e in list_cluster_events(
+        etype="task.retry", task_id=task_id, limit=10))
+    assert retry["data"]["reason"] == "application error"
+    assert retry["data"]["attempt"] >= 1
+    # task-state events flush on their own 1s batch window
+    assert wait_until(lambda: "task.FINISHED" in [
+        e["type"] for e in task_causal_timeline(task_id)], timeout=15)
+    ttypes = [e["type"] for e in task_causal_timeline(task_id)]
+    # the NOT-happy-path view: the retry decision sits between the
+    # attempts' state transitions
+    assert "task.retry" in ttypes
+    assert ttypes.index("task.RUNNING") < ttypes.index("task.retry")
+
+
+# --------------------------------------------------------------------------
+# the acceptance scenario: chaos kill -> flight dump -> merged postmortem
+# --------------------------------------------------------------------------
+
+def test_postmortem_reconstructs_chaos_kill(tmp_path, monkeypatch):
+    """A chaos-induced failure is reconstructible OFFLINE: a worker
+    process is killed mid-scenario by an injected fault; its flight
+    recorder dumps the ring buffer (including the chaos.inject record)
+    before dying; the raylet/GCS recovery decisions land in the cluster
+    event log; and `ray-tpu debug postmortem` (API:
+    event_log.postmortem_timeline) merges both into one causally ordered
+    story: injection -> death report -> restart decision -> recovered."""
+    flight = str(tmp_path / "flight")
+    CONFIG.set("flight_recorder_dir", flight)  # workers inherit via env
+    plan_json = chaos.ChaosPlan(seed=42, rules=[
+        # kill the actor's worker process on its SECOND method push: every
+        # spawned worker re-arms this plan from the env with fresh
+        # counters, so after=1 lets each incarnation serve its first call
+        # — incarnation 0 dies mid-scenario, the restarted one survives
+        # (the PR 3 partition/restart class of failure, process edition)
+        chaos.ChaosRule(action="kill", site="before_execute",
+                        method="push_task_w", label="worker",
+                        after=1, times=1),
+    ]).to_json()
+    monkeypatch.setenv(chaos.ENV_VAR, plan_json)
+    ray_tpu.init(num_cpus=2)
+    try:
+        @ray_tpu.remote(max_restarts=1, max_task_retries=2)
+        class Survivor:
+            def __init__(self):
+                self.calls = 0
+
+            def bump(self):
+                self.calls += 1
+                return self.calls
+
+        a = Survivor.remote()
+        assert ray_tpu.get(a.bump.remote(), timeout=90) == 1
+        # this call's push kills incarnation 0's worker; the owner's retry
+        # FSM requeues it and it lands on the restarted incarnation
+        assert ray_tpu.get(a.bump.remote(), timeout=90) == 1
+        aid = a._actor_id.hex()
+
+        from ray_tpu.util.state import list_cluster_events
+
+        assert wait_until(lambda: any(
+            e["type"] == "actor.alive" and e["data"].get("restarts") == 1
+            for e in list_cluster_events(etype="actor.alive", limit=100)),
+            timeout=30), "restarted incarnation never reported alive"
+
+        # the killed worker left its black box in the session flight dir
+        assert wait_until(
+            lambda: len(event_log.load_flight_dumps(flight)) >= 1,
+            timeout=15), "chaos-killed worker left no flight dump"
+        dumps = event_log.load_flight_dumps(flight)
+        kill_dump = next(d for d in dumps
+                         if str(d.get("reason", "")).startswith("chaos_kill"))
+        dump_types = [e["type"] for e in kill_dump["events"]]
+        assert "chaos.inject" in dump_types
+        assert "chaos.plan" in dump_types  # env-armed install marker
+
+        cluster_events = list_cluster_events(limit=10_000)
+        timeline = event_log.postmortem_timeline(flight, cluster_events)
+        types = [e["type"] for e in timeline]
+        # the whole story, in causal order: the injection (known only from
+        # the dead process's dump), the raylet's death report + recovery
+        # decision, the GCS restart transition, the recovered incarnation
+        for needed in ("chaos.inject", "worker.death_report",
+                       "actor.restarting", "actor.alive"):
+            assert needed in types, f"merged timeline missing {needed}"
+        inject = types.index("chaos.inject")
+        restarting = next(
+            i for i, e in enumerate(timeline)
+            if e["type"] == "actor.restarting" and e["actor_id"] == aid)
+        recovered = next(
+            i for i, e in enumerate(timeline)
+            if e["type"] == "actor.alive"
+            and e["data"].get("restarts") == 1)
+        death = next(
+            i for i, e in enumerate(timeline)
+            if e["type"] == "worker.death_report")
+        assert inject < death < restarting < recovered, (
+            f"causal order broken: inject={inject} death={death} "
+            f"restarting={restarting} recovered={recovered}")
+        report = timeline[death]
+        assert report["data"]["intended"] is False
+    finally:
+        chaos.uninstall()
+        CONFIG.set("flight_recorder_dir", "")
+        ray_tpu.shutdown()
+
+
+def _regen_golden():
+    golden = {
+        "_comment": ("Golden corpus of lifecycle event types and their "
+                     "required data fields (event_log.EVENT_SCHEMAS). "
+                     "Drift fails tests/test_event_log.py; if intentional, "
+                     "regenerate with: python -m tests.test_event_log"),
+        "event_types": {k: sorted(v)
+                        for k, v in event_log.EVENT_SCHEMAS.items()},
+    }
+    path = os.path.join(REPO_ROOT, "tests", "event_schema_golden.json")
+    with open(path, "w") as f:
+        json.dump(golden, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(f"regenerated {path}: {len(golden['event_types'])} event types")
+
+
+if __name__ == "__main__":
+    _regen_golden()

@@ -378,6 +378,76 @@ def test_spec_serialization_missing_reader_field(tmp_path):
     assert "never restored" in diags[0].message
 
 
+# ---------------------------------------------------------------- RTL006
+
+
+def test_fsm_event_positive(tmp_path):
+    _write(tmp_path, "ray_tpu/gcs/mgr.py", """
+        class Mgr:
+            def mark_dead(self, info):
+                info.state = "DEAD"
+    """)
+    diags = _lint(tmp_path, ["ray_tpu"], select=["fsm-transition-event"])
+    assert _ids(diags) == ["RTL006"]
+    assert "info.state" in diags[0].message
+    assert "mark_dead" in diags[0].message
+
+
+def test_fsm_event_emit_in_same_function_clean(tmp_path):
+    _write(tmp_path, "ray_tpu/gcs/mgr.py", """
+        class Mgr:
+            def mark_dead(self, info):
+                info.state = "DEAD"
+                self._elog.emit("actor.dead", reason="x")
+
+            def via_helper(self, rec):
+                rec.status = "idle"
+                self._emit_state(rec)
+    """)
+    assert _lint(tmp_path, ["ray_tpu"],
+                 select=["fsm-transition-event"]) == []
+
+
+def test_fsm_event_nested_def_emit_does_not_vouch(tmp_path):
+    # an emit inside a nested def runs later (or never) — the enclosing
+    # function's transition is still unrecorded
+    _write(tmp_path, "ray_tpu/raylet/mgr.py", """
+        class Mgr:
+            def transition(self, rec):
+                rec.state = "dead"
+                def later():
+                    self._elog.emit("worker.state", state="dead")
+                return later
+    """)
+    diags = _lint(tmp_path, ["ray_tpu"], select=["fsm-transition-event"])
+    assert _ids(diags) == ["RTL006"]
+
+
+def test_fsm_event_self_and_out_of_scope_ignored(tmp_path):
+    _write(tmp_path, "ray_tpu/gcs/mgr.py", """
+        class Mgr:
+            def local(self):
+                self.state = "running"   # object-local attr, not an FSM row
+    """)
+    _write(tmp_path, "ray_tpu/serve/mgr.py", """
+        class Mgr:
+            def transition(self, rec):
+                rec.state = "dead"       # outside gcs/raylet/worker scope
+    """)
+    assert _lint(tmp_path, ["ray_tpu"],
+                 select=["fsm-transition-event"]) == []
+
+
+def test_fsm_event_suppressible(tmp_path):
+    _write(tmp_path, "ray_tpu/worker/mgr.py", """
+        class Mgr:
+            def transition(self, rec):
+                rec.state = "dead"  # raylint: disable=fsm-transition-event
+    """)
+    assert _lint(tmp_path, ["ray_tpu"],
+                 select=["fsm-transition-event"]) == []
+
+
 # ----------------------------------------------------------- suppressions
 
 
@@ -465,7 +535,8 @@ def test_cli_exit_codes(tmp_path):
         [sys.executable, "-m", "tools.raylint", "--list-checks"],
         capture_output=True, text=True, env=env, cwd=REPO_ROOT)
     assert r.returncode == 0
-    for cid in ("RTL001", "RTL002", "RTL003", "RTL004", "RTL005"):
+    for cid in ("RTL001", "RTL002", "RTL003", "RTL004", "RTL005",
+                "RTL006"):
         assert cid in r.stdout
 
     r = subprocess.run(

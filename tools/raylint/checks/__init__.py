@@ -2,6 +2,7 @@
 
 from tools.raylint.checks import (  # noqa: F401
     blocking_in_handler,
+    fsm_event,
     lock_order,
     rpc_surface,
     spec_serialization,

@@ -1,0 +1,123 @@
+"""RTL006 fsm-transition-event.
+
+Invariant: every FSM state transition in the control plane must leave a
+record in the cluster lifecycle event log (_private/event_log.py). A
+`.state` / `.status` assignment in gcs/, raylet/, or worker/ that emits no
+event is a transition post-mortems cannot see — exactly the class of gap
+that made PR 3's chaos failures (wedged ordering gates, hung streams) die
+with no durable record of which transition went wrong on which process.
+
+Mechanics: inside the configured scope paths, any assignment whose target
+is an attribute named in `state-attrs` (default: state, status) on a
+non-`self` receiver must share its enclosing function with at least one
+call whose dotted name contains an `emit-call-substring` match (default:
+"emit" — covers `_elog.emit(...)`, `event_log.emit(...)`,
+`self._emit_actor_state(...)`, `self._emit_state(...)`). Suppress a
+deliberate silent transition with `# raylint: disable=fsm-transition-event`.
+
+Paired with the golden event-schema corpus (tests/event_schema_golden.json,
+pinning event_log.EVENT_SCHEMAS): this check forces NEW transitions to
+emit; the golden makes renaming/retyping EXISTING events fail loudly.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Tuple
+
+from tools.raylint.core import (
+    Check,
+    Diagnostic,
+    Project,
+    dotted_name,
+    register_check,
+)
+
+DEFAULT_SCOPE_PATHS = ["ray_tpu/gcs/", "ray_tpu/raylet/", "ray_tpu/worker/"]
+DEFAULT_STATE_ATTRS = ["state", "status"]
+DEFAULT_EMIT_SUBSTRINGS = ["emit"]
+
+
+def _assigned_attrs(node: ast.AST) -> List[ast.Attribute]:
+    """Attribute targets of an assignment statement (a.b = / a.b: T = /
+    a.b += all count as transitions)."""
+    targets: List[ast.AST] = []
+    if isinstance(node, ast.Assign):
+        targets = list(node.targets)
+    elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+        targets = [node.target]
+    out = []
+    for t in targets:
+        if isinstance(t, ast.Tuple):
+            out.extend(el for el in t.elts if isinstance(el, ast.Attribute))
+        elif isinstance(t, ast.Attribute):
+            out.append(t)
+    return out
+
+
+@register_check
+class FsmEventCheck(Check):
+    name = "fsm-transition-event"
+    check_id = "RTL006"
+    description = (".state/.status FSM transition without an event-log "
+                   "emit in the same function (post-mortems go blind)")
+
+    def __init__(self, options: dict):
+        super().__init__(options)
+        self.scope_paths = tuple(options.get(
+            "scope-paths", DEFAULT_SCOPE_PATHS))
+        self.state_attrs = set(options.get(
+            "state-attrs", DEFAULT_STATE_ATTRS))
+        self.emit_substrings = tuple(options.get(
+            "emit-call-substrings", DEFAULT_EMIT_SUBSTRINGS))
+
+    def _is_emit_call(self, node: ast.Call) -> bool:
+        target = dotted_name(node.func)
+        if target is None:
+            return False
+        leaf = target.rsplit(".", 1)[-1]
+        return any(s in leaf for s in self.emit_substrings)
+
+    def _scan_function(self, fn: ast.AST) -> Tuple[
+            List[ast.Attribute], bool]:
+        """(state-attr assignment targets, has_emit_call) for one function
+        body, not descending into nested defs (a nested def's body runs at
+        a different time — its emit cannot vouch for the outer
+        transition, nor vice versa)."""
+        hits: List[ast.Attribute] = []
+        has_emit = False
+        stack: List[ast.AST] = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            if isinstance(node, ast.Call) and self._is_emit_call(node):
+                has_emit = True
+            for attr in _assigned_attrs(node):
+                if attr.attr in self.state_attrs and not (
+                        isinstance(attr.value, ast.Name)
+                        and attr.value.id == "self"):
+                    hits.append(attr)
+            stack.extend(ast.iter_child_nodes(node))
+        return hits, has_emit
+
+    def run(self, project: Project) -> Iterable[Diagnostic]:
+        for mod in project.target_modules():
+            if not any(mod.relpath.startswith(p) for p in self.scope_paths):
+                continue
+            for cls, fn in mod.functions():
+                hits, has_emit = self._scan_function(fn)
+                if not hits or has_emit:
+                    continue
+                fname = f"{cls + '.' if cls else ''}{fn.name}"
+                for attr in hits:
+                    recv = dotted_name(attr.value) or "<expr>"
+                    yield Diagnostic(
+                        self.check_id, self.name, mod.relpath,
+                        attr.lineno, attr.col_offset,
+                        f"FSM transition `{recv}.{attr.attr} = ...` in "
+                        f"{fname}() emits no event-log record; call "
+                        "event_log.emit()/an _emit_* helper in the same "
+                        "function, or suppress a deliberate silent "
+                        "transition")
