@@ -46,13 +46,32 @@ def _subprocess_benches() -> dict:
     except Exception as e:  # noqa: BLE001
         out["rllib_env_steps_error"] = str(e)[:200]
     try:
-        sv = run("ray_tpu.serve.benchmarks", 600)
+        sv = run("ray_tpu.serve.benchmarks", 600, "classic")
         out["serve_http_rps"] = sv["serve_http"]["rps"]
         out["serve_http_p50_ms"] = sv["serve_http"]["p50_ms"]
         out["serve_http_p99_ms"] = sv["serve_http"]["p99_ms"]
         out["serve_handle_rps"] = sv["serve_handle"]["rps"]
     except Exception as e:  # noqa: BLE001
         out["serve_error"] = str(e)[:200]
+    try:
+        # the ISSUE 6 serving gate: max rps HELD at a p99 bound (not
+        # peak rps), through the sharded proxy
+        sv = run("ray_tpu.serve.benchmarks", 600, "sustained")
+        s = sv["serve_http_sustained"]
+        out["serve_http_sustained_rps"] = s["rps"]
+        out["serve_http_sustained_p99_ms"] = s["p99_ms"]
+        out["serve_http_sustained_detail"] = s
+    except Exception as e:  # noqa: BLE001
+        out["serve_sustained_error"] = str(e)[:200]
+    try:
+        # prefix-cache TTFT: shared-system-prompt hit vs cold
+        sv = run("ray_tpu.serve.benchmarks", 600, "prefix")
+        p = sv["llm_prefix_ttft"]
+        out["llm_prefix_ttft_cold_ms"] = p["cold_p50_ms"]
+        out["llm_prefix_ttft_hit_ms"] = p["hit_p50_ms"]
+        out["llm_prefix_ttft_detail"] = p
+    except Exception as e:  # noqa: BLE001
+        out["llm_prefix_error"] = str(e)[:200]
     try:
         # serving-level LLM numbers (TTFT + delivered tokens/sec under
         # Poisson arrivals through serve.llm) so the perf trajectory

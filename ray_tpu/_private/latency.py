@@ -50,6 +50,18 @@ _recent: deque = deque(maxlen=2048)
 _stage_hist = None
 _total_hist = None
 
+# Raw breakdowns awaiting metric/trace recording. The owner's RPC reply
+# loop only APPENDS here (record_breakdown); the histogram observes and
+# chrome-trace span formatting — ~60us/task, enough to stall every
+# in-flight reply at serving rates — run on the drainer thread below.
+# Bounded: under a sustained burst the OLDEST breakdowns drop (the
+# histograms lose samples, never the request path).
+_pending_raw: deque = deque(maxlen=65536)
+_drain_lock = threading.Lock()
+_drainer: Optional[threading.Thread] = None
+_drain_wake = threading.Event()
+_DRAIN_INTERVAL_S = 0.5
+
 
 def _metrics():
     """Lazily create the per-process stage histograms (importing
@@ -103,8 +115,51 @@ def owner_breakdown(
 
 def record_breakdown(task_id_hex: str, name: str, task_type: str,
                      stages: Dict[str, float]) -> None:
-    """Observe one task's breakdown into metrics, the trace buffer, and
-    the ring buffer. Runs on the owner's RPC loop — keep it cheap."""
+    """Queue one task's breakdown for recording. Runs on the owner's RPC
+    reply loop, so it must stay O(1): the histogram observes and trace
+    span formatting happen on the drainer thread (readers drain inline
+    first, so `recent()`/metrics stay consistent at read time). NO
+    thread creation here — spawning a thread from the reply loop stalls
+    it for tens of ms on gVisor-class kernels, which is exactly the tail
+    this deferral removes (CoreWorker.__init__ calls start_drainer)."""
+    _pending_raw.append((task_id_hex, name, task_type, stages))
+    _drain_wake.set()
+
+
+def start_drainer() -> None:
+    """Start the background drainer (idempotent). Called from cold paths
+    only (process init), never from the request path."""
+    global _drainer
+    with _drain_lock:
+        if _drainer is not None and _drainer.is_alive():
+            return
+        _drainer = threading.Thread(target=_drain_loop, daemon=True,
+                                    name="rt-latency-drain")
+        _drainer.start()
+
+
+def _drain_loop() -> None:
+    while True:
+        _drain_wake.wait(timeout=_DRAIN_INTERVAL_S)
+        _drain_wake.clear()
+        try:
+            drain_pending()
+        except Exception:  # noqa: BLE001 — the drainer must never die
+            pass
+
+
+def drain_pending() -> None:
+    """Record every queued breakdown (drainer thread + read paths)."""
+    while True:
+        try:
+            item = _pending_raw.popleft()
+        except IndexError:
+            return
+        _record_one(*item)
+
+
+def _record_one(task_id_hex: str, name: str, task_type: str,
+                stages: Dict[str, float]) -> None:
     stage_hist, total_hist = _metrics()
     total = 0.0
     for stage in STAGES:
@@ -140,12 +195,14 @@ def record_breakdown(task_id_hex: str, name: str, task_type: str,
 
 def recent(n: int = 100) -> List[Dict[str, Any]]:
     """The last n recorded breakdowns in this process (newest last)."""
+    drain_pending()
     with _lock:
         out = list(_recent)
     return out[-n:]
 
 
 def clear_recent() -> None:
+    _pending_raw.clear()
     with _lock:
         _recent.clear()
 

@@ -17,6 +17,14 @@ block tables; ray itself ships no engine):
     already-emitted tokens) once space frees — emitted tokens stay
     emitted; generation resumes exactly where it stopped (vLLM's
     RECOMPUTE preemption mode).
+  * PREFIX CACHING (ISSUE 6 tentpole): blocks are content-addressed by a
+    chain hash over their token prefix. A released request's full blocks
+    stay in the pool as a ref-counted cache (LRU-evicted at refcount
+    zero); a new request whose prompt shares a cached prefix attaches
+    the matched blocks read-only and prefills ONLY the tail — a million
+    users sharing a system prompt pay its prefill once. The one block a
+    matched request must write into (the sampling position when the
+    whole prompt matched) is copied on write, never mutated in place.
 
 Static shapes throughout: one prefill program per bucket, one decode
 program per chunk size; the block table is a fixed [max_batch,
@@ -25,6 +33,8 @@ max_blocks_per_seq] operand.
 
 from __future__ import annotations
 
+import hashlib
+from collections import OrderedDict
 from functools import partial
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
@@ -51,6 +61,7 @@ class PagedInferenceEngine:
         decode_chunk: int = 16,
         forward_with_paged_cache: Optional[Callable] = None,
         init_paged_kv_cache: Optional[Callable] = None,
+        enable_prefix_cache: bool = True,
     ):
         from ray_tpu.models import llama
 
@@ -88,6 +99,26 @@ class PagedInferenceEngine:
         self.free_slots = list(range(max_batch))
         self.free_blocks = list(range(1, n_blocks))  # 0 = scratch
         self.slot_blocks: Dict[int, List[int]] = {}
+        # -- prefix cache (content-addressed, ref-counted) -------------------
+        self.enable_prefix_cache = enable_prefix_cache
+        # tokens whose KV the pool holds per slot (== lengths[slot]); the
+        # source of truth for promoting a released slot's blocks into the
+        # content index
+        self.slot_tokens: Dict[int, List[int]] = {}
+        self.block_ref: Dict[int, int] = {}      # block -> attached slots
+        self.block_hash: Dict[int, bytes] = {}   # block -> chain hash
+        self.hash_index: Dict[bytes, int] = {}   # chain hash -> block
+        # refcount-zero blocks still serving the index, oldest-released
+        # first (eviction order); every non-scratch block is in exactly
+        # one of free_blocks / cached_lru / block_ref(>0)
+        self.cached_lru: "OrderedDict[int, None]" = OrderedDict()
+        kv_bytes = sum(int(x.size) * x.dtype.itemsize
+                       for x in jax.tree.leaves(self.pool))
+        self._bytes_per_token = kv_bytes // (n_blocks * block_size)
+        self.prefix_stats = {
+            "hit_requests": 0, "miss_requests": 0, "hit_tokens": 0,
+            "evictions": 0, "bytes_saved": 0, "cow_copies": 0,
+        }
         self._key = jax.random.PRNGKey(0)
         self.decode_chunk = max(1, decode_chunk)
         self.preemptions = 0  # observability: recompute-preemption count
@@ -99,18 +130,21 @@ class PagedInferenceEngine:
 
         @partial(jax.jit, donate_argnums=(1,),
                  static_argnames=("temperature", "top_k", "top_p"))
-        def prefill_batch(params, pool, tokens, block_rows, true_lens, key,
-                          temperature=0.0, top_k=0, top_p=1.0):
+        def prefill_batch(params, pool, tokens, block_rows, true_lens,
+                          offsets, key, temperature=0.0, top_k=0, top_p=1.0):
             """Batched admission wave: tokens [N, bucket], block_rows
-            [N, max_blocks], true_lens [N]. Prefills every row into its
-            reserved blocks and samples each first token on-device —
-            one dispatch per admission wave instead of a prefill + a
-            sample round trip per request."""
+            [N, max_blocks], true_lens [N], offsets [N]. Prefills every
+            row's TAIL (tokens at positions offsets..offsets+true_lens)
+            into its reserved blocks and samples each first token
+            on-device — one dispatch per admission wave instead of a
+            prefill + a sample round trip per request. offsets are the
+            prefix-cache hit lengths (0 for cold rows): matched positions
+            already hold their KV, only the tail runs the model."""
             n, s = tokens.shape
             valid = jnp.arange(s)[None, :] < true_lens[:, None]
             logits, pool = self._fwd(
-                params, tokens, pool, block_rows,
-                jnp.zeros((n,), jnp.int32), self.config, valid=valid)
+                params, tokens, pool, block_rows, offsets, self.config,
+                valid=valid)
             last = logits[jnp.arange(n), true_lens - 1]
             first = sample_token(last, key, temperature=temperature,
                                  top_k=top_k, top_p=top_p)
@@ -155,28 +189,136 @@ class PagedInferenceEngine:
                  key, out0))
             return pool, out, i
 
+        @partial(jax.jit, donate_argnums=(0,))
+        def copy_blocks(pool, src, dst):
+            """Copy-on-write: duplicate pool blocks src[i] -> dst[i] (one
+            gather/scatter over the block axis, batched per wave)."""
+            return jax.tree.map(lambda x: x.at[:, dst].set(x[:, src]), pool)
+
         self._prefill_batch = prefill_batch
         self._decode = decode
+        self._copy_blocks = copy_blocks
 
     # -- block allocator -----------------------------------------------------
 
     def _blocks_for(self, n_tokens: int) -> int:
         return -(-n_tokens // self.block_size)
 
+    def available_blocks(self) -> int:
+        """Blocks allocatable right now: truly free + cached-evictable."""
+        return len(self.free_blocks) + len(self.cached_lru)
+
+    def _alloc_block(self) -> Optional[int]:
+        """Claim a writable block: free list first, then evict the
+        least-recently-released cached block from the content index."""
+        if self.free_blocks:
+            return self.free_blocks.pop()
+        if self.cached_lru:
+            b, _ = self.cached_lru.popitem(last=False)
+            h = self.block_hash.pop(b, None)
+            if h is not None and self.hash_index.get(h) == b:
+                del self.hash_index[h]
+            self.prefix_stats["evictions"] += 1
+            return b
+        return None
+
+    def _unref_block(self, b: int) -> None:
+        """Drop one slot's reference; at zero the block either stays
+        cached (content-indexed -> LRU) or returns to the free list."""
+        n = self.block_ref.get(b, 0) - 1
+        if n > 0:
+            self.block_ref[b] = n
+            return
+        self.block_ref.pop(b, None)
+        h = self.block_hash.get(b)
+        if h is not None and self.hash_index.get(h) == b:
+            self.cached_lru[b] = None
+        else:
+            self.block_hash.pop(b, None)
+            self.free_blocks.append(b)
+
+    def _attach_block(self, b: int) -> None:
+        """Add one slot's reference to a cached/shared block."""
+        n = self.block_ref.get(b, 0)
+        if n == 0:
+            self.cached_lru.pop(b, None)
+        self.block_ref[b] = n + 1
+
+    def _chain_hashes(self, tokens: List[int]) -> List[bytes]:
+        """Content identity per FULL block: hash k covers tokens
+        [0, (k+1)*block_size) — position-dependent by construction, so
+        equal hashes mean equal KV contents for the whole prefix."""
+        bs = self.block_size
+        out = []
+        h = b""
+        for k in range(len(tokens) // bs):
+            m = hashlib.blake2b(h, digest_size=16)
+            m.update(np.asarray(tokens[k * bs:(k + 1) * bs],
+                                np.int32).tobytes())
+            h = m.digest()
+            out.append(h)
+        return out
+
+    def _promote(self, blocks: List[int], tokens: List[int]) -> None:
+        """Index a released slot's full blocks by content so future
+        prompts sharing the prefix can reuse their KV. Partial tail
+        blocks are never indexed (their content is not a full block)."""
+        if not self.enable_prefix_cache:
+            return
+        for k, h in enumerate(self._chain_hashes(tokens)):
+            b = blocks[k]
+            if b in self.block_hash:
+                continue  # already indexed (attached from the cache)
+            if h in self.hash_index:
+                continue  # duplicate content: one copy serves the index
+            self.hash_index[h] = b
+            self.block_hash[b] = h
+
+    def _match_prefix(self, prefix: List[int]) -> Tuple[List[int], int]:
+        """Longest cached block run covering `prefix` -> (blocks,
+        n_matched_tokens). Matched tokens are capped at len(prefix)-1:
+        the last prompt position must be re-computed to produce the
+        first sampling logits, and when that position falls inside the
+        final matched block the admission path copies it on write."""
+        if not self.enable_prefix_cache:
+            return [], 0
+        blocks = []
+        for h in self._chain_hashes(prefix):
+            b = self.hash_index.get(h)
+            if b is None:
+                break
+            blocks.append(b)
+        # cap: matched blocks never exceed len(prefix)//block_size, so the
+        # cap only bites when the WHOLE prompt matched (len a multiple of
+        # block_size) — then m = len(prefix)-1 lands inside the final
+        # matched block and the caller copies it on write
+        m = min(len(blocks) * self.block_size, len(prefix) - 1)
+        if m <= 0:
+            return [], 0
+        return blocks, m
+
     def _ensure_capacity(self, slot: int, upto: int) -> bool:
         """Grow the slot's block list to cover `upto` tokens."""
         want = self._blocks_for(upto)
         blocks = self.slot_blocks.setdefault(slot, [])
         while len(blocks) < want:
-            if not self.free_blocks:
+            b = self._alloc_block()
+            if b is None:
                 return False
-            b = self.free_blocks.pop()
+            self.block_ref[b] = 1
             self.block_table[slot, len(blocks)] = b
             blocks.append(b)
         return True
 
     def _release(self, slot: int) -> None:
-        self.free_blocks.extend(self.slot_blocks.pop(slot, []))
+        blocks = self.slot_blocks.pop(slot, [])
+        tokens = self.slot_tokens.pop(slot, None)
+        if tokens is not None and blocks:
+            # promote BEFORE unref so a full block landing at refcount
+            # zero parks in the cache LRU instead of the free list
+            self._promote(blocks, tokens)
+        for b in blocks:
+            self._unref_block(b)
         self.block_table[slot, :] = 0
         self.lengths[slot] = 0
         self.free_slots.append(slot)
@@ -189,7 +331,7 @@ class PagedInferenceEngine:
         while len(blocks) > want:
             b = blocks.pop()
             self.block_table[slot, len(blocks)] = 0
-            self.free_blocks.append(b)
+            self._unref_block(b)
 
     def _bucket_for(self, n: int) -> int:
         for b in self.buckets:
@@ -200,21 +342,58 @@ class PagedInferenceEngine:
 
     # -- admission -----------------------------------------------------------
 
-    def _reserve(self, n_tokens: int) -> Optional[int]:
-        """Claim a slot + blocks covering n_tokens plus one decode token.
-        -> slot or None (no capacity)."""
+    def _reserve(self, prefix: List[int], match=None
+                 ) -> Optional[Tuple[int, int, Optional[Tuple[int, int]]]]:
+        """Claim a slot + blocks covering `prefix` plus one decode token,
+        reusing cached blocks for any content-matched prefix. ->
+        (slot, n_matched_tokens, cow_pair | None) or None (no capacity).
+        cow_pair = (src, dst): the final matched block must be duplicated
+        before the tail prefill writes into it (copy-on-write — the
+        cached original may back other slots and stays immutable)."""
         if not self.free_slots:
             return None
-        if len(self.free_blocks) < self._blocks_for(n_tokens) + 1:
+        matched, m = match if match is not None else \
+            self._match_prefix(prefix)
+        # does the tail's first write land inside the matched region?
+        cow = bool(matched) and m < len(matched) * self.block_size
+        n_new = (self._blocks_for(len(prefix) + 1) - len(matched)
+                 + (1 if cow else 0))
+        # matched blocks at refcount zero sit in the LRU: attaching them
+        # removes them from the evictable pool, so they must not count
+        # toward the capacity that will serve the n_new fresh allocations
+        lru_matched = sum(1 for b in matched if b in self.cached_lru)
+        if self.available_blocks() - lru_matched < n_new:
             return None
         slot = self.free_slots.pop()
-        if not self._ensure_capacity(slot, n_tokens + 1):
+        cow_pair = None
+        blocks = self.slot_blocks.setdefault(slot, [])
+        for i, b in enumerate(matched):
+            if cow and i == len(matched) - 1:
+                dst = self._alloc_block()
+                if dst is None:  # raced empty despite the pre-check
+                    self._release(slot)
+                    return None
+                self.block_ref[dst] = 1
+                cow_pair = (b, dst)
+                b = dst
+                self.prefix_stats["cow_copies"] += 1
+            else:
+                self._attach_block(b)
+            self.block_table[slot, len(blocks)] = b
+            blocks.append(b)
+        if not self._ensure_capacity(slot, len(prefix) + 1):
             # raced out of blocks despite the pre-check above; _release
             # returns both the slot AND any blocks the partial allocation
             # already consumed
             self._release(slot)
             return None
-        return slot
+        if m > 0:
+            self.prefix_stats["hit_requests"] += 1
+            self.prefix_stats["hit_tokens"] += m
+            self.prefix_stats["bytes_saved"] += m * self._bytes_per_token
+        else:
+            self.prefix_stats["miss_requests"] += 1
+        return slot, m, cow_pair
 
     # -- generation ----------------------------------------------------------
 
@@ -224,9 +403,16 @@ class PagedInferenceEngine:
             "max_batch": self.max_batch,
             "active_slots": self.max_batch - len(self.free_slots),
             "free_blocks": len(self.free_blocks),
+            "available_blocks": self.available_blocks(),
             "n_blocks": self.n_blocks,
             "preemptions": self.preemptions,
             "peak_active": self.peak_active,
+            "prefix_cache": {
+                **self.prefix_stats,
+                "enabled": self.enable_prefix_cache,
+                "cached_blocks": len(self.cached_lru),
+                "indexed_blocks": len(self.hash_index),
+            },
         }
 
     def serve_stream(
@@ -317,48 +503,76 @@ class PagedInferenceEngine:
                         self._release(slot)
 
         def admit_all():
-            """Admit pending requests in bucket-grouped waves: reserve
-            slot+blocks host-side for as many as fit, then ONE batched
-            prefill dispatch samples every first token on-device."""
+            """Admit pending requests in tail-bucket-grouped waves:
+            match each prompt against the prefix cache, reserve
+            slot+blocks host-side for as many as fit, run the batched
+            COW block copies (one dispatch), then ONE batched prefill
+            over the UNMATCHED tails samples every first token
+            on-device. A full-prefix hit prefills one token."""
             while pending and self.free_slots:
-                wave = []  # (req_id, prompt, emitted, max_new, slot, prefix)
+                # wave rows: (req_id, prompt, emitted, max_new, slot,
+                #             prefix, n_matched)
+                wave = []
+                cow_pairs = []
                 bucket = None
                 while pending:
                     req_id, prompt, emitted, max_new = pending[-1]
                     # cache must hold prompt + all emitted tokens EXCEPT
                     # the last (which is the next decode input)
                     prefix = prompt + emitted[:-1] if emitted else prompt
-                    b = self._bucket_for(len(prefix))
+                    match = self._match_prefix(prefix)
+                    b = self._bucket_for(len(prefix) - match[1])
                     if bucket is None:
                         bucket = b
                     elif b != bucket:
                         break
-                    slot = self._reserve(len(prefix))
-                    if slot is None:
+                    res = self._reserve(prefix, match=match)
+                    if res is None:
                         break  # pool full: wait for frees/preemption
+                    slot, n_matched, cow = res
+                    if cow is not None:
+                        cow_pairs.append(cow)
                     pending.pop()
                     wave.append((req_id, prompt, emitted, max_new, slot,
-                                 prefix))
+                                 prefix, n_matched))
                 if not wave:
                     return
                 n = len(wave)
                 toks = np.zeros((n, bucket), np.int32)
                 true_lens = np.zeros((n,), np.int32)
+                offsets = np.zeros((n,), np.int32)
                 rows = np.zeros((n, self.max_blocks_per_seq), np.int32)
-                for i, (_, _, _, _, slot, prefix) in enumerate(wave):
-                    toks[i, :len(prefix)] = prefix
-                    true_lens[i] = len(prefix)
+                for i, (_, _, _, _, slot, prefix, m) in enumerate(wave):
+                    tail = prefix[m:]
+                    toks[i, :len(tail)] = tail
+                    true_lens[i] = len(tail)
+                    offsets[i] = m
                     rows[i] = self.block_table[slot]
                 self._key, sub = jax.random.split(self._key)
                 try:
+                    if cow_pairs:
+                        # pad the pair list to a power of two so the copy
+                        # program compiles O(log) variants, not one per
+                        # count; scratch->scratch pads are no-ops
+                        n_cow = 1
+                        while n_cow < len(cow_pairs):
+                            n_cow *= 2
+                        src = [s for s, _ in cow_pairs]
+                        dst = [d for _, d in cow_pairs]
+                        src += [0] * (n_cow - len(cow_pairs))
+                        dst += [0] * (n_cow - len(cow_pairs))
+                        self.pool = self._copy_blocks(
+                            self.pool, jnp.asarray(src, jnp.int32),
+                            jnp.asarray(dst, jnp.int32))
                     self.pool, firsts = self._prefill_batch(
                         self.params, self.pool, jnp.asarray(toks),
-                        jnp.asarray(rows), jnp.asarray(true_lens), sub,
+                        jnp.asarray(rows), jnp.asarray(true_lens),
+                        jnp.asarray(offsets), sub,
                         temperature=gen.temperature, top_k=gen.top_k,
                         top_p=gen.top_p)
                     firsts = np.asarray(firsts)
                 except Exception:
-                    for _, _, _, _, slot, _ in wave:
+                    for _, _, _, _, slot, _, _ in wave:
                         self._release(slot)
                     raise
                 # Bookkeep the WHOLE wave (register/release every slot)
@@ -369,8 +583,9 @@ class PagedInferenceEngine:
                 # leak the not-yet-registered slots forever.
                 first_tokens = []
                 for (req_id, prompt, emitted, max_new, slot,
-                     prefix), first in zip(wave, firsts):
+                     prefix, _m), first in zip(wave, firsts):
                     self.lengths[slot] = len(prefix)
+                    self.slot_tokens[slot] = list(prefix)
                     tok = int(first)
                     fresh = not emitted
                     if fresh:
@@ -501,6 +716,10 @@ class PagedInferenceEngine:
                 for slot in list(active):
                     st = active[slot]
                     self.lengths[slot] += 1
+                    # the KV just written belongs to the step's INPUT
+                    # token (the previous current) — track it so release
+                    # can promote full blocks into the prefix cache
+                    self.slot_tokens[slot].append(st["current"])
                     token = int(chunk[step, slot])
                     st["emitted"].append(token)
                     st["current"] = token

@@ -480,6 +480,14 @@ def cmd_llm(args) -> int:
                   f"done={rs.get('finished_requests')} "
                   f"slots={eng.get('active_slots')}/{eng.get('max_batch')} "
                   f"preemptions={eng.get('preemptions', 0)}")
+            pc = eng.get("prefix_cache")
+            if pc and pc.get("enabled"):
+                print(f"    prefix-cache: hits={pc.get('hit_requests', 0)} "
+                      f"misses={pc.get('miss_requests', 0)} "
+                      f"hit_tokens={pc.get('hit_tokens', 0)} "
+                      f"evictions={pc.get('evictions', 0)} "
+                      f"cached_blocks={pc.get('cached_blocks', 0)} "
+                      f"bytes_saved={pc.get('bytes_saved', 0)}")
         router = info.get("router") or {}
         if router and "error" not in router:
             print(f"  router: assigned={router.get('assigned_total')} "
@@ -497,6 +505,13 @@ def cmd_llm(args) -> int:
           f"preemptions={m.get('preemptions', 0):.0f} "
           f"shed={m.get('requests_shed', 0):.0f} "
           f"requests={m.get('requests', {})}")
+    pc = m.get("prefix_cache")
+    if pc:
+        print(f"prefix_cache: hits={pc.get('hit_requests', 0):.0f} "
+              f"misses={pc.get('miss_requests', 0):.0f} "
+              f"hit_tokens={pc.get('hit_tokens', 0):.0f} "
+              f"evictions={pc.get('evictions', 0):.0f} "
+              f"bytes_saved={pc.get('bytes_saved', 0):.0f}")
     return 0
 
 

@@ -97,6 +97,12 @@ class ServeController:
         # replica_id -> last reported num_ongoing_requests (piggybacked
         # to routers on long-poll replies)
         self._replica_metrics: Dict[str, int] = {}
+        # HTTP proxy shards (ISSUE 6): the controller owns shard
+        # lifecycle — spawn, health-check/restart, route pushes.
+        # proxy shard index -> actor handle; config survives restarts
+        self._proxy_shards: Dict[int, Any] = {}
+        self._proxy_started_at: Dict[int, float] = {}
+        self._proxy_config: Optional[Dict[str, Any]] = None
         self._shutdown = threading.Event()
         self._reconcile_thread = threading.Thread(
             target=self._run_control_loop, name="serve-controller",
@@ -148,6 +154,7 @@ class ServeController:
                     self._deployments[key] = _DeploymentState(
                         app_name, cfg["name"], cfg)
         self._wait_for_ready(app_name)
+        self.update_proxy_routes()
 
     def _wait_for_ready(self, app_name: str,
                         timeout: float = REPLICA_INIT_TIMEOUT_S) -> None:
@@ -175,6 +182,7 @@ class ServeController:
                     for r in state.replicas:
                         self._stop_replica(r)
                     self._bump(state.full_name)
+        self.update_proxy_routes()
 
     def _bump(self, key: str) -> None:
         """Mark `key`'s replica set changed; wakes parked long-polls."""
@@ -246,9 +254,141 @@ class ServeController:
                     self._stop_replica(r)
             self._deployments.clear()
             self._apps.clear()
+            shards = list(self._proxy_shards.values())
+            self._proxy_shards.clear()
+            self._proxy_config = None
+        for shard in shards:
+            try:
+                ray_tpu.kill(shard)
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
 
     def ping(self) -> str:
         return "pong"
+
+    # -- HTTP proxy shard lifecycle ------------------------------------------
+
+    def ensure_http_proxies(self, host: str = "127.0.0.1", port: int = 8000,
+                            num_shards: Optional[int] = None) -> int:
+        """Start (or adopt) the HTTP ingress: `num_shards` proxy shard
+        actors sharing one listen port via SO_REUSEPORT. Idempotent; a
+        later call can only grow the shard count (shrinking would strand
+        kernel-balanced connections). Returns the live shard count."""
+        from ray_tpu.serve._private.proxy import default_num_shards
+
+        with self._lock:
+            if self._proxy_config is not None:
+                host = self._proxy_config["host"]
+                port = self._proxy_config["port"]
+                num_shards = max(num_shards or 0,
+                                 self._proxy_config["num_shards"])
+            elif num_shards is None:
+                num_shards = default_num_shards()
+            num_shards = max(1, num_shards)
+            self._proxy_config = {"host": host, "port": port,
+                                  "num_shards": num_shards}
+        for idx in range(num_shards):
+            self._start_proxy_shard(idx)
+        # bind failures surface here, not on the first request
+        for idx, shard in sorted(self._proxy_shards.items()):
+            ray_tpu.get(shard.ready.remote(), timeout=30)
+        return len(self._proxy_shards)
+
+    def _start_proxy_shard(self, idx: int) -> None:
+        from ray_tpu.serve._private.proxy import ProxyActor
+
+        cfg = self._proxy_config
+        if cfg is None:
+            return
+        with self._lock:
+            if idx in self._proxy_shards:
+                return
+        try:
+            shard = ray_tpu.remote(ProxyActor).options(
+                name=f"SERVE_PROXY:{cfg['port']}:{idx}",
+                lifetime="detached", num_cpus=0.1,
+                get_if_exists=True, max_concurrency=256,
+            ).remote(host=cfg["host"], port=cfg["port"], shard_index=idx,
+                     num_shards=cfg["num_shards"])
+        except Exception:  # noqa: BLE001 — retried by _check_proxies
+            logger.exception("failed to start proxy shard %d", idx)
+            return
+        with self._lock:
+            self._proxy_shards[idx] = shard
+            self._proxy_started_at[idx] = time.monotonic()
+
+    def get_http_proxy_handles(self) -> Dict[int, Any]:
+        with self._lock:
+            return dict(self._proxy_shards)
+
+    def update_proxy_routes(self) -> None:
+        """Push the current route table to every shard (deploys/deletes).
+        Fan-out then harvest: a dead shard must not stall the rest (it
+        gets fresh routes when _check_proxies restarts it)."""
+        with self._lock:
+            shards = list(self._proxy_shards.values())
+        refs = []
+        for shard in shards:
+            try:
+                refs.append(shard.update_routes.remote())
+            except Exception:  # noqa: BLE001 — dead shard, restarted later
+                pass
+        if refs:
+            try:
+                ray_tpu.wait(refs, num_returns=len(refs), timeout=10.0)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _check_proxies(self) -> None:
+        """Health-check shards; restart dead ones (control loop). Young
+        shards get an init grace period — their ping is queued behind a
+        cold __init__ (imports + route pull), and killing them for that
+        would churn startup forever."""
+        now = time.monotonic()
+        with self._lock:
+            shards = [(i, s) for i, s in self._proxy_shards.items()
+                      if now - self._proxy_started_at.get(i, 0.0) > 20.0]
+        if not shards:
+            return
+        probes = []
+        for idx, shard in shards:
+            try:
+                probes.append((idx, shard, shard.ping.remote()))
+            except Exception:  # noqa: BLE001 — already dead
+                probes.append((idx, shard, None))
+        refs = [r for _, _, r in probes if r is not None]
+        done_set = set()
+        if refs:
+            try:
+                done, _ = ray_tpu.wait(refs, num_returns=len(refs),
+                                       timeout=5.0)
+                done_set = set(done)
+            except Exception:  # noqa: BLE001
+                pass
+        for idx, shard, ref in probes:
+            ok = ref is not None and ref in done_set
+            if ok:
+                try:
+                    ok = bool(ray_tpu.get(ref, timeout=0.1))
+                except Exception:  # noqa: BLE001 — shard crashed
+                    ok = False
+            if ok:
+                continue
+            logger.warning("proxy shard %d unhealthy; restarting", idx)
+            with self._lock:
+                self._proxy_shards.pop(idx, None)
+            try:
+                ray_tpu.kill(shard)
+            except Exception:  # noqa: BLE001
+                pass
+            self._start_proxy_shard(idx)
+            with self._lock:
+                fresh = self._proxy_shards.get(idx)
+            if fresh is not None:
+                try:
+                    fresh.update_routes.remote()
+                except Exception:  # noqa: BLE001
+                    pass
 
     # -- reconcile loop ------------------------------------------------------
 
@@ -261,6 +401,7 @@ class ServeController:
                 self._health_check()  # self-gated per deployment period
                 if now - last_health > HEALTH_CHECK_INTERVAL_S:
                     self._autoscale()
+                    self._check_proxies()
                     last_health = now
             except Exception:  # noqa: BLE001 — loop must survive
                 logger.exception("reconcile error")

@@ -1,9 +1,36 @@
 """HTTP ingress proxy (reference: ray python/ray/serve/_private/proxy.py:1130
 ProxyActor; HTTPProxy :761 — uvicorn/starlette there, aiohttp here).
 
-Routes: longest-matching route_prefix → the app's ingress deployment handle.
-GET/POST bodies are decoded as JSON when possible, else passed as raw bytes;
-responses likewise JSON-encoded unless already bytes/str.
+Sharded data plane (ISSUE 6 tentpole): N proxy shard actors share ONE
+listen port via SO_REUSEPORT — the kernel spreads connections across
+shards, so ingress scales with processes instead of one aiohttp loop.
+The controller owns shard lifecycle (spawn, health, restart, route
+pushes); shards never coordinate with each other on the request path.
+
+Request paths, hottest first:
+
+  * UNARY FAST PATH — the handler assigns a replica without blocking
+    (router.try_assign_request), then awaits the reply ref via a
+    memory-store completion callback: no executor hop, no parked thread
+    per request. Cold starts (no replicas yet) fall back to the
+    blocking assign on an executor thread.
+  * STREAMING — a per-connection _StreamPump: one feeder thread pulls
+    replica chunks (pre-encoded SSE frames for serve.llm — no per-chunk
+    re-encoding anywhere) into a byte-bounded queue; the aiohttp writer
+    drains it, and `stream.write`'s own flow control propagates client
+    backpressure. When the queue holds more than `stream_buffer_bytes`,
+    the FEEDER suspends — the replica-side generator pull stops instead
+    of buffering unboundedly. Client disconnect closes the replica-side
+    generator from the feeder thread (every shard, not just shard 0).
+  * serve.llm apps get a PER-SHARD embedded LLMRouter (built from the
+    app's ingress_flags) running against the shared replica set: token
+    streams skip the router-deployment hop entirely and no cross-shard
+    lock sits on the request path (shed bounds and session affinity are
+    per shard; SO_REUSEPORT keeps a keep-alive client on one shard).
+
+Routes: longest-matching route_prefix → the app's ingress deployment
+handle. GET/POST bodies are decoded as JSON when possible, else passed
+as raw bytes; responses likewise JSON-encoded unless already bytes/str.
 """
 
 from __future__ import annotations
@@ -11,6 +38,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import os
 import threading
 from typing import Any, Dict, Optional
 
@@ -18,7 +46,15 @@ import ray_tpu
 
 logger = logging.getLogger(__name__)
 
-_SENTINEL = object()  # end-of-stream marker for the chunked path
+# Per-connection cap on bytes queued between the replica-side feeder and
+# the client socket. Past it the feeder stops pulling the generator
+# (backpressure to the engine) instead of buffering; writes resume the
+# pull at half the cap.
+DEFAULT_STREAM_BUFFER_BYTES = 256 * 1024
+
+
+def default_num_shards() -> int:
+    return max(1, min(4, os.cpu_count() or 1))
 
 
 def _close_generator(gen) -> None:
@@ -44,21 +80,150 @@ def _http_status_of(e: BaseException) -> int:
     return 500
 
 
+def _encode_chunk(chunk) -> bytes:
+    if isinstance(chunk, bytes):
+        return chunk
+    if isinstance(chunk, str):
+        return chunk.encode()
+    return (json.dumps(chunk) + "\n").encode()
+
+
+class _StreamPump:
+    """Bounded bridge between a blocking replica-chunk iterator and the
+    asyncio writer. The feeder THREAD owns the iterator end to end
+    (creation can block on routing, pulls block on the engine, and
+    close-on-disconnect must not run on the event loop); the queue and
+    byte budget live on the loop thread, so neither side takes a lock on
+    the chunk path."""
+
+    def __init__(self, loop: asyncio.AbstractEventLoop, make_iter,
+                 max_bytes: int):
+        self._loop = loop
+        self._make_iter = make_iter
+        self._max = max_bytes
+        self._low = max(1, max_bytes // 2)
+        self._q: "asyncio.Queue" = asyncio.Queue()
+        self._queued_bytes = 0  # touched on the loop thread only
+        self._space = threading.Event()  # feeder waits; loop thread sets
+        self._space.set()
+        self._cancelled = False
+        self._thread = threading.Thread(
+            target=self._feed, name="serve-stream-feeder", daemon=True)
+        self._thread.start()
+
+    # -- feeder thread -------------------------------------------------------
+
+    def _feed(self) -> None:
+        it = None
+        try:
+            it = self._make_iter()
+            for chunk in it:
+                data = _encode_chunk(chunk)
+                self._space.wait()
+                if self._cancelled:
+                    break
+                self._loop.call_soon_threadsafe(self._enqueue, "chunk", data)
+            else:
+                self._loop.call_soon_threadsafe(self._enqueue, "end", None)
+        except BaseException as e:  # noqa: BLE001 — reported in-band
+            if not self._cancelled:
+                try:
+                    self._loop.call_soon_threadsafe(self._enqueue, "err", e)
+                except RuntimeError:  # loop closed mid-teardown
+                    pass
+        finally:
+            if self._cancelled and it is not None:
+                _close_generator(it)
+
+    # -- loop thread ---------------------------------------------------------
+
+    def _enqueue(self, kind: str, data) -> None:
+        if kind == "chunk":
+            self._queued_bytes += len(data)
+            if self._queued_bytes >= self._max:
+                self._space.clear()
+        self._q.put_nowait((kind, data))
+
+    async def get(self):
+        """Next (kind, data); coalesces every already-queued chunk into
+        one bytes object (fewer writer wakeups + socket writes, zero
+        added latency — only data that is ALREADY waiting coalesces)."""
+        kind, data = await self._q.get()
+        if kind != "chunk":
+            return kind, data
+        parts = [data]
+        while not self._q.empty():
+            k2, d2 = self._q.get_nowait()
+            if k2 != "chunk":
+                # re-queue the terminal marker for the next get()
+                self._q.put_nowait((k2, d2))
+                break
+            parts.append(d2)
+        out = b"".join(parts)
+        self._queued_bytes -= len(out)
+        if self._queued_bytes <= self._low and not self._space.is_set():
+            self._space.set()
+        return "chunk", out
+
+    def cancel(self) -> None:
+        """Client went away: stop the feeder and close the replica-side
+        generator (on the feeder thread, off the event loop)."""
+        self._cancelled = True
+        self._space.set()
+
+
 class ProxyActor:
-    def __init__(self, host: str = "127.0.0.1", port: int = 8000):
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000,
+                 shard_index: int = 0, num_shards: int = 1,
+                 stream_buffer_bytes: int = DEFAULT_STREAM_BUFFER_BYTES):
         self._host = host
         self._port = port
-        self._routes: Dict[str, Any] = {}  # route_prefix -> handle
+        self._shard_index = shard_index
+        self._num_shards = num_shards
+        self._stream_buffer_bytes = stream_buffer_bytes
+        self._routes: Dict[str, Any] = {}  # route_prefix -> route entry
+        self._llm_routers: Dict[str, Any] = {}  # app name -> LLMRouter
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._started = threading.Event()
+        self._bind_error: Optional[BaseException] = None
+        self._requests_served = 0
         self._thread = threading.Thread(
-            target=self._serve_forever, name="serve-proxy", daemon=True)
+            target=self._serve_forever,
+            name=f"serve-proxy-{shard_index}", daemon=True)
         self._thread.start()
         self.update_routes()
 
     def ready(self) -> str:
         self._started.wait(10)
+        if self._bind_error is not None:
+            raise RuntimeError(
+                f"proxy shard {self._shard_index} failed to bind "
+                f"{self._host}:{self._port}: {self._bind_error}")
+        if not self._started.is_set():
+            raise RuntimeError(
+                f"proxy shard {self._shard_index} failed to start")
         return f"http://{self._host}:{self._port}"
+
+    def ping(self) -> bool:
+        """Controller liveness probe: the serving thread must be up."""
+        return self._thread.is_alive() and self._started.is_set()
+
+    def get_stats(self) -> Dict[str, Any]:
+        return {
+            "shard_index": self._shard_index,
+            "num_shards": self._num_shards,
+            "requests_served": self._requests_served,
+            "routes": sorted(self._routes),
+            "llm_apps": sorted(self._llm_routers),
+        }
+
+    def llm_metrics_snapshot(self):
+        """Embedded per-shard LLM routers observe into THIS process's
+        registry (shed counters); collect_llm_metrics scrapes shards
+        alongside replicas."""
+        from ray_tpu.serve.llm import metrics as llm_metrics
+
+        return llm_metrics.snapshot()
 
     def update_routes(self) -> None:
         from ray_tpu.serve.context import get_controller
@@ -70,24 +235,116 @@ class ProxyActor:
             return
         apps = ray_tpu.get(controller.list_applications.remote())
         routes = {}
+        live_llm = set()
         for app_name, info in apps.items():
             handle = DeploymentHandle(info["ingress"], app_name)
+            flags = info.get("ingress_flags") or {}
+            llm_router = None
+            if flags.get("llm_engine"):
+                llm_router = self._ensure_llm_router(app_name, flags)
+                live_llm.add(app_name)
             # one long-lived stream-enabled handle per route, so streaming
             # requests share the router (and its replica/queue-len cache)
             # instead of rebuilding one per request
             routes[info["route_prefix"]] = (
-                handle, handle.options(stream=True),
-                info.get("ingress_flags") or {})
+                handle, handle.options(stream=True), flags, llm_router)
         self._routes = routes
+        for app_name in list(self._llm_routers):
+            if app_name not in live_llm:
+                router = self._llm_routers.pop(app_name)
+                try:
+                    router.shutdown()
+                except Exception:  # noqa: BLE001 — teardown
+                    pass
+
+    def _ensure_llm_router(self, app_name: str, flags: Dict[str, Any]):
+        """Per-shard serve.llm ingress: an LLMRouter instance running in
+        this shard against the shared engine-replica set (config rides
+        the app's ingress_flags from build_llm_app)."""
+        router = self._llm_routers.get(app_name)
+        if router is not None:
+            return router
+        from ray_tpu.serve.handle import DeploymentHandle
+        from ray_tpu.serve.llm.router import LLMRouter
+
+        cfg = flags.get("llm_config") or {}
+        try:
+            router = LLMRouter(
+                DeploymentHandle(flags["llm_engine"], app_name),
+                shed_queue_depth=cfg.get("shed_queue_depth", 64),
+                session_ttl_s=cfg.get("session_ttl_s", 600.0),
+                default_max_new_tokens=cfg.get("default_max_new_tokens", 64))
+        except Exception:  # noqa: BLE001 — fall back to the handle path
+            logger.exception("embedded llm router init failed for %r",
+                             app_name)
+            return None
+        self._llm_routers[app_name] = router
+        return router
 
     def _match_route(self, path: str):
         best = None
-        for prefix, (handle, stream_handle, flags) in self._routes.items():
+        for prefix, entry in self._routes.items():
             if path == prefix or path.startswith(
                     prefix.rstrip("/") + "/") or prefix == "/":
                 if best is None or len(prefix) > len(best[0]):
-                    best = (prefix, handle, stream_handle, flags)
+                    best = (prefix,) + entry
         return best
+
+    # -- async reply resolution ----------------------------------------------
+
+    def _await_ref(self, ref, timeout_s: float):
+        """Future resolving to the ref's value WITHOUT parking a thread:
+        a memory-store completion callback settles it on the loop. Values
+        living in plasma/remote locations are materialized on an executor
+        thread (their get can block on I/O); inline replies — the unary
+        serving case — deserialize right on the loop."""
+        from ray_tpu._raylet import get_core_worker
+
+        loop = self._loop
+        fut = loop.create_future()
+        cw = get_core_worker()
+
+        def _settle_inline():
+            if fut.done():
+                return
+            try:
+                # entry is present: timeout=0 cannot wait
+                fut.set_result(ray_tpu.get(ref, timeout=0))
+            except BaseException as e:  # noqa: BLE001 — surfaced to caller
+                fut.set_exception(e)
+
+        def _settle_executor():
+            if fut.done():
+                return
+
+            def _get():
+                try:
+                    value = ray_tpu.get(ref, timeout=timeout_s)
+                    loop.call_soon_threadsafe(
+                        lambda: None if fut.done()
+                        else fut.set_result(value))
+                except BaseException as e:  # noqa: BLE001
+                    loop.call_soon_threadsafe(
+                        lambda: None if fut.done()
+                        else fut.set_exception(e))
+
+            loop.run_in_executor(None, _get)
+
+        def _on_ready(entry) -> None:
+            # inline entries (serialized payload or cached value) resolve
+            # on the loop; plasma/remote-location entries go to a thread
+            inline = (entry.serialized is not None or entry.freed
+                      or entry.value is not entry.__class__.value)
+            try:
+                loop.call_soon_threadsafe(
+                    _settle_inline if inline else _settle_executor)
+            except RuntimeError:  # loop closed mid-teardown
+                pass
+
+        cw.memory_store.add_callback(ref.object_id(), _on_ready)
+        return asyncio.wait_for(fut, timeout_s)
+
+    # -- server --------------------------------------------------------------
 
     def _serve_forever(self) -> None:
         from aiohttp import web
@@ -100,7 +357,8 @@ class ProxyActor:
             match = self._match_route(request.path)
             if match is None:
                 return web.Response(status=404, text="no matching route")
-            prefix, handle, stream_handle, flags = match
+            prefix, handle, stream_handle, flags, llm_router = match
+            self._requests_served += 1
             body = await request.read()
 
             if flags.get("asgi"):
@@ -115,8 +373,7 @@ class ProxyActor:
                     "body": body,
                 }
                 try:
-                    resp = await loop.run_in_executor(
-                        None, lambda: handle.remote(raw).result(timeout_s=60))
+                    resp = await self._unary(handle, raw)
                 except Exception as e:  # noqa: BLE001 — surface as 500
                     logger.exception("asgi request failed")
                     return web.Response(status=500, text=str(e))
@@ -143,92 +400,20 @@ class ProxyActor:
                 arg = dict(request.query) if request.query else None
 
             if flags.get("streaming"):
-                # Route BEFORE committing the 200: replica assignment can
-                # fail (no replicas) and must surface as a 500, not a
-                # truncated stream. Routing blocks (queue-len probes), so
-                # keep it off the event loop like the unary paths.
-                try:
-                    gen = await loop.run_in_executor(
-                        None, lambda: stream_handle.remote(arg))
-                except Exception as e:  # noqa: BLE001 — surface as 500
-                    logger.exception("streaming route failed")
-                    return web.Response(status=500, text=str(e))
-                it = iter(gen)
+                if llm_router is not None:
+                    # per-shard serve.llm ingress: route + stream in the
+                    # feeder thread, frames arrive pre-encoded from the
+                    # engine replica
+                    def make_iter(r=llm_router, a=arg):
+                        return r(a)
+                else:
+                    def make_iter(h=stream_handle, a=arg):
+                        return iter(h.remote(a))
 
-                def next_chunk():
-                    try:
-                        return next(it)
-                    except StopIteration:
-                        return _SENTINEL
-
-                # Pull the FIRST chunk before committing the status: a
-                # replica that rejects up front (load shed → 429, bad
-                # request → 400, raise before the first yield → 5xx)
-                # must produce a real HTTP error, not a 200 that
-                # truncates. Only failures AFTER the first chunk are
-                # signaled in-band.
-                try:
-                    first = await loop.run_in_executor(None, next_chunk)
-                except Exception as e:  # noqa: BLE001 — pre-stream failure
-                    logger.exception("streaming request rejected")
-                    await loop.run_in_executor(None, _close_generator, gen)
-                    return web.Response(
-                        status=_http_status_of(e),
-                        text=str(getattr(e, "cause", None) or e))
-                stream = web.StreamResponse()
-                if flags.get("sse"):
-                    stream.content_type = "text/event-stream"
-                    stream.headers["Cache-Control"] = "no-cache"
-                    stream.headers["X-Accel-Buffering"] = "no"
-                stream.enable_chunked_encoding()
-                try:
-                    await stream.prepare(request)
-                except Exception:  # noqa: BLE001 — client gone pre-commit
-                    # stop the replica-side generator before propagating:
-                    # nobody will ever consume its chunks
-                    await loop.run_in_executor(None, _close_generator, gen)
-                    raise
-
-                try:
-                    chunk = first
-                    while True:
-                        if chunk is _SENTINEL:
-                            break
-                        if isinstance(chunk, bytes):
-                            pass
-                        elif isinstance(chunk, str):
-                            chunk = chunk.encode()
-                        else:
-                            chunk = (json.dumps(chunk) + "\n").encode()
-                        await stream.write(chunk)
-                        chunk = await loop.run_in_executor(None, next_chunk)
-                except Exception as e:  # noqa: BLE001 — mid-stream failure
-                    # status is already committed; signal the error in-band
-                    # instead of masking it as a clean end-of-stream. The
-                    # client may be the thing that failed (disconnect), so
-                    # the in-band write itself must not escape the handler.
-                    logger.exception("streaming request failed mid-stream")
-                    try:
-                        await stream.write(
-                            f"\n[stream error] {e}\n".encode())
-                    except Exception:  # noqa: BLE001 — client gone
-                        # cancel RPC off the event loop: it may block
-                        await loop.run_in_executor(
-                            None, _close_generator, gen)
-                finally:
-                    try:
-                        await stream.write_eof()
-                    except Exception:  # noqa: BLE001 — client gone
-                        # stop the replica-side generator: nobody is
-                        # consuming its chunks anymore (run_in_executor —
-                        # the cancel RPC must not stall other requests)
-                        await loop.run_in_executor(
-                            None, _close_generator, gen)
-                return stream
+                return await self._stream(request, flags, make_iter)
 
             try:
-                response = await loop.run_in_executor(
-                    None, lambda: handle.remote(arg).result(timeout_s=60))
+                response = await self._unary(handle, arg)
             except Exception as e:  # noqa: BLE001 — surface as status
                 logger.exception("request failed")
                 return web.Response(status=_http_status_of(e),
@@ -247,7 +432,96 @@ class ProxyActor:
         # rates (operators get request metrics from /metrics instead)
         runner = web.AppRunner(app, access_log=None)
         loop.run_until_complete(runner.setup())
-        site = web.TCPSite(runner, self._host, self._port)
-        loop.run_until_complete(site.start())
+        # One listen port for every shard: SO_REUSEPORT makes the kernel
+        # spread connections across shard processes. ALWAYS set it, even
+        # for a lone shard — ensure_http_proxies may grow the count
+        # later, and Linux only balances when every socket on the port
+        # opted in (a reuse_port-less first bind would EADDRINUSE every
+        # later shard forever). Platforms without SO_REUSEPORT fall back
+        # to a plain bind when (and only when) one shard is configured.
+        try:
+            site = web.TCPSite(runner, self._host, self._port,
+                               reuse_port=True)
+            loop.run_until_complete(site.start())
+        except BaseException as e:  # noqa: BLE001 — surfaced by ready()
+            if self._num_shards > 1:
+                self._bind_error = e
+                self._started.set()
+                return
+            try:
+                site = web.TCPSite(runner, self._host, self._port)
+                loop.run_until_complete(site.start())
+            except BaseException as e2:  # noqa: BLE001
+                self._bind_error = e2
+                self._started.set()
+                return
         self._started.set()
         loop.run_forever()
+
+    async def _unary(self, handle, arg, timeout_s: float = 60.0):
+        """Unary request: non-blocking replica assignment + async reply
+        await. Falls back to the blocking assign on an executor thread
+        only when no replica is known yet (cold start / scale-from-0)."""
+        loop = self._loop
+        resp = handle.try_remote(arg)
+        if resp is None:
+            resp = await loop.run_in_executor(
+                None, lambda: handle.remote(arg))
+        try:
+            return await self._await_ref(resp._ref, timeout_s)
+        finally:
+            resp._done()
+
+    async def _stream(self, request, flags: Dict[str, Any], make_iter):
+        from aiohttp import web
+
+        loop = self._loop
+        pump = _StreamPump(loop, make_iter, self._stream_buffer_bytes)
+        # Pull the FIRST chunk before committing the status: a replica
+        # that rejects up front (load shed → 429, bad request → 400,
+        # raise before the first yield → 5xx) must produce a real HTTP
+        # error, not a 200 that truncates. Only failures AFTER the first
+        # chunk are signaled in-band.
+        kind, first = await pump.get()
+        if kind == "err":
+            logger.warning("streaming request rejected: %s", first)
+            return web.Response(
+                status=_http_status_of(first),
+                text=str(getattr(first, "cause", None) or first))
+        stream = web.StreamResponse()
+        if flags.get("sse"):
+            stream.content_type = "text/event-stream"
+            stream.headers["Cache-Control"] = "no-cache"
+            stream.headers["X-Accel-Buffering"] = "no"
+        stream.enable_chunked_encoding()
+        try:
+            await stream.prepare(request)
+        except Exception:  # noqa: BLE001 — client gone pre-commit
+            pump.cancel()
+            raise
+
+        try:
+            while kind == "chunk":
+                # stream.write awaits the transport's drain when the
+                # client reads slowly — that suspension stops our queue
+                # drain, fills the byte budget, and suspends the feeder's
+                # generator pull: end-to-end backpressure with a bounded
+                # buffer at every hop
+                await stream.write(first)
+                kind, first = await pump.get()
+            if kind == "err":
+                # status is already committed; signal the error in-band
+                # instead of masking it as a clean end-of-stream
+                logger.warning("streaming request failed mid-stream: %s",
+                               first)
+                await stream.write(f"\n[stream error] {first}\n".encode())
+        except Exception:  # noqa: BLE001 — client disconnected mid-stream
+            # stop the feeder and cancel the replica-side generator
+            # (pump.cancel closes it on the feeder thread, off the loop)
+            pump.cancel()
+            return stream
+        try:
+            await stream.write_eof()
+        except Exception:  # noqa: BLE001 — client gone at EOF
+            pump.cancel()
+        return stream

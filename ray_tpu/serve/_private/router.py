@@ -176,6 +176,19 @@ class Router:
         ref = handle.handle_request.remote(method_name, args, kwargs)
         return self._track(ref, replica_id)
 
+    def try_assign_request(self, method_name: str, args: tuple,
+                           kwargs: dict):
+        """Non-blocking assign_request: None when no replica is known yet
+        (cold start / scale-from-zero) instead of parking the caller.
+        The proxy's async handlers use this so the event loop never waits
+        on replica availability."""
+        choice = self._scheduler.choose_replica()
+        if choice is None:
+            return None
+        replica_id, handle = choice
+        ref = handle.handle_request.remote(method_name, args, kwargs)
+        return self._track(ref, replica_id)
+
     def assign_request_streaming(self, method_name: str, args: tuple,
                                  kwargs: dict):
         """Returns an ObjectRefGenerator of response chunks."""

@@ -190,23 +190,25 @@ def _ensure_grpc_proxy(grpc_options: Optional[dict] = None):
 
 
 def _ensure_proxy(http_options: Optional[dict] = None):
+    """HTTP ingress = N proxy shard actors sharing one listen port; the
+    CONTROLLER owns their lifecycle (spawn/health/restart/route pushes).
+    `http_options`: host, port, num_shards (default min(4, cpus))."""
     global _proxy
     import ray_tpu
-    from ray_tpu.serve._private.proxy import ProxyActor
 
-    if _proxy is None:
-        opts = http_options or {}
-        _proxy = ray_tpu.remote(ProxyActor).options(
-            name="SERVE_PROXY", lifetime="detached", num_cpus=0.1,
-            get_if_exists=True, max_concurrency=256,
-        ).remote(host=opts.get("host", "127.0.0.1"),
-                 port=opts.get("port", 8000))
-        ray_tpu.get(_proxy.ready.remote())
+    opts = http_options or {}
+    controller = serve_context.get_controller(create=True)
+    ray_tpu.get(controller.ensure_http_proxies.remote(
+        host=opts.get("host", "127.0.0.1"),
+        port=opts.get("port", 8000),
+        num_shards=opts.get("num_shards")), timeout=60)
+    _proxy = controller
     return _proxy
 
 
 def run(app: Application, *, name: str = "default", route_prefix: str = "/",
         _blocking: bool = False, http_port: Optional[int] = None,
+        http_shards: Optional[int] = None,
         grpc_port: Optional[int] = None,
         grpc_servicer_functions: Optional[list] = None) -> DeploymentHandle:
     controller = serve_context.get_controller(create=True)
@@ -270,13 +272,21 @@ def run(app: Application, *, name: str = "default", route_prefix: str = "/",
         # ingress, so any process can discover LLM apps (CLI/dashboard
         # metric collection) from the controller alone
         "llm_engine": getattr(root_fc, "__serve_llm_engine__", None),
+        # router construction knobs: proxy shards build a PER-SHARD
+        # embedded LLMRouter from these (shed bound / affinity TTL /
+        # default token budget), so HTTP token streams skip the
+        # router-deployment hop
+        "llm_config": getattr(root_fc, "__serve_llm_config__", None),
     }
     ray_tpu.get(controller.deploy_application.remote(
         name, deployments, app.root.deployment.name, route_prefix,
         ingress_flags))
     if http_port is not None:
-        proxy = _ensure_proxy({"port": http_port})
-        ray_tpu.get(proxy.update_routes.remote())
+        # no explicit route push needed: shards that existed before this
+        # deploy already got the push from deploy_application, and fresh
+        # shards read the route table in ProxyActor.__init__ (which runs
+        # after the deploy above committed)
+        _ensure_proxy({"port": http_port, "num_shards": http_shards})
     if grpc_port is not None or grpc_servicer_functions:
         actor, _port = _ensure_grpc_proxy({
             "port": grpc_port if grpc_port is not None else 9000,
@@ -335,16 +345,12 @@ def shutdown() -> None:
     except RuntimeError:
         return
     try:
+        # controller.shutdown also kills the HTTP proxy shards it owns
         ray_tpu.get(controller.shutdown.remote(), timeout=30)
         ray_tpu.kill(controller)
     except Exception:  # noqa: BLE001 — best-effort teardown
         pass
-    if _proxy is not None:
-        try:
-            ray_tpu.kill(_proxy)
-        except Exception:  # noqa: BLE001
-            pass
-        _proxy = None
+    _proxy = None
     if _grpc_proxy is not None:
         actor, _port = _grpc_proxy
         try:

@@ -114,6 +114,22 @@ class DeploymentHandle:
         ref = router.assign_request(self._method_name, args, kwargs)
         return DeploymentResponse(ref, router)
 
+    def try_remote(self, *args, **kwargs) -> Optional[DeploymentResponse]:
+        """Non-blocking remote(): None when no replica is available yet
+        instead of waiting for one (the proxy's event-loop fast path;
+        unary calls only)."""
+        if self._stream:
+            raise ValueError("try_remote does not support stream=True")
+        args = tuple(a._to_object_ref() if isinstance(a, DeploymentResponse)
+                     else a for a in args)
+        kwargs = {k: v._to_object_ref() if isinstance(v, DeploymentResponse)
+                  else v for k, v in kwargs.items()}
+        router = self._get_router()
+        ref = router.try_assign_request(self._method_name, args, kwargs)
+        if ref is None:
+            return None
+        return DeploymentResponse(ref, router)
+
     def __reduce__(self):
         return (DeploymentHandle,
                 (self.deployment_name, self.app_name, self._method_name,

@@ -68,12 +68,19 @@ def build_llm_app(build_engine, *, name: str = "llm",
     # the app's ingress_flags to the controller, making LLM apps (and
     # their metric sources) discoverable from any process (CLI,
     # dashboard) — see metrics.find_llm_apps.
+    default_max_new = (default_config or {}).get("max_new_tokens", 64)
     router_cls = type("LLMRouter", (LLMRouter,),
                       {"__serve_llm_engine__": engine_name,
+                       # proxy shards rebuild this router config locally
+                       # (per-shard embedded ingress; see _private/proxy)
+                       "__serve_llm_config__": {
+                           "shed_queue_depth": shed_queue_depth,
+                           "session_ttl_s": session_ttl_s,
+                           "default_max_new_tokens": default_max_new,
+                       },
                        "__module__": LLMRouter.__module__})
     router_d = Deployment(router_cls, name=name, num_replicas=1,
                           max_ongoing_requests=128)
-    default_max_new = (default_config or {}).get("max_new_tokens", 64)
     return router_d.bind(engine_app, shed_queue_depth=shed_queue_depth,
                          session_ttl_s=session_ttl_s,
                          default_max_new_tokens=default_max_new)

@@ -106,6 +106,7 @@ class LLMEngineReplica:
         self._cancels: set = set()
         self._next_id = itertools.count()
         self._seen_preemptions = 0
+        self._seen_prefix: Dict[str, int] = {}
         self._n_finished = 0
         self._shutdown = threading.Event()
         # metric tag values (stable for this replica's lifetime)
@@ -192,6 +193,15 @@ class LLMEngineReplica:
         finally:
             if not finished:
                 self._cancel(rq)
+
+    def generate_stream_sse(self, prompt: List[int],
+                            max_new_tokens: Optional[int] = None):
+        """generate_stream with each token PRE-ENCODED as a complete SSE
+        frame at the source (zero-copy streaming, ISSUE 6): the router
+        and the HTTP proxy forward these bytes untouched, so a token is
+        serialized exactly once on its way to the client."""
+        for tok in self.generate_stream(prompt, max_new_tokens):
+            yield b'data: {"token": %d}\n\n' % tok
 
     def generate(self, prompt: List[int],
                  max_new_tokens: Optional[int] = None,
@@ -314,6 +324,17 @@ class LLMEngineReplica:
             llm_metrics.preemptions_counter().inc(
                 preempt - self._seen_preemptions, tags=self._tags)
             self._seen_preemptions = preempt
+        prefix = getattr(eng, "prefix_stats", None)
+        if prefix:
+            # engine counters are cumulative; export only the delta
+            for name, (_d, key) in \
+                    llm_metrics.PREFIX_CACHE_COUNTERS.items():
+                cur = prefix.get(key, 0)
+                seen = self._seen_prefix.get(key, 0)
+                if cur > seen:
+                    llm_metrics.prefix_cache_counter(name).inc(
+                        cur - seen, tags=self._tags)
+                    self._seen_prefix[key] = cur
 
     def _feed(self, block: bool):
         new: List[_Request] = []

@@ -38,6 +38,11 @@ TOKENS_NAME = "ray_tpu_llm_tokens_generated_total"
 PREEMPTIONS_NAME = "ray_tpu_llm_preemptions_total"
 REQUESTS_NAME = "ray_tpu_llm_requests_total"
 SHED_NAME = "ray_tpu_llm_requests_shed_total"
+PREFIX_HITS_NAME = "ray_tpu_llm_prefix_cache_hits_total"
+PREFIX_MISSES_NAME = "ray_tpu_llm_prefix_cache_misses_total"
+PREFIX_HIT_TOKENS_NAME = "ray_tpu_llm_prefix_cache_hit_tokens_total"
+PREFIX_EVICTIONS_NAME = "ray_tpu_llm_prefix_cache_evictions_total"
+PREFIX_BYTES_SAVED_NAME = "ray_tpu_llm_prefix_cache_bytes_saved_total"
 
 _TAG_KEYS = ("deployment", "replica")
 
@@ -99,6 +104,28 @@ def shed_counter() -> um.Counter:
                           tag_keys=("deployment",))
 
 
+# engine prefix-cache counter name -> (metric factory args, engine
+# prefix_stats key); the replica diffs the engine's cumulative stats into
+# these each gauge refresh (engine.py _update_gauges)
+PREFIX_CACHE_COUNTERS = {
+    PREFIX_HITS_NAME: ("requests admitted with a prefix-cache hit",
+                       "hit_requests"),
+    PREFIX_MISSES_NAME: ("requests admitted with no cached prefix",
+                         "miss_requests"),
+    PREFIX_HIT_TOKENS_NAME: ("prompt tokens whose prefill was skipped "
+                             "(KV served from cached blocks)",
+                             "hit_tokens"),
+    PREFIX_EVICTIONS_NAME: ("cached KV blocks evicted (LRU) to serve "
+                            "new allocations", "evictions"),
+    PREFIX_BYTES_SAVED_NAME: ("KV bytes not recomputed thanks to "
+                              "prefix-cache hits", "bytes_saved"),
+}
+
+
+def prefix_cache_counter(name: str) -> um.Counter:
+    return _get_or_create(um.Counter, name, PREFIX_CACHE_COUNTERS[name][0])
+
+
 def snapshot() -> List[Dict]:
     """Cumulative snapshot of this process's llm metrics (RPC payload)."""
     return um.snapshot_metrics(METRIC_PREFIX)
@@ -154,6 +181,20 @@ def collect_llm_metrics(app_name: Optional[str] = None,
                 probes.append((
                     rid,
                     h.handle_request.remote("llm_metrics_snapshot", (), {})))
+    if apps:
+        # proxy shards host per-shard embedded LLM routers whose shed
+        # counters live in the shard process registries
+        try:
+            shards = ray_tpu.get(
+                controller.get_http_proxy_handles.remote(), timeout=5)
+        except Exception:  # noqa: BLE001 — older controller / no proxies
+            shards = {}
+        for idx, shard in shards.items():
+            try:
+                probes.append((f"proxy_shard:{idx}",
+                               shard.llm_metrics_snapshot.remote()))
+            except Exception:  # noqa: BLE001 — shard mid-restart
+                pass
     # ONE bounded wait for the whole fan-out, then cheap gets: harvesting
     # serially at timeout_s each would stall the caller (the dashboard's
     # sampler tick) k*timeout_s when k replicas are mid-restart — same
@@ -237,6 +278,13 @@ def serving_summary() -> Dict[str, Any]:
         c = um.get_metric(name)
         if c is not None:
             out[key] = sum(v for _, _, v in c._samples())
+    pc = {}
+    for name, (_desc, key) in PREFIX_CACHE_COUNTERS.items():
+        c = um.get_metric(name)
+        if c is not None:
+            pc[key] = sum(v for _, _, v in c._samples())
+    if pc:
+        out["prefix_cache"] = pc
     req = um.get_metric(REQUESTS_NAME)
     if req is not None:
         by_outcome: Dict[str, float] = {}

@@ -290,8 +290,10 @@ class LLMRouter:
         return {"prompt": prompt, "max_new_tokens": max_new,
                 "session_id": str(sid) if sid is not None else None}
 
-    def _token_stream(self, rq: Dict[str, Any]):
-        """Assign + stream: yields token ids; releases charges on exit.
+    def _token_stream(self, rq: Dict[str, Any], sse: bool = False):
+        """Assign + stream: yields token ids (or, with sse=True,
+        replica-PRE-ENCODED SSE byte frames forwarded verbatim — the
+        zero-copy path); releases charges on exit.
 
         Replica-death failover: a stream whose replica dies BEFORE the
         first token retries transparently on a different replica (the
@@ -302,6 +304,7 @@ class LLMRouter:
         released and it is evicted from the local replica view."""
         cost = len(rq["prompt"]) + (rq["max_new_tokens"]
                                     or self._default_max_new)
+        method = "generate_stream_sse" if sse else "generate_stream"
         failed: set = set()
         for failover in range(_MAX_FAILOVERS + 1):
             rid, handle = self._choose(rq["session_id"], cost,
@@ -315,7 +318,7 @@ class LLMRouter:
                     # treatment as a mid-stream transport failure
                     gen = handle.handle_request_streaming.options(
                         num_returns="streaming").remote(
-                            "generate_stream", (rq["prompt"],),
+                            method, (rq["prompt"],),
                             {"max_new_tokens": rq["max_new_tokens"]})
                     for ref in gen:
                         token = ray_tpu.get(ref)
@@ -363,13 +366,15 @@ class LLMRouter:
     def __call__(self, request: Any = None):
         """HTTP ingress: streams Server-Sent Events, one per token, then
         a final usage event and `[DONE]` — each flushed through the
-        proxy's chunked path as it is produced."""
+        proxy's chunked path as it is produced. Frames arrive from the
+        engine replica PRE-ENCODED (generate_stream_sse) and pass through
+        untouched — no per-token re-encoding on the router or proxy."""
         rq = self._parse(request)
         n = 0
         t0 = time.monotonic()
-        for token in self._token_stream(rq):
+        for frame in self._token_stream(rq, sse=True):
             n += 1
-            yield f'data: {{"token": {int(token)}}}\n\n'.encode()
+            yield frame
         dt = time.monotonic() - t0
         usage = {"completion_tokens": n,
                  "prompt_tokens": len(rq["prompt"]),

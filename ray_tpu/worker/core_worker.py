@@ -17,6 +17,7 @@ started and whether an Executor serves push_task.
 from __future__ import annotations
 
 import asyncio
+import concurrent.futures
 import hashlib
 import logging
 import os
@@ -335,7 +336,22 @@ class CoreWorker:
                 self._connect_plasma(reply.get("store_socket") or env_socket)
                 _mark("plasma")
         self._lease_reaper = self._lt.submit(self._lease_reaper_loop())
-        self._event_flusher = self._lt.submit(self._task_event_loop())
+        # Off-loop helpers are spawned NOW, at init: creating a thread
+        # from the RPC loop mid-serving (lazy executors, lazy drainers)
+        # stalls the loop for tens of ms on gVisor-class kernels — a
+        # pure-tail latency tax on every in-flight request (ISSUE 6).
+        from ray_tpu._private import latency as _latency
+
+        _latency.start_drainer()
+        # Task-event flushing lives on its own daemon thread: formatting
+        # a 1s batch is thousands of dict builds at serving rates, and
+        # doing it on the RPC loop stalled every in-flight reply for
+        # milliseconds once per second (the r05 HTTP p99 regression).
+        self._task_events_wakeup = threading.Event()
+        self._event_flusher = threading.Thread(
+            target=self._task_event_flush_loop,
+            name=f"cw-taskev-{self.worker_id.hex()[:6]}", daemon=True)
+        self._event_flusher.start()
         # Lifecycle-event flush path: batched RPC to the GCS event manager.
         # First-wins: an embedded head keeps the GCS's direct sink; pure
         # worker/driver processes ship over their existing GCS connection.
@@ -511,11 +527,13 @@ class CoreWorker:
                 logger.debug("mark_job_finished failed on shutdown",
                              exc_info=True)
         self._lease_reaper.cancel()
-        self._event_flusher.cancel()
+        if self._task_events_wakeup is not None:
+            self._task_events_wakeup.set()  # unpark the flusher to exit
         # Final event flush so short-lived drivers still show their tasks in
-        # the state API / timeline.
+        # the state API / timeline (the daemon flusher thread sees
+        # _shutdown and exits on its own).
         try:
-            self._lt.submit(self._flush_task_events()).result(timeout=2)
+            self._flush_task_events_sync(deadline_s=2.0)
         except Exception:  # noqa: BLE001 — best effort on teardown
             logger.debug("final task-event flush failed", exc_info=True)
         if self._event_sink_token is not None:
@@ -2399,9 +2417,39 @@ class CoreWorker:
         pending = self._pending_tasks.get(task_id)
         if pending is None:
             return
-        if pending.pushed_to is not None:
+        target = pending.pushed_to
+        if target is None and pending.spec.task_type == TaskType.ACTOR_TASK:
+            # Actor pushes never set pushed_to (that is the normal-task
+            # lease field): resolve the actor's CURRENT worker address so
+            # a running actor stream actually receives the cancel — the
+            # silent local no-op here left abandoned serving streams
+            # decoding whole token budgets into the void (ISSUE 6 find).
+            rec = self._actors.get(pending.spec.actor_id)
+            if rec is not None:
+                if rec.queue and any(
+                        s.task_id == task_id for s in rec.queue):
+                    # still parked owner-side waiting for an address:
+                    # cancel it locally, nothing to RPC
+                    async def _drop_queued():
+                        r = self._actors.get(pending.spec.actor_id)
+                        if r is None:
+                            return
+                        for s in list(r.queue):
+                            if s.task_id == task_id:
+                                r.queue.remove(s)
+                                self._cancel_queued_spec(s, task_id)
+                                return
+
+                    try:
+                        self._lt.submit(_drop_queued()).result(timeout=10)
+                    except (TimeoutError, concurrent.futures.TimeoutError):
+                        pass
+                    return
+                if rec.address is not None:
+                    target = rec.address.rpc_address
+        if target is not None:
             try:
-                self._peers.get(pending.pushed_to).call(
+                self._peers.get(target).call(
                     "cancel_task", {"task_id": task_id, "force": force}, timeout=10
                 )
             except ConnectionLost:
@@ -2427,7 +2475,7 @@ class CoreWorker:
 
             try:
                 self._lt.submit(_cancel_local()).result(timeout=10)
-            except TimeoutError:
+            except (TimeoutError, concurrent.futures.TimeoutError):
                 pass
 
     def _cancel_queued_spec(self, spec: TaskSpec, task_id):
@@ -3051,47 +3099,75 @@ class CoreWorker:
             (spec.task_id, spec.function_name, spec.task_type.name,
              spec.job_id, state, time.time(), spec.trace_parent, stages))
         ev = self._task_events_wakeup
-        if ev is not None and not ev.is_set():
-            self._lt.loop.call_soon_threadsafe(ev.set)
+        if ev is not None:
+            ev.set()  # plain threading.Event: no loop interaction here
 
-    async def _task_event_loop(self):
-        self._task_events_wakeup = ev = asyncio.Event()
-        while True:
+    def _task_event_flush_loop(self):
+        """Daemon flusher thread: the RPC loop's only involvement is the
+        actual send coroutine — formatting a 1s batch (thousands of dict
+        builds at serving rates) happens HERE, off the loop, where it
+        used to stall every in-flight reply for milliseconds once per
+        second (the r05 HTTP p99 regression)."""
+        ev = self._task_events_wakeup
+        while not self._shutdown:
             if not self._task_events:
-                await ev.wait()  # idle workers: zero periodic wakeups
+                ev.wait()  # idle workers: zero periodic wakeups
             ev.clear()
-            await asyncio.sleep(1.0)  # batch window (same flush latency)
-            await self._flush_task_events()
+            if self._shutdown:
+                return
+            time.sleep(1.0)  # batch window (same flush latency)
+            self._flush_task_events_sync()
 
-    async def _flush_task_events(self):
+    def _format_task_events(self, limit: int = 5000) -> list:
+        """Drain up to `limit` raw task-event tuples into wire dicts
+        (flusher thread / teardown only — never the RPC loop)."""
         node = self.node_id.hex() if self.node_id else None
         worker = self.worker_id.hex()
+        events = []
+        while self._task_events and len(events) < limit:
+            task_id, name, type_name, job_id, state, ts, parent, \
+                stages = self._task_events.popleft()
+            ev = {
+                "task_id": task_id.hex(),
+                "name": name,
+                "type": type_name,
+                "state": state,
+                "parent": parent,
+                "job_id": job_id.hex() if job_id else None,
+                "node": node,
+                "worker_id": worker,
+                "time": ts,
+            }
+            if stages is not None:
+                ev["stages"] = stages
+            events.append(ev)
+        return events
+
+    def _flush_task_events_sync(self, deadline_s: float = 10.0):
         # Drain FULLY in 5000-event sends: a single capped send per second
         # falls behind batched submission rates (>5k events/s) and the
         # bounded deque would silently drop the overflow.
+        deadline = time.monotonic() + deadline_s
         while self._task_events:
-            events = []
-            while self._task_events and len(events) < 5000:
-                task_id, name, type_name, job_id, state, ts, parent, \
-                    stages = self._task_events.popleft()
-                ev = {
-                    "task_id": task_id.hex(),
-                    "name": name,
-                    "type": type_name,
-                    "state": state,
-                    "parent": parent,
-                    "job_id": job_id.hex() if job_id else None,
-                    "node": node,
-                    "worker_id": worker,
-                    "time": ts,
-                }
-                if stages is not None:
-                    ev["stages"] = stages
-                events.append(ev)
+            events = self._format_task_events()
+            if not events:
+                return
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return
+            coro = self._gcs.send_async(
+                "add_task_events", {"events": events})
             try:
-                await self._gcs.send_async(
-                    "add_task_events", {"events": events})
-            except (ConnectionLost, OSError):
+                self._lt.submit(coro).result(timeout=remaining)
+            # NB: Future.result raises concurrent.futures.TimeoutError,
+            # which is NOT the builtin TimeoutError until Python 3.11 —
+            # catching only the builtin would kill the flusher thread on
+            # the first slow GCS send
+            except (ConnectionLost, OSError, TimeoutError,
+                    concurrent.futures.TimeoutError):
+                return
+            except RuntimeError:  # loop closed mid-teardown
+                coro.close()  # suppress the never-awaited warning
                 return
 
 

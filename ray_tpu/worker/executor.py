@@ -385,6 +385,17 @@ class Executor:
                 index += 1
             self.cw.report_generator_item(spec, index, None, done=True)
             return {"status": "ok", "returns": [], "streaming_num_items": index}
+        except TaskCancelledError:
+            # consumer-initiated close (ObjectRefGenerator.close →
+            # cancel_task): not an application error — no ERROR-channel
+            # broadcast, a plain cancelled reply. Still finish the stream
+            # so any racing next_generator_item waiter wakes.
+            err = RayTaskError.from_exception(
+                spec.function_name, TaskCancelledError(spec.task_id))
+            self.cw.report_generator_item(
+                spec, -1, {"inline": ser.serialize(err)}, done=True, error=True
+            )
+            return {"status": "cancelled", "return_ids": spec.return_ids()}
         except BaseException as e:  # noqa: BLE001
             err = RayTaskError.from_exception(spec.function_name, e)
             oid = ObjectID.for_task_return(spec.task_id, 1)

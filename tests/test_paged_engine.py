@@ -72,8 +72,9 @@ def test_eight_concurrent_streams_small_pool(tiny):
     gen = GenerationConfig(max_new_tokens=10)
     out = eng.generate(prompts, gen)
     assert len(out) == 12 and all(len(o) == 10 for o in out)
-    # pool fully reclaimed after the batch
-    assert len(eng.free_blocks) == eng.n_blocks - 1
+    # pool fully reclaimed after the batch (released blocks may park in
+    # the prefix-cache LRU, but every one must be allocatable again)
+    assert eng.available_blocks() == eng.n_blocks - 1
     assert sorted(eng.free_slots) == list(range(8))
 
 
@@ -96,7 +97,7 @@ def test_preemption_by_recomputation(tiny):
     got = tight.generate(prompts, gen)
     assert tight.preemptions > 0, "tight pool never preempted"
     assert got == expected
-    assert len(tight.free_blocks) == tight.n_blocks - 1
+    assert tight.available_blocks() == tight.n_blocks - 1
 
 
 def test_lone_request_shrinks_chunk_instead_of_preempting(tiny):

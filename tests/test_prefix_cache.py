@@ -1,0 +1,185 @@
+"""Prefix-cache / KV-block-reuse correctness (ISSUE 6 satellite).
+
+Engine-level invariants of the content-addressed, ref-counted block
+cache in PagedInferenceEngine: caching must be output-invisible (greedy
+outputs identical with it on and off), shared blocks must outlive every
+referencing slot but no longer, divergence must copy-on-write instead of
+mutating cached KV, and LRU eviction under pool pressure must keep
+admitting new requests."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from ray_tpu.inference import GenerationConfig
+from ray_tpu.inference.paged_engine import PagedInferenceEngine
+from ray_tpu.models import llama
+
+pytestmark = pytest.mark.serve
+
+BLOCK = 16
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = llama.LlamaConfig.tiny(vocab_size=128)
+    cfg = cfg.__class__(**{**cfg.__dict__, "dtype": jnp.float32,
+                           "remat": False})
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _engine(tiny, **kw):
+    cfg, params = tiny
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("max_len", 128)
+    kw.setdefault("block_size", BLOCK)
+    kw.setdefault("decode_chunk", 4)
+    return PagedInferenceEngine(params, cfg, **kw)
+
+
+def _assert_fully_reclaimed(eng):
+    """Every block is allocatable again and no slot or refcount leaks."""
+    assert sorted(eng.free_slots) == list(range(eng.max_batch))
+    assert eng.available_blocks() == eng.n_blocks - 1
+    assert not eng.block_ref, eng.block_ref
+    assert not eng.slot_blocks
+    assert not eng.slot_tokens
+
+
+def test_caching_on_off_identical_outputs(tiny):
+    """Greedy outputs must be token-for-token identical with the cache
+    cold, warm (prefix hits), and disabled — including the COW case
+    (prompt length an exact block multiple, fully matched)."""
+    gen = GenerationConfig(max_new_tokens=10)
+    shared = [3] * (2 * BLOCK + 5)
+    prompts = [
+        shared + [7, 8],
+        shared + [9],          # prefix hit on the request above
+        [5] * (2 * BLOCK),     # exact block multiple: full-match + COW
+        [5] * (2 * BLOCK),
+        [11, 4, 8],            # short: never cached (sub-block)
+    ]
+    warm = _engine(tiny)
+    warm_out = [warm.generate([p], gen)[0] for p in prompts]
+    assert warm.prefix_stats["hit_requests"] >= 2
+    cold = _engine(tiny, enable_prefix_cache=False)
+    cold_out = [cold.generate([p], gen)[0] for p in prompts]
+    assert warm_out == cold_out
+    assert cold.prefix_stats["hit_requests"] == 0
+    _assert_fully_reclaimed(warm)
+
+
+def test_shared_blocks_freed_only_on_last_release(tiny):
+    """Two live requests sharing a cached prefix hold its blocks at
+    refcount 2; one cancelling drops them to 1 (still pinned, not
+    evictable); the last release parks them in the cache LRU."""
+    eng = _engine(tiny)
+    gen = GenerationConfig(max_new_tokens=24)
+    shared = [7] * (2 * BLOCK)
+
+    # populate the cache, then admit two followers that both match it
+    step = {"n": 0}
+    checked = {"both": False, "after_cancel": False}
+
+    def feed(_block):
+        step["n"] += 1
+        if step["n"] == 1:
+            return [("P", shared + [9], 4)], (), False
+        if step["n"] == 2:
+            return [("A", shared + [1], 24), ("B", shared + [2], 24)], \
+                (), False
+        if step["n"] == 5:
+            return [], ("A",), False
+        return [], (), step["n"] > 8
+
+    out = {}
+    for rid, tok, _done in eng.serve_stream(feed, gen):
+        assert tok is not None, eng.abort_reasons
+        out.setdefault(rid, []).append(tok)
+        shared_blocks = [b for b, r in eng.block_ref.items() if r == 2]
+        if len(out.get("A", [])) >= 1 and len(out.get("B", [])) >= 1 \
+                and not checked["both"]:
+            # both followers decoding: the 2 prefix blocks are shared
+            assert len(shared_blocks) == 2, eng.block_ref
+            for b in shared_blocks:
+                assert b not in eng.cached_lru
+                assert b not in eng.free_blocks
+            checked["both"] = True
+            checked["shared"] = list(shared_blocks)
+    assert checked["both"]
+    assert len(out["B"]) == 24
+    assert len(out.get("A", [])) < 24  # cancelled mid-stream
+    # everything released: the shared blocks survive ONLY in the cache
+    for b in checked["shared"]:
+        assert eng.block_ref.get(b) is None
+        assert b in eng.cached_lru
+    _assert_fully_reclaimed(eng)
+
+
+def test_copy_on_write_preserves_cached_blocks(tiny):
+    """A full-prompt match writes its sampling position into a COPY; the
+    cached original must keep serving later identical prompts."""
+    eng = _engine(tiny)
+    gen = GenerationConfig(max_new_tokens=8)
+    prompt = [5] * (2 * BLOCK)  # exact multiple: the COW trigger
+    first = eng.generate([prompt], gen)[0]
+    assert eng.prefix_stats["cow_copies"] == 0
+    second = eng.generate([prompt], gen)[0]
+    assert eng.prefix_stats["cow_copies"] == 1
+    assert eng.prefix_stats["hit_tokens"] == 2 * BLOCK - 1
+    third = eng.generate([prompt], gen)[0]  # reads the original again
+    assert first == second == third
+    # a diverging prompt over the same prefix still matches block 0 only
+    div = eng.generate([prompt[:BLOCK] + [9] * BLOCK], gen)[0]
+    cold = _engine(tiny, enable_prefix_cache=False)
+    assert div == cold.generate([prompt[:BLOCK] + [9] * BLOCK], gen)[0]
+    _assert_fully_reclaimed(eng)
+
+
+def test_eviction_under_pressure_still_admits(tiny):
+    """A pool whose free list is exhausted by cached blocks must evict
+    (LRU) to admit new requests — the cache can never wedge admission."""
+    # 12 usable blocks; each request occupies ~4 and caches ~2-3
+    eng = _engine(tiny, max_batch=2, n_blocks=13)
+    gen = GenerationConfig(max_new_tokens=6)
+    outs = []
+    for i in range(1, 7):
+        prompt = [i] * (2 * BLOCK + 3)  # distinct content every time
+        outs.append(eng.generate([prompt], gen)[0])
+        assert len(outs[-1]) == 6
+    assert eng.prefix_stats["evictions"] > 0
+    _assert_fully_reclaimed(eng)
+    # evicted content re-admits (recomputed) with identical output
+    again = eng.generate([[1] * (2 * BLOCK + 3)], gen)[0]
+    assert again == outs[0]
+
+
+def test_preempted_request_readmits_via_cache(tiny):
+    """Recompute-preemption releases blocks through the cache, so the
+    victim's re-admission is a prefix HIT (resume without re-prefill)
+    and output still matches a roomy pool."""
+    prompts = [[2, 4, 6], [1, 3, 5], [7, 8, 9]]
+    gen = GenerationConfig(max_new_tokens=24)
+    roomy = _engine(tiny, n_blocks=40, block_size=8)
+    expected = roomy.generate(prompts, gen)
+    tight = _engine(tiny, n_blocks=9, block_size=8)
+    got = tight.generate(prompts, gen)
+    assert got == expected
+    assert tight.preemptions > 0
+    # the preempted request's prompt+emitted blocks were promoted on
+    # release and matched again on re-admission
+    assert tight.prefix_stats["hit_requests"] > 0
+    _assert_fully_reclaimed(tight)
+
+
+def test_disabled_cache_keeps_flat_accounting(tiny):
+    eng = _engine(tiny, enable_prefix_cache=False)
+    gen = GenerationConfig(max_new_tokens=6)
+    p = [4] * (3 * BLOCK)
+    assert eng.generate([p], gen) == eng.generate([p], gen)
+    assert eng.prefix_stats == {
+        "hit_requests": 0, "miss_requests": 2, "hit_tokens": 0,
+        "evictions": 0, "bytes_saved": 0, "cow_copies": 0}
+    assert not eng.hash_index and not eng.cached_lru
+    assert len(eng.free_blocks) == eng.n_blocks - 1

@@ -264,6 +264,49 @@ def test_rpc_surface_chaos_glob_validation(tmp_path):
     assert "'pusj_task'" in msgs and "'before_exec'" in msgs
 
 
+def test_rpc_surface_extra_methods_extend_chaos_globs(tmp_path):
+    """ISSUE 6: actor-dispatched control-plane names (shard management —
+    no handle_* anywhere) are legal chaos-rule targets only when listed
+    in `extra-methods`; the augmentation must NOT legitimize literal
+    .call_async() callers of the same name."""
+    _write(tmp_path, "tests/test_shards.py", """
+        from ray_tpu import chaos
+
+        def plan():
+            return [chaos.ChaosRule(action="drop",
+                                    method="ensure_http_proxies")]
+    """)
+    # rejected without the config…
+    diags = _lint(tmp_path, ["tests"], select=["rpc-surface-drift"])
+    assert len(diags) == 1 and "ensure_http_proxies" in diags[0].message
+    # …accepted with it
+    opts = {"rpc-surface-drift": {
+        "extra-methods": ["ensure_http_proxies"]}}
+    assert _lint(tmp_path, ["tests"], options=opts,
+                 select=["rpc-surface-drift"]) == []
+    # a literal transport-level caller is still drift, extra-methods or
+    # not: the surface augmentation is for chaos GLOBS only
+    _write(tmp_path, "ray_tpu/worker/x.py", """
+        def f(client):
+            return client.call_async("ensure_http_proxies", {})
+    """)
+    diags = _lint(tmp_path, ["ray_tpu", "tests"], options=opts,
+                  select=["rpc-surface-drift"])
+    assert len(diags) == 1
+    assert diags[0].path == "ray_tpu/worker/x.py"
+
+
+def test_repo_raylint_toml_covers_shard_management_rpcs():
+    """The repo config must keep the shard-management names chaos-
+    targetable (a rule over them in a future chaos test cannot go
+    vacuously green OR be lint-rejected)."""
+    cfg = LintConfig.load(REPO_ROOT)
+    extra = cfg.check_options("rpc-surface-drift")["extra-methods"]
+    for name in ("ensure_http_proxies", "update_proxy_routes",
+                 "get_http_proxy_handles", "update_routes"):
+        assert name in extra, name
+
+
 # ---------------------------------------------------------------- RTL004
 
 

@@ -9,6 +9,8 @@ import pytest
 import ray_tpu
 from ray_tpu import serve
 
+pytestmark = pytest.mark.serve
+
 
 @pytest.fixture
 def serve_shutdown():

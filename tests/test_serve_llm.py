@@ -20,6 +20,8 @@ import ray_tpu
 from ray_tpu import serve
 from ray_tpu.models import llama
 
+pytestmark = pytest.mark.serve
+
 HTTP_PORT = 18533
 
 
@@ -423,6 +425,115 @@ def test_grpc_route_stream_propagates_midstream_error():
     assert "exploded mid-stream" in ctx.abort_details
 
 
+def test_disconnect_mid_stream_closes_generator_on_every_shard(
+        llm_cluster, tiny):
+    """ISSUE 6 satellite regression: the SHARDED streaming path must
+    close the replica-side generator on client disconnect on every
+    shard, not just shard 0 (the single-proxy path got this in PR 2).
+    Raw sockets, one per attempt, until the kernel's SO_REUSEPORT
+    hashing has exercised every shard; abrupt close after the first SSE
+    byte; then engine slots and router accounting must fully drain."""
+    import socket
+
+    from ray_tpu.serve.llm import build_llm_app
+
+    # a WIDER model than tiny(), deliberately: the stream must still be
+    # decoding when the disconnect lands — tiny() emits its whole budget
+    # before the RST propagates, and the engine (which produces
+    # independently of consumption) would mask a broken cancel path by
+    # finishing naturally
+    cfg = llama.LlamaConfig(
+        vocab_size=128, d_model=256, n_layers=4, n_heads=8,
+        n_kv_heads=4, d_head=32, d_ff=512, max_seq_len=512,
+        dtype=jnp.float32, remat=False)
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+
+    def build():
+        from ray_tpu.inference.paged_engine import PagedInferenceEngine
+
+        return PagedInferenceEngine(params, cfg, max_batch=4, max_len=512,
+                                    block_size=16, decode_chunk=4)
+
+    app = build_llm_app(build, name="llm_slow", num_replicas=1,
+                        default_config={"max_new_tokens": 450},
+                        shed_queue_depth=64)
+    # explicit shard count: the default is min(4, cpus), and a 1-cpu CI
+    # host would otherwise create a single shard — this test exists to
+    # cover the MULTI-shard disconnect path
+    serve.run(app, name="llm_slow", route_prefix="/llm_slow",
+              http_port=HTTP_PORT, http_shards=2)
+    controller = ray_tpu.get_actor("SERVE_CONTROLLER")
+    shards = ray_tpu.get(controller.get_http_proxy_handles.remote())
+    assert len(shards) >= 2, "sharded proxy expected for this test"
+
+    def shard_served():
+        return {i: ray_tpu.get(s.get_stats.remote(),
+                               timeout=30)["requests_served"]
+                for i, s in shards.items()}
+
+    def engine_stats():
+        reps = ray_tpu.get(
+            controller.get_replica_handles.remote(
+                "llm_slow", "llm_slow_engine"))
+        return [ray_tpu.get(r.handle_request.remote("get_stats", (), {}),
+                            timeout=30) for r in reps]
+
+    def drained(deadline_s=30.0):
+        deadline = time.monotonic() + deadline_s
+        while time.monotonic() < deadline:
+            stats = engine_stats()
+            if (all(s["outstanding_requests"] == 0 for s in stats)
+                    and all(s["engine"]["active_slots"] == 0
+                            for s in stats)
+                    and all(s["engine"]["available_blocks"]
+                            == s["engine"]["n_blocks"] - 1
+                            for s in stats)):
+                return True
+            time.sleep(0.2)
+        return False
+
+    assert drained(), "engine busy before the test started"
+    finished_before = sum(s["finished_requests"] for s in engine_stats())
+
+    hit_shards = set()
+    n_streams = 0
+    for attempt in range(24):
+        before = shard_served()
+        conn = socket.create_connection(("127.0.0.1", HTTP_PORT),
+                                        timeout=30)
+        body = json.dumps({"prompt": [9, 9, 1 + attempt],
+                           "max_new_tokens": 450}).encode()
+        conn.sendall(
+            b"POST /llm_slow HTTP/1.1\r\nHost: x\r\n"
+            b"Content-Type: application/json\r\n"
+            b"Content-Length: " + str(len(body)).encode()
+            + b"\r\n\r\n" + body)
+        # read until the first SSE payload byte, then walk away
+        buf = b""
+        while b"data:" not in buf:
+            chunk = conn.recv(4096)
+            assert chunk, f"stream closed early: {buf!r}"
+            buf += chunk
+        assert b" 200 " in buf.split(b"\r\n", 1)[0]
+        conn.close()  # abrupt client disconnect mid-stream
+        n_streams += 1
+        after = shard_served()
+        hit_shards |= {i for i in after if after[i] > before.get(i, 0)}
+        if len(hit_shards) == len(shards) and n_streams >= 4:
+            break
+    assert hit_shards == set(shards), (
+        f"kernel never spread connections: {hit_shards}")
+    # every stream's slot, KV blocks, and request entry must drain —
+    # on EVERY shard's path
+    assert drained(), engine_stats()
+    # at least some streams were genuinely cancelled mid-flight (a
+    # completed stream would count as finished)
+    finished_after = sum(s["finished_requests"] for s in engine_stats())
+    assert finished_after - finished_before < n_streams, (
+        finished_before, finished_after, n_streams)
+    serve.delete("llm_slow")
+
+
 def test_paged_engine_serve_stream_dynamic_admission(tiny):
     """Engine-level: a request arriving mid-generation joins the running
     batch; cancellation frees its slot and blocks; resources fully
@@ -455,7 +566,7 @@ def test_paged_engine_serve_stream_dynamic_admission(tiny):
     assert min(i for i, r in enumerate(order) if r == "B") < max(
         i for i, r in enumerate(order) if r == "A")
     assert sorted(eng.free_slots) == [0, 1, 2, 3]
-    assert len(eng.free_blocks) == eng.n_blocks - 1
+    assert eng.available_blocks() == eng.n_blocks - 1
     # dynamic path matches the one-shot batch path token for token
     assert eng.generate([[1, 2, 3]],
                         GenerationConfig(max_new_tokens=8))[0] == out["A"]

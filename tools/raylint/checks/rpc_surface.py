@@ -40,6 +40,14 @@ DEFAULT_HANDLER_PREFIX = "handle_"
 DEFAULT_EXTRA_HANDLERS = ["_register_peer"]
 DEFAULT_CHAOS_SITES = ["client_request", "before_execute", "after_reply",
                        "mid_stream"]
+# Actor-dispatched control-plane method names chaos rules may target:
+# these ride the generic push_task RPC (no handle_<name> exists), so
+# without surface augmentation a rule globbing them would be rejected as
+# matching nothing — and silently-vacuous rules are exactly what RTL003
+# exists to catch. Configure per-repo additions via `extra-methods` in
+# [tool.raylint.rpc-surface-drift] (ISSUE 6: the proxy-shard management
+# surface).
+DEFAULT_EXTRA_METHODS: list = []
 _CHAOS_RULE_FIELDS = ["action", "site", "method", "label", "peer"]
 
 
@@ -62,6 +70,11 @@ class RpcSurfaceCheck(Check):
             "chaos-sites", DEFAULT_CHAOS_SITES))
         self.surface_paths = tuple(options.get(
             "surface-paths", DEFAULT_SURFACE_PATHS))
+        # chaos-rule method globs may additionally match these (actor-
+        # dispatched control-plane names with no handle_* definition);
+        # they do NOT legitimize .call_async()-style literal callers
+        self.extra_methods = set(options.get(
+            "extra-methods", DEFAULT_EXTRA_METHODS))
 
     # ------------------------------------------------------------- extract
     def extract_handlers(self, project: Project) -> Dict[str, List[str]]:
@@ -177,7 +190,7 @@ class RpcSurfaceCheck(Check):
                 local_names[relpath] = ({n for n, _ in
                                          self._module_handlers(mod)}
                                         if mod is not None else set())
-            scope = names | local_names[relpath]
+            scope = names | local_names[relpath] | self.extra_methods
             if not any(fnmatchcase(n, method) for n in scope):
                 yield Diagnostic(
                     self.check_id, self.name, relpath, lineno, 0,
