@@ -19,10 +19,24 @@ import time
 from functools import partial
 
 
+def _run_bench_module(mod: str, timeout: float, env: dict, *argv) -> dict:
+    """Run a benchmark module in a subprocess and parse its last JSON line
+    (every bench prints one JSON line; warnings/log noise may precede it)."""
+    import subprocess
+
+    r = subprocess.run(
+        [sys.executable, "-m", mod, *argv], capture_output=True,
+        text=True, timeout=timeout, env=env)
+    for line in reversed(r.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    raise RuntimeError(r.stderr[-200:] or f"no JSON from {mod}")
+
+
 def _subprocess_benches() -> dict:
     """rllib env-steps/s + serve RPS/p50/p99 in clean CPU subprocesses."""
     import os
-    import subprocess
 
     out = {}
     env = dict(os.environ)
@@ -30,14 +44,7 @@ def _subprocess_benches() -> dict:
     env.pop("PALLAS_AXON_POOL_IPS", None)
 
     def run(mod, timeout, *argv):
-        r = subprocess.run(
-            [sys.executable, "-m", mod, *argv], capture_output=True,
-            text=True, timeout=timeout, env=env)
-        for line in reversed(r.stdout.strip().splitlines()):
-            line = line.strip()
-            if line.startswith("{"):
-                return json.loads(line)
-        raise RuntimeError(r.stderr[-200:] or f"no JSON from {mod}")
+        return _run_bench_module(mod, timeout, env, *argv)
 
     try:
         rl = run("ray_tpu.rllib.benchmarks", 600)
@@ -84,6 +91,23 @@ def _subprocess_benches() -> dict:
     except Exception as e:  # noqa: BLE001
         out["llm_serving_error"] = str(e)[:200]
     return out
+
+
+def _multichip_bench(n_devices: int = 8) -> dict:
+    """Measured n-device SPMD step (train/spmd_bench) in a subprocess:
+    real devices when the ambient backend has enough, else
+    `--xla_force_host_platform_device_count` virtual CPU devices. Replaces
+    the dryrun-only MULTICHIP smoke with measured per-chip throughput,
+    MFU, and scaling efficiency vs the 1-device step."""
+    import os
+
+    from ray_tpu._private.backend_probe import backend_alive, force_cpu_env
+
+    env = dict(os.environ)
+    if not backend_alive(n_devices, timeout_s=120):
+        env = force_cpu_env(env, n_devices)
+    return _run_bench_module("ray_tpu.train.spmd_bench", 900, env,
+                             "--n-devices", str(n_devices))
 
 
 def _backend_alive(timeout_s: float = 180.0) -> bool:
@@ -142,7 +166,9 @@ def main():
         # batch dim always divides the mesh (fixed global batch would fail
         # device_put on slices wider than 8 chips).
         batch, seq, steps = 4 * n_devices, 2048, 20
-        peak_flops = 197e12  # v5e bf16 peak per chip
+        from ray_tpu._private.accelerators.tpu import bf16_peak_flops_per_chip
+
+        peak_flops = bf16_peak_flops_per_chip(jax.devices()[0].device_kind)
     else:  # CPU smoke fallback so the script always emits a line
         cfg = llama.LlamaConfig.tiny()
         batch, seq, steps = 4, 128, 3
@@ -217,6 +243,19 @@ def main():
         detail["engine_decode"] = eng["detail"]
     except Exception as e:  # noqa: BLE001
         detail["engine_decode_error"] = str(e)[:200]
+    # Measured multi-device SPMD step (ISSUE 7): per-chip tokens/sec over
+    # an (dp, fsdp, tp) mesh + scaling efficiency vs the 1-device step.
+    # Runs in a subprocess (8 virtual CPU devices when no TPU slice is
+    # reachable) so the trajectory JSONs track multichip numbers on every
+    # host, not just slice-attached ones.
+    try:
+        mc = _multichip_bench(8)
+        detail["train_multichip_tokens_per_sec_per_chip"] = mc["value"]
+        detail["train_scaling_efficiency"] = (
+            mc["detail"]["scaling_efficiency"])
+        detail["train_multichip_detail"] = mc["detail"]
+    except Exception as e:  # noqa: BLE001 — must not sink the headline
+        detail["train_multichip_error"] = str(e)[:200]
     # Remaining north stars (VERDICT r2 missing #3): PPO env-steps/s and
     # serve RPS/latency. Both are host-side subsystems — they run in CPU
     # subprocesses so the tunnel-attached TPU process stays out of their

@@ -7,6 +7,7 @@ GPU/other accelerators pass through as plain custom resources.
 from ray_tpu._private.accelerators.tpu import (  # noqa: F401
     TpuSliceInfo,
     apply_tpu_detection,
+    chips_per_host,
     detect_tpu,
     tpu_head_resource_name,
 )

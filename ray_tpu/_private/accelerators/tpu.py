@@ -60,6 +60,38 @@ def tpu_head_resource_name(accelerator_type: str) -> str:
     return f"TPU-{accelerator_type}-head"
 
 
+# Per-chip bf16 peak FLOP/s by jax device_kind, for MFU math (published
+# figures: v2/v3 per-chip = 2 cores; v5e has no matmul-rate doubling).
+_BF16_PEAK_FLOPS = {
+    "TPU v2": 46e12,
+    "TPU v3": 123e12,
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+
+def bf16_peak_flops_per_chip(device_kind: str) -> float:
+    """Per-chip bf16 peak for the given jax ``device_kind``. Unknown
+    generations fall back to the v5e figure (this repo's reference chip) —
+    MFU against the wrong generation's peak is off by the peak ratio, so
+    keep the table current as new device kinds appear."""
+    return _BF16_PEAK_FLOPS.get(device_kind, 197e12)
+
+
+def chips_per_host(accelerator_type: str,
+                   env: Optional[Mapping[str, str]] = None) -> int:
+    """Chips a single host of this slice type contributes — the per-worker
+    `TPU` demand a ScalingConfig(topology=...) gang bundles up. Defaults to
+    os.environ (like detect_tpu) so TPU_CHIPS_PER_HOST_BOUNDS overrides are
+    honored — the demand must match what apply_tpu_detection advertises."""
+    return _chips_per_host(os.environ if env is None else env,
+                           accelerator_type)
+
+
 def _chips_per_host(env: Mapping[str, str], accelerator_type: str) -> int:
     bounds = env.get(_CHIP_BOUNDS)
     if bounds:
@@ -193,6 +225,11 @@ def apply_tpu_detection(
     if info is None:
         return None
     resources.setdefault("TPU", float(info.num_chips))
+    # Typed per-chip resource alongside the generic one: gangs that pin a
+    # topology (ScalingConfig(topology="v5e-8")) demand `TPU-v5e-8` per
+    # worker so they can only place on hosts of that slice generation.
+    resources.setdefault(f"TPU-{info.accelerator_type}",
+                         float(info.num_chips))
     if info.is_head:
         resources.setdefault(
             tpu_head_resource_name(info.accelerator_type), 1.0)

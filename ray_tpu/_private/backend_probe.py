@@ -9,11 +9,14 @@ hang defenses cannot drift apart.
 
 from __future__ import annotations
 
+import contextlib
 import os
 import re
 import subprocess
 import sys
-from typing import Dict, Optional
+from typing import Dict, Iterator, Optional
+
+_COUNT_FLAG = "--xla_force_host_platform_device_count"
 
 
 def backend_alive(min_devices: int = 1, timeout_s: float = 180.0) -> bool:
@@ -32,6 +35,16 @@ def backend_alive(min_devices: int = 1, timeout_s: float = 180.0) -> bool:
         return False
 
 
+def with_host_device_count(flags: str, n_devices: int) -> str:
+    """XLA_FLAGS string with the host-platform device count forced to
+    `n_devices`. Idempotent: any existing count flag is REPLACED (never
+    appended next to), and surrounding whitespace is normalized, so
+    nested/repeated probes cannot accumulate contradictory flags."""
+    stripped = re.sub(rf"{_COUNT_FLAG}=\d+", "", flags)
+    stripped = " ".join(stripped.split())
+    return f"{stripped} {_COUNT_FLAG}={n_devices}".strip()
+
+
 def force_cpu_env(env: Optional[Dict[str, str]] = None,
                   n_devices: Optional[int] = None) -> Dict[str, str]:
     """Return a copy of `env` (default os.environ) with the accelerator
@@ -42,9 +55,35 @@ def force_cpu_env(env: Optional[Dict[str, str]] = None,
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
     if n_devices is not None:
-        flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
-                       env.get("XLA_FLAGS", ""))
-        env["XLA_FLAGS"] = (
-            flags + f" --xla_force_host_platform_device_count={n_devices}"
-        ).strip()
+        env["XLA_FLAGS"] = with_host_device_count(
+            env.get("XLA_FLAGS", ""), n_devices)
     return env
+
+
+@contextlib.contextmanager
+def forced_host_device_count(n_devices: int) -> Iterator[None]:
+    """Force `n_devices` virtual CPU host devices in os.environ, restoring
+    the EXACT prior state (including absence) of every touched variable on
+    exit. Safe to nest or repeat in one process: each entry replaces the
+    count flag rather than appending, and each exit restores the enclosing
+    scope's values, so back-to-back `n_devices` probes leak nothing into
+    later tests.
+
+    Note: this only affects processes spawned while active (and the first
+    jax backend initialization, if it hasn't happened yet) — an already-
+    initialized in-process jax backend keeps its device count.
+    """
+    touched = ("XLA_FLAGS", "JAX_PLATFORMS", "PALLAS_AXON_POOL_IPS")
+    prior = {k: os.environ.get(k) for k in touched}
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = with_host_device_count(
+        os.environ.get("XLA_FLAGS", ""), n_devices)
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    try:
+        yield
+    finally:
+        for k, v in prior.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v

@@ -26,6 +26,15 @@ class ScalingConfig:
     resources_per_worker: extra custom resources per worker.
     placement_strategy: PACK | SPREAD | STRICT_PACK | STRICT_SPREAD.
     topology: optional TPU slice topology string (gang resource name).
+
+    With topology set, the per-worker TPU demand defaults to
+    chips_per_host(topology) evaluated on the DRIVER — a generation
+    heuristic plus the driver's TPU_CHIPS_PER_HOST_BOUNDS/
+    TPU_VISIBLE_CHIPS. If slice hosts carry env overrides the driver
+    doesn't (e.g. GKE single-chip node pools), the heuristic can disagree
+    with what those raylets advertise and the gang never places: pass
+    resources_per_worker={"TPU": <actual chips/host>} explicitly to pin
+    the demand to the advertised value.
     """
 
     num_workers: int = 1
@@ -38,24 +47,47 @@ class ScalingConfig:
     def __post_init__(self):
         if self.num_workers <= 0:
             raise ValueError("num_workers must be positive")
+        if self.topology and self.placement_strategy == "PACK":
+            # A topology gang is atomic on ONE ICI domain: STRICT_PACK of
+            # TPU bundles routes through the GCS slice-aware placer
+            # (gcs/pg_manager._place_on_single_slice), which never lets a
+            # gang straddle slices. Explicit SPREAD/STRICT_SPREAD wins.
+            self.placement_strategy = "STRICT_PACK"
 
     @property
     def _resources_per_worker_not_none(self) -> Dict[str, float]:
         res = dict(self.resources_per_worker or {})
         if "CPU" not in res:
             res["CPU"] = 1.0
-        if self.use_tpu and "TPU" not in res:
-            res["TPU"] = 1.0
+        if (self.use_tpu or self.topology) and "TPU" not in res:
+            if self.topology:
+                from ray_tpu._private.accelerators import tpu as tpu_accel
+
+                res["TPU"] = float(tpu_accel.chips_per_host(self.topology))
+            else:
+                res["TPU"] = 1.0
         if self.topology:
-            res[f"TPU-{self.topology}-head"] = res.get(
-                f"TPU-{self.topology}-head", 0.0
-            )
+            # Typed per-chip resource: only raylets that detected this
+            # slice generation advertise it (apply_tpu_detection), so a
+            # v5e gang can never land on leftover v4 hosts.
+            res.setdefault(f"TPU-{self.topology}", res["TPU"])
         return res
+
+    def worker_bundles(self) -> list:
+        """Per-worker bundle list. Worker 0 of a topology gang additionally
+        claims the slice's head gang resource (advertised by worker 0 of
+        each slice — accelerators/tpu.py), serializing one gang per slice.
+        """
+        bundles = [dict(self._resources_per_worker_not_none)
+                   for _ in range(self.num_workers)]
+        if self.topology:
+            head = f"TPU-{self.topology}-head"
+            bundles[0][head] = bundles[0].get(head, 0.0) + 1.0
+        return bundles
 
     def as_placement_group_factory(self):
         """Bundle list for the worker gang (+ optional trainer bundle)."""
-        bundles = [dict(self._resources_per_worker_not_none)
-                   for _ in range(self.num_workers)]
+        bundles = self.worker_bundles()
         if self.trainer_resources:
             bundles = [dict(self.trainer_resources)] + bundles
         return bundles
@@ -63,8 +95,8 @@ class ScalingConfig:
     @property
     def total_resources(self) -> Dict[str, float]:
         out: Dict[str, float] = dict(self.trainer_resources or {})
-        for _ in range(self.num_workers):
-            for k, v in self._resources_per_worker_not_none.items():
+        for b in self.worker_bundles():
+            for k, v in b.items():
                 out[k] = out.get(k, 0.0) + v
         return out
 

@@ -25,6 +25,29 @@ from ray_tpu.data._internal.plan import Operator, Plan
 from ray_tpu.data.block import Block, BlockAccessor
 
 
+def _shard_host_batch(v, sharding):
+    """One host numpy column → a global jax.Array under `sharding`.
+
+    Fully-addressable shardings (single-process mesh): slice the host
+    batch per device and device_put each slice to the device that owns it
+    (`make_array_from_single_device_arrays`) — no device ever holds the
+    full batch. Multi-process shardings: this process's rows are its shard
+    of the global batch (`make_array_from_process_local_data`). Anything
+    that isn't a jax Sharding (a bare device) keeps plain device_put.
+    """
+    import jax
+
+    if not isinstance(sharding, jax.sharding.Sharding):
+        return jax.device_put(v, sharding)
+    if not sharding.is_fully_addressable:
+        return jax.make_array_from_process_local_data(sharding, v)
+    global_shape = v.shape
+    idx_map = sharding.addressable_devices_indices_map(global_shape)
+    shards = [jax.device_put(v[idx], dev) for dev, idx in idx_map.items()]
+    return jax.make_array_from_single_device_arrays(
+        global_shape, sharding, shards)
+
+
 class Dataset:
     def __init__(self, plan: Plan):
         self._plan = plan
@@ -344,8 +367,17 @@ class Dataset:
     def iter_jax_batches(self, *, batch_size: int = 256,
                          sharding=None, dtypes: Optional[Dict] = None,
                          drop_last: bool = True) -> Iterator[Dict[str, Any]]:
-        """numpy batches → jax.device_put, optionally with a NamedSharding
-        (a sharded global batch lands directly across the mesh)."""
+        """numpy batches → global jax.Arrays, optionally sharded.
+
+        With a ``NamedSharding`` (e.g. the trainer mesh's batch sharding
+        from ``ray_tpu.train.batch_sharding()``), each yielded column is a
+        GLOBAL array assembled from per-shard host slices device_put to
+        exactly the devices that own them — the full batch is never
+        replicated onto any device, and on a multi-host gang each process
+        contributes only its local rows (its dataset shard) to the global
+        batch, so the batch dim it yields is the PER-PROCESS slice of the
+        global batch size.
+        """
         import jax
 
         for batch in self.iter_batches(batch_size=batch_size,
@@ -355,7 +387,7 @@ class Dataset:
                 batch = {k: v.astype(dtypes[k]) if k in dtypes else v
                          for k, v in batch.items()}
             if sharding is not None:
-                yield {k: jax.device_put(v, sharding)
+                yield {k: _shard_host_batch(v, sharding)
                        for k, v in batch.items()}
             else:
                 yield {k: jax.device_put(v) for k, v in batch.items()}

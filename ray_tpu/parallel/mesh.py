@@ -146,9 +146,40 @@ def initialize_distributed(
     Called by every worker in a gang (see ray_tpu.train's backend setup);
     rank 0's address is distributed through the actor gang the same way the
     reference broadcasts the master address (torch/config.py:112).
+
+    Idempotent: re-initializing an already-connected process with the same
+    (coordinator, world, rank) is a no-op — a gang restarted inside a
+    surviving worker process must not crash on double-init. A DIFFERENT
+    binding (a re-formed gang with a new rank-0 coordinator) shuts the old
+    client down first, so the process never stays silently bound to a dead
+    coordinator. Limitation: a coordinator that died and RESTARTED at the
+    same fixed address is indistinguishable from a live one by address
+    alone — pin coordinator_port only when worker processes cannot outlive
+    a gang incarnation (the default random-port path never collides).
     """
     import jax
 
+    try:  # jax 0.4.x: no public is_initialized — inspect the global client
+        from jax._src import distributed as _dist
+
+        state = _dist.global_state
+        if getattr(state, "client", None) is not None:
+            if (state.coordinator_address == coordinator_address
+                    and state.num_processes == num_processes
+                    and state.process_id == process_id):
+                logger.info(
+                    "jax.distributed already initialized for this gang; "
+                    "skipping")
+                return
+            logger.warning(
+                "jax.distributed bound to %s (world=%s rank=%s); "
+                "re-initializing for %s (world=%s rank=%s)",
+                state.coordinator_address, state.num_processes,
+                state.process_id, coordinator_address, num_processes,
+                process_id)
+            state.shutdown()
+    except ImportError:  # pragma: no cover — future jax moves the module
+        pass
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

@@ -37,6 +37,11 @@ from ray_tpu.train.predictor import (  # noqa: F401
     Predictor,
     TorchPredictor,
 )
+from ray_tpu.train.spmd import (  # noqa: F401
+    batch_sharding,
+    get_mesh,
+    shard_local_batch,
+)
 from ray_tpu.train.step import (  # noqa: F401
     TrainState,
     init_train_state,
@@ -78,10 +83,13 @@ __all__ = [
     "XGBoostTrainer",
     "TrainContext",
     "TrainState",
+    "batch_sharding",
     "get_checkpoint",
     "get_context",
     "get_dataset_shard",
+    "get_mesh",
     "init_train_state",
     "make_train_step",
     "report",
+    "shard_local_batch",
 ]

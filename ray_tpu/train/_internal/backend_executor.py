@@ -36,17 +36,20 @@ class BackendExecutor:
         num_workers: int,
         resources_per_worker: Dict[str, float],
         placement_strategy: str = "PACK",
+        bundles: Optional[List[Dict[str, float]]] = None,
     ):
         self._backend_config = backend_config
         self._backend = backend_config.backend_cls()
         self._num_workers = num_workers
         self._resources = resources_per_worker
         self._strategy = placement_strategy
+        self._bundles = bundles
         self.worker_group: Optional[WorkerGroup] = None
 
     def start(self) -> None:
         self.worker_group = WorkerGroup(
-            self._num_workers, self._resources, self._strategy)
+            self._num_workers, self._resources, self._strategy,
+            bundles=self._bundles)
         self.worker_group.start()
         try:
             self._backend.on_start(self.worker_group, self._backend_config)

@@ -115,23 +115,33 @@ class WorkerGroup:
     def __init__(self, num_workers: int,
                  resources_per_worker: Dict[str, float],
                  placement_strategy: str = "PACK",
-                 actor_cls=None):
+                 actor_cls=None,
+                 bundles: Optional[List[Dict[str, float]]] = None):
+        """`bundles` overrides the uniform per-worker resources with one
+        dict per worker — TPU topology gangs put the slice's head gang
+        resource on bundle 0 only (ScalingConfig.worker_bundles)."""
         self.num_workers = num_workers
-        self._resources = resources_per_worker
+        if bundles is not None and len(bundles) != num_workers:
+            raise ValueError(
+                f"bundles has {len(bundles)} entries for {num_workers} "
+                "workers")
+        self._bundles = (list(bundles) if bundles is not None
+                         else [dict(resources_per_worker)
+                               for _ in range(num_workers)])
         self._strategy = placement_strategy
         self._actor_cls = actor_cls or TrainWorker
         self.workers: List[Any] = []
         self._pg = None
 
     def start(self) -> None:
-        bundles = [dict(self._resources) for _ in range(self.num_workers)]
+        bundles = [dict(b) for b in self._bundles]
         self._pg = placement_group(bundles, strategy=self._strategy)
         ray_tpu.get(self._pg.ready())
         remote_cls = ray_tpu.remote(self._actor_cls)
         self.workers = [
             remote_cls.options(
-                num_cpus=self._resources.get("CPU", 1.0),
-                resources={k: v for k, v in self._resources.items()
+                num_cpus=self._bundles[i].get("CPU", 1.0),
+                resources={k: v for k, v in self._bundles[i].items()
                            if k != "CPU" and v > 0},
                 max_concurrency=4,  # next_result must overlap start_training
                 scheduling_strategy=PlacementGroupSchedulingStrategy(

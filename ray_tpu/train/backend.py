@@ -44,7 +44,15 @@ class Backend:
 class JaxConfig(BackendConfig):
     """distributed=True bootstraps jax.distributed across the gang (multi-
     host TPU). On a single host (or under tests on the CPU platform) leave it
-    False: every worker sees the local chips only."""
+    False: every worker sees the local chips only.
+
+    mesh_config (a ``ray_tpu.parallel.MeshConfig``) switches the gang into
+    MESH-NATIVE mode: every worker bootstraps the named (dp, fsdp, tp, ...)
+    mesh through the collective-group rendezvous (util.collective.
+    bootstrap_mesh — with distributed=True the rendezvous also feeds
+    jax.distributed.initialize, replacing the metadata-exchange coordinator
+    below), and train_fns reach it via ``ray_tpu.train.get_mesh()``.
+    """
 
     distributed: bool = False
     coordinator_port: int = 0
@@ -52,6 +60,10 @@ class JaxConfig(BackendConfig):
     # Applied in each worker BEFORE its first jax import (e.g. XLA_FLAGS
     # to fake per-process device counts in multi-process CPU tests).
     env_vars: Optional[dict] = None
+    # Mesh-native mode: the gang's parallelism axes (MeshConfig). None =
+    # legacy per-worker loops with no ambient mesh.
+    mesh_config: Optional[Any] = None
+    num_slices: int = 1
 
     @property
     def backend_cls(self):
@@ -59,16 +71,16 @@ class JaxConfig(BackendConfig):
 
 
 def _find_free_port() -> int:
-    import socket
+    # module-level so worker_group.execute_single can ship it by reference
+    from ray_tpu._private.rpc import find_free_port
 
-    with socket.socket() as s:
-        s.bind(("", 0))
-        return s.getsockname()[1]
+    return find_free_port()
 
 
 def _init_jax_worker(platform: Optional[str], coordinator: Optional[str],
                      world_size: int, rank: int,
-                     env_vars: Optional[dict] = None) -> str:
+                     env_vars: Optional[dict] = None,
+                     probe_backend: bool = True) -> str:
     import os
 
     for k, v in (env_vars or {}).items():
@@ -83,6 +95,11 @@ def _init_jax_worker(platform: Optional[str], coordinator: Optional[str],
             num_processes=world_size,
             process_id=rank,
         )
+    if not probe_backend:
+        # Mesh-native gangs must not touch the backend yet:
+        # jax.distributed.initialize (run later, fed by the collective
+        # rendezvous) refuses to run after any jax computation.
+        return platform or "deferred"
     import jax
 
     return jax.devices()[0].platform
@@ -92,7 +109,21 @@ class JaxBackend(Backend):
     def on_start(self, worker_group, backend_config: JaxConfig) -> None:
         world = worker_group.num_workers
         coordinator = None
-        if backend_config.distributed and world > 1:
+        mesh_mode = backend_config.mesh_config is not None
+        if mesh_mode and world > 1 and not backend_config.distributed:
+            # Without jax.distributed each worker would bootstrap its OWN
+            # local mesh (identical shapes, so the agreement check below
+            # cannot catch it) and train a divergent model copy with no
+            # cross-worker sync at all — silently wrong results.
+            raise ValueError(
+                "mesh_config with num_workers>1 requires "
+                "JaxConfig(distributed=True): a multi-worker gang must "
+                "rendezvous into ONE global mesh; distributed=False would "
+                f"give {world} workers {world} independent local meshes "
+                "with no gradient sync")
+        if backend_config.distributed and world > 1 and not mesh_mode:
+            # mesh-native gangs rendezvous through the collective group
+            # below instead of exchanging the coordinator via gang metadata
             meta = worker_group.group_metadata()
             port = backend_config.coordinator_port or worker_group.execute_single(
                 0, _find_free_port)
@@ -101,12 +132,58 @@ class JaxBackend(Backend):
         platforms = [
             worker_group.workers[rank].execute.remote(
                 _init_jax_worker, backend_config.platform, coordinator,
-                world, rank, backend_config.env_vars)
+                world, rank, backend_config.env_vars,
+                probe_backend=not mesh_mode)
             for rank in range(world)
         ]
         import ray_tpu
 
         ray_tpu.get(platforms)
+        if mesh_mode:
+            import uuid
+
+            from ray_tpu.train.spmd import setup_worker_mesh
+
+            group = f"rt_train_mesh:{uuid.uuid4().hex[:8]}"
+            self._mesh_group = group
+            shapes = ray_tpu.get([
+                worker_group.workers[rank].execute.remote(
+                    setup_worker_mesh, backend_config.mesh_config,
+                    group_name=group, world_size=world, rank=rank,
+                    distributed=backend_config.distributed,
+                    num_slices=backend_config.num_slices,
+                    coordinator_port=backend_config.coordinator_port)
+                for rank in range(world)
+            ])
+            if len(set(map(str, shapes))) != 1:
+                raise RuntimeError(
+                    f"gang workers disagree on mesh shape: {shapes}")
+            logger.info("gang mesh established: %s", shapes[0])
+
+    def on_shutdown(self, worker_group, backend_config: JaxConfig) -> None:
+        if backend_config.mesh_config is None:
+            return
+        from ray_tpu.train.spmd import teardown_worker_mesh
+
+        try:
+            worker_group.execute(teardown_worker_mesh)
+        except Exception:  # noqa: BLE001 — teardown best-effort
+            logger.debug("mesh teardown failed", exc_info=True)
+        # Worker-side teardown kills the detached rendezvous coordinator
+        # from rank 0 — but a dead rank 0 (the very failure that triggers a
+        # gang restart) would leak it, and each restart uses a fresh group
+        # name, so orphans would accumulate. The driver sweeps it too.
+        group = getattr(self, "_mesh_group", None)
+        if group is not None:
+            import ray_tpu
+
+            from ray_tpu.util.collective.collective import _COORD_PREFIX
+
+            self._mesh_group = None
+            try:
+                ray_tpu.kill(ray_tpu.get_actor(_COORD_PREFIX + group))
+            except ValueError:
+                pass  # never created (world-1 gang) or already dead
 
 
 @dataclasses.dataclass

@@ -1,0 +1,178 @@
+"""Measured multi-device SPMD training-step benchmark.
+
+Runs the SAME global batch through the 1-device jit step and through the
+pjit step over a named (dp, fsdp, tp) mesh spanning `n_devices`, and
+reports MEASURED numbers — per-chip tokens/sec, per-chip MFU, scaling
+efficiency vs the 1-device step, and the max loss divergence between the
+two trajectories (the SPMD program must be a pure re-partitioning of the
+same math). This replaces the compile-and-execute-only multichip dryrun
+with a measurement: `bench.py` invokes it in a subprocess (real devices on
+TPU, `--xla_force_host_platform_device_count` virtual devices on CPU) and
+folds the numbers into the trajectory JSON.
+
+Standalone:
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python -m ray_tpu.train.spmd_bench --n-devices 8
+
+Prints ONE JSON line:
+    {"metric": "train_multichip_tokens_per_sec_per_chip", "value": ...,
+     "detail": {..., "scaling_efficiency": ..., "loss_max_abs_diff": ...}}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from functools import partial
+from typing import Dict, List, Tuple
+
+
+def axis_plan(n_devices: int) -> Dict[str, int]:
+    """Split n devices over the (dp, fsdp, tp) named mesh, model axes
+    first (tp rides the fastest links, then fsdp shards params, remainder
+    is pure data parallel): 8 -> dp=2, fsdp=2, tp=2; 4 -> fsdp=2, tp=2;
+    2 -> tp=2; odd prime counts fall back to pure dp."""
+    plan = {"dp": 1, "fsdp": 1, "tp": 1}
+    rest = n_devices
+    for axis in ("tp", "fsdp"):
+        if rest % 2 == 0:
+            plan[axis] = 2
+            rest //= 2
+    plan["dp"] = rest
+    return plan
+
+
+def _timed_steps(step, state, batch, steps: int) -> Tuple[float, List[float]]:
+    """Wall time per step + the loss trajectory. Synchronizes with a host
+    transfer (float()), not block_until_ready — on tunneled PJRT backends
+    the latter can return before the computation runs."""
+    losses = []
+    state, m = step(state, batch)  # warmup/compile
+    losses.append(float(m["loss"]))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, m = step(state, batch)
+        losses.append(float(m["loss"]))
+    dt = (time.perf_counter() - t0) / steps
+    del state
+    return dt, losses
+
+
+def run(n_devices: int, steps: int = 8) -> dict:
+    import jax
+    import optax
+
+    from ray_tpu.models import llama
+    from ray_tpu.parallel.mesh import MeshConfig, build_mesh
+    from ray_tpu.parallel.sharding import LogicalAxisRules, logical_sharding
+    from ray_tpu.train.step import init_train_state, make_train_step
+
+    devices = jax.devices()
+    if len(devices) < n_devices:
+        raise RuntimeError(
+            f"need {n_devices} devices, found {len(devices)} — on CPU set "
+            "XLA_FLAGS=--xla_force_host_platform_device_count")
+    devices = devices[:n_devices]
+    platform = devices[0].platform
+    on_tpu = platform == "tpu"
+
+    if on_tpu:
+        # Same 8B-width proxy as the headline bench: true Llama-3-8B layer
+        # shapes at reduced depth; per-layer arithmetic intensity matches
+        # the 8B target.
+        cfg = llama.LlamaConfig(
+            vocab_size=32_000, d_model=4096, n_layers=5, n_heads=32,
+            n_kv_heads=8, d_head=128, d_ff=14_336, max_seq_len=2048,
+            loss_chunk_size=1024,
+        )
+        batch, seq = 4 * n_devices, 2048
+        from ray_tpu._private.accelerators.tpu import bf16_peak_flops_per_chip
+
+        peak_flops = bf16_peak_flops_per_chip(devices[0].device_kind)
+    else:
+        import dataclasses
+
+        import jax.numpy as jnp
+
+        # float32 so the 1-device and n-device trajectories are comparable
+        # at a tight tolerance (bf16 accumulation order drifts visibly)
+        cfg = dataclasses.replace(llama.LlamaConfig.tiny(),
+                                  dtype=jnp.float32)
+        batch, seq = 2 * n_devices, 128
+        peak_flops = 1e12
+
+    plan = axis_plan(n_devices)
+    rules = LogicalAxisRules()
+    opt = optax.adamw(3e-4, weight_decay=0.0)
+    toks = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, seq + 1), 0, cfg.vocab_size)
+
+    def measure(mesh) -> Tuple[float, List[float]]:
+        state, shardings = init_train_state(
+            partial(llama.init, cfg), opt, llama.param_logical_axes(cfg),
+            mesh, jax.random.PRNGKey(0), rules)
+        bs = logical_sharding(mesh, ("batch", "seq"), rules)
+        step = make_train_step(
+            partial(llama.loss_fn, config=cfg, mesh=mesh, rules=rules),
+            opt, shardings, batch_sharding={"inputs": bs, "targets": bs})
+        b = {"inputs": jax.device_put(toks[:, :-1], bs),
+             "targets": jax.device_put(toks[:, 1:], bs)}
+        return _timed_steps(step, state, b, steps)
+
+    # The SAME global batch through both programs: first the single-chip
+    # baseline, then the mesh program over all n devices.
+    dt_1, losses_1 = measure(build_mesh(MeshConfig(), devices=devices[:1]))
+    dt_n, losses_n = measure(build_mesh(MeshConfig(**plan), devices=devices))
+
+    tokens_per_step = batch * seq
+    per_chip_1 = tokens_per_step / dt_1  # 1 device
+    per_chip_n = tokens_per_step / dt_n / n_devices
+    flops_tok = llama.flops_per_token(cfg, seq)
+    loss_diff = max(abs(a - b) for a, b in zip(losses_1, losses_n))
+
+    detail = {
+        "platform": platform,
+        "n_devices": n_devices,
+        "mesh_axes": plan,
+        "model_params_m": round(cfg.num_params() / 1e6, 1),
+        "seq_len": seq,
+        "global_batch": batch,
+        "steps": steps,
+        "step_time_ms_1dev": round(dt_1 * 1e3, 2),
+        "step_time_ms_ndev": round(dt_n * 1e3, 2),
+        "tokens_per_sec_per_chip_1dev": round(per_chip_1, 1),
+        "mfu_1dev": round(flops_tok * per_chip_1 / peak_flops, 4),
+        "mfu": round(flops_tok * per_chip_n / peak_flops, 4),
+        # per-chip throughput retained going 1 -> n chips (1.0 = perfect
+        # linear scaling; CPU virtual devices share one host's cores, so
+        # ~1/n there is expected and still a real measurement)
+        "scaling_efficiency": round(per_chip_n / per_chip_1, 4),
+        "loss_max_abs_diff": loss_diff,
+        "loss_1dev": [round(x, 6) for x in losses_1],
+        "loss_ndev": [round(x, 6) for x in losses_n],
+    }
+    return {
+        "metric": "train_multichip_tokens_per_sec_per_chip",
+        "value": round(per_chip_n, 1),
+        "unit": "tokens/s/chip",
+        "detail": detail,
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--n-devices", type=int, default=None,
+                   help="devices to span (default: all visible)")
+    p.add_argument("--steps", type=int, default=8)
+    args = p.parse_args(argv)
+    import jax
+
+    n = args.n_devices or len(jax.devices())
+    print(json.dumps(run(n, steps=args.steps)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

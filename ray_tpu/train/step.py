@@ -90,8 +90,22 @@ def init_train_state(
         lambda k: _init(k),
         out_shardings=_as_dict(state_shardings),
     )
+    # Sharding-invariant initialization: with non-partitionable threefry
+    # (the jax 0.4.x default), jax.random draws inside a jit depend on the
+    # OUTPUT sharding — the same seed yields different params on different
+    # meshes, breaking 1<->n-device loss parity and cross-mesh checkpoint
+    # resume. Scoped to the init program so the ambient stream is untouched.
+    try:
+        from jax._src.config import threefry_partitionable as _tfp
+
+        _ctx = _tfp(True)
+    except ImportError:  # future jax: partitionable is the default
+        import contextlib
+
+        _ctx = contextlib.nullcontext()
     # jit out_shardings wants a matching pytree structure; use dict form.
-    state_dict = init_jit(key)
+    with _ctx:
+        state_dict = init_jit(key)
     state = TrainState(**state_dict)
     return state, state_shardings
 
@@ -123,6 +137,16 @@ def make_train_step(
             "step": state_dict["step"] + 1,
         }, metrics
 
+    if donate and jax.default_backend() == "cpu":
+        # XLA CPU's thunk runtime races donated input buffers in
+        # executables DESERIALIZED from the persistent compilation cache
+        # (JAX_COMPILATION_CACHE_DIR): stepping a restored checkpoint
+        # produced nondeterministic losses in ~40% of fresh processes on
+        # this host. In-process-compiled donating programs are fine, the
+        # cache without donation is fine, and
+        # --xla_cpu_use_thunk_runtime=false is fine — the triple is the
+        # bug. Donation only matters for accelerator HBM; CPU forgoes it.
+        donate = False
     shardings_dict = _as_dict(state_shardings)
     jitted = jax.jit(
         step_fn,

@@ -132,6 +132,7 @@ class DataParallelTrainer(BaseTrainer):
             sc.num_workers,
             sc._resources_per_worker_not_none,
             sc.placement_strategy,
+            bundles=sc.worker_bundles(),
         )
         executor.start()
         try:
@@ -200,12 +201,31 @@ class DataParallelTrainer(BaseTrainer):
 
 class JaxTrainer(DataParallelTrainer):
     """Flagship trainer: SPMD JAX gang over the TPU mesh (SURVEY §7
-    'JaxTrainer whose train loop is a jax.jit step with NamedSharding')."""
+    'JaxTrainer whose train loop is a jax.jit step with NamedSharding').
+
+    Mesh-native mode: pass ``mesh_config=MeshConfig(dp=..., fsdp=...,
+    tp=...)`` (or set it on ``jax_config``) and every gang worker
+    bootstraps the named mesh before train_fn runs — the train loop builds
+    its jit step over ``ray_tpu.train.get_mesh()`` with the canonical
+    per-parameter PartitionSpecs from ``parallel.sharding`` (see
+    ``train.step.init_train_state`` / ``make_train_step``: donated
+    buffers, fsdp-sharded optimizer state).
+    """
 
     _default_backend_config = JaxConfig()
 
-    def __init__(self, train_loop_per_worker, *, jax_config=None, **kwargs):
-        kwargs.setdefault("backend_config", jax_config or JaxConfig())
+    def __init__(self, train_loop_per_worker, *, jax_config=None,
+                 mesh_config=None, **kwargs):
+        import dataclasses
+
+        if jax_config is not None and "backend_config" in kwargs:
+            raise ValueError(
+                "pass jax_config or backend_config, not both")
+        cfg = (jax_config or kwargs.pop("backend_config", None)
+               or JaxConfig())
+        if mesh_config is not None:
+            cfg = dataclasses.replace(cfg, mesh_config=mesh_config)
+        kwargs["backend_config"] = cfg
         super().__init__(train_loop_per_worker, **kwargs)
 
 

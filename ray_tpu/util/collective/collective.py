@@ -18,8 +18,17 @@ this module's job is the part NCCL/gloo did *outside* jit:
   to run ``jax.distributed.initialize`` for multi-host meshes.
 
 Backend "host" works anywhere (it moves bytes through the object-store /
-actor RPC plane). Backend "mesh" is documented sugar: it asserts the caller
-is inside a mesh context and tells them to use in-jit collectives.
+actor RPC plane). Backend "mesh" is the in-jit path made real: collective
+calls on traced values lower to ``jax.lax.psum`` / ``all_gather`` /
+``psum_scatter`` over the group's mesh axes (compiler-emitted ICI
+collectives), while calls on concrete host values fall back to the host
+coordinator — one group serves both the hot in-jit path and out-of-jit
+metadata. Calling a mesh collective on a traced value OUTSIDE a mesh
+context (no shard_map binding the axes) raises the typed
+``MeshCollectiveError``. ``bootstrap_mesh`` turns the same gang rendezvous
+into a ``jax.distributed.initialize`` bootstrap + named-mesh build, so a
+multi-worker gang and a single-process multi-device mesh share one code
+path (a world-1 mesh group never touches the actor plane at all).
 """
 
 from __future__ import annotations
@@ -38,6 +47,13 @@ class ReduceOp:
     PRODUCT = "product"
     MIN = "min"
     MAX = "max"
+
+
+class MeshCollectiveError(RuntimeError):
+    """A mesh-backend collective was used outside a mesh context (or with
+    an operation that has no in-jit lowering). The message says exactly
+    which axis binding is missing and what to do instead — this error is
+    part of the API surface (tested), not an assert."""
 
 
 _REDUCERS = {
@@ -167,14 +183,41 @@ class _GroupCoordinator:
 
 
 class _GroupHandle:
-    def __init__(self, name: str, world_size: int, rank: int, coordinator):
+    def __init__(self, name: str, world_size: int, rank: int, coordinator,
+                 backend: str = "host", mesh_axes=None):
         self.name = name
         self.world_size = world_size
         self.rank = rank
-        self.coordinator = coordinator
+        self.coordinator = coordinator  # None for world-1 groups (rayless)
+        self.backend = backend
+        # Mesh axes the in-jit collectives reduce/gather over. Set at init
+        # (mesh_axes=...) or defaulted at bootstrap_mesh time to the >1-size
+        # axes of the built mesh.
+        self.mesh_axes = tuple(mesh_axes) if mesh_axes else None
+        self.mesh = None  # set by bootstrap_mesh
         self._seq = 0
         self._p2p_tag = 0
         self._lock = threading.Lock()
+
+    @property
+    def is_mesh(self) -> bool:
+        return self.backend in ("mesh", "xla")
+
+    def axes_for_lowering(self):
+        if self.mesh_axes:
+            return self.mesh_axes
+        if self.mesh is not None:
+            live = tuple(a for a in self.mesh.axis_names
+                         if self.mesh.shape[a] > 1)
+            # All-size-1 mesh (1 device): collectives over size-1 axes are
+            # identity, so the laptop-to-pod code path degrades gracefully
+            # instead of raising on the degenerate mesh.
+            return live or tuple(self.mesh.axis_names)
+        raise MeshCollectiveError(
+            f"mesh collective group {self.name!r} has no mesh axes: pass "
+            "mesh_axes=(...) to init_collective_group, or bootstrap_mesh() "
+            "first so the group can default to the mesh's non-trivial axes"
+        )
 
     def next_seq(self) -> int:
         with self._lock:
@@ -209,21 +252,35 @@ def init_collective_group(
     rank: int,
     backend: str = "host",
     group_name: str = "default",
+    mesh_axes=None,
 ) -> None:
-    """Join this process into a named collective group (collective.py:120)."""
+    """Join this process into a named collective group (collective.py:120).
+
+    backend="mesh": collectives on traced jax values lower to in-jit mesh
+    collectives over `mesh_axes` (see module docstring); host values still
+    ride the coordinator. A world-1 mesh group (single process driving a
+    multi-device mesh) never contacts the actor plane — usable without a
+    running cluster.
+    """
     import ray_tpu as rt
 
     if backend not in ("host", "gloo", "mesh", "xla"):
         raise ValueError(f"unsupported backend {backend!r}")
+    if mesh_axes is not None and backend not in ("mesh", "xla"):
+        raise ValueError("mesh_axes only applies to backend='mesh'")
     if not (0 <= rank < world_size):
         raise ValueError(f"rank {rank} out of range for world size {world_size}")
     with _groups_lock:
         if group_name in _groups:
             raise RuntimeError(f"group {group_name!r} already initialized")
-    coord = _coordinator_actor(group_name, world_size)
-    rt.get(coord.register.remote(rank))
+    if world_size == 1:
+        coord = None
+    else:
+        coord = _coordinator_actor(group_name, world_size)
+        rt.get(coord.register.remote(rank))
     with _groups_lock:
-        _groups[group_name] = _GroupHandle(group_name, world_size, rank, coord)
+        _groups[group_name] = _GroupHandle(
+            group_name, world_size, rank, coord, backend, mesh_axes)
 
 
 def create_collective_group(actors, world_size: int, ranks: List[int],
@@ -270,6 +327,8 @@ def destroy_collective_group(group_name: str = "default") -> None:
         g = _groups.pop(group_name, None)
         declared = group_name in _declared
         _declared.discard(group_name)
+    if g is not None and g.coordinator is None and not declared:
+        return  # world-1 group: no coordinator actor was ever created
     # The detached coordinator must die with the group or a later group
     # reusing the name silently inherits the old world_size via
     # get_if_exists. Rank 0 kills it; so does the declaring driver (which
@@ -309,35 +368,246 @@ def _tree_to_host(x):
     return _to_host(x)
 
 
-def allreduce(tensor, group_name: str = "default", op=ReduceOp.SUM):
-    """Host allreduce (collective.py:258). Pytrees of arrays supported.
+# ---- mesh (in-jit) lowering ------------------------------------------------
 
-    For on-device tensors inside a training step, use ``jax.lax.psum`` over
-    the mesh axis instead — this call is for out-of-jit host data.
+
+def _is_traced(tensor) -> bool:
+    """True iff any leaf of the pytree is a jax tracer (we are inside a
+    jit/shard_map trace and must lower to compiler collectives)."""
+    try:
+        import jax
+    except ImportError:  # pragma: no cover — jax absent: nothing is traced
+        return False
+    leaves = jax.tree_util.tree_leaves(tensor)
+    return any(isinstance(leaf, jax.core.Tracer) for leaf in leaves)
+
+
+def _mesh_misuse(g: "_GroupHandle", op_name: str, err: Exception):
+    return MeshCollectiveError(
+        f"collective.{op_name} on group {g.name!r} (backend='mesh') was "
+        f"called on a traced value, but the mesh axes "
+        f"{tuple(g.axes_for_lowering())!r} are not bound here ({err}). "
+        "In-jit mesh collectives only lower inside shard_map over the "
+        "group's mesh (GSPMD-style jit code should express reductions "
+        "through shardings and let XLA emit the collective). For host-side "
+        "metadata, pass a concrete numpy value instead — it rides the host "
+        "coordinator."
+    )
+
+
+def _axes_positions(g: "_GroupHandle", axes) -> int:
+    """Total device positions along the lowering axes: from the
+    bootstrapped mesh when present, else from the bound axis environment at
+    trace time (psum of a unit constant resolves to the static axis size).
+    Raises NameError when the axes aren't bound — callers convert that to
+    the typed misuse error."""
+    if g.mesh is not None:
+        n = 1
+        for a in axes:
+            n *= int(g.mesh.shape[a])
+        return n
+    import jax
+
+    n = 1
+    for a in axes:
+        n *= int(jax.lax.psum(1, a))
+    return n
+
+
+def _mesh_allreduce(g: "_GroupHandle", tensor, op):
+    import jax
+    import jax.numpy as jnp
+
+    axes = g.axes_for_lowering()
+
+    def one(x):
+        if op == ReduceOp.SUM:
+            return jax.lax.psum(x, axes)
+        if op == ReduceOp.MIN:
+            return jax.lax.pmin(x, axes)
+        if op == ReduceOp.MAX:
+            return jax.lax.pmax(x, axes)
+        if op == ReduceOp.PRODUCT:
+            # no pprod primitive: gather the factors and multiply
+            return jnp.prod(jax.lax.all_gather(x, axes), axis=0)
+        raise ValueError(f"unknown reduce op {op!r}")
+
+    try:
+        return jax.tree.map(one, tensor)
+    except NameError as e:  # unbound axis name
+        raise _mesh_misuse(g, "allreduce", e) from e
+
+
+def _mesh_allgather(g: "_GroupHandle", tensor):
+    import jax
+
+    axes = g.axes_for_lowering()
+    try:
+        # Stacked along a new leading axis, ordered by mesh position —
+        # the in-jit analogue of the host path's rank-ordered list.
+        return jax.tree.map(
+            lambda x: jax.lax.all_gather(x, axes, axis=0), tensor)
+    except NameError as e:
+        raise _mesh_misuse(g, "allgather", e) from e
+
+
+def _mesh_broadcast(g: "_GroupHandle", tensor, src_rank: int):
+    import jax
+    import jax.numpy as jnp
+
+    axes = g.axes_for_lowering()
+    try:
+        n_pos = _axes_positions(g, axes)
+    except NameError as e:
+        raise _mesh_misuse(g, "broadcast", e) from e
+    # An out-of-range source matches NO device position: the masked
+    # psum below would silently return zeros. Typed error instead.
+    if not 0 <= src_rank < n_pos:
+        raise MeshCollectiveError(
+            f"in-jit broadcast src_rank={src_rank} is out of range for "
+            f"the {n_pos} device positions along mesh axes "
+            f"{tuple(axes)!r} (src_rank addresses the linear device "
+            "position in-jit, not a process rank)")
+
+    def one(x):
+        # Masked psum: only the source position contributes. Unlike
+        # gather-then-index, psum is replication-transparent to shard_map's
+        # output-spec checker. NOTE the in-jit src_rank addresses the
+        # LINEAR DEVICE POSITION along `axes` (row-major), not a process
+        # rank: inside the program each device holds a shard, so "broadcast
+        # from process r" has no per-shard meaning — on a multi-device-per-
+        # process gang, process r's devices occupy positions
+        # [r*k, (r+1)*k). The host path (concrete values) keeps process-
+        # rank semantics.
+        idx = jax.lax.axis_index(axes)
+        return jax.lax.psum(jnp.where(idx == src_rank, x,
+                                      jnp.zeros_like(x)), axes)
+
+    try:
+        return jax.tree.map(one, tensor)
+    except NameError as e:
+        raise _mesh_misuse(g, "broadcast", e) from e
+
+
+def _mesh_reducescatter(g: "_GroupHandle", tensor_list, op):
+    import jax
+    import jax.numpy as jnp
+
+    if op != ReduceOp.SUM:
+        raise MeshCollectiveError(
+            "in-jit reducescatter lowers to jax.lax.psum_scatter, which "
+            f"only supports ReduceOp.SUM (got {op!r})")
+    axes = g.axes_for_lowering()
+    if isinstance(tensor_list, (list, tuple)):
+        # One chunk per shard position along the lowering axes — the in-jit
+        # analogue of the host path's world_size check. A mis-sized list
+        # must be the typed error, not an opaque XLA shape mismatch.
+        try:
+            n_shards = _axes_positions(g, axes)
+        except NameError as e:
+            raise _mesh_misuse(g, "reducescatter", e) from e
+        if len(tensor_list) != n_shards:
+            raise MeshCollectiveError(
+                f"in-jit reducescatter over mesh axes {tuple(axes)!r} "
+                f"needs one chunk per shard ({n_shards}), got "
+                f"{len(tensor_list)}")
+        # Pytree chunks stack leaf-wise, like the host path's _tree_to_host.
+        stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *tensor_list)
+    else:
+        stacked = tensor_list
+    try:
+        return jax.tree.map(
+            lambda x: jax.lax.psum_scatter(x, axes, scatter_dimension=0),
+            stacked)
+    except NameError as e:
+        raise _mesh_misuse(g, "reducescatter", e) from e
+
+
+def _check_host_pullable(g: "_GroupHandle", tensor, op_name: str) -> None:
+    """Mesh-group collectives on CONCRETE values ride the host coordinator,
+    which pulls them to host numpy. A globally-sharded jax.Array (concrete
+    but not fully addressable from this process — e.g. a sharded param
+    referenced OUT of jit on a multi-process mesh) is neither traced nor
+    host-pullable: raise the typed error with the fix, not np.asarray's
+    opaque 'array is not fully addressable'."""
+    try:
+        import jax
+    except ImportError:  # pragma: no cover — jax absent: plain host values
+        return
+    for leaf in jax.tree_util.tree_leaves(tensor):
+        if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+            raise MeshCollectiveError(
+                f"collective.{op_name} on mesh group {g.name!r} was called "
+                "out-of-jit on a globally-sharded jax.Array (not fully "
+                "addressable from this process), which cannot ride the "
+                "host-coordinator fallback. Run the collective inside the "
+                "jit/shard_map program — it lowers to the in-jit mesh "
+                "collective — or pass process-local host values.")
+
+
+# ---- collective ops --------------------------------------------------------
+
+
+def allreduce(tensor, group_name: str = "default", op=ReduceOp.SUM):
+    """Allreduce (collective.py:258). Pytrees of arrays supported.
+
+    Host groups reduce through the coordinator actor. Mesh groups lower
+    traced values to ``jax.lax.psum``/``pmin``/``pmax`` over the group's
+    mesh axes (inside shard_map), and route concrete host values through
+    the coordinator like a host group.
     """
     import ray_tpu as rt
 
     g = _require(group_name)
+    if g.is_mesh and _is_traced(tensor):
+        return _mesh_allreduce(g, tensor, op)
+    if g.is_mesh:
+        _check_host_pullable(g, tensor, "allreduce")
+    if g.coordinator is None:  # world-1: reduction of one contribution
+        return _REDUCERS[op]([_tree_to_host(tensor)])
     seq = g.next_seq()
     return rt.get(g.coordinator.contribute.remote(
         "allreduce", seq, g.rank, _tree_to_host(tensor), {"op": op}))
 
 
 def allgather(tensor, group_name: str = "default") -> List[Any]:
-    """Gather every rank's tensor, ordered by rank (collective.py:423)."""
+    """Gather every rank's tensor, ordered by rank (collective.py:423).
+
+    Mesh groups lower traced values to ``jax.lax.all_gather`` (stacked
+    along a new leading axis, ordered by mesh position).
+    """
     import ray_tpu as rt
 
     g = _require(group_name)
+    if g.is_mesh and _is_traced(tensor):
+        return _mesh_allgather(g, tensor)
+    if g.is_mesh:
+        _check_host_pullable(g, tensor, "allgather")
+    if g.coordinator is None:
+        return [_tree_to_host(tensor)]
     seq = g.next_seq()
     return rt.get(g.coordinator.contribute.remote(
         "allgather", seq, g.rank, _tree_to_host(tensor)))
 
 
 def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
-    """Broadcast from src_rank to all (collective.py:373)."""
+    """Broadcast from src_rank to all (collective.py:373).
+
+    Mesh groups: on a TRACED value, src_rank addresses the linear device
+    position along the group's mesh axes (see _mesh_broadcast — inside the
+    program each device holds a shard, so process-rank semantics don't
+    apply); on a concrete host value it is the process rank, as for host
+    groups.
+    """
     import ray_tpu as rt
 
     g = _require(group_name)
+    if g.is_mesh and _is_traced(tensor):
+        return _mesh_broadcast(g, tensor, src_rank)
+    if g.is_mesh and g.rank == src_rank:  # only the source pulls its payload
+        _check_host_pullable(g, tensor, "broadcast")
+    if g.coordinator is None:
+        return _tree_to_host(tensor)
     seq = g.next_seq()
     payload = _tree_to_host(tensor) if g.rank == src_rank else None
     return rt.get(g.coordinator.contribute.remote(
@@ -346,14 +616,23 @@ def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
 
 def reducescatter(tensor_list: List[Any], group_name: str = "default",
                   op=ReduceOp.SUM):
-    """Reduce chunk r over all ranks → rank r (collective.py:472)."""
+    """Reduce chunk r over all ranks → rank r (collective.py:472).
+
+    Mesh groups lower traced chunks to ``jax.lax.psum_scatter``.
+    """
     import ray_tpu as rt
 
     g = _require(group_name)
+    if g.is_mesh and _is_traced(tensor_list):
+        return _mesh_reducescatter(g, tensor_list, op)
+    if g.is_mesh:
+        _check_host_pullable(g, tensor_list, "reducescatter")
     if len(tensor_list) != g.world_size:
         raise ValueError(
             f"reducescatter needs world_size={g.world_size} chunks, got "
             f"{len(tensor_list)}")
+    if g.coordinator is None:
+        return _REDUCERS[op]([[_tree_to_host(t) for t in tensor_list]])[0]
     seq = g.next_seq()
     return rt.get(g.coordinator.contribute.remote(
         "reducescatter", seq, g.rank, [_tree_to_host(t) for t in tensor_list],
@@ -364,6 +643,8 @@ def barrier(group_name: str = "default") -> None:
     import ray_tpu as rt
 
     g = _require(group_name)
+    if g.coordinator is None:
+        return
     seq = g.next_seq()
     rt.get(g.coordinator.contribute.remote("barrier", seq, g.rank, None))
 
@@ -374,6 +655,14 @@ def send(tensor, dst_rank: int, group_name: str = "default",
     import ray_tpu as rt
 
     g = _require(group_name)
+    if g.is_mesh and _is_traced(tensor):
+        raise MeshCollectiveError(
+            "send() has no in-jit lowering: use jax.lax.ppermute over the "
+            "mesh axis for traced point-to-point transfers")
+    if g.is_mesh:
+        _check_host_pullable(g, tensor, "send")
+    if g.coordinator is None:
+        raise RuntimeError("send() on a world-1 group has no peer")
     rt.get(g.coordinator.post.remote(g.rank, dst_rank, tag,
                                      _tree_to_host(tensor)))
 
@@ -383,4 +672,57 @@ def recv(src_rank: int, group_name: str = "default", tag: int = 0):
     import ray_tpu as rt
 
     g = _require(group_name)
+    if g.coordinator is None:
+        raise RuntimeError("recv() on a world-1 group has no peer")
     return rt.get(g.coordinator.fetch.remote(src_rank, g.rank, tag))
+
+
+# ---- mesh bootstrap --------------------------------------------------------
+
+
+def bootstrap_mesh(mesh_config=None, *, group_name: str = "default",
+                   devices=None, num_slices: int = 1,
+                   coordinator_port: int = 0):
+    """Build the group's named device mesh, bootstrapping jax.distributed
+    through the gang rendezvous first when the group spans processes.
+
+    The multi-process and single-process paths are ONE code path: rank 0
+    broadcasts its `host:port` through the same coordinator the host
+    collectives use (the NCCL-unique-id-exchange analogue), every rank runs
+    ``jax.distributed.initialize`` against it, and then every process
+    builds the identical mesh over the now-global device set. A world-1
+    group skips only the rendezvous leg — same call, same mesh shape, no
+    cluster needed — so trainer code is mesh-topology-agnostic.
+
+    Returns the ``jax.sharding.Mesh``; also remembers it on the group so
+    mesh collectives can default their axes to the mesh's >1-size axes.
+    """
+    from ray_tpu.parallel import mesh as mesh_mod
+
+    g = _require(group_name)
+    cfg = mesh_config or mesh_mod.MeshConfig()
+    if g.world_size > 1:
+        if g.rank == 0:
+            import socket
+
+            from ray_tpu._private.rpc import find_free_port
+
+            port = coordinator_port or find_free_port()
+            addr = f"{socket.gethostname()}:{port}"
+        else:
+            addr = None
+        addr = str(np.asarray(broadcast(addr, src_rank=0,
+                                        group_name=group_name)))
+        mesh_mod.initialize_distributed(addr, g.world_size, g.rank)
+    if num_slices > 1:
+        mesh = mesh_mod.build_multislice_mesh(cfg, num_slices,
+                                              devices=devices)
+    else:
+        mesh = mesh_mod.build_mesh(cfg, devices=devices)
+    g.mesh = mesh
+    return mesh
+
+
+def get_group_mesh(group_name: str = "default"):
+    """The mesh built by bootstrap_mesh for this group (None before)."""
+    return _require(group_name).mesh
