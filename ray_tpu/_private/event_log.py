@@ -87,6 +87,21 @@ EVENT_SCHEMAS: Dict[str, Tuple[str, ...]] = {
     "node.alive": ("address",),
     "node.dead": ("expected",),
     "node.drain": ("reason",),
+    # preemptible-TPU advance notice: announced node loss with a
+    # deadline-carrying drain window (gangs checkpoint-and-drain, serve
+    # replicas deregister-then-drain) — the injection anchor every
+    # preemption-drill SLO timeline starts from (drills/slo.py)
+    "node.preempt_notice": ("deadline_s", "reason"),
+    # a training gang observed a preempt notice and is checkpointing +
+    # unwinding so the trainer reschedules it onto a fresh placement group
+    "gang.checkpoint_drain": ("reason", "world_size"),
+    # chaos drills (ray_tpu.drills): run markers + verdicts. drill.phase
+    # records every injection ("inject") and workload window ("window");
+    # SLO math pairs injection markers with the recovery events between
+    # them, so these are load-bearing for MTTR, not just bookkeeping.
+    "drill.start": ("scenario", "seed"),
+    "drill.phase": ("scenario", "phase"),
+    "drill.verdict": ("scenario", "passed"),
     # placement-group FSM (gcs/pg_manager)
     "pg.state": ("state",),
     # chaos (fault_injection): every fired rule / partition hit

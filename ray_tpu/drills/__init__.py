@@ -1,0 +1,56 @@
+"""ray_tpu.drills — self-verifying SLO resilience drills.
+
+Closes the resilience loop the chaos layer (PR 3) and the structured
+event log (PR 5) opened: a DRILL runs a scenario (serve replica kills,
+raylet<->GCS partitions, rolling proxy-shard restarts, whole-node
+preemption notices) against a LIVE workload (sustained HTTP serving, or
+a checkpointing SPMD training gang) and computes its SLOs — MTTR,
+availability, request loss — directly from the GcsEventManager causal
+timeline: every injection is a `drill.phase` marker, every recovery is a
+real lifecycle event (`actor.alive`, `node.alive`,
+`gang.checkpoint_drain`), and the verdict is thresholds
+(drills/thresholds.json) applied to the derived numbers.
+
+Entry points:
+
+    from ray_tpu.drills import DrillConfig, run_drill
+    report = run_drill(DrillConfig(scenario="replica_kill", seed=0))
+
+    ray-tpu drill run --scenario replica_kill --budget 120s --seed 0
+    ray-tpu drill report --from-events run.json.events.json ...
+    python -m ray_tpu.drills --gate            # the CI-wired bounded run
+
+Same seed => same victims, same injection sequence, same report
+fingerprint; the SLO math itself is pure (slo.py) and byte-identical
+over the same events.
+"""
+
+from ray_tpu.drills.runner import (  # noqa: F401
+    DrillConfig,
+    export_drill_metrics,
+    load_thresholds,
+    report_from_events,
+    run_drill,
+    write_report,
+)
+from ray_tpu.drills.scenarios import (  # noqa: F401
+    SCENARIO_CLASSES,
+    DrillContext,
+    Scenario,
+    make_scenario,
+)
+from ray_tpu.drills import slo  # noqa: F401
+
+__all__ = [
+    "DrillConfig",
+    "DrillContext",
+    "SCENARIO_CLASSES",
+    "Scenario",
+    "export_drill_metrics",
+    "load_thresholds",
+    "make_scenario",
+    "report_from_events",
+    "run_drill",
+    "slo",
+    "write_report",
+]

@@ -1,0 +1,23 @@
+"""`python -m ray_tpu.drills` — the bounded CI drill gate.
+
+Equivalent to `ray-tpu drill run --gate`: runs one seeded drill inside
+its budget and exits non-zero when the verdict fails its thresholds
+(drills/thresholds.json). Wired into tools/ci.sh next to raylint.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from ray_tpu.scripts.scripts import main as cli_main
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0].startswith("-"):
+        argv = ["run", "--gate"] + argv
+    return cli_main(["drill"] + argv)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

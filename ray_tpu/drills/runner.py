@@ -1,0 +1,364 @@
+"""Drill runner: one scheduled chaos drill, end to end.
+
+`run_drill(DrillConfig)` is self-contained: it builds an in-process
+cluster shaped for the scenario (cluster_utils.Cluster — real GCS, real
+raylets, real worker processes), starts the live workload, fires the
+scenario's injection with a `drill.phase` marker, polls the cluster
+event log until the scenario's recovery event appears (or the budget
+runs out), and computes the SLO report + verdict purely from the event
+timeline (drills/slo.py). Thresholds come from drills/thresholds.json
+unless overridden.
+
+Artifacts per run:
+* a JSON report (slo.dumps_report — canonical serialization, so
+  recomputing over the same events is byte-identical),
+* `ray_tpu_drill_*` metrics in this process's registry,
+* `drill.start` / `drill.phase` / `drill.verdict` events in the cluster
+  log (so a drill is itself post-mortem-debuggable).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import tempfile
+import time
+from dataclasses import dataclass, field
+from random import Random
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private import event_log
+from ray_tpu.drills import slo
+from ray_tpu.drills.scenarios import DrillContext, make_scenario
+
+logger = logging.getLogger(__name__)
+
+THRESHOLDS_PATH = os.path.join(os.path.dirname(__file__), "thresholds.json")
+
+
+def load_thresholds(path: Optional[str] = None) -> Dict[str, Dict]:
+    with open(path or THRESHOLDS_PATH) as f:
+        return json.load(f)
+
+
+@dataclass
+class DrillConfig:
+    scenario: str = "replica_kill"
+    seed: int = 0
+    budget_s: float = 120.0
+    warmup_s: float = 3.0
+    settle_s: float = 2.0
+    rate_hz: float = 30.0
+    report_path: Optional[str] = None
+    thresholds_path: Optional[str] = None
+    thresholds: Optional[Dict[str, Any]] = None
+    http_port: int = 0
+    extras: Dict[str, Any] = field(default_factory=dict)
+
+
+# -- cluster topologies -------------------------------------------------------
+
+def _build_cluster(scenario_name: str):
+    """Scenario-shaped in-process cluster. The head carries a large
+    `drill_head` resource so unconstrained control-plane actors (serve
+    controller, proxy shards) sort onto it, keeping the preemptible /
+    partitionable worker nodes holding ONLY the drill workload."""
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=True, head_node_args={
+        "num_cpus": 4, "resources": {"drill_head": 100}})
+    if scenario_name == "gcs_partition":
+        cluster.add_node(num_cpus=1, resources={"drill_partition": 1})
+    elif scenario_name == "node_preempt_serve":
+        cluster.add_node(num_cpus=4, resources={"drill_replica": 10})
+        cluster.add_node(num_cpus=4, resources={"drill_replica": 10})
+    elif scenario_name == "node_preempt_train":
+        cluster.add_node(num_cpus=4, resources={"drill_gang": 10})
+        cluster.add_node(num_cpus=4, resources={"drill_gang": 10})
+    cluster.wait_for_nodes()
+    cluster.connect()
+    return cluster
+
+
+def _build_workload(config: DrillConfig, scenario) -> Any:
+    from ray_tpu.drills.workloads import ServingWorkload, TrainingWorkload
+
+    if scenario.workload_kind == "training":
+        storage = config.extras.get("storage_path") or tempfile.mkdtemp(
+            prefix="drill_train_")
+        return TrainingWorkload(
+            scenario=scenario.name, storage_path=storage,
+            num_workers=int(config.extras.get("train_workers", 2)),
+            total_steps=int(config.extras.get("train_steps", 200)),
+            step_time_s=float(config.extras.get("train_step_time_s", 0.05)),
+            resources_per_worker={"CPU": 1, "drill_gang": 1})
+    replica_resources = None
+    if scenario.name == "node_preempt_serve":
+        replica_resources = {"drill_replica": 0.001}
+    return ServingWorkload(
+        scenario=scenario.name, rate_hz=config.rate_hz,
+        http_port=config.http_port,
+        replica_resources=replica_resources)
+
+
+# -- event plumbing -----------------------------------------------------------
+
+def _fetch_events(since: float) -> List[dict]:
+    from ray_tpu._raylet import get_core_worker
+
+    event_log.flush(timeout=2.0)
+    events = get_core_worker()._gcs.call(
+        "get_cluster_events", {"since": since, "limit": 100_000},
+        timeout=10.0)
+    return slo.order_events(events or [])
+
+
+def _find_marker(events: List[dict], scenario_name: str) -> Optional[dict]:
+    markers = slo.find_injections(events, scenario_name)
+    return markers[-1] if markers else None
+
+
+def _await_recovery(scenario_name: str, since: float,
+                    deadline: float) -> List[dict]:
+    """Poll the event log until the injection's recovery event lands (or
+    the budget deadline passes); returns the final event snapshot."""
+    events: List[dict] = []
+    while time.monotonic() < deadline:
+        events = _fetch_events(since)
+        marker = _find_marker(events, scenario_name)
+        if marker is not None and slo.find_recovery(
+                scenario_name, marker, events) is not None:
+            return events
+        time.sleep(0.5)
+    return events
+
+
+# -- metrics ------------------------------------------------------------------
+
+def export_drill_metrics(report: Dict[str, Any]) -> None:
+    """ray_tpu_drill_* series for the metrics pipeline (scraped like any
+    other registry metrics; delta-safe across repeated drills)."""
+    try:
+        from ray_tpu.util.metrics import Counter, Gauge, get_metric
+
+        def gauge(name, desc):
+            m = get_metric(name)
+            return m if m is not None else Gauge(name, desc,
+                                                 tag_keys=("scenario",))
+
+        def counter(name, desc):
+            m = get_metric(name)
+            return m if m is not None else Counter(name, desc,
+                                                   tag_keys=("scenario",))
+
+        tags = {"scenario": report["scenario"]}
+        s = report["slo"]
+        if s.get("mttr_max_s") is not None:
+            gauge("ray_tpu_drill_mttr_seconds",
+                  "Max injection->recovery time of the last drill run "
+                  "(event-log derived)").set(s["mttr_max_s"], tags=tags)
+        if s.get("availability") is not None:
+            gauge("ray_tpu_drill_availability",
+                  "ok/attempts availability of the last drill run"
+                  ).set(s["availability"], tags=tags)
+        gauge("ray_tpu_drill_passed",
+              "1 when the last drill run met its thresholds").set(
+            1.0 if report["verdict"]["passed"] else 0.0, tags=tags)
+        if s.get("lost_accepted"):
+            counter("ray_tpu_drill_requests_lost_total",
+                    "Accepted requests lost across drill runs").inc(
+                s["lost_accepted"], tags=tags)
+        counter("ray_tpu_drill_runs_total", "Drill runs executed").inc(
+            tags=tags)
+    except Exception:  # noqa: BLE001 — metrics never fail a drill
+        logger.debug("drill metric export failed", exc_info=True)
+
+
+# -- the drill ----------------------------------------------------------------
+
+def run_drill(config: DrillConfig) -> Dict[str, Any]:
+    scenario = make_scenario(config.scenario)
+    thresholds = config.thresholds
+    if thresholds is None:
+        thresholds = load_thresholds(config.thresholds_path).get(
+            config.scenario, {})
+    rng = Random(config.seed)
+    t_wall_start = time.time() - 1.0  # clock-skew slack on `since` filters
+    deadline = time.monotonic() + config.budget_s
+    cluster = None
+    workload = None
+    workload_summary: Dict[str, Any] = {}
+    try:
+        logger.warning("drill %s (seed=%d, budget=%.0fs) starting",
+                       config.scenario, config.seed, config.budget_s)
+        cluster = _build_cluster(config.scenario)
+        event_log.emit("drill.start", scenario=config.scenario,
+                       seed=config.seed, budget_s=config.budget_s)
+        workload = _build_workload(config, scenario)
+        workload.start()
+        _warmup(workload, scenario, config)
+        ctx = DrillContext(cluster, workload, rng, config.budget_s)
+        detail = scenario.prepare(ctx)
+        # marker BEFORE the fault: every recovery event must causally
+        # follow it in the timeline slo.py pairs over
+        event_log.emit("drill.phase", scenario=config.scenario,
+                       phase="inject", **detail)
+        event_log.flush(timeout=2.0)
+        scenario.execute(ctx, detail)
+        events = _await_recovery(config.scenario, t_wall_start, deadline)
+        _settle(workload, scenario, config, deadline)
+        workload_summary = workload.stop()
+        workload = None
+        events = _fetch_events(t_wall_start)
+        report = slo.compute_report(
+            events, config.scenario, config.seed, thresholds,
+            budget_s=config.budget_s, workload=workload_summary)
+        _apply_workload_checks(report, workload_summary)
+        event_log.emit(
+            "drill.verdict", scenario=config.scenario,
+            passed=report["verdict"]["passed"],
+            mttr_s=report["slo"]["mttr_max_s"],
+            availability=report["slo"]["availability"])
+        event_log.flush(timeout=2.0)
+        export_drill_metrics(report)
+        if config.report_path:
+            write_report(report, config.report_path, events=events)
+        logger.warning(
+            "drill %s verdict: %s (mttr=%s availability=%s lost=%s)",
+            config.scenario,
+            "PASS" if report["verdict"]["passed"] else "FAIL",
+            report["slo"]["mttr_max_s"], report["slo"]["availability"],
+            report["slo"]["lost_accepted"])
+        return report
+    finally:
+        if workload is not None:
+            try:
+                workload.stop()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                logger.debug("workload stop failed", exc_info=True)
+        if cluster is not None:
+            try:
+                cluster.shutdown()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                logger.debug("cluster shutdown failed", exc_info=True)
+        # drills install nothing durable, but a failed partition scenario
+        # must never leak its plan into the next run
+        try:
+            import ray_tpu.chaos as chaos
+
+            chaos.uninstall()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def _warmup(workload, scenario, config: DrillConfig) -> None:
+    if scenario.workload_kind == "training":
+        # the gang must be reporting (and checkpointing) before a notice
+        # can drain it
+        deadline = time.monotonic() + max(30.0, config.warmup_s)
+        while time.monotonic() < deadline:
+            rows = workload._read_results()
+            if len(rows) >= 5:
+                return
+            if workload.error is not None:
+                raise RuntimeError(
+                    f"training workload failed during warmup: "
+                    f"{workload.error}")
+            time.sleep(0.5)
+        raise RuntimeError("training workload reported nothing in warmup")
+    time.sleep(config.warmup_s)
+
+
+def _settle(workload, scenario, config: DrillConfig,
+            deadline: float) -> None:
+    """Post-recovery window: serving keeps measuring availability for a
+    beat; a training workload runs to completion (bounded by the budget)
+    so loss continuity covers the resumed segment."""
+    if scenario.workload_kind == "training":
+        remaining = max(1.0, deadline - time.monotonic())
+        workload.wait(timeout=remaining)
+    else:
+        time.sleep(config.settle_s)
+
+
+def _apply_workload_checks(report: Dict[str, Any],
+                           summary: Dict[str, Any]) -> None:
+    """Workload-side invariants folded into the verdict (the SLO half
+    comes from the event log; these prove the workload's own story —
+    e.g. loss continuity across a preemption)."""
+    failures = report["verdict"]["failures"]
+    if summary.get("kind") == "training":
+        if summary.get("error"):
+            failures.append(f"training workload error: {summary['error']}")
+        if not summary.get("loss_continuous"):
+            failures.append(
+                "loss continuity broken across the preemption "
+                f"(seams={summary.get('step_seams')}, "
+                f"resume_points={summary.get('resume_points')})")
+        if not summary.get("resume_points"):
+            failures.append("gang never resumed from a drain checkpoint")
+    report["verdict"]["passed"] = not failures
+
+
+def write_report(report: Dict[str, Any], path: str,
+                 events: Optional[List[dict]] = None) -> str:
+    """Write the canonical report artifact; with `events`, a sibling
+    <path>.events.json makes the run re-computable offline
+    (`ray-tpu drill report --from-events`). The sibling is
+    self-describing — scenario, seed and the workload summary ride
+    along — so the offline recompute applies the SAME verdict (matcher
+    AND workload checks) as the live run, not a weaker one."""
+    d = os.path.dirname(os.path.abspath(path))
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        f.write(slo.dumps_report(report))
+    if events is not None:
+        with open(f"{path}.events.json", "w") as f:
+            json.dump({"schema": "ray_tpu.drill.events/1",
+                       "scenario": report.get("scenario"),
+                       "seed": report.get("seed"),
+                       "workload": report.get("workload") or {},
+                       "events": events}, f, default=str)
+    return path
+
+
+def report_from_events(events_path: str, scenario: Optional[str] = None,
+                       seed: Optional[int] = None,
+                       thresholds: Optional[Dict[str, Any]] = None,
+                       thresholds_path: Optional[str] = None
+                       ) -> Dict[str, Any]:
+    """Recompute a drill report offline from a saved events artifact —
+    deterministic: the same events produce a byte-identical report.
+
+    Self-describing artifacts carry their own scenario/seed/workload
+    summary; `scenario`/`seed` are only needed for legacy bare-list
+    artifacts, and a `scenario` that contradicts the artifact is an
+    error (a wrong matcher yields a misleading 'no injection' verdict,
+    not an obviously broken one)."""
+    with open(events_path) as f:
+        artifact = json.load(f)
+    workload: Dict[str, Any] = {}
+    if isinstance(artifact, dict):
+        saved = artifact.get("scenario")
+        if scenario is not None and saved and scenario != saved:
+            raise ValueError(
+                f"artifact {events_path} was recorded by scenario "
+                f"{saved!r}, not {scenario!r}")
+        scenario = saved or scenario
+        seed = artifact.get("seed") if seed is None else seed
+        workload = artifact.get("workload") or {}
+        events = artifact.get("events") or []
+    else:
+        events = artifact
+    if scenario is None:
+        raise ValueError(
+            f"artifact {events_path} does not name its scenario; "
+            "pass --scenario")
+    if thresholds is None:
+        thresholds = load_thresholds(thresholds_path).get(scenario, {})
+    report = slo.compute_report(events, scenario, seed or 0, thresholds,
+                                workload=workload)
+    _apply_workload_checks(report, workload)
+    return report

@@ -1,0 +1,347 @@
+"""SLO math over event-log causal timelines.
+
+Every number a drill verdict depends on derives from the cluster
+lifecycle event log (GcsEventManager, _private/event_log.py), never from
+wall-clock guesses in the runner:
+
+* MTTR — each injection marker (`drill.phase` with phase="inject") is
+  paired with the RECOVERY event that causally closes it (scenario-
+  specific matcher over the post-injection timeline: the replacement
+  replica's `actor.alive`, the healed node's `node.alive`, the rolling
+  restart's last fresh proxy `actor.alive`, the preempted gang's
+  rescheduled worker `actor.alive` after `gang.checkpoint_drain`).
+  MTTR = recovery.time - injection.time, per injection.
+* availability / request-loss — the drill workload emits one
+  `drill.phase` phase="window" event per load window with its
+  ok/rejected/lost counts; availability = ok / attempts over all
+  windows, request loss = the lost (ACCEPTED then failed) total.
+
+Pure functions over event lists: the fast test slice drives them from
+canned fixtures (tests/test_drills.py), `ray-tpu drill report
+--from-events` recomputes a report offline, and two computations over
+the same events are byte-identical.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Any, Callable, Dict, List, Optional
+
+# class-name markers used by the causal recovery matchers
+REPLICA_CLASS_MARKER = "ReplicaActor"
+PROXY_CLASS_MARKER = "ProxyActor"
+TRAIN_WORKER_CLASS_MARKER = "TrainWorker"
+
+
+def _order_key(e: dict):
+    return (e.get("time", 0.0), e.get("pid") or 0, e.get("seq") or 0)
+
+
+def order_events(events: List[dict]) -> List[dict]:
+    """Causal order: wall time across processes, exact seq within one
+    (same key event_log.merge_timeline uses). Already-ordered input is
+    returned as-is after an O(n) check — compute_report sorts once and
+    every helper re-calls this on the same list, which must not cost a
+    fresh O(n log n) sort each time over 100k-event logs."""
+    evs = events or []
+    if all(_order_key(evs[i]) <= _order_key(evs[i + 1])
+           for i in range(len(evs) - 1)):
+        return evs
+    return sorted(evs, key=_order_key)
+
+
+def _data(ev: dict) -> dict:
+    return ev.get("data") or {}
+
+
+def find_injections(events: List[dict],
+                    scenario: Optional[str] = None) -> List[dict]:
+    out = []
+    for ev in order_events(events):
+        if ev.get("type") != "drill.phase":
+            continue
+        d = _data(ev)
+        if d.get("phase") != "inject":
+            continue
+        if scenario is not None and d.get("scenario") != scenario:
+            continue
+        out.append(ev)
+    return out
+
+
+def _after(events: List[dict], marker: dict) -> List[dict]:
+    """Events causally after `marker` (ordered)."""
+    key = (marker.get("time", 0.0), marker.get("pid") or 0,
+           marker.get("seq") or 0)
+    return [e for e in order_events(events)
+            if (e.get("time", 0.0), e.get("pid") or 0, e.get("seq") or 0)
+            > key]
+
+
+def _fresh_actor_ids(post: List[dict], class_marker: str) -> List[str]:
+    """Actor ids whose `actor.pending` (class filtered) appears in the
+    post-injection timeline — i.e. actors the recovery machinery started
+    AFTER the fault, not pre-existing ones."""
+    ids = []
+    for ev in post:
+        if (ev.get("type") == "actor.pending"
+                and class_marker in str(_data(ev).get("class_name", ""))
+                and ev.get("actor_id")):
+            ids.append(ev["actor_id"])
+    return ids
+
+
+# -- recovery matchers (scenario name -> finder) ------------------------------
+#
+# Each finder takes (injection marker, post-injection ordered events) and
+# returns the single event that closes the injection, or None while the
+# system has not recovered yet.
+
+def _recover_replacement_replica(injection: dict,
+                                 post: List[dict]) -> Optional[dict]:
+    """A killed/drained serve replica is recovered when a REPLACEMENT
+    replica (pending after the injection) reaches actor.alive."""
+    fresh = set(_fresh_actor_ids(post, REPLICA_CLASS_MARKER))
+    for ev in post:
+        if ev.get("type") == "actor.alive" and ev.get("actor_id") in fresh:
+            return ev
+    return None
+
+
+def _recover_node_alive(injection: dict, post: List[dict]) -> Optional[dict]:
+    """A partitioned-then-healed node is recovered when it re-registers
+    (node.alive for the SAME node after the injection)."""
+    target = _data(injection).get("target_node") or injection.get("node_id")
+    for ev in post:
+        if ev.get("type") == "node.alive" and ev.get("node_id") == target:
+            return ev
+    return None
+
+
+def _recover_rolling_proxies(injection: dict,
+                             post: List[dict]) -> Optional[dict]:
+    """A rolling proxy-shard restart is recovered when the LAST fresh
+    shard is alive: the completing actor.alive of `shards` replacement
+    ProxyActors started after the injection. Fresh proxies are keyed by
+    their SLOT (the named-actor name carries the shard index): a
+    replacement that itself died and was respawned is two fresh actor
+    ids but ONE slot, and must not close the timeline while another
+    slot was never restarted."""
+    want = int(_data(injection).get("shards", 1))
+    slot_by_actor: Dict[str, str] = {}
+    for ev in post:
+        if (ev.get("type") == "actor.pending"
+                and PROXY_CLASS_MARKER in str(_data(ev).get("class_name", ""))
+                and ev.get("actor_id")):
+            slot_by_actor[ev["actor_id"]] = str(
+                _data(ev).get("name") or ev["actor_id"])
+    seen: set = set()
+    for ev in post:
+        if (ev.get("type") == "actor.alive"
+                and ev.get("actor_id") in slot_by_actor):
+            seen.add(slot_by_actor[ev["actor_id"]])
+            if len(seen) >= want:
+                return ev
+    return None
+
+
+def _recover_gang_reschedule(injection: dict,
+                             post: List[dict]) -> Optional[dict]:
+    """A preempted training gang is recovered when, AFTER its
+    gang.checkpoint_drain, a rescheduled TrainWorker (pending after the
+    drain) reaches actor.alive — i.e. the gang is back on a fresh
+    placement group, resuming from the drain checkpoint."""
+    drain = next((ev for ev in post
+                  if ev.get("type") == "gang.checkpoint_drain"), None)
+    if drain is None:
+        return None
+    after_drain = _after(post, drain)
+    fresh = set(_fresh_actor_ids(after_drain, TRAIN_WORKER_CLASS_MARKER))
+    for ev in after_drain:
+        if ev.get("type") == "actor.alive" and ev.get("actor_id") in fresh:
+            return ev
+    return None
+
+
+RECOVERY_MATCHERS: Dict[str, Callable[[dict, List[dict]], Optional[dict]]] = {
+    "replica_kill": _recover_replacement_replica,
+    "gcs_partition": _recover_node_alive,
+    "proxy_rolling_restart": _recover_rolling_proxies,
+    "node_preempt_serve": _recover_replacement_replica,
+    "node_preempt_train": _recover_gang_reschedule,
+}
+
+
+def find_recovery(scenario: str, injection: dict,
+                  events: List[dict]) -> Optional[dict]:
+    matcher = RECOVERY_MATCHERS.get(scenario)
+    if matcher is None:
+        raise KeyError(f"no recovery matcher for scenario {scenario!r}")
+    return matcher(injection, _after(events, injection))
+
+
+# -- SLO aggregation ----------------------------------------------------------
+
+def mttr_timeline(events: List[dict], scenario: str) -> List[dict]:
+    """One row per injection: the marker, its recovery event (or None)
+    and the MTTR derived from their event-log timestamps."""
+    rows = []
+    for inj in find_injections(events, scenario):
+        rec = find_recovery(scenario, inj, events)
+        rows.append({
+            "injected_at": inj.get("time"),
+            "detail": {k: v for k, v in _data(inj).items()
+                       if k not in ("scenario", "phase")},
+            "recovery_type": rec.get("type") if rec else None,
+            "recovered_at": rec.get("time") if rec else None,
+            "mttr_s": (round(rec["time"] - inj.get("time", 0.0), 6)
+                       if rec else None),
+        })
+    return rows
+
+
+def request_windows(events: List[dict],
+                    scenario: Optional[str] = None) -> List[dict]:
+    out = []
+    for ev in order_events(events):
+        if ev.get("type") != "drill.phase":
+            continue
+        d = _data(ev)
+        if d.get("phase") != "window":
+            continue
+        if scenario is not None and d.get("scenario") != scenario:
+            continue
+        out.append(d)
+    return out
+
+
+def availability(windows: List[dict]) -> Optional[float]:
+    """ok / attempts over the whole drill. `rejected` (shed/refused
+    before acceptance) and `lost` (ACCEPTED, then failed) both count
+    against availability; only `lost` counts as request loss."""
+    ok = sum(int(w.get("ok", 0)) for w in windows)
+    attempts = ok + sum(int(w.get("rejected", 0)) + int(w.get("lost", 0))
+                        for w in windows)
+    if attempts == 0:
+        return None
+    return round(ok / attempts, 6)
+
+
+def lost_accepted(windows: List[dict]) -> int:
+    return sum(int(w.get("lost", 0)) for w in windows)
+
+
+# -- report + verdict ---------------------------------------------------------
+
+def evaluate_thresholds(slo: Dict[str, Any],
+                        thresholds: Dict[str, Any]) -> List[str]:
+    """Threshold keys (drills/thresholds.json, per scenario):
+    mttr_max_s, availability_min, max_lost_accepted,
+    require_checkpoint_drain. Returns the list of failures (empty =
+    verdict passes)."""
+    failures = []
+    mttr_max = thresholds.get("mttr_max_s")
+    if mttr_max is not None:
+        mttrs = [r["mttr_s"] for r in slo["timeline"]]
+        if not mttrs:
+            failures.append("no injection was recorded")
+        for r in slo["timeline"]:
+            if r["mttr_s"] is None:
+                failures.append("injection never recovered "
+                                f"(injected_at={r['injected_at']})")
+            elif r["mttr_s"] > mttr_max:
+                failures.append(
+                    f"MTTR {r['mttr_s']:.3f}s above threshold {mttr_max}s")
+    avail_min = thresholds.get("availability_min")
+    if avail_min is not None:
+        avail = slo.get("availability")
+        if avail is None:
+            failures.append("no request windows recorded")
+        elif avail < avail_min:
+            failures.append(
+                f"availability {avail:.4f} below floor {avail_min}")
+    max_lost = thresholds.get("max_lost_accepted")
+    if max_lost is not None and slo.get("lost_accepted", 0) > max_lost:
+        failures.append(
+            f"{slo['lost_accepted']} accepted requests lost "
+            f"(max {max_lost})")
+    if (thresholds.get("require_checkpoint_drain")
+            and not slo.get("checkpoint_drains")):
+        failures.append("no gang.checkpoint_drain event "
+                        "(gang did not drain on notice)")
+    return failures
+
+
+def fingerprint(events: List[dict], scenario: str,
+                timeline: Optional[List[dict]] = None) -> str:
+    """Seed-stable digest of the drill's causal shape: the ordered
+    sequence of drill phases and the recovery event TYPES — no
+    timestamps, pids or per-run ids, so two runs with the same seed (and
+    two computations over the same events) fingerprint identically.
+    `timeline` lets compute_report reuse the mttr_timeline it already
+    built instead of re-running every recovery matcher."""
+    shape: List[Any] = [("scenario", scenario)]
+    for ev in order_events(events):
+        if ev.get("type") == "drill.phase":
+            d = _data(ev)
+            if d.get("scenario") not in (None, scenario):
+                continue
+            if d.get("phase") == "window":
+                continue  # window count varies with host speed, not seed
+            shape.append(("phase", d.get("phase")))
+    if timeline is None:
+        timeline = mttr_timeline(events, scenario)
+    for row in timeline:
+        shape.append(("recovery", row["recovery_type"]))
+    raw = json.dumps(shape, sort_keys=True).encode()
+    return hashlib.sha256(raw).hexdigest()[:16]
+
+
+def compute_report(events: List[dict], scenario: str, seed: int,
+                   thresholds: Dict[str, Any],
+                   budget_s: Optional[float] = None,
+                   workload: Optional[Dict[str, Any]] = None
+                   ) -> Dict[str, Any]:
+    """The drill report artifact: SLOs from the event timeline + the
+    verdict against thresholds. Deterministic: same events in, identical
+    JSON out (sort_keys at serialization time)."""
+    events = order_events(events)
+    windows = request_windows(events, scenario)
+    timeline = mttr_timeline(events, scenario)
+    mttrs = [r["mttr_s"] for r in timeline if r["mttr_s"] is not None]
+    slo = {
+        "timeline": timeline,
+        "mttr_max_s": round(max(mttrs), 6) if mttrs else None,
+        "mttr_mean_s": (round(sum(mttrs) / len(mttrs), 6)
+                        if mttrs else None),
+        "availability": availability(windows),
+        "lost_accepted": lost_accepted(windows),
+        "windows": len(windows),
+        "requests": {
+            k: sum(int(w.get(k, 0)) for w in windows)
+            for k in ("sent", "ok", "rejected", "lost")
+        },
+        "checkpoint_drains": sum(
+            1 for e in events if e.get("type") == "gang.checkpoint_drain"),
+        "preempt_notices": sum(
+            1 for e in events if e.get("type") == "node.preempt_notice"),
+    }
+    failures = evaluate_thresholds(slo, thresholds)
+    return {
+        "schema": "ray_tpu.drill_report/1",
+        "scenario": scenario,
+        "seed": seed,
+        "budget_s": budget_s,
+        "slo": slo,
+        "thresholds": dict(thresholds),
+        "verdict": {"passed": not failures, "failures": failures},
+        "fingerprint": fingerprint(events, scenario, timeline=timeline),
+        "workload": workload or {},
+        "events_seen": len(events),
+    }
+
+
+def dumps_report(report: Dict[str, Any]) -> str:
+    """Canonical serialization (byte-identical for equal reports)."""
+    return json.dumps(report, sort_keys=True, indent=2, default=str)

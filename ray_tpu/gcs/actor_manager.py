@@ -136,7 +136,7 @@ class GcsActorManager:
             self._creation_specs[creation.actor_id] = spec
             self._persist(creation.actor_id)
         _elog.emit("actor.pending", actor_id=creation.actor_id.hex(),
-                   class_name=spec.function_name)
+                   class_name=spec.function_name, name=name)
         asyncio.ensure_future(self._schedule_actor(creation.actor_id))
         return {"status": "registered", "info": info}
 

@@ -29,7 +29,8 @@ from typing import Dict, List, Optional
 from ray_tpu._private import event_log
 from ray_tpu._private.config import CONFIG
 from ray_tpu._private.ids import JobID, NodeID
-from ray_tpu._private.rpc import ClientPool, EventLoopThread, RpcServer
+from ray_tpu._private.rpc import (ClientPool, ConnectionLost,
+                                  EventLoopThread, RpcServer)
 from ray_tpu._private.specs import (
     JobInfo,
     NodeInfo,
@@ -570,11 +571,23 @@ class GcsEventManager:
                       ("task_id", "actor_id", "node_id", "object_id")
                       if payload.get(k)]
         out = []
+        stale_run = 0
         with self._lock:
             events = list(self._events)
         for ev in reversed(events):
             if since is not None and ev.get("time", 0) < since:
-                continue  # arrival order only approximates event time
+                # Arrival order only approximates event time, so one
+                # stale event must not stop the scan — but a long
+                # CONSECUTIVE run of them means we are past any
+                # realistic flush-lag inversion and the rest of the
+                # deque is history. Without this, every 1s preempt
+                # watcher poll scans the full 100k ring even when the
+                # cluster is idle.
+                stale_run += 1
+                if stale_run >= 2048:
+                    break
+                continue
+            stale_run = 0
             if type_glob and not fnmatchcase(ev.get("type", ""), type_glob):
                 continue
             if any(ev.get(k) != v for k, v in id_filters):
@@ -679,6 +692,7 @@ class GcsServer:
         ):
             self._server.register_all(mgr)
         self._server.register("drain_node", self._handle_drain_node)
+        self._server.register("preempt_node", self._handle_preempt_node)
         self._server.register("subscribe", self._handle_subscribe)
         self._server.register("unsubscribe", self._handle_unsubscribe)
         self._server.register("gcs_ping", self._handle_ping)
@@ -705,6 +719,13 @@ class GcsServer:
         info = self.node_manager._nodes.get(nid)
         if info is None or not info.alive:
             return {"status": "not_found"}
+        if info.draining:
+            # a drain/preempt is already in flight. Proceeding would be
+            # actively destructive during a PREEMPT notice window: the
+            # bundle teardown below would kill a training gang
+            # mid-checkpoint-drain, and the rollback branch could clear
+            # the preempt's scheduling exclusion.
+            return {"status": "already_draining"}
         info.draining = True
         self.node_manager._bump_node(nid)
         try:
@@ -728,6 +749,54 @@ class GcsServer:
         # gang actors restart with their group elsewhere.
         await self.pg_manager.on_node_death(nid)
         return {"status": "ok", "raylet": reply}
+
+    async def _handle_preempt_node(self, payload):
+        """Preemptible-TPU advance notice (the announced-node-loss sibling
+        of drain_node): the node is excluded from scheduling immediately
+        and its raylet stops leasing, but — unlike drain — its placement-
+        group bundles are NOT torn down up front. The notice window
+        belongs to the workloads: training gangs checkpoint-and-drain
+        (train/_internal/backend_executor watches for the
+        node.preempt_notice event), serve replicas deregister-then-drain
+        (serve controller), and only at the deadline does the raylet kill
+        stragglers and unregister. Bundles re-place through the normal
+        node-death listener when the node leaves."""
+        nid: NodeID = payload["node_id"]
+        deadline_s = float(payload.get("deadline_s", 30.0))
+        reason = payload.get("reason", "preemption")
+        info = self.node_manager._nodes.get(nid)
+        if info is None or not info.alive:
+            return {"status": "not_found"}
+        if info.draining:
+            # a drain_node/preempt_node is already in flight — do NOT
+            # re-notify, and (crucially) never let this call's rollback
+            # clear the exclusion the earlier operation installed
+            return {"status": "already_draining"}
+        info.draining = True
+        self.node_manager._bump_node(nid)
+        try:
+            reply = await self._pool.get(info.raylet_address).call_async(
+                "preempt_notice",
+                {"deadline_s": deadline_s, "reason": reason},
+                timeout=10.0)
+        except Exception as e:  # noqa: BLE001 — report, don't crash the GCS
+            if isinstance(e, ConnectionLost) and not e.maybe_delivered:
+                # the raylet provably never got the notice: undo the
+                # scheduling exclusion (same half-drained-wedge hazard
+                # as drain_node)
+                info.draining = False
+                self.node_manager._bump_node(nid)
+                return {"status": "unreachable", "error": str(e)}
+            # Timeout / mid-call reset: the raylet MAY already be draining
+            # (it rejects its lease queue and arms the deadline on
+            # receipt). Keep the exclusion — leasing onto a node that
+            # rejects everything and kills itself at the deadline is
+            # worse than an idle one.
+            return {"status": "unknown", "error": str(e)}
+        # The raylet is the single emitter of node.preempt_notice (on
+        # receipt, before it touches its queue): one event per notice,
+        # and none at all when the notice provably never took effect.
+        return {"status": "ok", "deadline_s": deadline_s, "raylet": reply}
 
     # -- chaos control plane (`ray-tpu chaos`, ray_tpu.chaos) -----------------
 

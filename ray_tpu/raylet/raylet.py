@@ -1196,6 +1196,48 @@ class Raylet:
             self._lt.loop.create_task(self._drain_watch(deadline_s)))
         return {"status": "draining", "active_leases": len(self._leases)}
 
+    async def handle_preempt_notice(self, payload):
+        """Advance notice of node loss (preemptible-TPU semantics; GCS
+        `preempt_node` forwards here). Differs from handle_drain_node in
+        ONE load-bearing way: placement-group bundles survive the notice
+        window instead of being cancelled up front, so training gangs can
+        checkpoint-and-drain and serve replicas can finish their in-flight
+        streams before their workers go away. New leases stop immediately;
+        at the deadline any surviving bundles are released and the normal
+        drain path kills stragglers and unregisters the node."""
+        if self._draining:
+            return {"status": "already_draining"}
+        deadline_s = float(payload.get("deadline_s", 30.0))
+        reason = payload.get("reason", "preemption")
+        self._draining = True
+        self.drain_reason = f"preempt: {reason}" if reason else "preempt"
+        self._elog.emit("node.preempt_notice", node_id=self.node_id.hex(),
+                        deadline_s=deadline_s, reason=reason)
+        for q in list(self._queue):
+            if not q.future.done():
+                q.future.set_result(
+                    {"rejected": True, "reason": "node is draining"})
+        self._queue.clear()
+        self._tasks.append(
+            self._lt.loop.create_task(self._preempt_watch(deadline_s)))
+        return {"status": "draining", "deadline_s": deadline_s,
+                "active_leases": len(self._leases),
+                "active_bundles": len(self._bundles)}
+
+    async def _preempt_watch(self, deadline_s: float):
+        """Wait out the notice window: workloads that heed the notice
+        tear their own leases/bundles down (gang shutdown removes its
+        placement group; drained serve replicas are killed by their
+        controller). Whatever survives the deadline is released the hard
+        way, then the node leaves through the normal drain path."""
+        deadline = time.monotonic() + deadline_s
+        while ((self._leases or self._bundles)
+               and time.monotonic() < deadline):
+            await asyncio.sleep(0.1)
+        for pg_id in list(self._bundles):
+            await self.handle_cancel_bundles({"placement_group_id": pg_id})
+        await self._drain_watch(5.0)
+
     async def _drain_watch(self, deadline_s: float):
         deadline = time.monotonic() + deadline_s
         while self._leases and time.monotonic() < deadline:

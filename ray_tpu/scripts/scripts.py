@@ -1013,6 +1013,164 @@ def cmd_drain_node(args) -> int:
         lt.stop()
 
 
+def cmd_preempt_node(args) -> int:
+    """`ray-tpu preempt-node`: deliver a preemption ADVANCE NOTICE to a
+    node (the announced-node-loss sibling of drain-node): scheduling
+    excludes it immediately, training gangs checkpoint-and-drain, serve
+    replicas deregister-then-drain, and the raylet kills stragglers only
+    at the deadline. Models the cloud provider's preemptible-TPU notice
+    for operators and drills alike."""
+    from ray_tpu._private.rpc import EventLoopThread, RpcClient
+
+    gcs_addr = args.address or os.environ.get("RT_ADDRESS")
+    if not gcs_addr:
+        print("--address (or RT_ADDRESS) is required", file=sys.stderr)
+        return 1
+    lt = EventLoopThread("preempt-cli")
+    try:
+        gcs = RpcClient(gcs_addr, lt)
+        nodes = gcs.call("get_all_node_info", {}, timeout=10)
+        matches = [n for n in nodes
+                   if n.alive and n.node_id.hex().startswith(args.node_id)]
+        if len(matches) != 1:
+            print(f"node id prefix {args.node_id!r} matches "
+                  f"{len(matches)} alive nodes", file=sys.stderr)
+            return 1
+        reply = gcs.call(
+            "preempt_node",
+            {"node_id": matches[0].node_id, "reason": args.reason,
+             "deadline_s": args.deadline},
+            timeout=15)
+        if reply.get("status") not in ("ok", "already_draining"):
+            print(f"preempt failed: {reply}", file=sys.stderr)
+            return 1
+        if reply.get("status") == "already_draining":
+            # idempotent, like drain-node: the notice is already in
+            # effect — a retried command must not read as a failure
+            print(f"node {matches[0].node_id.hex()[:12]} is already "
+                  "draining")
+            return 0
+        print(f"node {matches[0].node_id.hex()[:12]} notified: "
+              f"{args.deadline:.0f}s to checkpoint-and-drain "
+              f"({reply.get('raylet', {}).get('active_leases', 0)} leases, "
+              f"{reply.get('raylet', {}).get('active_bundles', 0)} bundles "
+              "on notice)")
+        return 0
+    finally:
+        lt.stop()
+
+
+def _parse_budget(raw: str) -> float:
+    """'500ms' / '120s' / '2m' / '1h' / plain seconds."""
+    text = raw.strip().lower()
+    mult = 1.0
+    if text.endswith("ms"):
+        text, mult = text[:-2], 1e-3
+    elif text.endswith("s"):
+        text = text[:-1]
+    elif text.endswith("m"):
+        text, mult = text[:-1], 60.0
+    elif text.endswith("h"):
+        text, mult = text[:-1], 3600.0
+    try:
+        return float(text) * mult
+    except ValueError:
+        raise ValueError(
+            f"bad duration {raw!r} (expected e.g. 500ms, 120s, 2m, 1h)"
+        ) from None
+
+
+def cmd_drill(args) -> int:
+    """`ray-tpu drill` — scheduled chaos drills with SLO verdicts:
+    `run` executes one seeded scenario against a live self-contained
+    cluster + workload and writes a JSON report whose MTTR/availability
+    derive from the cluster event log; `report` pretty-prints a report
+    artifact or recomputes one offline from saved events; `list` shows
+    scenarios and their thresholds. --gate exits 1 on a failed verdict
+    (the CI wiring: tools/ci.sh)."""
+    from ray_tpu import drills
+
+    if args.drill_cmd == "list":
+        thresholds = drills.load_thresholds(args.thresholds)
+        out = {name: thresholds.get(name, {})
+               for name in sorted(drills.SCENARIO_CLASSES)}
+        print(json.dumps(out, indent=2))
+        return 0
+
+    if args.drill_cmd == "report":
+        if args.from_events:
+            try:
+                report = drills.report_from_events(
+                    args.from_events, scenario=args.scenario,
+                    seed=args.seed, thresholds_path=args.thresholds)
+            except ValueError as e:
+                print(f"drill report: {e}", file=sys.stderr)
+                return 1
+        elif args.report:
+            with open(args.report) as f:
+                report = json.load(f)
+        else:
+            print("drill report needs --report FILE or --from-events FILE",
+                  file=sys.stderr)
+            return 1
+        if args.json:
+            print(drills.slo.dumps_report(report))
+        else:
+            _print_drill_report(report)
+        return 0 if (not args.gate or report["verdict"]["passed"]) else 1
+
+    # run
+    scenario = args.scenario or "replica_kill"
+    seed = 0 if args.seed is None else args.seed
+    report_path = args.report or os.path.join(
+        ".", f"drill_{scenario}_seed{seed}.json")
+    try:
+        budget_s = _parse_budget(args.budget)
+    except ValueError as e:
+        print(f"drill run: {e}", file=sys.stderr)
+        return 2
+    config = drills.DrillConfig(
+        scenario=scenario, seed=seed,
+        budget_s=budget_s,
+        rate_hz=args.rate, report_path=report_path,
+        thresholds_path=args.thresholds)
+    report = drills.run_drill(config)
+    if args.json:
+        print(drills.slo.dumps_report(report))
+    else:
+        _print_drill_report(report)
+        print(f"report: {report_path} "
+              f"(events: {report_path}.events.json)")
+    return 0 if (not args.gate or report["verdict"]["passed"]) else 1
+
+
+def _print_drill_report(report: dict) -> None:
+    v = report["verdict"]
+    s = report["slo"]
+    print(f"drill {report['scenario']} (seed={report['seed']}): "
+          f"{'PASS' if v['passed'] else 'FAIL'}")
+    print(f"  fingerprint : {report['fingerprint']}")
+    print(f"  MTTR        : max={s['mttr_max_s']}s mean={s['mttr_mean_s']}s "
+          f"({len(s['timeline'])} injection(s))")
+    print(f"  availability: {s['availability']} "
+          f"over {s['windows']} window(s) {s['requests']}")
+    print(f"  lost        : {s['lost_accepted']} accepted request(s)")
+    if s.get("preempt_notices") or s.get("checkpoint_drains"):
+        print(f"  preemption  : {s['preempt_notices']} notice(s), "
+              f"{s['checkpoint_drains']} gang drain(s)")
+    for row in s["timeline"]:
+        print(f"    inject {row['detail']} -> "
+              f"{row['recovery_type'] or 'NO RECOVERY'} "
+              f"mttr={row['mttr_s']}s")
+    wl = report.get("workload") or {}
+    if wl.get("kind") == "training":
+        print(f"  training    : steps={wl.get('steps_reported')} "
+              f"resume_points={wl.get('resume_points')} "
+              f"loss_continuous={wl.get('loss_continuous')}")
+    for f in v["failures"]:
+        print(f"  FAIL: {f}")
+
+
 def cmd_healthcheck(args) -> int:
     """Liveness probe (reference: `ray health-check`, scripts.py:2365):
     exit 0 iff the GCS answers a ping — usable as a container/systemd
@@ -1193,6 +1351,43 @@ def main(argv=None) -> int:
     sp.add_argument("--wait", action="store_true",
                     help="block until the node unregisters")
     sp.set_defaults(fn=cmd_drain_node)
+
+    sp = sub.add_parser("preempt-node",
+                        help="deliver a preemption advance notice "
+                             "(checkpoint-and-drain window) to a node")
+    sp.add_argument("--address")
+    sp.add_argument("--node-id", required=True,
+                    help="node id (hex, prefix ok)")
+    sp.add_argument("--reason", default="operator preemption")
+    sp.add_argument("--deadline", type=float, default=30.0,
+                    help="notice window before stragglers are killed")
+    sp.set_defaults(fn=cmd_preempt_node)
+
+    sp = sub.add_parser("drill",
+                        help="chaos drills with event-log-derived SLO "
+                             "verdicts (MTTR, availability, request loss)")
+    sp.add_argument("drill_cmd", choices=["run", "report", "list"])
+    sp.add_argument("--scenario", default=None,
+                    help="see `ray-tpu drill list` (run default: "
+                         "replica_kill; report: taken from the artifact)")
+    sp.add_argument("--seed", type=int, default=None,
+                    help="same seed => same victims + fingerprint "
+                         "(run default: 0; report: from the artifact)")
+    sp.add_argument("--budget", default="120s",
+                    help="drill budget, e.g. 120s or 2m")
+    sp.add_argument("--rate", type=float, default=30.0,
+                    help="serving workload offered load (rps)")
+    sp.add_argument("--report", help="report artifact path "
+                                     "(run: write; report: read)")
+    sp.add_argument("--from-events",
+                    help="report: recompute SLOs from a saved "
+                         "*.events.json artifact (deterministic)")
+    sp.add_argument("--thresholds",
+                    help="thresholds JSON (default: drills/thresholds.json)")
+    sp.add_argument("--gate", action="store_true",
+                    help="exit 1 when the verdict fails (CI)")
+    sp.add_argument("--json", action="store_true")
+    sp.set_defaults(fn=cmd_drill)
 
     sp = sub.add_parser("healthcheck", help="exit 0 iff the GCS is healthy")
     sp.add_argument("--address")

@@ -30,6 +30,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 import ray_tpu
+from ray_tpu._private.event_watch import EventCursor
 from ray_tpu.serve._private.replica import ReplicaActor
 
 logger = logging.getLogger(__name__)
@@ -58,6 +59,12 @@ class _ReplicaState:
         self.state = _ReplicaState.STARTING
         self.started_at = time.monotonic()
         self.drain_since = 0.0
+        # a DRAINING replica is killed once idle (in-flight requests and
+        # streams finished) or at this deadline, whichever comes first
+        self.drain_deadline = 0.0
+        # where the replica landed (filled on promotion to RUNNING) — the
+        # preemption path drains replicas by node
+        self.node_id: Optional[str] = None
         # check_health queued behind __init__: resolves iff init succeeded
         self.init_ref = None
         self.consecutive_failures = 0
@@ -103,6 +110,19 @@ class ServeController:
         self._proxy_shards: Dict[int, Any] = {}
         self._proxy_started_at: Dict[int, float] = {}
         self._proxy_config: Optional[Dict[str, Any]] = None
+        # shard indexes mid-rolling-restart: _check_proxies' missing-shard
+        # sweep must not respawn these — between the roll's pop and kill,
+        # get_if_exists would re-adopt the OLD still-named actor, the roll
+        # would then kill the "fresh" handle, and its ready() barrier
+        # would probe a corpse while the next shard goes down too
+        self._proxy_rolling: set = set()
+        # node.preempt_notice watcher (shared event-log poll protocol)
+        self._preempt_cursor = EventCursor("node.preempt_notice")
+        # node_id -> monotonic drain expiry for nodes under an active
+        # preemption notice: a replica that finishes STARTING on one of
+        # these after the notice sweep must drain immediately, not serve
+        # until the raylet's hard deadline kills it mid-request
+        self._preempted_nodes: Dict[str, float] = {}
         self._shutdown = threading.Event()
         self._reconcile_thread = threading.Thread(
             target=self._run_control_loop, name="serve-controller",
@@ -214,6 +234,17 @@ class ServeController:
         return {"version": version, "replicas": replicas,
                 "metrics": metrics}
 
+    def list_replica_nodes(self) -> Dict[str, str]:
+        """replica_id -> node_id attribution for every live replica
+        (preemption drills pick victims from this; empty node ids are
+        replicas still starting)."""
+        with self._lock:
+            return {r.replica_id: r.node_id or ""
+                    for s in self._deployments.values()
+                    for r in s.replicas
+                    if r.state in (_ReplicaState.STARTING,
+                                   _ReplicaState.RUNNING)}
+
     def get_replica_handles(self, app_name: str,
                             deployment_name: str) -> List[Any]:
         with self._lock:
@@ -265,6 +296,71 @@ class ServeController:
 
     def ping(self) -> str:
         return "pong"
+
+    # -- preemptible-node semantics ------------------------------------------
+
+    def preempt_node(self, node_id: str,
+                     deadline_s: Optional[float] = None) -> int:
+        """Advance notice of node loss: deregister-then-drain every
+        replica on the node. Routers stop routing to them in one
+        long-poll latency, in-flight requests/streams finish inside the
+        notice window (_reap_draining kills on idle or deadline), and the
+        reconcile loop starts replacements — which the scheduler places
+        off the draining node. Returns the number of replicas drained."""
+        n = 0
+        with self._lock:
+            states = list(self._deployments.values())
+            # remember the notice for replicas still STARTING (node_id
+            # unknown until promotion): _check_starting drains them the
+            # moment their attribution lands on this node
+            self._preempted_nodes[node_id] = time.monotonic() + (
+                deadline_s if deadline_s is not None
+                else self.DRAIN_DEADLINE_S)
+        for state in states:
+            with self._lock:
+                targets = [
+                    r for r in state.replicas
+                    if r.node_id == node_id
+                    and r.state in (_ReplicaState.STARTING,
+                                    _ReplicaState.RUNNING)]
+            for r in targets:
+                self._drain_replica(state, r, deadline_s=deadline_s)
+                n += 1
+        if n:
+            logger.warning(
+                "preempt notice for node %s: drained %d replica(s)",
+                node_id[:12], n)
+        return n
+
+    def _check_preempt_notices(self) -> None:
+        """Watch the cluster event log for node.preempt_notice (the GCS
+        advance-notice path) so serve reacts to announced node loss
+        without any operator wiring. Runs on the control thread at the
+        health-check cadence; each notice is handled once (EventCursor
+        holds the dedup/anchor protocol)."""
+        # prune expiries for nodes that never saw a late attribution —
+        # preempted nodes leave the cluster, so nothing else removes
+        # them (under the lock: preempt_node inserts from RPC threads)
+        now = time.monotonic()
+        with self._lock:
+            for nid in [n for n, exp in self._preempted_nodes.items()
+                        if now >= exp]:
+                self._preempted_nodes.pop(nid, None)
+        for ev in self._preempt_cursor.poll(limit=100):
+            if not ev.get("node_id"):
+                continue
+            deadline = float((ev.get("data") or {}).get("deadline_s",
+                                                        self.DRAIN_DEADLINE_S))
+            # The raylet armed its hard kill at EMIT time; drain with
+            # what's left of the window at poll time, minus a skew margin
+            # (emit time is the raylet host's wall clock) — draining a
+            # little early is safe, a replica still streaming when the
+            # raylet's deadline fires is not.
+            elapsed = max(0.0, time.time() - float(ev.get("time")
+                                                   or time.time()))
+            remaining = deadline - elapsed - self.PREEMPT_SKEW_MARGIN_S
+            self.preempt_node(ev["node_id"],
+                              deadline_s=max(0.0, remaining))
 
     # -- HTTP proxy shard lifecycle ------------------------------------------
 
@@ -339,12 +435,91 @@ class ServeController:
             except Exception:  # noqa: BLE001
                 pass
 
+    def rolling_restart_proxies(self) -> int:
+        """Restart every HTTP proxy shard ONE at a time (config rollout /
+        resilience drill scenario): kill shard i, start its replacement,
+        wait until it binds and pulls routes, then move to the next. The
+        shared SO_REUSEPORT listen set keeps the other N-1 shards
+        accepting throughout, so ingress availability never drops to
+        zero. Returns the number of shards restarted."""
+        with self._lock:
+            idxs = sorted(self._proxy_shards)
+        for idx in idxs:
+            fresh = self._respawn_shard(idx)
+            if fresh is None:
+                continue  # _check_proxies retries the spawn next tick
+            try:
+                # barrier: the replacement must be serving before the
+                # next shard goes down, or a 2-shard roll would briefly
+                # drop the whole listen set
+                ray_tpu.get(fresh.ready.remote(), timeout=60)
+            except Exception:  # noqa: BLE001 — health loop will retry it
+                logger.warning("proxy shard %d slow to return after "
+                               "rolling restart", idx)
+        return len(idxs)
+
+    def _respawn_shard(self, idx: int, missing_only: bool = False,
+                       expected=None):
+        """The one respawn stanza (missing-shard sweep, unhealthy
+        restart, rolling restart all use it): mark the slot mid-respawn
+        so _check_proxies' missing sweep cannot re-adopt the OLD
+        still-named actor between pop and kill, kill whatever held the
+        slot, start the replacement, push it routes. Returns the fresh
+        handle, or None when the spawn failed (the health loop retries
+        next tick).
+
+        `missing_only` / `expected` re-check the slot ATOMICALLY with
+        claiming it: the sweep's missing-list and health-probe snapshots
+        race the rolling restart, and acting on a stale snapshot would
+        kill the replacement the roll just started (its ready() barrier
+        then probes a corpse while the next shard goes down — a full
+        listen-set outage on 2 shards). `expected` claims the slot only
+        while it still holds the exact handle whose probe failed."""
+        with self._lock:
+            if missing_only and (idx in self._proxy_shards
+                                 or idx in self._proxy_rolling):
+                return self._proxy_shards.get(idx)
+            if expected is not None and (
+                    self._proxy_shards.get(idx) is not expected):
+                return self._proxy_shards.get(idx)
+            self._proxy_rolling.add(idx)
+            shard = self._proxy_shards.pop(idx, None)
+        try:
+            if shard is not None:
+                try:
+                    ray_tpu.kill(shard)
+                except Exception:  # noqa: BLE001 — already dead
+                    pass
+            self._start_proxy_shard(idx)
+        finally:
+            with self._lock:
+                self._proxy_rolling.discard(idx)
+        with self._lock:
+            fresh = self._proxy_shards.get(idx)
+        if fresh is not None:
+            try:
+                fresh.update_routes.remote()
+            except Exception:  # noqa: BLE001 — dead already; health loop
+                pass
+        return fresh
+
     def _check_proxies(self) -> None:
         """Health-check shards; restart dead ones (control loop). Young
         shards get an init grace period — their ping is queued behind a
         cold __init__ (imports + route pull), and killing them for that
         would churn startup forever."""
         now = time.monotonic()
+        with self._lock:
+            cfg = self._proxy_config
+            missing = ([i for i in range(cfg["num_shards"])
+                        if i not in self._proxy_shards
+                        and i not in self._proxy_rolling] if cfg else [])
+        # a shard whose spawn failed outright (rolling restart or a prior
+        # unhealthy-restart) has no entry to health-check — without this
+        # sweep the listen set would silently stay at N-1 forever
+        for idx in missing:
+            logger.warning("proxy shard %d missing; respawning", idx)
+            self._respawn_shard(idx, missing_only=True)
         with self._lock:
             shards = [(i, s) for i, s in self._proxy_shards.items()
                       if now - self._proxy_started_at.get(i, 0.0) > 20.0]
@@ -375,20 +550,7 @@ class ServeController:
             if ok:
                 continue
             logger.warning("proxy shard %d unhealthy; restarting", idx)
-            with self._lock:
-                self._proxy_shards.pop(idx, None)
-            try:
-                ray_tpu.kill(shard)
-            except Exception:  # noqa: BLE001
-                pass
-            self._start_proxy_shard(idx)
-            with self._lock:
-                fresh = self._proxy_shards.get(idx)
-            if fresh is not None:
-                try:
-                    fresh.update_routes.remote()
-                except Exception:  # noqa: BLE001
-                    pass
+            self._respawn_shard(idx, expected=shard)
 
     # -- reconcile loop ------------------------------------------------------
 
@@ -402,6 +564,7 @@ class ServeController:
                 if now - last_health > HEALTH_CHECK_INTERVAL_S:
                     self._autoscale()
                     self._check_proxies()
+                    self._check_preempt_notices()
                     last_health = now
             except Exception:  # noqa: BLE001 — loop must survive
                 logger.exception("reconcile error")
@@ -413,6 +576,7 @@ class ServeController:
         with self._lock:
             starting = [r for r in state.replicas
                         if r.state == _ReplicaState.STARTING]
+        promoted = []
         for r in starting:
             try:
                 done, _ = ray_tpu.wait([r.init_ref], timeout=0)
@@ -422,6 +586,7 @@ class ServeController:
                 try:
                     ray_tpu.get(r.init_ref, timeout=1.0)
                     r.state = _ReplicaState.RUNNING
+                    promoted.append(r)
                     self._bump(state.full_name)
                 except Exception:  # noqa: BLE001 — init raised
                     logger.warning("replica %s failed to initialize",
@@ -430,12 +595,63 @@ class ServeController:
             elif time.monotonic() - r.started_at > REPLICA_INIT_TIMEOUT_S:
                 logger.warning("replica %s init timed out", r.replica_id)
                 r.state = _ReplicaState.UNHEALTHY
+        if promoted:
+            self._attribute_node_ids(state, promoted)
+
+    def _attribute_node_ids(self, state: _DeploymentState,
+                            replicas: list) -> None:
+        """Node attribution for preemption drains: fan out one
+        get_node_id RPC per replica with a single bounded wait for the
+        whole sweep — a wedged replica must cost the control loop 5s
+        once, not 5s serially per replica (health checks, drain reaping
+        and preempt-notice polling all share this thread). Replicas the
+        sweep misses stay node_id=None and are retried from _reconcile:
+        an unattributed replica is invisible to preempt_node's by-node
+        drain and would serve straight into the raylet's deadline kill.
+        A replica that resolves onto a node under an active preemption
+        notice drains immediately with whatever window remains."""
+        node_refs = []
+        for r in replicas:
+            try:
+                node_refs.append((r, r.handle.get_node_id.remote()))
+            except Exception:  # noqa: BLE001 — attribution only
+                r.node_id = None
+        if node_refs:
+            try:
+                ray_tpu.wait([ref for _, ref in node_refs],
+                             num_returns=len(node_refs), timeout=5.0)
+            except Exception:  # noqa: BLE001 — attribution only
+                pass
+            for r, ref in node_refs:
+                try:
+                    r.node_id = ray_tpu.get(ref, timeout=0)
+                except Exception:  # noqa: BLE001 — attribution only
+                    r.node_id = None
+        for r in replicas:
+            # lock the expiry lookup (preempt_node mutates the dict from
+            # RPC threads); _drain_replica runs outside the lock
+            with self._lock:
+                expiry = self._preempted_nodes.get(r.node_id or "")
+                if expiry is not None and time.monotonic() >= expiry:
+                    self._preempted_nodes.pop(r.node_id or "", None)
+                    expiry = None
+            if expiry is not None:
+                self._drain_replica(state, r,
+                                    deadline_s=expiry - time.monotonic())
 
     def _reconcile(self) -> None:
         with self._lock:
             states = list(self._deployments.values())
         for state in states:
             self._check_starting(state)
+            with self._lock:
+                unattributed = [r for r in state.replicas
+                                if r.state == _ReplicaState.RUNNING
+                                and r.node_id is None]
+            if unattributed:
+                # promotion-time attribution missed these (slow RPC,
+                # transient failure) — keep retrying at reconcile cadence
+                self._attribute_node_ids(state, unattributed)
             self._reap_draining(state)
             with self._lock:
                 alive = [r for r in state.replicas
@@ -514,13 +730,27 @@ class ServeController:
                             for r in updated)):
             self._start_replica(state)  # the surge replica (new version)
 
+    # routers assigned requests from the previous long-poll snapshot for
+    # up to one RPC latency after a drain deregisters the replica; the
+    # grace floor lets those land before the idle check can pass
+    DRAIN_GRACE_S = 1.0
+    DRAIN_DEADLINE_S = 30.0
+    # budget for raylet-vs-controller wall-clock skew when computing the
+    # remaining preempt-drain window from an event's emit time
+    PREEMPT_SKEW_MARGIN_S = 2.0
+
     def _drain_replica(self, state: _DeploymentState,
-                       replica: _ReplicaState) -> None:
-        """Deregister a replica from routers NOW; the kill happens a
-        grace period later (_reap_draining) so requests assigned from the
-        previous long-poll snapshot still complete."""
+                       replica: _ReplicaState,
+                       deadline_s: Optional[float] = None) -> None:
+        """Deregister-then-drain: the replica leaves the routers' set NOW
+        (long-poll bump) but is killed only once its in-flight requests
+        and streams finish (_reap_draining polls its ongoing count) or at
+        the drain deadline — announced node loss must not truncate live
+        token streams."""
         replica.state = _ReplicaState.DRAINING
         replica.drain_since = time.monotonic()
+        replica.drain_deadline = replica.drain_since + (
+            deadline_s if deadline_s is not None else self.DRAIN_DEADLINE_S)
         try:
             replica.handle.prepare_shutdown.remote()
         except Exception:  # noqa: BLE001
@@ -530,11 +760,43 @@ class ServeController:
     def _reap_draining(self, state: _DeploymentState) -> None:
         now = time.monotonic()
         with self._lock:
-            expired = [r for r in state.replicas
-                       if r.state == _ReplicaState.DRAINING
-                       and now - r.drain_since > 1.0]
+            draining = [r for r in state.replicas
+                        if r.state == _ReplicaState.DRAINING
+                        and now - r.drain_since > self.DRAIN_GRACE_S]
+        if not draining:
+            return
+        # idle probe: fan out, harvest with one bounded wait (a wedged
+        # draining replica must not stall the control loop)
+        probes = []
+        for r in draining:
+            try:
+                probes.append((r, r.handle.get_metrics.remote()))
+            except Exception:  # noqa: BLE001 — already dead: reap now
+                probes.append((r, None))
+        refs = [ref for _, ref in probes if ref is not None]
+        done_set = set()
+        if refs:
+            try:
+                done, _ = ray_tpu.wait(refs, num_returns=len(refs),
+                                       timeout=1.0)
+                done_set = set(done)
+            except Exception:  # noqa: BLE001
+                pass
+        expired = []
+        for r, ref in probes:
+            ongoing = None
+            if ref is not None and ref in done_set:
+                try:
+                    ongoing = int(ray_tpu.get(ref, timeout=0.1)
+                                  .get("num_ongoing_requests", 0))
+                except Exception:  # noqa: BLE001 — replica died draining
+                    ongoing = 0
+            if ref is None or ongoing == 0 or now > r.drain_deadline:
+                expired.append(r)
+        with self._lock:
             for r in expired:
-                state.replicas.remove(r)
+                if r in state.replicas:
+                    state.replicas.remove(r)
         for r in expired:
             self._stop_replica(r)
 

@@ -187,6 +187,7 @@ class ProxyActor:
         self._started = threading.Event()
         self._bind_error: Optional[BaseException] = None
         self._requests_served = 0
+        self._replica_death_retries = 0
         self._thread = threading.Thread(
             target=self._serve_forever,
             name=f"serve-proxy-{shard_index}", daemon=True)
@@ -213,6 +214,7 @@ class ProxyActor:
             "shard_index": self._shard_index,
             "num_shards": self._num_shards,
             "requests_served": self._requests_served,
+            "replica_death_retries": self._replica_death_retries,
             "routes": sorted(self._routes),
             "llm_apps": sorted(self._llm_routers),
         }
@@ -458,19 +460,48 @@ class ProxyActor:
         self._started.set()
         loop.run_forever()
 
-    async def _unary(self, handle, arg, timeout_s: float = 60.0):
+    async def _unary(self, handle, arg, timeout_s: float = 60.0,
+                     max_attempts: int = 3):
         """Unary request: non-blocking replica assignment + async reply
         await. Falls back to the blocking assign on an executor thread
-        only when no replica is known yet (cold start / scale-from-0)."""
+        only when no replica is known yet (cold start / scale-from-0).
+
+        A request whose REPLICA died under it (actor death, node loss —
+        not a user exception) is re-assigned, bounded: unary serve calls
+        are idempotent by contract, so a replica kill mid-request must
+        not surface as a lost accepted request while other replicas are
+        healthy. The dead replica leaves the router set within one
+        long-poll latency; until then a retry can land on it again, hence
+        the short backoff between attempts."""
+        from ray_tpu.exceptions import RayActorError
+
         loop = self._loop
-        resp = handle.try_remote(arg)
-        if resp is None:
-            resp = await loop.run_in_executor(
-                None, lambda: handle.remote(arg))
-        try:
-            return await self._await_ref(resp._ref, timeout_s)
-        finally:
-            resp._done()
+        last_err: Optional[BaseException] = None
+        for attempt in range(max_attempts):
+            if attempt:
+                await asyncio.sleep(0.05 * (2 ** attempt))
+            resp = None
+            try:
+                # a KNOWN-dead replica raises at submit time (the router
+                # releases + evicts it); an in-flight death surfaces on
+                # the reply ref — both re-assign
+                resp = handle.try_remote(arg)
+                if resp is None:
+                    resp = await loop.run_in_executor(
+                        None, lambda: handle.remote(arg))
+                return await self._await_ref(resp._ref, timeout_s)
+            except RayActorError as e:
+                last_err = e
+                self._replica_death_retries += 1
+                if resp is not None and resp._router is not None:
+                    # reply-time death: evict so the retry's power-of-two
+                    # choice stops seeing the corpse as least-loaded
+                    resp._router.notify_replica_death(resp._ref)
+                continue
+            finally:
+                if resp is not None:
+                    resp._done()
+        raise last_err
 
     async def _stream(self, request, flags: Dict[str, Any], make_iter):
         from aiohttp import web

@@ -78,6 +78,12 @@ class ReplicaActor:
             user_check()
         return True
 
+    def get_node_id(self) -> str:
+        """Node attribution for the controller's preemption drains."""
+        import ray_tpu
+
+        return ray_tpu.get_runtime_context().get_node_id()
+
     def reconfigure(self, user_config: Any) -> None:
         hook = getattr(self._callable, "reconfigure", None)
         if hook is not None:

@@ -76,6 +76,18 @@ class PowerOfTwoChoicesReplicaScheduler:
             if n > 0:
                 self._local_load[replica_id] = n - 1
 
+    def evict(self, replica_id: str) -> None:
+        """Drop a replica this router OBSERVED dead (actor-death error on
+        submit or reply). The controller's next long-poll push re-syncs
+        the authoritative set; until then a dead replica must not keep
+        winning the power-of-two choice — with its errored requests
+        released it would look like the LEAST loaded candidate."""
+        with self._lock:
+            self._replicas = [(rid, h) for rid, h in self._replicas
+                              if rid != replica_id]
+            self._base_load.pop(replica_id, None)
+            self._local_load.pop(replica_id, None)
+
 
 class Router:
     """Per-handle router; replica set maintained by a controller
@@ -93,6 +105,11 @@ class Router:
         self._stopped = threading.Event()
         # outstanding response refs; resolution decrements local load
         self._outstanding: Dict[Any, str] = {}
+        # ref -> replica id of recently RELEASED charges (bounded FIFO):
+        # the sweep can release a dead replica's refs before the awaiting
+        # caller observes the actor-death error, and its later
+        # notify_replica_death must still be able to evict the corpse
+        self._recently_done: Dict[Any, str] = {}
         self._out_lock = threading.Lock()
         self._sweep_at = 512
         threading.Thread(target=self._long_poll_loop, daemon=True,
@@ -150,8 +167,31 @@ class Router:
         (idempotent)."""
         with self._out_lock:
             rid = self._outstanding.pop(ref, None)
+            if rid is not None:
+                self._recently_done[ref] = rid
+                while len(self._recently_done) > 1024:
+                    self._recently_done.pop(
+                        next(iter(self._recently_done)))
         if rid is not None:
             self._scheduler.request_done(rid)
+
+    def notify_replica_death(self, ref) -> None:
+        """A response resolved to an actor-death error: release its
+        charge AND locally evict the replica so retries stop landing on
+        it before the controller's long-poll update arrives. Eviction is
+        a fact the caller observed — it must happen even when the sweep
+        already released this ref's charge (the _recently_done lookup),
+        or the corpse sits in the set at zero load and power-of-two
+        keeps feeding it retries."""
+        with self._out_lock:
+            rid = self._outstanding.pop(ref, None)
+            charged = rid is not None
+            if rid is None:
+                rid = self._recently_done.pop(ref, None)
+        if rid is not None:
+            if charged:
+                self._scheduler.request_done(rid)
+            self._scheduler.evict(rid)
 
     # -- request path --------------------------------------------------------
 
@@ -170,11 +210,31 @@ class Router:
                 f"no replicas available for deployment {self._deployment!r}")
         return choice
 
+    def _submit(self, replica_id: str, handle, method_name: str,
+                args: tuple, kwargs: dict):
+        """Submit to the chosen replica; a KNOWN-dead actor raises right
+        at submit, so release the charge and evict before re-raising —
+        otherwise the leaked charge pins load on a corpse and retries
+        keep picking it (it looks idle). Any other submit-time error
+        (bad payload, transient RPC failure) releases the charge but
+        keeps the replica routable — evicting a healthy replica on a
+        caller-side error would drain the set one malformed request at
+        a time until the next long-poll resync."""
+        try:
+            ref = handle.handle_request.remote(method_name, args, kwargs)
+        except ray_tpu.exceptions.ActorDiedError:
+            self._scheduler.request_done(replica_id)
+            self._scheduler.evict(replica_id)
+            raise
+        except Exception:
+            self._scheduler.request_done(replica_id)
+            raise
+        return self._track(ref, replica_id)
+
     def assign_request(self, method_name: str, args: tuple, kwargs: dict):
         """Returns an ObjectRef for the response."""
         replica_id, handle = self._choose()
-        ref = handle.handle_request.remote(method_name, args, kwargs)
-        return self._track(ref, replica_id)
+        return self._submit(replica_id, handle, method_name, args, kwargs)
 
     def try_assign_request(self, method_name: str, args: tuple,
                            kwargs: dict):
@@ -186,8 +246,7 @@ class Router:
         if choice is None:
             return None
         replica_id, handle = choice
-        ref = handle.handle_request.remote(method_name, args, kwargs)
-        return self._track(ref, replica_id)
+        return self._submit(replica_id, handle, method_name, args, kwargs)
 
     def assign_request_streaming(self, method_name: str, args: tuple,
                                  kwargs: dict):

@@ -17,6 +17,7 @@ from ray_tpu.train._internal.dataset_integration import (  # noqa: F401
     get_dataset_shard,
 )
 from ray_tpu.train._internal.session import (  # noqa: F401
+    GangPreemptedError,
     get_checkpoint,
     get_context,
     report,
@@ -67,6 +68,7 @@ __all__ = [
     "CheckpointConfig",
     "DataParallelTrainer",
     "FailureConfig",
+    "GangPreemptedError",
     "JaxBackend",
     "JaxConfig",
     "JaxPredictor",

@@ -13,10 +13,13 @@ recovery — SURVEY §7: a failed host means the whole mesh restarts).
 from __future__ import annotations
 
 import logging
+import threading
+import time
 from collections import defaultdict
 from typing import Any, Callable, Dict, List, Optional
 
 import ray_tpu
+from ray_tpu._private.event_watch import EventCursor
 from ray_tpu.train._internal.storage import StorageContext
 from ray_tpu.train._internal.worker_group import WorkerGroup
 from ray_tpu.train.backend import BackendConfig
@@ -26,7 +29,76 @@ logger = logging.getLogger(__name__)
 
 
 class TrainingWorkerError(RuntimeError):
-    """A training worker died or its train_fn raised."""
+    """A training worker died or its train_fn raised.
+
+    `preempted` marks a gang that checkpoint-drained after a
+    node.preempt_notice: the trainer reschedules it onto a fresh
+    placement group without consuming failure budget."""
+
+    def __init__(self, msg: str, preempted: bool = False):
+        super().__init__(msg)
+        self.preempted = preempted
+
+
+class _PreemptWatcher(threading.Thread):
+    """Driver-side watcher closing the preemptible-TPU loop: polls the
+    cluster event log for `node.preempt_notice` events on nodes hosting
+    this gang's workers; on a hit, emits `gang.checkpoint_drain` and
+    tells EVERY worker to checkpoint-and-drain at its next report —
+    gang-atomic, because a mesh gang missing one host must restart as one
+    unit anyway (the fresh placement group excludes the draining node)."""
+
+    def __init__(self, worker_group: WorkerGroup,
+                 gang_node_ids: List[str], interval_s: float = 1.0,
+                 since: Optional[float] = None):
+        super().__init__(daemon=True, name="rt-train-preempt-watch")
+        self._wg = worker_group
+        self._nodes = set(gang_node_ids)
+        self._interval = interval_s
+        self._stop = threading.Event()
+        # `since` = when gang PLACEMENT began, not when this watcher
+        # starts: placement + spawn + init_session can take far longer
+        # than the cursor's skew slack, and a notice emitted in that
+        # window targets nodes the gang just landed on (earlier notices
+        # can't — the scheduler excludes draining nodes from placement)
+        self._cursor = EventCursor("node.preempt_notice", since=since)
+        self.fired = threading.Event()
+        self.notice: Optional[dict] = None
+
+    def run(self) -> None:
+        while not self._stop.wait(self._interval):
+            for ev in self._cursor.poll(limit=100):
+                if ev.get("node_id") in self._nodes:
+                    self._fire(ev)
+                    return
+
+    def _fire(self, notice: dict) -> None:
+        from ray_tpu._private import event_log
+
+        self.notice = notice
+        reason = (notice.get("data") or {}).get("reason", "")
+        event_log.emit("gang.checkpoint_drain",
+                       node_id=notice.get("node_id"),
+                       reason=reason, world_size=self._wg.num_workers)
+        logger.warning(
+            "preempt notice for gang node %s (%s): draining %d workers to "
+            "their next checkpoint", str(notice.get("node_id"))[:12],
+            reason or "no reason", self._wg.num_workers)
+        refs = []
+        for w in self._wg.workers:
+            try:
+                refs.append(w.notify_preempt.remote(reason))
+            except Exception:  # noqa: BLE001 — worker already gone
+                pass
+        if refs:
+            try:
+                ray_tpu.wait(refs, num_returns=len(refs), timeout=10.0)
+            except Exception:  # noqa: BLE001 — best-effort fan-out
+                pass
+        self.fired.set()
+
+    def stop(self) -> None:
+        self._stop.set()
 
 
 class BackendExecutor:
@@ -45,8 +117,11 @@ class BackendExecutor:
         self._strategy = placement_strategy
         self._bundles = bundles
         self.worker_group: Optional[WorkerGroup] = None
+        self._preempt_watcher: Optional[_PreemptWatcher] = None
+        self._placement_started_at: Optional[float] = None
 
     def start(self) -> None:
+        self._placement_started_at = time.time()
         self.worker_group = WorkerGroup(
             self._num_workers, self._resources, self._strategy,
             bundles=self._bundles)
@@ -102,6 +177,10 @@ class BackendExecutor:
         ray_tpu.get([
             w.start_training.remote(train_fn, config) for w in wg.workers
         ])
+        self._preempt_watcher = _PreemptWatcher(
+            wg, [m["node_id"] for m in meta],
+            since=self._placement_started_at)
+        self._preempt_watcher.start()
 
     def get_next_results(self, timeout: float = 3600.0) -> Optional[List[dict]]:
         """One result per worker, or None when training completed everywhere.
@@ -113,7 +192,11 @@ class BackendExecutor:
         try:
             results = ray_tpu.get(refs, timeout=timeout)
         except Exception as e:  # noqa: BLE001 — train_fn / actor-death errors
-            raise TrainingWorkerError(str(e)) from e
+            preempted = (
+                (self._preempt_watcher is not None
+                 and self._preempt_watcher.fired.is_set())
+                or "GangPreemptedError" in str(e))
+            raise TrainingWorkerError(str(e), preempted=preempted) from e
         done = [r is None for r in results]
         if all(done):
             return None
@@ -138,6 +221,9 @@ class BackendExecutor:
                 pass
 
     def shutdown(self) -> None:
+        if self._preempt_watcher is not None:
+            self._preempt_watcher.stop()
+            self._preempt_watcher = None
         if self.worker_group is not None:
             try:
                 self._backend.on_shutdown(

@@ -22,6 +22,13 @@ from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.train.context import TrainContext
 
 
+class GangPreemptedError(RuntimeError):
+    """This worker's node got a preemption notice (node.preempt_notice)
+    and the train_fn unwound AFTER persisting its drain checkpoint — the
+    trainer catches the resulting gang failure and reschedules the whole
+    gang onto a fresh placement group without burning failure budget."""
+
+
 class _TrainingResult:
     __slots__ = ("metrics", "checkpoint_dir_name")
 
@@ -40,7 +47,17 @@ class _Session:
         self.finished = threading.Event()
         self.error: Optional[BaseException] = None
         self.stop_requested = threading.Event()
+        self.preempt_requested = threading.Event()
+        self.preempt_reason = ""
         self._report_count = checkpoint_index_start
+
+    def request_preempt(self, reason: str = "") -> None:
+        """Arm checkpoint-and-drain: the next report() that carries a
+        checkpoint persists it and unwinds the train_fn with
+        GangPreemptedError (called by TrainWorker.notify_preempt from the
+        driver's preempt watcher)."""
+        self.preempt_reason = reason
+        self.preempt_requested.set()
 
     # called from the train thread
     def report(self, metrics: Dict[str, Any],
@@ -49,6 +66,16 @@ class _Session:
         if checkpoint is not None:
             ckpt_name = self._persist_checkpoint(checkpoint)
             self.latest_checkpoint = checkpoint
+        if self.preempt_requested.is_set() and checkpoint is not None:
+            # drain ordering contract (tested): the checkpoint above is
+            # already persisted to trial storage BEFORE the unwind, so the
+            # rescheduled gang resumes from this exact step. Raised before
+            # the queue put — the driver is about to tear the gang down
+            # and may never consume another result (maxsize=1 would wedge
+            # this thread forever).
+            raise GangPreemptedError(
+                f"node preempted ({self.preempt_reason or 'notice'}); "
+                f"drain checkpoint {ckpt_name!r} persisted")
         self._report_count += 1
         self.result_queue.put(_TrainingResult(dict(metrics), ckpt_name))
         if self.stop_requested.is_set():
@@ -70,12 +97,16 @@ class _Session:
         dest = os.path.join(trial_dir, name)
         if self.context.world_rank == 0:
             checkpoint.to_directory(dest)
-        else:
+        elif checkpoint.get_metadata().get("sharded"):
             shard = os.path.join(
                 dest, f"shard_{self.context.world_rank:05d}")
-            os.makedirs(os.path.dirname(shard), exist_ok=True)
-            if checkpoint.get_metadata().get("sharded"):
-                checkpoint.to_directory(shard)
+            os.makedirs(dest, exist_ok=True)
+            checkpoint.to_directory(shard)
+        # non-sharded non-zero ranks must not even create the directory:
+        # report-count skew between ranks (the queue allows one report in
+        # flight) would otherwise leave an EMPTY checkpoint_NNNNNN ahead
+        # of rank 0's real one, and a gang restart would "resume" from a
+        # payload-less checkpoint (found by the preemption drill)
         return name
 
     def get_checkpoint(self) -> Optional[Checkpoint]:

@@ -66,8 +66,18 @@ class StorageContext:
             return len(cs)
 
     def latest_checkpoint(self) -> Optional[str]:
-        cs = self.list_checkpoints()
-        return self.checkpoint_path(cs[-1]) if cs else None
+        """Newest NON-EMPTY checkpoint: an empty dir (a rank that died
+        between mkdir and its first file, or a legacy skewed-rank mkdir)
+        has no payload to resume from and must not shadow the last real
+        checkpoint."""
+        for c in reversed(self.list_checkpoints()):
+            path = self.checkpoint_path(c)
+            try:
+                if os.listdir(path):
+                    return path
+            except OSError:
+                continue
+        return None
 
     def prune_checkpoints(self, num_to_keep: Optional[int],
                           scores: Optional[Dict[str, float]] = None,

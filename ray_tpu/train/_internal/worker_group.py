@@ -98,6 +98,15 @@ class TrainWorker:
         if self._session is not None:
             self._session.stop_requested.set()
 
+    def notify_preempt(self, reason: str = "") -> bool:
+        """Advance notice of node loss (driver preempt watcher fan-out):
+        arm checkpoint-and-drain so the next checkpointed report unwinds
+        the train_fn gang-atomically (see session.GangPreemptedError)."""
+        if self._session is None:
+            return False
+        self._session.request_preempt(reason)
+        return True
+
     def finish(self, timeout: float = 30.0) -> None:
         if self._train_thread is not None:
             self._train_thread.join(timeout)

@@ -103,12 +103,22 @@ class DataParallelTrainer(BaseTrainer):
         max_failures = self.run_config.failure_config.max_failures
         latest_checkpoint = self.resume_from_checkpoint
         attempts = 0
+        preemptions = 0
         while True:
             try:
                 return self._run_attempt(storage, latest_checkpoint,
                                          name, trial_id)
             except TrainingWorkerError as e:
-                attempts += 1
+                if getattr(e, "preempted", False):
+                    # announced node loss: the gang checkpoint-drained on
+                    # notice, so this is a reschedule, not a failure — it
+                    # never burns failure budget (bounded only by a large
+                    # runaway backstop)
+                    preemptions += 1
+                    if preemptions > 64:
+                        raise
+                else:
+                    attempts += 1
                 if max_failures != -1 and attempts > max_failures:
                     last = storage.latest_checkpoint()
                     return Result(
@@ -119,9 +129,14 @@ class DataParallelTrainer(BaseTrainer):
                     )
                 last = storage.latest_checkpoint()
                 latest_checkpoint = Checkpoint(last) if last else None
-                logger.warning(
-                    "training attempt %d failed (%s); restarting gang from "
-                    "checkpoint %s", attempts, e, last)
+                if getattr(e, "preempted", False):
+                    logger.warning(
+                        "gang preempted (%s); rescheduling onto a fresh "
+                        "placement group from drain checkpoint %s", e, last)
+                else:
+                    logger.warning(
+                        "training attempt %d failed (%s); restarting gang "
+                        "from checkpoint %s", attempts, e, last)
 
     def _run_attempt(self, storage: StorageContext,
                      latest_checkpoint: Optional[Checkpoint],

@@ -180,18 +180,28 @@ def test_node_agent_stats_logs_profile(dash_cluster):
             sum(range(1000))
         return 1
 
-    ref = spin.remote(8.0)
-    time.sleep(1.0)
+    ref = spin.remote(20.0)
 
     agents = json.loads(_get(base + "/api/agents"))
     assert len(agents) == 1
     node_id = next(iter(agents))
 
-    stats = json.loads(_get(base + f"/api/nodes/{node_id}/stats"))
-    assert stats["node_id"] == node_id
-    assert stats["mem"]["total_bytes"] > 0
+    # Poll instead of a fixed sleep: on a loaded CI share the worker can
+    # take several seconds to spawn and register, and a miss here was the
+    # long-standing tier-1 flake (the spin task runs long enough that the
+    # worker stays alive for the whole poll + profile window).
+    deadline = time.monotonic() + 15.0
+    pids = []
+    while time.monotonic() < deadline:
+        stats = json.loads(_get(base + f"/api/nodes/{node_id}/stats"))
+        assert stats["node_id"] == node_id
+        assert stats["mem"]["total_bytes"] > 0
+        pids = [w["pid"] for w in stats.get("workers", ())
+                if w["registered"]]
+        if pids:
+            break
+        time.sleep(0.25)
     assert stats["workers"], "agent saw no worker processes"
-    pids = [w["pid"] for w in stats["workers"] if w["registered"]]
     assert pids, "no registered (profile-able) workers in agent stats"
 
     logs = json.loads(_get(base + f"/api/nodes/{node_id}/logs"))

@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# CI gate, in dependency order (cheapest signal first):
+#   1. raylint         — static invariants, JAX-free, ~5s
+#   2. drill gate      — one bounded, seeded resilience drill; fails on an
+#                        SLO regression (MTTR/availability/request-loss
+#                        thresholds in ray_tpu/drills/thresholds.json)
+#   3. tier-1 tests    — the full `not slow` suite
+#
+# Usage: tools/ci.sh [--skip-tests]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== raylint =="
+python -m tools.raylint ray_tpu/ tests/
+
+echo "== drill gate (bounded, seeded) =="
+JAX_PLATFORMS=cpu python -m ray_tpu drill run \
+    --scenario replica_kill --budget 120s --seed 0 \
+    --report "${TMPDIR:-/tmp}/ci_drill_report.json" --gate
+
+if [[ "${1:-}" != "--skip-tests" ]]; then
+    echo "== tier-1 tests =="
+    JAX_PLATFORMS=cpu python -m pytest tests/ -q -m "not slow" \
+        -p no:cacheprovider
+fi
