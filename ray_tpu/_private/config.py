@@ -169,6 +169,22 @@ _d("max_inline_function_bytes", 64 * 1024)
 _d("gcs_reconnect_backoff_max_s", 5.0)
 _d("gcs_reconnect_backoff_jitter", 0.5)
 
+# --- overload protection (ISSUE 9; _private/backoff.py, deadlines.py) --------
+# Every queue names its bound (CONTRIBUTING). Overflow returns typed
+# pushback (RetryLaterError / retry_later replies with a retry-after
+# hint) and counts ray_tpu_shed_total{layer=...}; it never parks work
+# forever or fails it as lost.
+_d("raylet_lease_queue_max", 2000)       # queued lease requests per raylet
+_d("gcs_actor_creation_queue_max", 4000)  # actors pending first creation
+_d("actor_mailbox_max", 10_000)          # owner-side queued calls per actor
+# Token-bucket retry budgets per (peer, method): each retry spends a
+# token; an empty bucket fails fast with the underlying error instead of
+# amplifying a brownout into a retry storm. retry_budget_enabled=False
+# restores pre-budget behavior (the chaos-brownout e2e compares both).
+_d("retry_budget_capacity", 10.0)
+_d("retry_budget_fill_per_s", 1.0)
+_d("retry_budget_enabled", True)
+
 # --- gcs ---------------------------------------------------------------------
 _d("gcs_storage_path", "")  # "" = pure in-memory; path = snapshot for restart
 _d("maximum_gcs_dead_node_cache_count", 1000)

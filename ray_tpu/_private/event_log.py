@@ -65,6 +65,13 @@ EVENT_SCHEMAS: Dict[str, Tuple[str, ...]] = {
     # owner-side task retry FSM (core_worker)
     "task.retry": ("reason", "attempt", "retries_left"),
     "task.giveup": ("reason",),
+    # overload protection (ISSUE 9): work refused by a bounded queue with
+    # typed pushback (layer = raylet | gcs_actor_creation | actor_mailbox
+    # | serve), vs doomed work dropped at queue-pop because its deadline
+    # passed (layer = owner | raylet | worker). Shed work was never
+    # accepted; expired work is resolved with DeadlineExceededError.
+    "task.shed": ("layer", "reason"),
+    "task.deadline_expired": ("layer",),
     # raylet lease/dispatch decisions
     "lease.grant": ("function", "worker_id"),
     "lease.reject": ("function", "reason"),

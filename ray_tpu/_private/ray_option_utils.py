@@ -20,10 +20,10 @@ _COMMON_OPTIONS = {
     "max_concurrency", "get_if_exists", "runtime_env", "memory",
     "placement_group", "placement_group_bundle_index",
     "max_pending_calls", "concurrency_groups", "label_selector",
-    "_metadata",
+    "deadline_s", "_metadata",
 }
 
-TASK_ONLY = {"max_retries", "retry_exceptions", "max_calls"}
+TASK_ONLY = {"max_retries", "retry_exceptions", "max_calls", "deadline_s"}
 ACTOR_ONLY = {
     "max_restarts", "max_task_retries", "max_concurrency", "lifetime",
     "get_if_exists", "max_pending_calls", "concurrency_groups",
@@ -49,6 +49,10 @@ def validate_options(options: Dict[str, Any], *, is_actor: bool) -> Dict[str, An
         v = options.get(key)
         if v is not None and (not isinstance(v, (int, float)) or v < 0):
             raise ValueError(f"{key} must be a non-negative number")
+    dl = options.get("deadline_s")
+    if dl is not None and (not isinstance(dl, (int, float))
+                           or isinstance(dl, bool) or dl <= 0):
+        raise ValueError("deadline_s must be a positive number of seconds")
     return options
 
 

@@ -157,6 +157,15 @@ class TaskSpec:
     # tasks leave this None: pooled workers amortize one fetch per
     # function across many tasks.
     function_blob: Optional[bytes] = None
+    # Absolute wall-clock deadline (time.time() domain); None = no bound.
+    # Set from .options(deadline_s=), the ambient submission deadline
+    # (serve's X-Request-Deadline header), or inherited child-from-parent
+    # with the remaining budget (_private/deadlines.py). The wire codec
+    # stamps REMAINING time and re-anchors on receipt, so cross-host
+    # clock skew shifts the budget instead of corrupting it. Every
+    # queue-pop (owner pump, raylet lease queue, worker executor) drops
+    # expired specs with a typed DeadlineExceededError.
+    deadline_s: Optional[float] = None
 
     def return_ids(self) -> List[ObjectID]:
         n = max(self.num_returns, 1) if self.num_returns != 0 else 0
@@ -321,6 +330,9 @@ def spec_to_wire(sp: TaskSpec) -> tuple:
          for k, a in getattr(sp, "kwarg_specs", {}).items()] or None,
         sp.function_blob,
         sp.trace_parent,
+        # deadline rides as REMAINING seconds (absolute instants don't
+        # survive clock skew between hosts; spec_from_wire re-anchors)
+        None if sp.deadline_s is None else sp.deadline_s - time.time(),
     )
 
 
@@ -354,6 +366,8 @@ def spec_from_wire(t: tuple) -> TaskSpec:
     if len(t) > 23:
         sp.function_blob = t[23]
         sp.trace_parent = t[24]
+    if len(t) > 25:
+        sp.deadline_s = None if t[25] is None else time.time() + t[25]
     return sp
 
 

@@ -19,8 +19,13 @@ Protocol (line-JSON on stdio, single-threaded and fork-safe):
   stdout -> {"exited": <pid>, "status": <waitpid exit code>}
 Children are reaped HERE (they are the zygote's children); the worker
 pool converts exit reports into its normal death handling. EOF on stdin
-shuts the zygote down (children keep running; the pool owns their
-lifecycle).
+shuts the zygote down AND takes any still-running children with it: a
+clean pool shutdown terminates its workers BEFORE closing our stdin, so
+surviving children at EOF mean the host process was killed without
+teardown (e.g. a `timeout -k`ed tier-1 run). Leaving those workers
+alive leaked serve proxy shards that kept holding SO_REUSEPORT test
+ports — the next run's sockets shared the port with a corpse and its
+share of connections hung on the first byte.
 """
 
 from __future__ import annotations
@@ -70,6 +75,23 @@ def main():
     import faulthandler
     faulthandler.register(signal.SIGUSR1, all_threads=True)
 
+    children: set = set()
+
+    def _terminated(signum, frame):
+        # A SIGTERM that kills only this fork-server (e.g. `timeout`
+        # TERMing the whole test-run tree while the raylet is already
+        # gone) must not strand its children: they are OUR children, and
+        # orphaned they sit on their sockets — including SO_REUSEPORT
+        # serve-proxy ports that then starve the NEXT run's listeners.
+        for pid in list(children):
+            try:
+                os.kill(pid, signal.SIGTERM)
+            except OSError:
+                pass
+        os._exit(0)
+
+    signal.signal(signal.SIGTERM, _terminated)
+
     # Preimport the worker stack so forked children inherit a warm module
     # cache. NOTHING here may start threads or event loops — fork() only
     # duplicates the calling thread, and a lock held elsewhere at fork
@@ -97,7 +119,6 @@ def main():
     stdin_fd = sys.stdin.fileno()
     buf = b""
     boot_ppid = os.getppid()
-    children: set = set()
     while True:
         # Orphan defense: a clean pool shutdown closes our stdin (EOF
         # below), but a SIGKILLed host process leaves us reparented to
@@ -129,7 +150,17 @@ def main():
             continue
         chunk = os.read(stdin_fd, 65536)
         if not chunk:
-            return  # pool closed our stdin: shut down
+            # Pool closed our stdin: shut down. A clean shutdown already
+            # terminated the workers (pool kills children, THEN closes
+            # stdin); anything still alive here is an orphan from a
+            # killed host process — reap it, or it holds its ports
+            # (SO_REUSEPORT proxy shards!) until someone pkills it.
+            for pid in children:
+                try:
+                    os.kill(pid, signal.SIGTERM)
+                except OSError:
+                    pass
+            return
         buf += chunk
         while b"\n" in buf:
             line, buf = buf.split(b"\n", 1)

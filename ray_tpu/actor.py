@@ -59,16 +59,18 @@ def _is_asyncio_class(cls) -> bool:
 
 class ActorMethod:
     def __init__(self, handle: "ActorHandle", method_name: str,
-                 num_returns=1):
+                 num_returns=1, deadline_s=None):
         self._handle = handle
         self._method_name = method_name
         self._num_returns = num_returns
+        self._deadline_s = deadline_s
 
     def options(self, **overrides) -> "ActorMethod":
         return ActorMethod(
             self._handle,
             self._method_name,
             num_returns=overrides.get("num_returns", self._num_returns),
+            deadline_s=overrides.get("deadline_s", self._deadline_s),
         )
 
     def remote(self, *args, **kwargs):
@@ -79,6 +81,7 @@ class ActorMethod:
             args,
             kwargs,
             num_returns=self._num_returns,
+            deadline_s=self._deadline_s,
         )
         if isinstance(result, list):
             if self._num_returns == 1:

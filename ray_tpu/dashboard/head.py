@@ -512,6 +512,25 @@ class DashboardHead:
                         add(f"{label}_{tags.get('replica', '')[:24]}", v)
         except Exception:  # noqa: BLE001 — serving stack not up
             pass
+        # 1.6) overload protection (ISSUE 9): cluster-wide shed and
+        # doomed-work totals from the GCS event manager's per-type
+        # counts (covers every process, not just this one's registry),
+        # plus this process's retry-budget fail-fast counter.
+        try:
+            stats = self._gcs.call("get_event_log_stats", {}, timeout=5)
+            by_type = stats.get("by_type") or {}
+            add("overload_shed_total", float(by_type.get("task.shed", 0)))
+            add("overload_deadline_expired_total",
+                float(by_type.get("task.deadline_expired", 0)))
+        except Exception:  # noqa: BLE001 — GCS unreachable mid-sample
+            pass
+        budget_c = get_metric("ray_tpu_retry_budget_exhausted_total")
+        if budget_c is not None:
+            try:
+                add("retry_budget_exhausted_total",
+                    float(sum(v for _, v in budget_c._values.items())))
+            except Exception:  # noqa: BLE001
+                pass
         # 2) task throughput from GCS task events. Count FINISHED events
         # past a PER-JOB watermark over EVENT timestamps — a delta of the
         # windowed count would flatline to zero once the event store holds

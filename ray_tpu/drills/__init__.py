@@ -3,9 +3,10 @@
 Closes the resilience loop the chaos layer (PR 3) and the structured
 event log (PR 5) opened: a DRILL runs a scenario (serve replica kills,
 raylet<->GCS partitions, rolling proxy-shard restarts, whole-node
-preemption notices) against a LIVE workload (sustained HTTP serving, or
-a checkpointing SPMD training gang) and computes its SLOs — MTTR,
-availability, request loss — directly from the GcsEventManager causal
+preemption notices, a 3x overload storm) against a LIVE workload
+(sustained HTTP serving, or a checkpointing SPMD training gang) and
+computes its SLOs — MTTR, availability, request loss, storm goodput and
+shed-vs-lost accounting — directly from the GcsEventManager causal
 timeline: every injection is a `drill.phase` marker, every recovery is a
 real lifecycle event (`actor.alive`, `node.alive`,
 `gang.checkpoint_drain`), and the verdict is thresholds

@@ -96,6 +96,24 @@ def _build_workload(config: DrillConfig, scenario) -> Any:
     replica_resources = None
     if scenario.name == "node_preempt_serve":
         replica_resources = {"drill_replica": 0.001}
+    if scenario.name == "overload_storm":
+        # A KNOWN capacity so the storm provably exceeds it: ordered
+        # replicas serialize per CALLER, so with 2 proxy shards x 2
+        # replicas there are 4 concurrent service streams; at 150ms work
+        # each, capacity ≈ 4/0.15 ≈ 27 accepted/s. Baseline offers 16/s
+        # (59% utilization), the storm 3x that (48/s). Enough closed-loop
+        # workers that the offered rate survives 1s-latency shed
+        # responses (rate x patience ≈ 48x1), and a 1s client budget the
+        # proxy maps onto task deadlines (excess 504s typed, fast).
+        return ServingWorkload(
+            scenario=scenario.name,
+            rate_hz=float(config.extras.get("storm_baseline_hz", 16.0)),
+            http_port=config.http_port,
+            n_workers=int(config.extras.get("storm_workers", 32)),
+            work_s=float(config.extras.get("storm_work_s", 0.15)),
+            max_ongoing=1,
+            request_timeout_s=float(
+                config.extras.get("storm_timeout_s", 1.0)))
     return ServingWorkload(
         scenario=scenario.name, rate_hz=config.rate_hz,
         http_port=config.http_port,

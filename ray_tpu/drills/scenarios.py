@@ -32,11 +32,22 @@ Scenario inventory:
                             training gang: checkpoint-and-drain, then
                             reschedule onto a fresh placement group with
                             loss continuity.
+* overload_storm          — no fault at all: offered HTTP load jumps to
+                            >=3x the workload's sustained capacity while
+                            a deadline-carrying task flood hits the
+                            raylet. The overload-protection stack
+                            (bounded queues + typed pushback, deadline
+                            drops at queue-pop, retry budgets) must keep
+                            goodput up, account every refusal as SHED
+                            (zero lost-accepted), and return to baseline
+                            throughput when the storm ends — the
+                            anti-metastable-collapse drill.
 """
 
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from random import Random
 from typing import Any, Dict, Optional
@@ -234,6 +245,106 @@ class NodePreemptTrainScenario(_NodePreemptBase):
                 "deadline_s": self.notice_deadline_s}
 
 
+def _make_flood_fn(key: int, sleep_s: float):
+    """One flood function per scheduling key: lease asks are capped PER
+    KEY (max_pending_lease_requests_per_scheduling_key), so a flood from
+    a single function could never overrun the raylet lease queue — many
+    distinct keys ask concurrently, exactly like many independent
+    submitters hammering one node."""
+
+    def _storm_flood(i: int):
+        import time as _time
+
+        _time.sleep(sleep_s)
+        return i
+
+    _storm_flood.__name__ = f"storm_flood_{key}"
+    return _storm_flood
+
+
+class OverloadStormScenario(Scenario):
+    """Offered load >= 3x sustained capacity at the sharded HTTP proxy +
+    a deadline-carrying task-submission flood at the raylet, held for
+    `storm_s`, then released. Recovery = the first post-storm window
+    whose accepted-request rate is back at `recovery_frac` of the
+    measured pre-storm baseline with nothing shed (slo.py matcher) —
+    proving the cluster sheds typed under overload and snaps back with
+    no metastable state."""
+
+    name = "overload_storm"
+    workload_kind = "serving"
+    multiplier = 3.0
+    storm_s = 8.0
+    flood_tasks = 200
+    flood_keys = 40             # distinct scheduling keys in the flood
+    flood_task_sleep_s = 0.02
+    flood_deadline_s = 1.5
+    flood_lease_queue_max = 48  # drill-tightened raylet bound
+
+    def prepare(self, ctx: DrillContext) -> Dict[str, Any]:
+        w = ctx.workload
+        baseline = w.measured_ok_hz()
+        if not baseline or baseline <= 0:
+            raise RuntimeError("no baseline throughput measured in warmup")
+        return {
+            "baseline_rate_hz": w.rate_hz,
+            "baseline_ok_hz": round(baseline, 3),
+            "multiplier": self.multiplier,
+            "storm_s": self.storm_s,
+            "recovery_frac": 0.95,
+            "flood_tasks": self.flood_tasks,
+        }
+
+    def execute(self, ctx: DrillContext, detail: Dict[str, Any]) -> None:
+        import ray_tpu
+        from ray_tpu._private.config import CONFIG
+        from ray_tpu.exceptions import DeadlineExceededError
+        from ray_tpu._private import event_log
+
+        w = ctx.workload
+        flood_stats = {"flood_sent": 0, "flood_ok": 0,
+                       "flood_expired": 0, "flood_lost": 0}
+
+        def _flood():
+            fns = [ray_tpu.remote(
+                _make_flood_fn(k, self.flood_task_sleep_s))
+                for k in range(self.flood_keys)]
+            refs = [fns[i % len(fns)].options(
+                deadline_s=self.flood_deadline_s).remote(i)
+                for i in range(self.flood_tasks)]
+            flood_stats["flood_sent"] = len(refs)
+            for ref in refs:
+                try:
+                    ray_tpu.get(ref, timeout=self.flood_deadline_s + 30)
+                    flood_stats["flood_ok"] += 1
+                except DeadlineExceededError:
+                    flood_stats["flood_expired"] += 1  # dropped typed: shed
+                except Exception:  # noqa: BLE001 — anything else is LOST
+                    flood_stats["flood_lost"] += 1
+
+        prev_bound = CONFIG.raylet_lease_queue_max
+        CONFIG.set("raylet_lease_queue_max", self.flood_lease_queue_max)
+        logger.warning(
+            "drill: overload storm — offered %gx for %gs + %d-task flood",
+            self.multiplier, self.storm_s, self.flood_tasks)
+        flood_thread = None
+        try:
+            w.set_rate(w.rate_hz * self.multiplier)
+            flood_thread = threading.Thread(
+                target=_flood, name="drill-storm-flood", daemon=True)
+            flood_thread.start()
+            time.sleep(self.storm_s)
+        finally:
+            w.set_rate(w.rate_hz)
+            if flood_thread is not None:
+                flood_thread.join(timeout=60.0)
+            CONFIG.set("raylet_lease_queue_max", prev_bound)
+        # storm over: the recovery matcher scans windows AFTER this marker
+        event_log.emit("drill.phase", scenario=self.name, phase="storm_end",
+                       **flood_stats)
+        event_log.flush(timeout=2.0)
+
+
 SCENARIO_CLASSES = {
     cls.name: cls for cls in (
         ReplicaKillScenario,
@@ -241,6 +352,7 @@ SCENARIO_CLASSES = {
         ProxyRollingRestartScenario,
         NodePreemptServeScenario,
         NodePreemptTrainScenario,
+        OverloadStormScenario,
     )
 }
 

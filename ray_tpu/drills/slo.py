@@ -164,12 +164,48 @@ def _recover_gang_reschedule(injection: dict,
     return None
 
 
+def _storm_end(post: List[dict]) -> Optional[dict]:
+    return next((ev for ev in post
+                 if ev.get("type") == "drill.phase"
+                 and _data(ev).get("phase") == "storm_end"), None)
+
+
+def _recover_overload(injection: dict, post: List[dict]) -> Optional[dict]:
+    """An overload storm is recovered at the first load window AFTER the
+    storm_end marker whose accepted-request rate is back at
+    `recovery_frac` of the measured pre-storm baseline AND which sheds
+    nothing (a still-draining backlog keeps 504ing excess — that window
+    is not yet baseline). No metastable state = this window exists."""
+    inj = _data(injection)
+    baseline = float(inj.get("baseline_ok_hz") or 0.0)
+    frac = float(inj.get("recovery_frac") or 0.95)
+    end = _storm_end(post)
+    if end is None or baseline <= 0:
+        return None
+    for ev in _after(post, end):
+        if ev.get("type") != "drill.phase":
+            continue
+        d = _data(ev)
+        if d.get("phase") != "window":
+            continue
+        window_s = float(d.get("window_s") or 0.0)
+        if window_s <= 0:
+            continue
+        ok, sent = int(d.get("ok", 0)), int(d.get("sent", 0))
+        shed_or_lost = int(d.get("rejected", 0)) + int(d.get("lost", 0))
+        if (sent > 0 and ok / window_s >= frac * baseline
+                and shed_or_lost == 0):
+            return ev
+    return None
+
+
 RECOVERY_MATCHERS: Dict[str, Callable[[dict, List[dict]], Optional[dict]]] = {
     "replica_kill": _recover_replacement_replica,
     "gcs_partition": _recover_node_alive,
     "proxy_rolling_restart": _recover_rolling_proxies,
     "node_preempt_serve": _recover_replacement_replica,
     "node_preempt_train": _recover_gang_reschedule,
+    "overload_storm": _recover_overload,
 }
 
 
@@ -232,6 +268,55 @@ def lost_accepted(windows: List[dict]) -> int:
     return sum(int(w.get("lost", 0)) for w in windows)
 
 
+def overload_slo(events: List[dict], scenario: str) -> Optional[Dict[str, Any]]:
+    """Storm-phase SLOs for overload_storm-style scenarios, computed
+    purely from the event timeline: goodput (accepted-request rate while
+    the storm held, as a fraction of the measured pre-storm baseline),
+    shed-vs-lost accounting, p99-of-accepted, and the task-flood's
+    ok/expired/lost split (from the storm_end marker's data). None when
+    the timeline carries no storm."""
+    injections = find_injections(events, scenario)
+    if not injections:
+        return None
+    inj = injections[-1]
+    post = _after(events, inj)
+    end = _storm_end(post)
+    if end is None:
+        return None
+    end_key = _order_key(end)
+    storm_windows = []
+    for ev in post:
+        if _order_key(ev) > end_key:
+            break
+        if (ev.get("type") == "drill.phase"
+                and _data(ev).get("phase") == "window"):
+            storm_windows.append(_data(ev))
+    total_s = sum(float(w.get("window_s") or 0.0) for w in storm_windows)
+    ok = sum(int(w.get("ok", 0)) for w in storm_windows)
+    shed = sum(int(w.get("rejected", 0)) for w in storm_windows)
+    lost = sum(int(w.get("lost", 0)) for w in storm_windows)
+    baseline = float(_data(inj).get("baseline_ok_hz") or 0.0)
+    goodput_hz = (ok / total_s) if total_s > 0 else None
+    p99s = [float(w["p99_ms"]) for w in storm_windows if "p99_ms" in w]
+    end_data = _data(end)
+    return {
+        "storm_windows": len(storm_windows),
+        "offered_multiplier": _data(inj).get("multiplier"),
+        "baseline_ok_hz": round(baseline, 3) if baseline else None,
+        "goodput_hz": round(goodput_hz, 3) if goodput_hz is not None
+        else None,
+        "goodput_frac": (round(goodput_hz / baseline, 4)
+                         if goodput_hz is not None and baseline > 0
+                         else None),
+        "shed": shed,
+        "lost_accepted": lost,
+        "p99_of_accepted_ms": round(max(p99s), 3) if p99s else None,
+        "flood": {k: end_data.get(k) for k in
+                  ("flood_sent", "flood_ok", "flood_expired", "flood_lost")
+                  if k in end_data},
+    }
+
+
 # -- report + verdict ---------------------------------------------------------
 
 def evaluate_thresholds(slo: Dict[str, Any],
@@ -270,6 +355,25 @@ def evaluate_thresholds(slo: Dict[str, Any],
             and not slo.get("checkpoint_drains")):
         failures.append("no gang.checkpoint_drain event "
                         "(gang did not drain on notice)")
+    goodput_min = thresholds.get("goodput_min_frac")
+    if goodput_min is not None:
+        storm = slo.get("overload")
+        if not storm:
+            failures.append("no storm phase recorded in the timeline")
+        else:
+            frac = storm.get("goodput_frac")
+            if frac is None:
+                failures.append("no goodput measurable during the storm")
+            elif frac < goodput_min:
+                failures.append(
+                    f"storm goodput {frac:.3f} of baseline below floor "
+                    f"{goodput_min}")
+            flood_lost = (storm.get("flood") or {}).get("flood_lost")
+            max_flood_lost = thresholds.get("max_flood_lost", 0)
+            if flood_lost is not None and flood_lost > max_flood_lost:
+                failures.append(
+                    f"{flood_lost} flood tasks failed untyped "
+                    "(every refusal must be shed or deadline-expired)")
     return failures
 
 
@@ -327,6 +431,9 @@ def compute_report(events: List[dict], scenario: str, seed: int,
         "preempt_notices": sum(
             1 for e in events if e.get("type") == "node.preempt_notice"),
     }
+    storm = overload_slo(events, scenario)
+    if storm is not None:
+        slo["overload"] = storm
     failures = evaluate_thresholds(slo, thresholds)
     return {
         "schema": "ray_tpu.drill_report/1",

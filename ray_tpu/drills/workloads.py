@@ -41,9 +41,23 @@ class ServingWorkload:
                  num_replicas: int = 2, http_shards: int = 2,
                  http_port: int = 0, window_s: float = 0.5,
                  n_workers: int = 4,
-                 replica_resources: Optional[Dict[str, float]] = None):
+                 replica_resources: Optional[Dict[str, float]] = None,
+                 work_s: float = 0.0, max_ongoing: int = 8,
+                 request_timeout_s: Optional[float] = None):
         self.scenario = scenario
         self.rate_hz = rate_hz
+        # live offered-rate control (overload_storm raises it mid-run);
+        # load workers re-read this every cycle
+        self._target_rate_hz = rate_hz
+        # fixed per-request service time in the replica: gives the drill
+        # a KNOWN capacity (num_replicas * max_ongoing / work_s) so an
+        # overload storm can provably exceed it
+        self.work_s = work_s
+        self.max_ongoing = max_ongoing
+        # client patience, sent as X-Request-Timeout-S so the serve proxy
+        # maps it onto the task deadline (doomed-work elimination) AND
+        # used as the HTTP client timeout
+        self.request_timeout_s = request_timeout_s
         self.num_replicas = num_replicas
         self.http_shards = http_shards
         if not http_port:
@@ -67,7 +81,9 @@ class ServingWorkload:
         self._lock = threading.Lock()
         self._counts = {"sent": 0, "ok": 0, "rejected": 0, "lost": 0}
         self._totals = {"sent": 0, "ok": 0, "rejected": 0, "lost": 0}
+        self._ok_latencies: List[float] = []  # current window, seconds
         self._windows = 0
+        self._started_at: Optional[float] = None
         self._controller = None
 
     # -- lifecycle -----------------------------------------------------------
@@ -80,11 +96,17 @@ class ServingWorkload:
         if self.replica_resources:
             opts["ray_actor_options"] = {
                 "resources": dict(self.replica_resources)}
+        work_s = self.work_s
 
         @serve.deployment(num_replicas=self.num_replicas,
+                          max_ongoing_requests=self.max_ongoing,
                           health_check_period_s=0.5,
                           health_check_timeout_s=2.0, **opts)
         def drill_echo(body=None):
+            if work_s:
+                import time as _time
+
+                _time.sleep(work_s)
             return {"ok": True}
 
         serve.run(drill_echo.bind(), name=self.app_name,
@@ -94,15 +116,35 @@ class ServingWorkload:
         handle = serve.get_deployment_handle("drill_echo", self.app_name)
         assert handle.remote(None).result(timeout_s=60)["ok"]
         self._threads = [
-            threading.Thread(target=self._load_worker, daemon=True,
-                             name=f"drill-load-{i}")
+            threading.Thread(target=self._load_worker, args=(i,),
+                             daemon=True, name=f"drill-load-{i}")
             for i in range(self.n_workers)
         ]
         self._threads.append(
             threading.Thread(target=self._window_loop, daemon=True,
                              name="drill-load-windows"))
+        self._started_at = time.time()
         for t in self._threads:
             t.start()
+
+    # -- offered-rate control (overload_storm) -------------------------------
+
+    def set_rate(self, rate_hz: float) -> None:
+        """Change the offered rate mid-run (storm injection); workers
+        re-read the target every request cycle."""
+        self._target_rate_hz = float(rate_hz)
+
+    def measured_ok_hz(self) -> Optional[float]:
+        """Mean accepted-request rate since start() — the storm's baseline
+        capacity reference, measured rather than assumed."""
+        if self._started_at is None:
+            return None
+        elapsed = time.time() - self._started_at
+        if elapsed <= 0:
+            return None
+        with self._lock:
+            ok = self._totals["ok"] + self._counts["ok"]
+        return ok / elapsed
 
     def stop(self) -> Dict[str, Any]:
         self._stop.set()
@@ -124,30 +166,53 @@ class ServingWorkload:
 
     # -- load generation -----------------------------------------------------
 
-    def _classify(self, status: int) -> str:
+    def _classify(self, status: int, typed_shed: bool = False) -> str:
         if status == 200:
             return "ok"
         if status in (429, 503):
-            return "rejected"   # shed before acceptance
+            return "rejected"   # queue pushback: shed before acceptance
+        if status == 504 and typed_shed:
+            # doomed-work elimination: the proxy's X-Typed-Shed header
+            # certifies the request was dropped at queue-pop BEFORE
+            # execution started (typed DeadlineExceededError) — refused,
+            # not accepted-then-lost. A bare 504 (no header) means
+            # accepted work stalled past the budget: that IS lost.
+            return "rejected"
         return "lost"           # accepted, then failed
 
-    def _load_worker(self) -> None:
+    def _load_worker(self, index: int = 0) -> None:
         host_port = f"127.0.0.1:{self.http_port}"
         path = f"/{self.app_name}"
-        period = self.n_workers / self.rate_hz
+        headers = {}
+        timeout = 10.0
+        if self.request_timeout_s:
+            headers["X-Request-Timeout-S"] = f"{self.request_timeout_s:g}"
+            # client gives the cluster a grace beat past the declared
+            # budget before hanging up (the 504 should beat this)
+            timeout = self.request_timeout_s + 5.0
         conn: Optional[http.client.HTTPConnection] = None
+        # Stagger the first request across workers: an unstaggered start
+        # fires n_workers requests in the same instant, inflating the
+        # measured baseline rate the storm verdict is judged against.
+        start_period = self.n_workers / max(0.1, self._target_rate_hz)
+        if self._stop.wait((index / max(1, self.n_workers)) * start_period):
+            return
         while not self._stop.is_set():
+            period = self.n_workers / max(0.1, self._target_rate_hz)
             t0 = time.perf_counter()
             outcome = None
             sent = False
             try:
                 if conn is None:
-                    conn = http.client.HTTPConnection(host_port, timeout=10)
-                conn.request("GET", path)
+                    conn = http.client.HTTPConnection(host_port,
+                                                      timeout=timeout)
+                conn.request("GET", path, headers=headers)
                 sent = True
                 resp = conn.getresponse()
                 resp.read()
-                outcome = self._classify(resp.status)
+                outcome = self._classify(
+                    resp.status,
+                    typed_shed=bool(resp.getheader("X-Typed-Shed")))
             except Exception:  # noqa: BLE001 — classified below
                 # send-side failure = never accepted (rejected); a reset
                 # after the request went out = accepted-then-lost
@@ -158,12 +223,14 @@ class ServingWorkload:
                 except Exception:  # noqa: BLE001
                     pass
                 conn = None
+            latency = time.perf_counter() - t0
             with self._lock:
                 self._counts["sent"] += 1
                 self._counts[outcome] += 1
-            elapsed = time.perf_counter() - t0
-            if elapsed < period:
-                self._stop.wait(period - elapsed)
+                if outcome == "ok":
+                    self._ok_latencies.append(latency)
+            if latency < period:
+                self._stop.wait(period - latency)
         if conn is not None:
             try:
                 conn.close()
@@ -174,13 +241,21 @@ class ServingWorkload:
         with self._lock:
             counts, self._counts = self._counts, {
                 "sent": 0, "ok": 0, "rejected": 0, "lost": 0}
+            latencies, self._ok_latencies = self._ok_latencies, []
         if counts["sent"] == 0:
             return
         for k, v in counts.items():
             self._totals[k] += v
         self._windows += 1
+        extra: Dict[str, Any] = {"window_s": self.window_s}
+        if latencies:
+            latencies.sort()
+            # p99-of-ACCEPTED requests: shed/lost requests never count —
+            # the storm verdict reads this straight from the event log
+            idx = min(len(latencies) - 1, int(0.99 * len(latencies)))
+            extra["p99_ms"] = round(latencies[idx] * 1000.0, 3)
         event_log.emit("drill.phase", scenario=self.scenario,
-                       phase="window", **counts)
+                       phase="window", **counts, **extra)
 
     def _window_loop(self) -> None:
         while not self._stop.wait(self.window_s):

@@ -166,6 +166,49 @@ class GetTimeoutError(RayTpuError, TimeoutError):
     """`get` timed out (reference :727)."""
 
 
+class DeadlineExceededError(RayTpuError, TimeoutError):
+    """The task's deadline passed before it produced a result.
+
+    Raised for work dropped at queue-pop (doomed-work elimination: the
+    raylet lease queue, the worker executor, and the owner's submit pump
+    all drop already-expired specs) and for work whose caller-supplied
+    budget (`.options(deadline_s=...)`, serve's `X-Request-Deadline`
+    header) ran out. Maps to HTTP 504 at the serve proxy. Never
+    retried: a deadline is a promise to the caller, not a transient."""
+
+    status_code = 504
+
+    def __init__(self, error_message: str = "", *, layer: str = "",
+                 deadline: Optional[float] = None):
+        self.layer = layer
+        self.deadline = deadline
+        super().__init__(
+            error_message
+            or f"Task deadline exceeded (dropped at layer={layer or '?'})")
+
+
+class RetryLaterError(RayTpuError):
+    """Typed pushback from a bounded queue: the request was refused (not
+    queued, not executed) and may be retried after `retry_after_s`.
+
+    Raised by the raylet lease queue, the GCS actor-creation queue and
+    the per-actor owner-side mailbox when full. Internal submitters pace
+    resubmission with AIMD (_private/backoff.AIMDPacer); user-facing
+    surfaces translate it to HTTP 503 + Retry-After. The work is
+    accounted SHED (`ray_tpu_shed_total{layer=...}`), never lost."""
+
+    status_code = 503
+
+    def __init__(self, error_message: str = "", *,
+                 retry_after_s: float = 1.0, layer: str = ""):
+        self.retry_after_s = retry_after_s
+        self.layer = layer
+        super().__init__(
+            error_message
+            or f"Queue full at layer={layer or '?'}; "
+               f"retry after {retry_after_s:.2f}s")
+
+
 class RuntimeEnvSetupError(RayTpuError):
     """Creating the runtime environment failed (reference :748)."""
 
@@ -211,6 +254,8 @@ __all__ = [
     "ObjectReconstructionFailedMaxAttemptsExceededError",
     "ObjectReconstructionFailedLineageEvictedError",
     "GetTimeoutError",
+    "DeadlineExceededError",
+    "RetryLaterError",
     "RuntimeEnvSetupError",
     "RaySystemError",
     "WorkerCrashedError",

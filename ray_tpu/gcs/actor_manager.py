@@ -16,6 +16,7 @@ import asyncio
 import logging
 from typing import Dict, List, Optional, Tuple
 
+from ray_tpu._private import backoff as _backoff
 from ray_tpu._private.config import CONFIG
 from ray_tpu._private.ids import ActorID, NodeID
 from ray_tpu._private.rpc import ClientPool, ConnectionLost
@@ -42,6 +43,9 @@ class GcsActorManager:
         self._store = store
         self._actors: Dict[ActorID, ActorInfo] = {}
         self._creation_specs: Dict[ActorID, TaskSpec] = {}
+        # Actors awaiting their FIRST creation (the bounded registration
+        # queue; restarts bypass it — they already hold capacity budget).
+        self._pending_creation: set = set()
         # (namespace, name) -> actor_id
         self._named: Dict[Tuple[str, str], ActorID] = {}
         # node_id -> set of actor ids placed there
@@ -78,6 +82,8 @@ class GcsActorManager:
             self._actors[info.actor_id] = info
             if spec is not None:
                 self._creation_specs[info.actor_id] = spec
+            if info.state == ActorState.PENDING_CREATION:
+                self._pending_creation.add(info.actor_id)
             if info.name and info.state != ActorState.DEAD:
                 self._named[(info.namespace or "", info.name)] = info.actor_id
             if info.address is not None and info.state == ActorState.ALIVE:
@@ -109,6 +115,25 @@ class GcsActorManager:
             existing = self._actors.get(creation.actor_id)
             if existing is not None:
                 return {"status": "registered", "info": existing}
+            # Bounded creation queue (gcs_actor_creation_queue_max): a
+            # registration burst beyond the bound gets typed retry_later
+            # pushback (the owner re-registers with paced backoff) instead
+            # of an unbounded PENDING_CREATION backlog each running its
+            # own scheduling loop against the same full raylets.
+            bound = CONFIG.gcs_actor_creation_queue_max
+            pending = len(self._pending_creation)
+            if bound > 0 and pending >= bound:
+                _elog.emit("task.shed", actor_id=creation.actor_id.hex(),
+                           layer="gcs_actor_creation",
+                           reason="creation queue full",
+                           class_name=spec.function_name)
+                _backoff.count_shed("gcs_actor_creation")
+                return {
+                    "status": "retry_later",
+                    # creations are heavier than leases: 2ms/item, 10s cap
+                    "retry_after_s": _backoff.retry_after_hint(
+                        pending, per_item_s=0.002, cap_s=10.0),
+                }
             if name:
                 existing_id = self._named.get((namespace, name))
                 if existing_id is not None:
@@ -134,6 +159,7 @@ class GcsActorManager:
             )
             self._actors[creation.actor_id] = info
             self._creation_specs[creation.actor_id] = spec
+            self._pending_creation.add(creation.actor_id)
             self._persist(creation.actor_id)
         _elog.emit("actor.pending", actor_id=creation.actor_id.hex(),
                    class_name=spec.function_name, name=name)
@@ -193,6 +219,7 @@ class GcsActorManager:
         info.state = ActorState.ALIVE
         info.address = address
         info.pid = payload.get("pid", 0)
+        self._pending_creation.discard(actor_id)
         self._by_node.setdefault(address.node_id, set()).add(actor_id)
         self._persist(actor_id)
         self._pub.publish(ps.ACTOR_CHANNEL, actor_id, info)
@@ -258,6 +285,7 @@ class GcsActorManager:
             return
         info.state = ActorState.DEAD
         info.death_cause = reason
+        self._pending_creation.discard(actor_id)
         if info.address is not None:
             self._by_node.get(info.address.node_id, set()).discard(actor_id)
             info.address = None
@@ -276,6 +304,10 @@ class GcsActorManager:
             return
         attempt = 0
         refunds = 0
+        failures = 0  # consecutive lease failures, drives the backoff
+        policy = _backoff.BackoffPolicy(base_s=0.2, multiplier=1.5,
+                                        max_s=2.0, jitter=0.2)
+        pacer = _backoff.AIMDPacer(base_s=0.2, max_s=5.0)
         target_node: Optional[NodeID] = None
         while attempt < 60:
             info = self._actors.get(actor_id)
@@ -317,10 +349,23 @@ class GcsActorManager:
                     # before the bounded refund pool drains).
                     attempt -= 1
                     refunds += 1
-                await asyncio.sleep(0.2)
+                failures += 1
+                await asyncio.sleep(policy.delay(failures))
                 continue
             except (OSError, asyncio.TimeoutError):
-                await asyncio.sleep(0.2)
+                failures += 1
+                await asyncio.sleep(policy.delay(failures))
+                continue
+            if reply.get("retry_later"):
+                # typed pushback from a full raylet lease queue: pace the
+                # re-ask (AIMD) and refund the attempt — shed work is not
+                # a failed lease, and burning the budget on it would turn
+                # an overloaded-but-healthy cluster into dead actors
+                if refunds < 120:
+                    attempt -= 1
+                    refunds += 1
+                await asyncio.sleep(
+                    pacer.on_pushback(reply.get("retry_after_s")))
                 continue
             if reply.get("rejected"):
                 if reply.get("runtime_env_error"):
@@ -329,11 +374,14 @@ class GcsActorManager:
                     await self._mark_dead(actor_id,
                                           reply["runtime_env_error"])
                     return
-                await asyncio.sleep(0.2)
+                failures += 1
+                await asyncio.sleep(policy.delay(failures))
                 continue
             if reply.get("retry_at"):
                 target_node = reply["retry_at_node_id"]
                 continue
+            failures = 0
+            pacer.on_success()
             worker_addr: Address = reply["worker_address"]
             ok = await self._push_creation_task(actor_id, spec, worker_addr, raylet_addr)
             if ok:

@@ -114,7 +114,9 @@ class ExternalStore(InMemoryStore):
             "xs_dump", {}, timeout=CONFIG.gcs_external_store_op_timeout_s)
         with self._lock:
             self._tables = {t: dict(kv) for t, kv in dump.items()}
-        self._queue: deque = deque()
+        # bounded by gcs_external_store_max_queue at enqueue time (the
+        # shipper drops-oldest past it while the store is down)
+        self._queue: deque = deque()  # raylint: disable=unbounded-queue
         self._cv = threading.Condition()
         self._inflight = 0
         self._closed = False

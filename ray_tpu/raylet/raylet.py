@@ -26,6 +26,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
+from ray_tpu._private import backoff as _backoff
+from ray_tpu._private import deadlines as _deadlines
 from ray_tpu._private import event_log
 from ray_tpu._private.config import CONFIG
 from ray_tpu._private.ids import NodeID, PlacementGroupID, WorkerID
@@ -199,6 +201,13 @@ class Raylet:
         # retry schedule is reproducible while different nodes stay
         # decorrelated (no synchronized reconnect storm on GCS restart).
         self._backoff_rng = random.Random(self.node_id.binary())
+        self._reconnect_policy = _backoff.BackoffPolicy(
+            base_s=CONFIG.heartbeat_period_ms / 1000.0,
+            multiplier=2.0,
+            max_s=CONFIG.gcs_reconnect_backoff_max_s,
+            jitter=CONFIG.gcs_reconnect_backoff_jitter,
+            rng=self._backoff_rng,
+        )
         # set by `python -m ray_tpu start` so a drained worker PROCESS
         # exits instead of lingering unregistered
         self._exit_on_drain = False
@@ -882,10 +891,44 @@ class Raylet:
         return True
 
     # ------------------------------------------------------------ RPC: lease
+    def _expired_reply(self, spec: TaskSpec) -> dict:
+        """Doomed-work elimination: the spec's deadline passed (on arrival
+        or while queued) — tell the owner which task to resolve typed."""
+        self._elog.emit("task.deadline_expired", task_id=spec.task_id.hex(),
+                        node_id=self.node_id.hex(), layer="raylet",
+                        function=spec.function_name)
+        _backoff.count_deadline_expired("raylet")
+        return {"rejected": True, "deadline_expired": True,
+                "task_id": spec.task_id.hex()}
+
+    def _lease_queue_guard(self, spec: TaskSpec) -> Optional[dict]:
+        """Bounded lease queue (every queue names its bound —
+        raylet_lease_queue_max): overflow returns typed retry_later
+        pushback with a hint scaled to the backlog, so the owner paces
+        (AIMD) instead of parking work here forever."""
+        bound = CONFIG.raylet_lease_queue_max
+        if bound <= 0 or len(self._queue) < bound:
+            return None
+        self._elog.emit("task.shed", task_id=spec.task_id.hex(),
+                        node_id=self.node_id.hex(), layer="raylet",
+                        reason="lease queue full",
+                        function=spec.function_name)
+        _backoff.count_shed("raylet")
+        return {
+            "rejected": True,
+            "retry_later": True,
+            "retry_after_s": _backoff.retry_after_hint(len(self._queue)),
+            "reason": f"lease queue full ({len(self._queue)} waiting)",
+        }
+
     async def handle_request_worker_lease(self, payload):
         spec: TaskSpec = payload["spec"]
         spillback_count = payload.get("spillback_count", 0)
         strat = spec.scheduling_strategy
+
+        if _deadlines.expired(spec.deadline_s):
+            # expired on arrival: never enters the queue
+            return self._expired_reply(spec)
 
         if self._draining:
             # A draining node takes no new work; the submitter retries
@@ -901,6 +944,9 @@ class Raylet:
             # The submitter routes PG leases to the node holding the bundle.
             if strat.placement_group_id not in self._bundles:
                 return {"rejected": True, "reason": "bundle not on this node"}
+            shed = self._lease_queue_guard(spec)
+            if shed is not None:
+                return shed
             return await self._queue_local(spec)
 
         if spillback_count == 0:
@@ -942,6 +988,9 @@ class Raylet:
                             function=spec.function_name,
                             reason="infeasible on this node")
             return {"rejected": True, "reason": "infeasible on this node"}
+        shed = self._lease_queue_guard(spec)
+        if shed is not None:
+            return shed
         return await self._queue_local(spec)
 
     def _cluster_decision(self, spec: TaskSpec) -> Optional[NodeID]:
@@ -985,9 +1034,17 @@ class Raylet:
             again = True
             while again:
                 again = False
+                now = time.time()
                 for q in list(self._queue):
                     if q.future.done():
                         self._queue.remove(q)
+                        continue
+                    if _deadlines.expired(q.spec.deadline_s, now):
+                        # queue-pop doomed-work elimination: the caller
+                        # gave up while this lease waited for resources —
+                        # dropping it here frees the slot for live work
+                        self._queue.remove(q)
+                        q.future.set_result(self._expired_reply(q.spec))
                         continue
                     alloc = self._try_allocate(q.spec)
                     if alloc is None:
@@ -1474,19 +1531,16 @@ class Raylet:
                 gcs_failures += 1
             if gcs_failures:
                 # Exponential backoff with jitter while the GCS is
-                # unreachable: at a fixed period, every raylet of an
-                # N-node cluster would hammer a restarting GCS in
-                # lockstep (N reconnect attempts per 250ms, all phase-
-                # aligned with the moment it went down). Doubling per
+                # unreachable (shared policy module — the schedule is
+                # bit-for-bit the PR 3 hand-rolled one, parity-tested):
+                # at a fixed period, every raylet of an N-node cluster
+                # would hammer a restarting GCS in lockstep. Doubling per
                 # consecutive failure caps the aggregate load, and the
                 # per-node jitter (seeded by node id: deterministic per
                 # node, decorrelated across nodes) spreads the
                 # re-registration burst when the GCS comes back.
-                base = min(period * (2 ** min(gcs_failures, 10)),
-                           CONFIG.gcs_reconnect_backoff_max_s)
-                jitter = CONFIG.gcs_reconnect_backoff_jitter
                 await asyncio.sleep(
-                    base * (1.0 - jitter * self._backoff_rng.random()))
+                    self._reconnect_policy.delay(gcs_failures))
             else:
                 await asyncio.sleep(period)
 

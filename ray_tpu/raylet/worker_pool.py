@@ -192,7 +192,9 @@ class WorkerPool:
         self._registered: Dict[WorkerID, WorkerHandle] = {}
         self._pop_waiters = 0
         self._plain_waiters = 0
-        self._waiters: "deque[asyncio.Future]" = deque()
+        # one waiter per in-flight pop_worker: bounded upstream by the
+        # raylet lease queue bound (raylet_lease_queue_max)
+        self._waiters: "deque[asyncio.Future]" = deque()  # raylint: disable=unbounded-queue
         self._monitor_task: Optional[asyncio.Task] = None
         self._closed = False
         # fork-server for plain workers (see workers/zygote.py)
@@ -549,7 +551,8 @@ class WorkerPool:
         burning the wakeup, with the pop_worker poll as the fairness
         backstop."""
         if n is None:
-            entries, self._waiters = self._waiters, deque()
+            # fresh empty swap of the lease-bounded waiter set (above)
+            entries, self._waiters = self._waiters, deque()  # raylint: disable=unbounded-queue
             for entry in entries:
                 if not entry[0].done():
                     entry[0].set_result(None)

@@ -65,6 +65,7 @@ class RemoteFunction:
             max_retries=o.get("max_retries", 3),
             retry_exceptions=bool(o.get("retry_exceptions", False)),
             max_calls=int(o.get("max_calls", 0)),
+            deadline_s=o.get("deadline_s"),
             scheduling_strategy=strategy,
             name=o.get("name") or self._function.__name__,
             function_id=self._function_id,

@@ -244,6 +244,28 @@ def cmd_status(args) -> int:
         print(f"  {src.split('#')[0]:<22} depth={st['depth']} "
               f"flush_lag={st['flush_lag_s']:.1f}s "
               f"dropped={st['dropped']} emitted={st['emitted']}")
+    # Overload protection (ISSUE 9): shed-vs-doomed accounting straight
+    # from the cluster event totals, split by layer from recent events.
+    by_type = ev.get("by_type") or {}
+    shed = int(by_type.get("task.shed", 0))
+    expired = int(by_type.get("task.deadline_expired", 0))
+    if shed or expired:
+        from ray_tpu.util.state import list_cluster_events
+
+        print(f"\nOverload protection: {shed} shed (typed pushback), "
+              f"{expired} deadline-expired (doomed work dropped)")
+        layers: dict = {}
+        for etype in ("task.shed", "task.deadline_expired"):
+            try:
+                for e in list_cluster_events(etype=etype, limit=2000):
+                    layer = (e.get("data") or {}).get("layer", "?")
+                    layers.setdefault(etype, {}).setdefault(layer, 0)
+                    layers[etype][layer] += 1
+            except Exception:  # noqa: BLE001 — recent-window detail only
+                pass
+        for etype, counts in sorted(layers.items()):
+            detail = ", ".join(f"{k}={v}" for k, v in sorted(counts.items()))
+            print(f"  {etype:<24} recent: {detail}")
     return 0
 
 

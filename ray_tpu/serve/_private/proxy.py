@@ -43,6 +43,7 @@ import threading
 from typing import Any, Dict, Optional
 
 import ray_tpu
+from ray_tpu._private import deadlines as _deadlines
 
 logger = logging.getLogger(__name__)
 
@@ -68,6 +69,38 @@ def _close_generator(gen) -> None:
         logger.debug("generator close failed", exc_info=True)
 
 
+def _request_deadline(headers) -> Optional[float]:
+    """Map the client's patience onto a task deadline (ISSUE 9):
+    `X-Request-Deadline` carries an ABSOLUTE unix time,
+    `X-Request-Timeout-S` a relative budget in seconds. Work submitted
+    for the request inherits it (ambient submission deadline), so an
+    abandoned request stops consuming lease slots and decode steps at
+    the next queue-pop instead of running to completion into the void."""
+    raw = headers.get("X-Request-Deadline")
+    if raw:
+        try:
+            return float(raw)
+        except ValueError:
+            pass
+    raw = headers.get("X-Request-Timeout-S")
+    if raw:
+        try:
+            import time
+
+            return time.time() + float(raw)
+        except ValueError:
+            pass
+    return None
+
+
+def _retry_after_of(e: BaseException) -> Optional[str]:
+    for exc in (e, getattr(e, "cause", None)):
+        after = getattr(exc, "retry_after_s", None)
+        if isinstance(after, (int, float)):
+            return f"{max(0.0, after):.3f}"
+    return None
+
+
 def _http_status_of(e: BaseException) -> int:
     """Replica exceptions can carry an HTTP status (e.g. serve.llm's
     LLMOverloadedError.status_code = 429 for load shedding). Task errors
@@ -77,6 +110,8 @@ def _http_status_of(e: BaseException) -> int:
         status = getattr(exc, "status_code", None)
         if isinstance(status, int) and 400 <= status < 600:
             return status
+    if isinstance(e, (asyncio.TimeoutError, TimeoutError)):
+        return 504  # client budget ran out awaiting the reply
     return 500
 
 
@@ -102,7 +137,9 @@ class _StreamPump:
         self._make_iter = make_iter
         self._max = max_bytes
         self._low = max(1, max_bytes // 2)
-        self._q: "asyncio.Queue" = asyncio.Queue()
+        # byte-budgeted, not item-bounded: _enqueue suspends the feeder
+        # past max_bytes (the real bound for variable-size SSE frames)
+        self._q: "asyncio.Queue" = asyncio.Queue()  # raylint: disable=unbounded-queue
         self._queued_bytes = 0  # touched on the loop thread only
         self._space = threading.Event()  # feeder waits; loop thread sets
         self._space.set()
@@ -401,24 +438,73 @@ class ProxyActor:
             else:
                 arg = dict(request.query) if request.query else None
 
+            # client-declared patience: ambient submission deadline for
+            # every task submitted on behalf of this request
+            deadline = _request_deadline(request.headers)
+
             if flags.get("streaming"):
                 if llm_router is not None:
                     # per-shard serve.llm ingress: route + stream in the
                     # feeder thread, frames arrive pre-encoded from the
-                    # engine replica
-                    def make_iter(r=llm_router, a=arg):
-                        return r(a)
+                    # engine replica. LLMRouter.__call__ is a GENERATOR
+                    # function — calling it submits nothing — so the
+                    # ambient deadline must cover the ITERATION (where
+                    # the lazy routing + task submission actually run),
+                    # not just the call. The wrapping generator holds the
+                    # scope on the feeder thread for the stream's life
+                    # (the feeder is dedicated to this one stream).
+                    def make_iter(r=llm_router, a=arg, d=deadline):
+                        def _gen():
+                            with _deadlines.ambient_deadline(d):
+                                yield from r(a)
+                        return _gen()
                 else:
-                    def make_iter(h=stream_handle, a=arg):
-                        return iter(h.remote(a))
+                    def make_iter(h=stream_handle, a=arg, d=deadline):
+                        # h.remote submits EAGERLY: scoping the call is
+                        # enough to stamp the spec
+                        with _deadlines.ambient_deadline(d):
+                            return iter(h.remote(a))
 
                 return await self._stream(request, flags, make_iter)
 
+            timeout_s = 60.0
+            if deadline is not None:
+                import time as _time
+
+                remaining = deadline - _time.time()
+                if remaining <= 0:
+                    # refused before any work was submitted: typed shed
+                    return web.Response(
+                        status=504, headers={"X-Typed-Shed": "deadline"},
+                        text="request deadline already passed")
+                # grace beat past the task deadline: the worker's TYPED
+                # drop-at-pop reply (fired AT the deadline) must beat this
+                # await's own TimeoutError, or a cleanly-refused request
+                # would read as an untyped (accepted-then-lost) failure
+                timeout_s = min(timeout_s, remaining + 1.0)
             try:
-                response = await self._unary(handle, arg)
+                response = await self._unary(handle, arg,
+                                             timeout_s=timeout_s,
+                                             deadline=deadline)
             except Exception as e:  # noqa: BLE001 — surface as status
-                logger.exception("request failed")
-                return web.Response(status=_http_status_of(e),
+                status = _http_status_of(e)
+                if status >= 500 and status != 504:
+                    logger.exception("request failed")
+                headers = {}
+                retry_after = _retry_after_of(e)
+                if retry_after is not None:
+                    headers["Retry-After"] = retry_after
+                from ray_tpu.exceptions import DeadlineExceededError
+
+                if isinstance(e, DeadlineExceededError) or isinstance(
+                        getattr(e, "cause", None), DeadlineExceededError):
+                    # dropped at a queue-pop BEFORE execution started —
+                    # shed, not lost; clients (and the drill's accounting)
+                    # tell the two apart by this header. A bare
+                    # TimeoutError 504 (accepted work that stalled) gets
+                    # no header and counts as lost-accepted.
+                    headers["X-Typed-Shed"] = "deadline"
+                return web.Response(status=status, headers=headers,
                                     text=str(getattr(e, "cause", None) or e))
             if isinstance(response, bytes):
                 return web.Response(body=response)
@@ -461,7 +547,7 @@ class ProxyActor:
         loop.run_forever()
 
     async def _unary(self, handle, arg, timeout_s: float = 60.0,
-                     max_attempts: int = 3):
+                     max_attempts: int = 3, deadline: Optional[float] = None):
         """Unary request: non-blocking replica assignment + async reply
         await. Falls back to the blocking assign on an executor thread
         only when no replica is known yet (cold start / scale-from-0).
@@ -484,11 +570,17 @@ class ProxyActor:
             try:
                 # a KNOWN-dead replica raises at submit time (the router
                 # releases + evicts it); an in-flight death surfaces on
-                # the reply ref — both re-assign
-                resp = handle.try_remote(arg)
+                # the reply ref — both re-assign. The ambient deadline
+                # wraps SUBMISSION only: the spec is stamped there, and
+                # downstream queue-pops enforce it from then on.
+                with _deadlines.ambient_deadline(deadline):
+                    resp = handle.try_remote(arg)
                 if resp is None:
-                    resp = await loop.run_in_executor(
-                        None, lambda: handle.remote(arg))
+                    def _blocking_remote(h=handle, a=arg, d=deadline):
+                        with _deadlines.ambient_deadline(d):
+                            return h.remote(a)
+
+                    resp = await loop.run_in_executor(None, _blocking_remote)
                 return await self._await_ref(resp._ref, timeout_s)
             except RayActorError as e:
                 last_err = e
@@ -516,8 +608,12 @@ class ProxyActor:
         kind, first = await pump.get()
         if kind == "err":
             logger.warning("streaming request rejected: %s", first)
+            headers = {}
+            retry_after = _retry_after_of(first)
+            if retry_after is not None:
+                headers["Retry-After"] = retry_after
             return web.Response(
-                status=_http_status_of(first),
+                status=_http_status_of(first), headers=headers,
                 text=str(getattr(first, "cause", None) or first))
         stream = web.StreamResponse()
         if flags.get("sse"):

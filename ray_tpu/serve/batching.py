@@ -26,7 +26,9 @@ class _BatchQueue:
         self._handler = handler
         self._max = max_batch_size
         self._timeout = batch_wait_timeout_s
-        self._queue: "queue.Queue[tuple]" = queue.Queue()
+        # fed only by this replica's in-flight requests: bounded upstream
+        # by the deployment's max_ongoing_requests admission
+        self._queue: "queue.Queue[tuple]" = queue.Queue()  # raylint: disable=unbounded-queue
         self._thread = threading.Thread(
             target=self._loop, name="serve-batch", daemon=True)
         self._thread.start()

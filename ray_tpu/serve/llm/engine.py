@@ -78,7 +78,9 @@ class _Request:
         self.prompt = prompt
         self.max_new = max_new
         self.gen_override = gen_override
-        self.out: "queue.SimpleQueue" = queue.SimpleQueue()
+        # bounded by the request's own max_new token budget (one entry
+        # per generated token, consumer-drained)
+        self.out: "queue.SimpleQueue" = queue.SimpleQueue()  # raylint: disable=unbounded-queue
         self.enqueued_at = time.monotonic()
         self.first_at: Optional[float] = None
         self.last_at: Optional[float] = None
@@ -100,7 +102,9 @@ class LLMEngineReplica:
         self.default = GenerationConfig(**(default_config or {}))
         self._continuous = hasattr(self.engine, "serve_stream")
         self._max_queue_depth = max_queue_depth
-        self._queue: "queue.Queue[_Request]" = queue.Queue()
+        # bounded by max_queue_depth at submit (LLMOverloadedError 429
+        # past it) — the 429-shed half of the overload-protection story
+        self._queue: "queue.Queue[_Request]" = queue.Queue()  # raylint: disable=unbounded-queue
         self._requests: Dict[int, _Request] = {}
         self._lock = threading.Lock()
         self._cancels: set = set()

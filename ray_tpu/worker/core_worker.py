@@ -29,6 +29,8 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu import exceptions as exc
+from ray_tpu._private import backoff as _backoff
+from ray_tpu._private import deadlines as _deadlines
 from ray_tpu._private import event_log
 from ray_tpu._private import fault_injection as _fi
 from ray_tpu._private import serialization as ser
@@ -144,12 +146,19 @@ class _Lease:
 
 @dataclass
 class _KeyState:
-    pending: deque = field(default_factory=deque)
+    # owner-side submission queue; unbounded BY DESIGN: the bound lives
+    # downstream at the raylet lease queue, whose typed pushback paces
+    # this queue's drain (pacer below) instead of dropping user work
+    pending: deque = field(  # raylint: disable=unbounded-queue
+        default_factory=deque)
     leases: Dict[str, _Lease] = field(default_factory=dict)
     inflight_lease_requests: int = 0
     # EMA of per-task wall time for this scheduling key (None = no sample
     # yet). Drives push batching: only provably-short tasks batch.
     avg_task_s: Optional[float] = None
+    # AIMD resubmission pacing on typed raylet pushback (lease queue
+    # full): delay doubles per retry_later, shrinks additively per grant.
+    pacer: _backoff.AIMDPacer = field(default_factory=_backoff.AIMDPacer)
 
 
 @dataclass
@@ -158,7 +167,20 @@ class _ActorRecord:
     state: str = "PENDING"  # PENDING | ALIVE | RESTARTING | DEAD
     address: Optional[Address] = None
     seq: int = 0
-    queue: deque = field(default_factory=deque)  # TaskSpec waiting for address
+    # TaskSpec waiting for an address. Bounded by `outstanding` below
+    # (actor_mailbox_max, checked synchronously at submit) — the deque
+    # itself can't carry the bound because submits buffer before the loop
+    # drains them here.
+    queue: deque = field(  # raylint: disable=unbounded-queue
+        default_factory=deque)
+    # Calls accepted (submit_actor_task) and not yet finalized: THE
+    # mailbox bound counter, incremented on the user thread so a burst
+    # can't overrun the bound while the submit buffer drains. Guarded by
+    # `lock`: the increment (user thread) and decrement (loop thread,
+    # _finalize_task) are read-modify-writes — unsynchronized, a lost
+    # decrement would leak mailbox slots until an idle actor sheds.
+    outstanding: int = 0
+    lock: threading.Lock = field(default_factory=threading.Lock)
     inflight: int = 0
     death_cause: Optional[str] = None
     max_task_retries: int = 0
@@ -227,7 +249,9 @@ class CoreWorker:
         self._generators: Dict[TaskID, _GeneratorState] = {}
         self._key_states: Dict[tuple, _KeyState] = {}
         self._dep_waiters: Dict[ObjectID, List[_DepWait]] = {}
-        self._submit_buf: deque = deque()
+        # drained whole on every loop wakeup (_drain_submits): depth is
+        # bounded by one burst between wakeups, not accumulation
+        self._submit_buf: deque = deque()  # raylint: disable=unbounded-queue
         self._submit_scheduled = False
         self._submit_lock = threading.Lock()
         self._inflight_fetches: Dict[ObjectID, Any] = {}
@@ -597,6 +621,31 @@ class CoreWorker:
 
     def current_spec(self) -> Optional[TaskSpec]:
         return getattr(_task_ctx, "spec", None)
+
+    def _parent_deadline(self) -> Optional[float]:
+        """Deadline inheritance: a child task submitted from inside a
+        running task gets the parent's remaining budget (a child of
+        doomed work is doomed work)."""
+        spec = getattr(_task_ctx, "spec", None)
+        return getattr(spec, "deadline_s", None) if spec is not None else None
+
+    def _expire_spec(self, spec: TaskSpec, layer: str = "owner",
+                     record: bool = True) -> None:
+        """Doomed-work elimination at an owner-side queue pop: resolve the
+        task with a typed DeadlineExceededError instead of spending a
+        lease/push on work whose caller already gave up. `record=False`
+        when ANOTHER layer already emitted/counted the drop (the raylet's
+        _expired_reply) and this call only resolves the caller's refs —
+        double-recording would double every raylet-layer total."""
+        if record:
+            self._elog.emit("task.deadline_expired",
+                            task_id=spec.task_id.hex(),
+                            layer=layer, function=spec.function_name)
+            _backoff.count_deadline_expired(layer)
+        self._store_error_for_task(spec, exc.DeadlineExceededError(
+            f"deadline for task {spec.function_name} passed before "
+            f"dispatch", layer=layer, deadline=spec.deadline_s))
+        self._finalize_task(spec, "FAILED")
 
     # ------------------------------------------------------------------- KV
     def kv_get(self, key: bytes, namespace: Optional[str] = None) -> Optional[bytes]:
@@ -988,7 +1037,9 @@ class CoreWorker:
         # timeout marked it dead: reconstruction is the WRONG response to
         # a slow-but-alive primary.
         for rnd in range(3):
-            remaining = deque(i for i in range(n_chunks) if not done[i])
+            # bounded by the object's own chunk count
+            remaining = deque(  # raylint: disable=unbounded-queue
+                i for i in range(n_chunks) if not done[i])
             if not remaining:
                 break
             if deadline is not None and time.monotonic() > deadline:
@@ -1175,6 +1226,7 @@ class CoreWorker:
         runtime_env: Optional[dict] = None,
         runtime_env_prepared: bool = False,
         max_calls: int = 0,
+        deadline_s: Optional[float] = None,
     ):
         t_submit = time.monotonic()
         fid = function_id or self.register_function(fn)
@@ -1200,6 +1252,8 @@ class CoreWorker:
             max_calls=max_calls,
             scheduling_strategy=scheduling_strategy or SchedulingStrategySpec(),
             runtime_env=runtime_env,
+            deadline_s=_deadlines.effective_deadline(
+                deadline_s, self._parent_deadline()),
         )
         spec.kwarg_specs = kwarg_specs
         self._pending_tasks[task_id] = _PendingTask(
@@ -1354,11 +1408,20 @@ class CoreWorker:
             specs = []
             while len(specs) < n and st.pending:
                 spec = st.pending[0]
+                if _deadlines.expired(spec.deadline_s):
+                    # queue-pop doomed-work elimination: the caller's
+                    # budget ran out while this spec queued — resolve it
+                    # typed instead of spending the lease on it
+                    st.pending.popleft()
+                    self._expire_spec(spec)
+                    continue
                 if not self._batchable(spec):
                     if not specs:
                         specs.append(st.pending.popleft())  # ship alone
                     break
                 specs.append(st.pending.popleft())
+            if not specs:
+                continue
             lease.busy = True
             asyncio.ensure_future(self._push(key, lease, specs))
         # Request more leases if there is unassigned work.
@@ -1432,8 +1495,23 @@ class CoreWorker:
         target = await self._resolve_route(sample_spec)
         spillback = 0
         warned = 0.0
-        refused = blips = 0
+        refused = blips = rejects = 0
+        # retry delays come from the shared policy module (ISSUE 9): the
+        # constants match the old hand-rolled sleeps at attempt 1 and grow
+        # from there instead of hammering a struggling raylet at a fixed
+        # cadence.
+        refused_policy = _backoff.BackoffPolicy(
+            base_s=0.2, multiplier=1.5, max_s=2.0, jitter=0.2)
+        blip_policy = _backoff.BackoffPolicy(
+            base_s=0.1, multiplier=2.0, max_s=0.5)
+        reject_policy = _backoff.BackoffPolicy(
+            base_s=0.2, multiplier=1.2, max_s=1.0, jitter=0.1)
         while not self._shutdown:
+            while st.pending and _deadlines.expired(
+                    st.pending[0].deadline_s):
+                # queue-pop doomed-work elimination on the lease path: no
+                # point re-asking for work whose caller already gave up
+                self._expire_spec(st.pending.popleft())
             if not st.pending:
                 return
             if target is None:
@@ -1468,7 +1546,7 @@ class CoreWorker:
                         # still escalates below after ~5s.
                         refused += 1
                         self._peers.invalidate(target)
-                        await asyncio.sleep(0.2)
+                        await asyncio.sleep(refused_policy.delay(refused))
                         continue
                     if e.maybe_delivered and blips < 3:
                         # Connection reset with the request possibly in
@@ -1480,7 +1558,7 @@ class CoreWorker:
                         # refused on the retry and escalates above.
                         blips += 1
                         self._peers.invalidate(target)
-                        await asyncio.sleep(0.1)
+                        await asyncio.sleep(blip_policy.delay(blips))
                         continue
                 if target == self.raylet_address:
                     new_local = await self._refresh_local_raylet()
@@ -1495,12 +1573,43 @@ class CoreWorker:
                 target = reply["retry_at"]
                 spillback = 1
                 continue
+            if reply.get("deadline_expired"):
+                # the raylet dropped the spec at ITS queue pop: resolve the
+                # matching queued spec typed (it may no longer be the head)
+                expired_hex = reply.get("task_id")
+                for spec in list(st.pending):
+                    if spec.task_id.hex() == expired_hex:
+                        st.pending.remove(spec)
+                        # the raylet already emitted + counted this drop
+                        self._expire_spec(spec, layer="raylet",
+                                          record=False)
+                        break
+                continue
+            if reply.get("retry_later"):
+                # typed pushback from the bounded raylet lease queue: pace
+                # resubmission (AIMD — delay doubles per pushback, shrinks
+                # per grant) instead of hammering a full queue at a fixed
+                # cadence. The task stays queued owner-side; its deadline
+                # (checked at the top of this loop) bounds total waiting.
+                delay = st.pacer.on_pushback(reply.get("retry_after_s"))
+                now = time.monotonic()
+                if now - warned > 10:
+                    warned = now
+                    logger.warning(
+                        "lease queue pushback for %s (retry in %.2fs): %s",
+                        sample_spec.function_name, delay,
+                        reply.get("reason"))
+                await asyncio.sleep(delay)
+                target = await self._resolve_route(sample_spec)
+                spillback = 0
+                continue
             if reply.get("rejected"):
                 if reply.get("runtime_env_error"):
                     # permanent env misconfiguration — fail, don't retry
                     self._fail_queued(key, exc.RuntimeEnvSetupError(
                         reply["runtime_env_error"]))
                     return
+                rejects += 1
                 now = time.monotonic()
                 if now - warned > 10:
                     warned = now
@@ -1508,10 +1617,12 @@ class CoreWorker:
                         "lease request for %s rejected: %s (retrying)",
                         sample_spec.function_name, reply.get("reason"),
                     )
-                await asyncio.sleep(0.2)
+                await asyncio.sleep(reject_policy.delay(rejects))
                 target = await self._resolve_route(sample_spec)
                 spillback = 0
                 continue
+            rejects = 0
+            st.pacer.on_success()
             addr: Address = reply["worker_address"]
             st.leases[addr.rpc_address] = _Lease(address=addr, busy=False,
                                                 idle_since=time.monotonic())
@@ -1807,6 +1918,13 @@ class CoreWorker:
         if pending is not None:
             for oid in pending.arg_ids:
                 self.reference_counter.remove_submitted_task_ref(oid)
+            if (spec.task_type == TaskType.ACTOR_TASK
+                    and spec.actor_id is not None):
+                rec = self._actors.get(spec.actor_id)
+                if rec is not None:
+                    with rec.lock:  # mailbox slot freed
+                        if rec.outstanding > 0:
+                            rec.outstanding -= 1
         self._record_task_event(spec, state, stages)
 
     # ------------------------------------------------------- actor submission
@@ -1871,6 +1989,12 @@ class CoreWorker:
             reply = self._gcs.call(
                 "register_actor",
                 {"spec": spec, "get_if_exists": get_if_exists})
+            if reply["status"] == "retry_later":
+                # bounded GCS creation queue: typed pushback to the caller
+                raise exc.RetryLaterError(
+                    "GCS actor-creation queue is full",
+                    retry_after_s=reply.get("retry_after_s", 1.0),
+                    layer="gcs_actor_creation")
             if reply["status"] == "error":
                 raise ValueError(reply["message"])
             registered_id = reply["info"].actor_id
@@ -1879,32 +2003,59 @@ class CoreWorker:
             # GCS registration is async, core_worker.cc:2224): the
             # request is enqueued and .remote() returns immediately, so a
             # burst of N creations pays one round trip of latency, not N.
-            # A lost registration (GCS blip) retries once, then marks the
-            # local record DEAD so queued method calls fail with
-            # ActorDiedError instead of hanging.
-            def _register(attempt: int = 0):
+            # A lost registration (GCS blip) retries once; a retry_later
+            # pushback from the bounded creation queue re-registers with
+            # paced backoff (the creation-burst analogue of AIMD lease
+            # pacing). Both paths eventually mark the local record DEAD
+            # so queued method calls fail typed instead of hanging.
+            pushback_policy = _backoff.BackoffPolicy(
+                base_s=0.25, multiplier=2.0, max_s=5.0, jitter=0.25)
+
+            def _register(attempt: int = 0, pushbacks: int = 0):
                 fut = self._gcs.call_future(
                     "register_actor",
                     {"spec": spec, "get_if_exists": False})
 
+                def _mark_dead(aid, cause):
+                    dead = ActorInfo(
+                        actor_id=aid, state=ActorState.DEAD,
+                        death_cause=cause)
+                    asyncio.ensure_future(self._on_actor_event_async(dead))
+
                 def _on_reply(f, aid=actor_id):
                     err = f.exception()
                     if err is None:
+                        reply = f.result()
+                        if (isinstance(reply, dict)
+                                and reply.get("status") == "retry_later"):
+                            if pushbacks >= 6:
+                                logger.warning(
+                                    "actor %s shed by the GCS creation "
+                                    "queue %d times; giving up", aid,
+                                    pushbacks + 1)
+                                _mark_dead(
+                                    aid,
+                                    "GCS actor-creation queue stayed full"
+                                    " (typed RetryLaterError pushback)")
+                                return
+                            delay = max(
+                                reply.get("retry_after_s", 0.0),
+                                pushback_policy.delay(pushbacks + 1))
+                            self._gcs._lt.loop.call_later(
+                                delay, lambda: _register(
+                                    attempt, pushbacks + 1))
                         return
                     if attempt == 0:
                         logger.warning(
                             "actor %s registration failed (%s); retrying",
                             aid, err)
                         self._gcs._lt.loop.call_later(
-                            0.5, lambda: _register(1))
+                            0.5, lambda: _register(1, pushbacks))
                         return
                     logger.warning(
                         "actor %s registration failed permanently: %s",
                         aid, err)
-                    dead = ActorInfo(
-                        actor_id=aid, state=ActorState.DEAD,
-                        death_cause=f"actor registration failed: {err}")
-                    asyncio.ensure_future(self._on_actor_event_async(dead))
+                    _mark_dead(aid, f"actor registration failed: {err}")
 
                 fut.add_done_callback(_on_reply)
 
@@ -2084,7 +2235,7 @@ class CoreWorker:
 
     def submit_actor_task(
         self, actor_id: ActorID, method_name: str, args: tuple, kwargs: dict,
-        *, num_returns=1,
+        *, num_returns=1, deadline_s: Optional[float] = None,
     ):
         t_submit = time.monotonic()
         rec = self._actors.get(actor_id)
@@ -2116,6 +2267,21 @@ class CoreWorker:
             raise exc.ActorDiedError(
                 actor_id, error_message=f"Actor is dead: {rec.death_cause}"
             )
+        mailbox_max = CONFIG.actor_mailbox_max
+        if mailbox_max > 0 and rec.outstanding >= mailbox_max:
+            # Bounded owner-side mailbox: typed pushback at submit instead
+            # of parking an unbounded backlog behind a non-ALIVE (or
+            # slow-flushing) actor. The caller retries after the hint —
+            # shed, never lost.
+            self._elog.emit("task.shed", actor_id=actor_id.hex(),
+                            layer="actor_mailbox", reason="mailbox full",
+                            method=method_name)
+            _backoff.count_shed("actor_mailbox")
+            raise exc.RetryLaterError(
+                f"actor {actor_id.hex()[:12]} mailbox is full "
+                f"({rec.outstanding} outstanding calls)",
+                retry_after_s=_backoff.retry_after_hint(rec.outstanding),
+                layer="actor_mailbox")
         streaming = num_returns == "streaming" or num_returns == -1
         task_id = TaskID.for_actor_task(actor_id)
         arg_specs, kwarg_specs, arg_ids = self._build_args(args, kwargs)
@@ -2131,12 +2297,19 @@ class CoreWorker:
             owner_address=self.address,
             trace_parent=self.current_task_id().hex(),
             actor_id=actor_id,
+            deadline_s=_deadlines.effective_deadline(
+                deadline_s, self._parent_deadline()),
         )
         spec.kwarg_specs = kwarg_specs
         self._pending_tasks[task_id] = _PendingTask(
             spec=spec, retries_left=rec.max_task_retries, is_actor_task=True,
             arg_ids=arg_ids, t_submit=t_submit,
         )
+        # mailbox slot held from here until _finalize_task releases it
+        # (incremented on the user thread, AFTER every raise-able step,
+        # paired with the _pending_tasks entry the decrement keys off)
+        with rec.lock:
+            rec.outstanding += 1
         if streaming:
             # See submit_task: item oids are owned at report time, not here.
             self._generators[task_id] = _GeneratorState()
@@ -2227,6 +2400,23 @@ class CoreWorker:
         # leave a permanent gap the worker's gate waits 60s on for every
         # later call. Specs carried across an incarnation bump re-stamp
         # from the reset counter (the new worker's gate starts at 0).
+        #
+        # Queue-pop doomed-work elimination: expired specs that were
+        # never sequence-stamped are dropped HERE (before a number is
+        # burned); already-stamped requeues must still ride to the worker
+        # — it drops them at its own pop and advances the gate, so no
+        # permanent seq gap can form.
+        now = time.time()
+        alive = []
+        for spec in specs:
+            if (spec.sequence_number < 0
+                    and _deadlines.expired(spec.deadline_s, now)):
+                self._expire_spec(spec)
+                continue
+            alive.append(spec)
+        specs = alive
+        if not specs:
+            return
         for spec in specs:
             if (spec.sequence_number < 0
                     or getattr(spec, "_seq_incarnation", None)
@@ -2331,6 +2521,9 @@ class CoreWorker:
         is safe for ANY method and must not consume the at-most-once
         retry budget (bounded by undelivered_failures so a persistently
         refusing address still terminates)."""
+        peer = (rec.address.rpc_address if rec.address is not None
+                else rec.actor_id.hex())
+        budget = _backoff.default_retry_budget()
         retry_specs = []
         for spec, undelivered in failures:
             pending = self._pending_tasks.get(spec.task_id)
@@ -2340,7 +2533,15 @@ class CoreWorker:
                     retry_specs.append(spec)
                     continue
                 # persistent refusals: fall through to the budgeted path
-            if pending is not None and pending.retries_left > 0:
+            # At-most-once retries spend the (peer, method) token bucket
+            # BEFORE spending per-task retries_left: during a brownout
+            # every in-flight call fails at once, and N tasks x M retries
+            # of un-budgeted resubmission is the retry storm that turns a
+            # brownout into a blackout. An empty bucket fails fast with
+            # the underlying error (counted in
+            # ray_tpu_retry_budget_exhausted_total).
+            if (pending is not None and pending.retries_left > 0
+                    and budget.try_spend(peer, spec.method_name)):
                 pending.retries_left -= 1
                 retry_specs.append(spec)
             else:

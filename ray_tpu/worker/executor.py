@@ -25,12 +25,15 @@ import traceback
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Tuple
 
+from ray_tpu._private import backoff as _backoff
+from ray_tpu._private import deadlines as _deadlines
 from ray_tpu._private.config import CONFIG
 from ray_tpu._private.ids import ActorID, ObjectID, TaskID
 from ray_tpu._private import serialization as ser
 from ray_tpu._private.specs import Address, TaskArg, TaskSpec, TaskType
 from ray_tpu.exceptions import (
     AsyncioActorExit,
+    DeadlineExceededError,
     RayTaskError,
     TaskCancelledError,
 )
@@ -277,6 +280,27 @@ class Executor:
         return {"location": self.cw.address.rpc_address,
                 "plasma_node": plasma_node}
 
+    def _deadline_reply(self, spec: TaskSpec) -> dict:
+        """Queue-pop doomed-work elimination on the worker: the spec's
+        deadline passed while it waited for this thread (sequencing gate,
+        concurrency semaphore, pool backlog). The caller gets a typed
+        DeadlineExceededError; no ERROR-channel broadcast — an expired
+        deadline is the caller's own budget, not an application fault."""
+        self.cw._elog.emit(
+            "task.deadline_expired", task_id=spec.task_id.hex(),
+            layer="worker", function=spec.function_name)
+        _backoff.count_deadline_expired("worker")
+        err = DeadlineExceededError(
+            f"deadline for {spec.function_name} passed before execution "
+            "started", layer="worker", deadline=spec.deadline_s)
+        return {
+            "status": "error",
+            "error_str": str(err),
+            "is_application_error": True,
+            "error": ser.serialize(err),
+            "return_ids": spec.return_ids(),
+        }
+
     def _error_reply(self, spec: TaskSpec, exc: BaseException) -> dict:
         if isinstance(exc, RayTaskError):
             err = exc
@@ -313,6 +337,8 @@ class Executor:
                 "status": "cancelled",
                 "return_ids": spec.return_ids(),
             }
+        if _deadlines.expired(spec.deadline_s):
+            return self._deadline_reply(spec)
         token = self.cw.enter_task_context(spec)
         self._running_threads[spec.task_id] = threading.get_ident()
         limit = getattr(spec, "max_calls", 0)
@@ -504,6 +530,12 @@ class Executor:
             if self._actor_semaphore is not None:
                 self._actor_semaphore.acquire()
             try:
+                if _deadlines.expired(spec.deadline_s):
+                    # queue-pop drop AFTER the sequencing-gate/semaphore
+                    # wait (that wait IS the actor's dispatch queue); the
+                    # finally blocks still advance the gate, so a dropped
+                    # call can't wedge later sequence numbers
+                    return self._deadline_reply(spec)
                 args, kwargs = self._resolve_args(
                     spec.args, getattr(spec, "kwarg_specs", {}) or {}
                 )

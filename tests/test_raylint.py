@@ -491,6 +491,71 @@ def test_fsm_event_suppressible(tmp_path):
                  select=["fsm-transition-event"]) == []
 
 
+# ---------------------------------------------------------------- RTL007
+
+
+def test_unbounded_queue_positives(tmp_path):
+    _write(tmp_path, "ray_tpu/raylet/q.py", """
+        import asyncio
+        import queue
+        from collections import deque
+        from dataclasses import dataclass, field
+
+        mailbox = deque()
+        waiting = queue.Queue()
+        tokens = queue.SimpleQueue()
+        aq = asyncio.Queue()
+        zero_is_unlimited = deque(maxlen=0)
+
+        @dataclass
+        class Rec:
+            inbox: deque = field(default_factory=deque)
+    """)
+    diags = _lint(tmp_path, ["ray_tpu"], select=["unbounded-queue"])
+    assert _ids(diags) == ["RTL007"]
+    assert len(diags) == 6
+    assert any("cannot be bounded" in d.message for d in diags)
+    assert any("default_factory=deque" in d.message for d in diags)
+    assert any("0/None = no limit" in d.message for d in diags)
+
+
+def test_unbounded_queue_bounded_clean(tmp_path):
+    _write(tmp_path, "ray_tpu/serve/q.py", """
+        import asyncio
+        import queue
+        from collections import deque
+
+        ring = deque(maxlen=1000)
+        ring2 = deque([], 512)
+        bounded = queue.Queue(maxsize=64)
+        bounded2 = queue.Queue(64)
+        config_bound = deque(maxlen=get_bound())
+        aq = asyncio.Queue(maxsize=8)
+    """)
+    assert _lint(tmp_path, ["ray_tpu"], select=["unbounded-queue"]) == []
+
+
+def test_unbounded_queue_out_of_scope_clean(tmp_path):
+    # data/ and _private/ are out of the configured scope paths
+    _write(tmp_path, "ray_tpu/data/q.py", """
+        from collections import deque
+
+        buf = deque()
+    """)
+    assert _lint(tmp_path, ["ray_tpu"], select=["unbounded-queue"]) == []
+
+
+def test_unbounded_queue_suppressible_by_name_and_id(tmp_path):
+    _write(tmp_path, "ray_tpu/worker/q.py", """
+        from collections import deque
+
+        # bounded externally by the drain-per-wakeup contract
+        a = deque()  # raylint: disable=unbounded-queue
+        b = deque()  # raylint: disable=RTL007
+    """)
+    assert _lint(tmp_path, ["ray_tpu"], select=["unbounded-queue"]) == []
+
+
 # ----------------------------------------------------------- suppressions
 
 

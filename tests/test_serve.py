@@ -214,9 +214,16 @@ def test_http_proxy(serve_instance):
         def __call__(self, body):
             return {"got": body}
 
+    from ray_tpu._private.rpc import find_free_port
+
+    # ephemeral, never fixed: the proxy binds SO_REUSEPORT, so a stale
+    # listener from a killed earlier run on a fixed port would silently
+    # steal a share of connections (orphan-zygote hang)
+    port = find_free_port()
     serve.run(Api.bind(), name="http_app", route_prefix="/api",
-              http_port=18432)
-    r = requests.post("http://127.0.0.1:18432/api", json={"x": 1}, timeout=10)
+              http_port=port)
+    r = requests.post(f"http://127.0.0.1:{port}/api", json={"x": 1},
+                      timeout=10)
     assert r.status_code == 200
     assert r.json() == {"got": {"x": 1}}
 
@@ -278,7 +285,9 @@ def test_serve_benchmarks_produce_sane_numbers(ray_start_regular):
     ignore_reinit_error.)"""
     from ray_tpu.serve.benchmarks import run_serve_benchmarks
 
-    out = run_serve_benchmarks(n_requests=40, http_port=18437)
+    from ray_tpu._private.rpc import find_free_port
+
+    out = run_serve_benchmarks(n_requests=40, http_port=find_free_port())
     assert out["serve_handle"]["rps"] > 50
     assert out["serve_http"]["rps"] > 20
     assert out["serve_handle"]["p50_ms"] < 1000

@@ -8,6 +8,7 @@ import pytest
 
 import ray_tpu
 from ray_tpu import serve
+from ray_tpu._private.rpc import find_free_port
 
 pytestmark = pytest.mark.serve
 
@@ -45,8 +46,8 @@ def test_streaming_http_chunks(ray_start_regular, serve_shutdown):
             yield {"i": i}
 
     serve.run(gen.bind(), name="stream_app", route_prefix="/gen",
-              http_port=18111)
-    status, body = _http_get("http://127.0.0.1:18111/gen")
+              http_port=(port := find_free_port()))
+    status, body = _http_get(f"http://127.0.0.1:{port}/gen")
     assert status == 200
     lines = [json.loads(ln) for ln in body.decode().splitlines() if ln]
     assert lines == [{"i": 0}, {"i": 1}, {"i": 2}]
@@ -76,9 +77,9 @@ def test_asgi_ingress_minimal_app(ray_start_regular, serve_shutdown):
         pass
 
     serve.run(Api.bind(), name="asgi_app", route_prefix="/api",
-              http_port=18112)
+              http_port=(port := find_free_port()))
     req = urllib.request.Request(
-        "http://127.0.0.1:18112/api/hello?x=1", data=b"ping",
+        f"http://127.0.0.1:{port}/api/hello?x=1", data=b"ping",
         method="POST")
     with urllib.request.urlopen(req, timeout=30) as r:
         assert r.status == 201
@@ -104,7 +105,7 @@ def test_fastapi_ingress(ray_start_regular, serve_shutdown):
         pass
 
     serve.run(Api.bind(), name="fastapi_app", route_prefix="/f",
-              http_port=18113)
-    status, body = _http_get("http://127.0.0.1:18113/f/hello")
+              http_port=(port := find_free_port()))
+    status, body = _http_get(f"http://127.0.0.1:{port}/f/hello")
     assert status == 200
     assert json.loads(body) == {"msg": "hi"}

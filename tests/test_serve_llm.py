@@ -22,7 +22,13 @@ from ray_tpu.models import llama
 
 pytestmark = pytest.mark.serve
 
-HTTP_PORT = 18533
+# Ephemeral, never fixed: proxy shards bind with SO_REUSEPORT, so a
+# stale shard leaked by a timeout-killed earlier run on a FIXED port
+# would silently steal a share of every connection and hang this run's
+# first HTTP byte (the orphan-zygote class of failure).
+from ray_tpu._private.rpc import find_free_port
+
+HTTP_PORT = find_free_port()
 
 
 @pytest.fixture(scope="module")

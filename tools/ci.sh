@@ -4,7 +4,10 @@
 #   2. drill gate      — one bounded, seeded resilience drill; fails on an
 #                        SLO regression (MTTR/availability/request-loss
 #                        thresholds in ray_tpu/drills/thresholds.json)
-#   3. tier-1 tests    — the full `not slow` suite
+#   3. overload gate   — the overload_storm drill: >=3x offered load +
+#                        task flood; goodput floor, zero lost-accepted,
+#                        post-storm recovery (anti-metastable-collapse)
+#   4. tier-1 tests    — the full `not slow` suite
 #
 # Usage: tools/ci.sh [--skip-tests]
 set -euo pipefail
@@ -17,6 +20,11 @@ echo "== drill gate (bounded, seeded) =="
 JAX_PLATFORMS=cpu python -m ray_tpu drill run \
     --scenario replica_kill --budget 120s --seed 0 \
     --report "${TMPDIR:-/tmp}/ci_drill_report.json" --gate
+
+echo "== overload_storm drill gate =="
+JAX_PLATFORMS=cpu python -m ray_tpu drill run \
+    --scenario overload_storm --budget 120s --seed 0 \
+    --report "${TMPDIR:-/tmp}/ci_overload_report.json" --gate
 
 if [[ "${1:-}" != "--skip-tests" ]]; then
     echo "== tier-1 tests =="

@@ -7,4 +7,5 @@ from tools.raylint.checks import (  # noqa: F401
     rpc_surface,
     spec_serialization,
     swallowed_error,
+    unbounded_queue,
 )

@@ -1,0 +1,143 @@
+"""RTL007 unbounded-queue.
+
+Invariant (ISSUE 9, CONTRIBUTING "every queue names its bound"): a queue
+created in a control/data-plane path (gcs/, raylet/, worker/, serve/)
+must either carry an explicit bound at the creation site or a
+`# raylint: disable=unbounded-queue` suppression whose comment justifies
+where the bound actually lives (an external counter, a drain-per-wakeup
+contract, a byte budget). Unbounded queues are how overload turns into
+metastable collapse: the raylet lease queue, the GCS creation queue and
+the actor mailbox each accepted work without limit until this PR — under
+a storm they grew without shedding, latency exploded, every caller
+retried, and the backlog outlived the storm.
+
+Flags:
+* `deque(...)` without a `maxlen` (kwarg or 2nd positional),
+* `queue.Queue/LifoQueue/PriorityQueue(...)` without a `maxsize`
+  (kwarg or 1st positional),
+* `queue.SimpleQueue()` — cannot be bounded, always needs justification,
+* `asyncio.Queue(...)` without a `maxsize`,
+* `field(default_factory=deque)` — the bare-mailbox pattern: the bound
+  can't live at the creation site, so the site must name (via the
+  suppression comment) the counter that enforces it.
+
+Zero-valued bounds (`maxlen=0`, `maxsize=0`) count as unbounded — they
+are Python's own "no limit" spelling.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Tuple
+
+from tools.raylint.core import (
+    Check,
+    Diagnostic,
+    Project,
+    dotted_name,
+    register_check,
+)
+
+DEFAULT_SCOPE_PATHS = [
+    "ray_tpu/gcs/",
+    "ray_tpu/raylet/",
+    "ray_tpu/worker/",
+    "ray_tpu/serve/",
+]
+
+# leaf callable name -> (bound kwarg, positional index of the bound)
+_BOUNDED_TYPES = {
+    "deque": ("maxlen", 1),
+    "Queue": ("maxsize", 0),
+    "LifoQueue": ("maxsize", 0),
+    "PriorityQueue": ("maxsize", 0),
+}
+_NEVER_BOUNDED = {"SimpleQueue"}
+
+
+def _is_nonzero_const(node: ast.AST) -> bool:
+    """A literal 0/None bound is Python's 'unlimited'; any other
+    expression (a constant, a config read, a parameter) names a bound."""
+    if isinstance(node, ast.Constant):
+        return node.value not in (0, None)
+    return True
+
+
+class _Hit:
+    __slots__ = ("node", "what")
+
+    def __init__(self, node: ast.Call, what: str):
+        self.node = node
+        self.what = what
+
+
+def _queue_hit(node: ast.Call) -> Optional[str]:
+    target = dotted_name(node.func)
+    if target is None:
+        return None
+    leaf = target.rsplit(".", 1)[-1]
+    if leaf in _NEVER_BOUNDED:
+        return (f"{target}() cannot be bounded — justify the external "
+                "bound in a disable comment")
+    spec = _BOUNDED_TYPES.get(leaf)
+    if spec is None:
+        return None
+    kwarg, pos = spec
+    for kw in node.keywords:
+        if kw.arg == kwarg:
+            if _is_nonzero_const(kw.value):
+                return None
+            return (f"{target}({kwarg}={ast.unparse(kw.value)}) is "
+                    "unbounded (0/None = no limit)")
+    if len(node.args) > pos and _is_nonzero_const(node.args[pos]):
+        return None
+    return f"{target}() without an explicit {kwarg}="
+
+
+def _default_factory_hit(node: ast.Call) -> Optional[str]:
+    """field(default_factory=deque): the mailbox pattern — a deque born
+    unbounded inside a dataclass field."""
+    target = dotted_name(node.func)
+    if target is None or target.rsplit(".", 1)[-1] != "field":
+        return None
+    for kw in node.keywords:
+        if kw.arg != "default_factory":
+            continue
+        factory = dotted_name(kw.value)
+        if factory is not None and factory.rsplit(".", 1)[-1] == "deque":
+            return ("field(default_factory=deque) creates an unbounded "
+                    "mailbox — name the counter that bounds it in a "
+                    "disable comment, or bound it at fill sites")
+    return None
+
+
+@register_check
+class UnboundedQueueCheck(Check):
+    name = "unbounded-queue"
+    check_id = "RTL007"
+    description = ("queue/deque created without an explicit bound in a "
+                   "gcs/raylet/worker/serve path (every queue names its "
+                   "bound — unbounded queues are the metastable-collapse "
+                   "ingredient)")
+
+    def __init__(self, options: dict):
+        super().__init__(options)
+        self.scope_paths = tuple(options.get(
+            "scope-paths", DEFAULT_SCOPE_PATHS))
+
+    def run(self, project: Project) -> Iterable[Diagnostic]:
+        for mod in project.target_modules():
+            if not any(mod.relpath.startswith(p) for p in self.scope_paths):
+                continue
+            for node in mod.nodes():
+                if not isinstance(node, ast.Call):
+                    continue
+                msg = _queue_hit(node) or _default_factory_hit(node)
+                if msg is None:
+                    continue
+                yield Diagnostic(
+                    self.check_id, self.name, mod.relpath,
+                    node.lineno, node.col_offset,
+                    f"{msg}; every queue names its bound — pass one, or "
+                    "suppress with `# raylint: disable=unbounded-queue` "
+                    "and say where the bound lives")

@@ -324,7 +324,10 @@ def run_lint(root: str, paths: Iterable[str],
             mod = project.module(d.path)
             if mod is not None and not mod.is_target:
                 continue
-            if mod is not None and mod.is_suppressed(d.check_name, d.line):
+            # suppressible by name (unbounded-queue) or stable id (RTL007)
+            if mod is not None and (
+                    mod.is_suppressed(d.check_name, d.line)
+                    or mod.is_suppressed(d.check_id, d.line)):
                 continue
             diags.append(d)
     diags.sort(key=lambda d: (d.path, d.line, d.col, d.check_id))
