@@ -214,6 +214,29 @@ _d("gcs_external_store_down_after_s", 20.0)     # unreachable window before on_d
 _d("log_dir", "/tmp/rt_session/logs")
 _d("log_to_driver", True)
 
+# --- distributed request tracing (_private/tracing.py) -----------------------
+# Head sampling: probability a ROOT trace context is minted for a task
+# submission with no ambient context. 0.0 (default) = plain task
+# submission does no tracing work at all (one thread-local read + one
+# config read); serve requests still carry a context (the proxy always
+# generates one for response attribution) but it is unsampled unless the
+# client's traceparent sets the sampled flag — tail-based force-keep
+# (errors, deadline drops, sheds, latency p99 breaches) promotes the
+# interesting ones anyway.
+_d("trace_sample_rate", 0.0)
+_d("trace_max_pending", 20_000)        # unflushed span bound (overflow = drop)
+_d("trace_flush_interval_s", 1.0)      # span flusher batch window
+_d("trace_store_max_spans", 200_000)   # GCS durable span store bound
+_d("trace_provisional_max_spans", 50_000)  # GCS undecided (unsampled) ring
+_d("trace_profile_max_spans", 100_000)  # GCS profile-span ring (timeline)
+# per-stream cap on engine decode-chunk / generator item spans (the tail
+# of a long stream adds no shape information, only volume)
+_d("trace_max_stream_spans", 64)
+# force-keep a trace whose end-to-end task latency exceeds this many
+# seconds (0 = p99-relative only: a stage breaching ~p99 of the recent
+# window force-keeps, computed on the latency drainer thread)
+_d("trace_force_slow_s", 0.0)
+
 # --- event log / flight recorder (_private/event_log.py) ---------------------
 _d("event_log_max_events", 4096)        # per-process post-mortem ring size
 _d("event_log_max_pending", 20_000)     # unflushed-queue bound (overflow = drop)

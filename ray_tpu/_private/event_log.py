@@ -117,9 +117,13 @@ EVENT_SCHEMAS: Dict[str, Tuple[str, ...]] = {
     "chaos.plan": ("op", "seed", "rules"),
     # flight recorder bookkeeping
     "flight.dump": ("reason",),
+    # distributed request tracing (ISSUE 11): a trace was force-kept by a
+    # tail trigger (error / deadline_expired / shed / latency p99 breach)
+    # — the GCS span store promotes its provisional spans on this mark
+    "trace.force": ("reason",),
 }
 
-_ID_KEYS = ("task_id", "actor_id", "node_id", "object_id")
+_ID_KEYS = ("task_id", "actor_id", "node_id", "object_id", "trace_id")
 
 # ------------------------------------------------------------ module state
 
@@ -209,9 +213,11 @@ class EventLogger:
 
     def emit(self, etype: str, *, task_id: Optional[str] = None,
              actor_id: Optional[str] = None, node_id: Optional[str] = None,
-             object_id: Optional[str] = None, **data) -> None:
+             object_id: Optional[str] = None,
+             trace_id: Optional[str] = None, **data) -> None:
         emit(etype, proc=self.proc, task_id=task_id, actor_id=actor_id,
-             node_id=node_id, object_id=object_id, **data)
+             node_id=node_id, object_id=object_id, trace_id=trace_id,
+             **data)
 
 
 def logger_for(kind: str, ident: Optional[str] = None) -> EventLogger:
@@ -221,7 +227,7 @@ def logger_for(kind: str, ident: Optional[str] = None) -> EventLogger:
 def emit(etype: str, *, proc: Optional[str] = None,
          task_id: Optional[str] = None, actor_id: Optional[str] = None,
          node_id: Optional[str] = None, object_id: Optional[str] = None,
-         **data) -> None:
+         trace_id: Optional[str] = None, **data) -> None:
     """Record one lifecycle event. Cheap and non-blocking by contract:
     callable from any thread, including event-loop threads and code
     holding component locks."""
@@ -242,6 +248,10 @@ def emit(etype: str, *, proc: Optional[str] = None,
         "actor_id": actor_id,
         "node_id": node_id,
         "object_id": object_id,
+        # trace-context cross-reference (ISSUE 11): lets `ray-tpu trace`
+        # pull the lifecycle decisions for a trace and postmortem filter
+        # a timeline down to one request
+        "trace_id": trace_id,
         "data": data,
     }
     cfg = _config()
@@ -589,9 +599,12 @@ def merge_timeline(*event_lists: List[dict]) -> List[dict]:
 
 def postmortem_timeline(flight_dir_path: Optional[str] = None,
                         cluster_events: Optional[List[dict]] = None,
-                        task_id: Optional[str] = None) -> List[dict]:
+                        task_id: Optional[str] = None,
+                        trace_id: Optional[str] = None) -> List[dict]:
     """The `ray-tpu debug postmortem` core: flight dumps + (optionally) a
-    GCS cluster-event query merged into one ordered timeline."""
+    GCS cluster-event query merged into one ordered timeline. `trace_id`
+    narrows the timeline to one distributed request (the other half of
+    the trace<->event cross-reference; `ray-tpu trace` links back)."""
     dumps = load_flight_dumps(flight_dir_path)
     streams = [d.get("events") or [] for d in dumps]
     if cluster_events:
@@ -599,6 +612,8 @@ def postmortem_timeline(flight_dir_path: Optional[str] = None,
     merged = merge_timeline(*streams)
     if task_id:
         merged = [e for e in merged if e.get("task_id") == task_id]
+    if trace_id:
+        merged = [e for e in merged if e.get("trace_id") == trace_id]
     return merged
 
 

@@ -114,15 +114,18 @@ def owner_breakdown(
 
 
 def record_breakdown(task_id_hex: str, name: str, task_type: str,
-                     stages: Dict[str, float]) -> None:
+                     stages: Dict[str, float],
+                     trace_id: Optional[str] = None) -> None:
     """Queue one task's breakdown for recording. Runs on the owner's RPC
     reply loop, so it must stay O(1): the histogram observes and trace
     span formatting happen on the drainer thread (readers drain inline
     first, so `recent()`/metrics stay consistent at read time). NO
     thread creation here — spawning a thread from the reply loop stalls
     it for tens of ms on gVisor-class kernels, which is exactly the tail
-    this deferral removes (CoreWorker.__init__ calls start_drainer)."""
-    _pending_raw.append((task_id_hex, name, task_type, stages))
+    this deferral removes (CoreWorker.__init__ calls start_drainer).
+    `trace_id` stamps the breakdown for trace<->latency cross-reference
+    (ISSUE 11) and arms the p99-breach tail-keep check on the drainer."""
+    _pending_raw.append((task_id_hex, name, task_type, stages, trace_id))
     _drain_wake.set()
 
 
@@ -159,7 +162,8 @@ def drain_pending() -> None:
 
 
 def _record_one(task_id_hex: str, name: str, task_type: str,
-                stages: Dict[str, float]) -> None:
+                stages: Dict[str, float],
+                trace_id: Optional[str] = None) -> None:
     stage_hist, total_hist = _metrics()
     total = 0.0
     for stage in STAGES:
@@ -176,21 +180,60 @@ def _record_one(task_id_hex: str, name: str, task_type: str,
         "type": task_type,
         "time": now,
         "total": total,
+        "trace_id": trace_id,
         "stages": {s: stages.get(s, 0.0) for s in STAGES},
     }
     with _lock:
         _recent.append(entry)
+    # every task feeds the p99 window; only traced ones can breach it
+    _check_tail_keep(trace_id, stages, total)
     # Stage-segmented spans into the local chrome-trace buffer: the six
     # stages laid out back-to-back, ending at the reply-processed instant.
-    from ray_tpu.util.tracing.tracing_helper import record_event
+    # Local-only (ship=False): cluster-wide consumers already get the
+    # stages inside the terminal task event; shipping six more spans per
+    # task would tax the flusher for data the GCS already holds.
+    from ray_tpu._private.tracing import record_profile_span
 
     t = now - total
     for stage in STAGES:
         dur = stages.get(stage, 0.0) or 0.0
-        record_event(f"{name}:{stage}", t, t + dur,
-                     attributes={"task_id": task_id_hex, "stage": stage},
-                     thread="task-stages")
+        record_profile_span(f"{name}:{stage}", t, t + dur,
+                            attrs={"task_id": task_id_hex, "stage": stage,
+                                   "trace_id": trace_id},
+                            thread="task-stages", ship=False)
         t += dur
+
+
+# Tail-based force-keep on latency: per-stage reservoirs of the recent
+# window; a traced task whose stage lands past ~p99 of that window (or
+# whose total exceeds trace_force_slow_s) promotes its trace. Runs on the
+# drainer thread only — never the reply loop.
+_stage_window: Dict[str, deque] = {s: deque(maxlen=512) for s in STAGES}
+_P99_MIN_SAMPLES = 64
+
+
+def _check_tail_keep(trace_id: Optional[str], stages: Dict[str, float],
+                     total: float) -> None:
+    from ray_tpu._private.config import CONFIG
+    from ray_tpu._private.tracing import force_trace
+
+    slow_s = CONFIG.trace_force_slow_s
+    if trace_id is not None and slow_s > 0 and total >= slow_s:
+        force_trace(trace_id, f"latency_slow:{total:.3f}s")
+    breached = None
+    for stage in STAGES:
+        dur = stages.get(stage, 0.0) or 0.0
+        window = _stage_window[stage]
+        if (trace_id is not None and breached is None
+                and len(window) >= _P99_MIN_SAMPLES):
+            p99 = _quantile(list(window), 0.99)
+            # require real signal: microsecond jitter over a fast stage
+            # must not force-keep half the traffic
+            if dur > p99 and dur > 0.005:
+                breached = stage
+        window.append(dur)
+    if breached is not None:
+        force_trace(trace_id, f"latency_p99_breach:{breached}")
 
 
 def recent(n: int = 100) -> List[Dict[str, Any]]:

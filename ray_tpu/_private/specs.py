@@ -157,6 +157,15 @@ class TaskSpec:
     # tasks leave this None: pooled workers amortize one fetch per
     # function across many tasks.
     function_blob: Optional[bytes] = None
+    # Distributed trace context (ISSUE 11): the flat wire tuple of
+    # _private/tracing.TraceContext — (trace_id, span_id, parent_span_id,
+    # sampled). span_id is THIS task's own span; children submitted from
+    # inside the task parent at it (tracing.current_trace falls back to
+    # the executing spec). None = untraced, and every tracing touchpoint
+    # is a single `is None` check (the ISSUE 3 zero-cost-uninstalled
+    # bar). Requeued/retried specs keep their context — a requeued actor
+    # push is the SAME request, so re-stamping would orphan its spans.
+    trace_ctx: Optional[tuple] = None
     # Absolute wall-clock deadline (time.time() domain); None = no bound.
     # Set from .options(deadline_s=), the ambient submission deadline
     # (serve's X-Request-Deadline header), or inherited child-from-parent
@@ -333,6 +342,8 @@ def spec_to_wire(sp: TaskSpec) -> tuple:
         # deadline rides as REMAINING seconds (absolute instants don't
         # survive clock skew between hosts; spec_from_wire re-anchors)
         None if sp.deadline_s is None else sp.deadline_s - time.time(),
+        # trace context: already a flat tuple of scalars (tracing.py)
+        sp.trace_ctx,
     )
 
 
@@ -368,6 +379,8 @@ def spec_from_wire(t: tuple) -> TaskSpec:
         sp.trace_parent = t[24]
     if len(t) > 25:
         sp.deadline_s = None if t[25] is None else time.time() + t[25]
+    if len(t) > 26:
+        sp.trace_ctx = t[26]
     return sp
 
 

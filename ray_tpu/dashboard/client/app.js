@@ -201,6 +201,57 @@ window.downloadTrace = () => {
   return false;
 };
 
+async function pageTraces() {
+  // distributed-request trace lookup (/api/trace): recent sampled or
+  // force-kept traces, plus lookup by the X-Trace-Id a response carried
+  const hash = location.hash.slice(1);
+  const traceId = hash.startsWith("traces-") ? hash.slice(7) : null;
+  const lookup = `<form onsubmit="location.hash =
+      'traces-' + this.tid.value.trim(); return false">
+    <input name="tid" class="mono" size="36"
+      placeholder="trace id (X-Trace-Id header)"
+      value="${esc(traceId || "")}">
+    <button>look up</button></form>`;
+  if (traceId) {
+    const t = await getJSON(
+      `/api/trace?trace_id=${encodeURIComponent(traceId)}`);
+    const spans = t.spans || [];
+    if (!spans.length) {
+      return `<h2>Trace</h2>${lookup}
+        <p class="muted">no spans stored for
+        <span class="mono">${esc(traceId)}</span> (unsampled traces age
+        out unless force-kept).</p>`;
+    }
+    const forced = t.forced
+      ? `<p>force-kept: <span class="status warn">
+          ${esc(t.forced_reason)}</span></p>` : "";
+    const events = (t.events || []).map((e) =>
+      `<tr>${td(new Date(e.time * 1000).toLocaleTimeString())}
+       ${td(esc(e.proc))}${td(esc(e.type), "mono")}</tr>`).join("");
+    return `<h2>Trace <span class="mono">${esc(traceId)}</span></h2>
+      ${lookup}${forced}
+      <pre class="mono">${esc(t.tree)}</pre>
+      ${events ? `<h3>lifecycle events</h3>
+        <table><tr><th>time</th><th>proc</th><th>type</th></tr>
+        ${events}</table>` : ""}`;
+  }
+  const data = await getJSON("/api/trace");
+  const rows = (data.traces || []).map((t) => [
+    td(`<a href="#traces-${esc(t.trace_id)}" class="mono">
+        ${esc(t.trace_id)}</a>`),
+    td(new Date(t.start * 1000).toLocaleTimeString()),
+    td((t.duration_s * 1e3).toFixed(2) + " ms"),
+    td(t.spans), td((t.procs || []).length),
+    td(esc(t.root || "")),
+    t.forced_reason ? statusCell(t.forced_reason) : td("-"),
+  ]);
+  return `<h2>Traces
+      <span class="muted">(sampled or force-kept)</span></h2>
+    ${lookup}
+    ${table(["trace id", "start", "duration", "spans", "procs", "root",
+             "force-kept"], rows)}`;
+}
+
 function fmtRes(r) {
   return Object.entries(r || {}).sort()
     .map(([k, v]) => `${k}:${(+v).toFixed(1)}`).join(" ") || "-";
@@ -439,6 +490,7 @@ const PAGES = {
   overview: pageOverview, nodes: pageNodes, actors: pageActors,
   tasks: pageTasks, jobs: pageJobs, pgs: pagePGs, serve: pageServe,
   logs: pageLogs, timeline: pageTimeline, metrics: pageMetrics,
+  traces: pageTraces,
 };
 let timer = null;
 
@@ -446,10 +498,13 @@ async function render() {
   const page = (location.hash || "#overview").slice(1);
   const fn = page.startsWith("node-")
     ? () => pageNode(page.slice(5))
-    : PAGES[page] || pageOverview;
+    : page.startsWith("traces-")
+      ? pageTraces
+      : PAGES[page] || pageOverview;
   document.querySelectorAll("#nav a").forEach((a) =>
     a.classList.toggle("active", a.hash === `#${page}` ||
-      (a.hash === "#nodes" && page.startsWith("node-"))));
+      (a.hash === "#nodes" && page.startsWith("node-")) ||
+      (a.hash === "#traces" && page.startsWith("traces-"))));
   try {
     const html = await fn();
     // jobs page holds form state + log/profile panes: skip auto-rerender

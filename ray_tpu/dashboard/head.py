@@ -233,6 +233,35 @@ class DashboardHead:
                 "get_task_events", {"job_id": None, "limit": 100_000},
                 timeout=30)
             self._json(req, build_chrome_trace(events))
+        elif path == "/api/trace":
+            # distributed-request trace lookup (ISSUE 11): ?trace_id=<id>
+            # returns the cross-process span set + a rendered tree +
+            # the lifecycle events stamped with the id; without trace_id,
+            # recent sampled/force-kept trace summaries (the SPA's Trace
+            # page and curl both consume this)
+            from urllib.parse import parse_qs, urlparse
+
+            from ray_tpu._private.tracing import format_trace, trace_chrome
+
+            q = parse_qs(urlparse(req.path).query)
+            trace_id = q.get("trace_id", [None])[0]
+            if not trace_id:
+                self._json(req, {
+                    "traces": self._gcs.call(
+                        "list_traces",
+                        {"limit": int(q.get("limit", ["50"])[0])},
+                        timeout=30)})
+            else:
+                reply = self._gcs.call(
+                    "get_trace", {"trace_id": trace_id}, timeout=30)
+                spans = reply.get("spans") or []
+                reply["tree"] = format_trace(spans) if spans else ""
+                if q.get("chrome", [None])[0]:
+                    reply["chrome"] = trace_chrome(spans)
+                reply["events"] = self._gcs.call(
+                    "get_cluster_events",
+                    {"limit": 1000, "trace_id": trace_id}, timeout=30)
+                self._json(req, reply)
         elif path == "/api/events":
             # cluster-wide lifecycle event feed (same filters as the
             # `ray-tpu events` CLI: type glob + id exact-matches)

@@ -14,9 +14,11 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import Dict, List, Optional, Tuple
 
 from ray_tpu._private import backoff as _backoff
+from ray_tpu._private import tracing as _tracing
 from ray_tpu._private.config import CONFIG
 from ray_tpu._private.ids import ActorID, NodeID
 from ray_tpu._private.rpc import ClientPool, ConnectionLost
@@ -46,6 +48,10 @@ class GcsActorManager:
         # Actors awaiting their FIRST creation (the bounded registration
         # queue; restarts bypass it — they already hold capacity budget).
         self._pending_creation: set = set()
+        # traced creations: registration wall time, closed into a
+        # gcs.actor_admission span when the worker reports ALIVE (popped
+        # there / on DEAD — only traced creations ever enter)
+        self._register_wall: Dict[ActorID, float] = {}
         # (namespace, name) -> actor_id
         self._named: Dict[Tuple[str, str], ActorID] = {}
         # node_id -> set of actor ids placed there
@@ -123,11 +129,14 @@ class GcsActorManager:
             bound = CONFIG.gcs_actor_creation_queue_max
             pending = len(self._pending_creation)
             if bound > 0 and pending >= bound:
+                trace_id = _tracing.trace_id_of(spec)
                 _elog.emit("task.shed", actor_id=creation.actor_id.hex(),
+                           trace_id=trace_id,
                            layer="gcs_actor_creation",
                            reason="creation queue full",
                            class_name=spec.function_name)
                 _backoff.count_shed("gcs_actor_creation")
+                _tracing.force_trace(trace_id, "task.shed:gcs_actor_creation")
                 return {
                     "status": "retry_later",
                     # creations are heavier than leases: 2ms/item, 10s cap
@@ -161,6 +170,9 @@ class GcsActorManager:
             self._creation_specs[creation.actor_id] = spec
             self._pending_creation.add(creation.actor_id)
             self._persist(creation.actor_id)
+        if getattr(spec, "trace_ctx", None) is not None:
+            # admission-span anchor: report_actor_alive closes it
+            self._register_wall[creation.actor_id] = time.time()
         _elog.emit("actor.pending", actor_id=creation.actor_id.hex(),
                    class_name=spec.function_name, name=name)
         asyncio.ensure_future(self._schedule_actor(creation.actor_id))
@@ -223,6 +235,19 @@ class GcsActorManager:
         self._by_node.setdefault(address.node_id, set()).add(actor_id)
         self._persist(actor_id)
         self._pub.publish(ps.ACTOR_CHANNEL, actor_id, info)
+        registered_at = self._register_wall.pop(actor_id, None)
+        if registered_at is not None:
+            spec = self._creation_specs.get(actor_id)
+            ctx = getattr(spec, "trace_ctx", None) if spec is not None \
+                else None
+            if ctx is not None:
+                # GCS-side admission span of a traced actor creation:
+                # register -> ALIVE (scheduling + lease + __init__)
+                _tracing.record_span(
+                    "gcs.actor_admission", ctx, registered_at, time.time(),
+                    proc="gcs",
+                    attrs={"actor_id": actor_id.hex(),
+                           "restarts": info.num_restarts})
         _elog.emit("actor.alive", actor_id=actor_id.hex(),
                    node_id=(address.node_id.hex()
                             if address.node_id else None),
@@ -286,6 +311,7 @@ class GcsActorManager:
         info.state = ActorState.DEAD
         info.death_cause = reason
         self._pending_creation.discard(actor_id)
+        self._register_wall.pop(actor_id, None)
         if info.address is not None:
             self._by_node.get(info.address.node_id, set()).discard(actor_id)
             info.address = None

@@ -22,11 +22,12 @@ import asyncio
 import logging
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from fnmatch import fnmatchcase
 from typing import Dict, List, Optional
 
 from ray_tpu._private import event_log
+from ray_tpu._private import tracing as _tracing
 from ray_tpu._private.config import CONFIG
 from ray_tpu._private.ids import JobID, NodeID
 from ray_tpu._private.rpc import (ClientPool, ConnectionLost,
@@ -568,7 +569,8 @@ class GcsEventManager:
         type_glob = payload.get("type")
         since = payload.get("since")
         id_filters = [(k, payload[k]) for k in
-                      ("task_id", "actor_id", "node_id", "object_id")
+                      ("task_id", "actor_id", "node_id", "object_id",
+                       "trace_id")
                       if payload.get(k)]
         out = []
         stale_run = 0
@@ -627,6 +629,199 @@ class GcsEventManager:
             }
 
 
+class GcsSpanManager:
+    """Cluster-wide span store for distributed request tracing (ISSUE 11)
+    — the tracing sibling of GcsEventManager, fed by every process's
+    _private/tracing span flusher.
+
+    Two tiers implement tail-based sampling at the collector:
+
+    * durable store — spans of head-SAMPLED traces, and of traces that
+      were FORCE-kept (error / deadline expired / shed / latency p99
+      breach anywhere in the cluster);
+    * provisional ring — spans of unsampled traces, held in arrival
+      order until a force marker promotes their trace or they age out of
+      the bounded ring. `ray-tpu trace <id>` reads both, so a just-served
+      request is inspectable even at sample rate 0 while storage stays
+      bounded.
+
+    Profile spans (util.tracing trace_span — no trace id) land in their
+    own ring feeding the cluster-wide `ray-tpu timeline`.
+
+    Thread-safe: the embedded head's direct sink appends from the span-
+    flusher thread while handlers read on the gcs-io loop.
+    """
+
+    def __init__(self, max_spans: Optional[int] = None,
+                 provisional_max: Optional[int] = None,
+                 profile_max: Optional[int] = None):
+        # Both tiers are trace-id-INDEXED (OrderedDict of trace_id ->
+        # span list, oldest trace first), bounded by TOTAL span count
+        # with whole-trace eviction. The index keeps every store
+        # operation O(one trace): promotion is a dict pop, get_trace a
+        # dict read, eviction pops oldest traces — a flat deque made all
+        # three O(store-size) Python scans on the gcs-io loop / under
+        # the ingestion lock, which stalled every GCS RPC and every span
+        # flusher once the store neared its 250k-span capacity.
+        self._max_spans = max_spans or CONFIG.trace_store_max_spans
+        self._provisional_max = (provisional_max
+                                 or CONFIG.trace_provisional_max_spans)
+        self._spans: "OrderedDict[str, List[dict]]" = OrderedDict()
+        self._span_count = 0
+        self._provisional: "OrderedDict[str, List[dict]]" = OrderedDict()
+        self._provisional_count = 0
+        self._profile = deque(maxlen=profile_max
+                              or CONFIG.trace_profile_max_spans)
+        self._forced: "OrderedDict[str, str]" = OrderedDict()
+        self._lock = threading.Lock()
+        self._sources: Dict[int, dict] = {}
+        self._received = 0
+
+    def add_local(self, spans: List[dict], forced: Optional[list],
+                  stats: Optional[dict]) -> None:
+        """Direct sink for an in-process tracing buffer (embedded head):
+        same path the RPC handler takes, minus the wire."""
+        with self._lock:
+            for trace_id, reason in forced or ():
+                if trace_id not in self._forced:
+                    self._forced[trace_id] = reason
+                    while len(self._forced) > 4096:
+                        self._forced.popitem(last=False)
+                    self._promote_locked(trace_id)
+            for span in spans or ():
+                self._received += 1
+                trace_id = span.get("trace_id")
+                if trace_id is None:
+                    self._profile.append(span)
+                elif span.get("sampled") or trace_id in self._forced:
+                    self._spans.setdefault(trace_id, []).append(span)
+                    self._span_count += 1
+                else:
+                    self._provisional.setdefault(trace_id,
+                                                 []).append(span)
+                    self._provisional_count += 1
+            # whole-trace eviction, oldest (first-span arrival) first
+            while (self._span_count > self._max_spans
+                   and len(self._spans) > 1):
+                _, evicted = self._spans.popitem(last=False)
+                self._span_count -= len(evicted)
+            while (self._provisional_count > self._provisional_max
+                   and len(self._provisional) > 1):
+                _, evicted = self._provisional.popitem(last=False)
+                self._provisional_count -= len(evicted)
+            if stats:
+                self._sources[stats.get("pid")] = dict(stats,
+                                                       received=time.time())
+                if len(self._sources) > 512:
+                    for pid, _ in sorted(
+                            self._sources.items(),
+                            key=lambda kv: kv[1].get("received", 0.0)
+                    )[:len(self._sources) - 512]:
+                        self._sources.pop(pid, None)
+
+    def _promote_locked(self, trace_id: str) -> None:
+        # O(one trace): failure bursts fire one promotion per refused
+        # request, so this must never scan the whole provisional tier
+        keep = self._provisional.pop(trace_id, None)
+        if keep:
+            self._provisional_count -= len(keep)
+            self._spans.setdefault(trace_id, []).extend(keep)
+            self._span_count += len(keep)
+
+    async def handle_add_spans(self, payload):
+        self.add_local(payload.get("spans") or [],
+                       payload.get("forced") or [],
+                       payload.get("stats"))
+        return True
+
+    async def handle_get_trace(self, payload):
+        """Every stored span of one trace (durable + provisional),
+        ordered by start time, plus the force verdict."""
+        trace_id = payload.get("trace_id")
+        with self._lock:
+            spans = list(self._spans.get(trace_id) or ())
+            spans += self._provisional.get(trace_id) or ()
+            forced_reason = self._forced.get(trace_id)
+        # a span can reach both tiers across a promotion/flush race
+        seen = set()
+        out = []
+        for s in sorted(spans, key=lambda s: (s.get("start", 0.0),
+                                              s.get("span_id") or "")):
+            key = s.get("span_id")
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append(s)
+        return {"trace_id": trace_id, "spans": out,
+                "forced": forced_reason is not None,
+                "forced_reason": forced_reason}
+
+    async def handle_list_traces(self, payload):
+        """Newest-first trace summaries from the durable store (sampled +
+        force-kept traces — the ones worth listing). Only the newest
+        `limit` traces are summarized — the store can hold thousands."""
+        limit = payload.get("limit", 100)
+        with self._lock:
+            newest = list(self._spans.keys())[-limit:]
+            groups = [(tid, list(self._spans[tid])) for tid in newest]
+            forced = dict(self._forced)
+        rows = []
+        for trace_id, spans in groups:
+            span_ids = {s.get("span_id") for s in spans}
+            # root = the earliest span whose parent never arrived; a
+            # client-originated trace has NO parentless span here (the
+            # proxy's span is a child of the client's), so "parent not
+            # stored" is the right rule, same as build_span_tree
+            roots = [s for s in spans
+                     if s.get("parent_id") not in span_ids]
+            roots.sort(key=lambda s: s.get("start", 0.0))
+            rows.append({
+                "trace_id": trace_id,
+                "root": roots[0].get("name") if roots else None,
+                "spans": len(spans),
+                "procs": sorted({s.get("proc", "?") for s in spans}),
+                "start": min(s.get("start", 0.0) for s in spans),
+                "duration_s": max(0.0, max(s.get("end", 0.0)
+                                           for s in spans)
+                                  - min(s.get("start", 0.0)
+                                        for s in spans)),
+                "forced_reason": forced.get(trace_id),
+            })
+        rows.sort(key=lambda t: -t["start"])
+        return rows
+
+    async def handle_get_profile_spans(self, payload):
+        """Cluster-wide profile spans (util.tracing) for the timeline —
+        the spans the old process-local-only path silently dropped for
+        every non-driver process."""
+        limit = payload.get("limit", 10_000)
+        with self._lock:
+            out = list(self._profile)
+        return out[-limit:]
+
+    async def handle_get_span_stats(self, payload):
+        now = time.time()
+        with self._lock:
+            return {
+                "spans": self._span_count,
+                "provisional": self._provisional_count,
+                "traces": len(self._spans),
+                "profile": len(self._profile),
+                "forced_traces": len(self._forced),
+                "received": self._received,
+                "sources": {
+                    f"{st.get('source')}#{pid}": {
+                        "depth": st.get("depth", 0),
+                        "dropped": st.get("dropped", 0),
+                        "recorded": st.get("recorded", 0),
+                        "flush_lag_s": max(0.0, now - st.get(
+                            "received", now)),
+                    }
+                    for pid, st in self._sources.items()
+                },
+            }
+
+
 class GcsServer:
     """Assembles all managers onto one RpcServer + loop."""
 
@@ -669,10 +864,13 @@ class GcsServer:
                     "pubsub recovery: skipping torn subscription %r", key)
         self.task_event_manager = GcsTaskEventManager()
         self.event_manager = GcsEventManager()
+        self.span_manager = GcsSpanManager()
         # The head process's lifecycle events skip the wire entirely; the
         # token scopes teardown so a later sink owner isn't clobbered.
         self._event_sink_token = event_log.set_sink(
             self.event_manager.add_local)
+        self._span_sink_token = _tracing.set_span_sink(
+            self.span_manager.add_local)
         self.node_manager.pg_locator = self.pg_manager
         self.node_manager.add_death_listener(self.actor_manager.on_node_death)
         self.node_manager.add_death_listener(self.pg_manager.on_node_death)
@@ -689,6 +887,7 @@ class GcsServer:
             self.pg_manager,
             self.task_event_manager,
             self.event_manager,
+            self.span_manager,
         ):
             self._server.register_all(mgr)
         self._server.register("drain_node", self._handle_drain_node)
@@ -909,6 +1108,8 @@ class GcsServer:
     def stop(self):
         event_log.flush(timeout=0.5)  # pull in the head's own tail events
         event_log.clear_sink(self._event_sink_token)
+        _tracing.flush_spans(timeout=0.5)
+        _tracing.clear_span_sink(self._span_sink_token)
         if self._health_task is not None:
             self._health_task.cancel()
         self.publisher.close()

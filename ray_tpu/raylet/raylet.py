@@ -29,6 +29,7 @@ from typing import Dict, List, Optional, Tuple
 from ray_tpu._private import backoff as _backoff
 from ray_tpu._private import deadlines as _deadlines
 from ray_tpu._private import event_log
+from ray_tpu._private import tracing as _tracing
 from ray_tpu._private.config import CONFIG
 from ray_tpu._private.ids import NodeID, PlacementGroupID, WorkerID
 from ray_tpu._private.rpc import (
@@ -250,6 +251,12 @@ class Raylet:
                             {"events": events, "stats": stats})
 
         self._event_sink_token = event_log.set_sink(_ship_events)
+
+        def _ship_spans(spans, forced, stats):
+            gcs_client.send("add_spans", {"spans": spans, "forced": forced,
+                                          "stats": stats})
+
+        self._span_sink_token = _tracing.set_span_sink(_ship_spans)
         info = NodeInfo(
             node_id=self.node_id,
             raylet_address=self.address,
@@ -840,6 +847,9 @@ class Raylet:
         if self._event_sink_token is not None:
             event_log.flush(timeout=0.5)
             event_log.clear_sink(self._event_sink_token)
+        if getattr(self, "_span_sink_token", None) is not None:
+            _tracing.flush_spans(timeout=0.5)
+            _tracing.clear_span_sink(self._span_sink_token)
         for t in self._tasks:
             t.cancel()
         if self._store_client is not None:
@@ -894,10 +904,12 @@ class Raylet:
     def _expired_reply(self, spec: TaskSpec) -> dict:
         """Doomed-work elimination: the spec's deadline passed (on arrival
         or while queued) — tell the owner which task to resolve typed."""
+        trace_id = _tracing.trace_id_of(spec)
         self._elog.emit("task.deadline_expired", task_id=spec.task_id.hex(),
-                        node_id=self.node_id.hex(), layer="raylet",
-                        function=spec.function_name)
+                        node_id=self.node_id.hex(), trace_id=trace_id,
+                        layer="raylet", function=spec.function_name)
         _backoff.count_deadline_expired("raylet")
+        _tracing.force_trace(trace_id, "task.deadline_expired:raylet")
         return {"rejected": True, "deadline_expired": True,
                 "task_id": spec.task_id.hex()}
 
@@ -909,11 +921,13 @@ class Raylet:
         bound = CONFIG.raylet_lease_queue_max
         if bound <= 0 or len(self._queue) < bound:
             return None
+        trace_id = _tracing.trace_id_of(spec)
         self._elog.emit("task.shed", task_id=spec.task_id.hex(),
-                        node_id=self.node_id.hex(), layer="raylet",
-                        reason="lease queue full",
+                        node_id=self.node_id.hex(), trace_id=trace_id,
+                        layer="raylet", reason="lease queue full",
                         function=spec.function_name)
         _backoff.count_shed("raylet")
+        _tracing.force_trace(trace_id, "task.shed:raylet")
         return {
             "rejected": True,
             "retry_later": True,
@@ -1153,6 +1167,17 @@ class Raylet:
                         node_id=self.node_id.hex(),
                         function=q.spec.function_name,
                         worker_id=worker.worker_id.hex())
+        if getattr(q.spec, "trace_ctx", None) is not None:
+            # the raylet's contribution to the trace: queued -> granted,
+            # on this process's wall clock (spans never need clock sync —
+            # the tree hangs off span ids, not timestamps)
+            now = time.time()
+            _tracing.record_span(
+                "raylet.lease", q.spec.trace_ctx,
+                now - (time.monotonic() - q.enqueue_time), now,
+                proc=f"raylet:{self.node_id.hex()[:12]}",
+                attrs={"task_id": q.spec.task_id.hex(),
+                       "worker_id": worker.worker_id.hex()[:12]})
         q.future.set_result({"worker_address": addr})
 
     def _release_alloc(self, resources: Resources, pg_id, bundle_index):

@@ -411,6 +411,67 @@ def cmd_events(args) -> int:
     return 0
 
 
+def cmd_trace(args) -> int:
+    """`ray-tpu trace <trace_id>` — the cross-process span tree of one
+    distributed request (proxy -> router -> owner -> raylet -> worker ->
+    engine), with per-span durations and the lifecycle events stamped
+    with the same trace id. `--list` shows recent sampled/force-kept
+    traces; `--chrome FILE` exports a merged chrome trace whose flow
+    events link the process lanes."""
+    _connect(args)
+    from ray_tpu._private import tracing as _tracing
+    from ray_tpu._private.event_log import format_events
+    from ray_tpu.util.state import get_trace, list_traces, trace_events
+
+    # local spans flush on a 1s cadence; give this process's tail a push
+    _tracing.flush_spans(timeout=1.0)
+    if args.list or not args.trace_id:
+        rows = list_traces(limit=args.limit)
+        if args.json:
+            print(json.dumps(rows, indent=2, default=str))
+            return 0
+        if not rows:
+            print("no stored traces (sampled or force-kept) yet — pass a "
+                  "sampled traceparent, raise trace_sample_rate, or look "
+                  "up a recent trace id from a response's X-Trace-Id "
+                  "header directly")
+            return 0
+        for t in rows:
+            ts = time.strftime("%H:%M:%S", time.localtime(t["start"]))
+            forced = (f" forced={t['forced_reason']}"
+                      if t.get("forced_reason") else "")
+            print(f"{t['trace_id']}  {ts}  {t['duration_s'] * 1e3:8.2f}ms  "
+                  f"{t['spans']:>3} span(s)  {len(t['procs'])} proc(s)  "
+                  f"root={t.get('root')}{forced}")
+        return 0
+    reply = get_trace(args.trace_id)
+    spans = reply.get("spans") or []
+    if args.json:
+        print(json.dumps(reply, indent=2, default=str))
+        return 0
+    if not spans:
+        print(f"no spans stored for trace {args.trace_id} (unsampled "
+              "traces age out of the provisional ring unless force-kept; "
+              "spans flush within ~1s of recording)")
+        return 1
+    if args.chrome:
+        trace = _tracing.trace_chrome(spans)
+        with open(args.chrome, "w") as f:
+            json.dump(trace, f)
+        print(f"Wrote {len(trace)} chrome-trace events to {args.chrome} "
+              f"(open in chrome://tracing or perfetto.dev)")
+        return 0
+    if reply.get("forced"):
+        print(f"force-kept: {reply.get('forced_reason')}")
+    print(_tracing.format_trace(spans))
+    events = trace_events(args.trace_id)
+    if events:
+        print(f"\nlifecycle events carrying this trace id ({len(events)}; "
+              "cross-ref: ray-tpu debug postmortem --trace-id):")
+        print(format_events(events))
+    return 0
+
+
 def cmd_serve(args) -> int:
     """serve deploy/status/shutdown (reference: serve/scripts.py CLI)."""
     _connect(args)
@@ -921,7 +982,8 @@ def _cmd_debug_postmortem(args) -> int:
     flight = args.flight_dir or event_log.flight_dir()
     dumps = event_log.load_flight_dumps(flight)
     timeline = event_log.postmortem_timeline(
-        flight, cluster_events, task_id=args.task_id)
+        flight, cluster_events, task_id=args.task_id,
+        trace_id=getattr(args, "trace_id", None))
     if args.output:
         with open(args.output, "w") as f:
             json.dump(timeline, f, indent=2, default=str)
@@ -1333,6 +1395,22 @@ def main(argv=None) -> int:
     sp.add_argument("--json", action="store_true")
     sp.set_defaults(fn=cmd_events)
 
+    sp = sub.add_parser(
+        "trace", help="cross-process span tree of one traced request")
+    sp.add_argument("trace_id", nargs="?",
+                    help="trace id (a response's X-Trace-Id header, an "
+                         "event's trace= field, or `ray-tpu trace --list`)")
+    sp.add_argument("--address")
+    sp.add_argument("--list", action="store_true",
+                    help="list recent sampled/force-kept traces")
+    sp.add_argument("--limit", type=int, default=50,
+                    help="traces to list (with --list)")
+    sp.add_argument("--chrome", metavar="FILE",
+                    help="export the trace as a chrome://tracing file "
+                         "with cross-process flow arrows")
+    sp.add_argument("--json", action="store_true")
+    sp.set_defaults(fn=cmd_trace)
+
     sp = sub.add_parser("serve", help="serve deploy/status/shutdown")
     sp.add_argument("serve_cmd", choices=["deploy", "status", "shutdown"])
     sp.add_argument("config", nargs="?", help="JSON config (deploy)")
@@ -1481,6 +1559,10 @@ def main(argv=None) -> int:
     sp.add_argument("--flight-dir",
                     help="flight-dump dir (default: <session>/flight)")
     sp.add_argument("--task-id", help="postmortem: only this task's events")
+    sp.add_argument("--trace-id",
+                    help="postmortem: only events stamped with this "
+                         "distributed trace id (`ray-tpu trace` links "
+                         "back the other way)")
     sp.add_argument("-o", "--output",
                     help="postmortem: write merged JSON here")
     sp.set_defaults(fn=cmd_debug)

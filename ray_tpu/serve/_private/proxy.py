@@ -40,10 +40,12 @@ import json
 import logging
 import os
 import threading
+import time
 from typing import Any, Dict, Optional
 
 import ray_tpu
 from ray_tpu._private import deadlines as _deadlines
+from ray_tpu._private import tracing as _tracing
 
 logger = logging.getLogger(__name__)
 
@@ -393,6 +395,34 @@ class ProxyActor:
         self._loop = loop
 
         async def handler(request: "web.Request") -> "web.Response":
+            """Trace envelope around every request: ingest the client's
+            `traceparent` (or mint one), run the route, stamp X-Trace-Id
+            + traceparent on EVERY response — typed 429/503/504 sheds
+            included — record the proxy.request span, and tail-force the
+            trace on any error status so a user-visible failure is
+            always traceable."""
+            t_req = time.time()
+            req_ctx = _tracing.ingest_traceparent(
+                request.headers.get("traceparent"))
+            resp = await _route_request(request, req_ctx)
+            status = getattr(resp, "status", 500)
+            if not getattr(resp, "prepared", False):
+                # streaming responses stamped their headers pre-prepare
+                resp.headers["X-Trace-Id"] = req_ctx.trace_id
+                resp.headers["traceparent"] = req_ctx.traceparent()
+            _tracing.record_span(
+                "proxy.request", req_ctx, t_req, time.time(),
+                span_id=req_ctx.span_id,
+                proc=f"proxy:{self._shard_index}",
+                attrs={"path": request.path, "method": request.method,
+                       "status": status})
+            if status >= 400:
+                _tracing.force_trace(req_ctx.trace_id,
+                                     f"http_{status}")
+            return resp
+
+        async def _route_request(request: "web.Request",
+                                 req_ctx) -> "web.Response":
             match = self._match_route(request.path)
             if match is None:
                 return web.Response(status=404, text="no matching route")
@@ -453,19 +483,28 @@ class ProxyActor:
                     # not just the call. The wrapping generator holds the
                     # scope on the feeder thread for the stream's life
                     # (the feeder is dedicated to this one stream).
-                    def make_iter(r=llm_router, a=arg, d=deadline):
+                    def make_iter(r=llm_router, a=arg, d=deadline,
+                                  c=req_ctx):
                         def _gen():
-                            with _deadlines.ambient_deadline(d):
+                            # trace scope mirrors the deadline scope: the
+                            # feeder thread is dedicated to this stream,
+                            # so holding both for the iteration is safe
+                            # and stamps every spec the router submits
+                            with _deadlines.ambient_deadline(d), \
+                                    _tracing.trace_scope(c):
                                 yield from r(a)
                         return _gen()
                 else:
-                    def make_iter(h=stream_handle, a=arg, d=deadline):
+                    def make_iter(h=stream_handle, a=arg, d=deadline,
+                                  c=req_ctx):
                         # h.remote submits EAGERLY: scoping the call is
                         # enough to stamp the spec
-                        with _deadlines.ambient_deadline(d):
+                        with _deadlines.ambient_deadline(d), \
+                                _tracing.trace_scope(c):
                             return iter(h.remote(a))
 
-                return await self._stream(request, flags, make_iter)
+                return await self._stream(request, flags, make_iter,
+                                          req_ctx=req_ctx)
 
             timeout_s = 60.0
             if deadline is not None:
@@ -485,7 +524,8 @@ class ProxyActor:
             try:
                 response = await self._unary(handle, arg,
                                              timeout_s=timeout_s,
-                                             deadline=deadline)
+                                             deadline=deadline,
+                                             trace=req_ctx)
             except Exception as e:  # noqa: BLE001 — surface as status
                 status = _http_status_of(e)
                 if status >= 500 and status != 504:
@@ -547,7 +587,8 @@ class ProxyActor:
         loop.run_forever()
 
     async def _unary(self, handle, arg, timeout_s: float = 60.0,
-                     max_attempts: int = 3, deadline: Optional[float] = None):
+                     max_attempts: int = 3, deadline: Optional[float] = None,
+                     trace=None):
         """Unary request: non-blocking replica assignment + async reply
         await. Falls back to the blocking assign on an executor thread
         only when no replica is known yet (cold start / scale-from-0).
@@ -572,12 +613,19 @@ class ProxyActor:
                 # releases + evicts it); an in-flight death surfaces on
                 # the reply ref — both re-assign. The ambient deadline
                 # wraps SUBMISSION only: the spec is stamped there, and
-                # downstream queue-pops enforce it from then on.
-                with _deadlines.ambient_deadline(deadline):
+                # downstream queue-pops enforce it from then on. The
+                # trace scope covers the same window (both are
+                # thread-scoped: wrapping only synchronous submission
+                # keeps concurrent requests on this loop from leaking
+                # scopes across awaits).
+                with _deadlines.ambient_deadline(deadline), \
+                        _tracing.trace_scope(trace):
                     resp = handle.try_remote(arg)
                 if resp is None:
-                    def _blocking_remote(h=handle, a=arg, d=deadline):
-                        with _deadlines.ambient_deadline(d):
+                    def _blocking_remote(h=handle, a=arg, d=deadline,
+                                         c=trace):
+                        with _deadlines.ambient_deadline(d), \
+                                _tracing.trace_scope(c):
                             return h.remote(a)
 
                     resp = await loop.run_in_executor(None, _blocking_remote)
@@ -595,7 +643,8 @@ class ProxyActor:
                     resp._done()
         raise last_err
 
-    async def _stream(self, request, flags: Dict[str, Any], make_iter):
+    async def _stream(self, request, flags: Dict[str, Any], make_iter,
+                      req_ctx=None):
         from aiohttp import web
 
         loop = self._loop
@@ -616,6 +665,10 @@ class ProxyActor:
                 status=_http_status_of(first), headers=headers,
                 text=str(getattr(first, "cause", None) or first))
         stream = web.StreamResponse()
+        if req_ctx is not None:
+            # stamped BEFORE prepare(): committed headers are immutable
+            stream.headers["X-Trace-Id"] = req_ctx.trace_id
+            stream.headers["traceparent"] = req_ctx.traceparent()
         if flags.get("sse"):
             stream.content_type = "text/event-stream"
             stream.headers["Cache-Control"] = "no-cache"

@@ -22,9 +22,11 @@ from __future__ import annotations
 
 import random
 import threading
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import ray_tpu
+from ray_tpu._private import tracing as _tracing
 
 _LONG_POLL_TIMEOUT_S = 30.0
 
@@ -220,8 +222,18 @@ class Router:
         keeps the replica routable — evicting a healthy replica on a
         caller-side error would drain the set one malformed request at
         a time until the next long-poll resync."""
+        ctx = _tracing.current_trace()
+        t_pick = time.time() if ctx is not None else 0.0
         try:
             ref = handle.handle_request.remote(method_name, args, kwargs)
+            if ctx is not None:
+                # the routing decision of a traced request: which replica
+                # won the power-of-two choice (submission is a child span
+                # of the same context via the spec's own trace_ctx)
+                _tracing.record_span(
+                    "router.pick", ctx, t_pick, time.time(),
+                    attrs={"deployment": self._deployment,
+                           "replica": replica_id})
         except ray_tpu.exceptions.ActorDiedError:
             self._scheduler.request_done(replica_id)
             self._scheduler.evict(replica_id)
@@ -251,9 +263,16 @@ class Router:
     def assign_request_streaming(self, method_name: str, args: tuple,
                                  kwargs: dict):
         """Returns an ObjectRefGenerator of response chunks."""
+        ctx = _tracing.current_trace()
+        t_pick = time.time() if ctx is not None else 0.0
         replica_id, handle = self._choose()
         gen = handle.handle_request_streaming.options(
             num_returns="streaming").remote(method_name, args, kwargs)
+        if ctx is not None:
+            _tracing.record_span(
+                "router.pick", ctx, t_pick, time.time(),
+                attrs={"deployment": self._deployment,
+                       "replica": replica_id, "streaming": True})
         # Streams aren't completion-tracked (their lifetime is the whole
         # generator); release the local charge and let the controller's
         # piggybacked ongoing counts carry streaming load.

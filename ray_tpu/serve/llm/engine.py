@@ -37,6 +37,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from ray_tpu._private import tracing as _tracing
+from ray_tpu._private.config import CONFIG
 from ray_tpu.inference import GenerationConfig
 from ray_tpu.serve.llm import metrics as llm_metrics
 
@@ -145,6 +147,9 @@ class LLMEngineReplica:
         if self._backlog() >= self._max_queue_depth:
             llm_metrics.requests_counter().inc(
                 tags={**self._tags, "outcome": "shed"})
+            ambient = _tracing.current_trace()
+            if ambient is not None:
+                _tracing.force_trace(ambient.trace_id, "llm_shed:engine")
             raise LLMOverloadedError(
                 f"engine admission backlog full "
                 f"({self._max_queue_depth} requests waiting)")
@@ -173,8 +178,15 @@ class LLMEngineReplica:
         """Yields token ids as the engine samples them. Closing the
         consumer side (client disconnect, ObjectRefGenerator.close())
         cancels the request and frees its engine slot."""
+        trace_ctx = _tracing.current_trace()
+        t_submit = time.monotonic()
+        t_prev_wall = time.time()
+        first_token = trace_ctx is not None
+        span_cap = (CONFIG.trace_max_stream_spans
+                    if trace_ctx is not None else 0)
         rq = self._submit(prompt, max_new_tokens, None)
         finished = False
+        produced = 0
         try:
             while True:
                 try:
@@ -193,6 +205,25 @@ class LLMEngineReplica:
                 if isinstance(item, BaseException):
                     finished = True
                     raise item
+                if first_token:
+                    # admission span of a traced request: submit ->
+                    # first sampled token (queue wait + prefill — the
+                    # TTFT the engine is responsible for)
+                    first_token = False
+                    now = time.time()
+                    _tracing.record_span(
+                        "engine.admission", trace_ctx,
+                        now - (time.monotonic() - t_submit), now,
+                        attrs={"req_id": rq.req_id,
+                               "prompt_tokens": len(prompt)})
+                    t_prev_wall = now
+                elif produced < span_cap:
+                    now = time.time()
+                    _tracing.record_span(
+                        "engine.decode_chunk", trace_ctx, t_prev_wall, now,
+                        attrs={"req_id": rq.req_id, "index": produced})
+                    t_prev_wall = now
+                produced += 1
                 yield item
         finally:
             if not finished:

@@ -39,6 +39,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import ray_tpu
 from ray_tpu import exceptions as exc
+from ray_tpu._private import tracing as _tracing
 from ray_tpu._private.rpc import ConnectionLost
 from ray_tpu.serve.llm import metrics as llm_metrics
 from ray_tpu.serve.llm.engine import (
@@ -189,6 +190,12 @@ class LLMRouter:
             if agg >= self._shed_queue_depth:
                 self._shed_total += 1
                 llm_metrics.shed_counter().inc(tags=self._tags)
+                ambient = _tracing.current_trace()
+                if ambient is not None:
+                    # a shed is a tail-keep trigger: the 429 the client
+                    # sees must be traceable at any sample rate
+                    _tracing.force_trace(ambient.trace_id,
+                                         "llm_shed:router")
                 raise LLMOverloadedError(
                     f"serving queue depth {agg} >= bound "
                     f"{self._shed_queue_depth}; retry later")
@@ -307,8 +314,15 @@ class LLMRouter:
         method = "generate_stream_sse" if sse else "generate_stream"
         failed: set = set()
         for failover in range(_MAX_FAILOVERS + 1):
+            trace_ctx = _tracing.current_trace()
+            t_pick = time.time() if trace_ctx is not None else 0.0
             rid, handle = self._choose(rq["session_id"], cost,
                                        excluded=frozenset(failed))
+            if trace_ctx is not None:
+                _tracing.record_span(
+                    "router.pick", trace_ctx, t_pick, time.time(),
+                    attrs={"deployment": self._deployment, "replica": rid,
+                           "failover": failover, "cost": cost})
             produced = 0
             gen = None
             try:

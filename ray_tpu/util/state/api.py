@@ -141,6 +141,7 @@ def list_cluster_events(filters=None, limit: int = 1000,
                         actor_id: Optional[str] = None,
                         node_id: Optional[str] = None,
                         object_id: Optional[str] = None,
+                        trace_id: Optional[str] = None,
                         since: Optional[float] = None,
                         **_kw) -> List[Dict[str, Any]]:
     """Cluster-wide structured lifecycle events (the _private/event_log
@@ -150,7 +151,7 @@ def list_cluster_events(filters=None, limit: int = 1000,
     events = _gcs().call("get_cluster_events", {
         "limit": limit, "type": etype, "task_id": task_id,
         "actor_id": actor_id, "node_id": node_id, "object_id": object_id,
-        "since": since,
+        "trace_id": trace_id, "since": since,
     })
     return _apply_filters(events, filters)[:limit]
 
@@ -180,6 +181,28 @@ def task_causal_timeline(task_id: str) -> List[Dict[str, Any]]:
     # a task's object reconstruction events carry the task id too; actor
     # tasks additionally pull their actor's transitions in by actor id
     return merge_timeline(task_events, lifecycle)
+
+
+def get_trace(trace_id: str) -> Dict[str, Any]:
+    """Every stored span of one distributed request (durable +
+    provisional tiers of the GCS span store), ordered by start time,
+    plus the tail force-keep verdict (`ray-tpu trace`)."""
+    return _gcs().call("get_trace", {"trace_id": trace_id})
+
+
+def list_traces(limit: int = 100) -> List[Dict[str, Any]]:
+    """Newest-first summaries of sampled/force-kept traces."""
+    return _gcs().call("list_traces", {"limit": limit})
+
+
+def trace_events(trace_id: str) -> List[Dict[str, Any]]:
+    """Lifecycle events stamped with this trace id (retries, deadline
+    drops, sheds, chaos hits) — the event-log half of the trace<->event
+    cross-reference, ordered like a timeline."""
+    events = list_cluster_events(limit=10_000, trace_id=trace_id)
+    return sorted(events, key=lambda e: (e.get("time", 0),
+                                         e.get("pid") or 0,
+                                         e.get("seq") or 0))
 
 
 def list_workers(filters=None, limit: int = 100, **_kw) -> List[Dict[str, Any]]:
@@ -270,10 +293,34 @@ def task_timeline_events(limit: int = 100_000,
                          task_id: Optional[str] = None) -> list:
     """Chrome-trace 'X' events built from GCS task events (reference:
     _private/state.py:434 chrome_tracing_dump — what `ray timeline` and
-    `ray.timeline()` emit). `limit` bounds the raw event fetch (CLI
-    --limit); `task_id` restricts the trace to one task's spans."""
+    `ray.timeline()` emit), merged with the CLUSTER-WIDE profile spans
+    from the GCS span store — util.tracing trace_span spans recorded on
+    worker processes used to live only in that process's deque, so the
+    timeline silently showed driver spans only (ISSUE 11 satellite).
+    `limit` bounds the raw event fetch (CLI --limit); `task_id`
+    restricts the trace to one task's spans."""
     events = list_tasks(limit=limit, raw_events=True, task_id=task_id)
-    return build_chrome_trace(events)
+    trace = build_chrome_trace(events)
+    if task_id is None:
+        try:
+            profile = _gcs().call("get_profile_spans", {"limit": limit})
+        except Exception:  # noqa: BLE001 — older GCS without a span store
+            profile = []
+        trace.extend(profile_chrome_events(profile))
+    return trace
+
+
+def profile_chrome_events(spans: list) -> list:
+    """Profile-span records (GCS span store / local ring) -> chrome 'X'
+    entries, one lane per source process."""
+    return [{
+        "cat": "profile", "ph": "X", "name": s.get("name", "?"),
+        "pid": s.get("proc") or "profile",
+        "tid": s.get("thread") or "profile",
+        "ts": int(s.get("start", 0.0) * 1e6),
+        "dur": int((s.get("end", 0.0) - s.get("start", 0.0)) * 1e6),
+        "args": dict(s.get("attrs") or {}),
+    } for s in spans]
 
 
 def build_chrome_trace(events: list) -> list:
@@ -301,7 +348,10 @@ def build_chrome_trace(events: list) -> list:
                          # propagated trace context: the submitter's span
                          # (task id, or the driver root) — joins the
                          # events into a driver->task->nested-task tree
-                         "parent": ev.get("parent")},
+                         "parent": ev.get("parent"),
+                         # distributed trace id (ISSUE 11) when the task
+                         # was traced: `ray-tpu trace <id>` cross-ref
+                         "trace_id": ev.get("trace_id")},
             }
             trace.append(entry)
             spans[ev["task_id"]] = entry

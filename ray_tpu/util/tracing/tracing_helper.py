@@ -4,8 +4,14 @@ injected around task submit/execute; plus the C++ ProfileEvent buffered into
 the task-event stream for `ray timeline`).
 
 `trace_span` uses OpenTelemetry when it is importable, and ALWAYS records a
-profile event into the process-local buffer that `ray-tpu timeline` dumps —
-so spans appear in the chrome trace regardless of otel availability.
+profile span through `_private/tracing` — which means the span both lands
+in this process's local ring AND drains through the cluster span flusher to
+the GCS span store. The old process-local-only deque silently made
+`ray-tpu timeline` a driver-only view: spans recorded on WORKER processes
+never left them (ISSUE 11 satellite); now the timeline merges every
+process's profile spans from the GCS. When an ambient trace context is
+active (serve request scope, an executing traced task), the span joins
+that trace automatically.
 """
 
 from __future__ import annotations
@@ -14,11 +20,9 @@ import contextlib
 import functools
 import threading
 import time
-from collections import deque
 from typing import Any, Dict, List, Optional
 
-_events: deque = deque(maxlen=100_000)
-_lock = threading.Lock()
+from ray_tpu._private import tracing as _tracing
 
 
 class _LazyOpenTelemetry:
@@ -47,7 +51,7 @@ _otel = _LazyOpenTelemetry()
 
 @contextlib.contextmanager
 def trace_span(name: str, attributes: Optional[Dict[str, Any]] = None):
-    """Record a span: otel (if present) + the local profile-event buffer."""
+    """Record a span: otel (if present) + the cluster span pipeline."""
     start = time.time()
     otel_cm = None
     if _otel.tracer is not None:
@@ -59,30 +63,18 @@ def trace_span(name: str, attributes: Optional[Dict[str, Any]] = None):
         end = time.time()
         if otel_cm is not None:
             otel_cm.__exit__(None, None, None)
-        with _lock:
-            _events.append({
-                "name": name,
-                "start": start,
-                "end": end,
-                "thread": threading.current_thread().name,
-                "attributes": dict(attributes or {}),
-            })
+        _tracing.record_profile_span(name, start, end,
+                                     attrs=dict(attributes or {}))
 
 
 def record_event(name: str, start: float, end: float,
                  attributes: Optional[Dict[str, Any]] = None,
                  thread: Optional[str] = None) -> None:
     """Record a span with EXPLICIT wall-clock bounds (for after-the-fact
-    instrumentation like per-stage task latency segments, where the span
-    is reconstructed from stamps rather than wrapped with trace_span)."""
-    with _lock:
-        _events.append({
-            "name": name,
-            "start": start,
-            "end": end,
-            "thread": thread or threading.current_thread().name,
-            "attributes": dict(attributes or {}),
-        })
+    instrumentation where the span is reconstructed from stamps rather
+    than wrapped with trace_span)."""
+    _tracing.record_profile_span(name, start, end, thread=thread,
+                                 attrs=dict(attributes or {}))
 
 
 def profile(name: str):
@@ -99,11 +91,25 @@ def profile(name: str):
     return wrap
 
 
+def _legacy_event(span: dict) -> Dict[str, Any]:
+    return {
+        "name": span.get("name"),
+        "start": span.get("start"),
+        "end": span.get("end"),
+        "thread": span.get("thread")
+        or threading.current_thread().name,
+        "attributes": dict(span.get("attrs") or {}),
+    }
+
+
 def get_trace_events(clear: bool = False) -> List[Dict[str, Any]]:
-    with _lock:
-        out = list(_events)
-        if clear:
-            _events.clear()
+    """This process's recent spans in the legacy profile-event shape
+    (the local tail of the ring that also feeds the cluster flusher)."""
+    out = [_legacy_event(s) for s in _tracing.get_local_spans(100_000)]
+    if clear:
+        # legacy contract: drain THIS view only — unflushed cluster
+        # spans / force markers stay on their way to the GCS store
+        _tracing.clear_local_ring()
     return out
 
 

@@ -34,6 +34,7 @@ from ray_tpu._private import deadlines as _deadlines
 from ray_tpu._private import event_log
 from ray_tpu._private import fault_injection as _fi
 from ray_tpu._private import serialization as ser
+from ray_tpu._private import tracing as _tracing
 from ray_tpu._private.config import CONFIG
 from ray_tpu._private.ids import (
     ActorID,
@@ -386,6 +387,14 @@ class CoreWorker:
                             {"events": events, "stats": stats})
 
         self._event_sink_token = event_log.set_sink(_ship_events)
+        # Span flush path rides the same GCS connection (tracing.py): the
+        # embedded head keeps the GCS's direct sink (first-wins).
+
+        def _ship_spans(spans, forced, stats):
+            gcs_client.send("add_spans", {"spans": spans, "forced": forced,
+                                          "stats": stats})
+
+        self._span_sink_token = _tracing.set_span_sink(_ship_spans)
         if mode == "worker":
             event_log.set_default_proc_label(f"worker:{os.getpid()}")
             event_log.install_flight_recorder(on_exit=True)
@@ -563,6 +572,9 @@ class CoreWorker:
         if self._event_sink_token is not None:
             event_log.flush(timeout=0.5)
             event_log.clear_sink(self._event_sink_token)
+        if getattr(self, "_span_sink_token", None) is not None:
+            _tracing.flush_spans(timeout=0.5)
+            _tracing.clear_span_sink(self._span_sink_token)
         self.executor.shutdown()
         if self.plasma is not None:
             try:
@@ -629,6 +641,20 @@ class CoreWorker:
         spec = getattr(_task_ctx, "spec", None)
         return getattr(spec, "deadline_s", None) if spec is not None else None
 
+    @staticmethod
+    def _trace_ctx_for_submit() -> Optional[tuple]:
+        """Trace-context inheritance (the tracing sibling of
+        _parent_deadline): a child of the ambient context — the serve
+        proxy's request scope, or the executing task's own context — or
+        a head-sampled fresh root. None (the common case at default
+        sample rate) costs one thread-local read."""
+        ctx = _tracing.context_for_submission()
+        return ctx.to_wire() if ctx is not None else None
+
+    @staticmethod
+    def _spec_trace_id(spec: TaskSpec) -> Optional[str]:
+        return _tracing.trace_id_of(spec)
+
     def _expire_spec(self, spec: TaskSpec, layer: str = "owner",
                      record: bool = True) -> None:
         """Doomed-work elimination at an owner-side queue pop: resolve the
@@ -640,8 +666,11 @@ class CoreWorker:
         if record:
             self._elog.emit("task.deadline_expired",
                             task_id=spec.task_id.hex(),
+                            trace_id=self._spec_trace_id(spec),
                             layer=layer, function=spec.function_name)
             _backoff.count_deadline_expired(layer)
+        _tracing.force_trace(self._spec_trace_id(spec),
+                             f"task.deadline_expired:{layer}")
         self._store_error_for_task(spec, exc.DeadlineExceededError(
             f"deadline for task {spec.function_name} passed before "
             f"dispatch", layer=layer, deadline=spec.deadline_s))
@@ -1247,6 +1276,7 @@ class CoreWorker:
             resources=resources or {"CPU": CONFIG.default_task_num_cpus},
             owner_address=self.address,
             trace_parent=self.current_task_id().hex(),
+            trace_ctx=self._trace_ctx_for_submit(),
             max_retries=max_retries,
             retry_exceptions=retry_exceptions,
             max_calls=max_calls,
@@ -1824,11 +1854,22 @@ class CoreWorker:
                                        error=ser.serialize(err))
             self._finalize_task(spec, "CANCELLED")
         else:  # application error
+            error_obj = None
             if spec.retry_exceptions and pending.retries_left > 0:
-                pending.retries_left -= 1
-                self._resubmit(spec, reason="application error")
-                return
-            error_obj, _ = ser.deserialize(reply["error"])
+                # A worker-side deadline drop rides the error reply shape,
+                # but DeadlineExceededError is "never retried: a deadline
+                # is a promise to the caller" (exceptions.py) — and the
+                # requeued spec would keep its already-expired absolute
+                # deadline, so every retry is a guaranteed futile
+                # lease+push round trip (the exact doomed-work
+                # amplification ISSUE 9 eliminates at the other layers).
+                error_obj, _ = ser.deserialize(reply["error"])
+                if not isinstance(error_obj, exc.DeadlineExceededError):
+                    pending.retries_left -= 1
+                    self._resubmit(spec, reason="application error")
+                    return
+            if error_obj is None:
+                error_obj, _ = ser.deserialize(reply["error"])
             self._store_error_for_task(spec, error_obj)
             if spec.is_streaming_generator():
                 self._finish_generator(spec.task_id, 0, error=reply["error"])
@@ -1847,8 +1888,38 @@ class CoreWorker:
         if stages is not None:
             latency.record_breakdown(
                 spec.task_id.hex(), spec.function_name,
-                spec.task_type.name, stages)
+                spec.task_type.name, stages,
+                trace_id=self._spec_trace_id(spec))
+            if spec.trace_ctx is not None:
+                self._record_owner_trace_spans(spec, stages)
         return stages
+
+    def _record_owner_trace_spans(self, spec: TaskSpec,
+                                  stages: dict) -> None:
+        """Owner-side spans of a traced task: the task's OWN span (its
+        id is the spec's trace_ctx span id, so children recorded by the
+        worker/raylet parent correctly) plus the owner-observed stages.
+        dispatch/execute are the worker's to record (its wall clock is
+        the honest one there); the stage layout mirrors latency.py's
+        back-to-back reconstruction ending at the reply-processed
+        instant."""
+        from ray_tpu._private.latency import STAGES
+
+        end = time.time()
+        total = sum(stages.get(s, 0.0) or 0.0 for s in STAGES)
+        ctx = spec.trace_ctx
+        _tracing.record_span(
+            f"task:{spec.function_name}", ctx, end - total, end,
+            span_id=ctx[1],
+            attrs={"task_id": spec.task_id.hex(),
+                   "type": spec.task_type.name})
+        t = end - total
+        for stage in STAGES:
+            dur = stages.get(stage, 0.0) or 0.0
+            if stage in ("submit", "queue", "rpc", "reply"):
+                _tracing.record_span(f"task.{stage}", ctx, t, t + dur,
+                                     attrs={"task_id": spec.task_id.hex()})
+            t += dur
 
     def _on_worker_failure(self, spec: TaskSpec):
         pending = self._pending_tasks.get(spec.task_id)
@@ -1862,6 +1933,7 @@ class CoreWorker:
             return
         # the other half of the retry FSM: budget exhausted, fail for good
         self._elog.emit("task.giveup", task_id=spec.task_id.hex(),
+                        trace_id=self._spec_trace_id(spec),
                         reason="worker failure, no retries left")
         err = exc.WorkerCrashedError(
             f"The worker executing task {spec.function_name} died unexpectedly."
@@ -1874,6 +1946,7 @@ class CoreWorker:
         pending = self._pending_tasks.get(spec.task_id)
         self._elog.emit(
             "task.retry", task_id=spec.task_id.hex(), reason=reason,
+            trace_id=self._spec_trace_id(spec),
             attempt=spec.attempt_number,
             retries_left=pending.retries_left if pending else 0)
         if pending is not None:
@@ -1899,6 +1972,13 @@ class CoreWorker:
         self._release_deps(oid)
 
     def _store_error_for_task(self, spec: TaskSpec, error: BaseException):
+        # tail-based keep: a trace that contains a task FAILURE is
+        # interesting regardless of the head-sampling verdict. Consumer-
+        # initiated cancels are routine (every abandoned stream ends in
+        # one) — promoting them would flood the durable store.
+        if not isinstance(error, exc.TaskCancelledError):
+            _tracing.force_trace(self._spec_trace_id(spec),
+                                 f"task_error:{type(error).__name__}")
         s = ser.serialize(error)
         for oid in spec.return_ids():
             self.memory_store.put_serialized(oid, s, value=error, is_exception=True)
@@ -1977,6 +2057,7 @@ class CoreWorker:
             placement_resources=placement_resources,
             owner_address=self.address,
             trace_parent=self.current_task_id().hex(),
+            trace_ctx=self._trace_ctx_for_submit(),
             scheduling_strategy=scheduling_strategy or SchedulingStrategySpec(),
             actor_creation=creation,
             runtime_env=runtime_env,
@@ -2273,10 +2354,15 @@ class CoreWorker:
             # of parking an unbounded backlog behind a non-ALIVE (or
             # slow-flushing) actor. The caller retries after the hint —
             # shed, never lost.
+            ambient = _tracing.current_trace()
             self._elog.emit("task.shed", actor_id=actor_id.hex(),
+                            trace_id=ambient.trace_id if ambient else None,
                             layer="actor_mailbox", reason="mailbox full",
                             method=method_name)
             _backoff.count_shed("actor_mailbox")
+            if ambient is not None:
+                _tracing.force_trace(ambient.trace_id,
+                                     "task.shed:actor_mailbox")
             raise exc.RetryLaterError(
                 f"actor {actor_id.hex()[:12]} mailbox is full "
                 f"({rec.outstanding} outstanding calls)",
@@ -2296,6 +2382,7 @@ class CoreWorker:
             num_returns=-1 if streaming else num_returns,
             owner_address=self.address,
             trace_parent=self.current_task_id().hex(),
+            trace_ctx=self._trace_ctx_for_submit(),
             actor_id=actor_id,
             deadline_s=_deadlines.effective_deadline(
                 deadline_s, self._parent_deadline()),
@@ -2922,12 +3009,41 @@ class CoreWorker:
                            "pack": pack,
                            "wall": dispatch + execute + pack}
 
+    @staticmethod
+    def _record_worker_trace_spans(specs, replies) -> None:
+        """Worker-side spans of traced tasks (dispatch + execute), laid
+        out on THIS process's wall clock ending at reply time — the
+        owner records submit/queue/rpc/reply from its own stamps, so the
+        pair covers the whole round trip without clock sync. One `is
+        None` check per untraced spec."""
+        now = time.time()
+        for spec, reply in zip(specs, replies):
+            ctx = getattr(spec, "trace_ctx", None)
+            if ctx is None or not isinstance(reply, dict):
+                continue
+            stages = reply.get("stages")
+            if not stages:
+                continue
+            end_exec = now - (stages.get("pack", 0.0) or 0.0)
+            execute = stages.get("exec", 0.0) or 0.0
+            dispatch = stages.get("dispatch", 0.0) or 0.0
+            _tracing.record_span(
+                "task.execute", ctx, end_exec - execute, end_exec,
+                attrs={"task_id": spec.task_id.hex(),
+                       "function": spec.function_name,
+                       "status": reply.get("status", "?")})
+            _tracing.record_span(
+                "task.dispatch", ctx, end_exec - execute - dispatch,
+                end_exec - execute,
+                attrs={"task_id": spec.task_id.hex()})
+
     async def _handle_push_task(self, payload):
         recv = time.monotonic()
         spec: TaskSpec = payload["spec"]
         self._record_task_event(spec, "EXECUTING")
         reply = await self.executor.execute(spec)
         self._attach_worker_stages([reply], recv, shared=False)
+        self._record_worker_trace_spans([spec], [reply])
         if spec.task_type == TaskType.ACTOR_CREATION_TASK:
             # creation tasks have no owner-side _finalize_task (the GCS
             # pushes them); record completion here or the timeline shows
@@ -2950,6 +3066,7 @@ class CoreWorker:
         replies = await loop.run_in_executor(
             self.executor._pool, self.executor.execute_batch_sync, specs)
         self._attach_worker_stages(replies, recv, shared=len(specs) > 1)
+        self._record_worker_trace_spans(specs, replies)
         return {"replies": replies}
 
     async def _handle_push_task_w(self, payload):
@@ -2966,6 +3083,7 @@ class CoreWorker:
         if len(specs) == 1:
             reply = await self.executor.execute(specs[0])
             self._attach_worker_stages([reply], recv, shared=False)
+            self._record_worker_trace_spans(specs, [reply])
             return [reply_to_wire(reply)]
         if all(s.task_type == TaskType.NORMAL_TASK for s in specs):
             loop = asyncio.get_event_loop()
@@ -2973,6 +3091,7 @@ class CoreWorker:
                 self.executor._pool, self.executor.execute_batch_sync,
                 specs)
             self._attach_worker_stages(replies, recv, shared=True)
+            self._record_worker_trace_spans(specs, replies)
             return [reply_to_wire(r) for r in replies]
         creation = self.executor._actor_spec
         if creation is None or (creation.max_concurrency <= 1
@@ -2985,10 +3104,12 @@ class CoreWorker:
                 self.executor._pool, self.executor.execute_actor_batch_sync,
                 specs)
             self._attach_worker_stages(replies, recv, shared=True)
+            self._record_worker_trace_spans(specs, replies)
             return [reply_to_wire(r) for r in replies]
         replies = await asyncio.gather(
             *(self.executor.execute(s) for s in specs))
         self._attach_worker_stages(replies, recv, shared=True)
+        self._record_worker_trace_spans(specs, replies)
         return [reply_to_wire(r) for r in replies]
 
     async def _handle_kill_actor(self, payload):
@@ -3298,7 +3419,8 @@ class CoreWorker:
         # on terminal events (the per-stage latency breakdown).
         self._task_events.append(
             (spec.task_id, spec.function_name, spec.task_type.name,
-             spec.job_id, state, time.time(), spec.trace_parent, stages))
+             spec.job_id, state, time.time(), spec.trace_parent, stages,
+             self._spec_trace_id(spec)))
         ev = self._task_events_wakeup
         if ev is not None:
             ev.set()  # plain threading.Event: no loop interaction here
@@ -3327,7 +3449,7 @@ class CoreWorker:
         events = []
         while self._task_events and len(events) < limit:
             task_id, name, type_name, job_id, state, ts, parent, \
-                stages = self._task_events.popleft()
+                stages, trace_id = self._task_events.popleft()
             ev = {
                 "task_id": task_id.hex(),
                 "name": name,
@@ -3338,6 +3460,7 @@ class CoreWorker:
                 "node": node,
                 "worker_id": worker,
                 "time": ts,
+                "trace_id": trace_id,
             }
             if stages is not None:
                 ev["stages"] = stages

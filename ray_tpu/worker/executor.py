@@ -27,6 +27,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu._private import backoff as _backoff
 from ray_tpu._private import deadlines as _deadlines
+from ray_tpu._private import tracing as _tracing
 from ray_tpu._private.config import CONFIG
 from ray_tpu._private.ids import ActorID, ObjectID, TaskID
 from ray_tpu._private import serialization as ser
@@ -286,10 +287,13 @@ class Executor:
         concurrency semaphore, pool backlog). The caller gets a typed
         DeadlineExceededError; no ERROR-channel broadcast — an expired
         deadline is the caller's own budget, not an application fault."""
+        trace_id = _tracing.trace_id_of(spec)
         self.cw._elog.emit(
             "task.deadline_expired", task_id=spec.task_id.hex(),
-            layer="worker", function=spec.function_name)
+            trace_id=trace_id, layer="worker",
+            function=spec.function_name)
         _backoff.count_deadline_expired("worker")
+        _tracing.force_trace(trace_id, "task.deadline_expired:worker")
         err = DeadlineExceededError(
             f"deadline for {spec.function_name} passed before execution "
             "started", layer="worker", deadline=spec.deadline_s)
@@ -302,6 +306,11 @@ class Executor:
         }
 
     def _error_reply(self, spec: TaskSpec, exc: BaseException) -> dict:
+        if spec.trace_ctx is not None:
+            # tail-keep from the failing side too: generator errors reach
+            # the owner via item reports, not this reply
+            _tracing.force_trace(spec.trace_ctx[0],
+                                 f"task_error:{type(exc).__name__}")
         if isinstance(exc, RayTaskError):
             err = exc
         else:
@@ -400,14 +409,28 @@ class Executor:
     def _run_generator(self, spec: TaskSpec, fn, args, kwargs) -> dict:
         """Streaming generator: report each item to the owner as produced."""
         gen = None
+        trace_ctx = spec.trace_ctx
+        span_cap = CONFIG.trace_max_stream_spans if trace_ctx is not None \
+            else 0
         try:
             gen = fn(*args, **kwargs)
             index = 0
+            t_prev = time.time() if trace_ctx is not None else 0.0
             for item in gen:
                 oid = ObjectID.for_task_return(spec.task_id, index + 1)
                 payload = self._package_value(
                     oid, item, recipient=spec.owner_address)
                 self.cw.report_generator_item(spec, index, payload, done=False)
+                if index < span_cap:
+                    # per-chunk spans (decode steps for serve.llm): each
+                    # covers produce->reported; capped — a long stream's
+                    # tail adds volume, not shape
+                    t_now = time.time()
+                    _tracing.record_span(
+                        "task.stream_item", trace_ctx, t_prev, t_now,
+                        attrs={"task_id": spec.task_id.hex(),
+                               "index": index})
+                    t_prev = t_now
                 index += 1
             self.cw.report_generator_item(spec, index, None, done=True)
             return {"status": "ok", "returns": [], "streaming_num_items": index}

@@ -75,7 +75,8 @@ def test_deadline_rides_the_wire_as_remaining_time():
         function_name="f", deadline_s=time.time() + 30.0)
     wire = spec_to_wire(spec)
     # the wire carries REMAINING seconds, not an absolute instant
-    assert wire[-1] == pytest.approx(30.0, abs=1.0)
+    # (slot 25; ISSUE 11 appended the trace context after it)
+    assert wire[25] == pytest.approx(30.0, abs=1.0)
     back = spec_from_wire(wire)
     assert back.deadline_s == pytest.approx(spec.deadline_s, abs=1.0)
     # no deadline stays no deadline
@@ -211,6 +212,33 @@ def test_expired_work_dropped_at_queue_pop(overload_cluster):
     assert evs, "no task.deadline_expired event recorded"
     assert all((e.get("data") or {}).get("layer") in
                ("owner", "raylet", "worker") for e in evs)
+
+
+def test_expired_drop_is_never_retried(overload_cluster):
+    """A worker-layer deadline drop rides the error-reply shape, but it
+    must NOT consume retry_exceptions retries: the requeued spec would
+    keep its already-expired absolute deadline, so every retry is a
+    guaranteed futile lease+push round trip (retry amplification of
+    doomed work — the review find on ISSUE 11)."""
+    from ray_tpu._private import event_log
+    from ray_tpu.util.state import list_cluster_events
+
+    @ray_tpu.remote
+    def blocker():
+        time.sleep(0.6)
+
+    blockers = [blocker.remote() for _ in range(6)]
+    doomed = _fresh_fn("retried_doomed", 1).options(
+        deadline_s=0.1, retry_exceptions=True, max_retries=3).remote()
+    with pytest.raises(DeadlineExceededError):
+        ray_tpu.get(doomed, timeout=20)
+    ray_tpu.get(blockers)
+    event_log.flush(timeout=2.0)
+    task_hex = doomed.object_id().task_id().hex()
+    retries = [e for e in list_cluster_events(etype="task.retry",
+                                              task_id=task_hex,
+                                              limit=100)]
+    assert retries == [], retries
 
 
 def test_actor_call_expired_at_worker_pop(overload_cluster):

@@ -1,0 +1,86 @@
+"""Bounded tracing smoke for CI (ISSUE 11 satellite).
+
+Brings up an in-process cluster + a one-replica serve app, sends ONE
+traced HTTP request (sampled traceparent), and asserts the GCS span
+store holds a span tree for it spanning at least MIN_SPANS spans and
+MIN_PROCS distinct proc labels (proxy shard, owner, replica worker, ...)
+— the end-to-end guarantee `ray-tpu trace` depends on: trace context on
+the wire, spans collected cluster-wide, response header attribution.
+
+Exit 0 on success; nonzero (with the observed spans printed) on any
+missed link. Budgeted: the whole run is bounded by --budget seconds.
+
+Usage: JAX_PLATFORMS=cpu python -m tools.tracing_smoke [--budget 120]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+import urllib.request
+
+MIN_SPANS = 6
+MIN_PROCS = 3
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--budget", type=float, default=120.0)
+    args = parser.parse_args()
+    deadline = time.monotonic() + args.budget
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu._private import tracing
+    from ray_tpu._private.rpc import find_free_port
+
+    ray_tpu.init(num_cpus=4)
+    try:
+        @serve.deployment
+        def smoke(arg):
+            return {"ok": True}
+
+        port = find_free_port()
+        serve.run(smoke.bind(), name="tracing_smoke",
+                  route_prefix="/smoke", http_port=port)
+
+        ctx = tracing.start_trace(sampled=True)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/smoke",
+            headers={"traceparent": ctx.traceparent()})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            if r.headers.get("X-Trace-Id") != ctx.trace_id:
+                print(f"FAIL: X-Trace-Id {r.headers.get('X-Trace-Id')!r} "
+                      f"!= sent trace id {ctx.trace_id}")
+                return 1
+
+        cw = ray_tpu._raylet.get_core_worker()
+        spans = []
+        while time.monotonic() < deadline:
+            tracing.flush_spans(timeout=1.0)
+            reply = cw._gcs.call("get_trace", {"trace_id": ctx.trace_id})
+            spans = reply.get("spans") or []
+            procs = {s.get("proc") for s in spans}
+            if len(spans) >= MIN_SPANS and len(procs) >= MIN_PROCS:
+                print(f"tracing smoke OK: {len(spans)} spans across "
+                      f"{len(procs)} procs ({', '.join(sorted(procs))})")
+                print(tracing.format_trace(spans))
+                return 0
+            time.sleep(0.5)
+        procs = {s.get("proc") for s in spans}
+        print(f"FAIL: only {len(spans)} span(s) across {len(procs)} "
+              f"proc(s) within the budget (need >={MIN_SPANS} spans, "
+              f">={MIN_PROCS} procs)")
+        print(tracing.format_trace(spans))
+        return 1
+    finally:
+        try:
+            serve.shutdown()
+        except Exception:  # noqa: BLE001 — teardown best effort
+            pass
+        ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
