@@ -121,6 +121,16 @@ EVENT_SCHEMAS: Dict[str, Tuple[str, ...]] = {
     # tail trigger (error / deadline_expired / shed / latency p99 breach)
     # — the GCS span store promotes its provisional spans on this mark
     "trace.force": ("reason",),
+    # serve control-plane fault tolerance (ISSUE 12): the controller
+    # write-throughs its reconcile state into the GCS KV on every
+    # mutation, and a restarted incarnation ADOPTS live replicas/proxy
+    # shards instead of restarting them. controller_recover is the
+    # recovery anchor the controller_kill drill's MTTR pairs against;
+    # replica_adopted events prove the data plane was never touched.
+    "serve.controller_checkpoint": ("incarnation", "reason"),
+    "serve.controller_recover": ("incarnation", "adopted_replicas",
+                                 "restarted_replicas"),
+    "serve.replica_adopted": ("replica_id", "incarnation"),
 }
 
 _ID_KEYS = ("task_id", "actor_id", "node_id", "object_id", "trace_id")

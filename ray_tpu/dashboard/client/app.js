@@ -365,8 +365,27 @@ async function pageServe() {
   catch { return `<h2>Serve</h2><p class="muted">serve is not running
     (or the controller is unreachable).</p>`; }
   const apps = Object.entries(s.applications || {});
+  // control-plane FT posture: incarnation, checkpoint freshness, and
+  // the last recovery's adopted-vs-restarted replica split
+  let ctl = "";
+  if (s.controller) {
+    const c = s.controller;
+    const age = c.last_checkpoint_age_s;
+    const bits = [`incarnation ${esc(String(c.incarnation))}`,
+                  `${esc(String(c.checkpoints_written || 0))} checkpoint(s)` +
+                  (age != null ? ` (last ${Number(age).toFixed(1)}s ago)`
+                              : "")];
+    if (c.recovered_at) {
+      bits.push(`last recovery adopted ` +
+        `${esc(String(c.adopted_replicas || 0))} replica(s) + ` +
+        `${esc(String(c.adopted_proxies || 0))} proxy shard(s), ` +
+        `${esc(String(c.restarted_replicas || 0))} restarted`);
+    }
+    ctl = `<p class="muted">controller: ${bits.join(" · ")}</p>`;
+  }
   if (!apps.length) {
-    return `<h2>Serve</h2><p class="muted">no applications deployed.</p>`;
+    return `<h2>Serve</h2>${ctl}
+      <p class="muted">no applications deployed.</p>`;
   }
   const rows = [];
   for (const [app, info] of apps) {
@@ -379,7 +398,7 @@ async function pageServe() {
       ]);
     }
   }
-  return `<h2>Serve</h2>` + table(
+  return `<h2>Serve</h2>` + ctl + table(
     ["application", "deployment", "status", "replicas", "message"], rows);
 }
 

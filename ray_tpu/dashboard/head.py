@@ -369,14 +369,27 @@ class DashboardHead:
             return None
 
     def _serve_status(self, req) -> Dict[str, Any]:
-        """Serve application/deployment states for the Serve page."""
+        """Serve application/deployment states for the Serve page, plus
+        the control plane's FT posture (ISSUE 12): controller
+        incarnation, checkpoint freshness, and the last recovery's
+        adopted-vs-restarted replica split."""
         self._jobs_client()  # ensures a connected driver
         from ray_tpu.serve import api as serve_api
 
         try:
-            return {"applications": serve_api.status()}
+            out: Dict[str, Any] = {"applications": serve_api.status()}
         except Exception:  # noqa: BLE001 — serve not running
             return {"applications": {}}
+        try:
+            import ray_tpu
+            from ray_tpu.serve.context import CONTROLLER_NAME
+
+            controller = ray_tpu.get_actor(CONTROLLER_NAME)
+            out["controller"] = ray_tpu.get(
+                controller.get_recovery_info.remote(), timeout=5)
+        except Exception:  # noqa: BLE001 — controller down mid-recovery
+            pass
+        return out
 
     # -- data ----------------------------------------------------------------
 

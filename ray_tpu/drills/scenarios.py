@@ -32,6 +32,13 @@ Scenario inventory:
                             training gang: checkpoint-and-drain, then
                             reschedule onto a fresh placement group with
                             loss continuity.
+* controller_kill         — crash-style kill of the serve CONTROLLER
+                            under sustained HTTP load: the data plane
+                            must keep serving from cached replica sets
+                            while the restarted incarnation recovers
+                            from its GCS-KV checkpoint and ADOPTS the
+                            live replicas (zero healthy-replica
+                            restarts, zero lost-accepted requests).
 * overload_storm          — no fault at all: offered HTTP load jumps to
                             >=3x the workload's sustained capacity while
                             a deadline-carrying task flood hits the
@@ -127,6 +134,42 @@ class ReplicaKillScenario(Scenario):
         logger.warning("drill: killing replica actor %s",
                        detail["target_actor"][:12])
         ray_tpu.kill(self._victim)
+
+
+class ControllerKillScenario(Scenario):
+    """Kill the serve control plane, not the data plane: the controller
+    actor dies crash-style (kill with no_restart=False → unintended
+    death → GCS restart FSM) while HTTP load flows. Recovery is the
+    restarted incarnation's `serve.controller_recover` event; the
+    verdict additionally gates ADOPTION (thresholds
+    max_replicas_restarted / require_adoption over slo["controller"]):
+    every pre-kill replica must be re-resolved and health-checked into
+    the new incarnation, never restarted."""
+
+    name = "controller_kill"
+    workload_kind = "serving"
+
+    def __init__(self):
+        self._victim = None
+
+    def prepare(self, ctx: DrillContext) -> Dict[str, Any]:
+        controller = ctx.workload.controller
+        info = ray_tpu.get(controller.get_recovery_info.remote(),
+                           timeout=30)
+        replicas = ray_tpu.get(controller.list_replica_nodes.remote(),
+                               timeout=30)
+        if not replicas:
+            raise RuntimeError("no live replicas to survive the "
+                               "controller kill")
+        self._victim = controller
+        return {"target_actor": controller._actor_id.hex(),
+                "incarnation": int(info["incarnation"]),
+                "replicas": len(replicas)}
+
+    def execute(self, ctx: DrillContext, detail: Dict[str, Any]) -> None:
+        logger.warning("drill: killing serve controller %s (restartable)",
+                       detail["target_actor"][:12])
+        ray_tpu.kill(self._victim, no_restart=False)
 
 
 class GcsPartitionScenario(Scenario):
@@ -348,6 +391,7 @@ class OverloadStormScenario(Scenario):
 SCENARIO_CLASSES = {
     cls.name: cls for cls in (
         ReplicaKillScenario,
+        ControllerKillScenario,
         GcsPartitionScenario,
         ProxyRollingRestartScenario,
         NodePreemptServeScenario,

@@ -164,6 +164,17 @@ def _recover_gang_reschedule(injection: dict,
     return None
 
 
+def _recover_controller(injection: dict,
+                        post: List[dict]) -> Optional[dict]:
+    """A killed serve controller is recovered when a restarted
+    incarnation finishes checkpoint recovery + adoption — its
+    `serve.controller_recover` event (emitted after live replicas/proxy
+    shards were re-resolved and health-checked, before the reconcile
+    loop starts)."""
+    return next((ev for ev in post
+                 if ev.get("type") == "serve.controller_recover"), None)
+
+
 def _storm_end(post: List[dict]) -> Optional[dict]:
     return next((ev for ev in post
                  if ev.get("type") == "drill.phase"
@@ -201,6 +212,7 @@ def _recover_overload(injection: dict, post: List[dict]) -> Optional[dict]:
 
 RECOVERY_MATCHERS: Dict[str, Callable[[dict, List[dict]], Optional[dict]]] = {
     "replica_kill": _recover_replacement_replica,
+    "controller_kill": _recover_controller,
     "gcs_partition": _recover_node_alive,
     "proxy_rolling_restart": _recover_rolling_proxies,
     "node_preempt_serve": _recover_replacement_replica,
@@ -317,14 +329,49 @@ def overload_slo(events: List[dict], scenario: str) -> Optional[Dict[str, Any]]:
     }
 
 
+def controller_slo(events: List[dict],
+                   scenario: str) -> Optional[Dict[str, Any]]:
+    """Control-plane recovery SLOs for controller_kill-style scenarios,
+    from the event timeline alone: the recovered incarnation, its
+    adopted-vs-restarted split (the recover event's data), and the
+    number of FRESH replica actors started post-injection —
+    `fresh_replicas_started` is the zero-healthy-replica-restarts proof
+    (with no replica faults injected, any fresh ReplicaActor means the
+    recovered controller restarted something it should have adopted).
+    None when the timeline carries no controller recovery."""
+    injections = find_injections(events, scenario)
+    if not injections:
+        return None
+    inj = injections[-1]
+    post = _after(events, inj)
+    rec = _recover_controller(inj, post)
+    if rec is None:
+        return None
+    d = _data(rec)
+    return {
+        "incarnation": d.get("incarnation"),
+        "adopted_replicas": int(d.get("adopted_replicas", 0) or 0),
+        "restarted_replicas": int(d.get("restarted_replicas", 0) or 0),
+        "adopted_proxies": int(d.get("adopted_proxies", 0) or 0),
+        "replica_adopted_events": sum(
+            1 for e in post if e.get("type") == "serve.replica_adopted"),
+        "fresh_replicas_started": len(
+            _fresh_actor_ids(post, REPLICA_CLASS_MARKER)),
+        "checkpoints_after_recovery": sum(
+            1 for e in post
+            if e.get("type") == "serve.controller_checkpoint"),
+    }
+
+
 # -- report + verdict ---------------------------------------------------------
 
 def evaluate_thresholds(slo: Dict[str, Any],
                         thresholds: Dict[str, Any]) -> List[str]:
     """Threshold keys (drills/thresholds.json, per scenario):
     mttr_max_s, availability_min, max_lost_accepted,
-    require_checkpoint_drain. Returns the list of failures (empty =
-    verdict passes)."""
+    require_checkpoint_drain, max_replicas_restarted, require_adoption,
+    goodput_min_frac, max_flood_lost. Returns the list of failures
+    (empty = verdict passes)."""
     failures = []
     mttr_max = thresholds.get("mttr_max_s")
     if mttr_max is not None:
@@ -355,6 +402,25 @@ def evaluate_thresholds(slo: Dict[str, Any],
             and not slo.get("checkpoint_drains")):
         failures.append("no gang.checkpoint_drain event "
                         "(gang did not drain on notice)")
+    max_restarted = thresholds.get("max_replicas_restarted")
+    require_adoption = thresholds.get("require_adoption")
+    if max_restarted is not None or require_adoption:
+        ctl = slo.get("controller")
+        if not ctl:
+            failures.append("no controller recovery recorded "
+                            "in the timeline")
+        else:
+            if (max_restarted is not None
+                    and ctl.get("fresh_replicas_started", 0)
+                    > max_restarted):
+                failures.append(
+                    f"{ctl['fresh_replicas_started']} fresh replica(s) "
+                    f"started during controller recovery — healthy "
+                    f"replicas must be ADOPTED, not restarted "
+                    f"(max {max_restarted})")
+            if require_adoption and ctl.get("adopted_replicas", 0) < 1:
+                failures.append(
+                    "recovered controller adopted no replicas")
     goodput_min = thresholds.get("goodput_min_frac")
     if goodput_min is not None:
         storm = slo.get("overload")
@@ -434,6 +500,9 @@ def compute_report(events: List[dict], scenario: str, seed: int,
     storm = overload_slo(events, scenario)
     if storm is not None:
         slo["overload"] = storm
+    ctl = controller_slo(events, scenario)
+    if ctl is not None:
+        slo["controller"] = ctl
     failures = evaluate_thresholds(slo, thresholds)
     return {
         "schema": "ray_tpu.drill_report/1",

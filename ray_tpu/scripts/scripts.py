@@ -266,6 +266,31 @@ def cmd_status(args) -> int:
         for etype, counts in sorted(layers.items()):
             detail = ", ".join(f"{k}={v}" for k, v in sorted(counts.items()))
             print(f"  {etype:<24} recent: {detail}")
+    # Serve control plane (ISSUE 12): incarnation + checkpoint freshness
+    # + the last recovery's adopted-vs-restarted split — the numbers an
+    # operator checks after a controller crash/restart.
+    try:
+        from ray_tpu.serve.context import CONTROLLER_NAME
+
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        info = ray_tpu.get(controller.get_recovery_info.remote(),
+                           timeout=5)
+    except Exception:  # noqa: BLE001 — serve not running
+        info = None
+    if info:
+        age = info.get("last_checkpoint_age_s")
+        freshness = (f"last {age:.1f}s ago" if age is not None
+                     else "no checkpoint yet")
+        print(f"\nServe control plane: incarnation "
+              f"{info.get('incarnation')}, "
+              f"{info.get('checkpoints_written', 0)} checkpoint(s), "
+              f"{freshness}")
+        if info.get("recovered_at"):
+            print(f"  last recovery: adopted "
+                  f"{info.get('adopted_replicas', 0)} replica(s) + "
+                  f"{info.get('adopted_proxies', 0)} proxy shard(s), "
+                  f"{info.get('restarted_replicas', 0)} reconciled "
+                  f"(restarted)")
     return 0
 
 
@@ -1242,6 +1267,12 @@ def _print_drill_report(report: dict) -> None:
     if s.get("preempt_notices") or s.get("checkpoint_drains"):
         print(f"  preemption  : {s['preempt_notices']} notice(s), "
               f"{s['checkpoint_drains']} gang drain(s)")
+    ctl = s.get("controller")
+    if ctl:
+        print(f"  controller  : incarnation {ctl.get('incarnation')} "
+              f"adopted={ctl.get('adopted_replicas')} "
+              f"restarted={ctl.get('restarted_replicas')} "
+              f"fresh_replicas={ctl.get('fresh_replicas_started')}")
     for row in s["timeline"]:
         print(f"    inject {row['detail']} -> "
               f"{row['recovery_type'] or 'NO RECOVERY'} "

@@ -19,17 +19,33 @@ health flip) — scale-downs reach routers in one RPC latency instead of a
 poll interval. Replies piggyback the controller's latest per-replica
 ongoing-request counts so routers never probe queue lengths on the
 request path.
+
+CRASH TOLERANCE (ISSUE 12): the controller is a named actor with
+max_restarts=-1, and every reconcile-relevant mutation write-throughs a
+schema-versioned checkpoint into the GCS internal KV (reference: ray's
+serve controller snapshots into the GCS-backed KV and recovers from it,
+arXiv:1712.05889 §4.3). A restarted incarnation loads the checkpoint and
+ADOPTS its live, named replicas and proxy shards — health-check, not
+restart — so a controller crash never touches the data plane: routers
+keep serving from cached replica sets while it is down (paced re-resolve
+in router.py), and the recovered controller's pushes carry a bumped
+incarnation so a zombie's stale pushes are rejected. Preempt/drain
+bookkeeping is NOT checkpointed per tick; it rebuilds from the event log
+(node.preempt_notice replay via EventCursor) so a death mid-preemption
+cannot leak a draining replica.
 """
 
 from __future__ import annotations
 
 import logging
 import math
+import pickle
 import threading
 import time
 from typing import Any, Dict, List, Optional
 
 import ray_tpu
+from ray_tpu._private import event_log
 from ray_tpu._private.event_watch import EventCursor
 from ray_tpu.serve._private.replica import ReplicaActor
 
@@ -44,6 +60,64 @@ HEALTH_CHECK_INTERVAL_S = 2.0
 # probing during init killed LLM replicas mid-compile).
 REPLICA_INIT_TIMEOUT_S = 300.0
 HEALTH_CHECK_FAILURE_THRESHOLD = 3
+
+# -- controller checkpoint (GCS internal KV) ---------------------------------
+#
+# One envelope, write-through on every mutation (the _checkpoint helper —
+# CONTRIBUTING: controller state mutations MUST route through it; a
+# fixture test in tests/test_serve_controller_ft.py enforces the list).
+# The envelope is schema-versioned so OLD checkpoints decode forward: the
+# restore path reads every field with a default, and unknown future
+# fields are ignored, so a rolling upgrade never bricks recovery.
+CKPT_SCHEMA = "ray_tpu.serve_controller_ckpt"
+CKPT_VERSION = 1
+CKPT_NAMESPACE = b"serve"
+CKPT_KEY = b"controller_checkpoint"
+# replica actor-name prefix: adoption resolves these as named actors
+REPLICA_NAME_PREFIX = "SERVE_REPLICA:"
+
+
+def proxy_shard_name(port: int, idx: int) -> str:
+    """THE proxy-shard actor name (creation and adoption both resolve
+    through this): format drift between the two would silently turn
+    every recovery into a full proxy-fleet restart."""
+    return f"SERVE_PROXY:{port}:{idx}"
+# how far back a recovered controller replays node.preempt_notice events
+# to rebuild _preempted_nodes (covers the longest drain window plus the
+# cursor's own clock-skew slack)
+PREEMPT_REPLAY_WINDOW_S = 45.0
+
+
+def encode_checkpoint(state: Dict[str, Any]) -> bytes:
+    # cloudpickle, not stdlib pickle: deployment configs legitimately
+    # carry local closures (serve.llm app builders, user init args) that
+    # stdlib pickle refuses
+    import cloudpickle
+
+    env = {"schema": CKPT_SCHEMA, "version": CKPT_VERSION}
+    env.update(state)
+    return cloudpickle.dumps(env, protocol=5)
+
+
+def decode_checkpoint(blob: Optional[bytes]) -> Optional[Dict[str, Any]]:
+    """Decode a checkpoint envelope; None for missing/foreign/torn blobs.
+    Version gate is FORWARD-compatible: any version <= CKPT_VERSION
+    decodes (fields read with defaults), a NEWER version is refused —
+    an old controller must not half-apply state it doesn't understand."""
+    if not blob:
+        return None
+    try:
+        env = pickle.loads(blob)  # cloudpickle emits pickle-loadable blobs
+    except Exception:  # noqa: BLE001 — torn/garbage blob: start fresh
+        return None
+    if not isinstance(env, dict) or env.get("schema") != CKPT_SCHEMA:
+        return None
+    if int(env.get("version", 0)) > CKPT_VERSION:
+        logger.warning(
+            "serve controller checkpoint is version %s (> understood %s); "
+            "ignoring it", env.get("version"), CKPT_VERSION)
+        return None
+    return env
 
 
 class _ReplicaState:
@@ -116,18 +190,346 @@ class ServeController:
         # would then kill the "fresh" handle, and its ready() barrier
         # would probe a corpse while the next shard goes down too
         self._proxy_rolling: set = set()
-        # node.preempt_notice watcher (shared event-log poll protocol)
-        self._preempt_cursor = EventCursor("node.preempt_notice")
         # node_id -> monotonic drain expiry for nodes under an active
         # preemption notice: a replica that finishes STARTING on one of
         # these after the notice sweep must drain immediately, not serve
         # until the raylet's hard deadline kills it mid-request
         self._preempted_nodes: Dict[str, float] = {}
         self._shutdown = threading.Event()
+        # -- crash tolerance (ISSUE 12) ---------------------------------
+        # monotonic across controller incarnations: stamped on every
+        # long-poll reply and route push so routers/shards reject a
+        # zombie's stale pushes after a recovery
+        self._incarnation = 1
+        self._ckpt_lock = threading.Lock()
+        self._ckpt_seq = 0          # snapshot counter (under self._lock)
+        self._ckpt_written_seq = 0  # newest seq persisted (ckpt lock)
+        self._ckpt_count = 0
+        self._last_checkpoint_at = 0.0
+        self._recovered_at = 0.0
+        self._adopted_replicas = 0
+        self._restarted_replicas = 0
+        self._adopted_proxies = 0
+        preempt_since: Optional[float] = None
+        ckpt = self._load_checkpoint()
+        if ckpt is not None:
+            self._restore(ckpt)
+            # a death mid-preemption must not leak a draining replica:
+            # replay recent notices so _preempted_nodes (and the by-node
+            # drains) rebuild from the event log, with the REMAINING
+            # window computed from each notice's emit time
+            preempt_since = time.time() - PREEMPT_REPLAY_WINDOW_S
+        # node.preempt_notice watcher (shared event-log poll protocol)
+        self._preempt_cursor = EventCursor("node.preempt_notice",
+                                           since=preempt_since)
+        # the reconcile thread starts only after adoption settled, so it
+        # cannot race recovery into starting replacement replicas
         self._reconcile_thread = threading.Thread(
             target=self._run_control_loop, name="serve-controller",
             daemon=True)
         self._reconcile_thread.start()
+
+    # -- checkpoint / recovery ----------------------------------------------
+
+    def _load_checkpoint(self) -> Optional[Dict[str, Any]]:
+        from ray_tpu.experimental.internal_kv import internal_kv_get
+
+        try:
+            blob = internal_kv_get(CKPT_KEY, namespace=CKPT_NAMESPACE)
+        except Exception:  # noqa: BLE001 — KV unreachable: start fresh
+            logger.exception("serve controller checkpoint load failed")
+            return None
+        return decode_checkpoint(blob)
+
+    def _checkpoint(self, reason: str) -> None:
+        """THE write-through helper: serialize the reconcile-relevant
+        state and persist it in the GCS internal KV (append-log backed).
+        Called on every mutation — deploy/delete/scale/roll/replica
+        start-stop/shard change — never on a timer, so the checkpoint is
+        at most one mutation behind the live state. Per-snapshot seq +
+        a write lock keep concurrent writers from persisting an older
+        snapshot over a newer one. Failures are logged, never raised:
+        losing one checkpoint write degrades recovery, not serving."""
+        from ray_tpu.experimental.internal_kv import internal_kv_put
+
+        if self._shutdown.is_set():
+            return
+        with self._lock:
+            self._ckpt_seq += 1
+            seq = self._ckpt_seq
+            blob = encode_checkpoint(self._snapshot_state())
+        try:
+            with self._ckpt_lock:
+                if seq <= self._ckpt_written_seq:
+                    return  # a newer snapshot already landed
+                if self._shutdown.is_set():
+                    # shutdown deletes the checkpoint; a write racing
+                    # past the entry check must not resurrect it (the
+                    # delete happens-after the shutdown flag is set, so
+                    # this re-check under the write lock is sufficient)
+                    return
+                internal_kv_put(CKPT_KEY, blob, namespace=CKPT_NAMESPACE)
+                self._ckpt_written_seq = seq
+                self._ckpt_count += 1
+                self._last_checkpoint_at = time.time()
+        except Exception:  # noqa: BLE001 — must not break the control loop
+            logger.exception("serve controller checkpoint write failed "
+                             "(reason=%s)", reason)
+            return
+        event_log.emit("serve.controller_checkpoint",
+                       incarnation=self._incarnation, reason=reason,
+                       bytes=len(blob))
+
+    def _snapshot_state(self) -> Dict[str, Any]:
+        """Reconcile-relevant state only (caller holds self._lock).
+        Replica HANDLES are never serialized — adoption re-resolves each
+        replica's named actor (REPLICA_NAME_PREFIX + replica_id)."""
+        deployments = {}
+        for key, s in self._deployments.items():
+            deployments[key] = {
+                "app": s.app,
+                "name": s.name,
+                "config": s.config,
+                "target_num_replicas": s.target_num_replicas,
+                "next_replica_idx": s.next_replica_idx,
+                "replicas": [
+                    {"replica_id": r.replica_id, "version": r.version,
+                     "state": r.state, "node_id": r.node_id}
+                    for r in s.replicas
+                    if r.state in (_ReplicaState.STARTING,
+                                   _ReplicaState.RUNNING,
+                                   _ReplicaState.DRAINING)],
+            }
+        return {
+            "incarnation": self._incarnation,
+            "saved_at": time.time(),
+            "seq": self._ckpt_seq,
+            "apps": dict(self._apps),
+            "deployments": deployments,
+            "versions": dict(self._versions),
+            "proxy": {"config": (dict(self._proxy_config)
+                                 if self._proxy_config else None),
+                      "shards": sorted(self._proxy_shards)},
+        }
+
+    def _restore(self, ckpt: Dict[str, Any]) -> None:
+        """Recovery with ADOPTION: rebuild target state from the
+        checkpoint, then resolve each recorded replica / proxy shard as a
+        named actor and health-check it. Healthy replicas are adopted
+        as-is (same actor, same PID — never restarted); missing or
+        unhealthy ones are dropped here and reconciled normally by the
+        control loop. Every field reads with a default so an OLD envelope
+        (earlier schema version) decodes forward."""
+        self._incarnation = int(ckpt.get("incarnation", 0) or 0) + 1
+        self._apps = dict(ckpt.get("apps") or {})
+        self._versions = dict(ckpt.get("versions") or {})
+        adopted, lost = 0, 0
+        for key, rec in (ckpt.get("deployments") or {}).items():
+            state = _DeploymentState(rec.get("app", ""),
+                                     rec.get("name", ""),
+                                     rec.get("config") or {})
+            state.target_num_replicas = int(
+                rec.get("target_num_replicas",
+                        state.target_num_replicas))
+            state.next_replica_idx = int(rec.get("next_replica_idx", 0))
+            self._deployments[key] = state
+            a, l = self._adopt_replicas(state, rec.get("replicas") or [])
+            adopted += a
+            lost += l
+        self._adopted_replicas = adopted
+        self._restarted_replicas = lost
+        self._reap_orphan_replicas(ckpt)
+        self._restore_proxies(ckpt.get("proxy") or {})
+        # wake every parked/reconnecting router with a fresh snapshot;
+        # versions continue monotonically from the checkpoint, so a
+        # router's last_version stays meaningful across the recovery
+        for key in list(self._deployments):
+            self._bump(key)
+        self._recovered_at = time.time()
+        event_log.emit("serve.controller_recover",
+                       incarnation=self._incarnation,
+                       adopted_replicas=adopted,
+                       restarted_replicas=lost,
+                       adopted_proxies=self._adopted_proxies)
+        logger.warning(
+            "serve controller recovered (incarnation %d): adopted %d "
+            "replica(s) + %d proxy shard(s), %d lost to reconcile",
+            self._incarnation, adopted, self._adopted_proxies, lost)
+        # recovery is itself a mutation of record: persist the bumped
+        # incarnation immediately so a crash loop cannot reuse one
+        self._checkpoint("recover")
+
+    def _adopt_replicas(self, state: _DeploymentState,
+                        records: List[Dict[str, Any]]) -> tuple:
+        """Resolve + health-check one deployment's checkpointed replicas.
+        Fan out the probes, harvest with one bounded wait (recovery must
+        not serialize on a wedged replica). Returns (adopted, lost).
+
+        STARTING records are special: their check_health is queued behind
+        a possibly-minutes-long user __init__ (REPLICA_INIT_TIMEOUT_S is
+        300s for a reason), so probing them on the adoption clock would
+        kill every mid-compile LLM replica a controller crash overlaps.
+        They re-adopt as STARTING with a fresh init deadline and the
+        usual init_ref; _check_starting promotes or times them out.
+
+        The probe is a LIVENESS gate, not a health verdict: only a
+        provably dead actor is dropped here. A probe that times out
+        (replica saturated with long streams — its mailbox is
+        max_ongoing deep) or raises a user health-check error adopts
+        the replica with ONE strike and lets the steady-state health
+        loop apply its usual 3-consecutive-failures rule — adoption
+        must never be stricter than the health checking it resumes."""
+        probes = []
+        adopted, lost = 0, 0
+        for rec in records:
+            rid = rec.get("replica_id", "")
+            try:
+                handle = ray_tpu.get_actor(REPLICA_NAME_PREFIX + rid)
+                if rec.get("state") == _ReplicaState.STARTING:
+                    r = _ReplicaState(handle, rid,
+                                      version=rec.get("version", ""))
+                    r.node_id = rec.get("node_id")
+                    r.init_ref = handle.check_health.remote()
+                    state.replicas.append(r)
+                    adopted += 1
+                    event_log.emit("serve.replica_adopted",
+                                   replica_id=rid,
+                                   incarnation=self._incarnation,
+                                   deployment=state.full_name,
+                                   state=r.state)
+                    continue
+                probes.append((rec, handle,
+                               handle.check_health.remote()))
+            except Exception:  # noqa: BLE001 — dead at resolve OR at
+                # first-contact submit (DEAD actors raise synchronously
+                # from .remote()): reconcile a replacement
+                probes.append((rec, None, None))
+        refs = [ref for _, _, ref in probes if ref is not None]
+        done_set = set()
+        if refs:
+            try:
+                done, _ = ray_tpu.wait(refs, num_returns=len(refs),
+                                       timeout=10.0)
+                done_set = set(done)
+            except Exception:  # noqa: BLE001
+                pass
+        for rec, handle, ref in probes:
+            dead = ref is None
+            strikes = 0
+            if not dead and ref in done_set:
+                try:
+                    ray_tpu.get(ref, timeout=0.5)
+                except ray_tpu.exceptions.RayActorError:
+                    dead = True
+                except Exception:  # noqa: BLE001 — live actor, failing
+                    # user check: one strike, the health loop decides
+                    strikes = 1
+            elif not dead:
+                # probe timed out: busy (long streams queue ahead of
+                # it), not dead — one strike, never a one-shot kill
+                strikes = 1
+            if dead:
+                lost += 1
+                logger.warning("replica %s not adoptable; will reconcile",
+                               rec.get("replica_id"))
+                continue
+            r = _ReplicaState(handle, rec.get("replica_id", ""),
+                              version=rec.get("version", ""))
+            r.consecutive_failures = strikes
+            if rec.get("state") == _ReplicaState.DRAINING:
+                # resume the drain (deadline re-capped; a preempt-notice
+                # replay may tighten it to the remaining notice window)
+                r.state = _ReplicaState.DRAINING
+                r.drain_since = time.monotonic()
+                r.drain_deadline = r.drain_since + self.DRAIN_DEADLINE_S
+            else:
+                r.state = _ReplicaState.RUNNING
+            r.node_id = rec.get("node_id")
+            state.replicas.append(r)
+            adopted += 1
+            event_log.emit("serve.replica_adopted",
+                           replica_id=r.replica_id,
+                           incarnation=self._incarnation,
+                           deployment=state.full_name, state=r.state)
+        return adopted, lost
+
+    def _reap_orphan_replicas(self, ckpt: Dict[str, Any]) -> None:
+        """Kill SERVE_REPLICA-named actors the checkpoint does not know:
+        a crash between actor creation and the id-reserving checkpoint
+        write leaves a live, unrecorded replica — unsupervised, holding
+        its name and resources forever if nothing reaps it."""
+        from ray_tpu._raylet import get_core_worker
+
+        known = {REPLICA_NAME_PREFIX + r.get("replica_id", "")
+                 for rec in (ckpt.get("deployments") or {}).values()
+                 for r in rec.get("replicas") or []}
+        try:
+            named = get_core_worker()._gcs.call(
+                "list_named_actors", {"namespace": ""}, timeout=10.0)
+        except Exception:  # noqa: BLE001 — listing is best-effort
+            logger.debug("orphan replica sweep: listing failed",
+                         exc_info=True)
+            return
+        for entry in named or []:
+            name = entry.get("name", "")
+            if not name.startswith(REPLICA_NAME_PREFIX) or name in known:
+                continue
+            logger.warning("reaping orphan replica actor %s "
+                           "(not in the recovered checkpoint)", name)
+            try:
+                ray_tpu.kill(ray_tpu.get_actor(name))
+            except Exception:  # noqa: BLE001 — already gone
+                pass
+
+    def _restore_proxies(self, rec: Dict[str, Any]) -> None:
+        """Adopt live proxy shards by name; missing ones are respawned by
+        _check_proxies' missing-shard sweep on the first health tick."""
+        cfg = rec.get("config")
+        if not cfg:
+            return
+        self._proxy_config = dict(cfg)
+        now = time.monotonic()
+        for idx in rec.get("shards") or []:
+            try:
+                shard = ray_tpu.get_actor(proxy_shard_name(cfg["port"],
+                                                           idx))
+            except Exception:  # noqa: BLE001 — sweep respawns it
+                logger.warning("proxy shard %s not adoptable; will respawn",
+                               idx)
+                continue
+            self._proxy_shards[idx] = shard
+            self._proxy_started_at[idx] = now
+            self._adopted_proxies += 1
+        # re-push routes with the bumped incarnation so shards drop any
+        # stale push a zombie incarnation might still have in flight —
+        # fire-and-forget, NO harvest: the shard's update_routes pulls
+        # list_applications back from THIS actor, which cannot serve the
+        # call until __init__ returns, so waiting here (as
+        # update_proxy_routes does) would deterministically burn its
+        # full timeout into every recovery's MTTR
+        for shard in self._proxy_shards.values():
+            try:
+                shard.update_routes.remote(incarnation=self._incarnation)
+            except Exception:  # noqa: BLE001 — dead shard: sweep respawns
+                pass
+
+    def get_recovery_info(self) -> Dict[str, Any]:
+        """Control-plane FT observability (`ray-tpu status`, dashboard,
+        drills): incarnation, checkpoint freshness, adoption counts."""
+        now = time.time()
+        with self._lock:
+            return {
+                "incarnation": self._incarnation,
+                "recovered_at": self._recovered_at or None,
+                "adopted_replicas": self._adopted_replicas,
+                "restarted_replicas": self._restarted_replicas,
+                "adopted_proxies": self._adopted_proxies,
+                "checkpoints_written": self._ckpt_count,
+                "last_checkpoint_at": self._last_checkpoint_at or None,
+                "last_checkpoint_age_s": (
+                    round(now - self._last_checkpoint_at, 3)
+                    if self._last_checkpoint_at else None),
+            }
 
     # -- API called by serve.run / handles ----------------------------------
 
@@ -173,6 +575,9 @@ class ServeController:
                 else:
                     self._deployments[key] = _DeploymentState(
                         app_name, cfg["name"], cfg)
+        # persist BEFORE the ready wait: a crash while replicas start
+        # must recover the deploy, not forget it
+        self._checkpoint("deploy")
         self._wait_for_ready(app_name)
         self.update_proxy_routes()
 
@@ -202,6 +607,7 @@ class ServeController:
                     for r in state.replicas:
                         self._stop_replica(r)
                     self._bump(state.full_name)
+        self._checkpoint("delete")
         self.update_proxy_routes()
 
     def _bump(self, key: str) -> None:
@@ -231,8 +637,11 @@ class ServeController:
                         if state is not None else [])
             metrics = {rid: self._replica_metrics.get(rid, 0)
                        for rid, _ in replicas}
+            incarnation = self._incarnation
+        # incarnation rides every reply: routers reject pushes from an
+        # older incarnation (zombie controller) after a recovery
         return {"version": version, "replicas": replicas,
-                "metrics": metrics}
+                "metrics": metrics, "incarnation": incarnation}
 
     def list_replica_nodes(self) -> Dict[str, str]:
         """replica_id -> node_id attribution for every live replica
@@ -276,7 +685,21 @@ class ServeController:
             }
 
     def shutdown(self) -> None:
+        # serve-ckpt: exempt — intentional teardown DELETES the
+        # checkpoint: the next controller must start fresh, not adopt
+        # replicas this shutdown is about to kill
+        from ray_tpu.experimental.internal_kv import internal_kv_del
+
         self._shutdown.set()
+        try:
+            # under _ckpt_lock: a writer already inside the lock finishes
+            # its put BEFORE this delete; any writer arriving after will
+            # re-check the (already set) shutdown flag under the same
+            # lock and skip — no write can land after the delete
+            with self._ckpt_lock:
+                internal_kv_del(CKPT_KEY, namespace=CKPT_NAMESPACE)
+        except Exception:  # noqa: BLE001 — best-effort teardown
+            logger.debug("checkpoint delete failed", exc_info=True)
         with self._change_cv:
             self._change_cv.notify_all()
         with self._lock:
@@ -307,6 +730,9 @@ class ServeController:
         notice window (_reap_draining kills on idle or deadline), and the
         reconcile loop starts replacements — which the scheduler places
         off the draining node. Returns the number of replicas drained."""
+        # serve-ckpt: exempt — _preempted_nodes rebuilds from the event
+        # log on recovery (node.preempt_notice replay); the per-replica
+        # DRAINING flips below checkpoint via _drain_replica
         n = 0
         with self._lock:
             states = list(self._deployments.values())
@@ -383,6 +809,7 @@ class ServeController:
             num_shards = max(1, num_shards)
             self._proxy_config = {"host": host, "port": port,
                                   "num_shards": num_shards}
+        self._checkpoint("proxy_config")
         for idx in range(num_shards):
             self._start_proxy_shard(idx)
         # bind failures surface here, not on the first request
@@ -401,7 +828,7 @@ class ServeController:
                 return
         try:
             shard = ray_tpu.remote(ProxyActor).options(
-                name=f"SERVE_PROXY:{cfg['port']}:{idx}",
+                name=proxy_shard_name(cfg["port"], idx),
                 lifetime="detached", num_cpus=0.1,
                 get_if_exists=True, max_concurrency=256,
             ).remote(host=cfg["host"], port=cfg["port"], shard_index=idx,
@@ -412,6 +839,7 @@ class ServeController:
         with self._lock:
             self._proxy_shards[idx] = shard
             self._proxy_started_at[idx] = time.monotonic()
+        self._checkpoint("proxy_shard")
 
     def get_http_proxy_handles(self) -> Dict[int, Any]:
         with self._lock:
@@ -423,10 +851,14 @@ class ServeController:
         gets fresh routes when _check_proxies restarts it)."""
         with self._lock:
             shards = list(self._proxy_shards.values())
+            incarnation = self._incarnation
         refs = []
         for shard in shards:
             try:
-                refs.append(shard.update_routes.remote())
+                # incarnation-stamped: a shard ignores pushes older than
+                # the newest incarnation it has seen (zombie rejection)
+                refs.append(shard.update_routes.remote(
+                    incarnation=incarnation))
             except Exception:  # noqa: BLE001 — dead shard, restarted later
                 pass
         if refs:
@@ -498,7 +930,7 @@ class ServeController:
             fresh = self._proxy_shards.get(idx)
         if fresh is not None:
             try:
-                fresh.update_routes.remote()
+                fresh.update_routes.remote(incarnation=self._incarnation)
             except Exception:  # noqa: BLE001 — dead already; health loop
                 pass
         return fresh
@@ -596,6 +1028,7 @@ class ServeController:
                 logger.warning("replica %s init timed out", r.replica_id)
                 r.state = _ReplicaState.UNHEALTHY
         if promoted:
+            self._checkpoint("promote")
             self._attribute_node_ids(state, promoted)
 
     def _attribute_node_ids(self, state: _DeploymentState,
@@ -622,11 +1055,17 @@ class ServeController:
                              num_returns=len(node_refs), timeout=5.0)
             except Exception:  # noqa: BLE001 — attribution only
                 pass
+            attributed = 0
             for r, ref in node_refs:
                 try:
                     r.node_id = ray_tpu.get(ref, timeout=0)
+                    attributed += r.node_id is not None
                 except Exception:  # noqa: BLE001 — attribution only
                     r.node_id = None
+            if attributed:
+                # persisted so a recovered controller can drain adopted
+                # replicas by node without re-probing first
+                self._checkpoint("attribute")
         for r in replicas:
             # lock the expiry lookup (preempt_node mutates the dict from
             # RPC threads); _drain_replica runs outside the lock
@@ -667,6 +1106,7 @@ class ServeController:
                     state.replicas.remove(r)
             if dead:
                 self._bump(state.full_name)
+                self._checkpoint("remove_dead")
             want_v = state.config.get("version", "")
             with self._lock:
                 rolling = any(r.version != want_v for r in state.replicas
@@ -696,6 +1136,7 @@ class ServeController:
                     self._stop_replica(r)
                 if excess:
                     self._bump(state.full_name)
+                    self._checkpoint("scale_down")
 
     def _roll_outdated(self, state: _DeploymentState) -> None:
         """Rolling code update (reference: deployment_state.py versioned
@@ -756,6 +1197,9 @@ class ServeController:
         except Exception:  # noqa: BLE001
             pass
         self._bump(state.full_name)
+        # DRAINING is persisted so a controller death mid-drain resumes
+        # the reap instead of re-serving a deregistered replica
+        self._checkpoint("drain")
 
     def _reap_draining(self, state: _DeploymentState) -> None:
         now = time.monotonic()
@@ -799,6 +1243,8 @@ class ServeController:
                     state.replicas.remove(r)
         for r in expired:
             self._stop_replica(r)
+        if expired:
+            self._checkpoint("reap")
 
     def _start_replica(self, state: _DeploymentState) -> None:
         cfg = state.config
@@ -807,6 +1253,16 @@ class ServeController:
         actor_opts = dict(cfg.get("ray_actor_options") or {})
         actor_opts.setdefault("num_cpus", 0.1)
         actor_opts["max_concurrency"] = cfg.get("max_ongoing_requests", 8)
+        # NAMED so a recovered controller incarnation can re-resolve and
+        # adopt the live actor (checkpoint stores only the replica id;
+        # next_replica_idx is persisted, so ids never collide across
+        # incarnations)
+        actor_opts["name"] = REPLICA_NAME_PREFIX + replica_id
+        # reserve the id BEFORE creating the named actor: a crash in the
+        # create-then-persist window must not recover a checkpoint whose
+        # next idx re-issues this name ("already taken" forever); the
+        # unrecorded actor itself is reaped by _restore's orphan sweep
+        self._checkpoint("reserve_replica_id")
         try:
             handle = ray_tpu.remote(ReplicaActor).options(
                 **actor_opts).remote({
@@ -825,6 +1281,7 @@ class ServeController:
             replica.init_ref = handle.check_health.remote()
             with self._lock:
                 state.replicas.append(replica)
+            self._checkpoint("start_replica")
         except Exception:  # noqa: BLE001
             logger.exception("failed to start replica for %s",
                              state.full_name)
@@ -944,4 +1401,7 @@ class ServeController:
             desired = max(cfg.get("min_replicas", 1),
                           min(cfg.get("max_replicas", 10), desired))
             with self._lock:
+                changed = state.target_num_replicas != desired
                 state.target_num_replicas = desired
+            if changed:
+                self._checkpoint("autoscale")

@@ -221,6 +221,7 @@ class ProxyActor:
         self._num_shards = num_shards
         self._stream_buffer_bytes = stream_buffer_bytes
         self._routes: Dict[str, Any] = {}  # route_prefix -> route entry
+        self._routes_incarnation = 0  # newest controller incarnation seen
         self._llm_routers: Dict[str, Any] = {}  # app name -> LLMRouter
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._started = threading.Event()
@@ -254,6 +255,7 @@ class ProxyActor:
             "num_shards": self._num_shards,
             "requests_served": self._requests_served,
             "replica_death_retries": self._replica_death_retries,
+            "routes_incarnation": self._routes_incarnation,
             "routes": sorted(self._routes),
             "llm_apps": sorted(self._llm_routers),
         }
@@ -266,15 +268,32 @@ class ProxyActor:
 
         return llm_metrics.snapshot()
 
-    def update_routes(self) -> None:
+    def update_routes(self, incarnation: Optional[int] = None) -> None:
+        """Pull the route table from the controller. `incarnation` is the
+        pushing controller's incarnation: pushes older than the newest
+        one this shard has seen are dropped (a zombie controller racing
+        its recovered successor must not roll the routes back). A failed
+        pull — controller dead or mid-recovery — KEEPS the cached routes:
+        the data plane serves through the control-plane outage."""
         from ray_tpu.serve.context import get_controller
         from ray_tpu.serve.handle import DeploymentHandle
 
+        if incarnation is not None:
+            if incarnation < self._routes_incarnation:
+                return
+            self._routes_incarnation = incarnation
         try:
             controller = get_controller()
         except RuntimeError:
             return
-        apps = ray_tpu.get(controller.list_applications.remote())
+        try:
+            apps = ray_tpu.get(controller.list_applications.remote(),
+                               timeout=30.0)
+        except Exception:  # noqa: BLE001 — controller down mid-pull
+            logger.warning(
+                "route pull failed (controller down?); keeping %d cached "
+                "route(s)", len(self._routes))
+            return
         routes = {}
         live_llm = set()
         for app_name, info in apps.items():

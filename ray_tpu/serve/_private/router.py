@@ -27,6 +27,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import ray_tpu
 from ray_tpu._private import tracing as _tracing
+from ray_tpu._private.backoff import BackoffPolicy
 
 _LONG_POLL_TIMEOUT_S = 30.0
 
@@ -103,6 +104,15 @@ class Router:
                      else deployment_name)
         self._scheduler = PowerOfTwoChoicesReplicaScheduler()
         self._version = -1  # first long-poll returns immediately
+        # newest controller incarnation observed: pushes from an OLDER
+        # incarnation (a zombie controller after a recovery) are dropped
+        self._incarnation = 0
+        # controller-down degradation (ISSUE 12): long-poll failures pace
+        # out exponentially instead of hammering a restarting controller
+        # at a fixed 0.5s — jittered so a fleet of routers doesn't
+        # reconnect in lockstep when it comes back
+        self._poll_backoff = BackoffPolicy(base_s=0.2, max_s=5.0,
+                                           jitter=0.25)
         self._have_replicas = threading.Event()
         self._stopped = threading.Event()
         # outstanding response refs; resolution decrements local load
@@ -120,6 +130,7 @@ class Router:
     # -- background threads --------------------------------------------------
 
     def _long_poll_loop(self) -> None:
+        failures = 0
         while not self._stopped.is_set():
             try:
                 update = ray_tpu.get(
@@ -127,10 +138,21 @@ class Router:
                         self._key, self._version,
                         timeout=_LONG_POLL_TIMEOUT_S),
                     timeout=_LONG_POLL_TIMEOUT_S + 10.0)
-            except Exception:  # noqa: BLE001 — controller restarting
-                if self._stopped.wait(0.5):
+            except Exception:  # noqa: BLE001 — controller down/restarting
+                # NONSTOP data plane: the cached replica set keeps
+                # serving untouched — never evict healthy replicas on a
+                # listen_for_change failure; just pace the re-resolve
+                failures += 1
+                if self._stopped.wait(self._poll_backoff.delay(failures)):
                     return
                 continue
+            failures = 0
+            incarnation = int(update.get("incarnation") or 0)
+            if incarnation < self._incarnation:
+                # stale push from a zombie incarnation after a recovery:
+                # the recovered controller's route state wins
+                continue
+            self._incarnation = incarnation
             self._version = update["version"]
             self._scheduler.update_replicas(update["replicas"],
                                             update.get("metrics"))

@@ -30,9 +30,14 @@ def get_controller(create: bool = False):
                 ) from None
         from ray_tpu.serve._private.controller import ServeController
 
+        # max_restarts=-1: an UNINTENDED controller death (crash, OOM,
+        # node loss) restarts it in place — same actor id, same name —
+        # and the fresh incarnation recovers from its GCS-KV checkpoint,
+        # adopting live replicas/proxy shards instead of restarting them.
+        # ray_tpu.kill() (serve.shutdown) stays terminal.
         _controller = ray_tpu.remote(ServeController).options(
             name=CONTROLLER_NAME, lifetime="detached", num_cpus=0.1,
-            max_concurrency=256,
+            max_concurrency=256, max_restarts=-1,
         ).remote()
         ray_tpu.get(_controller.ping.remote())
         return _controller

@@ -3113,7 +3113,15 @@ class CoreWorker:
         return [reply_to_wire(r) for r in replies]
 
     async def _handle_kill_actor(self, payload):
-        threading.Thread(target=lambda: (time.sleep(0.05), os._exit(0)), daemon=True).start()
+        # kill(no_restart=False) is a crash-style kill: exit NONZERO so
+        # the raylet's death report reads unintended and the GCS restart
+        # FSM reschedules the actor (max_restarts permitting). A clean
+        # exit(0) here would read as intended and strand the actor dead
+        # regardless of its restart budget.
+        code = 0 if payload.get("no_restart", True) else 1
+        threading.Thread(
+            target=lambda: (time.sleep(0.05), os._exit(code)), daemon=True
+        ).start()
         return True
 
     async def _handle_cancel_task(self, payload):

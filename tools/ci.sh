@@ -7,11 +7,16 @@
 #   3. overload gate   — the overload_storm drill: >=3x offered load +
 #                        task flood; goodput floor, zero lost-accepted,
 #                        post-storm recovery (anti-metastable-collapse)
-#   4. tracing smoke   — one traced serve request must produce a span
+#   4. controller gate — the controller_kill drill: serve controller
+#                        dies under load; the restarted incarnation must
+#                        recover from its GCS-KV checkpoint and ADOPT
+#                        every live replica (zero restarts, zero
+#                        lost-accepted, bounded MTTR)
+#   5. tracing smoke   — one traced serve request must produce a span
 #                        tree spanning >=6 spans across >=3 processes in
 #                        the GCS span store (trace context on the wire,
 #                        cluster-wide collection, header attribution)
-#   5. tier-1 tests    — the full `not slow` suite
+#   6. tier-1 tests    — the full `not slow` suite
 #
 # Usage: tools/ci.sh [--skip-tests]
 set -euo pipefail
@@ -29,6 +34,11 @@ echo "== overload_storm drill gate =="
 JAX_PLATFORMS=cpu python -m ray_tpu drill run \
     --scenario overload_storm --budget 120s --seed 0 \
     --report "${TMPDIR:-/tmp}/ci_overload_report.json" --gate
+
+echo "== controller_kill drill gate =="
+JAX_PLATFORMS=cpu python -m ray_tpu drill run \
+    --scenario controller_kill --budget 120s --seed 0 \
+    --report "${TMPDIR:-/tmp}/ci_controller_report.json" --gate
 
 echo "== tracing smoke (bounded) =="
 JAX_PLATFORMS=cpu python -m tools.tracing_smoke --budget 120
