@@ -80,6 +80,19 @@ def _subprocess_benches() -> dict:
     except Exception as e:  # noqa: BLE001
         out["llm_prefix_error"] = str(e)[:200]
     try:
+        # ISSUE 13 object/data plane: put/get bandwidth through the shm
+        # store (numpy AND jax.Array — the typed wire keeps them within
+        # 1.2× of each other) + the input-pipeline overlap fraction of
+        # the prefetched iter_jax_batches feed
+        dp = run("ray_tpu._private.dataplane_bench", 600)
+        out["object_put_gbps"] = dp["detail"]["object_put_gbps"]
+        out["object_get_gbps"] = dp["detail"]["object_get_gbps"]
+        out["input_pipeline_overlap_frac"] = (
+            dp["detail"]["input_pipeline_overlap_frac"])
+        out["dataplane_detail"] = dp["detail"]
+    except Exception as e:  # noqa: BLE001
+        out["dataplane_error"] = str(e)[:200]
+    try:
         # serving-level LLM numbers (TTFT + delivered tokens/sec under
         # Poisson arrivals through serve.llm) so the perf trajectory
         # tracks serving, not just on-device decode

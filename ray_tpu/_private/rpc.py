@@ -5,8 +5,11 @@ client_call.h) and long-poll pubsub (src/ray/pubsub/): every control-plane
 boundary (GCS services, raylet lease protocol, worker task push, object
 service) is a method on an `RpcServer`, and clients hold persistent
 connections with request-id correlation. Transport is asyncio TCP with
-4-byte-length-prefixed pickle frames; good for localhost and DCN. Data-plane
-payloads ride the same connections as out-of-band bytes (no double pickling).
+length-prefixed pickle-5 frames whose large buffers travel OUT-OF-BAND as
+raw scatter segments (see _frame_segments); good for localhost and DCN.
+Data-plane payloads ride the same connections copy-free: a reply carrying a
+SerializedObject writes its buffers from the shm arena straight to the
+socket, and the receiver decodes arrays as views into one receive blob.
 
 Also provides `EventLoopThread` — the per-component io_context equivalent of
 the reference's instrumented asio loops (src/ray/common/asio/).
@@ -149,16 +152,73 @@ class EventLoopThread:
         self._thread.join(timeout=2.0)
 
 
+# Out-of-band wire format (ISSUE 13 copy-free wire path). Frame layout:
+#   [4B inband len][4B buffer count][8B len per buffer][inband][buffers…]
+# pickle-5 buffer_callback diverts large PickleBuffers (SerializedObject
+# payloads, numpy arrays) out of the pickle stream; the writer scatters the
+# raw memoryviews straight to the socket (no bytes() materialization, no
+# re-pickle of array data) and the reader hands the decoder zero-copy
+# views into ONE contiguous receive blob. Buffers below _OOB_MIN_BYTES stay
+# in-band: per-buffer framing + scatter writes cost more than a tiny copy.
+_OOB_MIN_BYTES = 4096
+
+
+def _frame_segments(msg: Any) -> list:
+    """Encode a message as an ordered segment list (scatter list): one
+    header+inband bytes object followed by the raw out-of-band buffers."""
+    bufs: list = []
+
+    def _divert(b: pickle.PickleBuffer):
+        try:
+            raw = b.raw()
+        except Exception:  # noqa: BLE001 — non-contiguous: keep in-band
+            return True
+        if raw.nbytes < _OOB_MIN_BYTES:
+            return True  # in-band
+        bufs.append(raw)
+        return False  # out-of-band
+    payload = pickle.dumps(msg, protocol=5, buffer_callback=_divert)
+    head = bytearray()
+    head += len(payload).to_bytes(4, "little")
+    head += len(bufs).to_bytes(4, "little")
+    for m in bufs:
+        head += m.nbytes.to_bytes(8, "little")
+    head += payload
+    return [bytes(head), *bufs]
+
+
+def _write_segments(writer: asyncio.StreamWriter, segments: list) -> None:
+    # NOT writelines(): CPython's StreamWriter.writelines b"".join()s the
+    # segments — the exact copy this format exists to avoid.
+    for seg in segments:
+        writer.write(seg)
+
+
 async def _read_frame(reader: asyncio.StreamReader) -> Any:
-    header = await reader.readexactly(4)
-    length = int.from_bytes(header, "little")
+    header = await reader.readexactly(8)
+    length = int.from_bytes(header[:4], "little")
+    n_bufs = int.from_bytes(header[4:8], "little")
+    sizes = []
+    if n_bufs:
+        raw = await reader.readexactly(8 * n_bufs)
+        sizes = [int.from_bytes(raw[i * 8:(i + 1) * 8], "little")
+                 for i in range(n_bufs)]
     payload = await reader.readexactly(length)
-    return pickle.loads(payload)
+    if not n_bufs:
+        return pickle.loads(payload)
+    blob = memoryview(await reader.readexactly(sum(sizes)))
+    views, off = [], 0
+    for n in sizes:
+        views.append(blob[off:off + n])
+        off += n
+    # decoded values (numpy arrays, SerializedObject buffers) alias `blob`
+    # — zero-copy receive; the blob lives as long as any of them does
+    return pickle.loads(payload, buffers=views)
 
 
 def _frame(msg: Any) -> bytes:
-    payload = pickle.dumps(msg, protocol=5)
-    return len(payload).to_bytes(4, "little") + payload
+    """Flat single-buffer form of _frame_segments (tests/diagnostics)."""
+    return b"".join(bytes(s) for s in _frame_segments(msg))
 
 
 class RpcServer:
@@ -245,15 +305,17 @@ class RpcServer:
                 if method == "_register_peer":
                     peer_meta.update(payload)
                     async with write_lock:
-                        writer.write(_frame((_REPLY_OK, msg_id, None, None)))
+                        _write_segments(writer, _frame_segments(
+                            (_REPLY_OK, msg_id, None, None)))
                         await writer.drain()
                     continue
                 handler = self._handlers.get(method)
                 if handler is None:
                     if kind == _REQUEST:
                         async with write_lock:
-                            writer.write(_frame((_REPLY_ERR, msg_id, None,
-                                                 RpcError(f"no handler {method}"))))
+                            _write_segments(writer, _frame_segments(
+                                (_REPLY_ERR, msg_id, None,
+                                 RpcError(f"no handler {method}"))))
                             await writer.drain()
                     continue
                 asyncio.ensure_future(
@@ -320,13 +382,14 @@ class RpcServer:
             except Exception:  # noqa: BLE001 — a metrics failure must not
                 pass           # turn a successful reply into _REPLY_ERR
             if kind == _REQUEST:
-                frame = _frame((_REPLY_OK, msg_id, None, reply))
+                frame = _frame_segments((_REPLY_OK, msg_id, None, reply))
         except Exception as e:
             if kind == _REQUEST:
                 try:
-                    frame = _frame((_REPLY_ERR, msg_id, None, e))
+                    frame = _frame_segments((_REPLY_ERR, msg_id, None, e))
                 except Exception:
-                    frame = _frame((_REPLY_ERR, msg_id, None, RpcError(str(e))))
+                    frame = _frame_segments(
+                        (_REPLY_ERR, msg_id, None, RpcError(str(e))))
             else:
                 logger.exception("error in oneway handler %s", method)
                 return
@@ -352,13 +415,13 @@ class RpcServer:
                     # correlation must drop the second copy
                     try:
                         async with write_lock:
-                            writer.write(frame)
+                            _write_segments(writer, frame)
                             await writer.drain()
                     except (ConnectionResetError, BrokenPipeError):
                         pass
             try:
                 async with write_lock:
-                    writer.write(frame)
+                    _write_segments(writer, frame)
                     await writer.drain()
             except (ConnectionResetError, BrokenPipeError):
                 pass
@@ -448,7 +511,8 @@ class RpcClient:
         msg_id = next(self._msg_ids)
         fut = asyncio.get_event_loop().create_future()
         self._pending[msg_id] = fut
-        self._writer.write(_frame((_REQUEST, msg_id, method, payload)))
+        _write_segments(self._writer,
+                        _frame_segments((_REQUEST, msg_id, method, payload)))
         await self._writer.drain()
         return await fut
 
@@ -473,10 +537,10 @@ class RpcClient:
         self._pending[msg_id] = fut
         if act != "drop":  # "drop": frame never hits the wire — the caller
             try:           # waits on silence, exactly like network loss
-                frame = _frame((_REQUEST, msg_id, method, payload))
-                self._writer.write(frame)
+                frame = _frame_segments((_REQUEST, msg_id, method, payload))
+                _write_segments(self._writer, frame)
                 if act == "duplicate":
-                    self._writer.write(frame)  # peer executes it twice
+                    _write_segments(self._writer, frame)  # executed twice
                 await self._writer.drain()
                 if act == "disconnect":
                     self._writer.close()  # reply can never arrive: pending
@@ -517,10 +581,11 @@ class RpcClient:
         if act == "drop":
             return  # oneway frame lost in flight: sender never knows
         try:
-            frame = _frame((_ONEWAY, next(self._msg_ids), method, payload))
-            self._writer.write(frame)
+            frame = _frame_segments(
+                (_ONEWAY, next(self._msg_ids), method, payload))
+            _write_segments(self._writer, frame)
             if act == "duplicate":
-                self._writer.write(frame)
+                _write_segments(self._writer, frame)
             await self._writer.drain()
             if act == "disconnect":
                 self._writer.close()

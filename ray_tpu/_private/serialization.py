@@ -5,18 +5,40 @@ Equivalent of the reference's SerializationContext
 out-of-band buffer collection so large numpy arrays round-trip without copies,
 plus ObjectRef tracking so refs nested inside arguments/results are discovered
 (for borrowing/ref-counting) during (de)serialization.
+
+Typed array plane (ISSUE 13): `jax.Array` values take a device-native wire
+format — a small in-band header (dtype/shape/sharding/committed) plus each
+addressable shard's host view as an out-of-band buffer — instead of jax's
+default pickle, which materializes `np.asarray(arr)` INSIDE the pickle
+stream (a full host copy of the payload, then a pickle of those bytes).
+With the typed path, `write_into` performs the one host copy straight into
+the shm arena, and a local get rebuilds the array with `jax.device_put`
+over an `np.frombuffer` view of the arena. `COPY_STATS` counts the copies
+the zero-copy discipline forbids; tests and the dataplane smoke assert the
+hot paths leave them untouched.
 """
 
 from __future__ import annotations
 
 import io
 import pickle
+import sys
 import threading
 from typing import Any, List, Optional, Tuple
 
 import cloudpickle
 
 _thread_local = threading.local()
+
+# Data-plane copy accounting. Plain int bumps (GIL-atomic enough for the
+# monotone assertions tests make): payload_flatten counts whole-payload
+# materializations (to_bytes), typed_array_put/get count typed jax wire
+# traversals. Monitoring only — never read on a hot path.
+COPY_STATS = {
+    "payload_flatten": 0,
+    "typed_array_put": 0,
+    "typed_array_get": 0,
+}
 
 
 def _get_ctx_stack():
@@ -41,9 +63,14 @@ class SerializedObject:
         # TaskArg.nested_ids) so that transporting a serialized payload never
         # re-instantiates live ObjectRefs mid-frame-decode — doing so would
         # trigger borrow registration on the RPC loop thread (deadlock).
+        # The buffers ride as PickleBuffer objects: under the RPC layer's
+        # protocol-5 out-of-band framing they go to the socket as raw
+        # scatter segments (zero copies); a pickler without a
+        # buffer_callback still serializes them in-band (a copy, but only
+        # on cold paths like KV snapshots — never the data plane).
         return (
             _rebuild_serialized,
-            (self.inband, [bytes(b.raw()) for b in self.buffers]),
+            (self.inband, list(self.buffers)),
         )
 
     def total_bytes(self) -> int:
@@ -94,7 +121,12 @@ class SerializedObject:
                 memoryview(self.inband), *raw_buffers]
 
     def to_bytes(self) -> bytes:
-        """Flatten to a single contiguous wire format (copies buffers)."""
+        """Flatten to a single contiguous wire format (copies buffers).
+
+        NOT for the data plane (raylint RTL008): transport uses
+        wire_segments() scatter lists, the shm store uses write_into().
+        """
+        COPY_STATS["payload_flatten"] += 1
         out = io.BytesIO()
         header, raw_buffers = self._wire_parts()
         out.write(len(header).to_bytes(4, "little"))
@@ -123,6 +155,23 @@ def _rebuild_serialized(inband: bytes, raw_buffers) -> "SerializedObject":
     return SerializedObject(inband, [pickle.PickleBuffer(b) for b in raw_buffers], [])
 
 
+class _DataPlanePickler(cloudpickle.Pickler):
+    """cloudpickle with the typed jax.Array reducer layered on top.
+
+    reducer_override runs for EVERY object, so the jax probe is gated on a
+    module-name prefix check ("jaxlib…"/"jax…") before any isinstance work —
+    non-array pickling pays two attribute reads.
+    """
+
+    def reducer_override(self, obj):
+        mod = getattr(type(obj), "__module__", None)
+        if mod is not None and mod.startswith("jax"):
+            r = _maybe_reduce_jax_array(obj)
+            if r is not None:
+                return r
+        return super().reducer_override(obj)
+
+
 def serialize(value: Any) -> SerializedObject:
     """Serialize with out-of-band buffers and contained-ObjectRef discovery."""
     from ray_tpu._raylet import ObjectRef  # local import to avoid cycle
@@ -137,7 +186,11 @@ def serialize(value: Any) -> SerializedObject:
     stack = _get_ctx_stack()
     stack.append(contained)
     try:
-        inband = cloudpickle.dumps(value, protocol=5, buffer_callback=buffer_callback)
+        sink = io.BytesIO()
+        p = _DataPlanePickler(sink, protocol=5,
+                              buffer_callback=buffer_callback)
+        p.dump(value)
+        inband = sink.getvalue()
     finally:
         stack.pop()
     return SerializedObject(inband, buffers, contained)
@@ -168,3 +221,191 @@ def dumps_function(fn) -> bytes:
 
 def loads_function(data: bytes):
     return pickle.loads(data)
+
+
+# -- typed jax.Array wire ----------------------------------------------------
+#
+# Wire shape: (_rebuild_jax_array, (meta, PickleBuffer, ...)) where meta is
+#   (dtype, global_shape, committed, sharding_wire, shard_meta, device_map)
+#   shard_meta  — one entry per UNIQUE shard index: (index_wire, shard_shape)
+#                 (replicated shardings carry each distinct slice ONCE, not
+#                 once per device)
+#   device_map  — [(device_id, shard_meta position), ...] for every
+#                 addressable shard, so a receiver with the same device set
+#                 can rebuild the exact sharding
+# and each PickleBuffer wraps the shard's HOST view (np.from_dlpack /
+# np.asarray — on CPU backends a zero-copy alias of the device buffer; on
+# accelerators the one device→host transfer). No tobytes(), no pickle of
+# array data: write_into() copies the raw views straight into the shm page.
+
+
+def _np_host_view(x):
+    """Host numpy view of a single-device jax.Array, zero-copy when the
+    backend allows (CPU: dlpack aliases device memory)."""
+    import numpy as np
+
+    try:
+        v = np.from_dlpack(x)
+    except Exception:  # noqa: BLE001 — bf16/layout: fall back to asarray
+        v = np.asarray(x)
+    if not v.flags.c_contiguous:
+        v = np.ascontiguousarray(v)
+    return v
+
+
+def _index_wire(index, shape):
+    """A shard index (tuple of slices into the global array) as plain
+    (start, stop) pairs — slice objects don't pickle compactly and carry
+    None endpoints."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        out.append((start, stop))
+    return tuple(out)
+
+
+def _index_unwire(wire):
+    return tuple(slice(a, b) for a, b in wire)
+
+
+def _sharding_wire(sharding):
+    """Portable description of a sharding: enough to rebuild it when the
+    receiving process has the same device ids, and to degrade to a host
+    assembly + default device_put when it does not (1↔n-device parity)."""
+    jax = sys.modules["jax"]
+    if isinstance(sharding, jax.sharding.NamedSharding):
+        mesh = sharding.mesh
+        spec = tuple(
+            tuple(p) if isinstance(p, (tuple, list)) else p
+            for p in tuple(sharding.spec))
+        return ("named", tuple(str(a) for a in mesh.axis_names),
+                tuple(int(s) for s in mesh.devices.shape),
+                tuple(int(d.id) for d in mesh.devices.flat), spec)
+    if isinstance(sharding, jax.sharding.SingleDeviceSharding):
+        (dev,) = sharding.device_set
+        return ("single", int(dev.id))
+    return ("opaque",)
+
+
+def _rebuild_sharding(wire, devices):
+    """-> a jax Sharding, or None when this process can't host it (missing
+    device ids) and the caller must assemble on host instead."""
+    jax = sys.modules["jax"]
+    kind = wire[0]
+    if kind == "single":
+        return devices.get(wire[1])
+    if kind == "named":
+        _, axis_names, mesh_shape, dev_ids, spec = wire
+        if any(i not in devices for i in dev_ids):
+            return None
+        import numpy as np
+
+        mesh_devs = np.array([devices[i] for i in dev_ids],
+                             dtype=object).reshape(mesh_shape)
+        mesh = jax.sharding.Mesh(mesh_devs, axis_names)
+        parts = [tuple(p) if isinstance(p, tuple) else p for p in spec]
+        return jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec(*parts))
+    return None
+
+
+def _maybe_reduce_jax_array(obj):
+    """The typed reducer: jax.Array → header + raw shard host views.
+    None -> not a (fully addressable) jax array; caller falls back to the
+    default reduce."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    try:
+        if not isinstance(obj, jax.Array):
+            return None
+        if not obj.is_fully_addressable:
+            # multi-process global array: this process only holds SOME
+            # shards — a typed wire here would silently drop data. jax's
+            # own pickle raises for these; let it.
+            return None
+    except Exception:  # noqa: BLE001 — tracers/abstract values: not data
+        return None
+    import numpy as np
+
+    shard_meta: list = []
+    device_map: list = []
+    bufs: list = []
+    seen: dict = {}
+    for sh in obj.addressable_shards:
+        key = _index_wire(sh.index, obj.shape)
+        pos = seen.get(key)
+        if pos is None:
+            host = _np_host_view(sh.data)
+            pos = len(shard_meta)
+            seen[key] = pos
+            shard_meta.append((key, tuple(int(s) for s in host.shape)))
+            try:
+                pb = pickle.PickleBuffer(host)
+            except (ValueError, BufferError):
+                # extension dtypes (bfloat16 et al) refuse buffer export;
+                # a raw byte view shares the same memory — the header's
+                # dtype drives the frombuffer on the other side
+                pb = pickle.PickleBuffer(host.view(np.uint8))
+            bufs.append(pb)
+        device_map.append((int(sh.device.id), pos))
+    COPY_STATS["typed_array_put"] += 1
+    meta = (obj.dtype, tuple(int(s) for s in obj.shape),
+            bool(getattr(obj, "_committed", True)),
+            _sharding_wire(obj.sharding), tuple(shard_meta),
+            tuple(device_map))
+    return (_rebuild_jax_array, (meta, *bufs))
+
+
+def _rebuild_jax_array(meta, *bufs):
+    """Inverse of _maybe_reduce_jax_array: np.frombuffer views over the
+    received buffers (shm arena / RPC frame — zero-copy, read-only) fed to
+    jax.device_put.
+
+    Pin-until-transfer: each view's .base chain keeps the arena mapping's
+    GC-tied store ref alive for the duration of the device_put. PJRT host
+    buffer semantics cover the async tail — the binding holds the source
+    buffer until the transfer completes (CPU clients copy or alias during
+    the call) — and on non-CPU backends we additionally block so a view
+    over a reusable arena page is provably dead only after the DMA."""
+    import jax
+    import numpy as np
+
+    COPY_STATS["typed_array_get"] += 1
+    dtype, shape, committed, sharding_w, shard_meta, device_map = meta
+    views = [
+        np.frombuffer(b, dtype=dtype).reshape(shp)
+        for (_idx, shp), b in zip(shard_meta, bufs)
+    ]
+    devices = {int(d.id): d for d in jax.devices()}
+    single_full = (len(shard_meta) == 1
+                   and shard_meta[0][1] == tuple(shape))
+    if single_full:
+        target = (_rebuild_sharding(sharding_w, devices)
+                  if committed else None)
+        out = (jax.device_put(views[0], target) if target is not None
+               else jax.device_put(views[0]))
+    else:
+        target = _rebuild_sharding(sharding_w, devices)
+        if target is not None and all(
+                did in devices for did, _ in device_map):
+            per_dev = [
+                jax.device_put(views[pos], devices[did])
+                for did, pos in device_map
+            ]
+            out = jax.make_array_from_single_device_arrays(
+                tuple(shape), target, per_dev)
+        else:
+            # device-set mismatch (e.g. an 8-device put read by a 1-device
+            # process): assemble the global array on host, then one
+            # device_put — values stay exact, layout degrades gracefully.
+            host = np.empty(shape, dtype=dtype)
+            for (idx, _shp), v in zip(shard_meta, views):
+                host[_index_unwire(idx)] = v
+            out = jax.device_put(host)
+    if jax.default_backend() != "cpu":
+        # CPU clients finish (or alias) the host read during the call; for
+        # accelerator DMAs, block before the frombuffer views can die.
+        out.block_until_ready()
+    return out

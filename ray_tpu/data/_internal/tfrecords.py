@@ -174,7 +174,10 @@ def _encode_feature(value: Any) -> bytes:
         for v in values:
             _write_len_delimited(lst, 1, v)
     elif kind == 2:  # FloatList: repeated float value = 1 [packed]
-        _write_len_delimited(lst, 1, np.asarray(values, "<f4").tobytes())
+        # persistence boundary, not the data plane: the tfrecord proto
+        # needs the packed little-endian row bytes in the output file
+        _write_len_delimited(  # raylint: disable=payload-copy
+            lst, 1, np.asarray(values, "<f4").tobytes())
     else:  # Int64List: repeated int64 value = 1 [packed]
         packed = bytearray()
         for v in values:

@@ -21,6 +21,7 @@ import concurrent.futures
 import hashlib
 import logging
 import os
+import pickle
 import sys
 import threading
 import time
@@ -97,23 +98,31 @@ class _PendingTask:
     t_pushed: Optional[float] = None
 
 
-def _slice_segments(segments, off: int, length: int) -> bytes:
-    """Assemble [off, off+length) across an ordered list of buffer segments
-    without flattening the whole payload."""
-    out = bytearray()
+def _slice_segments(segments, off: int, length: int):
+    """[off, off+length) across an ordered list of buffer segments without
+    flattening the whole payload. A range that lands inside ONE segment
+    (the common case: chunk size divides the dominant array buffer) comes
+    back as a zero-copy memoryview into that segment — the RPC layer's
+    out-of-band framing writes it to the socket as-is; only ranges
+    straddling segment boundaries assemble into a fresh buffer."""
     pos = 0
     need_start, need_end = off, off + length
+    out = None
     for seg in segments:
         m = memoryview(seg)
         seg_end = pos + m.nbytes
         if seg_end > need_start and pos < need_end:
             a = max(0, need_start - pos)
             b = min(m.nbytes, need_end - pos)
+            if out is None and pos <= need_start and seg_end >= need_end:
+                return m[a:b].cast("B")  # single-segment: no copy
+            if out is None:
+                out = bytearray()
             out += m[a:b]
         pos = seg_end
         if pos >= need_end:
             break
-    return bytes(out)
+    return memoryview(out if out is not None else b"")
 
 
 @dataclass
@@ -1106,7 +1115,9 @@ class CoreWorker:
                 plasma_node=self.node_id.hex() if self.node_id else None)
             self._register_as_copy_holder(oid, owner)
         else:
-            s = ser.SerializedObject.from_bytes(bytes(buf))
+            # heap fallback (no shm store): decode over the assembly buffer
+            # directly — bytes(buf) would re-copy the whole object
+            s = ser.SerializedObject.from_bytes(memoryview(buf))
         return s
 
     def _drop_replica_at_owner(self, oid: ObjectID, replica: str,
@@ -2927,7 +2938,14 @@ class CoreWorker:
         return {"status": "ok", "data": s}
 
     async def _handle_fetch_object_chunk(self, payload):
-        """One [off, off+length) range of the flat wire payload."""
+        """One [off, off+length) range of the flat wire payload.
+
+        Copy-free serving: chunks go back as PickleBuffer views — a pinned
+        slice of the shm arena, or a zero-copy slice of a memory-store
+        resident's wire segments — which the RPC layer's out-of-band
+        framing scatters straight to the socket. The arena slice keeps the
+        parent view (and through it the GC-tied store ref) alive until the
+        reply frame is written."""
         oid: ObjectID = payload["object_id"]
         off, length = payload["off"], payload["len"]
         entry = self.memory_store.get_entry(oid)
@@ -2939,12 +2957,13 @@ class CoreWorker:
             view = await asyncio.to_thread(self.plasma.get_raw_view, oid)
             if view is None:
                 return {"status": "not_found"}
-            return {"status": "ok", "data": bytes(view[off:off + length])}
+            return {"status": "ok",
+                    "data": pickle.PickleBuffer(view[off:off + length])}
         if entry.serialized is None:
             return {"status": "not_found"}
         return {"status": "ok",
-                "data": _slice_segments(
-                    entry.serialized.wire_segments(), off, length)}
+                "data": pickle.PickleBuffer(_slice_segments(
+                    entry.serialized.wire_segments(), off, length))}
 
     async def _handle_add_object_location(self, payload):
         self.reference_counter.add_location(

@@ -556,6 +556,53 @@ def test_unbounded_queue_suppressible_by_name_and_id(tmp_path):
     assert _lint(tmp_path, ["ray_tpu"], select=["unbounded-queue"]) == []
 
 
+# ---------------------------------------------------------------- RTL008
+
+
+def test_payload_copy_positives(tmp_path):
+    _write(tmp_path, "ray_tpu/worker/wire.py", """
+        def ship(serialized, buf, view):
+            flat = serialized.to_bytes()
+            host = view.tobytes()
+            raw = bytes(buf.raw())
+            return flat, host, raw
+    """)
+    diags = _lint(tmp_path, ["ray_tpu"], select=["payload-copy"])
+    assert _ids(diags) == ["RTL008"]
+    assert len(diags) == 3
+    assert any(".tobytes()" in d.message for d in diags)
+    assert any("wire_segments" in d.message for d in diags)
+    assert any("bytes(<buffer>.raw())" in d.message for d in diags)
+
+
+def test_payload_copy_int_to_bytes_clean(tmp_path):
+    # int.to_bytes keeps its (length, byteorder) args — framing headers
+    # are not payload flattens
+    _write(tmp_path, "ray_tpu/worker/hdr.py", """
+        def header(n):
+            return n.to_bytes(4, "little") + len("x").to_bytes(8, "little")
+    """)
+    assert _lint(tmp_path, ["ray_tpu"], select=["payload-copy"]) == []
+
+
+def test_payload_copy_out_of_scope_clean(tmp_path):
+    # serve/ is off the object plane for this check
+    _write(tmp_path, "ray_tpu/serve/enc.py", """
+        def encode(arr):
+            return arr.tobytes()
+    """)
+    assert _lint(tmp_path, ["ray_tpu"], select=["payload-copy"]) == []
+
+
+def test_payload_copy_suppressible_with_justification(tmp_path):
+    _write(tmp_path, "ray_tpu/data/sink.py", """
+        def persist(arr):
+            # persistence boundary: the file format wants flat bytes
+            return arr.tobytes()  # raylint: disable=payload-copy
+    """)
+    assert _lint(tmp_path, ["ray_tpu"], select=["payload-copy"]) == []
+
+
 # ----------------------------------------------------------- suppressions
 
 

@@ -16,7 +16,11 @@
 #                        tree spanning >=6 spans across >=3 processes in
 #                        the GCS span store (trace context on the wire,
 #                        cluster-wide collection, header attribution)
-#   6. tier-1 tests    — the full `not slow` suite
+#   6. dataplane smoke — one >2x-chunk-size jax.Array put/get across a
+#                        2-node in-process cluster: value integrity, a
+#                        conservative bandwidth floor, and ZERO
+#                        whole-payload copies (serialization.COPY_STATS)
+#   7. tier-1 tests    — the full `not slow` suite
 #
 # Usage: tools/ci.sh [--skip-tests]
 set -euo pipefail
@@ -42,6 +46,9 @@ JAX_PLATFORMS=cpu python -m ray_tpu drill run \
 
 echo "== tracing smoke (bounded) =="
 JAX_PLATFORMS=cpu python -m tools.tracing_smoke --budget 120
+
+echo "== dataplane smoke (bounded) =="
+JAX_PLATFORMS=cpu python -m tools.dataplane_smoke --budget 120
 
 if [[ "${1:-}" != "--skip-tests" ]]; then
     echo "== tier-1 tests =="

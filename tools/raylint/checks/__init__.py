@@ -4,6 +4,7 @@ from tools.raylint.checks import (  # noqa: F401
     blocking_in_handler,
     fsm_event,
     lock_order,
+    payload_copy,
     rpc_surface,
     spec_serialization,
     swallowed_error,

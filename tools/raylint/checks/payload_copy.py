@@ -1,0 +1,93 @@
+"""RTL008 payload-copy.
+
+Invariant (ISSUE 13, CONTRIBUTING "array-bearing paths never flatten"):
+code on the object/data plane — gcs/, raylet/, worker/, data/ — must not
+materialize whole payload buffers. The zero-copy discipline is that an
+array moves as (metadata, raw buffer views): `write_into()` lands it in
+the shm arena in one copy, `wire_segments()` scatter-lists feed the RPC
+layer's out-of-band framing, and gets are `np.frombuffer` views. One
+stray `.tobytes()` on a hot path silently reintroduces a whole-object
+host copy per transfer (exactly the `bytes(b.raw())` wire bug this
+check's PR removed) and shows up only as mysteriously halved bandwidth.
+
+Flags, in the configured scope paths:
+* `<expr>.tobytes()` — numpy/memoryview flattening, any arity,
+* `<expr>.to_bytes()` with NO arguments — the SerializedObject-style
+  whole-payload flatten (`int.to_bytes(length, order)` keeps its args
+  and is untouched),
+* `bytes(<expr>.raw())` — materializing a PickleBuffer.
+
+A justified copy (a small checksum row, a persistence boundary) carries
+`# raylint: disable=payload-copy` naming why the copy is not on the
+data plane.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.raylint.core import (
+    Check,
+    Diagnostic,
+    Project,
+    register_check,
+)
+
+DEFAULT_SCOPE_PATHS = [
+    "ray_tpu/gcs/",
+    "ray_tpu/raylet/",
+    "ray_tpu/worker/",
+    "ray_tpu/data/",
+]
+
+
+def _hit(node: ast.Call):
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        if fn.attr == "tobytes":
+            return (".tobytes() flattens an array/buffer payload — keep "
+                    "raw views (write_into / wire_segments / frombuffer)")
+        if fn.attr == "to_bytes" and not node.args and not node.keywords:
+            return (".to_bytes() materializes the whole wire payload — "
+                    "transport wire_segments(), store via write_into()")
+        return None
+    if (isinstance(fn, ast.Name) and fn.id == "bytes"
+            and len(node.args) == 1 and isinstance(node.args[0], ast.Call)
+            and isinstance(node.args[0].func, ast.Attribute)
+            and node.args[0].func.attr == "raw"):
+        return ("bytes(<buffer>.raw()) copies an out-of-band buffer — "
+                "pass the PickleBuffer/memoryview through instead")
+    return None
+
+
+@register_check
+class PayloadCopyCheck(Check):
+    name = "payload-copy"
+    check_id = "RTL008"
+    description = ("whole-payload buffer copy (.tobytes() / bare "
+                   ".to_bytes() / bytes(x.raw())) in a gcs/raylet/worker/"
+                   "data path — array-bearing paths move raw views, "
+                   "never flattened bytes")
+
+    def __init__(self, options: dict):
+        super().__init__(options)
+        self.scope_paths = tuple(options.get(
+            "scope-paths", DEFAULT_SCOPE_PATHS))
+
+    def run(self, project: Project) -> Iterable[Diagnostic]:
+        for mod in project.target_modules():
+            if not any(mod.relpath.startswith(p) for p in self.scope_paths):
+                continue
+            for node in mod.nodes():
+                if not isinstance(node, ast.Call):
+                    continue
+                msg = _hit(node)
+                if msg is None:
+                    continue
+                yield Diagnostic(
+                    self.check_id, self.name, mod.relpath,
+                    node.lineno, node.col_offset,
+                    f"{msg}; if this copy is genuinely off the data plane "
+                    "suppress with `# raylint: disable=payload-copy` and "
+                    "say why")
