@@ -53,6 +53,16 @@ def _subprocess_benches() -> dict:
     except Exception as e:  # noqa: BLE001
         out["rllib_env_steps_error"] = str(e)[:200]
     try:
+        # ISSUE 14 decoupled RL dataflow: learner-consumed env-steps/sec
+        # through the bounded sample queue at >=2 rollout-worker counts —
+        # a measured scaling curve, not a single-number plateau
+        rd = run("ray_tpu.rllib.benchmarks", 900, "decoupled")
+        out["rllib_decoupled_env_steps_per_sec"] = rd["value"]
+        out["rllib_decoupled_scaling"] = rd["detail"].get("scaling")
+        out["rllib_decoupled_detail"] = rd.get("detail", {})
+    except Exception as e:  # noqa: BLE001
+        out["rllib_decoupled_error"] = str(e)[:200]
+    try:
         sv = run("ray_tpu.serve.benchmarks", 600, "classic")
         out["serve_http_rps"] = sv["serve_http"]["rps"]
         out["serve_http_p50_ms"] = sv["serve_http"]["p50_ms"]

@@ -177,6 +177,11 @@ _d("gcs_reconnect_backoff_jitter", 0.5)
 _d("raylet_lease_queue_max", 2000)       # queued lease requests per raylet
 _d("gcs_actor_creation_queue_max", 4000)  # actors pending first creation
 _d("actor_mailbox_max", 10_000)          # owner-side queued calls per actor
+# Decoupled RL dataflow (rllib/dataflow.py): sample batches queued between
+# the rollout fleet and the learner — entries are (ref, version) stamps,
+# the payloads live in the object store. Overflow is typed shed back to
+# the pushing runner (retry_later + retry-after hint), never silent loss.
+_d("rl_sample_queue_max", 64)
 # Token-bucket retry budgets per (peer, method): each retry spends a
 # token; an empty bucket fails fast with the underlying error instead of
 # amplifying a brownout into a retry storm. retry_budget_enabled=False

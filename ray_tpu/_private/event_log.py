@@ -131,6 +131,20 @@ EVENT_SCHEMAS: Dict[str, Tuple[str, ...]] = {
     "serve.controller_recover": ("incarnation", "adopted_replicas",
                                  "restarted_replicas"),
     "serve.replica_adopted": ("replica_id", "incarnation"),
+    # decoupled RL dataflow (ISSUE 14): the rollout fleet is crashable —
+    # every membership change (death, respawn, elastic scale) and every
+    # sample-plane decision (queue shed, zombie-push reject, staleness
+    # drop) emits, and the learner stamps one rl.learner_step per ACTUAL
+    # update so step cadence / zero-stale-trained derive from the log
+    # (drills/slo.rl_slo — the rl_rollout_storm verdict reads these).
+    "rl.learner_step": ("step", "version", "env_steps"),
+    "rl.weights_broadcast": ("version",),
+    "rl.stale_drop": ("version", "batch_version"),
+    "rl.sample_shed": ("runner", "depth"),
+    "rl.zombie_push": ("runner", "incarnation", "current"),
+    "rl.runner_dead": ("runner", "reason"),
+    "rl.runner_respawn": ("runner", "incarnation"),
+    "rl.fleet_scale": ("from_runners", "to_runners", "reason"),
 }
 
 _ID_KEYS = ("task_id", "actor_id", "node_id", "object_id", "trace_id")

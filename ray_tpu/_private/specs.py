@@ -384,6 +384,24 @@ def spec_from_wire(t: tuple) -> TaskSpec:
     return sp
 
 
+def _borrows_w(r: dict):
+    """Arg-borrow retention report (executor._attach_retained_borrows):
+    (borrower_address, [oid bytes, ...]) or None. Must survive the wire
+    codec — dropping it silently reintroduces the owner frame-exit free
+    race for refs nested in task args."""
+    held = r.get("retained_borrows")
+    if not held or not r.get("borrower_address"):
+        return None
+    return (r["borrower_address"], [o.binary() for o in held])
+
+
+def _borrows_r(out: dict, t) -> dict:
+    if t is not None:
+        out["borrower_address"] = t[0]
+        out["retained_borrows"] = [ObjectID(b) for b in t[1]]
+    return out
+
+
 def reply_to_wire(r: dict) -> tuple:
     """PushTaskReply dict -> flat tuple (see reply_from_wire for shape)."""
     if r.get("not_run"):
@@ -398,12 +416,13 @@ def reply_to_wire(r: dict) -> tuple:
         ]
         return ("ok", returns, r.get("exec_s"),
                 r.get("streaming_num_items"), r.get("worker_retiring"),
-                r.get("stages"))
+                r.get("stages"), _borrows_w(r))
     if status == "cancelled":
         return ("cancelled", [o.binary() for o in r.get("return_ids", [])])
     return ("error", _ser_w(r.get("error")), r.get("error_str"),
             [o.binary() for o in r.get("return_ids", [])],
-            r.get("exec_s"), r.get("worker_retiring"), r.get("stages"))
+            r.get("exec_s"), r.get("worker_retiring"), r.get("stages"),
+            _borrows_w(r))
 
 
 def reply_from_wire(t: tuple) -> dict:
@@ -427,6 +446,8 @@ def reply_from_wire(t: tuple) -> dict:
             out["worker_retiring"] = True
         if len(t) > 5 and t[5] is not None:
             out["stages"] = t[5]
+        if len(t) > 6:
+            _borrows_r(out, t[6])
         return out
     if kind == "cancelled":
         return {"status": "cancelled",
@@ -440,6 +461,8 @@ def reply_from_wire(t: tuple) -> dict:
         out["worker_retiring"] = True
     if len(t) > 6 and t[6] is not None:
         out["stages"] = t[6]
+    if len(t) > 7:
+        _borrows_r(out, t[7])
     return out
 
 

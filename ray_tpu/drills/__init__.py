@@ -3,8 +3,10 @@
 Closes the resilience loop the chaos layer (PR 3) and the structured
 event log (PR 5) opened: a DRILL runs a scenario (serve replica kills,
 raylet<->GCS partitions, rolling proxy-shard restarts, whole-node
-preemption notices, a 3x overload storm) against a LIVE workload
-(sustained HTTP serving, or a checkpointing SPMD training gang) and
+preemption notices, a 3x overload storm, a rollout-fleet storm under
+the decoupled RL dataflow) against a LIVE workload (sustained HTTP
+serving, a checkpointing SPMD training gang, or an IMPALA learner
+pulling from the bounded sample queue) and
 computes its SLOs — MTTR, availability, request loss, storm goodput and
 shed-vs-lost accounting — directly from the GcsEventManager causal
 timeline: every injection is a `drill.phase` marker, every recovery is a

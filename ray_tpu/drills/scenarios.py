@@ -39,6 +39,13 @@ Scenario inventory:
                             from its GCS-KV checkpoint and ADOPTS the
                             live replicas (zero healthy-replica
                             restarts, zero lost-accepted requests).
+* rl_rollout_storm        — decoupled RL dataflow under fleet chaos:
+                            kill rollout runner actor(s), then preempt a
+                            whole rollout node mid-training. The learner
+                            must keep stepping (cadence gap bounded),
+                            train on zero stale batches, lose no
+                            progress; every affected runner slot must
+                            respawn to actor.alive.
 * overload_storm          — no fault at all: offered HTTP load jumps to
                             >=3x the workload's sustained capacity while
                             a deadline-carrying task flood hits the
@@ -388,6 +395,77 @@ class OverloadStormScenario(Scenario):
         event_log.flush(timeout=2.0)
 
 
+class RLRolloutStormScenario(Scenario):
+    """Kill rollout workers and preempt a rollout node mid-training
+    under the decoupled RL dataflow: the learner must keep its step
+    cadence (never waiting on the crashed fleet), train on ZERO stale
+    batches, lose no learner progress, and the fleet must respawn every
+    affected runner slot (recovery = the last affected slot's
+    replacement reaching actor.alive, slot-keyed via rl.runner_respawn
+    so a double-respawned slot can't close the timeline early)."""
+
+    name = "rl_rollout_storm"
+    workload_kind = "rl"
+    kill_count = 1
+    preempt_deadline_s = 12.0
+    # seconds between the actor kill and the node preempt: the fleet
+    # must absorb the first fault (respawn under load) before the second
+    kill_settle_s = 2.0
+
+    def __init__(self):
+        self._kill_handles = []
+
+    def prepare(self, ctx: DrillContext) -> Dict[str, Any]:
+        snap = ctx.workload.fleet_snapshot()
+        if len(snap) < 2:
+            raise RuntimeError("rollout fleet too small to storm")
+        by_node: Dict[str, list] = {}
+        for idx, s in snap.items():
+            if s["node_id"]:
+                by_node.setdefault(s["node_id"], []).append(idx)
+        if not by_node:
+            raise RuntimeError("no rollout-runner node attribution yet "
+                               "(fleet still starting?)")
+        nodes = sorted(by_node)
+        target_node = nodes[ctx.rng.randrange(len(nodes))]
+        on_node = sorted(by_node[target_node])
+        off_node = sorted(i for i in snap if i not in on_node)
+        kill_pool = off_node or on_node
+        kills = []
+        for _ in range(min(self.kill_count, len(kill_pool))):
+            kills.append(kill_pool.pop(ctx.rng.randrange(len(kill_pool))))
+        self._kill_handles = [snap[i]["handle"] for i in kills]
+        affected = sorted(set(kills) | set(on_node))
+        return {
+            "target_node": target_node,
+            "kill_runners": sorted(kills),
+            "runners_on_node": on_node,
+            "affected_runners": affected,
+            "expected_replacements": len(affected),
+            "deadline_s": self.preempt_deadline_s,
+            "staleness_bound": ctx.workload.max_sample_staleness,
+        }
+
+    def execute(self, ctx: DrillContext, detail: Dict[str, Any]) -> None:
+        from ray_tpu._private.ids import NodeID
+
+        for idx, handle in zip(detail["kill_runners"], self._kill_handles):
+            logger.warning("drill: killing rollout runner %d (%s)", idx,
+                           handle._actor_id.hex()[:12])
+            ray_tpu.kill(handle)
+        time.sleep(self.kill_settle_s)
+        logger.warning("drill: preempting rollout node %s (runners %s)",
+                       detail["target_node"][:12],
+                       detail["runners_on_node"])
+        reply = ctx.gcs_call(
+            "preempt_node",
+            {"node_id": NodeID.from_hex(detail["target_node"]),
+             "deadline_s": self.preempt_deadline_s,
+             "reason": f"drill:{self.name}"})
+        if reply.get("status") != "ok":
+            raise RuntimeError(f"preempt_node failed: {reply}")
+
+
 SCENARIO_CLASSES = {
     cls.name: cls for cls in (
         ReplicaKillScenario,
@@ -397,6 +475,7 @@ SCENARIO_CLASSES = {
         NodePreemptServeScenario,
         NodePreemptTrainScenario,
         OverloadStormScenario,
+        RLRolloutStormScenario,
     )
 }
 

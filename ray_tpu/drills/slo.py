@@ -181,6 +181,39 @@ def _storm_end(post: List[dict]) -> Optional[dict]:
                  and _data(ev).get("phase") == "storm_end"), None)
 
 
+def _recover_rl_fleet(injection: dict, post: List[dict]) -> Optional[dict]:
+    """Killed + preempted rollout runners are recovered when EVERY
+    affected fleet slot's replacement reaches actor.alive. Slot-keyed
+    (the proxy-restart rule): rl.runner_respawn events attribute each
+    fresh actor to its runner slot, and a slot whose replacement itself
+    died and respawned again counts once — via its LATEST actor —
+    so a double-respawn can't close the timeline while another slot is
+    still down."""
+    want = _data(injection).get("affected_runners")
+    if not want:
+        return None
+    want = set(want)
+    latest_by_slot: Dict[Any, str] = {}
+    alive: set = set()
+    for ev in post:
+        if ev.get("type") == "rl.runner_respawn":
+            d = _data(ev)
+            if d.get("runner") is not None and ev.get("actor_id"):
+                # a re-respawned slot's PREVIOUS replacement no longer
+                # counts even if it reached alive earlier (the new
+                # actor's own alive mark — which may already have been
+                # seen, GCS stamps race the driver's emit — must stay)
+                prev = latest_by_slot.get(d["runner"])
+                if prev is not None:
+                    alive.discard(prev)
+                latest_by_slot[d["runner"]] = ev["actor_id"]
+        elif ev.get("type") == "actor.alive" and ev.get("actor_id"):
+            alive.add(ev["actor_id"])
+        if want and all(latest_by_slot.get(s) in alive for s in want):
+            return ev
+    return None
+
+
 def _recover_overload(injection: dict, post: List[dict]) -> Optional[dict]:
     """An overload storm is recovered at the first load window AFTER the
     storm_end marker whose accepted-request rate is back at
@@ -218,6 +251,7 @@ RECOVERY_MATCHERS: Dict[str, Callable[[dict, List[dict]], Optional[dict]]] = {
     "node_preempt_serve": _recover_replacement_replica,
     "node_preempt_train": _recover_gang_reschedule,
     "overload_storm": _recover_overload,
+    "rl_rollout_storm": _recover_rl_fleet,
 }
 
 
@@ -329,6 +363,58 @@ def overload_slo(events: List[dict], scenario: str) -> Optional[Dict[str, Any]]:
     }
 
 
+def rl_slo(events: List[dict], scenario: str) -> Optional[Dict[str, Any]]:
+    """Decoupled-RL SLOs, purely from the event timeline: learner step
+    CADENCE (max gap between consecutive rl.learner_step events — the
+    learner-never-waits proof), the zero-stale-trained proof (every step
+    carries its version, the oldest batch version trained, and the
+    staleness bound; a violation means a too-stale batch WAS trained
+    on), monotonic learner progress (step counter strictly increasing =
+    zero lost progress), and the fleet/queue accounting (deaths,
+    respawns, sheds, zombie-push rejections, staleness drops). None when
+    the timeline carries no learner steps."""
+    steps = [ev for ev in order_events(events)
+             if ev.get("type") == "rl.learner_step"]
+    if not steps:
+        return None
+    times = [float(ev.get("time", 0.0)) for ev in steps]
+    gaps = [b - a for a, b in zip(times, times[1:])]
+    ids = [int(_data(ev).get("step", 0)) for ev in steps]
+    monotonic = all(b > a for a, b in zip(ids, ids[1:]))
+    stale_violations = 0
+    for ev in steps:
+        d = _data(ev)
+        mbv = d.get("min_batch_version")
+        bound = d.get("staleness_bound")
+        if mbv is None or bound is None:
+            continue
+        if int(d.get("version", 0)) - 1 - int(mbv) > int(bound):
+            # version was bumped AFTER training on the pulled batches,
+            # so the version the pull was checked against is version-1
+            stale_violations += 1
+    last = _data(steps[-1])
+
+    def count(etype):
+        return sum(1 for e in events if e.get("type") == etype)
+
+    return {
+        "learner_steps": len(steps),
+        "max_step_gap_s": round(max(gaps), 6) if gaps else None,
+        "steps_monotonic": monotonic,
+        "last_step": ids[-1] if ids else None,
+        "last_version": int(last.get("version", 0)),
+        "stale_trained_violations": stale_violations,
+        "stale_dropped": int(last.get("stale_dropped", 0) or 0),
+        "discarded_dead": int(last.get("discarded_dead", 0) or 0),
+        "env_steps_total": sum(
+            int(_data(e).get("env_steps", 0) or 0) for e in steps),
+        "runner_deaths": count("rl.runner_dead"),
+        "runner_respawns": count("rl.runner_respawn"),
+        "samples_shed": count("rl.sample_shed"),
+        "zombie_pushes_rejected": count("rl.zombie_push"),
+    }
+
+
 def controller_slo(events: List[dict],
                    scenario: str) -> Optional[Dict[str, Any]]:
     """Control-plane recovery SLOs for controller_kill-style scenarios,
@@ -370,8 +456,9 @@ def evaluate_thresholds(slo: Dict[str, Any],
     """Threshold keys (drills/thresholds.json, per scenario):
     mttr_max_s, availability_min, max_lost_accepted,
     require_checkpoint_drain, max_replicas_restarted, require_adoption,
-    goodput_min_frac, max_flood_lost. Returns the list of failures
-    (empty = verdict passes)."""
+    goodput_min_frac, max_flood_lost, learner_gap_max_s,
+    max_stale_trained, require_monotonic_learner_steps. Returns the
+    list of failures (empty = verdict passes)."""
     failures = []
     mttr_max = thresholds.get("mttr_max_s")
     if mttr_max is not None:
@@ -421,6 +508,33 @@ def evaluate_thresholds(slo: Dict[str, Any],
             if require_adoption and ctl.get("adopted_replicas", 0) < 1:
                 failures.append(
                     "recovered controller adopted no replicas")
+    gap_max = thresholds.get("learner_gap_max_s")
+    max_stale = thresholds.get("max_stale_trained")
+    if gap_max is not None or max_stale is not None \
+            or thresholds.get("require_monotonic_learner_steps"):
+        rl = slo.get("rl")
+        if not rl:
+            failures.append("no rl.learner_step events in the timeline "
+                            "(learner never stepped)")
+        else:
+            if (gap_max is not None and rl.get("max_step_gap_s") is not None
+                    and rl["max_step_gap_s"] > gap_max):
+                failures.append(
+                    f"learner step cadence gapped {rl['max_step_gap_s']:.3f}s "
+                    f"(ceiling {gap_max}s) — the learner waited on the fleet")
+            if gap_max is not None and rl.get("max_step_gap_s") is None:
+                failures.append("only one learner step recorded — "
+                                "no cadence to judge")
+            if (max_stale is not None
+                    and rl.get("stale_trained_violations", 0) > max_stale):
+                failures.append(
+                    f"{rl['stale_trained_violations']} learner step(s) "
+                    f"trained on batches past the staleness bound "
+                    f"(max {max_stale})")
+            if (thresholds.get("require_monotonic_learner_steps")
+                    and not rl.get("steps_monotonic")):
+                failures.append("learner step counter regressed — "
+                                "learner progress was lost")
     goodput_min = thresholds.get("goodput_min_frac")
     if goodput_min is not None:
         storm = slo.get("overload")
@@ -503,6 +617,9 @@ def compute_report(events: List[dict], scenario: str, seed: int,
     ctl = controller_slo(events, scenario)
     if ctl is not None:
         slo["controller"] = ctl
+    rl = rl_slo(events, scenario)
+    if rl is not None:
+        slo["rl"] = rl
     failures = evaluate_thresholds(slo, thresholds)
     return {
         "schema": "ray_tpu.drill_report/1",

@@ -262,6 +262,93 @@ class ServingWorkload:
             self._flush_window()
 
 
+class RLTrainingWorkload:
+    """Decoupled RL training under rollout-fleet chaos: an IMPALA
+    learner in the drill process pulls from the bounded sample queue
+    (pinned to the head node) while the rollout fleet rides the
+    `drill_rollout` worker nodes — the rl_rollout_storm scenario kills
+    runners and preempts a rollout node out from under it. The learner's
+    own `rl.learner_step` events carry the whole SLO story (cadence,
+    staleness proof, monotonic progress); this harness just keeps
+    train() stepping and exposes the fleet for victim selection."""
+
+    def __init__(self, scenario: str, num_runners: int = 3,
+                 rollout_fragment_length: int = 24,
+                 max_sample_staleness: int = 3, seed: int = 0):
+        self.scenario = scenario
+        self.num_runners = num_runners
+        self.rollout_fragment_length = rollout_fragment_length
+        self.max_sample_staleness = max_sample_staleness
+        self.seed = seed
+        self.algo = None
+        self.error: Optional[BaseException] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._updates = 0
+
+    def start(self) -> None:
+        from ray_tpu.rllib.algorithms.impala import IMPALAConfig
+
+        config = (
+            IMPALAConfig()
+            .environment("CartPole-v1")
+            .env_runners(
+                num_env_runners=self.num_runners,
+                rollout_fragment_length=self.rollout_fragment_length,
+                num_cpus_per_env_runner=1,
+                custom_resources_per_env_runner={"drill_rollout": 1})
+            .training(model={"fcnet_hiddens": [32]}, lr=1e-3)
+            .dataflow(decoupled=True,
+                      max_sample_staleness=self.max_sample_staleness,
+                      sample_queue_resources={"drill_head": 0.001})
+            .fault_tolerance(restart_failed_env_runners=True,
+                             max_env_runner_restarts=10)
+            .debugging(seed=self.seed))
+        self.algo = config.build()
+
+        def _loop():
+            try:
+                while not self._stop.is_set():
+                    result = self.algo.train()
+                    if result.get("num_episodes", 0):
+                        self._updates += 1
+                    else:
+                        # queue refilling (respawn / compile): yield the
+                        # core instead of a hot empty-pull loop
+                        self._stop.wait(0.05)
+            except BaseException as e:  # noqa: BLE001 — surfaced in summary
+                self.error = e
+
+        self._thread = threading.Thread(target=_loop, daemon=True,
+                                        name="drill-rl-learner")
+        self._thread.start()
+
+    @property
+    def updates(self) -> int:
+        return self._updates
+
+    def fleet_snapshot(self):
+        return self.algo.dataflow.fleet.snapshot()
+
+    def stop(self) -> Dict[str, Any]:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30.0)
+        stats = {}
+        try:
+            stats = self.algo.dataflow.stats()
+        except Exception:  # noqa: BLE001 — teardown best-effort
+            logger.debug("rl dataflow stats failed", exc_info=True)
+        try:
+            self.algo.stop()
+        except Exception:  # noqa: BLE001 — teardown best-effort
+            logger.debug("rl workload stop failed", exc_info=True)
+        return {"kind": "rl", "updates": self._updates,
+                "policy_version": getattr(self.algo, "policy_version", 0),
+                "error": str(self.error) if self.error else None,
+                **stats}
+
+
 class TrainingWorkload:
     """A deterministic checkpoint-every-step training gang for the
     preemption drill."""

@@ -11,6 +11,11 @@ donated buffers, and multi-learner data parallelism is a mesh sharding
 
 from ray_tpu.rllib.algorithm import Algorithm  # noqa: F401
 from ray_tpu.rllib.algorithm_config import AlgorithmConfig  # noqa: F401
+from ray_tpu.rllib.dataflow import (  # noqa: F401
+    DecoupledDataflow,
+    RolloutFleet,
+    SampleQueueActor,
+)
 from ray_tpu.rllib.env import MultiAgentEnv  # noqa: F401
 from ray_tpu.rllib.episode import SingleAgentEpisode  # noqa: F401
 from ray_tpu.rllib.multi_agent import (  # noqa: F401
@@ -26,11 +31,14 @@ from ray_tpu.rllib.replay_buffer import (  # noqa: F401
 __all__ = [
     "Algorithm",
     "AlgorithmConfig",
+    "DecoupledDataflow",
     "MultiAgentEnv",
     "MultiAgentEpisode",
     "MultiAgentPPO",
     "MultiAgentPPOConfig",
     "PrioritizedReplayBuffer",
     "ReplayBuffer",
+    "RolloutFleet",
+    "SampleQueueActor",
     "SingleAgentEpisode",
 ]

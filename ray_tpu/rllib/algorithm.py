@@ -69,10 +69,20 @@ class Algorithm:
                 self._episode_returns.append(ep.total_reward)
 
     def get_state(self) -> Dict[str, Any]:
-        return {"iteration": self.iteration}
+        return {"iteration": self.iteration,
+                "num_env_steps_sampled_lifetime":
+                    self._num_env_steps_sampled_lifetime,
+                "policy_version": getattr(self, "policy_version", 0)}
 
     def set_state(self, state: Dict[str, Any]) -> None:
         self.iteration = state.get("iteration", 0)
+        self._num_env_steps_sampled_lifetime = state.get(
+            "num_env_steps_sampled_lifetime", 0)
+        if hasattr(self, "policy_version"):
+            # restored learner progress keeps its version monotonic so a
+            # checkpoint-restart can't re-accept pre-restart-stale batches
+            self.policy_version = state.get("policy_version",
+                                            self.policy_version)
 
     def save(self, checkpoint_dir: str) -> str:
         os.makedirs(checkpoint_dir, exist_ok=True)

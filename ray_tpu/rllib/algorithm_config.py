@@ -18,6 +18,27 @@ class AlgorithmConfig:
         self.num_env_runners: int = 0  # 0 = sample in the driver
         self.num_envs_per_env_runner: int = 1
         self.rollout_fragment_length: int = 200
+        self.num_cpus_per_env_runner: float = 1.0
+        self.custom_resources_per_env_runner: Dict[str, float] = {}
+        # decoupled fault-tolerant dataflow (rllib/dataflow.py):
+        # rollout fleet -> bounded sample queue -> async learner pulls
+        self.decoupled: bool = False
+        # sample-queue bound (entries); None -> CONFIG.rl_sample_queue_max
+        self.sample_queue_size: Optional[int] = None
+        # custom-resource pin for the queue actor (e.g. keep it on the
+        # head node while the rollout fleet rides preemptible nodes)
+        self.sample_queue_resources: Optional[Dict[str, float]] = None
+        # off-policy staleness bound: batches whose stamped policy
+        # version trails the learner by more than this are dropped
+        # (counted, evented), never trained on
+        self.max_sample_staleness: int = 2
+        # crashable-fleet knobs: dead/preempted runners are respawned
+        # with the current weights, bounded by the restart budget
+        self.restart_failed_env_runners: bool = True
+        self.max_env_runner_restarts: int = 20
+        # elastic fleet sizing (decoupled mode): None = fixed fleet
+        self.elastic_min_env_runners: Optional[int] = None
+        self.elastic_max_env_runners: Optional[int] = None
         # training
         self.lr: float = 3e-4
         self.gamma: float = 0.99
@@ -64,6 +85,8 @@ class AlgorithmConfig:
     def env_runners(self, *, num_env_runners: Optional[int] = None,
                     num_envs_per_env_runner: Optional[int] = None,
                     rollout_fragment_length: Optional[int] = None,
+                    num_cpus_per_env_runner: Optional[float] = None,
+                    custom_resources_per_env_runner: Optional[dict] = None,
                     **_kw) -> "AlgorithmConfig":
         if num_env_runners is not None:
             self.num_env_runners = num_env_runners
@@ -71,6 +94,47 @@ class AlgorithmConfig:
             self.num_envs_per_env_runner = num_envs_per_env_runner
         if rollout_fragment_length is not None:
             self.rollout_fragment_length = rollout_fragment_length
+        if num_cpus_per_env_runner is not None:
+            self.num_cpus_per_env_runner = num_cpus_per_env_runner
+        if custom_resources_per_env_runner is not None:
+            self.custom_resources_per_env_runner = dict(
+                custom_resources_per_env_runner)
+        return self
+
+    def fault_tolerance(
+            self, *, restart_failed_env_runners: Optional[bool] = None,
+            max_env_runner_restarts: Optional[int] = None,
+            **_kw) -> "AlgorithmConfig":
+        """Crashable-fleet policy (reference: algorithm_config.py
+        fault_tolerance() — restart_failed_env_runners)."""
+        if restart_failed_env_runners is not None:
+            self.restart_failed_env_runners = restart_failed_env_runners
+        if max_env_runner_restarts is not None:
+            self.max_env_runner_restarts = max_env_runner_restarts
+        return self
+
+    def dataflow(self, *, decoupled: Optional[bool] = None,
+                 sample_queue_size: Optional[int] = None,
+                 sample_queue_resources: Optional[dict] = None,
+                 max_sample_staleness: Optional[int] = None,
+                 elastic_min_env_runners: Optional[int] = None,
+                 elastic_max_env_runners: Optional[int] = None,
+                 **_kw) -> "AlgorithmConfig":
+        """Decoupled rollout/learner dataflow (rllib/dataflow.py): the
+        fleet pushes into a bounded object-store sample queue; the
+        learner pulls asynchronously under `max_sample_staleness`."""
+        if decoupled is not None:
+            self.decoupled = decoupled
+        if sample_queue_size is not None:
+            self.sample_queue_size = sample_queue_size
+        if sample_queue_resources is not None:
+            self.sample_queue_resources = dict(sample_queue_resources)
+        if max_sample_staleness is not None:
+            self.max_sample_staleness = max_sample_staleness
+        if elastic_min_env_runners is not None:
+            self.elastic_min_env_runners = elastic_min_env_runners
+        if elastic_max_env_runners is not None:
+            self.elastic_max_env_runners = elastic_max_env_runners
         return self
 
     def training(self, **kwargs) -> "AlgorithmConfig":

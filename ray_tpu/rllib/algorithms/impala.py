@@ -15,6 +15,7 @@ from typing import Any, Dict, List
 import numpy as np
 
 import ray_tpu
+from ray_tpu import exceptions as exc
 from ray_tpu.rllib.algorithm import Algorithm
 from ray_tpu.rllib.algorithm_config import AlgorithmConfig
 from ray_tpu.rllib.env_runner import EnvRunner, EnvRunnerGroup
@@ -140,16 +141,33 @@ class IMPALA(Algorithm):
             lambda p, o: self.module.forward(p, o)[1])
 
         cfg = config.to_dict()
-        self.runner_group = EnvRunnerGroup(cfg, self.module_spec)
-        self.runner_group.sync_weights(self.params)
+        self.policy_version = 0
+        self._updates = 0
+        self._stale_seen = 0  # stale-drop watermark for livelock escape
+        self.dataflow = None
+        self.runner_group = None
         self._inflight: Dict[Any, Any] = {}  # ref -> runner handle
-        # Async pipeline: keep N sample requests in flight per runner.
-        if self.runner_group.remotes:
-            per = config.max_requests_in_flight_per_env_runner
-            for w in self.runner_group.remotes:
-                for _ in range(per):
-                    self._inflight[w.sample.remote(
-                        num_steps=config.rollout_fragment_length)] = w
+        if getattr(config, "decoupled", False) and config.num_env_runners:
+            # Decoupled fault-tolerant dataflow (ISSUE 14): the rollout
+            # fleet pushes into a bounded object-store sample queue; this
+            # learner pulls asynchronously under the staleness bound and
+            # never waits on (or even knows about) any single runner.
+            from ray_tpu.rllib.dataflow import DecoupledDataflow
+
+            self.dataflow = DecoupledDataflow(
+                cfg, self.module_spec, self.params,
+                version=self.policy_version)
+        else:
+            self.runner_group = EnvRunnerGroup(cfg, self.module_spec)
+            self.runner_group.sync_weights(self.params,
+                                           self.policy_version)
+            # Async pipeline: keep N sample requests in flight per runner.
+            if self.runner_group.remotes:
+                per = config.max_requests_in_flight_per_env_runner
+                for w in self.runner_group.remotes:
+                    for _ in range(per):
+                        self._inflight[w.sample.remote(
+                            num_steps=config.rollout_fragment_length)] = w
         self._steps_since_broadcast = 0
 
     def _episodes_to_batch(self, episodes) -> Dict[str, np.ndarray]:
@@ -220,9 +238,57 @@ class IMPALA(Algorithm):
         batch["bootstrap_value"] = boots
         return batch
 
+    def _replenish_pipeline(self) -> None:
+        """Keep max_requests_in_flight sample calls armed per CURRENT
+        fleet member. Deficit-based rather than re-arm-what-returned:
+        a dead runner's handle may already have been replaced in place
+        by another path (sync_weights' broadcast repair), which would
+        strand the replacement with zero armed calls — counting
+        in-flight per live handle and topping up can never silently
+        lose a pipeline slot, whoever did the replacing."""
+        per = max(1, self.config.max_requests_in_flight_per_env_runner)
+        n = self.config.rollout_fragment_length
+        counts: Dict[int, int] = {}
+        for h in self._inflight.values():
+            counts[id(h)] = counts.get(id(h), 0) + 1
+        for slot in range(len(self.runner_group.remotes)):
+            h = self.runner_group.remotes[slot]
+            deficit = per - counts.get(id(h), 0)
+            while deficit > 0:
+                try:
+                    self._inflight[h.sample.remote(num_steps=n)] = h
+                    deficit -= 1
+                except exc.RayActorError:
+                    # dead at submit: replace in place and keep arming
+                    # the replacement (restart budget permitting)
+                    h = self.runner_group.replace_runner(h)
+                    if h is None:
+                        break
+
+    def _pull_decoupled(self) -> Dict[str, Any]:
+        """One decoupled pull: whatever version-safe batches are queued.
+        Returns {"episodes": [...], "meta": {...}}; empty episodes means
+        the fleet is (re)filling the queue — the learner returns to its
+        caller instead of blocking on any runner."""
+        pulled = self.dataflow.pull(self.policy_version)
+        episodes: List = []
+        versions = []
+        for entry, eps in pulled:
+            episodes.extend(eps)
+            versions.append(int(entry.get("policy_version", 0)))
+        return {"episodes": episodes,
+                "min_batch_version": min(versions) if versions else None}
+
     def training_step(self) -> Dict[str, Any]:
+        from ray_tpu._private import event_log
+
         cfg = self.config
-        if not self.runner_group.remotes:
+        min_batch_version = None
+        if self.dataflow is not None:
+            pulled = self._pull_decoupled()
+            episodes = pulled["episodes"]
+            min_batch_version = pulled["min_batch_version"]
+        elif not self.runner_group.remotes:
             # Synchronous fallback (num_env_runners=0): sample inline.
             episodes = self.runner_group.sample(
                 num_steps=cfg.rollout_fragment_length)
@@ -232,27 +298,73 @@ class IMPALA(Algorithm):
             episodes = []
             for ref in ready:
                 runner = self._inflight.pop(ref)
-                episodes.extend(ray_tpu.get(ref))
-                # immediately re-arm the runner (async pipeline)
-                self._inflight[runner.sample.remote(
-                    num_steps=cfg.rollout_fragment_length)] = runner
+                try:
+                    episodes.extend(ray_tpu.get(ref))
+                except exc.RayActorError:
+                    # crashable fleet, pipelined path: drop the dead
+                    # runner's fragment and replace the slot in place
+                    # (no-op if sync_weights already did)
+                    self.runner_group.replace_runner(runner)
+            # deficit-based re-arm: every CURRENT fleet member keeps its
+            # full in-flight pipeline, replacements included
+            self._replenish_pipeline()
         if not episodes:
-            # Runners stalled (worker spawn / first-compile); retry next step.
+            if self.dataflow is not None \
+                    and self.dataflow.stale_dropped > self._stale_seen:
+                # an empty pull where batches were dropped as STALE means
+                # the fleet is stamping versions the learner no longer
+                # accepts (restored checkpoint, broadcast_interval wider
+                # than the staleness window): re-broadcast NOW or the
+                # loop livelocks — no update, so the interval-gated
+                # broadcast below would never fire again
+                self._stale_seen = self.dataflow.stale_dropped
+                self.dataflow.broadcast(self.params, self.policy_version)
+                self._steps_since_broadcast = 0
+            # Queue refilling / runners stalled (respawn, first-compile):
+            # the learner's cadence is preserved by returning, not waiting.
             return {"num_episodes": 0}
+        if self.dataflow is not None:
+            self._stale_seen = self.dataflow.stale_dropped
         self._record_episodes(episodes)
+        env_steps = sum(len(e) for e in episodes)
         batch = self._episodes_to_batch(episodes)
         self.params, self.opt_state, aux = self._update(
             self.params, self.opt_state, batch)
+        self.policy_version += 1
+        self._updates += 1
         self._steps_since_broadcast += 1
         if self._steps_since_broadcast >= cfg.broadcast_interval:
-            self.runner_group.sync_weights(self.params)
+            if self.dataflow is not None:
+                self.dataflow.broadcast(self.params, self.policy_version)
+            else:
+                self.runner_group.sync_weights(self.params,
+                                               self.policy_version)
             self._steps_since_broadcast = 0
         out = {k: float(v) for k, v in aux.items()}
         out["num_episodes"] = len(episodes)
+        out["policy_version"] = self.policy_version
+        if self.dataflow is not None:
+            # one rl.learner_step per ACTUAL update: step cadence, the
+            # staleness proof (version vs min batch version vs bound) and
+            # monotonic progress all derive from these events
+            # (drills/slo.rl_slo)
+            df = self.dataflow.stats()
+            event_log.emit(
+                "rl.learner_step", step=self._updates,
+                version=self.policy_version, env_steps=env_steps,
+                min_batch_version=min_batch_version,
+                staleness_bound=self.dataflow.max_staleness,
+                stale_dropped=df["stale_dropped"],
+                discarded_dead=df["discarded_dead"],
+                runners=df["fleet_runners"])
+            out["dataflow"] = df
         return out
 
     def stop(self) -> None:
-        self.runner_group.stop()
+        if self.dataflow is not None:
+            self.dataflow.stop()
+        if self.runner_group is not None:
+            self.runner_group.stop()
 
 
 class APPOConfig(IMPALAConfig):

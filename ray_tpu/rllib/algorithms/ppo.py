@@ -82,7 +82,9 @@ class PPO(Algorithm):
         cfg = config.to_dict()
         self.env_runner_group = EnvRunnerGroup(cfg, self.module_spec)
         self.learner_group = LearnerGroup(PPOLearner, self.module_spec, cfg)
-        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+        self.env_runner_group.sync_weights(
+            self.learner_group.get_weights(),
+            self.learner_group.policy_version)
 
     def training_step(self) -> Dict[str, Any]:
         cfg = self.config
@@ -140,8 +142,11 @@ class PPO(Algorithm):
                 mb = {k: v[idx] for k, v in train_batch.items()}
                 metrics = self.learner_group.update_from_batch(mb)
 
-        # 4. broadcast
-        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+        # 4. broadcast (versioned: restarted runners and offline
+        # consumers can tell which policy produced a sample)
+        self.env_runner_group.sync_weights(
+            self.learner_group.get_weights(),
+            self.learner_group.policy_version)
         metrics["num_env_steps_sampled"] = steps
         return metrics
 
@@ -155,7 +160,8 @@ class PPO(Algorithm):
         if "learner" in state:
             self.learner_group.set_state(state["learner"])
             self.env_runner_group.sync_weights(
-                self.learner_group.get_weights())
+                self.learner_group.get_weights(),
+                self.learner_group.policy_version)
 
     def stop(self) -> None:
         self.env_runner_group.stop()

@@ -1,0 +1,570 @@
+"""Decoupled, fault-tolerant RL dataflow (ISSUE 14).
+
+The Podracer/MindSpeed-RL shape: a fleet of rollout `EnvRunner` actors
+pushes sample batches into a bounded sample queue riding the object
+store, while the learner pulls asynchronously — sampling never blocks on
+learning and the learner never waits on any single runner. The fleet is
+explicitly CRASHABLE and PREEMPTIBLE; the learner makes monotonic
+progress through runner deaths, node preemption and elastic resizing.
+
+Three pieces:
+
+* `SampleQueueActor` — the bounded queue (bound named from
+  `CONFIG.rl_sample_queue_max` / `AlgorithmConfig.sample_queue_size`;
+  CONTRIBUTING "every queue names its bound"). Entries are small
+  (ObjectRef + policy-version + runner-incarnation stamps); the sample
+  payloads live in the object store, owned by the runner that produced
+  them — a dead runner's in-flight batches surface as typed
+  OwnerDiedError at the learner and are discarded, never trained on.
+  Overflow is typed shed back to the runner ({"retry_later": ...} with a
+  retry-after hint, the PR 9 pushback convention) plus an
+  `rl.sample_shed` event. Pushes from a superseded runner incarnation
+  (a zombie on a partitioned/preempted node the fleet already replaced)
+  are rejected, mirroring serve's controller-incarnation guard
+  (`rl.zombie_push`).
+
+* `RolloutFleet` — the driver-side fleet manager: keeps
+  `max_requests_in_flight_per_env_runner` sample-and-push calls armed
+  per runner, detects `ActorDiedError` on ack refs and
+  `node.preempt_notice` via `EventCursor` (the serve-controller
+  pattern), discards the dead runner's queued batches (incarnation bump
+  at the queue), respawns a replacement with the CURRENT weights without
+  any blocking call on the learner's step path, and resizes elastically
+  on queue starvation/backlog signals. Every membership change emits
+  (`rl.runner_dead` / `rl.runner_respawn` / `rl.fleet_scale` —
+  CONTRIBUTING rule).
+
+* `DecoupledDataflow` — the learner-side façade: pop a batch of entries,
+  enforce the off-policy staleness bound (learner_version −
+  batch_version > max_sample_staleness ⇒ dropped + counted +
+  `rl.stale_drop`, NEVER trained on), resolve refs (dead-runner refs
+  counted discarded), and expose versioned weight broadcast.
+
+Metrics ride the existing autoscaler/dashboard path
+(`ray_tpu_rl_queue_depth`, `ray_tpu_rl_rollout_runners`,
+`ray_tpu_rl_samples_shed_total`, `ray_tpu_rl_stale_dropped_total`,
+`ray_tpu_rl_runner_restarts_total`).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import ray_tpu
+from ray_tpu import exceptions as exc
+from ray_tpu._private import event_log
+from ray_tpu._private.config import CONFIG
+
+logger = logging.getLogger(__name__)
+
+# exceptions that mean "this runner / its objects are gone", not a bug
+# (ObjectLostError covers OwnerDied/ObjectFreed/reconstruction-failed)
+_RUNNER_GONE = (exc.RayActorError, exc.ObjectLostError,
+                exc.WorkerCrashedError)
+
+
+class SampleQueueActor:
+    """Bounded sample queue between the rollout fleet and the learner.
+
+    Bound: `maxsize` entries, named from CONFIG.rl_sample_queue_max at
+    the creation site (DecoupledDataflow). Entries carry refs, not
+    payloads — the queue actor never materializes a sample batch.
+    """
+
+    def __init__(self, maxsize: int):
+        self._maxsize = int(maxsize)
+        self._items: List[dict] = []  # bounded by _maxsize in push()
+        # runner slot -> current incarnation; pushes below it are zombies
+        self._incarnations: Dict[int, int] = {}
+        self._stats = {"pushed": 0, "popped": 0, "shed": 0,
+                       "zombie_rejected": 0, "discarded_dead": 0}
+
+    def set_incarnation(self, runner: int, incarnation: int) -> int:
+        """Install a runner slot's current incarnation (spawn/respawn)
+        and DISCARD queued entries from older incarnations of that slot
+        — the dead/preempted runner's in-flight batches. Returns the
+        discard count."""
+        runner = int(runner)
+        cur = self._incarnations.get(runner, -1)
+        if incarnation < cur:
+            return 0  # stale installer (out-of-order fleet message)
+        self._incarnations[runner] = int(incarnation)
+        keep = []
+        dropped = 0
+        for e in self._items:
+            if e.get("runner") == runner \
+                    and e.get("incarnation", 0) < incarnation:
+                dropped += 1
+            else:
+                keep.append(e)
+        if dropped:
+            self._items = keep
+            self._stats["discarded_dead"] += dropped
+        return dropped
+
+    def push(self, entry: dict) -> dict:
+        runner = int(entry.get("runner", 0))
+        incarnation = int(entry.get("incarnation", 0))
+        current = self._incarnations.get(runner, -1)
+        if incarnation < current:
+            # zombie: a superseded incarnation still pushing (preempted
+            # node not yet torn down) — its weights/version stamps are
+            # untrusted, reject outright (never queued, never trained)
+            self._stats["zombie_rejected"] += 1
+            event_log.emit("rl.zombie_push", runner=runner,
+                           incarnation=incarnation, current=current)
+            return {"rejected": "zombie", "current": current}
+        if incarnation > current:
+            # a replacement's first push can beat the fleet's
+            # set_incarnation message; newer always supersedes
+            self._incarnations[runner] = incarnation
+        if len(self._items) >= self._maxsize:
+            from ray_tpu._private.backoff import retry_after_hint
+
+            self._stats["shed"] += 1
+            event_log.emit("rl.sample_shed", runner=runner,
+                           depth=len(self._items))
+            # typed pushback, PR 9 convention: refused (not queued, not
+            # lost), retry after THE shared hint formula (one fragment's
+            # learner-side train time per queued entry, floored so a
+            # just-full queue isn't instantly re-hammered)
+            return {"retry_later": True,
+                    "retry_after_s": retry_after_hint(
+                        len(self._items), per_item_s=0.01, floor_s=0.05,
+                        cap_s=1.0)}
+        self._items.append(entry)
+        self._stats["pushed"] += 1
+        return {"ok": True, "depth": len(self._items)}
+
+    def pop_batch(self, max_items: int) -> dict:
+        """Pop up to `max_items` entries, returning them WITH a stats
+        snapshot in one reply — the learner's pull must never need a
+        second round trip whose failure would strand already-popped
+        (hence unrecoverable) entries."""
+        out, self._items = (self._items[:max_items],
+                            self._items[max_items:])
+        self._stats["popped"] += len(out)
+        return {"entries": out, **self.stats()}
+
+    def depth(self) -> int:
+        return len(self._items)
+
+    def stats(self) -> dict:
+        return {"depth": len(self._items), "maxsize": self._maxsize,
+                "incarnations": dict(self._incarnations), **self._stats}
+
+
+class _Slot:
+    """One rollout-fleet slot: a runner actor + its incarnation."""
+
+    def __init__(self, index: int, incarnation: int, handle):
+        self.index = index
+        self.incarnation = incarnation
+        self.handle = handle
+        self.node_ref = None        # in-flight get_node_id
+        self.node_id: Optional[str] = None
+        self.inflight: set = set()  # ack refs of armed sample_and_push
+        self.actor_id = handle._actor_id.hex()
+
+
+class RolloutFleet:
+    """Driver-side manager of a crashable, elastic rollout fleet."""
+
+    def __init__(self, config: Dict[str, Any], module_spec: Dict[str, Any],
+                 queue_handle):
+        from ray_tpu._private.event_watch import EventCursor
+        from ray_tpu.rllib.env_runner import EnvRunner
+
+        self._config = config
+        self._module_spec = module_spec
+        self._queue = queue_handle
+        self._cls = ray_tpu.remote(EnvRunner)
+        self._num_steps = config.get("rollout_fragment_length", 200)
+        self._per_runner_inflight = max(
+            1, int(config.get("max_requests_in_flight_per_env_runner", 2)))
+        self._restart = config.get("restart_failed_env_runners", True)
+        self._restart_budget = int(
+            config.get("max_env_runner_restarts", 20))
+        self._elastic_min = config.get("elastic_min_env_runners")
+        self._elastic_max = config.get("elastic_max_env_runners")
+        self._lock = threading.Lock()   # snapshot() is read cross-thread
+        self.slots: Dict[int, _Slot] = {}
+        self._next_index = 0
+        self._weights_ref = None
+        self._version = 0
+        self.restarts = 0
+        self.deaths = 0
+        self._acks = {"pushed": 0, "shed": 0, "env_steps": 0}
+        # starvation/backlog windows for the elastic policy
+        self._starved_pumps = 0
+        self._backlogged_pumps = 0
+        # preempt notices, consumed once each (serve-controller pattern)
+        self._preempt_cursor = EventCursor("node.preempt_notice")
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self, weights, version: int = 0) -> None:
+        self._weights_ref = ray_tpu.put(weights)
+        self._version = int(version)
+        n = int(self._config.get("num_env_runners", 0))
+        for _ in range(n):
+            self._spawn_slot()
+
+    def stop(self) -> None:
+        with self._lock:
+            slots = list(self.slots.values())
+            self.slots = {}
+        for s in slots:
+            try:
+                ray_tpu.kill(s.handle)
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+
+    # -- spawning ------------------------------------------------------------
+
+    def _actor_options(self) -> dict:
+        opts: Dict[str, Any] = {
+            "num_cpus": self._config.get("num_cpus_per_env_runner", 1)}
+        custom = self._config.get("custom_resources_per_env_runner")
+        if custom:
+            opts["resources"] = dict(custom)
+        return opts
+
+    def _spawn_slot(self, index: Optional[int] = None,
+                    incarnation: int = 0) -> _Slot:
+        """Create a runner for `index` (fresh slot when None) and arm it.
+        Everything here is non-blocking submission — a respawn must not
+        consume the learner's step cadence."""
+        if index is None:
+            index = self._next_index
+            self._next_index += 1
+        handle = self._cls.options(**self._actor_options()).remote(
+            self._config, self._module_spec, index + 1)
+        slot = _Slot(index, incarnation, handle)
+        # versioned weights BEFORE the first sample; the queue learns the
+        # incarnation so older queued pushes from this slot are discarded
+        handle.set_weights.remote(self._weights_ref, self._version)
+        self._queue.set_incarnation.remote(index, incarnation)
+        slot.node_ref = handle.get_node_id.remote()
+        for _ in range(self._per_runner_inflight):
+            self._arm(slot)
+        with self._lock:
+            self.slots[index] = slot
+        return slot
+
+    def _arm(self, slot: _Slot) -> None:
+        ref = slot.handle.sample_and_push.remote(
+            self._queue, num_steps=self._num_steps,
+            runner_index=slot.index, incarnation=slot.incarnation)
+        slot.inflight.add(ref)
+
+    # -- death / preemption / respawn ----------------------------------------
+
+    def _on_runner_gone(self, slot: _Slot, reason: str) -> None:
+        """A runner died (ActorDiedError on an ack) or its node got a
+        preempt notice: discard its queued batches via the incarnation
+        bump and respawn a replacement with the current weights."""
+        with self._lock:
+            live = self.slots.get(slot.index)
+            if live is None or live.incarnation != slot.incarnation:
+                return  # already replaced (death raced the preempt path)
+            del self.slots[slot.index]
+        self.deaths += 1
+        event_log.emit("rl.runner_dead", actor_id=slot.actor_id,
+                       runner=slot.index, reason=reason,
+                       incarnation=slot.incarnation)
+        if reason == "preempt_notice":
+            # the old actor may still run for the notice window; kill it
+            # so it stops burning the node's last CPU-seconds (its pushes
+            # would be zombie-rejected regardless)
+            try:
+                ray_tpu.kill(slot.handle)
+            except Exception:  # noqa: BLE001 — node may already be gone
+                pass
+        if not self._restart:
+            return
+        if self.restarts >= self._restart_budget:
+            logger.warning(
+                "rollout runner %d died (%s) but the respawn budget "
+                "(max_env_runner_restarts=%d) is spent; fleet degrades "
+                "to %d runner(s)", slot.index, reason,
+                self._restart_budget, len(self.slots))
+            return
+        self.restarts += 1
+        new = self._spawn_slot(slot.index, slot.incarnation + 1)
+        event_log.emit("rl.runner_respawn", actor_id=new.actor_id,
+                       runner=new.index, incarnation=new.incarnation,
+                       reason=reason)
+
+    def _check_preempt_notices(self) -> None:
+        for ev in self._preempt_cursor.poll(limit=100):
+            node = ev.get("node_id")
+            if not node:
+                continue
+            with self._lock:
+                victims = [s for s in self.slots.values()
+                           if s.node_id == node]
+            for slot in victims:
+                self._on_runner_gone(slot, "preempt_notice")
+
+    # -- the pump ------------------------------------------------------------
+
+    def pump(self, timeout: float = 0.0) -> Dict[str, int]:
+        """Collect ready acks, re-arm runners, resolve node attribution,
+        react to deaths and preempt notices. Non-blocking by default —
+        this runs on the learner's step path."""
+        self._check_preempt_notices()
+        with self._lock:
+            slots = list(self.slots.values())
+        # node attribution resolves lazily (one outstanding ref per slot)
+        for slot in slots:
+            if slot.node_id is None and slot.node_ref is not None:
+                ready, _ = ray_tpu.wait([slot.node_ref], num_returns=1,
+                                        timeout=0)
+                if ready:
+                    try:
+                        slot.node_id = ray_tpu.get(ready[0])
+                    except _RUNNER_GONE:
+                        self._on_runner_gone(slot, "actor_died")
+                    slot.node_ref = None
+        by_ref: Dict[Any, _Slot] = {
+            ref: slot for slot in slots for ref in slot.inflight}
+        if not by_ref:
+            return dict(self._acks)
+        ready, _ = ray_tpu.wait(list(by_ref), num_returns=len(by_ref),
+                                timeout=timeout)
+        dead: Dict[int, Tuple[_Slot, str]] = {}
+        for ref in ready:
+            slot = by_ref[ref]
+            slot.inflight.discard(ref)
+            try:
+                ack = ray_tpu.get(ref)
+            except _RUNNER_GONE as e:
+                dead.setdefault(slot.index, (slot, type(e).__name__))
+                continue
+            except Exception as e:  # noqa: BLE001 — sampling bug: surface
+                raise e
+            self._acks["env_steps"] += int(ack.get("env_steps", 0))
+            if ack.get("pushed"):
+                self._acks["pushed"] += 1
+            elif ack.get("shed"):
+                self._acks["shed"] += 1
+            # re-arm (the runner already paced itself on shed); a kill
+            # between ack and re-arm surfaces HERE as a synchronous
+            # ActorDiedError from submit
+            if slot.index in self.slots \
+                    and self.slots[slot.index] is slot:
+                try:
+                    self._arm(slot)
+                except _RUNNER_GONE as e:
+                    dead.setdefault(slot.index, (slot, type(e).__name__))
+        for slot, reason in dead.values():
+            self._on_runner_gone(slot, reason)
+        return dict(self._acks)
+
+    # -- weights -------------------------------------------------------------
+
+    def broadcast(self, weights, version: int) -> None:
+        """Versioned weight push to every live runner (one put, N refs).
+        Fire-and-forget: a broadcast never blocks the learner; a runner
+        that dies mid-push is caught by the next pump."""
+        self._weights_ref = ray_tpu.put(weights)
+        self._version = int(version)
+        with self._lock:
+            slots = list(self.slots.values())
+        for slot in slots:
+            try:
+                slot.handle.set_weights.remote(self._weights_ref, version)
+            except _RUNNER_GONE:
+                self._on_runner_gone(slot, "actor_died")
+        event_log.emit("rl.weights_broadcast", version=version,
+                       runners=len(slots))
+
+    # -- elastic scaling -----------------------------------------------------
+
+    def maybe_autoscale(self, queue_depth: int, shed_delta: int) -> None:
+        """Elastic fleet sizing off the same signals the metrics path
+        exports: a persistently EMPTY queue means the learner is starved
+        (scale up, bounded by elastic_max_env_runners); persistent shed
+        means rollouts outpace the learner (scale down to the min —
+        shed work is wasted env steps)."""
+        if self._elastic_max is None:
+            return
+        lo = int(self._elastic_min if self._elastic_min is not None
+                 else self._config.get("num_env_runners", 1))
+        hi = int(self._elastic_max)
+        n = len(self.slots)
+        self._starved_pumps = self._starved_pumps + 1 \
+            if queue_depth == 0 and shed_delta == 0 else 0
+        self._backlogged_pumps = self._backlogged_pumps + 1 \
+            if shed_delta > 0 else 0
+        if self._starved_pumps >= 5 and n < hi:
+            self._starved_pumps = 0
+            self._spawn_slot()
+            event_log.emit("rl.fleet_scale", from_runners=n,
+                           to_runners=n + 1, reason="learner_starved")
+        elif self._backlogged_pumps >= 5 and n > lo:
+            self._backlogged_pumps = 0
+            with self._lock:
+                idx = max(self.slots)
+                slot = self.slots.pop(idx)
+            # retire the slot: discard its queued entries (the queue
+            # treats a bumped incarnation's predecessors as dead) and
+            # kill the actor
+            self._queue.set_incarnation.remote(idx, slot.incarnation + 1)
+            try:
+                ray_tpu.kill(slot.handle)
+            except Exception:  # noqa: BLE001
+                pass
+            event_log.emit("rl.fleet_scale", from_runners=n,
+                           to_runners=n - 1, reason="queue_backlogged")
+
+    # -- introspection -------------------------------------------------------
+
+    def snapshot(self) -> Dict[int, Dict[str, Any]]:
+        """Thread-safe fleet view (the rl_rollout_storm drill picks its
+        victims from this on another thread)."""
+        with self._lock:
+            return {
+                i: {"actor_id": s.actor_id, "incarnation": s.incarnation,
+                    "node_id": s.node_id, "handle": s.handle}
+                for i, s in self.slots.items()
+            }
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            n = len(self.slots)
+        return {"runners": n, "deaths": self.deaths,
+                "restarts": self.restarts, "version": self._version,
+                **self._acks}
+
+
+class DecoupledDataflow:
+    """Learner-side façade over the queue + fleet."""
+
+    def __init__(self, config: Dict[str, Any], module_spec: Dict[str, Any],
+                 weights, version: int = 0):
+        bound = int(config.get("sample_queue_size")
+                    or CONFIG.rl_sample_queue_max)
+        qopts: Dict[str, Any] = {"num_cpus": 0.05}
+        if config.get("sample_queue_resources"):
+            # e.g. pin to the head node while the fleet rides
+            # preemptible nodes — the queue is learner-side state
+            qopts["resources"] = dict(config["sample_queue_resources"])
+        self.queue = ray_tpu.remote(SampleQueueActor).options(
+            **qopts).remote(bound)
+        self.fleet = RolloutFleet(config, module_spec, self.queue)
+        self.fleet.start(weights, version)
+        self.max_staleness = int(config.get("max_sample_staleness", 2))
+        self.stale_dropped = 0
+        self.discarded_dead = 0
+        self.env_steps_trained = 0
+        self._last_shed = 0
+        self._metrics_ready = False
+
+    def pull(self, current_version: int,
+             max_batches: Optional[int] = None,
+             ) -> List[Tuple[dict, list]]:
+        """Pump the fleet, pop ready entries, enforce the staleness
+        bound, resolve refs. Returns [(entry, episodes), ...] of batches
+        SAFE to train on. Never blocks on any individual runner."""
+        self.fleet.pump()
+        if max_batches is None:
+            max_batches = max(2, 2 * len(self.fleet.slots))
+        try:
+            # ONE round trip: entries + stats snapshot together — a
+            # failure here loses nothing (the entries stay queued)
+            qstats = ray_tpu.get(
+                self.queue.pop_batch.remote(max_batches), timeout=30)
+            entries = qstats["entries"]
+        except Exception:  # noqa: BLE001 — queue actor mid-restart blip
+            logger.warning("sample queue unreachable this pull; retrying "
+                           "next step", exc_info=True)
+            return []
+        out: List[Tuple[dict, list]] = []
+        for e in entries:
+            version = int(e.get("policy_version", 0))
+            if current_version - version > self.max_staleness:
+                # off-policy staleness bound: dropped and counted, NEVER
+                # trained on
+                self.stale_dropped += 1
+                event_log.emit("rl.stale_drop", version=current_version,
+                               batch_version=version,
+                               bound=self.max_staleness,
+                               runner=e.get("runner"))
+                continue
+            try:
+                episodes = ray_tpu.get(e["ref"], timeout=30)
+            except _RUNNER_GONE:
+                # the producing runner died with this batch in flight
+                self.discarded_dead += 1
+                continue
+            self.env_steps_trained += int(e.get("env_steps", 0))
+            out.append((e, episodes))
+        shed_delta = qstats["shed"] - self._last_shed
+        self._last_shed = qstats["shed"]
+        self.fleet.maybe_autoscale(qstats["depth"], shed_delta)
+        self._export_metrics(qstats)
+        return out
+
+    def broadcast(self, weights, version: int) -> None:
+        self.fleet.broadcast(weights, version)
+
+    def stats(self) -> Dict[str, Any]:
+        return {"stale_dropped": self.stale_dropped,
+                "discarded_dead": self.discarded_dead,
+                "env_steps_trained": self.env_steps_trained,
+                **{f"fleet_{k}": v for k, v in self.fleet.stats().items()}}
+
+    def stop(self) -> None:
+        self.fleet.stop()
+        try:
+            ray_tpu.kill(self.queue)
+        except Exception:  # noqa: BLE001 — teardown best-effort
+            pass
+
+    # -- metrics (the autoscaler/dashboard path) -----------------------------
+
+    def _export_metrics(self, qstats: dict) -> None:
+        try:
+            from ray_tpu.util.metrics import Counter, Gauge, get_metric
+
+            def gauge(name, desc):
+                m = get_metric(name)
+                return m if m is not None else Gauge(name, desc)
+
+            def counter(name, desc):
+                m = get_metric(name)
+                return m if m is not None else Counter(name, desc)
+
+            gauge("ray_tpu_rl_queue_depth",
+                  "Sample-queue depth (entries)").set(qstats["depth"])
+            gauge("ray_tpu_rl_rollout_runners",
+                  "Live rollout runners").set(len(self.fleet.slots))
+            if not self._metrics_ready:
+                # counters exist from the first export so dashboards see
+                # zeros rather than gaps
+                counter("ray_tpu_rl_samples_shed_total",
+                        "Sample batches shed by the bounded queue")
+                counter("ray_tpu_rl_stale_dropped_total",
+                        "Batches dropped by the staleness bound")
+                counter("ray_tpu_rl_runner_restarts_total",
+                        "Rollout runners respawned after death")
+                self._metrics_ready = True
+                self._exported = {"shed": 0, "stale": 0, "restarts": 0}
+            deltas = (("ray_tpu_rl_samples_shed_total", "shed",
+                       qstats["shed"]),
+                      ("ray_tpu_rl_stale_dropped_total", "stale",
+                       self.stale_dropped),
+                      ("ray_tpu_rl_runner_restarts_total", "restarts",
+                       self.fleet.restarts))
+            for name, key, total in deltas:
+                d = total - self._exported[key]
+                if d > 0:
+                    counter(name, "").inc(d)
+                    self._exported[key] = total
+        except Exception:  # noqa: BLE001 — metrics never fail training
+            logger.debug("rl metric export failed", exc_info=True)

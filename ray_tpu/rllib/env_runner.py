@@ -15,6 +15,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 import ray_tpu
+from ray_tpu import exceptions as exc
 from ray_tpu.rllib.episode import SingleAgentEpisode
 
 
@@ -90,12 +91,60 @@ class EnvRunner:
         self._episodes = [SingleAgentEpisode() for _ in range(n_envs)]
         for i, ep in enumerate(self._episodes):
             ep.add_env_reset(self._obs[i])
+        # policy version of the last set_weights: every pushed sample
+        # batch is stamped with it so the learner can enforce the
+        # off-policy staleness bound (dataflow.DecoupledDataflow)
+        self._weights_version = 0
 
-    def set_weights(self, params) -> None:
+    def set_weights(self, params, version=None) -> None:
         self.params = params
+        if version is not None:
+            self._weights_version = int(version)
 
     def get_weights(self):
         return self.params
+
+    def get_weights_version(self) -> int:
+        return self._weights_version
+
+    def get_node_id(self) -> str:
+        """Node attribution for the fleet's preempt-notice sweep."""
+        from ray_tpu.runtime_context import get_runtime_context
+
+        try:
+            return get_runtime_context().get_node_id()
+        except Exception:  # noqa: BLE001 — inline (non-actor) runner
+            return ""
+
+    def sample_and_push(self, queue, *, num_steps: Optional[int] = None,
+                        runner_index: int = 0, incarnation: int = 0,
+                        explore: bool = True) -> Dict[str, Any]:
+        """One decoupled rollout turn: sample a fragment, put it in the
+        object store (this runner owns the payload — if this actor dies
+        the learner sees typed OwnerDiedError and discards), push the
+        stamped entry to the bounded sample queue, and return a SMALL
+        ack to the fleet pump. A shed push drops the batch (the ref dies
+        with this frame) and paces the next arm from the queue's
+        retry-after hint — pushback is honored runner-side so the fleet
+        pump stays non-blocking."""
+        import time as _time
+
+        episodes = self.sample(num_steps=num_steps, explore=explore)
+        steps = sum(len(e) for e in episodes)
+        version = self._weights_version
+        ref = ray_tpu.put(episodes)
+        entry = {"ref": ref, "env_steps": steps, "policy_version": version,
+                 "runner": runner_index, "incarnation": incarnation}
+        ack = ray_tpu.get(queue.push.remote(entry), timeout=60)
+        if ack.get("retry_later"):
+            _time.sleep(min(float(ack.get("retry_after_s", 0.05)), 0.5))
+            return {"pushed": False, "shed": True, "env_steps": steps,
+                    "version": version}
+        if ack.get("rejected"):
+            return {"pushed": False, "rejected": ack["rejected"],
+                    "env_steps": steps, "version": version}
+        return {"pushed": True, "env_steps": steps, "version": version,
+                "depth": ack.get("depth")}
 
     def sample(self, *, num_steps: Optional[int] = None,
                explore: bool = True,
@@ -172,32 +221,143 @@ class EnvRunner:
 
 
 class EnvRunnerGroup:
-    """Driver-side handle to N EnvRunner actors (or one inline runner)."""
+    """Driver-side handle to N EnvRunner actors (or one inline runner).
+
+    Fault-tolerant on the synchronous path too: a runner that dies
+    mid-`sample()` is detected per-ref (`ActorDiedError`), replaced with
+    a fresh actor carrying the LAST synced weights and its fragment
+    re-collected from the survivors' results — one lost env runner no
+    longer stalls or kills training (fleet-membership events
+    `rl.runner_dead` / `rl.runner_respawn` emitted, CONTRIBUTING rule).
+    `restart_failed_env_runners=False` restores fail-fast."""
 
     def __init__(self, config: Dict[str, Any], module_spec: Dict[str, Any]):
         self.num_remote = config.get("num_env_runners", 0)
+        self._config = config
+        self._module_spec = module_spec
+        self._restart = config.get("restart_failed_env_runners", True)
+        self._restart_budget = int(
+            config.get("max_env_runner_restarts", 20))
+        self.restarts = 0
+        self._last_weights_ref = None
         if self.num_remote == 0:
             self.local = EnvRunner(config, module_spec, worker_index=0)
             self.remotes = []
         else:
             self.local = None
             cls = ray_tpu.remote(EnvRunner)
+            self._cls = cls
             self.remotes = [
-                cls.options(num_cpus=1).remote(config, module_spec, i + 1)
-                for i in range(self.num_remote)]
+                self._spawn(i + 1) for i in range(self.num_remote)]
 
-    def sync_weights(self, params) -> None:
+    def _spawn(self, worker_index: int):
+        opts: Dict[str, Any] = {
+            "num_cpus": self._config.get("num_cpus_per_env_runner", 1)}
+        custom = self._config.get("custom_resources_per_env_runner")
+        if custom:
+            opts["resources"] = dict(custom)
+        return self._cls.options(**opts).remote(
+            self._config, self._module_spec, worker_index)
+
+    def sync_weights(self, params, version: Optional[int] = None) -> None:
         if self.local is not None:
-            self.local.set_weights(params)
-        else:
-            ref = ray_tpu.put(params)
-            ray_tpu.get([w.set_weights.remote(ref) for w in self.remotes])
+            self.local.set_weights(params, version)
+            return
+        ref = ray_tpu.put(params)
+        self._last_weights_ref = (ref, version)
+        pushes: List[tuple] = []
+        dead: List[int] = []
+        for i, w in enumerate(self.remotes):
+            try:
+                pushes.append((i, w.set_weights.remote(ref, version)))
+            except exc.RayActorError:
+                dead.append(i)
+        for i, push in pushes:
+            try:
+                ray_tpu.get(push)
+            except exc.RayActorError:
+                dead.append(i)
+        for i in dead:
+            if not self._restart or self.restarts >= self._restart_budget:
+                raise exc.ActorDiedError(
+                    self.remotes[i]._actor_id,
+                    error_message=f"env runner {i} died during weight "
+                                  "sync and restarts are exhausted/off")
+            # _replace_runner pushes _last_weights_ref (set above), so
+            # the replacement comes up on THIS broadcast's weights
+            self._replace_runner(i, "actor_died")
+
+    def replace_runner(self, handle, reason: str = "actor_died"):
+        """Replace a dead remote runner HANDLE in place and return the
+        replacement (carrying the last synced weights). None when the
+        handle is no longer in the fleet (another path already replaced
+        it). When restarts are off or the budget is exhausted this emits
+        the membership event and RAISES — fail-fast parity with the
+        sync sample() path; a silently shrinking fleet is worse than a
+        loud stop. For callers that drive runners by handle (IMPALA's
+        pipelined in-flight map) rather than through sample()."""
+        try:
+            index = self.remotes.index(handle)
+        except ValueError:
+            return None
+        if not self._restart or self.restarts >= self._restart_budget:
+            from ray_tpu._private import event_log
+
+            event_log.emit("rl.runner_dead",
+                           actor_id=handle._actor_id.hex(),
+                           runner=index, reason=reason)
+            raise exc.ActorDiedError(
+                handle._actor_id,
+                error_message=f"env runner {index} died ({reason}) and "
+                              "restarts are exhausted/off")
+        self._replace_runner(index, reason)
+        return self.remotes[index]
+
+    def _replace_runner(self, index: int, reason: str) -> None:
+        from ray_tpu._private import event_log
+
+        old = self.remotes[index]
+        event_log.emit("rl.runner_dead", actor_id=old._actor_id.hex(),
+                       runner=index, reason=reason)
+        replacement = self._spawn(index + 1)
+        if self._last_weights_ref is not None:
+            ref, version = self._last_weights_ref
+            replacement.set_weights.remote(ref, version)
+        self.remotes[index] = replacement
+        self.restarts += 1
+        event_log.emit("rl.runner_respawn",
+                       actor_id=replacement._actor_id.hex(),
+                       runner=index, incarnation=self.restarts,
+                       reason=reason)
 
     def sample(self, **kw) -> List[SingleAgentEpisode]:
         if self.local is not None:
             return self.local.sample(**kw)
-        out = ray_tpu.get([w.sample.remote(**kw) for w in self.remotes])
-        return [ep for eps in out for ep in eps]
+        refs: List[tuple] = []
+        out: List[SingleAgentEpisode] = []
+        dead: List[int] = []
+
+        def _mark_dead(i):
+            if not self._restart or self.restarts >= self._restart_budget:
+                raise  # noqa: PLE0704 — re-raise the active RayActorError
+            dead.append(i)
+
+        for i, w in enumerate(self.remotes):
+            try:
+                # a known-dead handle raises synchronously at submit
+                refs.append((i, w.sample.remote(**kw)))
+            except exc.RayActorError:
+                _mark_dead(i)
+        for i, ref in refs:
+            try:
+                out.extend(ray_tpu.get(ref))
+            except exc.RayActorError:
+                _mark_dead(i)
+        for i in dead:
+            self._replace_runner(i, "actor_died")
+        # the caller's batch-size loop tops up whatever the dead
+        # runner(s) failed to deliver; nothing re-blocks here
+        return out
 
     def stop(self) -> None:
         if self.local is not None:

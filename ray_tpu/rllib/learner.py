@@ -33,6 +33,10 @@ class JaxLearner:
         self.optimizer = optax.chain(*tx)
         self.opt_state = self.optimizer.init(self.params)
         self._update = self._build_update()
+        # monotonic policy version: bumped per update, stamped onto every
+        # weight broadcast so rollout batches carry the version that
+        # produced them (the decoupled dataflow's staleness bound)
+        self.policy_version = 0
 
     # -- to be overridden ----------------------------------------------------
 
@@ -60,6 +64,7 @@ class JaxLearner:
                           ) -> Dict[str, float]:
         self.params, self.opt_state, metrics = self._update(
             self.params, self.opt_state, batch)
+        self.policy_version += 1
         return {k: float(v) for k, v in metrics.items()}
 
     def get_weights(self):
@@ -72,11 +77,13 @@ class JaxLearner:
         import jax
 
         return {"params": jax.device_get(self.params),
-                "opt_state": jax.device_get(self.opt_state)}
+                "opt_state": jax.device_get(self.opt_state),
+                "policy_version": self.policy_version}
 
     def set_state(self, state: Dict[str, Any]) -> None:
         self.params = state["params"]
         self.opt_state = state["opt_state"]
+        self.policy_version = int(state.get("policy_version", 0))
 
 
 class LearnerGroup:
@@ -89,6 +96,9 @@ class LearnerGroup:
     def __init__(self, learner_cls, module_spec: Dict[str, Any],
                  config: Dict[str, Any]):
         self.num_remote = config.get("num_learners", 0)
+        # driver-side mirror of the policy version for the remote-learner
+        # case (the local case reads the learner's own counter)
+        self._version = 0
         if self.num_remote == 0:
             self.local = learner_cls(module_spec, config)
             self.remotes = []
@@ -111,6 +121,7 @@ class LearnerGroup:
         metrics = ray_tpu.get([
             w.update_from_batch.remote(s)
             for w, s in zip(self.remotes, shards)])
+        self._version += 1
         # average weights (parameter-mean DP)
         import jax
 
@@ -128,6 +139,12 @@ class LearnerGroup:
         if self.local is not None:
             return self.local.get_weights()
         return ray_tpu.get(self.remotes[0].get_weights.remote())
+
+    @property
+    def policy_version(self) -> int:
+        if self.local is not None:
+            return self.local.policy_version
+        return self._version
 
     def get_state(self):
         if self.local is not None:

@@ -1842,11 +1842,29 @@ class CoreWorker:
             pass
 
     # ------------------------------------------------- task completion paths
+    def _register_reply_borrows(self, reply: dict) -> None:
+        """Arg-borrow retention, owner side: the executing worker's reply
+        names the nested arg refs it kept (executor._attach_retained_
+        borrows). Register it as borrower NOW — before _finalize_task
+        releases the submitted-task pins — because its own eager
+        add_borrower rides a separate (possibly first-contact) peer
+        connection and can lose the race against this owner's frame-exit
+        free. Double-adds are harmless (borrowers is a set); a borrow
+        retained here and dropped later is released by the worker's
+        normal remove_borrower / death sweep."""
+        borrower = reply.get("borrower_address")
+        if not borrower:
+            return
+        for oid in reply.get("retained_borrows") or ():
+            if self.reference_counter.owns(oid):
+                self.reference_counter.add_borrower(oid, borrower)
+
     def _on_task_reply(self, spec: TaskSpec, reply: dict):
         t_reply = time.monotonic()
         pending = self._pending_tasks.get(spec.task_id)
         if pending is None or pending.spec.attempt_number != spec.attempt_number:
             return
+        self._register_reply_borrows(reply)
         status = reply.get("status")
         if status == "ok":
             for oid, payload in reply.get("returns", []):

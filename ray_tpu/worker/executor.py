@@ -242,15 +242,95 @@ class Executor:
         or dies (the owner drops dead borrowers wholesale). A recipient
         that never deserializes the value holds the borrow until death —
         the price of not piggybacking registration on replies like the
-        reference does."""
+        reference does.
+
+        Refs this worker merely BORROWS (forwarding: a queue actor
+        handing an owned-elsewhere ref onward) have the same race one
+        hop removed — this worker's own borrow is released by GC the
+        moment the value leaves its heap, and the owner may free before
+        the recipient's async registration lands. For those this worker
+        PINS the ref with an extra local ref (extending its own borrow,
+        which the owner already honors) and registers the recipient
+        asynchronously; the pin is released only when that registration
+        completes, so the owner always sees add(recipient) strictly
+        before remove(this worker) — without ever blocking reply
+        packaging on a possibly-hung owner (a partitioned owner must
+        not stall every reply this actor sends)."""
         if recipient is None or not s.contained_refs:
             return
         addr = getattr(recipient, "rpc_address", None)
         if addr is None or addr == self.cw.address.rpc_address:
             return  # self-call: local refcounts already cover it
         for ref in s.contained_refs:
-            if self.cw.reference_counter.owns(ref.object_id()):
-                self.cw.reference_counter.add_borrower(ref.object_id(), addr)
+            oid = ref.object_id()
+            if self.cw.reference_counter.owns(oid):
+                self.cw.reference_counter.add_borrower(oid, addr)
+                continue
+            owner = ref.owner_address
+            owner_addr = getattr(owner, "rpc_address", None)
+            if owner_addr is None or owner_addr in (
+                    addr, self.cw.address.rpc_address):
+                # unknown owner (the ref is doomed regardless), the
+                # recipient IS the owner (its local counts cover it), or
+                # a self-owned ref already handled above
+                continue
+            self._register_forward_borrow(oid, owner_addr, addr)
+
+    def _register_forward_borrow(self, oid: ObjectID, owner_addr: str,
+                                 borrower_addr: str) -> None:
+        """Pin `oid` locally, register `borrower_addr` with the owner
+        async, release the pin when the registration settles (success or
+        failure — a dead owner means the ref is already doomed)."""
+        rc = self.cw.reference_counter
+        rc.add_local_ref(oid)
+
+        def _release(fut):
+            try:
+                fut.result()
+            except Exception:  # noqa: BLE001 — owner gone: ref doomed
+                logger.debug("forward-borrow registration with %s failed "
+                             "for %s", owner_addr, oid.hex(), exc_info=True)
+            rc.remove_local_ref(oid)
+
+        try:
+            client = self.cw._peers.get(owner_addr)
+            fut = asyncio.run_coroutine_threadsafe(
+                client.call_async("add_borrower",
+                                  {"object_id": oid,
+                                   "borrower": borrower_addr},
+                                  timeout=30.0),
+                self.cw._lt.loop)
+            fut.add_done_callback(_release)
+        except Exception:  # noqa: BLE001 — loop shutting down
+            rc.remove_local_ref(oid)
+            logger.debug("forward-borrow registration submit failed "
+                         "for %s", oid.hex(), exc_info=True)
+
+    def _attach_retained_borrows(self, spec: TaskSpec, reply: dict) -> None:
+        """The other half of the borrow protocol, for ARGS: a ref nested
+        in a task argument whose owner is the SUBMITTER races the same
+        way returns do — the submitter's frame-exit free (its local ref
+        plus the submitted-task pin both drop when this reply lands) can
+        beat this worker's eager first-contact add_borrower. The reply
+        therefore reports every nested arg ref this worker RETAINED
+        (e.g. a sample-queue actor that stored the entry), and the owner
+        registers the borrow synchronously BEFORE releasing its pins
+        (core_worker._register_reply_borrows). A ref retained here but
+        dropped later is cleaned by the normal remove_borrower path."""
+        kwarg_specs = getattr(spec, "kwarg_specs", {}) or {}
+        nested = [nid
+                  for a in list(spec.args) + list(kwarg_specs.values())
+                  for nid in a.nested_ids]
+        if not nested:
+            return
+        owner_addr = getattr(spec.owner_address, "rpc_address", None)
+        if owner_addr is None or owner_addr == self.cw.address.rpc_address:
+            return  # self-call: local refcounts already cover it
+        held = [oid for oid in nested
+                if self.cw.reference_counter.holds_borrow(oid)]
+        if held:
+            reply["retained_borrows"] = held
+            reply["borrower_address"] = self.cw.address.rpc_address
 
     def _package_value(self, oid: ObjectID, value: Any,
                        recipient=None) -> dict:
@@ -331,6 +411,7 @@ class Executor:
     def _run_normal_task(self, spec: TaskSpec) -> dict:
         t0 = time.monotonic()
         reply = self._run_normal_task_inner(spec)
+        self._attach_retained_borrows(spec, reply)
         # worker-measured execution time: the owner's push-batching gate
         # needs task duration EXCLUDING network RTT (an RTT-inclusive
         # sample would lock remote owners out of batching forever)
@@ -536,6 +617,7 @@ class Executor:
         exec_started = time.monotonic()
         reply = self._run_actor_body(spec, caller, ordered)
         if isinstance(reply, dict):
+            self._attach_retained_borrows(spec, reply)
             reply["exec_s"] = time.monotonic() - exec_started
             # dispatch stage = recv -> here; for ordered actors that
             # includes the sequencing-gate wait, which IS dispatch queueing

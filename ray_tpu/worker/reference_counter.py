@@ -235,6 +235,16 @@ class ReferenceCounter:
             ref = self._refs.get(object_id)
             return ref.owner_address if ref else None
 
+    def holds_borrow(self, object_id: ObjectID) -> bool:
+        """True when this worker currently BORROWS the object (not owner)
+        and still pins it with a local or submitted-task ref — i.e. the
+        executor retained a nested arg ref past the task body and must
+        report it in the reply (executor._attach_retained_borrows)."""
+        with self._lock:
+            ref = self._refs.get(object_id)
+            return (ref is not None and not ref.owned
+                    and (ref.local_refs > 0 or ref.submitted_task_refs > 0))
+
     def owns(self, object_id: ObjectID) -> bool:
         with self._lock:
             ref = self._refs.get(object_id)

@@ -12,15 +12,20 @@
 #                        recover from its GCS-KV checkpoint and ADOPT
 #                        every live replica (zero restarts, zero
 #                        lost-accepted, bounded MTTR)
-#   5. tracing smoke   — one traced serve request must produce a span
+#   5. rl storm gate   — the rl_rollout_storm drill: rollout-runner
+#                        kills + a node preemption mid-decoupled-RL-
+#                        training; learner cadence, zero stale batches
+#                        trained, zero lost progress, slot-keyed
+#                        respawn MTTR
+#   6. tracing smoke   — one traced serve request must produce a span
 #                        tree spanning >=6 spans across >=3 processes in
 #                        the GCS span store (trace context on the wire,
 #                        cluster-wide collection, header attribution)
-#   6. dataplane smoke — one >2x-chunk-size jax.Array put/get across a
+#   7. dataplane smoke — one >2x-chunk-size jax.Array put/get across a
 #                        2-node in-process cluster: value integrity, a
 #                        conservative bandwidth floor, and ZERO
 #                        whole-payload copies (serialization.COPY_STATS)
-#   7. tier-1 tests    — the full `not slow` suite
+#   8. tier-1 tests    — the full `not slow` suite
 #
 # Usage: tools/ci.sh [--skip-tests]
 set -euo pipefail
@@ -43,6 +48,11 @@ echo "== controller_kill drill gate =="
 JAX_PLATFORMS=cpu python -m ray_tpu drill run \
     --scenario controller_kill --budget 120s --seed 0 \
     --report "${TMPDIR:-/tmp}/ci_controller_report.json" --gate
+
+echo "== rl_rollout_storm drill gate =="
+JAX_PLATFORMS=cpu python -m ray_tpu drill run \
+    --scenario rl_rollout_storm --budget 240s --seed 0 \
+    --report "${TMPDIR:-/tmp}/ci_rl_storm_report.json" --gate
 
 echo "== tracing smoke (bounded) =="
 JAX_PLATFORMS=cpu python -m tools.tracing_smoke --budget 120
