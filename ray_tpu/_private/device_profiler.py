@@ -1,0 +1,541 @@
+"""Device-plane step-phase profiler: attribute every training step and
+engine decode wave into fenced phases.
+
+The control plane has had stage breakdowns since PR 1 — the DEVICE plane
+(where the two flat ROADMAP curves live: single-chip MFU at 0.656 since
+BENCH_r02, decode at 85% of the HBM roofline) had none: nothing said
+whether a step was input-starved, recompiling, or compute-bound. Podracer
+(PAPERS.md) frames TPU efficiency as exactly this attribution problem —
+keep the chip busy by measuring what it waits on.
+
+One step decomposes into phases:
+
+  input_wait       host: blocked on the input pipeline (iterator next)
+  h2d              host->device transfer of the batch (device_put, fenced)
+  compile          XLA compilation observed DURING the step (via the
+                   jax.monitoring backend_compile listener; subtracted
+                   from the phase it fired inside of)
+  device_execute   the fenced device program (dispatch -> buffers ready)
+  reply            result delivery (host transfer of metrics / token
+                   chunks pushed to consumers)
+
+FENCING is the load-bearing part: jax dispatch is async, so a bare
+``perf_counter()`` delta around a jitted call measures dispatch (~µs) and
+silently attributes the real device time to whatever host code happens to
+block next. Every phase context fences with ``jax.block_until_ready`` on
+the value registered via ``fence()`` before stopping its clock (raylint
+RTL009 `unfenced-device-timing` enforces the same invariant tree-wide).
+
+Exports, per profiler (train step / decode wave):
+
+  ray_tpu_step_phase_seconds{phase,profiler}   histogram
+  ray_tpu_device_mfu{profiler}                 gauge (needs flops_per_step)
+  ray_tpu_hbm_bytes_in_use{device}             gauge (device.memory_stats)
+  ray_tpu_hbm_bytes_peak{device}               gauge
+
+plus ``compile.start`` / ``compile.end`` events into the event log so
+recompile storms show up in ``ray-tpu debug postmortem``, and per-step
+records behind ``report()`` — the payload `ray-tpu profile --device`
+fans out and merges with PR 1's task-stage spans into one chrome trace.
+
+Zero overhead when off: a disabled profiler's ``step()``/``phase()``
+return shared no-op contexts (one attribute check per call).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+PHASES = ("input_wait", "h2d", "compile", "device_execute", "reply")
+
+# Device phases span ~100µs (one decode chunk) to minutes (a compile
+# storm); reuse the control-plane stage layout which covers that range.
+_PHASE_BOUNDARIES = [
+    1e-5, 5e-5, 1e-4, 5e-4, 1e-3, 5e-3, 0.01, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 15.0, 60.0, 300.0,
+]
+
+_lock = threading.Lock()           # profiler registry
+_metrics_lock = threading.Lock()   # lazy metric creation
+_phase_hist = None
+_mfu_gauge = None
+_hbm_gauges = None
+_registry: Dict[str, "DeviceStepProfiler"] = {}
+
+# -- compile telemetry (jax.monitoring backend_compile listener) ------------
+
+_compile_lock = threading.Lock()
+_compile_listener_installed = False
+_compile_seconds = 0.0
+_compile_count = 0
+# jax.monitoring fires this once per XLA backend compilation (cache
+# misses only — cache hits never reach the backend).
+_COMPILE_EVENT_SUFFIX = "backend_compile_duration"
+
+
+def _metrics():
+    """Lazy per-process metric objects (importing this module must not
+    register metrics in processes that never profile). Locked: the data
+    feed thread (observe_phase) can race a profiler construction here."""
+    global _phase_hist, _mfu_gauge, _hbm_gauges
+    with _metrics_lock:
+        return _metrics_locked()
+
+
+def _metrics_locked():
+    global _phase_hist, _mfu_gauge, _hbm_gauges
+    if _phase_hist is None:
+        from ray_tpu.util.metrics import Gauge, get_metric, \
+            get_or_create_histogram
+
+        _phase_hist = get_or_create_histogram(
+            "ray_tpu_step_phase_seconds",
+            "Per-phase device-step latency (input_wait/h2d/compile/"
+            "device_execute/reply)",
+            boundaries=_PHASE_BOUNDARIES,
+            tag_keys=("phase", "profiler"),
+        )
+
+        def _gauge(name, desc, tags):
+            m = get_metric(name)
+            return m if m is not None else Gauge(name, desc, tag_keys=tags)
+
+        _mfu_gauge = _gauge(
+            "ray_tpu_device_mfu",
+            "Model FLOPs utilization of the profiled step (device_execute "
+            "time vs the per-chip peak-flops table)", ("profiler",))
+        _hbm_gauges = (
+            _gauge("ray_tpu_hbm_bytes_in_use",
+                   "Device memory in use (device.memory_stats)", ("device",)),
+            _gauge("ray_tpu_hbm_bytes_peak",
+                   "Peak device memory in use (device.memory_stats)",
+                   ("device",)),
+        )
+    return _phase_hist, _mfu_gauge, _hbm_gauges
+
+
+def _on_event_duration(event: str, duration: float, **attrs) -> None:
+    """jax.monitoring listener: accumulate backend compile seconds and
+    emit compile.start/compile.end so recompile storms are visible in the
+    postmortem timeline. May fire on any thread — emit() is non-blocking
+    by contract."""
+    if not event.endswith(_COMPILE_EVENT_SUFFIX):
+        return
+    global _compile_seconds, _compile_count
+    now = time.time()
+    with _compile_lock:
+        _compile_seconds += float(duration)
+        _compile_count += 1
+    try:
+        from ray_tpu._private.event_log import emit
+
+        # The listener fires at compile END; compile.start carries the
+        # true wall start in its data (t_start) — its envelope time is
+        # necessarily the emit instant, one compile later than reality.
+        emit("compile.start", source=event, t_start=now - float(duration))
+        emit("compile.end", source=event, duration_s=float(duration))
+    except Exception:  # noqa: BLE001 — telemetry must never break compiles
+        pass
+
+
+def install_compile_listener() -> None:
+    """Install the compile-duration listener (idempotent, process-wide).
+    jax.monitoring has no deregistration, so this is once-per-process by
+    design; profilers install it on construction."""
+    global _compile_listener_installed
+    with _compile_lock:
+        if _compile_listener_installed:
+            return
+        _compile_listener_installed = True
+    try:
+        import jax.monitoring
+
+        jax.monitoring.register_event_duration_secs_listener(
+            _on_event_duration)
+    except Exception:  # noqa: BLE001 — profiling degrades without jax
+        pass
+
+
+def compile_stats() -> Dict[str, float]:
+    """Cumulative backend-compile telemetry for this process."""
+    with _compile_lock:
+        return {"compiles": _compile_count, "compile_s": _compile_seconds}
+
+
+# -- HBM telemetry ----------------------------------------------------------
+
+def hbm_stats(devices: Optional[List[Any]] = None,
+              export: bool = True) -> Dict[str, Dict[str, int]]:
+    """Per-device HBM occupancy from ``device.memory_stats()``, exported
+    as ray_tpu_hbm_bytes_{in_use,peak} gauges. CPU devices (and any PJRT
+    backend without memory stats) return None / raise — those devices are
+    reported with an empty dict rather than dropped, so the caller can
+    tell "no telemetry" from "no device"."""
+    if devices is None:
+        try:
+            import jax
+
+            devices = jax.local_devices()
+        except Exception:  # noqa: BLE001 — no backend reachable
+            return {}
+    out: Dict[str, Dict[str, int]] = {}
+    gauges = _metrics()[2] if export else None
+    for d in devices:
+        label = f"{getattr(d, 'platform', '?')}:{getattr(d, 'id', '?')}"
+        try:
+            stats = d.memory_stats()
+        except Exception:  # noqa: BLE001 — backend without the API
+            stats = None
+        if not stats:
+            out[label] = {}
+            continue
+        entry = {}
+        in_use = stats.get("bytes_in_use")
+        peak = stats.get("peak_bytes_in_use", stats.get("bytes_in_use"))
+        if in_use is not None:
+            entry["bytes_in_use"] = int(in_use)
+            if gauges:
+                gauges[0].set(float(in_use), tags={"device": label})
+        if peak is not None:
+            entry["peak_bytes_in_use"] = int(peak)
+            if gauges:
+                gauges[1].set(float(peak), tags={"device": label})
+        if "bytes_limit" in stats:
+            entry["bytes_limit"] = int(stats["bytes_limit"])
+        out[label] = entry
+    return out
+
+
+def observe_phase(phase: str, seconds: float, profiler: str = "data") -> None:
+    """Record one phase sample into the cluster-wide histogram without a
+    step scope — how the input pipeline (data/dataset.py) contributes
+    input_wait/h2d from its producer thread."""
+    _metrics()[0].observe(max(0.0, seconds),
+                          tags={"phase": phase, "profiler": profiler})
+
+
+def _block(value: Any) -> None:
+    """Fence: wait until every jax array in `value` is ready. Non-array
+    leaves pass through untouched (jax.block_until_ready's contract), so
+    host values are free to fence."""
+    import jax
+
+    jax.block_until_ready(value)
+
+
+# -- no-op fast path --------------------------------------------------------
+
+class _NoopPhase:
+    __slots__ = ()
+
+    def fence(self, value):
+        return value
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class _NoopStep(_NoopPhase):
+    __slots__ = ()
+
+    def phase(self, name):  # noqa: ARG002 — signature parity
+        return _NOOP_PHASE
+
+    def external(self, name, seconds):
+        pass
+
+
+_NOOP_PHASE = _NoopPhase()
+_NOOP_STEP = _NoopStep()
+
+
+# -- the profiler -----------------------------------------------------------
+
+class _Phase:
+    """One timed, fenced phase inside a step scope."""
+
+    __slots__ = ("_scope", "_name", "_t0", "_fence")
+
+    def __init__(self, scope: "_StepScope", name: str):
+        self._scope = scope
+        self._name = name
+        self._fence = None
+
+    def fence(self, value):
+        """Register the value whose readiness ends this phase (pytrees
+        fine; non-array leaves ignored). Returns it for inline use."""
+        self._fence = value
+        return value
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is None and self._fence is not None:
+            _block(self._fence)
+        self._scope._record_phase(
+            self._name, time.perf_counter() - self._t0)
+        self._fence = None
+        return False
+
+
+class _StepScope:
+    """One step's phase accounting; created by DeviceStepProfiler.step()."""
+
+    __slots__ = ("_prof", "_phases", "_t0", "_wall0", "_compile0",
+                 "_tokens", "_lock")
+
+    def __init__(self, prof: "DeviceStepProfiler", tokens: Optional[int]):
+        self._prof = prof
+        self._phases: Dict[str, float] = {}
+        self._tokens = tokens
+        self._lock = threading.Lock()
+
+    def phase(self, name: str) -> _Phase:
+        return _Phase(self, name)
+
+    def external(self, name: str, seconds: float) -> None:
+        """Attribute host-measured seconds (e.g. the input pipeline's
+        consumer wait, measured by the iterator) to a phase of this step."""
+        self._record_phase(name, seconds)
+
+    def _record_phase(self, name: str, dur: float) -> None:
+        with self._lock:
+            self._phases[name] = self._phases.get(name, 0.0) + max(0.0, dur)
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        self._wall0 = time.time()
+        with _compile_lock:
+            self._compile0 = _compile_seconds
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        total = time.perf_counter() - self._t0
+        with _compile_lock:
+            compile_d = _compile_seconds - self._compile0
+        if exc_type is None:
+            self._prof._finish_step(
+                self._wall0, total, dict(self._phases), compile_d,
+                self._tokens)
+        return False
+
+
+class DeviceStepProfiler:
+    """Phase attribution for a repeated device program (train step /
+    decode wave). Thread-safe; one instance per logical step stream.
+
+    flops_per_step + peak_flops_per_chip make every profiled step export
+    a live MFU (the PR 7 per-chip flops tables feed peak_flops_per_chip:
+    accelerators.tpu.bf16_peak_flops_per_chip(device_kind))."""
+
+    def __init__(self, name: str, *,
+                 flops_per_step: Optional[float] = None,
+                 peak_flops_per_chip: Optional[float] = None,
+                 n_devices: int = 1,
+                 enabled: bool = True,
+                 max_steps: int = 1024,
+                 hbm_every: int = 0):
+        self.name = name
+        self.flops_per_step = flops_per_step
+        self.peak_flops_per_chip = peak_flops_per_chip
+        self.n_devices = max(1, n_devices)
+        self.enabled = enabled
+        self.hbm_every = hbm_every  # export HBM gauges every N steps (0=off)
+        self._steps: deque = deque(maxlen=max_steps)
+        self._totals: Dict[str, float] = {}
+        self._n = 0
+        self._mfu_last: Optional[float] = None
+        self._lock = threading.Lock()
+        # record_step compile attribution: compiles since this mark belong
+        # to the next recorded step (the scope path snapshots per step)
+        with _compile_lock:
+            self._compile_mark = _compile_seconds
+        if enabled:
+            install_compile_listener()
+            _metrics()
+
+    # the one per-step overhead when disabled: this attribute check
+    def step(self, tokens: Optional[int] = None):
+        if not self.enabled:
+            return _NOOP_STEP
+        return _StepScope(self, tokens)
+
+    def record_step(self, phases: Dict[str, float],
+                    tokens: Optional[int] = None,
+                    wall0: Optional[float] = None) -> None:
+        """Record one already-timed step (generator-shaped loops — the
+        engine's decode wave — can't wrap their body in a scope without
+        attributing consumer suspension time to a phase). The caller
+        fenced its own device phases (device_get / block_until_ready);
+        compile seconds since the previous record are carved out exactly
+        like the scoped path."""
+        if not self.enabled:
+            return
+        with _compile_lock:
+            now_c = _compile_seconds
+        with self._lock:
+            mark = self._compile_mark
+            self._compile_mark = now_c
+        compile_d = max(0.0, now_c - mark)
+        total = sum(phases.values())
+        self._finish_step(
+            wall0 if wall0 is not None else time.time() - total,
+            total, dict(phases), compile_d, tokens)
+
+    def _finish_step(self, wall0: float, total: float,
+                     phases: Dict[str, float], compile_d: float,
+                     tokens: Optional[int]) -> None:
+        hist, mfu_gauge, _ = _metrics()
+        if compile_d > 0:
+            # compile fired inside one of the fenced phases (almost
+            # always device_execute's first call); carve it out so the
+            # steady-state phase doesn't wear the compile storm
+            for carve in ("device_execute", "h2d"):
+                if phases.get(carve, 0.0) > 0:
+                    phases[carve] = max(0.0, phases[carve] - compile_d)
+                    break
+            phases["compile"] = phases.get("compile", 0.0) + compile_d
+        mfu = None
+        dev = phases.get("device_execute", 0.0)
+        if (self.flops_per_step and self.peak_flops_per_chip and dev > 0):
+            mfu = (self.flops_per_step / dev
+                   / (self.peak_flops_per_chip * self.n_devices))
+            mfu_gauge.set(mfu, tags={"profiler": self.name})
+        for ph, dur in phases.items():
+            hist.observe(dur, tags={"phase": ph, "profiler": self.name})
+        rec = {"time": wall0, "total": total, "phases": phases,
+               "mfu": mfu, "tokens": tokens}
+        with self._lock:
+            self._steps.append(rec)
+            self._n += 1
+            self._mfu_last = mfu if mfu is not None else self._mfu_last
+            for ph, dur in phases.items():
+                self._totals[ph] = self._totals.get(ph, 0.0) + dur
+            n = self._n
+        if self.hbm_every and n % self.hbm_every == 0:
+            try:
+                hbm_stats()
+            except Exception:  # noqa: BLE001 — telemetry only
+                pass
+
+    def report(self, recent: int = 64, emit_event: bool = True,
+               include_hbm: bool = True) -> Dict[str, Any]:
+        """Aggregate phase report: totals, fractions of accounted time
+        (input_wait_frac / device_frac / ...), compile seconds, MFU, HBM
+        occupancy, and the recent per-step records `ray-tpu profile
+        --device` renders into chrome-trace lanes. recent=0 means NO
+        per-step records; include_hbm=False skips the device sweep
+        (snapshot_all does ONE sweep for all profilers)."""
+        with self._lock:
+            totals = dict(self._totals)
+            steps = self._n
+            recent_steps = list(self._steps)[-recent:] if recent > 0 else []
+            mfu = self._mfu_last
+        accounted = sum(totals.values()) or 1.0
+        fracs = {f"{ph}_frac": round(totals.get(ph, 0.0) / accounted, 4)
+                 for ph in PHASES}
+        for ph in set(totals) - set(PHASES):
+            fracs[f"{ph}_frac"] = round(totals[ph] / accounted, 4)
+        rep = {
+            "profiler": self.name,
+            "steps": steps,
+            "phase_seconds": {k: round(v, 6) for k, v in totals.items()},
+            "accounted_s": round(accounted if totals else 0.0, 6),
+            "compile_s": round(totals.get("compile", 0.0), 6),
+            "mfu": mfu,
+            **fracs,
+            "compile_process": compile_stats(),
+            "hbm": hbm_stats() if include_hbm else {},
+            "recent_steps": recent_steps,
+        }
+        if emit_event and steps:
+            try:
+                from ray_tpu._private.event_log import emit
+
+                emit("perf.phase_report", profiler=self.name, steps=steps,
+                     fracs={k: v for k, v in fracs.items()})
+            except Exception:  # noqa: BLE001 — reporting is best-effort
+                pass
+        return rep
+
+    def reset(self) -> None:
+        with self._lock:
+            self._steps.clear()
+            self._totals.clear()
+            self._n = 0
+            self._mfu_last = None
+
+
+def get_profiler(name: str, **kwargs) -> DeviceStepProfiler:
+    """Process-wide registry: the engine/train loop creates, the
+    profile_device RPC snapshots. Construction kwargs only apply on first
+    creation; flops/peak updates go through the returned object."""
+    with _lock:
+        prof = _registry.get(name)
+        if prof is None:
+            prof = _registry[name] = DeviceStepProfiler(name, **kwargs)
+        return prof
+
+
+def snapshot_all(recent: int = 64) -> Dict[str, Any]:
+    """Every registered profiler's report — the profile_device RPC body."""
+    with _lock:
+        profs = list(_registry.values())
+    return {
+        "pid": os.getpid(),
+        "compile": compile_stats(),
+        # ONE device sweep for the whole snapshot (per-profiler reports
+        # skip theirs — identical data K+1 times otherwise)
+        "hbm": hbm_stats(),
+        "profilers": {p.name: p.report(recent=recent, emit_event=False,
+                                       include_hbm=False)
+                      for p in profs},
+    }
+
+
+def steps_to_spans(report: Dict[str, Any], proc: str) -> List[Dict[str, Any]]:
+    """Render one profiler report's recent steps into span dicts (the
+    tracing-module shape) — phases laid back-to-back inside each step, one
+    lane per (proc, profiler) — mergeable with PR 1 task-stage spans via
+    tracing.trace_chrome."""
+    spans: List[Dict[str, Any]] = []
+    name = report.get("profiler", "?")
+    for i, rec in enumerate(report.get("recent_steps", ())):
+        t0 = rec.get("time", 0.0)
+        t = t0
+        spans.append({
+            "span_id": f"dev-{name}-{i}", "parent_id": None,
+            "trace_id": None, "name": f"{name}.step",
+            "proc": proc, "thread": f"device:{name}",
+            "start": t0, "end": t0 + rec.get("total", 0.0),
+            "attrs": {"mfu": rec.get("mfu"), "tokens": rec.get("tokens")},
+        })
+        phases = rec.get("phases", {})
+        # canonical phases first for stable ordering, then any custom
+        # ones (e.g. the engine's "prefill") — dropping them would show
+        # unexplained gaps in an admission-bound engine's lanes
+        ordered = [p for p in PHASES if p in phases] + sorted(
+            p for p in phases if p not in PHASES)
+        for ph in ordered:
+            dur = phases.get(ph, 0.0)
+            if dur <= 0:
+                continue
+            spans.append({
+                "span_id": f"dev-{name}-{i}-{ph}",
+                "parent_id": f"dev-{name}-{i}", "trace_id": None,
+                "name": f"{name}:{ph}", "proc": proc,
+                "thread": f"device:{name}",
+                "start": t, "end": t + dur,
+                "attrs": {"phase": ph},
+            })
+            t += dur
+    return spans

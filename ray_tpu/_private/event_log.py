@@ -145,6 +145,20 @@ EVENT_SCHEMAS: Dict[str, Tuple[str, ...]] = {
     "rl.runner_dead": ("runner", "reason"),
     "rl.runner_respawn": ("runner", "incarnation"),
     "rl.fleet_scale": ("from_runners", "to_runners", "reason"),
+    # device-plane performance observability (ISSUE 15): one compile.*
+    # pair per XLA backend compilation, emitted by the device profiler's
+    # jax.monitoring listener — a recompile storm is a dense run of these
+    # in `ray-tpu debug postmortem`. The listener only fires at compile
+    # END, so compile.start's envelope time is the emit instant; its
+    # data.t_start carries the true wall start.
+    "compile.start": ("source", "t_start"),
+    "compile.end": ("source", "duration_s"),
+    # a DeviceStepProfiler aggregate report (bench runs, `ray-tpu
+    # profile --device` fan-outs): phase fractions of accounted time
+    "perf.phase_report": ("profiler", "steps", "fracs"),
+    # tools/perf_gate.py: a gated benchmark metric fell past its noise
+    # band vs the BENCH_* trajectory (the CI perf-regression gate)
+    "perf.regression": ("metric", "baseline", "current", "band"),
 }
 
 _ID_KEYS = ("task_id", "actor_id", "node_id", "object_id", "trace_id")

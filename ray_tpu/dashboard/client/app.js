@@ -481,6 +481,12 @@ async function pageMetrics() {
     svgChart("LLM queue depth (per engine replica)",
              pick(/^llm_queue_depth_/), num),
     svgChart("LLM batch occupancy", pick(/^llm_batch_occupancy_/), num),
+    svgChart("Device step phases p50 (input_wait/h2d/compile/execute/reply)",
+             pick(/^device_phase_.*_p50$/), ms),
+    svgChart("Device step phases p99", pick(/^device_phase_.*_p99$/), ms),
+    svgChart("Device MFU (per profiler)", pick(/^device_mfu_/), num),
+    svgChart("HBM bytes (in use / peak, per device)",
+             pick(/^hbm_(in_use|peak)_/), mib),
   ].join("");
   return `<h2>Live metrics
     <span class="muted">(ring-buffered, ${data.sample_period_s ?? 5}s

@@ -111,11 +111,19 @@ def _prefetch_device_feed(src: Iterator, to_device: Callable, depth: int,
     t = threading.Thread(target=_produce, name="rt-data-device-feed",
                          daemon=True)
     t.start()
+    from ray_tpu._private.device_profiler import observe_phase
+
     try:
         while True:
             t0 = _time.perf_counter()
             item = q.get()
-            acc["wait_s"] += _time.perf_counter() - t0
+            wait = _time.perf_counter() - t0
+            acc["wait_s"] += wait
+            # feed the cluster-wide device-plane histogram (ISSUE 15):
+            # consumer seconds blocked on the feed ARE the train step's
+            # input_wait phase, visible next to device_execute in
+            # ray_tpu_step_phase_seconds without any trainer plumbing
+            observe_phase("input_wait", wait)
             if item is _FEED_DONE:
                 break
             if isinstance(item, BaseException):
@@ -502,6 +510,8 @@ class Dataset:
             # second by definition — stats reflect that (overlap_frac 0)
             import time as _time
 
+            from ray_tpu._private.device_profiler import observe_phase
+
             acc = {"produce_s": 0.0, "wait_s": 0.0, "batches": 0}
             try:
                 it = iter(src)
@@ -514,6 +524,7 @@ class Dataset:
                     dt = _time.perf_counter() - t0
                     acc["produce_s"] += dt
                     acc["wait_s"] += dt
+                    observe_phase("input_wait", dt)
                     acc["batches"] += 1
                     yield out
             finally:

@@ -40,6 +40,10 @@ def benchmark_engine(config: Optional[Any] = None, *, max_batch: int = 8,
     eng.generate(prompts, gen)
     t0 = time.perf_counter()
     n_tokens = sum(len(toks) for toks in eng.generate(prompts, gen))
+    # the fence lives inside generate(): every decode wave device_gets its
+    # token chunk before it reaches these host lists (paged_engine serve
+    # loop), so the delta below covers completed device work
+    # raylint: disable=unfenced-device-timing
     dt = time.perf_counter() - t0
 
     # On-device estimate (VERDICT r2 weak #3): the bench chip sits behind

@@ -34,6 +34,7 @@ max_blocks_per_seq] operand.
 from __future__ import annotations
 
 import hashlib
+import time
 from collections import OrderedDict
 from functools import partial
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
@@ -121,6 +122,14 @@ class PagedInferenceEngine:
         }
         self._key = jax.random.PRNGKey(0)
         self.decode_chunk = max(1, decode_chunk)
+        # Device-plane phase attribution (ISSUE 15): every decode wave
+        # records input_wait / prefill / device_execute / reply into the
+        # shared "decode" profiler — `ray-tpu profile --device` fans these
+        # out, engine.stats() carries the aggregate, and HBM occupancy
+        # gauges refresh every few waves (memory_stats is a no-op on CPU).
+        from ray_tpu._private.device_profiler import get_profiler
+
+        self.profiler = get_profiler("decode", hbm_every=8)
         self.preemptions = 0  # observability: recompute-preemption count
         self.peak_active = 0  # high-water mark of concurrently-decoding
         # requests — the ground-truth continuous-batching signal
@@ -413,6 +422,14 @@ class PagedInferenceEngine:
                 "cached_blocks": len(self.cached_lru),
                 "indexed_blocks": len(self.hash_index),
             },
+            # decode-wave phase attribution (ISSUE 15): is the engine
+            # input-starved, recompiling, or device-bound?
+            "device_phases": {
+                k: v for k, v in self.profiler.report(
+                    recent=0, emit_event=False,
+                    include_hbm=False).items()
+                if k not in ("recent_steps", "hbm", "compile_process")
+            },
         }
 
     def serve_stream(
@@ -609,11 +626,22 @@ class PagedInferenceEngine:
                                     "max_new": max_new}
                 yield from first_tokens
 
+        # per-wave phase accounting (ISSUE 15): input_wait = blocked on
+        # feed, prefill = admission waves (batched prefill + first-token
+        # handoff), device_execute = the fenced decode dispatch, reply =
+        # token fan-out to the consumer. Accumulates across the host-side
+        # bookkeeping of one wave, records one profiler step per dispatch.
+        phase_acc = {"input_wait": 0.0, "prefill": 0.0}
+
+        _t = time.perf_counter()
         poll(block=True)
+        phase_acc["input_wait"] += time.perf_counter() - _t
         while True:
             while failed:
                 yield failed.pop(), None, True
+            _t = time.perf_counter()
             yield from admit_all()
+            phase_acc["prefill"] += time.perf_counter() - _t
             self.peak_active = max(self.peak_active, len(active))
             if not active:
                 if pending:
@@ -629,7 +657,9 @@ class PagedInferenceEngine:
                     continue
                 if stopped:
                     return
+                _t = time.perf_counter()
                 poll(block=True)
+                phase_acc["input_wait"] += time.perf_counter() - _t
                 continue
             # grow every active slot to cover the next chunk; preempt the
             # youngest request (fewest emitted tokens) until it fits.
@@ -702,13 +732,19 @@ class PagedInferenceEngine:
                    if gen.eos_token_id is not None else -1)
             # n_steps is capped by the block capacity the host actually
             # reserved (`steps`), not just the remaining budget
+            _t = time.perf_counter()
             self.pool, chunk, executed = self._decode(
                 self.params, self.pool, jnp.asarray(tokens), table,
                 lengths, jnp.asarray(budget), jnp.asarray(act), sub,
                 jnp.int32(steps), jnp.int32(eos), max_steps=steps,
                 temperature=gen.temperature,
                 top_k=gen.top_k, top_p=gen.top_p)
+            # the device_get IS the fence: the wave's device time ends
+            # when its tokens reach the host (RTL009's invariant)
             chunk, executed = jax.device_get((chunk, executed))
+            phase_acc["device_execute"] = time.perf_counter() - _t
+            n_emitted = 0
+            _t = time.perf_counter()
             finished = []
             for step in range(int(executed)):
                 if not active:
@@ -727,15 +763,26 @@ class PagedInferenceEngine:
                              and token == gen.eos_token_id)
                             or len(st["emitted"]) >= st["max_new"]
                             or self.lengths[slot] + 1 >= self.max_len)
+                    n_emitted += 1
                     yield st["req"], token, done
                     if done:
                         del active[slot]
                         finished.append(slot)
             for slot in finished:
                 self._release(slot)
+            # reply covers token fan-out INCLUDING consumer handoff (the
+            # generator suspends at each yield): a slow consumer shows up
+            # here, not hidden inside device time
+            phase_acc["reply"] = time.perf_counter() - _t
+            self.profiler.record_step(
+                {k: v for k, v in phase_acc.items() if v > 0},
+                tokens=n_emitted)
+            phase_acc = {"input_wait": 0.0, "prefill": 0.0}
             poll(block=False)
             if finished or (pending and self.free_slots):
+                _t = time.perf_counter()
                 yield from admit_all()
+                phase_acc["prefill"] += time.perf_counter() - _t
 
     def generate_stream(
         self,

@@ -1432,11 +1432,36 @@ class Raylet:
         {pid, kind: "cpu"|"memory", duration_s?, interval_ms?, top?}."""
         want_pid = payload.get("pid")
         kind = payload.get("kind", "cpu")
+        method = {"cpu": "profile_cpu", "memory": "profile_memory",
+                  "device": "profile_device"}.get(kind, "profile_cpu")
+        timeout = float(payload.get("duration_s", 5.0)) + 30
+        if kind == "device" and want_pid is None:
+            # device-phase reports are cheap aggregates — with no pid the
+            # whole node answers: {pid: snapshot} for every live worker
+            # (the `ray-tpu profile --device` cluster fan-out). Queries
+            # run CONCURRENTLY with a short per-worker timeout: the
+            # caller gives the whole NODE one budget, so two hung
+            # workers polled sequentially must not discard every healthy
+            # worker's report with them.
+            import asyncio as _asyncio
+
+            handles = [h for h in list(self.worker_pool._workers.values())
+                       if h.pid is not None and h.address is not None]
+
+            async def _one(handle):
+                try:
+                    return handle.pid, await self._pool.get(
+                        handle.address.rpc_address).call_async(
+                            method, payload, timeout=10)
+                except Exception as e:  # noqa: BLE001 — worker mid-death
+                    return handle.pid, {"error": str(e)}
+
+            results = await _asyncio.gather(*(_one(h) for h in handles))
+            return {"node_id": self.node_id,
+                    "workers": dict(results)}
         for handle in list(self.worker_pool._workers.values()):
             if handle.pid != want_pid or handle.address is None:
                 continue
-            method = "profile_cpu" if kind == "cpu" else "profile_memory"
-            timeout = float(payload.get("duration_s", 5.0)) + 30
             return await self._pool.get(
                 handle.address.rpc_address).call_async(
                     method, payload, timeout=timeout)

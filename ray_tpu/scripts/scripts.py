@@ -850,11 +850,134 @@ def cmd_client_server(args) -> int:
     return 0
 
 
-def cmd_profile(args) -> int:
-    """Live CPU flamegraph / heap snapshot of a worker (reference: the
-    dashboard's py-spy and memray endpoints, profile_manager.py:83/:192)."""
+def _task_stage_spans(events) -> list:
+    """PR 1 task-stage breakdowns (terminal task events carrying 'stages')
+    rendered as span dicts — the six stages laid back-to-back ending at
+    the event instant, one lane — for the `ray-tpu profile --device`
+    chrome merge against device-phase lanes."""
+    from ray_tpu._private.latency import STAGES
+
+    spans = []
+    for i, e in enumerate(events):
+        stages = e.get("stages") or {}
+        total = sum(stages.get(s, 0.0) or 0.0 for s in STAGES)
+        t_end = e.get("time", 0.0)
+        root = f"task-{i}"
+        spans.append({
+            "span_id": root, "parent_id": None, "trace_id": None,
+            "name": str(e.get("name") or e.get("task_id", "?")),
+            "proc": "tasks", "thread": "task-stages",
+            "start": t_end - total, "end": t_end,
+            "attrs": {"task_id": e.get("task_id"),
+                      "type": e.get("type")},
+        })
+        t = t_end - total
+        for s in STAGES:
+            dur = stages.get(s, 0.0) or 0.0
+            if dur <= 0:
+                continue
+            spans.append({
+                "span_id": f"{root}-{s}", "parent_id": root,
+                "trace_id": None, "name": f"{e.get('name', '?')}:{s}",
+                "proc": "tasks", "thread": "task-stages",
+                "start": t, "end": t + dur, "attrs": {"stage": s},
+            })
+            t += dur
+    return spans
+
+
+def _cmd_profile_device(args) -> int:
+    """`ray-tpu profile --device` (ISSUE 15): fan per-worker device-plane
+    phase reports out through every raylet, merge them with the driver's
+    own profilers, print the phase-attribution table, and optionally
+    export ONE chrome trace whose lanes carry device phases next to the
+    PR 1 task-stage spans."""
     import json as _json
 
+    ray_tpu = _connect(args)
+    from ray_tpu._private import device_profiler
+    from ray_tpu._raylet import get_core_worker
+
+    reports = []  # (proc label, per-profiler report)
+    local = device_profiler.snapshot_all(recent=args.recent)
+    for _name, rep in sorted(local.get("profilers", {}).items()):
+        reports.append((f"driver:{local.get('pid', '?')}", rep))
+    cw = get_core_worker()
+    for n in cw._gcs.call("get_all_node_info", {}):
+        if not n.alive:
+            continue
+        try:
+            r = cw._peers.get(n.raylet_address).call(
+                "profile_worker", {"kind": "device",
+                                   "recent": args.recent}, timeout=60)
+        except Exception as e:  # noqa: BLE001 — keep trying other nodes
+            print(f"node {n.node_id.hex()[:8]}: unreachable ({e})",
+                  file=sys.stderr)
+            continue
+        for pid, snap in sorted((r.get("workers") or {}).items()):
+            if not isinstance(snap, dict) or "error" in snap:
+                continue
+            for _name, rep in sorted((snap.get("profilers") or {}).items()):
+                reports.append((f"worker:{pid}", rep))
+    if args.json:
+        print(_json.dumps([{"proc": p, **r} for p, r in reports],
+                          indent=2, default=str))
+    elif not reports:
+        print("no device-step profilers registered anywhere (a profiler "
+              "appears with the first profiled train step / decode wave; "
+              "bench.py and the paged engine register them)")
+    else:
+        hdr = (f"{'proc':<16} {'profiler':<12} {'steps':>6} "
+               f"{'input_wait':>10} {'h2d':>7} {'compile_s':>9} "
+               f"{'device':>7} {'reply':>7} {'mfu':>7}")
+        print(hdr)
+        print("-" * len(hdr))
+        for proc, rep in reports:
+            mfu = rep.get("mfu")
+            mfu_s = "-" if mfu is None else f"{mfu:.4f}"
+            print(f"{proc:<16} {rep.get('profiler', '?'):<12} "
+                  f"{rep.get('steps', 0):>6} "
+                  f"{rep.get('input_wait_frac', 0.0):>10.3f} "
+                  f"{rep.get('h2d_frac', 0.0):>7.3f} "
+                  f"{rep.get('compile_s', 0.0):>9.3f} "
+                  f"{rep.get('device_execute_frac', 0.0):>7.3f} "
+                  f"{rep.get('reply_frac', 0.0):>7.3f} "
+                  f"{mfu_s:>7}")
+    if args.chrome:
+        from ray_tpu._private import tracing as _tracing
+        from ray_tpu.util.state.api import list_tasks
+
+        spans = []
+        for proc, rep in reports:
+            spans.extend(device_profiler.steps_to_spans(rep, proc))
+        try:
+            events = [e for e in list_tasks(limit=100_000, raw_events=True)
+                      if e.get("stages")]
+        except Exception:  # noqa: BLE001 — GCS task events unavailable
+            events = []
+        spans.extend(_task_stage_spans(events))
+        trace = _tracing.trace_chrome(spans)
+        with open(args.chrome, "w") as f:
+            _json.dump(trace, f)
+        print(f"Wrote {len(trace)} chrome-trace events to {args.chrome} "
+              f"(device phases + task stages; open in chrome://tracing "
+              f"or perfetto.dev)")
+    ray_tpu.shutdown()
+    return 0
+
+
+def cmd_profile(args) -> int:
+    """Live CPU flamegraph / heap snapshot of a worker (reference: the
+    dashboard's py-spy and memray endpoints, profile_manager.py:83/:192),
+    or — with --device — the cluster-wide device-plane phase report."""
+    import json as _json
+
+    if getattr(args, "device", False):
+        return _cmd_profile_device(args)
+    if args.pid is None:
+        print("--pid is required for --cpu/--memory profiles "
+              "(--device fans out to every worker)", file=sys.stderr)
+        return 1
     ray_tpu = _connect(args)
     from ray_tpu._raylet import get_core_worker
     from ray_tpu.util.profiling import folded_to_text
@@ -887,7 +1010,14 @@ def cmd_profile(args) -> int:
         print(f"no live worker with pid {args.pid}")
         return 1
     if args.memory or getattr(args, "memory_stop", False):
-        print(_json.dumps(reply, indent=2))
+        if getattr(args, "folded", False):
+            # flamegraph.pl-compatible heap stacks (size bytes as counts)
+            print(folded_to_text(reply, top=args.top))
+            print(f"# traced {reply.get('traced_current_bytes', 0)} bytes "
+                  f"(peak {reply.get('traced_peak_bytes', 0)})",
+                  file=sys.stderr)
+        else:
+            print(_json.dumps(reply, indent=2))
     else:
         # flamegraph.pl / speedscope-compatible folded stacks
         print(folded_to_text(reply, top=args.top))
@@ -1559,16 +1689,36 @@ def main(argv=None) -> int:
     sp.set_defaults(fn=cmd_client_server)
 
     sp = sub.add_parser("profile",
-                        help="CPU flamegraph / heap snapshot of a worker")
+                        help="CPU flamegraph / heap snapshot of a worker, "
+                             "or --device for the cluster device-plane "
+                             "phase report")
     sp.add_argument("--address")
-    sp.add_argument("--pid", type=int, required=True)
+    sp.add_argument("--pid", type=int,
+                    help="target worker pid (required for --cpu/--memory; "
+                         "--device fans out to every worker)")
     sp.add_argument("--duration", type=float, default=5.0)
     sp.add_argument("--memory", action="store_true",
-                    help="heap snapshot (tracemalloc) instead of CPU")
+                    help="heap snapshot (tracemalloc) instead of CPU; a "
+                         "cold worker samples for --duration in one call")
     sp.add_argument("--memory-stop", action="store_true",
                     help="take a final heap snapshot and STOP tracemalloc "
                          "in the worker (disarms the per-allocation "
                          "overhead a prior --memory run left behind)")
+    sp.add_argument("--folded", action="store_true",
+                    help="with --memory: flamegraph-compatible folded "
+                         "heap stacks instead of JSON")
+    sp.add_argument("--device", action="store_true",
+                    help="device-plane phase report (ISSUE 15): fan "
+                         "per-worker step/decode phase attributions "
+                         "(input_wait/h2d/compile/device_execute/reply), "
+                         "MFU and HBM occupancy out of every raylet")
+    sp.add_argument("--chrome",
+                    help="with --device: write ONE chrome trace merging "
+                         "device phase lanes with PR 1 task-stage spans")
+    sp.add_argument("--recent", type=int, default=64,
+                    help="device steps per profiler in the chrome export")
+    sp.add_argument("--json", action="store_true",
+                    help="with --device: raw JSON reports")
     sp.add_argument("--top", type=int, default=40)
     sp.set_defaults(fn=cmd_profile)
 

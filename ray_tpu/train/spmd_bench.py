@@ -44,17 +44,27 @@ def axis_plan(n_devices: int) -> Dict[str, int]:
     return plan
 
 
-def _timed_steps(step, state, batch, steps: int) -> Tuple[float, List[float]]:
+def _timed_steps(step, state, batch, steps: int,
+                 profiler=None) -> Tuple[float, List[float]]:
     """Wall time per step + the loss trajectory. Synchronizes with a host
     transfer (float()), not block_until_ready — on tunneled PJRT backends
-    the latter can return before the computation runs."""
+    the latter can return before the computation runs. With a
+    DeviceStepProfiler each step's device_execute phase (and any compile
+    it triggers) is attributed (ISSUE 15)."""
     losses = []
     state, m = step(state, batch)  # warmup/compile
     losses.append(float(m["loss"]))
     t0 = time.perf_counter()
     for _ in range(steps):
-        state, m = step(state, batch)
-        losses.append(float(m["loss"]))
+        if profiler is None:
+            state, m = step(state, batch)
+            losses.append(float(m["loss"]))
+        else:
+            with profiler.step() as sp:
+                with sp.phase("device_execute"):
+                    state, m = step(state, batch)
+                    # the float() host transfer IS the fence (see above)
+                    losses.append(float(m["loss"]))
     dt = (time.perf_counter() - t0) / steps
     del state
     return dt, losses
@@ -109,7 +119,7 @@ def run(n_devices: int, steps: int = 8) -> dict:
     toks = jax.random.randint(
         jax.random.PRNGKey(1), (batch, seq + 1), 0, cfg.vocab_size)
 
-    def measure(mesh) -> Tuple[float, List[float]]:
+    def measure(mesh, profiler=None) -> Tuple[float, List[float]]:
         state, shardings = init_train_state(
             partial(llama.init, cfg), opt, llama.param_logical_axes(cfg),
             mesh, jax.random.PRNGKey(0), rules)
@@ -119,17 +129,36 @@ def run(n_devices: int, steps: int = 8) -> dict:
             opt, shardings, batch_sharding={"inputs": bs, "targets": bs})
         b = {"inputs": jax.device_put(toks[:, :-1], bs),
              "targets": jax.device_put(toks[:, 1:], bs)}
-        return _timed_steps(step, state, b, steps)
+        return _timed_steps(step, state, b, steps, profiler=profiler)
+
+    # Device-plane attribution of the MESH program (ISSUE 15): live MFU
+    # from the per-chip flops tables + compile seconds for the n-device
+    # compile, reported in detail and visible to `ray-tpu profile
+    # --device` via the registry.
+    from ray_tpu._private.device_profiler import get_profiler
+
+    flops_tok = llama.flops_per_token(cfg, seq)
+    tokens_per_step = batch * seq
+    prof_n = get_profiler("train_spmd")
+    prof_n.flops_per_step = flops_tok * tokens_per_step
+    prof_n.peak_flops_per_chip = peak_flops
+    prof_n.n_devices = n_devices
+    prof_n.reset()
 
     # The SAME global batch through both programs: first the single-chip
     # baseline, then the mesh program over all n devices.
-    dt_1, losses_1 = measure(build_mesh(MeshConfig(), devices=devices[:1]))
-    dt_n, losses_n = measure(build_mesh(MeshConfig(**plan), devices=devices))
+    from ray_tpu._private.device_profiler import compile_stats
 
-    tokens_per_step = batch * seq
+    dt_1, losses_1 = measure(build_mesh(MeshConfig(), devices=devices[:1]))
+    compile_before = compile_stats()
+    dt_n, losses_n = measure(build_mesh(MeshConfig(**plan), devices=devices),
+                             profiler=prof_n)
+    compile_after = compile_stats()
+
+    # (tokens_per_step / flops_tok computed once above, shared with the
+    # profiler's flops_per_step so MFU and tokens/s can't desynchronize)
     per_chip_1 = tokens_per_step / dt_1  # 1 device
     per_chip_n = tokens_per_step / dt_n / n_devices
-    flops_tok = llama.flops_per_token(cfg, seq)
     loss_diff = max(abs(a - b) for a, b in zip(losses_1, losses_n))
 
     detail = {
@@ -152,6 +181,19 @@ def run(n_devices: int, steps: int = 8) -> dict:
         "loss_max_abs_diff": loss_diff,
         "loss_1dev": [round(x, 6) for x in losses_1],
         "loss_ndev": [round(x, 6) for x in losses_n],
+    }
+    # fenced phase attribution of the mesh program (ISSUE 15): device
+    # fraction + live MFU from the profiled steady-state steps; compile
+    # seconds as a compile_stats() DELTA around the n-device measure —
+    # the big XLA compile fires in the unprofiled warmup call, so the
+    # per-step carve-out (steady-state recompiles) is ~0 by design
+    rep = prof_n.report(emit_event=False)
+    detail["step_phases_ndev"] = {
+        "device_execute_frac": rep.get("device_execute_frac", 0.0),
+        "compile_frac": rep.get("compile_frac", 0.0),
+        "compile_s": round(
+            compile_after["compile_s"] - compile_before["compile_s"], 3),
+        "mfu_live": rep.get("mfu"),
     }
     return {
         "metric": "train_multichip_tokens_per_sec_per_chip",

@@ -60,22 +60,36 @@ def folded_to_text(profile: Dict[str, object], top: int = 0) -> str:
     return "\n".join(f"{stack} {count}" for stack, count in items)
 
 
-def heap_snapshot(top: int = 30, stop: bool = False) -> Dict[str, object]:
+def heap_snapshot(top: int = 30, stop: bool = False,
+                  duration_s: float = 0.0) -> Dict[str, object]:
     """Top allocation sites by retained size. First call starts
     tracemalloc (only subsequent allocations are tracked — same contract
     as attaching memray to a live process). Pass ``stop=True`` to disarm
     tracing afterwards — tracemalloc taxes every allocation for as long
-    as it runs, so profiled workers need a way back to full speed."""
+    as it runs, so profiled workers need a way back to full speed.
+
+    ``duration_s`` makes a cold call usable in ONE round trip: when
+    tracemalloc is not yet tracing, start it, sample for ``duration_s``,
+    and return the snapshot — without it the first `ray-tpu profile
+    --memory` only armed tracing and returned no data, and the heap
+    path was effectively unreachable from the CLI.
+
+    The result carries both per-line ``stats`` and flamegraph-compatible
+    ``folded`` stacks (size bytes as the fold count; render with
+    folded_to_text, invert with parse_folded)."""
     import tracemalloc
 
     if not tracemalloc.is_tracing():
         if stop:
             return {"started": False, "stats": [], "stopped": True,
+                    "folded": {},
                     "note": "tracemalloc was not running"}
         tracemalloc.start(10)
-        return {"started": True, "stats": [],
-                "note": "tracemalloc started; snapshot again to see "
-                        "allocations made from now on"}
+        if duration_s <= 0:
+            return {"started": True, "stats": [], "folded": {},
+                    "note": "tracemalloc started; snapshot again to see "
+                            "allocations made from now on"}
+        time.sleep(duration_s)
     snap = tracemalloc.take_snapshot()
     stats = snap.statistics("lineno")[:top]
     out = []
@@ -83,8 +97,33 @@ def heap_snapshot(top: int = 30, stop: bool = False) -> Dict[str, object]:
         frame = s.traceback[0]
         out.append({"file": frame.filename, "line": frame.lineno,
                     "size_bytes": s.size, "count": s.count})
+    folded: Dict[str, int] = {}
+    for s in snap.statistics("traceback")[:max(top, 100)]:
+        # tracemalloc stores frames most-recent-LAST; folded stacks read
+        # root-first, which matches — join as-is
+        stack = ";".join(
+            f"{f.filename.rsplit('/', 1)[-1]}:{f.lineno}"
+            for f in s.traceback)
+        folded[stack] = folded.get(stack, 0) + s.size
     current, peak = tracemalloc.get_traced_memory()
     if stop:
         tracemalloc.stop()
     return {"started": False, "stats": out, "stopped": stop,
+            "folded": folded,
             "traced_current_bytes": current, "traced_peak_bytes": peak}
+
+
+def parse_folded(text: str) -> Dict[str, int]:
+    """Invert folded_to_text: `stack count` lines back into the folded
+    dict (blank/comment lines skipped) — the round-trip contract the
+    profiling tests pin for both the CPU and heap profilers."""
+    out: Dict[str, int] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        stack, _, count = line.rpartition(" ")
+        if not stack or not count.isdigit():
+            continue
+        out[stack] = out.get(stack, 0) + int(count)
+    return out

@@ -555,6 +555,7 @@ class CoreWorker:
         s.register("ping", self._handle_ping)
         s.register("profile_cpu", self._handle_profile_cpu)
         s.register("profile_memory", self._handle_profile_memory)
+        s.register("profile_device", self._handle_profile_device)
         s.register("pubsub_message", self._handle_pubsub_message)
         s.register("reconstruct_object", self._handle_reconstruct_object)
 
@@ -3187,7 +3188,21 @@ class CoreWorker:
 
         return await asyncio.to_thread(
             heap_snapshot, int(payload.get("top", 30)),
-            bool(payload.get("stop", False)))
+            bool(payload.get("stop", False)),
+            float(payload.get("duration_s", 0.0)))
+
+    async def _handle_profile_device(self, payload):
+        """Device-plane phase reports (ISSUE 15): every DeviceStepProfiler
+        registered in this worker (train step, decode wave) plus process
+        compile/HBM telemetry — fanned out by the raylet for `ray-tpu
+        profile --device` and merged with task-stage spans driver-side."""
+        from ray_tpu._private import device_profiler
+
+        # to_thread like the cpu/memory handlers: hbm_stats may import
+        # jax (seconds on first touch) — never on the RPC loop
+        return await asyncio.to_thread(
+            device_profiler.snapshot_all,
+            int(payload.get("recent", 64)))
 
     # ---------------------------------------------- generator streaming (owner)
     async def _handle_report_generator_item(self, payload):

@@ -1,0 +1,383 @@
+"""Device-plane performance observability (ISSUE 15): step-phase
+profiler accounting + fencing, compile telemetry, HBM export, and the
+`ray-tpu profile --device` fan-out/chrome-merge — the `pytest -m
+profiling` fast slice."""
+
+import json
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import device_profiler as dp
+from ray_tpu._private.device_profiler import (
+    DeviceStepProfiler,
+    get_profiler,
+    hbm_stats,
+    snapshot_all,
+    steps_to_spans,
+)
+
+pytestmark = pytest.mark.profiling
+
+
+# ------------------------------------------------- phase accounting math
+
+def test_phase_accounting_on_canned_timings():
+    prof = DeviceStepProfiler("canned", enabled=True)
+    prof.record_step({"input_wait": 0.2, "h2d": 0.1,
+                      "device_execute": 0.6, "reply": 0.1}, tokens=10)
+    prof.record_step({"input_wait": 0.0, "device_execute": 1.0}, tokens=20)
+    rep = prof.report(emit_event=False)
+    assert rep["steps"] == 2
+    acc = rep["accounted_s"]
+    assert acc == pytest.approx(2.0, abs=1e-6)
+    assert rep["input_wait_frac"] == pytest.approx(0.2 / 2.0, abs=1e-3)
+    assert rep["device_execute_frac"] == pytest.approx(1.6 / 2.0, abs=1e-3)
+    assert rep["h2d_frac"] == pytest.approx(0.05, abs=1e-3)
+    assert rep["compile_s"] == 0.0
+    # per-step records carry phases + tokens for the chrome export
+    assert [r["tokens"] for r in rep["recent_steps"]] == [10, 20]
+
+
+def test_mfu_math_from_flops_tables():
+    prof = DeviceStepProfiler("mfu", flops_per_step=5e11,
+                              peak_flops_per_chip=1e12, n_devices=2)
+    prof.record_step({"device_execute": 0.5})
+    rep = prof.report(emit_event=False)
+    # 5e11 flops / 0.5s / (1e12 * 2 chips) = 0.5 MFU
+    assert rep["mfu"] == pytest.approx(0.5, rel=1e-6)
+
+
+def test_compile_carveout_from_device_execute():
+    prof = DeviceStepProfiler("carve", enabled=True)
+    with prof.step() as sp:
+        with sp.phase("device_execute"):
+            # a backend compile fires mid-phase (simulated listener hit)
+            dp._on_event_duration(
+                "/jax/core/compile/backend_compile_duration", 0.25)
+            time.sleep(0.01)
+    rep = prof.report(emit_event=False)
+    phases = rep["phase_seconds"]
+    assert phases["compile"] == pytest.approx(0.25, abs=1e-6)
+    # the 0.25s carve exceeds the real ~10ms phase: floored at zero, so
+    # the steady-state phase never wears the compile storm
+    assert phases["device_execute"] >= 0.0
+    assert rep["compile_s"] == pytest.approx(0.25, abs=1e-6)
+
+
+def test_disabled_profiler_is_noop():
+    prof = DeviceStepProfiler("off", enabled=False)
+    with prof.step() as sp:
+        with sp.phase("device_execute") as ph:
+            ph.fence(object())
+    assert prof.report(emit_event=False)["steps"] == 0
+
+
+def test_external_phase_attribution():
+    prof = DeviceStepProfiler("ext", enabled=True)
+    with prof.step() as sp:
+        sp.external("input_wait", 0.4)
+        with sp.phase("device_execute"):
+            pass
+    rep = prof.report(emit_event=False)
+    assert rep["phase_seconds"]["input_wait"] == pytest.approx(0.4)
+
+
+# ------------------------------------------------- fencing correctness
+
+def test_profiled_step_outputs_match_unprofiled():
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda x: jnp.sin(x) @ x + 1.0)
+    x0 = jnp.ones((64, 64))
+
+    x = x0
+    for _ in range(5):
+        x = f(x)
+    unprofiled = jax.device_get(x)
+
+    prof = DeviceStepProfiler("parity", enabled=True)
+    x = x0
+    for _ in range(5):
+        with prof.step() as sp:
+            with sp.phase("device_execute") as ph:
+                x = f(x)
+                ph.fence(x)
+    profiled = jax.device_get(x)
+    import numpy as np
+
+    assert np.array_equal(unprofiled, profiled)
+    rep = prof.report(emit_event=False)
+    assert rep["steps"] == 5
+    assert rep["phase_seconds"]["device_execute"] > 0
+
+
+def test_profiler_overhead_within_two_percent():
+    """The acceptance bound: profiled-on vs profiled-off step wall time
+    within 2% on this host. min-of-interleaved-trials is the estimator —
+    the minimum is robust to CI-host load spikes; both arms run the
+    identical fenced loop, isolating the profiler's own cost."""
+    import jax
+    import jax.numpy as jnp
+
+    # a train-step-sized program (~10ms): the 2% bound is a statement
+    # about real steps, not µs-scale dispatches where the profiler's
+    # fixed ~100µs/step cost would dominate any workload
+    f = jax.jit(lambda x: jnp.tanh(x @ x))
+    x0 = jnp.ones((768, 768))
+    jax.block_until_ready(f(x0))  # compile outside both arms
+    steps = 12
+
+    def plain():
+        x = x0
+        out = []
+        for _ in range(steps):
+            t0 = time.perf_counter()
+            x = f(x)
+            jax.block_until_ready(x)
+            out.append(time.perf_counter() - t0)
+        return out
+
+    prof = DeviceStepProfiler("overhead", enabled=True)
+
+    def profiled():
+        x = x0
+        out = []
+        for _ in range(steps):
+            t0 = time.perf_counter()
+            with prof.step() as sp:
+                with sp.phase("device_execute") as ph:
+                    x = f(x)
+                    ph.fence(x)
+            out.append(time.perf_counter() - t0)
+        return out
+
+    # per-STEP minima: on a loaded CI share, min over 60 individual step
+    # samples finds a quiet window per arm where min-of-loop-totals
+    # cannot (one co-scheduled suite poisons a whole loop). Bounded
+    # retries absorb pathological load; the bound itself stays 2%.
+    overhead = None
+    for _attempt in range(3):
+        base, prof_t = [], []
+        for _ in range(5):  # interleaved: load hits both arms alike
+            base.extend(plain())
+            prof_t.extend(profiled())
+        overhead = min(prof_t) / min(base)
+        if overhead <= 1.02:
+            break
+    assert overhead <= 1.02, (
+        f"profiler overhead {overhead:.4f}x exceeds the 2% bound "
+        f"(plain min-step {min(base):.5f}s vs profiled "
+        f"{min(prof_t):.5f}s)")
+
+
+# ------------------------------------------------- HBM + compile telemetry
+
+def test_memory_stats_export_on_cpu_devices():
+    """CPU PJRT devices return None from memory_stats(): the exporter
+    reports the device with an EMPTY entry (telemetry absent, device
+    present) instead of dropping or crashing."""
+    import jax
+
+    stats = hbm_stats()
+    assert stats, "no devices reported"
+    for label, entry in stats.items():
+        assert label.startswith(jax.devices()[0].platform)
+        assert entry == {}  # no HBM telemetry on CPU — and no crash
+
+
+def test_memory_stats_export_with_real_stats():
+    class FakeDev:
+        platform = "tpu"
+        id = 3
+
+        def memory_stats(self):
+            return {"bytes_in_use": 1024, "peak_bytes_in_use": 4096,
+                    "bytes_limit": 16 << 30}
+
+    class DeadDev:
+        platform = "tpu"
+        id = 4
+
+        def memory_stats(self):
+            raise RuntimeError("backend gone")
+
+    out = hbm_stats(devices=[FakeDev(), DeadDev()])
+    assert out["tpu:3"] == {"bytes_in_use": 1024,
+                            "peak_bytes_in_use": 4096,
+                            "bytes_limit": 16 << 30}
+    assert out["tpu:4"] == {}
+    from ray_tpu.util.metrics import get_metric
+
+    g = get_metric("ray_tpu_hbm_bytes_in_use")
+    samples = {tuple(sorted(t.items())): v for _, t, v in g._samples()}
+    assert samples[(("device", "tpu:3"),)] == 1024.0
+    g = get_metric("ray_tpu_hbm_bytes_peak")
+    samples = {tuple(sorted(t.items())): v for _, t, v in g._samples()}
+    assert samples[(("device", "tpu:3"),)] == 4096.0
+
+
+def test_compile_events_on_forced_cache_miss():
+    """A fresh jit program (guaranteed XLA cache miss) must emit a
+    compile.start/compile.end pair into the event log and attribute its
+    seconds to the step that compiled."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu._private import event_log
+
+    prof = DeviceStepProfiler("miss", enabled=True)
+    marker = float(time.time() % 997)  # unique constant -> fresh program
+
+    @jax.jit
+    def fresh(x):
+        return x * marker + jnp.float32(1.5)
+
+    before = [e for e in list(event_log._ring)
+              if e["type"].startswith("compile.")]
+    with prof.step() as sp:
+        with sp.phase("device_execute") as ph:
+            y = fresh(jnp.ones((8, 8)))
+            ph.fence(y)
+    after = [e for e in list(event_log._ring)
+             if e["type"].startswith("compile.")]
+    new = after[len(before):]
+    ends = [e for e in new if e["type"] == "compile.end"]
+    starts = [e for e in new if e["type"] == "compile.start"]
+    assert ends and starts, "forced cache miss emitted no compile events"
+    assert all(e["data"]["duration_s"] > 0 for e in ends)
+    assert all(e["data"]["t_start"] <= e["time"] for e in starts)
+    rep = prof.report(emit_event=False)
+    assert rep["compile_s"] > 0
+
+
+# ------------------------------------------------- engine + span rendering
+
+def test_engine_decode_wave_phases():
+    import jax
+
+    from ray_tpu.inference.engine import GenerationConfig
+    from ray_tpu.inference.paged_engine import PagedInferenceEngine
+    from ray_tpu.models import llama
+
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    eng = PagedInferenceEngine(params, cfg, max_batch=2, max_len=128)
+    eng.profiler.reset()
+    out = eng.generate([[1, 2, 3], [4, 5, 6]],
+                       GenerationConfig(max_new_tokens=8))
+    assert [len(o) for o in out] == [8, 8]
+    phases = eng.stats()["device_phases"]
+    assert phases["steps"] >= 1
+    assert phases["device_execute_frac"] + phases["compile_frac"] > 0
+    assert phases["reply_frac"] >= 0
+    rep = eng.profiler.report(emit_event=False)
+    # decode waves account 7 of each request's 8 tokens — the first token
+    # is sampled by the admission prefill (the "prefill" phase), not a wave
+    assert sum(r.get("tokens") or 0 for r in rep["recent_steps"]) == 14
+
+
+def test_steps_to_spans_chrome_merge():
+    from ray_tpu._private.tracing import trace_chrome
+
+    prof = DeviceStepProfiler("spans", enabled=True)
+    prof.record_step({"input_wait": 0.1, "device_execute": 0.5,
+                      "reply": 0.05}, tokens=7)
+    rep = prof.report(emit_event=False)
+    spans = steps_to_spans(rep, "worker:123")
+    names = {s["name"] for s in spans}
+    assert "spans.step" in names
+    assert "spans:device_execute" in names
+    trace = trace_chrome(spans)
+    lanes = {e["pid"] for e in trace if e.get("ph") == "X"}
+    assert lanes == {"worker:123"}
+    # phases nest back-to-back inside the step slice
+    step_ev = next(e for e in trace if e["name"] == "spans.step")
+    phase_ev = [e for e in trace if ":" in e["name"]]
+    assert all(e["ts"] >= step_ev["ts"] for e in phase_ev)
+
+
+# ------------------------------------------------- cluster e2e + CLI
+
+def _wait_for(fn, timeout=30.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(0.25)
+    return False
+
+
+def test_profile_device_fanout_and_cli_chrome(ray_start_regular, capsys,
+                                              tmp_path):
+    """The acceptance path: a live worker runs profiled device steps, a
+    task produces PR 1 stage spans, and `ray-tpu profile --device
+    --chrome` merges both into one chrome trace."""
+
+    @ray_tpu.remote
+    class Dev:
+        def run_steps(self):
+            from ray_tpu._private.device_profiler import get_profiler
+
+            p = get_profiler("train")
+            for _ in range(3):
+                p.record_step({"input_wait": 0.01, "h2d": 0.002,
+                               "device_execute": 0.03, "reply": 0.001},
+                              tokens=16)
+            return os.getpid()
+
+    w = Dev.remote()
+    pid = ray_tpu.get(w.run_steps.remote(), timeout=60)
+
+    # raylet fan-out: no pid -> every worker on the node answers
+    from ray_tpu._raylet import get_core_worker
+
+    cw = get_core_worker()
+    found = None
+    for n in cw._gcs.call("get_all_node_info", {}):
+        if not n.alive:
+            continue
+        r = cw._peers.get(n.raylet_address).call(
+            "profile_worker", {"kind": "device"}, timeout=60)
+        workers = r.get("workers") or {}
+        if pid in workers and "train" in (
+                workers[pid].get("profilers") or {}):
+            found = workers[pid]["profilers"]["train"]
+            break
+    assert found is not None, "device fan-out never reached the worker"
+    assert found["steps"] == 3
+    assert found["input_wait_frac"] > 0
+
+    # stage spans need a finished task in the GCS event stream
+    from ray_tpu.util.state.api import list_tasks
+
+    assert _wait_for(lambda: any(
+        e.get("stages") for e in list_tasks(limit=100_000,
+                                            raw_events=True)))
+
+    from ray_tpu.scripts.scripts import main as cli_main
+
+    chrome_path = str(tmp_path / "device_trace.json")
+    assert cli_main(["profile", "--device", "--chrome", chrome_path]) == 0
+    out = capsys.readouterr().out
+    assert "train" in out and "input_wait" in out
+    with open(chrome_path) as f:
+        trace = json.load(f)
+    lanes = {e["pid"] for e in trace if e.get("ph") == "X"}
+    # ONE trace, two worlds: device-phase lanes AND task-stage lanes
+    assert any(str(p).startswith("worker:") for p in lanes), lanes
+    assert "tasks" in lanes, lanes
+    names = {e["name"] for e in trace}
+    assert "train:device_execute" in names
+    assert any(":execute" in n for n in names)  # PR 1 stage span
+
+
+def test_snapshot_all_includes_registry_and_compile():
+    get_profiler("snap-reg").record_step({"device_execute": 0.01})
+    snap = snapshot_all()
+    assert "snap-reg" in snap["profilers"]
+    assert "compile_s" in snap["compile"]
+    assert isinstance(snap["hbm"], dict)

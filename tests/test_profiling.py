@@ -4,10 +4,13 @@ natively on sys._current_frames and tracemalloc)."""
 
 import time
 
+import pytest
+
 import ray_tpu
 from ray_tpu.util.profiling import (
     folded_to_text,
     heap_snapshot,
+    parse_folded,
     sample_cpu_profile,
 )
 
@@ -47,6 +50,57 @@ def test_heap_snapshot_reports_allocations():
     assert snap["traced_current_bytes"] > 1_000_000
     assert snap["stats"] and snap["stats"][0]["size_bytes"] > 0
     del blob
+
+
+@pytest.mark.profiling
+def test_heap_snapshot_folded_roundtrip():
+    """ISSUE 15 satellite: the heap profiler's folded output (size bytes
+    as fold counts) survives the text round trip — render with
+    folded_to_text, invert with parse_folded, byte-identical."""
+    heap_snapshot()  # arm tracemalloc (no-op if already tracing)
+    blob = [bytearray(2048) for _ in range(1000)]  # ~2MB retained
+    snap = heap_snapshot(top=50)
+    assert snap["folded"], "traceback statistics produced no stacks"
+    assert all(isinstance(v, int) and v > 0
+               for v in snap["folded"].values())
+    text = folded_to_text(snap)
+    assert parse_folded(text) == snap["folded"]
+    # stacks are ;-joined file:line frames, biggest first
+    first = text.splitlines()[0]
+    assert ":" in first.rsplit(" ", 1)[0]
+    del blob
+
+
+@pytest.mark.profiling
+def test_heap_snapshot_cold_start_with_duration_samples_in_one_call():
+    """The unreachable-path fix: a COLD heap profile used to return only
+    'tracemalloc started' — duration_s makes one `ray-tpu profile
+    --memory` round trip arm, sample, and report."""
+    import tracemalloc
+
+    was_tracing = tracemalloc.is_tracing()
+    if was_tracing:
+        tracemalloc.stop()
+    try:
+        leak = []
+
+        import threading
+
+        def alloc():
+            time.sleep(0.05)
+            leak.extend(bytearray(4096) for _ in range(500))
+
+        t = threading.Thread(target=alloc)
+        t.start()
+        snap = heap_snapshot(top=20, duration_s=0.4)
+        t.join()
+        assert snap["started"] is False
+        assert snap["stats"], "one-call duration sample saw no allocations"
+        assert snap["traced_current_bytes"] > 0
+    finally:
+        if tracemalloc.is_tracing():
+            tracemalloc.stop()
+        del leak
 
 
 def test_profile_worker_rpc_end_to_end(ray_start_regular):
@@ -90,8 +144,12 @@ def test_profile_worker_rpc_end_to_end(ray_start_regular):
     assert "spin" in folded_to_text(reply)
     assert ray_tpu.get(spin_ref, timeout=60) == "done"
 
-    # heap path through the same fan-out
-    for _ in range(2):  # first call starts tracing, second snapshots
-        mem = cw._peers.get(n.raylet_address).call(
-            "profile_worker", {"pid": pid, "kind": "memory"}, timeout=60)
-    assert "stats" in mem
+    # heap path through the same fan-out — ONE round trip on a cold
+    # worker (duration_s arms tracemalloc and samples), folded output
+    # round-trips (the `ray-tpu profile --memory --folded` contract)
+    mem = cw._peers.get(n.raylet_address).call(
+        "profile_worker",
+        {"pid": pid, "kind": "memory", "duration_s": 0.5}, timeout=60)
+    assert "stats" in mem and mem["started"] is False
+    if mem["folded"]:  # a quiet worker may allocate nothing in 0.5s
+        assert parse_folded(folded_to_text(mem)) == mem["folded"]

@@ -603,6 +603,124 @@ def test_payload_copy_suppressible_with_justification(tmp_path):
     assert _lint(tmp_path, ["ray_tpu"], select=["payload-copy"]) == []
 
 
+# ---------------------------------------------------------------- RTL009
+
+
+def test_unfenced_timing_positive(tmp_path):
+    # perf_counter delta spans a device call, no fence anywhere in the
+    # window: the classic async-dispatch timing lie
+    _write(tmp_path, "ray_tpu/train/loop.py", """
+        import time
+
+        def measure(step, state, batch):
+            t0 = time.perf_counter()
+            state, metrics = step(state, batch)
+            dt = time.perf_counter() - t0
+            return state, dt
+    """)
+    diags = _lint(tmp_path, ["ray_tpu"],
+                  select=["unfenced-device-timing"])
+    assert _ids(diags) == ["RTL009"]
+    assert "step(...)" in diags[0].message
+    assert "fence" in diags[0].message
+
+
+def test_unfenced_timing_jit_bound_name_positive(tmp_path):
+    # the device call is a module-local name bound from jax.jit — not in
+    # the configured device-call list, found via the jit-binding scan
+    _write(tmp_path, "ray_tpu/inference/fast.py", """
+        import time
+        import jax
+
+        fused = jax.jit(lambda x: x * 2)
+
+        def bench(x):
+            t0 = time.perf_counter()
+            y = fused(x)
+            return time.perf_counter() - t0
+    """)
+    diags = _lint(tmp_path, ["ray_tpu"],
+                  select=["unfenced-device-timing"])
+    assert _ids(diags) == ["RTL009"]
+    assert "fused(...)" in diags[0].message
+
+
+def test_unfenced_timing_augassign_delta_single_diagnostic(tmp_path):
+    # `acc["t"] += pc() - t0` closes a window via the inner BinOp that
+    # ast.walk visits ONCE — exactly one diagnostic, not a duplicate
+    _write(tmp_path, "ray_tpu/train/accum.py", """
+        import time
+
+        def f(step, s, b, acc):
+            t0 = time.perf_counter()
+            s, m = step(s, b)
+            acc["t"] += time.perf_counter() - t0
+            return s
+    """)
+    diags = _lint(tmp_path, ["ray_tpu"],
+                  select=["unfenced-device-timing"])
+    assert len(diags) == 1 and diags[0].check_id == "RTL009"
+
+
+def test_unfenced_timing_fenced_clean(tmp_path):
+    # block_until_ready / float(...) host transfers inside the window
+    # fence the timing — no diagnostic
+    _write(tmp_path, "ray_tpu/train/loop.py", """
+        import time
+        import jax
+
+        def measure(step, state, batch):
+            t0 = time.perf_counter()
+            state, metrics = step(state, batch)
+            jax.block_until_ready(metrics)
+            dt = time.perf_counter() - t0
+            t1 = time.perf_counter()
+            state, metrics = step(state, batch)
+            loss = float(metrics["loss"])
+            dt2 = time.perf_counter() - t1
+            return dt, dt2
+    """)
+    assert _lint(tmp_path, ["ray_tpu"],
+                 select=["unfenced-device-timing"]) == []
+
+
+def test_unfenced_timing_out_of_scope_and_no_device_call_clean(tmp_path):
+    # serve/ is out of scope; a host-only timing in scope is fine
+    _write(tmp_path, "ray_tpu/serve/timing.py", """
+        import time
+
+        def roundtrip(step, s, b):
+            t0 = time.perf_counter()
+            step(s, b)
+            return time.perf_counter() - t0
+    """)
+    _write(tmp_path, "ray_tpu/data/host.py", """
+        import time
+
+        def shuffle_ms(rows):
+            t0 = time.perf_counter()
+            rows.sort()
+            return time.perf_counter() - t0
+    """)
+    assert _lint(tmp_path, ["ray_tpu"],
+                 select=["unfenced-device-timing"]) == []
+
+
+def test_unfenced_timing_suppressible_with_justification(tmp_path):
+    _write(tmp_path, "ray_tpu/inference/bench.py", """
+        import time
+
+        def dispatch_only(generate, prompts):
+            t0 = time.perf_counter()
+            generate(prompts)
+            # deliberately dispatch-only: the consumer device_gets
+            # raylint: disable=unfenced-device-timing
+            return time.perf_counter() - t0
+    """)
+    assert _lint(tmp_path, ["ray_tpu"],
+                 select=["unfenced-device-timing"]) == []
+
+
 # ----------------------------------------------------------- suppressions
 
 

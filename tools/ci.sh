@@ -25,7 +25,13 @@
 #                        2-node in-process cluster: value integrity, a
 #                        conservative bandwidth floor, and ZERO
 #                        whole-payload copies (serialization.COPY_STATS)
-#   8. tier-1 tests    — the full `not slow` suite
+#   8. perf gate       — tools/perf_gate.py --smoke: the newest bench
+#                        trajectory row vs its history, per-metric
+#                        noise-banded thresholds (loose smoke bands on
+#                        this shared CI host; run WITHOUT --smoke on a
+#                        quiet dedicated host for the strict bands that
+#                        catch r05-class drifts)
+#   9. tier-1 tests    — the full `not slow` suite
 #
 # Usage: tools/ci.sh [--skip-tests]
 set -euo pipefail
@@ -59,6 +65,9 @@ JAX_PLATFORMS=cpu python -m tools.tracing_smoke --budget 120
 
 echo "== dataplane smoke (bounded) =="
 JAX_PLATFORMS=cpu python -m tools.dataplane_smoke --budget 120
+
+echo "== perf-regression gate (smoke bands) =="
+python -m tools.perf_gate --smoke
 
 if [[ "${1:-}" != "--skip-tests" ]]; then
     echo "== tier-1 tests =="

@@ -9,4 +9,5 @@ from tools.raylint.checks import (  # noqa: F401
     spec_serialization,
     swallowed_error,
     unbounded_queue,
+    unfenced_timing,
 )

@@ -1,0 +1,205 @@
+"""RTL009 unfenced-device-timing.
+
+Invariant (ISSUE 15, CONTRIBUTING "fence before you time"): jax dispatch
+is asynchronous — a jitted call returns the moment the computation is
+ENQUEUED. A `time.time()` / `perf_counter()` delta taken around a
+device call without a fence (`block_until_ready`, `device_get`, a host
+transfer like `float(...)` / `np.asarray(...)`) measures dispatch
+latency (~µs) and silently attributes the real device seconds to
+whatever host code blocks next. Every phase in
+`_private/device_profiler.py` fences for exactly this reason; timing
+code in the device-plane paths (train/, inference/, data/) must do the
+same or say why not.
+
+Detection, per function:
+* a timestamp assignment `t = time.time()` / `time.perf_counter()` /
+  `time.monotonic()` opens a timing window,
+* a subtraction involving that timestamp variable (or a fresh
+  `perf_counter() - t`) closes it,
+* a DEVICE call inside the window — a configured device-call name
+  (step/prefill/decode/generate/... — see raylint.toml), a name bound
+  from `jax.jit`/`pjit`, or a function decorated with them — with NO
+  fence call in the window is an error.
+
+Fences: `block_until_ready`, `device_get`, `np.asarray`, `float(...)`,
+`.item()`, `.tolist()` (each forces a host transfer of the fenced
+value). A timing that is deliberately dispatch-only carries
+`# raylint: disable=unfenced-device-timing` naming the fence that lives
+elsewhere (e.g. the consumer's device_get).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set, Tuple
+
+from tools.raylint.core import (
+    Check,
+    Diagnostic,
+    Project,
+    dotted_name,
+    register_check,
+)
+
+DEFAULT_SCOPE_PATHS = [
+    "ray_tpu/train/",
+    "ray_tpu/inference/",
+    "ray_tpu/data/",
+]
+
+# call leaf names that dispatch compiled device work in these paths
+DEFAULT_DEVICE_CALLS = [
+    "step", "train_step", "prefill", "prefill_batch", "decode", "_decode",
+    "generate", "generate_wave", "generate_stream", "device_put",
+]
+
+# call leaf names that fence (force completion / host transfer)
+DEFAULT_FENCE_CALLS = [
+    "block_until_ready", "device_get", "asarray", "float", "item",
+    "tolist",
+]
+
+_CLOCKS = {"time", "perf_counter", "monotonic"}
+_JIT_BUILDERS = {"jit", "pjit"}
+
+
+def _is_clock_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    target = dotted_name(node.func)
+    return target is not None and target.rsplit(".", 1)[-1] in _CLOCKS
+
+
+def _jit_bound_names(mod) -> Set[str]:
+    """Names in this module bound to compiled programs: `x = jax.jit(f)`
+    assignments plus functions decorated with @jit / @partial(jit, ...)."""
+    names: Set[str] = set()
+    for node in mod.nodes():
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            target = dotted_name(node.value.func)
+            if target and target.rsplit(".", 1)[-1] in _JIT_BUILDERS:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        names.add(t.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                leaf = None
+                if isinstance(dec, ast.Call):
+                    target = dotted_name(dec.func)
+                    leaf = target.rsplit(".", 1)[-1] if target else None
+                    if leaf == "partial" and dec.args:
+                        inner = dotted_name(dec.args[0])
+                        leaf = inner.rsplit(".", 1)[-1] if inner else None
+                else:
+                    target = dotted_name(dec)
+                    leaf = target.rsplit(".", 1)[-1] if target else None
+                if leaf in _JIT_BUILDERS:
+                    names.add(node.name)
+                    break
+    return names
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+class _Window:
+    __slots__ = ("var", "start", "end", "end_node")
+
+    def __init__(self, var: str, start: int, end: int, end_node: ast.AST):
+        self.var = var
+        self.start = start
+        self.end = end
+        self.end_node = end_node
+
+
+def _timing_windows(fn: ast.AST) -> List[_Window]:
+    """(timestamp var, assign line) .. (subtraction line) spans."""
+    stamps = {}  # var -> assign line (latest wins: re-stamped loops)
+    windows: List[_Window] = []
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Assign) and _is_clock_call(node.value)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            stamps[node.targets[0].id] = node.lineno
+        # (AugAssign deltas like `acc["t"] += pc() - t0` need no special
+        # case: ast.walk visits the inner BinOp directly)
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub):
+            # any subtraction touching a stamped timestamp var closes a
+            # window (covers both `t1 - t0` and `perf_counter() - t0`)
+            involved = _names_in(node) & set(stamps)
+            if involved:
+                var = min(involved, key=lambda v: stamps[v])
+                if node.lineno > stamps[var]:
+                    windows.append(_Window(var, stamps[var], node.lineno,
+                                           node))
+    return windows
+
+
+@register_check
+class UnfencedDeviceTimingCheck(Check):
+    name = "unfenced-device-timing"
+    check_id = "RTL009"
+    description = ("wall-clock delta around a jit-compiled call without a "
+                   "fence in a train/inference/data path — async dispatch "
+                   "makes unfenced timings lie")
+
+    def __init__(self, options: dict):
+        super().__init__(options)
+        self.scope_paths = tuple(options.get(
+            "scope-paths", DEFAULT_SCOPE_PATHS))
+        self.device_calls = set(options.get(
+            "device-calls", DEFAULT_DEVICE_CALLS))
+        self.fence_calls = set(options.get(
+            "fence-calls", DEFAULT_FENCE_CALLS))
+
+    def run(self, project: Project) -> Iterable[Diagnostic]:
+        for mod in project.target_modules():
+            if not any(mod.relpath.startswith(p) for p in self.scope_paths):
+                continue
+            jit_names = _jit_bound_names(mod)
+            for _cls, fn in mod.functions():
+                yield from self._check_function(mod, fn, jit_names)
+
+    def _call_leaf(self, node: ast.Call) -> Optional[str]:
+        target = dotted_name(node.func)
+        if target is not None:
+            return target.rsplit(".", 1)[-1]
+        if isinstance(node.func, ast.Attribute):
+            return node.func.attr
+        return None
+
+    def _check_function(self, mod, fn, jit_names: Set[str]
+                        ) -> Iterable[Diagnostic]:
+        windows = _timing_windows(fn)
+        if not windows:
+            return
+        device_lines: List[Tuple[int, str]] = []
+        fence_lines: List[int] = []
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            leaf = self._call_leaf(node)
+            if leaf is None:
+                continue
+            if leaf in self.fence_calls:
+                fence_lines.append(node.lineno)
+            elif leaf in self.device_calls or leaf in jit_names:
+                device_lines.append((node.lineno, leaf))
+        for w in windows:
+            hit = next((name for line, name in device_lines
+                        if w.start <= line <= w.end), None)
+            if hit is None:
+                continue
+            if any(w.start <= line <= w.end for line in fence_lines):
+                continue
+            yield Diagnostic(
+                self.check_id, self.name, mod.relpath, w.end,
+                getattr(w.end_node, "col_offset", 0),
+                f"timing delta over `{w.var}` spans a device call "
+                f"`{hit}(...)` with no fence — async dispatch returns "
+                "before the device finishes, so this measures dispatch, "
+                "not compute; fence (block_until_ready / device_get / "
+                "float(...)) before reading the clock, or suppress with "
+                "`# raylint: disable=unfenced-device-timing` naming "
+                "where the fence lives")
