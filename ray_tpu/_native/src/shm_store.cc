@@ -72,6 +72,7 @@ enum Op : uint8_t {
   OP_STATS = 7,
   OP_LIST = 8,   // a: max ids, b: 1 = spillable primaries, 0 = evictable caches
   OP_ABORT = 9,  // abort an unsealed create
+  OP_FREE_INFO = 10,  // free-list shape: status=holes, offset=largest, size=total
 };
 
 enum Status : int64_t {
@@ -233,6 +234,17 @@ class Arena {
 
   uint64_t used() const { return used_; }
   uint64_t capacity() const { return capacity_; }
+
+  // Free-list shape for fragmentation accounting: a put needs ONE
+  // contiguous hole, so `largest` (not the total) bounds the biggest
+  // allocatable object.
+  void FreeInfo(uint64_t* holes, uint64_t* largest, uint64_t* total) const {
+    for (const auto& kv : free_) {
+      ++*holes;
+      *total += kv.second;
+      if (kv.second > *largest) *largest = kv.second;
+    }
+  }
 
  private:
   uint64_t capacity_;
@@ -489,6 +501,15 @@ class StoreServer {
         case OP_LIST:
           rsp = List(req.a, req.b != 0, &extra);
           break;
+        case OP_FREE_INFO: {
+          std::lock_guard<std::mutex> g(mu_);
+          uint64_t holes = 0, largest = 0, total = 0;
+          arena_.FreeInfo(&holes, &largest, &total);
+          rsp.status = static_cast<int64_t>(holes);
+          rsp.offset = largest;
+          rsp.size = total;
+          break;
+        }
         default:
           rsp.status = ST_ERR;
       }
@@ -964,6 +985,11 @@ int64_t rtps_list(void* cli, uint64_t max_ids, uint64_t primaries,
   return static_cast<StoreClient*>(cli)->Call(OP_LIST, nullptr, max_ids,
                                               primaries, nullptr, nullptr,
                                               ids_out, max_ids * 16);
+}
+
+int64_t rtps_free_info(void* cli, uint64_t* largest, uint64_t* total) {
+  return static_cast<StoreClient*>(cli)->Call(OP_FREE_INFO, nullptr, 0, 0,
+                                              largest, total, nullptr, 0);
 }
 
 // ---- channels (client-side atomics on the mapped arena; see ChanHeader)

@@ -90,6 +90,9 @@ EVENT_SCHEMAS: Dict[str, Tuple[str, ...]] = {
     "object.spill": ("uri",),
     "object.restore": ("uri",),
     "object.reconstruct": ("function",),
+    # memory observability: leak-sweep verdicts and arena pressure
+    "object.leak_suspect": ("kind", "size_bytes", "age_s", "owner", "holder"),
+    "memory.pressure": ("used_bytes", "capacity_bytes", "frac"),
     # node membership + drain
     "node.alive": ("address",),
     "node.dead": ("expected",),

@@ -1,0 +1,81 @@
+"""Process-wide paged-KV block-pool registry (memory observability).
+
+The paged inference engine's block pool is the other ref-counted memory
+plane next to the object store: blocks move between free / cached-LRU /
+active(refcount>0), and a pin leak there exhausts decode capacity the
+same way a leaked ObjectRef exhausts the arena. Engines register here on
+construction (weakly — a dropped engine disappears from reports), and the
+worker `memory_report` RPC snapshots every live engine through
+``report_all`` without importing jax: this module must stay import-light
+because every worker answers the RPC, engine or not.
+
+This registry is also the groundwork for the ROADMAP's cluster-wide
+prefix-cache index: the per-engine block/prefix accounting exported here
+is exactly what a global index would aggregate.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Any, Dict, List
+
+_lock = threading.Lock()
+_engines: "weakref.WeakValueDictionary[int, Any]" = weakref.WeakValueDictionary()
+_next_id = 0
+
+_metrics_lock = threading.Lock()
+_kv_gauge = None
+
+
+def register(engine: Any) -> None:
+    """Called by PagedInferenceEngine.__init__ (any object exposing
+    ``kv_block_report()`` works — tests register stubs)."""
+    global _next_id
+    with _lock:
+        _next_id += 1
+        _engines[_next_id] = engine
+
+
+def _blocks_gauge():
+    """Lazy gauge creation (same discipline as device_profiler._metrics:
+    importing this module must never register metrics in processes that
+    run no engine)."""
+    global _kv_gauge
+    with _metrics_lock:
+        if _kv_gauge is None:
+            from ray_tpu.util.metrics import Gauge
+
+            _kv_gauge = Gauge(
+                "ray_tpu_kv_blocks",
+                "Paged-KV block pool occupancy by state "
+                "(free / cached / active), summed over this process's "
+                "engines",
+                tag_keys=("state",))
+        return _kv_gauge
+
+
+def report_all() -> List[Dict[str, Any]]:
+    """Every live engine's KV block-pool report; also refreshes this
+    process's ray_tpu_kv_blocks{state} gauges. Failures never break the
+    caller — the memory report degrades, it doesn't die."""
+    with _lock:
+        engines = list(_engines.values())
+    reports: List[Dict[str, Any]] = []
+    totals = {"free": 0, "cached": 0, "active": 0}
+    for eng in engines:
+        try:
+            rep = eng.kv_block_report()
+        except Exception:  # noqa: BLE001 — engine mid-teardown
+            continue
+        reports.append(rep)
+        for state in totals:
+            totals[state] += int(rep.get(f"{state}_blocks", 0))
+    if reports:
+        try:
+            g = _blocks_gauge()
+            for state, n in totals.items():
+                g.set(float(n), tags={"state": state})
+        except Exception:  # noqa: BLE001 — metrics must never break reports
+            pass
+    return reports

@@ -78,6 +78,8 @@ def _load():
     lib.rtps_list.restype = ctypes.c_int64
     lib.rtps_list.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
                               ctypes.c_uint64, ctypes.c_char_p]
+    lib.rtps_free_info.restype = ctypes.c_int64
+    lib.rtps_free_info.argtypes = [ctypes.c_void_p, u64p, u64p]
     # SPSC channels (client-side atomics; see shm_store.cc ChanHeader)
     lib.rtps_chan_region_size.restype = ctypes.c_uint64
     lib.rtps_chan_region_size.argtypes = [ctypes.c_uint64, ctypes.c_uint64]
@@ -389,6 +391,15 @@ class StoreClient:
         return self._lib.rtps_contains(
             self._handle, _pad_id(object_id), ctypes.byref(size)) == ST_OK
 
+    def size_of(self, object_id: bytes) -> Optional[int]:
+        """Sealed object's byte size, or None when absent (the CONTAINS
+        reply already carries it — no pin, unlike get)."""
+        size = ctypes.c_uint64()
+        if self._lib.rtps_contains(
+                self._handle, _pad_id(object_id), ctypes.byref(size)) != ST_OK:
+            return None
+        return int(size.value)
+
     def stats(self) -> Tuple[int, int, int]:
         """-> (num_objects, bytes_used, bytes_capacity)."""
         used = ctypes.c_uint64()
@@ -408,3 +419,16 @@ class StoreClient:
         if n < 0:
             raise ShmStoreError(f"list failed: {n}")
         return [buf.raw[i * 16:(i + 1) * 16] for i in range(n)]
+
+    def free_info(self) -> Tuple[int, int, int]:
+        """Arena free-list shape -> (num_holes, largest_hole_bytes,
+        total_free_bytes). Fragmentation = 1 - largest/total: a put needs
+        ONE contiguous hole, so a full-looking arena with many small holes
+        rejects large creates while stats() still shows headroom."""
+        largest = ctypes.c_uint64()
+        total = ctypes.c_uint64()
+        n = self._lib.rtps_free_info(self._handle, ctypes.byref(largest),
+                                     ctypes.byref(total))
+        if n < 0:
+            raise ShmStoreError(f"free_info failed: {n}")
+        return int(n), int(largest.value), int(total.value)

@@ -411,7 +411,7 @@ def reply_to_wire(r: dict) -> tuple:
         returns = [
             (oid.binary(), *(_ser_w(p["inline"]) if "inline" in p
                              else (None, None)),
-             p.get("location"), p.get("plasma_node"))
+             p.get("location"), p.get("plasma_node"), p.get("size"))
             for oid, p in r.get("returns", [])
         ]
         return ("ok", returns, r.get("exec_s"),
@@ -431,11 +431,15 @@ def reply_from_wire(t: tuple) -> dict:
         return {"not_run": True}
     if kind == "ok":
         returns = []
-        for oid_b, inband, bufs, location, plasma_node in t[1]:
+        for oid_b, inband, bufs, location, plasma_node, *rest in t[1]:
             if inband is not None:
                 payload = {"inline": _ser_r((inband, bufs))}
             else:
                 payload = {"location": location, "plasma_node": plasma_node}
+                # 6-tuple since the memory-observability PR; tolerate
+                # 5-tuples from an in-flight old sender during upgrade
+                if rest and rest[0]:
+                    payload["size"] = rest[0]
             returns.append((ObjectID(oid_b), payload))
         out = {"status": "ok", "returns": returns}
         if t[2] is not None:

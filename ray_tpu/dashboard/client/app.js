@@ -471,8 +471,12 @@ async function pageMetrics() {
     svgChart("Stage latency p99", pick(/^stage_.*_p99$/), ms),
     svgChart("End-to-end task latency",
              pick(/^task_total_.*_p(50|90|99)$/), ms),
-    svgChart("Object store used",
-             pick(/^store_(used|capacity)_bytes$/), mib),
+    svgChart("Object store used (arena / capacity / spilled)",
+             pick(/^store_(used|capacity|spilled)_bytes$/), mib),
+    svgChart("Object refs (owned / borrowed / pinned, cluster-wide)",
+             pick(/^object_refs_/), num),
+    svgChart("KV blocks (free / cached / active)",
+             pick(/^kv_blocks_/), num),
     svgChart("Worker leases (active / queued)",
              pick(/^leases_/), num),
     svgChart("Node CPU %", pick(/^node_cpu_percent_/), pct),

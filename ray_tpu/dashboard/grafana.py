@@ -57,11 +57,25 @@ def generate_grafana_dashboard(
             {"expr": 'ray_tpu_cluster_resource_available{resource="memory"}',
              "legend": "available"},
         ], y=8, unit="bytes"),
+        _panel(4, "Object store (per node)", [
+            {"expr": "ray_tpu_object_store_used_bytes",
+             "legend": "used {{node_id}}"},
+            {"expr": "ray_tpu_object_store_capacity_bytes",
+             "legend": "capacity {{node_id}}"},
+            {"expr": "ray_tpu_object_store_spilled_bytes",
+             "legend": "spilled {{node_id}}"},
+        ], y=16, unit="bytes"),
+        _panel(5, "Object references (cluster-wide)", [
+            {"expr": "ray_tpu_object_refs", "legend": "{{kind}}"},
+        ], y=16),
+        _panel(6, "Paged-KV blocks", [
+            {"expr": "ray_tpu_kv_blocks", "legend": "{{state}}"},
+        ], y=24),
     ]
-    next_id = 4
+    next_id = 7
     for name in extra_metric_names or []:
         panels.append(_panel(next_id, name, [{"expr": name}],
-                             y=16 + 8 * ((next_id - 4) // 2)))
+                             y=32 + 8 * ((next_id - 7) // 2)))
         next_id += 1
     return {
         "title": "ray_tpu cluster",

@@ -657,6 +657,41 @@ class DashboardHead:
         if got_store:
             add("store_used_bytes", store_used)
             add("store_capacity_bytes", store_cap)
+        # 3b) memory plane: spill bytes + cluster ref/KV-block totals from
+        # the cheap ({"refs": False}) get_cluster_memory fan-out. The same
+        # report refreshes the ray_tpu_object_store_*/object_refs/
+        # kv_blocks prometheus gauges served at /metrics.
+        try:
+            from ray_tpu._private import memory_obs
+
+            mem = self._gcs.call(
+                "get_cluster_memory",
+                {"refs": False, "node_timeout_s": 4.0,
+                 "worker_timeout_s": 2.0}, timeout=5)
+            memory_obs.export_metrics(mem)
+            spilled = 0
+            refs = {"owned": 0, "borrowed": 0, "pinned": 0}
+            kv = {"free": 0, "cached": 0, "active": 0}
+            for node in (mem.get("nodes") or {}).values():
+                if not isinstance(node, dict) or "error" in node:
+                    continue
+                spilled += (node.get("spill") or {}).get("bytes") or 0
+            for _nid, _pid, rep in memory_obs.iter_worker_reports(mem):
+                counts = rep.get("counts") or {}
+                refs["owned"] += counts.get("num_owned", 0)
+                refs["borrowed"] += counts.get("num_borrowed", 0)
+                refs["pinned"] += counts.get("num_pinned", 0)
+                for rpt in rep.get("kv") or ():
+                    for state in kv:
+                        kv[state] += int(rpt.get(f"{state}_blocks", 0))
+            add("store_spilled_bytes", spilled)
+            for kind, n in refs.items():
+                add(f"object_refs_{kind}", n)
+            if any(kv.values()):
+                for state, n in kv.items():
+                    add(f"kv_blocks_{state}", n)
+        except Exception:  # noqa: BLE001 — GCS predating the RPC
+            pass
         # 4) per-node CPU via the dashboard agents
         try:
             agents = self._agents()

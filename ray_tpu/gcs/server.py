@@ -897,6 +897,8 @@ class GcsServer:
         self._server.register("gcs_ping", self._handle_ping)
         self._server.register("publish_logs", self._handle_publish_logs)
         self._server.register("report_error", self._handle_report_error)
+        self._server.register("get_cluster_memory",
+                              self._handle_get_cluster_memory)
         self._server.register("chaos_start", self._handle_chaos_start)
         self._server.register("chaos_stop", self._handle_chaos_stop)
         self._server.register("chaos_status", self._handle_chaos_status)
@@ -996,6 +998,32 @@ class GcsServer:
         # receipt, before it touches its queue): one event per notice,
         # and none at all when the notice provably never took effect.
         return {"status": "ok", "deadline_s": deadline_s, "raylet": reply}
+
+    async def _handle_get_cluster_memory(self, payload):
+        """Cluster-wide memory aggregation (ISSUE 16): every alive
+        raylet's node_memory_report (arena + spill + per-worker reference
+        tables), fanned out CONCURRENTLY — per-node failures land in-band
+        so one partitioned node degrades the report instead of timing the
+        whole call out. Callers (`ray-tpu memory`, the state API, the
+        leak sweep) merge their own driver-side report on top: drivers
+        register with the GCS, not a raylet worker pool."""
+        payload = payload or {}
+        node_timeout = float(payload.get("node_timeout_s", 30.0))
+        sub = {"refs": bool(payload.get("refs", True)),
+               "worker_timeout_s": float(payload.get("worker_timeout_s",
+                                                     10.0))}
+        nodes = self._alive_raylets()
+
+        async def _one(addr):
+            try:
+                return await self._pool.get(addr).call_async(
+                    "node_memory_report", dict(sub), timeout=node_timeout)
+            except Exception as e:  # noqa: BLE001 — node mid-death
+                return {"error": str(e)}
+
+        replies = await asyncio.gather(*(_one(addr) for _, addr in nodes))
+        return {"nodes": {nid.hex(): reply
+                          for (nid, _), reply in zip(nodes, replies)}}
 
     # -- chaos control plane (`ray-tpu chaos`, ray_tpu.chaos) -----------------
 

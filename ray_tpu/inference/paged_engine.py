@@ -136,6 +136,13 @@ class PagedInferenceEngine:
         # serve_stream: req_id -> reason for requests the loop aborted
         # (pool too small, prompt too long); read by the serving layer
         self.abort_reasons: Dict[Any, str] = {}
+        # Memory observability (ISSUE 16): the block pool is a ref-counted
+        # memory plane like the object store — publish it through the
+        # per-worker memory_report RPC (weak registration; a dropped
+        # engine vanishes from reports).
+        from ray_tpu._private import kv_registry
+
+        kv_registry.register(self)
 
         @partial(jax.jit, donate_argnums=(1,),
                  static_argnames=("temperature", "top_k", "top_p"))
@@ -405,6 +412,28 @@ class PagedInferenceEngine:
         return slot, m, cow_pair
 
     # -- generation ----------------------------------------------------------
+
+    def kv_block_report(self) -> Dict[str, Any]:
+        """Block-pool occupancy + prefix stats for the memory_report RPC
+        (kv_registry.report_all). Every non-scratch block is in exactly
+        one of free / cached(LRU, refcount 0, still indexed) / active
+        (attached to a decoding slot), so the three counts sum to
+        n_blocks - 1 and a drift there is itself a leak signal."""
+        active = sum(1 for n in self.block_ref.values() if n > 0)
+        return {
+            "n_blocks": self.n_blocks,
+            "block_size": self.block_size,
+            "free_blocks": len(self.free_blocks),
+            "cached_blocks": len(self.cached_lru),
+            "active_blocks": active,
+            "bytes_per_token": self._bytes_per_token,
+            "block_bytes": self._bytes_per_token * self.block_size,
+            "active_slots": self.max_batch - len(self.free_slots),
+            "max_batch": self.max_batch,
+            "preemptions": self.preemptions,
+            "peak_active": self.peak_active,
+            "prefix_stats": dict(self.prefix_stats),
+        }
 
     def stats(self) -> Dict[str, Any]:
         """Host-side engine occupancy snapshot (serving observability)."""

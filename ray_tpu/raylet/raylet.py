@@ -177,6 +177,9 @@ class Raylet:
         self._store_client = None
         self.store_socket: Optional[str] = None
         self._spilled: Dict[bytes, str] = {}  # store key -> spill URI/path
+        # store key -> spilled payload bytes (memory observability: the
+        # node report's spill accounting; mirrors _spilled's lifecycle)
+        self._spilled_sizes: Dict[bytes, int] = {}
         self._spill_dir: Optional[str] = None
         self._spill_backend = None  # set with the store (external_storage)
         # Remote spill URIs not yet confirmed by the GCS registry
@@ -647,6 +650,7 @@ class Raylet:
                     finally:
                         c.release(key)
                     self._spilled[key] = uri
+                    self._spilled_sizes[key] = len(view)
                     self._elog.emit("object.spill", object_id=key.hex(),
                                     node_id=self.node_id.hex(), uri=uri)
                     if self._spill_backend.is_remote:
@@ -780,6 +784,8 @@ class Raylet:
         ok = await asyncio.to_thread(_restore)
         if ok:
             self._spilled[key] = uri  # cache for the next restore/free
+            self._spilled_sizes.setdefault(
+                key, self._store_client.size_of(key) or 0)
             self._elog.emit("object.restore", object_id=key.hex(),
                             node_id=self.node_id.hex(), uri=uri)
         return ok
@@ -803,6 +809,7 @@ class Raylet:
         for oid in payload["object_ids"]:
             key = _pad_id(oid.binary())
             uri = self._spilled.pop(key, None)
+            self._spilled_sizes.pop(key, None)
             if uri is not None:
                 to_delete.append((key, uri))
         if not to_delete:
@@ -1490,6 +1497,77 @@ class Raylet:
                 for pg, e in self._bundles.items()
             },
         }
+
+    async def handle_node_memory_report(self, payload):
+        """Node-level memory observability (ISSUE 16): arena occupancy +
+        free-list fragmentation, spill accounting, and every live
+        worker's memory_report — fanned out CONCURRENTLY with a short
+        per-worker timeout (the profile_worker device pattern: the caller
+        budgets the NODE, so two hung workers polled sequentially must
+        not discard every healthy worker's report with them)."""
+        worker_timeout = float(payload.get("worker_timeout_s", 10.0))
+        include_refs = bool(payload.get("refs", True))
+        base = await asyncio.to_thread(
+            self._node_memory_stats_sync, include_refs)
+
+        handles = [h for h in list(self.worker_pool._workers.values())
+                   if h.pid is not None and h.address is not None]
+
+        async def _one(handle):
+            try:
+                return handle.pid, await self._pool.get(
+                    handle.address.rpc_address).call_async(
+                        "memory_report", {"refs": include_refs},
+                        timeout=worker_timeout)
+            except Exception as e:  # noqa: BLE001 — worker mid-death
+                return handle.pid, {"error": str(e)}
+
+        results = await asyncio.gather(*(_one(h) for h in handles))
+        base["workers"] = dict(results)
+        return base
+
+    def _node_memory_stats_sync(self, include_resident: bool) -> dict:
+        """Store + spill accounting for node_memory_report. Runs in a
+        thread: store RPCs can block while the store restarts."""
+        store = None
+        if self._store_client is not None:
+            try:
+                c = self._store_client
+                n, used, cap = c.stats()
+                holes, largest, free_total = c.free_info()
+                store = {
+                    "objects": n, "used_bytes": used, "capacity_bytes": cap,
+                    # a put needs ONE contiguous hole: 1 - largest/total
+                    # rises as the arena shatters even while used/capacity
+                    # still shows headroom
+                    "fragmentation": (0.0 if free_total == 0
+                                      else 1.0 - largest / free_total),
+                    "free_holes": holes,
+                    "largest_free_bytes": largest,
+                }
+                if include_resident:
+                    # Sealed, client-unreferenced residents (the
+                    # spillable-primaries + evictable-caches free lists):
+                    # the leak sweep correlates these keys against the
+                    # cluster union of references — a resident key no ref
+                    # table knows is an orphan nothing will ever free.
+                    resident = {}
+                    for primaries in (True, False):
+                        for key in c.list_ids(max_ids=4096,
+                                              primaries=primaries):
+                            sz = c.size_of(key)
+                            if sz is not None:
+                                resident[key.hex()] = sz
+                    store["resident_unreferenced"] = resident
+            except Exception:  # noqa: BLE001 — store restarting
+                store = None
+        with self._spill_uri_lock:
+            pending = len(self._pending_spill_uris)
+        spill = {"objects": len(self._spilled),
+                 "bytes": sum(self._spilled_sizes.values()),
+                 "pending_uris": pending,
+                 "spilled_keys": [k.hex() for k in self._spilled]}
+        return {"node_id": self.node_id, "store": store, "spill": spill}
 
     async def handle_raylet_ping(self, payload):
         return {"status": "ok", "node_id": self.node_id}

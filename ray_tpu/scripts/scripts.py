@@ -231,6 +231,41 @@ def cmd_status(args) -> int:
     print("\nResources:")
     for k in sorted(total):
         print(f"  {avail.get(k, 0):g}/{total[k]:g} {k}")
+    # Memory plane: arena occupancy + spill per node and the cluster ref
+    # totals, from the cheap ({"refs": False}) fan-out. Best-effort — an
+    # old GCS without get_cluster_memory just omits the section.
+    try:
+        from ray_tpu._private import memory_obs
+        from ray_tpu.util.state.api import get_cluster_memory
+
+        cluster = get_cluster_memory(refs=False, node_timeout_s=10.0,
+                                     worker_timeout_s=5.0)
+        print("\nMemory:")
+        for nid, node in sorted((cluster.get("nodes") or {}).items()):
+            if not isinstance(node, dict) or "error" in node:
+                print(f"  {nid[:12]} unreachable")
+                continue
+            store = node.get("store") or {}
+            spill = node.get("spill") or {}
+            line = (f"  {nid[:12]} arena "
+                    f"{_fmt_bytes(store.get('used_bytes'))}/"
+                    f"{_fmt_bytes(store.get('capacity_bytes'))}"
+                    if store else f"  {nid[:12]} no shm store")
+            if spill.get("objects"):
+                line += (f", spilled {spill['objects']} obj "
+                         f"({_fmt_bytes(spill.get('bytes', 0))})")
+            print(line)
+        totals = {"owned": 0, "borrowed": 0, "pinned": 0}
+        for _n, _p, rep in memory_obs.iter_worker_reports(cluster):
+            counts = rep.get("counts") or {}
+            totals["owned"] += counts.get("num_owned", 0)
+            totals["borrowed"] += counts.get("num_borrowed", 0)
+            totals["pinned"] += counts.get("num_pinned", 0)
+        print(f"  refs: {totals['owned']} owned, {totals['borrowed']} "
+              f"borrowed, {totals['pinned']} pinned "
+              "(`ray-tpu memory` for the full table)")
+    except Exception as e:  # noqa: BLE001 — status degrades, not dies
+        print(f"\nMemory: unavailable ({e})")
     # Event-pipeline health: silent drops anywhere in the cluster must be
     # visible here, not discovered during the next post-mortem.
     try:
@@ -349,16 +384,171 @@ def cmd_list(args) -> int:
     return 0
 
 
+def _fmt_bytes(n) -> str:
+    if n is None:
+        return "?"
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024
+    return f"{n:.1f}GiB"
+
+
+def _render_memory_table(rows, group_by=None, top: int = 0) -> str:
+    """Pure row-list -> table renderer (unit-tested without a cluster).
+    group_by None: one line per reference, largest first. group_by
+    "owner"/"node": aggregate refs + bytes per group."""
+    lines = []
+    if group_by:
+        key = {"owner": lambda r: r.get("owner_address") or r.get("holder")
+               or "?",
+               "node": lambda r: (r.get("node_id") or "?")[:12]}[group_by]
+        groups = {}
+        for r in rows:
+            g = groups.setdefault(key(r), {"refs": 0, "bytes": 0,
+                                           "pinned": 0, "borrowed": 0})
+            g["refs"] += 1
+            g["bytes"] += r.get("size_bytes") or 0
+            g["pinned"] += 1 if r.get("pinned") else 0
+            g["borrowed"] += 1 if r.get("kind") == "borrowed" else 0
+        lines.append(f"{group_by.upper():<42} {'REFS':>6} {'BYTES':>10} "
+                     f"{'PINNED':>7} {'BORROWED':>9}")
+        ordered = sorted(groups.items(), key=lambda kv: -kv[1]["bytes"])
+        if top:
+            ordered = ordered[:top]
+        for name, g in ordered:
+            lines.append(f"{str(name):<42} {g['refs']:>6} "
+                         f"{_fmt_bytes(g['bytes']):>10} {g['pinned']:>7} "
+                         f"{g['borrowed']:>9}")
+        return "\n".join(lines)
+    lines.append(f"{'OBJECT_ID':<14} {'KIND':<9} {'SIZE':>10} {'AGE':>8} "
+                 f"{'PIN':>4} {'LREF':>5} {'BRW':>4} {'NODE':<13} "
+                 f"{'HOLDER':<21} OWNER")
+    ordered = sorted(rows, key=lambda r: -(r.get("size_bytes") or 0))
+    if top:
+        ordered = ordered[:top]
+    for r in ordered:
+        age = r.get("age_s")
+        borrowers = r.get("borrowers")
+        n_brw = len(borrowers) if isinstance(borrowers, (list, tuple)) \
+            else (borrowers or 0)
+        lines.append(
+            f"{r.get('object_id', '?')[:12]:<14} "
+            f"{r.get('kind', '?'):<9} "
+            f"{_fmt_bytes(r.get('size_bytes')):>10} "
+            f"{(f'{age:.0f}s' if age is not None else '?'):>8} "
+            f"{('Y' if r.get('pinned') else '-'):>4} "
+            f"{r.get('local_refs', 0):>5} {n_brw:>4} "
+            f"{(r.get('node_id') or '?')[:12]:<13} "
+            f"{str(r.get('holder') or '?'):<21} "
+            f"{r.get('owner_address') or '-'}")
+    return "\n".join(lines)
+
+
 def cmd_memory(args) -> int:
+    """Cluster-wide memory report: per-node arena/spill occupancy, every
+    worker's reference table (sizes, ages, pins, borrowers), KV-block
+    pools, and an optional leak sweep. --local keeps the old driver-only
+    snapshot (no fan-out)."""
     ray_tpu = _connect(args)
     cw = ray_tpu._raylet.get_core_worker()
-    stats = {"memory_store_objects": cw.memory_store.size(),
-             "memory_store_bytes": cw.memory_store.total_bytes()}
-    if cw.plasma is not None:
-        n, used, cap = cw.plasma._client.stats()
-        stats["shm_store"] = {"objects": n, "used_bytes": used,
-                              "capacity_bytes": cap}
-    print(json.dumps(stats, indent=2))
+    if getattr(args, "local", False):
+        stats = {"memory_store_objects": cw.memory_store.size(),
+                 "memory_store_bytes": cw.memory_store.total_bytes()}
+        if cw.plasma is not None:
+            n, used, cap = cw.plasma._client.stats()
+            stats["shm_store"] = {"objects": n, "used_bytes": used,
+                                  "capacity_bytes": cap}
+        print(json.dumps(stats, indent=2))
+        return 0
+
+    from ray_tpu._private import memory_obs
+    from ray_tpu.util.state.api import get_cluster_memory
+
+    include_refs = not args.stats_only
+    cluster = get_cluster_memory(refs=include_refs,
+                                 node_timeout_s=args.timeout,
+                                 worker_timeout_s=args.timeout / 2)
+    verdict = None
+    if args.leaks:
+        verdict = memory_obs.sweep_and_emit(
+            cluster, max_age_s=args.max_age,
+            min_orphan_age_s=args.min_orphan_age)
+    if args.json:
+        out = dict(cluster)
+        if verdict is not None:
+            out["leak_sweep"] = verdict
+        print(json.dumps(out, indent=2, default=str))
+        return 1 if verdict and verdict["suspects"] else 0
+
+    for nid, node in sorted((cluster.get("nodes") or {}).items()):
+        if not isinstance(node, dict) or "error" in node:
+            err = node.get("error") if isinstance(node, dict) else node
+            print(f"node {nid[:12]}: UNREACHABLE ({err})", file=sys.stderr)
+            continue
+        store = node.get("store") or {}
+        spill = node.get("spill") or {}
+        workers = node.get("workers") or {}
+        if store:
+            frag = store.get("fragmentation") or 0.0
+            print(f"node {nid[:12]}: arena "
+                  f"{_fmt_bytes(store.get('used_bytes'))}/"
+                  f"{_fmt_bytes(store.get('capacity_bytes'))} "
+                  f"({store.get('objects', 0)} objects, "
+                  f"frag {frag:.2f}, largest hole "
+                  f"{_fmt_bytes(store.get('largest_free_bytes'))})")
+        else:
+            print(f"node {nid[:12]}: no shm store")
+        if spill:
+            pend = len(spill.get("pending_uris") or ())
+            print(f"  spill: {spill.get('objects', 0)} objects, "
+                  f"{_fmt_bytes(spill.get('bytes', 0))}"
+                  + (f", {pend} restore(s) pending" if pend else ""))
+        n_err = sum(1 for w in workers.values()
+                    if isinstance(w, dict) and "error" in w)
+        print(f"  workers reporting: {len(workers) - n_err}/{len(workers)}")
+        for pid, w in sorted(workers.items()):
+            if isinstance(w, dict) and "error" in w:
+                print(f"    pid {pid}: {w['error']}", file=sys.stderr)
+    kv_reports = [kv for _n, _p, rep in memory_obs.iter_worker_reports(cluster)
+                  for kv in rep.get("kv") or ()]
+    if kv_reports:
+        free = sum(k.get("free_blocks", 0) for k in kv_reports)
+        cached = sum(k.get("cached_blocks", 0) for k in kv_reports)
+        active = sum(k.get("active_blocks", 0) for k in kv_reports)
+        hits = sum((k.get("prefix_stats") or {}).get("hit_tokens", 0)
+                   for k in kv_reports)
+        saved = sum((k.get("prefix_stats") or {}).get("bytes_saved", 0)
+                    for k in kv_reports)
+        print(f"\nKV blocks ({len(kv_reports)} engine(s)): {active} active, "
+              f"{cached} cached, {free} free; prefix cache: {hits} hit "
+              f"tokens, {_fmt_bytes(saved)} saved")
+
+    if include_refs:
+        rows = memory_obs.flatten_refs(cluster)
+        print(f"\n{len(rows)} reference(s) cluster-wide:")
+        print(_render_memory_table(rows, group_by=args.group_by,
+                                   top=args.top))
+
+    if verdict is not None:
+        suspects = verdict["suspects"]
+        print(f"\nLeak sweep: {len(suspects)} suspect(s)")
+        for s in suspects:
+            age = s.get("age_s")
+            extra = "" if age is None else f" age {age:.0f}s"
+            if s.get("holder"):
+                extra += f" holder {s['holder']}"
+            if s.get("owner"):
+                extra += f" owner {s['owner']}"
+            print(f"  {s['kind']:<14} {s['object_id'][:12]} "
+                  f"{_fmt_bytes(s.get('size_bytes'))}{extra}")
+        for p in verdict["pressure"]:
+            print(f"  PRESSURE node {p['node_id'][:12]}: "
+                  f"{_fmt_bytes(p['used_bytes'])}/"
+                  f"{_fmt_bytes(p['capacity_bytes'])} "
+                  f"({p['frac']:.0%})")
+        return 1 if suspects else 0
     return 0
 
 
@@ -1522,8 +1712,27 @@ def main(argv=None) -> int:
     sp.add_argument("--limit", type=int, default=100)
     sp.set_defaults(fn=cmd_list)
 
-    sp = sub.add_parser("memory", help="object store usage")
+    sp = sub.add_parser(
+        "memory", help="cluster-wide object/KV memory report + leak sweep")
     sp.add_argument("--address")
+    sp.add_argument("--group-by", choices=["owner", "node"],
+                    help="aggregate the reference table per owner or node")
+    sp.add_argument("--top", type=int, default=20,
+                    help="show only the top N rows by size (0 = all)")
+    sp.add_argument("--stats-only", action="store_true",
+                    help="occupancy counters only, skip per-ref tables")
+    sp.add_argument("--leaks", action="store_true",
+                    help="run the leak sweep (exit 1 if suspects found)")
+    sp.add_argument("--max-age", type=float, default=3600.0,
+                    help="pin/borrow age (s) before it becomes a suspect")
+    sp.add_argument("--min-orphan-age", type=float, default=30.0,
+                    help="grace (s) before an unreferenced entry is an "
+                         "orphan suspect")
+    sp.add_argument("--timeout", type=float, default=30.0,
+                    help="per-node fan-out timeout (s)")
+    sp.add_argument("--local", action="store_true",
+                    help="driver-local snapshot only (no cluster fan-out)")
+    sp.add_argument("--json", action="store_true")
     sp.set_defaults(fn=cmd_memory)
 
     sp = sub.add_parser("timeline", help="dump chrome trace of task events")

@@ -6,6 +6,7 @@ state_aggregator behind the dashboard's state_head).
 
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, List, Optional
 
 from ray_tpu._raylet import get_core_worker
@@ -117,9 +118,41 @@ def list_placement_groups(filters=None, limit: int = 100,
     return _apply_filters(out, filters)[:limit]
 
 
-def list_objects(filters=None, limit: int = 100, **_kw) -> List[Dict[str, Any]]:
-    """Objects known to THIS worker's reference counter (the reference
-    aggregates per-worker core-worker stats; ray memory does the same)."""
+def get_cluster_memory(refs: bool = True,
+                       node_timeout_s: float = 30.0,
+                       worker_timeout_s: float = 10.0,
+                       include_driver: bool = True) -> Dict[str, Any]:
+    """Cluster-wide memory report: the GCS fans node_memory_report out to
+    every alive raylet concurrently, each raylet fans memory_report out to
+    its worker pool concurrently (per-worker timeout), and the caller's
+    own report is grafted in — drivers live outside every raylet worker
+    pool, and without the driver's ref table a leak sweep would flag all
+    driver-owned objects as orphans. Unreachable nodes/workers appear
+    in-band as {"error": ...} entries, never as a raised exception."""
+    from ray_tpu._private import memory_obs
+
+    cluster = _gcs().call("get_cluster_memory", {
+        "refs": refs, "node_timeout_s": node_timeout_s,
+        "worker_timeout_s": worker_timeout_s,
+    })
+    if include_driver:
+        cluster = memory_obs.merge_driver(
+            cluster, get_core_worker().memory_report(include_refs=refs))
+    return cluster
+
+
+def list_objects(filters=None, limit: int = 100,
+                 all_workers: bool = False, **_kw) -> List[Dict[str, Any]]:
+    """Object references with sizes and ages. Default: THIS worker's
+    reference counter (the reference aggregates per-worker core-worker
+    stats; ray memory does the same). With all_workers=True, the rows
+    come from the cluster-wide memory fan-out — every worker's table,
+    stamped with node_id/pid/holder."""
+    if all_workers:
+        from ray_tpu._private import memory_obs
+
+        rows = memory_obs.flatten_refs(get_cluster_memory(refs=True))
+        return _apply_filters(rows, filters)[:limit]
     cw = get_core_worker()
     out = []
     for oid, ref in cw.reference_counter.snapshot().items():
@@ -131,6 +164,7 @@ def list_objects(filters=None, limit: int = 100, **_kw) -> List[Dict[str, Any]]:
             "owned": ref.owned,
             "borrowers": len(ref.borrowers),
             "location": ref.location,
+            "size_bytes": ref.size_bytes,
         })
     return _apply_filters(out, filters)[:limit]
 
@@ -206,15 +240,43 @@ def trace_events(trace_id: str) -> List[Dict[str, Any]]:
 
 
 def list_workers(filters=None, limit: int = 100, **_kw) -> List[Dict[str, Any]]:
-    actors = list_actors(limit=limit)
-    # Worker-level view: one row per live actor process + the driver.
+    """One row per live worker PROCESS with its real worker id. Sourced
+    from the per-node memory fan-out ({"refs": False} — cheap counts
+    only), which asks each worker directly — the old actor-table
+    synthesis invented rows (worker_id None, task-only workers missing).
+    Falls back to the actor-table view if the fan-out fails (e.g. GCS
+    predating get_cluster_memory)."""
+    import os
+
     cw = get_core_worker()
     rows = [{"worker_id": cw.worker_id.hex(), "worker_type": "DRIVER",
-             "pid": __import__("os").getpid()}]
-    for a in actors:
-        if a["pid"]:
-            rows.append({"worker_id": None, "worker_type": "WORKER",
-                         "pid": a["pid"], "actor_id": a["actor_id"]})
+             "pid": os.getpid(), "node_id": cw.node_id.hex()
+             if cw.node_id else None, "actor_id": None}]
+    try:
+        from ray_tpu._private import memory_obs
+
+        cluster = get_cluster_memory(refs=False, include_driver=False)
+        pid_to_actor = {a["pid"]: a["actor_id"]
+                        for a in list_actors(limit=100_000) if a["pid"]}
+        seen = {rows[0]["worker_id"]}
+        for nid, pid, rep in memory_obs.iter_worker_reports(cluster):
+            if rep.get("worker_id") in seen:
+                continue  # local mode: the driver is in the pool too
+            seen.add(rep.get("worker_id"))
+            rows.append({
+                "worker_id": rep.get("worker_id"),
+                "worker_type": "WORKER",
+                "pid": rep.get("pid", pid),
+                "node_id": nid,
+                "actor_id": rep.get("actor_id")
+                or pid_to_actor.get(rep.get("pid", pid)),
+            })
+    except Exception:  # noqa: BLE001 — degrade to the actor-table view
+        for a in list_actors(limit=100_000):
+            if a["pid"]:
+                rows.append({"worker_id": None, "worker_type": "WORKER",
+                             "pid": a["pid"], "node_id": None,
+                             "actor_id": a["actor_id"]})
     return _apply_filters(rows, filters)[:limit]
 
 
@@ -267,25 +329,51 @@ def _apply_filters(rows: List[Dict[str, Any]], filters) -> List[Dict[str, Any]]:
 
 
 def collect_worker_logs(nodes, rpc_call, *, node_id=None, pid=None,
-                        lines: int = 100) -> Dict[str, Any]:
+                        lines: int = 100,
+                        timeout_s: float = 10.0) -> Dict[str, Any]:
     """Cluster-wide worker-log fan-out shared by the `ray-tpu logs` CLI
     and the dashboard /api/logs route: per alive node, tail_worker_logs
-    over `rpc_call(raylet_address, payload)`; per-node failures are
-    reported in-band, never raised."""
-    out: Dict[str, Any] = {}
+    over `rpc_call(raylet_address, payload)`. All nodes are queried
+    CONCURRENTLY with a per-node timeout — sequentially, one hung raylet
+    used to stall the whole collection for every node behind it.
+    Per-node failures (including timeout) are reported in-band, never
+    raised."""
+    from concurrent.futures import ThreadPoolExecutor
+    from concurrent.futures import TimeoutError as FutTimeout
+
+    targets = []
     for n in nodes:
         if not n.alive:
             continue
         nid = n.node_id.hex()
         if node_id and not nid.startswith(node_id):
             continue
-        try:
-            reply = rpc_call(n.raylet_address,
-                             {"pid": pid, "lines": lines})
-        except Exception as e:  # noqa: BLE001 — report per-node failure
-            out[nid] = {"error": str(e)}
-            continue
-        out[nid] = {str(p): info for p, info in reply.items()}
+        targets.append((nid, n.raylet_address))
+    out: Dict[str, Any] = {}
+    if not targets:
+        return out
+    # No `with`: shutdown(wait=True) would join a hung rpc_call thread
+    # and undo the timeout we just enforced.
+    pool = ThreadPoolExecutor(max_workers=min(16, len(targets)),
+                              thread_name_prefix="log-fanout")
+    try:
+        futs = {nid: pool.submit(rpc_call, addr,
+                                 {"pid": pid, "lines": lines})
+                for nid, addr in targets}
+        deadline = time.monotonic() + timeout_s
+        for nid, fut in futs.items():
+            try:
+                reply = fut.result(
+                    timeout=max(0.0, deadline - time.monotonic()))
+            except FutTimeout:
+                out[nid] = {"error": f"timeout after {timeout_s}s"}
+                fut.cancel()
+            except Exception as e:  # noqa: BLE001 — report per-node failure
+                out[nid] = {"error": str(e)}
+            else:
+                out[nid] = {str(p): info for p, info in reply.items()}
+    finally:
+        pool.shutdown(wait=False)
     return out
 
 

@@ -556,6 +556,7 @@ class CoreWorker:
         s.register("profile_cpu", self._handle_profile_cpu)
         s.register("profile_memory", self._handle_profile_memory)
         s.register("profile_device", self._handle_profile_device)
+        s.register("memory_report", self._handle_memory_report)
         s.register("pubsub_message", self._handle_pubsub_message)
         s.register("reconstruct_object", self._handle_reconstruct_object)
 
@@ -747,6 +748,7 @@ class CoreWorker:
         else:
             self.memory_store.put_serialized(oid, s, value=value)
         self.reference_counter.add_owned(oid, self.address)
+        self.reference_counter.set_size(oid, s.total_bytes())
         for ref in s.contained_refs:
             pass  # nested refs stay alive via the stored value holding them
         return ObjectRef(oid, owner_address=self.address)
@@ -1993,12 +1995,17 @@ class CoreWorker:
     def _store_return(self, oid: ObjectID, payload: dict):
         if "inline" in payload:
             self.memory_store.put_serialized(oid, payload["inline"])
+            if payload["inline"] is not None:
+                self.reference_counter.set_size(
+                    oid, payload["inline"].total_bytes())
         else:
             self.memory_store.put_serialized(
                 oid, None, location=payload["location"],
                 in_plasma=payload.get("plasma_node") is not None,
                 plasma_node=payload.get("plasma_node"))
             self.reference_counter.set_location(oid, payload["location"])
+            if payload.get("size"):
+                self.reference_counter.set_size(oid, payload["size"])
         self._release_deps(oid)
 
     def _store_error_for_task(self, spec: TaskSpec, error: BaseException):
@@ -3203,6 +3210,82 @@ class CoreWorker:
         return await asyncio.to_thread(
             device_profiler.snapshot_all,
             int(payload.get("recent", 64)))
+
+    async def _handle_memory_report(self, payload):
+        """Cluster memory observability (ISSUE 16): this worker's full
+        reference-table snapshot plus memory-store and paged-KV pool
+        occupancy — fanned out by the raylet's node_memory_report for
+        `ray-tpu memory` / get_cluster_memory. to_thread like the profile
+        handlers: the snapshots take component locks and size whole
+        payload tables, never on the RPC loop."""
+        return await asyncio.to_thread(
+            self.memory_report, bool((payload or {}).get("refs", True)))
+
+    def memory_report(self, include_refs: bool = True) -> dict:
+        """Memory-observability snapshot of THIS worker. include_refs=False
+        is the cheap summary form (counts + store/KV occupancy only) for
+        periodic samplers like the dashboard head."""
+        from ray_tpu._private import kv_registry
+
+        now = time.time()
+        report = {
+            "worker_id": self.worker_id.hex(),
+            "pid": os.getpid(),
+            "mode": self.mode,
+            "address": self.address_str,
+            "node_id": self.node_id.hex() if self.node_id else None,
+            "actor_id": (self.current_actor_id.hex()
+                         if self.current_actor_id else None),
+            "counts": self.reference_counter.summary(),
+            "memory_store": {"objects": self.memory_store.size(),
+                             "bytes": self.memory_store.total_bytes()},
+            "kv": kv_registry.report_all(),
+        }
+        if not include_refs:
+            return report
+        snap = self.reference_counter.snapshot()
+        refs = []
+        for oid, ref in snap.items():
+            entry = self.memory_store.get_entry(oid)
+            size = ref.size_bytes
+            if not size and entry is not None and entry.serialized is not None:
+                size = entry.serialized.total_bytes()
+            refs.append({
+                "object_id": oid.hex(),
+                "kind": "owned" if ref.owned else "borrowed",
+                "local_refs": ref.local_refs,
+                "submitted_task_refs": ref.submitted_task_refs,
+                "pinned": ref.pinned,
+                "borrowers": sorted(ref.borrowers),
+                "owner_address": getattr(ref.owner_address, "rpc_address",
+                                         None),
+                "size_bytes": int(size),
+                "age_s": max(0.0, now - ref.created_at),
+                "location": ref.location,
+                "in_plasma": bool(entry is not None and entry.in_plasma),
+            })
+        report["refs"] = refs
+        # Store-resident entries with NO ref in this worker's table. The
+        # memory store is process-private, so nothing can ever free an
+        # unreferenced entry — the leak detector's orphan candidates.
+        # Secondary/primary copies held for remote owners (the executor's
+        # hold_secondary_copy) are tracked by the OWNER's ref table, not
+        # ours: marked so the sweep checks them against the cluster union
+        # instead of flagging them outright.
+        unref = []
+        for (oid, nbytes, created, in_plasma, freed,
+             _is_exc) in self.memory_store.entries_snapshot():
+            if freed or oid in snap:
+                continue
+            unref.append({
+                "object_id": oid.hex(),
+                "size_bytes": int(nbytes),
+                "age_s": max(0.0, now - created),
+                "in_plasma": bool(in_plasma),
+                "secondary": oid in self._secondary_copies,
+            })
+        report["unreferenced_entries"] = unref
+        return report
 
     # ---------------------------------------------- generator streaming (owner)
     async def _handle_report_generator_item(self, payload):

@@ -359,7 +359,7 @@ class Executor:
             self.cw.memory_store.put_serialized(oid, s, value=value)
         self.cw.hold_secondary_copy(oid)
         return {"location": self.cw.address.rpc_address,
-                "plasma_node": plasma_node}
+                "plasma_node": plasma_node, "size": s.total_bytes()}
 
     def _deadline_reply(self, spec: TaskSpec) -> dict:
         """Queue-pop doomed-work elimination on the worker: the spec's

@@ -10,6 +10,7 @@ cached deserialized value (zero-copy buffers preserved end to end).
 from __future__ import annotations
 
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Set
 
@@ -32,6 +33,9 @@ class StoreEntry:
     # remote node, plasma_node says which node's store has the primary copy.
     in_plasma: bool = False
     plasma_node: Optional[str] = None
+    # wall time the entry landed — ages memory-report rows and lets the
+    # leak detector skip freshly-stored entries mid-registration
+    created_at: float = field(default_factory=time.time)
 
 
 class MemoryStore:
@@ -130,3 +134,15 @@ class MemoryStore:
                 for e in self._entries.values()
                 if e.serialized is not None
             )
+
+    def entries_snapshot(self) -> List[tuple]:
+        """(object_id, bytes, created_at, in_plasma, freed, is_exception)
+        per entry — the memory_report RPC's store-resident view (sizes
+        computed under the lock; the caller formats off-lock)."""
+        with self._lock:
+            return [
+                (oid,
+                 e.serialized.total_bytes() if e.serialized is not None else 0,
+                 e.created_at, e.in_plasma, e.freed, e.is_exception)
+                for oid, e in self._entries.items()
+            ]

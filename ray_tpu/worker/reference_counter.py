@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, Optional, Set
 
@@ -44,6 +45,12 @@ class Reference:
     lineage_task = None     # TaskSpec that produces this object (owned only)
     pinned: bool = False    # e.g. detached-actor handles, named refs
     freed: bool = False
+    # Memory observability (`ray-tpu memory` / memory_report RPC): payload
+    # size when the tracker saw it (0 = unknown, e.g. a remote return not
+    # yet fetched) and the wall time the entry was created — age drives
+    # the leak detector's over-age pin/borrow verdicts.
+    size_bytes: int = 0
+    created_at: float = field(default_factory=time.time)
 
 
 class ReferenceCounter:
@@ -129,6 +136,14 @@ class ReferenceCounter:
             ref = self._refs.get(object_id)
             if ref is not None:
                 ref.pinned = True
+
+    def set_size(self, object_id: ObjectID, size_bytes: int):
+        """Record the payload size for the memory report (put / stored
+        return paths — borrowers learn it from their fetched copy)."""
+        with self._lock:
+            ref = self._refs.get(object_id)
+            if ref is not None:
+                ref.size_bytes = int(size_bytes)
 
     # ---- local count hooks (from ObjectRef lifecycle) -----------------------
 
@@ -260,6 +275,8 @@ class ReferenceCounter:
                 "num_refs": len(self._refs),
                 "num_owned": sum(1 for r in self._refs.values() if r.owned),
                 "num_borrowed": sum(1 for r in self._refs.values() if not r.owned),
+                "num_pinned": sum(1 for r in self._refs.values() if r.pinned),
+                "tracked_bytes": sum(r.size_bytes for r in self._refs.values()),
             }
 
     def snapshot(self) -> dict:

@@ -25,13 +25,18 @@
 #                        2-node in-process cluster: value integrity, a
 #                        conservative bandwidth floor, and ZERO
 #                        whole-payload copies (serialization.COPY_STATS)
-#   8. perf gate       — tools/perf_gate.py --smoke: the newest bench
+#   8. memory smoke    — put/transfer/free churn across a 2-node
+#                        in-process cluster: every node+worker answers
+#                        the memory fan-out, the leak sweep stays at
+#                        ZERO suspects, no object.leak_suspect events,
+#                        arena bytes back to the pre-churn baseline
+#   9. perf gate       — tools/perf_gate.py --smoke: the newest bench
 #                        trajectory row vs its history, per-metric
 #                        noise-banded thresholds (loose smoke bands on
 #                        this shared CI host; run WITHOUT --smoke on a
 #                        quiet dedicated host for the strict bands that
 #                        catch r05-class drifts)
-#   9. tier-1 tests    — the full `not slow` suite
+#  10. tier-1 tests    — the full `not slow` suite
 #
 # Usage: tools/ci.sh [--skip-tests]
 set -euo pipefail
@@ -65,6 +70,9 @@ JAX_PLATFORMS=cpu python -m tools.tracing_smoke --budget 120
 
 echo "== dataplane smoke (bounded) =="
 JAX_PLATFORMS=cpu python -m tools.dataplane_smoke --budget 120
+
+echo "== memory smoke (bounded) =="
+JAX_PLATFORMS=cpu python -m tools.memory_smoke --budget 120
 
 echo "== perf-regression gate (smoke bands) =="
 python -m tools.perf_gate --smoke

@@ -1,0 +1,130 @@
+"""Bounded cluster-memory-observability smoke for CI (ISSUE 16 satellite).
+
+Brings up a 2-node in-process cluster, records the arena baseline, runs a
+put → cross-node transfer → free churn loop, then asserts over the
+get_cluster_memory fan-out:
+
+* every node reports arena occupancy (used/capacity + free-list shape)
+  and every worker answered the memory_report RPC,
+* the leak sweep over the merged cluster + driver report finds ZERO
+  suspects — healthy churn must not trip the detector,
+* no `object.leak_suspect` event reached the cluster event log,
+* arena usage returns to the pre-churn baseline once the refs are
+  dropped — the churn freed what it allocated.
+
+Exit 0 on success; nonzero with the observed numbers printed.
+
+Usage: JAX_PLATFORMS=cpu python -m tools.memory_smoke [--budget 120]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+PAYLOAD_BYTES = 2 * 1024 * 1024
+ROUNDS = 6
+
+
+def _arena_used(report) -> int:
+    return sum((n.get("store") or {}).get("used_bytes") or 0
+               for n in report["nodes"].values()
+               if isinstance(n, dict) and "error" not in n)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--budget", type=float, default=120.0)
+    args = parser.parse_args()
+    deadline = time.monotonic() + args.budget
+
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu._private import memory_obs
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=False)
+    try:
+        cluster.add_node(num_cpus=2, resources={"A": 1})
+        cluster.add_node(num_cpus=2, resources={"B": 1})
+        cluster.wait_for_nodes()
+        cluster.connect()
+
+        from ray_tpu.util.state.api import get_cluster_memory
+
+        baseline = _arena_used(get_cluster_memory(refs=False))
+
+        @ray_tpu.remote(resources={"A": 0.1})
+        def produce():
+            return np.ones(PAYLOAD_BYTES, dtype=np.uint8)
+
+        @ray_tpu.remote(resources={"B": 0.1})
+        def consume(refs):
+            return int(ray_tpu.get(refs[0])[:1024].sum())
+
+        for i in range(ROUNDS):
+            held = ray_tpu.put(np.full(PAYLOAD_BYTES, i, dtype=np.uint8))
+            r = produce.remote()
+            assert ray_tpu.get(consume.remote([r]),
+                               timeout=args.budget) == 1024
+            del r, held
+
+        report = get_cluster_memory()
+        nodes = {nid: n for nid, n in report["nodes"].items()
+                 if isinstance(n, dict) and "error" not in n}
+        ok = True
+        if len(nodes) < 2:
+            print(f"FAIL: fan-out reached {len(nodes)} node(s), want 2: "
+                  f"{report['nodes']}")
+            ok = False
+        for nid, n in nodes.items():
+            store = n.get("store") or {}
+            if not store.get("capacity_bytes"):
+                print(f"FAIL: node {nid[:12]} reported no arena stats")
+                ok = False
+            workers = n.get("workers") or {}
+            errs = {p: w for p, w in workers.items()
+                    if isinstance(w, dict) and "error" in w}
+            if errs:
+                print(f"FAIL: node {nid[:12]} worker report errors: {errs}")
+                ok = False
+
+        verdict = memory_obs.sweep_and_emit(report)
+        if verdict["suspects"]:
+            print(f"FAIL: clean churn produced {len(verdict['suspects'])} "
+                  f"leak suspect(s): {verdict['suspects']}")
+            ok = False
+
+        from ray_tpu.util.state import list_cluster_events
+
+        leak_events = list_cluster_events(etype="object.leak_suspect",
+                                          limit=100)
+        if leak_events:
+            print(f"FAIL: object.leak_suspect events during clean churn: "
+                  f"{leak_events}")
+            ok = False
+
+        # freed refs must drain the arena back to the baseline (the churn
+        # loop dropped every ref; frees propagate asynchronously)
+        used = _arena_used(get_cluster_memory(refs=False))
+        while used > baseline and time.monotonic() < deadline:
+            time.sleep(0.5)
+            used = _arena_used(get_cluster_memory(refs=False))
+        if used > baseline:
+            print(f"FAIL: arena did not return to baseline: "
+                  f"{used}B used vs {baseline}B before the churn")
+            ok = False
+
+        print(f"memory smoke: {ROUNDS}x{PAYLOAD_BYTES/1e6:.0f}MB churn "
+              f"across 2 nodes, {len(nodes)} nodes reporting, "
+              f"{len(verdict['suspects'])} suspects, arena {used}B "
+              f"(baseline {baseline}B)" + ("" if ok else "  [FAILED]"))
+        return 0 if ok else 1
+    finally:
+        cluster.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
