@@ -127,6 +127,9 @@ class ObjectRefGenerator:
         ref = cw.next_generator_item(self._task_id, self._consumed, timeout=None)
         if ref is None:
             raise StopIteration
+        # raylint: disable=cross-domain-mutation — single-consumer
+        # invariant: a generator is drained by exactly one of __next__
+        # (caller's thread) or __anext__ (its loop), never both
         self._consumed += 1
         return ref
 

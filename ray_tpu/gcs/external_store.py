@@ -198,26 +198,40 @@ class ExternalStore(InMemoryStore):
                     self._client.call(
                         "xs_ping", {},
                         timeout=CONFIG.gcs_external_store_op_timeout_s)
-                self._down_since = None
-                self._down_fired = False
                 with self._cv:
+                    # reset under the cv: _append's divert path does a
+                    # check-then-set on _down_since from writer threads,
+                    # and a reset torn across its check would restart the
+                    # down clock mid-outage (detector never fires)
+                    self._down_since = None
+                    self._down_fired = False
                     self._inflight = 0
                     self._cv.notify_all()
             except Exception as e:  # noqa: BLE001 — store unreachable
                 if self._closed:
                     return
+                now = time.monotonic()
+                fire = False
                 with self._cv:
                     # requeue IN ORDER ahead of anything newer
                     self._queue.extendleft(reversed(batch))
                     self._inflight = 0
-                now = time.monotonic()
-                if self._down_since is None:
-                    self._down_since = now
-                    logger.warning("external GCS store unreachable: %s", e)
-                down_for = now - self._down_since
-                if (not self._down_fired
-                        and down_for >= CONFIG.gcs_external_store_down_after_s):
-                    self._down_fired = True
+                    # check-then-set under the cv, same as _append's
+                    # divert path: torn against it, a concurrent writer
+                    # could re-arm _down_since mid-outage or the detector
+                    # could fire twice for one outage
+                    if self._down_since is None:
+                        self._down_since = now
+                        logger.warning(
+                            "external GCS store unreachable: %s", e)
+                    down_for = now - self._down_since
+                    if (not self._down_fired and down_for
+                            >= CONFIG.gcs_external_store_down_after_s):
+                        self._down_fired = True
+                        fire = True
+                if fire:
+                    # callback OUTSIDE the cv: it is user code and may
+                    # block or call back into the store
                     logger.critical(
                         "external GCS store down for %.0fs — failure "
                         "detector fired (reference: "

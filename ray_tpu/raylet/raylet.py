@@ -192,6 +192,12 @@ class Raylet:
         # Serializes _spill_until across the watermark loop and per-worker
         # spill_objects RPCs (both run via asyncio.to_thread).
         self._spill_lock = threading.Lock()
+        # Guards the _spilled/_spilled_sizes PAIR: _spill_until writes
+        # them from to_thread executor threads while restore/free mutate
+        # them on the raylet loop. Held only around the dict ops, never
+        # across backend IO (unlike _spill_lock), so the loop may take it.
+        # Order when nested: _spill_lock, then _spill_maps_lock.
+        self._spill_maps_lock = threading.Lock()
         # Recently-rejected infeasible demand shapes -> last-seen time;
         # reported to the GCS while fresh so the autoscaler sees them.
         self._infeasible: Dict[tuple, float] = {}
@@ -269,6 +275,10 @@ class Raylet:
             is_head=self.is_head,
         )
         self._gcs.call("register_node", {"info": info})
+        # raylint: disable=cross-domain-mutation — startup ordering: this
+        # write precedes the NODE subscribe below and _start_tasks, so no
+        # handler or heartbeat mutation can exist yet; every later
+        # _cluster_view mutation is loop-confined
         self._cluster_view[self.node_id] = (dict(self.total), dict(self.available))
         self._cluster_addrs: Dict[NodeID, str] = {self.node_id: self.address}
         self._view_version = 0  # delta-heartbeat cursor (see _apply_view_reply)
@@ -649,8 +659,9 @@ class Raylet:
                         uri = self._spill_backend.put(key.hex(), view)
                     finally:
                         c.release(key)
-                    self._spilled[key] = uri
-                    self._spilled_sizes[key] = len(view)
+                    with self._spill_maps_lock:
+                        self._spilled[key] = uri
+                        self._spilled_sizes[key] = len(view)
                     self._elog.emit("object.spill", object_id=key.hex(),
                                     node_id=self.node_id.hex(), uri=uri)
                     if self._spill_backend.is_remote:
@@ -783,9 +794,10 @@ class Raylet:
 
         ok = await asyncio.to_thread(_restore)
         if ok:
-            self._spilled[key] = uri  # cache for the next restore/free
-            self._spilled_sizes.setdefault(
-                key, self._store_client.size_of(key) or 0)
+            size = self._store_client.size_of(key) or 0
+            with self._spill_maps_lock:
+                self._spilled[key] = uri  # cache for the next restore/free
+                self._spilled_sizes.setdefault(key, size)
             self._elog.emit("object.restore", object_id=key.hex(),
                             node_id=self.node_id.hex(), uri=uri)
         return ok
@@ -806,12 +818,13 @@ class Raylet:
         from ray_tpu.raylet.external_storage import SPILL_KV_NAMESPACE
 
         to_delete = []
-        for oid in payload["object_ids"]:
-            key = _pad_id(oid.binary())
-            uri = self._spilled.pop(key, None)
-            self._spilled_sizes.pop(key, None)
-            if uri is not None:
-                to_delete.append((key, uri))
+        with self._spill_maps_lock:
+            for oid in payload["object_ids"]:
+                key = _pad_id(oid.binary())
+                uri = self._spilled.pop(key, None)
+                self._spilled_sizes.pop(key, None)
+                if uri is not None:
+                    to_delete.append((key, uri))
         if not to_delete:
             return True
         if self._spill_backend is not None and self._spill_backend.is_remote:
@@ -1563,10 +1576,14 @@ class Raylet:
                 store = None
         with self._spill_uri_lock:
             pending = len(self._pending_spill_uris)
-        spill = {"objects": len(self._spilled),
-                 "bytes": sum(self._spilled_sizes.values()),
-                 "pending_uris": pending,
-                 "spilled_keys": [k.hex() for k in self._spilled]}
+        # under the maps lock: iterating .values()/keys while a to_thread
+        # spill batch mutates the dicts raises "changed size during
+        # iteration" on the loop
+        with self._spill_maps_lock:
+            spill = {"objects": len(self._spilled),
+                     "bytes": sum(self._spilled_sizes.values()),
+                     "pending_uris": pending,
+                     "spilled_keys": [k.hex() for k in self._spilled]}
         return {"node_id": self.node_id, "store": store, "spill": spill}
 
     async def handle_raylet_ping(self, payload):

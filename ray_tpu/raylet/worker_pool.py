@@ -314,6 +314,12 @@ class WorkerPool:
                 handle.pid = msg["spawned"]
                 for key, h in list(self._workers.items()):
                     if h is handle and key != handle.pid:
+                        # raylint: disable=cross-domain-mutation —
+                        # loop-confined: every _workers mutation runs on
+                        # the raylet loop (reader/monitor/finish
+                        # coroutines, register_* from raylet handlers);
+                        # shutdown() on the driver thread only snapshots
+                        # values and terminates processes
                         del self._workers[key]
                         break
                 self._workers[handle.pid] = handle
@@ -327,6 +333,10 @@ class WorkerPool:
                     handle.proc.returncode = msg.get("status", -1)
         # zygote gone: drop pending forks so their waiters respawn direct
         if self._zygote is z:
+            # raylint: disable=cross-domain-mutation — benign converging
+            # check-then-set: the only other writer is shutdown() (driver
+            # thread), and both racers write None; terminate() on an
+            # already-dead zygote is caught there
             self._zygote = None
             for h in self._workers.values():
                 # Its exit reports die with it; see _ForkedProc.poll.
@@ -508,6 +518,10 @@ class WorkerPool:
         handle.state = "idle"
         self._emit_state(handle)
         handle.idle_since = time.monotonic()
+        # raylint: disable=cross-domain-mutation — loop-confined:
+        # register_worker/register_driver run inside raylet RPC handlers
+        # on the raylet loop, as does the monitor coroutine's cleanup;
+        # no user-thread caller exists
         self._registered[worker_id] = handle
         self._wake_waiters(n=1, needs_accelerator=handle.needs_accelerator,
                            env_hash=handle.env_hash)

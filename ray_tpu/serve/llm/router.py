@@ -135,20 +135,29 @@ class LLMRouter:
                     return
                 continue
             self._version = update["version"]
-            with self._lock:
-                self._replicas = list(update["replicas"])
-                live = {rid for rid, _ in self._replicas}
-                metrics = update.get("metrics") or {}
-                self._base_load = {rid: metrics.get(rid, 0) for rid in live}
-                self._out_tokens = {r: self._out_tokens.get(r, 0)
-                                    for r in live}
-                self._out_requests = {r: self._out_requests.get(r, 0)
-                                      for r in live}
-                self._sessions = {
-                    sid: (rid, exp)
-                    for sid, (rid, exp) in self._sessions.items()
-                    if rid in live}
-            if update["replicas"]:
+            self._apply_update(update)
+
+    def _apply_update(self, update: dict) -> None:
+        with self._lock:
+            self._replicas = list(update["replicas"])
+            live = {rid for rid, _ in self._replicas}
+            metrics = update.get("metrics") or {}
+            self._base_load = {rid: metrics.get(rid, 0) for rid in live}
+            self._out_tokens = {r: self._out_tokens.get(r, 0)
+                                for r in live}
+            self._out_requests = {r: self._out_requests.get(r, 0)
+                                  for r in live}
+            self._sessions = {
+                sid: (rid, exp)
+                for sid, (rid, exp) in self._sessions.items()
+                if rid in live}
+            # Gate transitions under the SAME lock that _evict_replica
+            # holds, and on the post-merge self._replicas: set outside
+            # the lock raced the eviction of the last replica — the
+            # stale update re-armed the event over an empty replica set,
+            # and a FAILOVER waiter woke into an immediate typed 503
+            # instead of waiting out the controller's replacement push.
+            if self._replicas:
                 self._have_replicas.set()
             else:
                 self._have_replicas.clear()

@@ -256,6 +256,12 @@ class CoreWorker:
         )
         self.executor = Executor(self)
         self._pending_tasks: Dict[TaskID, _PendingTask] = {}
+        # serializes _pending_tasks mutations across the submitting user
+        # thread, the RPC loop (_finalize_task), and get()-path
+        # reconstruction (user or as_future daemon threads): the
+        # check-then-insert in _try_reconstruct must be atomic or two
+        # concurrent readers of a lost object both re-execute its task
+        self._pending_lock = threading.Lock()
         self._generators: Dict[TaskID, _GeneratorState] = {}
         self._key_states: Dict[tuple, _KeyState] = {}
         self._dep_waiters: Dict[ObjectID, List[_DepWait]] = {}
@@ -1162,16 +1168,21 @@ class CoreWorker:
         if spec is None:
             return False
         tid = spec.task_id
-        if tid in self._pending_tasks:
-            return True  # already re-executing
+        with self._pending_lock:
+            # atomic check-then-insert: concurrent get()s of a lost
+            # object (user thread + as_future resolver threads) race to
+            # reconstruct; exactly one may insert and re-execute
+            if tid in self._pending_tasks:
+                return True  # already re-executing
+            spec.attempt_number += 1
+            self._pending_tasks[tid] = _PendingTask(
+                spec=spec, retries_left=0,
+                arg_ids=[a.object_id for a in spec.args if not a.is_inline]
+            )
         logger.info("reconstructing %s by re-executing %s", oid.hex()[:12], spec.function_name)
         self._elog.emit("object.reconstruct", object_id=oid.hex(),
                         task_id=tid.hex(), function=spec.function_name)
         self.memory_store.delete([o for o in spec.return_ids()])
-        spec.attempt_number += 1
-        self._pending_tasks[tid] = _PendingTask(
-            spec=spec, retries_left=0, arg_ids=[a.object_id for a in spec.args if not a.is_inline]
-        )
         self._normal_submit(spec)
         return True
 
@@ -1300,10 +1311,11 @@ class CoreWorker:
                 deadline_s, self._parent_deadline()),
         )
         spec.kwarg_specs = kwarg_specs
-        self._pending_tasks[task_id] = _PendingTask(
-            spec=spec, retries_left=max_retries, arg_ids=arg_ids,
-            t_submit=t_submit,
-        )
+        with self._pending_lock:
+            self._pending_tasks[task_id] = _PendingTask(
+                spec=spec, retries_left=max_retries, arg_ids=arg_ids,
+                t_submit=t_submit,
+            )
         lineage = spec if CONFIG.enable_lineage_reconstruction else None
         self._record_task_event(spec, "PENDING")
         if streaming:
@@ -1511,6 +1523,10 @@ class CoreWorker:
         if reply.get("status") != "ready":
             return None
         info = reply["info"]
+        # raylint: disable=cross-domain-mutation — GIL-atomic dict ops on
+        # a read-through cache: remove_placement_group's pop vs this
+        # insert worst-cases a stale entry, which the bundle_locations
+        # completeness check above re-validates on every hit
         self._pg_cache[pg_id] = info
         return info
 
@@ -2031,7 +2047,8 @@ class CoreWorker:
 
     def _finalize_task(self, spec: TaskSpec, state: str,
                        stages: Optional[dict] = None):
-        pending = self._pending_tasks.pop(spec.task_id, None)
+        with self._pending_lock:
+            pending = self._pending_tasks.pop(spec.task_id, None)
         if pending is not None:
             for oid in pending.arg_ids:
                 self.reference_counter.remove_submitted_task_ref(oid)
@@ -2425,10 +2442,11 @@ class CoreWorker:
                 deadline_s, self._parent_deadline()),
         )
         spec.kwarg_specs = kwarg_specs
-        self._pending_tasks[task_id] = _PendingTask(
-            spec=spec, retries_left=rec.max_task_retries, is_actor_task=True,
-            arg_ids=arg_ids, t_submit=t_submit,
-        )
+        with self._pending_lock:
+            self._pending_tasks[task_id] = _PendingTask(
+                spec=spec, retries_left=rec.max_task_retries,
+                is_actor_task=True, arg_ids=arg_ids, t_submit=t_submit,
+            )
         # mailbox slot held from here until _finalize_task releases it
         # (incremented on the user thread, AFTER every raise-able step,
         # paired with the _pending_tasks entry the decrement keys off)
@@ -3009,6 +3027,10 @@ class CoreWorker:
                 plasma_frees.append(oid)
         self.memory_store.delete(payload["object_ids"])
         for oid in payload["object_ids"]:
+            # raylint: disable=cross-domain-mutation — GIL-atomic set
+            # add/discard with no compound invariant across the two
+            # sites: free-vs-hold of the same oid is ordered by the
+            # owner's ref protocol (free only after all refs dropped)
             self._secondary_copies.discard(oid)
         if plasma_frees:
             def _free():
@@ -3560,6 +3582,10 @@ class CoreWorker:
         # each) for the life of the bounded deque. Dict formatting happens
         # once per flush batch in _flush_task_events. `stages` rides only
         # on terminal events (the per-stage latency breakdown).
+        # raylint: disable=cross-domain-mutation — lock-free SPSC deque:
+        # deque.append/popleft are atomic, producers append here from any
+        # thread, and the flusher daemon is the ONLY consumer (popleft in
+        # _format_task_events) — the documented threading-free pattern
         self._task_events.append(
             (spec.task_id, spec.function_name, spec.task_type.name,
              spec.job_id, state, time.time(), spec.trace_parent, stages,

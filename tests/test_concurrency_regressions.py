@@ -1,0 +1,219 @@
+"""Regression tests for the real races the concurrency-domain analyzer
+(ISSUE 19, RTL010) surfaced during triage. Each test pins one fix:
+
+  * LLMRouter._have_replicas was set/cleared OUTSIDE self._lock from the
+    long-poll thread, racing _evict_replica of the last replica — a
+    stale update could re-arm the event over an empty replica set.
+  * CoreWorker._try_reconstruct did an unlocked check-then-insert on
+    _pending_tasks: concurrent get()s of the same lost object (user
+    thread + as_future resolver threads) could both submit the
+    reconstruction task and double-bump attempt_number.
+  * Raylet._spilled/_spilled_sizes were mutated as an unguarded PAIR
+    from to_thread spill batches and loop-side free/restore — torn
+    writes could leave a size without a URI (or vice versa), and the
+    node-stats sum() could see "dict changed size during iteration".
+
+The external-store failure-detector fix (single fire per outage) lives
+with its integration harness in test_external_store.py.
+"""
+
+import asyncio
+import threading
+
+import pytest
+
+
+# ------------------------------------------------------------ LLM router
+
+
+def _bare_router():
+    from ray_tpu.serve.llm.router import LLMRouter
+
+    r = LLMRouter.__new__(LLMRouter)
+    r._lock = threading.Lock()
+    r._replicas = []
+    r._base_load = {}
+    r._out_tokens = {}
+    r._out_requests = {}
+    r._sessions = {}
+    r._have_replicas = threading.Event()
+    return r
+
+
+def test_router_event_tracks_post_merge_replica_set():
+    r = _bare_router()
+    r._apply_update({"replicas": [("r1", object())], "metrics": {}})
+    assert r._have_replicas.is_set()
+    r._apply_update({"replicas": [], "metrics": {}})
+    assert not r._have_replicas.is_set()
+
+
+def test_router_evicting_last_replica_clears_event():
+    r = _bare_router()
+    r._apply_update({"replicas": [("r1", object())], "metrics": {}})
+    r._evict_replica("r1")
+    assert not r._have_replicas.is_set()
+    # the controller's replacement push re-arms it
+    r._apply_update({"replicas": [("r2", object())], "metrics": {}})
+    assert r._have_replicas.is_set()
+
+
+def test_router_event_never_armed_over_empty_set_under_contention():
+    """The race shape itself: long-poll updates and evictions interleave
+    from two threads; at every quiescent point the event must agree with
+    the replica set (the old code set the event from the update dict
+    outside the lock, so eviction of the last replica could lose)."""
+    r = _bare_router()
+    update = {"replicas": [("r1", object())], "metrics": {}}
+    stop = threading.Event()
+
+    def poller():
+        while not stop.is_set():
+            r._apply_update(update)
+
+    t = threading.Thread(target=poller, daemon=True)
+    t.start()
+    try:
+        for _ in range(300):
+            r._evict_replica("r1")
+            with r._lock:
+                # invariant holds whenever the lock is held — exactly
+                # what _choose sees before deciding to wait or route
+                assert r._have_replicas.is_set() == bool(r._replicas)
+    finally:
+        stop.set()
+        t.join(timeout=5)
+
+
+# ------------------------------------------------- CoreWorker reconstruct
+
+
+class _FakeSpec:
+    def __init__(self):
+        from ray_tpu._private.ids import TaskID
+
+        self.task_id = TaskID.from_random()
+        self.attempt_number = 0
+        self.args = []
+        self.function_name = "fake_fn"
+
+    def return_ids(self):
+        return []
+
+
+def test_try_reconstruct_submits_exactly_once_under_contention(monkeypatch):
+    from ray_tpu._private.config import CONFIG
+    from ray_tpu._private.ids import ObjectID
+    from ray_tpu.worker.core_worker import CoreWorker
+
+    monkeypatch.setattr(CONFIG, "enable_lineage_reconstruction", True,
+                        raising=False)
+    spec = _FakeSpec()
+
+    cw = CoreWorker.__new__(CoreWorker)
+    cw._pending_tasks = {}
+    cw._pending_lock = threading.Lock()
+    cw.reference_counter = type("RC", (), {
+        "get_lineage": staticmethod(lambda oid: spec)})()
+    cw.memory_store = type("MS", (), {
+        "delete": staticmethod(lambda oids: None)})()
+    cw._elog = type("EL", (), {
+        "emit": staticmethod(lambda *a, **k: None)})()
+    submits = []
+    cw._normal_submit = submits.append
+
+    oid = ObjectID.from_random()
+    n = 8
+    barrier = threading.Barrier(n)
+    results = []
+
+    def racer():
+        barrier.wait()
+        results.append(cw._try_reconstruct(oid))
+
+    threads = [threading.Thread(target=racer) for _ in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+
+    # every caller sees "being handled", but exactly one re-executes
+    assert results == [True] * n
+    assert len(submits) == 1
+    assert spec.attempt_number == 1
+    assert list(cw._pending_tasks) == [spec.task_id]
+
+
+# --------------------------------------------------- Raylet spill maps
+
+
+class _Oid:
+    def __init__(self, b: bytes):
+        self._b = b
+
+    def binary(self) -> bytes:
+        return self._b
+
+
+def test_spill_maps_stay_a_consistent_pair_under_concurrent_free():
+    """handle_free_spilled (loop side) races a spill batch writing the
+    _spilled/_spilled_sizes pair from an executor thread. Under
+    _spill_maps_lock the two dicts must never disagree on their key set
+    — a URI without a size undercounts node stats, a size without a URI
+    leaks bytes forever — and the stats sum() must never observe a
+    mid-mutation dict."""
+    from ray_tpu._private.shm_store import _pad_id
+    from ray_tpu.raylet.raylet import Raylet
+
+    rl = Raylet.__new__(Raylet)
+    rl._spilled = {}
+    rl._spilled_sizes = {}
+    rl._spill_maps_lock = threading.Lock()
+    rl._spill_backend = type("B", (), {
+        "is_remote": False,
+        "delete": staticmethod(lambda uri: None)})()
+
+    stop = threading.Event()
+    errors = []
+
+    def spiller():
+        # mimics _spill_until's fixed write path: pair-write under lock
+        i = 0
+        while not stop.is_set():
+            key = _pad_id(b"obj-%06d" % (i % 64))
+            with rl._spill_maps_lock:
+                rl._spilled[key] = f"file:///spill/{i}"
+                rl._spilled_sizes[key] = 128
+            i += 1
+
+    def stats_reader():
+        # the node-stats path: iterate sizes under the lock
+        while not stop.is_set():
+            try:
+                with rl._spill_maps_lock:
+                    sum(rl._spilled_sizes.values())
+                    if set(rl._spilled) != set(rl._spilled_sizes):
+                        errors.append("pair diverged")
+                        return
+            except RuntimeError as e:  # dict changed size during iteration
+                errors.append(str(e))
+                return
+
+    workers = [threading.Thread(target=spiller, daemon=True),
+               threading.Thread(target=stats_reader, daemon=True)]
+    for t in workers:
+        t.start()
+
+    async def free_loop():
+        for i in range(200):
+            oids = [_Oid(b"obj-%06d" % ((i + j) % 64)) for j in range(8)]
+            await rl.handle_free_spilled({"object_ids": oids})
+
+    try:
+        asyncio.run(free_loop())
+    finally:
+        stop.set()
+        for t in workers:
+            t.join(timeout=5)
+    assert not errors
+    assert set(rl._spilled) == set(rl._spilled_sizes)

@@ -177,3 +177,71 @@ def test_gcs_head_disk_loss_recovers_from_external_store(tmp_path):
     finally:
         cluster.shutdown()
         xs.stop()
+
+
+def test_failure_detector_fires_exactly_once_per_outage(tmp_path,
+                                                        monkeypatch):
+    """Regression for the RTL010-surfaced race on _down_since/_down_fired:
+    the shipper daemon's check-then-set used to run unlocked against
+    _append's divert path on writer threads, so a torn interleave could
+    restart the down clock mid-outage (detector never fires) or fire the
+    callback twice for one outage. Under self._cv the detector must fire
+    EXACTLY once per outage — even with writers hammering the divert path
+    — and re-arm after a successful recovery."""
+    monkeypatch.setattr(CONFIG, "gcs_external_store_ping_interval_s", 0.1,
+                        raising=False)
+    monkeypatch.setattr(CONFIG, "gcs_external_store_down_after_s", 0.4,
+                        raising=False)
+    monkeypatch.setattr(CONFIG, "gcs_external_store_op_timeout_s", 0.5,
+                        raising=False)
+    monkeypatch.setattr(CONFIG, "gcs_external_store_inline_timeout_s", 0.5,
+                        raising=False)
+    server = ExternalStoreServer(storage_path=str(tmp_path / "once.db"))
+    addr = server.start(0)
+    fired = []
+    s = ExternalStore(addr, on_down=lambda: fired.append(time.monotonic()))
+    s.put("t", b"k", b"v")
+    assert s.flush(timeout=10)
+
+    # first outage: writers keep diverting while the shipper retries
+    server.stop()
+    stop_writing = False
+
+    def writer():
+        i = 0
+        while not stop_writing:
+            s.put("t", b"w%d" % (i % 8), b"x")
+            i += 1
+            time.sleep(0.02)
+
+    import threading as _threading
+    wt = _threading.Thread(target=writer, daemon=True)
+    wt.start()
+    try:
+        assert wait_until(lambda: fired, timeout=20), "detector never fired"
+        # stay down for several more detector periods: still one fire
+        time.sleep(CONFIG.gcs_external_store_down_after_s * 4)
+        assert len(fired) == 1, f"detector fired {len(fired)}x for 1 outage"
+    finally:
+        stop_writing = True
+        wt.join(timeout=5)
+
+    # recovery resets the latch; a SECOND outage fires again
+    port = int(addr.rsplit(":", 1)[1])
+    server2 = ExternalStoreServer(storage_path=str(tmp_path / "once2.db"))
+    deadline = time.monotonic() + 10
+    while True:
+        try:
+            server2.start(port)
+            break
+        except Exception:  # noqa: BLE001 — port in TIME_WAIT
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(0.2)
+    assert s.flush(timeout=20)
+    server2.stop()
+    s.put("t", b"again", b"x")
+    assert wait_until(lambda: len(fired) >= 2, timeout=20), \
+        "detector did not re-arm after recovery"
+    assert len(fired) == 2
+    s.close()

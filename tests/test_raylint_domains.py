@@ -1,0 +1,564 @@
+"""Concurrency-domain analyzer tests (ISSUE 19): thread-domain model
+unit tests plus the RTL010/011/012/013 regression corpus. Each fixture
+in the corpus is modeled on a race this repo actually shipped and later
+fixed by hand — PR 9's ``rec.outstanding`` user-thread/loop-thread
+``+=``/``-=`` tear, PR 11's blocking-scan-under-lock GCS stall, and the
+loop-thread scope-across-await leak rule PR 11 wrote down. The corpus
+pins the analyzer to those bug classes: every true positive must flag,
+every near-miss must stay quiet, every suppression must register."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from tools.raylint.core import LintConfig, Project, run_lint
+from tools.raylint.domains import (
+    CONSTRUCTION,
+    EVENT_LOOP,
+    EXECUTOR,
+    USER,
+    DomainModel,
+)
+
+pytestmark = pytest.mark.lint
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write(tmp_path, relpath: str, source: str) -> None:
+    path = tmp_path / relpath
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+
+
+def _lint(tmp_path, paths, options=None, select=None):
+    config = LintConfig(options=options or {}, reference_paths=[])
+    return run_lint(str(tmp_path), paths, config=config, select=select)
+
+
+def _model(tmp_path, options=None) -> DomainModel:
+    config = LintConfig(options=options or {}, reference_paths=[])
+    project = Project.build(str(tmp_path), ["ray_tpu"], config=config)
+    return DomainModel(project, (options or {}).get("domains"))
+
+
+def _ids(diags):
+    return sorted({d.check_id for d in diags})
+
+
+# ------------------------------------------------------- domain model
+
+
+def test_async_defs_are_event_loop(tmp_path):
+    _write(tmp_path, "ray_tpu/svc.py", """
+        class Svc:
+            async def handle_ping(self, payload):
+                return True
+    """)
+    m = _model(tmp_path)
+    assert m.domains_of("ray_tpu/svc.py", "Svc", "handle_ping") == \
+        {EVENT_LOOP}
+
+
+def test_daemon_thread_inference_and_propagation(tmp_path):
+    # Thread(target=self._loop, name="my-flusher") seeds daemon:my-flusher
+    # on the target AND on the private helpers it calls
+    _write(tmp_path, "ray_tpu/flush.py", """
+        import threading
+
+        class Flusher:
+            def start(self):
+                threading.Thread(target=self._loop, daemon=True,
+                                 name="my-flusher").start()
+
+            def _loop(self):
+                while True:
+                    self._drain()
+
+            def _drain(self):
+                pass
+    """)
+    m = _model(tmp_path)
+    assert m.domains_of("ray_tpu/flush.py", "Flusher", "_loop") == \
+        {"daemon:my-flusher"}
+    assert m.domains_of("ray_tpu/flush.py", "Flusher", "_drain") == \
+        {"daemon:my-flusher"}
+    # public sync entry point stays user-callable
+    assert USER in m.domains_of("ray_tpu/flush.py", "Flusher", "start")
+
+
+def test_unnamed_thread_takes_target_leaf_name(tmp_path):
+    _write(tmp_path, "ray_tpu/bg.py", """
+        import threading
+
+        def start():
+            threading.Thread(target=_pump, daemon=True).start()
+
+        def _pump():
+            pass
+    """)
+    m = _model(tmp_path)
+    assert m.domains_of("ray_tpu/bg.py", None, "_pump") == {"daemon:_pump"}
+
+
+def test_private_helper_inherits_handler_domain(tmp_path):
+    _write(tmp_path, "ray_tpu/svc.py", """
+        class Svc:
+            async def handle_get(self, payload):
+                return self._lookup(payload)
+
+            def _lookup(self, payload):
+                return None
+    """)
+    m = _model(tmp_path)
+    assert m.domains_of("ray_tpu/svc.py", "Svc", "_lookup") == {EVENT_LOOP}
+
+
+def test_construction_only_helper_is_construction_domain(tmp_path):
+    _write(tmp_path, "ray_tpu/svc.py", """
+        class Svc:
+            def __init__(self):
+                self._load()
+
+            def _load(self):
+                self._table = {}
+    """)
+    m = _model(tmp_path)
+    assert m.domains_of("ray_tpu/svc.py", "Svc", "_load") == {CONSTRUCTION}
+
+
+def test_run_in_executor_target_is_executor_domain(tmp_path):
+    _write(tmp_path, "ray_tpu/svc.py", """
+        class Svc:
+            async def handle_scan(self, payload):
+                return await self._loop.run_in_executor(None, self._scan)
+
+            def _scan(self):
+                return 1
+    """)
+    m = _model(tmp_path)
+    assert EXECUTOR in m.domains_of("ray_tpu/svc.py", "Svc", "_scan")
+
+
+def test_call_soon_threadsafe_target_is_event_loop(tmp_path):
+    # the loop-dispatch primitives schedule their callback ON the loop:
+    # without this seed a sync callback with no static caller would
+    # default to user and every loop-internal mutation would false-flag
+    _write(tmp_path, "ray_tpu/svc.py", """
+        class Svc:
+            def start(self):
+                def _arm():
+                    self._tasks = []
+                self._loop.call_soon_threadsafe(_arm)
+    """)
+    m = _model(tmp_path)
+    assert m.domains_of("ray_tpu/svc.py", "Svc", "_arm") == {EVENT_LOOP}
+
+
+def test_loop_entry_points_config_seeds_event_loop(tmp_path):
+    _write(tmp_path, "ray_tpu/svc.py", """
+        class Svc:
+            def _on_death(self, handle):
+                self._peers = {}
+    """)
+    m = _model(tmp_path, options={"domains": {
+        "loop-entry-points": ["ray_tpu/svc.py:Svc._on_death"]}})
+    assert m.domains_of("ray_tpu/svc.py", "Svc", "_on_death") == \
+        {EVENT_LOOP}
+
+
+def test_entry_locks_locked_helper_pattern(tmp_path):
+    # GcsSpanManager._promote_locked: every static caller provably holds
+    # self._lock at the call, so the helper's mutations count as guarded
+    _write(tmp_path, "ray_tpu/spans.py", """
+        class Mgr:
+            def add(self, item):
+                with self._lock:
+                    self._promote_locked(item)
+
+            async def handle_add(self, payload):
+                with self._lock:
+                    self._promote_locked(payload)
+
+            def _promote_locked(self, item):
+                self._ring[item.key] = item
+    """)
+    m = _model(tmp_path)
+    locks = m.entry_locks_of("ray_tpu/spans.py", "Mgr", "_promote_locked")
+    assert locks == {"ray_tpu.spans:Mgr._lock"}
+    # ...and the public entry points themselves get none
+    assert m.entry_locks_of("ray_tpu/spans.py", "Mgr", "add") == frozenset()
+
+
+# --------------------------------------------------- RTL010 cross-domain
+
+# PR 9's race, reduced: sync submit (user thread) increments, the async
+# reply handler (loop thread) decrements; += is LOAD/ADD/STORE with a
+# suspension point between each, so counts tear under load
+_PR9_OUTSTANDING = """
+    class Mailbox:
+        def submit(self, spec):
+            self._outstanding += 1
+
+        async def handle_reply(self, payload):
+            self._outstanding -= 1
+"""
+
+
+def test_cross_domain_pr9_outstanding_race_flagged(tmp_path):
+    _write(tmp_path, "ray_tpu/mailbox.py", _PR9_OUTSTANDING)
+    diags = _lint(tmp_path, ["ray_tpu"], select=["cross-domain-mutation"])
+    assert _ids(diags) == ["RTL010"]
+    assert "_outstanding" in diags[0].message
+    assert "event-loop" in diags[0].message and "user" in diags[0].message
+
+
+def test_cross_domain_common_lock_negative(tmp_path):
+    _write(tmp_path, "ray_tpu/mailbox.py", """
+        class Mailbox:
+            def submit(self, spec):
+                with self._lock:
+                    self._outstanding += 1
+
+            async def handle_reply(self, payload):
+                with self._lock:
+                    self._outstanding -= 1
+    """)
+    assert _lint(tmp_path, ["ray_tpu"],
+                 select=["cross-domain-mutation"]) == []
+
+
+def test_cross_domain_locked_helper_negative(tmp_path):
+    # the *_locked-helper form of the same guard: the helper holds no
+    # lock itself, but every caller provably does (entry_locks)
+    _write(tmp_path, "ray_tpu/mailbox.py", """
+        class Mailbox:
+            def submit(self, spec):
+                with self._lock:
+                    self._bump_locked(1)
+
+            async def handle_reply(self, payload):
+                with self._lock:
+                    self._bump_locked(-1)
+
+            def _bump_locked(self, delta):
+                self._outstanding += delta
+    """)
+    assert _lint(tmp_path, ["ray_tpu"],
+                 select=["cross-domain-mutation"]) == []
+
+
+def test_cross_domain_single_domain_negative(tmp_path):
+    # near-miss: both mutation sites live on the SAME loop — coroutines
+    # interleave only at await, so no tear is possible
+    _write(tmp_path, "ray_tpu/mailbox.py", """
+        class Mailbox:
+            async def handle_submit(self, payload):
+                self._outstanding += 1
+
+            async def handle_reply(self, payload):
+                self._outstanding -= 1
+    """)
+    assert _lint(tmp_path, ["ray_tpu"],
+                 select=["cross-domain-mutation"]) == []
+
+
+def test_cross_domain_construction_site_negative(tmp_path):
+    # near-miss: the only sync mutation happens during __init__, which
+    # happens-before the object reaches any other thread
+    _write(tmp_path, "ray_tpu/mailbox.py", """
+        class Mailbox:
+            def __init__(self):
+                self._seed()
+
+            def _seed(self):
+                self._table["boot"] = 1
+
+            async def handle_put(self, payload):
+                self._table[payload.key] = payload.value
+    """)
+    assert _lint(tmp_path, ["ray_tpu"],
+                 select=["cross-domain-mutation"]) == []
+
+
+def test_cross_domain_daemon_vs_user_flagged(tmp_path):
+    _write(tmp_path, "ray_tpu/ship.py", """
+        import threading
+
+        class Shipper:
+            def start(self):
+                threading.Thread(target=self._loop, daemon=True,
+                                 name="shipper").start()
+
+            def _loop(self):
+                if self._down is None:
+                    self._down = 1.0
+
+            def append(self, rec):
+                if self._down is None:
+                    self._down = 2.0
+    """)
+    diags = _lint(tmp_path, ["ray_tpu"], select=["cross-domain-mutation"])
+    assert _ids(diags) == ["RTL010"]
+    assert "daemon:shipper" in diags[0].message
+
+
+def test_cross_domain_suppressed(tmp_path):
+    _write(tmp_path, "ray_tpu/mailbox.py", """
+        class Mailbox:
+            def submit(self, spec):
+                # raylint: disable=cross-domain-mutation — stats gauge,
+                # torn read acceptable
+                self._outstanding += 1
+
+            async def handle_reply(self, payload):
+                self._outstanding -= 1
+    """)
+    assert _lint(tmp_path, ["ray_tpu"],
+                 select=["cross-domain-mutation"]) == []
+
+
+# ----------------------------------------------- RTL011 scope-across-await
+
+
+def test_scope_across_await_flagged(tmp_path):
+    # the PR 11 leak class: a thread-local ambient scope entered on the
+    # loop thread and held across a suspension bleeds into whatever
+    # coroutine the loop runs next
+    _write(tmp_path, "ray_tpu/proxy.py", """
+        from ray_tpu._private.tracing import trace_scope
+
+        class Proxy:
+            async def handle_request(self, payload):
+                with trace_scope(payload.trace_id):
+                    return await self._route(payload)
+    """)
+    diags = _lint(tmp_path, ["ray_tpu"], select=["scope-across-await"])
+    assert _ids(diags) == ["RTL011"]
+    assert "trace_scope" in diags[0].message
+
+
+def test_scope_without_await_negative(tmp_path):
+    # near-miss: the scope wraps only the SYNCHRONOUS submission window,
+    # exactly how serve/_private/proxy.py complies with the rule
+    _write(tmp_path, "ray_tpu/proxy.py", """
+        from ray_tpu._private.tracing import trace_scope
+
+        class Proxy:
+            async def handle_request(self, payload):
+                with trace_scope(payload.trace_id):
+                    fut = self._submit(payload)
+                return await fut
+    """)
+    assert _lint(tmp_path, ["ray_tpu"], select=["scope-across-await"]) == []
+
+
+def test_scope_in_sync_function_negative(tmp_path):
+    _write(tmp_path, "ray_tpu/driver.py", """
+        from ray_tpu._private.tracing import trace_scope
+
+        def run(payload):
+            with trace_scope(payload.trace_id):
+                return submit(payload)
+    """)
+    assert _lint(tmp_path, ["ray_tpu"], select=["scope-across-await"]) == []
+
+
+def test_scope_across_await_suppressed(tmp_path):
+    _write(tmp_path, "ray_tpu/proxy.py", """
+        from ray_tpu._private.tracing import trace_scope
+
+        class Proxy:
+            async def handle_request(self, payload):
+                # raylint: disable=scope-across-await — single-task loop:
+                # this loop never interleaves another coroutine
+                with trace_scope(payload.trace_id):
+                    return await self._route(payload)
+    """)
+    assert _lint(tmp_path, ["ray_tpu"], select=["scope-across-await"]) == []
+
+
+# ------------------------------------------------ RTL012 lock-across-await
+
+
+def test_lock_across_await_flagged(tmp_path):
+    _write(tmp_path, "ray_tpu/spans.py", """
+        class Mgr:
+            async def handle_get(self, payload):
+                with self._lock:
+                    return await self._fetch(payload)
+    """)
+    diags = _lint(tmp_path, ["ray_tpu"], select=["lock-across-await"])
+    assert _ids(diags) == ["RTL012"]
+    assert "_lock" in diags[0].message
+
+
+def test_lock_across_blocking_call_in_loop_helper_flagged(tmp_path):
+    # the PR 11 GcsSpanManager stall class: a sync helper reached from a
+    # handler blocks under the ingestion lock — every flusher thread
+    # cluster-wide wedges behind the scan
+    _write(tmp_path, "ray_tpu/spans.py", """
+        import time
+
+        class Mgr:
+            async def handle_get_trace(self, payload):
+                return self._scan(payload)
+
+            def _scan(self, payload):
+                with self._lock:
+                    time.sleep(0.2)
+                    return list(self._ring)
+    """)
+    diags = _lint(tmp_path, ["ray_tpu"], select=["lock-across-await"])
+    assert _ids(diags) == ["RTL012"]
+    assert "time.sleep" in diags[0].message
+
+
+def test_asyncio_lock_across_await_negative(tmp_path):
+    # `async with` means an asyncio lock — designed to span awaits
+    _write(tmp_path, "ray_tpu/spans.py", """
+        class Mgr:
+            async def handle_get(self, payload):
+                async with self._lock:
+                    return await self._fetch(payload)
+    """)
+    assert _lint(tmp_path, ["ray_tpu"], select=["lock-across-await"]) == []
+
+
+def test_lock_snapshot_then_await_negative(tmp_path):
+    # near-miss: snapshot under the lock, await OUTSIDE it — the fix
+    # shape PR 11 applied
+    _write(tmp_path, "ray_tpu/spans.py", """
+        class Mgr:
+            async def handle_get(self, payload):
+                with self._lock:
+                    snapshot = list(self._ring)
+                return await self._send(snapshot)
+    """)
+    assert _lint(tmp_path, ["ray_tpu"], select=["lock-across-await"]) == []
+
+
+def test_lock_across_await_suppressed(tmp_path):
+    _write(tmp_path, "ray_tpu/spans.py", """
+        class Mgr:
+            async def handle_get(self, payload):
+                # raylint: disable=lock-across-await — uncontended:
+                # single writer, try-lock readers
+                with self._lock:
+                    return await self._fetch(payload)
+    """)
+    assert _lint(tmp_path, ["ray_tpu"], select=["lock-across-await"]) == []
+
+
+# ----------------------------------------------- RTL013 stale-suppression
+
+
+def test_stale_suppression_flagged(tmp_path):
+    _write(tmp_path, "ray_tpu/clean.py", """
+        class Clean:
+            def tidy(self):
+                # raylint: disable=cross-domain-mutation — long gone
+                return 1
+    """)
+    diags = _lint(tmp_path, ["ray_tpu"],
+                  select=["cross-domain-mutation", "stale-suppression"])
+    assert _ids(diags) == ["RTL013"]
+    assert "stale" in diags[0].message
+
+
+def test_used_suppression_not_stale(tmp_path):
+    _write(tmp_path, "ray_tpu/mailbox.py", """
+        class Mailbox:
+            def submit(self, spec):
+                # raylint: disable=cross-domain-mutation — gauge only
+                self._outstanding += 1
+
+            async def handle_reply(self, payload):
+                self._outstanding -= 1
+    """)
+    assert _lint(tmp_path, ["ray_tpu"],
+                 select=["cross-domain-mutation",
+                         "stale-suppression"]) == []
+
+
+def test_unknown_check_name_flagged(tmp_path):
+    _write(tmp_path, "ray_tpu/clean.py", """
+        def tidy():
+            # raylint: disable=no-such-check
+            return 1
+    """)
+    diags = _lint(tmp_path, ["ray_tpu"],
+                  select=["cross-domain-mutation", "stale-suppression"])
+    assert _ids(diags) == ["RTL013"]
+    assert "unknown check" in diags[0].message
+
+
+def test_suppression_for_check_that_did_not_run_is_not_judged(tmp_path):
+    # staleness can only be judged against checks that actually looked:
+    # lock-order is real but NOT selected here, so its suppression stays
+    _write(tmp_path, "ray_tpu/clean.py", """
+        def tidy():
+            # raylint: disable=lock-order — judged only when it runs
+            return 1
+    """)
+    assert _lint(tmp_path, ["ray_tpu"],
+                 select=["cross-domain-mutation",
+                         "stale-suppression"]) == []
+
+
+def test_multiline_justification_comment_still_reaches_code(tmp_path):
+    # a justification too long for one comment line chains through the
+    # continuation comments to the first code line after the run
+    _write(tmp_path, "ray_tpu/mailbox.py", """
+        class Mailbox:
+            def submit(self, spec):
+                # raylint: disable=cross-domain-mutation — a justification
+                # that needs a second line to fully name the invariant
+                # and a third for good measure
+                self._outstanding += 1
+
+            async def handle_reply(self, payload):
+                self._outstanding -= 1
+    """)
+    assert _lint(tmp_path, ["ray_tpu"],
+                 select=["cross-domain-mutation",
+                         "stale-suppression"]) == []
+
+
+def test_suppression_in_string_literal_does_not_register(tmp_path):
+    # suppression syntax QUOTED in a string (this corpus itself!) must
+    # neither suppress nor count as stale — comments are tokenized, not
+    # regexed out of raw lines
+    _write(tmp_path, "ray_tpu/fixture.py", '''
+        SNIPPET = """
+        # raylint: disable=cross-domain-mutation — inside a string
+        """
+
+        def tidy():
+            return SNIPPET
+    ''')
+    assert _lint(tmp_path, ["ray_tpu"],
+                 select=["cross-domain-mutation",
+                         "stale-suppression"]) == []
+
+
+# ------------------------------------------------------------ CLI plumbing
+
+
+def test_json_out_writes_report_alongside_human_output(tmp_path):
+    _write(tmp_path, "ray_tpu/mailbox.py", _PR9_OUTSTANDING)
+    out = tmp_path / "report.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.raylint", "ray_tpu",
+         "--root", str(tmp_path), "--select", "cross-domain-mutation",
+         "--json-out", str(out)],
+        capture_output=True, text=True, cwd=REPO_ROOT)
+    assert proc.returncode == 1
+    assert "RTL010" in proc.stdout          # human format on stdout
+    payload = json.loads(out.read_text())   # machine format in the file
+    assert payload["count"] == 1
+    assert payload["errors"][0]["check_id"] == "RTL010"

@@ -43,7 +43,9 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 echo "== raylint =="
-python -m tools.raylint ray_tpu/ tests/
+# human format on stdout; machine-readable report for CI artifact upload
+python -m tools.raylint ray_tpu/ tests/ \
+    --json-out "${TMPDIR:-/tmp}/ci_raylint.json"
 
 echo "== drill gate (bounded, seeded) =="
 JAX_PLATFORMS=cpu python -m ray_tpu drill run \

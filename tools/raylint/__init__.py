@@ -16,10 +16,34 @@ encodes a real ray_tpu invariant:
                                    gcs/ raylet/ worker/ recovery paths
   RTL005 spec-serialization-drift  spec dataclass fields must round-trip
                                    through their wire codecs
+  RTL006 fsm-transition-event      FSM transitions must emit an event-log
+                                   record in the same function
+  RTL007 unbounded-queue           every queue in a control/data-plane
+                                   path names an explicit bound
+  RTL008 payload-copy              array-bearing paths move raw views,
+                                   never whole-payload byte copies
+  RTL009 unfenced-device-timing    wall-clock deltas around jit calls
+                                   must be fenced
+  RTL010 cross-domain-mutation     attr read-modify-writes reachable from
+                                   >=2 thread domains need a lock common
+                                   to every mutation site
+  RTL011 scope-across-await        thread-local ambient scopes must not
+                                   span an await in a coroutine
+  RTL012 lock-across-await         threading locks must not be held
+                                   across an await or a blocking call in
+                                   event-loop-domain code
+  RTL013 stale-suppression         disable comments that suppress nothing
+                                   are errors
+
+RTL010-012 run on the whole-program thread-domain model in
+tools/raylint/domains.py (event-loop / user / daemon:<name> / executor /
+construction domains, propagated through the static call graph).
 
 Run `python -m tools.raylint ray_tpu/` (or `ray-tpu lint`). Suppress a
 finding with `# raylint: disable=<check-name>` on (or directly above) the
-flagged line; config lives in raylint.toml (`[tool.raylint]` table).
+flagged line, with a justification naming the guarding lock or
+single-domain invariant; config lives in raylint.toml
+(`[tool.raylint]` table).
 """
 
 from tools.raylint.core import (  # noqa: F401

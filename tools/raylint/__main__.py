@@ -28,6 +28,11 @@ def main(argv=None) -> int:
                    help="comma-separated check names to skip")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="machine-readable output")
+    p.add_argument("--json-out", default=None, metavar="FILE",
+                   help="also write the JSON report to FILE (human "
+                        "output stays on stdout — CI uses this so "
+                        "failures are machine-parseable without losing "
+                        "the readable log)")
     p.add_argument("--list-checks", action="store_true")
     args = p.parse_args(argv)
 
@@ -56,6 +61,9 @@ def main(argv=None) -> int:
     except ValueError as e:
         print(f"raylint: {e}", file=sys.stderr)
         return 2
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            f.write(format_json(diags) + "\n")
     if args.as_json:
         print(format_json(diags))
     else:

@@ -2,11 +2,15 @@
 
 from tools.raylint.checks import (  # noqa: F401
     blocking_in_handler,
+    cross_domain,
     fsm_event,
+    lock_across_await,
     lock_order,
     payload_copy,
     rpc_surface,
+    scope_across_await,
     spec_serialization,
+    stale_suppression,
     swallowed_error,
     unbounded_queue,
     unfenced_timing,

@@ -1,0 +1,270 @@
+"""RTL010 cross-domain-mutation.
+
+Invariant: shared mutable state names its lock and its domain. A
+``self.<attr>`` read-modify-write — ``+=``, check-then-set,
+``self.attr[k] = v``, ``.append()/.pop()/.update()`` and friends — whose
+enclosing method is reachable from TWO OR MORE execution domains (user
+thread vs component event loop vs a daemon thread; see
+tools/raylint/domains.py) is a data race unless every access site of
+that attribute is guarded by one common lock.
+
+This is the static gate for the bug class three of the last six PRs
+fixed by hand: PR 9's ``rec.outstanding`` user-thread/loop-thread
+``+=``/``-=`` tear, and PR 14's two borrower-protocol races. The GIL
+makes single bytecodes atomic; it does not make ``+=`` (LOAD, ADD,
+STORE — a suspension point between each) or check-then-set atomic.
+
+Per (class, attribute), the check collects every mutation site with the
+locks held there (including locks every static caller provably holds —
+the ``*_locked`` helper pattern), unions the domains over the sites,
+and flags when >=2 domains share the attribute with no common lock.
+One diagnostic per attribute, anchored at the first unguarded
+read-modify-write, naming the domains and the other sites.
+
+Suppress a deliberate single-writer or GIL-atomic design with
+``# raylint: disable=cross-domain-mutation`` naming the invariant that
+makes it safe (e.g. "single-domain: only the flusher thread writes
+after __init__", or "torn read acceptable: stats gauge").
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.raylint.core import (
+    Check,
+    Diagnostic,
+    Module,
+    Project,
+    dotted_name,
+    register_check,
+)
+from tools.raylint.domains import (
+    CONSTRUCTION,
+    get_domain_model,
+    lock_node,
+)
+
+DEFAULT_SCOPE_PATHS = ["ray_tpu/"]
+
+# container mutators that REWRITE self.attr in place; reads like dict.get
+# or plain iteration are deliberately absent (flagging reads would bury
+# the writes), and so is list.count-style pure inspection
+MUTATOR_METHODS = {
+    "append", "appendleft", "extend", "extendleft", "insert",
+    "pop", "popleft", "popitem", "remove", "discard", "clear",
+    "update", "setdefault", "add", "sort", "reverse",
+    "put", "put_nowait",
+}
+
+# methods whose body runs before the object is published (or after it is
+# torn down) — single-threaded by construction
+_UNPUBLISHED = {"__init__", "__new__", "__post_init__", "__del__"}
+
+
+class _Site:
+    __slots__ = ("func_key", "kind", "lineno", "col", "locks", "is_rmw")
+
+    def __init__(self, func_key, kind, lineno, col, locks, is_rmw):
+        self.func_key = func_key
+        self.kind = kind
+        self.lineno = lineno
+        self.col = col
+        self.locks = locks          # FrozenSet[str], incl. entry locks
+        self.is_rmw = is_rmw
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """`self.a` -> "a"; `self.a.b` -> "a.b"; None otherwise."""
+    name = dotted_name(node)
+    if name is None or not name.startswith("self.") or name == "self":
+        return None
+    return name[len("self."):]
+
+
+def _attrs_read(expr: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(expr):
+        attr = _self_attr(node)
+        if attr is not None:
+            out.add(attr)
+    return out
+
+
+@register_check
+class CrossDomainMutationCheck(Check):
+    name = "cross-domain-mutation"
+    check_id = "RTL010"
+    description = ("self.<attr> read-modify-write reachable from >=2 "
+                   "thread domains (user/event-loop/daemon) with no "
+                   "common lock over all of the attribute's mutation "
+                   "sites — a data race")
+
+    def __init__(self, options: dict):
+        super().__init__(options)
+        self.scope_paths = tuple(options.get(
+            "scope-paths", DEFAULT_SCOPE_PATHS))
+        self.exclude_attrs = set(options.get("exclude-attrs", []))
+        self.mutators = set(options.get(
+            "mutator-methods", sorted(MUTATOR_METHODS)))
+
+    # --------------------------------------------------------- site scan
+    def _scan_method(self, model, mod: Module, cls: str,
+                     fn: ast.AST) -> List[Tuple[str, _Site]]:
+        """Every self-attr mutation in one method body (nested defs are
+        scanned as their own functions), with the lock stack held at
+        each site."""
+        out: List[Tuple[str, _Site]] = []
+        fi = model.info(mod.relpath, cls, fn.name)
+        entry = fi.entry_locks if fi is not None else frozenset()
+        key = (mod.relpath, cls, fn.name)
+
+        def add(attr: Optional[str], kind: str, node: ast.AST,
+                held: Tuple[str, ...], is_rmw: bool) -> None:
+            if attr is None:
+                return
+            leaf = attr.rsplit(".", 1)[-1]
+            if model.lock_re.search(leaf) or attr in self.exclude_attrs:
+                return  # the lock itself is not shared *state*
+            out.append((attr, _Site(key, kind, node.lineno,
+                                    node.col_offset,
+                                    frozenset(held) | entry, is_rmw)))
+
+        def walk(node: ast.AST, held: Tuple[str, ...],
+                 cond_attrs: Set[str]) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef, ast.Lambda)):
+                return
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                new_held = held
+                for item in node.items:
+                    lk = lock_node(mod, cls, item.context_expr,
+                                   model.lock_re)
+                    if lk is not None:
+                        new_held = new_held + (lk,)
+                    else:
+                        walk(item.context_expr, held, cond_attrs)
+                for stmt in node.body:
+                    walk(stmt, new_held, cond_attrs)
+                return
+            if isinstance(node, (ast.If, ast.While)):
+                walk(node.test, held, cond_attrs)
+                inner = cond_attrs | _attrs_read(node.test)
+                for stmt in node.body:
+                    walk(stmt, held, inner)
+                for stmt in node.orelse:
+                    walk(stmt, held, inner)
+                return
+            if isinstance(node, ast.AugAssign):
+                attr = _self_attr(node.target)
+                if attr is not None:
+                    add(attr, f"augmented assignment at line "
+                              f"{node.lineno}", node, held, True)
+                elif isinstance(node.target, ast.Subscript):
+                    add(_self_attr(node.target.value),
+                        f"item aug-assignment at line {node.lineno}",
+                        node, held, True)
+                walk(node.value, held, cond_attrs)
+                return
+            if isinstance(node, ast.Assign):
+                rhs_reads = _attrs_read(node.value)
+                for tgt in node.targets:
+                    attr = _self_attr(tgt)
+                    if attr is not None:
+                        # plain blind writes are last-write-wins, not
+                        # RMW; only check-then-set / self-referencing
+                        # assignments race structurally
+                        if attr in rhs_reads:
+                            add(attr, f"read-modify-write assignment "
+                                      f"at line {node.lineno}",
+                                node, held, True)
+                        elif attr in cond_attrs:
+                            add(attr, f"check-then-set at line "
+                                      f"{node.lineno}", node, held, True)
+                    elif isinstance(tgt, ast.Subscript):
+                        add(_self_attr(tgt.value),
+                            f"item assignment at line {node.lineno}",
+                            node, held, True)
+                walk(node.value, held, cond_attrs)
+                return
+            if isinstance(node, ast.Delete):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Subscript):
+                        add(_self_attr(tgt.value),
+                            f"item delete at line {node.lineno}",
+                            node, held, True)
+                return
+            if isinstance(node, ast.Call):
+                target = dotted_name(node.func)
+                if target is not None and target.startswith("self.") \
+                        and "." in target[len("self."):]:
+                    attr, meth = target[len("self."):].rsplit(".", 1)
+                    if meth in self.mutators:
+                        add(attr, f".{meth}() at line {node.lineno}",
+                            node, held, True)
+            for child in ast.iter_child_nodes(node):
+                walk(child, held, cond_attrs)
+
+        for stmt in fn.body:
+            walk(stmt, (), set())
+        return out
+
+    # ----------------------------------------------------------------- run
+    def run(self, project: Project) -> Iterable[Diagnostic]:
+        model = get_domain_model(
+            project, project.config.check_options("domains"))
+        for mod in project.target_modules():
+            if not any(mod.relpath.startswith(p)
+                       for p in self.scope_paths):
+                continue
+            yield from self._run_module(model, mod)
+
+    def _run_module(self, model, mod: Module) -> Iterable[Diagnostic]:
+        by_class: Dict[str, Dict[str, List[_Site]]] = {}
+        for cls, fn in mod.functions():
+            if cls is None or fn.name in _UNPUBLISHED:
+                continue
+            for attr, site in self._scan_method(model, mod, cls, fn):
+                by_class.setdefault(cls, {}).setdefault(
+                    attr, []).append(site)
+
+        for cls in sorted(by_class):
+            for attr in sorted(by_class[cls]):
+                yield from self._judge(model, mod, cls, attr,
+                                       by_class[cls][attr])
+
+    def _judge(self, model, mod: Module, cls: str, attr: str,
+               sites: List[_Site]) -> Iterable[Diagnostic]:
+        # construction happens-before publication: sites only reachable
+        # during __init__ can neither race nor need the lock
+        sites = [s for s in sites
+                 if model.domains_of(*s.func_key) != {CONSTRUCTION}]
+        if not sites:
+            return
+        domains: Set[str] = set()
+        for s in sites:
+            domains |= model.domains_of(*s.func_key)
+        domains.discard(CONSTRUCTION)
+        if len(domains) < 2:
+            return
+        common = frozenset.intersection(*[s.locks for s in sites])
+        if common:
+            return
+        anchor = next((s for s in sites if s.is_rmw and not s.locks),
+                      next((s for s in sites if s.is_rmw), sites[0]))
+        others = sorted({f"{s.func_key[2]}():{s.lineno}"
+                         for s in sites if s is not anchor})
+        where = f"; other sites: {', '.join(others)}" if others else ""
+        unlocked = sorted({f"{s.func_key[2]}():{s.lineno}"
+                           for s in sites if not s.locks})
+        yield Diagnostic(
+            self.check_id, self.name, mod.relpath,
+            anchor.lineno, anchor.col,
+            f"self.{attr} of {cls} is mutated ({anchor.kind}) and "
+            f"reachable from domains {{{', '.join(sorted(domains))}}} "
+            f"with no common lock across its "
+            f"{len(sites)} mutation site(s) "
+            f"(unguarded: {', '.join(unlocked) or 'none'}){where} — "
+            "guard every site with one lock, or suppress naming the "
+            "single-domain invariant")

@@ -1,0 +1,178 @@
+"""RTL012 lock-across-await.
+
+Invariant: event-loop code never holds a threading lock across a
+suspension point or a blocking call. Two shapes:
+
+* **lock across await** — a sync ``with self._lock:`` in a coroutine
+  whose body awaits. While the coroutine is suspended the lock stays
+  held; every *thread* contending for it (a span flusher, a daemon
+  drainer, the user thread) blocks for the full suspension, and a
+  second task on the same loop that takes the same lock deadlocks the
+  loop outright. (``async with`` an asyncio lock is the legal spelling
+  and is not flagged.)
+* **blocking call under a lock on the loop** — a function whose domain
+  includes the event loop (a handler, or a sync helper handlers reach)
+  that makes a blocking call while holding a lock. This is the
+  GcsSpanManager stall class PR 11 fixed: an O(store) scan/RPC under
+  the ingestion lock on the gcs-io loop stalled every span flusher
+  cluster-wide. RTL001 flags blocking calls in handlers at all; this
+  check names the aggravating lock (the stall fans out to every thread
+  sharing it) and — being domain-propagated — also catches sync
+  helpers RTL001's one-level graph cannot see.
+
+Fix by snapshotting under the lock and awaiting/working outside it, or
+switch to an ``asyncio.Lock``. Suppress with
+``# raylint: disable=lock-across-await`` naming why the hold is
+bounded (e.g. "lock is uncontended: single writer, try-lock readers").
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional, Tuple
+
+from tools.raylint.core import (
+    Check,
+    Diagnostic,
+    Module,
+    Project,
+    dotted_name,
+    register_check,
+)
+from tools.raylint.checks.scope_across_await import first_suspension
+from tools.raylint.domains import (
+    EVENT_LOOP,
+    get_domain_model,
+    lock_node,
+)
+
+DEFAULT_SCOPE_PATHS = ["ray_tpu/"]
+# call suffixes that block the thread (the RTL001 list, minus the
+# receiver-independent method names it handles separately)
+DEFAULT_BLOCKING_CALLS = [
+    "time.sleep",
+    "ray_tpu.get",
+    "ray_tpu.wait",
+    "ray.get",
+    "socket.create_connection",
+    "subprocess.run",
+    "subprocess.check_output",
+    "subprocess.check_call",
+]
+DEFAULT_BLOCKING_METHODS = ["run_coro", "wait_until", "join"]
+
+
+@register_check
+class LockAcrossAwaitCheck(Check):
+    name = "lock-across-await"
+    check_id = "RTL012"
+    description = ("threading lock held across an await, or across a "
+                   "blocking call in event-loop-domain code — one "
+                   "holder stalls every thread and task contending "
+                   "for the lock")
+
+    def __init__(self, options: dict):
+        super().__init__(options)
+        self.scope_paths = tuple(options.get(
+            "scope-paths", DEFAULT_SCOPE_PATHS))
+        self.blocking_calls = list(options.get(
+            "blocking-calls", DEFAULT_BLOCKING_CALLS))
+        self.blocking_methods = set(options.get(
+            "blocking-methods", DEFAULT_BLOCKING_METHODS))
+
+    # ------------------------------------------------------ classification
+    def _blocking_call(self, node: ast.AST) -> Optional[str]:
+        if not isinstance(node, ast.Call):
+            return None
+        target = dotted_name(node.func)
+        if target is None:
+            return None
+        for known in self.blocking_calls:
+            if target == known or target.endswith("." + known):
+                return f"{known}()"
+        leaf = target.rsplit(".", 1)[-1]
+        if leaf in self.blocking_methods and "." in target:
+            return f"{leaf}()"
+        return None
+
+    def _first_blocking(self, body) -> Optional[Tuple[ast.AST, str]]:
+        stack = list(body)
+        while stack:
+            node = stack.pop(0)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef, ast.Lambda)):
+                continue
+            desc = self._blocking_call(node)
+            if desc is not None:
+                return node, desc
+            stack.extend(ast.iter_child_nodes(node))
+        return None
+
+    # ----------------------------------------------------------------- run
+    def run(self, project: Project) -> Iterable[Diagnostic]:
+        model = get_domain_model(
+            project, project.config.check_options("domains"))
+        for mod in project.target_modules():
+            if not any(mod.relpath.startswith(p)
+                       for p in self.scope_paths):
+                continue
+            yield from self._run_module(model, mod)
+
+    def _run_module(self, model, mod: Module) -> Iterable[Diagnostic]:
+        for cls, fn in mod.functions():
+            is_async = isinstance(fn, ast.AsyncFunctionDef)
+            on_loop = is_async or EVENT_LOOP in model.domains_of(
+                mod.relpath, cls, fn.name)
+            if not on_loop:
+                continue
+            qual = f"{cls + '.' if cls else ''}{fn.name}"
+            yield from self._scan(model, mod, cls, fn, qual, is_async)
+
+    def _scan(self, model, mod: Module, cls, fn, qual: str,
+              is_async: bool) -> Iterable[Diagnostic]:
+        stack = list(fn.body)
+        while stack:
+            node = stack.pop(0)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef, ast.Lambda)):
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+            # sync `with` only: `async with` means an asyncio lock,
+            # which is designed to be held across awaits
+            if not isinstance(node, ast.With):
+                continue
+            lock = None
+            for item in node.items:
+                lk = lock_node(mod, cls, item.context_expr,
+                               model.lock_re)
+                if lk is not None:
+                    lock = lk
+                    break
+            if lock is None:
+                continue
+            if is_async:
+                susp = first_suspension(node.body)
+                if susp is not None:
+                    yield Diagnostic(
+                        self.check_id, self.name, mod.relpath,
+                        node.lineno, node.col_offset,
+                        f"threading lock {lock} held across a "
+                        f"suspension point (line {susp.lineno}) in "
+                        f"coroutine {qual} — every thread contending "
+                        "for it stalls for the suspension, and a "
+                        "same-loop re-acquire deadlocks; snapshot "
+                        "under the lock and await outside it, or use "
+                        "an asyncio.Lock")
+                    continue
+            blocking = self._first_blocking(node.body)
+            if blocking is not None:
+                bnode, desc = blocking
+                yield Diagnostic(
+                    self.check_id, self.name, mod.relpath,
+                    bnode.lineno, bnode.col_offset,
+                    f"blocking call {desc} while holding {lock} in "
+                    f"event-loop-domain code ({qual}) — the "
+                    "GcsSpanManager stall class: every flusher thread "
+                    "and loop task contending for the lock wedges "
+                    "behind it; move the blocking work outside the "
+                    "lock")

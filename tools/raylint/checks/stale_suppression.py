@@ -1,0 +1,105 @@
+"""RTL013 stale-suppression.
+
+Invariant: a suppression must not outlive the code it excused. A
+``# raylint: disable=<name>`` on a line where no enabled check reports
+anything is dead weight — usually the flagged code was refactored away
+and the comment survived, silently pre-authorizing whatever lands on
+that line next. Dead suppressions therefore ERROR:
+
+* a line (or file-level) suppression naming a check that ran this run
+  but suppressed nothing there -> stale, delete it;
+* a suppression naming a check raylint does not know at all -> typo or
+  a removed check, either way it guards nothing.
+
+Names for checks that were NOT run (a ``--select`` subset, a config
+``disable``) are left alone — staleness can only be judged against
+checks that actually looked. The check runs after every other enabled
+check, over the usage marks the suppression table collected.
+
+Suppressing this check itself (``disable=stale-suppression``) is
+possible but almost always wrong — delete the dead comment instead.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, Optional, Set
+
+from tools.raylint.core import (
+    Check,
+    Diagnostic,
+    Project,
+    register_check,
+)
+
+
+@register_check
+class StaleSuppressionCheck(Check):
+    name = "stale-suppression"
+    check_id = "RTL013"
+    description = ("`# raylint: disable=X` that suppresses nothing (or "
+                   "names an unknown check) — dead suppressions must "
+                   "not outlive the code they excused")
+
+    def __init__(self, options: dict):
+        super().__init__(options)
+        self._ran_names: Optional[Set[str]] = None
+        self._registry: Dict[str, type] = {}
+
+    def bind(self, ran_names: Set[str], registry: Dict[str, type]):
+        """The driver hands over which checks actually ran (staleness
+        is judged only against those) and the full registry (for
+        name<->id aliasing)."""
+        self._ran_names = ran_names
+        self._registry = registry
+
+    def run(self, project: Project) -> Iterable[Diagnostic]:
+        if self._ran_names is None:
+            return  # not driven by run_lint: nothing to judge against
+        id_to_name = {cls.check_id: n
+                      for n, cls in self._registry.items()}
+
+        def resolve(token: str) -> Optional[str]:
+            if token in self._registry:
+                return token
+            return id_to_name.get(token)
+
+        def alias(token: str) -> str:
+            cls = self._registry.get(token)
+            if cls is not None:
+                return cls.check_id
+            n = id_to_name.get(token)
+            return n if n is not None else token
+
+        for mod in project.target_modules():
+            for entry in mod.supp_entries:
+                for token in sorted(entry.names):
+                    if token in ("all", self.name, self.check_id):
+                        continue
+                    cname = resolve(token)
+                    if cname is None:
+                        yield Diagnostic(
+                            self.check_id, self.name, mod.relpath,
+                            entry.line, 0,
+                            f"suppression names unknown check "
+                            f"'{token}' — typo, or the check was "
+                            "removed; either way it guards nothing")
+                        continue
+                    if cname not in self._ran_names:
+                        continue  # not judged: the check didn't look
+                    used = (token in entry.used
+                            or alias(token) in entry.used
+                            or (entry.file_level
+                                and (mod.file_suppression_used(token)
+                                     or mod.file_suppression_used(
+                                         alias(token)))))
+                    if used:
+                        continue
+                    kind = ("file-level suppression"
+                            if entry.file_level else "suppression")
+                    yield Diagnostic(
+                        self.check_id, self.name, mod.relpath,
+                        entry.line, 0,
+                        f"stale {kind}: '{token}' suppressed nothing "
+                        "this run — the code it excused is gone; "
+                        "delete the comment so it cannot pre-authorize "
+                        "the next thing on this line")

@@ -8,11 +8,13 @@ from __future__ import annotations
 
 import ast
 import fnmatch
+import io
 import json
 import os
 import re
+import tokenize
 from dataclasses import dataclass, field
-from typing import Dict, Iterable, List, Optional, Set, Type
+from typing import Dict, Iterable, List, Optional, Set, Tuple, Type
 
 try:  # 3.11+
     import tomllib as _toml
@@ -54,6 +56,20 @@ class Diagnostic:
         }
 
 
+class SuppressionEntry:
+    """One `# raylint: disable=...` comment: where it is, what it
+    names, and which of those names actually suppressed a diagnostic
+    this run (the staleness check reports the rest)."""
+
+    __slots__ = ("line", "names", "used", "file_level")
+
+    def __init__(self, line: int, names: Set[str], file_level: bool):
+        self.line = line
+        self.names = names
+        self.used: Set[str] = set()
+        self.file_level = file_level
+
+
 class Module:
     """One parsed source file: AST + per-line suppression table."""
 
@@ -65,8 +81,10 @@ class Module:
         self.lines = source.splitlines()
         self.tree = ast.parse(source, filename=path)
         self.is_target = is_target  # emit diagnostics for this file?
-        self.suppressions: Dict[int, Set[str]] = {}
+        self.supp_entries: List[SuppressionEntry] = []
+        self._supp_by_line: Dict[int, List[SuppressionEntry]] = {}
         self.file_suppressions: Set[str] = set()
+        self._file_supp_used: Set[str] = set()
         self._functions: Optional[list] = None
         self._nodes: Optional[list] = None
         self._scan_suppressions()
@@ -84,43 +102,78 @@ class Module:
             self._nodes = list(ast.walk(self.tree))
         return self._nodes
 
+    def _comments(self) -> List[Tuple[int, int, str]]:
+        """(line, col, text) of every REAL comment token. Tokenizing —
+        rather than regexing raw lines — keeps suppression syntax
+        quoted inside string literals (docstrings, lint-test fixtures)
+        from registering as live suppressions, which matters once
+        stale suppressions are an error."""
+        try:
+            toks = tokenize.generate_tokens(
+                io.StringIO(self.source).readline)
+            return [(t.start[0], t.start[1], t.string) for t in toks
+                    if t.type == tokenize.COMMENT]
+        except (tokenize.TokenError, IndentationError,
+                SyntaxError):  # pragma: no cover - ast.parse passed
+            out = []
+            for i, line in enumerate(self.lines, start=1):
+                pos = line.find("#")
+                if pos >= 0:
+                    out.append((i, pos, line[pos:]))
+            return out
+
     def _scan_suppressions(self):
-        for i, line in enumerate(self.lines, start=1):
-            m = _SUPPRESS_FILE_RE.search(line)
+        for lineno, col, text in self._comments():
+            m = _SUPPRESS_FILE_RE.search(text)
             if m:
-                self.file_suppressions |= _split_names(m.group(1))
+                names = _split_names(m.group(1))
+                self.file_suppressions |= names
+                self.supp_entries.append(
+                    SuppressionEntry(lineno, names, file_level=True))
                 continue
-            m = _SUPPRESS_RE.search(line)
+            m = _SUPPRESS_RE.search(text)
             if not m:
                 continue
-            names = _split_names(m.group(1))
-            self.suppressions.setdefault(i, set()).update(names)
-            # a comment-only line suppresses the next code line too
-            if line.split("#", 1)[0].strip() == "":
-                self.suppressions.setdefault(i + 1, set()).update(names)
+            entry = SuppressionEntry(lineno, _split_names(m.group(1)),
+                                     file_level=False)
+            self.supp_entries.append(entry)
+            applies = {lineno}
+            code = (self.lines[lineno - 1][:col].rstrip()
+                    if lineno <= len(self.lines) else "")
+            # a comment-only line suppresses the next code line; so does
+            # the trailing comment of a multi-line statement opener
+            # (e.g. `except Exception:  # raylint: disable=x`). A
+            # justification too long for one comment line may continue
+            # on further comment-only lines — chain through the run so
+            # the suppression still reaches the code it guards.
+            if code == "" or code.endswith((":", "(", ",", "\\")):
+                nxt = lineno + 1
+                applies.add(nxt)
+                while (nxt <= len(self.lines)
+                       and self.lines[nxt - 1].lstrip().startswith("#")):
+                    nxt += 1
+                    applies.add(nxt)
+            for ln in applies:
+                self._supp_by_line.setdefault(ln, []).append(entry)
 
     def is_suppressed(self, check_name: str, line: int) -> bool:
-        if check_name in self.file_suppressions or \
-                "all" in self.file_suppressions:
+        if check_name in self.file_suppressions:
+            self._file_supp_used.add(check_name)
             return True
-        for probe in (line, line - 1):
-            names = self.suppressions.get(probe)
-            if names and (check_name in names or "all" in names):
-                # line-1 only counts when that previous line is comment-only
-                # (handled at scan time by double-registration) or carries
-                # the trailing comment of a multi-line statement opener.
-                if probe == line or _is_comment_tail(self.lines, probe):
-                    return True
+        if "all" in self.file_suppressions:
+            self._file_supp_used.add("all")
+            return True
+        for entry in self._supp_by_line.get(line, ()):
+            if check_name in entry.names:
+                entry.used.add(check_name)
+                return True
+            if "all" in entry.names:
+                entry.used.add("all")
+                return True
         return False
 
-
-def _is_comment_tail(lines: List[str], lineno: int) -> bool:
-    if not (1 <= lineno <= len(lines)):
-        return False
-    code = lines[lineno - 1].split("#", 1)[0].rstrip()
-    # a trailing comment on the previous physical line of a wrapped
-    # statement (e.g. `except Exception:  # raylint: disable=x`) applies
-    return code.endswith((":", "(", ",", "\\")) or code == ""
+    def file_suppression_used(self, name: str) -> bool:
+        return name in self._file_supp_used
 
 
 def _split_names(blob: str) -> Set[str]:
@@ -318,8 +371,8 @@ def run_lint(root: str, paths: Iterable[str],
 
     project = Project.build(root, paths, config)
     diags: List[Diagnostic] = list(project.parse_errors)
-    for name in sorted(enabled):
-        check = registry[name](config.check_options(name))
+
+    def _apply(check) -> Iterable[Diagnostic]:
         for d in check.run(project):
             mod = project.module(d.path)
             if mod is not None and not mod.is_target:
@@ -329,7 +382,18 @@ def run_lint(root: str, paths: Iterable[str],
                     mod.is_suppressed(d.check_name, d.line)
                     or mod.is_suppressed(d.check_id, d.line)):
                 continue
-            diags.append(d)
+            yield d
+
+    # stale-suppression runs LAST: it judges which suppressions the
+    # other enabled checks actually consumed this run
+    main = sorted(enabled - {"stale-suppression"})
+    for name in main:
+        diags.extend(_apply(registry[name](config.check_options(name))))
+    if "stale-suppression" in enabled:
+        check = registry["stale-suppression"](
+            config.check_options("stale-suppression"))
+        check.bind(ran_names=set(main), registry=registry)
+        diags.extend(_apply(check))
     diags.sort(key=lambda d: (d.path, d.line, d.col, d.check_id))
     return diags
 

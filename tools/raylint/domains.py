@@ -1,0 +1,513 @@
+"""Whole-program thread-domain model for raylint (ISSUE 19).
+
+Every function/method in the project is classified into the EXECUTION
+DOMAINS it can run on, and the classification propagates through a
+whole-program call graph. Domains:
+
+* ``event-loop`` — coroutines. Component ``EventLoopThread``s (gcs-io,
+  raylet-io, serve replica loops — the paths the blocking-in-handler
+  ``async-scopes`` config names) run every ``async def`` in the tree;
+  two coroutines on one loop interleave only at ``await``, so the whole
+  async world is ONE domain for data-race purposes.
+* ``daemon:<name>`` — functions reachable from a
+  ``threading.Thread(target=...)`` construction site: the span flusher
+  (``rt-span-flusher``), the event-log drainer, the ``iter_jax_batches``
+  device-feed producer, serve reconcile loops. One domain per thread
+  name (the ``name=`` kwarg when it is a string literal, else the
+  target's function name), so two *different* daemon threads touching
+  the same attribute count as two domains.
+* ``executor`` — functions shipped to ``loop.run_in_executor(...)``:
+  they run on anonymous thread-pool threads, concurrently with
+  everything else.
+* ``user`` — the default for PUBLIC sync functions and methods: they
+  run on whatever thread the caller happens to hold (the driver thread,
+  a test thread). Private sync helpers inherit their callers' domains;
+  a private helper nothing seeds also defaults to ``user``.
+* ``construction`` — ``__init__``-family methods and the private
+  helpers only they reach. Construction happens-before the object is
+  published to any other thread, so this pseudo-domain can never race
+  with anything; RTL010 excludes it from its >=2-domain count.
+
+Propagation: domains flow caller -> callee over resolved call edges
+(``self.method()``, module-local calls, and cross-module calls through
+the import table). A private sync helper called only from handlers is
+``event-loop``; the same helper also called from a daemon loop carries
+both domains — which is exactly when an unsynchronized ``self.x += 1``
+inside it becomes a data race (RTL010). Async defs keep a fixed
+``{event-loop}``: calling a coroutine function from sync code only
+*creates* the coroutine; it executes on whichever loop awaits it.
+
+The model also computes ``entry_locks``: the set of lock nodes every
+static caller of a function provably holds at the call (the
+``*_locked``-helper pattern — ``GcsSpanManager._promote_locked`` runs
+under ``self._lock`` at every call site, so its mutations are guarded
+even though no ``with`` appears in its own body).
+
+New daemon threads are inferred automatically from ``Thread(target=)``
+construction sites; a thread built through a helper/factory the
+inference cannot see registers its entry point explicitly in
+``raylint.toml`` ``[tool.raylint.domains] daemon-entry-points``
+(``"<relpath>:<Class.method-or-function>"`` strings) — CONTRIBUTING
+"shared mutable state names its lock and its domain". Callbacks the
+event loop invokes through a callable attribute (``on_worker_death=``,
+pubsub subscriptions) register in ``loop-entry-points`` the same way.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
+
+from tools.raylint.core import (
+    Module,
+    Project,
+    dotted_name,
+    module_name_of,
+    str_const,
+)
+
+EVENT_LOOP = "event-loop"
+USER = "user"
+EXECUTOR = "executor"
+# pseudo-domain: __init__-family methods and the private helpers only
+# they reach. Construction happens-before publication, so this domain
+# never races with anything — RTL010 excludes it from the >=2 count.
+CONSTRUCTION = "construction"
+CONSTRUCTION_METHODS = frozenset(
+    {"__init__", "__new__", "__post_init__", "__init_subclass__"})
+
+# function key: (relpath, enclosing class or None, function name)
+FuncKey = Tuple[str, Optional[str], str]
+
+DEFAULT_LOCK_NAME_RE = r"(?:^|_)(lock|rlock|mutex|cv|cond|condition)s?$"
+DEFAULT_THREAD_CLASSES = ["Thread"]
+DEFAULT_EXECUTOR_CALLS = ["run_in_executor"]
+# loop-dispatch primitives: the callback they take runs ON the loop
+DEFAULT_LOOP_CALLS = ["call_soon", "call_soon_threadsafe",
+                      "call_later", "call_at"]
+
+# entry_locks lattice top: "every lock" (shrinks via intersection)
+_ALL_LOCKS = None  # sentinel: unknown-yet == universe
+
+
+def lock_node(mod: Module, cls: Optional[str],
+              expr: ast.AST, lock_re) -> Optional[str]:
+    """`with self._lock:` in class C of module m -> "m:C._lock" — the
+    same node naming RTL002 uses, so one lock site is one node across
+    every domain-aware check."""
+    name = dotted_name(expr)
+    if name is None:
+        return None
+    leaf = name.rsplit(".", 1)[-1]
+    if not lock_re.search(leaf):
+        return None
+    modname = module_name_of(mod.relpath)
+    if name.startswith("self."):
+        scope = cls or ""
+        return f"{modname}:{scope}.{name[len('self.'):]}"
+    return f"{modname}:{name}"
+
+
+class FuncInfo:
+    __slots__ = ("key", "node", "module", "cls", "is_async", "domains",
+                 "calls", "entry_locks", "seed_reasons")
+
+    def __init__(self, key: FuncKey, node: ast.AST, module: Module,
+                 cls: Optional[str]):
+        self.key = key
+        self.node = node
+        self.module = module
+        self.cls = cls
+        self.is_async = isinstance(node, ast.AsyncFunctionDef)
+        self.domains: Set[str] = set()
+        # [(resolved FuncKey, held lock nodes at the call, lineno)]
+        self.calls: List[Tuple[FuncKey, Tuple[str, ...], int]] = []
+        self.entry_locks: Optional[FrozenSet[str]] = _ALL_LOCKS
+        self.seed_reasons: List[str] = []
+
+    @property
+    def is_public(self) -> bool:
+        n = self.key[2]
+        return not n.startswith("_") or (n.startswith("__")
+                                         and n.endswith("__"))
+
+
+class _ModuleImports:
+    """Per-module import table: alias -> dotted module, plus
+    from-imports name -> (dotted module, original name)."""
+
+    def __init__(self, mod: Module):
+        self.aliases: Dict[str, str] = {}
+        self.from_imports: Dict[str, Tuple[str, str]] = {}
+        pkg = module_name_of(mod.relpath)
+        pkg_parts = pkg.split(".")
+        is_pkg = mod.relpath.endswith("/__init__.py")
+        for node in mod.nodes():
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        self.aliases[a.asname] = a.name
+                    else:
+                        # `import a.b.c` binds `a`; dotted use resolves
+                        # by appending the remaining attribute path
+                        self.aliases[a.name.split(".")[0]] = \
+                            a.name.split(".")[0]
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    # relative: `from .x import f` inside pkg a.b ->
+                    # module a.b.x (level counts dropped trailing parts;
+                    # a package module's own dotted name IS its package)
+                    drop = node.level - (1 if is_pkg else 0)
+                    base = pkg_parts[:len(pkg_parts) - drop] if drop \
+                        else pkg_parts
+                    module = ".".join(base + ([node.module]
+                                              if node.module else []))
+                else:
+                    module = node.module or ""
+                for a in node.names:
+                    local = a.asname or a.name
+                    if a.name == "*":
+                        continue
+                    self.from_imports[local] = (module, a.name)
+
+
+class DomainModel:
+    """Call graph + domain sets + caller-held-lock entry sets over one
+    Project. Built once per lint run and shared by RTL010/011/012 (and
+    any future domain-aware check) via get_domain_model()."""
+
+    def __init__(self, project: Project, options: Optional[dict] = None):
+        options = options or {}
+        self.project = project
+        self.lock_re = re.compile(
+            options.get("lock-name-regex", DEFAULT_LOCK_NAME_RE), re.I)
+        self.thread_classes = set(options.get(
+            "thread-classes", DEFAULT_THREAD_CLASSES))
+        self.executor_calls = set(options.get(
+            "executor-calls", DEFAULT_EXECUTOR_CALLS))
+        self.loop_calls = set(options.get(
+            "loop-calls", DEFAULT_LOOP_CALLS))
+        self.extra_entry_points = list(options.get(
+            "daemon-entry-points", []))
+        self.loop_entry_points = list(options.get(
+            "loop-entry-points", []))
+        self.functions: Dict[FuncKey, FuncInfo] = {}
+        # [(construction relpath, lineno, target FuncKey, domain label)]
+        self.daemon_sites: List[Tuple[str, int, FuncKey, str]] = []
+        self._imports: Dict[str, _ModuleImports] = {}
+        self._mod_by_dotted: Dict[str, str] = {}
+        self._build()
+
+    # ---------------------------------------------------------------- query
+    def info(self, relpath: str, cls: Optional[str],
+             name: str) -> Optional[FuncInfo]:
+        return self.functions.get((relpath, cls, name))
+
+    def domains_of(self, relpath: str, cls: Optional[str],
+                   name: str) -> FrozenSet[str]:
+        fi = self.functions.get((relpath, cls, name))
+        return frozenset(fi.domains) if fi else frozenset()
+
+    def entry_locks_of(self, relpath: str, cls: Optional[str],
+                       name: str) -> FrozenSet[str]:
+        fi = self.functions.get((relpath, cls, name))
+        if fi is None or fi.entry_locks is _ALL_LOCKS:
+            return frozenset()
+        return fi.entry_locks
+
+    # ---------------------------------------------------------------- build
+    def _build(self) -> None:
+        for mod in self.project.modules:
+            self._mod_by_dotted[module_name_of(mod.relpath)] = mod.relpath
+            for cls, fn in mod.functions():
+                key = (mod.relpath, cls, fn.name)
+                self.functions[key] = FuncInfo(key, fn, mod, cls)
+        for mod in self.project.modules:
+            self._imports[mod.relpath] = _ModuleImports(mod)
+        for mod in self.project.modules:
+            for cls, fn in mod.functions():
+                self._scan_function(mod, cls, fn)
+        self._seed()
+        self._propagate()
+        self._compute_entry_locks()
+
+    # ------------------------------------------------------------- resolve
+    def _resolve(self, mod: Module, cls: Optional[str],
+                 target: str) -> Optional[FuncKey]:
+        """Dotted call target -> FuncKey, through self-methods, locals
+        (incl. nested defs), from-imports, module aliases, and
+        Class.method on an imported class. None when unresolvable
+        (dynamic dispatch, library call) — the model under-approximates
+        rather than guessing."""
+        imports = self._imports.get(mod.relpath)
+        if target.startswith("self."):
+            rest = target[len("self."):]
+            if "." in rest:
+                return None
+            key = (mod.relpath, cls, rest)
+            return key if key in self.functions else None
+        parts = target.split(".")
+        if len(parts) == 1:
+            for probe in ((mod.relpath, cls, target),
+                          (mod.relpath, None, target)):
+                if probe in self.functions:
+                    return probe
+            if imports and target in imports.from_imports:
+                dotted, orig = imports.from_imports[target]
+                rel = self._mod_by_dotted.get(dotted)
+                if rel:
+                    key = (rel, None, orig)
+                    return key if key in self.functions else None
+            return None
+        # Class.method through a from-imported (or same-module) class
+        if len(parts) == 2:
+            key = (mod.relpath, parts[0], parts[1])
+            if key in self.functions:
+                return key
+            if imports and parts[0] in imports.from_imports:
+                dotted, orig = imports.from_imports[parts[0]]
+                rel = self._mod_by_dotted.get(dotted)
+                if rel:
+                    key = (rel, orig, parts[1])
+                    if key in self.functions:
+                        return key
+        # module-attribute paths through import aliases
+        if imports:
+            head = imports.aliases.get(parts[0])
+            if head is not None:
+                parts = head.split(".") + parts[1:]
+            elif parts[0] in imports.from_imports:
+                # `from a import b` where b is a submodule
+                dotted, orig = imports.from_imports[parts[0]]
+                full = f"{dotted}.{orig}" if dotted else orig
+                parts = full.split(".") + parts[1:]
+            for split in range(len(parts) - 1, 0, -1):
+                dotted = ".".join(parts[:split])
+                rel = self._mod_by_dotted.get(dotted)
+                if rel is None:
+                    continue
+                rest = parts[split:]
+                if len(rest) == 1:
+                    key = (rel, None, rest[0])
+                elif len(rest) == 2:
+                    key = (rel, rest[0], rest[1])
+                else:
+                    return None
+                return key if key in self.functions else None
+        return None
+
+    # ---------------------------------------------------------------- scan
+    def _scan_function(self, mod: Module, cls: Optional[str],
+                       fn: ast.AST) -> None:
+        """One pass over a function body (nested defs excluded — they
+        are their own FuncInfos): call edges with the held-lock stack,
+        thread-construction seeds, executor-submission seeds."""
+        fi = self.functions[(mod.relpath, cls, fn.name)]
+
+        def walk(node: ast.AST, held: Tuple[str, ...]) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef, ast.Lambda)):
+                return
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                new_held = held
+                for item in node.items:
+                    lk = lock_node(mod, cls, item.context_expr,
+                                   self.lock_re)
+                    if lk is not None:
+                        new_held = new_held + (lk,)
+                    else:
+                        walk(item.context_expr, held)
+                for stmt in node.body:
+                    walk(stmt, new_held)
+                return
+            if isinstance(node, ast.Call):
+                self._scan_call(mod, cls, fi, node, held)
+            for child in ast.iter_child_nodes(node):
+                walk(child, held)
+
+        for stmt in fn.body:
+            walk(stmt, ())
+
+    def _scan_call(self, mod: Module, cls: Optional[str], fi: FuncInfo,
+                   node: ast.Call, held: Tuple[str, ...]) -> None:
+        target = dotted_name(node.func)
+        if target is None:
+            return
+        leaf = target.rsplit(".", 1)[-1]
+        if leaf in self.thread_classes:
+            self._seed_thread_site(mod, cls, node)
+            return  # Thread(...) itself is not a call edge to target
+        if leaf in self.executor_calls and len(node.args) >= 2:
+            tkey = self._deferred_target(mod, cls, node.args[1])
+            if tkey is not None:
+                self._seed_key(tkey, EXECUTOR,
+                               f"run_in_executor at {mod.relpath}:"
+                               f"{node.lineno}")
+        if leaf in self.loop_calls:
+            # call_soon(fn)/call_soon_threadsafe(fn) vs call_later(delay,
+            # fn)/call_at(when, fn): the callback runs ON the loop
+            idx = 1 if leaf in ("call_later", "call_at") else 0
+            if len(node.args) > idx:
+                tkey = self._deferred_target(mod, cls, node.args[idx])
+                if tkey is not None:
+                    self._seed_key(tkey, EVENT_LOOP,
+                                   f"{leaf} at {mod.relpath}:"
+                                   f"{node.lineno}")
+        callee = self._resolve(mod, cls, target)
+        if callee is not None:
+            fi.calls.append((callee, held, node.lineno))
+
+    def _deferred_target(self, mod: Module, cls: Optional[str],
+                         expr: ast.AST) -> Optional[FuncKey]:
+        """A callback expression (`target=self._run`, `target=loop`,
+        a partial(f, ...)) -> the FuncKey it will invoke, if static."""
+        if isinstance(expr, ast.Call):  # functools.partial(f, ...)
+            t = dotted_name(expr.func)
+            if t and t.rsplit(".", 1)[-1] == "partial" and expr.args:
+                return self._deferred_target(mod, cls, expr.args[0])
+            return None
+        name = dotted_name(expr)
+        if name is None:
+            return None
+        return self._resolve(mod, cls, name)
+
+    def _seed_thread_site(self, mod: Module, cls: Optional[str],
+                          node: ast.Call) -> None:
+        target_expr = None
+        label = None
+        for kw in node.keywords:
+            if kw.arg == "target":
+                target_expr = kw.value
+            elif kw.arg == "name":
+                label = str_const(kw.value)
+        if target_expr is None and node.args:
+            # Thread(group, target, ...) — positional target is arg 1
+            if len(node.args) >= 2:
+                target_expr = node.args[1]
+        if target_expr is None:
+            return
+        tkey = self._deferred_target(mod, cls, target_expr)
+        if tkey is None:
+            return
+        domain = f"daemon:{label or tkey[2]}"
+        self.daemon_sites.append((mod.relpath, node.lineno, tkey, domain))
+        self._seed_key(tkey, domain,
+                       f"Thread(target=...) at {mod.relpath}:"
+                       f"{node.lineno}")
+
+    def _seed_key(self, key: FuncKey, domain: str, reason: str) -> None:
+        fi = self.functions.get(key)
+        if fi is None or fi.is_async:
+            return  # a coroutine target stays event-loop
+        fi.domains.add(domain)
+        fi.seed_reasons.append(reason)
+
+    # ---------------------------------------------------------------- seed
+    def _seed(self) -> None:
+        for fi in self.functions.values():
+            if fi.is_async:
+                fi.domains = {EVENT_LOOP}
+            elif fi.key[2] in CONSTRUCTION_METHODS:
+                fi.domains.add(CONSTRUCTION)
+        for spec in self.extra_entry_points:
+            relpath, _, qual = spec.partition(":")
+            cls, _, name = qual.rpartition(".")
+            key = (relpath, cls or None, name)
+            self._seed_key(key, f"daemon:{name}",
+                           f"raylint.toml daemon-entry-points {spec!r}")
+        # callbacks handed to loop-running machinery through a callable
+        # attribute (pool.on_worker_death, pubsub subscriptions): the
+        # resolver cannot see the indirection, so the config names them
+        for spec in self.loop_entry_points:
+            relpath, _, qual = spec.partition(":")
+            cls, _, name = qual.rpartition(".")
+            key = (relpath, cls or None, name)
+            self._seed_key(key, EVENT_LOOP,
+                           f"raylint.toml loop-entry-points {spec!r}")
+
+    def _propagate(self) -> None:
+        """Flow domains caller -> callee to a fixpoint, then apply the
+        user default for sync functions."""
+        worklist: List[FuncKey] = [k for k, fi in self.functions.items()
+                                   if fi.domains]
+        while worklist:
+            key = worklist.pop()
+            fi = self.functions[key]
+            for callee_key, _held, _line in fi.calls:
+                callee = self.functions.get(callee_key)
+                if callee is None or callee.is_async:
+                    continue  # async callee executes on its own loop
+                before = len(callee.domains)
+                callee.domains |= fi.domains
+                if len(callee.domains) != before:
+                    worklist.append(callee_key)
+        for fi in self.functions.values():
+            if fi.is_async or fi.key[2] in CONSTRUCTION_METHODS:
+                continue
+            if fi.is_public or not fi.domains:
+                fi.domains.add(USER)
+
+    def _compute_entry_locks(self) -> None:
+        """entry_locks(f) = ∩ over static call sites of
+        (locks held at the call ∪ entry_locks(caller)). Externally
+        callable functions (public, async, daemon/executor entry
+        points) get ∅ — an outside caller holds nothing. Descends from
+        the universe sentinel, so the fixpoint is the greatest one."""
+        callers: Dict[FuncKey, List[Tuple[FuncKey, Tuple[str, ...]]]] = {}
+        for key, fi in self.functions.items():
+            for callee, held, _line in fi.calls:
+                callers.setdefault(callee, []).append((key, held))
+
+        def externally_callable(fi: FuncInfo) -> bool:
+            return (fi.is_public or fi.is_async or fi.seed_reasons
+                    or not callers.get(fi.key))
+
+        for fi in self.functions.values():
+            if externally_callable(fi):
+                fi.entry_locks = frozenset()
+        for _ in range(8):  # bounded fixpoint; depth-8 private chains
+            changed = False
+            for key, fi in self.functions.items():
+                if fi.entry_locks == frozenset() and \
+                        externally_callable(fi):
+                    continue
+                acc: Optional[FrozenSet[str]] = _ALL_LOCKS
+                for caller_key, held in callers.get(key, ()):
+                    caller = self.functions.get(caller_key)
+                    centry = (caller.entry_locks
+                              if caller and caller.entry_locks
+                              is not _ALL_LOCKS else frozenset())
+                    site = frozenset(held) | centry
+                    acc = site if acc is _ALL_LOCKS else (acc & site)
+                if acc is _ALL_LOCKS:
+                    acc = frozenset()
+                if acc != fi.entry_locks:
+                    fi.entry_locks = acc
+                    changed = True
+            if not changed:
+                break
+        for fi in self.functions.values():
+            if fi.entry_locks is _ALL_LOCKS:
+                fi.entry_locks = frozenset()
+
+    # ---------------------------------------------------------------- repr
+    def describe(self, relpath: str, cls: Optional[str],
+                 name: str) -> str:
+        fi = self.functions.get((relpath, cls, name))
+        if fi is None:
+            return "<unknown function>"
+        doms = ", ".join(sorted(fi.domains)) or "<none>"
+        return f"{cls + '.' if cls else ''}{name} runs on: {doms}"
+
+
+def get_domain_model(project: Project,
+                     options: Optional[dict] = None) -> DomainModel:
+    """The per-run shared model (RTL010/011/012 all need it; building
+    it is the expensive whole-program pass, so it is cached on the
+    Project)."""
+    model = getattr(project, "_domain_model", None)
+    if model is None:
+        model = DomainModel(project, options)
+        project._domain_model = model
+    return model
