@@ -251,4 +251,18 @@ _d("flight_recorder_dir", "")           # "" = <session>/flight next to log_dir
 # on_exit=True explicitly; this flips it for drivers too)
 _d("flight_recorder_on_exit", False)
 
+# --- cluster health plane (ray_tpu/health/) -----------------------------------
+_d("health_push_interval_s", 5.0)      # per-process metric snapshot cadence
+_d("health_push_max_pending", 4)       # unsent-snapshot bound (overflow = drop)
+_d("health_eval_interval_s", 5.0)      # GCS-side SLO evaluation cadence
+# multiplies every rule window (fast ~5m, slow ~1h) — drills/smokes set
+# this <1 to compress the clock while exercising the production rules
+# unchanged (e.g. 0.05: 5m->15s, 1h->3m)
+_d("health_window_scale", 1.0)
+_d("health_store_max_series", 2000)    # distinct (name, tags) series bound
+_d("health_store_raw_points", 720)     # raw ring length per series
+_d("health_store_rollup_buckets", 360)  # rollup buckets kept per tier
+# emit the health.slo_eval heartbeat every N evals (sparse by design)
+_d("health_eval_log_every", 12)
+
 CONFIG.load_from_env()

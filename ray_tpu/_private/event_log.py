@@ -162,6 +162,15 @@ EVENT_SCHEMAS: Dict[str, Tuple[str, ...]] = {
     # tools/perf_gate.py: a gated benchmark metric fell past its noise
     # band vs the BENCH_* trajectory (the CI perf-regression gate)
     "perf.regression": ("metric", "baseline", "current", "band"),
+    # cluster health plane (ISSUE 20): the GCS-side streaming SLO engine
+    # (health/engine.py) flips a rule's state — one firing/resolved pair
+    # per incident by construction (state-machine dedup + flap damping),
+    # so drills can cross-check alert timelines against injection ground
+    # truth. health.slo_eval is a sparse heartbeat (every
+    # health_eval_log_every evals) proving the evaluator is running.
+    "alert.firing": ("rule", "severity", "value"),
+    "alert.resolved": ("rule", "severity", "duration_s"),
+    "health.slo_eval": ("rules", "firing"),
 }
 
 _ID_KEYS = ("task_id", "actor_id", "node_id", "object_id", "trace_id")

@@ -407,8 +407,11 @@ async function pageServe() {
 const CHART_COLORS = ["#4f86f7", "#e0723c", "#3cb371", "#c95fcf",
                       "#d9b036", "#56b8c9", "#e05c6c", "#8a8f98"];
 
-function svgChart(title, series, fmt) {
-  // series: [{name, points: [[t, v], ...]}]; vanilla inline SVG, no deps
+function svgChart(title, series, fmt, gapS) {
+  // series: [{name, points: [[t, v], ...], stale}]; vanilla inline SVG,
+  // no deps. Points carry their collection stamps, so a sampling gap
+  // larger than `gapS` BREAKS the line instead of drawing a flat bridge
+  // — a dead sampler looks dead, not flat.
   const W = 560, H = 150, PAD = 36;
   const all = series.flatMap((s) => s.points);
   if (!all.length) {
@@ -422,22 +425,36 @@ function svgChart(title, series, fmt) {
   const sy = (v) => H - 18 - (H - 30) * (v / vmax);
   const lines = series.map((s, i) => {
     const color = CHART_COLORS[i % CHART_COLORS.length];
-    if (s.points.length === 1) {
-      const [t, v] = s.points[0];
-      return `<circle cx="${sx(t).toFixed(1)}" cy="${sy(v).toFixed(1)}"
-        r="2.5" fill="${color}"/>`;
+    // split into segments at sampling gaps
+    const segs = [];
+    let seg = [];
+    for (const p of s.points) {
+      if (seg.length && gapS && p[0] - seg[seg.length - 1][0] > gapS) {
+        segs.push(seg); seg = [];
+      }
+      seg.push(p);
     }
-    const pts = s.points.map(
-      (p) => `${sx(p[0]).toFixed(1)},${sy(p[1]).toFixed(1)}`).join(" ");
-    return `<polyline points="${pts}" fill="none" stroke="${color}"
-      stroke-width="1.5"/>`;
+    if (seg.length) segs.push(seg);
+    return segs.map((pts) => {
+      if (pts.length === 1) {
+        const [t, v] = pts[0];
+        return `<circle cx="${sx(t).toFixed(1)}" cy="${sy(v).toFixed(1)}"
+          r="2.5" fill="${color}"/>`;
+      }
+      const pstr = pts.map(
+        (p) => `${sx(p[0]).toFixed(1)},${sy(p[1]).toFixed(1)}`).join(" ");
+      return `<polyline points="${pstr}" fill="none" stroke="${color}"
+        stroke-width="1.5"/>`;
+    }).join("");
   }).join("");
   const legend = series.map((s, i) => {
     const color = CHART_COLORS[i % CHART_COLORS.length];
     const last = s.points.length ? s.points[s.points.length - 1][1] : 0;
+    const stale = s.stale
+      ? ` <span class="status dead">stale ${s.stale}s</span>` : "";
     return `<span class="legend-item">
       <span class="swatch" style="background:${color}"></span>
-      ${esc(s.name)} <span class="muted">${fmt(last)}</span></span>`;
+      ${esc(s.name)} <span class="muted">${fmt(last)}</span>${stale}</span>`;
   }).join(" ");
   const span = Math.max(1, t1 - t0);
   return `<div class="chart"><h4>${esc(title)}</h4>
@@ -457,45 +474,128 @@ function svgChart(title, series, fmt) {
 async function pageMetrics() {
   const data = await getJSON("/api/metrics_timeseries");
   const series = data.series || {};
+  const staleAfter = data.stale_after_s || 15;
+  const staleS = data.stale_s || {};
   const pick = (re) => Object.keys(series).filter((k) => re.test(k)).sort()
-    .map((k) => ({name: k, points: series[k]}));
+    .map((k) => ({name: k, points: series[k],
+                  stale: staleS[k] > staleAfter
+                    ? staleS[k].toFixed(0) : null}));
   const ms = (v) => `${(v * 1e3).toFixed(2)}ms`;
   const num = (v) => v >= 100 ? v.toFixed(0) : v.toFixed(2);
   const mib = (v) => `${(v / 2 ** 20).toFixed(1)}MiB`;
   const pct = (v) => `${num(v)}%`;
+  // break chart lines at sampling gaps wider than the staleness bound
+  const chart = (t, s, f) => svgChart(t, s, f, staleAfter);
   const charts = [
-    svgChart("Task throughput (tasks/s)",
+    chart("Task throughput (tasks/s)",
              pick(/^task_throughput$/), num),
-    svgChart("Stage latency p50 (submit/queue/rpc/dispatch/execute/reply)",
+    chart("Stage latency p50 (submit/queue/rpc/dispatch/execute/reply)",
              pick(/^stage_.*_p50$/), ms),
-    svgChart("Stage latency p99", pick(/^stage_.*_p99$/), ms),
-    svgChart("End-to-end task latency",
+    chart("Stage latency p99", pick(/^stage_.*_p99$/), ms),
+    chart("End-to-end task latency",
              pick(/^task_total_.*_p(50|90|99)$/), ms),
-    svgChart("Object store used (arena / capacity / spilled)",
+    chart("Object store used (arena / capacity / spilled)",
              pick(/^store_(used|capacity|spilled)_bytes$/), mib),
-    svgChart("Object refs (owned / borrowed / pinned, cluster-wide)",
+    chart("Object refs (owned / borrowed / pinned, cluster-wide)",
              pick(/^object_refs_/), num),
-    svgChart("KV blocks (free / cached / active)",
+    chart("KV blocks (free / cached / active)",
              pick(/^kv_blocks_/), num),
-    svgChart("Worker leases (active / queued)",
+    chart("Worker leases (active / queued)",
              pick(/^leases_/), num),
-    svgChart("Node CPU %", pick(/^node_cpu_percent_/), pct),
-    svgChart("LLM serving latency (TTFT / TPOT p50,p99)",
+    chart("Node CPU %", pick(/^node_cpu_percent_/), pct),
+    chart("LLM serving latency (TTFT / TPOT p50,p99)",
              pick(/^llm_t(tft|pot)_/), ms),
-    svgChart("LLM queue depth (per engine replica)",
+    chart("LLM queue depth (per engine replica)",
              pick(/^llm_queue_depth_/), num),
-    svgChart("LLM batch occupancy", pick(/^llm_batch_occupancy_/), num),
-    svgChart("Device step phases p50 (input_wait/h2d/compile/execute/reply)",
+    chart("LLM batch occupancy", pick(/^llm_batch_occupancy_/), num),
+    chart("Device step phases p50 (input_wait/h2d/compile/execute/reply)",
              pick(/^device_phase_.*_p50$/), ms),
-    svgChart("Device step phases p99", pick(/^device_phase_.*_p99$/), ms),
-    svgChart("Device MFU (per profiler)", pick(/^device_mfu_/), num),
-    svgChart("HBM bytes (in use / peak, per device)",
+    chart("Device step phases p99", pick(/^device_phase_.*_p99$/), ms),
+    chart("Device MFU (per profiler)", pick(/^device_mfu_/), num),
+    chart("HBM bytes (in use / peak, per device)",
              pick(/^hbm_(in_use|peak)_/), mib),
   ].join("");
+  const smp = data.sampler || {};
+  const banner = smp.healthy === false
+    ? `<p class="error">sampler unhealthy: last successful sample
+       ${smp.age_s != null ? smp.age_s + "s ago" : "never"}
+       (${smp.consecutive_failures || 0} consecutive failures) —
+       series below are STALE, not flat</p>` : "";
   return `<h2>Live metrics
-    <span class="muted">(ring-buffered, ${data.sample_period_s ?? 5}s
+    <span class="muted">(GCS health store, ${data.sample_period_s ?? 5}s
     cadence; stage series need task activity in the head's process)</span>
-    </h2><div class="charts">${charts}</div>`;
+    </h2>${banner}<div class="charts">${charts}</div>`;
+}
+
+// ---- health (ISSUE 20: SLO scorecard + alerts + demand signals) ------------
+
+const fmtNum = (v) => v == null ? "-"
+  : Math.abs(v) >= 100 ? Number(v).toFixed(0) : Number(v).toFixed(3);
+
+async function pageHealth() {
+  let h;
+  try { h = await getJSON("/api/health"); }
+  catch (e) {
+    return `<h2>Health</h2><p class="muted">health plane unavailable
+      (GCS predating it, or unreachable): ${esc(e)}</p>`;
+  }
+  let hist = [];
+  try { hist = (await getJSON("/api/alerts")).history || []; } catch {}
+  const d = h.demand || {};
+  const store = h.store || {};
+  const tiles = [
+    ["alerts firing", (h.alerts || []).length],
+    ["nodes alive", d.nodes_alive ?? "-"],
+    ["req rate /s", fmtNum((d.serve || {}).request_rate)],
+    ["shed rate /s", fmtNum((d.serve || {}).shed_rate)],
+    ["TTFT p99 s", fmtNum((d.serve || {}).ttft_p99_s)],
+    ["metric series", store.series ?? "-"],
+  ].map(([k, v]) => `<div class="tile"><div class="v">${v}</div>
+      <div class="k">${k}</div></div>`).join("");
+  const score = table(
+    ["rule", "severity", "state", "value", "threshold", "description"],
+    (h.scorecard || []).map((r) => [
+      td(esc(r.rule), "mono"),
+      td(esc(r.severity)),
+      statusCell(r.firing ? "FIRING" : "OK"),
+      td(fmtNum(r.value), "mono"),
+      td(fmtNum(r.threshold), "mono"),
+      td(esc(r.description || "")),
+    ]));
+  const hrows = hist.slice(-50).reverse().map((ev) => [
+    td(new Date(ev.time * 1000).toLocaleTimeString()),
+    statusCell(ev.type === "alert.firing" ? "FIRING" : "RESOLVED"),
+    td(esc(ev.rule), "mono"),
+    td(esc(ev.severity)),
+    td(ev.duration_s != null ? `${fmtNum(ev.duration_s)}s`
+       : fmtNum(ev.value), "mono"),
+  ]);
+  const pools = Object.entries(d.pools || {}).sort().map(([k, p]) =>
+    meter(k, p.total - p.available, p.total)).join("");
+  const pending = d.pending || {};
+  const pushRows = Object.entries(h.push_sources || {}).sort().map(
+    ([src, st]) => [
+      td(esc(src), "mono"), td(st.pushed ?? 0),
+      td(st.dropped ?? 0, st.dropped ? "dead" : ""),
+      td(`${fmtNum(st.lag_s)}s`),
+    ]);
+  return `<h2>Health
+      <span class="muted">(SLO scorecard · burn-rate alerts · demand
+      signals)</span></h2>
+    <div class="tiles">${tiles}</div>
+    <h3>SLO scorecard</h3>${score}
+    <h3>Alert history <span class="muted">(newest first)</span></h3>
+    ${table(["time", "event", "rule", "severity", "value/duration"], hrows)}
+    <h3>Demand signals</h3>
+    <p class="muted">pending PG bundles:
+      ${esc(JSON.stringify(pending.pg_bundles || []))} · task demands:
+      ${esc(JSON.stringify(pending.task_demands || []))}</p>
+    ${pools || '<p class="muted">no pool data</p>'}
+    <h3>Metric push sources</h3>
+    ${table(["source", "pushed", "dropped", "lag"], pushRows)}
+    <p class="muted">store: ${store.series ?? 0} series
+      (${store.series_dropped ?? 0} refused past the bound),
+      ${store.points_ingested ?? 0} points ingested</p>`;
 }
 
 async function pageLogs() {
@@ -519,7 +619,7 @@ const PAGES = {
   overview: pageOverview, nodes: pageNodes, actors: pageActors,
   tasks: pageTasks, jobs: pageJobs, pgs: pagePGs, serve: pageServe,
   logs: pageLogs, timeline: pageTimeline, metrics: pageMetrics,
-  traces: pageTraces,
+  traces: pageTraces, health: pageHealth,
 };
 let timer = null;
 

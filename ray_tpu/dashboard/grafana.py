@@ -32,6 +32,24 @@ def _panel(panel_id: int, title: str, exprs: List[dict], y: int,
     }
 
 
+# annotation overlay: every panel gets vertical firing/resolved marks
+# wherever ray_tpu_alerts_firing flips — the Grafana-side mirror of the
+# GCS SLO engine's alert.firing/alert.resolved events
+_ALERT_ANNOTATIONS = {
+    "list": [
+        {
+            "name": "SLO alerts",
+            "datasource": {"type": "prometheus", "uid": "${datasource}"},
+            "enable": True,
+            "iconColor": "red",
+            "expr": "ray_tpu_alerts_firing > 0",
+            "titleFormat": "{{rule}} ({{severity}})",
+            "useValueForTime": False,
+        },
+    ]
+}
+
+
 def generate_grafana_dashboard(
         extra_metric_names: Optional[List[str]] = None) -> dict:
     """-> importable Grafana dashboard dict for the core cluster series."""
@@ -71,11 +89,38 @@ def generate_grafana_dashboard(
         _panel(6, "Paged-KV blocks", [
             {"expr": "ray_tpu_kv_blocks", "legend": "{{state}}"},
         ], y=24),
+        # cluster health plane (ISSUE 20)
+        _panel(7, "SLO alerts firing (per rule)", [
+            {"expr": "ray_tpu_alerts_firing",
+             "legend": "{{rule}} ({{severity}})"},
+        ], y=24),
+        _panel(8, "Serve requests by outcome (rate)", [
+            {"expr": "rate(ray_tpu_serve_requests_total[5m])",
+             "legend": "{{outcome}}"},
+        ], y=32, unit="reqps"),
+        _panel(9, "Serve availability burn rate "
+                  "(5m error-frac / 0.1% objective)", [
+            {"expr": "(1 - sum(rate(ray_tpu_serve_requests_total"
+                     '{outcome="ok"}[5m])) / '
+                     "sum(rate(ray_tpu_serve_requests_total[5m]))) "
+                     "/ 0.001",
+             "legend": "burn (fires >10)"},
+        ], y=32),
+        _panel(10, "Lifecycle events by type (rate)", [
+            {"expr": "rate(ray_tpu_events_by_type_total[5m])",
+             "legend": "{{type}}"},
+        ], y=40),
+        _panel(11, "Metric push health (pushes / drops)", [
+            {"expr": "rate(ray_tpu_health_pushes_total[5m])",
+             "legend": "pushes {{proc}}"},
+            {"expr": "rate(ray_tpu_health_push_dropped_total[5m])",
+             "legend": "drops {{proc}}"},
+        ], y=40),
     ]
-    next_id = 7
+    next_id = 12
     for name in extra_metric_names or []:
         panels.append(_panel(next_id, name, [{"expr": name}],
-                             y=32 + 8 * ((next_id - 7) // 2)))
+                             y=48 + 8 * ((next_id - 12) // 2)))
         next_id += 1
     return {
         "title": "ray_tpu cluster",
@@ -87,6 +132,7 @@ def generate_grafana_dashboard(
             "name": "datasource", "type": "datasource",
             "query": "prometheus",
         }]},
+        "annotations": _ALERT_ANNOTATIONS,
         "panels": panels,
     }
 

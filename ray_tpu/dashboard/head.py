@@ -87,6 +87,14 @@ class DashboardHead:
         self._ts_sampling = threading.Lock()   # one sampler at a time
         self._ts: Dict[str, deque] = {}
         self._ts_last_sample = 0.0
+        # sampler health (ISSUE 20 satellite): a failed sample used to be
+        # a debug log + a last point persisting indefinitely — now every
+        # failure is counted, surfaced in /api/metrics_timeseries, and
+        # logged at warning (rate-limited) so "flat" and "dead" are
+        # distinguishable
+        self._ts_last_success = 0.0
+        self._ts_fail_count = 0
+        self._ts_last_warn = 0.0
         self._ts_tp_prev_t: Optional[float] = None
         self._ts_finished_cum = 0
         self._ts_event_watermarks: Dict[str, float] = {}
@@ -216,6 +224,12 @@ class DashboardHead:
                 node_id=(q.get("node_id", [None])[0])))
         elif path == "/api/metrics_timeseries":
             self._json(req, self._timeseries())
+        elif path == "/api/health":
+            # cluster health plane (ISSUE 20): scorecard + firing alerts
+            # + demand signals, straight from the GCS metrics manager
+            self._json(req, self._gcs.call("get_health", {}, timeout=10))
+        elif path == "/api/alerts":
+            self._json(req, self._gcs.call("get_alerts", {}, timeout=10))
         elif path == "/metrics":
             self._respond(req, self._metrics_text(),
                           "text/plain; version=0.0.4")
@@ -476,7 +490,17 @@ class DashboardHead:
             try:
                 self._ts_sample()
             except Exception:  # noqa: BLE001 — sampler must never die
-                logger.debug("timeseries sample failed", exc_info=True)
+                self._ts_fail_count += 1
+                now = time.time()
+                if now - self._ts_last_warn > 60.0:
+                    self._ts_last_warn = now
+                    logger.warning(
+                        "timeseries sample failed (%d consecutive; series "
+                        "are going stale, last success %.0fs ago)",
+                        self._ts_fail_count,
+                        now - self._ts_last_success
+                        if self._ts_last_success else -1.0,
+                        exc_info=True)
 
     def _ts_add(self, name: str, t: float, value: float) -> None:
         buf = self._ts.get(name)
@@ -507,8 +531,26 @@ class DashboardHead:
             self._ts_collect(now, points)
             with self._ts_lock:
                 self._ts_last_sample = now
+                self._ts_last_success = now
+                self._ts_fail_count = 0
                 for name, value in points:
                     self._ts_add(name, now, value)
+            # Ship the collected points to the GCS health store (ISSUE
+            # 20): the dashboard ring becomes a warm cache over the
+            # cluster-wide store, so series survive dashboard restarts.
+            # Tagged src=dash so _timeseries can query exactly its own
+            # families back without pattern-matching names.
+            if points:
+                try:
+                    import os as _os
+
+                    self._gcs.send("push_metrics", {
+                        "source": "dashboard", "pid": _os.getpid(),
+                        "time": now,
+                        "points": [[name, {"src": "dash"}, float(v)]
+                                   for name, v in points]})
+                except Exception:  # noqa: BLE001 — GCS mid-restart
+                    logger.debug("metric push failed", exc_info=True)
         finally:
             self._ts_sampling.release()
 
@@ -711,12 +753,16 @@ class DashboardHead:
                 continue
 
     def _timeseries(self) -> Dict[str, Any]:
-        # Serve the ring buffers (at most one background cycle stale).
-        # Sample on demand ONLY while they are still empty — so the first
-        # page load has data, without paying the multi-second cluster
-        # fan-out on an HTTP request thread during an incident (nodes
-        # mid-death make the fan-out slowest exactly when the user opens
-        # the dashboard to look).
+        # Thin query over the GCS health store (ISSUE 20): the sampler
+        # pushes every collected point there tagged src=dash, so the
+        # series are cluster-wide state that survives dashboard restarts.
+        # The local ring buffers stay as the fallback when the GCS (or a
+        # GCS predating the RPC) can't answer. Sample on demand ONLY
+        # while the rings are still empty — so the first page load has
+        # data, without paying the multi-second cluster fan-out on an
+        # HTTP request thread during an incident (nodes mid-death make
+        # the fan-out slowest exactly when the user opens the dashboard
+        # to look).
         with self._ts_lock:
             empty = not self._ts
         if empty:
@@ -724,12 +770,45 @@ class DashboardHead:
                 self._ts_sample()
             except Exception:  # noqa: BLE001
                 logger.debug("on-demand sample failed", exc_info=True)
+        now = time.time()
+        series: Dict[str, list] = {}
+        try:
+            for row in self._gcs.call(
+                    "query_metrics",
+                    {"tags": {"src": "dash"}, "resolution": "raw",
+                     "since": now - 3600.0, "limit_series": 500},
+                    timeout=10):
+                series[row["name"]] = [list(p) for p in row["points"]]
+        except Exception:  # noqa: BLE001 — store-less GCS: local rings
+            logger.debug("query_metrics failed; serving local rings",
+                         exc_info=True)
+            with self._ts_lock:
+                series = {k: list(v) for k, v in self._ts.items()}
+        # Per-series staleness from each point's collection stamp, plus
+        # sampler health — so the SPA and the health scorecard can
+        # distinguish a legitimately flat series from a dead sampler.
+        stale_s = {
+            name: round(now - pts[-1][0], 1)
+            for name, pts in series.items() if pts}
         with self._ts_lock:
-            return {
-                "now": time.time(),
-                "sample_period_s": TS_SAMPLE_PERIOD_S,
-                "series": {k: list(v) for k, v in self._ts.items()},
-            }
+            last_success = self._ts_last_success
+            failures = self._ts_fail_count
+        return {
+            "now": now,
+            "sample_period_s": TS_SAMPLE_PERIOD_S,
+            "series": series,
+            "stale_s": stale_s,
+            "stale_after_s": TS_SAMPLE_PERIOD_S * 3,
+            "sampler": {
+                "last_success": last_success,
+                "age_s": (round(now - last_success, 1)
+                          if last_success else None),
+                "consecutive_failures": failures,
+                "healthy": bool(
+                    last_success
+                    and now - last_success < TS_SAMPLE_PERIOD_S * 3),
+            },
+        }
 
     def _metrics_text(self) -> str:
         from ray_tpu.util.metrics import prometheus_text
@@ -747,7 +826,13 @@ class DashboardHead:
                     f'ray_tpu_cluster_resource_available{{resource="{name}"}}'
                     f' {v}')
             alive = sum(1 for n in status["nodes"].values() if n["alive"])
-            lines.append(f"ray_tpu_cluster_nodes_alive {alive}")
+            from ray_tpu.util.metrics import get_metric
+
+            if get_metric("ray_tpu_cluster_nodes_alive") is None:
+                # embedded heads share a registry with the GCS, whose
+                # metrics manager exports this as a real gauge — don't
+                # emit the raw line twice
+                lines.append(f"ray_tpu_cluster_nodes_alive {alive}")
         except Exception:  # noqa: BLE001 — GCS may be mid-restart
             pass
         return "\n".join(lines) + "\n"

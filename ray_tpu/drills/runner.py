@@ -138,6 +138,37 @@ def _build_workload(config: DrillConfig, scenario) -> Any:
         replica_resources=replica_resources)
 
 
+# health-plane clock compression for drills: the production SLO rules
+# (health/slo_rules.json) run UNCHANGED, but every window is scaled so a
+# ~60s drill can observe a full fire->resolve alert cycle (5m->15s,
+# 1h->3m) and push/eval cadences keep up with it. Set via CONFIG before
+# the cluster builds so spawned workers inherit through RT_SYSTEM_CONFIG.
+_HEALTH_DRILL_KNOBS = {
+    "health_eval_interval_s": 0.5,
+    "health_push_interval_s": 1.0,
+    "health_window_scale": 0.05,
+}
+
+
+def _set_health_knobs() -> Dict[str, Any]:
+    from ray_tpu._private.config import CONFIG
+
+    saved = {k: CONFIG.get(k) for k in _HEALTH_DRILL_KNOBS}
+    for k, v in _HEALTH_DRILL_KNOBS.items():
+        CONFIG.set(k, v)
+    return saved
+
+
+def _restore_health_knobs(saved: Dict[str, Any]) -> None:
+    from ray_tpu._private.config import CONFIG
+
+    for k, v in saved.items():
+        try:
+            CONFIG.set(k, v)
+        except Exception:  # noqa: BLE001 — restore best-effort
+            pass
+
+
 # -- event plumbing -----------------------------------------------------------
 
 def _fetch_events(since: float) -> List[dict]:
@@ -153,6 +184,48 @@ def _fetch_events(since: float) -> List[dict]:
 def _find_marker(events: List[dict], scenario_name: str) -> Optional[dict]:
     markers = slo.find_injections(events, scenario_name)
     return markers[-1] if markers else None
+
+
+def _await_alerts_resolved(expected_rule: Optional[str] = None,
+                           timeout_s: float = 45.0,
+                           fire_grace_s: float = 10.0) -> None:
+    """Post-recovery grace: poll get_alerts until the SLO engine has no
+    active alerts (or the bound passes), so the final event fetch can see
+    the alert.resolved half of the fire->resolve pair. When the
+    scenario's thresholds name an alert_rule, first wait (briefly) for
+    that rule to FIRE — "no active alerts" is also true before the
+    engine's next eval pass has seen the injection, and returning then
+    would fetch events without either half of the pair. Bounded and
+    best-effort — a stuck or never-firing alert shows up as a verdict
+    failure via the thresholds' alert_rule cross-check, not as a hang
+    here."""
+    from ray_tpu._raylet import get_core_worker
+
+    fire_deadline = time.monotonic() + fire_grace_s
+    deadline = time.monotonic() + timeout_s
+    seen_expected = expected_rule is None
+    while time.monotonic() < deadline:
+        try:
+            reply = get_core_worker()._gcs.call(
+                "get_alerts", {}, timeout=5.0)
+        except Exception:  # noqa: BLE001 — health plane absence ≠ hang
+            return
+        reply = reply or {}
+        if not seen_expected:
+            fired = any(a.get("rule") == expected_rule
+                        for a in (reply.get("active") or [])) \
+                or any(h.get("rule") == expected_rule
+                       for h in (reply.get("history") or []))
+            if fired:
+                seen_expected = True
+            elif time.monotonic() >= fire_deadline:
+                return  # never fired: let the verdict report it
+            else:
+                time.sleep(0.5)
+                continue
+        if not reply.get("active"):
+            return
+        time.sleep(0.5)
 
 
 def _await_recovery(scenario_name: str, since: float,
@@ -225,6 +298,7 @@ def run_drill(config: DrillConfig) -> Dict[str, Any]:
     cluster = None
     workload = None
     workload_summary: Dict[str, Any] = {}
+    saved_health_knobs = _set_health_knobs()
     try:
         logger.warning("drill %s (seed=%d, budget=%.0fs) starting",
                        config.scenario, config.seed, config.budget_s)
@@ -246,6 +320,7 @@ def run_drill(config: DrillConfig) -> Dict[str, Any]:
         _settle(workload, scenario, config, deadline)
         workload_summary = workload.stop()
         workload = None
+        _await_alerts_resolved(thresholds.get("alert_rule"))
         events = _fetch_events(t_wall_start)
         report = slo.compute_report(
             events, config.scenario, config.seed, thresholds,
@@ -268,6 +343,7 @@ def run_drill(config: DrillConfig) -> Dict[str, Any]:
             report["slo"]["lost_accepted"])
         return report
     finally:
+        _restore_health_knobs(saved_health_knobs)
         if workload is not None:
             try:
                 workload.stop()

@@ -449,6 +449,36 @@ def controller_slo(events: List[dict],
     }
 
 
+def alerts_timeline(events: List[dict]) -> List[dict]:
+    """One row per SLO-alert incident from the health plane's typed
+    `alert.firing` / `alert.resolved` events, folded per rule in causal
+    order. Cross-check material only — the drill verdict derives from
+    the drill's own markers; these rows prove the PRODUCTION alerting
+    path observed the same incident (thresholds.json `alert_rule`).
+    Never part of the fingerprint: alert timing varies with eval cadence,
+    not with the seed."""
+    rows: List[dict] = []
+    open_by_rule: Dict[str, dict] = {}
+    for ev in order_events(events):
+        etype = ev.get("type")
+        if etype not in ("alert.firing", "alert.resolved"):
+            continue
+        d = _data(ev)
+        rule = d.get("rule")
+        if etype == "alert.firing":
+            row = {"rule": rule, "severity": d.get("severity"),
+                   "fired_at": ev.get("time"), "value": d.get("value"),
+                   "resolved_at": None, "duration_s": None}
+            rows.append(row)
+            open_by_rule[rule] = row
+        else:
+            row = open_by_rule.pop(rule, None)
+            if row is not None:
+                row["resolved_at"] = ev.get("time")
+                row["duration_s"] = d.get("duration_s")
+    return rows
+
+
 # -- report + verdict ---------------------------------------------------------
 
 def evaluate_thresholds(slo: Dict[str, Any],
@@ -457,8 +487,8 @@ def evaluate_thresholds(slo: Dict[str, Any],
     mttr_max_s, availability_min, max_lost_accepted,
     require_checkpoint_drain, max_replicas_restarted, require_adoption,
     goodput_min_frac, max_flood_lost, learner_gap_max_s,
-    max_stale_trained, require_monotonic_learner_steps. Returns the
-    list of failures (empty = verdict passes)."""
+    max_stale_trained, require_monotonic_learner_steps, alert_rule.
+    Returns the list of failures (empty = verdict passes)."""
     failures = []
     mttr_max = thresholds.get("mttr_max_s")
     if mttr_max is not None:
@@ -554,6 +584,26 @@ def evaluate_thresholds(slo: Dict[str, Any],
                 failures.append(
                     f"{flood_lost} flood tasks failed untyped "
                     "(every refusal must be shed or deadline-expired)")
+    # production-alert cross-check (CONTRIBUTING: every scenario names
+    # its alert rule or opts out): the health plane's SLO engine must
+    # have observed the SAME incident the drill injected — a firing for
+    # the named rule at-or-after the injection, later resolved.
+    alert_rule = thresholds.get("alert_rule")
+    if alert_rule is not None:
+        injected = [r["injected_at"] for r in slo.get("timeline", [])
+                    if r.get("injected_at") is not None]
+        t0 = min(injected) if injected else None
+        rows = [a for a in slo.get("alerts", [])
+                if a.get("rule") == alert_rule
+                and (t0 is None or (a.get("fired_at") or 0.0) >= t0)]
+        if not rows:
+            failures.append(
+                f"production alert {alert_rule!r} never fired after the "
+                "injection (health plane missed the incident)")
+        elif not any(a.get("resolved_at") is not None for a in rows):
+            failures.append(
+                f"production alert {alert_rule!r} fired but never "
+                "resolved (health plane missed the recovery)")
     return failures
 
 
@@ -610,6 +660,7 @@ def compute_report(events: List[dict], scenario: str, seed: int,
             1 for e in events if e.get("type") == "gang.checkpoint_drain"),
         "preempt_notices": sum(
             1 for e in events if e.get("type") == "node.preempt_notice"),
+        "alerts": alerts_timeline(events),
     }
     storm = overload_slo(events, scenario)
     if storm is not None:

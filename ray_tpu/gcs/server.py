@@ -41,6 +41,7 @@ from ray_tpu._private.specs import (
 )
 from ray_tpu.gcs import pubsub as ps
 from ray_tpu.gcs.actor_manager import GcsActorManager
+from ray_tpu.gcs.metrics_manager import GcsMetricsManager
 from ray_tpu.gcs.pg_manager import GcsPlacementGroupManager
 from ray_tpu.gcs.storage import make_store
 
@@ -865,6 +866,8 @@ class GcsServer:
         self.task_event_manager = GcsTaskEventManager()
         self.event_manager = GcsEventManager()
         self.span_manager = GcsSpanManager()
+        self.metrics_manager = GcsMetricsManager(self.node_manager,
+                                                 self.event_manager)
         # The head process's lifecycle events skip the wire entirely; the
         # token scopes teardown so a later sink owner isn't clobbered.
         self._event_sink_token = event_log.set_sink(
@@ -877,6 +880,7 @@ class GcsServer:
         self.job_manager.add_finish_listener(self.actor_manager.on_job_finished)
         self.address: Optional[str] = None
         self._health_task = None
+        self._slo_eval_task = None
 
     def start(self, port: int = 0) -> str:
         for mgr in (
@@ -888,6 +892,7 @@ class GcsServer:
             self.task_event_manager,
             self.event_manager,
             self.span_manager,
+            self.metrics_manager,
         ):
             self._server.register_all(mgr)
         self._server.register("drain_node", self._handle_drain_node)
@@ -905,6 +910,7 @@ class GcsServer:
         self.address = self._server.start(port)
         self._pool.set_local_id(self.address)
         self._health_task = self._lt.submit(self.node_manager.health_check_loop())
+        self._slo_eval_task = self._lt.submit(self.metrics_manager.eval_loop())
         # resume actors/PGs that were mid-schedule when a previous GCS
         # incarnation stopped (no-ops on a fresh start)
         self._lt.loop.call_soon_threadsafe(self.actor_manager.recover)
@@ -1140,6 +1146,9 @@ class GcsServer:
         _tracing.clear_span_sink(self._span_sink_token)
         if self._health_task is not None:
             self._health_task.cancel()
+        if self._slo_eval_task is not None:
+            self._slo_eval_task.cancel()
+        self.metrics_manager.stop()
         self.publisher.close()
         self._pool.close_all()
         self._server.stop()

@@ -266,6 +266,16 @@ class Raylet:
                                           "stats": stats})
 
         self._span_sink_token = _tracing.set_span_sink(_ship_spans)
+        # Metric-snapshot push path (health plane): same first-wins shape
+        # — in an embedded head the GCS's direct sink already owns the
+        # process pusher, so this no-ops there.
+        from ray_tpu.health import push as _health_push
+
+        def _ship_metrics(payload):
+            gcs_client.send("push_metrics", payload)
+
+        self._metrics_push_token = _health_push.set_push_sink(
+            _ship_metrics, f"raylet:{self.node_id.hex()[:8]}")
         info = NodeInfo(
             node_id=self.node_id,
             raylet_address=self.address,
@@ -870,6 +880,9 @@ class Raylet:
         if getattr(self, "_span_sink_token", None) is not None:
             _tracing.flush_spans(timeout=0.5)
             _tracing.clear_span_sink(self._span_sink_token)
+        if getattr(self, "_metrics_push_token", None) is not None:
+            from ray_tpu.health import push as _health_push
+            _health_push.clear_push_sink(self._metrics_push_token)
         for t in self._tasks:
             t.cancel()
         if self._store_client is not None:

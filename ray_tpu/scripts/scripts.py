@@ -1630,6 +1630,125 @@ def cmd_healthcheck(args) -> int:
         lt.stop()
 
 
+def _fmt_alert_value(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.3g}"
+    return str(v)
+
+
+def cmd_health(args) -> int:
+    """`ray-tpu health`: live SLO scorecard + demand signals from the GCS
+    health plane (metrics store, burn-rate engine, demand bus)."""
+    from ray_tpu._private.rpc import EventLoopThread, RpcClient
+
+    gcs_addr = args.address or os.environ.get("RT_ADDRESS")
+    if not gcs_addr:
+        print("--address (or RT_ADDRESS) is required", file=sys.stderr)
+        return 1
+    lt = EventLoopThread("health-cli")
+    try:
+        reply = RpcClient(gcs_addr, lt).call("get_health", {}, timeout=10)
+    except Exception as e:  # noqa: BLE001 — unreachable GCS is the answer
+        print(f"health query failed: {e}", file=sys.stderr)
+        return 1
+    finally:
+        lt.stop()
+    if args.json:
+        print(json.dumps(reply, indent=2, default=str))
+        firing = [r for r in reply.get("scorecard", []) if r.get("firing")]
+        return 1 if firing else 0
+    return render_health(reply)
+
+
+def render_health(reply: dict) -> int:
+    scorecard = reply.get("scorecard", [])
+    firing = [r for r in scorecard if r.get("firing")]
+    print(f"cluster health @ {time.strftime('%H:%M:%S', time.localtime(reply.get('time', time.time())))}"
+          f" — {len(firing)} alert(s) firing, {len(scorecard)} rules")
+    print("  SLO scorecard:")
+    for row in scorecard:
+        state = "FIRING" if row.get("firing") else "ok"
+        line = (f"    [{state:>6}] {row['rule']:<28} {row['severity']:<7}"
+                f" value={_fmt_alert_value(row.get('value'))}"
+                f" threshold={_fmt_alert_value(row.get('threshold'))}")
+        print(line)
+        if row.get("firing") and row.get("description"):
+            print(f"             {row['description']}")
+    demand = reply.get("demand") or {}
+    serve = demand.get("serve") or {}
+    rl = demand.get("rl") or {}
+    pending = demand.get("pending") or {}
+    print("  demand signals:")
+    print(f"    serve : queue={_fmt_alert_value(serve.get('queue_depth'))}"
+          f" req/s={_fmt_alert_value(serve.get('request_rate'))}"
+          f" ok/s={_fmt_alert_value(serve.get('ok_rate'))}"
+          f" shed/s={_fmt_alert_value(serve.get('shed_rate'))}"
+          f" ttft_p99={_fmt_alert_value(serve.get('ttft_p99_s'))}s")
+    print(f"    rl    : shed/s={_fmt_alert_value(rl.get('sample_shed_rate'))}"
+          f" stale/s={_fmt_alert_value(rl.get('stale_drop_rate'))}")
+    print(f"    sched : pending_pg_bundles="
+          f"{_fmt_alert_value(pending.get('pg_bundles'))}"
+          f" task_demands={_fmt_alert_value(pending.get('task_demands'))}"
+          f" nodes_alive={_fmt_alert_value(demand.get('nodes_alive'))}")
+    for res, pool in sorted((demand.get("pools") or {}).items()):
+        print(f"    pool  : {res:<8} util="
+              f"{_fmt_alert_value(pool.get('utilization'))}"
+              f" ({_fmt_alert_value(pool.get('available'))}"
+              f"/{_fmt_alert_value(pool.get('total'))} free)")
+    store = reply.get("store") or {}
+    print(f"  store : {store.get('series', 0)} series, "
+          f"{store.get('points_ingested', 0)} points ingested, "
+          f"{store.get('series_dropped', 0)} series dropped, "
+          f"{len(reply.get('push_sources') or [])} push sources")
+    return 1 if firing else 0
+
+
+def cmd_alerts(args) -> int:
+    """`ray-tpu alerts [--history]`: active SLO alerts (and recent
+    fire/resolve transitions) from the GCS SLO engine."""
+    from ray_tpu._private.rpc import EventLoopThread, RpcClient
+
+    gcs_addr = args.address or os.environ.get("RT_ADDRESS")
+    if not gcs_addr:
+        print("--address (or RT_ADDRESS) is required", file=sys.stderr)
+        return 1
+    lt = EventLoopThread("alerts-cli")
+    try:
+        reply = RpcClient(gcs_addr, lt).call("get_alerts", {}, timeout=10)
+    except Exception as e:  # noqa: BLE001
+        print(f"alert query failed: {e}", file=sys.stderr)
+        return 1
+    finally:
+        lt.stop()
+    if args.json:
+        print(json.dumps(reply, indent=2, default=str))
+        return 1 if reply.get("active") else 0
+    return render_alerts(reply, history=args.history)
+
+
+def render_alerts(reply: dict, history: bool = False) -> int:
+    active = reply.get("active") or []
+    if not active:
+        print("no alerts firing")
+    for a in active:
+        fired = time.strftime("%H:%M:%S", time.localtime(a.get("fired_at", 0)))
+        print(f"  FIRING {a['rule']:<28} {a.get('severity', '?'):<7} "
+              f"since {fired} value={_fmt_alert_value(a.get('value'))}")
+    if history:
+        rows = reply.get("history") or []
+        print(f"  history ({len(rows)} transitions, newest last):")
+        for h in rows:
+            t = time.strftime("%H:%M:%S", time.localtime(h.get("time", 0)))
+            extra = (f"after {_fmt_alert_value(h.get('duration_s'))}s"
+                     if h.get("type") == "alert.resolved"
+                     else f"value={_fmt_alert_value(h.get('value'))}")
+            print(f"    {t} {h.get('type', '?'):<15} "
+                  f"{h.get('rule', '?'):<28} {extra}")
+    return 1 if active else 0
+
+
 # --------------------------------------------------------------------- main
 
 
@@ -1863,6 +1982,20 @@ def main(argv=None) -> int:
     sp.add_argument("--address")
     sp.add_argument("--timeout", type=float, default=5.0)
     sp.set_defaults(fn=cmd_healthcheck)
+
+    sp = sub.add_parser(
+        "health", help="SLO scorecard + demand signals (exit 1 if firing)")
+    sp.add_argument("--address")
+    sp.add_argument("--json", action="store_true")
+    sp.set_defaults(fn=cmd_health)
+
+    sp = sub.add_parser(
+        "alerts", help="active SLO alerts (exit 1 if any firing)")
+    sp.add_argument("--address")
+    sp.add_argument("--history", action="store_true",
+                    help="also print recent fire/resolve transitions")
+    sp.add_argument("--json", action="store_true")
+    sp.set_defaults(fn=cmd_alerts)
 
     sp = sub.add_parser("kill-random-node",
                         help="chaos: ungracefully kill a random worker node")

@@ -60,6 +60,27 @@ def default_num_shards() -> int:
     return max(1, min(4, os.cpu_count() or 1))
 
 
+SERVE_REQUESTS_NAME = "ray_tpu_serve_requests_total"
+_requests_metric = None
+
+
+def _requests_counter():
+    """Lazy singleton: the per-shard request-outcome counter feeding the
+    serve availability SLO (health/slo_rules.json
+    serve_availability_burn). Proxy shards are worker processes, so the
+    core-worker metric pusher ships it to the GCS health store."""
+    global _requests_metric
+    if _requests_metric is None:
+        from ray_tpu.util.metrics import get_or_create_counter
+
+        _requests_metric = get_or_create_counter(
+            SERVE_REQUESTS_NAME,
+            "Proxied serve requests by outcome (ok = 2xx/3xx, shed = "
+            "typed pushback 429/503/typed-504, error = everything "
+            "else).", ("outcome",))
+    return _requests_metric
+
+
 def _close_generator(gen) -> None:
     """Best-effort cancel of a replica-side streaming generator after the
     HTTP client disconnects (nobody will consume further chunks)."""
@@ -438,6 +459,20 @@ class ProxyActor:
             if status >= 400:
                 _tracing.force_trace(req_ctx.trace_id,
                                      f"http_{status}")
+            # health plane (ISSUE 20): the serve availability SLO's
+            # denominator — every proxied request gets exactly one
+            # outcome here. "shed" = typed pushback the client can back
+            # off on (never accepted); "error" = accepted work that
+            # failed, which is what burns the availability objective.
+            if status < 400:
+                outcome = "ok"
+            elif status in (429, 503) or (
+                    status == 504
+                    and resp.headers.get("X-Typed-Shed")):
+                outcome = "shed"
+            else:
+                outcome = "error"
+            _requests_counter().inc(tags={"outcome": outcome})
             return resp
 
         async def _route_request(request: "web.Request",

@@ -163,6 +163,14 @@ def collect_llm_metrics(app_name: Optional[str] = None,
     import ray_tpu
     from ray_tpu.serve import context as serve_ctx
 
+    # This process is about to become an AGGREGATOR of other processes'
+    # serving series. Its own health-plane pusher must stop shipping the
+    # merged ray_tpu_llm_* families or the GCS store would count every
+    # replica's series twice (once from the replica that owns it, once
+    # re-badged under this process).
+    from ray_tpu.health import push as _health_push
+
+    _health_push.exclude_prefix(METRIC_PREFIX)
     controller = serve_ctx.get_controller()
     apps = find_llm_apps(controller)
     if app_name is not None:

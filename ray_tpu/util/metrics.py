@@ -223,6 +223,23 @@ def get_or_create_histogram(name: str, description: str = "",
                      tag_keys=tag_keys)
 
 
+def get_or_create_counter(name: str, description: str = "",
+                          tag_keys: Optional[Sequence[str]] = None
+                          ) -> Counter:
+    m = get_metric(name)
+    if isinstance(m, Counter):
+        return m
+    return Counter(name, description, tag_keys=tag_keys)
+
+
+def get_or_create_gauge(name: str, description: str = "",
+                        tag_keys: Optional[Sequence[str]] = None) -> Gauge:
+    m = get_metric(name)
+    if isinstance(m, Gauge):
+        return m
+    return Gauge(name, description, tag_keys=tag_keys)
+
+
 def snapshot_metrics(prefix: str) -> List[Dict]:
     """Serializable CUMULATIVE snapshot of every registered metric whose
     name starts with `prefix`. Counterpart of merge_metrics_snapshot: a

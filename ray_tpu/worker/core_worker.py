@@ -410,6 +410,17 @@ class CoreWorker:
                                           "stats": stats})
 
         self._span_sink_token = _tracing.set_span_sink(_ship_spans)
+        # Metric-snapshot push path (health plane): per-process registry
+        # snapshots on a background cadence. First-wins, same as above —
+        # serve replicas / proxy shards are worker processes, so their
+        # serving histograms reach the GCS store through this.
+        from ray_tpu.health import push as _health_push
+
+        def _ship_metrics(payload):
+            gcs_client.send("push_metrics", payload)
+
+        self._metrics_push_token = _health_push.set_push_sink(
+            _ship_metrics, f"{mode}:{os.getpid()}")
         if mode == "worker":
             event_log.set_default_proc_label(f"worker:{os.getpid()}")
             event_log.install_flight_recorder(on_exit=True)
@@ -592,6 +603,9 @@ class CoreWorker:
         if getattr(self, "_span_sink_token", None) is not None:
             _tracing.flush_spans(timeout=0.5)
             _tracing.clear_span_sink(self._span_sink_token)
+        if getattr(self, "_metrics_push_token", None) is not None:
+            from ray_tpu.health import push as _health_push
+            _health_push.clear_push_sink(self._metrics_push_token)
         self.executor.shutdown()
         if self.plasma is not None:
             try:

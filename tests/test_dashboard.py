@@ -2,6 +2,7 @@
 endpoints against a live cluster)."""
 
 import json
+import time
 import urllib.request
 
 import pytest
@@ -125,8 +126,17 @@ def test_dashboard_prometheus_metrics(dash_cluster):
     c.inc(3, tags={"k": "v"})
     text = _get(dash_cluster.dashboard_url + "/metrics")
     assert 'dash_test_total{k="v"} 3' in text
-    assert "ray_tpu_cluster_nodes_alive 1" in text
     assert 'ray_tpu_cluster_resource_total{resource="CPU"} 2.0' in text
+    # the nodes-alive gauge is populated by the health eval loop's
+    # control-plane sample pass — allow one eval period for the first one
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        if "ray_tpu_cluster_nodes_alive 1" in text:
+            break
+        time.sleep(0.5)
+        text = _get(dash_cluster.dashboard_url + "/metrics")
+    else:
+        raise AssertionError("ray_tpu_cluster_nodes_alive never exposed")
 
 
 def test_dashboard_404(dash_cluster):

@@ -482,6 +482,19 @@ def test_replica_kill_drill_end_to_end(tmp_path):
     assert s["lost_accepted"] == 0
     assert s["availability"] >= 0.95
     assert s["requests"]["ok"] > 50
+    # health-plane cross-check (ISSUE 20): the PRODUCTION
+    # actor_churn_burst rule must have fired after the injection and
+    # resolved after the recovery — the alert brackets the incident
+    # (availability holds at 1.0 by design, so the churn rule pages)
+    inj_t = s["timeline"][0]["injected_at"]
+    rec_t = s["timeline"][0]["recovered_at"]
+    burn = [a for a in s["alerts"]
+            if a["rule"] == "actor_churn_burst"
+            and a["fired_at"] is not None and a["fired_at"] >= inj_t]
+    assert burn, f"actor_churn_burst never fired: {s['alerts']}"
+    resolved = [a for a in burn if a["resolved_at"] is not None]
+    assert resolved, f"actor_churn_burst never resolved: {burn}"
+    assert resolved[-1]["resolved_at"] >= rec_t
     # the artifact exists and recomputes byte-identically from its events
     from ray_tpu.drills import report_from_events, slo as slo_mod
 

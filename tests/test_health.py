@@ -1,0 +1,542 @@
+"""Cluster health plane fast slice (ISSUE 20): metrics-store delta /
+rollup / quantile math on canned ingests, SLO burn-rate fire-and-resolve
+flips with flap damping, push-queue bounding + drop accounting, demand
+signal shape, rule-file validation, alert<->drill cross-check math, CLI
+rendering, and the prometheus exposition catalog golden.
+
+Everything here is process-local and clock-explicit (timestamps passed
+in, never slept for); the live fire->resolve proof is the slow
+replica_kill drill e2e in test_drills.py plus tools/health_smoke.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from ray_tpu._private.config import CONFIG
+from ray_tpu.health import MetricsStore, SloEngine, SloRule, load_rules
+from ray_tpu.util import metrics as um
+
+pytestmark = pytest.mark.health
+
+T0 = 1_000_000.0
+REQS = "ray_tpu_serve_requests_total"
+
+
+def _small_store(**kw):
+    kw.setdefault("max_series", 64)
+    kw.setdefault("raw_points", 256)
+    kw.setdefault("rollup_buckets", 64)
+    return MetricsStore(**kw)
+
+
+# ------------------------------------------------------------------ store
+
+
+def test_store_counter_watermarks_restart_and_idempotency():
+    st = _small_store()
+    # first observation is the BASELINE, not a delta (prometheus rate())
+    st.ingest_counter_absolute("a", T0, "x_total", None, 100.0)
+    assert st.window_delta("x_total", None, T0 - 60, T0) == (0.0, 0.0)
+    st.ingest_counter_absolute("a", T0 + 10, "x_total", None, 150.0)
+    # re-sending the same cumulative snapshot adds nothing (at-least-once
+    # pushes are safe)
+    st.ingest_counter_absolute("a", T0 + 11, "x_total", None, 150.0)
+    got = st.window_delta("x_total", None, T0, T0 + 20)
+    assert got is not None and got[0] == 50.0
+    # value < watermark = source restart: the full value is the delta
+    st.ingest_counter_absolute("a", T0 + 20, "x_total", None, 30.0)
+    got = st.window_delta("x_total", None, T0, T0 + 30)
+    assert got[0] == 80.0
+    # a second source merges into the same series with its own watermark
+    st.ingest_counter_absolute("b", T0 + 10, "x_total", None, 1000.0)
+    st.ingest_counter_absolute("b", T0 + 20, "x_total", None, 1010.0)
+    got = st.window_delta("x_total", None, T0, T0 + 30)
+    assert got[0] == 90.0
+    assert st.window_rate("x_total", None, 30.0, now=T0 + 30) == \
+        pytest.approx(3.0)
+
+
+def test_store_series_bound_and_kind_guard():
+    st = _small_store(max_series=2)
+    st.ingest_gauge(T0, "g1", None, 1.0)
+    st.ingest_gauge(T0, "g2", None, 2.0)
+    st.ingest_gauge(T0, "g3", None, 3.0)  # refused: over max_series
+    assert st.stats()["series"] == 2
+    assert st.stats()["series_dropped"] == 1
+    # a kind collision must not corrupt the established series
+    st.ingest_counter_absolute("a", T0 + 1, "g1", None, 99.0)
+    assert st.latest_gauge("g1", now=T0 + 2, max_age_s=60) == 1.0
+
+
+def test_store_young_series_still_shows_its_delta():
+    """A series younger than the query window must anchor on its raw
+    baseline, not a rollup bucket's LAST value — regression for the
+    earliest() fallback that made fresh event-counter series read as
+    rate 0 until they crossed a bucket boundary (so a drill's injected
+    kill never breached its rate rule)."""
+    st = _small_store()
+    st.ingest_counter_absolute("gcs", T0, "e_total", None, 0.0)
+    st.ingest_counter_absolute("gcs", T0 + 0.2, "e_total", None, 1.0)
+    got = st.window_delta("e_total", None, T0 - 15.0, T0 + 1.0)
+    assert got is not None and got[0] == 1.0
+    assert st.window_rate("e_total", None, 15.0, now=T0 + 1.0) == \
+        pytest.approx(1.0 / 15.0)
+
+
+def test_store_gauge_staleness_is_dead_not_flat():
+    st = _small_store()
+    st.ingest_gauge(T0, "nodes", None, 3.0)
+    assert st.latest_gauge("nodes", max_age_s=60, now=T0 + 30) == 3.0
+    # past the staleness bound the series is DEAD (None), never a stale 3
+    assert st.latest_gauge("nodes", max_age_s=60, now=T0 + 120) is None
+
+
+def test_store_rollup_math():
+    st = _small_store()
+    for t, v in ((T0, 1.0), (T0 + 3, 5.0), (T0 + 12, 3.0)):
+        st.ingest_gauge(t, "g", None, v)
+    rows = st.query("g", resolution="10s", since=T0 - 1, until=T0 + 20)
+    assert len(rows) == 1
+    pts = rows[0]["points"]
+    assert pts[0] == {"t": T0, "last": 5.0, "min": 1.0, "max": 5.0,
+                      "avg": 3.0}
+    assert pts[1]["last"] == 3.0
+    # counter rollups report per-second rates vs the PREVIOUS bucket
+    st.ingest_counter_absolute("a", T0, "c_total", None, 0.0)
+    st.ingest_counter_absolute("a", T0 + 5, "c_total", None, 50.0)
+    st.ingest_counter_absolute("a", T0 + 12, "c_total", None, 120.0)
+    rows = st.query("c_total", resolution="10s",
+                    since=T0 - 1, until=T0 + 20)
+    pts = rows[0]["points"]
+    assert pts[0]["rate"] == 0.0          # first bucket has no predecessor
+    assert pts[1]["rate"] == pytest.approx(7.0)   # (120-50)/10
+    # raw resolution returns the cumulative ring
+    raw = st.query("c_total", resolution="raw")[0]
+    assert [v for _t, v in raw["points"]] == [0.0, 50.0, 120.0]
+    assert raw["last_t"] == pytest.approx(T0 + 12)
+
+
+def test_store_histogram_window_quantile():
+    st = _small_store()
+    bounds = [0.1, 1.0, 10.0]
+
+    def snap(counts, total_sum, total):
+        return [{"name": "h_seconds", "type": "Histogram",
+                 "boundaries": bounds,
+                 "samples": [((), counts, total_sum, total)]}]
+
+    st.ingest_snapshot("a", T0, snap([0, 0, 0, 0], 0.0, 0))  # baseline
+    st.ingest_snapshot("a", T0 + 10, snap([0, 10, 0, 0], 5.0, 10))
+    # window [T0+5, T0+15]: the baseline anchors the start, the burst
+    # snapshot the end -> 10 observations, all in the (0.1, 1.0] bucket
+    q = st.window_quantile("h_seconds", None, 10.0, 0.5, now=T0 + 15)
+    assert q is not None and 0.1 <= q <= 1.0
+    # no observations in a later window -> None, not 0
+    assert st.window_quantile("h_seconds", None, 2.0, 0.5,
+                              now=T0 + 120) is None
+
+
+# ----------------------------------------------------------------- engine
+
+
+def _feed_requests(st, t, ok, err, state={}):
+    """Ship cumulative ok/error totals for REQS at time t."""
+    cum = state.setdefault(id(st), {"ok": 0.0, "err": 0.0})
+    cum["ok"] += ok
+    cum["err"] += err
+    st.ingest_counter_absolute("w", t, REQS, {"outcome": "ok"}, cum["ok"])
+    st.ingest_counter_absolute("w", t, REQS, {"outcome": "error"},
+                               cum["err"])
+
+
+def _burn_rule(**kw):
+    kw.setdefault("name", "avail")
+    kw.setdefault("kind", "burn_rate")
+    kw.setdefault("metric", REQS)
+    kw.setdefault("good_tags", {"outcome": "ok"})
+    kw.setdefault("objective", 0.99)
+    kw.setdefault("fast_window_s", 60.0)
+    kw.setdefault("slow_window_s", 600.0)
+    kw.setdefault("fast_burn", 10.0)
+    kw.setdefault("slow_burn", 2.0)
+    kw.setdefault("resolve_evals", 2)
+    return SloRule(**kw)
+
+
+def test_burn_rate_fires_and_resolves():
+    assert CONFIG.health_window_scale == 1.0
+    st = _small_store()
+    eng = SloEngine(st, rules=[_burn_rule()])
+    # healthy traffic: no burn
+    for i in range(6):
+        _feed_requests(st, T0 + i * 10, ok=100, err=0)
+    assert eng.evaluate(now=T0 + 60)["firing"] == []
+    # error burst: both windows breach -> fires
+    for i in range(6):
+        _feed_requests(st, T0 + 60 + i * 10, ok=50, err=50)
+    out = eng.evaluate(now=T0 + 120)
+    assert out["firing"] == ["avail"] and out["transitions"] == 1
+    assert eng.active_alerts()[0]["rule"] == "avail"
+    # recovery: once the FAST window is clean (the slow window still
+    # holds the incident — resolution is judged fast-only), the alert
+    # resolves after resolve_evals consecutive clear passes
+    for i in range(8):
+        _feed_requests(st, T0 + 120 + i * 10, ok=100, err=0)
+    assert eng.evaluate(now=T0 + 200)["firing"] == ["avail"]  # clear #1
+    out = eng.evaluate(now=T0 + 210)                          # clear #2
+    assert out["firing"] == [] and out["transitions"] == 1
+    hist = eng.history()
+    assert [h["type"] for h in hist] == ["alert.firing", "alert.resolved"]
+    assert hist[1]["duration_s"] > 0
+
+
+def test_no_traffic_is_not_a_burn():
+    st = _small_store()
+    eng = SloEngine(st, rules=[_burn_rule()])
+    _feed_requests(st, T0, ok=10, err=0)
+    # a window with zero delta must read as no-burn, not fire on 0/0
+    assert eng.evaluate(now=T0 + 300)["firing"] == []
+
+
+def test_flap_damping_both_directions():
+    st = _small_store()
+    rule = SloRule(name="shed", kind="rate_above", metric="s_total",
+                   threshold=3.0, fast_window_s=10.0,
+                   for_evals=2, resolve_evals=2)
+    eng = SloEngine(st, rules=[rule])
+    st.ingest_counter_absolute("a", T0, "s_total", None, 0.0)
+    st.ingest_counter_absolute("a", T0 + 10, "s_total", None, 100.0)
+    # one breaching eval is a blip, not an alert (for_evals=2)
+    assert eng.evaluate(now=T0 + 10)["firing"] == []
+    assert eng.evaluate(now=T0 + 10)["firing"] == ["shed"]
+    # one clear eval does not resolve (resolve_evals=2)
+    assert eng.evaluate(now=T0 + 60)["firing"] == ["shed"]
+    assert eng.evaluate(now=T0 + 60)["firing"] == []
+
+
+def test_gauge_liveness_dead_series_breaches():
+    st = _small_store()
+    rule = SloRule(name="nodes_low", kind="gauge_below",
+                   metric="ray_tpu_cluster_nodes_alive", threshold=1.0,
+                   stale_after_s=60.0, resolve_evals=1)
+    eng = SloEngine(st, rules=[rule])
+    # a DEAD series must breach a liveness rule, never pass as flat
+    assert eng.evaluate(now=T0)["firing"] == ["nodes_low"]
+    st.ingest_gauge(T0 + 10, "ray_tpu_cluster_nodes_alive", None, 2.0)
+    assert eng.evaluate(now=T0 + 11)["firing"] == []
+    # ...and going stale re-fires it
+    assert eng.evaluate(now=T0 + 200)["firing"] == ["nodes_low"]
+
+
+def test_scorecard_shape():
+    st = _small_store()
+    eng = SloEngine(st, rules=[_burn_rule()])
+    rows = eng.scorecard(now=T0)
+    assert rows[0]["rule"] == "avail"
+    assert rows[0]["threshold"] == 10.0  # fast_burn for burn_rate rules
+    assert rows[0]["firing"] is False
+
+
+# -------------------------------------------------------------- rule file
+
+
+def test_shipped_rules_load_and_cover_the_drills():
+    rules = {r.name: r for r in load_rules()}
+    for required in ("serve_availability_burn", "overload_shed_burst",
+                     "actor_churn_burst", "cluster_nodes_low",
+                     "serve_ttft_p99"):
+        assert required in rules, f"slo_rules.json lost {required}"
+    assert rules["serve_availability_burn"].kind == "burn_rate"
+    assert rules["serve_availability_burn"].good_tags == {"outcome": "ok"}
+
+
+def test_rule_validation_rejects_unknowns():
+    with pytest.raises(ValueError, match="unknown kind"):
+        SloRule.from_dict({"name": "x", "kind": "nope", "metric": "m"})
+    with pytest.raises(ValueError, match="unknown keys"):
+        SloRule.from_dict({"name": "x", "kind": "rate_above",
+                           "metric": "m", "thresold": 1.0})
+
+
+def test_every_drill_scenario_names_its_alert_rule_or_opts_out():
+    """The CONTRIBUTING rule, enforced: each scenario's thresholds row
+    either names a production SLO rule (which must exist) or carries an
+    explicit opt-out reason."""
+    from ray_tpu.drills import SCENARIO_CLASSES, load_thresholds
+
+    rules = {r.name for r in load_rules()}
+    table = load_thresholds()
+    for name in SCENARIO_CLASSES:
+        row = table[name]
+        rule = row.get("alert_rule")
+        if rule is not None:
+            assert rule in rules, \
+                f"{name}: alert_rule {rule!r} not in slo_rules.json"
+        else:
+            assert row.get("alert_rule_opt_out"), \
+                f"{name}: no alert_rule and no alert_rule_opt_out reason"
+
+
+# ------------------------------------------- drill <-> alert cross-check
+
+
+def _alert_events():
+    return [
+        {"type": "drill.phase", "time": 100.0,
+         "data": {"scenario": "replica_kill", "phase": "inject"}},
+        {"type": "alert.firing", "time": 105.0,
+         "data": {"rule": "serve_availability_burn", "severity": "page",
+                  "value": 42.0}},
+        {"type": "alert.resolved", "time": 130.0,
+         "data": {"rule": "serve_availability_burn", "severity": "page",
+                  "duration_s": 25.0}},
+    ]
+
+
+def test_alerts_timeline_pairs_incidents():
+    from ray_tpu.drills import slo
+
+    rows = slo.alerts_timeline(_alert_events())
+    assert rows == [{"rule": "serve_availability_burn", "severity": "page",
+                     "fired_at": 105.0, "value": 42.0,
+                     "resolved_at": 130.0, "duration_s": 25.0}]
+    # an unresolved incident keeps resolved_at None
+    rows = slo.alerts_timeline(_alert_events()[:-1])
+    assert rows[0]["resolved_at"] is None
+
+
+def test_alert_events_never_enter_the_drill_fingerprint():
+    """Acceptance: the health plane observes, it never perturbs — the
+    same drill must fingerprint identically with and without alerts."""
+    from ray_tpu.drills import slo
+
+    evs = _alert_events()
+    bare = [e for e in evs if not e["type"].startswith("alert.")]
+    assert slo.fingerprint(evs, "replica_kill") == \
+        slo.fingerprint(bare, "replica_kill")
+
+
+def test_alert_rule_threshold_crosscheck_flips():
+    from ray_tpu.drills import slo as dslo
+
+    base = {"timeline": [{"injected_at": 100.0}],
+            "alerts": dslo.alerts_timeline(_alert_events())}
+    th = {"alert_rule": "serve_availability_burn"}
+    assert dslo.evaluate_thresholds(base, th) == []
+    # never fired -> failure
+    empty = dict(base, alerts=[])
+    assert any("never fired" in f
+               for f in dslo.evaluate_thresholds(empty, th))
+    # fired before the injection doesn't count
+    early = dict(base, timeline=[{"injected_at": 200.0}])
+    assert any("never fired" in f
+               for f in dslo.evaluate_thresholds(early, th))
+    # fired but never resolved -> failure
+    stuck = dict(base,
+                 alerts=dslo.alerts_timeline(_alert_events()[:-1]))
+    assert any("never resolved" in f
+               for f in dslo.evaluate_thresholds(stuck, th))
+
+
+# ------------------------------------------------------------------- push
+
+
+def test_push_queue_bounded_drop_oldest_and_counted():
+    from ray_tpu.health import push
+
+    probe = um.get_or_create_counter(
+        "ray_tpu_health_test_probe_total", "non-empty snapshot for tests")
+    probe.inc(1.0)
+    def _exported_drops():
+        snap = um.snapshot_metrics("ray_tpu_health_push_dropped")
+        return sum(v for e in snap for _t, v in e["samples"])
+
+    saved = CONFIG.get("health_push_max_pending")
+    CONFIG.set("health_push_max_pending", 2)
+    token = None
+    base_drops = _exported_drops()
+    try:
+        push.clear_for_tests()
+
+        def down(_payload):
+            raise RuntimeError("gcs unreachable")
+
+        token = push.set_push_sink(down, "test", force=True)
+        for _ in range(5):
+            push._push_once()
+        stats = push.local_stats()
+        assert stats["pending"] == 2          # bounded, newest kept
+        assert stats["dropped"] == 3          # overflow COUNTED
+        assert stats["pushed"] == 0
+
+        received = []
+        token = push.set_push_sink(received.append, "test", force=True)
+        # this call builds one more payload, evicting one more from the
+        # bounded queue before the (now healthy) send drains the rest
+        push._push_once()
+        stats = push.local_stats()
+        assert stats["pending"] == 0
+        assert stats["dropped"] == 4
+        assert stats["pushed"] == 2           # backlog drained in order
+        assert received[0]["source"] == "test"
+        assert received[-1]["stats"]["dropped"] == 3  # stamped at build
+        names = {e["name"] for e in received[-1]["snapshot"]}
+        assert "ray_tpu_health_test_probe_total" in names
+        # the drop counter is exported as a metric, per ISSUE acceptance
+        assert um.get_metric("ray_tpu_health_push_dropped_total") is not None
+        assert _exported_drops() - base_drops == 4
+    finally:
+        CONFIG.set("health_push_max_pending", saved)
+        push.clear_push_sink(token)
+        push.clear_for_tests()
+
+
+def test_push_exclude_prefix_filters_payload():
+    from ray_tpu.health import push
+
+    um.get_or_create_counter("ray_tpu_llm_test_merged_total",
+                             "aggregator-merged family").inc(1.0)
+    um.get_or_create_counter("ray_tpu_health_test_probe_total",
+                             "non-empty snapshot for tests").inc(1.0)
+    token = None
+    try:
+        push.clear_for_tests()
+        received = []
+        token = push.set_push_sink(received.append, "test", force=True)
+        push.exclude_prefix("ray_tpu_llm_test_merged")
+        push._push_once()
+        names = {e["name"] for e in received[-1]["snapshot"]}
+        assert "ray_tpu_llm_test_merged_total" not in names
+        assert "ray_tpu_health_test_probe_total" in names
+    finally:
+        push.clear_push_sink(token)
+        push.clear_for_tests()
+
+
+# ----------------------------------------------------------------- demand
+
+
+def test_demand_signals_shape():
+    from ray_tpu.health.demand import compute_demand_signals
+
+    st = _small_store()
+    _feed_requests(st, T0, ok=0, err=0, state={})
+    _feed_requests(st, T0 + 30, ok=60, err=0, state={})
+    st.ingest_gauge(T0 + 30, "ray_tpu_llm_queue_depth", None, 4.0)
+    load = {
+        "nodes": {
+            "n1": {"alive": True, "total": {"CPU": 8.0},
+                   "available": {"CPU": 2.0}},
+            "n2": {"alive": False, "total": {"CPU": 4.0},
+                   "available": {"CPU": 4.0}},
+        },
+        "demands": [({"CPU": 1.0}, 3, None)],
+        "pending_pg_bundles": [{"CPU": 1.0}],
+    }
+    sig = compute_demand_signals(st, load, firing_alerts=1, now=T0 + 40)
+    assert sig["version"] == 1
+    assert sig["serve"]["request_rate"] == pytest.approx(1.0)
+    assert sig["serve"]["ok_rate"] == pytest.approx(1.0)
+    assert sig["serve"]["queue_depth"] == 4.0
+    assert sig["serve"]["ttft_p99_s"] is None       # dead series = absent
+    assert sig["pools"]["CPU"]["utilization"] == pytest.approx(0.75)
+    assert sig["nodes_alive"] == 1                  # dead node excluded
+    assert sig["pending"]["task_demands"] == [
+        {"resources": {"CPU": 1.0}, "count": 3}]
+    assert sig["pending"]["pg_bundles"] == [{"CPU": 1.0}]
+    assert sig["alerts_firing"] == 1
+
+
+# -------------------------------------------------------------------- CLI
+
+
+def test_cli_health_and_alerts_render(capsys):
+    from ray_tpu.scripts.scripts import render_alerts, render_health
+
+    reply = {
+        "time": T0,
+        "scorecard": [
+            {"rule": "serve_availability_burn", "kind": "burn_rate",
+             "metric": REQS, "severity": "page", "firing": True,
+             "fired_at": T0 - 30, "value": 42.5, "threshold": 10.0,
+             "description": "serve ok-rate SLO burn"},
+            {"rule": "cluster_nodes_low", "kind": "gauge_below",
+             "metric": "ray_tpu_cluster_nodes_alive", "severity": "page",
+             "firing": False, "fired_at": None, "value": 2.0,
+             "threshold": 1.0, "description": ""},
+        ],
+        "demand": {"serve": {"queue_depth": 3, "request_rate": 12.5},
+                   "rl": {}, "pending": {"pg_bundles": []},
+                   "pools": {"CPU": {"total": 8.0, "available": 2.0,
+                                     "utilization": 0.75}},
+                   "nodes_alive": 2},
+        "store": {"series": 29, "points_ingested": 693,
+                  "series_dropped": 0},
+        "push_sources": {"gcs#1": {"pushed": 10, "dropped": 0}},
+    }
+    assert render_health(reply) == 1  # firing -> exit 1
+    out = capsys.readouterr().out
+    assert "FIRING" in out and "serve_availability_burn" in out
+    assert "cluster_nodes_low" in out and "ok" in out
+    assert "util=0.75" in out
+    assert "29 series" in out
+
+    alerts = {"active": [{"rule": "serve_availability_burn",
+                          "severity": "page", "fired_at": T0,
+                          "value": 42.5}],
+              "history": [
+                  {"type": "alert.firing", "time": T0,
+                   "rule": "serve_availability_burn", "severity": "page",
+                   "value": 42.5},
+                  {"type": "alert.resolved", "time": T0 + 25,
+                   "rule": "serve_availability_burn", "severity": "page",
+                   "duration_s": 25.0}]}
+    assert render_alerts(alerts, history=True) == 1
+    out = capsys.readouterr().out
+    assert "FIRING serve_availability_burn" in out
+    assert "alert.resolved" in out and "after 25s" in out
+    assert render_alerts({"active": []}) == 0
+    assert "no alerts firing" in capsys.readouterr().out
+
+
+# ----------------------------------------------------- exposition catalog
+
+
+def test_prometheus_catalog_golden():
+    """Every ray_tpu_* family the health plane queries must expose HELP +
+    TYPE through prometheus_text() once its real creator has run (the
+    golden list is tests/health_metrics_golden.json)."""
+    golden_path = os.path.join(os.path.dirname(__file__),
+                               "health_metrics_golden.json")
+    with open(golden_path) as f:
+        golden = json.load(f)["metrics"]
+
+    # run each family's REAL creator (no stand-in registrations: the
+    # audit must see the production descriptions)
+    from ray_tpu.serve._private.proxy import _requests_counter
+    _requests_counter()
+    from ray_tpu.serve.llm import metrics as llm_metrics
+    llm_metrics.ttft_histogram()
+    llm_metrics.queue_depth_gauge()
+    from ray_tpu.health import push as health_push
+    assert health_push._get_metrics() is not None
+    from ray_tpu.gcs.metrics_manager import GcsMetricsManager
+    mgr = GcsMetricsManager(node_manager=None, event_manager=None)
+    try:
+        text = um.prometheus_text()
+        missing = []
+        for name in golden:
+            help_line = next(
+                (ln for ln in text.splitlines()
+                 if ln.startswith(f"# HELP {name} ")), None)
+            if help_line is None or not help_line.split(" ", 3)[3].strip():
+                missing.append(f"{name}: no HELP with a description")
+            if f"# TYPE {name} " not in text:
+                missing.append(f"{name}: no TYPE")
+        assert not missing, "\n".join(missing)
+    finally:
+        mgr.stop()

@@ -30,13 +30,18 @@
 #                        the memory fan-out, the leak sweep stays at
 #                        ZERO suspects, no object.leak_suspect events,
 #                        arena bytes back to the pre-churn baseline
-#   9. perf gate       — tools/perf_gate.py --smoke: the newest bench
+#   9. health smoke    — a typed-shed burst on a 2-node cluster must
+#                        fire the production overload_shed_burst SLO
+#                        rule (compressed windows) and RESOLVE after
+#                        the burst, with alert.firing/alert.resolved
+#                        in the cluster event log and a live scorecard
+#  10. perf gate       — tools/perf_gate.py --smoke: the newest bench
 #                        trajectory row vs its history, per-metric
 #                        noise-banded thresholds (loose smoke bands on
 #                        this shared CI host; run WITHOUT --smoke on a
 #                        quiet dedicated host for the strict bands that
 #                        catch r05-class drifts)
-#  10. tier-1 tests    — the full `not slow` suite
+#  11. tier-1 tests    — the full `not slow` suite
 #
 # Usage: tools/ci.sh [--skip-tests]
 set -euo pipefail
@@ -75,6 +80,9 @@ JAX_PLATFORMS=cpu python -m tools.dataplane_smoke --budget 120
 
 echo "== memory smoke (bounded) =="
 JAX_PLATFORMS=cpu python -m tools.memory_smoke --budget 120
+
+echo "== health smoke (bounded) =="
+JAX_PLATFORMS=cpu python -m tools.health_smoke --budget 120
 
 echo "== perf-regression gate (smoke bands) =="
 python -m tools.perf_gate --smoke

@@ -1,0 +1,175 @@
+"""Bounded cluster-health-plane smoke for CI (ISSUE 20 satellite).
+
+Brings up a 2-node in-process cluster with the drill-style compressed
+health clock (production SLO rules unchanged, windows scaled 0.05x),
+injects a shed burst — typed `task.shed` events at ~4x the
+`overload_shed_burst` rule's rate threshold — and asserts the FULL
+production alerting path end to end:
+
+* the burst fires `overload_shed_burst` (GCS event counts -> control-
+  plane sampling -> metrics store -> burn/rate eval -> active alert),
+* `alert.firing` lands in the cluster event log with a timestamp at or
+  after the injection start,
+* after the burst stops the alert RESOLVES (fast-window drain + flap
+  damping) and `alert.resolved` lands after the burst end,
+* `get_health` serves a scorecard + demand signals, at least one push
+  source registered, and the store ingested points,
+* `ray_tpu_alerts_firing` is exposed through prometheus_text().
+
+Exit 0 on success; nonzero with the observed numbers printed.
+
+Usage: JAX_PLATFORMS=cpu python -m tools.health_smoke [--budget 120]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+SHED_HZ = 12.0        # vs overload_shed_burst threshold 3/s
+SHED_BURST_S = 8.0
+RULE = "overload_shed_burst"
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--budget", type=float, default=120.0)
+    args = parser.parse_args()
+    deadline = time.monotonic() + args.budget
+
+    from ray_tpu._private import event_log
+    from ray_tpu._private.config import CONFIG
+    from ray_tpu.cluster_utils import Cluster
+
+    # compressed clock BEFORE the cluster builds (the in-process GCS
+    # reads these live; spawned workers inherit via RT_SYSTEM_CONFIG)
+    CONFIG.set("health_eval_interval_s", 0.5)
+    CONFIG.set("health_push_interval_s", 1.0)
+    CONFIG.set("health_window_scale", 0.05)  # fast 5m -> 15s
+
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 2})
+    try:
+        cluster.add_node(num_cpus=1)
+        cluster.wait_for_nodes()
+        cluster.connect()
+
+        from ray_tpu._raylet import get_core_worker
+
+        gcs = get_core_worker()._gcs
+
+        def alerts():
+            return gcs.call("get_alerts", {}, timeout=10.0)
+
+        ok = True
+        t_inject = time.time()
+        print(f"health smoke: injecting task.shed burst "
+              f"({SHED_HZ:.0f}/s for {SHED_BURST_S:.0f}s)...")
+        fired_during = False
+        burst_end = time.monotonic() + SHED_BURST_S
+        while time.monotonic() < burst_end:
+            event_log.emit("task.shed", layer="smoke", reason="health_smoke")
+            if not fired_during and any(
+                    a["rule"] == RULE for a in alerts().get("active", [])):
+                fired_during = True
+                print(f"  {RULE} FIRING "
+                      f"{time.time() - t_inject:.1f}s after inject")
+            time.sleep(1.0 / SHED_HZ)
+        # keep polling briefly: the rule needs the rate visible over the
+        # (compressed) fast window, which can lag the burst end by an
+        # eval or two
+        grace = time.monotonic() + 10.0
+        while not fired_during and time.monotonic() < min(grace, deadline):
+            if any(a["rule"] == RULE for a in alerts().get("active", [])):
+                fired_during = True
+                print(f"  {RULE} FIRING "
+                      f"{time.time() - t_inject:.1f}s after inject")
+            time.sleep(0.5)
+        t_end = time.time()
+        if not fired_during:
+            print(f"FAIL: {RULE} never fired during the shed burst")
+            ok = False
+
+        # the burst is over: the alert must RESOLVE once the fast window
+        # drains (15s at scale 0.05) + resolve_evals damping
+        resolved = not fired_during
+        while not resolved and time.monotonic() < deadline:
+            if not any(a["rule"] == RULE
+                       for a in alerts().get("active", [])):
+                resolved = True
+                print(f"  {RULE} resolved "
+                      f"{time.time() - t_end:.1f}s after burst end")
+            time.sleep(0.5)
+        if fired_during and not resolved:
+            print(f"FAIL: {RULE} still firing "
+                  f"{time.time() - t_end:.0f}s after the burst ended")
+            ok = False
+
+        # typed transitions in the cluster event log, sanely timestamped
+        event_log.flush(timeout=2.0)
+        events = gcs.call("get_cluster_events",
+                          {"since": t_inject - 60.0, "limit": 100_000},
+                          timeout=10.0) or []
+        fires = [e for e in events if e.get("type") == "alert.firing"
+                 and (e.get("data") or {}).get("rule") == RULE]
+        resolves = [e for e in events if e.get("type") == "alert.resolved"
+                    and (e.get("data") or {}).get("rule") == RULE]
+        if not fires:
+            print("FAIL: no alert.firing event in the cluster log")
+            ok = False
+        elif fires[0].get("time", 0.0) < t_inject - 1.0:
+            print(f"FAIL: alert.firing stamped {fires[0].get('time')} "
+                  f"before the injection at {t_inject}")
+            ok = False
+        if fired_during and not resolves:
+            print("FAIL: no alert.resolved event in the cluster log")
+            ok = False
+        elif resolves and resolves[-1].get("time", 0.0) < t_end - 1.0:
+            print(f"FAIL: alert.resolved stamped {resolves[-1].get('time')} "
+                  f"before the burst end at {t_end}")
+            ok = False
+
+        # the health surface: scorecard + demand + push accounting
+        health = gcs.call("get_health", {}, timeout=10.0)
+        rules = {r["rule"] for r in health.get("scorecard", [])}
+        if RULE not in rules or "serve_availability_burn" not in rules:
+            print(f"FAIL: scorecard missing rules (got {sorted(rules)})")
+            ok = False
+        demand = health.get("demand") or {}
+        for section in ("serve", "rl", "pending", "pools"):
+            if section not in demand:
+                print(f"FAIL: demand signals missing {section!r}: {demand}")
+                ok = False
+        if demand.get("nodes_alive") != 2:
+            print(f"FAIL: demand nodes_alive={demand.get('nodes_alive')}, "
+                  "want 2")
+            ok = False
+        store = health.get("store") or {}
+        if not store.get("points_ingested"):
+            print(f"FAIL: metrics store ingested nothing: {store}")
+            ok = False
+        if not health.get("push_sources"):
+            print("FAIL: no metric push sources registered")
+            ok = False
+
+        # exposition: the engine's gauge must be scrapeable
+        from ray_tpu.util.metrics import prometheus_text
+
+        if "ray_tpu_alerts_firing" not in prometheus_text():
+            print("FAIL: ray_tpu_alerts_firing absent from prometheus_text")
+            ok = False
+
+        print(f"health smoke: fired={fired_during} resolved={resolved} "
+              f"{len(fires)} firing / {len(resolves)} resolved events, "
+              f"{store.get('series')} series / "
+              f"{store.get('points_ingested')} points, "
+              f"{len(health.get('push_sources') or [])} push sources"
+              + ("" if ok else "  [FAILED]"))
+        return 0 if ok else 1
+    finally:
+        cluster.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
